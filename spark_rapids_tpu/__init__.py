@@ -1,0 +1,25 @@
+"""spark_rapids_tpu: a TPU-native columnar SQL accelerator.
+
+A ground-up TPU re-design of the capability set of NVIDIA's RAPIDS
+Accelerator for Apache Spark (reference: /root/reference, v21.06):
+
+- a columnar data plane of accelerator-resident batches
+  (ref: sql-plugin/.../GpuColumnVector.java) built on JAX arrays with
+  static padded shapes, validity masks, and fixed-width string encoding;
+- an expression + operator library executing as XLA programs
+  (ref: GpuExpressions.scala, basicPhysicalOperators.scala);
+- a plan-rewriting engine that tags every operator supported/unsupported
+  and falls back to a CPU reference engine per-subtree
+  (ref: GpuOverrides.scala, RapidsMeta.scala);
+- a tiered HBM -> host -> disk spill store (ref: RapidsBufferStore.scala);
+- partitioned shuffle exchanges over jax.sharding Mesh collectives
+  (ref: shuffle-plugin UCX transport, GpuShuffleExchangeExec.scala).
+
+Unlike the reference, which plugs into Spark's JVM, this package ships its
+own small DataFrame/plan frontend plus a CPU engine (pyarrow-backed) that
+plays the role of "CPU Spark" for differential testing and fallback.
+"""
+
+__version__ = "0.1.0"
+
+from spark_rapids_tpu.config import TpuConf, get_conf, set_conf  # noqa: F401

@@ -1,0 +1,5 @@
+from spark_rapids_tpu.columnar.column import Column, StringColumn, pad_capacity
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar import arrow as arrow_interop  # noqa: F401
+
+__all__ = ["Column", "StringColumn", "ColumnarBatch", "pad_capacity"]

@@ -1,0 +1,179 @@
+"""Arrow RecordBatch <-> device ColumnarBatch conversion.
+
+TPU analog of the reference's row/columnar transitions and host interop:
+HostColumnarToGpu (ref: sql-plugin/.../HostColumnarToGpu.scala) for
+host Arrow -> device, and GpuColumnarToRowExec's device -> host path
+(ref: GpuColumnarToRowExec.scala:287).  Arrow is the host substrate the
+CPU engine and all file formats speak, so this module is the single H2D /
+D2H seam of the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import (
+    AnyColumn,
+    Column,
+    StringColumn,
+    pad_capacity,
+    pad_width,
+)
+
+
+def schema_from_arrow(aschema: pa.Schema) -> T.Schema:
+    return T.Schema(
+        [T.Field(f.name, T.from_arrow_type(f.type), f.nullable)
+         for f in aschema]
+    )
+
+
+def schema_to_arrow(schema: T.Schema) -> pa.Schema:
+    return pa.schema(
+        [pa.field(f.name, T.to_arrow_type(f.dtype), f.nullable)
+         for f in schema.fields]
+    )
+
+
+def _fixed_from_arrow(arr: pa.Array, dtype: T.DataType, cap: int) -> Column:
+    n = len(arr)
+    phys = T.to_numpy_dtype(dtype)
+    if isinstance(dtype, T.DecimalType):
+        np_vals = np.zeros(n, np.int64)
+        pylist = arr.to_pylist()
+        scale = dtype.scale
+        for i, v in enumerate(pylist):
+            if v is not None:
+                np_vals[i] = int(v.scaleb(scale))
+        validity = np.array([v is not None for v in pylist], np.bool_)
+    else:
+        # zero-copy-ish: fill nulls then view as numpy
+        if arr.null_count:
+            validity = np.asarray(arr.is_valid())
+            arr = arr.fill_null(_zero_value(dtype))
+        else:
+            validity = np.ones(n, np.bool_)
+        if isinstance(dtype, T.DateType):
+            np_vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        elif isinstance(dtype, T.TimestampType):
+            np_vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        else:
+            np_vals = arr.to_numpy(zero_copy_only=False)
+    data = np.zeros(cap, phys)
+    data[:n] = np_vals.astype(phys, copy=False)
+    valid = np.zeros(cap, np.bool_)
+    valid[:n] = validity
+    return Column(jnp.asarray(data), jnp.asarray(valid), dtype)
+
+
+def _zero_value(dtype: T.DataType):
+    if isinstance(dtype, T.BooleanType):
+        return False
+    if isinstance(dtype, (T.DateType,)):
+        import datetime
+
+        return datetime.date(1970, 1, 1)
+    if isinstance(dtype, T.TimestampType):
+        import datetime
+
+        return datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+    if isinstance(dtype, (T.FloatType, T.DoubleType)):
+        return 0.0
+    return 0
+
+
+def _string_from_arrow(arr: pa.Array, cap: int) -> StringColumn:
+    n = len(arr)
+    sarr = arr.cast(pa.large_string())
+    buf_offsets = np.frombuffer(sarr.buffers()[1], dtype=np.int64,
+                                count=n + 1, offset=sarr.offset * 8)
+    data_buf = sarr.buffers()[2]
+    raw = np.frombuffer(data_buf, dtype=np.uint8) if data_buf is not None \
+        else np.zeros(0, np.uint8)
+    lengths_np = (buf_offsets[1:] - buf_offsets[:-1]).astype(np.int32)
+    validity = np.asarray(arr.is_valid()) if arr.null_count else np.ones(
+        n, np.bool_)
+    lengths_np = np.where(validity, lengths_np, 0).astype(np.int32)
+    maxw = int(lengths_np.max()) if n else 0
+    w = pad_width(max(maxw, 1))
+    chars = np.zeros((cap, w), np.uint8)
+    for i in range(n):
+        ln = lengths_np[i]
+        if ln:
+            s = buf_offsets[i]
+            chars[i, :ln] = raw[s:s + ln]
+    lengths = np.zeros(cap, np.int32)
+    lengths[:n] = lengths_np
+    valid = np.zeros(cap, np.bool_)
+    valid[:n] = validity
+    return StringColumn(jnp.asarray(chars), jnp.asarray(lengths),
+                        jnp.asarray(valid))
+
+
+def from_arrow(rb: pa.RecordBatch | pa.Table,
+               capacity: Optional[int] = None) -> ColumnarBatch:
+    """Host Arrow batch -> device ColumnarBatch (the H2D upload)."""
+    if isinstance(rb, pa.Table):
+        rb = rb.combine_chunks()
+        arrays = [
+            c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+            for c in rb.columns
+        ]
+        arrays = [a.chunk(0) if isinstance(a, pa.ChunkedArray) else a
+                  for a in arrays]
+        aschema = rb.schema
+        n = rb.num_rows
+    else:
+        arrays = rb.columns
+        aschema = rb.schema
+        n = rb.num_rows
+    schema = schema_from_arrow(aschema)
+    cap = capacity if capacity is not None else pad_capacity(n)
+    cols: list[AnyColumn] = []
+    for arr, f in zip(arrays, schema.fields):
+        if isinstance(f.dtype, T.StringType):
+            cols.append(_string_from_arrow(arr, cap))
+        else:
+            cols.append(_fixed_from_arrow(arr, f.dtype, cap))
+    return ColumnarBatch(cols, n, schema)
+
+
+def to_arrow(batch: ColumnarBatch) -> pa.Table:
+    """Device ColumnarBatch -> host Arrow table (the D2H download)."""
+    n = batch.concrete_num_rows()
+    arrays = []
+    aschema = schema_to_arrow(batch.schema)
+    for f, col, afield in zip(batch.schema.fields, batch.columns, aschema):
+        if isinstance(col, StringColumn):
+            arrays.append(pa.array(col.to_list(n), type=afield.type))
+        else:
+            vals = np.asarray(col.data)[:n]
+            valid = np.asarray(col.validity)[:n]
+            if isinstance(f.dtype, T.DecimalType):
+                import decimal
+
+                pylist = [
+                    decimal.Decimal(int(vals[i])).scaleb(-f.dtype.scale)
+                    if valid[i] else None
+                    for i in range(n)
+                ]
+                arrays.append(pa.array(pylist, type=afield.type))
+            else:
+                mask = ~valid if (~valid).any() else None
+                if isinstance(f.dtype, T.DateType):
+                    arrays.append(
+                        pa.array(vals.astype("int32"), pa.int32(),
+                                 mask=mask).cast(afield.type))
+                elif isinstance(f.dtype, T.TimestampType):
+                    arrays.append(
+                        pa.array(vals.astype("int64"), pa.int64(),
+                                 mask=mask).cast(afield.type))
+                else:
+                    arrays.append(pa.array(vals, type=afield.type, mask=mask))
+    return pa.Table.from_arrays(arrays, schema=aschema)

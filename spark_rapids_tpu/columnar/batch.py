@@ -1,0 +1,212 @@
+"""Device-resident columnar batches.
+
+TPU-native counterpart of the reference's Spark `ColumnarBatch` of
+GpuColumnVectors (ref: GpuColumnVector.java:571,603) plus the coalescing
+machinery of GpuCoalesceBatches (ref: GpuCoalesceBatches.scala:133-455).
+
+Invariants:
+- all columns share one static `capacity` (power-of-two bucket);
+- valid rows are a *prefix*: rows [0, num_rows) are live, the rest padding;
+- `num_rows` may be a Python int (statically known, e.g. straight from a
+  scan) or a traced/device int32 scalar (e.g. after a filter).  Operators
+  must work with both; host materialization forces a sync.
+
+The prefix-compact invariant is what lets aggregations/sorts/joins run as
+fixed-shape XLA programs with a row-activity mask derived from
+`arange(capacity) < num_rows`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (
+    AnyColumn,
+    Column,
+    StringColumn,
+    pad_capacity,
+    pad_width,
+)
+
+RowCount = Union[int, jax.Array]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnarBatch:
+    columns: list[AnyColumn]
+    num_rows: RowCount
+    schema: T.Schema
+
+    def tree_flatten(self):
+        static_rows = self.num_rows if isinstance(self.num_rows, int) else None
+        if static_rows is None:
+            return (tuple(self.columns), self.num_rows), (None, self.schema)
+        return (tuple(self.columns),), (static_rows, self.schema)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        static_rows, schema = aux
+        if static_rows is None:
+            cols, num_rows = children
+        else:
+            (cols,) = children
+            num_rows = static_rows
+        return cls(list(cols), num_rows, schema)
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return 0
+        return self.columns[0].capacity
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def row_mask(self) -> jax.Array:
+        """Boolean mask of live rows, shape (capacity,)."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < jnp.asarray(
+            self.num_rows, dtype=jnp.int32
+        )
+
+    def column(self, i: int) -> AnyColumn:
+        return self.columns[i]
+
+    def with_columns(self, columns: Sequence[AnyColumn],
+                     schema: T.Schema) -> "ColumnarBatch":
+        return ColumnarBatch(list(columns), self.num_rows, schema)
+
+    def concrete_num_rows(self) -> int:
+        """Force num_rows to a host int (syncs if it is a device scalar)."""
+        n = self.num_rows
+        return n if isinstance(n, int) else int(jax.device_get(n))
+
+    # ------------------------------------------------------------------ #
+    # Construction / host interop
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def from_numpy(data: dict[str, np.ndarray],
+                   schema: T.Schema,
+                   validity: Optional[dict[str, np.ndarray]] = None,
+                   capacity: Optional[int] = None) -> "ColumnarBatch":
+        validity = validity or {}
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity if capacity is not None else pad_capacity(n)
+        cols: list[AnyColumn] = []
+        for f in schema.fields:
+            vals = data[f.name]
+            if isinstance(f.dtype, T.StringType):
+                cols.append(StringColumn.from_list(list(vals), capacity=cap))
+                if f.name in validity:
+                    sc = cols[-1]
+                    v = np.zeros(cap, np.bool_)
+                    v[:n] = validity[f.name]
+                    cols[-1] = sc.with_validity(jnp.asarray(v))
+            else:
+                cols.append(
+                    Column.from_numpy(vals, f.dtype,
+                                      validity.get(f.name), capacity=cap)
+                )
+        return ColumnarBatch(cols, n, schema)
+
+    def to_pydict(self) -> dict[str, list]:
+        """Host materialization (syncs). NULLs become None."""
+        n = self.concrete_num_rows()
+        out: dict[str, list] = {}
+        for f, col in zip(self.schema.fields, self.columns):
+            if isinstance(col, StringColumn):
+                out[f.name] = col.to_list(n)
+            else:
+                vals = np.asarray(col.data)[:n]
+                valid = np.asarray(col.validity)[:n]
+                out[f.name] = [
+                    (vals[i].item() if valid[i] else None) for i in range(n)
+                ]
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Batch surgery
+    # ------------------------------------------------------------------ #
+
+    def gather(self, indices: jax.Array, num_rows: RowCount,
+               index_valid: Optional[jax.Array] = None) -> "ColumnarBatch":
+        cols = [c.gather(indices, index_valid) for c in self.columns]
+        return ColumnarBatch(cols, num_rows, self.schema)
+
+    def compact(self, keep: jax.Array) -> "ColumnarBatch":
+        """Keep rows where `keep` is True, preserving order; result is
+        prefix-compact with a traced num_rows.  This is the XLA equivalent
+        of cudf's filter/gather (ref: basicPhysicalOperators.scala:230):
+        a stable argsort on the drop-flag moves kept rows to the front.
+        """
+        keep = keep & self.row_mask()
+        order = jnp.argsort(~keep, stable=True)
+        n = jnp.sum(keep).astype(jnp.int32)
+        cols = [c.gather(order) for c in self.columns]
+        # rows past n are garbage; invalidate them so padding stays NULL
+        live = jnp.arange(self.capacity, dtype=jnp.int32) < n
+        cols = [c.with_validity(c.validity & live) for c in cols]
+        return ColumnarBatch(cols, n, self.schema)
+
+    def slice_prefix(self, n: RowCount) -> "ColumnarBatch":
+        """Logically truncate to the first n rows (no data movement)."""
+        if isinstance(n, int) and isinstance(self.num_rows, int):
+            new_n: RowCount = min(n, self.num_rows)
+        else:
+            new_n = jnp.minimum(jnp.asarray(n, jnp.int32),
+                                jnp.asarray(self.num_rows, jnp.int32))
+        live = jnp.arange(self.capacity, dtype=jnp.int32) < jnp.asarray(
+            new_n, jnp.int32)
+        cols = [c.with_validity(c.validity & live) for c in self.columns]
+        return ColumnarBatch(cols, new_n, self.schema)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenate batches of one schema into a single larger batch.
+
+    TPU analog of GpuCoalesceBatches' cudf Table.concatenate
+    (ref: GpuCoalesceBatches.scala:340).  Requires concrete row counts
+    (host-side decision, like the reference's coalesce goal logic).
+    """
+    assert batches, "concat of zero batches"
+    schema = batches[0].schema
+    ns = [b.concrete_num_rows() for b in batches]
+    total = sum(ns)
+    cap = pad_capacity(total)
+    out_cols: list[AnyColumn] = []
+    for ci, f in enumerate(schema.fields):
+        parts = [b.columns[ci] for b in batches]
+        if isinstance(f.dtype, T.StringType):
+            w = pad_width(max(p.width for p in parts))  # type: ignore[union-attr]
+            chars = np.zeros((cap, w), np.uint8)
+            lengths = np.zeros(cap, np.int32)
+            valid = np.zeros(cap, np.bool_)
+            off = 0
+            for p, n in zip(parts, ns):
+                chars[off:off + n, : p.width] = np.asarray(p.chars)[:n]
+                lengths[off:off + n] = np.asarray(p.lengths)[:n]
+                valid[off:off + n] = np.asarray(p.validity)[:n]
+                off += n
+            out_cols.append(StringColumn(jnp.asarray(chars),
+                                         jnp.asarray(lengths),
+                                         jnp.asarray(valid)))
+        else:
+            phys = T.to_numpy_dtype(f.dtype)
+            data = np.zeros(cap, phys)
+            valid = np.zeros(cap, np.bool_)
+            off = 0
+            for p, n in zip(parts, ns):
+                data[off:off + n] = np.asarray(p.data)[:n]
+                valid[off:off + n] = np.asarray(p.validity)[:n]
+                off += n
+            out_cols.append(Column(jnp.asarray(data), jnp.asarray(valid),
+                                   f.dtype))
+    return ColumnarBatch(out_cols, total, schema)

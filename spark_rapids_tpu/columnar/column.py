@@ -1,0 +1,200 @@
+"""Device-resident columns.
+
+TPU-native counterpart of the reference's GpuColumnVector
+(ref: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java).
+cudf stores variable-length row counts and offset-encoded strings; XLA wants
+static shapes, so the design here is different by construction:
+
+- every column in a batch is padded to the batch *capacity* (a power-of-two
+  bucket) so the per-operator XLA programs are compiled once per bucket and
+  reused (the reference instead re-launches dynamically-shaped kernels);
+- SQL NULLs are a boolean `validity` array (True = valid), matching the
+  semantics (not the bit-packing) of Arrow/cudf validity buffers;
+- strings are a fixed-width `(capacity, width)` uint8 byte matrix plus an
+  int32 `lengths` array.  `width` is the max byte length in the batch,
+  rounded up to a small bucket for compile-cache stability.
+
+Columns are registered as JAX pytrees so whole batches can flow through
+`jax.jit` / `shard_map` directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+ArrayLike = Union[jax.Array, np.ndarray]
+
+#: minimum capacity bucket; keeps tiny test batches from fragmenting the
+#: compile cache.
+MIN_CAPACITY = 8
+
+#: string width buckets (bytes)
+_WIDTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def pad_capacity(n: int) -> int:
+    """Round a row count up to its capacity bucket (next power of two)."""
+    c = MIN_CAPACITY
+    while c < n:
+        c <<= 1
+    return c
+
+
+def pad_width(w: int) -> int:
+    for b in _WIDTH_BUCKETS:
+        if w <= b:
+            return b
+    return ((w + 4095) // 4096) * 4096
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """A fixed-width device column: `data[capacity]` + `validity[capacity]`.
+
+    Rows past the owning batch's `num_rows` are padding with arbitrary data
+    and validity False.
+    """
+
+    data: ArrayLike
+    validity: ArrayLike
+    dtype: T.DataType
+
+    def tree_flatten(self):
+        return (self.data, self.validity), (self.dtype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity = children
+        return cls(data, validity, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def with_validity(self, validity: ArrayLike) -> "Column":
+        return Column(self.data, validity, self.dtype)
+
+    def gather(self, indices: ArrayLike, index_valid: Optional[ArrayLike] = None
+               ) -> "Column":
+        """Take rows by index; out-of-range/invalid indices produce NULLs."""
+        idx = jnp.clip(indices, 0, self.capacity - 1)
+        data = jnp.take(self.data, idx, axis=0)
+        validity = jnp.take(self.validity, idx, axis=0)
+        if index_valid is not None:
+            validity = validity & index_valid
+        return Column(data, validity, self.dtype)
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: T.DataType,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        n = len(values)
+        cap = capacity if capacity is not None else pad_capacity(n)
+        phys = T.to_numpy_dtype(dtype)
+        data = np.zeros(cap, dtype=phys)
+        data[:n] = values.astype(phys, copy=False)
+        valid = np.zeros(cap, dtype=np.bool_)
+        if validity is None:
+            valid[:n] = True
+        else:
+            valid[:n] = validity
+        return Column(jnp.asarray(data), jnp.asarray(valid), dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class StringColumn:
+    """Fixed-width string column: `chars[capacity, width]` uint8 +
+    `lengths[capacity]` int32 + `validity[capacity]`.
+
+    Bytes past a row's length are zero.  This is the TPU answer to cudf's
+    offset+chars layout: every string op becomes a dense 2-D vectorized op
+    on the MXU/VPU instead of a ragged traversal.
+    """
+
+    chars: ArrayLike
+    lengths: ArrayLike
+    validity: ArrayLike
+
+    dtype: T.DataType = dataclasses.field(default_factory=lambda: T.STRING)
+
+    def tree_flatten(self):
+        return (self.chars, self.lengths, self.validity), (self.dtype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        chars, lengths, validity = children
+        return cls(chars, lengths, validity, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.chars.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.chars.shape[1])
+
+    def with_validity(self, validity: ArrayLike) -> "StringColumn":
+        return StringColumn(self.chars, self.lengths, validity)
+
+    def gather(self, indices: ArrayLike, index_valid: Optional[ArrayLike] = None
+               ) -> "StringColumn":
+        idx = jnp.clip(indices, 0, self.capacity - 1)
+        chars = jnp.take(self.chars, idx, axis=0)
+        lengths = jnp.take(self.lengths, idx, axis=0)
+        validity = jnp.take(self.validity, idx, axis=0)
+        if index_valid is not None:
+            validity = validity & index_valid
+        return StringColumn(chars, lengths, validity)
+
+    @staticmethod
+    def from_list(values: list[Optional[str]],
+                  capacity: Optional[int] = None,
+                  width: Optional[int] = None) -> "StringColumn":
+        n = len(values)
+        cap = capacity if capacity is not None else pad_capacity(n)
+        encoded = [v.encode("utf-8") if v is not None else b"" for v in values]
+        maxw = max((len(b) for b in encoded), default=0)
+        w = width if width is not None else pad_width(max(maxw, 1))
+        chars = np.zeros((cap, w), dtype=np.uint8)
+        lengths = np.zeros(cap, dtype=np.int32)
+        valid = np.zeros(cap, dtype=np.bool_)
+        for i, (b, v) in enumerate(zip(encoded, values)):
+            chars[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lengths[i] = len(b)
+            valid[i] = v is not None
+        return StringColumn(jnp.asarray(chars), jnp.asarray(lengths),
+                            jnp.asarray(valid))
+
+    def to_list(self, num_rows: int) -> list[Optional[str]]:
+        chars = np.asarray(self.chars)[:num_rows]
+        lengths = np.asarray(self.lengths)[:num_rows]
+        valid = np.asarray(self.validity)[:num_rows]
+        out: list[Optional[str]] = []
+        for i in range(num_rows):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(bytes(chars[i, : lengths[i]]).decode("utf-8"))
+        return out
+
+
+AnyColumn = Union[Column, StringColumn]
+
+
+def column_to_numpy(col: AnyColumn, num_rows: int
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Return (values, validity) trimmed to num_rows (host copies)."""
+    if isinstance(col, StringColumn):
+        vals = np.array(col.to_list(num_rows), dtype=object)
+        return vals, np.asarray(col.validity)[:num_rows].copy()
+    return (np.asarray(col.data)[:num_rows].copy(),
+            np.asarray(col.validity)[:num_rows].copy())

@@ -1,0 +1,226 @@
+"""Self-documenting config registry.
+
+TPU re-design of the reference's RapidsConf
+(ref: sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:190-270):
+a typed ConfBuilder registry where every entry carries a doc string, a
+default, an optional value-check, and an `internal` flag; `help_text()`
+generates the configs doc the way RapidsConf.help generates docs/configs.md.
+Per-operator / per-expression kill-switch keys (spark.rapids.sql.exec.* /
+expression.* in the reference, RapidsMeta.scala:35-46) are registered
+dynamically by the planner's replacement rules under
+`spark.rapids.tpu.sql.exec.*` / `...expression.*`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conv: Callable[[str], Any]
+    internal: bool = False
+    check: Optional[Callable[[Any], bool]] = None
+
+    def convert(self, raw: Any) -> Any:
+        v = self.conv(raw) if isinstance(raw, str) else raw
+        if self.check is not None and not self.check(v):
+            raise ValueError(f"invalid value {v!r} for {self.key}")
+        return v
+
+
+_REGISTRY: dict[str, ConfEntry] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _to_bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes")
+
+
+def register(key: str, default: Any, doc: str, *, internal: bool = False,
+             conv: Optional[Callable[[str], Any]] = None,
+             check: Optional[Callable[[Any], bool]] = None) -> ConfEntry:
+    if conv is None:
+        if isinstance(default, bool):
+            conv = _to_bool
+        elif isinstance(default, int):
+            conv = int
+        elif isinstance(default, float):
+            conv = float
+        else:
+            conv = str
+    with _REG_LOCK:
+        if key in _REGISTRY:
+            return _REGISTRY[key]
+        e = ConfEntry(key, default, doc, conv, internal, check)
+        _REGISTRY[key] = e
+        return e
+
+
+# ---------------------------------------------------------------------- #
+# Core entries (counterparts of the reference keys noted inline)
+# ---------------------------------------------------------------------- #
+
+SQL_ENABLED = register(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Master enable for plan replacement (ref: spark.rapids.sql.enabled, "
+    "RapidsConf.scala:514).")
+CONCURRENT_TPU_TASKS = register(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
+    "Max concurrent tasks admitted to the accelerator per executor "
+    "(ref: spark.rapids.sql.concurrentGpuTasks, RapidsConf.scala:423).")
+BATCH_SIZE_ROWS = register(
+    "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
+    "Target row count per coalesced batch; the TPU analog of "
+    "spark.rapids.sql.batchSizeBytes (RapidsConf.scala:436) — rows, not "
+    "bytes, because XLA programs are specialized per capacity bucket.")
+MAX_CAPACITY = register(
+    "spark.rapids.tpu.sql.maxBatchCapacity", 1 << 22,
+    "Hard cap on a single batch's padded capacity.")
+HBM_POOL_FRACTION = register(
+    "spark.rapids.tpu.memory.hbm.poolFraction", 0.75,
+    "Fraction of device HBM the buffer store may occupy before proactive "
+    "spill (ref: spark.rapids.memory.gpu.allocFraction).")
+HOST_SPILL_SIZE = register(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 1 << 30,
+    "Bytes of host memory for spilled buffers before they go to disk "
+    "(ref: spark.rapids.memory.host.spillStorageSize, RapidsConf.scala:357).")
+SPILL_DIR = register(
+    "spark.rapids.tpu.memory.spillDir", "/tmp/spark_rapids_tpu_spill",
+    "Directory for disk-tier spill files (ref: RapidsDiskBlockManager).")
+EXPLAIN = register(
+    "spark.rapids.tpu.sql.explain", "NOT_ON_TPU",
+    "What to log about plan replacement: NONE, NOT_ON_TPU, ALL "
+    "(ref: spark.rapids.sql.explain).")
+INCOMPATIBLE_OPS = register(
+    "spark.rapids.tpu.sql.incompatibleOps.enabled", True,
+    "Allow ops whose results may differ from the CPU engine in documented "
+    "ways, e.g. float aggregation order "
+    "(ref: spark.rapids.sql.incompatibleOps.enabled).")
+HAS_NANS = register(
+    "spark.rapids.tpu.sql.hasNans", True,
+    "Assume floating point data may contain NaNs (ref: "
+    "spark.rapids.sql.hasNans).")
+VARIABLE_FLOAT_AGG = register(
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled", True,
+    "Permit float aggregation whose ordering differs from CPU "
+    "(ref: spark.rapids.sql.variableFloatAgg.enabled).")
+SHUFFLE_TRANSPORT_ENABLED = register(
+    "spark.rapids.tpu.shuffle.transport.enabled", False,
+    "Enable the accelerated collective shuffle transport "
+    "(ref: spark.rapids.shuffle.transport.enabled, RapidsConf.scala:930).")
+SHUFFLE_PARTITIONS = register(
+    "spark.rapids.tpu.sql.shuffle.partitions", 8,
+    "Default partition count for shuffle exchanges (ref: "
+    "spark.sql.shuffle.partitions).")
+CBO_ENABLED = register(
+    "spark.rapids.tpu.sql.optimizer.enabled", False,
+    "Enable the cost-based optimizer that keeps subtrees on CPU when "
+    "acceleration is not profitable (ref: CostBasedOptimizer.scala:34).")
+METRICS_LEVEL = register(
+    "spark.rapids.tpu.sql.metrics.level", "MODERATE",
+    "Metric detail level: ESSENTIAL, MODERATE, DEBUG "
+    "(ref: GpuExec.scala:40-160 metric levels).",
+    check=lambda v: v in ("ESSENTIAL", "MODERATE", "DEBUG"))
+TEST_ALLOWED_NONTPU = register(
+    "spark.rapids.tpu.sql.test.allowedNonTpu", "",
+    "Comma-separated exec names allowed to fall back in strict test mode.",
+    internal=True)
+STRICT_FALLBACK = register(
+    "spark.rapids.tpu.sql.test.strictFallback", False,
+    "Raise if any operator falls back to CPU (test aid; analog of the "
+    "reference integration tests' allow_non_gpu machinery).",
+    internal=True)
+
+
+class TpuConf:
+    """An immutable-ish snapshot of config values, like `new RapidsConf(conf)`
+    in the reference (Plugin.scala:179)."""
+
+    def __init__(self, overrides: Optional[dict[str, Any]] = None):
+        self._values: dict[str, Any] = {}
+        env_prefix = "SPARK_RAPIDS_TPU_"
+        for key, entry in _REGISTRY.items():
+            raw: Any = entry.default
+            env_key = env_prefix + key.split("spark.rapids.tpu.")[-1] \
+                .replace(".", "_").upper()
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+            self._values[key] = entry.convert(raw)
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            self._values[key] = entry.convert(value)
+        else:
+            # unknown keys allowed (dynamic per-op keys register lazily)
+            self._values[key] = value
+        return self
+
+    def get(self, entry_or_key, default: Any = None) -> Any:
+        if isinstance(entry_or_key, ConfEntry):
+            key = entry_or_key.key
+            default = entry_or_key.default
+        else:
+            key = entry_or_key
+            reg = _REGISTRY.get(key)
+            if reg is not None and default is None:
+                default = reg.default
+        return self._values.get(key, default)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        return _to_bool(v) if isinstance(v, str) else bool(v)
+
+    # convenient properties
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return self.get(EXPLAIN)
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def strict_fallback(self) -> bool:
+        return self.get(STRICT_FALLBACK)
+
+
+def help_text(include_internal: bool = False) -> str:
+    """Generate the configs doc, like RapidsConf.help -> docs/configs.md."""
+    lines = ["# spark_rapids_tpu configuration", "",
+             "| Key | Default | Description |", "|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal and not include_internal:
+            continue
+        doc = e.doc.replace("\n", " ")
+        lines.append(f"| {key} | {e.default} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+_ACTIVE = threading.local()
+
+
+def get_conf() -> TpuConf:
+    conf = getattr(_ACTIVE, "conf", None)
+    if conf is None:
+        conf = TpuConf()
+        _ACTIVE.conf = conf
+    return conf
+
+
+def set_conf(conf: TpuConf) -> None:
+    _ACTIVE.conf = conf

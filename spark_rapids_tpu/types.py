@@ -1,0 +1,308 @@
+"""SQL data-type system and TPU physical-type mapping.
+
+Mirrors the role of the reference's Spark `DataType` handling plus the
+GPU-physical mapping in GpuColumnVector.getNonNestedRapidsType
+(ref: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:497)
+and the declarative per-operator type signatures of TypeChecks/TypeSig
+(ref: sql-plugin/.../TypeChecks.scala:129,483).
+
+Physical mapping (TPU-first, not a cudf translation):
+- fixed-width SQL types -> a single JAX array plus a boolean validity array;
+- DATE -> int32 days since epoch; TIMESTAMP -> int64 microseconds UTC
+  (the reference is likewise UTC-only, GpuOverrides.scala:439);
+- DECIMAL(p<=18, s) -> int64 unscaled values (the reference uses
+  DECIMAL64, DecimalUtil.scala);
+- STRING -> fixed-width uint8 byte matrix (n, width) + int32 lengths.
+  XLA wants static shapes, so variable-width UTF-8 is padded to the
+  batch's max byte length instead of cudf's offset+chars layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+class DataType:
+    """Base class for SQL-level data types."""
+
+    #: short name used in TypeSig strings and explain output
+    name: str = "?"
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return True
+
+
+class BooleanType(DataType):
+    name = "boolean"
+
+
+class IntegralType(DataType):
+    bits = 64
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+
+class ByteType(IntegralType):
+    name = "tinyint"
+    bits = 8
+
+
+class ShortType(IntegralType):
+    name = "smallint"
+    bits = 16
+
+
+class IntegerType(IntegralType):
+    name = "int"
+    bits = 32
+
+
+class LongType(IntegralType):
+    name = "bigint"
+    bits = 64
+
+
+class FractionalType(DataType):
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+
+class FloatType(FractionalType):
+    name = "float"
+
+
+class DoubleType(FractionalType):
+    name = "double"
+
+
+class StringType(DataType):
+    name = "string"
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return False
+
+
+class DateType(DataType):
+    """Days since unix epoch, int32."""
+
+    name = "date"
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch, UTC only (parity with the reference:
+    GpuOverrides.scala:439 UTC_TIMEZONE_ID)."""
+
+    name = "timestamp"
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(DataType):
+    """Decimal with precision <= 18 backed by int64 unscaled values."""
+
+    precision: int = 10
+    scale: int = 0
+    MAX_PRECISION = 18
+
+    def __post_init__(self):
+        if self.precision > self.MAX_PRECISION:
+            raise ValueError(
+                f"decimal precision {self.precision} > {self.MAX_PRECISION}"
+            )
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, DecimalType)
+            and other.precision == self.precision
+            and other.scale == self.scale
+        )
+
+    def __hash__(self) -> int:
+        return hash((DecimalType, self.precision, self.scale))
+
+
+class NullType(DataType):
+    name = "null"
+
+
+# Singletons
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+INTEGRAL_TYPES = (BYTE, SHORT, INT, LONG)
+NUMERIC_TYPES = INTEGRAL_TYPES + (FLOAT, DOUBLE)
+ALL_BASIC_TYPES = NUMERIC_TYPES + (BOOLEAN, STRING, DATE, TIMESTAMP)
+
+
+_NUMPY_DTYPES = {
+    BooleanType: np.bool_,
+    ByteType: np.int8,
+    ShortType: np.int16,
+    IntegerType: np.int32,
+    LongType: np.int64,
+    FloatType: np.float32,
+    DoubleType: np.float64,
+    DateType: np.int32,
+    TimestampType: np.int64,
+    DecimalType: np.int64,
+    NullType: np.bool_,
+}
+
+
+def to_numpy_dtype(dt: DataType) -> np.dtype:
+    """Physical numpy/JAX dtype backing a fixed-width SQL type."""
+    try:
+        return np.dtype(_NUMPY_DTYPES[type(dt)])
+    except KeyError:
+        raise TypeError(f"no fixed-width physical type for {dt}") from None
+
+
+def from_arrow_type(at) -> DataType:
+    """Map a pyarrow DataType to ours."""
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return BOOLEAN
+    if pa.types.is_int8(at):
+        return BYTE
+    if pa.types.is_int16(at):
+        return SHORT
+    if pa.types.is_int32(at):
+        return INT
+    if pa.types.is_int64(at):
+        return LONG
+    if pa.types.is_float32(at):
+        return FLOAT
+    if pa.types.is_float64(at):
+        return DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_date32(at):
+        return DATE
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP
+    if pa.types.is_decimal(at):
+        if at.precision > DecimalType.MAX_PRECISION:
+            raise TypeError(f"decimal precision {at.precision} unsupported")
+        return DecimalType(at.precision, at.scale)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow_type(dt: DataType):
+    import pyarrow as pa
+
+    m = {
+        BooleanType: pa.bool_(),
+        ByteType: pa.int8(),
+        ShortType: pa.int16(),
+        IntegerType: pa.int32(),
+        LongType: pa.int64(),
+        FloatType: pa.float32(),
+        DoubleType: pa.float64(),
+        StringType: pa.string(),
+        DateType: pa.date32(),
+        TimestampType: pa.timestamp("us", tz="UTC"),
+    }
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    try:
+        return m[type(dt)]
+    except KeyError:
+        raise TypeError(f"unsupported type {dt}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+    def __repr__(self) -> str:
+        n = "" if self.nullable else " not null"
+        return f"{self.name}: {self.dtype}{n}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    fields: tuple[Field, ...]
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def dtypes(self) -> list[DataType]:
+        return [f.dtype for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(map(repr, self.fields)) + ")"
+
+
+def common_type(a: DataType, b: DataType) -> Optional[DataType]:
+    """Numeric widening a la Spark's implicit cast promotion."""
+    if a == b:
+        return a
+    order = {ByteType: 0, ShortType: 1, IntegerType: 2, LongType: 3,
+             FloatType: 4, DoubleType: 5}
+    ta, tb = type(a), type(b)
+    if ta in order and tb in order:
+        return [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE][max(order[ta], order[tb])]
+    return None

@@ -1,0 +1,26 @@
+"""Test harness config: force JAX onto CPU with 8 virtual devices so the
+multi-chip sharding paths are exercised without TPU hardware (the driver
+separately dry-runs the multi-chip path; bench.py uses the real chip).
+
+Note: this environment's sitecustomize registers a remote TPU PJRT plugin
+and *forcibly* sets jax_platforms="axon,cpu" via jax.config.update, which
+overrides the JAX_PLATFORMS env var.  We must win the override back with
+another config.update before any backend initializes, otherwise every test
+run rides a fragile remote-TPU tunnel.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,132 @@
+"""Round-trip and surgery tests for the columnar substrate.
+
+Mirrors the reference's GpuBatchUtilsSuite / unit-level batch tests
+(SURVEY.md section 4 tier 1/2).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import Column, ColumnarBatch, StringColumn
+from spark_rapids_tpu.columnar.arrow import from_arrow, to_arrow
+from spark_rapids_tpu.columnar.batch import concat_batches
+from spark_rapids_tpu.columnar.column import pad_capacity, pad_width
+
+
+def test_pad_capacity():
+    assert pad_capacity(0) == 8
+    assert pad_capacity(8) == 8
+    assert pad_capacity(9) == 16
+    assert pad_capacity(1000) == 1024
+
+
+def test_pad_width():
+    assert pad_width(1) == 1
+    assert pad_width(3) == 4
+    assert pad_width(5000) == 8192
+
+
+def make_arrow_table():
+    return pa.table({
+        "i": pa.array([1, 2, None, 4, 5], pa.int64()),
+        "f": pa.array([1.5, None, 3.25, -0.0, float("nan")], pa.float64()),
+        "s": pa.array(["a", "bc", None, "", "longer string"], pa.string()),
+        "b": pa.array([True, False, None, True, False], pa.bool_()),
+        "d": pa.array([0, 1, 18000, None, -5], pa.int32()).cast(pa.date32()),
+    })
+
+
+def test_arrow_round_trip():
+    tbl = make_arrow_table()
+    batch = from_arrow(tbl)
+    assert batch.num_rows == 5
+    assert batch.capacity == 8
+    back = to_arrow(batch)
+    assert back.num_rows == 5
+    for name in tbl.column_names:
+        a = tbl.column(name).to_pylist()
+        b = back.column(name).to_pylist()
+        if name == "f":
+            for x, y in zip(a, b):
+                if x is None or (isinstance(x, float) and np.isnan(x)):
+                    assert y is None or np.isnan(y)
+                else:
+                    assert x == y
+        else:
+            assert a == b, name
+
+
+def test_string_column_roundtrip():
+    vals = ["hello", None, "", "unicode: héllo ✓", "x" * 100]
+    col = StringColumn.from_list(vals)
+    assert col.to_list(len(vals)) == vals
+
+
+def test_compact():
+    import jax.numpy as jnp
+
+    tbl = pa.table({"x": pa.array(list(range(10)), pa.int64())})
+    batch = from_arrow(tbl)
+    keep = jnp.asarray(
+        np.array([i % 2 == 0 for i in range(batch.capacity)]))
+    out = batch.compact(keep)
+    assert out.concrete_num_rows() == 5
+    assert out.to_pydict()["x"] == [0, 2, 4, 6, 8]
+
+
+def test_compact_respects_row_mask():
+    import jax.numpy as jnp
+
+    tbl = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    batch = from_arrow(tbl)  # capacity 8, rows 3
+    keep = jnp.ones(batch.capacity, dtype=bool)  # would keep padding too
+    out = batch.compact(keep)
+    assert out.concrete_num_rows() == 3
+    assert out.to_pydict()["x"] == [1, 2, 3]
+
+
+def test_concat_batches():
+    t1 = pa.table({"x": pa.array([1, 2, 3], pa.int64()),
+                   "s": pa.array(["a", None, "ccc"], pa.string())})
+    t2 = pa.table({"x": pa.array([None, 5], pa.int64()),
+                   "s": pa.array(["dd" * 40, "e"], pa.string())})
+    b = concat_batches([from_arrow(t1), from_arrow(t2)])
+    assert b.concrete_num_rows() == 5
+    d = b.to_pydict()
+    assert d["x"] == [1, 2, 3, None, 5]
+    assert d["s"] == ["a", None, "ccc", "dd" * 40, "e"]
+
+
+def test_slice_prefix():
+    tbl = pa.table({"x": pa.array(list(range(6)), pa.int64())})
+    out = from_arrow(tbl).slice_prefix(4)
+    assert out.to_pydict()["x"] == [0, 1, 2, 3]
+
+
+def test_gather_nulls_out_of_range():
+    import jax.numpy as jnp
+
+    col = Column.from_numpy(np.array([10, 20, 30]), T.LONG)
+    idx = jnp.asarray(np.array([2, 0, 7, 1, 0, 0, 0, 0]))
+    valid = jnp.asarray(np.array([True, True, False, True] + [False] * 4))
+    g = col.gather(idx, valid)
+    vals = np.asarray(g.data)[:4]
+    vmask = np.asarray(g.validity)[:4]
+    assert list(vals[:2]) == [30, 10]
+    assert list(vmask) == [True, True, False, True]
+
+
+def test_decimal_round_trip():
+    import decimal
+
+    tbl = pa.table({
+        "dec": pa.array([decimal.Decimal("1.23"), None,
+                         decimal.Decimal("-99.99")], pa.decimal128(9, 2)),
+    })
+    batch = from_arrow(tbl)
+    assert batch.schema.dtypes[0] == T.DecimalType(9, 2)
+    back = to_arrow(batch)
+    assert back.column("dec").to_pylist() == [
+        decimal.Decimal("1.23"), None, decimal.Decimal("-99.99")]
