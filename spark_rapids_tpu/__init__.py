@@ -22,4 +22,18 @@ plays the role of "CPU Spark" for differential testing and fallback.
 
 __version__ = "0.1.0"
 
+# SQL semantics demand real int64/float64 (Spark's BIGINT/DOUBLE); JAX
+# defaults to 32-bit, so importing this package enables the process-global
+# x64 flag.  This is a deliberate, documented side effect — the framework
+# owns the process the way a Spark executor plugin owns its JVM.  Embedders
+# co-hosting f32 JAX models can opt out by setting
+# SPARK_RAPIDS_TPU_NO_X64=1 before import (device columns then degrade to
+# 32-bit physical types and the parity test suite will not pass).
+import os as _os
+
+if _os.environ.get("SPARK_RAPIDS_TPU_NO_X64", "") != "1":
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
 from spark_rapids_tpu.config import TpuConf, get_conf, set_conf  # noqa: F401

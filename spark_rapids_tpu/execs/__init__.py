@@ -1,0 +1,8 @@
+from spark_rapids_tpu.execs.base import TpuExec, TpuMetric, FusableExec  # noqa: F401
+from spark_rapids_tpu.execs.basic import (  # noqa: F401
+    TpuBatchSourceExec,
+    TpuFilterExec,
+    TpuProjectExec,
+    TpuRangeExec,
+    TpuUnionExec,
+)

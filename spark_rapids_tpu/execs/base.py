@@ -1,0 +1,163 @@
+"""Physical operator (exec) base classes and metrics.
+
+TPU re-design of the reference's GpuExec
+(ref: sql-plugin/.../GpuExec.scala:40-217 — doExecuteColumnar contract +
+tiered GpuMetric hierarchy).
+
+The TPU twist: execs that are pure per-batch transforms (project, filter,
+...) expose `make_batch_fn()`, and `execute()` *fuses* every consecutive
+fusable ancestor into ONE `jax.jit` program per pipeline — the columnar
+equivalent of Spark's whole-stage codegen, and the idiomatic XLA answer to
+the reference's per-operator cudf kernel launches: one compiled program per
+(pipeline, capacity-bucket) with all elementwise work fused by the
+compiler.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Optional
+
+import jax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import METRICS_LEVEL, get_conf
+
+
+class TpuMetric:
+    """A named counter, levelled like the reference's ESSENTIAL/MODERATE/
+    DEBUG GpuMetrics (ref: GpuExec.scala:32-160)."""
+
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: str = "MODERATE"):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v: int) -> None:
+        self.value += v
+
+    def __repr__(self) -> str:
+        return f"{self.name}={self.value}"
+
+
+class MetricTimer:
+    """Context manager adding elapsed ns to a metric — the NVTX-with-metric
+    pattern (ref: NvtxWithMetrics.scala:25-42)."""
+
+    def __init__(self, metric: Optional[TpuMetric]):
+        self.metric = metric
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self.metric is not None:
+            self.metric.add(time.perf_counter_ns() - self.t0)
+        return False
+
+
+# standard metric names (ref: GpuExec.scala companion constants)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+TOTAL_TIME = "totalTime"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+
+
+class TpuExec:
+    """Base physical operator producing an iterator of device batches."""
+
+    def __init__(self, *children: "TpuExec"):
+        self.children: list[TpuExec] = list(children)
+        self.metrics: dict[str, TpuMetric] = {}
+        for name in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, TOTAL_TIME):
+            self.metrics[name] = TpuMetric(name, "ESSENTIAL")
+        for name, lvl in self.additional_metrics():
+            self.metrics[name] = TpuMetric(name, lvl)
+
+    # -- overridables ---------------------------------------------------- #
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def additional_metrics(self) -> list[tuple[str, str]]:
+        return []
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        """Produce output batches (ref: GpuExec.doExecuteColumnar)."""
+        raise NotImplementedError
+
+    # -- plumbing -------------------------------------------------------- #
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def node_desc(self) -> str:
+        return self.name
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + "+- " + self.node_desc() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def _count_output(self, batch: ColumnarBatch) -> ColumnarBatch:
+        self.metrics[NUM_OUTPUT_BATCHES].add(1)
+        # concrete_num_rows syncs when num_rows is a device scalar; by this
+        # point the batch has already been computed, so the sync is cheap
+        self.metrics[NUM_OUTPUT_ROWS].add(batch.concrete_num_rows())
+        return batch
+
+    def collect_metrics(self) -> dict[str, dict[str, int]]:
+        level = get_conf().get(METRICS_LEVEL)
+        rank = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}[level]
+        out = {}
+        for node in self._walk():
+            m = {k: v.value for k, v in node.metrics.items()
+                 if rank >= {"ESSENTIAL": 0, "MODERATE": 1,
+                             "DEBUG": 2}[v.level]}
+            out.setdefault(node.name, {}).update(m)
+        return out
+
+    def _walk(self):
+        yield self
+        for c in self.children:
+            yield from c._walk()
+
+
+BatchFn = Callable[[ColumnarBatch], ColumnarBatch]
+
+
+class FusableExec(TpuExec):
+    """An exec that is a pure per-batch device transform.  Consecutive
+    fusable execs compile into a single XLA program per batch pipeline."""
+
+    def make_batch_fn(self) -> BatchFn:
+        """Return a traceable ColumnarBatch -> ColumnarBatch function."""
+        raise NotImplementedError
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        # walk down through fusable children, composing their batch fns
+        fns: list[BatchFn] = [self.make_batch_fn()]
+        node: TpuExec = self.children[0]
+        while isinstance(node, FusableExec):
+            fns.append(node.make_batch_fn())
+            node = node.children[0]
+        fns.reverse()
+
+        def pipeline(batch: ColumnarBatch) -> ColumnarBatch:
+            for f in fns:
+                batch = f(batch)
+            return batch
+
+        fused = jax.jit(pipeline)
+        for batch in node.execute():
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                out = fused(batch)
+            yield self._count_output(out)

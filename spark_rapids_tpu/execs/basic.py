@@ -1,0 +1,201 @@
+"""Basic physical operators: source, project, filter, range, union.
+
+TPU counterparts of the reference's basicPhysicalOperators.scala:
+GpuProjectExec (:83), GpuFilterExec (:184), GpuRangeExec (:245),
+GpuUnionExec (:287), GpuCoalesceExec (:408).
+
+Project and filter are FusableExecs: a Filter(Project(Filter(...)))
+pipeline executes as one jitted XLA program per batch.  Filter keeps
+batches prefix-compact via ColumnarBatch.compact (stable argsort on the
+keep mask) — the XLA equivalent of cudf's stream-compaction gather.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, pad_capacity
+from spark_rapids_tpu.exprs.base import (
+    Alias,
+    EvalContext,
+    Expression,
+    bind_references,
+)
+from spark_rapids_tpu.execs.base import BatchFn, FusableExec, TpuExec
+
+
+def output_field(e: Expression, i: int) -> T.Field:
+    name = e.name if isinstance(e, Alias) or hasattr(e, "col_name") \
+        else f"col{i}"
+    if isinstance(e, Alias):
+        name = e.out_name
+    elif getattr(e, "col_name", ""):
+        name = e.col_name  # type: ignore[attr-defined]
+    return T.Field(name, e.dtype, e.nullable)
+
+
+class TpuBatchSourceExec(TpuExec):
+    """Leaf exec over pre-materialized device batches (test aid and the
+    receiving side of exchanges)."""
+
+    def __init__(self, batches: Sequence[ColumnarBatch], schema: T.Schema):
+        super().__init__()
+        self._batches = list(batches)
+        self._schema = schema
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for b in self._batches:
+            yield self._count_output(b)
+
+
+class TpuProjectExec(FusableExec):
+    """Bind refs, eval each projection over the batch
+    (ref: basicPhysicalOperators.scala:110-119 projectAndClose)."""
+
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        self.exprs = [bind_references(e, child.schema) for e in exprs]
+        self._schema = T.Schema(
+            [output_field(e, i) for i, e in enumerate(self.exprs)])
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"TpuProjectExec [{', '.join(e.name for e in self.exprs)}]"
+
+    def make_batch_fn(self) -> BatchFn:
+        exprs = self.exprs
+        schema = self._schema
+
+        def fn(batch: ColumnarBatch) -> ColumnarBatch:
+            ctx = EvalContext.for_batch(batch)
+            cols = [e.eval(ctx) for e in exprs]
+            return ColumnarBatch(cols, batch.num_rows, schema)
+
+        return fn
+
+
+class TpuFilterExec(FusableExec):
+    """Eval predicate -> compact (ref: basicPhysicalOperators.scala:184,230).
+
+    NULL predicate results drop the row (SQL WHERE semantics)."""
+
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__(child)
+        self.condition = bind_references(condition, child.schema)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        return f"TpuFilterExec [{self.condition!r}]"
+
+    def make_batch_fn(self) -> BatchFn:
+        cond = self.condition
+
+        def fn(batch: ColumnarBatch) -> ColumnarBatch:
+            ctx = EvalContext.for_batch(batch)
+            pred = cond.eval(ctx)
+            keep = pred.data.astype(bool) & pred.validity
+            return batch.compact(keep)
+
+        return fn
+
+
+class TpuRangeExec(TpuExec):
+    """Generate a range on device (ref: basicPhysicalOperators.scala:245)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 batch_rows: Optional[int] = None):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+
+        self.batch_rows = batch_rows or get_conf().get(BATCH_SIZE_ROWS)
+        self._schema = T.Schema([T.Field("id", T.LONG, False)])
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        emitted = 0
+        while emitted < total:
+            n = min(self.batch_rows, total - emitted)
+            cap = pad_capacity(n)
+            base = self.start + emitted * self.step
+            data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+            valid = jnp.arange(cap, dtype=jnp.int32) < n
+            col = Column(data, valid, T.LONG)
+            emitted += n
+            yield self._count_output(ColumnarBatch([col], n, self._schema))
+
+
+class TpuUnionExec(TpuExec):
+    """Concatenation of children outputs (ref: GpuUnionExec,
+    basicPhysicalOperators.scala:287) — streams batches through."""
+
+    def __init__(self, *children: TpuExec):
+        super().__init__(*children)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        schema = self.schema
+        for child in self.children:
+            for b in child.execute():
+                # re-tag with union schema (names from first child)
+                yield self._count_output(
+                    ColumnarBatch(b.columns, b.num_rows, schema))
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concatenate small batches up to a target row goal
+    (ref: GpuCoalesceBatches.scala:133-455 AbstractGpuCoalesceIterator)."""
+
+    def __init__(self, child: TpuExec, goal_rows: Optional[int] = None):
+        super().__init__(child)
+        from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+
+        self.goal_rows = goal_rows or get_conf().get(BATCH_SIZE_ROWS)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def additional_metrics(self):
+        return [("numConcats", "MODERATE")]
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.batch import concat_batches
+
+        pending: list[ColumnarBatch] = []
+        pending_rows = 0
+        for b in self.children[0].execute():
+            n = b.concrete_num_rows()
+            if n == 0:
+                continue
+            pending.append(b)
+            pending_rows += n
+            if pending_rows >= self.goal_rows:
+                self.metrics["numConcats"].add(1)
+                yield self._count_output(concat_batches(pending))
+                pending, pending_rows = [], 0
+        if pending:
+            out = concat_batches(pending) if len(pending) > 1 else pending[0]
+            yield self._count_output(out)

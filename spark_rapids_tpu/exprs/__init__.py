@@ -1,0 +1,10 @@
+from spark_rapids_tpu.exprs.base import (  # noqa: F401
+    Alias,
+    BoundReference,
+    ColumnReference,
+    EvalContext,
+    Expression,
+    Literal,
+    bind_references,
+)
+from spark_rapids_tpu.exprs import arithmetic, predicates  # noqa: F401

@@ -1,0 +1,254 @@
+"""Arithmetic expressions.
+
+TPU counterparts of the reference's arithmetic expression library
+(ref: sql-plugin/.../org/apache/spark/sql/rapids/arithmetic.scala, 676 LoC)
+with Spark SQL semantics: NULL-propagating binary ops, NULL (not error) on
+divide/modulo by zero in non-ANSI mode, Java-style truncating integer
+division, and Spark's pmod definition (sign handling follows
+`r = a % n; if (r < 0) (r + n) % n else r` with Java `%`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column
+from spark_rapids_tpu.exprs.base import (
+    EvalContext,
+    Expression,
+    broadcast_validity,
+    result_numeric_type,
+)
+
+
+def _java_mod(ld, rd):
+    """Java's % (remainder with the dividend's sign) for integer arrays.
+    jnp's // rounds toward -inf; Java divides truncating toward zero."""
+    qi = ld // rd
+    rem = ld - qi * rd
+    fix = (rem != 0) & ((ld < 0) != (rd < 0))
+    qtrunc = jnp.where(fix, qi + 1, qi)
+    return ld - qtrunc * rd
+
+
+@dataclasses.dataclass(repr=False)
+class BinaryArithmetic(Expression):
+    left: Expression
+    right: Expression
+
+    symbol = "?"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return result_numeric_type(self.left.dtype, self.right.dtype)
+
+    @property
+    def nullable(self) -> bool:
+        return self.left.nullable or self.right.nullable
+
+    def _phys(self):
+        return T.to_numpy_dtype(self.dtype)
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        phys = self._phys()
+        ld = lc.data.astype(phys)
+        rd = rc.data.astype(phys)
+        valid = broadcast_validity(lc, rc)
+        data, valid = self.compute(ld, rd, valid)
+        return Column(data, valid, self.dtype)
+
+    def compute(self, ld, rd, valid):
+        raise NotImplementedError
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def compute(self, ld, rd, valid):
+        return ld + rd, valid
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def compute(self, ld, rd, valid):
+        return ld - rd, valid
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def compute(self, ld, rd, valid):
+        return ld * rd, valid
+
+
+class Divide(BinaryArithmetic):
+    """Double division; x/0 -> NULL per Spark non-ANSI Divide semantics."""
+
+    symbol = "/"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DOUBLE
+
+    def compute(self, ld, rd, valid):
+        zero = rd == 0.0
+        safe = jnp.where(zero, 1.0, rd)
+        return ld / safe, valid & ~zero
+
+
+class IntegralDivide(BinaryArithmetic):
+    """`div`: long division truncated toward zero; x div 0 -> NULL."""
+
+    symbol = "div"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    def compute(self, ld, rd, valid):
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd)
+        # integer arithmetic (no float round-trip: big longs lose precision);
+        # jnp // rounds toward -inf, Spark/Java div truncates toward 0
+        qi = ld // safe
+        rem = ld - qi * safe
+        fix = (rem != 0) & ((ld < 0) != (safe < 0))
+        qi = jnp.where(fix, qi + 1, qi)
+        return qi, valid & ~zero
+
+
+class Remainder(BinaryArithmetic):
+    """`%` with Java semantics (sign of dividend); x % 0 -> NULL."""
+
+    symbol = "%"
+
+    def compute(self, ld, rd, valid):
+        if jnp.issubdtype(ld.dtype, jnp.floating):
+            zero = rd == 0.0
+            safe = jnp.where(zero, 1.0, rd)
+            return jnp.fmod(ld, safe), valid & ~zero
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd)
+        return _java_mod(ld, safe), valid & ~zero
+
+
+class Pmod(BinaryArithmetic):
+    """Spark pmod: `r = a % n; if (r < 0) (r + n) % n else r` with Java `%`
+    (ref: arithmetic.scala GpuPmod).  Note pmod(-7, -3) = -1, not 2."""
+
+    symbol = "pmod"
+
+    def compute(self, ld, rd, valid):
+        if jnp.issubdtype(ld.dtype, jnp.floating):
+            zero = rd == 0.0
+            safe = jnp.where(zero, 1.0, rd)
+            r = jnp.fmod(ld, safe)
+            r = jnp.where(r < 0, jnp.fmod(r + safe, safe), r)
+            return r, valid & ~zero
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd)
+        r = _java_mod(ld, safe)
+        r = jnp.where(r < 0, _java_mod(r + safe, safe), r)
+        return r, valid & ~zero
+
+
+@dataclasses.dataclass(repr=False)
+class UnaryMinus(Expression):
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        return Column(-c.data, c.validity, self.dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class UnaryPositive(Expression):
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        return self.child.eval(ctx)
+
+
+@dataclasses.dataclass(repr=False)
+class Abs(Expression):
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        return Column(jnp.abs(c.data), c.validity, self.dtype)
+
+
+def _widen(dtypes) -> T.DataType:
+    out = dtypes[0]
+    for dt in dtypes[1:]:
+        ct = T.common_type(out, dt)
+        if ct is None:
+            raise TypeError(f"incompatible types {out} / {dt}")
+        out = ct
+    return out
+
+
+@dataclasses.dataclass(repr=False)
+class Least(Expression):
+    """least(...) ignoring NULLs (ref: arithmetic.scala GpuLeast)."""
+
+    exprs: tuple[Expression, ...]
+
+    def __init__(self, *exprs: Expression):
+        self.exprs = tuple(exprs)
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return _widen([e.dtype for e in self.exprs])
+
+    def _select(self, acc, d):
+        return jnp.minimum(acc, d)
+
+    def _sentinel(self, phys):
+        return jnp.asarray(
+            jnp.finfo(phys).max if jnp.issubdtype(phys, jnp.floating)
+            else jnp.iinfo(phys).max, phys)
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cols = [e.eval(ctx) for e in self.exprs]
+        phys = T.to_numpy_dtype(self.dtype)
+        sentinel = self._sentinel(phys)
+        acc = None
+        any_valid = None
+        for c in cols:
+            d = jnp.where(c.validity, c.data.astype(phys), sentinel)
+            acc = d if acc is None else self._select(acc, d)
+            any_valid = c.validity if any_valid is None \
+                else (any_valid | c.validity)
+        return Column(acc, any_valid, self.dtype)
+
+
+class Greatest(Least):
+    def _select(self, acc, d):
+        return jnp.maximum(acc, d)
+
+    def _sentinel(self, phys):
+        return jnp.asarray(
+            jnp.finfo(phys).min if jnp.issubdtype(phys, jnp.floating)
+            else jnp.iinfo(phys).min, phys)

@@ -1,0 +1,85 @@
+"""Project/Filter/Range/Union/Coalesce exec tests incl. pipeline fusion."""
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.arrow import from_arrow, to_arrow
+from spark_rapids_tpu.exprs.base import ColumnReference as col, lit
+from spark_rapids_tpu.execs.base import NUM_OUTPUT_BATCHES
+from spark_rapids_tpu.execs.basic import (
+    TpuBatchSourceExec,
+    TpuCoalesceBatchesExec,
+    TpuFilterExec,
+    TpuProjectExec,
+    TpuRangeExec,
+    TpuUnionExec,
+)
+
+
+def source(*tables):
+    batches = [from_arrow(t) for t in tables]
+    return TpuBatchSourceExec(batches, batches[0].schema)
+
+
+def run(plan):
+    tables = [to_arrow(b) for b in plan.execute()]
+    out = pa.concat_tables(tables) if tables else None
+    return out
+
+
+T1 = pa.table({
+    "a": pa.array([1, 2, None, -7, 9], pa.int64()),
+    "b": pa.array([3, 0, 5, 2, None], pa.int64()),
+})
+
+
+def test_project():
+    plan = TpuProjectExec(
+        [(col("a") + col("b")).alias("s"), col("a")], source(T1))
+    out = run(plan)
+    assert out.column("s").to_pylist() == [4, 2, None, -5, None]
+    assert out.column("a").to_pylist() == [1, 2, None, -7, 9]
+    assert plan.schema.names == ["s", "a"]
+
+
+def test_filter_drops_null_predicate_rows():
+    plan = TpuFilterExec(col("a") > lit(0), source(T1))
+    out = run(plan)
+    assert out.column("a").to_pylist() == [1, 2, 9]
+    assert out.column("b").to_pylist() == [3, 0, None]
+
+
+def test_fused_pipeline():
+    # filter(project(filter(src))) fuses into one jit program
+    p1 = TpuFilterExec(col("a").is_not_null(), source(T1))
+    p2 = TpuProjectExec(
+        [col("a"), (col("a") * lit(10)).alias("a10")], p1)
+    p3 = TpuFilterExec(col("a10") >= lit(0), p2)
+    out = run(p3)
+    assert out.column("a").to_pylist() == [1, 2, 9]
+    assert out.column("a10").to_pylist() == [10, 20, 90]
+
+
+def test_range():
+    plan = TpuRangeExec(0, 1000, 3, batch_rows=256)
+    out = run(plan)
+    assert out.column("id").to_pylist() == list(range(0, 1000, 3))
+    assert plan.metrics[NUM_OUTPUT_BATCHES].value == 2
+
+
+def test_union():
+    t2 = pa.table({"a": pa.array([100], pa.int64()),
+                   "b": pa.array([None], pa.int64())})
+    plan = TpuUnionExec(source(T1), source(t2))
+    out = run(plan)
+    assert out.column("a").to_pylist() == [1, 2, None, -7, 9, 100]
+
+
+def test_coalesce_batches():
+    tables = [pa.table({"a": pa.array([i, i + 1], pa.int64()),
+                        "b": pa.array([0, 0], pa.int64())})
+              for i in range(0, 10, 2)]
+    plan = TpuCoalesceBatchesExec(source(*tables), goal_rows=6)
+    batches = list(plan.execute())
+    assert [b.concrete_num_rows() for b in batches] == [6, 4]
+    assert plan.metrics["numConcats"].value == 1

@@ -1,0 +1,186 @@
+"""Expression engine tests: Spark SQL null semantics and arithmetic parity
+against hand-computed expectations (model: the reference's CastOpSuite /
+arithmetic unit suites, SURVEY.md section 4 tier 2)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.arrow import from_arrow
+from spark_rapids_tpu.exprs.base import ColumnReference, EvalContext, Literal, bind_references, lit
+from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.columnar.column import column_to_numpy
+
+
+def col(name):
+    return ColumnReference(name)
+
+
+def eval_expr(expr, table):
+    batch = from_arrow(table)
+    bound = bind_references(expr, batch.schema)
+    ctx = EvalContext.for_batch(batch)
+    out = bound.eval(ctx)
+    n = batch.concrete_num_rows()
+    vals, valid = column_to_numpy(out, n)
+    return [
+        (vals[i].item() if hasattr(vals[i], "item") else vals[i])
+        if valid[i] else None
+        for i in range(n)
+    ]
+
+
+T1 = pa.table({
+    "a": pa.array([1, 2, None, -7, 9], pa.int64()),
+    "b": pa.array([3, 0, 5, 2, None], pa.int64()),
+    "x": pa.array([1.5, -2.0, None, 0.0, float("nan")], pa.float64()),
+    "p": pa.array([True, False, None, True, False], pa.bool_()),
+    "q": pa.array([True, None, None, False, True], pa.bool_()),
+    "s": pa.array(["apple", "banana", None, "", "apple"], pa.string()),
+})
+
+
+def test_add_nulls():
+    assert eval_expr(col("a") + col("b"), T1) == [4, 2, None, -5, None]
+
+
+def test_divide_by_zero_is_null():
+    out = eval_expr(col("a") / col("b"), T1)
+    assert out[0] == pytest.approx(1 / 3)
+    assert out[1] is None  # 2/0 -> NULL (Spark non-ANSI)
+    assert out[2] is None
+    assert out[3] == pytest.approx(-3.5)
+    assert out[4] is None
+
+
+def test_integral_divide_truncates_toward_zero():
+    t = pa.table({"a": pa.array([7, -7, 7, -7, 0], pa.int64()),
+                  "b": pa.array([2, 2, -2, -2, 0], pa.int64())})
+    assert eval_expr(A.IntegralDivide(col("a"), col("b")), t) == \
+        [3, -3, -3, 3, None]
+
+
+def test_remainder_java_sign():
+    t = pa.table({"a": pa.array([7, -7, 7, -7], pa.int64()),
+                  "b": pa.array([3, 3, -3, -3], pa.int64())})
+    assert eval_expr(A.Remainder(col("a"), col("b")), t) == [1, -1, 1, -1]
+
+
+def test_pmod_spark_semantics():
+    # Spark pmod: r = a % n (Java %); if r < 0 then (r + n) % n else r
+    # => pmod(-7, 3) = 2 but pmod(7, -3) = 1, pmod(-7, -3) = -1
+    t = pa.table({"a": pa.array([7, -7, 7, -7], pa.int64()),
+                  "b": pa.array([3, 3, -3, -3], pa.int64())})
+    assert eval_expr(A.Pmod(col("a"), col("b")), t) == [1, 2, 1, -1]
+
+
+def test_comparisons_null_propagate():
+    assert eval_expr(col("a") > col("b"), T1) == \
+        [False, True, None, False, None]
+    assert eval_expr(col("a").eq(lit(2)), T1) == \
+        [False, True, None, False, False]
+
+
+def test_kleene_and_or():
+    assert eval_expr(col("p") & col("q"), T1) == \
+        [True, False, None, False, False]
+    assert eval_expr(col("p") | col("q"), T1) == \
+        [True, None, None, True, True]
+
+
+def test_is_null_not_null():
+    assert eval_expr(col("a").is_null(), T1) == \
+        [False, False, True, False, False]
+    assert eval_expr(col("x").is_not_null(), T1) == \
+        [True, True, False, True, True]
+
+
+def test_equal_null_safe():
+    t = pa.table({"a": pa.array([1, None, None, 4], pa.int64()),
+                  "b": pa.array([1, None, 3, 5], pa.int64())})
+    assert eval_expr(P.EqualNullSafe(col("a"), col("b")), t) == \
+        [True, True, False, False]
+
+
+def test_string_compare():
+    assert eval_expr(col("s").eq(lit("apple")), T1) == \
+        [True, False, None, False, True]
+    assert eval_expr(col("s") < lit("b"), T1) == \
+        [True, False, None, True, True]
+
+
+def test_string_embedded_nul():
+    t = pa.table({"s": pa.array(["a", "a\x00", "a\x00b"], pa.string())})
+    assert eval_expr(col("s").eq(lit("a")), t) == [True, False, False]
+    assert eval_expr(col("s") < lit("a\x00"), t) == [True, False, False]
+
+
+def test_in_set():
+    assert eval_expr(P.In(col("a"), (1, 9)), T1) == \
+        [True, False, None, False, True]
+    # list containing NULL: no-match rows become NULL
+    assert eval_expr(P.In(col("a"), (1, None)), T1) == \
+        [True, None, None, None, None]
+    assert eval_expr(P.In(col("s"), ("apple", "")), T1) == \
+        [True, False, None, True, True]
+
+
+def test_coalesce():
+    assert eval_expr(P.Coalesce(col("a"), col("b")), T1) == [1, 2, 5, -7, 9]
+    assert eval_expr(P.Coalesce(col("s"), lit("zz")), T1) == \
+        ["apple", "banana", "zz", "", "apple"]
+
+
+def test_if_case_when():
+    e = P.If(col("a") > lit(0), col("a"), A.UnaryMinus(col("a")))
+    assert eval_expr(e, T1) == [1, 2, None, 7, 9]
+    cw = P.CaseWhen(
+        (((col("a") > lit(5)), lit(100)), ((col("a") > lit(0)), lit(10))),
+        lit(0))
+    assert eval_expr(cw, T1) == [10, 10, 0, 0, 100]
+
+
+def test_least_greatest():
+    assert eval_expr(A.Least(col("a"), col("b")), T1) == [1, 0, 5, -7, 9]
+    assert eval_expr(A.Greatest(col("a"), col("b")), T1) == [3, 2, 5, 2, 9]
+
+
+def test_isnan():
+    # Spark IsNaN is non-nullable: NULL input -> false
+    assert eval_expr(P.IsNaN(col("x")), T1) == \
+        [False, False, False, False, True]
+
+
+def test_nan_total_order():
+    t = pa.table({"x": pa.array([1.0, float("nan"), float("nan"), 5.0],
+                                pa.float64()),
+                  "y": pa.array([float("nan"), float("nan"), 2.0, 4.0],
+                                pa.float64())})
+    # Spark: NaN == NaN true, NaN greater than everything
+    assert eval_expr(col("x").eq(col("y")), t) == \
+        [False, True, False, False]
+    assert eval_expr(col("x") > col("y"), t) == \
+        [False, False, True, True]
+    assert eval_expr(col("x") < col("y"), t) == \
+        [True, False, False, False]
+    assert eval_expr(col("x") >= col("y"), t) == \
+        [False, True, True, True]
+
+
+def test_if_widens_types():
+    t = pa.table({"a": pa.array([1, 2], pa.int64()),
+                  "x": pa.array([1.5, 2.5], pa.float64()),
+                  "p": pa.array([True, False], pa.bool_())})
+    assert eval_expr(P.If(col("p"), col("a"), col("x")), t) == [1.0, 2.5]
+    assert eval_expr(A.Least(col("a"), col("x")), t) == [1.0, 2.0]
+
+
+def test_abs_unary_minus():
+    assert eval_expr(A.Abs(col("a")), T1) == [1, 2, None, 7, 9]
+    assert eval_expr(A.UnaryMinus(col("a")), T1) == [-1, -2, None, 7, -9]
+
+
+def test_literal_null():
+    assert eval_expr(Literal.of(None, T.LONG) + col("a"), T1) == [None] * 5
