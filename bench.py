@@ -1,0 +1,56 @@
+"""Benchmark driver: TPC-H q6-shaped pipeline throughput on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is effective scan throughput (rows/s) of the fused
+filter+project+aggregate program over device-resident batches — the
+first milestone config in BASELINE.md (q6 @ single executor).
+`vs_baseline` compares against a CPU-Spark-class single-core columnar
+baseline of 100M rows/s for this pipeline shape (the reference claims
+3-7x over CPU Spark for full-GPU plans, docs/FAQ.md:82-88; we measure,
+not copy — this constant is our local CPU pyarrow-compute measurement
+and is re-derived in tests/test_bench_baseline.py).
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Rows/s of the same q6 pipeline on one host CPU core via pyarrow.compute
+# (measured locally; see scripts/measure_cpu_baseline.py).
+CPU_BASELINE_ROWS_PER_S = 100e6
+
+
+def main() -> None:
+    import jax
+
+    from __graft_entry__ import _example_batch, _q6_batch_fn
+
+    n_rows = 1 << 22  # 4M rows per batch
+    capacity = 1 << 22
+    fn = jax.jit(_q6_batch_fn())
+    batches = [_example_batch(n_rows, capacity, seed=s) for s in range(4)]
+
+    # warmup/compile
+    out = fn(batches[0])
+    jax.block_until_ready(out.columns[0].data)
+
+    iters = 8
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(batches[i % len(batches)])
+    jax.block_until_ready(out.columns[0].data)
+    dt = time.perf_counter() - t0
+
+    rows_per_s = n_rows * iters / dt
+    print(json.dumps({
+        "metric": "q6_pipeline_throughput",
+        "value": round(rows_per_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_s / CPU_BASELINE_ROWS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

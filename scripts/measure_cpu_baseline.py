@@ -1,0 +1,44 @@
+"""Measure the single-core CPU (pyarrow.compute) throughput of the same
+q6-shaped pipeline bench.py runs on the accelerator.  The printed rows/s
+feeds bench.py's CPU_BASELINE_ROWS_PER_S (the stand-in for "CPU Spark"
+until the differential engine runs full TPC-H)."""
+
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+def main() -> None:
+    n_rows = 1 << 22
+    rng = np.random.default_rng(0)
+    tbl = pa.table({
+        "l_quantity": rng.integers(1, 51, n_rows).astype(np.float64),
+        "l_extendedprice": rng.uniform(900, 105000, n_rows),
+        "l_discount": rng.integers(0, 11, n_rows).astype(np.float64) / 100.0,
+        "l_shipdate": rng.integers(8766, 10957, n_rows).astype(np.int32),
+    })
+
+    def q6(t):
+        m = pc.and_(
+            pc.and_(
+                pc.and_(pc.greater_equal(t["l_shipdate"], 8766),
+                        pc.less(t["l_shipdate"], 9131)),
+                pc.and_(pc.greater_equal(t["l_discount"], 0.05),
+                        pc.less_equal(t["l_discount"], 0.07))),
+            pc.less(t["l_quantity"], 24.0))
+        f = t.filter(m)
+        return pc.sum(pc.multiply(f["l_extendedprice"], f["l_discount"]))
+
+    q6(tbl)  # warmup
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = q6(tbl)
+    dt = time.perf_counter() - t0
+    print(f"result={out}  rows/s={n_rows * iters / dt:,.0f}")
+
+
+if __name__ == "__main__":
+    main()

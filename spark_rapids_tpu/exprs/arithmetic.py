@@ -24,14 +24,18 @@ from spark_rapids_tpu.exprs.base import (
 )
 
 
-def _java_mod(ld, rd):
-    """Java's % (remainder with the dividend's sign) for integer arrays.
-    jnp's // rounds toward -inf; Java divides truncating toward zero."""
+def _java_divmod(ld, rd):
+    """Java's truncate-toward-zero (quotient, remainder) for integer
+    arrays.  jnp's // rounds toward -inf; Java truncates toward zero."""
     qi = ld // rd
     rem = ld - qi * rd
     fix = (rem != 0) & ((ld < 0) != (rd < 0))
     qtrunc = jnp.where(fix, qi + 1, qi)
-    return ld - qtrunc * rd
+    return qtrunc, ld - qtrunc * rd
+
+
+def _java_mod(ld, rd):
+    return _java_divmod(ld, rd)[1]
 
 
 @dataclasses.dataclass(repr=False)
@@ -93,6 +97,10 @@ class Divide(BinaryArithmetic):
     symbol = "/"
 
     @property
+    def nullable(self) -> bool:
+        return True  # introduces NULL on zero divisor (Spark: always true)
+
+    @property
     def dtype(self) -> T.DataType:
         return T.DOUBLE
 
@@ -108,18 +116,18 @@ class IntegralDivide(BinaryArithmetic):
     symbol = "div"
 
     @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
     def dtype(self) -> T.DataType:
         return T.LONG
 
     def compute(self, ld, rd, valid):
         zero = rd == 0
         safe = jnp.where(zero, 1, rd)
-        # integer arithmetic (no float round-trip: big longs lose precision);
-        # jnp // rounds toward -inf, Spark/Java div truncates toward 0
-        qi = ld // safe
-        rem = ld - qi * safe
-        fix = (rem != 0) & ((ld < 0) != (safe < 0))
-        qi = jnp.where(fix, qi + 1, qi)
+        # integer arithmetic (no float round-trip: big longs lose precision)
+        qi, _ = _java_divmod(ld, safe)
         return qi, valid & ~zero
 
 
@@ -127,6 +135,10 @@ class Remainder(BinaryArithmetic):
     """`%` with Java semantics (sign of dividend); x % 0 -> NULL."""
 
     symbol = "%"
+
+    @property
+    def nullable(self) -> bool:
+        return True
 
     def compute(self, ld, rd, valid):
         if jnp.issubdtype(ld.dtype, jnp.floating):
@@ -143,6 +155,10 @@ class Pmod(BinaryArithmetic):
     (ref: arithmetic.scala GpuPmod).  Note pmod(-7, -3) = -1, not 2."""
 
     symbol = "pmod"
+
+    @property
+    def nullable(self) -> bool:
+        return True
 
     def compute(self, ld, rd, valid):
         if jnp.issubdtype(ld.dtype, jnp.floating):
@@ -208,9 +224,17 @@ def _widen(dtypes) -> T.DataType:
 
 @dataclasses.dataclass(repr=False)
 class Least(Expression):
-    """least(...) ignoring NULLs (ref: arithmetic.scala GpuLeast)."""
+    """least(...) ignoring NULLs (ref: arithmetic.scala GpuLeast).
+
+    Selection runs on integer *total-order keys* (the sort-key transform
+    from ops.sort) rather than the float values themselves, which gets
+    Spark's ordering contract for free: NaN counts as the greatest value
+    (least(NaN, 1.0) = 1.0, greatest(NaN, 1.0) = NaN) and +/-inf inputs
+    never collide with the NULL-slot sentinel."""
 
     exprs: tuple[Expression, ...]
+
+    _take_new = staticmethod(lambda k, acc_k: k < acc_k)
 
     def __init__(self, *exprs: Expression):
         self.exprs = tuple(exprs)
@@ -222,33 +246,34 @@ class Least(Expression):
     def dtype(self) -> T.DataType:
         return _widen([e.dtype for e in self.exprs])
 
-    def _select(self, acc, d):
-        return jnp.minimum(acc, d)
-
-    def _sentinel(self, phys):
-        return jnp.asarray(
-            jnp.finfo(phys).max if jnp.issubdtype(phys, jnp.floating)
-            else jnp.iinfo(phys).max, phys)
+    def _null_key(self, kdt):
+        # NULL slots must never win the comparison
+        return jnp.asarray(jnp.iinfo(kdt).max, kdt)
 
     def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.ops.sort import float_total_order_bits
+
         cols = [e.eval(ctx) for e in self.exprs]
         phys = T.to_numpy_dtype(self.dtype)
-        sentinel = self._sentinel(phys)
-        acc = None
-        any_valid = None
+        is_float = jnp.issubdtype(phys, jnp.floating)
+        acc_val = acc_key = any_valid = None
         for c in cols:
-            d = jnp.where(c.validity, c.data.astype(phys), sentinel)
-            acc = d if acc is None else self._select(acc, d)
+            d = c.data.astype(phys)
+            key = float_total_order_bits(d) if is_float else d
+            key = jnp.where(c.validity, key, self._null_key(key.dtype))
+            if acc_val is None:
+                acc_val, acc_key = d, key
+            else:
+                take = self._take_new(key, acc_key)
+                acc_val = jnp.where(take, d, acc_val)
+                acc_key = jnp.where(take, key, acc_key)
             any_valid = c.validity if any_valid is None \
                 else (any_valid | c.validity)
-        return Column(acc, any_valid, self.dtype)
+        return Column(acc_val, any_valid, self.dtype)
 
 
 class Greatest(Least):
-    def _select(self, acc, d):
-        return jnp.maximum(acc, d)
+    _take_new = staticmethod(lambda k, acc_k: k > acc_k)
 
-    def _sentinel(self, phys):
-        return jnp.asarray(
-            jnp.finfo(phys).min if jnp.issubdtype(phys, jnp.floating)
-            else jnp.iinfo(phys).min, phys)
+    def _null_key(self, kdt):
+        return jnp.asarray(jnp.iinfo(kdt).min, kdt)

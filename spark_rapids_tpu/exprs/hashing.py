@@ -1,0 +1,202 @@
+"""Spark-compatible Murmur3 hashing, vectorized for XLA.
+
+Counterpart of the reference's HashFunctions.scala (GpuMurmur3Hash) whose
+whole purpose is *bit-for-bit parity with Spark CPU hash partitioning*
+(ref: sql-plugin/.../org/apache/spark/sql/rapids/HashFunctions.scala and
+GpuHashPartitioning.scala).  Spark's hash is Murmur3 x86_32 with Spark's
+own quirks (from `Murmur3_x86_32.hashUnsafeBytes` in spark-catalyst):
+
+- ints/smaller + float + boolean + date hash as a single 4-byte block;
+- longs + double + timestamp hash as two 4-byte blocks (low word first);
+- strings hash their UTF-8 bytes: each aligned 4-byte little-endian block
+  through mixK1/mixH1, then *each remaining tail byte individually*
+  (sign-extended!) through mixK1/mixH1 — this differs from canonical
+  murmur3's tail handling and is required for parity;
+- NULL columns leave the running seed untouched;
+- multi-column hash chains: seed of column i+1 = hash of column i;
+  default initial seed is 42.
+
+All arithmetic is uint32 with wrap-around, which XLA vectorizes cleanly on
+the VPU; the string path is a static unroll over the fixed byte-matrix
+width (W/4 block steps + W masked tail steps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+
+DEFAULT_SEED = 42
+
+
+def _u32(x) -> jax.Array:
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def _rotl(x: jax.Array, r: int) -> jax.Array:
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1: jax.Array) -> jax.Array:
+    k1 = k1 * _C1
+    k1 = _rotl(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1: jax.Array, k1: jax.Array) -> jax.Array:
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix(h1: jax.Array, length: Union[int, jax.Array]) -> jax.Array:
+    h1 = h1 ^ _u32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> 16)
+    return h1
+
+
+def hash_int32_block(word: jax.Array, seed: jax.Array) -> jax.Array:
+    """Murmur3 of a single 4-byte value (Spark hashInt)."""
+    h1 = _mix_h1(_u32(seed), _mix_k1(_u32(word)))
+    return _fmix(h1, 4)
+
+
+def hash_int64_blocks(value: jax.Array, seed: jax.Array) -> jax.Array:
+    """Murmur3 of an 8-byte value, low 32-bit word first (Spark hashLong)."""
+    v = value.astype(jnp.int64)
+    low = _u32(v & jnp.int64(0xFFFFFFFF))
+    high = _u32((v >> 32) & jnp.int64(0xFFFFFFFF))
+    h1 = _mix_h1(_u32(seed), _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, 8)
+
+
+def _float_to_bits(x: jax.Array) -> jax.Array:
+    """Java floatToIntBits: canonical NaN 0x7fc00000, else raw IEEE bits."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+    return jnp.where(jnp.isnan(x), jnp.int32(0x7FC00000), bits)
+
+
+def _double_to_bits(x: jax.Array) -> jax.Array:
+    """Java doubleToLongBits: canonical NaN 0x7ff8000000000000."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
+    return jnp.where(jnp.isnan(x), jnp.int64(0x7FF8000000000000), bits)
+
+
+def hash_string_bytes(chars: jax.Array, lengths: jax.Array,
+                      seed: jax.Array) -> jax.Array:
+    """Spark hashUnsafeBytes over a fixed-width (n, W) uint8 byte matrix.
+
+    Aligned blocks are little-endian ints; tail bytes are processed one at
+    a time *sign-extended* (Platform.getByte is a signed read).
+    """
+    n, width = chars.shape
+    h1 = jnp.broadcast_to(_u32(seed), (n,))
+    lengths = lengths.astype(jnp.int32)
+    aligned = lengths - (lengths % 4)
+    c32 = chars.astype(jnp.uint32)
+    nblocks = (width + 3) // 4
+    for b in range(nblocks):
+        j = b * 4
+
+        def byte(off):
+            if j + off < width:
+                return c32[:, j + off]
+            return jnp.zeros((n,), jnp.uint32)
+
+        word = (byte(0) | (byte(1) << 8) | (byte(2) << 16) | (byte(3) << 24))
+        in_block = jnp.int32(j + 4) <= aligned
+        h1 = jnp.where(in_block, _mix_h1(h1, _mix_k1(word)), h1)
+    # tail: each byte beyond the aligned prefix, sign-extended to int
+    for j in range(width):
+        is_tail = (jnp.int32(j) >= aligned) & (jnp.int32(j) < lengths)
+        signed = chars[:, j].astype(jnp.int8).astype(jnp.int32)
+        h1 = jnp.where(is_tail, _mix_h1(h1, _mix_k1(_u32(signed))), h1)
+    return _fmix(h1, _u32(lengths))
+
+
+def hash_column(col: AnyColumn, seed: jax.Array) -> jax.Array:
+    """Hash one column into a running uint32 seed array; NULL rows keep
+    the incoming seed (Spark semantics)."""
+    if isinstance(col, StringColumn):
+        h = hash_string_bytes(col.chars, col.lengths, seed)
+        return jnp.where(col.validity, h, seed)
+    dt = col.dtype
+    if isinstance(dt, (T.BooleanType,)):
+        h = hash_int32_block(col.data.astype(jnp.int32), seed)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        h = hash_int32_block(col.data.astype(jnp.int32), seed)
+    elif isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
+        h = hash_int64_blocks(col.data, seed)
+    elif isinstance(dt, T.FloatType):
+        # Spark normalizes -0.0f to 0.0f before hashing
+        x = col.data.astype(jnp.float32)
+        x = jnp.where(x == 0.0, jnp.float32(0.0), x)
+        h = hash_int32_block(_float_to_bits(x), seed)
+    elif isinstance(dt, T.DoubleType):
+        x = col.data.astype(jnp.float64)
+        x = jnp.where(x == 0.0, jnp.float64(0.0), x)
+        h = hash_int64_blocks(_double_to_bits(x), seed)
+    else:
+        raise TypeError(f"murmur3 unsupported for {dt}")
+    return jnp.where(col.validity, h, seed)
+
+
+def hash_columns(cols: Sequence[AnyColumn], capacity: int,
+                 seed: int = DEFAULT_SEED) -> jax.Array:
+    """Chained multi-column Spark hash -> int32 array (Spark `hash(...)`)."""
+    h = jnp.full((capacity,), seed, jnp.uint32)
+    for c in cols:
+        h = hash_column(c, h)
+    return h.astype(jnp.int32)
+
+
+@dataclasses.dataclass(repr=False)
+class Murmur3Hash(Expression):
+    """SQL hash(exprs...) (ref: HashFunctions.scala GpuMurmur3Hash)."""
+
+    exprs: tuple[Expression, ...]
+    seed: int = DEFAULT_SEED
+
+    def __init__(self, *exprs: Expression, seed: int = DEFAULT_SEED):
+        self.exprs = tuple(exprs)
+        self.seed = seed
+
+    def with_children(self, children):
+        return type(self)(*children, seed=self.seed)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.INT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cols = [e.eval(ctx) for e in self.exprs]
+        h = hash_columns(cols, ctx.batch.capacity, self.seed)
+        return Column(h, ctx.row_mask, T.INT)
+
+
+def partition_ids(cols: Sequence[AnyColumn], capacity: int,
+                  num_partitions: int) -> jax.Array:
+    """Spark hash-partitioning: pmod(hash(keys), numPartitions)
+    (ref: GpuHashPartitioning.scala).  Returns int32 in [0, n)."""
+    h = hash_columns(cols, capacity)
+    m = h % jnp.int32(num_partitions)
+    return jnp.where(m < 0, m + jnp.int32(num_partitions), m)

@@ -339,7 +339,8 @@ class If(Expression):
 
     @property
     def dtype(self) -> T.DataType:
-        if isinstance(self.then.dtype, T.StringType):
+        if isinstance(self.then.dtype, T.StringType) or isinstance(
+                self.otherwise.dtype, T.StringType):
             return T.STRING
         from spark_rapids_tpu.exprs.arithmetic import _widen
 
@@ -388,7 +389,12 @@ class CaseWhen(Expression):
 
     @property
     def dtype(self) -> T.DataType:
-        return self.branches[0][1].dtype
+        vals = [v for _, v in self.branches] + [self.else_value]
+        if any(isinstance(v.dtype, T.StringType) for v in vals):
+            return T.STRING
+        from spark_rapids_tpu.exprs.arithmetic import _widen
+
+        return _widen([v.dtype for v in vals])
 
     def eval(self, ctx: EvalContext) -> AnyColumn:
         expr: Expression = self.else_value

@@ -1,0 +1,6 @@
+"""Shared device kernels (sort, segmented aggregation, partitioning).
+
+This package plays the role cudf's C++ kernels play for the reference
+(L0 in SURVEY.md): dense, fixed-shape primitives the operator library
+calls into.  Here they are jax.numpy/XLA programs (Pallas where it pays).
+"""

@@ -1,0 +1,225 @@
+"""Sort-based segmented group-by aggregation.
+
+TPU counterpart of cudf's `Table.groupBy(...).aggregate(...)` as used by
+GpuHashAggregateExec (ref: sql-plugin/.../aggregate.scala:240,366).  cudf
+uses a device hash table; the XLA-idiomatic design is sort-based:
+
+    sort rows by key -> mark segment starts -> segment_{sum,min,max}
+
+which is one fused program of static shape: the output batch has the same
+capacity as the input with `num_groups` live rows (traced scalar).
+Aggregations are expressed as (update, merge) pairs the way Spark
+aggregate modes are (Partial -> PartialMerge/Final), so multi-batch and
+post-shuffle merging reuse the same kernels on the partial-result columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
+from spark_rapids_tpu.ops.sort import SortOrder, sort_permutation
+
+
+def _keys_equal_adjacent(col: AnyColumn) -> jax.Array:
+    """row i equal to row i-1 under SQL grouping (NULL == NULL)."""
+    if isinstance(col, StringColumn):
+        chars_eq = jnp.all(col.chars == jnp.roll(col.chars, 1, axis=0), axis=1)
+        len_eq = col.lengths == jnp.roll(col.lengths, 1)
+        data_eq = chars_eq & len_eq
+    else:
+        data_eq = col.data == jnp.roll(col.data, 1)
+        if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
+            # NaN == NaN for grouping; -0.0 groups with 0.0 via pre-normalize
+            both_nan = jnp.isnan(col.data) & jnp.isnan(jnp.roll(col.data, 1))
+            data_eq = data_eq | both_nan
+    valid_eq = col.validity == jnp.roll(col.validity, 1)
+    null_pair = (~col.validity) & (~jnp.roll(col.validity, 1))
+    return valid_eq & (data_eq | null_pair)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregation over a value ordinal.  `op` in
+    {sum, count, count_star, min, max, first, last}; avg is planned as
+    sum+count and finalized by the exec (the way the reference splits
+    GpuAverage into update/merge expressions, AggregateFunctions.scala)."""
+
+    op: str
+    ordinal: int  # ignored for count_star
+    out_dtype: Optional[T.DataType] = None
+
+
+def _sum_dtype(dt: T.DataType) -> T.DataType:
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return T.DOUBLE
+    if isinstance(dt, T.DecimalType):
+        return T.DecimalType(min(dt.precision + 10, T.DecimalType.MAX_PRECISION),
+                             dt.scale)
+    return T.LONG
+
+
+def agg_output_dtype(spec: AggSpec, value_dtype: Optional[T.DataType]
+                     ) -> T.DataType:
+    if spec.out_dtype is not None:
+        return spec.out_dtype
+    if spec.op in ("count", "count_star"):
+        return T.LONG
+    if spec.op == "sum":
+        assert value_dtype is not None
+        return _sum_dtype(value_dtype)
+    assert value_dtype is not None
+    return value_dtype
+
+
+def groupby_aggregate(batch: ColumnarBatch, key_ordinals: Sequence[int],
+                      aggs: Sequence[AggSpec],
+                      out_schema: T.Schema) -> ColumnarBatch:
+    """One-batch group-by.  Output columns = keys ++ aggs, prefix-compact
+    with num_groups live rows.  Traceable (fixed shapes throughout)."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    orders = [SortOrder(o) for o in key_ordinals]
+    perm = sort_permutation(batch, orders)
+    sorted_batch = batch.gather(perm, batch.num_rows)
+    live_sorted = jnp.take(live, perm)
+
+    key_cols = [sorted_batch.columns[o] for o in key_ordinals]
+    same_as_prev = jnp.ones((cap,), bool)
+    for kc in key_cols:
+        same_as_prev = same_as_prev & _keys_equal_adjacent(kc)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_start = live_sorted & ((idx == 0) | ~same_as_prev)
+    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    # dead rows -> out-of-range segment (dropped by segment_* ops)
+    seg_id = jnp.where(live_sorted, seg_id, cap)
+    num_groups = jnp.sum(is_start.astype(jnp.int32))
+
+    out_cols: list[AnyColumn] = []
+    # keys: value at each segment start, scattered to [0, num_groups)
+    start_dest = jnp.where(is_start, seg_id, cap)
+    group_live = idx < num_groups
+    for kc in key_cols:
+        if isinstance(kc, StringColumn):
+            chars = jnp.zeros_like(kc.chars).at[start_dest].set(
+                kc.chars, mode="drop")
+            lengths = jnp.zeros_like(kc.lengths).at[start_dest].set(
+                kc.lengths, mode="drop")
+            valid = jnp.zeros_like(kc.validity).at[start_dest].set(
+                kc.validity, mode="drop") & group_live
+            out_cols.append(StringColumn(chars, lengths, valid))
+        else:
+            data = jnp.zeros_like(kc.data).at[start_dest].set(
+                kc.data, mode="drop")
+            valid = jnp.zeros_like(kc.validity).at[start_dest].set(
+                kc.validity, mode="drop") & group_live
+            out_cols.append(Column(data, valid, kc.dtype))
+
+    for spec in aggs:
+        out_cols.append(_eval_agg(spec, sorted_batch, seg_id, live_sorted,
+                                  group_live, cap))
+    n_keys = len(key_cols)
+    assert len(out_schema) == n_keys + len(aggs)
+    return ColumnarBatch(out_cols, num_groups, out_schema)
+
+
+def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
+              live_sorted: jax.Array, group_live: jax.Array,
+              cap: int) -> Column:
+    if spec.op == "count_star":
+        ones = live_sorted.astype(jnp.int64)
+        counts = jax.ops.segment_sum(ones, seg_id, num_segments=cap)
+        return Column(counts, group_live, T.LONG)
+
+    vcol = sorted_batch.columns[spec.ordinal]
+    assert isinstance(vcol, Column), f"agg over {vcol.dtype} unsupported"
+    valid = vcol.validity & live_sorted
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int64), seg_id,
+                                 num_segments=cap)
+
+    if spec.op == "count":
+        return Column(nvalid, group_live, T.LONG)
+
+    out_dtype = agg_output_dtype(spec, vcol.dtype)
+    phys = T.to_numpy_dtype(out_dtype)
+    if spec.op == "sum":
+        vals = jnp.where(valid, vcol.data.astype(phys), jnp.asarray(0, phys))
+        sums = jax.ops.segment_sum(vals, seg_id, num_segments=cap)
+        return Column(sums, group_live & (nvalid > 0), out_dtype)
+    if spec.op in ("min", "max"):
+        if jnp.issubdtype(phys, jnp.floating):
+            sentinel = jnp.asarray(
+                jnp.inf if spec.op == "min" else -jnp.inf, phys)
+        else:
+            info = jnp.iinfo(phys)
+            sentinel = jnp.asarray(
+                info.max if spec.op == "min" else info.min, phys)
+        vals = jnp.where(valid, vcol.data.astype(phys), sentinel)
+        f = jax.ops.segment_min if spec.op == "min" else jax.ops.segment_max
+        out = f(vals, seg_id, num_segments=cap)
+        return Column(out, group_live & (nvalid > 0), out_dtype)
+    if spec.op in ("first", "last"):
+        # first/last non-null within the segment, in sorted-batch order
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        pos = jnp.where(valid, idx, cap if spec.op == "first" else -1)
+        f = jax.ops.segment_min if spec.op == "first" else jax.ops.segment_max
+        sel = f(pos, seg_id, num_segments=cap)
+        sel_clipped = jnp.clip(sel, 0, cap - 1)
+        out = jnp.take(vcol.data, sel_clipped).astype(phys)
+        return Column(out, group_live & (nvalid > 0), out_dtype)
+    raise ValueError(f"unknown agg op {spec.op}")
+
+
+def reduce_aggregate(batch: ColumnarBatch, aggs: Sequence[AggSpec],
+                     out_schema: T.Schema) -> ColumnarBatch:
+    """Grand aggregate (no keys): one output row.  Separate path because
+    there is no sort: plain masked reductions."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    out_cols: list[AnyColumn] = []
+    one_live = jnp.arange(cap, dtype=jnp.int32) < 1
+    for spec in aggs:
+        if spec.op == "count_star":
+            n = jnp.sum(live.astype(jnp.int64))
+            out_cols.append(Column(jnp.zeros(cap, jnp.int64).at[0].set(n),
+                                   one_live, T.LONG))
+            continue
+        vcol = batch.columns[spec.ordinal]
+        assert isinstance(vcol, Column)
+        valid = vcol.validity & live
+        nvalid = jnp.sum(valid.astype(jnp.int64))
+        if spec.op == "count":
+            out_cols.append(Column(
+                jnp.zeros(cap, jnp.int64).at[0].set(nvalid), one_live, T.LONG))
+            continue
+        out_dtype = agg_output_dtype(spec, vcol.dtype)
+        phys = T.to_numpy_dtype(out_dtype)
+        if spec.op == "sum":
+            s = jnp.sum(jnp.where(valid, vcol.data.astype(phys),
+                                  jnp.asarray(0, phys)))
+        elif spec.op in ("min", "max"):
+            if jnp.issubdtype(phys, jnp.floating):
+                sentinel = jnp.asarray(
+                    jnp.inf if spec.op == "min" else -jnp.inf, phys)
+            else:
+                info = jnp.iinfo(phys)
+                sentinel = jnp.asarray(
+                    info.max if spec.op == "min" else info.min, phys)
+            vals = jnp.where(valid, vcol.data.astype(phys), sentinel)
+            s = jnp.min(vals) if spec.op == "min" else jnp.max(vals)
+        elif spec.op in ("first", "last"):
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            pos = jnp.where(valid, idx, cap if spec.op == "first" else -1)
+            sel = jnp.min(pos) if spec.op == "first" else jnp.max(pos)
+            s = jnp.take(vcol.data, jnp.clip(sel, 0, cap - 1)).astype(phys)
+        else:
+            raise ValueError(f"unknown agg op {spec.op}")
+        data = jnp.zeros(cap, phys).at[0].set(s.astype(phys))
+        out_cols.append(Column(data, one_live & (nvalid > 0), out_dtype))
+    return ColumnarBatch(out_cols, 1, out_schema)

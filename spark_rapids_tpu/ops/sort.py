@@ -1,0 +1,117 @@
+"""Total-order sort keys and batch sorting.
+
+TPU counterpart of cudf's `Table.orderBy` as used by GpuSortExec
+(ref: sql-plugin/.../GpuSortExec.scala) — but instead of a comparator
+kernel, every SQL sort key is mapped to one or more *integer key arrays*
+whose ascending lexicographic order equals the SQL order, then a single
+stable `jnp.lexsort` produces the permutation.  This keeps the whole sort
+one fused XLA op (bitonic/radix under the hood) with no dynamic shapes.
+
+Key transforms:
+- integers: identity (descending = bitwise NOT, which is monotone-reversing
+  and overflow-free, unlike negation at INT_MIN);
+- floats: IEEE-754 total-order trick (sign-magnitude -> two's complement);
+  NaN's canonical bit pattern sorts above +inf, matching Spark;
+- strings: the fixed-width byte matrix is already lexicographic because
+  padding bytes are zero; bytes become uint8 key columns (chunked into
+  int32 words, 4 bytes per word, to cut lexsort key count 4x);
+- NULLs: a leading null-flag key implements NULLS FIRST/LAST;
+- dead padding rows always sort last via a most-significant live flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    """One sort key: column (by ordinal at this layer), direction, null
+    placement (Spark default: ascending, nulls first)."""
+
+    ordinal: int
+    descending: bool = False
+    nulls_last: bool = False
+
+
+def float_total_order_bits(x: jax.Array) -> jax.Array:
+    """Map float array to ints whose ascending order is IEEE total order
+    (with canonical NaN > +inf, as Spark sorts NaN largest)."""
+    if x.dtype == jnp.float64:
+        bits = jax.lax.bitcast_convert_type(x, jnp.int64)
+        bits = jnp.where(jnp.isnan(x), jnp.int64(0x7FF8000000000000), bits)
+        return jnp.where(bits < 0, bits ^ jnp.int64(2**63 - 1), bits)
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+    bits = jnp.where(jnp.isnan(x), jnp.int32(0x7FC00000), bits)
+    return jnp.where(bits < 0, bits ^ jnp.int32(2**31 - 1), bits)
+
+
+def _string_word_keys(col: StringColumn) -> list[jax.Array]:
+    """Big-endian 4-byte words over the byte matrix: ascending word order
+    == ascending byte-lexicographic order (zero padding sorts prefixes
+    first)."""
+    n, width = col.chars.shape
+    c = col.chars.astype(jnp.uint32)
+    words: list[jax.Array] = []
+    for j in range(0, width, 4):
+
+        def byte(off):
+            if j + off < width:
+                return c[:, j + off]
+            return jnp.zeros((n,), jnp.uint32)
+
+        w = (byte(0) << 24) | (byte(1) << 16) | (byte(2) << 8) | byte(3)
+        words.append(w.astype(jnp.int64))  # zero-extended, order-preserving
+    return words
+
+
+def column_sort_keys(col: AnyColumn, descending: bool,
+                     nulls_last: bool) -> list[jax.Array]:
+    """Minor-to-major int key arrays for one SQL sort key.  Returned
+    minor-first (callers feed jnp.lexsort, whose LAST key is primary)."""
+    if isinstance(col, StringColumn):
+        vals = _string_word_keys(col)
+        if descending:
+            vals = [~v for v in vals]
+        vals = list(reversed(vals))  # minor-first
+    else:
+        d = col.data
+        if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
+            k = float_total_order_bits(d)
+        elif col.dtype == T.BOOLEAN:
+            k = d.astype(jnp.int32)
+        else:
+            k = d
+        if descending:
+            k = ~k
+        vals = [k]
+    null_flag = col.validity.astype(jnp.int32)  # 0 = null
+    if nulls_last:
+        null_flag = 1 - null_flag
+    # null flag is more significant than the value keys
+    return vals + [null_flag]
+
+
+def sort_permutation(batch: ColumnarBatch,
+                     orders: Sequence[SortOrder]) -> jax.Array:
+    """Stable permutation realizing the SQL ORDER BY; padding rows last."""
+    keys: list[jax.Array] = []
+    for o in reversed(orders):  # minor keys first for lexsort
+        col = batch.columns[o.ordinal]
+        keys.extend(column_sort_keys(col, o.descending, o.nulls_last))
+    keys.append(batch.row_mask().astype(jnp.int32) * -1)  # live rows first
+    return jnp.lexsort(keys)
+
+
+def sort_batch(batch: ColumnarBatch,
+               orders: Sequence[SortOrder]) -> ColumnarBatch:
+    perm = sort_permutation(batch, orders)
+    return batch.gather(perm, batch.num_rows)

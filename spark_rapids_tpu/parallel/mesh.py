@@ -1,0 +1,34 @@
+"""Device mesh construction.
+
+The SQL engine's parallelism is data-parallel over partitions (the
+reference's model: one Spark task per partition, §2.9 of SURVEY.md), so
+the canonical mesh is 1-D over the `data` axis.  Multi-host meshes come
+from jax.distributed the usual way; everything downstream only sees axis
+names.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axes: Sequence[str] = (DATA_AXIS,),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)}; for CPU tests "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count=N")
+        devs = devs[:n_devices]
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axes) - 1)
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axes))

@@ -184,3 +184,26 @@ def test_abs_unary_minus():
 
 def test_literal_null():
     assert eval_expr(Literal.of(None, T.LONG) + col("a"), T1) == [None] * 5
+
+
+def test_least_greatest_nan_and_inf_null():
+    """Spark contract: NaN is the greatest value; +/-inf must survive
+    alongside NULL slots (regression: sentinel collision)."""
+    t = pa.table({
+        "x": pa.array([float("nan"), float("inf"), float("-inf"), 1.0]),
+        "y": pa.array([1.0, None, None, 2.0]),
+    })
+    l = eval_expr(A.Least(col("x"), col("y")), t)
+    assert l == [1.0, float("inf"), float("-inf"), 1.0]
+    g = eval_expr(A.Greatest(col("x"), col("y")), t)
+    assert np.isnan(g[0])
+    assert g[1:] == [float("inf"), float("-inf"), 2.0]
+
+
+def test_case_when_dtype_widens_to_else_branch():
+    """Regression: CaseWhen.dtype must match what eval returns (widened
+    over all branches + else), or the projected schema mistypes data."""
+    cw = P.CaseWhen(((lit(True), lit(100)),), lit(2.5))
+    assert cw.dtype == T.DOUBLE
+    t = pa.table({"a": pa.array([1, 2], pa.int64())})
+    assert eval_expr(cw, t) == [100.0, 100.0]
