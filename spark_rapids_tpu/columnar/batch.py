@@ -88,6 +88,17 @@ class ColumnarBatch:
         n = self.num_rows
         return n if isinstance(n, int) else int(jax.device_get(n))
 
+    def with_device_num_rows(self) -> "ColumnarBatch":
+        """Promote a Python-int num_rows to a device scalar so jitted
+        pipelines key their compile cache on capacity only (a static int
+        lives in pytree aux data and would recompile per distinct ragged
+        tail count)."""
+        if not isinstance(self.num_rows, int):
+            return self
+        return ColumnarBatch(self.columns,
+                             jnp.asarray(self.num_rows, jnp.int32),
+                             self.schema)
+
     # ------------------------------------------------------------------ #
     # Construction / host interop
     # ------------------------------------------------------------------ #

@@ -1,0 +1,241 @@
+"""Hash-aggregate exec.
+
+TPU re-design of GpuHashAggregateExec
+(ref: sql-plugin/.../aggregate.scala:240,282-430): per input batch run an
+*update* aggregation, then re-merge the accumulated partial results
+whenever they grow past the target batch size (the reference concatenates
+and re-aggregates the same way, aggregate.scala:387-395).  On TPU the
+per-batch aggregation is the sort-based segmented kernel in ops.groupby —
+one fused XLA program — instead of cudf's hash groupby.
+
+Modes follow Spark/the reference:
+- ``partial``:  keys ++ partial columns out (feeds an exchange);
+- ``final``:    partial-layout in, merged + finalized out;
+- ``complete``: full aggregation locally (single-partition plans).
+
+Bounded memory: between input batches only the merged partial batch is
+retained (size = O(#distinct keys seen)), matching the reference's
+streaming design."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+from spark_rapids_tpu.execs.basic import output_field
+from spark_rapids_tpu.exprs.aggregates import NamedAgg
+from spark_rapids_tpu.exprs.base import (
+    BoundReference,
+    EvalContext,
+    Expression,
+    bind_references,
+)
+from spark_rapids_tpu.ops.groupby import (
+    AggSpec,
+    groupby_aggregate,
+    reduce_aggregate,
+)
+
+
+def _as_device_rows(batch: ColumnarBatch) -> ColumnarBatch:
+    return batch.with_device_num_rows()
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, groups: Sequence[Expression], aggs: Sequence[NamedAgg],
+                 child: TpuExec, mode: str = "complete",
+                 goal_rows: Optional[int] = None,
+                 input_schema: Optional[T.Schema] = None):
+        """`input_schema`: for mode="final" only — the pre-aggregation
+        schema the aggregate children refer to (the planner threads the
+        original child schema across the partial/exchange/final split);
+        defaults to the child schema for the other modes."""
+        super().__init__(child)
+        assert mode in ("partial", "final", "complete"), mode
+        self.mode = mode
+        from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+
+        self.goal_rows = goal_rows or get_conf().get(BATCH_SIZE_ROWS)
+
+        child_schema = child.schema
+        bind_schema = input_schema if mode == "final" else child_schema
+        assert bind_schema is not None, "final mode requires input_schema"
+        self.aggs = [NamedAgg(na.fn.bind(bind_schema), na.out_name)
+                     for na in aggs]
+        if mode == "final":
+            # input already has partial layout: keys ++ partial columns
+            self.partial_schema = child_schema
+            self.groups = [BoundReference(i, f.dtype, f.nullable, f.name)
+                           for i, f in enumerate(
+                               child_schema.fields[: len(groups)])]
+            self.n_keys = len(groups)
+        else:
+            self.groups = [bind_references(g, child_schema) for g in groups]
+            self.n_keys = len(self.groups)
+            key_fields = [output_field(g, i)
+                          for i, g in enumerate(self.groups)]
+            self.input_exprs = list(self.groups)
+            partial_fields: list[T.Field] = []
+            for na in self.aggs:
+                ins = [bind_references(e, child_schema)
+                       for e in na.fn.inputs()]
+                self.input_exprs.extend(ins)
+                for pi, pdt in enumerate(na.fn.partial_dtypes()):
+                    partial_fields.append(
+                        T.Field(f"{na.out_name}__p{pi}", pdt, True))
+            if not self.input_exprs:
+                # COUNT(*)-only grand aggregate: a zero-column projection
+                # would lose the batch capacity (ColumnarBatch.capacity is
+                # 0 with no columns); carry one constant column
+                from spark_rapids_tpu.exprs.base import Literal
+
+                self.input_exprs = [Literal.of(True)]
+            self.update_input_schema = T.Schema(
+                key_fields + [T.Field(f"__in{i}", e.dtype, e.nullable)
+                              for i, e in enumerate(
+                                  self.input_exprs[self.n_keys:])])
+            self.partial_schema = T.Schema(key_fields + partial_fields)
+
+        # ops over the partial layout for the merge phase
+        self.merge_specs: list[AggSpec] = []
+        po = self.n_keys
+        for na in self.aggs:
+            for op, pdt in zip(na.fn.merge_ops(), na.fn.partial_dtypes()):
+                self.merge_specs.append(AggSpec(op, po, out_dtype=pdt))
+                po += 1
+
+        if mode == "partial":
+            self._schema = self.partial_schema
+        else:
+            key_fields = list(self.partial_schema.fields[: self.n_keys])
+            self._schema = T.Schema(
+                key_fields + [na.output_field() for na in self.aggs])
+
+        # finalize projection over the partial layout
+        self.final_exprs: list[Expression] = [
+            BoundReference(i, f.dtype, f.nullable, f.name)
+            for i, f in enumerate(self.partial_schema.fields[: self.n_keys])]
+        po = self.n_keys
+        for na in self.aggs:
+            refs = []
+            for pdt in na.fn.partial_dtypes():
+                pf = self.partial_schema.fields[po]
+                refs.append(BoundReference(po, pf.dtype, pf.nullable, pf.name))
+                po += 1
+            self.final_exprs.append(na.fn.finalize_expr(refs))
+
+        self._jit_update = None
+        self._jit_merge = None
+        self._jit_finalize = None
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        keys = ", ".join(e.name for e in self.groups)
+        outs = ", ".join(f"{na.fn.name}->{na.out_name}" for na in self.aggs)
+        return f"TpuHashAggregateExec[{self.mode}] keys=[{keys}] [{outs}]"
+
+    def additional_metrics(self):
+        return [("numMerges", "MODERATE")]
+
+    # -- traceable phases ------------------------------------------------ #
+
+    def _update_specs(self) -> list[AggSpec]:
+        specs = []
+        io = self.n_keys
+        for na in self.aggs:
+            n_in = len(na.fn.inputs())
+            ops = na.fn.update_ops()
+            pdts = na.fn.partial_dtypes()
+            for op, pdt in zip(ops, pdts):
+                # all current fns have <=1 input; count_star reads none
+                ord_ = io if n_in else 0
+                specs.append(AggSpec(op, ord_, out_dtype=pdt))
+            io += n_in
+        return specs
+
+    def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Project inputs then run the update aggregation (traceable)."""
+        ctx = EvalContext.for_batch(batch)
+        cols = [e.eval(ctx) for e in self.input_exprs]
+        proj = ColumnarBatch(cols, batch.num_rows, self.update_input_schema)
+        specs = self._update_specs()
+        if self.n_keys == 0:
+            return reduce_aggregate(proj, specs, self.partial_schema)
+        return groupby_aggregate(proj, list(range(self.n_keys)), specs,
+                                 self.partial_schema)
+
+    def _merge_batch(self, partial: ColumnarBatch) -> ColumnarBatch:
+        if self.n_keys == 0:
+            return reduce_aggregate(partial, self.merge_specs,
+                                    self.partial_schema)
+        return groupby_aggregate(partial, list(range(self.n_keys)),
+                                 self.merge_specs, self.partial_schema)
+
+    def _finalize_batch(self, partial: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext.for_batch(partial)
+        cols = [e.eval(ctx) for e in self.final_exprs]
+        return ColumnarBatch(cols, partial.num_rows, self._schema)
+
+    # -- streaming driver ------------------------------------------------ #
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        if self._jit_update is None:
+            self._jit_update = jax.jit(self._update_batch)
+            self._jit_merge = jax.jit(self._merge_batch)
+            self._jit_finalize = jax.jit(self._finalize_batch)
+
+        pending: list[ColumnarBatch] = []
+        pending_rows = 0
+        for batch in self.children[0].execute():
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                if self.mode == "final":
+                    part = _as_device_rows(batch)  # already partial layout
+                else:
+                    part = self._jit_update(_as_device_rows(batch))
+            pending.append(part)
+            pending_rows += part.concrete_num_rows()
+            if len(pending) > 1 and pending_rows >= self.goal_rows:
+                with MetricTimer(self.metrics[TOTAL_TIME]):
+                    merged = self._jit_merge(
+                        _as_device_rows(concat_batches(pending)))
+                self.metrics["numMerges"].add(1)
+                pending = [merged]
+                pending_rows = merged.concrete_num_rows()
+
+        if not pending:
+            if self.n_keys > 0:
+                return  # grouped aggregate of empty input: no rows
+            # grand aggregate of empty input: one default row
+            from spark_rapids_tpu.columnar.column import MIN_CAPACITY
+            import numpy as np
+
+            empty_cols = {
+                f.name: np.array(
+                    [], dtype=object if isinstance(f.dtype, T.StringType)
+                    else T.to_numpy_dtype(f.dtype))
+                for f in self.children[0].schema.fields}
+            eb = ColumnarBatch.from_numpy(empty_cols,
+                                          self.children[0].schema)
+            if self.mode == "final":
+                pending = [eb]
+            else:
+                pending = [self._jit_update(_as_device_rows(eb))]
+
+        with MetricTimer(self.metrics[TOTAL_TIME]):
+            merged = pending[0] if len(pending) == 1 else None
+            if merged is None or self.mode in ("final",):
+                merged = self._jit_merge(
+                    _as_device_rows(concat_batches(pending)))
+            if self.mode == "partial":
+                out = merged
+            else:
+                out = self._jit_finalize(_as_device_rows(merged))
+        yield self._count_output(out)

@@ -158,15 +158,6 @@ class FusableExec(TpuExec):
 
         fused = jax.jit(pipeline)
         for batch in node.execute():
-            # num_rows as a device scalar: a Python-int row count lives in
-            # pytree aux data (batch.py tree_flatten) and would recompile
-            # the pipeline for every distinct ragged-tail count; the
-            # compile key must be (pipeline, capacity bucket) only
-            if isinstance(batch.num_rows, int):
-                batch = ColumnarBatch(
-                    batch.columns,
-                    jax.numpy.asarray(batch.num_rows, jax.numpy.int32),
-                    batch.schema)
             with MetricTimer(self.metrics[TOTAL_TIME]):
-                out = fused(batch)
+                out = fused(batch.with_device_num_rows())
             yield self._count_output(out)

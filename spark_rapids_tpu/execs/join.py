@@ -1,0 +1,242 @@
+"""Join execs.
+
+TPU counterparts of GpuShuffledHashJoinBase / GpuBroadcastHashJoinExec /
+GpuHashJoin (ref: sql-plugin/.../GpuShuffledHashJoinBase.scala:28,
+sql/rapids/execution/GpuHashJoin.scala:62): the build side is collected
+into a single device batch (the reference requires the same,
+RequireSingleBatch), then every stream batch probes it through the dense
+group-id kernel in ops.join.  Output sizing mirrors JoinGatherer: one
+device->host sync per stream batch reads the pair count, then a
+statically-shaped expansion program (cached per capacity bucket) emits
+the joined batch.
+
+Join types: inner, left_outer, right_outer (side-swapped), full_outer,
+left_semi, left_anti, cross.  Non-equi residual conditions are applied
+as a post-filter for inner joins; plans needing conditional outer joins
+fall back to the CPU engine (as the reference falls back for cases cudf
+cannot express)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.column import pad_capacity
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+from spark_rapids_tpu.exprs.base import (
+    EvalContext,
+    Expression,
+    bind_references,
+)
+from spark_rapids_tpu.ops.join import (
+    expand_pairs,
+    gather_joined,
+    join_state,
+)
+
+JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
+              "left_semi", "left_anti", "cross")
+
+
+def _nullable_fields(schema: T.Schema) -> list[T.Field]:
+    return [T.Field(f.name, f.dtype, True) for f in schema.fields]
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: str,
+                 left: TpuExec, right: TpuExec,
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        assert join_type in JOIN_TYPES, join_type
+        self.join_type = join_type
+        if join_type == "cross":
+            # cross product == equi-join on a constant key (every pair
+            # shares the single group) — reuses the whole kernel
+            from spark_rapids_tpu.exprs.base import Literal
+
+            left_keys = [Literal.of(1)]
+            right_keys = [Literal.of(1)]
+        self.left_keys = [bind_references(k, left.schema) for k in left_keys]
+        self.right_keys = [bind_references(k, right.schema)
+                           for k in right_keys]
+        if condition is not None and join_type != "inner":
+            raise NotImplementedError(
+                "residual join conditions only on inner joins (planner "
+                "falls back otherwise)")
+        joined_schema = T.Schema(list(left.schema.fields)
+                                 + list(right.schema.fields))
+        self.condition = (bind_references(condition, joined_schema)
+                          if condition is not None else None)
+
+        # build = the side NOT preserved by an outer join; stream = other
+        self.build_is_right = join_type != "right_outer"
+        lf, rf = list(left.schema.fields), list(right.schema.fields)
+        if join_type in ("left_outer", "full_outer"):
+            rf = _nullable_fields(right.schema)
+        if join_type in ("right_outer", "full_outer"):
+            lf = _nullable_fields(left.schema)
+        if join_type in ("left_semi", "left_anti"):
+            self._schema = left.schema
+        else:
+            self._schema = T.Schema(lf + rf)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(f"{l.name}={r.name}" for l, r in
+                       zip(self.left_keys, self.right_keys))
+        return f"TpuShuffledHashJoinExec {self.join_type} [{ks}]"
+
+    def additional_metrics(self):
+        return [("buildRows", "MODERATE"), ("probeBatches", "MODERATE")]
+
+    # ------------------------------------------------------------------ #
+
+    def _collect_build(self) -> Optional[ColumnarBatch]:
+        child = self.children[1] if self.build_is_right else self.children[0]
+        batches = list(child.execute())
+        if not batches:
+            return None
+        b = batches[0] if len(batches) == 1 else concat_batches(batches)
+        self.metrics["buildRows"].add(b.concrete_num_rows())
+        return b
+
+    def _empty_build(self) -> ColumnarBatch:
+        import numpy as np
+
+        child = self.children[1] if self.build_is_right else self.children[0]
+        empty = {
+            f.name: np.array([], dtype=object
+                             if isinstance(f.dtype, T.StringType)
+                             else T.to_numpy_dtype(f.dtype))
+            for f in child.schema.fields}
+        return ColumnarBatch.from_numpy(empty, child.schema)
+
+    def _probe(self, build: ColumnarBatch, stream: ColumnarBatch):
+        """Traceable: key eval + join state (tuple of arrays)."""
+        build_keys = self.right_keys if self.build_is_right else self.left_keys
+        stream_keys = self.left_keys if self.build_is_right else self.right_keys
+        bctx = EvalContext.for_batch(build)
+        sctx = EvalContext.for_batch(stream)
+        bkc = [k.eval(bctx) for k in build_keys]
+        skc = [k.eval(sctx) for k in stream_keys]
+        # the stream side is the preserved side for every outer variant
+        jt = "left_outer" if self.join_type in (
+            "left_outer", "right_outer", "full_outer") else "inner" \
+            if self.join_type == "cross" else self.join_type
+        st = join_state(build, stream, bkc, skc, jt)
+        total = jnp.sum(st.cnt_s).astype(jnp.int32)
+        return st, total
+
+    def _expand(self, build, stream, st, num_rows, out_cap: int):
+        s_idx, b_idx, pair_live, matched = expand_pairs(st, out_cap)
+        stream_first = self.build_is_right
+        return gather_joined(build, stream, s_idx, b_idx, pair_live,
+                             matched, num_rows, self._schema,
+                             stream_first=stream_first)
+
+    def _jit_expand(self, out_cap: int):
+        """One cached jitted expansion program per output bucket (the
+        JoinGatherer-chunking analog of compile caching)."""
+        cache = getattr(self, "_expand_cache", None)
+        if cache is None:
+            cache = self._expand_cache = {}
+        if out_cap not in cache:
+            from functools import partial
+
+            cache[out_cap] = jax.jit(partial(self._expand, out_cap=out_cap))
+        return cache[out_cap]
+
+    @property
+    def _jit_condition(self):
+        fn = getattr(self, "_cond_fn", None)
+        if fn is None:
+            cond = self.condition
+
+            def apply(batch):
+                ctx = EvalContext.for_batch(batch)
+                p = cond.eval(ctx)
+                return batch.compact(p.data.astype(bool) & p.validity)
+
+            fn = self._cond_fn = jax.jit(apply)
+        return fn
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        build = self._collect_build()
+        if build is None:
+            if self.join_type in ("inner", "left_semi", "cross"):
+                return  # empty build: no output
+            build = self._empty_build()
+
+        jit_probe = jax.jit(self._probe)
+        jit_semi_compact = jax.jit(
+            lambda stream, keep: stream.compact(keep))
+        matched_b_acc = None
+
+        stream_child = (self.children[0] if self.build_is_right
+                        else self.children[1])
+        for stream in stream_child.execute():
+            self.metrics["probeBatches"].add(1)
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                st, total = jit_probe(build, stream)
+                if self.join_type == "full_outer":
+                    m = st.matched_b
+                    matched_b_acc = m if matched_b_acc is None \
+                        else (matched_b_acc | m)
+                if self.join_type in ("left_semi", "left_anti"):
+                    keep = st.matched_s if self.join_type == "left_semi" \
+                        else (st.live_s & ~st.matched_s)
+                    out = jit_semi_compact(stream, keep)
+                    yield self._count_output(out)
+                    continue
+                n_total = int(jax.device_get(total))
+                if n_total == 0:
+                    continue
+                out_cap = pad_capacity(n_total)
+                out = self._jit_expand(out_cap)(build, stream, st, total)
+                if self.condition is not None:
+                    out = self._jit_condition(out)
+            yield self._count_output(out)
+
+        if self.join_type == "full_outer":
+            yield from self._emit_unmatched_build(build, matched_b_acc)
+
+    def _emit_unmatched_build(self, build: ColumnarBatch,
+                              matched_b: Optional[jax.Array]):
+        """Remaining full-outer rows: build rows no stream batch matched,
+        with NULLs for the stream side."""
+        if matched_b is None:
+            matched_b = jnp.zeros((build.capacity,), bool)
+
+        def unmatched(build, matched_b):
+            keep = build.row_mask() & ~matched_b
+            compacted = build.compact(keep)
+            stream_schema = (self.children[0].schema if self.build_is_right
+                             else self.children[1].schema)
+            null_cols = []
+            from spark_rapids_tpu.exprs.base import Literal
+
+            ctx = EvalContext.for_batch(compacted)
+            dead = jnp.zeros((compacted.capacity,), bool)
+            for f in stream_schema.fields:
+                lit_null = Literal.of(None, f.dtype) \
+                    if not isinstance(f.dtype, T.StringType) \
+                    else Literal.of(None, T.STRING)
+                c = lit_null.eval(ctx)
+                null_cols.append(c.with_validity(dead))
+            if self.build_is_right:
+                cols = null_cols + list(compacted.columns)
+            else:
+                cols = list(compacted.columns) + null_cols
+            return ColumnarBatch(cols, compacted.num_rows, self._schema)
+
+        out = jax.jit(unmatched)(build, matched_b)
+        if out.concrete_num_rows() > 0:
+            yield self._count_output(out)

@@ -1,0 +1,48 @@
+"""Limit execs (ref: sql-plugin/.../limit.scala GpuLocalLimitExec :123,
+GpuGlobalLimitExec :128, GpuCollectLimitExec).
+
+Single-partition streaming: truncate batches until the limit is
+satisfied.  slice_prefix is a logical truncation (validity mask update),
+so no data movement happens on device."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec
+
+
+class TpuLocalLimitExec(TpuExec):
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__(child)
+        assert n >= 0
+        self.n = n
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        return f"{type(self).__name__} n={self.n}"
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        remaining = self.n
+        for b in self.children[0].execute():
+            if remaining <= 0:
+                return
+            n = b.concrete_num_rows()
+            if n <= remaining:
+                remaining -= n
+                yield self._count_output(b)
+            else:
+                out = b.slice_prefix(remaining)
+                out = ColumnarBatch(out.columns, remaining, out.schema)
+                remaining = 0
+                yield self._count_output(out)
+
+
+class TpuGlobalLimitExec(TpuLocalLimitExec):
+    """Same mechanics per partition; the planner places it after a
+    single-partition exchange the way Spark does."""

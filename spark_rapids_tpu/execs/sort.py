@@ -1,0 +1,145 @@
+"""Sort and top-N execs.
+
+TPU counterparts of GpuSortExec (ref: sql-plugin/.../GpuSortExec.scala:
+FullSortSingleBatch / SortEachBatch / OutOfCoreSort modes) and
+GpuTopN/GpuTakeOrderedAndProjectExec (ref: limit.scala:148,260).
+
+Sort keys are arbitrary expressions: they are projected as appended key
+columns, the batch is sorted on them via the total-order-key lexsort in
+ops.sort, and the appended columns are dropped — the same bind/project
+approach the reference takes with SortOrder child expressions.
+
+The full sort currently concatenates to a single batch (the reference's
+FullSortSingleBatch); the out-of-core merge path arrives with the spill
+store (SURVEY.md build stage 2)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+from spark_rapids_tpu.exprs.base import EvalContext, Expression, bind_references
+from spark_rapids_tpu.ops.sort import SortOrder, sort_batch
+
+
+@dataclasses.dataclass
+class SortKey:
+    """Frontend sort key: expression + direction/null placement."""
+
+    expr: Expression
+    descending: bool = False
+    nulls_last: bool = False
+
+
+class _SortMixin(TpuExec):
+    def _bind(self, keys: Sequence[SortKey], child: TpuExec):
+        self.keys = [SortKey(bind_references(k.expr, child.schema),
+                             k.descending, k.nulls_last) for k in keys]
+
+    def _sorted(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Append evaluated key columns, sort, drop them (traceable)."""
+        ctx = EvalContext.for_batch(batch)
+        n_data = batch.num_cols
+        key_cols = [k.expr.eval(ctx) for k in self.keys]
+        aug = ColumnarBatch(list(batch.columns) + key_cols, batch.num_rows,
+                            batch.schema)
+        orders = [SortOrder(n_data + i, k.descending, k.nulls_last)
+                  for i, k in enumerate(self.keys)]
+        out = sort_batch(aug, orders)
+        return ColumnarBatch(out.columns[:n_data], out.num_rows, batch.schema)
+
+
+class TpuSortExec(_SortMixin):
+    """global=True: total order over all input (single concatenated batch
+    for now); global=False: sort each batch independently (the
+    SortEachBatch mode used below partial aggregations)."""
+
+    def __init__(self, keys: Sequence[SortKey], child: TpuExec,
+                 global_sort: bool = True):
+        super().__init__(child)
+        self._bind(keys, child)
+        self.global_sort = global_sort
+        self._jit_sorted = jax.jit(self._sorted)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(
+            f"{k.expr.name}{' DESC' if k.descending else ''}" for k in self.keys)
+        return f"TpuSortExec [{ks}] global={self.global_sort}"
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        if self.global_sort:
+            batches = list(self.children[0].execute())
+            if not batches:
+                return
+            big = batches[0] if len(batches) == 1 else concat_batches(batches)
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                yield self._count_output(self._jit_sorted(big))
+        else:
+            for b in self.children[0].execute():
+                with MetricTimer(self.metrics[TOTAL_TIME]):
+                    yield self._count_output(self._jit_sorted(b))
+
+
+class TpuTakeOrderedAndProjectExec(_SortMixin):
+    """ORDER BY ... LIMIT n: keeps a running top-n batch; each incoming
+    batch is concatenated, sorted, and truncated to n (the reference's
+    per-batch sort+slice then final sort, limit.scala:148)."""
+
+    def __init__(self, n: int, keys: Sequence[SortKey], child: TpuExec,
+                 project: Optional[Sequence[Expression]] = None):
+        super().__init__(child)
+        assert n >= 0
+        self.n = n
+        self._bind(keys, child)
+        self.project = None
+        if project is not None:
+            self.project = [bind_references(e, child.schema) for e in project]
+            from spark_rapids_tpu.execs.basic import output_field
+
+            self._schema = T.Schema(
+                [output_field(e, i) for i, e in enumerate(self.project)])
+        else:
+            self._schema = child.schema
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"TpuTakeOrderedAndProjectExec n={self.n}"
+
+    def _topn(self, batch: ColumnarBatch) -> ColumnarBatch:
+        s = self._sorted(batch)
+        return s.slice_prefix(self.n)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        jit_topn = jax.jit(self._topn)
+        top: Optional[ColumnarBatch] = None
+        for b in self.children[0].execute():
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                merged = b if top is None else concat_batches([top, b])
+                top = jit_topn(merged)
+                # compact so concat_batches sees the concrete top-n rows
+                top = ColumnarBatch(top.columns, top.concrete_num_rows(),
+                                    top.schema)
+        if top is None:
+            return
+        out = top
+        if self.project is not None:
+            def proj(batch):
+                ctx = EvalContext.for_batch(batch)
+                return ColumnarBatch([e.eval(ctx) for e in self.project],
+                                     batch.num_rows, self._schema)
+
+            out = jax.jit(proj)(out)
+        yield self._count_output(out)

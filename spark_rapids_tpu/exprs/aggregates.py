@@ -1,0 +1,214 @@
+"""Declarative aggregate functions.
+
+TPU counterpart of the reference's GpuAggregateFunction hierarchy
+(ref: sql-plugin/.../org/apache/spark/sql/rapids/AggregateFunctions.scala,
+704 LoC: Sum/Count/Min/Max/Average/First/Last/Pivot) which decomposes
+every SQL aggregate into *update* expressions (per input batch),
+*merge* expressions (combining partial results, e.g. post-shuffle), and
+a *finalize* projection (e.g. avg = sum / count).  The same decomposition
+drives three placements here: single-batch complete aggregation,
+multi-batch streaming re-merge, and distributed partial->exchange->final
+plans (aggregate.scala:240's mode handling).
+
+Each function maps its update/merge phases onto the segmented-reduce
+kernel ops in ops.groupby (AggSpec)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import BoundReference, Expression
+from spark_rapids_tpu.ops.groupby import AggSpec, agg_output_dtype
+
+
+@dataclasses.dataclass(repr=False)
+class AggregateFunction:
+    """Base: child input expression(s) + phase decomposition."""
+
+    child: Optional[Expression]
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.lower()
+
+    def bind(self, schema: T.Schema) -> "AggregateFunction":
+        """Resolve the child expression against the pre-aggregation input
+        schema (required before dtype/partial_dtypes are meaningful)."""
+        if self.child is None:
+            return self
+        from spark_rapids_tpu.exprs.base import bind_references
+
+        return type(self)(bind_references(self.child, schema))
+
+    def inputs(self) -> list[Expression]:
+        """Expressions projected out of the child batch before update."""
+        return [self.child] if self.child is not None else []
+
+    def n_partials(self) -> int:
+        return 1
+
+    def update_ops(self) -> list[str]:
+        """AggSpec ops over this function's input columns (one per
+        partial)."""
+        raise NotImplementedError
+
+    def merge_ops(self) -> list[str]:
+        """AggSpec ops over this function's partial columns."""
+        raise NotImplementedError
+
+    def partial_dtypes(self) -> list[T.DataType]:
+        ops = self.update_ops()
+        in_dt = self.child.dtype if self.child is not None else None
+        return [agg_output_dtype(AggSpec(op, 0), in_dt) for op in ops]
+
+    def finalize_expr(self, partial_refs: list[Expression]) -> Expression:
+        """Expression over the partial columns producing the SQL result."""
+        return partial_refs[0]
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.partial_dtypes()[0]
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+
+class Sum(AggregateFunction):
+    def update_ops(self):
+        return ["sum"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+
+class Count(AggregateFunction):
+    """count(expr): counts non-null rows; count(*) via CountStar."""
+
+    def update_ops(self):
+        return ["count"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def finalize_expr(self, partial_refs):
+        from spark_rapids_tpu.exprs.predicates import Coalesce
+        from spark_rapids_tpu.exprs.base import Literal
+
+        # the merge phase SUMs counts; over an empty grand aggregate that
+        # sum is NULL but SQL count() must be 0
+        return Coalesce(partial_refs[0], Literal.of(0))
+
+
+class CountStar(AggregateFunction):
+    def __init__(self):
+        super().__init__(None)
+
+    def update_ops(self):
+        return ["count_star"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def partial_dtypes(self):
+        return [T.LONG]
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def finalize_expr(self, partial_refs):
+        from spark_rapids_tpu.exprs.predicates import Coalesce
+        from spark_rapids_tpu.exprs.base import Literal
+
+        # merge-sum of counts is NULL only for an empty global aggregate
+        return Coalesce(partial_refs[0], Literal.of(0))
+
+
+class Min(AggregateFunction):
+    def update_ops(self):
+        return ["min"]
+
+    def merge_ops(self):
+        return ["min"]
+
+
+class Max(AggregateFunction):
+    def update_ops(self):
+        return ["max"]
+
+    def merge_ops(self):
+        return ["max"]
+
+
+class First(AggregateFunction):
+    """first(expr) ignoring nulls (the reference's GpuFirst with
+    ignoreNulls — deterministic only after an explicit sort, as in
+    Spark)."""
+
+    def update_ops(self):
+        return ["first"]
+
+    def merge_ops(self):
+        return ["first"]
+
+
+class Last(AggregateFunction):
+    def update_ops(self):
+        return ["last"]
+
+    def merge_ops(self):
+        return ["last"]
+
+
+class Average(AggregateFunction):
+    """avg = sum / count, decomposed exactly like the reference's
+    GpuAverage (AggregateFunctions.scala): partials [sum, count],
+    merge [sum, sum], finalize sum/count (NULL when count == 0 — Divide
+    by zero yields NULL, matching Spark's null-safe average)."""
+
+    def n_partials(self) -> int:
+        return 2
+
+    def update_ops(self):
+        return ["sum", "count"]
+
+    def merge_ops(self):
+        return ["sum", "sum"]
+
+    def partial_dtypes(self):
+        return [T.DOUBLE, T.LONG]
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DOUBLE
+
+    def finalize_expr(self, partial_refs):
+        from spark_rapids_tpu.exprs.arithmetic import Divide
+
+        return Divide(partial_refs[0], partial_refs[1])
+
+
+@dataclasses.dataclass
+class NamedAgg:
+    """An aggregate function with its output column name."""
+
+    fn: AggregateFunction
+    out_name: str
+
+    def output_field(self) -> T.Field:
+        return T.Field(self.out_name, self.fn.dtype, self.fn.nullable)

@@ -229,8 +229,9 @@ class Least(Expression):
     Selection runs on integer *total-order keys* (the sort-key transform
     from ops.sort) rather than the float values themselves, which gets
     Spark's ordering contract for free: NaN counts as the greatest value
-    (least(NaN, 1.0) = 1.0, greatest(NaN, 1.0) = NaN) and +/-inf inputs
-    never collide with the NULL-slot sentinel."""
+    (least(NaN, 1.0) = 1.0, greatest(NaN, 1.0) = NaN).  NULL slots are
+    excluded by validity-aware selection, not sentinel keys, so extreme
+    valid values (LONG_MAX, +/-inf) are handled exactly."""
 
     exprs: tuple[Expression, ...]
 
@@ -246,34 +247,28 @@ class Least(Expression):
     def dtype(self) -> T.DataType:
         return _widen([e.dtype for e in self.exprs])
 
-    def _null_key(self, kdt):
-        # NULL slots must never win the comparison
-        return jnp.asarray(jnp.iinfo(kdt).max, kdt)
-
     def eval(self, ctx: EvalContext) -> AnyColumn:
         from spark_rapids_tpu.ops.sort import float_total_order_bits
 
         cols = [e.eval(ctx) for e in self.exprs]
         phys = T.to_numpy_dtype(self.dtype)
         is_float = jnp.issubdtype(phys, jnp.floating)
-        acc_val = acc_key = any_valid = None
+        acc_val = acc_key = acc_valid = None
         for c in cols:
             d = c.data.astype(phys)
             key = float_total_order_bits(d) if is_float else d
-            key = jnp.where(c.validity, key, self._null_key(key.dtype))
             if acc_val is None:
-                acc_val, acc_key = d, key
+                acc_val, acc_key, acc_valid = d, key, c.validity
             else:
-                take = self._take_new(key, acc_key)
+                # validity-aware select: no NULL sentinel key, so a valid
+                # LONG_MAX/LONG_MIN can never collide with a NULL slot
+                take = c.validity & (~acc_valid
+                                     | self._take_new(key, acc_key))
                 acc_val = jnp.where(take, d, acc_val)
                 acc_key = jnp.where(take, key, acc_key)
-            any_valid = c.validity if any_valid is None \
-                else (any_valid | c.validity)
-        return Column(acc_val, any_valid, self.dtype)
+                acc_valid = acc_valid | c.validity
+        return Column(acc_val, acc_valid, self.dtype)
 
 
 class Greatest(Least):
     _take_new = staticmethod(lambda k, acc_k: k > acc_k)
-
-    def _null_key(self, kdt):
-        return jnp.asarray(jnp.iinfo(kdt).min, kdt)

@@ -129,6 +129,20 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: Sequence[int],
     return ColumnarBatch(out_cols, num_groups, out_schema)
 
 
+def _minmax_sentinel(phys, op: str):
+    """Identity element masking NULL slots for min/max reductions."""
+    if jnp.issubdtype(phys, jnp.floating):
+        return jnp.asarray(jnp.inf if op == "min" else -jnp.inf, phys)
+    info = jnp.iinfo(phys)
+    return jnp.asarray(info.max if op == "min" else info.min, phys)
+
+
+def _firstlast_pos(valid: jax.Array, op: str, cap: int) -> jax.Array:
+    """Per-row candidate position for first/last non-null selection."""
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    return jnp.where(valid, idx, cap if op == "first" else -1)
+
+
 def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
               live_sorted: jax.Array, group_live: jax.Array,
               cap: int) -> Column:
@@ -153,21 +167,14 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
         sums = jax.ops.segment_sum(vals, seg_id, num_segments=cap)
         return Column(sums, group_live & (nvalid > 0), out_dtype)
     if spec.op in ("min", "max"):
-        if jnp.issubdtype(phys, jnp.floating):
-            sentinel = jnp.asarray(
-                jnp.inf if spec.op == "min" else -jnp.inf, phys)
-        else:
-            info = jnp.iinfo(phys)
-            sentinel = jnp.asarray(
-                info.max if spec.op == "min" else info.min, phys)
-        vals = jnp.where(valid, vcol.data.astype(phys), sentinel)
+        vals = jnp.where(valid, vcol.data.astype(phys),
+                         _minmax_sentinel(phys, spec.op))
         f = jax.ops.segment_min if spec.op == "min" else jax.ops.segment_max
         out = f(vals, seg_id, num_segments=cap)
         return Column(out, group_live & (nvalid > 0), out_dtype)
     if spec.op in ("first", "last"):
         # first/last non-null within the segment, in sorted-batch order
-        idx = jnp.arange(cap, dtype=jnp.int32)
-        pos = jnp.where(valid, idx, cap if spec.op == "first" else -1)
+        pos = _firstlast_pos(valid, spec.op, cap)
         f = jax.ops.segment_min if spec.op == "first" else jax.ops.segment_max
         sel = f(pos, seg_id, num_segments=cap)
         sel_clipped = jnp.clip(sel, 0, cap - 1)
@@ -204,18 +211,11 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: Sequence[AggSpec],
             s = jnp.sum(jnp.where(valid, vcol.data.astype(phys),
                                   jnp.asarray(0, phys)))
         elif spec.op in ("min", "max"):
-            if jnp.issubdtype(phys, jnp.floating):
-                sentinel = jnp.asarray(
-                    jnp.inf if spec.op == "min" else -jnp.inf, phys)
-            else:
-                info = jnp.iinfo(phys)
-                sentinel = jnp.asarray(
-                    info.max if spec.op == "min" else info.min, phys)
-            vals = jnp.where(valid, vcol.data.astype(phys), sentinel)
+            vals = jnp.where(valid, vcol.data.astype(phys),
+                             _minmax_sentinel(phys, spec.op))
             s = jnp.min(vals) if spec.op == "min" else jnp.max(vals)
         elif spec.op in ("first", "last"):
-            idx = jnp.arange(cap, dtype=jnp.int32)
-            pos = jnp.where(valid, idx, cap if spec.op == "first" else -1)
+            pos = _firstlast_pos(valid, spec.op, cap)
             sel = jnp.min(pos) if spec.op == "first" else jnp.max(pos)
             s = jnp.take(vcol.data, jnp.clip(sel, 0, cap - 1)).astype(phys)
         else:

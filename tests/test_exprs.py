@@ -207,3 +207,16 @@ def test_case_when_dtype_widens_to_else_branch():
     assert cw.dtype == T.DOUBLE
     t = pa.table({"a": pa.array([1, 2], pa.int64())})
     assert eval_expr(cw, t) == [100.0, 100.0]
+
+
+def test_least_greatest_extreme_values_with_nulls():
+    """Regression: a valid LONG_MAX/LONG_MIN must beat a NULL slot (no
+    sentinel-key collision)."""
+    t = pa.table({
+        "a": pa.array([None, None], pa.int64()),
+        "b": pa.array([2**63 - 1, -(2**63)], pa.int64()),
+    })
+    assert eval_expr(A.Least(col("a"), col("b")), t) == \
+        [2**63 - 1, -(2**63)]
+    assert eval_expr(A.Greatest(col("a"), col("b")), t) == \
+        [2**63 - 1, -(2**63)]
