@@ -104,6 +104,16 @@ class ColumnarBatch:
     # ------------------------------------------------------------------ #
 
     @staticmethod
+    def empty(schema: T.Schema) -> "ColumnarBatch":
+        """Zero-row batch of a schema (minimum capacity bucket)."""
+        data = {
+            f.name: np.array(
+                [], dtype=object if isinstance(f.dtype, T.StringType)
+                else T.to_numpy_dtype(f.dtype))
+            for f in schema.fields}
+        return ColumnarBatch.from_numpy(data, schema)
+
+    @staticmethod
     def from_numpy(data: dict[str, np.ndarray],
                    schema: T.Schema,
                    validity: Optional[dict[str, np.ndarray]] = None,

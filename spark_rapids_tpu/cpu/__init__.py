@@ -1,0 +1,11 @@
+"""CPU reference engine (pyarrow-backed).
+
+Plays the role "CPU Spark" plays for the reference: the independent
+implementation every TPU operator is differentially tested against
+(ref: integration_tests/src/main/python/asserts.py
+assert_gpu_and_cpu_are_equal_collect), and the fallback executor for
+plan nodes the TPU planner cannot replace (ref: RapidsMeta
+willNotWorkOnGpu -> original Spark operator keeps running).
+"""
+
+from spark_rapids_tpu.cpu.engine import execute_cpu  # noqa: F401

@@ -1,0 +1,550 @@
+"""Pyarrow-based executor for logical plans.
+
+Deliberately an *independent implementation* of the SQL semantics (built
+on pyarrow.compute kernels + numpy for the gaps), so a TPU kernel bug
+cannot be masked by sharing code with the device path.  Where Spark
+semantics differ from pyarrow defaults (Kleene logic, NULL on zero
+divisors, NaN ordering, IN-list NULLs, If's NULL predicate), the Spark
+behavior is implemented here explicitly — mirroring the compatibility
+contract the reference documents in docs/compatibility.md."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.arrow import schema_to_arrow
+from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.exprs.hashing import Murmur3Hash
+from spark_rapids_tpu.plan import logical as L
+
+
+# ---------------------------------------------------------------------- #
+# Expression evaluation
+# ---------------------------------------------------------------------- #
+
+def _arr(x, n: int, atype=None) -> pa.Array:
+    if isinstance(x, pa.ChunkedArray):
+        return x.combine_chunks()
+    return x
+
+
+def cpu_eval(e: B.Expression, table: pa.Table) -> pa.Array:
+    n = table.num_rows
+    out = _dispatch(e, table, n)
+    return _arr(out, n)
+
+
+def _widen_type(e: B.Expression) -> pa.DataType:
+    return T.to_arrow_type(e.dtype)
+
+
+def _binary_operands(e, table, n):
+    l = cpu_eval(e.left, table)
+    r = cpu_eval(e.right, table)
+    return l, r
+
+
+def _np_vals(arr: pa.Array, dtype) -> tuple[np.ndarray, np.ndarray]:
+    valid = np.asarray(arr.is_valid())
+    filled = arr.fill_null(0).cast(dtype) if arr.null_count else \
+        arr.cast(dtype)
+    return filled.to_numpy(zero_copy_only=False), valid
+
+
+def _from_np(vals: np.ndarray, valid: np.ndarray, atype) -> pa.Array:
+    mask = ~valid if (~valid).any() else None
+    return pa.array(vals, type=atype, mask=mask)
+
+
+def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
+    if isinstance(e, B.Alias):
+        return cpu_eval(e.child, table)
+    if isinstance(e, B.BoundReference):
+        return table.column(e.ordinal).combine_chunks()
+    if isinstance(e, B.ColumnReference):
+        return table.column(e.col_name).combine_chunks()
+    if isinstance(e, B.Literal):
+        if e.value is None:
+            return pa.nulls(n, type=T.to_arrow_type(e.dtype)
+                            if not isinstance(e.dtype, T.NullType)
+                            else pa.bool_())
+        return pa.array([e.value] * n, type=T.to_arrow_type(e.dtype))
+
+    # arithmetic --------------------------------------------------------- #
+    if isinstance(e, (A.Add, A.Subtract, A.Multiply)):
+        l, r = _binary_operands(e, table, n)
+        at = _widen_type(e)
+        fn = {A.Add: pc.add, A.Subtract: pc.subtract,
+              A.Multiply: pc.multiply}[type(e)]
+        return fn(l.cast(at), r.cast(at))
+    if isinstance(e, A.Divide):
+        l, r = _binary_operands(e, table, n)
+        l = l.cast(pa.float64())
+        r = r.cast(pa.float64())
+        zero = pc.equal(r, 0.0)
+        safe = pc.if_else(pc.fill_null(zero, False), pa.scalar(1.0), r)
+        out = pc.divide(l, safe)
+        return pc.if_else(pc.fill_null(zero, True), pa.nulls(
+            n, pa.float64()), out)
+    if isinstance(e, (A.IntegralDivide, A.Remainder, A.Pmod)):
+        l, r = _binary_operands(e, table, n)
+        at = _widen_type(e)
+        npdt = at.to_pandas_dtype()
+        lv, lva = _np_vals(l, at)
+        rv, rva = _np_vals(r, at)
+        valid = lva & rva
+        if np.issubdtype(npdt, np.floating):
+            zero = rv == 0.0
+            rv = np.where(zero, 1.0, rv)
+            rem = np.fmod(lv, rv)
+            if isinstance(e, A.Pmod):
+                rem = np.where(rem < 0, np.fmod(rem + rv, rv), rem)
+            out = rem
+        else:
+            zero = rv == 0
+            rv = np.where(zero, 1, rv)
+            q = np.where((lv < 0) != (rv < 0),
+                         -(np.abs(lv) // np.abs(rv)), lv // rv)
+            rem = lv - q * rv
+            if isinstance(e, A.IntegralDivide):
+                out = q
+            elif isinstance(e, A.Pmod):
+                out = np.where(rem < 0, (rem + rv) % rv if False else
+                               _np_java_mod(rem + rv, rv), rem)
+            else:
+                out = rem
+        return _from_np(out.astype(npdt), valid & ~zero, at)
+    if isinstance(e, A.UnaryMinus):
+        return pc.negate(cpu_eval(e.child, table))
+    if isinstance(e, A.UnaryPositive):
+        return cpu_eval(e.child, table)
+    if isinstance(e, A.Abs):
+        return pc.abs(cpu_eval(e.child, table))
+    if isinstance(e, (A.Least, A.Greatest)):
+        return _least_greatest(e, table, n)
+
+    # predicates --------------------------------------------------------- #
+    if isinstance(e, P.BinaryComparison):
+        l, r = _binary_operands(e, table, n)
+        if isinstance(e, P.EqualNullSafe):
+            ln, rn = pc.is_null(l), pc.is_null(r)
+            eq = pc.fill_null(pc.equal(l, r), False)
+            both_null = pc.and_(ln, rn)
+            one_null = pc.xor(ln, rn)
+            return pc.if_else(one_null, pa.scalar(False),
+                              pc.or_(both_null, eq))
+        fn = {P.EqualTo: pc.equal, P.LessThan: pc.less,
+              P.LessThanOrEqual: pc.less_equal, P.GreaterThan: pc.greater,
+              P.GreaterThanOrEqual: pc.greater_equal}[type(e)]
+        return fn(l, r)
+    if isinstance(e, P.And):
+        l, r = _binary_operands(e, table, n)
+        return pc.and_kleene(l, r)
+    if isinstance(e, P.Or):
+        l, r = _binary_operands(e, table, n)
+        return pc.or_kleene(l, r)
+    if isinstance(e, P.Not):
+        return pc.invert(cpu_eval(e.child, table))
+    if isinstance(e, P.IsNull):
+        return pc.is_null(cpu_eval(e.child, table))
+    if isinstance(e, P.IsNotNull):
+        return pc.is_valid(cpu_eval(e.child, table))
+    if isinstance(e, P.IsNaN):
+        c = cpu_eval(e.child, table)
+        return pc.fill_null(pc.is_nan(c), False)
+    if isinstance(e, P.In):
+        c = cpu_eval(e.child, table)
+        has_null = any(v is None for v in e.values)
+        vals = [v for v in e.values if v is not None]
+        match = pc.is_in(c, value_set=pa.array(vals, type=c.type))
+        if has_null:
+            # no match + NULL in list -> NULL
+            match = pc.if_else(match, pa.scalar(True),
+                               pa.nulls(n, pa.bool_()))
+        return pc.if_else(pc.is_valid(c), match, pa.nulls(n, pa.bool_()))
+    if isinstance(e, P.Coalesce):
+        arrs = [cpu_eval(x, table) for x in e.exprs]
+        at = _widen_type(e)
+        return pc.coalesce(*[a.cast(at) for a in arrs])
+    if isinstance(e, P.If):
+        p = pc.fill_null(cpu_eval(e.pred, table), False)
+        at = _widen_type(e)
+        return pc.if_else(p, cpu_eval(e.then, table).cast(at),
+                          cpu_eval(e.otherwise, table).cast(at))
+    if isinstance(e, P.CaseWhen):
+        at = _widen_type(e)
+        out = cpu_eval(e.else_value, table).cast(at)
+        for cond, val in reversed(e.branches):
+            p = pc.fill_null(cpu_eval(cond, table), False)
+            out = pc.if_else(p, cpu_eval(val, table).cast(at), out)
+        return out
+    if isinstance(e, P.AtLeastNNonNulls):
+        count = np.zeros(n, np.int32)
+        for x in e.exprs:
+            a = cpu_eval(x, table)
+            ok = np.asarray(a.is_valid())
+            if pa.types.is_floating(a.type):
+                ok = ok & ~np.asarray(
+                    pc.fill_null(pc.is_nan(a), False))
+            count += ok.astype(np.int32)
+        return pa.array(count >= e.n)
+
+    if isinstance(e, Murmur3Hash):
+        return _murmur3_cpu(e, table, n)
+
+    raise NotImplementedError(
+        f"CPU engine: unsupported expression {type(e).__name__}")
+
+
+def _np_java_mod(l, r):
+    q = np.where((l < 0) != (r < 0), -(np.abs(l) // np.abs(r)), l // r)
+    return l - q * r
+
+
+def _least_greatest(e, table, n):
+    is_least = isinstance(e, A.Least)
+    at = _widen_type(e)
+    npdt = at.to_pandas_dtype()
+    acc_v = acc_ok = None
+    for x in e.exprs:
+        a = cpu_eval(x, table).cast(at)
+        v, ok = _np_vals(a, at)
+        if acc_v is None:
+            acc_v, acc_ok = v.copy(), ok.copy()
+            continue
+        if np.issubdtype(npdt, np.floating):
+            # NaN counts as the greatest value (Spark ordering)
+            a_nan = np.isnan(acc_v)
+            b_nan = np.isnan(v)
+            if is_least:
+                cmp = np.where(a_nan, True, np.where(b_nan, False,
+                                                     v < acc_v))
+            else:
+                cmp = np.where(b_nan, True, np.where(a_nan, False,
+                                                     v > acc_v))
+        else:
+            cmp = (v < acc_v) if is_least else (v > acc_v)
+        take = ok & (~acc_ok | cmp)
+        acc_v = np.where(take, v, acc_v)
+        acc_ok = acc_ok | ok
+    return _from_np(acc_v.astype(npdt), acc_ok, at)
+
+
+def _murmur3_cpu(e: Murmur3Hash, table, n):
+    """Numpy Spark murmur3 (independent of the XLA implementation; the
+    scalar-python oracle in tests/test_hashing.py checks both)."""
+    h = np.full(n, e.seed, np.uint32)
+    with np.errstate(over="ignore"):
+        for x in e.exprs:
+            a = cpu_eval(x, table)
+            h = _np_hash_col(a, h)
+    return pa.array(h.astype(np.int32))
+
+
+def _np_rotl(x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _np_mix_k1(k1):
+    k1 = k1 * np.uint32(0xCC9E2D51)
+    k1 = _np_rotl(k1, 15)
+    return k1 * np.uint32(0x1B873593)
+
+
+def _np_mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _np_rotl(h1, 13)
+    return h1 * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _np_fmix(h1, length):
+    h1 = h1 ^ np.uint32(length) if np.isscalar(length) else \
+        h1 ^ length.astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 ^= h1 >> np.uint32(13)
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+def _np_hash_col(a: pa.Array, seed: np.ndarray) -> np.ndarray:
+    t = a.type
+    valid = np.asarray(a.is_valid())
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        out = seed.copy()
+        for i, v in enumerate(a.to_pylist()):
+            if v is None:
+                continue
+            bs = v.encode("utf-8")
+            h1 = np.uint32(seed[i])
+            aligned = len(bs) - len(bs) % 4
+            for j in range(0, aligned, 4):
+                word = np.uint32(int.from_bytes(bs[j:j + 4], "little"))
+                h1 = _np_mix_h1(h1, _np_mix_k1(word))
+            for j in range(aligned, len(bs)):
+                b = bs[j] - 256 if bs[j] >= 128 else bs[j]
+                h1 = _np_mix_h1(h1, _np_mix_k1(np.uint32(b)))
+            out[i] = _np_fmix(h1, len(bs))
+        return out
+    if pa.types.is_floating(t) and t.bit_width == 64:
+        v, _ = _np_vals(a, pa.float64())
+        v = np.where(v == 0.0, 0.0, v)
+        bits = v.view(np.int64)
+        bits = np.where(np.isnan(v), np.int64(0x7FF8000000000000), bits)
+        h = _np_hash_i64(bits, seed)
+    elif pa.types.is_floating(t):
+        v, _ = _np_vals(a, pa.float32())
+        v = np.where(v == 0.0, np.float32(0.0), v)
+        bits = v.view(np.int32)
+        bits = np.where(np.isnan(v), np.int32(0x7FC00000), bits)
+        h = _np_fmix(_np_mix_h1(seed, _np_mix_k1(bits.astype(np.uint32))), 4)
+    elif pa.types.is_int64(t) or pa.types.is_timestamp(t):
+        v, _ = _np_vals(a.cast(pa.int64()) if not pa.types.is_int64(t)
+                        else a, pa.int64())
+        h = _np_hash_i64(v, seed)
+    else:
+        v, _ = _np_vals(a.cast(pa.int32()), pa.int32())
+        h = _np_fmix(_np_mix_h1(seed, _np_mix_k1(v.astype(np.uint32))), 4)
+    return np.where(valid, h, seed)
+
+
+def _np_hash_i64(v: np.ndarray, seed: np.ndarray) -> np.ndarray:
+    low = (v & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    high = ((v >> np.int64(32)) & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    h1 = _np_mix_h1(seed, _np_mix_k1(low))
+    h1 = _np_mix_h1(h1, _np_mix_k1(high))
+    return _np_fmix(h1, 8)
+
+
+# ---------------------------------------------------------------------- #
+# Plan execution
+# ---------------------------------------------------------------------- #
+
+_AGG_MAP = {
+    "sum": "sum", "count": "count", "count_star": "count_all",
+    "min": "min", "max": "max", "first": "first", "last": "last",
+}
+
+
+def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
+    if isinstance(plan, L.InMemoryRelation):
+        return plan.table
+    if isinstance(plan, L.ParquetRelation):
+        import pyarrow.parquet as pq
+
+        tables = [pq.read_table(p, columns=plan.columns)
+                  for p in plan.paths]
+        return pa.concat_tables(tables)
+    if isinstance(plan, L.CsvRelation):
+        import pyarrow.csv as pacsv
+
+        return pa.concat_tables(
+            [pacsv.read_csv(p) for p in plan.paths])
+    if isinstance(plan, L.RangeRel):
+        total = max(0, -(-(plan.end - plan.start) // plan.step))
+        ids = plan.start + np.arange(total, dtype=np.int64) * plan.step
+        return pa.table({"id": ids})
+    if isinstance(plan, L.Project):
+        child = execute_cpu(plan.children[0])
+        arrays = [cpu_eval(e, child) for e in plan.exprs]
+        return pa.Table.from_arrays(arrays,
+                                    schema=schema_to_arrow(plan.schema))
+    if isinstance(plan, L.Filter):
+        child = execute_cpu(plan.children[0])
+        mask = pc.fill_null(cpu_eval(plan.condition, child), False)
+        return child.filter(mask)
+    if isinstance(plan, L.Aggregate):
+        return _aggregate_cpu(plan)
+    if isinstance(plan, L.Sort):
+        return _sort_cpu(plan)
+    if isinstance(plan, L.Limit):
+        return execute_cpu(plan.children[0]).slice(0, plan.n)
+    if isinstance(plan, L.Union):
+        tables = [execute_cpu(c) for c in plan.children]
+        schema = tables[0].schema
+        tables = [t.rename_columns(schema.names) for t in tables]
+        return pa.concat_tables(tables)
+    if isinstance(plan, L.Join):
+        return _join_cpu(plan)
+    raise NotImplementedError(f"CPU engine: {plan.name}")
+
+
+def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
+    child = execute_cpu(plan.children[0])
+    n_keys = len(plan.groups)
+    # project keys + agg inputs with partial-dtype casts applied
+    cols, names, agg_specs = [], [], []
+    for i, g in enumerate(plan.groups):
+        cols.append(cpu_eval(g, child))
+        names.append(plan.schema.fields[i].name)
+    seen = 0
+    for na in plan.aggs:
+        fn = na.fn
+        ins = fn.inputs()
+        if not ins:
+            agg_specs.append(([], "count_all", na.out_name, fn))
+            continue
+        in_name = f"__a{seen}"
+        seen += 1
+        arr = cpu_eval(ins[0], child)
+        op = fn.update_ops()[0]
+        if op == "sum":
+            arr = arr.cast(T.to_arrow_type(fn.partial_dtypes()[0]))
+        if fn.name == "average":
+            arr = arr.cast(pa.float64())
+        cols.append(arr)
+        names.append(in_name)
+        agg_specs.append(([in_name], fn.name, na.out_name, fn))
+
+    proj = pa.Table.from_arrays(cols, names=names)
+    if n_keys == 0:
+        out_cols, out_names = [], []
+        for in_names, fname, out_name, fn in agg_specs:
+            out_cols.append(_grand_agg(proj, in_names, fname))
+            out_names.append(out_name)
+        return pa.Table.from_arrays(
+            [pa.array([v.as_py()], type=v.type) for v in out_cols],
+            names=out_names).cast(schema_to_arrow(plan.schema))
+
+    aggs = []
+    for in_names, fname, out_name, fn in agg_specs:
+        if fname == "count_all":
+            aggs.append(([], "count_all"))
+        elif fname == "count":
+            aggs.append((in_names[0], "count"))
+        elif fname == "average":
+            aggs.append((in_names[0], "mean"))
+        else:
+            aggs.append((in_names[0], fname))
+    gb = proj.group_by(names[:n_keys], use_threads=False)
+    res = gb.aggregate(aggs)
+    # rename to output schema order: keys first in our schema, aggregates
+    # come back named '<col>_<agg>'
+    out_arrays = []
+    aschema = schema_to_arrow(plan.schema)
+    for i in range(n_keys):
+        out_arrays.append(res.column(names[i]))
+    for (in_names, fname, out_name, fn), (src, op) in zip(agg_specs, aggs):
+        col_name = f"{src}_{op}" if src else f"{op}"
+        if col_name not in res.column_names:
+            col_name = f"{'_'.join(in_names)}_{op}" if in_names else op
+        out_arrays.append(res.column(col_name))
+    return pa.Table.from_arrays(out_arrays,
+                                names=aschema.names).cast(aschema)
+
+
+def _grand_agg(proj: pa.Table, in_names, fname) -> pa.Scalar:
+    if fname == "count_all":
+        return pa.scalar(proj.num_rows, pa.int64())
+    col = proj.column(in_names[0])
+    if fname == "count":
+        return pa.scalar(len(col) - col.null_count, pa.int64())
+    if fname == "average":
+        return pc.mean(col)
+    if fname == "sum":
+        return pc.sum(col)
+    if fname == "min":
+        return pc.min(col)
+    if fname == "max":
+        return pc.max(col)
+    if fname == "first":
+        valid = col.drop_null()
+        return valid[0] if len(valid) else pa.scalar(None, col.type)
+    if fname == "last":
+        valid = col.drop_null()
+        return valid[-1] if len(valid) else pa.scalar(None, col.type)
+    raise NotImplementedError(fname)
+
+
+def _spark_sortable(arr: pa.Array) -> pa.Array:
+    """pyarrow sorts NaN alongside nulls; Spark sorts NaN as the greatest
+    value.  Encode floats as IEEE total-order int64 keys (nulls kept)."""
+    if not pa.types.is_floating(arr.type):
+        return arr
+    v, valid = _np_vals(arr, pa.float64())
+    bits = v.view(np.int64)
+    bits = np.where(np.isnan(v), np.int64(0x7FF8000000000000), bits)
+    keys = np.where(bits < 0, bits ^ np.int64(2**63 - 1), bits)
+    return _from_np(keys, valid, pa.int64())
+
+
+def _sort_cpu(plan: L.Sort) -> pa.Table:
+    child = execute_cpu(plan.children[0])
+    # project sort keys as temp columns
+    tmp = child
+    keys = []
+    for i, k in enumerate(plan.keys):
+        name = f"__s{i}"
+        tmp = tmp.append_column(
+            name, _spark_sortable(cpu_eval(k.expr, child)))
+        keys.append((name, "descending" if k.descending else "ascending"))
+    placements = {k.nulls_last for k in plan.keys}
+    if len(placements) == 1:
+        idx = pc.sort_indices(
+            tmp, sort_keys=keys,
+            null_placement="at_end" if placements.pop() else "at_start")
+    else:
+        # mixed per-key null placement: stable multi-pass sort from the
+        # least significant key (python fallback, oracle-grade only)
+        idx_np = np.arange(tmp.num_rows)
+        for (name, order), k in reversed(list(zip(keys, plan.keys))):
+            col = tmp.column(name).combine_chunks().take(
+                pa.array(idx_np, pa.int64()))
+            sidx = pc.sort_indices(
+                col, sort_keys=[("", order)],
+                null_placement="at_end" if k.nulls_last else "at_start")
+            idx_np = idx_np[np.asarray(sidx)]
+        idx = pa.array(idx_np, pa.int64())
+    return child.take(idx)
+
+
+def _join_cpu(plan: L.Join) -> pa.Table:
+    left = execute_cpu(plan.children[0])
+    right = execute_cpu(plan.children[1])
+    jt = plan.join_type
+    if jt == "cross":
+        left = left.append_column("__ck", pa.array([1] * left.num_rows))
+        right = right.append_column("__ck", pa.array([1] * right.num_rows))
+        lkeys, rkeys = ["__ck"], ["__ck"]
+        jt = "inner"
+    else:
+        tmpl, tmpr = left, right
+        lkeys, rkeys = [], []
+        for i, (lk, rk) in enumerate(zip(plan.left_keys, plan.right_keys)):
+            ln, rn = f"__lk{i}", f"__rk{i}"
+            tmpl = tmpl.append_column(ln, cpu_eval(lk, left))
+            tmpr = tmpr.append_column(rn, cpu_eval(rk, right))
+            lkeys.append(ln)
+            rkeys.append(rn)
+        left, right = tmpl, tmpr
+    pa_type = {"inner": "inner", "left_outer": "left outer",
+               "right_outer": "right outer", "full_outer": "full outer",
+               "left_semi": "left semi", "left_anti": "left anti"}[jt]
+    res = left.join(right, keys=lkeys, right_keys=rkeys, join_type=pa_type,
+                    left_suffix="", right_suffix="__r",
+                    coalesce_keys=False)
+    out_names = [f.name for f in plan.schema.fields]
+    res_names = res.column_names
+    arrays = []
+    used = []
+    for name in out_names:
+        # account for pa.join suffixing duplicate names
+        if name in res_names and name not in used:
+            pick = name
+        else:
+            pick = f"{name}__r"
+        used.append(pick)
+        arrays.append(res.column(pick))
+    out = pa.Table.from_arrays(arrays, names=out_names)
+    if plan.condition is not None:
+        mask = pc.fill_null(cpu_eval(plan.condition, out), False)
+        out = out.filter(mask)
+    return out.cast(schema_to_arrow(plan.schema))

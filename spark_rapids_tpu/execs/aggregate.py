@@ -214,16 +214,7 @@ class TpuHashAggregateExec(TpuExec):
             if self.n_keys > 0:
                 return  # grouped aggregate of empty input: no rows
             # grand aggregate of empty input: one default row
-            from spark_rapids_tpu.columnar.column import MIN_CAPACITY
-            import numpy as np
-
-            empty_cols = {
-                f.name: np.array(
-                    [], dtype=object if isinstance(f.dtype, T.StringType)
-                    else T.to_numpy_dtype(f.dtype))
-                for f in self.children[0].schema.fields}
-            eb = ColumnarBatch.from_numpy(empty_cols,
-                                          self.children[0].schema)
+            eb = ColumnarBatch.empty(self.children[0].schema)
             if self.mode == "final":
                 pending = [eb]
             else:

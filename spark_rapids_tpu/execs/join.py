@@ -109,15 +109,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         return b
 
     def _empty_build(self) -> ColumnarBatch:
-        import numpy as np
-
         child = self.children[1] if self.build_is_right else self.children[0]
-        empty = {
-            f.name: np.array([], dtype=object
-                             if isinstance(f.dtype, T.StringType)
-                             else T.to_numpy_dtype(f.dtype))
-            for f in child.schema.fields}
-        return ColumnarBatch.from_numpy(empty, child.schema)
+        return ColumnarBatch.empty(child.schema)
 
     def _probe(self, build: ColumnarBatch, stream: ColumnarBatch):
         """Traceable: key eval + join state (tuple of arrays)."""
@@ -182,9 +175,12 @@ class TpuShuffledHashJoinExec(TpuExec):
 
         stream_child = (self.children[0] if self.build_is_right
                         else self.children[1])
+        build = build.with_device_num_rows()
         for stream in stream_child.execute():
             self.metrics["probeBatches"].add(1)
+            out = None
             with MetricTimer(self.metrics[TOTAL_TIME]):
+                stream = stream.with_device_num_rows()
                 st, total = jit_probe(build, stream)
                 if self.join_type == "full_outer":
                     m = st.matched_b
@@ -194,16 +190,16 @@ class TpuShuffledHashJoinExec(TpuExec):
                     keep = st.matched_s if self.join_type == "left_semi" \
                         else (st.live_s & ~st.matched_s)
                     out = jit_semi_compact(stream, keep)
-                    yield self._count_output(out)
-                    continue
-                n_total = int(jax.device_get(total))
-                if n_total == 0:
-                    continue
-                out_cap = pad_capacity(n_total)
-                out = self._jit_expand(out_cap)(build, stream, st, total)
-                if self.condition is not None:
-                    out = self._jit_condition(out)
-            yield self._count_output(out)
+                else:
+                    n_total = int(jax.device_get(total))
+                    if n_total:
+                        out_cap = pad_capacity(n_total)
+                        out = self._jit_expand(out_cap)(build, stream, st,
+                                                        total)
+                        if self.condition is not None:
+                            out = self._jit_condition(out)
+            if out is not None:
+                yield self._count_output(out)
 
         if self.join_type == "full_outer":
             yield from self._emit_unmatched_build(build, matched_b_acc)

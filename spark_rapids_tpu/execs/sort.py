@@ -47,8 +47,12 @@ class _SortMixin(TpuExec):
         ctx = EvalContext.for_batch(batch)
         n_data = batch.num_cols
         key_cols = [k.expr.eval(ctx) for k in self.keys]
+        aug_schema = T.Schema(
+            list(batch.schema.fields)
+            + [T.Field(f"__sortkey{i}", k.expr.dtype)
+               for i, k in enumerate(self.keys)])
         aug = ColumnarBatch(list(batch.columns) + key_cols, batch.num_rows,
-                            batch.schema)
+                            aug_schema)
         orders = [SortOrder(n_data + i, k.descending, k.nulls_last)
                   for i, k in enumerate(self.keys)]
         out = sort_batch(aug, orders)
@@ -83,11 +87,13 @@ class TpuSortExec(_SortMixin):
                 return
             big = batches[0] if len(batches) == 1 else concat_batches(batches)
             with MetricTimer(self.metrics[TOTAL_TIME]):
-                yield self._count_output(self._jit_sorted(big))
+                out = self._jit_sorted(big.with_device_num_rows())
+            yield self._count_output(out)
         else:
             for b in self.children[0].execute():
                 with MetricTimer(self.metrics[TOTAL_TIME]):
-                    yield self._count_output(self._jit_sorted(b))
+                    out = self._jit_sorted(b.with_device_num_rows())
+                yield self._count_output(out)
 
 
 class TpuTakeOrderedAndProjectExec(_SortMixin):
@@ -128,7 +134,7 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
         for b in self.children[0].execute():
             with MetricTimer(self.metrics[TOTAL_TIME]):
                 merged = b if top is None else concat_batches([top, b])
-                top = jit_topn(merged)
+                top = jit_topn(merged.with_device_num_rows())
                 # compact so concat_batches sees the concrete top-n rows
                 top = ColumnarBatch(top.columns, top.concrete_num_rows(),
                                     top.schema)

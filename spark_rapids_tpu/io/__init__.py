@@ -1,0 +1,1 @@
+"""I/O: scans and writers (ref layer: SURVEY.md §2.8)."""

@@ -1,0 +1,124 @@
+"""Scan execs: host-decoded columnar reads uploaded to device.
+
+TPU analog of the reference's scan layer (ref: GpuParquetScan.scala:84 —
+CPU footer parse + device decode; GpuCSVScan at GpuBatchScanExec.scala:90).
+Stage-5 design from SURVEY.md §7: pyarrow does file decode on host
+(multi-threaded C++), and batches are uploaded H2D through the single
+arrow seam; device-side Parquet decode (Pallas) is a later optimization.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.arrow import from_arrow, schema_to_arrow
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec
+
+
+def _conf_batch_rows() -> int:
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+
+    return get_conf().get(BATCH_SIZE_ROWS)
+
+
+class ArrowSourceExec(TpuExec):
+    """Leaf over a host Arrow table: slices it into device batches (the
+    receiving end of every CPU->TPU transition, ref: HostColumnarToGpu)."""
+
+    def __init__(self, table: pa.Table, schema: Optional[T.Schema] = None,
+                 batch_rows: Optional[int] = None):
+        super().__init__()
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        self.table = table
+        self._schema = schema or schema_from_arrow(table.schema)
+        self.batch_rows = batch_rows or _conf_batch_rows()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"ArrowSourceExec [{self.table.num_rows} rows]"
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        t = self.table
+        if t.num_rows == 0:
+            yield self._count_output(
+                from_arrow(t.cast(schema_to_arrow(self._schema))))
+            return
+        for off in range(0, t.num_rows, self.batch_rows):
+            chunk = t.slice(off, self.batch_rows)
+            yield self._count_output(from_arrow(chunk))
+
+
+class ParquetScanExec(TpuExec):
+    """Reads row-group-sized record batches per file and uploads them
+    (the per-file reader mode; multi-file coalescing/cloud thread pools
+    of GpuParquetScan.scala:882 are a later stage)."""
+
+    def __init__(self, paths: Sequence[str], schema: T.Schema,
+                 columns: Optional[Sequence[str]] = None,
+                 batch_rows: Optional[int] = None):
+        super().__init__()
+        self.paths = list(paths)
+        self._schema = schema
+        self.columns = list(columns) if columns is not None else None
+        self.batch_rows = batch_rows or _conf_batch_rows()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"ParquetScanExec {self.paths}"
+
+    def additional_metrics(self):
+        return [("scanTime", "MODERATE")]
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        import pyarrow.parquet as pq
+
+        empty = True
+        for path in self.paths:
+            f = pq.ParquetFile(path)
+            for rb in f.iter_batches(batch_size=self.batch_rows,
+                                     columns=self.columns):
+                empty = False
+                yield self._count_output(
+                    from_arrow(pa.Table.from_batches([rb])))
+        if empty:
+            yield self._count_output(
+                from_arrow(pa.Table.from_arrays(
+                    [pa.array([], f.type) for f in
+                     schema_to_arrow(self._schema)],
+                    schema=schema_to_arrow(self._schema))))
+
+
+class CsvScanExec(TpuExec):
+    def __init__(self, paths: Sequence[str], schema: T.Schema,
+                 batch_rows: Optional[int] = None):
+        super().__init__()
+        self.paths = list(paths)
+        self._schema = schema
+        self.batch_rows = batch_rows or _conf_batch_rows()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"CsvScanExec {self.paths}"
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        import pyarrow.csv as pacsv
+
+        for path in self.paths:
+            t = pacsv.read_csv(path).cast(schema_to_arrow(self._schema))
+            for off in range(0, max(t.num_rows, 1), self.batch_rows):
+                chunk = t.slice(off, self.batch_rows)
+                yield self._count_output(from_arrow(chunk))

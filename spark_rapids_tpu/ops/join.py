@@ -64,14 +64,6 @@ def _concat_key_cols(build: list[AnyColumn], stream: list[AnyColumn]
     return out
 
 
-@dataclasses.dataclass
-class JoinSizing:
-    """Device scalars the exec reads (one sync) to size the output."""
-
-    total_pairs: jax.Array  # rows the pair expansion will produce
-    n_unmatched_build: jax.Array  # full-outer extra rows
-
-
 def compute_gids(build_keys: list[AnyColumn], stream_keys: list[AnyColumn],
                  live_b: jax.Array, live_s: jax.Array):
     """Dense rank over the union of both sides' keys.
@@ -163,15 +155,6 @@ def join_state(build: ColumnarBatch, stream: ColumnarBatch,
                      cum_excl=cum, start_by_gid=starts,
                      build_rows_sorted=build_sort, live_s=live_s,
                      matched_b=matched_b, live_b=live_b)
-
-
-def join_sizing(state: JoinState, join_type: str) -> JoinSizing:
-    total = jnp.sum(state.cnt_s).astype(jnp.int32)
-    unmatched_b = jnp.sum(
-        (state.live_b & ~state.matched_b).astype(jnp.int32))
-    if join_type != "full_outer":
-        unmatched_b = jnp.zeros((), jnp.int32)
-    return JoinSizing(total, unmatched_b)
 
 
 def expand_pairs(state: JoinState, out_cap: int

@@ -1,0 +1,9 @@
+"""Logical plans and the TPU plan-rewriting engine.
+
+The reference operates on Spark Catalyst physical plans; this framework
+ships its own small logical plan + DataFrame frontend (SURVEY.md §7:
+"put the data plane behind a narrow columnar FFI"), and this package is
+the counterpart of the reference's L4 rewrite layer: GpuOverrides-style
+per-node tagging with reasons, conf kill-switches, explain output, and
+per-subtree CPU fallback (ref: GpuOverrides.scala, RapidsMeta.scala).
+"""

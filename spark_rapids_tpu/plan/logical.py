@@ -1,0 +1,254 @@
+"""Logical plan nodes.
+
+The role Catalyst's logical/physical plans play for the reference: the
+engine-neutral description of a query that both the TPU planner
+(plan.planner) and the CPU engine (cpu.engine) consume.  Expressions are
+the shared Expression trees (unbound ColumnReferences resolved against
+child schemas at construction, so every node knows its output schema)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.execs.sort import SortKey
+from spark_rapids_tpu.exprs.aggregates import NamedAgg
+from spark_rapids_tpu.exprs.base import Expression, bind_references
+
+
+class LogicalPlan:
+    children: list["LogicalPlan"]
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def node_desc(self) -> str:
+        return self.name
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + "+- " + self.node_desc() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+
+def _output_fields(exprs: Sequence[Expression]) -> T.Schema:
+    from spark_rapids_tpu.execs.basic import output_field
+
+    return T.Schema([output_field(e, i) for i, e in enumerate(exprs)])
+
+
+class InMemoryRelation(LogicalPlan):
+    """Leaf over a host Arrow table (test sources, fallback boundaries)."""
+
+    def __init__(self, table: pa.Table):
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        self.children = []
+        self.table = table
+        self._schema = schema_from_arrow(table.schema)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"InMemoryRelation [{self.table.num_rows} rows]"
+
+
+class ParquetRelation(LogicalPlan):
+    """Parquet scan leaf (ref: GpuParquetScan.scala — here the footer/
+    row-group handling is pyarrow's; device decode is a later stage)."""
+
+    def __init__(self, paths: Sequence[str],
+                 columns: Optional[Sequence[str]] = None):
+        import pyarrow.parquet as pq
+
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        self.children = []
+        self.paths = list(paths)
+        aschema = pq.read_schema(self.paths[0])
+        if columns is not None:
+            aschema = pa.schema([aschema.field(c) for c in columns])
+        self.columns = list(columns) if columns is not None else None
+        self._schema = schema_from_arrow(aschema)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"ParquetRelation {self.paths}"
+
+
+class CsvRelation(LogicalPlan):
+    """CSV scan leaf (ref: GpuCSVScan in GpuBatchScanExec.scala:90)."""
+
+    def __init__(self, paths: Sequence[str],
+                 schema: Optional[T.Schema] = None):
+        import pyarrow.csv as pacsv
+
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        self.children = []
+        self.paths = list(paths)
+        if schema is None:
+            head = pacsv.read_csv(self.paths[0])
+            schema = schema_from_arrow(head.schema)
+        self._schema = schema
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"CsvRelation {self.paths}"
+
+
+class RangeRel(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1):
+        self.children = []
+        self.start, self.end, self.step = start, end, step
+        self._schema = T.Schema([T.Field("id", T.LONG, False)])
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"Range ({self.start}, {self.end}, step={self.step})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
+        self.children = [child]
+        self.exprs = [bind_references(e, child.schema) for e in exprs]
+        self._schema = _output_fields(self.exprs)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"Project [{', '.join(e.name for e in self.exprs)}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.children = [child]
+        self.condition = bind_references(condition, child.schema)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        return f"Filter [{self.condition!r}]"
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, groups: Sequence[Expression],
+                 aggs: Sequence[NamedAgg], child: LogicalPlan):
+        self.children = [child]
+        self.groups = [bind_references(g, child.schema) for g in groups]
+        self.aggs = [NamedAgg(na.fn.bind(child.schema), na.out_name)
+                     for na in aggs]
+        key_fields = list(_output_fields(self.groups).fields)
+        self._schema = T.Schema(
+            key_fields + [na.output_field() for na in self.aggs])
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(g.name for g in self.groups)
+        asr = ", ".join(f"{na.fn.name}->{na.out_name}" for na in self.aggs)
+        return f"Aggregate keys=[{ks}] [{asr}]"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, keys: Sequence[SortKey], child: LogicalPlan):
+        self.children = [child]
+        self.keys = [SortKey(bind_references(k.expr, child.schema),
+                             k.descending, k.nulls_last) for k in keys]
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(
+            f"{k.expr.name}{' DESC' if k.descending else ''}"
+            for k in self.keys)
+        return f"Sort [{ks}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        return f"Limit {self.n}"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: str,
+                 condition: Optional[Expression] = None):
+        from spark_rapids_tpu.execs.join import JOIN_TYPES, _nullable_fields
+
+        assert join_type in JOIN_TYPES, join_type
+        self.children = [left, right]
+        self.join_type = join_type
+        self.left_keys = [bind_references(k, left.schema) for k in left_keys]
+        self.right_keys = [bind_references(k, right.schema)
+                           for k in right_keys]
+        joined = T.Schema(list(left.schema.fields)
+                          + list(right.schema.fields))
+        self.condition = (bind_references(condition, joined)
+                          if condition is not None else None)
+        lf, rf = list(left.schema.fields), list(right.schema.fields)
+        if join_type in ("left_outer", "full_outer"):
+            rf = _nullable_fields(right.schema)
+        if join_type in ("right_outer", "full_outer"):
+            lf = _nullable_fields(left.schema)
+        if join_type in ("left_semi", "left_anti"):
+            self._schema = left.schema
+        else:
+            self._schema = T.Schema(lf + rf)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(f"{l.name}={r.name}" for l, r in
+                       zip(self.left_keys, self.right_keys))
+        c = f" cond={self.condition!r}" if self.condition is not None else ""
+        return f"Join {self.join_type} [{ks}]{c}"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        assert children
+        self.children = list(children)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema

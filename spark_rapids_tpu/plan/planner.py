@@ -1,0 +1,280 @@
+"""The plan-rewriting engine: tagging, conversion, fallback, explain.
+
+TPU re-design of the reference's L4 layer:
+- per-node meta wrappers carrying will-not-work reasons
+  (ref: RapidsMeta.scala:162 willNotWorkOnGpu, :197 canThisBeReplaced);
+- a replacement-rule registry with auto-registered per-exec and
+  per-expression conf kill-switches
+  (ref: GpuOverrides.scala:679-748 expr/exec rules,
+  RapidsMeta.scala:35-46 DataFromReplacementRule.confKey);
+- explain output listing every node kept off the accelerator and why
+  (ref: GpuOverrides.scala:3113-3122, the plugin's single most important
+  observability feature);
+- per-subtree CPU fallback with explicit transition execs at the
+  boundary (ref: GpuTransitionOverrides.scala inserts
+  HostColumnarToGpu/GpuBringBackToHost the same way).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Iterator, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import SQL_ENABLED, get_conf, register
+from spark_rapids_tpu.columnar.arrow import schema_to_arrow, to_arrow
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs.hashing import Murmur3Hash
+from spark_rapids_tpu.plan import logical as L
+
+# ---------------------------------------------------------------------- #
+# Supported-expression registry (ref: GpuOverrides.scala expr rules)
+# ---------------------------------------------------------------------- #
+
+SUPPORTED_EXPRS: dict[type, object] = {}
+
+
+def register_expr(cls: type) -> None:
+    key = f"spark.rapids.tpu.sql.expression.{cls.__name__}"
+    entry = register(key, True,
+                     f"Enable TPU execution of expression {cls.__name__}.")
+    SUPPORTED_EXPRS[cls] = entry
+
+
+for _cls in (
+    B.Alias, B.BoundReference, B.ColumnReference, B.Literal,
+    A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
+    A.Remainder, A.Pmod, A.UnaryMinus, A.UnaryPositive, A.Abs,
+    A.Least, A.Greatest,
+    P.EqualTo, P.LessThan, P.LessThanOrEqual, P.GreaterThan,
+    P.GreaterThanOrEqual, P.EqualNullSafe, P.And, P.Or, P.Not,
+    P.IsNull, P.IsNotNull, P.IsNaN, P.In, P.Coalesce, P.If, P.CaseWhen,
+    P.AtLeastNNonNulls, Murmur3Hash,
+):
+    register_expr(_cls)
+
+# aggregate functions are checked by their own registry
+from spark_rapids_tpu.exprs import aggregates as AG  # noqa: E402
+
+SUPPORTED_AGGS = (AG.Sum, AG.Count, AG.CountStar, AG.Min, AG.Max,
+                  AG.Average, AG.First, AG.Last)
+
+# per-exec kill switches (ref: spark.rapids.sql.exec.*)
+_EXEC_CONFS = {
+    cls: register(f"spark.rapids.tpu.sql.exec.{cls.__name__}", True,
+                  f"Enable TPU execution of {cls.__name__}.")
+    for cls in (L.InMemoryRelation, L.ParquetRelation, L.CsvRelation,
+                L.RangeRel, L.Project, L.Filter, L.Aggregate, L.Sort,
+                L.Limit, L.Join, L.Union)
+}
+
+
+def _check_expr(e: B.Expression, conf, reasons: set[str]) -> None:
+    entry = SUPPORTED_EXPRS.get(type(e))
+    if entry is None:
+        reasons.add(f"expression {type(e).__name__} is not supported on TPU")
+    elif not conf.get(entry):
+        reasons.add(
+            f"expression {type(e).__name__} disabled by {entry.key}")
+    for c in e.children:
+        _check_expr(c, conf, reasons)
+
+
+# ---------------------------------------------------------------------- #
+# Meta wrapper
+# ---------------------------------------------------------------------- #
+
+class PlanMeta:
+    """Wrapper tree over a logical plan carrying tagging state
+    (ref: RapidsMeta.scala SparkPlanMeta)."""
+
+    def __init__(self, plan: L.LogicalPlan, conf):
+        self.plan = plan
+        self.conf = conf
+        self.children = [PlanMeta(c, conf) for c in plan.children]
+        self.reasons: set[str] = set()
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons
+
+    def will_not_work(self, reason: str) -> None:
+        self.reasons.add(reason)
+
+    def tag(self) -> None:
+        conf = self.conf
+        entry = _EXEC_CONFS.get(type(self.plan))
+        if entry is None:
+            self.will_not_work(
+                f"operator {self.plan.name} is not supported on TPU")
+        elif not conf.get(entry):
+            self.will_not_work(f"disabled by {entry.key}")
+        self._tag_exprs()
+        for c in self.children:
+            c.tag()
+
+    def _tag_exprs(self) -> None:
+        p = self.plan
+        conf = self.conf
+        if isinstance(p, L.Project):
+            for e in p.exprs:
+                _check_expr(e, conf, self.reasons)
+        elif isinstance(p, L.Filter):
+            _check_expr(p.condition, conf, self.reasons)
+        elif isinstance(p, L.Aggregate):
+            for g in p.groups:
+                _check_expr(g, conf, self.reasons)
+            for na in p.aggs:
+                if not isinstance(na.fn, SUPPORTED_AGGS):
+                    self.will_not_work(
+                        f"aggregate {na.fn.name} is not supported on TPU")
+                for e in na.fn.inputs():
+                    _check_expr(e, conf, self.reasons)
+        elif isinstance(p, L.Sort):
+            for k in p.keys:
+                _check_expr(k.expr, conf, self.reasons)
+        elif isinstance(p, L.Join):
+            for e in list(p.left_keys) + list(p.right_keys):
+                _check_expr(e, conf, self.reasons)
+            if p.condition is not None:
+                if p.join_type != "inner":
+                    self.will_not_work(
+                        "non-inner join with residual condition")
+                else:
+                    _check_expr(p.condition, conf, self.reasons)
+            if p.join_type != "cross" and not p.left_keys:
+                self.will_not_work("non-equi join without keys")
+
+    # -- explain -------------------------------------------------------- #
+
+    def explain(self, indent: int = 0) -> str:
+        mark = "*" if self.can_replace else "!"
+        s = "  " * indent + f"{mark} {self.plan.node_desc()}"
+        if self.reasons:
+            s += "  <-- cannot run on TPU because " + "; ".join(
+                sorted(self.reasons))
+        s += "\n"
+        for c in self.children:
+            s += c.explain(indent + 1)
+        return s
+
+
+# ---------------------------------------------------------------------- #
+# Conversion (ref: RapidsMeta convertIfNeeded)
+# ---------------------------------------------------------------------- #
+
+class CpuFallbackExec(TpuExec):
+    """Runs one logical node on the CPU engine; exec children are
+    materialized to Arrow at the boundary (the device->host transition,
+    ref: GpuBringBackToHost + ColumnarToRow) and the result re-enters the
+    device path through ArrowSourceExec slicing on the parent side."""
+
+    def __init__(self, plan: L.LogicalPlan, *children: TpuExec):
+        super().__init__(*children)
+        self.plan = plan
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.plan.schema
+
+    def node_desc(self) -> str:
+        return f"CpuFallbackExec [{self.plan.node_desc()}]"
+
+    def cpu_table(self) -> pa.Table:
+        from spark_rapids_tpu.cpu.engine import execute_cpu
+
+        new_children = []
+        for c in self.children:
+            if isinstance(c, CpuFallbackExec):
+                # fuse adjacent CPU nodes: no device round-trip
+                new_children.append(L.InMemoryRelation(c.cpu_table()))
+            else:
+                new_children.append(L.InMemoryRelation(collect_exec(c)))
+        plan = copy.copy(self.plan)
+        plan.children = new_children
+        return execute_cpu(plan)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.io.scan import ArrowSourceExec
+
+        src = ArrowSourceExec(self.cpu_table(), self.schema)
+        for b in src.execute():
+            yield self._count_output(b)
+
+
+def convert_meta(meta: PlanMeta) -> TpuExec:
+    p = meta.plan
+    if not meta.can_replace:
+        return CpuFallbackExec(p, *[convert_meta(c)
+                                    for c in meta.children])
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.basic import (
+        TpuFilterExec,
+        TpuProjectExec,
+        TpuRangeExec,
+        TpuUnionExec,
+    )
+    from spark_rapids_tpu.execs.join import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.execs.limit import TpuGlobalLimitExec
+    from spark_rapids_tpu.execs.sort import TpuSortExec
+    from spark_rapids_tpu.io.scan import (
+        ArrowSourceExec,
+        CsvScanExec,
+        ParquetScanExec,
+    )
+
+    kids = [convert_meta(c) for c in meta.children]
+    if isinstance(p, L.InMemoryRelation):
+        return ArrowSourceExec(p.table, p.schema)
+    if isinstance(p, L.ParquetRelation):
+        return ParquetScanExec(p.paths, p.schema, p.columns)
+    if isinstance(p, L.CsvRelation):
+        return CsvScanExec(p.paths, p.schema)
+    if isinstance(p, L.RangeRel):
+        return TpuRangeExec(p.start, p.end, p.step)
+    if isinstance(p, L.Project):
+        return TpuProjectExec(p.exprs, kids[0])
+    if isinstance(p, L.Filter):
+        return TpuFilterExec(p.condition, kids[0])
+    if isinstance(p, L.Aggregate):
+        return TpuHashAggregateExec(p.groups, p.aggs, kids[0])
+    if isinstance(p, L.Sort):
+        return TpuSortExec(p.keys, kids[0])
+    if isinstance(p, L.Limit):
+        return TpuGlobalLimitExec(p.n, kids[0])
+    if isinstance(p, L.Union):
+        return TpuUnionExec(*kids)
+    if isinstance(p, L.Join):
+        return TpuShuffledHashJoinExec(
+            p.left_keys, p.right_keys, p.join_type, kids[0], kids[1],
+            condition=p.condition)
+    raise AssertionError(f"tagged-replaceable node unconvertible: {p.name}")
+
+
+# ---------------------------------------------------------------------- #
+# Entry points
+# ---------------------------------------------------------------------- #
+
+def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
+    conf = conf or get_conf()
+    meta = PlanMeta(plan, conf)
+    if conf.get(SQL_ENABLED):
+        meta.tag()
+    else:
+        meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
+    return convert_meta(meta), meta
+
+
+def collect_exec(exec_: TpuExec) -> pa.Table:
+    """Drain an exec to a host Arrow table (the D2H plan root)."""
+    tables = [to_arrow(b) for b in exec_.execute()]
+    aschema = schema_to_arrow(exec_.schema)
+    if not tables:
+        return aschema.empty_table()
+    return pa.concat_tables([t.cast(aschema) for t in tables])

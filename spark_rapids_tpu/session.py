@@ -1,0 +1,212 @@
+"""User-facing session + DataFrame API.
+
+The reference plugs into Spark's existing frontend; this framework ships
+its own minimal DataFrame surface (SURVEY.md §7: "a small DataFrame/plan
+frontend plus a CPU engine that plays the role of CPU Spark").  The API
+deliberately mirrors PySpark's shape (select/where/groupBy/agg/join/
+orderBy/limit/collect/explain) so reference test cases translate
+directly."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import SQL_ENABLED, TpuConf, get_conf
+from spark_rapids_tpu.execs.sort import SortKey
+from spark_rapids_tpu.exprs.aggregates import (
+    Average,
+    Count,
+    CountStar,
+    First,
+    Last,
+    Max,
+    Min,
+    NamedAgg,
+    Sum,
+)
+from spark_rapids_tpu.exprs.base import ColumnReference, Expression, lit
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+ExprLike = Union[str, Expression]
+AggLike = Union[NamedAgg, tuple]
+
+
+def col(name: str) -> ColumnReference:
+    return ColumnReference(name)
+
+
+def _expr(e: ExprLike) -> Expression:
+    return ColumnReference(e) if isinstance(e, str) else e
+
+
+# function-style aggregate constructors (pyspark.sql.functions shape)
+def sum_(e: ExprLike) -> Sum:
+    return Sum(_expr(e))
+
+
+def count(e: ExprLike) -> Count:
+    return Count(_expr(e))
+
+
+def count_star() -> CountStar:
+    return CountStar()
+
+
+def min_(e: ExprLike) -> Min:
+    return Min(_expr(e))
+
+
+def max_(e: ExprLike) -> Max:
+    return Max(_expr(e))
+
+
+def avg(e: ExprLike) -> Average:
+    return Average(_expr(e))
+
+
+def first(e: ExprLike) -> First:
+    return First(_expr(e))
+
+
+def last(e: ExprLike) -> Last:
+    return Last(_expr(e))
+
+
+class TpuSession:
+    """Counterpart of the SparkSession with the plugin installed
+    (ref: SQLPlugin.scala — here session == plugin)."""
+
+    def __init__(self, conf: Optional[TpuConf] = None):
+        self.conf = conf or get_conf()
+
+    # -- sources -------------------------------------------------------- #
+
+    def create_dataframe(self, data: Union[pa.Table, dict]) -> "DataFrame":
+        table = data if isinstance(data, pa.Table) else pa.table(data)
+        return DataFrame(L.InMemoryRelation(table), self)
+
+    def read_parquet(self, *paths: str,
+                     columns: Optional[Sequence[str]] = None) -> "DataFrame":
+        return DataFrame(L.ParquetRelation(list(paths), columns), self)
+
+    def read_csv(self, *paths: str,
+                 schema: Optional[T.Schema] = None) -> "DataFrame":
+        return DataFrame(L.CsvRelation(list(paths), schema), self)
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.RangeRel(start, end, step), self)
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: list[Expression]):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *aggs: AggLike) -> "DataFrame":
+        named = []
+        for i, a in enumerate(aggs):
+            if isinstance(a, NamedAgg):
+                named.append(a)
+            elif isinstance(a, tuple):
+                fn, name = a
+                named.append(NamedAgg(fn, name))
+            else:
+                named.append(NamedAgg(a, f"{a.name}_{i}"))
+        return DataFrame(
+            L.Aggregate(self._keys, named, self._df._plan),
+            self._df._session)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session: TpuSession):
+        self._plan = plan
+        self._session = session
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._plan.schema
+
+    # -- transformations ------------------------------------------------ #
+
+    def select(self, *exprs: ExprLike) -> "DataFrame":
+        return DataFrame(L.Project([_expr(e) for e in exprs], self._plan),
+                         self._session)
+
+    def where(self, cond: Expression) -> "DataFrame":
+        return DataFrame(L.Filter(cond, self._plan), self._session)
+
+    filter = where
+
+    def with_column(self, name: str, e: Expression) -> "DataFrame":
+        exprs: list[Expression] = [
+            ColumnReference(f.name) for f in self.schema.fields
+            if f.name != name]
+        exprs.append(e.alias(name))
+        return self.select(*exprs)
+
+    def group_by(self, *keys: ExprLike) -> GroupedData:
+        return GroupedData(self, [_expr(k) for k in keys])
+
+    def agg(self, *aggs: AggLike) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str], None]
+             = None, how: str = "inner",
+             left_on: Optional[Sequence[ExprLike]] = None,
+             right_on: Optional[Sequence[ExprLike]] = None,
+             condition: Optional[Expression] = None) -> "DataFrame":
+        if on is not None:
+            names = [on] if isinstance(on, str) else list(on)
+            lk = [ColumnReference(n) for n in names]
+            rk = [ColumnReference(n) for n in names]
+        else:
+            lk = [_expr(e) for e in (left_on or [])]
+            rk = [_expr(e) for e in (right_on or [])]
+        return DataFrame(
+            L.Join(self._plan, other._plan, lk, rk, how, condition),
+            self._session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self._plan, other._plan]), self._session)
+
+    def order_by(self, *keys, desc: bool = False) -> "DataFrame":
+        sks = []
+        for k in keys:
+            if isinstance(k, SortKey):
+                sks.append(k)
+            else:
+                sks.append(SortKey(_expr(k), descending=desc,
+                                   nulls_last=desc))
+        return DataFrame(L.Sort(sks, self._plan), self._session)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self._plan), self._session)
+
+    # -- actions --------------------------------------------------------- #
+
+    def collect(self, engine: Optional[str] = None) -> pa.Table:
+        """engine: 'tpu' (plan rewrite + fallback), 'cpu' (reference
+        engine), default from spark.rapids.tpu.sql.enabled."""
+        conf = self._session.conf
+        if engine is None:
+            engine = "tpu" if conf.get(SQL_ENABLED) else "cpu"
+        if engine == "cpu":
+            from spark_rapids_tpu.cpu.engine import execute_cpu
+
+            return execute_cpu(self._plan)
+        exec_, _meta = plan_query(self._plan, conf)
+        return collect_exec(exec_)
+
+    def explain(self) -> str:
+        _, meta = plan_query(self._plan, self._session.conf)
+        return meta.explain()
+
+    def __repr__(self) -> str:
+        return f"DataFrame[{self.schema}]"

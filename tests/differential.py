@@ -1,0 +1,123 @@
+"""Differential test harness: run the same DataFrame on the TPU engine
+and the CPU reference engine and require equal results.
+
+Mirrors the reference's integration harness
+(ref: integration_tests/src/main/python/asserts.py
+assert_gpu_and_cpu_are_equal_collect :375 and _assert_equal :14-60,
+with approximate-float and ignore-order options from marks.py), plus a
+composable random data generator in the spirit of data_gen.py."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+def _canon_row(row, approx_float: bool):
+    out = []
+    for v in row:
+        if v is None:
+            out.append(("null",))
+        elif isinstance(v, float):
+            if math.isnan(v):
+                out.append(("nan",))
+            elif approx_float:
+                out.append(("f", round(v, 9)))
+            else:
+                out.append(("f", v))
+        else:
+            out.append((type(v).__name__, v))
+    return tuple(out)
+
+
+def _rows(table: pa.Table, approx_float: bool):
+    cols = [c.to_pylist() for c in table.columns]
+    return [
+        _canon_row([c[i] for c in cols], approx_float)
+        for i in range(table.num_rows)
+    ]
+
+
+def assert_tables_equal(got: pa.Table, want: pa.Table,
+                        ignore_order: bool = True,
+                        approx_float: bool = False) -> None:
+    assert got.schema.names == want.schema.names, \
+        (got.schema.names, want.schema.names)
+    g = _rows(got, approx_float)
+    w = _rows(want, approx_float)
+    if ignore_order:
+        g, w = sorted(g), sorted(w)
+    assert g == w, f"\nTPU: {g[:10]}\nCPU: {w[:10]}"
+
+
+def assert_tpu_cpu_equal(df, ignore_order: bool = True,
+                         approx_float: bool = False) -> None:
+    tpu = df.collect(engine="tpu")
+    cpu = df.collect(engine="cpu")
+    assert_tables_equal(tpu, cpu, ignore_order, approx_float)
+
+
+# ---------------------------------------------------------------------- #
+# Random data generation (ref: data_gen.py)
+# ---------------------------------------------------------------------- #
+
+_WORDS = ["", "a", "ab", "ABC", "hello world", "ünïcode", "日本語テキスト",
+          "x" * 40, "NULL", "0", "-1", "spark", "rapids", "tpu"]
+
+
+def gen_table(spec: dict[str, str], n: int, seed: int = 0,
+              null_prob: float = 0.15) -> pa.Table:
+    """spec: name -> one of int8/int16/int32/int64/float32/float64/
+    bool/string/date/timestamp."""
+    rng = np.random.default_rng(seed)
+    arrays = {}
+    for name, kind in spec.items():
+        nulls = rng.random(n) < null_prob
+        if kind == "int64":
+            vals = rng.integers(-(2**40), 2**40, n, dtype=np.int64)
+            arr = pa.array(vals, pa.int64(), mask=nulls)
+        elif kind == "int32":
+            vals = rng.integers(-(2**28), 2**28, n, dtype=np.int64)
+            arr = pa.array(vals.astype(np.int32), pa.int32(), mask=nulls)
+        elif kind == "int16":
+            arr = pa.array(
+                rng.integers(-30000, 30000, n).astype(np.int16),
+                pa.int16(), mask=nulls)
+        elif kind == "int8":
+            arr = pa.array(rng.integers(-120, 120, n).astype(np.int8),
+                           pa.int8(), mask=nulls)
+        elif kind == "smallint64":  # small-range keys for joins/groups
+            arr = pa.array(rng.integers(0, 12, n, dtype=np.int64),
+                           pa.int64(), mask=nulls)
+        elif kind == "float64":
+            vals = rng.normal(0, 1e6, n)
+            special = rng.random(n)
+            vals = np.where(special < 0.05, np.nan, vals)
+            vals = np.where((special >= 0.05) & (special < 0.08),
+                            np.inf, vals)
+            vals = np.where((special >= 0.08) & (special < 0.10),
+                            -0.0, vals)
+            arr = pa.array(vals, pa.float64(), mask=nulls)
+        elif kind == "float32":
+            arr = pa.array(rng.normal(0, 100, n).astype(np.float32),
+                           pa.float32(), mask=nulls)
+        elif kind == "bool":
+            arr = pa.array(rng.random(n) < 0.5, pa.bool_(), mask=nulls)
+        elif kind == "string":
+            idx = rng.integers(0, len(_WORDS), n)
+            arr = pa.array([_WORDS[i] for i in idx], pa.string(),
+                           mask=nulls)
+        elif kind == "date":
+            arr = pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                           pa.int32(), mask=nulls).cast(pa.date32())
+        elif kind == "timestamp":
+            arr = pa.array(
+                rng.integers(0, 2**45, n, dtype=np.int64), pa.int64(),
+                mask=nulls).cast(pa.timestamp("us", tz="UTC"))
+        else:
+            raise ValueError(kind)
+        arrays[name] = arr
+    return pa.table(arrays)

@@ -69,9 +69,6 @@ def oracle(left, right, join_type):
         for i, r in enumerate(right):
             if not matched_r[i]:
                 out.append((None, None, r["rk"], r["rv"]))
-    if join_type == "right_outer":
-        out = [t for t in out if not (t[2] is not None and t[0] is None
-                                      and t[1] is None and False)]
     return sorted(out, key=lambda t: tuple((x is None, x) for x in t))
 
 
