@@ -19,10 +19,28 @@ import pyarrow.compute as pc
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.arrow import schema_to_arrow
 from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.exprs import bitwise as BW
+from spark_rapids_tpu.exprs import datetime as DT
+from spark_rapids_tpu.exprs import math as M
 from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import strings as S
 from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.exprs.cast import Cast
 from spark_rapids_tpu.exprs.hashing import Murmur3Hash
 from spark_rapids_tpu.plan import logical as L
+
+_PC_UNARY = {
+    M.Sqrt: pc.sqrt, M.Exp: pc.exp, M.Sin: pc.sin, M.Cos: pc.cos,
+    M.Tan: pc.tan, M.Asin: pc.asin, M.Acos: pc.acos, M.Atan: pc.atan,
+    M.Signum: pc.sign,
+}
+_NP_UNARY = {
+    M.Cbrt: np.cbrt, M.Expm1: np.expm1, M.Sinh: np.sinh,
+    M.Cosh: np.cosh, M.Tanh: np.tanh, M.Asinh: np.arcsinh,
+    M.Acosh: np.arccosh, M.Atanh: np.arctanh, M.Rint: np.rint,
+    M.ToDegrees: np.degrees, M.ToRadians: np.radians,
+    M.Cot: lambda d: 1.0 / np.tan(d),
+}
 
 
 # ---------------------------------------------------------------------- #
@@ -199,8 +217,244 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
     if isinstance(e, Murmur3Hash):
         return _murmur3_cpu(e, table, n)
 
-    raise NotImplementedError(
-        f"CPU engine: unsupported expression {type(e).__name__}")
+    out = _dispatch_extended(e, table, n)
+    if out is NotImplemented:
+        raise NotImplementedError(
+            f"CPU engine: unsupported expression {type(e).__name__}")
+    return out
+
+
+def _dispatch_extended(e, table, n):  # noqa: C901
+    # math ---------------------------------------------------------------- #
+    if type(e) in _PC_UNARY:
+        c = cpu_eval(e.child, table).cast(pa.float64())
+        return pc.cast(_PC_UNARY[type(e)](c), pa.float64())
+    if type(e) in _NP_UNARY:
+        c = cpu_eval(e.child, table).cast(pa.float64())
+        v, ok = _np_vals(c, pa.float64())
+        with np.errstate(all="ignore"):
+            return _from_np(_NP_UNARY[type(e)](v), ok, pa.float64())
+    if isinstance(e, M._LogBase):
+        c = cpu_eval(e.child, table).cast(pa.float64())
+        v, ok = _np_vals(c, pa.float64())
+        bad = v <= (-1.0 if isinstance(e, M.Log1p) else 0.0)
+        fn = {M.Log: np.log, M.Log10: np.log10, M.Log2: np.log2,
+              M.Log1p: np.log1p}[type(e)]
+        with np.errstate(all="ignore"):
+            return _from_np(fn(np.where(bad, 1.0, v)), ok & ~bad,
+                            pa.float64())
+    if isinstance(e, M.Logarithm):
+        b = cpu_eval(e.base, table).cast(pa.float64())
+        c = cpu_eval(e.child, table).cast(pa.float64())
+        bv, bok = _np_vals(b, pa.float64())
+        cv, cok = _np_vals(c, pa.float64())
+        bad = (cv <= 0) | (bv <= 0)
+        with np.errstate(all="ignore"):
+            out = np.log(np.where(cv <= 0, 1.0, cv)) / \
+                np.log(np.where(bv <= 0, 2.0, bv))
+        return _from_np(out, bok & cok & ~bad, pa.float64())
+    if isinstance(e, M.Pow):
+        l = cpu_eval(e.left, table).cast(pa.float64())
+        r = cpu_eval(e.right, table).cast(pa.float64())
+        return pc.power(l, r)
+    if isinstance(e, M.Ceil):  # Floor subclasses Ceil
+        c = cpu_eval(e.child, table)
+        if not pa.types.is_floating(c.type):
+            return c
+        fn = pc.floor if isinstance(e, M.Floor) else pc.ceil
+        return fn(c.cast(pa.float64())).cast(pa.int64())
+    if isinstance(e, M.Round):  # BRound subclasses Round
+        c = cpu_eval(e.child, table)
+        # Spark HALF_UP rounds half away from zero
+        mode = "half_to_even" if e.half_even else "half_towards_infinity"
+        if pa.types.is_floating(c.type):
+            return pc.round(c, ndigits=e.scale, round_mode=mode).cast(
+                c.type)
+        if e.scale >= 0:
+            return c
+        return pc.round(c, ndigits=e.scale, round_mode=mode).cast(c.type)
+
+    # bitwise ------------------------------------------------------------- #
+    if isinstance(e, BW.BitwiseBinary):
+        l, r = cpu_eval(e.left, table), cpu_eval(e.right, table)
+        at = T.to_arrow_type(e.dtype)
+        fn = {BW.BitwiseAnd: pc.bit_wise_and, BW.BitwiseOr: pc.bit_wise_or,
+              BW.BitwiseXor: pc.bit_wise_xor}[type(e)]
+        return fn(l.cast(at), r.cast(at))
+    if isinstance(e, BW.BitwiseNot):
+        return pc.bit_wise_not(cpu_eval(e.child, table))
+    if isinstance(e, BW.ShiftLeft):  # covers Right/RightUnsigned
+        l = cpu_eval(e.left, table)
+        r = cpu_eval(e.right, table)
+        bits = 64 if pa.types.is_int64(l.type) else 32
+        npdt = np.int64 if bits == 64 else np.int32
+        lv, lok = _np_vals(l, l.type)
+        rv, rok = _np_vals(r.cast(pa.int32()), pa.int32())
+        amount = rv.astype(npdt) & (bits - 1)
+        lv = lv.astype(npdt)
+        if isinstance(e, BW.ShiftRightUnsigned):
+            u = np.uint64 if bits == 64 else np.uint32
+            out = (lv.view(u) >> amount.astype(u)).view(npdt)
+        elif isinstance(e, BW.ShiftRight):
+            out = lv >> amount
+        else:
+            with np.errstate(over="ignore"):
+                out = lv << amount
+        return _from_np(out, lok & rok, l.type)
+
+    # datetime ------------------------------------------------------------ #
+    if isinstance(e, DT._DateField):
+        c = cpu_eval(e.child, table)
+        fns = {DT.Year: pc.year, DT.Month: pc.month,
+               DT.DayOfMonth: pc.day, DT.Quarter: pc.quarter,
+               DT.DayOfYear: pc.day_of_year}
+        if type(e) in fns:
+            return fns[type(e)](c).cast(pa.int32())
+        if isinstance(e, DT.DayOfWeek):
+            # Spark: Sunday=1..Saturday=7
+            return pc.add(pc.day_of_week(c, count_from_zero=True,
+                                         week_start=7), 1).cast(pa.int32())
+        if isinstance(e, DT.WeekDay):
+            return pc.day_of_week(c, count_from_zero=True,
+                                  week_start=1).cast(pa.int32())
+        return NotImplemented
+    if isinstance(e, DT.LastDay):
+        c = cpu_eval(e.child, table)
+        v, ok = _np_vals(c.cast(pa.int32()), pa.int32())
+        d = v.astype("datetime64[D]")
+        m = d.astype("datetime64[M]")
+        last = (m + 1).astype("datetime64[D]") - 1
+        return _from_np(last.astype(np.int32), ok,
+                        pa.int32()).cast(pa.date32())
+    if isinstance(e, (DT.DateAdd, DT.DateSub)):
+        l = cpu_eval(e.left, table).cast(pa.int32())
+        r = cpu_eval(e.right, table).cast(pa.int32())
+        sign = -1 if isinstance(e, DT.DateSub) else 1
+        out = pc.add(l, pc.multiply(r, sign))
+        return out.cast(pa.int32()).view(pa.date32())
+    if isinstance(e, DT.DateDiff):
+        l = cpu_eval(e.left, table).cast(pa.int32())
+        r = cpu_eval(e.right, table).cast(pa.int32())
+        return pc.subtract(l, r)
+    if isinstance(e, DT._TimeField):
+        c = cpu_eval(e.child, table)
+        fn = {DT.Hour: pc.hour, DT.Minute: pc.minute,
+              DT.Second: pc.second}[type(e)]
+        return fn(c).cast(pa.int32())
+    if isinstance(e, DT.UnixTimestampFromTs):
+        c = cpu_eval(e.child, table).cast(pa.int64())
+        v, ok = _np_vals(c, pa.int64())
+        return _from_np(v // 1_000_000, ok, pa.int64())
+
+    # cast ---------------------------------------------------------------- #
+    if isinstance(e, Cast):
+        return _cast_cpu(e, table, n)
+
+    # strings -------------------------------------------------------------- #
+    if isinstance(e, S.Length):
+        return pc.utf8_length(cpu_eval(e.child, table)).cast(pa.int32())
+    if isinstance(e, S.Upper):  # Lower subclasses Upper
+        c = cpu_eval(e.child, table)
+        return pc.utf8_lower(c) if isinstance(e, S.Lower) else \
+            pc.utf8_upper(c)
+    if isinstance(e, S.StartsWith):  # EndsWith/Contains subclass it
+        c = cpu_eval(e.left, table)
+        needle = e.right.value or ""
+        fn = {S.StartsWith: pc.starts_with, S.EndsWith: pc.ends_with,
+              S.Contains: pc.match_substring}[type(e)]
+        out = fn(c, pattern=needle)
+        rnull = e.right.value is None
+        if rnull:
+            return pa.nulls(n, pa.bool_())
+        return out
+    if isinstance(e, S.Like):
+        c = cpu_eval(e.left, table)
+        return pc.match_like(c, pattern=e.pattern)
+    if isinstance(e, S.Substring):
+        c = cpu_eval(e.child, table)
+        if e.pos > 0:
+            start = e.pos - 1
+            stop = None if e.length is None else start + max(e.length, 0)
+            return pc.utf8_slice_codeunits(c, start=start, stop=stop)
+        if e.pos == 0:
+            stop = None if e.length is None else max(e.length, 0)
+            return pc.utf8_slice_codeunits(c, start=0, stop=stop)
+        # negative pos: python oracle path.  Spark counts the length
+        # window from the UNCLAMPED start (substring('abc',-5,3)=='a')
+        out = []
+        for v in c.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            start = len(v) + e.pos
+            end = len(v) if e.length is None else start + max(e.length, 0)
+            out.append(v[max(start, 0):max(end, 0)])
+        return pa.array(out, pa.string())
+    if isinstance(e, S.StringTrim):
+        c = cpu_eval(e.child, table)
+        if isinstance(e, S.StringTrimLeft):
+            return pc.utf8_ltrim(c, characters=" ")
+        if isinstance(e, S.StringTrimRight):
+            return pc.utf8_rtrim(c, characters=" ")
+        return pc.utf8_trim(c, characters=" ")
+    if isinstance(e, S.Concat):
+        arrs = [cpu_eval(x, table) for x in e.exprs]
+        return pc.binary_join_element_wise(
+            *arrs, "", null_handling="emit_null")
+
+    return NotImplemented
+
+
+def _cast_cpu(e, table, n):
+    from spark_rapids_tpu.exprs.cast import Cast  # noqa: F401
+
+    src = e.child.dtype
+    dst = e.to
+    c = cpu_eval(e.child, table)
+    if src == dst:
+        return c
+    at = T.to_arrow_type(dst)
+    if isinstance(src, T.StringType):
+        return _cast_cpu_from_string(c, dst, at)
+    if isinstance(dst, T.StringType):
+        return pc.cast(c, pa.string())
+    if isinstance(dst, T.BooleanType):
+        return pc.not_equal(c, pa.scalar(0).cast(c.type))
+    if isinstance(src, T.BooleanType):
+        return pc.cast(c, at)
+    if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+        v, ok = _np_vals(c.cast(pa.int32()), pa.int32())
+        return _from_np(v.astype(np.int64) * 86_400_000_000, ok,
+                        pa.int64()).cast(at)
+    if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+        v, ok = _np_vals(c.cast(pa.int64()), pa.int64())
+        return _from_np((v // 86_400_000_000).astype(np.int32), ok,
+                        pa.int32()).cast(at)
+    if isinstance(src, T.TimestampType) and isinstance(dst, T.LongType):
+        v, ok = _np_vals(c.cast(pa.int64()), pa.int64())
+        return _from_np(v // 1_000_000, ok, pa.int64())
+    if isinstance(src, T.LongType) and isinstance(dst, T.TimestampType):
+        v, ok = _np_vals(c, pa.int64())
+        return _from_np(v * 1_000_000, ok, pa.int64()).cast(at)
+    npdt = T.to_numpy_dtype(dst)
+    if isinstance(src, (T.FloatType, T.DoubleType)) and \
+            isinstance(dst, T.IntegralType):
+        v, ok = _np_vals(c.cast(pa.float64()), pa.float64())
+        info = np.iinfo(npdt)
+        # float64 cannot represent int64 MAX exactly: saturate by
+        # threshold compare, never by clip-then-cast (which overflows)
+        hi_f = float(info.max) + 1.0  # exact power of two
+        lo_f = float(info.min)
+        t = np.trunc(np.where(np.isnan(v), 0.0, v))
+        interior = (t > lo_f) & (t < hi_f)
+        with np.errstate(invalid="ignore"):
+            res = np.where(interior, t, 0.0).astype(npdt)
+        res = np.where(t >= hi_f, info.max, res)
+        res = np.where(t <= lo_f, info.min, res)
+        return _from_np(res.astype(npdt), ok, at)
+    v, ok = _np_vals(c, c.type)
+    with np.errstate(over="ignore"):
+        return _from_np(v.astype(npdt), ok, at)
 
 
 def _np_java_mod(l, r):
@@ -474,6 +728,71 @@ def _spark_sortable(arr: pa.Array) -> pa.Array:
     bits = np.where(np.isnan(v), np.int64(0x7FF8000000000000), bits)
     keys = np.where(bits < 0, bits ^ np.int64(2**63 - 1), bits)
     return _from_np(keys, valid, pa.int64())
+
+
+_INT_RE = None
+
+
+def _cast_cpu_from_string(c: pa.Array, dst, at) -> pa.Array:
+    """Spark non-ANSI string casts: trim whitespace, NULL on malformed.
+    Strict ASCII-digit integer syntax (Python int() would accept '1_2'
+    and Unicode digits that Spark rejects)."""
+    global _INT_RE
+    import re
+
+    if _INT_RE is None:
+        _INT_RE = re.compile(r"^[+-]?[0-9]+$")
+    out = []
+    if isinstance(dst, T.IntegralType):
+        lo = np.iinfo(T.to_numpy_dtype(dst)).min
+        hi = np.iinfo(T.to_numpy_dtype(dst)).max
+        for v in c.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            s = v.strip()
+            if not _INT_RE.match(s):
+                out.append(None)
+                continue
+            iv = int(s)
+            out.append(iv if lo <= iv <= hi else None)
+        return pa.array(out, at)
+    if isinstance(dst, (T.FloatType, T.DoubleType)):
+        for v in c.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            s = v.strip()
+            try:
+                out.append(float(s))
+            except ValueError:
+                out.append(None)
+        return pa.array(out, at)
+    if isinstance(dst, T.BooleanType):
+        true_set = {"true", "t", "yes", "y", "1"}
+        false_set = {"false", "f", "no", "n", "0"}
+        for v in c.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            s = v.strip().lower()
+            out.append(True if s in true_set
+                       else False if s in false_set else None)
+        return pa.array(out, at)
+    if isinstance(dst, T.DateType):
+        import datetime as _dt
+
+        for v in c.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            s = v.strip()
+            try:
+                out.append(_dt.date.fromisoformat(s))
+            except ValueError:
+                out.append(None)
+        return pa.array(out, at)
+    raise NotImplementedError(f"CPU cast string -> {dst}")
 
 
 def _sort_cpu(plan: L.Sort) -> pa.Table:

@@ -135,6 +135,16 @@ class Expression:
 
         return Divide(_expr(self), _expr(other))
 
+    def __mod__(self, other):
+        from spark_rapids_tpu.exprs.arithmetic import Remainder
+
+        return Remainder(_expr(self), _expr(other))
+
+    def __neg__(self):
+        from spark_rapids_tpu.exprs.arithmetic import UnaryMinus
+
+        return UnaryMinus(_expr(self))
+
     def __and__(self, other):
         from spark_rapids_tpu.exprs.predicates import And
 

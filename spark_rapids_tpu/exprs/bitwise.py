@@ -1,0 +1,101 @@
+"""Bitwise and shift expressions (ref: sql-plugin/.../bitwise.scala).
+
+Shift semantics follow Java/Spark: the shift amount is masked by the
+value's bit width (x << 65 == x << 1 for longs), and >>> zero-fills."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column
+from spark_rapids_tpu.exprs.base import (
+    EvalContext,
+    Expression,
+    broadcast_validity,
+)
+
+
+@dataclasses.dataclass(repr=False)
+class BitwiseBinary(Expression):
+    left: Expression
+    right: Expression
+
+    fn = staticmethod(jnp.bitwise_and)
+
+    @property
+    def dtype(self) -> T.DataType:
+        ct = T.common_type(self.left.dtype, self.right.dtype)
+        if ct is None or not isinstance(ct, T.IntegralType):
+            raise TypeError("bitwise op requires integral operands")
+        return ct
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        phys = T.to_numpy_dtype(self.dtype)
+        out = type(self).fn(l.data.astype(phys), r.data.astype(phys))
+        return Column(out, broadcast_validity(l, r), self.dtype)
+
+
+class BitwiseAnd(BitwiseBinary):
+    fn = staticmethod(jnp.bitwise_and)
+
+
+class BitwiseOr(BitwiseBinary):
+    fn = staticmethod(jnp.bitwise_or)
+
+
+class BitwiseXor(BitwiseBinary):
+    fn = staticmethod(jnp.bitwise_xor)
+
+
+@dataclasses.dataclass(repr=False)
+class BitwiseNot(Expression):
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        return Column(~c.data, c.validity, self.dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class ShiftLeft(Expression):
+    left: Expression
+    right: Expression  # shift amount (int)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.left.dtype
+
+    def _bits(self) -> int:
+        return 64 if isinstance(self.left.dtype, T.LongType) else 32
+
+    def _shift(self, ld, amount):
+        return ld << amount
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        bits = self._bits()
+        phys = jnp.int64 if bits == 64 else jnp.int32
+        amount = r.data.astype(phys) & (bits - 1)  # Java masks the shift
+        out = self._shift(l.data.astype(phys), amount)
+        return Column(out, broadcast_validity(l, r), self.dtype)
+
+
+class ShiftRight(ShiftLeft):
+    def _shift(self, ld, amount):
+        return ld >> amount  # arithmetic (sign-propagating)
+
+
+class ShiftRightUnsigned(ShiftLeft):
+    def _shift(self, ld, amount):
+        u = jnp.uint64 if ld.dtype == jnp.int64 else jnp.uint32
+        return (ld.astype(u) >> amount.astype(u)).astype(ld.dtype)

@@ -1,0 +1,275 @@
+"""Math expressions.
+
+TPU counterparts of the reference's mathExpressions.scala (447 LoC).
+Spark semantics preserved where they differ from IEEE/numpy defaults:
+log-family functions return NULL (not NaN/-inf) for out-of-domain
+inputs, ceil/floor of doubles return LONG, round is HALF_UP while bround
+is HALF_EVEN (ref: GpuCeil/GpuFloor/GpuRound in mathExpressions.scala)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column
+from spark_rapids_tpu.exprs.base import (
+    EvalContext,
+    Expression,
+    broadcast_validity,
+)
+
+
+@dataclasses.dataclass(repr=False)
+class UnaryMath(Expression):
+    """double -> double elementwise function."""
+
+    child: Expression
+
+    fn = staticmethod(lambda d: d)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DOUBLE
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        d = c.data.astype(jnp.float64)
+        return Column(type(self).fn(d), c.validity, T.DOUBLE)
+
+
+class Sqrt(UnaryMath):
+    fn = staticmethod(jnp.sqrt)  # sqrt(neg) = NaN, as Spark
+
+
+class Cbrt(UnaryMath):
+    fn = staticmethod(jnp.cbrt)
+
+
+class Exp(UnaryMath):
+    fn = staticmethod(jnp.exp)
+
+
+class Expm1(UnaryMath):
+    fn = staticmethod(jnp.expm1)
+
+
+class Sin(UnaryMath):
+    fn = staticmethod(jnp.sin)
+
+
+class Cos(UnaryMath):
+    fn = staticmethod(jnp.cos)
+
+
+class Tan(UnaryMath):
+    fn = staticmethod(jnp.tan)
+
+
+class Cot(UnaryMath):
+    fn = staticmethod(lambda d: 1.0 / jnp.tan(d))
+
+
+class Asin(UnaryMath):
+    fn = staticmethod(jnp.arcsin)
+
+
+class Acos(UnaryMath):
+    fn = staticmethod(jnp.arccos)
+
+
+class Atan(UnaryMath):
+    fn = staticmethod(jnp.arctan)
+
+
+class Sinh(UnaryMath):
+    fn = staticmethod(jnp.sinh)
+
+
+class Cosh(UnaryMath):
+    fn = staticmethod(jnp.cosh)
+
+
+class Tanh(UnaryMath):
+    fn = staticmethod(jnp.tanh)
+
+
+class Asinh(UnaryMath):
+    fn = staticmethod(jnp.arcsinh)
+
+
+class Acosh(UnaryMath):
+    fn = staticmethod(jnp.arccosh)
+
+
+class Atanh(UnaryMath):
+    fn = staticmethod(jnp.arctanh)
+
+
+class Rint(UnaryMath):
+    fn = staticmethod(jnp.rint)
+
+
+class Signum(UnaryMath):
+    fn = staticmethod(jnp.sign)
+
+
+class ToDegrees(UnaryMath):
+    fn = staticmethod(jnp.degrees)
+
+
+class ToRadians(UnaryMath):
+    fn = staticmethod(jnp.radians)
+
+
+class _LogBase(UnaryMath):
+    """Spark log family: NULL for input <= 0 (ref: GpuLog et al apply an
+    is-not-<=0 mask — Spark returns NULL where math would give NaN/-inf)."""
+
+    _shift = 0.0  # log1p domain is > -1
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        d = c.data.astype(jnp.float64)
+        bad = d <= -self._shift if self._shift else d <= 0.0
+        safe = jnp.where(bad, 1.0, d)
+        return Column(type(self).fn(safe), c.validity & ~bad, T.DOUBLE)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+
+class Log(_LogBase):
+    fn = staticmethod(jnp.log)
+
+
+class Log10(_LogBase):
+    fn = staticmethod(jnp.log10)
+
+
+class Log2(_LogBase):
+    fn = staticmethod(jnp.log2)
+
+
+class Log1p(_LogBase):
+    fn = staticmethod(jnp.log1p)
+    _shift = 1.0
+
+
+@dataclasses.dataclass(repr=False)
+class Logarithm(Expression):
+    """log(base, x); NULL when x <= 0 or base <= 0."""
+
+    base: Expression
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DOUBLE
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        b = self.base.eval(ctx)
+        c = self.child.eval(ctx)
+        bd = b.data.astype(jnp.float64)
+        cd = c.data.astype(jnp.float64)
+        bad = (cd <= 0.0) | (bd <= 0.0)
+        out = jnp.log(jnp.where(cd <= 0, 1.0, cd)) / \
+            jnp.log(jnp.where(bd <= 0, 2.0, bd))
+        return Column(out, broadcast_validity(b, c) & ~bad, T.DOUBLE)
+
+
+@dataclasses.dataclass(repr=False)
+class Pow(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DOUBLE
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        out = jnp.power(l.data.astype(jnp.float64),
+                        r.data.astype(jnp.float64))
+        return Column(out, broadcast_validity(l, r), T.DOUBLE)
+
+
+@dataclasses.dataclass(repr=False)
+class Ceil(Expression):
+    """ceil(double) -> LONG (Spark), identity on integral types."""
+
+    child: Expression
+
+    _fn = staticmethod(jnp.ceil)
+
+    @property
+    def dtype(self) -> T.DataType:
+        if isinstance(self.child.dtype, (T.FloatType, T.DoubleType)):
+            return T.LONG
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        if not isinstance(self.child.dtype, (T.FloatType, T.DoubleType)):
+            return c
+        out = type(self)._fn(c.data.astype(jnp.float64)).astype(jnp.int64)
+        return Column(out, c.validity, T.LONG)
+
+
+class Floor(Ceil):
+    _fn = staticmethod(jnp.floor)
+
+
+@dataclasses.dataclass(repr=False)
+class Round(Expression):
+    """round(x, scale) HALF_UP (Spark GpuRound); bround is HALF_EVEN."""
+
+    child: Expression
+    scale: int = 0
+
+    half_even = False
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        dt = self.child.dtype
+        if isinstance(dt, (T.FloatType, T.DoubleType)):
+            d = c.data.astype(jnp.float64)
+            p = 10.0 ** self.scale
+            scaled = d * p
+            if self.half_even:
+                r = jnp.rint(scaled)
+            else:
+                # HALF_UP: away from zero at .5
+                r = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+            out = (r / p).astype(
+                jnp.float32 if isinstance(dt, T.FloatType) else jnp.float64)
+            return Column(out, c.validity, dt)
+        if self.scale >= 0:
+            return c
+        p = 10 ** (-self.scale)
+        d = c.data.astype(jnp.int64)
+        if self.half_even:
+            # floor-based: rem in [0, p) makes HALF_EVEN symmetric
+            q0 = d // p
+            rem = d - q0 * p
+            up = (rem * 2 > p) | ((rem * 2 == p) & (q0 % 2 != 0))
+            out = (q0 + up.astype(jnp.int64)) * p
+        else:
+            out = jnp.where(d >= 0, (d + p // 2) // p,
+                            -((-d + p // 2) // p)) * p
+        return Column(out.astype(c.data.dtype), c.validity, dt)
+
+
+class BRound(Round):
+    half_even = True

@@ -1,0 +1,422 @@
+"""String expressions over fixed-width byte matrices.
+
+TPU counterparts of stringFunctions.scala (976 LoC).  cudf walks ragged
+offset+chars buffers; here every op is a dense (rows, width) vectorized
+program:
+
+- char-indexed ops (length, substring) derive a per-byte *character
+  index* from UTF-8 start-byte detection (one cumsum);
+- byte re-layout ops (substring, concat, trim, pad) build output via
+  take_along_axis index arithmetic or a stable per-row argsort on a
+  drop flag — the row-local analog of the batch compaction trick;
+- case mapping decodes UTF-8 to codepoints and maps through a BMP
+  lookup table (built once from Python's casing rules).  Codepoints
+  whose case-mapped UTF-8 byte length differs (e.g. 'ß' -> 'SS') map to
+  themselves — a documented divergence, mirroring the reference's
+  unicode caveats (docs/compatibility.md "unicode case-change edge
+  cases"; the reference ships an incompatibleOps flag for the same
+  reason).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (
+    AnyColumn,
+    Column,
+    StringColumn,
+    pad_width,
+)
+from spark_rapids_tpu.exprs.base import (
+    EvalContext,
+    Expression,
+    Literal,
+    broadcast_validity,
+)
+
+
+def _is_char_start(chars: jax.Array) -> jax.Array:
+    """True for bytes that start a UTF-8 character (not 0b10xxxxxx)."""
+    return (chars & 0xC0) != 0x80
+
+
+def char_length(col: StringColumn) -> jax.Array:
+    pos = jnp.arange(col.width, dtype=jnp.int32)[None, :]
+    in_str = pos < col.lengths[:, None]
+    return jnp.sum((_is_char_start(col.chars) & in_str).astype(jnp.int32),
+                   axis=1)
+
+
+@dataclasses.dataclass(repr=False)
+class Length(Expression):
+    """character_length (ref: GpuLength — char count, not bytes)."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.INT
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        return Column(char_length(c), c.validity, T.INT)
+
+
+# ---------------------------------------------------------------------- #
+# Case mapping
+# ---------------------------------------------------------------------- #
+
+@lru_cache(maxsize=2)
+def _case_table(upper: bool) -> np.ndarray:
+    """BMP codepoint -> cased codepoint, restricted to mappings that
+    preserve UTF-8 byte length (others map to themselves)."""
+    tbl = np.arange(0x10000, dtype=np.int32)
+    for cp in range(0x10000):
+        if 0xD800 <= cp <= 0xDFFF:  # surrogates are not characters
+            continue
+        ch = chr(cp)
+        m = ch.upper() if upper else ch.lower()
+        if len(m) == 1 and ord(m) < 0x10000:
+            if len(m.encode("utf-8")) == len(ch.encode("utf-8")):
+                tbl[cp] = ord(m)
+    return tbl
+
+
+def _decode_codepoints(chars: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-byte (codepoint_of_its_char, is_start).  3-byte max (BMP);
+    4-byte sequences pass through unmapped."""
+    c = chars.astype(jnp.int32)
+    start = _is_char_start(chars)
+    b0 = c
+    b1 = jnp.pad(c[:, 1:], ((0, 0), (0, 1)))
+    b2 = jnp.pad(c[:, 2:], ((0, 0), (0, 2)))
+    cp1 = b0
+    cp2 = ((b0 & 0x1F) << 6) | (b1 & 0x3F)
+    cp3 = ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6) | (b2 & 0x3F)
+    cp = jnp.where(b0 < 0x80, cp1,
+                   jnp.where(b0 < 0xE0, cp2,
+                             jnp.where(b0 < 0xF0, cp3, -1)))
+    return jnp.where(start, cp, -1), start
+
+
+def _encode_inplace(chars: jax.Array, mapped_cp: jax.Array,
+                    start: jax.Array) -> jax.Array:
+    """Re-encode mapped codepoints over the same byte layout (same-length
+    mappings only, enforced by the table)."""
+    c = chars.astype(jnp.int32)
+    one = (mapped_cp >= 0) & (mapped_cp < 0x80) & start
+    two = (mapped_cp >= 0x80) & (mapped_cp < 0x800) & start
+    three = (mapped_cp >= 0x800) & start
+    out = c
+    out = jnp.where(one, mapped_cp, out)
+    out = jnp.where(two, 0xC0 | (mapped_cp >> 6), out)
+    out = jnp.where(three, 0xE0 | (mapped_cp >> 12), out)
+    # continuation bytes: recompute from the char's codepoint
+    prev_cp = jnp.full_like(mapped_cp, -1)
+    cum_cp = jax.lax.associative_scan(
+        lambda a, b: jnp.where(b >= 0, b, a),
+        jnp.where(start, mapped_cp, -1), axis=1)
+    # byte offset within char: distance from char start
+    pos = jnp.arange(chars.shape[1], dtype=jnp.int32)[None, :]
+    start_pos = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(start, pos, -1), axis=1)
+    off = pos - start_pos
+    cp_here = cum_cp
+    cont1 = (~start) & (off == 1)
+    cont2 = (~start) & (off == 2)
+    is3 = cp_here >= 0x800
+    out = jnp.where(cont1 & is3, 0x80 | ((cp_here >> 6) & 0x3F), out)
+    out = jnp.where(cont1 & ~is3 & (cp_here >= 0x80),
+                    0x80 | (cp_here & 0x3F), out)
+    out = jnp.where(cont2 & is3, 0x80 | (cp_here & 0x3F), out)
+    return out.astype(jnp.uint8)
+
+
+@dataclasses.dataclass(repr=False)
+class Upper(Expression):
+    child: Expression
+
+    _upper = True
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        tbl = jnp.asarray(_case_table(self._upper))
+        cp, start = _decode_codepoints(c.chars)
+        safe_cp = jnp.clip(cp, 0, 0xFFFF)
+        mapped = jnp.where((cp >= 0) & (cp < 0x10000),
+                           jnp.take(tbl, safe_cp), cp)
+        chars = _encode_inplace(c.chars, mapped, start)
+        # zero out padding bytes again
+        pos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        chars = jnp.where(pos < c.lengths[:, None], chars, 0)
+        return StringColumn(chars, c.lengths, c.validity)
+
+
+class Lower(Upper):
+    _upper = False
+
+
+# ---------------------------------------------------------------------- #
+# Search (literal needles, like the reference's lit-only TypeSigs)
+# ---------------------------------------------------------------------- #
+
+def _needle_bytes(e: Expression) -> bytes:
+    assert isinstance(e, Literal), "needle must be a literal"
+    return (e.value or "").encode("utf-8")
+
+
+@dataclasses.dataclass(repr=False)
+class StartsWith(Expression):
+    left: Expression
+    right: Expression  # literal
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def _match(self, c: StringColumn, nb: bytes) -> jax.Array:
+        m = len(nb)
+        if m == 0:
+            return jnp.ones((c.capacity,), bool)
+        if m > c.width:
+            return jnp.zeros((c.capacity,), bool)
+        needle = jnp.asarray(np.frombuffer(nb, np.uint8))
+        return (c.lengths >= m) & jnp.all(
+            c.chars[:, :m] == needle[None, :], axis=1)
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        out = self._match(c, _needle_bytes(self.right))
+        return Column(out, broadcast_validity(c, r), T.BOOLEAN)
+
+
+class EndsWith(StartsWith):
+    def _match(self, c: StringColumn, nb: bytes) -> jax.Array:
+        m = len(nb)
+        if m == 0:
+            return jnp.ones((c.capacity,), bool)
+        if m > c.width:
+            return jnp.zeros((c.capacity,), bool)
+        needle = jnp.asarray(np.frombuffer(nb, np.uint8))
+        # gather the last m bytes of each row
+        start = jnp.maximum(c.lengths - m, 0)
+        idx = start[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
+        tail = jnp.take_along_axis(
+            c.chars, jnp.clip(idx, 0, c.width - 1), axis=1)
+        return (c.lengths >= m) & jnp.all(tail == needle[None, :], axis=1)
+
+
+class Contains(StartsWith):
+    def _match(self, c: StringColumn, nb: bytes) -> jax.Array:
+        m = len(nb)
+        if m == 0:
+            return jnp.ones((c.capacity,), bool)
+        if m > c.width:
+            return jnp.zeros((c.capacity,), bool)
+        needle = jnp.asarray(np.frombuffer(nb, np.uint8))
+        # compare all windows (W - m + 1 shifted equality tests, fused)
+        hit = jnp.zeros((c.capacity,), bool)
+        for off in range(c.width - m + 1):
+            w = c.chars[:, off:off + m]
+            hit = hit | ((c.lengths >= off + m)
+                         & jnp.all(w == needle[None, :], axis=1))
+        return hit
+
+
+@dataclasses.dataclass(repr=False)
+class Like(Expression):
+    """SQL LIKE for simple patterns (%x, x%, %x%, exact, and
+    'a%b' prefix+suffix).  Patterns with '_' or more embedded '%'s fail
+    check_supported() and the planner falls back to the CPU engine's
+    full match_like (the reference likewise refuses regex-like patterns,
+    GpuOverrides.scala:440-473)."""
+
+    left: Expression
+    pattern: str
+
+    def check_supported(self) -> None:
+        p = self.pattern
+        if "_" in p:
+            raise TypeError("LIKE with '_' not supported on TPU")
+        inner = p.strip("%")
+        if "%" in inner and len(inner.split("%")) != 2:
+            raise TypeError(f"LIKE pattern {p!r} not supported on TPU")
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        self.check_supported()
+        c = self.left.eval(ctx)
+        assert isinstance(c, StringColumn)
+        p = self.pattern
+        lead = p.startswith("%")
+        trail = p.endswith("%")
+        inner = p.strip("%")
+        lit_ = Literal.of(inner, T.STRING)
+        if "%" in inner:  # 'a%b': prefix + suffix, lengths must fit
+            pre, suf = inner.split("%")
+            m1 = StartsWith(self.left, Literal.of(pre, T.STRING))._match(
+                c, pre.encode())
+            m2 = EndsWith(self.left, Literal.of(suf, T.STRING))._match(
+                c, suf.encode())
+            fit = c.lengths >= len(pre.encode()) + len(suf.encode())
+            out = m1 & m2 & fit
+        elif lead and trail:
+            out = Contains(self.left, lit_)._match(c, inner.encode())
+        elif trail:
+            out = StartsWith(self.left, lit_)._match(c, inner.encode())
+        elif lead:
+            out = EndsWith(self.left, lit_)._match(c, inner.encode())
+        else:
+            nb = inner.encode()
+            out = StartsWith(self.left, lit_)._match(c, nb) & (
+                c.lengths == len(nb))
+        return Column(out, c.validity, T.BOOLEAN)
+
+
+# ---------------------------------------------------------------------- #
+# Re-layout ops
+# ---------------------------------------------------------------------- #
+
+def _compact_rows(chars: jax.Array, keep: jax.Array
+                  ) -> tuple[jax.Array, jax.Array]:
+    """Left-pack kept bytes within each row (stable), zero the rest."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(chars, order, axis=1)
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    pos = jnp.arange(chars.shape[1], dtype=jnp.int32)[None, :]
+    packed = jnp.where(pos < new_len[:, None], packed, 0)
+    return packed, new_len
+
+
+@dataclasses.dataclass(repr=False)
+class Substring(Expression):
+    """substring(str, pos, len) — 1-based, char-indexed, negative pos
+    from the end (ref: GpuSubstring)."""
+
+    child: Expression
+    pos: int
+    length: Optional[int] = None
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        nchars = char_length(c)
+        pos = self.pos
+        # Spark substringSQL: the length window counts from the
+        # *unclamped* start (substring('abc', -5, 3) == 'a')
+        if pos > 0:
+            start = jnp.full_like(nchars, pos - 1)
+        elif pos == 0:
+            start = jnp.zeros_like(nchars)
+        else:
+            start = nchars + pos
+        if self.length is None:
+            end = nchars
+        else:
+            end = start + max(self.length, 0)
+        start = jnp.maximum(start, 0)
+        bpos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        in_str = bpos < c.lengths[:, None]
+        char_idx = jnp.cumsum(
+            (_is_char_start(c.chars) & in_str).astype(jnp.int32),
+            axis=1) - 1
+        keep = in_str & (char_idx >= start[:, None]) & \
+            (char_idx < end[:, None])
+        chars, lengths = _compact_rows(c.chars, keep)
+        return StringColumn(chars, lengths, c.validity)
+
+
+@dataclasses.dataclass(repr=False)
+class StringTrim(Expression):
+    """trim(str): strip leading+trailing spaces (0x20, Spark default)."""
+
+    child: Expression
+
+    _lead = True
+    _trail = True
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        pos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        in_str = pos < c.lengths[:, None]
+        sp = (c.chars == 32) & in_str
+        keep = in_str
+        if self._lead:
+            lead_run = jnp.cumprod(sp.astype(jnp.int32), axis=1)
+            keep = keep & (lead_run == 0)
+        if self._trail:
+            rev = (sp | ~in_str)[:, ::-1]
+            trail_run = jnp.cumprod(rev.astype(jnp.int32), axis=1)[:, ::-1]
+            keep = keep & (trail_run == 0)
+        chars, lengths = _compact_rows(c.chars, keep)
+        return StringColumn(chars, lengths, c.validity)
+
+
+class StringTrimLeft(StringTrim):
+    _trail = False
+
+
+class StringTrimRight(StringTrim):
+    _lead = False
+
+
+@dataclasses.dataclass(repr=False)
+class Concat(Expression):
+    """concat(s1, s2, ...): NULL if any input NULL (Spark concat)."""
+
+    exprs: tuple[Expression, ...]
+
+    def __init__(self, *exprs: Expression):
+        self.exprs = tuple(exprs)
+
+    def with_children(self, children):
+        return Concat(*children)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cols = [e.eval(ctx) for e in self.exprs]
+        total_w = pad_width(sum(c.width for c in cols))
+        n = cols[0].capacity
+        out_pos = jnp.arange(total_w, dtype=jnp.int32)[None, :]
+        chars = jnp.zeros((n, total_w), jnp.uint8)
+        offset = jnp.zeros((n,), jnp.int32)
+        valid = None
+        for c in cols:
+            src_idx = out_pos - offset[:, None]
+            in_src = (src_idx >= 0) & (src_idx < c.lengths[:, None])
+            gathered = jnp.take_along_axis(
+                c.chars, jnp.clip(src_idx, 0, c.width - 1), axis=1)
+            chars = jnp.where(in_src, gathered, chars)
+            offset = offset + c.lengths
+            valid = c.validity if valid is None else (valid & c.validity)
+        return StringColumn(chars, offset, valid)

@@ -47,6 +47,12 @@ def register_expr(cls: type) -> None:
     SUPPORTED_EXPRS[cls] = entry
 
 
+from spark_rapids_tpu.exprs import bitwise as BW  # noqa: E402
+from spark_rapids_tpu.exprs import datetime as DT  # noqa: E402
+from spark_rapids_tpu.exprs import math as M  # noqa: E402
+from spark_rapids_tpu.exprs import strings as S  # noqa: E402
+from spark_rapids_tpu.exprs.cast import Cast  # noqa: E402
+
 for _cls in (
     B.Alias, B.BoundReference, B.ColumnReference, B.Literal,
     A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
@@ -56,6 +62,25 @@ for _cls in (
     P.GreaterThanOrEqual, P.EqualNullSafe, P.And, P.Or, P.Not,
     P.IsNull, P.IsNotNull, P.IsNaN, P.In, P.Coalesce, P.If, P.CaseWhen,
     P.AtLeastNNonNulls, Murmur3Hash,
+    # math
+    M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Cot,
+    M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh, M.Acosh,
+    M.Atanh, M.Rint, M.Signum, M.ToDegrees, M.ToRadians,
+    M.Log, M.Log10, M.Log2, M.Log1p, M.Logarithm, M.Pow, M.Ceil,
+    M.Floor, M.Round, M.BRound,
+    # bitwise
+    BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
+    BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned,
+    # datetime
+    DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
+    DT.DayOfYear, DT.Quarter, DT.LastDay, DT.Hour, DT.Minute, DT.Second,
+    DT.DateAdd, DT.DateSub, DT.DateDiff, DT.UnixTimestampFromTs,
+    # strings
+    S.Length, S.Upper, S.Lower, S.StartsWith, S.EndsWith, S.Contains,
+    S.Like, S.Substring, S.StringTrim, S.StringTrimLeft,
+    S.StringTrimRight, S.Concat,
+    # cast
+    Cast,
 ):
     register_expr(_cls)
 
@@ -82,6 +107,14 @@ def _check_expr(e: B.Expression, conf, reasons: set[str]) -> None:
     elif not conf.get(entry):
         reasons.add(
             f"expression {type(e).__name__} disabled by {entry.key}")
+    # expressions with data-dependent support (Cast matrix, Like
+    # patterns) expose check_supported(); a raise becomes a reason
+    check = getattr(e, "check_supported", None)
+    if check is not None:
+        try:
+            check()
+        except TypeError as exc:
+            reasons.add(str(exc))
     for c in e.children:
         _check_expr(c, conf, reasons)
 
