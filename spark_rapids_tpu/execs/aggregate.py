@@ -192,7 +192,35 @@ class TpuHashAggregateExec(TpuExec):
             self._jit_merge = jax.jit(self._merge_batch)
             self._jit_finalize = jax.jit(self._finalize_batch)
 
-        pending: list[ColumnarBatch] = []
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
+
+        store = get_store()
+        # pending partials are spillable between merges (the reference
+        # plans the same: aggregate.scala:378-386 spill-of-running-agg)
+        pending: list = []  # SpillableBatch handles
+        pending_rows = 0
+
+        def drain_pending() -> ColumnarBatch:
+            batches = [h.get() for h in pending]
+            out = batches[0] if len(batches) == 1 \
+                else concat_batches(batches)
+            for h in pending:
+                h.close()
+            pending.clear()
+            return out
+
+        try:
+            yield from self._execute_inner(store, pending, drain_pending)
+        finally:
+            # a raise (or generator close) anywhere above must not leak
+            # registrations into the process-global store
+            for h in pending:
+                h.close()
+            pending.clear()
+
+    def _execute_inner(self, store, pending, drain_pending):
+        from spark_rapids_tpu.memory import SpillPriorities
+
         pending_rows = 0
         for batch in self.children[0].execute():
             with MetricTimer(self.metrics[TOTAL_TIME]):
@@ -200,31 +228,35 @@ class TpuHashAggregateExec(TpuExec):
                     part = _as_device_rows(batch)  # already partial layout
                 else:
                     part = self._jit_update(_as_device_rows(batch))
-            pending.append(part)
-            pending_rows += part.concrete_num_rows()
+            n = part.concrete_num_rows()
+            pending.append(store.register(
+                part, SpillPriorities.AGGREGATE_PARTIAL))
+            pending_rows += n
             if len(pending) > 1 and pending_rows >= self.goal_rows:
                 with MetricTimer(self.metrics[TOTAL_TIME]):
                     merged = self._jit_merge(
-                        _as_device_rows(concat_batches(pending)))
+                        _as_device_rows(drain_pending()))
                 self.metrics["numMerges"].add(1)
-                pending = [merged]
-                pending_rows = merged.concrete_num_rows()
+                pending_rows = merged.concrete_num_rows()  # before register:
+                # a register under pressure may immediately spill `merged`
+                pending.append(store.register(
+                    merged, SpillPriorities.AGGREGATE_PARTIAL))
 
         if not pending:
             if self.n_keys > 0:
                 return  # grouped aggregate of empty input: no rows
             # grand aggregate of empty input: one default row
             eb = ColumnarBatch.empty(self.children[0].schema)
-            if self.mode == "final":
-                pending = [eb]
-            else:
-                pending = [self._jit_update(_as_device_rows(eb))]
+            if self.mode != "final":
+                eb = self._jit_update(_as_device_rows(eb))
+            pending.append(store.register(
+                eb, SpillPriorities.AGGREGATE_PARTIAL))
 
         with MetricTimer(self.metrics[TOTAL_TIME]):
-            merged = pending[0] if len(pending) == 1 else None
-            if merged is None or self.mode in ("final",):
-                merged = self._jit_merge(
-                    _as_device_rows(concat_batches(pending)))
+            single = len(pending) == 1
+            merged = drain_pending()
+            if not single or self.mode == "final":
+                merged = self._jit_merge(_as_device_rows(merged))
             if self.mode == "partial":
                 out = merged
             else:

@@ -100,11 +100,22 @@ class TpuShuffledHashJoinExec(TpuExec):
     # ------------------------------------------------------------------ #
 
     def _collect_build(self) -> Optional[ColumnarBatch]:
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
+
         child = self.children[1] if self.build_is_right else self.children[0]
-        batches = list(child.execute())
-        if not batches:
-            return None
-        b = batches[0] if len(batches) == 1 else concat_batches(batches)
+        store = get_store()
+        handles = []
+        try:
+            for bb in child.execute():
+                handles.append(store.register(
+                    bb, SpillPriorities.JOIN_BUILD))
+            if not handles:
+                return None
+            batches = [h.get() for h in handles]
+            b = batches[0] if len(batches) == 1 else concat_batches(batches)
+        finally:
+            for h in handles:
+                h.close()
         self.metrics["buildRows"].add(b.concrete_num_rows())
         return b
 

@@ -82,10 +82,26 @@ class TpuSortExec(_SortMixin):
 
     def execute(self) -> Iterator[ColumnarBatch]:
         if self.global_sort:
-            batches = list(self.children[0].execute())
-            if not batches:
-                return
-            big = batches[0] if len(batches) == 1 else concat_batches(batches)
+            # collected input registers with the spill store so a
+            # larger-than-HBM collection degrades to host/disk instead
+            # of OOM (ref: GpuOutOfCoreSortIterator's spillable pending
+            # queues, GpuSortExec.scala:213)
+            from spark_rapids_tpu.memory import SpillPriorities, get_store
+
+            store = get_store()
+            handles = []
+            try:
+                for b in self.children[0].execute():
+                    handles.append(store.register(
+                        b, SpillPriorities.COALESCE_PENDING))
+                if not handles:
+                    return
+                batches = [h.get() for h in handles]
+                big = batches[0] if len(batches) == 1 \
+                    else concat_batches(batches)
+            finally:
+                for h in handles:
+                    h.close()
             with MetricTimer(self.metrics[TOTAL_TIME]):
                 out = self._jit_sorted(big.with_device_num_rows())
             yield self._count_output(out)

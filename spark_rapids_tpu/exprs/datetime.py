@@ -110,7 +110,8 @@ class DayOfWeek(_DateField):
     """Spark: Sunday=1 .. Saturday=7.  1970-01-01 was a Thursday."""
 
     def _field(self, days):
-        return ((days.astype(jnp.int64) + 4) % 7 + 7) % 7 + 1
+        return ((((days.astype(jnp.int64) + 4) % 7 + 7) % 7 + 1)
+                .astype(jnp.int32))
 
 
 class WeekDay(_DateField):

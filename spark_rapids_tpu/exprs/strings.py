@@ -120,17 +120,19 @@ def _encode_inplace(chars: jax.Array, mapped_cp: jax.Array,
     out = jnp.where(one, mapped_cp, out)
     out = jnp.where(two, 0xC0 | (mapped_cp >> 6), out)
     out = jnp.where(three, 0xE0 | (mapped_cp >> 12), out)
-    # continuation bytes: recompute from the char's codepoint
-    prev_cp = jnp.full_like(mapped_cp, -1)
-    cum_cp = jax.lax.associative_scan(
-        lambda a, b: jnp.where(b >= 0, b, a),
-        jnp.where(start, mapped_cp, -1), axis=1)
-    # byte offset within char: distance from char start
+    # continuation bytes: recompute from *this* char's codepoint.  Chars
+    # with no mapping (4-byte sequences, cp == -1) carry the -2 marker so
+    # their continuation bytes pass through untouched — a plain
+    # last-valid-value scan would leak the previous char's codepoint
+    # into them and corrupt the UTF-8
+    tag = jnp.where(start,
+                    jnp.where(mapped_cp >= 0, mapped_cp, -2), -3)
+    cp_here = jax.lax.associative_scan(
+        lambda a, b: jnp.where(b != -3, b, a), tag, axis=1)
     pos = jnp.arange(chars.shape[1], dtype=jnp.int32)[None, :]
     start_pos = jax.lax.associative_scan(
         jnp.maximum, jnp.where(start, pos, -1), axis=1)
     off = pos - start_pos
-    cp_here = cum_cp
     cont1 = (~start) & (off == 1)
     cont2 = (~start) & (off == 2)
     is3 = cp_here >= 0x800
@@ -253,6 +255,8 @@ class Like(Expression):
         p = self.pattern
         if "_" in p:
             raise TypeError("LIKE with '_' not supported on TPU")
+        if "\\" in p:
+            raise TypeError("LIKE with escapes not supported on TPU")
         inner = p.strip("%")
         if "%" in inner and len(inner.split("%")) != 2:
             raise TypeError(f"LIKE pattern {p!r} not supported on TPU")

@@ -1,0 +1,21 @@
+"""Device & memory runtime (SURVEY.md L1).
+
+TPU re-design of the reference's tiered buffer stores
+(RapidsBufferCatalog / Rapids{Device,Host,Disk}MemoryStore /
+DeviceMemoryEventHandler, SURVEY.md §2.4).  The reference reacts to RMM
+allocation failures; XLA/PJRT exposes no alloc-failure callback, so the
+TPU design is a *proactive budget manager*: operators register their
+resident batches, reserve budget before materializing new ones, and the
+store synchronously spills lowest-priority buffers down the
+DEVICE -> HOST -> DISK chain to make room (SURVEY.md §7 hard-part #3).
+"""
+
+from spark_rapids_tpu.memory.store import (  # noqa: F401
+    BufferStore,
+    SpillableBatch,
+    SpillPriorities,
+    StorageTier,
+    get_store,
+    reset_store,
+)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore  # noqa: F401

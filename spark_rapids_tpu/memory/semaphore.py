@@ -1,0 +1,59 @@
+"""Task admission semaphore.
+
+Counterpart of GpuSemaphore (ref: sql-plugin/.../GpuSemaphore.scala:27,
+74): caps how many concurrent tasks may hold device batches, preventing
+HBM oversubscription when the scheduler runs partitions on a thread
+pool.  On TPU a core runs one program at a time anyway, so the semaphore
+guards *memory residency*, not kernel concurrency — acquired on first
+batch materialization, released at task end (same protocol as the
+reference)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.BoundedSemaphore(permits)
+        self._holders: set[int] = set()
+        self._holders_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                from spark_rapids_tpu.config import (
+                    CONCURRENT_TPU_TASKS,
+                    get_conf,
+                )
+
+                cls._instance = TpuSemaphore(
+                    get_conf().get(CONCURRENT_TPU_TASKS))
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def acquire_if_necessary(self, task_id: int) -> None:
+        """Idempotent per task (ref: GpuSemaphore.acquireIfNecessary)."""
+        with self._holders_lock:
+            if task_id in self._holders:
+                return
+        self._sem.acquire()
+        with self._holders_lock:
+            self._holders.add(task_id)
+
+    def release_if_necessary(self, task_id: int) -> None:
+        with self._holders_lock:
+            if task_id not in self._holders:
+                return
+            self._holders.discard(task_id)
+        self._sem.release()

@@ -1,0 +1,345 @@
+"""Spill-tiered buffer store.
+
+Maps the reference's architecture onto JAX/TPU:
+
+- `StorageTier` DEVICE/HOST/DISK (ref: RapidsBuffer.scala:53-58; the GDS
+  tier has no TPU analog and is dropped);
+- `SpillableBatch` = SpillableColumnarBatch: a handle that lets the
+  store move the batch down-tier while unused; `.get()` re-materializes
+  on device (ref: SpillableColumnarBatch.scala:29);
+- `BufferStore` = RapidsBufferCatalog + the per-tier stores: one
+  priority-ordered registry with byte accounting per tier
+  (ref: RapidsBufferStore.scala:145-207 synchronousSpill);
+- `reserve()` replaces DeviceMemoryEventHandler.onAllocFailure: callers
+  reserve device bytes *before* materializing, and the store spills
+  lowest-priority resident buffers until the budget fits (proactive —
+  XLA has no alloc-failure hook);
+- spill priorities (ref: SpillPriorities.scala): exchange outputs spill
+  first, active working batches last.
+
+Device -> host movement is `jax.device_get` + explicit `.delete()` on
+the device arrays (deterministic HBM release); host -> disk is a .npz
+file in the configured spill directory."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import tempfile
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
+from spark_rapids_tpu.config import register
+
+
+class StorageTier(enum.IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class SpillPriorities:
+    """Lower value spills first (ref: SpillPriorities.scala:26-60)."""
+
+    OUTPUT_FOR_SHUFFLE = -100
+    COALESCE_PENDING = 0
+    AGGREGATE_PARTIAL = 50
+    JOIN_BUILD = 80
+    ACTIVE_ON_DECK = 100
+
+
+HBM_BUDGET_BYTES = register(
+    "spark.rapids.tpu.memory.hbm.budgetBytes", 12 << 30,
+    "Device-memory budget the buffer store manages batches within "
+    "(ref: spark.rapids.memory.gpu.pool sizing, RapidsConf.scala:413). "
+    "Proactive: reservations beyond this trigger synchronous spill.")
+HOST_SPILL_BYTES = register(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 4 << 30,
+    "Host-memory bound for spilled batches before they continue to disk "
+    "(ref: spark.rapids.memory.host.spillStorageSize, "
+    "RapidsConf.scala:357).")
+SPILL_DIR = register(
+    "spark.rapids.tpu.memory.spill.dir", "",
+    "Directory for disk-tier spill files (default: a temp dir).")
+
+
+def batch_device_bytes(batch: ColumnarBatch) -> int:
+    total = 0
+    for c in batch.columns:
+        if isinstance(c, StringColumn):
+            total += c.chars.size * 1 + c.lengths.size * 4 + c.validity.size
+        else:
+            total += c.data.size * c.data.dtype.itemsize + c.validity.size
+    if not isinstance(batch.num_rows, int):
+        total += 4
+    return total
+
+
+def _batch_to_host(batch: ColumnarBatch) -> dict:
+    """Materialize to numpy and DELETE the device buffers."""
+    arrays: dict[str, np.ndarray] = {}
+    n = batch.concrete_num_rows()
+    for i, c in enumerate(batch.columns):
+        if isinstance(c, StringColumn):
+            arrays[f"c{i}_chars"] = np.asarray(jax.device_get(c.chars))
+            arrays[f"c{i}_lengths"] = np.asarray(jax.device_get(c.lengths))
+            arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
+            for a in (c.chars, c.lengths, c.validity):
+                _delete(a)
+        else:
+            arrays[f"c{i}_data"] = np.asarray(jax.device_get(c.data))
+            arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
+            for a in (c.data, c.validity):
+                _delete(a)
+    arrays["__num_rows"] = np.asarray(n, np.int64)
+    return arrays
+
+
+def _delete(a) -> None:
+    if isinstance(a, jax.Array):
+        try:
+            a.delete()
+        except Exception:
+            pass  # already consumed/donated
+
+
+def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:
+    import jax.numpy as jnp
+
+    cols: list[AnyColumn] = []
+    for i, f in enumerate(schema.fields):
+        if isinstance(f.dtype, T.StringType):
+            cols.append(StringColumn(
+                jnp.asarray(arrays[f"c{i}_chars"]),
+                jnp.asarray(arrays[f"c{i}_lengths"]),
+                jnp.asarray(arrays[f"c{i}_valid"])))
+        else:
+            cols.append(Column(jnp.asarray(arrays[f"c{i}_data"]),
+                               jnp.asarray(arrays[f"c{i}_valid"]),
+                               f.dtype))
+    return ColumnarBatch(cols, int(arrays["__num_rows"]), schema)
+
+
+def _host_bytes(arrays: dict) -> int:
+    return int(sum(a.nbytes for a in arrays.values()))
+
+
+@dataclasses.dataclass
+class _Entry:
+    buffer_id: int
+    priority: int
+    nbytes: int
+    tier: StorageTier
+    batch: Optional[ColumnarBatch]  # DEVICE tier
+    host: Optional[dict]  # HOST tier
+    path: Optional[str]  # DISK tier
+    schema: T.Schema
+    #: pinned entries are in active use and must not be evicted — an
+    #: acquire() that spills an already-acquired sibling would delete
+    #: device arrays the caller still holds
+    pinned: bool = False
+
+
+class SpillableBatch:
+    """Handle registering a device batch with the store so it may spill
+    while not in active use.  `get()` returns a device-resident batch,
+    re-materializing (and re-registering at DEVICE) if spilled."""
+
+    def __init__(self, store: "BufferStore", buffer_id: int):
+        self._store = store
+        self.buffer_id = buffer_id
+
+    def get(self) -> ColumnarBatch:
+        """Acquire device-resident (pins the buffer until unpin/close)."""
+        return self._store.acquire(self.buffer_id)
+
+    def unpin(self) -> None:
+        """Make the buffer spillable again (caller dropped its batch
+        reference)."""
+        with self._store._lock:
+            e = self._store._entries.get(self.buffer_id)
+            if e is not None:
+                e.pinned = False
+
+    @property
+    def tier(self) -> StorageTier:
+        return self._store._entries[self.buffer_id].tier
+
+    @property
+    def nbytes(self) -> int:
+        return self._store._entries[self.buffer_id].nbytes
+
+    def close(self) -> None:
+        self._store.remove(self.buffer_id)
+
+
+class BufferStore:
+    def __init__(self, device_budget: Optional[int] = None,
+                 host_budget: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        from spark_rapids_tpu.config import get_conf
+
+        conf = get_conf()
+        self.device_budget = device_budget if device_budget is not None \
+            else conf.get(HBM_BUDGET_BYTES)
+        self.host_budget = host_budget if host_budget is not None \
+            else conf.get(HOST_SPILL_BYTES)
+        self._spill_dir = spill_dir or conf.get(SPILL_DIR) or None
+        self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
+        self._entries: dict[int, _Entry] = {}
+        self._next_id = 0
+        self._lock = threading.RLock()
+        self.device_used = 0
+        self.host_used = 0
+        #: observability (ref: spill metrics + memoryBytesSpilled)
+        self.spilled_device_to_host = 0
+        self.spilled_host_to_disk = 0
+
+    # -- registration --------------------------------------------------- #
+
+    def register(self, batch: ColumnarBatch,
+                 priority: int = SpillPriorities.ACTIVE_ON_DECK
+                 ) -> SpillableBatch:
+        nbytes = batch_device_bytes(batch)
+        with self._lock:
+            self.reserve(nbytes)
+            bid = self._next_id
+            self._next_id += 1
+            self._entries[bid] = _Entry(
+                bid, priority, nbytes, StorageTier.DEVICE, batch, None,
+                None, batch.schema)
+            self.device_used += nbytes
+            return SpillableBatch(self, bid)
+
+    def acquire(self, buffer_id: int) -> ColumnarBatch:
+        with self._lock:
+            e = self._entries[buffer_id]
+            e.pinned = True  # before reserve(): a cascaded spill must
+            # never select the entry being acquired (it could write a
+            # disk file acquire would then orphan)
+            if e.tier == StorageTier.DEVICE:
+                return e.batch  # type: ignore[return-value]
+            if e.tier == StorageTier.HOST:
+                arrays = e.host
+            else:
+                with np.load(e.path) as z:  # type: ignore[arg-type]
+                    arrays = {k: z[k] for k in z.files}
+                os.unlink(e.path)  # type: ignore[arg-type]
+            self.reserve(e.nbytes)
+            batch = _host_to_batch(arrays, e.schema)  # H2D upload
+            if e.tier == StorageTier.HOST:
+                self.host_used -= _host_bytes(arrays)
+            e.batch, e.host, e.path = batch, None, None
+            e.tier = StorageTier.DEVICE
+            e.pinned = True
+            self.device_used += e.nbytes
+            return batch
+
+    def remove(self, buffer_id: int) -> None:
+        with self._lock:
+            e = self._entries.pop(buffer_id, None)
+            if e is None:
+                return
+            if e.tier == StorageTier.DEVICE:
+                self.device_used -= e.nbytes
+            elif e.tier == StorageTier.HOST:
+                self.host_used -= _host_bytes(e.host)  # type: ignore
+            elif e.path:
+                try:
+                    os.unlink(e.path)
+                except OSError:
+                    pass
+
+    # -- budget / spill -------------------------------------------------- #
+
+    def reserve(self, nbytes: int) -> None:
+        """Make room for an nbytes device allocation, spilling if needed
+        (the proactive analog of DeviceMemoryEventHandler.onAllocFailure
+        -> synchronousSpill)."""
+        with self._lock:
+            while self.device_used + nbytes > self.device_budget:
+                if not self._spill_one_device():
+                    break  # nothing spillable left; let XLA try anyway
+
+    def _spill_one_device(self) -> bool:
+        candidates = [e for e in self._entries.values()
+                      if e.tier == StorageTier.DEVICE and not e.pinned]
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda e: (e.priority, e.buffer_id))
+        self._spill_to_host(victim)
+        return True
+
+    def _spill_to_host(self, e: _Entry) -> None:
+        arrays = _batch_to_host(e.batch)  # type: ignore[arg-type]
+        e.batch = None
+        e.tier = StorageTier.HOST
+        e.host = arrays
+        self.device_used -= e.nbytes
+        hb = _host_bytes(arrays)
+        self.host_used += hb
+        self.spilled_device_to_host += e.nbytes
+        while self.host_used > self.host_budget:
+            if not self._spill_one_host():
+                break
+
+    def _spill_one_host(self) -> bool:
+        candidates = [e for e in self._entries.values()
+                      if e.tier == StorageTier.HOST and not e.pinned]
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda e: (e.priority, e.buffer_id))
+        arrays = victim.host
+        path = os.path.join(self._dir(), f"spill-{victim.buffer_id}.npz")
+        np.savez(path, **arrays)  # type: ignore[arg-type]
+        hb = _host_bytes(arrays)  # type: ignore[arg-type]
+        victim.host = None
+        victim.path = path
+        victim.tier = StorageTier.DISK
+        self.host_used -= hb
+        self.spilled_host_to_disk += hb
+        return True
+
+    def _dir(self) -> str:
+        if self._spill_dir:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            return self._spill_dir
+        if self._tmpdir is None:
+            self._tmpdir = tempfile.TemporaryDirectory(
+                prefix="spark_rapids_tpu_spill_")
+        return self._tmpdir.name
+
+    def close(self) -> None:
+        with self._lock:
+            for bid in list(self._entries):
+                self.remove(bid)
+            if self._tmpdir is not None:
+                self._tmpdir.cleanup()
+                self._tmpdir = None
+
+
+_STORE: Optional[BufferStore] = None
+_STORE_LOCK = threading.Lock()
+
+
+def get_store() -> BufferStore:
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is None:
+            _STORE = BufferStore()
+        return _STORE
+
+
+def reset_store(store: Optional[BufferStore] = None) -> None:
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is not None:
+            _STORE.close()
+        _STORE = store

@@ -222,3 +222,23 @@ def test_cast_string_double_bool_on_cpu_fallback(spark):
     assert qd.collect().to_pydict()["d"] == [1.5, None, None, None, None]
     qb = df.select(Cast(col("s"), T.BOOLEAN).alias("b"))
     assert qb.collect().to_pydict()["b"] == [None, None, True, False, None]
+
+
+def test_upper_preserves_4byte_utf8_after_nonascii(spark):
+    """Regression: case mapping must pass 4-byte UTF-8 sequences through
+    untouched even when a mapped non-ASCII char precedes them."""
+    t = pa.table({"s": pa.array(["é\U0001F600", "a\U0001F600é",
+                                 "\U0001F600", "éé\U0001F600x"])})
+    got = spark.create_dataframe(t).select(
+        S.Upper(col("s")).alias("u")).collect().to_pydict()["u"]
+    assert got == ["É\U0001F600", "A\U0001F600É",
+                   "\U0001F600", "ÉÉ\U0001F600X"]
+    check(spark, t, S.Upper(col("s")), S.Lower(col("s")), approx=False)
+
+
+def test_like_escape_falls_back(spark):
+    t = pa.table({"s": pa.array(["100%", "100x", "100\\"])})
+    q = spark.create_dataframe(t).select(
+        S.Like(col("s"), "100\\%").alias("m"))
+    assert "not supported on TPU" in q.explain()
+    assert q.collect().to_pydict()["m"] == [True, False, False]
