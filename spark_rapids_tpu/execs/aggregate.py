@@ -129,9 +129,13 @@ class TpuHashAggregateExec(TpuExec):
                 po += 1
             self.final_exprs.append(na.fn.finalize_expr(refs))
 
+        import threading
+
         self._jit_update = None
         self._jit_merge = None
         self._jit_finalize = None
+        self._jits = None
+        self._jit_lock = threading.Lock()
 
     @property
     def schema(self) -> T.Schema:
@@ -186,11 +190,43 @@ class TpuHashAggregateExec(TpuExec):
 
     # -- streaming driver ------------------------------------------------ #
 
+    @property
+    def num_partitions(self) -> int:
+        # partial aggregation is narrow (per input partition); final is
+        # narrow too because the exchange already made partitions
+        # key-disjoint; complete consumes everything into one partition
+        if self.mode in ("partial", "final"):
+            return self.children[0].num_partitions
+        return 1
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if self.mode == "complete":
+            assert self.num_partitions == 1
+            if p == 0:
+                yield from self.execute()
+            return
+        yield from self._run_stream(self.children[0].execute_partition(p),
+                                    emit_empty_default=(p == 0))
+
     def execute(self) -> Iterator[ColumnarBatch]:
-        if self._jit_update is None:
-            self._jit_update = jax.jit(self._update_batch)
-            self._jit_merge = jax.jit(self._merge_batch)
-            self._jit_finalize = jax.jit(self._finalize_batch)
+        if self.mode == "complete":
+            yield from self._run_stream(self.children[0].execute(),
+                                        emit_empty_default=True)
+        else:
+            for p in range(self.num_partitions):
+                yield from self.execute_partition(p)
+
+    def _run_stream(self, source,
+                    emit_empty_default: bool) -> Iterator[ColumnarBatch]:
+        with self._jit_lock:
+            # exchange map tasks run partial aggregates concurrently; a
+            # field-by-field lazy init could be observed half-done
+            if self._jits is None:
+                self._jits = (jax.jit(self._update_batch),
+                              jax.jit(self._merge_batch),
+                              jax.jit(self._finalize_batch))
+            (self._jit_update, self._jit_merge,
+             self._jit_finalize) = self._jits
 
         from spark_rapids_tpu.memory import SpillPriorities, get_store
 
@@ -198,7 +234,6 @@ class TpuHashAggregateExec(TpuExec):
         # pending partials are spillable between merges (the reference
         # plans the same: aggregate.scala:378-386 spill-of-running-agg)
         pending: list = []  # SpillableBatch handles
-        pending_rows = 0
 
         def drain_pending() -> ColumnarBatch:
             batches = [h.get() for h in pending]
@@ -210,7 +245,8 @@ class TpuHashAggregateExec(TpuExec):
             return out
 
         try:
-            yield from self._execute_inner(store, pending, drain_pending)
+            yield from self._execute_inner(store, pending, drain_pending,
+                                           source, emit_empty_default)
         finally:
             # a raise (or generator close) anywhere above must not leak
             # registrations into the process-global store
@@ -218,11 +254,12 @@ class TpuHashAggregateExec(TpuExec):
                 h.close()
             pending.clear()
 
-    def _execute_inner(self, store, pending, drain_pending):
+    def _execute_inner(self, store, pending, drain_pending, source,
+                       emit_empty_default):
         from spark_rapids_tpu.memory import SpillPriorities
 
         pending_rows = 0
-        for batch in self.children[0].execute():
+        for batch in source:
             with MetricTimer(self.metrics[TOTAL_TIME]):
                 if self.mode == "final":
                     part = _as_device_rows(batch)  # already partial layout
@@ -243,9 +280,10 @@ class TpuHashAggregateExec(TpuExec):
                     merged, SpillPriorities.AGGREGATE_PARTIAL))
 
         if not pending:
-            if self.n_keys > 0:
+            if self.n_keys > 0 or not emit_empty_default:
                 return  # grouped aggregate of empty input: no rows
-            # grand aggregate of empty input: one default row
+            # grand aggregate of empty input: one default row (only the
+            # first partition emits it)
             eb = ColumnarBatch.empty(self.children[0].schema)
             if self.mode != "final":
                 eb = self._jit_update(_as_device_rows(eb))

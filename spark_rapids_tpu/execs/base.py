@@ -88,9 +88,26 @@ class TpuExec:
     def additional_metrics(self) -> list[tuple[str, str]]:
         return []
 
+    # -- partitioned execution (the Spark task-per-partition model, ref:
+    # SURVEY.md §2.9).  Narrow execs propagate the child's partitioning;
+    # wide execs (global sort/limit, broadcast-style join, complete
+    # aggregation) consume every child partition and emit ONE.  Execs
+    # must override execute() (wide) or execute_partition() (narrow). -- #
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        """Produce one output partition's batches."""
+        assert self.num_partitions == 1, type(self).__name__
+        if p == 0:
+            yield from self.execute()
+
     def execute(self) -> Iterator[ColumnarBatch]:
-        """Produce output batches (ref: GpuExec.doExecuteColumnar)."""
-        raise NotImplementedError
+        """All partitions, chained (ref: GpuExec.doExecuteColumnar)."""
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
 
     # -- plumbing -------------------------------------------------------- #
 
@@ -135,14 +152,22 @@ BatchFn = Callable[[ColumnarBatch], ColumnarBatch]
 
 
 class FusableExec(TpuExec):
-    """An exec that is a pure per-batch device transform.  Consecutive
-    fusable execs compile into a single XLA program per batch pipeline."""
+    """An exec that is a pure per-batch device transform (narrow: output
+    partitioning == child's).  Consecutive fusable execs compile into a
+    single XLA program per batch pipeline, shared across partitions."""
 
     def make_batch_fn(self) -> BatchFn:
         """Return a traceable ColumnarBatch -> ColumnarBatch function."""
         raise NotImplementedError
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    def _fused_pipeline(self):
+        cached = getattr(self, "_fused", None)
+        if cached is not None:
+            return cached
         # walk down through fusable children, composing their batch fns
         fns: list[BatchFn] = [self.make_batch_fn()]
         node: TpuExec = self.children[0]
@@ -156,8 +181,16 @@ class FusableExec(TpuExec):
                 batch = f(batch)
             return batch
 
-        fused = jax.jit(pipeline)
-        for batch in node.execute():
+        self._fused = (jax.jit(pipeline), node)
+        return self._fused
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        fused, node = self._fused_pipeline()
+        for batch in node.execute_partition(p):
             with MetricTimer(self.metrics[TOTAL_TIME]):
                 out = fused(batch.with_device_num_rows())
             yield self._count_output(out)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)

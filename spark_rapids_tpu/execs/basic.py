@@ -130,18 +130,27 @@ class TpuRangeExec(TpuExec):
     def schema(self) -> T.Schema:
         return self._schema
 
-    def execute(self) -> Iterator[ColumnarBatch]:
-        total = max(0, -(-(self.end - self.start) // self.step))
-        emitted = 0
-        while emitted < total:
-            n = min(self.batch_rows, total - emitted)
-            cap = pad_capacity(n)
-            base = self.start + emitted * self.step
-            data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
-            valid = jnp.arange(cap, dtype=jnp.int32) < n
-            col = Column(data, valid, T.LONG)
-            emitted += n
-            yield self._count_output(ColumnarBatch([col], n, self._schema))
+    def _total(self) -> int:
+        return max(0, -(-(self.end - self.start) // self.step))
+
+    @property
+    def num_partitions(self) -> int:
+        return max(1, -(-self._total() // self.batch_rows))
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        total = self._total()
+        emitted = p * self.batch_rows
+        if emitted >= total and total > 0:
+            return
+        n = min(self.batch_rows, total - emitted)
+        if total == 0:
+            n = 0
+        cap = pad_capacity(n)
+        base = self.start + emitted * self.step
+        data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+        valid = jnp.arange(cap, dtype=jnp.int32) < n
+        col = Column(data, valid, T.LONG)
+        yield self._count_output(ColumnarBatch([col], n, self._schema))
 
 
 class TpuUnionExec(TpuExec):
@@ -155,13 +164,20 @@ class TpuUnionExec(TpuExec):
     def schema(self) -> T.Schema:
         return self.children[0].schema
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions for c in self.children)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         schema = self.schema
         for child in self.children:
-            for b in child.execute():
-                # re-tag with union schema (names from first child)
-                yield self._count_output(
-                    ColumnarBatch(b.columns, b.num_rows, schema))
+            if p < child.num_partitions:
+                for b in child.execute_partition(p):
+                    # re-tag with union schema (names from first child)
+                    yield self._count_output(
+                        ColumnarBatch(b.columns, b.num_rows, schema))
+                return
+            p -= child.num_partitions
 
 
 class TpuCoalesceBatchesExec(TpuExec):

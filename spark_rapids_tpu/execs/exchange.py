@@ -1,0 +1,129 @@
+"""Shuffle exchange exec.
+
+Counterpart of GpuShuffleExchangeExecBase (ref: sql-plugin/.../sql/
+rapids/execution/GpuShuffleExchangeExec.scala:80,167-270): the map stage
+partitions every child batch (murmur3-pmod on device), writes the slices
+to the in-process shuffle manager (device-resident, spillable at
+shuffle-output priority), and reduce partitions read their blocks back.
+Map tasks (one per child partition) run on a thread pool gated by the
+task semaphore — the execution model of Spark executor task slots +
+GpuSemaphore.  On a multi-chip mesh the planner can instead lower an
+exchange+aggregation pair to the fused collective all_to_all program in
+parallel.exchange (SURVEY.md §5.8 tier-2 path)."""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator
+
+import jax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import register, get_conf
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+from spark_rapids_tpu.memory import TpuSemaphore
+from spark_rapids_tpu.ops.partition import (
+    Partitioning,
+    RoundRobinPartitioning,
+    split_batch,
+)
+from spark_rapids_tpu.shuffle import get_shuffle_manager
+
+SHUFFLE_PARTITIONS = register(
+    "spark.rapids.tpu.sql.shuffle.partitions", 8,
+    "Number of reduce partitions for shuffle exchanges (the "
+    "spark.sql.shuffle.partitions analog).")
+TASK_THREADS = register(
+    "spark.rapids.tpu.sql.taskThreads", 4,
+    "Host threads running map tasks concurrently (device work "
+    "serializes on the chip; threads overlap host IO/decode).")
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    def __init__(self, partitioning: Partitioning, child: TpuExec):
+        super().__init__(child)
+        self.partitioning = partitioning.bind(child.schema)
+        self._map_done = False
+        self._map_lock = threading.Lock()
+        self._shuffle_id = None
+        self._pid_fns: dict = {}
+        self._pid_lock = threading.Lock()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def node_desc(self) -> str:
+        return f"TpuShuffleExchangeExec {self.partitioning.describe()}"
+
+    def additional_metrics(self):
+        return [("shuffleWriteRows", "ESSENTIAL"),
+                ("mapTasks", "MODERATE")]
+
+    # -- map stage -------------------------------------------------------- #
+
+    def _run_map_task(self, child_part: int) -> None:
+        sem = TpuSemaphore.get()
+        task_id = threading.get_ident() ^ (child_part << 20)
+        manager = get_shuffle_manager()
+        n = self.num_partitions
+        part = self.partitioning
+        key = 0
+        if isinstance(part, RoundRobinPartitioning):
+            # offset per map task so output stays balanced (the reference
+            # randomizes the start position per task)
+            key = child_part % n
+            part = RoundRobinPartitioning(n, start=key)
+        with self._pid_lock:
+            pid_fn = self._pid_fns.get(key)
+            if pid_fn is None:
+                pid_fn = self._pid_fns[key] = jax.jit(part.partition_ids)
+        try:
+            for batch in self.children[0].execute_partition(child_part):
+                sem.acquire_if_necessary(task_id)
+                batch = batch.with_device_num_rows()
+                pids = pid_fn(batch)
+                for rid, sub in enumerate(split_batch(batch, pids, n)):
+                    rows = sub.concrete_num_rows()
+                    if rows:
+                        self.metrics["shuffleWriteRows"].add(rows)
+                        manager.write(self._shuffle_id, rid, sub)
+        finally:
+            sem.release_if_necessary(task_id)
+        self.metrics["mapTasks"].add(1)
+
+    def _ensure_map_stage(self) -> None:
+        with self._map_lock:
+            if self._map_done:
+                return
+            self._shuffle_id = get_shuffle_manager().new_shuffle_id()
+            n_tasks = self.children[0].num_partitions
+            threads = min(get_conf().get(TASK_THREADS), max(n_tasks, 1))
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                if threads <= 1 or n_tasks <= 1:
+                    for p in range(n_tasks):
+                        self._run_map_task(p)
+                else:
+                    with ThreadPoolExecutor(max_workers=threads) as pool:
+                        futures = [pool.submit(self._run_map_task, p)
+                                   for p in range(n_tasks)]
+                        for f in futures:
+                            f.result()  # propagate the first failure
+            self._map_done = True
+
+    # -- reduce side ------------------------------------------------------ #
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        self._ensure_map_stage()
+        for b in get_shuffle_manager().read(self._shuffle_id, p):
+            yield self._count_output(b)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)

@@ -27,9 +27,9 @@ class TpuLocalLimitExec(TpuExec):
     def node_desc(self) -> str:
         return f"{type(self).__name__} n={self.n}"
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    def _limited(self, source) -> Iterator[ColumnarBatch]:
         remaining = self.n
-        for b in self.children[0].execute():
+        for b in source:
             if remaining <= 0:
                 return
             n = b.concrete_num_rows()
@@ -42,7 +42,27 @@ class TpuLocalLimitExec(TpuExec):
                 remaining = 0
                 yield self._count_output(out)
 
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        """Narrow: caps each partition at n (ref: GpuLocalLimitExec)."""
+        yield from self._limited(self.children[0].execute_partition(p))
+
 
 class TpuGlobalLimitExec(TpuLocalLimitExec):
-    """Same mechanics per partition; the planner places it after a
-    single-partition exchange the way Spark does."""
+    """Wide: caps the total across partitions (ref: GpuGlobalLimitExec;
+    Spark runs it on a single partition after an exchange — here the
+    child partitions are consumed sequentially, stopping early)."""
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if p == 0:
+            yield from self.execute()
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        yield from self._limited(self.children[0].execute())

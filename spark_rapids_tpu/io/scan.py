@@ -45,15 +45,18 @@ class ArrowSourceExec(TpuExec):
     def node_desc(self) -> str:
         return f"ArrowSourceExec [{self.table.num_rows} rows]"
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return max(1, -(-self.table.num_rows // self.batch_rows))
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         t = self.table
         if t.num_rows == 0:
             yield self._count_output(
                 from_arrow(t.cast(schema_to_arrow(self._schema))))
             return
-        for off in range(0, t.num_rows, self.batch_rows):
-            chunk = t.slice(off, self.batch_rows)
-            yield self._count_output(from_arrow(chunk))
+        chunk = t.slice(p * self.batch_rows, self.batch_rows)
+        yield self._count_output(from_arrow(chunk))
 
 
 class ParquetScanExec(TpuExec):
@@ -80,23 +83,26 @@ class ParquetScanExec(TpuExec):
     def additional_metrics(self):
         return [("scanTime", "MODERATE")]
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return len(self.paths)  # one task per file (row-group splits later)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         import pyarrow.parquet as pq
 
+        f = pq.ParquetFile(self.paths[p])
         empty = True
-        for path in self.paths:
-            f = pq.ParquetFile(path)
-            for rb in f.iter_batches(batch_size=self.batch_rows,
-                                     columns=self.columns):
-                empty = False
-                yield self._count_output(
-                    from_arrow(pa.Table.from_batches([rb])))
-        if empty:
+        for rb in f.iter_batches(batch_size=self.batch_rows,
+                                 columns=self.columns):
+            empty = False
+            yield self._count_output(
+                from_arrow(pa.Table.from_batches([rb])))
+        if empty and p == 0:
+            aschema = schema_to_arrow(self._schema)
             yield self._count_output(
                 from_arrow(pa.Table.from_arrays(
-                    [pa.array([], f.type) for f in
-                     schema_to_arrow(self._schema)],
-                    schema=schema_to_arrow(self._schema))))
+                    [pa.array([], fl.type) for fl in aschema],
+                    schema=aschema)))
 
 
 class CsvScanExec(TpuExec):
@@ -114,11 +120,15 @@ class CsvScanExec(TpuExec):
     def node_desc(self) -> str:
         return f"CsvScanExec {self.paths}"
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return len(self.paths)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         import pyarrow.csv as pacsv
 
-        for path in self.paths:
-            t = pacsv.read_csv(path).cast(schema_to_arrow(self._schema))
-            for off in range(0, max(t.num_rows, 1), self.batch_rows):
-                chunk = t.slice(off, self.batch_rows)
-                yield self._count_output(from_arrow(chunk))
+        t = pacsv.read_csv(self.paths[p]).cast(
+            schema_to_arrow(self._schema))
+        for off in range(0, max(t.num_rows, 1), self.batch_rows):
+            chunk = t.slice(off, self.batch_rows)
+            yield self._count_output(from_arrow(chunk))

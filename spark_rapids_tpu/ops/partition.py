@@ -1,0 +1,112 @@
+"""Output partitioners.
+
+TPU counterparts of the reference's four partitioning strategies
+(ref: GpuHashPartitioning.scala, GpuRoundRobinPartitioning.scala,
+GpuSinglePartitioning.scala, GpuRangePartitioning.scala; base mechanics
+in GpuPartitioning.scala:45-73 — cudf Table.partition + contiguousSplit).
+
+Here a partitioner produces per-row partition ids on device; the split
+into per-partition sub-batches reuses the stable-argsort compaction: one
+sort by pid groups rows, a sizing sync reads the per-partition counts,
+and each sub-batch is a sliced gather of the grouped batch.  Hash
+partitioning is murmur3-pmod, bit-for-bit Spark-compatible (the parity
+requirement the reference calls out), so a row lands on the same
+partition index as it would under Spark CPU."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exprs.base import EvalContext, Expression, bind_references
+from spark_rapids_tpu.exprs.hashing import partition_ids
+
+
+class Partitioning:
+    """Computes per-row partition ids for a batch (traceable)."""
+
+    num_partitions: int
+
+    def bind(self, schema) -> "Partitioning":
+        return self
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class HashPartitioning(Partitioning):
+    exprs: Sequence[Expression]
+    num_partitions: int
+
+    def bind(self, schema) -> "HashPartitioning":
+        return HashPartitioning(
+            [bind_references(e, schema) for e in self.exprs],
+            self.num_partitions)
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        ctx = EvalContext.for_batch(batch)
+        cols = [e.eval(ctx) for e in self.exprs]
+        return partition_ids(cols, batch.capacity, self.num_partitions)
+
+    def describe(self) -> str:
+        return (f"hashpartitioning({', '.join(e.name for e in self.exprs)},"
+                f" {self.num_partitions})")
+
+
+@dataclasses.dataclass
+class RoundRobinPartitioning(Partitioning):
+    num_partitions: int
+    start: int = 0
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        idx = jnp.arange(batch.capacity, dtype=jnp.int32)
+        return (idx + jnp.int32(self.start)) % jnp.int32(self.num_partitions)
+
+    def describe(self) -> str:
+        return f"roundrobin({self.num_partitions})"
+
+
+@dataclasses.dataclass
+class SinglePartitioning(Partitioning):
+    num_partitions: int = 1
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        return jnp.zeros((batch.capacity,), jnp.int32)
+
+    def describe(self) -> str:
+        return "single"
+
+
+def split_batch(batch: ColumnarBatch, pids: jax.Array, n_parts: int
+                ) -> list[ColumnarBatch]:
+    """Group rows by partition id and slice out per-partition batches.
+    One device sort + one sizing sync per input batch (the analog of
+    cudf's Table.partition returning parts + offsets)."""
+    live = batch.row_mask()
+    key = jnp.where(live, pids, jnp.int32(n_parts))
+    order = jnp.argsort(key, stable=True)
+    grouped = batch.gather(order, batch.num_rows)
+    counts = jax.ops.segment_sum(live.astype(jnp.int32), key,
+                                 num_segments=n_parts)
+    counts_np = np.asarray(jax.device_get(counts))
+    offsets = np.concatenate([[0], np.cumsum(counts_np)])
+    out = []
+    cap = batch.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    for p in range(n_parts):
+        off, cnt = int(offsets[p]), int(counts_np[p])
+        take = jnp.clip(idx + off, 0, cap - 1)
+        sub = grouped.gather(take, cnt)
+        live_p = idx < cnt
+        cols = [c.with_validity(c.validity & live_p) for c in sub.columns]
+        out.append(ColumnarBatch(cols, cnt, batch.schema))
+    return out

@@ -276,7 +276,7 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.Filter):
         return TpuFilterExec(p.condition, kids[0])
     if isinstance(p, L.Aggregate):
-        return TpuHashAggregateExec(p.groups, p.aggs, kids[0])
+        return _plan_aggregate(p, kids[0])
     if isinstance(p, L.Sort):
         return TpuSortExec(p.keys, kids[0])
     if isinstance(p, L.Limit):
@@ -288,6 +288,39 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
             p.left_keys, p.right_keys, p.join_type, kids[0], kids[1],
             condition=p.condition)
     raise AssertionError(f"tagged-replaceable node unconvertible: {p.name}")
+
+
+def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
+    """Multi-partition input: partial agg (narrow) -> hash exchange on
+    the group keys (single exchange for grand aggregates) -> final agg
+    (narrow over key-disjoint partitions) — the Spark/reference physical
+    shape (aggregate.scala mode handling around ShuffleExchange).
+    Single-partition input: one complete aggregation, no shuffle."""
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.exchange import (
+        SHUFFLE_PARTITIONS,
+        TpuShuffleExchangeExec,
+    )
+    from spark_rapids_tpu.ops.partition import (
+        HashPartitioning,
+        SinglePartitioning,
+    )
+
+    if child_exec.num_partitions <= 1:
+        return TpuHashAggregateExec(p.groups, p.aggs, child_exec)
+    partial = TpuHashAggregateExec(p.groups, p.aggs, child_exec,
+                                   mode="partial")
+    if p.groups:
+        n = get_conf().get(SHUFFLE_PARTITIONS)
+        keys = [B.BoundReference(i, f.dtype, f.nullable, f.name)
+                for i, f in enumerate(
+                    partial.schema.fields[: len(p.groups)])]
+        part = HashPartitioning(keys, n)
+    else:
+        part = SinglePartitioning()
+    exchange = TpuShuffleExchangeExec(part, partial)
+    return TpuHashAggregateExec(p.groups, p.aggs, exchange, mode="final",
+                                input_schema=child_exec.schema)
 
 
 # ---------------------------------------------------------------------- #

@@ -1,0 +1,17 @@
+"""Shuffle subsystem (SURVEY.md §2.10).
+
+TPU re-design of the reference's two-tier shuffle: device-resident
+partition outputs held in a catalog (ref:
+RapidsShuffleInternalManagerBase's RapidsCachingWriter +
+ShuffleBufferCatalog) with spill-store backing, behind a transport SPI
+(ref: RapidsShuffleTransport.scala:338).  In-process execution uses the
+local catalog transport; partitions aligned with a device mesh ride the
+collective all_to_all exchange in parallel.exchange instead of N x N
+point-to-point pulls.
+"""
+
+from spark_rapids_tpu.shuffle.manager import (  # noqa: F401
+    ShuffleManager,
+    get_shuffle_manager,
+    reset_shuffle_manager,
+)

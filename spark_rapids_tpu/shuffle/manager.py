@@ -1,0 +1,80 @@
+"""In-process shuffle manager.
+
+Counterpart of RapidsShuffleInternalManagerBase + ShuffleBufferCatalog
+(ref: sql-plugin/.../sql/rapids/RapidsShuffleInternalManagerBase.scala:66
+RapidsCachingWriter stores partition slices in the device store instead
+of writing files; RapidsCachingReader serves local blocks zero-copy from
+the catalog).  Map-task outputs register with the spill store at
+OUTPUT_FOR_SHUFFLE priority — the first thing evicted under memory
+pressure, exactly the reference's spill ordering — so shuffle data
+overflows to host/disk transparently while reduce tasks read
+device-resident batches zero-copy when memory allows."""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory import SpillPriorities, get_store
+
+
+class ShuffleManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: (shuffle_id, reduce_id) -> list of SpillableBatch handles
+        self._blocks: dict[tuple[int, int], list] = {}
+        self._next_shuffle = 0
+
+    def new_shuffle_id(self) -> int:
+        with self._lock:
+            sid = self._next_shuffle
+            self._next_shuffle += 1
+            return sid
+
+    def write(self, shuffle_id: int, reduce_id: int,
+              batch: ColumnarBatch) -> None:
+        """Map side: register one partition slice (stays on device until
+        pressure evicts it)."""
+        if batch.concrete_num_rows() == 0:
+            return
+        h = get_store().register(batch, SpillPriorities.OUTPUT_FOR_SHUFFLE)
+        h.unpin()  # at rest until a reduce task fetches it
+        with self._lock:
+            self._blocks.setdefault((shuffle_id, reduce_id), []).append(h)
+
+    def read(self, shuffle_id: int, reduce_id: int
+             ) -> Iterator[ColumnarBatch]:
+        """Reduce side: drain this partition's blocks (consumes them)."""
+        with self._lock:
+            handles = self._blocks.pop((shuffle_id, reduce_id), [])
+        for h in handles:
+            try:
+                yield h.get()
+            finally:
+                h.close()
+
+    def unregister(self, shuffle_id: int) -> None:
+        with self._lock:
+            keys = [k for k in self._blocks if k[0] == shuffle_id]
+            for k in keys:
+                for h in self._blocks.pop(k):
+                    h.close()
+
+
+_MANAGER: Optional[ShuffleManager] = None
+_LOCK = threading.Lock()
+
+
+def get_shuffle_manager() -> ShuffleManager:
+    global _MANAGER
+    with _LOCK:
+        if _MANAGER is None:
+            _MANAGER = ShuffleManager()
+        return _MANAGER
+
+
+def reset_shuffle_manager() -> None:
+    global _MANAGER
+    with _LOCK:
+        _MANAGER = None
