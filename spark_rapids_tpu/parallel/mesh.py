@@ -20,8 +20,9 @@ DATA_AXIS = "data"
 
 def make_mesh(n_devices: Optional[int] = None,
               axes: Sequence[str] = (DATA_AXIS,),
-              shape: Optional[Sequence[int]] = None) -> Mesh:
-    devs = jax.devices()
+              shape: Optional[Sequence[int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         if len(devs) < n_devices:
             raise ValueError(

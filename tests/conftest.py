@@ -9,18 +9,6 @@ another config.update before any backend initializes, otherwise every test
 run rides a fragile remote-TPU tunnel.
 """
 
-import os
+from spark_rapids_tpu.platform import pin_cpu_platform
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+pin_cpu_platform(8)
