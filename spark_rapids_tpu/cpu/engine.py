@@ -261,8 +261,17 @@ def _dispatch_extended(e, table, n):  # noqa: C901
         c = cpu_eval(e.child, table)
         if not pa.types.is_floating(c.type):
             return c
-        fn = pc.floor if isinstance(e, M.Floor) else pc.ceil
-        return fn(c.cast(pa.float64())).cast(pa.int64())
+        # Spark: ceil/floor(double) -> LONG via the Java (long) cast:
+        # NaN -> 0, +/-inf and out-of-range saturate at Long.MIN/MAX
+        v, ok = _np_vals(c.cast(pa.float64()), pa.float64())
+        r = np.floor(v) if isinstance(e, M.Floor) else np.ceil(v)
+        r = np.where(np.isnan(r), 0.0, r)
+        i64 = np.iinfo(np.int64)
+        hi_f, lo_f = float(i64.max) + 1.0, float(i64.min)
+        out = np.where((r > lo_f) & (r < hi_f), r, 0.0).astype(np.int64)
+        out = np.where(r >= hi_f, i64.max, out)
+        out = np.where(r <= lo_f, i64.min, out)
+        return _from_np(out, ok, pa.int64())
     if isinstance(e, M.Round):  # BRound subclasses Round
         c = cpu_eval(e.child, table)
         # Spark HALF_UP rounds half away from zero
@@ -662,7 +671,7 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
     if n_keys == 0:
         out_cols, out_names = [], []
         for in_names, fname, out_name, fn in agg_specs:
-            out_cols.append(_grand_agg(proj, in_names, fname))
+            out_cols.append(_grand_agg(proj, in_names, fname, fn))
             out_names.append(out_name)
         return pa.Table.from_arrays(
             [pa.array([v.as_py()], type=v.type) for v in out_cols],
@@ -676,6 +685,10 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
             aggs.append((in_names[0], "count"))
         elif fname == "average":
             aggs.append((in_names[0], "mean"))
+        elif fname in ("first", "last"):
+            # Spark defaults ignoreNulls=false; pyarrow defaults skip
+            aggs.append((in_names[0], fname, pc.ScalarAggregateOptions(
+                skip_nulls=fn.ignore_nulls, min_count=0)))
         else:
             aggs.append((in_names[0], fname))
     gb = proj.group_by(names[:n_keys], use_threads=False)
@@ -686,7 +699,8 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
     aschema = schema_to_arrow(plan.schema)
     for i in range(n_keys):
         out_arrays.append(res.column(names[i]))
-    for (in_names, fname, out_name, fn), (src, op) in zip(agg_specs, aggs):
+    for (in_names, fname, out_name, fn), spec in zip(agg_specs, aggs):
+        src, op = spec[0], spec[1]
         col_name = f"{src}_{op}" if src else f"{op}"
         if col_name not in res.column_names:
             col_name = f"{'_'.join(in_names)}_{op}" if in_names else op
@@ -695,7 +709,7 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
                                 names=aschema.names).cast(aschema)
 
 
-def _grand_agg(proj: pa.Table, in_names, fname) -> pa.Scalar:
+def _grand_agg(proj: pa.Table, in_names, fname, fn=None) -> pa.Scalar:
     if fname == "count_all":
         return pa.scalar(proj.num_rows, pa.int64())
     col = proj.column(in_names[0])
@@ -709,12 +723,12 @@ def _grand_agg(proj: pa.Table, in_names, fname) -> pa.Scalar:
         return pc.min(col)
     if fname == "max":
         return pc.max(col)
-    if fname == "first":
-        valid = col.drop_null()
-        return valid[0] if len(valid) else pa.scalar(None, col.type)
-    if fname == "last":
-        valid = col.drop_null()
-        return valid[-1] if len(valid) else pa.scalar(None, col.type)
+    if fname in ("first", "last"):
+        src = col if (fn is None or fn.ignore_nulls) else None
+        vals = col.drop_null() if src is not None else col.combine_chunks()
+        if len(vals) == 0:
+            return pa.scalar(None, col.type)
+        return vals[0] if fname == "first" else vals[-1]
     raise NotImplementedError(fname)
 
 

@@ -109,6 +109,13 @@ class TpuExec:
         for p in range(self.num_partitions):
             yield from self.execute_partition(p)
 
+    def close(self) -> None:
+        """Release query-lifetime resources (shuffle blocks, broadcast
+        batches).  Called by the query root when the plan is drained or
+        abandoned; propagates down the tree."""
+        for c in self.children:
+            c.close()
+
     # -- plumbing -------------------------------------------------------- #
 
     @property

@@ -127,3 +127,13 @@ class TpuShuffleExchangeExec(TpuExec):
     def execute(self) -> Iterator[ColumnarBatch]:
         for p in range(self.num_partitions):
             yield from self.execute_partition(p)
+
+    def close(self) -> None:
+        """Drop any unread shuffle blocks (a downstream limit may abandon
+        reduce partitions; without this their SpillableBatch handles stay
+        registered in the process-global store forever)."""
+        super().close()
+        if self._shuffle_id is not None:
+            get_shuffle_manager().unregister(self._shuffle_id)
+            self._shuffle_id = None
+            self._map_done = False

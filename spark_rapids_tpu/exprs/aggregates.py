@@ -155,24 +155,34 @@ class Max(AggregateFunction):
         return ["max"]
 
 
+@dataclasses.dataclass(repr=False)
 class First(AggregateFunction):
-    """first(expr) ignoring nulls (the reference's GpuFirst with
-    ignoreNulls — deterministic only after an explicit sort, as in
-    Spark)."""
+    """first(expr[, ignoreNulls]) — Spark defaults ignoreNulls to FALSE:
+    a group whose first value is NULL returns NULL (ref: GpuFirst,
+    AggregateFunctions.scala).  Deterministic only after an explicit
+    sort, as in Spark."""
+
+    ignore_nulls: bool = False
+
+    def bind(self, schema: T.Schema) -> "First":
+        from spark_rapids_tpu.exprs.base import bind_references
+
+        return type(self)(bind_references(self.child, schema),
+                          self.ignore_nulls)
+
+    def _op(self) -> str:
+        base = type(self).__name__.lower()
+        return base if self.ignore_nulls else f"{base}_any"
 
     def update_ops(self):
-        return ["first"]
+        return [self._op()]
 
     def merge_ops(self):
-        return ["first"]
+        return [self._op()]
 
 
-class Last(AggregateFunction):
-    def update_ops(self):
-        return ["last"]
-
-    def merge_ops(self):
-        return ["last"]
+class Last(First):
+    pass
 
 
 class Average(AggregateFunction):

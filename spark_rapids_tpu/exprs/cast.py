@@ -112,21 +112,26 @@ class Cast(Expression):
             return Column(d.astype(jnp.int64) * 1_000_000, valid, dst)
         phys = T.to_numpy_dtype(dst)
         if ts in _FLOATING and td in _INTEGRAL:
-            # Java (long)(double): truncate toward zero, NaN -> 0,
-            # saturate at target bounds.  Saturation is by threshold
-            # compare: float64 cannot represent INT64_MAX, so
-            # clip-then-astype would convert 2^63 out of range
-            f = d.astype(jnp.float64)
-            info = jnp.iinfo(phys)
-            hi_f = float(info.max) + 1.0  # exact power of two
-            lo_f = float(info.min)
-            t = jnp.trunc(jnp.where(jnp.isnan(f), 0.0, f))
-            interior = (t > lo_f) & (t < hi_f)
-            out = jnp.where(interior, t, 0.0).astype(phys)
-            out = jnp.where(t >= hi_f, info.max, out)
-            out = jnp.where(t <= lo_f, info.min, out)
-            return Column(out, valid, dst)
+            return Column(saturating_float_to_integral(d, phys), valid, dst)
         return Column(d.astype(phys), valid, dst)
+
+
+def saturating_float_to_integral(d, phys):
+    """Java (long)(double) semantics: truncate toward zero, NaN -> 0,
+    +/-inf and out-of-range saturate at target MIN/MAX.  Saturation is by
+    threshold compare: float64 cannot represent INT64_MAX, so
+    clip-then-astype would convert 2^63 out of range.  Shared by Cast and
+    Ceil/Floor (whose double -> LONG results must saturate identically)."""
+    f = d.astype(jnp.float64)
+    info = jnp.iinfo(phys)
+    hi_f = float(info.max) + 1.0  # exact power of two
+    lo_f = float(info.min)
+    t = jnp.trunc(jnp.where(jnp.isnan(f), 0.0, f))
+    interior = (t > lo_f) & (t < hi_f)
+    out = jnp.where(interior, t, 0.0).astype(phys)
+    out = jnp.where(t >= hi_f, info.max, out)
+    out = jnp.where(t <= lo_f, info.min, out)
+    return out
 
 
 def _integral_to_string(c: Column, src: T.DataType,

@@ -216,10 +216,16 @@ class Ceil(Expression):
         return self.child.dtype
 
     def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.exprs.cast import saturating_float_to_integral
+
         c = self.child.eval(ctx)
         if not isinstance(self.child.dtype, (T.FloatType, T.DoubleType)):
             return c
-        out = type(self)._fn(c.data.astype(jnp.float64)).astype(jnp.int64)
+        r = type(self)._fn(c.data.astype(jnp.float64))
+        # ceil/floor already produce integral values; the shared
+        # conversion contributes NaN -> 0 and Long.MIN/MAX saturation
+        # (Spark's java (long) cast), where a raw astype is backend-defined
+        out = saturating_float_to_integral(r, jnp.int64)
         return Column(out, c.validity, T.LONG)
 
 

@@ -20,9 +20,9 @@ class TpuSemaphore:
 
     def __init__(self, permits: int):
         self.permits = permits
-        self._sem = threading.BoundedSemaphore(permits)
+        self._available = permits
+        self._cv = threading.Condition()
         self._holders: set[int] = set()
-        self._holders_lock = threading.Lock()
 
     @classmethod
     def get(cls) -> "TpuSemaphore":
@@ -43,17 +43,28 @@ class TpuSemaphore:
             cls._instance = None
 
     def acquire_if_necessary(self, task_id: int) -> None:
-        """Idempotent per task (ref: GpuSemaphore.acquireIfNecessary)."""
-        with self._holders_lock:
-            if task_id in self._holders:
-                return
-        self._sem.acquire()
-        with self._holders_lock:
-            self._holders.add(task_id)
+        """Idempotent per task (ref: GpuSemaphore.acquireIfNecessary).
+
+        Membership check, permit take, and holder registration happen in
+        one critical section, so two threads presenting the same task_id
+        cannot both take a permit (the set add would dedupe and leak a
+        permit on release).  notify_all after a grant wakes same-task
+        waiters so they observe membership and return without a permit."""
+        with self._cv:
+            while True:
+                if task_id in self._holders:
+                    return
+                if self._available > 0:
+                    self._available -= 1
+                    self._holders.add(task_id)
+                    self._cv.notify_all()
+                    return
+                self._cv.wait()
 
     def release_if_necessary(self, task_id: int) -> None:
-        with self._holders_lock:
+        with self._cv:
             if task_id not in self._holders:
                 return
             self._holders.discard(task_id)
-        self._sem.release()
+            self._available += 1
+            self._cv.notify_all()

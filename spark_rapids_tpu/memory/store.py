@@ -231,11 +231,18 @@ class BufferStore:
             else:
                 with np.load(e.path) as z:  # type: ignore[arg-type]
                     arrays = {k: z[k] for k in z.files}
-                os.unlink(e.path)  # type: ignore[arg-type]
             self.reserve(e.nbytes)
             batch = _host_to_batch(arrays, e.schema)  # H2D upload
             if e.tier == StorageTier.HOST:
                 self.host_used -= _host_bytes(arrays)
+            elif e.path:
+                # unlink only after the upload succeeded: an exception
+                # mid-acquire (cascaded spill, H2D failure) must not lose
+                # the only copy while the entry still claims DISK tier
+                try:
+                    os.unlink(e.path)
+                except OSError:
+                    pass
             e.batch, e.host, e.path = batch, None, None
             e.tier = StorageTier.DEVICE
             e.pinned = True

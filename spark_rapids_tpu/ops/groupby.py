@@ -180,6 +180,17 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
         sel_clipped = jnp.clip(sel, 0, cap - 1)
         out = jnp.take(vcol.data, sel_clipped).astype(phys)
         return Column(out, group_live & (nvalid > 0), out_dtype)
+    if spec.op in ("first_any", "last_any"):
+        # Spark default (ignoreNulls=false): first/last LIVE row of the
+        # segment regardless of validity; a NULL first value stays NULL
+        base = "first" if spec.op == "first_any" else "last"
+        pos = _firstlast_pos(live_sorted, base, cap)
+        f = jax.ops.segment_min if base == "first" else jax.ops.segment_max
+        sel = f(pos, seg_id, num_segments=cap)
+        sel_clipped = jnp.clip(sel, 0, cap - 1)
+        out = jnp.take(vcol.data, sel_clipped).astype(phys)
+        sel_valid = jnp.take(vcol.validity, sel_clipped)
+        return Column(out, group_live & sel_valid, out_dtype)
     raise ValueError(f"unknown agg op {spec.op}")
 
 
@@ -218,6 +229,17 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: Sequence[AggSpec],
             pos = _firstlast_pos(valid, spec.op, cap)
             sel = jnp.min(pos) if spec.op == "first" else jnp.max(pos)
             s = jnp.take(vcol.data, jnp.clip(sel, 0, cap - 1)).astype(phys)
+        elif spec.op in ("first_any", "last_any"):
+            base = "first" if spec.op == "first_any" else "last"
+            pos = _firstlast_pos(live, base, cap)
+            sel = jnp.min(pos) if base == "first" else jnp.max(pos)
+            sel_c = jnp.clip(sel, 0, cap - 1)
+            s = jnp.take(vcol.data, sel_c).astype(phys)
+            sel_ok = jnp.take(vcol.validity, sel_c)
+            data = jnp.zeros(cap, phys).at[0].set(s)
+            out_cols.append(Column(
+                data, one_live & sel_ok & (jnp.sum(live) > 0), out_dtype))
+            continue
         else:
             raise ValueError(f"unknown agg op {spec.op}")
         data = jnp.zeros(cap, phys).at[0].set(s.astype(phys))

@@ -339,7 +339,10 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
 
 def collect_exec(exec_: TpuExec) -> pa.Table:
     """Drain an exec to a host Arrow table (the D2H plan root)."""
-    tables = [to_arrow(b) for b in exec_.execute()]
+    try:
+        tables = [to_arrow(b) for b in exec_.execute()]
+    finally:
+        exec_.close()  # release shuffle blocks even on partial drains
     aschema = schema_to_arrow(exec_.schema)
     if not tables:
         return aschema.empty_table()

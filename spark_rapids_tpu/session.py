@@ -68,12 +68,12 @@ def avg(e: ExprLike) -> Average:
     return Average(_expr(e))
 
 
-def first(e: ExprLike) -> First:
-    return First(_expr(e))
+def first(e: ExprLike, ignore_nulls: bool = False) -> First:
+    return First(_expr(e), ignore_nulls)
 
 
-def last(e: ExprLike) -> Last:
-    return Last(_expr(e))
+def last(e: ExprLike, ignore_nulls: bool = False) -> Last:
+    return Last(_expr(e), ignore_nulls)
 
 
 class TpuSession:

@@ -45,13 +45,21 @@ class ShuffleManager:
 
     def read(self, shuffle_id: int, reduce_id: int
              ) -> Iterator[ColumnarBatch]:
-        """Reduce side: drain this partition's blocks (consumes them)."""
+        """Reduce side: drain this partition's blocks (consumes them).
+        Abandon-safe: if the consumer stops early (limit satisfied,
+        generator dropped), GeneratorExit lands in the finally and the
+        unread handles are still closed."""
         with self._lock:
             handles = self._blocks.pop((shuffle_id, reduce_id), [])
-        for h in handles:
-            try:
-                yield h.get()
-            finally:
+        try:
+            while handles:
+                h = handles.pop(0)
+                try:
+                    yield h.get()
+                finally:
+                    h.close()
+        finally:
+            for h in handles:
                 h.close()
 
     def unregister(self, shuffle_id: int) -> None:

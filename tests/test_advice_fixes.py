@@ -1,0 +1,151 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import TpuSession, col, first, last, sum_
+from tests.differential import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+# -- Ceil/Floor on non-finite doubles (medium) -------------------------- #
+
+def test_ceil_floor_nan_inf_saturate(session):
+    from spark_rapids_tpu.exprs.math import Ceil, Floor
+
+    data = {"x": [float("nan"), float("inf"), float("-inf"),
+                  1.5, -1.5, 2.0 ** 70, -(2.0 ** 70), 0.0]}
+    df = session.create_dataframe(pa.table(data)).select(
+        Ceil(col("x")).alias("c"), Floor(col("x")).alias("f"))
+    out = df.collect(engine="tpu").to_pydict()
+    i64 = np.iinfo(np.int64)
+    assert out["c"] == [0, i64.max, i64.min, 2, -1, i64.max, i64.min, 0]
+    assert out["f"] == [0, i64.max, i64.min, 1, -2, i64.max, i64.min, 0]
+    # CPU oracle must agree (it previously raised on these inputs)
+    assert_tpu_cpu_equal(df)
+
+
+# -- First/Last default ignoreNulls=false (low) ------------------------- #
+
+def test_first_last_default_keeps_nulls(session):
+    t = pa.table({"k": [1, 1, 2, 2], "v": [None, 10, 20, None]})
+    df = session.create_dataframe(t).group_by("k").agg(
+        (first("v"), "f"), (last("v"), "l"))
+    out = {r["k"]: (r["f"], r["l"])
+           for r in df.collect(engine="tpu").to_pylist()}
+    # group 1 first value is NULL -> NULL; group 2 last value NULL -> NULL
+    assert out[1] == (None, 10)
+    assert out[2] == (20, None)
+    assert_tpu_cpu_equal(df)
+
+
+def test_first_last_ignore_nulls(session):
+    t = pa.table({"k": [1, 1, 2, 2], "v": [None, 10, 20, None]})
+    df = session.create_dataframe(t).group_by("k").agg(
+        (first("v", ignore_nulls=True), "f"),
+        (last("v", ignore_nulls=True), "l"))
+    out = {r["k"]: (r["f"], r["l"])
+           for r in df.collect(engine="tpu").to_pylist()}
+    assert out[1] == (10, 10)
+    assert out[2] == (20, 20)
+    assert_tpu_cpu_equal(df)
+
+
+def test_grand_first_last_null(session):
+    t = pa.table({"v": [None, 7, None]}, schema=pa.schema(
+        [pa.field("v", pa.int64())]))
+    df = session.create_dataframe(t).agg((first("v"), "f"),
+                                         (last("v"), "l"),
+                                         (first("v", True), "fi"),
+                                         (last("v", True), "li"))
+    row = df.collect(engine="tpu").to_pylist()[0]
+    assert (row["f"], row["l"], row["fi"], row["li"]) == (None, None, 7, 7)
+    assert_tpu_cpu_equal(df)
+
+
+# -- shuffle blocks released when a limit abandons partitions (low) ----- #
+
+def test_shuffle_blocks_released_on_early_stop(session):
+    from spark_rapids_tpu.memory import get_store, reset_store
+    from spark_rapids_tpu.shuffle import reset_shuffle_manager
+
+    reset_store()
+    reset_shuffle_manager()
+    t = pa.table({"k": list(range(100)), "v": list(range(100))})
+    # multi-partition aggregate forces a shuffle; limit(3) stops early
+    df = (session.create_dataframe(t).union(session.create_dataframe(t))
+          .group_by("k").agg((sum_("v"), "s")).limit(3))
+    out = df.collect(engine="tpu")
+    assert out.num_rows == 3
+    store = get_store()
+    assert store._entries == {}, (
+        f"leaked {len(store._entries)} spillable buffers after collect")
+
+
+# -- semaphore: same task_id from two racing threads leaks no permit ---- #
+
+def test_semaphore_same_task_race():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    sem = TpuSemaphore(1)
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+
+    def run():
+        barrier.wait()
+        sem.acquire_if_necessary(42)
+
+    threads = [threading.Thread(target=run) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    assert not any(th.is_alive() for th in threads)
+    sem.release_if_necessary(42)
+    assert sem._available == sem.permits, "permit leaked"
+
+
+# -- disk-tier acquire keeps the spill file until upload succeeds ------- #
+
+def test_disk_acquire_survives_reserve_failure(monkeypatch):
+    import spark_rapids_tpu.memory.store as store_mod
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory import reset_store
+    from spark_rapids_tpu.memory.store import BufferStore, StorageTier
+
+    reset_store()
+    store = BufferStore(device_budget=10 ** 9, host_budget=0)
+    schema = T.Schema([T.Field("x", T.LONG)])
+    b = ColumnarBatch.from_numpy(
+        {"x": np.arange(16, dtype=np.int64)}, schema)
+    h = store.register(b)
+    h.unpin()
+    e = store._entries[h.buffer_id]
+    store._spill_to_host(e)  # host_budget=0 cascades straight to disk
+    assert e.tier == StorageTier.DISK
+
+    # first acquire attempt dies mid-upload; the file must survive
+    real = store_mod._host_to_batch
+    calls = {"n": 0}
+
+    def boom(arrays, schema):
+        if calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("injected H2D failure")
+        return real(arrays, schema)
+
+    monkeypatch.setattr(store_mod, "_host_to_batch", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        store.acquire(h.buffer_id)
+    e.pinned = False
+    got = store.acquire(h.buffer_id)  # retry succeeds from the same file
+    assert np.asarray(got.columns[0].data)[:16].tolist() == list(range(16))
+    h.close()
