@@ -1,54 +1,119 @@
-"""Benchmark driver: TPC-H q6-shaped pipeline throughput on one chip.
+"""Benchmark driver: TPC-H q6 end-to-end through the framework, one chip.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
-The metric is effective scan throughput (rows/s) of the fused
-filter+project+aggregate program over device-resident batches — the
-first milestone config in BASELINE.md (q6 @ single executor).
-`vs_baseline` compares against a CPU-Spark-class single-core columnar
-baseline of 100M rows/s for this pipeline shape (the reference claims
-3-7x over CPU Spark for full-GPU plans, docs/FAQ.md:82-88; we measure,
-not copy — this constant is our local CPU pyarrow-compute measurement
-and is re-derived in tests/test_bench_baseline.py).
+Unlike a kernel microbenchmark, this measures the REAL query path
+(BASELINE.md config #1): `TpuSession.read_parquet -> where -> agg ->
+collect`, which includes the host Parquet decode, plan tagging, H2D
+upload, the jitted filter+project+aggregate programs, the partial->
+exchange->final aggregation shape over multiple scan partitions, and the
+D2H result materialization.  Every timed iteration is a full collect()
+(the returned Arrow table forces a sync, so no async-dispatch artifact).
+
+`vs_baseline` is measured IN-RUN: the same logical plan executed by the
+CPU reference engine (pyarrow compute — the "CPU Spark" stand-in this
+repo uses for differential testing), same files, same process.
+
+A bytes/s figure against the chip's HBM roofline is included as a sanity
+check (q6 input is ~28 B/row); rows/s claims that exceed the roofline
+are physically impossible and mean the harness is broken.
 """
 
 import json
+import os
+import statistics
+import tempfile
 import time
 
-import numpy as np
+ROWS_PER_FILE = 1 << 20
+N_FILES = 6  # ~6.3M rows ~ TPC-H SF1 lineitem
+ROW_BYTES = 8 * 3 + 4  # three float64 columns + one int32 date
+TPU_ITERS = 5
+CPU_ITERS = 3
+# HBM bandwidth of the bench chip (TPU v5e ~819 GB/s); only used for the
+# roofline sanity fraction in the diagnostic fields.
+HBM_BYTES_PER_S = 819e9
 
-# Rows/s of the same q6 pipeline on one host CPU core via pyarrow.compute
-# (measured locally; see scripts/measure_cpu_baseline.py).
-CPU_BASELINE_ROWS_PER_S = 100e6
+
+def make_lineitem(dirpath: str):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    paths = []
+    for i in range(N_FILES):
+        t = pa.table({
+            "l_quantity": rng.integers(1, 51, ROWS_PER_FILE).astype(
+                np.float64),
+            "l_extendedprice": rng.uniform(900, 105000, ROWS_PER_FILE),
+            "l_discount": rng.integers(0, 11, ROWS_PER_FILE) / 100.0,
+            "l_shipdate": rng.integers(8766, 10957, ROWS_PER_FILE).astype(
+                np.int32),
+        })
+        p = os.path.join(dirpath, f"lineitem-{i}.parquet")
+        pq.write_table(t, p, row_group_size=ROWS_PER_FILE)
+        paths.append(p)
+    return paths
+
+
+def q6_dataframe(session, paths):
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import col, sum_
+
+    ship, disc, qty = col("l_shipdate"), col("l_discount"), col("l_quantity")
+    price = col("l_extendedprice")
+    cond = ((ship >= lit(8766)) & (ship < lit(9131))
+            & (disc >= lit(0.05)) & (disc <= lit(0.07))
+            & (qty < lit(24.0)))
+    return (session.read_parquet(*paths)
+            .where(cond)
+            .agg((sum_(price * disc), "revenue")))
+
+
+def _time_collect(df, engine: str, iters: int) -> tuple[float, float]:
+    """(median seconds per full collect, last result)."""
+    times = []
+    result = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        result = df.collect(engine=engine)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), result
 
 
 def main() -> None:
-    import jax
+    n_rows = ROWS_PER_FILE * N_FILES
+    with tempfile.TemporaryDirectory(prefix="q6bench_") as d:
+        paths = make_lineitem(d)
 
-    from __graft_entry__ import _example_batch, _q6_batch_fn
+        from spark_rapids_tpu.session import TpuSession
 
-    n_rows = 1 << 22  # 4M rows per batch
-    capacity = 1 << 22
-    fn = jax.jit(_q6_batch_fn())
-    batches = [_example_batch(n_rows, capacity, seed=s) for s in range(4)]
+        session = TpuSession()
+        df = q6_dataframe(session, paths)
 
-    # warmup/compile
-    out = fn(batches[0])
-    jax.block_until_ready(out.columns[0].data)
+        df.collect(engine="tpu")  # warmup: compile cache, page cache
+        tpu_t, tpu_result = _time_collect(df, "tpu", TPU_ITERS)
+        cpu_t, cpu_result = _time_collect(df, "cpu", CPU_ITERS)
 
-    iters = 8
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = fn(batches[i % len(batches)])
-    jax.block_until_ready(out.columns[0].data)
-    dt = time.perf_counter() - t0
+        # correctness gate: a fast wrong answer is not a benchmark
+        got = tpu_result.to_pydict()["revenue"][0]
+        want = cpu_result.to_pydict()["revenue"][0]
+        assert abs(got - want) <= 1e-6 * max(1.0, abs(want)), (got, want)
 
-    rows_per_s = n_rows * iters / dt
+    rows_per_s = n_rows / tpu_t
+    bytes_per_s = rows_per_s * ROW_BYTES
+    cpu_rows_per_s = n_rows / cpu_t
     print(json.dumps({
-        "metric": "q6_pipeline_throughput",
+        "metric": "tpch_q6_e2e_throughput",
         "value": round(rows_per_s, 1),
         "unit": "rows/s",
-        "vs_baseline": round(rows_per_s / CPU_BASELINE_ROWS_PER_S, 3),
+        "vs_baseline": round(rows_per_s / cpu_rows_per_s, 3),
+        "rows": n_rows,
+        "tpu_s_per_query": round(tpu_t, 4),
+        "cpu_s_per_query": round(cpu_t, 4),
+        "bytes_per_s": round(bytes_per_s, 1),
+        "hbm_roofline_fraction": round(bytes_per_s / HBM_BYTES_PER_S, 4),
     }))
 
 

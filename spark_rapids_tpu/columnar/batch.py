@@ -166,16 +166,43 @@ class ColumnarBatch:
         """Keep rows where `keep` is True, preserving order; result is
         prefix-compact with a traced num_rows.  This is the XLA equivalent
         of cudf's filter/gather (ref: basicPhysicalOperators.scala:230):
-        a stable argsort on the drop-flag moves kept rows to the front.
-        """
+        a cumsum ranks the kept rows and a searchsorted inverts that rank
+        into gather indices — O(n) scan + O(n log n) vectorized binary
+        search, much cheaper than the full stable argsort it replaces
+        (filters are the hottest op in the engine)."""
         keep = keep & self.row_mask()
-        order = jnp.argsort(~keep, stable=True)
-        n = jnp.sum(keep).astype(jnp.int32)
-        cols = [c.gather(order) for c in self.columns]
+        csum = jnp.cumsum(keep.astype(jnp.int32))
+        n = csum[-1]
+        # output slot j takes the row where csum first reaches j+1
+        src = jnp.searchsorted(
+            csum, jnp.arange(self.capacity, dtype=jnp.int32) + 1,
+            side="left").astype(jnp.int32)
+        src = jnp.minimum(src, self.capacity - 1)
+        cols = [c.gather(src) for c in self.columns]
         # rows past n are garbage; invalidate them so padding stays NULL
         live = jnp.arange(self.capacity, dtype=jnp.int32) < n
         cols = [c.with_validity(c.validity & live) for c in cols]
         return ColumnarBatch(cols, n, self.schema)
+
+    def shrink_to_capacity(self, new_cap: int) -> "ColumnarBatch":
+        """Re-bucket to a smaller capacity (cheap device slice).  Callers
+        must know num_rows <= new_cap (i.e. after a concrete_num_rows
+        sync).  Keeps downstream programs (exchange splits, concats,
+        merges) sized to the data instead of the producer's input bucket —
+        e.g. a grand-aggregate partial is 1 live row in a million-row
+        bucket without this."""
+        if not self.columns or new_cap >= self.capacity:
+            return self
+        cols: list[AnyColumn] = []
+        for c in self.columns:
+            if isinstance(c, StringColumn):
+                cols.append(StringColumn(c.chars[:new_cap],
+                                         c.lengths[:new_cap],
+                                         c.validity[:new_cap]))
+            else:
+                cols.append(Column(c.data[:new_cap], c.validity[:new_cap],
+                                   c.dtype))
+        return ColumnarBatch(cols, self.num_rows, self.schema)
 
     def slice_prefix(self, n: RowCount) -> "ColumnarBatch":
         """Logically truncate to the first n rows (no data movement)."""

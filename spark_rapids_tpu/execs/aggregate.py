@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.column import pad_capacity
 from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
 from spark_rapids_tpu.execs.basic import output_field
 from spark_rapids_tpu.exprs.aggregates import NamedAgg
@@ -137,6 +138,27 @@ class TpuHashAggregateExec(TpuExec):
         self._jits = None
         self._jit_lock = threading.Lock()
 
+    def _cache_key(self) -> tuple:
+        """Structural key for the global compile cache: covers everything
+        the three traced phases read off `self`."""
+        from spark_rapids_tpu.execs.jit_cache import exprs_key
+
+        update_specs: tuple = ()
+        if self.mode != "final":
+            update_specs = tuple((s.op, s.ordinal, repr(s.out_dtype))
+                                 for s in self._update_specs())
+        return (
+            "agg", self.mode, self.n_keys,
+            exprs_key(getattr(self, "input_exprs", ())),
+            repr(getattr(self, "update_input_schema", None)),
+            update_specs,
+            tuple((s.op, s.ordinal, repr(s.out_dtype))
+                  for s in self.merge_specs),
+            repr(self.partial_schema),
+            exprs_key(self.final_exprs),
+            repr(self._schema),
+        )
+
     @property
     def schema(self) -> T.Schema:
         return self._schema
@@ -222,9 +244,14 @@ class TpuHashAggregateExec(TpuExec):
             # exchange map tasks run partial aggregates concurrently; a
             # field-by-field lazy init could be observed half-done
             if self._jits is None:
-                self._jits = (jax.jit(self._update_batch),
-                              jax.jit(self._merge_batch),
-                              jax.jit(self._finalize_batch))
+                from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+                key = self._cache_key()
+                self._jits = (
+                    cached_jit(key + ("update",), lambda: self._update_batch),
+                    cached_jit(key + ("merge",), lambda: self._merge_batch),
+                    cached_jit(key + ("final",),
+                               lambda: self._finalize_batch))
             (self._jit_update, self._jit_merge,
              self._jit_finalize) = self._jits
 
@@ -266,6 +293,7 @@ class TpuHashAggregateExec(TpuExec):
                 else:
                     part = self._jit_update(_as_device_rows(batch))
             n = part.concrete_num_rows()
+            part = part.shrink_to_capacity(pad_capacity(n))
             pending.append(store.register(
                 part, SpillPriorities.AGGREGATE_PARTIAL))
             pending_rows += n
@@ -276,6 +304,7 @@ class TpuHashAggregateExec(TpuExec):
                 self.metrics["numMerges"].add(1)
                 pending_rows = merged.concrete_num_rows()  # before register:
                 # a register under pressure may immediately spill `merged`
+                merged = merged.shrink_to_capacity(pad_capacity(pending_rows))
                 pending.append(store.register(
                     merged, SpillPriorities.AGGREGATE_PARTIAL))
 

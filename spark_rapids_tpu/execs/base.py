@@ -167,6 +167,12 @@ class FusableExec(TpuExec):
         """Return a traceable ColumnarBatch -> ColumnarBatch function."""
         raise NotImplementedError
 
+    def fuse_key(self):
+        """Structural key identifying this exec's batch fn for the global
+        compile cache (None = not cacheable; the pipeline then compiles
+        per exec instance)."""
+        return None
+
     @property
     def num_partitions(self) -> int:
         return self.children[0].num_partitions
@@ -176,19 +182,26 @@ class FusableExec(TpuExec):
         if cached is not None:
             return cached
         # walk down through fusable children, composing their batch fns
-        fns: list[BatchFn] = [self.make_batch_fn()]
+        execs: list[FusableExec] = [self]
         node: TpuExec = self.children[0]
         while isinstance(node, FusableExec):
-            fns.append(node.make_batch_fn())
+            execs.append(node)  # type: ignore[arg-type]
             node = node.children[0]
-        fns.reverse()
+        fns: list[BatchFn] = [e.make_batch_fn() for e in reversed(execs)]
 
         def pipeline(batch: ColumnarBatch) -> ColumnarBatch:
             for f in fns:
                 batch = f(batch)
             return batch
 
-        self._fused = (jax.jit(pipeline), node)
+        keys = [e.fuse_key() for e in execs]
+        if all(k is not None for k in keys):
+            from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+            jitted = cached_jit(("fused", tuple(keys)), lambda: pipeline)
+        else:
+            jitted = jax.jit(pipeline)
+        self._fused = (jitted, node)
         return self._fused
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:

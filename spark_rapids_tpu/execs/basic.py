@@ -85,6 +85,11 @@ class TpuProjectExec(FusableExec):
 
         return fn
 
+    def fuse_key(self):
+        from spark_rapids_tpu.execs.jit_cache import exprs_key
+
+        return ("project", exprs_key(self.exprs), repr(self._schema))
+
 
 class TpuFilterExec(FusableExec):
     """Eval predicate -> compact (ref: basicPhysicalOperators.scala:184,230).
@@ -112,6 +117,11 @@ class TpuFilterExec(FusableExec):
             return batch.compact(keep)
 
         return fn
+
+    def fuse_key(self):
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        return ("filter", expr_key(self.condition))
 
 
 class TpuRangeExec(TpuExec):

@@ -74,24 +74,41 @@ class TpuShuffleExchangeExec(TpuExec):
         manager = get_shuffle_manager()
         n = self.num_partitions
         part = self.partitioning
-        key = 0
-        if isinstance(part, RoundRobinPartitioning):
-            # offset per map task so output stays balanced (the reference
-            # randomizes the start position per task)
-            key = child_part % n
-            part = RoundRobinPartitioning(n, start=key)
-        with self._pid_lock:
-            pid_fn = self._pid_fns.get(key)
-            if pid_fn is None:
-                pid_fn = self._pid_fns[key] = jax.jit(part.partition_ids)
+        pid_fn = None
+        if n > 1:  # single destination never reads partition ids
+            key = 0
+            if isinstance(part, RoundRobinPartitioning):
+                # offset per map task so output stays balanced (the
+                # reference randomizes the start position per task)
+                key = child_part % n
+                part = RoundRobinPartitioning(n, start=key)
+            with self._pid_lock:
+                pid_fn = self._pid_fns.get(key)
+                if pid_fn is None:
+                    from spark_rapids_tpu.execs.jit_cache import (
+                        cached_jit,
+                        exprs_key,
+                    )
+
+                    ck = ("part", type(part).__name__, part.num_partitions,
+                          getattr(part, "start", 0),
+                          exprs_key(getattr(part, "exprs", ())))
+                    pid_fn = self._pid_fns[key] = cached_jit(
+                        ck, lambda: part.partition_ids)
+        from spark_rapids_tpu.columnar.column import pad_capacity
+
         try:
             for batch in self.children[0].execute_partition(child_part):
                 sem.acquire_if_necessary(task_id)
                 batch = batch.with_device_num_rows()
-                pids = pid_fn(batch)
-                for rid, sub in enumerate(split_batch(batch, pids, n)):
+                if pid_fn is None:
+                    subs = [batch]
+                else:
+                    subs = split_batch(batch, pid_fn(batch), n)
+                for rid, sub in enumerate(subs):
                     rows = sub.concrete_num_rows()
                     if rows:
+                        sub = sub.shrink_to_capacity(pad_capacity(rows))
                         self.metrics["shuffleWriteRows"].add(rows)
                         manager.write(self._shuffle_id, rid, sub)
         finally:

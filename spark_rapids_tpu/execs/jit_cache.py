@@ -1,0 +1,87 @@
+"""Process-global compiled-program cache.
+
+Every collect() builds a fresh exec tree, and jax.jit's compile cache is
+per-wrapper — so without sharing, each query run re-traces and
+re-compiles XLA programs identical to the last run's.  The reference
+never pays this: cudf kernels are pre-compiled native code invoked per
+batch (SURVEY.md L0).  The XLA analog is a *structural program key*: two
+execs whose compute is determined by equal expression trees / specs share
+one jit wrapper, so the second query (and every query after) hits the
+compile cache at trace level.
+
+Keys must capture everything the traced function reads that is not part
+of the input pytree: bound expression trees (ordinals, dtypes, literal
+values), agg specs, static capacities, output schemas.  Input batch
+shape/dtype/schema ride the pytree and are keyed by jax itself.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Callable, Sequence
+
+import jax
+
+from spark_rapids_tpu.exprs.base import Expression
+
+_LOCK = threading.Lock()
+#: LRU: a long-lived process serving many distinct ad-hoc query shapes
+#: must not pin every query's exec tree (cached closures retain the exec
+#: instance that created them) and jax executable forever.
+_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+MAX_ENTRIES = 512
+
+
+def _field_key(v) -> str:
+    """Serialize one dataclass field value; recurses into tuples so nested
+    containers of Expressions (CaseWhen's branch pairs) serialize
+    structurally instead of through Expression.__repr__ (which is
+    name-only and would collide across ordinals/dtypes)."""
+    if isinstance(v, Expression):
+        return expr_key(v)
+    if isinstance(v, tuple):
+        return "(" + ",".join(_field_key(x) for x in v) + ")"
+    return repr(v)
+
+
+def expr_key(e) -> str:
+    """Deterministic structural serialization of a bound expression tree:
+    class names plus every dataclass field (ordinals, dtypes, literal
+    values) — everything eval() reads."""
+    if not isinstance(e, Expression):
+        return repr(e)
+    if dataclasses.is_dataclass(e):
+        parts = [_field_key(getattr(e, f.name))
+                 for f in dataclasses.fields(e)]
+        return f"{type(e).__name__}[{','.join(parts)}]"
+    return type(e).__name__
+
+
+def exprs_key(es: Sequence) -> tuple:
+    return tuple(expr_key(e) for e in es)
+
+
+def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
+    """Return a jitted callable shared by every caller presenting `key`.
+    `make_fn` is invoked (once) only on a cache miss."""
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is None:
+            fn = _CACHE[key] = jax.jit(make_fn())
+            while len(_CACHE) > MAX_ENTRIES:
+                _CACHE.popitem(last=False)
+        else:
+            _CACHE.move_to_end(key)
+        return fn
+
+
+def cache_size() -> int:
+    with _LOCK:
+        return len(_CACHE)
+
+
+def clear() -> None:
+    with _LOCK:
+        _CACHE.clear()

@@ -146,22 +146,46 @@ class TpuShuffledHashJoinExec(TpuExec):
                              matched, num_rows, self._schema,
                              stream_first=stream_first)
 
+    def _cache_key(self) -> tuple:
+        """Computed once per exec: the serialization is recursive and the
+        hot probe loop must not re-pay it per stream batch."""
+        key = getattr(self, "_ck", None)
+        if key is None:
+            from spark_rapids_tpu.execs.jit_cache import exprs_key
+
+            key = self._ck = (
+                "join", self.join_type, self.build_is_right,
+                exprs_key(self.left_keys), exprs_key(self.right_keys),
+                repr(self._schema))
+        return key
+
     def _jit_expand(self, out_cap: int):
         """One cached jitted expansion program per output bucket (the
-        JoinGatherer-chunking analog of compile caching)."""
+        JoinGatherer-chunking analog of compile caching); memoized per
+        instance so the per-batch path is a dict hit."""
         cache = getattr(self, "_expand_cache", None)
         if cache is None:
             cache = self._expand_cache = {}
-        if out_cap not in cache:
+        fn = cache.get(out_cap)
+        if fn is None:
             from functools import partial
 
-            cache[out_cap] = jax.jit(partial(self._expand, out_cap=out_cap))
-        return cache[out_cap]
+            from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+            fn = cache[out_cap] = cached_jit(
+                self._cache_key() + ("expand", out_cap),
+                lambda: partial(self._expand, out_cap=out_cap))
+        return fn
 
     @property
     def _jit_condition(self):
         fn = getattr(self, "_cond_fn", None)
         if fn is None:
+            from spark_rapids_tpu.execs.jit_cache import (
+                cached_jit,
+                expr_key,
+            )
+
             cond = self.condition
 
             def apply(batch):
@@ -169,7 +193,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                 p = cond.eval(ctx)
                 return batch.compact(p.data.astype(bool) & p.validity)
 
-            fn = self._cond_fn = jax.jit(apply)
+            fn = self._cond_fn = cached_jit(
+                ("join_cond", expr_key(cond)), lambda: apply)
         return fn
 
     def execute(self) -> Iterator[ColumnarBatch]:
@@ -179,9 +204,13 @@ class TpuShuffledHashJoinExec(TpuExec):
                 return  # empty build: no output
             build = self._empty_build()
 
-        jit_probe = jax.jit(self._probe)
-        jit_semi_compact = jax.jit(
-            lambda stream, keep: stream.compact(keep))
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        jit_probe = cached_jit(self._cache_key() + ("probe",),
+                               lambda: self._probe)
+        jit_semi_compact = cached_jit(
+            ("semi_compact",), lambda: lambda stream, keep:
+            stream.compact(keep))
         matched_b_acc = None
 
         stream_child = (self.children[0] if self.build_is_right
@@ -244,6 +273,9 @@ class TpuShuffledHashJoinExec(TpuExec):
                 cols = list(compacted.columns) + null_cols
             return ColumnarBatch(cols, compacted.num_rows, self._schema)
 
-        out = jax.jit(unmatched)(build, matched_b)
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        out = cached_jit(self._cache_key() + ("unmatched",),
+                         lambda: unmatched)(build, matched_b)
         if out.concrete_num_rows() > 0:
             yield self._count_output(out)

@@ -42,6 +42,12 @@ class _SortMixin(TpuExec):
         self.keys = [SortKey(bind_references(k.expr, child.schema),
                              k.descending, k.nulls_last) for k in keys]
 
+    def _keys_cache_key(self) -> tuple:
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        return tuple((expr_key(k.expr), k.descending, k.nulls_last)
+                     for k in self.keys)
+
     def _sorted(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Append evaluated key columns, sort, drop them (traceable)."""
         ctx = EvalContext.for_batch(batch)
@@ -69,7 +75,10 @@ class TpuSortExec(_SortMixin):
         super().__init__(child)
         self._bind(keys, child)
         self.global_sort = global_sort
-        self._jit_sorted = jax.jit(self._sorted)
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        self._jit_sorted = cached_jit(("sort", self._keys_cache_key()),
+                                      lambda: self._sorted)
 
     @property
     def schema(self) -> T.Schema:
@@ -145,7 +154,10 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
         return s.slice_prefix(self.n)
 
     def execute(self) -> Iterator[ColumnarBatch]:
-        jit_topn = jax.jit(self._topn)
+        from spark_rapids_tpu.execs.jit_cache import cached_jit, exprs_key
+
+        jit_topn = cached_jit(
+            ("topn", self.n, self._keys_cache_key()), lambda: self._topn)
         top: Optional[ColumnarBatch] = None
         for b in self.children[0].execute():
             with MetricTimer(self.metrics[TOTAL_TIME]):
@@ -163,5 +175,7 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
                 return ColumnarBatch([e.eval(ctx) for e in self.project],
                                      batch.num_rows, self._schema)
 
-            out = jax.jit(proj)(out)
+            out = cached_jit(
+                ("topn_proj", exprs_key(self.project), repr(self._schema)),
+                lambda: proj)(out)
         yield self._count_output(out)

@@ -91,6 +91,10 @@ def split_batch(batch: ColumnarBatch, pids: jax.Array, n_parts: int
     """Group rows by partition id and slice out per-partition batches.
     One device sort + one sizing sync per input batch (the analog of
     cudf's Table.partition returning parts + offsets)."""
+    if n_parts == 1:
+        # single destination: the batch IS the slice (grand-aggregate
+        # exchanges hit this constantly)
+        return [batch]
     live = batch.row_mask()
     key = jnp.where(live, pids, jnp.int32(n_parts))
     order = jnp.argsort(key, stable=True)
