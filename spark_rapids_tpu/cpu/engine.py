@@ -637,7 +637,175 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
         return pa.concat_tables(tables)
     if isinstance(plan, L.Join):
         return _join_cpu(plan)
+    if isinstance(plan, L.Window):
+        return _window_cpu(plan)
     raise NotImplementedError(f"CPU engine: {plan.name}")
+
+
+class _RevCmp:
+    """Reverses comparison order for descending sort keys (works for any
+    comparable payload, unlike numeric negation)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, o):
+        return o.v < self.v
+
+    def __eq__(self, o):
+        return self.v == o.v
+
+
+def _canon_key(v):
+    """Canonicalize a value for grouping/peers: NULL==NULL, NaN==NaN."""
+    if isinstance(v, float) and np.isnan(v):
+        return ("nan",)
+    return v
+
+
+def _sort_entry(v, descending, nulls_last):
+    null_flag = (1 if nulls_last else 0) if v is None else \
+        (0 if nulls_last else 1)
+    if v is None:
+        return (null_flag, 0)
+    if isinstance(v, float) and np.isnan(v):
+        # Spark sorts NaN greatest among values
+        v = _NaNGreatest()
+    return (null_flag, _RevCmp(v) if descending else v)
+
+
+class _NaNGreatest:
+    __slots__ = ()
+
+    def __lt__(self, o):
+        return False  # nothing is greater than NaN
+
+    def __gt__(self, o):
+        return not isinstance(o, _NaNGreatest)
+
+    def __eq__(self, o):
+        return isinstance(o, _NaNGreatest)
+
+
+def _window_cpu(plan: L.Window) -> pa.Table:
+    """Reference implementation with explicit per-group python loops —
+    deliberately simple and independent of the TPU kernels (the oracle
+    role of 'CPU Spark' in the differential harness)."""
+    from spark_rapids_tpu.exprs import window as WX
+
+    child = execute_cpu(plan.children[0])
+    n = child.num_rows
+    spec = plan.window_exprs[0][0].spec
+    pvals = [cpu_eval(e, child).to_pylist() for e in spec.partition_by]
+    ovals = [cpu_eval(k.expr, child).to_pylist() for k in spec.order_by]
+
+    def sort_key(i):
+        parts = [_sort_entry(c[i], False, False) for c in pvals]
+        parts += [_sort_entry(c[i], k.descending, k.nulls_last)
+                  for c, k in zip(ovals, spec.order_by)]
+        return tuple(parts)
+
+    order = sorted(range(n), key=sort_key)
+    pkey = [tuple(_canon_key(c[i]) for c in pvals) for i in range(n)]
+    okey = [tuple(_canon_key(c[i]) for c in ovals) for i in range(n)]
+
+    # group boundaries over the sorted order
+    groups: list[list[int]] = []
+    for pos, i in enumerate(order):
+        if pos == 0 or pkey[i] != pkey[order[pos - 1]]:
+            groups.append([])
+        groups[-1].append(i)
+
+    out_cols: dict[str, list] = {name: [None] * n
+                                 for _we, name in plan.window_exprs}
+    for we, name in plan.window_exprs:
+        fn = we.fn
+        vals = None
+        dvals = None
+        if fn.inputs():
+            vals = cpu_eval(fn.inputs()[0], child).to_pylist()
+        if isinstance(fn, WX.Lead) and fn.default is not None:
+            dvals = cpu_eval(fn.default, child).to_pylist()
+        col = out_cols[name]
+        for g in groups:
+            m = len(g)
+            gok = [okey[i] for i in g]
+            for pos, i in enumerate(g):
+                if isinstance(fn, WX.RowNumber):
+                    col[i] = pos + 1
+                elif isinstance(fn, WX.Rank):
+                    col[i] = gok.index(gok[pos]) + 1
+                elif isinstance(fn, WX.DenseRank):
+                    seen, dr = None, 0
+                    for q in range(pos + 1):
+                        if gok[q] != seen:
+                            dr += 1
+                            seen = gok[q]
+                    col[i] = dr
+                elif isinstance(fn, WX.Lead):  # Lag subclasses Lead
+                    j = pos + fn.shift
+                    if 0 <= j < m:
+                        col[i] = vals[g[j]]
+                    elif dvals is not None:
+                        col[i] = dvals[i]
+                elif isinstance(fn, WX.WindowAgg):
+                    frame = we.spec.resolved_frame()
+                    if frame.mode == "rows":
+                        lo = 0 if frame.start is None else max(
+                            0, pos + frame.start)
+                        hi = m - 1 if frame.end is None else min(
+                            m - 1, pos + frame.end)
+                        if hi < lo or hi < 0:  # empty frame (e.g. end
+                            lo, hi = 0, -1  # still before the partition)
+                    else:
+                        if frame.start is not None or frame.end not in (
+                                0, None):
+                            raise NotImplementedError(
+                                "bounded RANGE window frames (value-based "
+                                "offsets) are not implemented")
+                        lo = 0
+                        if frame.end is None:
+                            hi = m - 1
+                        else:  # current peer group's last row
+                            hi = pos
+                            while hi + 1 < m and gok[hi + 1] == gok[pos]:
+                                hi += 1
+                    col[i] = _frame_agg(fn.agg, vals, g, lo, hi)
+        # order within ties of the TPU sort may differ; that is fine — the
+        # differential harness compares row sets, and ranking fns only
+        # depend on key values
+    arrays = [child.column(j) for j in range(child.num_columns)]
+    names = list(child.schema.names)
+    aschema = schema_to_arrow(plan.schema)
+    for we, name in plan.window_exprs:
+        arrays.append(pa.array(out_cols[name],
+                               type=aschema.field(name).type))
+        names.append(name)
+    return pa.Table.from_arrays(arrays, names=names).cast(aschema)
+
+
+def _frame_agg(agg, vals, g, lo, hi):
+    from spark_rapids_tpu.exprs import aggregates as AGG
+
+    window_rows = g[lo:hi + 1] if hi >= lo >= 0 else []
+    if isinstance(agg, AGG.CountStar):
+        return len(window_rows)
+    xs = [vals[i] for i in window_rows if vals[i] is not None]
+    if isinstance(agg, AGG.Count):
+        return len(xs)
+    if not xs:
+        return None
+    if isinstance(agg, AGG.Sum):
+        return sum(xs)
+    if isinstance(agg, AGG.Min):
+        return min(xs)
+    if isinstance(agg, AGG.Max):
+        return max(xs)
+    if isinstance(agg, AGG.Average):
+        return sum(float(x) for x in xs) / len(xs)
+    raise NotImplementedError(type(agg).__name__)
 
 
 def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:

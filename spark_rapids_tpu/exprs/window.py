@@ -1,0 +1,384 @@
+"""Window expressions: functions + specs + frames.
+
+Counterpart of the reference's GpuWindowExpression / GpuWindowSpecDefinition
+/ GpuSpecifiedWindowFrame family (ref: GpuWindowExpression.scala:174,
+207-296,856) and the ranking/offset functions Lead/Lag/RowNumber from
+Appendix A.  A WindowExpression is an Expression for planning purposes
+(dtype, tagging, explain) but never evaluates inline — the planner routes
+it to TpuWindowExec, which computes all window columns of a projection in
+one segmented-scan program (ops.window).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.aggregates import (
+    AggregateFunction,
+    Average,
+    Count,
+    CountStar,
+    Max,
+    Min,
+    Sum,
+)
+from spark_rapids_tpu.exprs.base import Expression, bind_references
+from spark_rapids_tpu.execs.sort import SortKey
+
+#: offset value meaning "unbounded" in a frame bound
+UNBOUNDED = None
+CURRENT_ROW = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    """ROWS/RANGE frame with offsets relative to the current row
+    (negative = preceding, None = unbounded on that side).  Spark default
+    with an ORDER BY: RANGE UNBOUNDED PRECEDING .. CURRENT ROW; without:
+    the whole partition."""
+
+    mode: str = "range"  # "rows" | "range"
+    start: Optional[int] = UNBOUNDED
+    end: Optional[int] = CURRENT_ROW
+
+    def __post_init__(self):
+        assert self.mode in ("rows", "range"), self.mode
+
+    def describe(self) -> str:
+        def b(v, side):
+            if v is None:
+                return f"unbounded {side}"
+            if v == 0:
+                return "current row"
+            return f"{-v} preceding" if v < 0 else f"{v} following"
+
+        return (f"{self.mode} between {b(self.start, 'preceding')} "
+                f"and {b(self.end, 'following')}")
+
+
+WHOLE_PARTITION = WindowFrame("rows", UNBOUNDED, UNBOUNDED)
+DEFAULT_ORDERED = WindowFrame("range", UNBOUNDED, CURRENT_ROW)
+
+
+@dataclasses.dataclass(repr=False)
+class WindowSpec:
+    partition_by: tuple = ()
+    order_by: tuple = ()  # of SortKey
+    frame: Optional[WindowFrame] = None  # None = Spark default
+
+    def resolved_frame(self) -> WindowFrame:
+        if self.frame is not None:
+            return self.frame
+        return DEFAULT_ORDERED if self.order_by else WHOLE_PARTITION
+
+    def describe(self) -> str:
+        ps = ", ".join(e.name for e in self.partition_by)
+        os_ = ", ".join(
+            f"{k.expr.name}{' DESC' if k.descending else ''}"
+            for k in self.order_by)
+        return (f"partition by [{ps}] order by [{os_}] "
+                f"{self.resolved_frame().describe()}")
+
+
+class Window:
+    """pyspark-shaped WindowSpec builder:
+    Window.partition_by("k").order_by("ts").rows_between(-3, 0)"""
+
+    @staticmethod
+    def partition_by(*cols) -> "WindowSpecBuilder":
+        return WindowSpecBuilder().partition_by(*cols)
+
+    @staticmethod
+    def order_by(*keys) -> "WindowSpecBuilder":
+        return WindowSpecBuilder().order_by(*keys)
+
+
+class WindowSpecBuilder:
+    def __init__(self):
+        self._partition: list[Expression] = []
+        self._order: list[SortKey] = []
+        self._frame: Optional[WindowFrame] = None
+
+    def partition_by(self, *cols) -> "WindowSpecBuilder":
+        from spark_rapids_tpu.exprs.base import ColumnReference
+
+        for c in cols:
+            self._partition.append(
+                ColumnReference(c) if isinstance(c, str) else c)
+        return self
+
+    def order_by(self, *keys, desc: bool = False) -> "WindowSpecBuilder":
+        from spark_rapids_tpu.exprs.base import ColumnReference
+
+        for k in keys:
+            if isinstance(k, SortKey):
+                self._order.append(k)
+            else:
+                e = ColumnReference(k) if isinstance(k, str) else k
+                self._order.append(SortKey(e, descending=desc,
+                                           nulls_last=desc))
+        return self
+
+    def rows_between(self, start: Optional[int],
+                     end: Optional[int]) -> "WindowSpecBuilder":
+        self._frame = WindowFrame("rows", start, end)
+        return self
+
+    def range_between(self, start: Optional[int],
+                      end: Optional[int]) -> "WindowSpecBuilder":
+        self._frame = WindowFrame("range", start, end)
+        return self
+
+    def build(self) -> WindowSpec:
+        return WindowSpec(tuple(self._partition), tuple(self._order),
+                          self._frame)
+
+
+def _spec(s: Union[WindowSpec, WindowSpecBuilder]) -> WindowSpec:
+    return s.build() if isinstance(s, WindowSpecBuilder) else s
+
+
+@dataclasses.dataclass(repr=False)
+class WindowExpression(Expression):
+    """fn over spec.  Never evaluated inline — planned into
+    TpuWindowExec."""
+
+    fn: "WindowFunction"
+    spec: WindowSpec
+
+    def __post_init__(self):
+        # query-invalidity (vs device-capability) errors surface at
+        # construction, like Spark's AnalysisException — they must NOT
+        # become CPU fallbacks that silently compute degenerate results
+        self.fn.check_analysis(self.spec)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.fn.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.fn.nullable
+
+    @property
+    def name(self) -> str:
+        return f"{self.fn.describe()} over ({self.spec.describe()})"
+
+    @property
+    def children(self):
+        return tuple(self.fn.inputs()) + tuple(self.spec.partition_by) \
+            + tuple(k.expr for k in self.spec.order_by)
+
+    def bind(self, schema: T.Schema) -> "WindowExpression":
+        spec = WindowSpec(
+            tuple(bind_references(e, schema)
+                  for e in self.spec.partition_by),
+            tuple(SortKey(bind_references(k.expr, schema), k.descending,
+                          k.nulls_last) for k in self.spec.order_by),
+            self.spec.frame)
+        return WindowExpression(self.fn.bind(schema), spec)
+
+    def check_supported(self) -> None:
+        self.fn.check_supported(self.spec)
+
+
+class WindowFunction:
+    """Base for functions usable over a window."""
+
+    @property
+    def dtype(self) -> T.DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def inputs(self) -> list[Expression]:
+        return []
+
+    def bind(self, schema: T.Schema) -> "WindowFunction":
+        return self
+
+    def describe(self) -> str:
+        return type(self).__name__.lower()
+
+    def check_analysis(self, spec: WindowSpec) -> None:
+        """Query-validity checks (raise = invalid query, both engines)."""
+
+    def check_supported(self, spec: WindowSpec) -> None:
+        """Device-capability checks (raise = CPU fallback)."""
+
+    def over(self, spec) -> WindowExpression:
+        return WindowExpression(self, _spec(spec))
+
+
+class _RankingFunction(WindowFunction):
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def check_analysis(self, spec: WindowSpec) -> None:
+        if not spec.order_by:
+            raise ValueError(
+                f"{self.describe()}() requires a window ORDER BY")
+
+
+class RowNumber(_RankingFunction):
+    pass
+
+
+class Rank(_RankingFunction):
+    pass
+
+
+class DenseRank(_RankingFunction):
+    def describe(self) -> str:
+        return "dense_rank"
+
+
+@dataclasses.dataclass(repr=False)
+class Lead(WindowFunction):
+    """lead(expr, offset, default): value `offset` rows after the current
+    row within the partition (lag = negative direction)."""
+
+    child: Expression
+    offset: int = 1
+    default: Optional[Expression] = None
+
+    _sign = 1
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def inputs(self) -> list[Expression]:
+        return [self.child] + ([self.default] if self.default is not None
+                               else [])
+
+    def bind(self, schema: T.Schema) -> "Lead":
+        return type(self)(
+            bind_references(self.child, schema), self.offset,
+            bind_references(self.default, schema)
+            if self.default is not None else None)
+
+    def describe(self) -> str:
+        return f"{type(self).__name__.lower()}({self.child.name}, " \
+               f"{self.offset})"
+
+    def check_analysis(self, spec: WindowSpec) -> None:
+        if not spec.order_by:
+            raise ValueError(
+                f"{type(self).__name__.lower()}() requires a window "
+                "ORDER BY")
+
+    def check_supported(self, spec: WindowSpec) -> None:
+        if self.default is None:
+            return
+        try:
+            dt = self.child.dtype
+        except RuntimeError:  # unbound reference; planner re-checks bound
+            return
+        if isinstance(dt, T.StringType):
+            raise TypeError(
+                "lead/lag with a default over STRING is not supported on "
+                "TPU (string defaults need a width-merged select)")
+
+    @property
+    def shift(self) -> int:
+        return self._sign * self.offset
+
+
+class Lag(Lead):
+    _sign = -1
+
+
+@dataclasses.dataclass(repr=False)
+class WindowAgg(WindowFunction):
+    """An aggregate function evaluated over the window frame."""
+
+    agg: AggregateFunction
+
+    _SUPPORTED = (Sum, Count, CountStar, Min, Max, Average)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.agg.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.agg.nullable
+
+    def inputs(self) -> list[Expression]:
+        return self.agg.inputs()
+
+    def bind(self, schema: T.Schema) -> "WindowAgg":
+        return WindowAgg(self.agg.bind(schema))
+
+    def describe(self) -> str:
+        ins = ", ".join(e.name for e in self.agg.inputs())
+        return f"{self.agg.name}({ins})"
+
+    def check_supported(self, spec: WindowSpec) -> None:
+        if not isinstance(self.agg, self._SUPPORTED):
+            raise TypeError(
+                f"aggregate {self.agg.name} is not supported over a "
+                "window on TPU")
+        for e in self.agg.inputs():
+            try:
+                dt = e.dtype
+            except RuntimeError:  # unbound; planner re-checks bound
+                continue
+            if isinstance(dt, T.StringType):
+                raise TypeError(
+                    "window aggregates over STRING are not supported on "
+                    "TPU (falls back)")
+        frame = spec.resolved_frame()
+        if frame.mode == "range" and (frame.start is not UNBOUNDED or
+                                      frame.end not in (CURRENT_ROW,
+                                                        UNBOUNDED)):
+            raise TypeError(
+                "only RANGE BETWEEN UNBOUNDED PRECEDING AND "
+                "CURRENT ROW/UNBOUNDED FOLLOWING is supported")
+        if isinstance(self.agg, (Min, Max)):
+            if frame.start is not UNBOUNDED and frame.end is not UNBOUNDED:
+                raise TypeError(
+                    "min/max window frames must be unbounded on one side "
+                    "on TPU (bounded-both-sides falls back)")
+
+
+# Give every AggregateFunction an .over() so session aggregates compose:
+# sum_("v").over(Window.partition_by("k"))
+AggregateFunction.over = (  # type: ignore[attr-defined]
+    lambda self, spec: WindowExpression(WindowAgg(self), _spec(spec)))
+
+
+def row_number() -> RowNumber:
+    return RowNumber()
+
+
+def rank() -> Rank:
+    return Rank()
+
+
+def dense_rank() -> DenseRank:
+    return DenseRank()
+
+
+def lead(e, offset: int = 1, default=None) -> Lead:
+    from spark_rapids_tpu.exprs.base import ColumnReference
+
+    e = ColumnReference(e) if isinstance(e, str) else e
+    return Lead(e, offset, default)
+
+
+def lag(e, offset: int = 1, default=None) -> Lag:
+    from spark_rapids_tpu.exprs.base import ColumnReference
+
+    e = ColumnReference(e) if isinstance(e, str) else e
+    return Lag(e, offset, default)

@@ -244,6 +244,34 @@ class Join(LogicalPlan):
         return f"Join {self.join_type} [{ks}]{c}"
 
 
+class Window(LogicalPlan):
+    """One (partition_by, order_by) group of window expressions appended
+    to the child's output (ref: Spark's WindowExec contract; the session
+    frontend splits mixed specs into a chain of Window nodes)."""
+
+    def __init__(self, window_exprs, child: LogicalPlan):
+        self.children = [child]
+        self.window_exprs = [(we.bind(child.schema), name)
+                             for we, name in window_exprs]
+        spec0 = self.window_exprs[0][0].spec
+        for we, _ in self.window_exprs[1:]:
+            assert (we.spec.partition_by, we.spec.order_by) == \
+                (spec0.partition_by, spec0.order_by)
+        self._schema = T.Schema(
+            list(child.schema.fields)
+            + [T.Field(name, we.dtype, we.nullable)
+               for we, name in self.window_exprs])
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        fns = ", ".join(f"{we.fn.describe()}->{n}"
+                        for we, n in self.window_exprs)
+        return f"Window [{fns}] ({self.window_exprs[0][0].spec.describe()})"
+
+
 class Union(LogicalPlan):
     def __init__(self, children: Sequence[LogicalPlan]):
         assert children

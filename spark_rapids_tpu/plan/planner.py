@@ -96,7 +96,7 @@ _EXEC_CONFS = {
                   f"Enable TPU execution of {cls.__name__}.")
     for cls in (L.InMemoryRelation, L.ParquetRelation, L.CsvRelation,
                 L.RangeRel, L.Project, L.Filter, L.Aggregate, L.Sort,
-                L.Limit, L.Join, L.Union)
+                L.Limit, L.Join, L.Union, L.Window)
 }
 
 
@@ -172,6 +172,14 @@ class PlanMeta:
         elif isinstance(p, L.Sort):
             for k in p.keys:
                 _check_expr(k.expr, conf, self.reasons)
+        elif isinstance(p, L.Window):
+            for we, _name in p.window_exprs:
+                for e in we.children:
+                    _check_expr(e, conf, self.reasons)
+                try:
+                    we.check_supported()
+                except TypeError as exc:
+                    self.will_not_work(str(exc))
         elif isinstance(p, L.Join):
             for e in list(p.left_keys) + list(p.right_keys):
                 _check_expr(e, conf, self.reasons)
@@ -279,6 +287,10 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
         return _plan_aggregate(p, kids[0])
     if isinstance(p, L.Sort):
         return TpuSortExec(p.keys, kids[0])
+    if isinstance(p, L.Window):
+        from spark_rapids_tpu.execs.window import TpuWindowExec
+
+        return TpuWindowExec(p.window_exprs, kids[0])
     if isinstance(p, L.Limit):
         return TpuGlobalLimitExec(p.n, kids[0])
     if isinstance(p, L.Union):

@@ -76,6 +76,24 @@ def last(e: ExprLike, ignore_nulls: bool = False) -> Last:
     return Last(_expr(e), ignore_nulls)
 
 
+def _extract_windows(e: Expression, acc: list) -> Expression:
+    """Replace every WindowExpression subtree with a reference to a
+    generated column the Window node will produce."""
+    from spark_rapids_tpu.exprs.window import WindowExpression
+
+    if isinstance(e, WindowExpression):
+        name = f"__w{len(acc)}"
+        acc.append((e, name))
+        return ColumnReference(name)
+    kids = e.children
+    if not kids:
+        return e
+    new = [_extract_windows(c, acc) for c in kids]
+    if all(n is o for n, o in zip(new, kids)):
+        return e
+    return e.with_children(new)
+
+
 class TpuSession:
     """Counterpart of the SparkSession with the plugin installed
     (ref: SQLPlugin.scala — here session == plugin)."""
@@ -136,8 +154,28 @@ class DataFrame:
     # -- transformations ------------------------------------------------ #
 
     def select(self, *exprs: ExprLike) -> "DataFrame":
-        return DataFrame(L.Project([_expr(e) for e in exprs], self._plan),
-                         self._session)
+        """Projection; window expressions anywhere in the select list are
+        extracted into Window nodes under the projection (one node per
+        (partition_by, order_by) group), mirroring Spark's
+        ExtractWindowExpressions analysis rule."""
+        from spark_rapids_tpu.exprs.window import WindowExpression
+
+        exprs_ = [_expr(e) for e in exprs]
+        acc: list[tuple[WindowExpression, str]] = []
+        rewritten = [_extract_windows(e, acc) for e in exprs_]
+        plan = self._plan
+        if acc:
+            from spark_rapids_tpu.execs.jit_cache import exprs_key
+
+            groups: dict[tuple, list] = {}
+            for we, name in acc:
+                gk = (exprs_key(we.spec.partition_by),
+                      tuple((repr(k.expr), k.descending, k.nulls_last)
+                            for k in we.spec.order_by))
+                groups.setdefault(gk, []).append((we, name))
+            for group in groups.values():
+                plan = L.Window(group, plan)
+        return DataFrame(L.Project(rewritten, plan), self._session)
 
     def where(self, cond: Expression) -> "DataFrame":
         return DataFrame(L.Filter(cond, self._plan), self._session)

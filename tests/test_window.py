@@ -1,0 +1,203 @@
+"""Window function differential tests (TPU vs CPU oracle) — the q67/q93
+milestone shape (BASELINE.md config #4)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exprs.window import (
+    Window,
+    dense_rank,
+    lag,
+    lead,
+    rank,
+    row_number,
+)
+from spark_rapids_tpu.session import (
+    TpuSession,
+    avg,
+    col,
+    count,
+    count_star,
+    max_,
+    min_,
+    sum_,
+)
+from tests.differential import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _sales(n=200, seed=3, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 8, n)
+    v = rng.integers(-50, 50, n).astype(np.float64)
+    ts = rng.permutation(n).astype(np.int64)  # unique order key
+    vals = [None if (with_nulls and rng.random() < 0.15) else float(x)
+            for x in v]
+    return pa.table({"k": k, "ts": ts, "v": vals})
+
+
+def test_row_number_rank_dense_rank(session):
+    # rank/dense_rank need ties: order by a coarse key
+    t = _sales(with_nulls=False)
+    df = session.create_dataframe(t)
+    w = Window.partition_by("k").order_by("v")
+    out = df.select(
+        "k", "ts", "v",
+        rank().over(w).alias("rnk"),
+        dense_rank().over(w).alias("drnk"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_row_number_unique_order(session):
+    df = session.create_dataframe(_sales())
+    w = Window.partition_by("k").order_by("ts")
+    out = df.select("k", "ts", row_number().over(w).alias("rn"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_lead_lag(session):
+    df = session.create_dataframe(_sales())
+    w = Window.partition_by("k").order_by("ts")
+    out = df.select(
+        "k", "ts", "v",
+        lead("v").over(w).alias("nxt"),
+        lag("v", 2).over(w).alias("prev2"),
+        lead("v", 1, col("v")).over(w).alias("nxt_dflt"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_running_sum_range_frame_with_ties(session):
+    # default frame (RANGE unbounded preceding..current row) must include
+    # ALL peer rows of a tie — order by a coarse key to force ties
+    rng = np.random.default_rng(11)
+    t = pa.table({
+        "k": rng.integers(0, 4, 100),
+        "o": rng.integers(0, 5, 100),  # heavy ties
+        "v": rng.integers(0, 10, 100).astype(np.int64),
+    })
+    df = session.create_dataframe(t)
+    w = Window.partition_by("k").order_by("o")
+    out = df.select("k", "o", "v", sum_("v").over(w).alias("rsum"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_rows_frames_sum_count_avg(session):
+    df = session.create_dataframe(_sales())
+    w3 = Window.partition_by("k").order_by("ts").rows_between(-3, 0)
+    wfwd = Window.partition_by("k").order_by("ts").rows_between(0, 2)
+    out = df.select(
+        "k", "ts", "v",
+        sum_("v").over(w3).alias("s3"),
+        count("v").over(w3).alias("c3"),
+        count_star().over(wfwd).alias("cs_fwd"),
+        avg("v").over(wfwd).alias("a_fwd"))
+    assert_tpu_cpu_equal(out, approx_float=True)
+
+
+def test_min_max_running_and_whole_partition(session):
+    df = session.create_dataframe(_sales())
+    run = Window.partition_by("k").order_by("ts")
+    whole = Window.partition_by("k")
+    out = df.select(
+        "k", "ts", "v",
+        min_("v").over(run).alias("run_min"),
+        max_("v").over(run).alias("run_max"),
+        min_("v").over(whole).alias("p_min"),
+        max_("v").over(whole).alias("p_max"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_whole_partition_agg_no_order(session):
+    df = session.create_dataframe(_sales())
+    w = Window.partition_by("k")
+    out = df.select("k", "v", sum_("v").over(w).alias("total"),
+                    avg("v").over(w).alias("mean"))
+    assert_tpu_cpu_equal(out, approx_float=True)
+
+
+def test_window_expr_arithmetic_composition(session):
+    # window expr nested inside arithmetic: v - avg(v) over partition
+    df = session.create_dataframe(_sales(with_nulls=False))
+    w = Window.partition_by("k")
+    out = df.select(
+        "k", "v",
+        (col("v") - avg("v").over(w)).alias("dev"))
+    assert_tpu_cpu_equal(out, approx_float=True)
+
+
+def test_two_window_groups_one_select(session):
+    df = session.create_dataframe(_sales())
+    w1 = Window.partition_by("k").order_by("ts")
+    w2 = Window.order_by("ts")  # global window, different group
+    out = df.select(
+        "k", "ts",
+        row_number().over(w1).alias("rn_k"),
+        row_number().over(w2).alias("rn_all"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_string_partition_key(session):
+    rng = np.random.default_rng(5)
+    names = ["alpha", "beta", "y", "delta-long-name"]
+    t = pa.table({
+        "name": [names[i] for i in rng.integers(0, 4, 80)],
+        "ts": rng.permutation(80).astype(np.int64),
+        "v": rng.integers(0, 100, 80).astype(np.int64),
+    })
+    df = session.create_dataframe(t)
+    w = Window.partition_by("name").order_by("ts")
+    out = df.select("name", "ts",
+                    row_number().over(w).alias("rn"),
+                    sum_("v").over(w).alias("rsum"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_empty_input(session):
+    t = pa.table({"k": pa.array([], pa.int64()),
+                  "ts": pa.array([], pa.int64()),
+                  "v": pa.array([], pa.float64())})
+    df = session.create_dataframe(t)
+    w = Window.partition_by("k").order_by("ts")
+    out = df.select("k", row_number().over(w).alias("rn"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_unsupported_minmax_frame_falls_back(session):
+    df = session.create_dataframe(_sales())
+    w = Window.partition_by("k").order_by("ts").rows_between(-2, 2)
+    out = df.select("k", "ts", min_("v").over(w).alias("m"))
+    explain = out.explain()
+    assert "falls back" in explain or "!" in explain
+    # result still correct through the CPU fallback
+    assert_tpu_cpu_equal(out)
+
+
+def test_negative_only_rows_frame(session):
+    # frame entirely before the current row; empty for the first rows
+    df = session.create_dataframe(_sales())
+    w = Window.partition_by("k").order_by("ts").rows_between(-3, -2)
+    out = df.select("k", "ts", "v", sum_("v").over(w).alias("s"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_ranking_without_order_by_is_analysis_error(session):
+    with pytest.raises(ValueError, match="ORDER BY"):
+        row_number().over(Window.partition_by("k"))
+    with pytest.raises(ValueError, match="ORDER BY"):
+        lead("v").over(Window.partition_by("k"))
+
+
+def test_window_then_filter_then_agg(session):
+    # q67/q93 shape: rank within partition, keep top-n, aggregate
+    df = session.create_dataframe(_sales(with_nulls=False))
+    w = Window.partition_by("k").order_by(
+        "v", desc=True)
+    ranked = df.select("k", "ts", "v", rank().over(w).alias("rnk"))
+    out = (ranked.where(col("rnk") <= 3)
+           .group_by("k").agg((sum_("v"), "top3_sum")))
+    assert_tpu_cpu_equal(out)
