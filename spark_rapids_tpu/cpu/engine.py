@@ -1011,7 +1011,8 @@ def _join_cpu(plan: L.Join) -> pa.Table:
     left = execute_cpu(plan.children[0])
     right = execute_cpu(plan.children[1])
     jt = plan.join_type
-    if jt == "cross":
+    if jt == "cross" or (jt == "inner" and not plan.left_keys):
+        # cross product / keyless conditional inner join (nested loop)
         left = left.append_column("__ck", pa.array([1] * left.num_rows))
         right = right.append_column("__ck", pa.array([1] * right.num_rows))
         lkeys, rkeys = ["__ck"], ["__ck"]

@@ -221,6 +221,22 @@ class TpuHashAggregateExec(TpuExec):
             return self.children[0].num_partitions
         return 1
 
+    @property
+    def output_partitioning(self):
+        """A final aggregate preserves the feeding exchange's hash
+        distribution when that hash is over the group-key ordinals (the
+        key columns keep positions and dtypes through finalization)."""
+        if self.mode != "final":
+            return None
+        from spark_rapids_tpu.ops.partition import HashPartitioning
+
+        part = getattr(self.children[0], "output_partitioning", None)
+        if isinstance(part, HashPartitioning) and all(
+                isinstance(e, BoundReference) and e.ordinal < self.n_keys
+                for e in part.exprs):
+            return part
+        return None
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         if self.mode == "complete":
             assert self.num_partitions == 1

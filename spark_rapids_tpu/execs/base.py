@@ -98,6 +98,13 @@ class TpuExec:
     def num_partitions(self) -> int:
         return 1
 
+    @property
+    def output_partitioning(self):
+        """The data distribution this exec's output satisfies (a
+        Partitioning, or None = unknown) — the planner's
+        EnsureRequirements analog uses it to skip redundant exchanges."""
+        return None
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         """Produce one output partition's batches."""
         assert self.num_partitions == 1, type(self).__name__

@@ -2,22 +2,36 @@
 
 TPU counterparts of GpuShuffledHashJoinBase / GpuBroadcastHashJoinExec /
 GpuHashJoin (ref: sql-plugin/.../GpuShuffledHashJoinBase.scala:28,
+shims/spark301/.../GpuBroadcastHashJoinExec.scala,
 sql/rapids/execution/GpuHashJoin.scala:62): the build side is collected
 into a single device batch (the reference requires the same,
 RequireSingleBatch), then every stream batch probes it through the dense
 group-id kernel in ops.join.  Output sizing mirrors JoinGatherer: one
 device->host sync per stream batch reads the pair count, then a
-statically-shaped expansion program (cached per capacity bucket) emits
-the joined batch.
+statically-shaped expansion program (globally cached per capacity
+bucket) emits the joined batch.
+
+Three physical strategies (chosen by the planner, like GpuOverrides
+choosing BroadcastHashJoin vs ShuffledHashJoin by build-side size):
+- `TpuShuffledHashJoinExec` (default): wide — consume everything, one
+  output partition;
+- `TpuShuffledHashJoinExec(partition_wise=True)`: children are
+  co-hash-partitioned exchanges; partition p joins build part p against
+  stream part p (bounded memory, partition-parallel);
+- `TpuBroadcastHashJoinExec`: small build side collected ONCE and shared
+  across all stream partitions (the broadcast), stream stays partitioned
+  — dimension tables never shuffle.
 
 Join types: inner, left_outer, right_outer (side-swapped), full_outer,
-left_semi, left_anti, cross.  Non-equi residual conditions are applied
-as a post-filter for inner joins; plans needing conditional outer joins
+left_semi, left_anti, cross.  Inner joins with a residual condition and
+keyless conditional inner joins (nested-loop via the constant-key cross
+trick) apply the condition as a post-filter; conditional outer joins
 fall back to the CPU engine (as the reference falls back for cases cudf
 cannot express)."""
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, Optional, Sequence
 
 import jax
@@ -46,19 +60,28 @@ def _nullable_fields(schema: T.Schema) -> list[T.Field]:
     return [T.Field(f.name, f.dtype, True) for f in schema.fields]
 
 
-class TpuShuffledHashJoinExec(TpuExec):
+class _HashJoinBase(TpuExec):
+    """Shared machinery: schema/keys resolution, build collection, the
+    probe-expand-condition loop, full-outer unmatched emission."""
+
     def __init__(self, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression], join_type: str,
                  left: TpuExec, right: TpuExec,
-                 condition: Optional[Expression] = None):
+                 condition: Optional[Expression] = None,
+                 build_side: Optional[str] = None):
         super().__init__(left, right)
         assert join_type in JOIN_TYPES, join_type
         self.join_type = join_type
-        if join_type == "cross":
-            # cross product == equi-join on a constant key (every pair
-            # shares the single group) — reuses the whole kernel
+        if join_type == "cross" or not left_keys:
+            # cross product AND keyless conditional inner joins (nested
+            # loop): equi-join on a constant key — every pair shares the
+            # single group, the residual condition filters
             from spark_rapids_tpu.exprs.base import Literal
 
+            if join_type not in ("cross", "inner"):
+                raise NotImplementedError(
+                    "keyless joins only for inner/cross (planner falls "
+                    "back otherwise)")
             left_keys = [Literal.of(1)]
             right_keys = [Literal.of(1)]
         self.left_keys = [bind_references(k, left.schema) for k in left_keys]
@@ -73,8 +96,13 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.condition = (bind_references(condition, joined_schema)
                           if condition is not None else None)
 
-        # build = the side NOT preserved by an outer join; stream = other
-        self.build_is_right = join_type != "right_outer"
+        # build = the side NOT preserved by an outer/semi/anti join;
+        # inner/cross may build either side (planner picks the smaller)
+        if join_type in ("inner", "cross") and build_side is not None:
+            assert build_side in ("left", "right")
+            self.build_is_right = build_side == "right"
+        else:
+            self.build_is_right = join_type != "right_outer"
         lf, rf = list(left.schema.fields), list(right.schema.fields)
         if join_type in ("left_outer", "full_outer"):
             rf = _nullable_fields(right.schema)
@@ -92,27 +120,35 @@ class TpuShuffledHashJoinExec(TpuExec):
     def node_desc(self) -> str:
         ks = ", ".join(f"{l.name}={r.name}" for l, r in
                        zip(self.left_keys, self.right_keys))
-        return f"TpuShuffledHashJoinExec {self.join_type} [{ks}]"
+        return f"{self.name} {self.join_type} [{ks}]"
 
     def additional_metrics(self):
         return [("buildRows", "MODERATE"), ("probeBatches", "MODERATE")]
 
-    # ------------------------------------------------------------------ #
+    @property
+    def _build_child(self) -> TpuExec:
+        return self.children[1] if self.build_is_right else self.children[0]
 
-    def _collect_build(self) -> Optional[ColumnarBatch]:
+    @property
+    def _stream_child(self) -> TpuExec:
+        return self.children[0] if self.build_is_right else self.children[1]
+
+    # -- build collection ------------------------------------------------ #
+
+    def _collect_batches(self, batches) -> Optional[ColumnarBatch]:
         from spark_rapids_tpu.memory import SpillPriorities, get_store
 
-        child = self.children[1] if self.build_is_right else self.children[0]
         store = get_store()
         handles = []
         try:
-            for bb in child.execute():
+            for bb in batches:
                 handles.append(store.register(
                     bb, SpillPriorities.JOIN_BUILD))
             if not handles:
                 return None
-            batches = [h.get() for h in handles]
-            b = batches[0] if len(batches) == 1 else concat_batches(batches)
+            collected = [h.get() for h in handles]
+            b = collected[0] if len(collected) == 1 \
+                else concat_batches(collected)
         finally:
             for h in handles:
                 h.close()
@@ -120,8 +156,9 @@ class TpuShuffledHashJoinExec(TpuExec):
         return b
 
     def _empty_build(self) -> ColumnarBatch:
-        child = self.children[1] if self.build_is_right else self.children[0]
-        return ColumnarBatch.empty(child.schema)
+        return ColumnarBatch.empty(self._build_child.schema)
+
+    # -- probe machinery ------------------------------------------------- #
 
     def _probe(self, build: ColumnarBatch, stream: ColumnarBatch):
         """Traceable: key eval + join state (tuple of arrays)."""
@@ -197,8 +234,10 @@ class TpuShuffledHashJoinExec(TpuExec):
                 ("join_cond", expr_key(cond)), lambda: apply)
         return fn
 
-    def execute(self) -> Iterator[ColumnarBatch]:
-        build = self._collect_build()
+    def _join_stream(self, build: Optional[ColumnarBatch],
+                     stream_batches) -> Iterator[ColumnarBatch]:
+        """Probe every stream batch against the build batch; for
+        full_outer, finish with the unmatched build rows."""
         if build is None:
             if self.join_type in ("inner", "left_semi", "cross"):
                 return  # empty build: no output
@@ -213,10 +252,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             stream.compact(keep))
         matched_b_acc = None
 
-        stream_child = (self.children[0] if self.build_is_right
-                        else self.children[1])
         build = build.with_device_num_rows()
-        for stream in stream_child.execute():
+        for stream in stream_batches:
             self.metrics["probeBatches"].add(1)
             out = None
             with MetricTimer(self.metrics[TOTAL_TIME]):
@@ -254,8 +291,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         def unmatched(build, matched_b):
             keep = build.row_mask() & ~matched_b
             compacted = build.compact(keep)
-            stream_schema = (self.children[0].schema if self.build_is_right
-                             else self.children[1].schema)
+            stream_schema = self._stream_child.schema
             null_cols = []
             from spark_rapids_tpu.exprs.base import Literal
 
@@ -279,3 +315,88 @@ class TpuShuffledHashJoinExec(TpuExec):
                          lambda: unmatched)(build, matched_b)
         if out.concrete_num_rows() > 0:
             yield self._count_output(out)
+
+
+class TpuShuffledHashJoinExec(_HashJoinBase):
+    """partition_wise=False: wide — collect the whole build side, stream
+    every partition, one output partition.  partition_wise=True: children
+    are co-hash-partitioned on the join keys; partition p joins build
+    part p against stream part p (ref: the exchange-fed
+    GpuShuffledHashJoinExec plan shape)."""
+
+    def __init__(self, *args, partition_wise: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.partition_wise = partition_wise
+        if partition_wise:
+            assert (self._build_child.num_partitions
+                    == self._stream_child.num_partitions), \
+                "partition-wise join needs co-partitioned children"
+
+    @property
+    def num_partitions(self) -> int:
+        return self._stream_child.num_partitions if self.partition_wise \
+            else 1
+
+    def node_desc(self) -> str:
+        pw = " partition_wise" if self.partition_wise else ""
+        return super().node_desc() + pw
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if not self.partition_wise:
+            assert self.num_partitions == 1
+            if p == 0:
+                yield from self.execute()
+            return
+        build = self._collect_batches(
+            self._build_child.execute_partition(p))
+        yield from self._join_stream(
+            build, self._stream_child.execute_partition(p))
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        if self.partition_wise:
+            for p in range(self.num_partitions):
+                yield from self.execute_partition(p)
+            return
+        build = self._collect_batches(self._build_child.execute())
+        yield from self._join_stream(build, self._stream_child.execute())
+
+
+class TpuBroadcastHashJoinExec(_HashJoinBase):
+    """Small build side collected once and shared across all stream
+    partitions — the dimension side of a star join never shuffles
+    (ref: GpuBroadcastHashJoinExec; here 'broadcast' = one shared
+    device-resident batch, since a single process serves every task;
+    multi-host broadcast rides the exchange layer later).
+
+    full_outer is excluded: unmatched-build emission needs matched flags
+    merged across ALL stream partitions, which a streaming narrow exec
+    cannot do (the planner keeps full_outer on the shuffled path)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert self.join_type != "full_outer", \
+            "broadcast join cannot implement full_outer"
+        self._build_lock = threading.Lock()
+        self._build_cached: Optional[ColumnarBatch] = None
+        self._build_done = False
+
+    @property
+    def num_partitions(self) -> int:
+        return self._stream_child.num_partitions
+
+    def _get_build(self) -> Optional[ColumnarBatch]:
+        with self._build_lock:
+            if not self._build_done:
+                self._build_cached = self._collect_batches(
+                    self._build_child.execute())
+                self._build_done = True
+            return self._build_cached
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        build = self._get_build()
+        yield from self._join_stream(
+            build, self._stream_child.execute_partition(p))
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)

@@ -26,6 +26,21 @@ class LogicalPlan:
     def schema(self) -> T.Schema:
         raise NotImplementedError
 
+    def estimated_rows(self) -> Optional[int]:
+        """Upper-bound row estimate for physical strategy choices (e.g.
+        broadcast-vs-shuffle join, ref: CostBasedOptimizer.scala's row
+        counts).  None = unknown.  Narrow nodes propagate their child's
+        estimate (a filter can only shrink)."""
+        if len(self.children) == 1:
+            return self.children[0].estimated_rows()
+        return None
+
+    def estimated_bytes(self) -> Optional[int]:
+        n = self.estimated_rows()
+        if n is None:
+            return None
+        return n * row_width_bytes(self.schema)
+
     @property
     def name(self) -> str:
         return type(self).__name__
@@ -38,6 +53,22 @@ class LogicalPlan:
         for c in self.children:
             s += c.tree_string(indent + 1)
         return s
+
+
+def row_width_bytes(schema: T.Schema) -> int:
+    """Fixed-width physical bytes per row (+1 validity byte per column);
+    strings estimated at 32 chars."""
+    total = 0
+    for f in schema.fields:
+        if isinstance(f.dtype, T.StringType):
+            total += 32 + 4
+        else:
+            try:
+                total += T.to_numpy_dtype(f.dtype).itemsize
+            except TypeError:
+                total += 8
+        total += 1
+    return max(total, 1)
 
 
 def _output_fields(exprs: Sequence[Expression]) -> T.Schema:
@@ -60,6 +91,9 @@ class InMemoryRelation(LogicalPlan):
     def schema(self) -> T.Schema:
         return self._schema
 
+    def estimated_rows(self) -> Optional[int]:
+        return self.table.num_rows
+
     def node_desc(self) -> str:
         return f"InMemoryRelation [{self.table.num_rows} rows]"
 
@@ -81,10 +115,25 @@ class ParquetRelation(LogicalPlan):
             aschema = pa.schema([aschema.field(c) for c in columns])
         self.columns = list(columns) if columns is not None else None
         self._schema = schema_from_arrow(aschema)
+        self._est_rows: Optional[int] = None
+        self._est_done = False
 
     @property
     def schema(self) -> T.Schema:
         return self._schema
+
+    def estimated_rows(self) -> Optional[int]:
+        """Lazy (footer reads cost IO; only joins ever ask), memoized."""
+        if not self._est_done:
+            import pyarrow.parquet as pq
+
+            self._est_done = True
+            try:
+                self._est_rows = sum(pq.read_metadata(p).num_rows
+                                     for p in self.paths)
+            except Exception:
+                pass
+        return self._est_rows
 
     def node_desc(self) -> str:
         return f"ParquetRelation {self.paths}"
@@ -119,6 +168,9 @@ class RangeRel(LogicalPlan):
         self.children = []
         self.start, self.end, self.step = start, end, step
         self._schema = T.Schema([T.Field("id", T.LONG, False)])
+
+    def estimated_rows(self) -> Optional[int]:
+        return max(0, -(-(self.end - self.start) // self.step))
 
     @property
     def schema(self) -> T.Schema:
@@ -197,6 +249,10 @@ class Limit(LogicalPlan):
     def __init__(self, n: int, child: LogicalPlan):
         self.children = [child]
         self.n = n
+
+    def estimated_rows(self) -> Optional[int]:
+        c = self.children[0].estimated_rows()
+        return self.n if c is None else min(self.n, c)
 
     @property
     def schema(self) -> T.Schema:
@@ -280,3 +336,12 @@ class Union(LogicalPlan):
     @property
     def schema(self) -> T.Schema:
         return self.children[0].schema
+
+    def estimated_rows(self) -> Optional[int]:
+        total = 0
+        for c in self.children:
+            n = c.estimated_rows()
+            if n is None:
+                return None
+            total += n
+        return total

@@ -189,7 +189,9 @@ class PlanMeta:
                         "non-inner join with residual condition")
                 else:
                     _check_expr(p.condition, conf, self.reasons)
-            if p.join_type != "cross" and not p.left_keys:
+            if not p.left_keys and p.join_type not in ("cross", "inner"):
+                # keyless inner joins run as conditional nested loops
+                # (constant-key cross); keyless outer joins fall back
                 self.will_not_work("non-equi join without keys")
 
     # -- explain -------------------------------------------------------- #
@@ -296,10 +298,105 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.Union):
         return TpuUnionExec(*kids)
     if isinstance(p, L.Join):
-        return TpuShuffledHashJoinExec(
-            p.left_keys, p.right_keys, p.join_type, kids[0], kids[1],
-            condition=p.condition)
+        return _plan_join(p, kids)
     raise AssertionError(f"tagged-replaceable node unconvertible: {p.name}")
+
+
+BROADCAST_THRESHOLD = register(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThresholdBytes", 10 << 20,
+    "Maximum estimated build-side size for a join to use the broadcast "
+    "strategy (the spark.sql.autoBroadcastJoinThreshold analog); -1 "
+    "disables broadcast joins.")
+
+
+def _plan_join(p: L.Join, kids: list[TpuExec]) -> TpuExec:
+    """Physical join strategy (the role GpuOverrides plays when Spark has
+    already chosen; here the planner chooses, like Spark's
+    JoinSelection): broadcast the small side when an estimate proves it
+    fits; otherwise co-hash-partition both sides for a partition-wise
+    join; otherwise a single wide local join."""
+    from spark_rapids_tpu.execs.exchange import (
+        SHUFFLE_PARTITIONS,
+        TpuShuffleExchangeExec,
+    )
+    from spark_rapids_tpu.execs.join import (
+        TpuBroadcastHashJoinExec,
+        TpuShuffledHashJoinExec,
+    )
+    from spark_rapids_tpu.ops.partition import HashPartitioning
+
+    conf = get_conf()
+    thr = conf.get(BROADCAST_THRESHOLD)
+    jt = p.join_type
+    lbytes = p.children[0].estimated_bytes()
+    rbytes = p.children[1].estimated_bytes()
+
+    if thr >= 0 and jt != "full_outer":
+        candidates = []
+        if jt in ("inner", "cross", "left_outer", "left_semi",
+                  "left_anti") and rbytes is not None and rbytes <= thr:
+            candidates.append(("right", rbytes))
+        if jt in ("inner", "cross", "right_outer") \
+                and lbytes is not None and lbytes <= thr:
+            candidates.append(("left", lbytes))
+        if candidates:
+            side = min(candidates, key=lambda c: c[1])[0]
+            return TpuBroadcastHashJoinExec(
+                p.left_keys, p.right_keys, jt, kids[0], kids[1],
+                condition=p.condition, build_side=side)
+
+    # partition-wise shuffled join: only for real equi-keys with equal
+    # key dtypes on both sides (hash-parity requires identical physical
+    # hashing) and a genuinely partitioned input
+    key_dtypes_match = p.left_keys and all(
+        lk.dtype == rk.dtype
+        for lk, rk in zip(p.left_keys, p.right_keys))
+    if key_dtypes_match and (kids[0].num_partitions > 1
+                             or kids[1].num_partitions > 1):
+        # EnsureRequirements: a child already hash-partitioned on these
+        # keys (e.g. a final aggregate over an exchange) is not
+        # re-shuffled
+        lsat = _hash_satisfies(kids[0], p.left_keys)
+        rsat = _hash_satisfies(kids[1], p.right_keys)
+        if lsat is not None:
+            n = lsat.num_partitions
+            if rsat is not None and rsat.num_partitions != n:
+                rsat = None  # mismatched widths: re-shuffle right
+        elif rsat is not None:
+            n = rsat.num_partitions
+        else:
+            n = conf.get(SHUFFLE_PARTITIONS)
+        lex = kids[0] if lsat is not None else TpuShuffleExchangeExec(
+            HashPartitioning(p.left_keys, n), kids[0])
+        rex = kids[1] if rsat is not None else TpuShuffleExchangeExec(
+            HashPartitioning(p.right_keys, n), kids[1])
+        return TpuShuffledHashJoinExec(
+            p.left_keys, p.right_keys, jt, lex, rex,
+            condition=p.condition, partition_wise=True)
+
+    return TpuShuffledHashJoinExec(
+        p.left_keys, p.right_keys, jt, kids[0], kids[1],
+        condition=p.condition)
+
+
+def _hash_satisfies(exec_: TpuExec, keys):
+    """The child's output HashPartitioning when it already distributes by
+    exactly these key expressions (value-identical hashing), else None."""
+    from spark_rapids_tpu.execs.jit_cache import expr_key
+    from spark_rapids_tpu.ops.partition import HashPartitioning
+
+    part = exec_.output_partitioning
+    if not isinstance(part, HashPartitioning) \
+            or len(part.exprs) != len(keys):
+        return None
+    for pe, jk in zip(part.exprs, keys):
+        if isinstance(pe, B.BoundReference) \
+                and isinstance(jk, B.BoundReference):
+            if pe.ordinal != jk.ordinal or pe.dtype != jk.dtype:
+                return None
+        elif expr_key(pe) != expr_key(jk):
+            return None
+    return part
 
 
 def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:

@@ -1,0 +1,194 @@
+"""Join physical-strategy tests: broadcast vs partition-wise vs local,
+plus keyless nested-loop joins — all differential vs the CPU oracle."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+from spark_rapids_tpu.execs.join import (
+    TpuBroadcastHashJoinExec,
+    TpuShuffledHashJoinExec,
+)
+from spark_rapids_tpu.plan.planner import BROADCAST_THRESHOLD, plan_query
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _fact(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "fk": rng.integers(0, 40, n),
+        "x": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _dim(n=40):
+    return pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "name": [f"dim-{i}" for i in range(n)],
+    })
+
+
+def _exec_types(df):
+    exec_, _ = plan_query(df._plan, get_conf())
+    out = set()
+
+    def walk(e):
+        out.add(type(e))
+        for c in e.children:
+            walk(c)
+
+    walk(exec_)
+    return out
+
+
+def test_small_dim_side_broadcasts(session):
+    fact = session.create_dataframe(_fact())
+    dim = session.create_dataframe(_dim())
+    df = fact.join(dim, left_on=[col("fk")], right_on=[col("id")])
+    types = _exec_types(df)
+    assert TpuBroadcastHashJoinExec in types
+    assert TpuShuffleExchangeExec not in types  # neither side shuffles
+    assert_tpu_cpu_equal(df)
+
+
+def test_small_left_side_broadcasts_for_inner(session):
+    dim = session.create_dataframe(_dim())
+    fact = session.create_dataframe(_fact())
+    df = dim.join(fact, left_on=[col("id")], right_on=[col("fk")])
+    types = _exec_types(df)
+    assert TpuBroadcastHashJoinExec in types
+    assert_tpu_cpu_equal(df)
+
+
+@pytest.mark.parametrize("how", ["left_outer", "left_semi", "left_anti"])
+def test_broadcast_outer_semi_anti(session, how):
+    rng = np.random.default_rng(4)
+    fact = session.create_dataframe(pa.table({
+        "fk": rng.integers(0, 60, 300),  # some keys miss the dim table
+        "x": rng.integers(0, 9, 300).astype(np.int64)}))
+    dim = session.create_dataframe(_dim())
+    df = fact.join(dim, left_on=[col("fk")], right_on=[col("id")],
+                   how=how)
+    assert TpuBroadcastHashJoinExec in _exec_types(df)
+    assert_tpu_cpu_equal(df)
+
+
+def test_partition_wise_join_when_both_sides_large(session):
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+
+    conf = get_conf()
+    old = conf.get(BROADCAST_THRESHOLD)
+    old_bs = conf.get(BATCH_SIZE_ROWS)
+    conf.set(BROADCAST_THRESHOLD.key, 0)  # no side may broadcast
+    conf.set(BATCH_SIZE_ROWS.key, 512)  # force multi-partition sources
+    try:
+        rng = np.random.default_rng(9)
+        a = session.create_dataframe(pa.table({
+            "k": rng.integers(0, 50, 4000),
+            "va": rng.integers(0, 100, 4000).astype(np.int64)}))
+        b = session.create_dataframe(pa.table({
+            "k": rng.integers(0, 50, 4000),
+            "vb": rng.integers(0, 100, 4000).astype(np.int64)}))
+        df = a.join(b, on="k")
+        exec_, _ = plan_query(df._plan, conf)
+        assert isinstance(exec_, TpuShuffledHashJoinExec)
+        assert exec_.partition_wise
+        assert TpuShuffleExchangeExec in _exec_types(df)
+        assert exec_.num_partitions > 1
+        assert_tpu_cpu_equal(df)
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old)
+        conf.set(BATCH_SIZE_ROWS.key, old_bs)
+
+
+def test_partition_wise_full_outer(session):
+    conf = get_conf()
+    old = conf.get(BROADCAST_THRESHOLD)
+    conf.set(BROADCAST_THRESHOLD.key, 0)
+    try:
+        rng = np.random.default_rng(2)
+        a = session.create_dataframe(pa.table({
+            "k": rng.integers(0, 30, 3000),
+            "va": rng.integers(0, 100, 3000).astype(np.int64)}))
+        b = session.create_dataframe(pa.table({
+            "k": rng.integers(20, 60, 3000),
+            "vb": rng.integers(0, 100, 3000).astype(np.int64)}))
+        df = a.join(b, on="k", how="full_outer")
+        assert_tpu_cpu_equal(df)
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old)
+
+
+def test_join_reuses_aggregate_distribution(session):
+    """EnsureRequirements: a final aggregate is already hash-partitioned
+    on its group keys; joining on those keys must not re-shuffle it."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+    from spark_rapids_tpu.session import sum_
+
+    conf = get_conf()
+    old = conf.get(BROADCAST_THRESHOLD)
+    old_bs = conf.get(BATCH_SIZE_ROWS)
+    conf.set(BROADCAST_THRESHOLD.key, 0)
+    conf.set(BATCH_SIZE_ROWS.key, 512)
+    try:
+        rng = np.random.default_rng(6)
+        a = session.create_dataframe(pa.table({
+            "k": rng.integers(0, 30, 3000),
+            "v": rng.integers(0, 100, 3000).astype(np.int64)}))
+        agg = a.group_by("k").agg((sum_("v"), "s"))
+        b = session.create_dataframe(pa.table({
+            "k": rng.integers(0, 30, 3000),
+            "w": rng.integers(0, 100, 3000).astype(np.int64)}))
+        df = agg.join(b, on="k")
+        exec_, _ = plan_query(df._plan, conf)
+        assert isinstance(exec_, TpuShuffledHashJoinExec)
+        assert exec_.partition_wise
+        # left child is the final aggregate itself, not a fresh exchange
+        assert not isinstance(exec_.children[0], TpuShuffleExchangeExec)
+        assert_tpu_cpu_equal(df)
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old)
+        conf.set(BATCH_SIZE_ROWS.key, old_bs)
+
+
+def test_keyless_conditional_inner_join(session):
+    # nested loop: inner join on an arbitrary range condition, no keys
+    a = session.create_dataframe(pa.table(
+        {"x": np.arange(30, dtype=np.int64)}))
+    b = session.create_dataframe(pa.table(
+        {"lo": np.array([0, 10, 25], np.int64),
+         "hi": np.array([5, 12, 40], np.int64)}))
+    df = a.join(b, condition=(col("x") >= col("lo"))
+                & (col("x") < col("hi")))
+    assert_tpu_cpu_equal(df)
+
+
+def test_full_outer_never_broadcasts(session):
+    fact = session.create_dataframe(_fact())
+    dim = session.create_dataframe(_dim())
+    df = fact.join(dim, left_on=[col("fk")], right_on=[col("id")],
+                   how="full_outer")
+    assert TpuBroadcastHashJoinExec not in _exec_types(df)
+    assert_tpu_cpu_equal(df)
+
+
+def test_broadcast_disabled_by_threshold(session):
+    conf = get_conf()
+    old = conf.get(BROADCAST_THRESHOLD)
+    conf.set(BROADCAST_THRESHOLD.key, -1)
+    try:
+        fact = session.create_dataframe(_fact())
+        dim = session.create_dataframe(_dim())
+        df = fact.join(dim, left_on=[col("fk")], right_on=[col("id")])
+        assert TpuBroadcastHashJoinExec not in _exec_types(df)
+        assert_tpu_cpu_equal(df)
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old)
