@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -22,6 +23,7 @@ from spark_rapids_tpu.columnar.column import (
     AnyColumn,
     Column,
     StringColumn,
+    all_valid_mask,
     pad_capacity,
     pad_width,
 )
@@ -41,7 +43,10 @@ def schema_to_arrow(schema: T.Schema) -> pa.Schema:
     )
 
 
-def _fixed_from_arrow(arr: pa.Array, dtype: T.DataType, cap: int) -> Column:
+def _fixed_host(arr: pa.Array, dtype: T.DataType, cap: int
+                ) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    """Decode one fixed-width column to padded host buffers:
+    (data[cap], validity[cap] or None when fully valid)."""
     n = len(arr)
     phys = T.to_numpy_dtype(dtype)
     if isinstance(dtype, T.DecimalType):
@@ -58,18 +63,27 @@ def _fixed_from_arrow(arr: pa.Array, dtype: T.DataType, cap: int) -> Column:
             validity = np.asarray(arr.is_valid())
             arr = arr.fill_null(_zero_value(dtype))
         else:
-            validity = np.ones(n, np.bool_)
+            validity = None
         if isinstance(dtype, T.DateType):
             np_vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
         elif isinstance(dtype, T.TimestampType):
             np_vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
         else:
             np_vals = arr.to_numpy(zero_copy_only=False)
-    data = np.zeros(cap, phys)
-    data[:n] = np_vals.astype(phys, copy=False)
-    valid = np.zeros(cap, np.bool_)
-    valid[:n] = validity
-    return Column(jnp.asarray(data), jnp.asarray(valid), dtype)
+    if n == cap:
+        # exact-fit fast path: use the decoded buffer directly — no host
+        # pad-copy (scans with power-of-two batch sizes hit this on every
+        # full batch)
+        data = np.ascontiguousarray(np_vals.astype(phys, copy=False))
+    else:
+        data = np.zeros(cap, phys)
+        data[:n] = np_vals.astype(phys, copy=False)
+    if validity is None and n == cap:
+        vhost = None  # fully valid: the device-shared mask stands in
+    else:
+        vhost = np.zeros(cap, np.bool_)
+        vhost[:n] = True if validity is None else validity
+    return data, vhost
 
 
 def _zero_value(dtype: T.DataType):
@@ -88,7 +102,10 @@ def _zero_value(dtype: T.DataType):
     return 0
 
 
-def _string_from_arrow(arr: pa.Array, cap: int) -> StringColumn:
+def _string_host(arr: pa.Array, cap: int
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Decode one string column to (chars[cap,w], lengths[cap],
+    validity[cap]) host buffers."""
     n = len(arr)
     sarr = arr.cast(pa.large_string())
     buf_offsets = np.frombuffer(sarr.buffers()[1], dtype=np.int64,
@@ -112,8 +129,69 @@ def _string_from_arrow(arr: pa.Array, cap: int) -> StringColumn:
     lengths[:n] = lengths_np
     valid = np.zeros(cap, np.bool_)
     valid[:n] = validity
-    return StringColumn(jnp.asarray(chars), jnp.asarray(lengths),
-                        jnp.asarray(valid))
+    return chars, lengths, valid
+
+
+# --------------------------------------------------------------------- #
+# Packed upload: one H2D transfer per batch
+# --------------------------------------------------------------------- #
+# Device links have a per-transfer cost (dispatch + latency; large on
+# tunneled/remote PJRT backends), so shipping a scan batch as one packed
+# byte buffer + one jitted unpack program beats per-column uploads — the
+# single staging-buffer design the reference gets from assembling one
+# host buffer per Parquet read (ref: GpuParquetScan.scala:495-560).
+
+_PACKED_UPLOAD = None  # config entry, registered lazily
+
+
+def _packed_enabled() -> bool:
+    global _PACKED_UPLOAD
+    if _PACKED_UPLOAD is None:
+        from spark_rapids_tpu.config import get_conf, register
+
+        _PACKED_UPLOAD = register(
+            "spark.rapids.tpu.sql.scan.packedUpload", True,
+            "Ship each scanned batch as a single packed host buffer and "
+            "unpack on device in one compiled program, instead of one "
+            "transfer per column component.")
+    from spark_rapids_tpu.config import get_conf
+
+    return get_conf().get(_PACKED_UPLOAD)
+
+
+def _pack_components(comps: list[np.ndarray]) -> tuple[np.ndarray, tuple]:
+    layout = []
+    total = 0
+    for a in comps:
+        total = (total + 7) & ~7
+        layout.append((total, a.shape, str(a.dtype)))
+        total += a.nbytes
+    buf = np.zeros(total, np.uint8)
+    for a, (off, _, _) in zip(comps, layout):
+        buf[off:off + a.nbytes] = np.ascontiguousarray(a).view(
+            np.uint8).reshape(-1)
+    return buf, tuple(layout)
+
+
+def _make_unpack(layout: tuple):
+    def unpack(buf: jax.Array) -> list[jax.Array]:
+        out = []
+        for off, shape, dt in layout:
+            npdt = np.dtype(dt)
+            count = int(np.prod(shape))
+            raw = jax.lax.dynamic_slice(buf, (off,),
+                                        (count * npdt.itemsize,))
+            if npdt == np.uint8:
+                col = raw.reshape(shape)
+            elif npdt == np.bool_:
+                col = (raw.reshape(shape) != 0)
+            else:
+                col = jax.lax.bitcast_convert_type(
+                    raw.reshape(count, npdt.itemsize), npdt).reshape(shape)
+            out.append(col)
+        return out
+
+    return unpack
 
 
 def from_arrow(rb: pa.RecordBatch | pa.Table,
@@ -135,12 +213,42 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
         n = rb.num_rows
     schema = schema_from_arrow(aschema)
     cap = capacity if capacity is not None else pad_capacity(n)
-    cols: list[AnyColumn] = []
+
+    # host-decode every column into padded component buffers
+    comps: list[np.ndarray] = []
+    recipe: list[tuple] = []  # (kind, first-component index, dtype)
     for arr, f in zip(arrays, schema.fields):
         if isinstance(f.dtype, T.StringType):
-            cols.append(_string_from_arrow(arr, cap))
+            chars, lengths, valid = _string_host(arr, cap)
+            recipe.append(("str", len(comps), f.dtype))
+            comps.extend([chars, lengths, valid])
         else:
-            cols.append(_fixed_from_arrow(arr, f.dtype, cap))
+            data, vhost = _fixed_host(arr, f.dtype, cap)
+            if vhost is None:
+                recipe.append(("fixed_shared", len(comps), f.dtype))
+                comps.append(data)
+            else:
+                recipe.append(("fixed", len(comps), f.dtype))
+                comps.extend([data, vhost])
+
+    if len(comps) > 1 and _packed_enabled():
+        buf, layout = _pack_components(comps)
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        unpack = cached_jit(("unpack", layout),
+                            lambda: _make_unpack(layout))
+        dev = unpack(jnp.asarray(buf))
+    else:
+        dev = [jnp.asarray(a) for a in comps]
+
+    cols: list[AnyColumn] = []
+    for kind, i, dtype in recipe:
+        if kind == "str":
+            cols.append(StringColumn(dev[i], dev[i + 1], dev[i + 2]))
+        elif kind == "fixed_shared":
+            cols.append(Column(dev[i], all_valid_mask(cap), dtype))
+        else:
+            cols.append(Column(dev[i], dev[i + 1], dtype))
     return ColumnarBatch(cols, n, schema)
 
 

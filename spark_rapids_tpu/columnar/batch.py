@@ -36,6 +36,22 @@ from spark_rapids_tpu.columnar.column import (
 
 RowCount = Union[int, jax.Array]
 
+#: device scalar cache: row counts repeat heavily (full batches, tiny
+#: partials) and an eager scalar upload is a full dispatch round trip on
+#: high-latency device links, so promote each distinct value once
+_DEVICE_INT_CACHE: dict[int, jax.Array] = {}
+_DEVICE_INT_LOCK = __import__("threading").Lock()
+
+
+def _device_int32(v: int) -> jax.Array:
+    with _DEVICE_INT_LOCK:
+        a = _DEVICE_INT_CACHE.get(v)
+        if a is None or a.is_deleted():
+            if len(_DEVICE_INT_CACHE) > 4096:
+                _DEVICE_INT_CACHE.clear()
+            a = _DEVICE_INT_CACHE[v] = jnp.asarray(v, jnp.int32)
+        return a
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
@@ -96,7 +112,7 @@ class ColumnarBatch:
         if not isinstance(self.num_rows, int):
             return self
         return ColumnarBatch(self.columns,
-                             jnp.asarray(self.num_rows, jnp.int32),
+                             _device_int32(self.num_rows),
                              self.schema)
 
     # ------------------------------------------------------------------ #
@@ -221,9 +237,10 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Concatenate batches of one schema into a single larger batch.
 
     TPU analog of GpuCoalesceBatches' cudf Table.concatenate
-    (ref: GpuCoalesceBatches.scala:340).  Requires concrete row counts
-    (host-side decision, like the reference's coalesce goal logic).
-    """
+    (ref: GpuCoalesceBatches.scala:340).  Row counts must be concrete
+    (host-side sizing decision, like the reference's coalesce goal
+    logic), but the data never leaves the device: each part is packed
+    into the output with dynamic_update_slice — no host round trip."""
     assert batches, "concat of zero batches"
     schema = batches[0].schema
     ns = [b.concrete_num_rows() for b in batches]
@@ -234,27 +251,35 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         parts = [b.columns[ci] for b in batches]
         if isinstance(f.dtype, T.StringType):
             w = pad_width(max(p.width for p in parts))  # type: ignore[union-attr]
-            chars = np.zeros((cap, w), np.uint8)
-            lengths = np.zeros(cap, np.int32)
-            valid = np.zeros(cap, np.bool_)
+            chars = jnp.zeros((cap, w), jnp.uint8)
+            lengths = jnp.zeros(cap, jnp.int32)
+            valid = jnp.zeros(cap, jnp.bool_)
             off = 0
             for p, n in zip(parts, ns):
-                chars[off:off + n, : p.width] = np.asarray(p.chars)[:n]
-                lengths[off:off + n] = np.asarray(p.lengths)[:n]
-                valid[off:off + n] = np.asarray(p.validity)[:n]
+                if n == 0:
+                    continue
+                pc = p.chars[:n]
+                if p.width < w:
+                    pc = jnp.pad(pc, ((0, 0), (0, w - p.width)))
+                chars = jax.lax.dynamic_update_slice(chars, pc, (off, 0))
+                lengths = jax.lax.dynamic_update_slice(
+                    lengths, p.lengths[:n].astype(jnp.int32), (off,))
+                valid = jax.lax.dynamic_update_slice(
+                    valid, p.validity[:n], (off,))
                 off += n
-            out_cols.append(StringColumn(jnp.asarray(chars),
-                                         jnp.asarray(lengths),
-                                         jnp.asarray(valid)))
+            out_cols.append(StringColumn(chars, lengths, valid))
         else:
             phys = T.to_numpy_dtype(f.dtype)
-            data = np.zeros(cap, phys)
-            valid = np.zeros(cap, np.bool_)
+            data = jnp.zeros(cap, phys)
+            valid = jnp.zeros(cap, jnp.bool_)
             off = 0
             for p, n in zip(parts, ns):
-                data[off:off + n] = np.asarray(p.data)[:n]
-                valid[off:off + n] = np.asarray(p.validity)[:n]
+                if n == 0:
+                    continue
+                data = jax.lax.dynamic_update_slice(
+                    data, p.data[:n].astype(phys), (off,))
+                valid = jax.lax.dynamic_update_slice(
+                    valid, p.validity[:n], (off,))
                 off += n
-            out_cols.append(Column(jnp.asarray(data), jnp.asarray(valid),
-                                   f.dtype))
+            out_cols.append(Column(data, valid, f.dtype))
     return ColumnarBatch(out_cols, total, schema)

@@ -54,6 +54,27 @@ def pad_width(w: int) -> int:
     return ((w + 4095) // 4096) * 4096
 
 
+#: process-shared device all-True masks, one per capacity bucket.  Fully
+#: valid columns reference these instead of uploading per-batch bool
+#: arrays; the spill store must never .delete() them (is_shared_array).
+_SHARED_MASKS: dict[int, jax.Array] = {}
+_SHARED_LOCK = __import__("threading").Lock()
+
+
+def all_valid_mask(cap: int) -> jax.Array:
+    with _SHARED_LOCK:
+        m = _SHARED_MASKS.get(cap)
+        if m is None or m.is_deleted():
+            m = _SHARED_MASKS[cap] = jnp.ones(cap, jnp.bool_)
+        return m
+
+
+def is_shared_array(a) -> bool:
+    """True for process-shared immortal arrays (spill must not delete)."""
+    with _SHARED_LOCK:
+        return any(m is a for m in _SHARED_MASKS.values())
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Column:

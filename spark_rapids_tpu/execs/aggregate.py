@@ -189,21 +189,46 @@ class TpuHashAggregateExec(TpuExec):
 
     def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Project inputs then run the update aggregation (traceable)."""
+        from spark_rapids_tpu.columnar.column import MIN_CAPACITY
+
         ctx = EvalContext.for_batch(batch)
         cols = [e.eval(ctx) for e in self.input_exprs]
         proj = ColumnarBatch(cols, batch.num_rows, self.update_input_schema)
         specs = self._update_specs()
         if self.n_keys == 0:
-            return reduce_aggregate(proj, specs, self.partial_schema)
+            out = reduce_aggregate(proj, specs, self.partial_schema)
+            # exactly one live row: compact to the minimum bucket INSIDE
+            # the program so no eager slicing (or giant partial buffers)
+            # happens outside it
+            return out.shrink_to_capacity(MIN_CAPACITY)
         return groupby_aggregate(proj, list(range(self.n_keys)), specs,
                                  self.partial_schema)
 
     def _merge_batch(self, partial: ColumnarBatch) -> ColumnarBatch:
         if self.n_keys == 0:
-            return reduce_aggregate(partial, self.merge_specs,
-                                    self.partial_schema)
+            from spark_rapids_tpu.columnar.column import MIN_CAPACITY
+
+            return reduce_aggregate(
+                partial, self.merge_specs,
+                self.partial_schema).shrink_to_capacity(MIN_CAPACITY)
         return groupby_aggregate(partial, list(range(self.n_keys)),
                                  self.merge_specs, self.partial_schema)
+
+    def _jit_concat(self, batches: list[ColumnarBatch]) -> ColumnarBatch:
+        """Concatenate pending partials in ONE compiled program: eager
+        per-part update-slices would pay a dispatch round trip each on
+        high-latency device links.  Row counts are already host ints
+        (pinned after the sizing sync), so the whole concat is static."""
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        struct = tuple(
+            (b.capacity, b.num_rows,
+             tuple(c.width for c in b.columns
+                   if hasattr(c, "width")))
+            for b in batches)
+        fn = cached_jit(("aggconcat", self._cache_key(), struct),
+                        lambda: lambda bs: concat_batches(bs))
+        return fn(batches)
 
     def _finalize_batch(self, partial: ColumnarBatch) -> ColumnarBatch:
         ctx = EvalContext.for_batch(partial)
@@ -280,8 +305,17 @@ class TpuHashAggregateExec(TpuExec):
 
         def drain_pending() -> ColumnarBatch:
             batches = [h.get() for h in pending]
-            out = batches[0] if len(batches) == 1 \
-                else concat_batches(batches)
+            if len(batches) == 1:
+                out = batches[0]
+            elif self.n_keys == 0:
+                # grand aggregate: partials are fixed one-row min-bucket
+                # batches, so the concat program's static key is stable —
+                # compile once, then one dispatch per drain
+                out = self._jit_concat(batches)
+            else:
+                # grouped: partial sizes are data-dependent; jitting here
+                # would recompile per distinct row-count combination
+                out = concat_batches(batches)
             for h in pending:
                 h.close()
             pending.clear()
@@ -301,25 +335,32 @@ class TpuHashAggregateExec(TpuExec):
                        emit_empty_default):
         from spark_rapids_tpu.memory import SpillPriorities
 
+        import dataclasses
+
         pending_rows = 0
         for batch in source:
-            with MetricTimer(self.metrics[TOTAL_TIME]):
+            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
                 if self.mode == "final":
                     part = _as_device_rows(batch)  # already partial layout
                 else:
-                    part = self._jit_update(_as_device_rows(batch))
+                    part = t.observe(self._jit_update(_as_device_rows(batch)))
+            # one sizing sync per batch (free when the update emitted a
+            # static count, e.g. grand aggregates); pin the host int into
+            # the batch so downstream concat/shrink never re-syncs
             n = part.concrete_num_rows()
+            part = dataclasses.replace(part, num_rows=n)
             part = part.shrink_to_capacity(pad_capacity(n))
             pending.append(store.register(
                 part, SpillPriorities.AGGREGATE_PARTIAL))
             pending_rows += n
             if len(pending) > 1 and pending_rows >= self.goal_rows:
-                with MetricTimer(self.metrics[TOTAL_TIME]):
-                    merged = self._jit_merge(
-                        _as_device_rows(drain_pending()))
+                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    merged = t.observe(self._jit_merge(
+                        _as_device_rows(drain_pending())))
                 self.metrics["numMerges"].add(1)
                 pending_rows = merged.concrete_num_rows()  # before register:
                 # a register under pressure may immediately spill `merged`
+                merged = dataclasses.replace(merged, num_rows=pending_rows)
                 merged = merged.shrink_to_capacity(pad_capacity(pending_rows))
                 pending.append(store.register(
                     merged, SpillPriorities.AGGREGATE_PARTIAL))
@@ -335,7 +376,7 @@ class TpuHashAggregateExec(TpuExec):
             pending.append(store.register(
                 eb, SpillPriorities.AGGREGATE_PARTIAL))
 
-        with MetricTimer(self.metrics[TOTAL_TIME]):
+        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
             single = len(pending) == 1
             merged = drain_pending()
             if not single or self.mode == "final":
@@ -344,4 +385,5 @@ class TpuHashAggregateExec(TpuExec):
                 out = merged
             else:
                 out = self._jit_finalize(_as_device_rows(merged))
+            t.observe(out)
         yield self._count_output(out)

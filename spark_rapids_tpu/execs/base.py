@@ -15,6 +15,8 @@ compiler.
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 from typing import Callable, Iterator, Optional
 
@@ -27,35 +29,153 @@ from spark_rapids_tpu.config import METRICS_LEVEL, get_conf
 
 class TpuMetric:
     """A named counter, levelled like the reference's ESSENTIAL/MODERATE/
-    DEBUG GpuMetrics (ref: GpuExec.scala:32-160)."""
+    DEBUG GpuMetrics (ref: GpuExec.scala:32-160).
 
-    __slots__ = ("name", "level", "value")
+    Counts may be *deferred device scalars* (`add_lazy`): a filtered
+    batch's row count lives on device, and forcing it per batch would put
+    a host<->device round trip in every operator's hot loop.  Deferred
+    counts are summed with one transfer when the metric is read, and
+    flushed in bulk past a bound so a long query does not pin one tiny
+    device buffer per batch."""
+
+    __slots__ = ("name", "level", "_value", "_pending", "_lock")
+
+    _FLUSH_AT = 1024
 
     def __init__(self, name: str, level: str = "MODERATE"):
         self.name = name
         self.level = level
-        self.value = 0
+        self._value = 0
+        self._pending: list = []  # device int scalars, flushed on read
+        self._lock = threading.Lock()
 
     def add(self, v: int) -> None:
-        self.value += v
+        with self._lock:
+            self._value += v
+
+    def add_lazy(self, v) -> None:
+        """Add a host int now or a device scalar at read time."""
+        if isinstance(v, int):
+            self.add(v)
+            return
+        with self._lock:
+            self._pending.append(v)
+            if len(self._pending) < self._FLUSH_AT:
+                return
+            pending, self._pending = self._pending, []
+        # blocking transfer outside the lock
+        s = sum(int(x) for x in jax.device_get(pending))
+        with self._lock:
+            self._value += s
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if pending:
+            s = sum(int(x) for x in jax.device_get(pending))
+            with self._lock:
+                self._value += s
+        with self._lock:
+            return self._value
 
     def __repr__(self) -> str:
         return f"{self.name}={self.value}"
 
 
+METRICS_DEVICE_SYNC = None  # registered lazily to avoid an import cycle
+
+
+def _device_sync_enabled() -> bool:
+    global METRICS_DEVICE_SYNC
+    if METRICS_DEVICE_SYNC is None:
+        from spark_rapids_tpu.config import register
+
+        METRICS_DEVICE_SYNC = register(
+            "spark.rapids.tpu.sql.metrics.deviceSync", True,
+            "Block on the produced batch inside metric timers so "
+            "totalTime measures device execution, not async dispatch. "
+            "Disable to trade metric accuracy for pipeline overlap "
+            "within a task.")
+    return get_conf().get(METRICS_DEVICE_SYNC)
+
+
+class _MetricReaper:
+    """Background completion-waiter making operator timers measure device
+    execution without blocking the producing pipeline: timed regions hand
+    their output arrays here, and a daemon thread records
+    dispatch-to-completion elapsed time into the metric.  The producing
+    thread keeps dispatching (overlap preserved); the clock still stops
+    only when the device work is done — the truth the reference gets from
+    synchronous NVTX ranges around blocking cudf calls."""
+
+    _instance: Optional["_MetricReaper"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-metric-reaper", daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get(cls) -> "_MetricReaper":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = _MetricReaper()
+            return cls._instance
+
+    def submit(self, metric: TpuMetric, t0: int, observed) -> None:
+        self._q.put((metric, t0, observed))
+
+    def flush(self) -> None:
+        """Wait until every submitted region has been timed."""
+        self._q.join()
+
+    def _run(self) -> None:
+        while True:
+            metric, t0, observed = self._q.get()
+            try:
+                leaves = [x for x in jax.tree_util.tree_leaves(observed)
+                          if isinstance(x, jax.Array)]
+                jax.block_until_ready(leaves)
+                metric.add(time.perf_counter_ns() - t0)
+            except Exception:
+                pass  # deleted/donated arrays: drop the sample
+            finally:
+                self._q.task_done()
+
+
 class MetricTimer:
     """Context manager adding elapsed ns to a metric — the NVTX-with-metric
-    pattern (ref: NvtxWithMetrics.scala:25-42)."""
+    pattern (ref: NvtxWithMetrics.scala:25-42).
+
+    JAX dispatch is asynchronous; to make `totalTime` mean device time the
+    timed region registers its output via `observe(batch)` and the elapsed
+    time is recorded when the output's device work completes (measured on
+    a background thread so the pipeline keeps overlapping).  Disable via
+    spark.rapids.tpu.sql.metrics.deviceSync to time dispatch only."""
 
     def __init__(self, metric: Optional[TpuMetric]):
         self.metric = metric
+        self._observed = None
+
+    def observe(self, out):
+        """Register the region's device output to be waited on."""
+        self._observed = out
+        return out
 
     def __enter__(self):
         self.t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
-        if self.metric is not None:
+        if self.metric is None:
+            return False
+        if self._observed is not None and exc[0] is None \
+                and _device_sync_enabled():
+            _MetricReaper.get().submit(self.metric, self.t0, self._observed)
+        else:
             self.metric.add(time.perf_counter_ns() - self.t0)
         return False
 
@@ -140,12 +260,14 @@ class TpuExec:
 
     def _count_output(self, batch: ColumnarBatch) -> ColumnarBatch:
         self.metrics[NUM_OUTPUT_BATCHES].add(1)
-        # concrete_num_rows syncs when num_rows is a device scalar; by this
-        # point the batch has already been computed, so the sync is cheap
-        self.metrics[NUM_OUTPUT_ROWS].add(batch.concrete_num_rows())
+        # device-scalar row counts are deferred (summed when the metric is
+        # read) — forcing them here would put a host round trip in every
+        # operator's per-batch loop
+        self.metrics[NUM_OUTPUT_ROWS].add_lazy(batch.num_rows)
         return batch
 
     def collect_metrics(self) -> dict[str, dict[str, int]]:
+        _MetricReaper.get().flush()  # settle in-flight device timings
         level = get_conf().get(METRICS_LEVEL)
         rank = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}[level]
         out = {}
@@ -214,8 +336,8 @@ class FusableExec(TpuExec):
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         fused, node = self._fused_pipeline()
         for batch in node.execute_partition(p):
-            with MetricTimer(self.metrics[TOTAL_TIME]):
-                out = fused(batch.with_device_num_rows())
+            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                out = t.observe(fused(batch.with_device_num_rows()))
             yield self._count_output(out)
 
     def execute(self) -> Iterator[ColumnarBatch]:

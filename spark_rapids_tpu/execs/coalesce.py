@@ -1,0 +1,122 @@
+"""Coalesce-partitions exec: N child partitions -> 1, pulled concurrently.
+
+Two reference mechanisms meet here:
+- the plan shape of CoalesceExec / a SinglePartitioning exchange feeding
+  a grand aggregate (ref: GpuShuffleExchangeExec.scala:80 with
+  GpuSinglePartitioning) — but without the shuffle-manager detour: a
+  single consumer needs no partitioned blocks, so routing one-destination
+  exchanges through spill-registered shuffle storage is pure overhead;
+- the multi-file cloud reader's background thread pool
+  (ref: GpuParquetScan.scala:882-895 MultiFileCloudParquetPartitionReader):
+  worker threads run whole child partitions (host decode, H2D upload, the
+  per-batch jitted programs) ahead of the consumer, so upload and device
+  compute overlap across partitions.  A bounded queue provides
+  backpressure; the task semaphore caps device residency per worker.
+
+Output order is partition-completion order (like Spark's reduce-side
+pulls, batch order *within* a partition is preserved; order across
+partitions is not guaranteed — callers needing total order must sort).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.memory import TpuSemaphore
+
+_DONE = object()
+
+
+class TpuCoalescePartitionsExec(TpuExec):
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return "TpuCoalescePartitionsExec"
+
+    def additional_metrics(self):
+        return [("fetchWaitTime", "MODERATE")]
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.config import get_conf
+        from spark_rapids_tpu.execs.exchange import TASK_THREADS
+
+        child = self.children[0]
+        n_parts = child.num_partitions
+        threads = min(get_conf().get(TASK_THREADS), max(n_parts, 1))
+        if n_parts <= 1 or threads <= 1:
+            for b in child.execute():
+                yield self._count_output(b)
+            return
+
+        out_q: queue.Queue = queue.Queue(maxsize=threads * 2)
+        stop = threading.Event()
+        next_part = iter(range(n_parts))
+        part_lock = threading.Lock()
+
+        def worker() -> None:
+            sem = TpuSemaphore.get()
+            task_id = threading.get_ident()
+            try:
+                while not stop.is_set():
+                    with part_lock:
+                        p = next(next_part, None)
+                    if p is None:
+                        break
+                    for batch in child.execute_partition(p):
+                        sem.acquire_if_necessary(task_id)
+                        while not stop.is_set():
+                            try:
+                                out_q.put(batch, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                        if stop.is_set():
+                            return
+            except BaseException as e:  # surface to the consumer
+                out_q.put(e)
+            finally:
+                sem.release_if_necessary(task_id)
+                out_q.put(_DONE)
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(threads)]
+        for w in workers:
+            w.start()
+        done = 0
+        import time
+
+        try:
+            while done < threads:
+                t0 = time.perf_counter_ns()
+                item = out_q.get()
+                self.metrics["fetchWaitTime"].add(
+                    time.perf_counter_ns() - t0)
+                if item is _DONE:
+                    done += 1
+                elif isinstance(item, BaseException):
+                    raise item
+                else:
+                    yield self._count_output(item)
+        finally:
+            # consumer abandoned (limit) or raised: unblock workers
+            stop.set()
+            while done < threads:
+                item = out_q.get()
+                if item is _DONE:
+                    done += 1
+            for w in workers:
+                w.join()

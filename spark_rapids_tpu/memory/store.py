@@ -103,7 +103,9 @@ def _batch_to_host(batch: ColumnarBatch) -> dict:
 
 
 def _delete(a) -> None:
-    if isinstance(a, jax.Array):
+    from spark_rapids_tpu.columnar.column import is_shared_array
+
+    if isinstance(a, jax.Array) and not is_shared_array(a):
         try:
             a.delete()
         except Exception:

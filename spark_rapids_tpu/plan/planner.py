@@ -401,19 +401,20 @@ def _hash_satisfies(exec_: TpuExec, keys):
 
 def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
     """Multi-partition input: partial agg (narrow) -> hash exchange on
-    the group keys (single exchange for grand aggregates) -> final agg
-    (narrow over key-disjoint partitions) — the Spark/reference physical
-    shape (aggregate.scala mode handling around ShuffleExchange).
+    the group keys -> final agg (narrow over key-disjoint partitions) —
+    the Spark/reference physical shape (aggregate.scala mode handling
+    around ShuffleExchange).  Grand aggregates skip the shuffle manager:
+    their "exchange" has a single destination, so the partials are pulled
+    straight into the final aggregate through a coalesce-partitions exec
+    (prefetching worker pool) with no partitioned-block storage at all.
     Single-partition input: one complete aggregation, no shuffle."""
     from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.coalesce import TpuCoalescePartitionsExec
     from spark_rapids_tpu.execs.exchange import (
         SHUFFLE_PARTITIONS,
         TpuShuffleExchangeExec,
     )
-    from spark_rapids_tpu.ops.partition import (
-        HashPartitioning,
-        SinglePartitioning,
-    )
+    from spark_rapids_tpu.ops.partition import HashPartitioning
 
     if child_exec.num_partitions <= 1:
         return TpuHashAggregateExec(p.groups, p.aggs, child_exec)
@@ -424,11 +425,11 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
         keys = [B.BoundReference(i, f.dtype, f.nullable, f.name)
                 for i, f in enumerate(
                     partial.schema.fields[: len(p.groups)])]
-        part = HashPartitioning(keys, n)
+        source: TpuExec = TpuShuffleExchangeExec(
+            HashPartitioning(keys, n), partial)
     else:
-        part = SinglePartitioning()
-    exchange = TpuShuffleExchangeExec(part, partial)
-    return TpuHashAggregateExec(p.groups, p.aggs, exchange, mode="final",
+        source = TpuCoalescePartitionsExec(partial)
+    return TpuHashAggregateExec(p.groups, p.aggs, source, mode="final",
                                 input_schema=child_exec.schema)
 
 
