@@ -56,7 +56,14 @@ def expr_key(e) -> str:
         parts = [_field_key(getattr(e, f.name))
                  for f in dataclasses.fields(e)]
         return f"{type(e).__name__}[{','.join(parts)}]"
-    return type(e).__name__
+    # a non-dataclass Expression subclass with state would silently share
+    # one compiled program across different states — refuse instead of
+    # returning a bare class name (cache correctness depends entirely on
+    # key completeness)
+    raise TypeError(
+        f"expression {type(e).__name__} is not a dataclass; expression "
+        "classes must be dataclasses so their state serializes into "
+        "compile-cache keys")
 
 
 def exprs_key(es: Sequence) -> tuple:

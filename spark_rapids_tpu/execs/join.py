@@ -193,6 +193,11 @@ class _HashJoinBase(TpuExec):
             key = self._ck = (
                 "join", self.join_type, self.build_is_right,
                 exprs_key(self.left_keys), exprs_key(self.right_keys),
+                # the child schema split matters too: cached closures read
+                # the stream/build child schemas, and two joins with the
+                # same joined output but different left/right splits must
+                # not share programs
+                repr(self.children[0].schema), repr(self.children[1].schema),
                 repr(self._schema))
         return key
 

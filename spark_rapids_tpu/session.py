@@ -167,10 +167,15 @@ class DataFrame:
         if acc:
             from spark_rapids_tpu.execs.jit_cache import exprs_key
 
+            from spark_rapids_tpu.execs.jit_cache import expr_key
+
             groups: dict[tuple, list] = {}
             for we, name in acc:
+                # structural keys for BOTH components: display repr is
+                # name-only and would merge distinct order-by exprs that
+                # share a name (or split structurally identical ones)
                 gk = (exprs_key(we.spec.partition_by),
-                      tuple((repr(k.expr), k.descending, k.nulls_last)
+                      tuple((expr_key(k.expr), k.descending, k.nulls_last)
                             for k in we.spec.order_by))
                 groups.setdefault(gk, []).append((we, name))
             for group in groups.values():

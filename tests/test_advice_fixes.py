@@ -149,3 +149,64 @@ def test_disk_acquire_survives_reserve_failure(monkeypatch):
     got = store.acquire(h.buffer_id)  # retry succeeds from the same file
     assert np.asarray(got.columns[0].data)[:16].tolist() == list(range(16))
     h.close()
+
+
+# -- Round-2 advisor findings ------------------------------------------- #
+
+def test_window_orderby_grouping_is_structural(session):
+    """Two window exprs whose order-by exprs differ structurally but share
+    a display name must land in separate Window nodes (no crash, correct
+    results); structurally identical specs must share one node."""
+    from spark_rapids_tpu.exprs.window import Window, row_number
+
+    t = pa.table({
+        "g": pa.array([1, 1, 2, 2], pa.int64()),
+        "a": pa.array([3.0, 1.0, 4.0, 2.0], pa.float64()),
+    })
+    df = session.create_dataframe(t)
+    # order by a ascending vs a descending: same display name "a"
+    asc = Window.partition_by("g").order_by("a")
+    desc = Window.partition_by("g").order_by("a", desc=True)
+    out = df.select(
+        col("g"), col("a"),
+        row_number().over(asc).alias("rn_asc"),
+        row_number().over(desc).alias("rn_desc"),
+    ).collect().to_pydict()
+    by_pair = {(g, a): (x, y) for g, a, x, y in zip(
+        out["g"], out["a"], out["rn_asc"], out["rn_desc"])}
+    assert by_pair[(1, 1.0)] == (1, 2)
+    assert by_pair[(1, 3.0)] == (2, 1)
+    assert by_pair[(2, 2.0)] == (1, 2)
+    assert by_pair[(2, 4.0)] == (2, 1)
+
+
+def test_join_cache_key_covers_child_split():
+    """Joins with identical output schema but different left/right child
+    splits must not share compiled closures."""
+    from spark_rapids_tpu.execs.join import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.io.scan import ArrowSourceExec
+    from spark_rapids_tpu.exprs.base import ColumnReference
+
+    l1 = ArrowSourceExec(pa.table({"k": pa.array([1], pa.int64()),
+                                   "x": pa.array([1.0], pa.float64())}))
+    r1 = ArrowSourceExec(pa.table({"k": pa.array([1], pa.int64())}))
+    l2 = ArrowSourceExec(pa.table({"k": pa.array([1], pa.int64())}))
+    r2 = ArrowSourceExec(pa.table({"k": pa.array([1], pa.int64()),
+                                   "x": pa.array([1.0], pa.float64())}))
+    j1 = TpuShuffledHashJoinExec([ColumnReference("k")],
+                                 [ColumnReference("k")], "inner", l1, r1)
+    j2 = TpuShuffledHashJoinExec([ColumnReference("k")],
+                                 [ColumnReference("k")], "inner", l2, r2)
+    assert j1._cache_key() != j2._cache_key()
+
+
+def test_expr_key_rejects_non_dataclass_expression():
+    from spark_rapids_tpu.execs.jit_cache import expr_key
+    from spark_rapids_tpu.exprs.base import Expression
+
+    class Sneaky(Expression):
+        def __init__(self):
+            self.state = 42
+
+    with pytest.raises(TypeError, match="dataclass"):
+        expr_key(Sneaky())
