@@ -603,9 +603,23 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
     if isinstance(plan, L.ParquetRelation):
         import pyarrow.parquet as pq
 
-        tables = [pq.read_table(p, columns=plan.columns)
-                  for p in plan.paths]
-        return pa.concat_tables(tables)
+        aschema = schema_to_arrow(plan.schema)
+        tables = []
+        for i, p in enumerate(plan.paths):
+            t = pq.read_table(p, columns=plan.columns)
+            # trailing Hive partition-value columns (same layout as the
+            # TPU scan's constant-column appender)
+            for f in plan.partition_fields:
+                v = plan.partition_values[i].get(f.name) \
+                    if i < len(plan.partition_values) else None
+                if v is not None and isinstance(f.dtype, T.LongType):
+                    v = int(v)
+                t = t.append_column(
+                    pa.field(f.name, aschema.field(f.name).type, True),
+                    pa.array([v] * t.num_rows,
+                             aschema.field(f.name).type))
+            tables.append(t)
+        return pa.concat_tables(tables).cast(aschema)
     if isinstance(plan, L.CsvRelation):
         import pyarrow.csv as pacsv
 

@@ -59,19 +59,54 @@ class ArrowSourceExec(TpuExec):
         yield self._count_output(from_arrow(chunk))
 
 
+def constant_column(value, dtype: T.DataType, n: int, cap: int):
+    """A device column holding one repeated value for n live rows (the
+    partition-value appender, ref:
+    ColumnarPartitionReaderWithPartitionValues.scala)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columnar.column import Column, StringColumn, pad_width
+
+    if isinstance(dtype, T.StringType):
+        b = (value or "").encode("utf-8")
+        w = pad_width(max(len(b), 1))
+        chars = np.zeros((cap, w), np.uint8)
+        lengths = np.zeros(cap, np.int32)
+        valid = np.zeros(cap, np.bool_)
+        if value is not None:
+            chars[:n, : len(b)] = np.frombuffer(b, np.uint8)
+            lengths[:n] = len(b)
+            valid[:n] = True
+        import jax.numpy as jnp
+
+        return StringColumn(jnp.asarray(chars), jnp.asarray(lengths),
+                            jnp.asarray(valid))
+    vals = np.zeros(n, T.to_numpy_dtype(dtype))
+    validity = np.zeros(n, np.bool_)
+    if value is not None:
+        vals[:] = value
+        validity[:] = True
+    return Column.from_numpy(vals, dtype, validity, capacity=cap)
+
+
 class ParquetScanExec(TpuExec):
     """Reads row-group-sized record batches per file and uploads them
     (the per-file reader mode; multi-file coalescing/cloud thread pools
-    of GpuParquetScan.scala:882 are a later stage)."""
+    of GpuParquetScan.scala:882 are a later stage).  Per-file Hive
+    partition values are appended as trailing constant columns."""
 
     def __init__(self, paths: Sequence[str], schema: T.Schema,
                  columns: Optional[Sequence[str]] = None,
-                 batch_rows: Optional[int] = None):
+                 batch_rows: Optional[int] = None,
+                 partition_values: Optional[Sequence[dict]] = None,
+                 partition_fields: Sequence[T.Field] = ()):
         super().__init__()
         self.paths = list(paths)
         self._schema = schema
         self.columns = list(columns) if columns is not None else None
         self.batch_rows = batch_rows or _conf_batch_rows()
+        self.partition_values = list(partition_values or [])
+        self.partition_fields = list(partition_fields)
 
     @property
     def schema(self) -> T.Schema:
@@ -87,16 +122,52 @@ class ParquetScanExec(TpuExec):
     def num_partitions(self) -> int:
         return len(self.paths)  # one task per file (row-group splits later)
 
+    def _partition_value(self, p: int, f: T.Field):
+        v = self.partition_values[p].get(f.name) \
+            if p < len(self.partition_values) else None
+        if v is not None and isinstance(f.dtype, T.LongType):
+            v = int(v)
+        return v
+
+    def _with_partition_cols(self, batch: ColumnarBatch,
+                             p: int) -> ColumnarBatch:
+        if not self.partition_fields:
+            return batch
+        n = batch.concrete_num_rows()
+        cap = max(batch.capacity, 1)
+        cols = list(batch.columns)
+        for f in self.partition_fields:
+            cols.append(constant_column(
+                self._partition_value(p, f), f.dtype, n, cap))
+        return ColumnarBatch(cols, batch.num_rows, self._schema)
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         import pyarrow.parquet as pq
+
+        if self.columns is not None and not self.columns:
+            # partition-columns-only projection: no file columns to read
+            from spark_rapids_tpu.columnar.column import pad_capacity
+
+            n_total = pq.read_metadata(self.paths[p]).num_rows
+            offs = range(0, n_total, self.batch_rows) if n_total \
+                else ([0] if p == 0 else [])
+            for off in offs:
+                n = min(self.batch_rows, n_total - off)
+                cap = pad_capacity(max(n, 1))
+                cols = [constant_column(self._partition_value(p, f),
+                                        f.dtype, n, cap)
+                        for f in self.partition_fields]
+                yield self._count_output(
+                    ColumnarBatch(cols, n, self._schema))
+            return
 
         f = pq.ParquetFile(self.paths[p])
         empty = True
         for rb in f.iter_batches(batch_size=self.batch_rows,
                                  columns=self.columns):
             empty = False
-            yield self._count_output(
-                from_arrow(pa.Table.from_batches([rb])))
+            yield self._count_output(self._with_partition_cols(
+                from_arrow(pa.Table.from_batches([rb])), p))
         if empty and p == 0:
             aschema = schema_to_arrow(self._schema)
             yield self._count_output(

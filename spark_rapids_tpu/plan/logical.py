@@ -98,9 +98,84 @@ class InMemoryRelation(LogicalPlan):
         return f"InMemoryRelation [{self.table.num_rows} rows]"
 
 
+def expand_scan_paths(paths: Sequence[str], ext: str
+                      ) -> tuple[list[str], list[dict], list[str]]:
+    """Expand directory paths into data files, discovering Hive-style
+    key=value partition directories written by the file writers
+    (ref: the partition-discovery side of Spark's file index; per-file
+    partition values feed ColumnarPartitionReaderWithPartitionValues).
+
+    Returns (files, per-file partition-value dicts, partition col names).
+    """
+    import os
+
+    files: list[str] = []
+    values: list[dict] = []
+    part_cols: list[str] = []
+    for p in paths:
+        if not os.path.isdir(p):
+            files.append(p)
+            values.append({})
+            continue
+        for root, dirs, names in sorted(os.walk(p)):
+            dirs.sort()
+            rel = os.path.relpath(root, p)
+            pv: dict = {}
+            if rel != ".":
+                for seg in rel.split(os.sep):
+                    if "=" not in seg:
+                        pv = None
+                        break
+                    k, _, v = seg.partition("=")
+                    pv[k] = None if v == "__HIVE_DEFAULT_PARTITION__" \
+                        else _unescape_part(v)
+                if pv is None:
+                    continue
+            for name in sorted(names):
+                if name.startswith(("_", ".")) or not name.endswith(ext):
+                    continue
+                files.append(os.path.join(root, name))
+                values.append(dict(pv))
+                for k in pv:
+                    if k not in part_cols:
+                        part_cols.append(k)
+    return files, values, part_cols
+
+
+def _unescape_part(v: str) -> str:
+    import re
+
+    return re.sub("%([0-9A-Fa-f]{2})",
+                  lambda m: chr(int(m.group(1), 16)), v)
+
+
+def infer_partition_fields(part_cols: Sequence[str],
+                           values: Sequence[dict]) -> list:
+    """Type each partition column: int64 when every value parses, else
+    string (the common subset of Spark's partition-type inference)."""
+    from spark_rapids_tpu import types as T
+
+    fields = []
+    for c in part_cols:
+        vs = [pv.get(c) for pv in values]
+        dtype: T.DataType = T.LONG
+        for v in vs:
+            if v is None:
+                continue
+            try:
+                int(v)
+            except (TypeError, ValueError):
+                dtype = T.STRING
+                break
+        fields.append(T.Field(c, dtype, True))
+    return fields
+
+
 class ParquetRelation(LogicalPlan):
     """Parquet scan leaf (ref: GpuParquetScan.scala — here the footer/
-    row-group handling is pyarrow's; device decode is a later stage)."""
+    row-group handling is pyarrow's; device decode is a later stage).
+    Directory paths are expanded with Hive partition discovery; partition
+    values surface as trailing columns."""
 
     def __init__(self, paths: Sequence[str],
                  columns: Optional[Sequence[str]] = None):
@@ -109,12 +184,27 @@ class ParquetRelation(LogicalPlan):
         from spark_rapids_tpu.columnar.arrow import schema_from_arrow
 
         self.children = []
-        self.paths = list(paths)
+        self.paths, self.partition_values, part_cols = expand_scan_paths(
+            list(paths), ".parquet")
+        if not self.paths:
+            raise FileNotFoundError(f"no parquet files under {paths}")
+        self.partition_fields = infer_partition_fields(
+            part_cols, self.partition_values)
         aschema = pq.read_schema(self.paths[0])
+        file_schema = schema_from_arrow(aschema)
         if columns is not None:
-            aschema = pa.schema([aschema.field(c) for c in columns])
-        self.columns = list(columns) if columns is not None else None
-        self._schema = schema_from_arrow(aschema)
+            part_names = {f.name for f in self.partition_fields}
+            file_cols = [c for c in columns if c not in part_names]
+            by_name = {f.name: f for f in file_schema.fields}
+            file_fields = [by_name[c] for c in file_cols]
+            self.columns = file_cols
+            self.partition_fields = [f for f in self.partition_fields
+                                     if f.name in set(columns)]
+        else:
+            self.columns = None
+            file_fields = list(file_schema.fields)
+        # partition columns trail the file columns (Spark's layout)
+        self._schema = T.Schema(file_fields + self.partition_fields)
         self._est_rows: Optional[int] = None
         self._est_done = False
 
@@ -149,7 +239,9 @@ class CsvRelation(LogicalPlan):
         from spark_rapids_tpu.columnar.arrow import schema_from_arrow
 
         self.children = []
-        self.paths = list(paths)
+        self.paths, _, _ = expand_scan_paths(list(paths), ".csv")
+        if not self.paths:
+            raise FileNotFoundError(f"no csv files under {paths}")
         if schema is None:
             head = pacsv.read_csv(self.paths[0])
             schema = schema_from_arrow(head.schema)

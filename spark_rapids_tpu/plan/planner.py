@@ -276,7 +276,9 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.InMemoryRelation):
         return ArrowSourceExec(p.table, p.schema)
     if isinstance(p, L.ParquetRelation):
-        return ParquetScanExec(p.paths, p.schema, p.columns)
+        return ParquetScanExec(p.paths, p.schema, p.columns,
+                               partition_values=p.partition_values,
+                               partition_fields=p.partition_fields)
     if isinstance(p, L.CsvRelation):
         return CsvScanExec(p.paths, p.schema)
     if isinstance(p, L.RangeRel):

@@ -232,6 +232,23 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self._plan), self._session)
 
+    # -- writes ---------------------------------------------------------- #
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        """Spark-shaped writer: df.write.mode('overwrite')
+        .partition_by('k').parquet(path)."""
+        return DataFrameWriter(self)
+
+    def write_parquet(self, path: str, mode: str = "error",
+                      partition_by: Sequence[str] = ()):
+        return self.write.mode(mode).partition_by(
+            *partition_by).parquet(path)
+
+    def write_csv(self, path: str, mode: str = "error",
+                  partition_by: Sequence[str] = ()):
+        return self.write.mode(mode).partition_by(*partition_by).csv(path)
+
     # -- actions --------------------------------------------------------- #
 
     def collect(self, engine: Optional[str] = None) -> pa.Table:
@@ -253,3 +270,52 @@ class DataFrame:
 
     def __repr__(self) -> str:
         return f"DataFrame[{self.schema}]"
+
+
+class DataFrameWriter:
+    """Builder for durable output (ref: the GpuDataSource /
+    GpuFileFormatWriter entry surface, sql/rapids/GpuDataSource.scala).
+    The child query runs through the normal planner (plan rewrite + CPU
+    fallback); encoding happens in per-partition write tasks."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._mode = "error"
+        self._partition_by: list[str] = []
+        self._compression = "snappy"
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by.extend(cols)
+        return self
+
+    def compression(self, c: str) -> "DataFrameWriter":
+        self._compression = c
+        return self
+
+    def parquet(self, path: str):
+        from spark_rapids_tpu.io.write import ParquetWriteExec
+
+        return self._run(ParquetWriteExec, path)
+
+    def csv(self, path: str):
+        from spark_rapids_tpu.io.write import CsvWriteExec
+
+        return self._run(CsvWriteExec, path)
+
+    def _run(self, exec_cls, path: str):
+        from spark_rapids_tpu.io.write import prepare_target
+
+        if not prepare_target(path, self._mode):
+            return None  # mode=ignore on existing target
+        df = self._df
+        child, _meta = plan_query(df._plan, df._session.conf)
+        kwargs = {}
+        if exec_cls.FORMAT == "parquet":
+            kwargs["compression"] = self._compression
+        w = exec_cls(path, child, partition_by=self._partition_by,
+                     **kwargs)
+        return w.run()
