@@ -623,8 +623,22 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
     if isinstance(plan, L.CsvRelation):
         import pyarrow.csv as pacsv
 
-        return pa.concat_tables(
-            [pacsv.read_csv(p) for p in plan.paths])
+        aschema = schema_to_arrow(plan.schema)
+        file_aschema = schema_to_arrow(plan.file_schema)
+        tables = []
+        for i, p in enumerate(plan.paths):
+            t = pacsv.read_csv(p).cast(file_aschema)
+            for f in plan.partition_fields:
+                v = plan.partition_values[i].get(f.name) \
+                    if i < len(plan.partition_values) else None
+                if v is not None and isinstance(f.dtype, T.LongType):
+                    v = int(v)
+                t = t.append_column(
+                    pa.field(f.name, aschema.field(f.name).type, True),
+                    pa.array([v] * t.num_rows,
+                             aschema.field(f.name).type))
+            tables.append(t)
+        return pa.concat_tables(tables).cast(aschema)
     if isinstance(plan, L.RangeRel):
         total = max(0, -(-(plan.end - plan.start) // plan.step))
         ids = plan.start + np.arange(total, dtype=np.int64) * plan.step

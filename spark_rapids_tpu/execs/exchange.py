@@ -18,6 +18,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 import jax
+import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -120,6 +121,8 @@ class TpuShuffleExchangeExec(TpuExec):
         self.metrics["mapTasks"].add(1)
 
     def _ensure_map_stage(self) -> None:
+        from spark_rapids_tpu.ops.partition import RangePartitioning
+
         with self._map_lock:
             if self._map_done:
                 return
@@ -127,16 +130,127 @@ class TpuShuffleExchangeExec(TpuExec):
             n_tasks = self.children[0].num_partitions
             threads = min(get_conf().get(TASK_THREADS), max(n_tasks, 1))
             with MetricTimer(self.metrics[TOTAL_TIME]):
-                if threads <= 1 or n_tasks <= 1:
-                    for p in range(n_tasks):
-                        self._run_map_task(p)
+                if isinstance(self.partitioning, RangePartitioning):
+                    self._run_range_map_stage(threads)
                 else:
-                    with ThreadPoolExecutor(max_workers=threads) as pool:
-                        futures = [pool.submit(self._run_map_task, p)
-                                   for p in range(n_tasks)]
-                        for f in futures:
-                            f.result()  # propagate the first failure
+                    self._run_tasks(self._run_map_task, n_tasks, threads)
             self._map_done = True
+
+    # -- range partitioning: two-pass map stage -------------------------- #
+    # Bounds must exist before any batch can be split, and bounds come
+    # from a global sample — so pass 1 streams the child into spillable
+    # storage while sampling keys (ref: GpuRangePartitioner.sketch), and
+    # pass 2 splits the parked batches against the chosen bounds
+    # (ref: determineBounds + the device upper-bound search :167).
+
+    def _run_range_map_stage(self, threads: int) -> None:
+        import dataclasses as _dc
+
+        import numpy as np
+
+        from spark_rapids_tpu.execs.jit_cache import cached_jit, exprs_key
+        from spark_rapids_tpu.execs.sort import SORT_SAMPLE_PER_BATCH
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
+        from spark_rapids_tpu.ops.range_partition import choose_bounds
+
+        part = self.partitioning
+        n = self.num_partitions
+        n_sample = get_conf().get(SORT_SAMPLE_PER_BATCH)
+        pkey = (exprs_key([k.expr for k in part.keys]),
+                tuple((k.descending, k.nulls_last) for k in part.keys))
+        store = get_store()
+        manager = get_shuffle_manager()
+        sem = TpuSemaphore.get()
+        rng = np.random.default_rng(0x52414E47)
+        rng_lock = threading.Lock()
+        handles: list = []
+        samples: list = []
+        state_lock = threading.Lock()
+
+        def pass1(child_part: int) -> None:
+            task_id = threading.get_ident() ^ (child_part << 20)
+            try:
+                for batch in self.children[0].execute_partition(child_part):
+                    sem.acquire_if_necessary(task_id)
+                    rows = batch.concrete_num_rows()
+                    if rows == 0:
+                        continue
+                    batch = _dc.replace(batch, num_rows=rows)
+                    jit_sample = cached_jit(
+                        ("rangesample", pkey, batch.capacity, n_sample,
+                         repr(batch.schema)),
+                        lambda: lambda b, p: part.key_batch(b).gather(
+                            p, n_sample))
+                    with rng_lock:
+                        pos = rng.integers(0, rows, n_sample).astype(
+                            np.int32)
+                    s = jit_sample(batch, jnp.asarray(pos, jnp.int32))
+                    with state_lock:
+                        samples.append(s)
+                        handles.append(store.register(
+                            batch, SpillPriorities.COALESCE_PENDING))
+            finally:
+                sem.release_if_necessary(task_id)
+
+        n_tasks = self.children[0].num_partitions
+        self._run_tasks(pass1, n_tasks, threads)
+        if not handles:
+            return
+
+        k = len(samples)
+        pool_live = k * n_sample
+        orders = part.key_orders()
+
+        def pool_and_bound(sample_list):
+            from spark_rapids_tpu.columnar.batch import concat_batches
+
+            pooled = concat_batches(sample_list)
+            return choose_bounds(pooled, orders, n, pool_live)
+
+        bounds = cached_jit(
+            ("rangebounds", pkey, k, n_sample, n,
+             tuple(s.capacity for s in samples)),
+            lambda: pool_and_bound)(samples)
+
+        from spark_rapids_tpu.columnar.column import pad_capacity
+
+        def pass2(idx: int) -> None:
+            task_id = threading.get_ident() ^ (idx << 20) ^ 0x2
+            try:
+                h = handles[idx]
+                batch = h.get()
+                sem.acquire_if_necessary(task_id)
+                pid_fn = cached_jit(
+                    ("rangepid", pkey, n, batch.capacity,
+                     repr(batch.schema)),
+                    lambda: lambda b, bd: part.partition_ids_with_bounds(
+                        b, bd))
+                subs = split_batch(batch, pid_fn(batch, bounds), n)
+                for rid, sub in enumerate(subs):
+                    rows = sub.concrete_num_rows()
+                    if rows:
+                        sub = sub.shrink_to_capacity(pad_capacity(rows))
+                        self.metrics["shuffleWriteRows"].add(rows)
+                        manager.write(self._shuffle_id, rid, sub)
+                h.close()
+            finally:
+                sem.release_if_necessary(task_id)
+
+        try:
+            self._run_tasks(pass2, len(handles), threads)
+        finally:
+            for h in handles:
+                h.close()
+
+    def _run_tasks(self, fn, n_tasks: int, threads: int) -> None:
+        if threads <= 1 or n_tasks <= 1:
+            for p in range(n_tasks):
+                fn(p)
+            return
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            futures = [pool.submit(fn, p) for p in range(n_tasks)]
+            for f in futures:
+                f.result()
 
     # -- reduce side ------------------------------------------------------ #
 

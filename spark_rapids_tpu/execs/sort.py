@@ -9,9 +9,18 @@ columns, the batch is sorted on them via the total-order-key lexsort in
 ops.sort, and the appended columns are dropped — the same bind/project
 approach the reference takes with SortOrder child expressions.
 
-The full sort currently concatenates to a single batch (the reference's
-FullSortSingleBatch); the out-of-core merge path arrives with the spill
-store (SURVEY.md build stage 2)."""
+Inputs up to `spark.rapids.tpu.sql.sort.singleBatchRows` sort as one
+device batch (the reference's FullSortSingleBatch).  Larger inputs take
+the out-of-core **sample-split sort**: stream the input into spillable
+storage while sampling keys, choose range bounds, split every batch into
+key-range buckets (vectorized lexicographic bound search on device, ops.
+range_partition), park the grouped rows host-side, then sort each
+bounded bucket independently and emit buckets in bound order.  This is
+the TPU-idiomatic redesign of GpuOutOfCoreSortIterator
+(ref: GpuSortExec.scala:213): the reference's cursor-based k-way merge
+is row-at-a-time host logic with per-round device round trips; the
+sample-split design is two streaming passes of fixed-shape device
+programs."""
 
 from __future__ import annotations
 
@@ -20,12 +29,34 @@ from typing import Iterator, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.column import (
+    Column,
+    StringColumn,
+    pad_capacity,
+    pad_width,
+)
+from spark_rapids_tpu.config import register
 from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
 from spark_rapids_tpu.exprs.base import EvalContext, Expression, bind_references
 from spark_rapids_tpu.ops.sort import SortOrder, sort_batch
+
+SORT_SINGLE_BATCH_ROWS = register(
+    "spark.rapids.tpu.sql.sort.singleBatchRows", 1 << 21,
+    "Row threshold above which a global sort switches from one-device-"
+    "batch sorting to the out-of-core sample-split sort (the "
+    "OutOfCoreSort mode analog, ref: GpuSortExec.scala:38-40).")
+SORT_SAMPLE_PER_BATCH = register(
+    "spark.rapids.tpu.sql.sort.samplesPerBatch", 128,
+    "Rows sampled from each input batch to estimate range-bucket bounds "
+    "for the out-of-core sort (ref: GpuRangePartitioner.sketch).")
+SORT_MAX_BUCKETS = register(
+    "spark.rapids.tpu.sql.sort.maxBuckets", 64,
+    "Upper bound on out-of-core sort range buckets (bound-search program "
+    "size grows with bucket count).")
 
 
 @dataclasses.dataclass
@@ -66,19 +97,37 @@ class _SortMixin(TpuExec):
 
 
 class TpuSortExec(_SortMixin):
-    """global=True: total order over all input (single concatenated batch
-    for now); global=False: sort each batch independently (the
-    SortEachBatch mode used below partial aggregations)."""
+    """scope='global': total order over all input (one output
+    partition); scope='partition': sort each child partition (the
+    reduce-side sorter below a range exchange — partition index order
+    then equals total order); scope='batch': sort each batch
+    independently (the SortEachBatch mode used below partial
+    aggregations).  `global_sort=False` is the legacy spelling of
+    scope='batch'."""
 
     def __init__(self, keys: Sequence[SortKey], child: TpuExec,
-                 global_sort: bool = True):
+                 global_sort: bool = True, scope: Optional[str] = None):
         super().__init__(child)
         self._bind(keys, child)
-        self.global_sort = global_sort
+        if scope is None:
+            scope = "global" if global_sort else "batch"
+        assert scope in ("global", "partition", "batch"), scope
+        self.scope = scope
+        self.global_sort = scope == "global"
         from spark_rapids_tpu.execs.jit_cache import cached_jit
 
         self._jit_sorted = cached_jit(("sort", self._keys_cache_key()),
                                       lambda: self._sorted)
+        # augmented layout: data columns ++ evaluated key columns
+        child_schema = child.schema
+        self._n_data = len(child_schema.fields)
+        self.aug_schema = T.Schema(
+            list(child_schema.fields)
+            + [T.Field(f"__sortkey{i}", k.expr.dtype)
+               for i, k in enumerate(self.keys)])
+        self.aug_orders = [SortOrder(self._n_data + i, k.descending,
+                                     k.nulls_last)
+                           for i, k in enumerate(self.keys)]
 
     @property
     def schema(self) -> T.Schema:
@@ -87,38 +136,366 @@ class TpuSortExec(_SortMixin):
     def node_desc(self) -> str:
         ks = ", ".join(
             f"{k.expr.name}{' DESC' if k.descending else ''}" for k in self.keys)
-        return f"TpuSortExec [{ks}] global={self.global_sort}"
+        return f"TpuSortExec [{ks}] scope={self.scope}"
+
+    def additional_metrics(self):
+        return [("sortBuckets", "MODERATE"), ("oocRows", "MODERATE")]
+
+    @property
+    def num_partitions(self) -> int:
+        if self.scope == "global":
+            return 1
+        return self.children[0].num_partitions
+
+    @property
+    def output_partitioning(self):
+        # a partition-scoped sort preserves the child's distribution
+        if self.scope == "partition":
+            return self.children[0].output_partitioning
+        return None
+
+    # -- traceable pieces ------------------------------------------------ #
+
+    def _augment(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext.for_batch(batch)
+        key_cols = [k.expr.eval(ctx) for k in self.keys]
+        return ColumnarBatch(list(batch.columns) + key_cols,
+                             batch.num_rows, self.aug_schema)
+
+    def _sort_drop(self, aug: ColumnarBatch) -> ColumnarBatch:
+        out = sort_batch(aug, self.aug_orders)
+        return ColumnarBatch(out.columns[: self._n_data], out.num_rows,
+                             self.schema)
+
+    def _group_by_bounds(self, aug: ColumnarBatch, bounds: ColumnarBatch,
+                         n_parts: int):
+        """pid per row, rows grouped by bucket, per-bucket counts."""
+        from spark_rapids_tpu.ops.range_partition import bucket_ids
+
+        pid = bucket_ids(aug, bounds, self.aug_orders, n_parts - 1)
+        live = aug.row_mask()
+        key = jnp.where(live, pid, jnp.int32(n_parts))
+        order = jnp.argsort(key, stable=True)
+        grouped = aug.gather(order, aug.num_rows)
+        counts = jax.ops.segment_sum(live.astype(jnp.int32), key,
+                                     num_segments=n_parts + 1)[:n_parts]
+        return grouped, counts
+
+    # -- driver ---------------------------------------------------------- #
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if self.scope == "global":
+            assert self.num_partitions == 1
+            if p == 0:
+                yield from self.execute()
+            return
+        if self.scope == "batch":
+            for b in self.children[0].execute_partition(p):
+                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    out = t.observe(self._jit_sorted(
+                        b.with_device_num_rows()))
+                yield self._count_output(out)
+            return
+        yield from self._sort_stream(
+            self.children[0].execute_partition(p))
 
     def execute(self) -> Iterator[ColumnarBatch]:
-        if self.global_sort:
-            # collected input registers with the spill store so a
-            # larger-than-HBM collection degrades to host/disk instead
-            # of OOM (ref: GpuOutOfCoreSortIterator's spillable pending
-            # queues, GpuSortExec.scala:213)
-            from spark_rapids_tpu.memory import SpillPriorities, get_store
+        if self.scope == "global":
+            yield from self._sort_stream(self.children[0].execute())
+        else:
+            for p in range(self.num_partitions):
+                yield from self.execute_partition(p)
 
-            store = get_store()
-            handles = []
-            try:
-                for b in self.children[0].execute():
-                    handles.append(store.register(
-                        b, SpillPriorities.COALESCE_PENDING))
-                if not handles:
-                    return
+    def _sort_stream(self, source, depth: int = 0
+                     ) -> Iterator[ColumnarBatch]:
+        import dataclasses as _dc
+
+        from spark_rapids_tpu.config import get_conf
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
+
+        conf = get_conf()
+        single_rows = conf.get(SORT_SINGLE_BATCH_ROWS)
+        n_sample = conf.get(SORT_SAMPLE_PER_BATCH)
+        store = get_store()
+        kkey = self._keys_cache_key()
+        jit_aug = cached_jit(("sortaug", kkey, repr(self.aug_schema)),
+                             lambda: self._augment)
+
+        # collect phase: augment + register (spillable).  Sampling starts
+        # only once the running total crosses the single-batch threshold
+        # (small sorts — the common case — pay zero sampling cost);
+        # already-registered batches are back-sampled at that point.
+        handles: list = []
+        rows: list[int] = []
+        samples: list[ColumnarBatch] = []
+        rng = np.random.default_rng(0x5047 + depth)
+
+        def take_sample(aug, n):
+            pos = rng.integers(0, n, n_sample).astype(np.int32)
+            jit_sample = cached_jit(
+                ("sortsample", kkey, aug.capacity, n_sample,
+                 repr(self.aug_schema)),
+                lambda: lambda a, p: a.gather(p, n_sample))
+            samples.append(jit_sample(aug, jnp.asarray(pos, jnp.int32)))
+
+        try:
+            total = 0
+            for b in source:
+                if depth == 0:
+                    aug = jit_aug(b.with_device_num_rows())
+                else:
+                    aug = b  # recursive input is already augmented
+                n = aug.concrete_num_rows()
+                if n == 0:
+                    continue
+                aug = _dc.replace(aug, num_rows=n)
+                crossing = total <= single_rows < total + n
+                total += n
+                handles.append(store.register(
+                    aug, SpillPriorities.COALESCE_PENDING))
+                rows.append(n)
+                if crossing and len(handles) > 1:
+                    # threshold just crossed: back-sample earlier batches
+                    for h, hn in zip(handles[:-1], rows[:-1]):
+                        prev = h.get()
+                        take_sample(prev, hn)
+                        h.unpin()
+                if total > single_rows:
+                    take_sample(aug, n)
+            if total == 0:
+                return
+            if total <= single_rows or len(handles) == 1:
                 batches = [h.get() for h in handles]
                 big = batches[0] if len(batches) == 1 \
                     else concat_batches(batches)
-            finally:
+                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    out = t.observe(self._jit_sort_drop()(
+                        big.with_device_num_rows()))
                 for h in handles:
                     h.close()
-            with MetricTimer(self.metrics[TOTAL_TIME]):
-                out = self._jit_sorted(big.with_device_num_rows())
-            yield self._count_output(out)
-        else:
-            for b in self.children[0].execute():
-                with MetricTimer(self.metrics[TOTAL_TIME]):
-                    out = self._jit_sorted(b.with_device_num_rows())
+                handles.clear()
                 yield self._count_output(out)
+                return
+            yield from self._merge_buckets(store, handles, rows, samples,
+                                           total, single_rows, depth)
+        finally:
+            for h in handles:
+                h.close()
+
+    def _jit_sort_drop(self):
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        return cached_jit(
+            ("sortdrop", self._keys_cache_key(), repr(self.aug_schema)),
+            lambda: self._sort_drop)
+
+    def _merge_buckets(self, store, handles, rows, samples, total,
+                       single_rows, depth: int = 0
+                       ) -> Iterator[ColumnarBatch]:
+        """Out-of-core phase: bounds -> per-batch range split (device) ->
+        host-parked grouped runs -> per-bucket assemble/sort/emit.
+
+        A bucket that still exceeds the single-batch threshold (skewed
+        bounds) is recursively re-sampled and re-split once; past the
+        recursion limit it sorts as one oversized batch — a single key
+        group larger than device memory is the one shape ranges cannot
+        subdivide (the cursor-merge alternative pays steady per-round
+        host round trips to handle it; documented tradeoff)."""
+        from spark_rapids_tpu.config import get_conf
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+        from spark_rapids_tpu.memory import SpillPriorities
+        from spark_rapids_tpu.memory.store import _batch_to_host
+        from spark_rapids_tpu.ops.range_partition import choose_bounds
+
+        conf = get_conf()
+        kkey = self._keys_cache_key()
+        n_parts = min(max(2, -(-total // single_rows)),
+                      conf.get(SORT_MAX_BUCKETS))
+        self.metrics["sortBuckets"].add(n_parts)
+        self.metrics["oocRows"].add(total)
+
+        # bounds from the pooled fixed-size samples (one compiled program)
+        k = len(samples)
+        n_sample = samples[0].concrete_num_rows()
+        pool_live = k * n_sample
+
+        def pool_and_bound(sample_list):
+            pooled = concat_batches(sample_list)
+            return choose_bounds(pooled, self.aug_orders, n_parts,
+                                 pool_live)
+
+        bounds = cached_jit(
+            ("sortbounds", kkey, k, n_sample, n_parts,
+             tuple(s.capacity for s in samples)),
+            lambda: pool_and_bound)(samples)
+
+        # split phase: group each collected batch by bucket, park on host
+        runs: list[tuple[object, np.ndarray, np.ndarray]] = []
+        run_handles: list = []
+        try:
+            for h, n in zip(handles, rows):
+                aug = h.get()
+                jit_group = cached_jit(
+                    ("sortgroup", kkey, n_parts, aug.capacity,
+                     repr(self.aug_schema),
+                     tuple(getattr(c, "width", 0) for c in aug.columns)),
+                    lambda: lambda a, bd: self._group_by_bounds(
+                        a, bd, n_parts))
+                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    grouped, counts = jit_group(
+                        aug.with_device_num_rows(), bounds)
+                    t.observe(grouped)
+                counts_np = np.asarray(jax.device_get(counts))
+                import dataclasses as _dc
+
+                grouped = _dc.replace(grouped, num_rows=n)
+                arrays = _batch_to_host(grouped)  # D2H + free device copy
+                h.close()
+                rh = store.register_host(
+                    arrays, self.aug_schema,
+                    SpillPriorities.COALESCE_PENDING)
+                run_handles.append(rh)
+                offsets = np.concatenate(
+                    [[0], np.cumsum(counts_np)]).astype(np.int64)
+                runs.append((rh, counts_np, offsets))
+            handles.clear()
+
+            # emit phase: assemble each bucket host-side, sort on device
+            fn = self._jit_sort_drop()
+            for b in range(n_parts):
+                total_b = sum(int(c[b]) for _, c, _ in runs)
+                if total_b == 0:
+                    continue
+                if depth < 1 and total_b > 2 * single_rows:
+                    # skewed bucket: recursively sample-split it
+                    yield from self._sort_stream(
+                        self._bucket_chunks(runs, b, single_rows),
+                        depth + 1)
+                    continue
+                bucket = self._assemble_bucket(runs, b)
+                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    out = t.observe(fn(bucket.with_device_num_rows()))
+                yield self._count_output(out)
+        finally:
+            for rh in run_handles:
+                rh.close()
+
+    def _bucket_chunks(self, runs, b: int, chunk_rows: int
+                       ) -> Iterator[ColumnarBatch]:
+        """Bucket b's rows as a stream of augmented chunk batches (the
+        recursive sample-split input); per-run slicing, no global
+        assembly."""
+        for rh, counts, offsets in runs:
+            cnt = int(counts[b])
+            if not cnt:
+                continue
+            start = int(offsets[b])
+            for off in range(0, cnt, chunk_rows):
+                m = min(chunk_rows, cnt - off)
+                yield self._assemble_range(rh, start + off, m)
+            rh.unpin()
+
+    def _assemble_range(self, rh, start: int, m: int) -> ColumnarBatch:
+        """One run's rows [start, start+m) as a device aug batch."""
+        arrays = rh.get_host()
+        cap = pad_capacity(m)
+        comps: list[np.ndarray] = []
+        recipe: list[tuple] = []
+        for ci, f in enumerate(self.aug_schema.fields):
+            if isinstance(f.dtype, T.StringType):
+                chars = arrays[f"c{ci}_chars"][start:start + m]
+                w = chars.shape[1]
+                cpad = np.zeros((cap, w), np.uint8)
+                cpad[:m] = chars
+                lpad = np.zeros(cap, np.int32)
+                lpad[:m] = arrays[f"c{ci}_lengths"][start:start + m]
+                vpad = np.zeros(cap, np.bool_)
+                vpad[:m] = arrays[f"c{ci}_valid"][start:start + m]
+                recipe.append(("str", len(comps), f.dtype))
+                comps.extend([cpad, lpad, vpad])
+            else:
+                phys = T.to_numpy_dtype(f.dtype)
+                dpad = np.zeros(cap, phys)
+                dpad[:m] = arrays[f"c{ci}_data"][start:start + m]
+                vpad = np.zeros(cap, np.bool_)
+                vpad[:m] = arrays[f"c{ci}_valid"][start:start + m]
+                recipe.append(("fixed", len(comps), f.dtype))
+                comps.extend([dpad, vpad])
+        return self._upload_components(comps, recipe, m)
+
+    def _upload_components(self, comps, recipe, num_rows
+                           ) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar.arrow import (
+            _make_unpack,
+            _pack_components,
+        )
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        buf, layout = _pack_components(comps)
+        unpack = cached_jit(("unpack", layout),
+                            lambda: _make_unpack(layout))
+        dev = unpack(jnp.asarray(buf))
+        cols: list = []
+        for kind, i, dtype in recipe:
+            if kind == "str":
+                cols.append(StringColumn(dev[i], dev[i + 1], dev[i + 2]))
+            else:
+                cols.append(Column(dev[i], dev[i + 1], dtype))
+        return ColumnarBatch(cols, num_rows, self.aug_schema)
+
+    def _assemble_bucket(self, runs, b: int) -> Optional[ColumnarBatch]:
+        """Concatenate bucket b's row ranges from every host-parked run
+        and upload as one packed transfer."""
+        total_b = sum(int(counts[b]) for _, counts, _ in runs)
+        if total_b == 0:
+            return None
+        cap = pad_capacity(total_b)
+        fields = self.aug_schema.fields
+        # fetch each contributing run's host arrays ONCE (a disk-tier
+        # entry reloads its file per get_host call), unpin when done
+        contributing = [(rh, rh.get_host(), offsets)
+                        for rh, counts, offsets in runs if counts[b]]
+        comps: list[np.ndarray] = []
+        recipe: list[tuple] = []
+        for ci, f in enumerate(fields):
+            if isinstance(f.dtype, T.StringType):
+                pieces = [(arrays[f"c{ci}_chars"][int(offs[b]):
+                                                  int(offs[b + 1])],
+                           arrays[f"c{ci}_lengths"][int(offs[b]):
+                                                    int(offs[b + 1])],
+                           arrays[f"c{ci}_valid"][int(offs[b]):
+                                                  int(offs[b + 1])])
+                          for _, arrays, offs in contributing]
+                w = pad_width(max(p[0].shape[1] for p in pieces))
+                chars = np.zeros((cap, w), np.uint8)
+                lengths = np.zeros(cap, np.int32)
+                valid = np.zeros(cap, np.bool_)
+                off = 0
+                for pc, pl, pv in pieces:
+                    m = len(pl)
+                    chars[off:off + m, : pc.shape[1]] = pc
+                    lengths[off:off + m] = pl
+                    valid[off:off + m] = pv
+                    off += m
+                recipe.append(("str", len(comps), f.dtype))
+                comps.extend([chars, lengths, valid])
+            else:
+                phys = T.to_numpy_dtype(f.dtype)
+                data = np.zeros(cap, phys)
+                valid = np.zeros(cap, np.bool_)
+                off = 0
+                for _, arrays, offs in contributing:
+                    s, e = int(offs[b]), int(offs[b + 1])
+                    m = e - s
+                    data[off:off + m] = arrays[f"c{ci}_data"][s:e]
+                    valid[off:off + m] = arrays[f"c{ci}_valid"][s:e]
+                    off += m
+                recipe.append(("fixed", len(comps), f.dtype))
+                comps.extend([data, valid])
+        for rh, _, _ in contributing:
+            rh.unpin()  # stay spillable between buckets
+        return self._upload_components(comps, recipe, total_b)
 
 
 class TpuTakeOrderedAndProjectExec(_SortMixin):

@@ -178,11 +178,18 @@ class ParquetScanExec(TpuExec):
 
 class CsvScanExec(TpuExec):
     def __init__(self, paths: Sequence[str], schema: T.Schema,
-                 batch_rows: Optional[int] = None):
+                 batch_rows: Optional[int] = None,
+                 partition_values: Optional[Sequence[dict]] = None,
+                 partition_fields: Sequence[T.Field] = ()):
         super().__init__()
         self.paths = list(paths)
         self._schema = schema
         self.batch_rows = batch_rows or _conf_batch_rows()
+        self.partition_values = list(partition_values or [])
+        self.partition_fields = list(partition_fields)
+        n_file = len(schema.fields) - len(self.partition_fields)
+        self.file_aschema = schema_to_arrow(
+            T.Schema(schema.fields[:n_file]))
 
     @property
     def schema(self) -> T.Schema:
@@ -198,8 +205,19 @@ class CsvScanExec(TpuExec):
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         import pyarrow.csv as pacsv
 
-        t = pacsv.read_csv(self.paths[p]).cast(
-            schema_to_arrow(self._schema))
+        t = pacsv.read_csv(self.paths[p]).cast(self.file_aschema)
         for off in range(0, max(t.num_rows, 1), self.batch_rows):
             chunk = t.slice(off, self.batch_rows)
-            yield self._count_output(from_arrow(chunk))
+            batch = from_arrow(chunk)
+            if self.partition_fields:
+                n = batch.concrete_num_rows()
+                cap = max(batch.capacity, 1)
+                cols = list(batch.columns)
+                for f in self.partition_fields:
+                    v = self.partition_values[p].get(f.name) \
+                        if p < len(self.partition_values) else None
+                    if v is not None and isinstance(f.dtype, T.LongType):
+                        v = int(v)
+                    cols.append(constant_column(v, f.dtype, n, cap))
+                batch = ColumnarBatch(cols, batch.num_rows, self._schema)
+            yield self._count_output(batch)

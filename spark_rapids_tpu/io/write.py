@@ -262,7 +262,6 @@ def _split_by_partitions(table: pa.Table, part_cols: Sequence[str]
 
     if table.num_rows == 0:
         return []
-    keys = [table.column(c) for c in part_cols]
     distinct = pa.table(
         {c: table.column(c) for c in part_cols}).group_by(
         list(part_cols)).aggregate([]).to_pydict()
@@ -272,8 +271,14 @@ def _split_by_partitions(table: pa.Table, part_cols: Sequence[str]
         values = tuple(distinct[c][i] for c in part_cols)
         mask = None
         for c, v in zip(part_cols, values):
-            m = pc.is_null(table.column(c)) if v is None \
-                else pc.equal(table.column(c), pa.scalar(v))
+            col = table.column(c)
+            if v is None:
+                m = pc.is_null(col)
+            elif isinstance(v, float) and v != v:
+                # NaN partition value: pc.equal(x, NaN) never matches
+                m = pc.is_nan(col)
+            else:
+                m = pc.equal(col, pa.scalar(v))
             mask = m if mask is None else pc.and_(mask, m)
         out.append((values, table.filter(mask)))
     return out

@@ -162,6 +162,11 @@ class SpillableBatch:
         """Acquire device-resident (pins the buffer until unpin/close)."""
         return self._store.acquire(self.buffer_id)
 
+    def get_host(self) -> dict:
+        """Read the batch as host arrays without materializing on device
+        (pins; the out-of-core sort assembles buckets host-side)."""
+        return self._store.acquire_host(self.buffer_id)
+
     def unpin(self) -> None:
         """Make the buffer spillable again (caller dropped its batch
         reference)."""
@@ -220,6 +225,27 @@ class BufferStore:
             self.device_used += nbytes
             return SpillableBatch(self, bid)
 
+    def register_host(self, arrays: dict, schema: T.Schema,
+                      priority: int = SpillPriorities.ACTIVE_ON_DECK
+                      ) -> SpillableBatch:
+        """Register a batch already materialized as host arrays (the
+        out-of-core sort's run storage: data that by design does not live
+        on device).  Enters at HOST tier and participates in host->disk
+        spill; `get()` re-materializes on device as usual."""
+        with self._lock:
+            bid = self._next_id
+            self._next_id += 1
+            # device-size estimate for when it is re-materialized
+            nbytes = _host_bytes(arrays)
+            self._entries[bid] = _Entry(
+                bid, priority, nbytes, StorageTier.HOST, None, arrays,
+                None, schema)
+            self.host_used += nbytes
+            while self.host_used > self.host_budget:
+                if not self._spill_one_host():
+                    break
+            return SpillableBatch(self, bid)
+
     def acquire(self, buffer_id: int) -> ColumnarBatch:
         with self._lock:
             e = self._entries[buffer_id]
@@ -250,6 +276,31 @@ class BufferStore:
             e.pinned = True
             self.device_used += e.nbytes
             return batch
+
+    def acquire_host(self, buffer_id: int) -> dict:
+        """Host-array view of an entry at any tier (pins the entry; a
+        DEVICE-tier entry is pulled D2H without changing tiers)."""
+        with self._lock:
+            e = self._entries[buffer_id]
+            e.pinned = True
+            if e.tier == StorageTier.HOST:
+                return e.host  # type: ignore[return-value]
+            if e.tier == StorageTier.DISK:
+                with np.load(e.path) as z:  # type: ignore[arg-type]
+                    return {k: z[k] for k in z.files}
+            b = e.batch  # DEVICE: pull without deleting
+            arrays: dict[str, np.ndarray] = {}
+            n = b.concrete_num_rows()  # type: ignore[union-attr]
+            for i, c in enumerate(b.columns):  # type: ignore[union-attr]
+                if isinstance(c, StringColumn):
+                    arrays[f"c{i}_chars"] = np.asarray(c.chars)
+                    arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
+                    arrays[f"c{i}_valid"] = np.asarray(c.validity)
+                else:
+                    arrays[f"c{i}_data"] = np.asarray(c.data)
+                    arrays[f"c{i}_valid"] = np.asarray(c.validity)
+            arrays["__num_rows"] = np.asarray(n, np.int64)
+            return arrays
 
     def remove(self, buffer_id: int) -> None:
         with self._lock:

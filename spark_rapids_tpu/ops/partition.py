@@ -63,6 +63,62 @@ class HashPartitioning(Partitioning):
 
 
 @dataclasses.dataclass
+class RangePartitioning(Partitioning):
+    """Range partitioning for distributed ORDER BY (ref:
+    GpuRangePartitioning.scala + GpuRangePartitioner.scala:30,167).
+    Bounds are sampled at exchange map time (two-pass map stage); rows
+    compare to bounds via the total-order lexicographic keys of
+    ops.range_partition, so partition index order IS the sort order."""
+
+    keys: Sequence  # of execs.sort.SortKey
+    num_partitions: int
+
+    def bind(self, schema) -> "RangePartitioning":
+        from spark_rapids_tpu.execs.sort import SortKey
+
+        return RangePartitioning(
+            [SortKey(bind_references(k.expr, schema), k.descending,
+                     k.nulls_last) for k in self.keys],
+            self.num_partitions)
+
+    def key_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Evaluate the sort-key expressions into a key-column batch
+        (traceable); both samples and bounds live in this layout."""
+        from spark_rapids_tpu import types as T
+
+        ctx = EvalContext.for_batch(batch)
+        cols = [k.expr.eval(ctx) for k in self.keys]
+        schema = T.Schema([T.Field(f"__rk{i}", k.expr.dtype)
+                           for i, k in enumerate(self.keys)])
+        return ColumnarBatch(cols, batch.num_rows, schema)
+
+    def key_orders(self):
+        from spark_rapids_tpu.ops.sort import SortOrder
+
+        return [SortOrder(i, k.descending, k.nulls_last)
+                for i, k in enumerate(self.keys)]
+
+    def partition_ids_with_bounds(self, batch: ColumnarBatch,
+                                  bounds: ColumnarBatch) -> jax.Array:
+        """Traceable; `bounds` is a key-layout batch of
+        num_partitions-1 rows."""
+        from spark_rapids_tpu.ops.range_partition import bucket_ids
+
+        return bucket_ids(self.key_batch(batch), bounds,
+                          self.key_orders(), self.num_partitions - 1)
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        raise TypeError("RangePartitioning needs sampled bounds; the "
+                        "exchange runs its two-pass map stage")
+
+    def describe(self) -> str:
+        ks = ", ".join(
+            f"{k.expr.name}{' DESC' if k.descending else ''}"
+            for k in self.keys)
+        return f"rangepartitioning({ks}, {self.num_partitions})"
+
+
+@dataclasses.dataclass
 class RoundRobinPartitioning(Partitioning):
     num_partitions: int
     start: int = 0

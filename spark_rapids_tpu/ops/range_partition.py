@@ -1,0 +1,92 @@
+"""Range partitioning: sample -> bounds -> per-row bucket search.
+
+TPU counterpart of GpuRangePartitioning/GpuRangePartitioner
+(ref: GpuRangePartitioning.scala, GpuRangePartitioner.scala:30 `sketch`
+reservoir sampling, :77 `determineBounds`, :167 device upper-bound
+search).  The same mechanism drives BOTH:
+- the distributed ORDER BY exchange (range-partitioned shuffle), and
+- the local out-of-core sort (sample-split sort: split oversized input
+  into key-range buckets that each fit on device, sort buckets
+  independently, emit in bound order) — the TPU-idiomatic replacement
+  for the reference's cursor-based GpuOutOfCoreSortIterator merge
+  (GpuSortExec.scala:213), chosen because it is two streaming passes of
+  fixed-shape device programs with no per-round host round trips.
+
+Multi-column ordering reuses the total-order integer key transforms of
+ops.sort (floats via IEEE total-order bits, strings via big-endian words,
+NULL placement flags), so a "row < bound" test is a short vectorized
+lexicographic compare and bucket ids are `sum_i [bound_i < row]`.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.ops.sort import SortOrder, column_sort_keys
+
+
+def row_lex_keys(batch: ColumnarBatch,
+                 orders: Sequence[SortOrder]) -> list[jax.Array]:
+    """Major-first integer key arrays realizing the SQL ORDER BY as plain
+    ascending lexicographic order (padding/live flags NOT included)."""
+    keys: list[jax.Array] = []
+    for o in orders:
+        col = batch.columns[o.ordinal]
+        minor_first = column_sort_keys(col, o.descending, o.nulls_last)
+        # column_sort_keys returns [value minor..major, null_flag]; the
+        # null flag is most significant
+        keys.extend(reversed(minor_first))
+    return keys
+
+
+def _lex_less(a_keys: Sequence[jax.Array],
+              b_keys: Sequence[jax.Array]) -> jax.Array:
+    """Elementwise `a < b` over parallel major-first key arrays."""
+    lt = jnp.zeros(a_keys[0].shape, bool)
+    decided = jnp.zeros(a_keys[0].shape, bool)
+    for a, b in zip(a_keys, b_keys):
+        lt = lt | (~decided & (a < b))
+        decided = decided | (a != b)
+    return lt
+
+
+def choose_bounds(samples: ColumnarBatch, orders: Sequence[SortOrder],
+                  n_parts: int, n_live: int) -> ColumnarBatch:
+    """Sort the pooled sample and take n_parts-1 evenly spaced rows as
+    range bounds (ref: GpuRangePartitioner.determineBounds).  Returns a
+    small device batch of bound rows.  Traceable when n_live is static
+    (fixed-size sampling makes it so)."""
+    from spark_rapids_tpu.ops.sort import sort_batch
+
+    assert n_parts >= 1
+    s = sort_batch(samples, orders)
+    n_bounds = n_parts - 1
+    if n_live == 0 or n_bounds == 0:
+        return s.slice_prefix(0)
+    # evenly spaced ranks, clipped to live rows
+    ranks = np.minimum(
+        ((np.arange(1, n_bounds + 1) * n_live) // n_parts).astype(np.int32),
+        n_live - 1)
+    picked = s.gather(jnp.asarray(ranks, jnp.int32), n_bounds)
+    return ColumnarBatch(picked.columns, n_bounds, s.schema)
+
+
+def bucket_ids(batch: ColumnarBatch, bounds: ColumnarBatch,
+               orders: Sequence[SortOrder], n_bounds: int) -> jax.Array:
+    """Per-row partition id in [0, n_bounds]: number of bounds strictly
+    less than the row (rows equal to a bound share its left bucket).
+    Traceable; program size O(n_bounds * n_keys)."""
+    if n_bounds == 0:
+        return jnp.zeros((batch.capacity,), jnp.int32)
+    row_keys = row_lex_keys(batch, orders)
+    bound_keys = row_lex_keys(bounds, orders)
+    pid = jnp.zeros((batch.capacity,), jnp.int32)
+    for i in range(n_bounds):
+        bi = [bk[i] for bk in bound_keys]
+        pid = pid + _lex_less(bi, row_keys).astype(jnp.int32)
+    return pid

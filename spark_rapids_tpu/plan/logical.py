@@ -239,13 +239,18 @@ class CsvRelation(LogicalPlan):
         from spark_rapids_tpu.columnar.arrow import schema_from_arrow
 
         self.children = []
-        self.paths, _, _ = expand_scan_paths(list(paths), ".csv")
+        self.paths, self.partition_values, part_cols = expand_scan_paths(
+            list(paths), ".csv")
         if not self.paths:
             raise FileNotFoundError(f"no csv files under {paths}")
+        self.partition_fields = infer_partition_fields(
+            part_cols, self.partition_values)
         if schema is None:
             head = pacsv.read_csv(self.paths[0])
             schema = schema_from_arrow(head.schema)
-        self._schema = schema
+        self.file_schema = schema
+        self._schema = T.Schema(
+            list(schema.fields) + self.partition_fields)
 
     @property
     def schema(self) -> T.Schema:

@@ -280,7 +280,9 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
                                partition_values=p.partition_values,
                                partition_fields=p.partition_fields)
     if isinstance(p, L.CsvRelation):
-        return CsvScanExec(p.paths, p.schema)
+        return CsvScanExec(p.paths, p.schema,
+                           partition_values=p.partition_values,
+                           partition_fields=p.partition_fields)
     if isinstance(p, L.RangeRel):
         return TpuRangeExec(p.start, p.end, p.step)
     if isinstance(p, L.Project):
@@ -290,7 +292,7 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.Aggregate):
         return _plan_aggregate(p, kids[0])
     if isinstance(p, L.Sort):
-        return TpuSortExec(p.keys, kids[0])
+        return _plan_sort(p, kids[0])
     if isinstance(p, L.Window):
         from spark_rapids_tpu.execs.window import TpuWindowExec
 
@@ -399,6 +401,36 @@ def _hash_satisfies(exec_: TpuExec, keys):
         elif expr_key(pe) != expr_key(jk):
             return None
     return part
+
+
+RANGE_SORT = register(
+    "spark.rapids.tpu.sql.sort.rangeExchange", True,
+    "Plan multi-partition ORDER BY as a range-partitioned exchange plus "
+    "per-partition sorts (the Spark physical shape, ref: "
+    "GpuRangePartitioning.scala); disabled, the sort runs as one "
+    "wide out-of-core operator.")
+
+
+def _plan_sort(p: L.Sort, child_exec: TpuExec) -> TpuExec:
+    """Distributed ORDER BY (ref: Spark planning SortExec under a
+    RangePartitioning exchange): sample-bounded range exchange, then
+    each reduce partition sorts independently; partition index order
+    equals total order.  Single-partition children sort locally (with
+    the out-of-core sample-split path above the size threshold)."""
+    from spark_rapids_tpu.execs.exchange import (
+        SHUFFLE_PARTITIONS,
+        TpuShuffleExchangeExec,
+    )
+    from spark_rapids_tpu.execs.sort import TpuSortExec
+    from spark_rapids_tpu.ops.partition import RangePartitioning
+
+    conf = get_conf()
+    if child_exec.num_partitions > 1 and conf.get(RANGE_SORT):
+        n = conf.get(SHUFFLE_PARTITIONS)
+        ex = TpuShuffleExchangeExec(
+            RangePartitioning(p.keys, n), child_exec)
+        return TpuSortExec(p.keys, ex, scope="partition")
+    return TpuSortExec(p.keys, child_exec)
 
 
 def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:

@@ -1,0 +1,235 @@
+"""Out-of-core sample-split sort tests.
+
+Forces the OOC path with a tiny single-batch threshold (and a tiny HBM
+budget so collected batches actually spill) and checks exact ordered
+equality against the CPU oracle — the GpuOutOfCoreSortIterator coverage
+analog (ref: tests/.../SortExecSuite)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.execs.sort import (
+    SORT_MAX_BUCKETS,
+    SORT_SINGLE_BATCH_ROWS,
+)
+from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tables_equal, gen_table
+
+
+@pytest.fixture
+def ooc_conf():
+    """Tiny thresholds to force the OOC path, with the range exchange
+    off so the WIDE sample-split sort is what runs (the range-exchange
+    plan shape has its own tests below)."""
+    from spark_rapids_tpu.plan.planner import RANGE_SORT
+
+    conf = get_conf()
+    old = {k.key: conf.get(k) for k in (SORT_SINGLE_BATCH_ROWS,
+                                        SORT_MAX_BUCKETS, BATCH_SIZE_ROWS,
+                                        RANGE_SORT)}
+    conf.set(SORT_SINGLE_BATCH_ROWS.key, 500)
+    conf.set(BATCH_SIZE_ROWS.key, 700)
+    conf.set(SORT_MAX_BUCKETS.key, 8)
+    conf.set(RANGE_SORT.key, False)
+    yield conf
+    for k, v in old.items():
+        conf.set(k, v)
+
+
+def _write_files(tmp_path, t: pa.Table, n_files: int):
+    paths = []
+    per = t.num_rows // n_files
+    for i in range(n_files):
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(t.slice(i * per, per if i < n_files - 1
+                               else t.num_rows - i * per), p)
+        paths.append(p)
+    return paths
+
+
+#: key lists are TOTAL orders (every column appears): ORDER BY leaves
+#: tie order unspecified, and the threaded range exchange (like Spark's
+#: shuffle) does not preserve input order between equal keys
+@pytest.mark.parametrize("spec,keys", [
+    ({"a": "int64", "b": "float64"}, [("a", False), ("b", False)]),
+    ({"a": "int64", "b": "float64"}, [("b", True), ("a", False)]),
+    ({"a": "int32", "s": "string", "b": "float64"}, [("s", False),
+                                                    ("a", True),
+                                                    ("b", False)]),
+])
+def test_ooc_sort_matches_cpu(ooc_conf, tmp_path, spec, keys):
+    t = gen_table(spec, 4000, seed=11)
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 4)
+    from spark_rapids_tpu.execs.sort import SortKey
+    from spark_rapids_tpu.session import _expr
+
+    sks = [SortKey(col(name), descending=d, nulls_last=d) for name, d in keys]
+    df = session.read_parquet(*paths).order_by(*sks)
+    tpu = df.collect(engine="tpu")
+    cpu = df.collect(engine="cpu")
+    assert_tables_equal(tpu, cpu, ignore_order=False)
+    assert tpu.num_rows == 4000
+
+
+def test_ooc_sort_spills(ooc_conf, tmp_path):
+    """With a tiny HBM budget the collected batches must spill and the
+    result must still be exactly ordered."""
+    from spark_rapids_tpu.memory import get_store, reset_store
+    from spark_rapids_tpu.memory.store import BufferStore
+
+    t = gen_table({"a": "int64", "b": "float64"}, 3000, seed=3)
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 3)
+    reset_store(BufferStore(device_budget=30_000, host_budget=60_000))
+    try:
+        df = session.read_parquet(*paths).order_by(col("a"))
+        tpu = df.collect(engine="tpu")
+        store = get_store()
+        assert store.spilled_device_to_host > 0
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu, ignore_order=False)
+    finally:
+        reset_store()
+
+
+def test_ooc_sort_heavy_duplicates(ooc_conf, tmp_path):
+    """Skewed keys (many duplicates) must stay correct even when one
+    range bucket holds most rows."""
+    rng = np.random.default_rng(9)
+    t = pa.table({
+        "k": pa.array(np.where(rng.random(2000) < 0.8, 7,
+                               rng.integers(0, 100, 2000)), pa.int64()),
+        "v": pa.array(rng.random(2000), pa.float64()),
+    })
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 2)
+    df = session.read_parquet(*paths).order_by(col("k"))
+    tpu = df.collect(engine="tpu").to_pydict()
+    assert tpu["k"] == sorted(tpu["k"])
+    assert tpu["k"].count(7) == int(np.sum(np.asarray(
+        t.column("k")) == 7))
+
+
+def test_small_input_stays_single_batch(tmp_path):
+    """Below the threshold the sort must not take the OOC path (metric
+    stays zero)."""
+    t = gen_table({"a": "int64"}, 200, seed=5)
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 2)
+    df = session.read_parquet(*paths).order_by(col("a"))
+    exec_, _ = session_plan(session, df)
+    out = _drain(exec_)
+    sort_nodes = [n for n in exec_._walk()
+                  if type(n).__name__ == "TpuSortExec"]
+    assert sort_nodes and sort_nodes[0].metrics["oocRows"].value == 0
+
+
+def session_plan(session, df):
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    return plan_query(df._plan, session.conf)
+
+
+def _drain(exec_):
+    from spark_rapids_tpu.plan.planner import collect_exec
+
+    return collect_exec(exec_)
+
+
+# -- distributed ORDER BY via range exchange ---------------------------- #
+
+def test_range_exchange_order_by(tmp_path):
+    """Multi-partition ORDER BY plans as range exchange + per-partition
+    sorts and matches the CPU oracle exactly (Spark-semantics bounds:
+    any sampled bounds give the same total order)."""
+    from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+    t = gen_table({"a": "int64", "b": "float64", "s": "string"}, 3000,
+                  seed=21)
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 4)
+    # total order (every column a key): the threaded exchange does not
+    # preserve input order between equal keys, as in Spark
+    df = session.read_parquet(*paths).order_by(col("a"), col("s"),
+                                               col("b"))
+    exec_, _ = plan_query(df._plan, session.conf)
+    tree = exec_.tree_string()
+    assert "rangepartitioning" in tree, tree
+    assert "scope=partition" in tree, tree
+    tpu = collect_exec(exec_)
+    cpu = df.collect(engine="cpu")
+    assert_tables_equal(tpu, cpu, ignore_order=False)
+
+
+def test_range_exchange_descending_nulls(tmp_path):
+    from spark_rapids_tpu.execs.sort import SortKey
+
+    t = gen_table({"a": "int64", "b": "float64"}, 1500, seed=31,
+                  null_prob=0.3)
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 3)
+    df = session.read_parquet(*paths).order_by(
+        SortKey(col("a"), descending=True, nulls_last=True),
+        SortKey(col("b")))
+    tpu = df.collect(engine="tpu")
+    cpu = df.collect(engine="cpu")
+    assert_tables_equal(tpu, cpu, ignore_order=False)
+
+
+def test_range_exchange_disabled_falls_back_wide(tmp_path):
+    from spark_rapids_tpu.plan.planner import RANGE_SORT, plan_query
+
+    t = gen_table({"a": "int64"}, 500, seed=41)
+    session = TpuSession()
+    paths = _write_files(tmp_path, t, 2)
+    conf = get_conf()
+    old = conf.get(RANGE_SORT)
+    conf.set(RANGE_SORT.key, False)
+    try:
+        df = session.read_parquet(*paths).order_by(col("a"))
+        exec_, _ = plan_query(df._plan, session.conf)
+        assert "scope=global" in exec_.tree_string()
+        tpu = df.collect(engine="tpu")
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu, ignore_order=False)
+    finally:
+        conf.set(RANGE_SORT.key, old)
+
+
+def test_oversized_bucket_recursion(tmp_path):
+    """Clustered keys force one range bucket far over the threshold; the
+    recursive re-split must keep the result exactly ordered."""
+    from spark_rapids_tpu.plan.planner import RANGE_SORT
+
+    conf = get_conf()
+    old = {k.key: conf.get(k) for k in (SORT_SINGLE_BATCH_ROWS,
+                                        SORT_MAX_BUCKETS, BATCH_SIZE_ROWS,
+                                        RANGE_SORT)}
+    conf.set(SORT_SINGLE_BATCH_ROWS.key, 300)
+    conf.set(BATCH_SIZE_ROWS.key, 500)
+    conf.set(SORT_MAX_BUCKETS.key, 4)
+    conf.set(RANGE_SORT.key, False)
+    try:
+        rng = np.random.default_rng(17)
+        # 90% of keys in a narrow band -> one bucket swallows them
+        k = np.where(rng.random(4000) < 0.9,
+                     rng.integers(1000, 1010, 4000),
+                     rng.integers(0, 100000, 4000)).astype(np.int64)
+        t = pa.table({"k": pa.array(k, pa.int64()),
+                      "v": pa.array(rng.random(4000), pa.float64())})
+        session = TpuSession()
+        paths = _write_files(tmp_path, t, 4)
+        df = session.read_parquet(*paths).order_by(col("k"), col("v"))
+        tpu = df.collect(engine="tpu")
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu, ignore_order=False)
+    finally:
+        for kk, v in old.items():
+            conf.set(kk, v)

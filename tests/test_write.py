@@ -136,3 +136,29 @@ def test_empty_write_round_trip(session, tmp_path):
     back = session.read_parquet(p).collect()
     assert back.num_rows == 0
     assert back.schema.names == ["x"]
+
+
+def test_csv_partitioned_round_trip(session, tmp_path):
+    t = pa.table({"k": pa.array([1, 1, 2], pa.int64()),
+                  "v": pa.array([1.5, 2.5, 3.5], pa.float64())})
+    p = str(tmp_path / "out")
+    session.create_dataframe(t).write.partition_by("k").csv(p)
+    back = session.read_csv(p).collect()
+    assert sorted(zip(back.to_pydict()["v"], back.to_pydict()["k"])) == [
+        (1.5, 1), (2.5, 1), (3.5, 2)]
+    cpu = session.read_csv(p).collect(engine="cpu")
+    assert _sorted(back) == _sorted(cpu)
+
+
+def test_partitioned_write_nan_value(session, tmp_path):
+    import math
+
+    t = pa.table({"k": pa.array([1.0, float("nan"), 2.0], pa.float64()),
+                  "v": pa.array([1, 2, 3], pa.int64())})
+    p = str(tmp_path / "out")
+    stats = session.create_dataframe(t).write.partition_by("k").parquet(p)
+    assert stats.num_rows == 3
+    back = session.read_parquet(p).collect().to_pydict()
+    assert sorted(back["v"]) == [1, 2, 3]  # the NaN row must survive
+    kv = dict(zip(back["v"], back["k"]))
+    assert math.isnan(float(kv[2]))
