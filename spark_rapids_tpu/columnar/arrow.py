@@ -22,6 +22,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import (
     AnyColumn,
     Column,
+    ListColumn,
     StringColumn,
     all_valid_mask,
     pad_capacity,
@@ -132,6 +133,53 @@ def _string_host(arr: pa.Array, cap: int
     return chars, lengths, valid
 
 
+def _list_host(arr: pa.Array, dtype: T.ListType, cap: int
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Decode one list<primitive> column to dense host buffers:
+    (values[cap, L], lengths[cap], elem_validity[cap, L], validity[cap])."""
+    n = len(arr)
+    phys = T.to_numpy_dtype(dtype.element)
+    larr = arr.cast(pa.large_list(T.to_arrow_type(dtype.element)))
+    offsets = np.frombuffer(larr.buffers()[1], dtype=np.int64,
+                            count=n + 1, offset=larr.offset * 8)
+    flat = larr.values
+    if len(flat):
+        fv = np.asarray(flat.is_valid()) if flat.null_count \
+            else np.ones(len(flat), np.bool_)
+        if flat.null_count:
+            flat = flat.fill_null(_zero_value(dtype.element))
+        if isinstance(dtype.element, T.DateType):
+            flat_np = flat.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        elif isinstance(dtype.element, T.TimestampType):
+            flat_np = flat.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        else:
+            flat_np = flat.to_numpy(zero_copy_only=False).astype(
+                phys, copy=False)
+    else:
+        flat_np = np.zeros(0, phys)
+        fv = np.zeros(0, np.bool_)
+    validity = np.asarray(arr.is_valid()) if arr.null_count \
+        else np.ones(n, np.bool_)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    lens = np.where(validity, lens, 0).astype(np.int32)
+    maxlen = int(lens.max()) if n else 0
+    L = pad_width(max(maxlen, 1))
+    values = np.zeros((cap, L), phys)
+    evalid = np.zeros((cap, L), np.bool_)
+    if n:
+        idx = offsets[:-1, None] + np.arange(L)[None, :]
+        mask = np.arange(L)[None, :] < lens[:, None]
+        safe = np.clip(idx, 0, max(len(flat_np) - 1, 0))
+        if len(flat_np):
+            values[:n] = np.where(mask, flat_np[safe], 0)
+            evalid[:n] = mask & fv[safe]
+    lengths = np.zeros(cap, np.int32)
+    lengths[:n] = lens
+    valid = np.zeros(cap, np.bool_)
+    valid[:n] = validity
+    return values, lengths, evalid, valid
+
+
 # --------------------------------------------------------------------- #
 # Packed upload: one H2D transfer per batch
 # --------------------------------------------------------------------- #
@@ -222,6 +270,10 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
             chars, lengths, valid = _string_host(arr, cap)
             recipe.append(("str", len(comps), f.dtype))
             comps.extend([chars, lengths, valid])
+        elif isinstance(f.dtype, T.ListType):
+            values, lengths, evalid, valid = _list_host(arr, f.dtype, cap)
+            recipe.append(("list", len(comps), f.dtype))
+            comps.extend([values, lengths, evalid, valid])
         else:
             data, vhost = _fixed_host(arr, f.dtype, cap)
             if vhost is None:
@@ -245,6 +297,9 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
     for kind, i, dtype in recipe:
         if kind == "str":
             cols.append(StringColumn(dev[i], dev[i + 1], dev[i + 2]))
+        elif kind == "list":
+            cols.append(ListColumn(dev[i], dev[i + 1], dev[i + 2],
+                                   dev[i + 3], dtype))
         elif kind == "fixed_shared":
             cols.append(Column(dev[i], all_valid_mask(cap), dtype))
         else:
@@ -258,7 +313,22 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
     arrays = []
     aschema = schema_to_arrow(batch.schema)
     for f, col, afield in zip(batch.schema.fields, batch.columns, aschema):
-        if isinstance(col, StringColumn):
+        if isinstance(col, ListColumn):
+            vals = np.asarray(col.values)[:n]
+            lens = np.asarray(col.lengths)[:n]
+            ev = np.asarray(col.elem_validity)[:n]
+            rv = np.asarray(col.validity)[:n]
+            pylist = []
+            for i in range(n):
+                if not rv[i]:
+                    pylist.append(None)
+                else:
+                    m = int(lens[i])
+                    pylist.append([
+                        vals[i, j].item() if ev[i, j] else None
+                        for j in range(m)])
+            arrays.append(pa.array(pylist, type=afield.type))
+        elif isinstance(col, StringColumn):
             arrays.append(pa.array(col.to_list(n), type=afield.type))
         else:
             vals = np.asarray(col.data)[:n]

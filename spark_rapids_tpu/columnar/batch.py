@@ -29,6 +29,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import (
     AnyColumn,
     Column,
+    ListColumn,
     StringColumn,
     pad_capacity,
     pad_width,
@@ -215,6 +216,11 @@ class ColumnarBatch:
                 cols.append(StringColumn(c.chars[:new_cap],
                                          c.lengths[:new_cap],
                                          c.validity[:new_cap]))
+            elif isinstance(c, ListColumn):
+                cols.append(ListColumn(c.values[:new_cap],
+                                       c.lengths[:new_cap],
+                                       c.elem_validity[:new_cap],
+                                       c.validity[:new_cap], c.dtype))
             else:
                 cols.append(Column(c.data[:new_cap], c.validity[:new_cap],
                                    c.dtype))
@@ -249,7 +255,31 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     out_cols: list[AnyColumn] = []
     for ci, f in enumerate(schema.fields):
         parts = [b.columns[ci] for b in batches]
-        if isinstance(f.dtype, T.StringType):
+        if isinstance(f.dtype, T.ListType):
+            phys = T.to_numpy_dtype(f.dtype.element)
+            L = max(p.max_len for p in parts)  # type: ignore[union-attr]
+            values = jnp.zeros((cap, L), phys)
+            lengths = jnp.zeros(cap, jnp.int32)
+            evalid = jnp.zeros((cap, L), jnp.bool_)
+            valid = jnp.zeros(cap, jnp.bool_)
+            off = 0
+            for p, n in zip(parts, ns):
+                if n == 0:
+                    continue
+                pv, pe = p.values[:n], p.elem_validity[:n]
+                if p.max_len < L:
+                    pv = jnp.pad(pv, ((0, 0), (0, L - p.max_len)))
+                    pe = jnp.pad(pe, ((0, 0), (0, L - p.max_len)))
+                values = jax.lax.dynamic_update_slice(values, pv, (off, 0))
+                evalid = jax.lax.dynamic_update_slice(evalid, pe, (off, 0))
+                lengths = jax.lax.dynamic_update_slice(
+                    lengths, p.lengths[:n].astype(jnp.int32), (off,))
+                valid = jax.lax.dynamic_update_slice(
+                    valid, p.validity[:n], (off,))
+                off += n
+            out_cols.append(ListColumn(values, lengths, evalid, valid,
+                                       f.dtype))
+        elif isinstance(f.dtype, T.StringType):
             w = pad_width(max(p.width for p in parts))  # type: ignore[union-attr]
             chars = jnp.zeros((cap, w), jnp.uint8)
             lengths = jnp.zeros(cap, jnp.int32)

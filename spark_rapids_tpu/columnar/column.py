@@ -208,7 +208,59 @@ class StringColumn:
         return out
 
 
-AnyColumn = Union[Column, StringColumn]
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ListColumn:
+    """Fixed-width list column: `values[capacity, max_len]` +
+    `lengths[capacity]` int32 + per-element `elem_validity` + per-row
+    `validity`.
+
+    The dense-matrix answer to ragged arrays (the StringColumn pattern
+    applied to list<primitive>): cudf's offsets+child layout is a ragged
+    traversal, XLA wants one static 2-D shape — explode becomes a
+    flatten+compact, element access a column gather."""
+
+    values: ArrayLike          # (capacity, max_len) element physical type
+    lengths: ArrayLike         # (capacity,) int32
+    elem_validity: ArrayLike   # (capacity, max_len) bool
+    validity: ArrayLike        # (capacity,) bool — row-level NULL
+    dtype: T.DataType = dataclasses.field(
+        default_factory=lambda: T.ListType(T.LONG))
+
+    def tree_flatten(self):
+        return ((self.values, self.lengths, self.elem_validity,
+                 self.validity), (self.dtype,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        values, lengths, elem_validity, validity = children
+        return cls(values, lengths, elem_validity, validity, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def max_len(self) -> int:
+        return int(self.values.shape[1])
+
+    def with_validity(self, validity: ArrayLike) -> "ListColumn":
+        return ListColumn(self.values, self.lengths, self.elem_validity,
+                          validity, self.dtype)
+
+    def gather(self, indices: ArrayLike,
+               index_valid: Optional[ArrayLike] = None) -> "ListColumn":
+        idx = jnp.clip(indices, 0, self.capacity - 1)
+        validity = jnp.take(self.validity, idx, axis=0)
+        if index_valid is not None:
+            validity = validity & index_valid
+        return ListColumn(jnp.take(self.values, idx, axis=0),
+                          jnp.take(self.lengths, idx, axis=0),
+                          jnp.take(self.elem_validity, idx, axis=0),
+                          validity, self.dtype)
+
+
+AnyColumn = Union[Column, StringColumn, ListColumn]
 
 
 def column_to_numpy(col: AnyColumn, num_rows: int

@@ -82,8 +82,33 @@ def _from_np(vals: np.ndarray, valid: np.ndarray, atype) -> pa.Array:
 
 
 def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
+    from spark_rapids_tpu.exprs import collections as COLL
+
     if isinstance(e, B.Alias):
         return cpu_eval(e.child, table)
+    if isinstance(e, COLL.Size):
+        c = cpu_eval(e.child, table)
+        return pc.list_value_length(c).cast(pa.int32())
+    if isinstance(e, COLL.GetArrayItem):
+        c = cpu_eval(e.child, table)
+        k = int(e.index.value)
+        out = [None if (v is None or k < 0 or k >= len(v)) else v[k]
+               for v in c.to_pylist()]
+        return pa.array(out, T.to_arrow_type(e.dtype))
+    if isinstance(e, COLL.ArrayContains):
+        c = cpu_eval(e.child, table)
+        v = e.value.value
+        out = []
+        for row in c.to_pylist():
+            if row is None:
+                out.append(None)
+            elif v in row:
+                out.append(True)
+            elif None in row:
+                out.append(None)
+            else:
+                out.append(False)
+        return pa.array(out, pa.bool_())
     if isinstance(e, B.BoundReference):
         return table.column(e.ordinal).combine_chunks()
     if isinstance(e, B.ColumnReference):
@@ -652,6 +677,53 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
         child = execute_cpu(plan.children[0])
         mask = pc.fill_null(cpu_eval(plan.condition, child), False)
         return child.filter(mask)
+    if isinstance(plan, L.Generate):
+        child = execute_cpu(plan.children[0])
+        gen = plan.generator
+        aschema = schema_to_arrow(plan.schema)
+        arr = cpu_eval(gen.child, child)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        lens = pc.fill_null(pc.list_value_length(arr), 0).to_numpy(
+            zero_copy_only=False).astype(np.int64)
+        n = len(arr)
+        if gen.outer:
+            rep = np.maximum(lens, 1)
+        else:
+            rep = lens
+        parent = np.repeat(np.arange(n), rep)
+        pos_list = []
+        elems = []
+        py = arr.to_pylist()
+        for i in range(n):
+            vals = py[i]
+            if vals:
+                for j, v in enumerate(vals):
+                    pos_list.append(j)
+                    elems.append(v)
+            elif gen.outer:
+                pos_list.append(None)
+                elems.append(None)
+        arrays = [child.column(cname).take(pa.array(parent))
+                  for cname in child.schema.names]
+        if gen.pos:
+            arrays.append(pa.array(pos_list, pa.int32()))
+        arrays.append(pa.array(
+            elems, aschema.field(plan.out_name).type))
+        return pa.Table.from_arrays(arrays, schema=aschema)
+    if isinstance(plan, L.Expand):
+        child = execute_cpu(plan.children[0])
+        aschema = schema_to_arrow(plan.schema)
+        parts = []
+        for proj in plan.projections:
+            arrays = []
+            for e, f in zip(proj, aschema):
+                a = cpu_eval(e, child)
+                if a.type != f.type:
+                    a = a.cast(f.type)
+                arrays.append(a)
+            parts.append(pa.Table.from_arrays(arrays, schema=aschema))
+        return pa.concat_tables(parts)
     if isinstance(plan, L.Aggregate):
         return _aggregate_cpu(plan)
     if isinstance(plan, L.Sort):

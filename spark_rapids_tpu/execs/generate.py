@@ -1,0 +1,89 @@
+"""Generate exec: explode/posexplode over dense list matrices.
+
+TPU re-design of GpuGenerateExec (ref: sql-plugin/.../GpuGenerateExec.
+scala:378 — cudf's explode produces a new table via offsets traversal).
+Here the (capacity, max_len) element matrix flattens row-major, a keep
+mask marks real elements (plus one NULL slot per empty/NULL row for
+explode_outer), and the same cumsum+searchsorted compaction the filter
+uses gathers both the repeated parent columns and the element column —
+one fused program, output capacity = capacity * max_len."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, ListColumn
+from spark_rapids_tpu.execs.base import BatchFn, FusableExec, TpuExec
+from spark_rapids_tpu.exprs.base import EvalContext
+
+
+class TpuGenerateExec(FusableExec):
+    def __init__(self, generator, schema: T.Schema, child: TpuExec):
+        super().__init__(child)
+        self.generator = generator
+        self._schema = schema
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"TpuGenerateExec [{self.generator.name}]"
+
+    def fuse_key(self):
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        return ("generate", expr_key(self.generator.child),
+                self.generator.pos, self.generator.outer,
+                repr(self._schema))
+
+    def make_batch_fn(self) -> BatchFn:
+        gen = self.generator
+        schema = self._schema
+
+        def fn(batch: ColumnarBatch) -> ColumnarBatch:
+            ctx = EvalContext.for_batch(batch)
+            lc = gen.child.eval(ctx)
+            assert isinstance(lc, ListColumn)
+            cap, L = lc.values.shape
+            live = batch.row_mask()
+            pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+            keep2d = live[:, None] & lc.validity[:, None] \
+                & (pos < lc.lengths[:, None])
+            if gen.outer:
+                # empty or NULL arrays still emit one NULL-element row
+                empty = live & (~lc.validity | (lc.lengths == 0))
+                keep2d = keep2d | (empty[:, None] & (pos == 0))
+                elem_ok2d = lc.elem_validity \
+                    & (pos < lc.lengths[:, None]) & lc.validity[:, None]
+            else:
+                elem_ok2d = lc.elem_validity
+            keep = keep2d.reshape(-1)
+            flat_cap = cap * L
+            csum = jnp.cumsum(keep.astype(jnp.int32))
+            n_out = csum[-1]
+            src = jnp.searchsorted(
+                csum, jnp.arange(flat_cap, dtype=jnp.int32) + 1,
+                side="left").astype(jnp.int32)
+            src = jnp.minimum(src, flat_cap - 1)
+            out_live = jnp.arange(flat_cap, dtype=jnp.int32) < n_out
+            parent = src // L
+            elem_pos = src - parent * L
+            out_cols = []
+            for c in batch.columns:
+                g = c.gather(parent)
+                out_cols.append(g.with_validity(g.validity & out_live))
+            if gen.pos:
+                # pos is NULL on explode_outer's empty/NULL filler rows
+                real2d = lc.validity[:, None] & (pos < lc.lengths[:, None])
+                pos_ok = real2d.reshape(-1)[src] & out_live
+                out_cols.append(Column(elem_pos, pos_ok, T.INT))
+            ev = elem_ok2d.reshape(-1)[src]
+            out_cols.append(Column(
+                lc.values.reshape(-1)[src], ev & out_live,
+                gen.dtype))
+            return ColumnarBatch(out_cols, n_out, schema)
+
+        return fn

@@ -110,6 +110,17 @@ class Count(AggregateFunction):
         return Coalesce(partial_refs[0], Literal.of(0))
 
 
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT x) marker: never executes directly — the session
+    frontend rewrites it into a two-level aggregate (group-by-x dedupe
+    then count), the single-distinct specialization of Spark's
+    RewriteDistinctAggregates rule."""
+
+    @property
+    def name(self) -> str:
+        return "count_distinct"
+
+
 class CountStar(AggregateFunction):
     def __init__(self):
         super().__init__(None)

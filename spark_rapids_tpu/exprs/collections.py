@@ -1,0 +1,158 @@
+"""Collection (array) expressions over dense list matrices.
+
+TPU counterparts of collectionOperations.scala / complexTypeExtractors
+(ref: sql-plugin/.../sql/rapids/collectionOperations.scala,
+GpuGetArrayItem in complexTypeExtractors.scala, GpuExplode in
+GpuGenerateExec.scala:378).  cudf walks offsets+child buffers; a
+ListColumn is a dense (rows, max_len) element matrix + lengths, so
+every op here is one vectorized program."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import Column, ListColumn
+from spark_rapids_tpu.exprs.base import EvalContext, Expression, Literal
+
+
+def _as_list(col) -> ListColumn:
+    assert isinstance(col, ListColumn), \
+        f"collection op over non-list column {type(col).__name__}"
+    return col
+
+
+@dataclasses.dataclass(repr=False)
+class Size(Expression):
+    """size(array) — number of elements, NULL for NULL input
+    (ref: GpuSize, collectionOperations.scala; legacy -1-for-null mode
+    not implemented)."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.INT
+
+    @property
+    def name(self) -> str:
+        return f"size({self.child.name})"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.ListType):
+            raise TypeError(f"size() requires an array, got "
+                            f"{self.child.dtype.name}")
+
+    def eval(self, ctx: EvalContext) -> Column:
+        c = _as_list(self.child.eval(ctx))
+        return Column(c.lengths.astype(jnp.int32), c.validity, T.INT)
+
+
+@dataclasses.dataclass(repr=False)
+class GetArrayItem(Expression):
+    """array[i] with a literal index — NULL when out of bounds or the
+    element is NULL (ref: GpuGetArrayItem,
+    complexTypeExtractors.scala)."""
+
+    child: Expression
+    index: Expression  # Literal int
+
+    @property
+    def dtype(self) -> T.DataType:
+        dt = self.child.dtype
+        # non-list child: keep schema derivation alive so tagging can
+        # report the real reason via check_supported
+        return dt.element if isinstance(dt, T.ListType) else T.NULL
+
+    @property
+    def name(self) -> str:
+        return f"{self.child.name}[{self.index.name}]"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.ListType):
+            raise TypeError("getItem requires an array input")
+        if not isinstance(self.index, Literal):
+            raise TypeError("getItem index must be a literal")
+
+    def eval(self, ctx: EvalContext) -> Column:
+        c = _as_list(self.child.eval(ctx))
+        k = int(self.index.value)  # type: ignore[union-attr]
+        if k < 0 or k >= c.max_len:
+            phys = T.to_numpy_dtype(self.dtype)
+            return Column(jnp.zeros(c.capacity, phys),
+                          jnp.zeros(c.capacity, bool), self.dtype)
+        in_bounds = jnp.int32(k) < c.lengths
+        return Column(c.values[:, k],
+                      c.validity & in_bounds & c.elem_validity[:, k],
+                      self.dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class ArrayContains(Expression):
+    """array_contains(arr, literal) with Spark NULL semantics: true if
+    found; NULL if not found but the array has NULL elements; else
+    false (ref: GpuArrayContains, collectionOperations.scala)."""
+
+    child: Expression
+    value: Expression  # Literal
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def name(self) -> str:
+        return f"array_contains({self.child.name}, {self.value.name})"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.ListType):
+            raise TypeError("array_contains requires an array input")
+        if not isinstance(self.value, Literal) \
+                or self.value.value is None:
+            raise TypeError("array_contains value must be a non-null "
+                            "literal")
+
+    def eval(self, ctx: EvalContext) -> Column:
+        c = _as_list(self.child.eval(ctx))
+        elem_dt = c.dtype.element  # type: ignore[union-attr]
+        phys = T.to_numpy_dtype(elem_dt)
+        v = jnp.asarray(self.value.value, phys)  # type: ignore[union-attr]
+        pos = jnp.arange(c.max_len, dtype=jnp.int32)[None, :]
+        in_len = pos < c.lengths[:, None]
+        hit = in_len & c.elem_validity & (c.values == v)
+        found = jnp.any(hit, axis=1)
+        has_null = jnp.any(in_len & ~c.elem_validity, axis=1)
+        return Column(found, c.validity & (found | ~has_null), T.BOOLEAN)
+
+
+@dataclasses.dataclass(repr=False)
+class Explode(Expression):
+    """Generator marker: one output row per array element.  Never
+    evaluates inline — the session frontend extracts it into a Generate
+    node (ref: GpuExplode/GpuPosExplode, GpuGenerateExec.scala:378);
+    `outer` emits a NULL-element row for empty/NULL arrays
+    (explode_outer)."""
+
+    child: Expression
+    pos: bool = False
+    outer: bool = False
+
+    @property
+    def dtype(self) -> T.DataType:
+        dt = self.child.dtype
+        return dt.element if isinstance(dt, T.ListType) else T.NULL
+
+    @property
+    def name(self) -> str:
+        base = "posexplode" if self.pos else "explode"
+        return f"{base}{'_outer' if self.outer else ''}({self.child.name})"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.ListType):
+            raise TypeError(f"{self.name} requires an array input")
+
+    def eval(self, ctx: EvalContext):
+        raise TypeError("explode must appear at the top level of a "
+                        "select list")

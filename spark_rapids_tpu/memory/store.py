@@ -35,7 +35,12 @@ import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
+from spark_rapids_tpu.columnar.column import (
+    AnyColumn,
+    Column,
+    ListColumn,
+    StringColumn,
+)
 from spark_rapids_tpu.config import register
 
 
@@ -75,6 +80,10 @@ def batch_device_bytes(batch: ColumnarBatch) -> int:
     for c in batch.columns:
         if isinstance(c, StringColumn):
             total += c.chars.size * 1 + c.lengths.size * 4 + c.validity.size
+        elif isinstance(c, ListColumn):
+            total += (c.values.size * c.values.dtype.itemsize
+                      + c.lengths.size * 4 + c.elem_validity.size
+                      + c.validity.size)
         else:
             total += c.data.size * c.data.dtype.itemsize + c.validity.size
     if not isinstance(batch.num_rows, int):
@@ -92,6 +101,14 @@ def _batch_to_host(batch: ColumnarBatch) -> dict:
             arrays[f"c{i}_lengths"] = np.asarray(jax.device_get(c.lengths))
             arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
             for a in (c.chars, c.lengths, c.validity):
+                _delete(a)
+        elif isinstance(c, ListColumn):
+            arrays[f"c{i}_lvalues"] = np.asarray(jax.device_get(c.values))
+            arrays[f"c{i}_lengths"] = np.asarray(jax.device_get(c.lengths))
+            arrays[f"c{i}_levalid"] = np.asarray(
+                jax.device_get(c.elem_validity))
+            arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
+            for a in (c.values, c.lengths, c.elem_validity, c.validity):
                 _delete(a)
         else:
             arrays[f"c{i}_data"] = np.asarray(jax.device_get(c.data))
@@ -122,6 +139,12 @@ def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:
                 jnp.asarray(arrays[f"c{i}_chars"]),
                 jnp.asarray(arrays[f"c{i}_lengths"]),
                 jnp.asarray(arrays[f"c{i}_valid"])))
+        elif isinstance(f.dtype, T.ListType):
+            cols.append(ListColumn(
+                jnp.asarray(arrays[f"c{i}_lvalues"]),
+                jnp.asarray(arrays[f"c{i}_lengths"]),
+                jnp.asarray(arrays[f"c{i}_levalid"]),
+                jnp.asarray(arrays[f"c{i}_valid"]), f.dtype))
         else:
             cols.append(Column(jnp.asarray(arrays[f"c{i}_data"]),
                                jnp.asarray(arrays[f"c{i}_valid"]),
@@ -295,6 +318,11 @@ class BufferStore:
                 if isinstance(c, StringColumn):
                     arrays[f"c{i}_chars"] = np.asarray(c.chars)
                     arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
+                    arrays[f"c{i}_valid"] = np.asarray(c.validity)
+                elif isinstance(c, ListColumn):
+                    arrays[f"c{i}_lvalues"] = np.asarray(c.values)
+                    arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
+                    arrays[f"c{i}_levalid"] = np.asarray(c.elem_validity)
                     arrays[f"c{i}_valid"] = np.asarray(c.validity)
                 else:
                     arrays[f"c{i}_data"] = np.asarray(c.data)

@@ -325,6 +325,72 @@ class Aggregate(LogicalPlan):
         return f"Aggregate keys=[{ks}] [{asr}]"
 
 
+class Expand(LogicalPlan):
+    """Multiple projection lists over each input row (ref:
+    GpuExpandExec.scala:67): one output row per (input row, projection).
+    Grouping-set rewrites (rollup/cube) and distinct-aggregate rewrites
+    build on this node the way Spark's analyzer does."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: LogicalPlan):
+        assert projections and all(
+            len(p) == len(names) for p in projections)
+        self.children = [child]
+        self.projections = [
+            [bind_references(e, child.schema) for e in proj]
+            for proj in projections]
+        fields = []
+        for i, name in enumerate(names):
+            dt = None
+            for proj in self.projections:
+                pdt = proj[i].dtype
+                if not isinstance(pdt, T.NullType):
+                    dt = pdt
+                    break
+            fields.append(T.Field(name, dt or T.NULL, True))
+        self.names = list(names)
+        self._schema = T.Schema(fields)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return (f"Expand [{len(self.projections)} projections, "
+                f"{len(self.names)} cols]")
+
+
+class Generate(LogicalPlan):
+    """Generator over each input row (ref: GpuGenerateExec.scala:378):
+    child columns repeated per generated row, generator output columns
+    appended ('pos' for posexplode, 'col' for the element)."""
+
+    def __init__(self, generator, child: LogicalPlan,
+                 out_name: str = "col"):
+        from spark_rapids_tpu.exprs.collections import Explode
+
+        assert isinstance(generator, Explode)
+        self.children = [child]
+        self.generator = generator.with_children(
+            [bind_references(generator.child, child.schema)])
+        # analysis error, not a fallback: no engine can explode a
+        # non-array (Spark raises AnalysisException the same way)
+        self.generator.check_supported()
+        self.out_name = out_name
+        fields = list(child.schema.fields)
+        if self.generator.pos:
+            fields.append(T.Field("pos", T.INT, self.generator.outer))
+        fields.append(T.Field(out_name, self.generator.dtype, True))
+        self._schema = T.Schema(fields)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"Generate [{self.generator.name}]"
+
+
 class Sort(LogicalPlan):
     def __init__(self, keys: Sequence[SortKey], child: LogicalPlan):
         self.children = [child]

@@ -84,6 +84,11 @@ for _cls in (
 ):
     register_expr(_cls)
 
+from spark_rapids_tpu.exprs import collections as COLL  # noqa: E402
+
+for _cls in (COLL.Size, COLL.GetArrayItem, COLL.ArrayContains):
+    register_expr(_cls)
+
 # aggregate functions are checked by their own registry
 from spark_rapids_tpu.exprs import aggregates as AG  # noqa: E402
 
@@ -96,7 +101,7 @@ _EXEC_CONFS = {
                   f"Enable TPU execution of {cls.__name__}.")
     for cls in (L.InMemoryRelation, L.ParquetRelation, L.CsvRelation,
                 L.RangeRel, L.Project, L.Filter, L.Aggregate, L.Sort,
-                L.Limit, L.Join, L.Union, L.Window)
+                L.Limit, L.Join, L.Union, L.Window, L.Expand, L.Generate)
 }
 
 
@@ -158,6 +163,16 @@ class PlanMeta:
         if isinstance(p, L.Project):
             for e in p.exprs:
                 _check_expr(e, conf, self.reasons)
+        elif isinstance(p, L.Expand):
+            for proj in p.projections:
+                for e in proj:
+                    _check_expr(e, conf, self.reasons)
+        elif isinstance(p, L.Generate):
+            _check_expr(p.generator.child, conf, self.reasons)
+            try:
+                p.generator.check_supported()
+            except TypeError as exc:
+                self.will_not_work(str(exc))
         elif isinstance(p, L.Filter):
             _check_expr(p.condition, conf, self.reasons)
         elif isinstance(p, L.Aggregate):
@@ -289,6 +304,14 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
         return TpuProjectExec(p.exprs, kids[0])
     if isinstance(p, L.Filter):
         return TpuFilterExec(p.condition, kids[0])
+    if isinstance(p, L.Expand):
+        from spark_rapids_tpu.execs.expand import TpuExpandExec
+
+        return TpuExpandExec(p.projections, p.schema, kids[0])
+    if isinstance(p, L.Generate):
+        from spark_rapids_tpu.execs.generate import TpuGenerateExec
+
+        return TpuGenerateExec(p.generator, p.schema, kids[0])
     if isinstance(p, L.Aggregate):
         return _plan_aggregate(p, kids[0])
     if isinstance(p, L.Sort):

@@ -52,6 +52,12 @@ def count(e: ExprLike) -> Count:
     return Count(_expr(e))
 
 
+def count_distinct(e: ExprLike):
+    from spark_rapids_tpu.exprs.aggregates import CountDistinct
+
+    return CountDistinct(_expr(e))
+
+
 def count_star() -> CountStar:
     return CountStar()
 
@@ -74,6 +80,61 @@ def first(e: ExprLike, ignore_nulls: bool = False) -> First:
 
 def last(e: ExprLike, ignore_nulls: bool = False) -> Last:
     return Last(_expr(e), ignore_nulls)
+
+
+def _forbid_nested_explode(e: Expression) -> None:
+    """Explode is only valid at the top level of a select list (Spark
+    raises the same analysis error for nested generators)."""
+    from spark_rapids_tpu.exprs.collections import Explode
+
+    for c in e.children:
+        if isinstance(c, Explode):
+            raise ValueError(
+                "explode/posexplode must be at the top level of a "
+                "select list")
+        _forbid_nested_explode(c)
+
+
+def explode(e: ExprLike):
+    from spark_rapids_tpu.exprs.collections import Explode
+
+    return Explode(_expr(e))
+
+
+def explode_outer(e: ExprLike):
+    from spark_rapids_tpu.exprs.collections import Explode
+
+    return Explode(_expr(e), outer=True)
+
+
+def posexplode(e: ExprLike):
+    from spark_rapids_tpu.exprs.collections import Explode
+
+    return Explode(_expr(e), pos=True)
+
+
+def posexplode_outer(e: ExprLike):
+    from spark_rapids_tpu.exprs.collections import Explode
+
+    return Explode(_expr(e), pos=True, outer=True)
+
+
+def array_size(e: ExprLike):
+    from spark_rapids_tpu.exprs.collections import Size
+
+    return Size(_expr(e))
+
+
+def get_item(e: ExprLike, index: int):
+    from spark_rapids_tpu.exprs.collections import GetArrayItem
+
+    return GetArrayItem(_expr(e), lit(index))
+
+
+def array_contains(e: ExprLike, value):
+    from spark_rapids_tpu.exprs.collections import ArrayContains
+
+    return ArrayContains(_expr(e), lit(value))
 
 
 def _extract_windows(e: Expression, acc: list) -> Expression:
@@ -123,11 +184,17 @@ class TpuSession:
 
 
 class GroupedData:
-    def __init__(self, df: "DataFrame", keys: list[Expression]):
+    """Grouped frame; `grouping_sets` (a list of included-key-name sets)
+    switches to the Expand-based grouping-set rewrite that Spark's
+    analyzer performs for rollup/cube (ref: GpuExpandExec.scala:67)."""
+
+    def __init__(self, df: "DataFrame", keys: list[Expression],
+                 grouping_sets: Optional[list[frozenset]] = None):
         self._df = df
         self._keys = keys
+        self._sets = grouping_sets
 
-    def agg(self, *aggs: AggLike) -> "DataFrame":
+    def _named(self, aggs) -> list[NamedAgg]:
         named = []
         for i, a in enumerate(aggs):
             if isinstance(a, NamedAgg):
@@ -137,8 +204,79 @@ class GroupedData:
                 named.append(NamedAgg(fn, name))
             else:
                 named.append(NamedAgg(a, f"{a.name}_{i}"))
+        return named
+
+    def agg(self, *aggs: AggLike) -> "DataFrame":
+        from spark_rapids_tpu.exprs.aggregates import CountDistinct
+
+        named = self._named(aggs)
+        if any(isinstance(na.fn, CountDistinct) for na in named):
+            return self._agg_distinct(named)
+        if self._sets is not None:
+            return self._agg_grouping_sets(named)
         return DataFrame(
             L.Aggregate(self._keys, named, self._df._plan),
+            self._df._session)
+
+    def _agg_distinct(self, named: list[NamedAgg]) -> "DataFrame":
+        """count(DISTINCT x) as a two-level aggregate: group by
+        (keys, x) to dedupe, then count x per key group (the
+        single-distinct specialization of Spark's
+        RewriteDistinctAggregates)."""
+        from spark_rapids_tpu.exprs.aggregates import Count, CountDistinct
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        if self._sets is not None:
+            raise ValueError(
+                "count_distinct over rollup/cube is not supported yet")
+        dist = [na for na in named if isinstance(na.fn, CountDistinct)]
+        others = [na for na in named if not isinstance(na.fn, CountDistinct)]
+        if others:
+            raise ValueError(
+                "mixing count_distinct with other aggregates is not "
+                "supported yet")
+        key0 = expr_key(dist[0].fn.child)
+        if any(expr_key(na.fn.child) != key0 for na in dist[1:]):
+            raise ValueError(
+                "multiple count_distinct over different expressions are "
+                "not supported yet")
+        inner_x = dist[0].fn.child.alias("__dist")
+        inner = L.Aggregate(self._keys + [inner_x], [], self._df._plan)
+        key_names = [f.name for f in inner.schema.fields[:len(self._keys)]]
+        outer = L.Aggregate(
+            [ColumnReference(n) for n in key_names],
+            [NamedAgg(Count(ColumnReference("__dist")), na.out_name)
+             for na in dist],
+            inner)
+        return DataFrame(outer, self._df._session)
+
+    def _agg_grouping_sets(self, named: list[NamedAgg]) -> "DataFrame":
+        from spark_rapids_tpu.exprs import base as B
+
+        child = self._df._plan
+        key_names = []
+        for k in self._keys:
+            if not isinstance(k, ColumnReference):
+                raise ValueError(
+                    "rollup/cube keys must be plain columns")
+            key_names.append(k.col_name)
+        names = [f.name for f in child.schema.fields] + ["__gid"]
+        projections = []
+        for gid, included in enumerate(self._sets):
+            proj: list[Expression] = []
+            for f in child.schema.fields:
+                if f.name in key_names and f.name not in included:
+                    proj.append(B.Literal(None, f.dtype))
+                else:
+                    proj.append(ColumnReference(f.name))
+            proj.append(B.Literal.of(gid))
+            projections.append(proj)
+        expand = L.Expand(projections, names, child)
+        agg = L.Aggregate(
+            list(self._keys) + [ColumnReference("__gid")], named, expand)
+        out_names = key_names + [na.out_name for na in named]
+        return DataFrame(
+            L.Project([ColumnReference(n) for n in out_names], agg),
             self._df._session)
 
 
@@ -160,10 +298,40 @@ class DataFrame:
         ExtractWindowExpressions analysis rule."""
         from spark_rapids_tpu.exprs.window import WindowExpression
 
+        from spark_rapids_tpu.exprs.base import Alias
+        from spark_rapids_tpu.exprs.collections import Explode
+
         exprs_ = [_expr(e) for e in exprs]
         acc: list[tuple[WindowExpression, str]] = []
         rewritten = [_extract_windows(e, acc) for e in exprs_]
         plan = self._plan
+
+        # generator extraction (ref: Spark's ExtractGenerator rule):
+        # a top-level explode/posexplode becomes a Generate node under
+        # the projection
+        gens = [(i, e) for i, e in enumerate(rewritten)
+                if isinstance(e, Explode)
+                or (isinstance(e, Alias) and isinstance(e.child, Explode))]
+        if gens:
+            if len(gens) > 1:
+                raise ValueError("only one explode per select")
+            i, e = gens[0]
+            alias_name = e.out_name if isinstance(e, Alias) else None
+            gen = e.child if isinstance(e, Alias) else e
+            if gen.pos and alias_name is not None:
+                raise ValueError(
+                    "posexplode yields two columns (pos, col); alias "
+                    "them with a following select")
+            out_name = alias_name or "col"
+            plan = L.Generate(gen, plan, out_name=out_name)
+            repl: list[Expression] = []
+            if gen.pos:
+                repl.append(ColumnReference("pos"))
+            repl.append(ColumnReference(out_name))
+            rewritten[i:i + 1] = repl
+        for e in rewritten:
+            _forbid_nested_explode(e)
+
         if acc:
             from spark_rapids_tpu.execs.jit_cache import exprs_key
 
@@ -196,6 +364,28 @@ class DataFrame:
 
     def group_by(self, *keys: ExprLike) -> GroupedData:
         return GroupedData(self, [_expr(k) for k in keys])
+
+    def rollup(self, *keys: str) -> GroupedData:
+        """GROUP BY ROLLUP: hierarchical grouping sets
+        (a,b,c) -> {(a,b,c), (a,b), (a), ()}."""
+        sets = [frozenset(keys[:i]) for i in range(len(keys), -1, -1)]
+        return GroupedData(self, [_expr(k) for k in keys],
+                           grouping_sets=sets)
+
+    def cube(self, *keys: str) -> GroupedData:
+        """GROUP BY CUBE: all subsets of the grouping keys."""
+        import itertools
+
+        sets = [frozenset(c)
+                for r in range(len(keys), -1, -1)
+                for c in itertools.combinations(keys, r)]
+        return GroupedData(self, [_expr(k) for k in keys],
+                           grouping_sets=sets)
+
+    def grouping_sets(self, sets: Sequence[Sequence[str]],
+                      keys: Sequence[str]) -> GroupedData:
+        return GroupedData(self, [_expr(k) for k in keys],
+                           grouping_sets=[frozenset(s) for s in sets])
 
     def agg(self, *aggs: AggLike) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)

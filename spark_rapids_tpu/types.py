@@ -153,6 +153,31 @@ class NullType(DataType):
     name = "null"
 
 
+class ListType(DataType):
+    """list<element> with fixed-width primitive elements; the device
+    layout is a dense (capacity, max_len) element matrix + per-row
+    lengths (the same dense-matrix answer to ragged data the string
+    column uses — XLA wants static shapes, cudf's offset encoding does
+    not map)."""
+
+    def __init__(self, element: DataType):
+        if isinstance(element, (ListType, StringType)):
+            raise TypeError(
+                f"list element type {element} not supported (primitive "
+                "elements only)")
+        self.element = element
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"array<{self.element.name}>"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ListType) and other.element == self.element
+
+    def __hash__(self) -> int:
+        return hash((ListType, self.element))
+
+
 # Singletons
 BOOLEAN = BooleanType()
 BYTE = ByteType()
@@ -222,6 +247,8 @@ def from_arrow_type(at) -> DataType:
         if at.precision > DecimalType.MAX_PRECISION:
             raise TypeError(f"decimal precision {at.precision} unsupported")
         return DecimalType(at.precision, at.scale)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ListType(from_arrow_type(at.value_type))
     raise TypeError(f"unsupported arrow type {at}")
 
 
@@ -242,6 +269,8 @@ def to_arrow_type(dt: DataType):
     }
     if isinstance(dt, DecimalType):
         return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, ListType):
+        return pa.list_(to_arrow_type(dt.element))
     try:
         return m[type(dt)]
     except KeyError:
