@@ -186,7 +186,32 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
         fn = {P.EqualTo: pc.equal, P.LessThan: pc.less,
               P.LessThanOrEqual: pc.less_equal, P.GreaterThan: pc.greater,
               P.GreaterThanOrEqual: pc.greater_equal}[type(e)]
-        return fn(l, r)
+        out = fn(l, r)
+        # Spark NaN comparison semantics (docs/compatibility.md: NaN is
+        # larger than any other value and NaN = NaN) — raw IEEE from
+        # pyarrow says the opposite for every NaN operand
+        if pa.types.is_floating(l.type) or pa.types.is_floating(r.type):
+            fl = l.cast(pa.float64()) if not pa.types.is_floating(l.type) \
+                else l
+            fr = r.cast(pa.float64()) if not pa.types.is_floating(r.type) \
+                else r
+            lnan = pc.fill_null(pc.is_nan(fl), False)
+            rnan = pc.fill_null(pc.is_nan(fr), False)
+            either = pc.or_(lnan, rnan)
+            if pc.any(either).as_py():
+                nan_lt = pc.and_(pc.invert(lnan), rnan)   # l < r
+                nan_eq = pc.and_(lnan, rnan)              # l == r
+                repl = {
+                    P.EqualTo: nan_eq,
+                    P.LessThan: nan_lt,
+                    P.LessThanOrEqual: pc.or_(nan_lt, nan_eq),
+                    P.GreaterThan: pc.and_(lnan, pc.invert(rnan)),
+                    P.GreaterThanOrEqual: pc.or_(
+                        pc.and_(lnan, pc.invert(rnan)), nan_eq),
+                }[type(e)]
+                valid = pc.and_(pc.is_valid(l), pc.is_valid(r))
+                out = pc.if_else(pc.and_(either, valid), repl, out)
+        return out
     if isinstance(e, P.And):
         l, r = _binary_operands(e, table, n)
         return pc.and_kleene(l, r)

@@ -1,0 +1,166 @@
+"""Collective (tier-2) exchange-bearing operators.
+
+When the collective shuffle transport is active, the planner lowers a
+grouped aggregate's partial -> exchange -> final pipeline into ONE fused
+SPMD program per query stage (ref: the role GpuShuffleExchangeExecBase +
+RapidsShuffleTransport play around GpuHashAggregateExec, re-designed for
+TPU: the map-side update aggregation, the murmur3-routed `all_to_all`
+over the mesh axis, and the reduce-side merge+finalize are a single
+shard_map/jit program — no host hop between map and reduce, collectives
+ride ICI scheduled by XLA; SURVEY.md §5.8)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.column import (
+    Column,
+    StringColumn,
+    pad_width,
+)
+from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+from spark_rapids_tpu.exprs.aggregates import NamedAgg
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+
+
+def _repad(batch: ColumnarBatch, cap: int,
+           widths: dict[int, int]) -> ColumnarBatch:
+    """Pad a batch to a common capacity/string-width profile so per-shard
+    leaves stack into one array with a leading device axis."""
+    cols = []
+    for ci, c in enumerate(batch.columns):
+        if isinstance(c, StringColumn):
+            w = widths[ci]
+            chars = c.chars
+            if c.width < w:
+                chars = jnp.pad(chars, ((0, 0), (0, w - c.width)))
+            if c.capacity < cap:
+                pad = cap - c.capacity
+                chars = jnp.pad(chars, ((0, pad), (0, 0)))
+                cols.append(StringColumn(
+                    chars,
+                    jnp.pad(c.lengths, (0, pad)),
+                    jnp.pad(c.validity, (0, pad))))
+            else:
+                cols.append(StringColumn(chars, c.lengths, c.validity))
+        else:
+            if c.capacity < cap:
+                pad = cap - c.capacity
+                cols.append(Column(jnp.pad(c.data, (0, pad)),
+                                   jnp.pad(c.validity, (0, pad)),
+                                   c.dtype))
+            else:
+                cols.append(c)
+    return ColumnarBatch(cols, batch.num_rows, batch.schema)
+
+
+class TpuCollectiveHashAggregateExec(TpuExec):
+    """Grouped aggregation as one SPMD program over the active mesh.
+
+    Host side only routes input: child partitions are drained round-robin
+    into one batch per shard; everything after the stack — update
+    aggregation, hash exchange, merge, finalization — is device code in
+    a single compiled step shared across queries with equal structure."""
+
+    def __init__(self, groups: Sequence[Expression],
+                 aggs: Sequence[NamedAgg], child: TpuExec, mesh):
+        super().__init__(child)
+        self.mesh = mesh
+        # the partial-mode exec carries every traceable phase we fuse
+        self._agg = TpuHashAggregateExec(groups, aggs, child,
+                                         mode="partial")
+        self._schema = T.Schema(
+            list(self._agg.partial_schema.fields[: self._agg.n_keys])
+            + [na.output_field() for na in self._agg.aggs])
+        self._step = None
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return int(self.mesh.shape[DATA_AXIS])
+
+    def node_desc(self) -> str:
+        a = self._agg
+        keys = ", ".join(e.name for e in a.groups)
+        return (f"TpuCollectiveHashAggregateExec keys=[{keys}] "
+                f"[all_to_all over mesh axis '{DATA_AXIS}' x"
+                f"{self.num_partitions}]")
+
+    def additional_metrics(self):
+        return [("collectiveRows", "MODERATE")]
+
+    # -- fused phases ----------------------------------------------------- #
+
+    def _pre(self, batch: ColumnarBatch) -> ColumnarBatch:
+        return self._agg._update_batch(batch)
+
+    def _post(self, batch: ColumnarBatch) -> ColumnarBatch:
+        from spark_rapids_tpu.exprs.base import EvalContext
+
+        merged = self._agg._merge_batch(batch)
+        # finalize with THIS exec's output schema (the partial-mode
+        # helper's _schema is the partial layout)
+        ctx = EvalContext.for_batch(merged)
+        cols = [e.eval(ctx) for e in self._agg.final_exprs]
+        return ColumnarBatch(cols, merged.num_rows, self._schema)
+
+    # -- driver ----------------------------------------------------------- #
+
+    def _collect_shards(self) -> list[ColumnarBatch]:
+        """Drain child partitions round-robin into one batch per shard."""
+        import dataclasses as _dc
+
+        n = self.num_partitions
+        child = self.children[0]
+        per_shard: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+        for p in range(child.num_partitions):
+            for b in child.execute_partition(p):
+                rows = b.concrete_num_rows()
+                per_shard[p % n].append(
+                    _dc.replace(b, num_rows=rows))
+        shards = []
+        for group in per_shard:
+            if not group:
+                shards.append(ColumnarBatch.empty(child.schema))
+            elif len(group) == 1:
+                shards.append(group[0])
+            else:
+                shards.append(concat_batches(group))
+        # unify shapes for stacking
+        cap = max(s.capacity for s in shards)
+        widths: dict[int, int] = {}
+        for s in shards:
+            for ci, c in enumerate(s.columns):
+                if isinstance(c, StringColumn):
+                    widths[ci] = max(widths.get(ci, 1), c.width)
+        for ci in widths:
+            widths[ci] = pad_width(widths[ci])
+        return [_repad(s, cap, widths) for s in shards]
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.parallel.exchange import (
+            make_hash_exchange_step,
+            stack_batches,
+            unstack_batch,
+        )
+
+        shards = self._collect_shards()
+        if self._step is None:
+            self._step = make_hash_exchange_step(
+                self.mesh, list(range(self._agg.n_keys)),
+                pre=self._pre, post=self._post)
+        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            stacked = stack_batches(shards)
+            out = t.observe(self._step(stacked))
+        for b in unstack_batch(out):
+            self.metrics["collectiveRows"].add(b.concrete_num_rows())
+            yield self._count_output(b)

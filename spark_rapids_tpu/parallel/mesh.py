@@ -18,6 +18,21 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 
 
+#: process-active mesh for collective shuffle lowering (the executor's
+#: "device topology" state; ref: GpuShuffleEnv.scala:26 detecting the
+#: transport-backed shuffle manager)
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axes: Sequence[str] = (DATA_AXIS,),
               shape: Optional[Sequence[int]] = None,

@@ -473,6 +473,21 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
     )
     from spark_rapids_tpu.ops.partition import HashPartitioning
 
+    if p.groups:
+        # tier-2 lowering: with the collective transport active, the
+        # whole partial->exchange->final pipeline becomes ONE fused
+        # all_to_all SPMD program over the mesh (SURVEY.md §5.8)
+        from spark_rapids_tpu.shuffle.transport import get_transport
+
+        transport = get_transport()
+        if transport.kind == "collective" \
+                and transport.supports_schema(child_exec.schema):
+            from spark_rapids_tpu.execs.collective import (
+                TpuCollectiveHashAggregateExec,
+            )
+
+            return TpuCollectiveHashAggregateExec(
+                p.groups, p.aggs, child_exec, transport.mesh)
     if child_exec.num_partitions <= 1:
         return TpuHashAggregateExec(p.groups, p.aggs, child_exec)
     partial = TpuHashAggregateExec(p.groups, p.aggs, child_exec,

@@ -182,6 +182,27 @@ class TpuSession:
             start, end = 0, start
         return DataFrame(L.RangeRel(start, end, step), self)
 
+    def enable_collective_shuffle(self, n_devices: Optional[int] = None,
+                                  mesh=None):
+        """Activate the tier-2 collective shuffle transport over a device
+        mesh: grouped aggregates lower to fused all_to_all SPMD programs
+        (ref: the spark.rapids.shuffle.transport.enabled switch +
+        UCXShuffleTransport bring-up, re-designed for ICI collectives)."""
+        from spark_rapids_tpu.parallel.mesh import make_mesh, set_active_mesh
+        from spark_rapids_tpu.shuffle.transport import SHUFFLE_TRANSPORT
+
+        mesh = mesh or make_mesh(n_devices)
+        set_active_mesh(mesh)
+        self.conf.set(SHUFFLE_TRANSPORT.key, "collective")
+        return mesh
+
+    def disable_collective_shuffle(self) -> None:
+        from spark_rapids_tpu.parallel.mesh import set_active_mesh
+        from spark_rapids_tpu.shuffle.transport import SHUFFLE_TRANSPORT
+
+        set_active_mesh(None)
+        self.conf.set(SHUFFLE_TRANSPORT.key, "local")
+
 
 class GroupedData:
     """Grouped frame; `grouping_sets` (a list of included-key-name sets)

@@ -1,0 +1,68 @@
+"""Shuffle transport SPI: which fabric moves exchange data.
+
+Counterpart of the reference's transport seam
+(ref: RapidsShuffleTransport.scala:338 `makeTransport` — the SPI behind
+which UCX lives, with the default Spark shuffle as the fallback tier).
+Here the two tiers are:
+
+- ``local``      — the in-process spillable shuffle manager
+                   (shuffle.manager; the "default Spark shuffle" tier);
+- ``collective`` — exchanges lower into ONE fused SPMD program per
+                   query stage: map-side work, an XLA ``all_to_all``
+                   over the active mesh axis (ICI/DCN,
+                   compiler-scheduled), and reduce-side work, with no
+                   host round trip between map and reduce
+                   (parallel.exchange; SURVEY.md §5.8 tier 2).
+
+The planner consults `get_transport()` when lowering exchange-bearing
+operators; the collective tier engages only when a device mesh is
+active (parallel.mesh.set_active_mesh) and the data plane supports the
+schema (fixed-width + string columns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import register, get_conf
+
+SHUFFLE_TRANSPORT = register(
+    "spark.rapids.tpu.shuffle.transport", "local",
+    "Exchange transport tier: 'local' (in-process spillable shuffle "
+    "manager) or 'collective' (fused all_to_all SPMD programs over the "
+    "active device mesh; requires parallel.mesh.set_active_mesh). "
+    "The spark.rapids.shuffle.transport.enabled/class analog "
+    "(ref: RapidsConf.scala:930-954).",
+    check=lambda v: v in ("local", "collective"))
+
+
+@dataclasses.dataclass
+class ShuffleTransport:
+    """Resolved transport choice handed to the planner."""
+
+    kind: str  # "local" | "collective"
+    mesh: Optional[object] = None  # jax.sharding.Mesh for collective
+
+    def supports_schema(self, schema: T.Schema) -> bool:
+        """The collective data plane stacks leaves across shards; list
+        columns are not wired through it yet."""
+        if self.kind != "collective":
+            return True
+        return not any(isinstance(f.dtype, T.ListType)
+                       for f in schema.fields)
+
+
+def get_transport() -> ShuffleTransport:
+    from spark_rapids_tpu.parallel.mesh import active_mesh
+
+    kind = get_conf().get(SHUFFLE_TRANSPORT)
+    if kind == "collective":
+        mesh = active_mesh()
+        if mesh is not None:
+            return ShuffleTransport("collective", mesh)
+        # configured but no mesh: fall back to the local tier (the
+        # reference likewise degrades to the default shuffle when the
+        # transport cannot initialize)
+    return ShuffleTransport("local")
