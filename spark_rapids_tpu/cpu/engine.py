@@ -81,6 +81,98 @@ def _from_np(vals: np.ndarray, valid: np.ndarray, atype) -> pa.Array:
     return pa.array(vals, type=atype, mask=mask)
 
 
+def _string_batch3(e, table, n):
+    """Python-string reference semantics for the batch-3 string ops
+    (the oracle definitions; java.lang.String behavior where Spark
+    delegates there)."""
+    import re
+
+    if isinstance(e, S.ConcatWs):
+        sep = e.sep.value
+        cols = [cpu_eval(c, table).to_pylist() for c in e.exprs]
+        if sep is None:
+            return pa.array([None] * n, pa.string())
+        out = []
+        for i in range(n):
+            parts = [c[i] for c in cols if c[i] is not None]
+            out.append(sep.join(parts))
+        return pa.array(out, pa.string())
+
+    vals = cpu_eval(e.child, table).to_pylist()
+
+    def mapped(fn):
+        return pa.array([None if v is None else fn(v) for v in vals],
+                        pa.string())
+
+    if isinstance(e, S.RegExpReplace):
+        pat, rep = e.search.value, e.replacement.value or ""
+        return mapped(lambda s: re.sub(pat, rep, s))
+    if isinstance(e, S.StringReplace):
+        search, rep = e.search.value or "", e.replacement.value or ""
+        if not search:
+            return mapped(lambda s: s)
+        return mapped(lambda s: s.replace(search, rep))
+    if isinstance(e, S.StringLPad):
+        tgt = int(e.length.value)
+        p = e.pad.value or ""
+        left = e._left
+
+        def padfn(s):
+            if tgt <= 0:
+                return ""
+            if len(s) >= tgt:
+                return s[:tgt]
+            if not p:
+                return s
+            fill = (p * tgt)[: tgt - len(s)]
+            return fill + s if left else s + fill
+
+        return mapped(padfn)
+    if isinstance(e, S.StringLocate):
+        sub = e.substr.value or ""
+        start = int(e.start.value)
+
+        def locfn(s):
+            if start <= 0:
+                return 0
+            if sub == "":
+                return min(start, len(s) + 1)
+            return s.find(sub, start - 1) + 1
+
+        return pa.array([None if v is None else locfn(v) for v in vals],
+                        pa.int32())
+    if isinstance(e, S.SubstringIndex):
+        d = e.delim.value or ""
+        cnt = int(e.count.value)
+
+        def sifn(s):
+            if cnt == 0 or not d:
+                return ""
+            pos, hits = 0, []
+            while True:
+                j = s.find(d, pos)
+                if j < 0:
+                    break
+                hits.append(j)
+                pos = j + len(d)
+            if cnt > 0:
+                return s if len(hits) < cnt else s[: hits[cnt - 1]]
+            k = len(hits) + cnt
+            return s if k < 0 else s[hits[k] + len(d):]
+
+        return mapped(sifn)
+    if isinstance(e, S.InitCap):
+        def icfn(s):
+            out, prev = [], " "
+            for ch in s:
+                out.append(ch.upper() if prev == " " else ch.lower())
+                prev = ch
+            return "".join(out)
+
+        return mapped(icfn)
+    raise AssertionError(type(e))
+
+
 def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
     from spark_rapids_tpu.exprs import collections as COLL
 
@@ -410,6 +502,10 @@ def _dispatch_extended(e, table, n):  # noqa: C901
         return _cast_cpu(e, table, n)
 
     # strings -------------------------------------------------------------- #
+    if isinstance(e, (S.StringReplace, S.RegExpReplace, S.StringLPad,
+                      S.StringLocate, S.SubstringIndex, S.InitCap,
+                      S.ConcatWs)):
+        return _string_batch3(e, table, n)
     if isinstance(e, S.Length):
         return pc.utf8_length(cpu_eval(e.child, table)).cast(pa.int32())
     if isinstance(e, S.Upper):  # Lower subclasses Upper

@@ -424,3 +424,418 @@ class Concat(Expression):
             offset = offset + c.lengths
             valid = c.validity if valid is None else (valid & c.validity)
         return StringColumn(chars, offset, valid)
+
+
+# ---------------------------------------------------------------------- #
+# Expression batch 3 (ref: stringFunctions.scala GpuStringReplace,
+# GpuStringLPad/RPad, GpuStringLocate, GpuSubstringIndex, GpuInitCap,
+# GpuConcatWs; regexp policy from GpuOverrides.scala:440-473)
+# ---------------------------------------------------------------------- #
+
+def _match_starts(c: StringColumn, nb: bytes) -> jax.Array:
+    """(capacity, width) bool: a full needle match begins at this byte
+    (within the row's length)."""
+    m = len(nb)
+    W = c.width
+    out = jnp.zeros((c.capacity, W), bool)
+    if m == 0 or m > W:
+        return out
+    needle = jnp.asarray(np.frombuffer(nb, np.uint8))
+    for off in range(W - m + 1):
+        w = c.chars[:, off:off + m]
+        hit = (c.lengths >= off + m) & jnp.all(w == needle[None, :], axis=1)
+        out = out.at[:, off].set(hit)
+    return out
+
+
+def _greedy_matches(starts: jax.Array, m: int) -> jax.Array:
+    """Left-to-right non-overlapping match selection (the semantics of
+    str.replace): a candidate is real only if no real match covers it.
+    One lax.scan across the width (width is small and static)."""
+    W = starts.shape[1]
+
+    def step(next_allowed, j_col):
+        j, cand = j_col
+        real = cand & (j >= next_allowed)
+        next_allowed = jnp.where(real, j + m, next_allowed)
+        return next_allowed, real
+
+    js = jnp.arange(W, dtype=jnp.int32)
+    init = jnp.zeros((starts.shape[0],), jnp.int32)
+    _, reals = jax.lax.scan(step, init, (js, starts.T))
+    return reals.T
+
+
+@dataclasses.dataclass(repr=False)
+class StringReplace(Expression):
+    """replace(str, search, replacement) with literal search/replacement
+    (ref: GpuStringReplace, stringFunctions.scala).  Greedy
+    left-to-right non-overlapping, like java String.replace."""
+
+    child: Expression
+    search: Expression  # literal, non-empty
+    replacement: Expression  # literal
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    @property
+    def name(self) -> str:
+        return (f"replace({self.child.name}, {self.search.name}, "
+                f"{self.replacement.name})")
+
+    def check_supported(self) -> None:
+        if not isinstance(self.search, Literal) \
+                or not isinstance(self.replacement, Literal):
+            raise TypeError("replace search/replacement must be literals")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        sb = _needle_bytes(self.search)
+        rb = _needle_bytes(self.replacement)
+        m, r = len(sb), len(rb)
+        if m == 0 or m > c.width:
+            return c  # Spark: empty search returns the input unchanged
+        reals = _greedy_matches(_match_starts(c, sb), m)
+        # covered = bytes inside a real match
+        covered = jnp.zeros_like(reals)
+        for k in range(m):
+            covered = covered | jnp.pad(
+                reals[:, : c.width - k], ((0, 0), (k, 0)))
+        pos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        in_len = pos < c.lengths[:, None]
+        contrib = jnp.where(reals, r,
+                            jnp.where(covered | ~in_len, 0, 1))
+        out_end = jnp.cumsum(contrib, axis=1)
+        out_start = out_end - contrib
+        new_len = out_end[:, -1].astype(jnp.int32)
+        # worst-case output width
+        W_out = pad_width(max(1, (c.width // m) * max(r, m)
+                              + (c.width % m)))
+        n = c.capacity
+        flat = jnp.zeros((n * W_out,), jnp.uint8)
+        row_base = jnp.arange(n, dtype=jnp.int32)[:, None] * W_out
+        # plain bytes
+        plain = in_len & ~covered
+        idx = jnp.where(plain, row_base + out_start, n * W_out)
+        flat = flat.at[idx.reshape(-1)].set(
+            c.chars.reshape(-1), mode="drop")
+        # replacement bytes at real match starts
+        for k in range(r):
+            idx = jnp.where(reals, row_base + out_start + k, n * W_out)
+            flat = flat.at[idx.reshape(-1)].set(
+                jnp.full((n * c.width,), rb[k], jnp.uint8), mode="drop")
+        chars = flat.reshape(n, W_out)
+        opos = jnp.arange(W_out, dtype=jnp.int32)[None, :]
+        chars = jnp.where(opos < new_len[:, None], chars, 0)
+        return StringColumn(chars, new_len, c.validity)
+
+
+@dataclasses.dataclass(repr=False)
+class RegExpReplace(StringReplace):
+    """regexp_replace restricted to patterns that are plain strings —
+    the reference's policy (ref: GpuOverrides.scala:440-473
+    canRegexpBeTreatedLikeARegularString + GpuStringReplace reuse);
+    real regular expressions fall back to the CPU engine."""
+
+    _META = set("\\^$.|?*+()[]{}")
+
+    def check_supported(self) -> None:
+        super().check_supported()
+        pat = self.search.value  # type: ignore[union-attr]
+        if pat is None or any(ch in self._META for ch in pat):
+            raise TypeError(
+                f"regexp pattern {pat!r} is a real regular expression; "
+                "TPU runs only plain-string patterns (CPU fallback)")
+        rep = self.replacement.value  # type: ignore[union-attr]
+        if rep is not None and ("$" in rep or "\\" in rep):
+            raise TypeError(
+                "regexp replacement with backrefs is not supported")
+
+
+@dataclasses.dataclass(repr=False)
+class StringLPad(Expression):
+    """lpad(str, len, pad) with literal len/pad (ref: GpuStringLPad).
+    Character-based length, like Spark."""
+
+    child: Expression
+    length: Expression  # literal int
+    pad: Expression  # literal string
+
+    _left = True
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def check_supported(self) -> None:
+        if not isinstance(self.length, Literal) \
+                or not isinstance(self.pad, Literal):
+            raise TypeError("pad length/fill must be literals")
+        pb = (self.pad.value or "")
+        if any(ord(ch) > 127 for ch in pb):
+            raise TypeError("non-ASCII pad strings not supported on TPU")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        target = int(self.length.value)  # type: ignore[union-attr]
+        pb = _needle_bytes(self.pad)
+        if target <= 0:
+            z = jnp.zeros((c.capacity, c.width), jnp.uint8)
+            return StringColumn(z, jnp.zeros(c.capacity, jnp.int32),
+                                c.validity)
+        # character-count semantics: compute char length; byte targets
+        # only coincide for ASCII, so refuse non-ASCII rows? Spark pads
+        # by characters; with ASCII pad bytes the padded prefix/suffix is
+        # ASCII, and we count the child's characters explicitly.
+        nchars = char_length(c)
+        if pb:
+            npad = jnp.maximum(target - nchars, 0)  # chars==bytes for pad
+        else:
+            # empty pad: Spark returns the (truncated) input unpadded
+            npad = jnp.zeros_like(nchars)
+        W_out = pad_width(c.width + max(target, 0))
+        pos = jnp.arange(W_out, dtype=jnp.int32)[None, :]
+        padlen = max(len(pb), 1)
+        pada = jnp.asarray(np.frombuffer(
+            (pb * ((W_out // padlen) + 1))[:W_out], np.uint8)) \
+            if pb else jnp.zeros((W_out,), jnp.uint8)
+        if self._left:
+            src = pos - npad[:, None]
+            from_str = src >= 0
+            gathered = jnp.take_along_axis(
+                jnp.pad(c.chars, ((0, 0), (0, W_out - c.width))),
+                jnp.clip(src, 0, W_out - 1), axis=1)
+            chars = jnp.where(from_str, gathered, pada[None, :])
+        else:
+            in_str = pos < c.lengths[:, None]
+            padsrc = pos - c.lengths[:, None]
+            padbytes = jnp.take(
+                pada, jnp.clip(padsrc, 0, W_out - 1))
+            chars = jnp.where(
+                in_str,
+                jnp.pad(c.chars, ((0, 0), (0, W_out - c.width))),
+                padbytes)
+        # truncate to `target` characters (pad bytes are ASCII so the
+        # full byte length is simply npad + string bytes)
+        is_start = _is_char_start(chars)
+        charidx = jnp.cumsum(is_start.astype(jnp.int32), axis=1)
+        keep = charidx <= target
+        byte_len_full = c.lengths + npad
+        new_len = jnp.minimum(
+            jnp.sum((keep & (pos < byte_len_full[:, None])).astype(
+                jnp.int32), axis=1),
+            byte_len_full)
+        chars = jnp.where(pos < new_len[:, None], chars, 0)
+        return StringColumn(chars, new_len.astype(jnp.int32), c.validity)
+
+
+class StringRPad(StringLPad):
+    _left = False
+
+
+@dataclasses.dataclass(repr=False)
+class StringLocate(Expression):
+    """locate(substr, str, start) — 1-based character position, 0 when
+    absent, literal substr/start (ref: GpuStringLocate)."""
+
+    substr: Expression  # literal
+    child: Expression
+    start: Expression  # literal int, default 1
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.INT
+
+    def check_supported(self) -> None:
+        if not isinstance(self.substr, Literal) \
+                or not isinstance(self.start, Literal):
+            raise TypeError("locate substr/start must be literals")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        nb = _needle_bytes(self.substr)
+        start = int(self.start.value)  # type: ignore[union-attr]
+        valid = c.validity
+        if start <= 0:
+            # Spark: non-positive start returns 0
+            return Column(jnp.zeros(c.capacity, jnp.int32), valid, T.INT)
+        if len(nb) == 0:
+            # Spark (java indexOf) semantics: min(pos, length + 1)
+            nchars = char_length(c)
+            out = jnp.minimum(jnp.int32(start), nchars + 1)
+            return Column(out, valid, T.INT)
+        starts = _match_starts(c, nb)
+        is_cs = _is_char_start(c.chars)
+        charpos = jnp.cumsum(is_cs.astype(jnp.int32), axis=1)  # 1-based
+        cand = starts & (charpos >= start)
+        pos_or_big = jnp.where(cand, charpos, jnp.int32(2**30))
+        best = jnp.min(pos_or_big, axis=1)
+        out = jnp.where(best < 2**30, best, 0).astype(jnp.int32)
+        return Column(out, valid, T.INT)
+
+
+@dataclasses.dataclass(repr=False)
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count), literal delim/count
+    (ref: GpuSubstringIndex)."""
+
+    child: Expression
+    delim: Expression  # literal, non-empty
+    count: Expression  # literal int
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def check_supported(self) -> None:
+        if not isinstance(self.delim, Literal) \
+                or not isinstance(self.count, Literal):
+            raise TypeError("substring_index delim/count must be literals")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        db = _needle_bytes(self.delim)
+        count = int(self.count.value)  # type: ignore[union-attr]
+        m = len(db)
+        if count == 0 or m == 0:
+            z = jnp.zeros((c.capacity, c.width), jnp.uint8)
+            return StringColumn(z, jnp.zeros(c.capacity, jnp.int32),
+                                c.validity)
+        reals = _greedy_matches(_match_starts(c, db), m)
+        occ = jnp.cumsum(reals.astype(jnp.int32), axis=1)
+        total = occ[:, -1]
+        pos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        if count > 0:
+            # prefix ending before the count-th delimiter
+            cut = jnp.where(reals & (occ == count), pos, jnp.int32(2**30))
+            first_cut = jnp.min(cut, axis=1)
+            new_len = jnp.minimum(c.lengths,
+                                  jnp.minimum(first_cut, c.lengths))
+            chars = jnp.where(pos < new_len[:, None], c.chars, 0)
+            return StringColumn(chars, new_len.astype(jnp.int32),
+                                c.validity)
+        # count < 0: suffix after the |count|-th delimiter from the right
+        want = total + count  # 0-based index of the delimiter BEFORE out
+        start_at = jnp.where(reals & (occ == (want + 1)[:, None]),
+                             pos + m, jnp.int32(-1))
+        start_byte = jnp.max(start_at, axis=1)
+        take_all = want < 0
+        start_byte = jnp.where(take_all, 0, jnp.maximum(start_byte, 0))
+        new_len = (c.lengths - start_byte).astype(jnp.int32)
+        src = pos + start_byte[:, None]
+        chars = jnp.take_along_axis(
+            c.chars, jnp.clip(src, 0, c.width - 1), axis=1)
+        chars = jnp.where(pos < new_len[:, None], chars, 0)
+        return StringColumn(chars, new_len, c.validity)
+
+
+@dataclasses.dataclass(repr=False)
+class InitCap(Expression):
+    """initcap: first character of each space-separated word uppercased,
+    the rest lowercased (ref: GpuInitCap; same byte-length-preserving
+    mapping caveat as Upper/Lower)."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        up = jnp.asarray(_case_table(True))
+        lo = jnp.asarray(_case_table(False))
+        cp, start = _decode_codepoints(c.chars)
+        prev_byte = jnp.pad(c.chars[:, :-1], ((0, 0), (1, 0)))
+        word_start = start & (
+            (jnp.arange(c.width, dtype=jnp.int32)[None, :] == 0)
+            | (prev_byte == 0x20))
+        safe_cp = jnp.clip(cp, 0, 0xFFFF)
+        mapped_up = jnp.take(up, safe_cp)
+        mapped_lo = jnp.take(lo, safe_cp)
+        mapped = jnp.where(word_start, mapped_up, mapped_lo)
+        mapped = jnp.where((cp >= 0) & (cp < 0x10000), mapped, cp)
+        chars = _encode_inplace(c.chars, mapped, start)
+        pos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        chars = jnp.where(pos < c.lengths[:, None], chars, 0)
+        return StringColumn(chars, c.lengths, c.validity)
+
+
+@dataclasses.dataclass(repr=False)
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): literal separator, skips NULL inputs
+    and never returns NULL itself (ref: GpuConcatWs semantics in
+    stringFunctions.scala — note the difference from concat)."""
+
+    sep: Expression  # literal
+    exprs: tuple[Expression, ...]
+
+    def __init__(self, sep: Expression, *exprs: Expression):
+        self.sep = sep
+        self.exprs = tuple(exprs)
+
+    def with_children(self, children):
+        children = list(children)
+        return ConcatWs(children[0], *children[1:])
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    @property
+    def nullable(self) -> bool:
+        return self.sep.nullable
+
+    @property
+    def name(self) -> str:
+        return "concat_ws(" + ", ".join(
+            e.name for e in (self.sep,) + self.exprs) + ")"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.sep, Literal):
+            raise TypeError("concat_ws separator must be a literal")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        sep = _needle_bytes(self.sep)
+        slen = len(sep)
+        cols = [e.eval(ctx) for e in self.exprs]
+        n = ctx.batch.capacity if not cols else cols[0].capacity
+        total_w = pad_width(
+            max(1, sum(c.width for c in cols) + slen * max(
+                len(cols) - 1, 0)))
+        out_pos = jnp.arange(total_w, dtype=jnp.int32)[None, :]
+        chars = jnp.zeros((n, total_w), jnp.uint8)
+        offset = jnp.zeros((n,), jnp.int32)
+        any_prev = jnp.zeros((n,), bool)
+        sepa = jnp.asarray(np.frombuffer(sep, np.uint8)) if slen \
+            else jnp.zeros((0,), jnp.uint8)
+        for c in cols:
+            present = c.validity
+            # separator before this part when a part already exists
+            sep_here = present & any_prev
+            if slen:
+                for k in range(slen):
+                    at = offset + k
+                    put = sep_here[:, None] & (out_pos == at[:, None])
+                    chars = jnp.where(put, sepa[k], chars)
+                offset = offset + jnp.where(sep_here, slen, 0)
+            src_idx = out_pos - offset[:, None]
+            in_src = present[:, None] & (src_idx >= 0) \
+                & (src_idx < c.lengths[:, None])
+            gathered = jnp.take_along_axis(
+                c.chars, jnp.clip(src_idx, 0, c.width - 1), axis=1)
+            chars = jnp.where(in_src, gathered, chars)
+            offset = offset + jnp.where(present, c.lengths, 0)
+            any_prev = any_prev | present
+        if isinstance(self.sep, Literal) and self.sep.value is None:
+            valid = jnp.zeros((n,), bool)
+        else:
+            valid = jnp.ones((n,), bool)
+        return StringColumn(chars, offset, valid & ctx.row_mask)

@@ -152,13 +152,13 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
         return Column(counts, group_live, T.LONG)
 
     vcol = sorted_batch.columns[spec.ordinal]
-    assert isinstance(vcol, Column), f"agg over {vcol.dtype} unsupported"
     valid = vcol.validity & live_sorted
     nvalid = jax.ops.segment_sum(valid.astype(jnp.int64), seg_id,
                                  num_segments=cap)
 
-    if spec.op == "count":
+    if spec.op == "count":  # validity-only: works for ANY column kind
         return Column(nvalid, group_live, T.LONG)
+    assert isinstance(vcol, Column), f"agg over {vcol.dtype} unsupported"
 
     out_dtype = agg_output_dtype(spec, vcol.dtype)
     phys = T.to_numpy_dtype(out_dtype)
@@ -209,13 +209,13 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: Sequence[AggSpec],
                                    one_live, T.LONG))
             continue
         vcol = batch.columns[spec.ordinal]
-        assert isinstance(vcol, Column)
         valid = vcol.validity & live
         nvalid = jnp.sum(valid.astype(jnp.int64))
-        if spec.op == "count":
+        if spec.op == "count":  # validity-only: any column kind
             out_cols.append(Column(
                 jnp.zeros(cap, jnp.int64).at[0].set(nvalid), one_live, T.LONG))
             continue
+        assert isinstance(vcol, Column)
         out_dtype = agg_output_dtype(spec, vcol.dtype)
         phys = T.to_numpy_dtype(out_dtype)
         if spec.op == "sum":

@@ -37,14 +37,21 @@ from spark_rapids_tpu.plan import logical as L
 # Supported-expression registry (ref: GpuOverrides.scala expr rules)
 # ---------------------------------------------------------------------- #
 
+from spark_rapids_tpu.plan import typesig as TS
+
 SUPPORTED_EXPRS: dict[type, object] = {}
+#: declarative input-type signatures per expression rule
+#: (ref: TypeChecks.scala — tagging checks declarations, not op code)
+EXPR_SIGS: dict[type, TS.ExprSig] = {}
 
 
-def register_expr(cls: type) -> None:
+def register_expr(cls: type, sig: TS.ExprSig = None) -> None:
     key = f"spark.rapids.tpu.sql.expression.{cls.__name__}"
     entry = register(key, True,
                      f"Enable TPU execution of expression {cls.__name__}.")
     SUPPORTED_EXPRS[cls] = entry
+    if sig is not None:
+        EXPR_SIGS[cls] = sig
 
 
 from spark_rapids_tpu.exprs import bitwise as BW  # noqa: E402
@@ -53,47 +60,97 @@ from spark_rapids_tpu.exprs import math as M  # noqa: E402
 from spark_rapids_tpu.exprs import strings as S  # noqa: E402
 from spark_rapids_tpu.exprs.cast import Cast  # noqa: E402
 
-for _cls in (
-    B.Alias, B.BoundReference, B.ColumnReference, B.Literal,
-    A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
-    A.Remainder, A.Pmod, A.UnaryMinus, A.UnaryPositive, A.Abs,
-    A.Least, A.Greatest,
-    P.EqualTo, P.LessThan, P.LessThanOrEqual, P.GreaterThan,
-    P.GreaterThanOrEqual, P.EqualNullSafe, P.And, P.Or, P.Not,
-    P.IsNull, P.IsNotNull, P.IsNaN, P.In, P.Coalesce, P.If, P.CaseWhen,
-    P.AtLeastNNonNulls, Murmur3Hash,
-    # math
-    M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Cot,
-    M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh, M.Acosh,
-    M.Atanh, M.Rint, M.Signum, M.ToDegrees, M.ToRadians,
-    M.Log, M.Log10, M.Log2, M.Log1p, M.Logarithm, M.Pow, M.Ceil,
-    M.Floor, M.Round, M.BRound,
-    # bitwise
-    BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
-    BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned,
-    # datetime
-    DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
-    DT.DayOfYear, DT.Quarter, DT.LastDay, DT.Hour, DT.Minute, DT.Second,
-    DT.DateAdd, DT.DateSub, DT.DateDiff, DT.UnixTimestampFromTs,
-    # strings
-    S.Length, S.Upper, S.Lower, S.StartsWith, S.EndsWith, S.Contains,
-    S.Like, S.Substring, S.StringTrim, S.StringTrimLeft,
-    S.StringTrimRight, S.Concat,
-    # cast
-    Cast,
+_PASSTHROUGH = TS.ExprSig(TS.ALL)
+_ARITH = TS.ExprSig(
+    TS.NUMERIC + TS.NULLSIG,
+    "decimal arithmetic falls back (unscaled-value math would be wrong)")
+_COMPARE = TS.ExprSig(TS.ORDERABLE)
+_LOGIC = TS.ExprSig(TS.BOOLEAN + TS.NULLSIG)
+_MATH = TS.ExprSig(TS.NUMERIC + TS.NULLSIG)
+_BITS = TS.ExprSig(TS.INTEGRAL + TS.NULLSIG)
+_DT = TS.ExprSig(TS.DATETIME + TS.INTEGRAL + TS.NULLSIG)
+_STR = TS.ExprSig(TS.STRING + TS.INTEGRAL + TS.NULLSIG,
+                  "needle/length parameters must be literals")
+_COND = TS.ExprSig(TS.ORDERABLE)
+
+for _sig, _classes in (
+    (_PASSTHROUGH, (B.Alias, B.BoundReference, B.ColumnReference,
+                    B.Literal)),
+    (_ARITH, (A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
+              A.Remainder, A.Pmod, A.UnaryMinus, A.UnaryPositive, A.Abs,
+              A.Least, A.Greatest)),
+    (_COMPARE, (P.EqualTo, P.LessThan, P.LessThanOrEqual, P.GreaterThan,
+                P.GreaterThanOrEqual, P.EqualNullSafe, P.In)),
+    (_LOGIC, (P.And, P.Or, P.Not)),
+    (_PASSTHROUGH, (P.IsNull, P.IsNotNull, P.AtLeastNNonNulls)),
+    (TS.ExprSig(TS.NUMERIC + TS.NULLSIG), (P.IsNaN,)),
+    (_COND, (P.Coalesce, P.If, P.CaseWhen)),
+    (TS.ExprSig(TS.COMMON_N), (Murmur3Hash,)),
+    (_MATH, (M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Cot,
+             M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh,
+             M.Acosh, M.Atanh, M.Rint, M.Signum, M.ToDegrees,
+             M.ToRadians, M.Log, M.Log10, M.Log2, M.Log1p, M.Logarithm,
+             M.Pow, M.Ceil, M.Floor, M.Round, M.BRound)),
+    (_BITS, (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
+             BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned)),
+    (_DT, (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
+           DT.DayOfYear, DT.Quarter, DT.LastDay, DT.Hour, DT.Minute,
+           DT.Second, DT.DateAdd, DT.DateSub, DT.DateDiff,
+           DT.UnixTimestampFromTs)),
+    (_STR, (S.Length, S.Upper, S.Lower, S.StartsWith, S.EndsWith,
+            S.Contains, S.Like, S.Substring, S.StringTrim,
+            S.StringTrimLeft, S.StringTrimRight, S.Concat,
+            S.StringReplace, S.RegExpReplace, S.StringLPad, S.StringRPad,
+            S.StringLocate, S.SubstringIndex, S.InitCap, S.ConcatWs)),
+    (TS.ExprSig(TS.ALL, "per-pair support matrix in check_supported"),
+     (Cast,)),
 ):
-    register_expr(_cls)
+    for _cls in _classes:
+        register_expr(_cls, _sig)
 
 from spark_rapids_tpu.exprs import collections as COLL  # noqa: E402
 
 for _cls in (COLL.Size, COLL.GetArrayItem, COLL.ArrayContains):
-    register_expr(_cls)
+    register_expr(_cls, TS.ExprSig(TS.ALL, "array input required"))
 
 # aggregate functions are checked by their own registry
 from spark_rapids_tpu.exprs import aggregates as AG  # noqa: E402
 
 SUPPORTED_AGGS = (AG.Sum, AG.Count, AG.CountStar, AG.Min, AG.Max,
                   AG.Average, AG.First, AG.Last)
+
+#: per-aggregate input signatures (ref: TypeChecks on AggExprMeta)
+AGG_SIGS: dict[type, TS.ExprSig] = {
+    AG.Sum: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.NULLSIG),
+    AG.Average: TS.ExprSig(TS.NUMERIC + TS.NULLSIG,
+                           "decimal avg needs scale-aware division"),
+    AG.Count: TS.ExprSig(TS.ALL),
+    AG.CountStar: TS.ExprSig(TS.ALL),
+    AG.Min: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.DATETIME
+                       + TS.BOOLEAN + TS.NULLSIG,
+                       "string min/max falls back"),
+    AG.Max: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.DATETIME
+                       + TS.BOOLEAN + TS.NULLSIG,
+                       "string min/max falls back"),
+    AG.First: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.DATETIME
+                         + TS.BOOLEAN + TS.NULLSIG),
+    AG.Last: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.DATETIME
+                        + TS.BOOLEAN + TS.NULLSIG),
+}
+
+
+def _check_agg(fn, conf, reasons: set[str]) -> None:
+    sig = AGG_SIGS.get(type(fn))
+    if sig is None or fn.child is None:
+        return
+    try:
+        dt = fn.child.dtype
+    except Exception:
+        return
+    if not sig.inputs.supports(dt):
+        reasons.add(
+            f"aggregate {fn.name} does not support input type "
+            f"{dt.name} on TPU (supported: {sig.inputs.describe()})")
 
 # per-exec kill switches (ref: spark.rapids.sql.exec.*)
 _EXEC_CONFS = {
@@ -112,6 +169,8 @@ def _check_expr(e: B.Expression, conf, reasons: set[str]) -> None:
     elif not conf.get(entry):
         reasons.add(
             f"expression {type(e).__name__} disabled by {entry.key}")
+    # declarative input-type signature (ref: TypeChecks.tagExprForGpu)
+    TS.check_inputs(e, EXPR_SIGS.get(type(e)), reasons)
     # expressions with data-dependent support (Cast matrix, Like
     # patterns) expose check_supported(); a raise becomes a reason
     check = getattr(e, "check_supported", None)
@@ -182,6 +241,8 @@ class PlanMeta:
                 if not isinstance(na.fn, SUPPORTED_AGGS):
                     self.will_not_work(
                         f"aggregate {na.fn.name} is not supported on TPU")
+                else:
+                    _check_agg(na.fn, conf, self.reasons)
                 for e in na.fn.inputs():
                     _check_expr(e, conf, self.reasons)
         elif isinstance(p, L.Sort):

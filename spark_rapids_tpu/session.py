@@ -82,6 +82,54 @@ def last(e: ExprLike, ignore_nulls: bool = False) -> Last:
     return Last(_expr(e), ignore_nulls)
 
 
+def replace_(e: ExprLike, search: str, replacement: str):
+    from spark_rapids_tpu.exprs.strings import StringReplace
+
+    return StringReplace(_expr(e), lit(search), lit(replacement))
+
+
+def regexp_replace(e: ExprLike, pattern: str, replacement: str):
+    from spark_rapids_tpu.exprs.strings import RegExpReplace
+
+    return RegExpReplace(_expr(e), lit(pattern), lit(replacement))
+
+
+def lpad(e: ExprLike, length: int, pad: str = " "):
+    from spark_rapids_tpu.exprs.strings import StringLPad
+
+    return StringLPad(_expr(e), lit(length), lit(pad))
+
+
+def rpad(e: ExprLike, length: int, pad: str = " "):
+    from spark_rapids_tpu.exprs.strings import StringRPad
+
+    return StringRPad(_expr(e), lit(length), lit(pad))
+
+
+def locate(substr: str, e: ExprLike, start: int = 1):
+    from spark_rapids_tpu.exprs.strings import StringLocate
+
+    return StringLocate(lit(substr), _expr(e), lit(start))
+
+
+def substring_index(e: ExprLike, delim: str, count: int):
+    from spark_rapids_tpu.exprs.strings import SubstringIndex
+
+    return SubstringIndex(_expr(e), lit(delim), lit(count))
+
+
+def initcap(e: ExprLike):
+    from spark_rapids_tpu.exprs.strings import InitCap
+
+    return InitCap(_expr(e))
+
+
+def concat_ws(sep: str, *exprs: ExprLike):
+    from spark_rapids_tpu.exprs.strings import ConcatWs
+
+    return ConcatWs(lit(sep), *[_expr(e) for e in exprs])
+
+
 def _forbid_nested_explode(e: Expression) -> None:
     """Explode is only valid at the top level of a select list (Spark
     raises the same analysis error for nested generators)."""

@@ -1,0 +1,3 @@
+"""Aux tooling (ref: the reference's tools/ + doc generation from
+registries: RapidsConf.help -> docs/configs.md, TypeChecks ->
+docs/supported_ops.md)."""

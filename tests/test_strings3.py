@@ -1,0 +1,146 @@
+"""String expression batch 3 + regexp policy tests
+(ref: stringFunctions.scala ops; GpuOverrides.scala:440-473 policy)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import (
+    TpuSession,
+    col,
+    concat_ws,
+    initcap,
+    locate,
+    lpad,
+    regexp_replace,
+    replace_,
+    rpad,
+    substring_index,
+)
+from tests.differential import assert_tpu_cpu_equal, gen_table
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+@pytest.fixture
+def strings(session):
+    t = pa.table({"s": pa.array(
+        ["hello world", "a.b.c.d", "", None, "  pad me  ", "xxx",
+         "aaa", "ab", "no dots here", "ünïcode str", ".lead", "trail.",
+         "a..b", "ab ab ab"], pa.string())})
+    return session.create_dataframe(t)
+
+
+def test_replace(strings):
+    df = strings.select(
+        replace_(col("s"), "a", "XY").alias("r1"),
+        replace_(col("s"), ".", "--").alias("r2"),
+        replace_(col("s"), "ab", "").alias("r3"),
+        replace_(col("s"), "", "z").alias("r4"),
+    )
+    assert_tpu_cpu_equal(df)
+    out = df.collect().to_pydict()
+    assert out["r1"][6] == "XYXYXY"  # greedy non-overlapping
+    assert out["r2"][1] == "a--b--c--d"
+    assert out["r3"][13] == "  "
+
+
+def test_regexp_replace_plain_pattern(strings):
+    df = strings.select(regexp_replace(col("s"), "ab", "Z").alias("r"))
+    assert "cannot run on TPU" not in df.explain()
+    assert_tpu_cpu_equal(df)
+
+
+def test_regexp_replace_real_regex_falls_back(strings):
+    df = strings.select(
+        regexp_replace(col("s"), "a+", "Z").alias("r"))
+    assert "real regular expression" in df.explain()
+    # the CPU fallback still computes it
+    out = df.collect().to_pydict()
+    assert out["r"][6] == "Z"  # "aaa" -> one Z
+    assert_tpu_cpu_equal(df)
+
+
+def test_pads(strings):
+    df = strings.select(
+        lpad(col("s"), 8, "*").alias("l1"),
+        rpad(col("s"), 8, "ab").alias("r1"),
+        lpad(col("s"), 3).alias("l2"),
+        lpad(col("s"), 0, "*").alias("l3"),
+        rpad(col("s"), 5, "").alias("r2"),
+    )
+    assert_tpu_cpu_equal(df)
+    out = df.collect().to_pydict()
+    assert out["l1"][5] == "*****xxx"
+    assert out["r1"][7] == "abababab"[:6].join(["", ""]) or True
+    assert out["r1"][7] == "ab" + "ababab"  # "ab" padded to 8
+    assert out["l2"][0] == "hel"  # truncation
+    assert out["l3"][0] == ""
+
+
+def test_locate(strings):
+    df = strings.select(
+        locate("b", col("s")).alias("p1"),
+        locate(".", col("s"), 3).alias("p2"),
+        locate("", col("s"), 4).alias("p3"),
+        locate("zz", col("s")).alias("p4"),
+    )
+    assert_tpu_cpu_equal(df)
+    out = df.collect().to_pydict()
+    assert out["p1"][1] == 3
+    assert out["p2"][1] == 4
+    assert out["p4"][0] == 0
+
+
+def test_substring_index(strings):
+    df = strings.select(
+        substring_index(col("s"), ".", 2).alias("a"),
+        substring_index(col("s"), ".", -2).alias("b"),
+        substring_index(col("s"), ".", 10).alias("c"),
+        substring_index(col("s"), " ", 1).alias("d"),
+        substring_index(col("s"), ".", 0).alias("e"),
+    )
+    assert_tpu_cpu_equal(df)
+    out = df.collect().to_pydict()
+    assert out["a"][1] == "a.b"
+    assert out["b"][1] == "c.d"
+    assert out["c"][1] == "a.b.c.d"
+    assert out["d"][0] == "hello"
+    assert out["e"][0] == ""
+
+
+def test_initcap(strings):
+    df = strings.select(initcap(col("s")).alias("i"))
+    assert_tpu_cpu_equal(df)
+    out = df.collect().to_pydict()
+    assert out["i"][0] == "Hello World"
+    assert out["i"][13] == "Ab Ab Ab"
+
+
+def test_concat_ws(session):
+    t = pa.table({
+        "a": pa.array(["x", None, "p", None], pa.string()),
+        "b": pa.array(["y", "q", None, None], pa.string()),
+    })
+    df = session.create_dataframe(t).select(
+        concat_ws("-", col("a"), col("b")).alias("c"))
+    out = df.collect().to_pydict()
+    # NULL inputs are SKIPPED (unlike concat) and the result is never
+    # NULL for a non-null separator
+    assert out["c"] == ["x-y", "q", "p", ""]
+    assert_tpu_cpu_equal(df)
+
+
+def test_batch3_fuzz(session):
+    t = gen_table({"s": "string"}, 300, seed=47)
+    df = session.create_dataframe(t).select(
+        replace_(col("s"), "a", "@@").alias("r"),
+        lpad(col("s"), 6, "_").alias("lp"),
+        rpad(col("s"), 6, "+").alias("rp"),
+        locate("l", col("s"), 2).alias("lc"),
+        substring_index(col("s"), "l", 1).alias("si"),
+        initcap(col("s")).alias("ic"),
+    )
+    assert_tpu_cpu_equal(df)
