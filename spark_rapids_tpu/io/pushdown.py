@@ -1,0 +1,174 @@
+"""Scan predicate pushdown: row-group and partition pruning.
+
+TPU analog of the reference's CPU-side Parquet filtering
+(ref: GpuParquetScan.scala:263-306 GpuParquetFileFilterHandler.
+filterBlocks — footer statistics decide which row groups are read at
+all) plus Hive partition pruning on the discovered partition values.
+
+The pushed predicate is the scan-adjacent Filter's condition; pruning is
+conservative (a row group is skipped only when its stats PROVE no row
+can match), and the Filter still runs exactly afterwards — pushdown is
+an IO optimization, never a semantics change."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.exprs import predicates as P
+
+
+def split_conjuncts(e: B.Expression) -> list[B.Expression]:
+    if isinstance(e, P.And):
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def _col_name(e: B.Expression, schema: T.Schema) -> Optional[str]:
+    if isinstance(e, B.BoundReference):
+        return schema.fields[e.ordinal].name
+    if isinstance(e, B.ColumnReference):
+        return e.col_name
+    return None
+
+
+def _lit_value(e: B.Expression):
+    if isinstance(e, B.Literal) and e.value is not None:
+        return e.value
+    return None
+
+
+_FLIP = {P.LessThan: P.GreaterThan, P.LessThanOrEqual: P.GreaterThanOrEqual,
+         P.GreaterThan: P.LessThan, P.GreaterThanOrEqual: P.LessThanOrEqual,
+         P.EqualTo: P.EqualTo}
+
+
+def _as_col_op_lit(conj: B.Expression, schema: T.Schema):
+    """Normalize a conjunct to (col_name, op_class, literal) or None."""
+    if type(conj) not in (P.LessThan, P.LessThanOrEqual, P.GreaterThan,
+                          P.GreaterThanOrEqual, P.EqualTo):
+        return None
+    name = _col_name(conj.left, schema)
+    v = _lit_value(conj.right)
+    if name is not None and v is not None:
+        return name, type(conj), v
+    name = _col_name(conj.right, schema)
+    v = _lit_value(conj.left)
+    if name is not None and v is not None:
+        return name, _FLIP[type(conj)], v
+    return None
+
+
+def _range_may_match(op, v, lo, hi) -> bool:
+    """Could any x in [lo, hi] satisfy `x op v`?  Conservative: any
+    comparison error (mismatched python types) keeps the range."""
+    try:
+        # NaN anywhere (literal OR footer stats) -> comparisons are
+        # unordered garbage; keep the row group
+        for x in (v, lo, hi):
+            if isinstance(x, float) and math.isnan(x):
+                return True
+        if op is P.LessThan:
+            return lo < v
+        if op is P.LessThanOrEqual:
+            return lo <= v
+        if op is P.GreaterThan:
+            return hi > v
+        if op is P.GreaterThanOrEqual:
+            return hi >= v
+        if op is P.EqualTo:
+            return lo <= v <= hi
+    except TypeError:
+        return True
+    return True
+
+
+def row_group_may_match(conjuncts: Sequence[B.Expression],
+                        schema: T.Schema, rg_meta) -> bool:
+    """False only when the row group's footer statistics prove no row
+    matches every conjunct (ref: filterBlocks' min/max checks)."""
+    stats_by_name = {}
+    nrows = rg_meta.num_rows
+    for ci in range(rg_meta.num_columns):
+        col = rg_meta.column(ci)
+        name = col.path_in_schema.split(".")[0]
+        stats_by_name[name] = col.statistics
+    for conj in conjuncts:
+        if isinstance(conj, P.IsNull):
+            name = _col_name(conj.child, schema)
+            st = stats_by_name.get(name)
+            if st is not None and st.null_count is not None \
+                    and st.null_count == 0:
+                return False
+            continue
+        if isinstance(conj, P.IsNotNull):
+            name = _col_name(conj.child, schema)
+            st = stats_by_name.get(name)
+            if st is not None and st.null_count is not None \
+                    and st.null_count >= nrows:
+                return False
+            continue
+        norm = _as_col_op_lit(conj, schema)
+        if norm is None:
+            continue
+        name, op, v = norm
+        st = stats_by_name.get(name)
+        if st is None or not st.has_min_max:
+            continue
+        v = _coerce_like(v, st.min)
+        if not _range_may_match(op, v, st.min, st.max):
+            return False
+        # a comparison also implies the column is non-NULL
+        if st.null_count is not None and st.null_count >= nrows:
+            return False
+    return True
+
+
+def _coerce_like(v, stat_sample):
+    """Align literal representation with pyarrow's stat values (dates
+    come back as datetime.date; our date literals are epoch days)."""
+    import datetime
+
+    if isinstance(stat_sample, datetime.date) \
+            and not isinstance(stat_sample, datetime.datetime) \
+            and isinstance(v, int):
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=v)
+    return v
+
+
+def partition_may_match(conjuncts: Sequence[B.Expression],
+                        schema: T.Schema, part_values: dict,
+                        part_fields: Sequence[T.Field]) -> bool:
+    """Hive partition pruning: partition values are EXACT, so any
+    violated conjunct on a partition column eliminates the whole file."""
+    typed = {}
+    for f in part_fields:
+        v = part_values.get(f.name)
+        if v is not None and isinstance(f.dtype, T.LongType):
+            v = int(v)
+        typed[f.name] = v
+    for conj in conjuncts:
+        if isinstance(conj, P.IsNull):
+            name = _col_name(conj.child, schema)
+            if name in typed and typed[name] is not None:
+                return False
+            continue
+        if isinstance(conj, P.IsNotNull):
+            name = _col_name(conj.child, schema)
+            if name in typed and typed[name] is None:
+                return False
+            continue
+        norm = _as_col_op_lit(conj, schema)
+        if norm is None:
+            continue
+        name, op, v = norm
+        if name not in typed:
+            continue
+        pv = typed[name]
+        if pv is None:
+            return False  # NULL partition value fails any comparison
+        if not _range_may_match(op, v, pv, pv):
+            return False
+    return True

@@ -13,6 +13,7 @@ from typing import Iterator, Optional, Sequence
 
 import pyarrow as pa
 
+from spark_rapids_tpu import config as _config
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.arrow import from_arrow, schema_to_arrow
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -89,11 +90,86 @@ def constant_column(value, dtype: T.DataType, n: int, cap: int):
     return Column.from_numpy(vals, dtype, validity, capacity=cap)
 
 
+FILES_PER_TASK_BYTES = _config.register(
+    "spark.rapids.tpu.sql.scan.taskTargetBytes", 32 << 20,
+    "Target total file size per scan task: small files coalesce into one "
+    "task up to this size (the multi-file reader analog, ref: "
+    "GpuParquetScan.scala:882 MultiFileParquetPartitionReader).")
+
+
+def _task_target_bytes() -> int:
+    return _config.get_conf().get(FILES_PER_TASK_BYTES)
+
+
+def _prefetched(gen, stop_depth: int = 2):
+    """Run a generator on a background thread with a bounded queue so
+    host-side work (footer pruning, Parquet decode) overlaps the
+    consumer's upload + device compute (the cloud-reader thread-pool
+    idea, ref: GpuParquetScan.scala:882-895
+    MultiFileCloudParquetPartitionReader).  Items must stay host-side;
+    device residency belongs to the consuming task thread."""
+    import queue
+    import threading
+    import time
+
+    q: "queue.Queue" = queue.Queue(maxsize=stop_depth)
+    stop = threading.Event()
+    _DONE = object()
+
+    def put_or_abort(item) -> None:
+        # never block forever: give up once the consumer signalled stop
+        while True:
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                if stop.is_set():
+                    return
+
+    def producer():
+        try:
+            for item in gen:
+                put_or_abort(item)
+                if stop.is_set():
+                    return
+        except BaseException as e:
+            put_or_abort(e)
+        finally:
+            put_or_abort(_DONE)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()  # producer's put loops notice within 0.1s
+        while True:  # drop whatever it had already queued
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                if not t.is_alive():
+                    break
+                time.sleep(0.01)
+        t.join()
+
+
 class ParquetScanExec(TpuExec):
-    """Reads row-group-sized record batches per file and uploads them
-    (the per-file reader mode; multi-file coalescing/cloud thread pools
-    of GpuParquetScan.scala:882 are a later stage).  Per-file Hive
-    partition values are appended as trailing constant columns."""
+    """Multi-file coalesced Parquet scan with footer predicate pushdown.
+
+    - files group into tasks up to a byte target (ref:
+      MultiFileParquetPartitionReader, GpuParquetScan.scala:882);
+    - a scan-adjacent Filter's condition prunes whole files on Hive
+      partition values and row groups on footer min/max statistics
+      before any byte is read (ref: filterBlocks :263-306) — the exact
+      Filter still runs afterwards;
+    - each task's decode+upload runs prefetched on a background thread;
+    - per-file Hive partition values append as trailing constants."""
 
     def __init__(self, paths: Sequence[str], schema: T.Schema,
                  columns: Optional[Sequence[str]] = None,
@@ -107,20 +183,49 @@ class ParquetScanExec(TpuExec):
         self.batch_rows = batch_rows or _conf_batch_rows()
         self.partition_values = list(partition_values or [])
         self.partition_fields = list(partition_fields)
+        self.pushed_filter = None  # set by the planner (Filter above)
+        self._groups = self._group_files()
+
+    def _group_files(self) -> list[list[int]]:
+        import os
+
+        target = _task_target_bytes()
+        groups: list[list[int]] = []
+        cur: list[int] = []
+        cur_bytes = 0
+        for i, p in enumerate(self.paths):
+            try:
+                sz = os.path.getsize(p)
+            except OSError:
+                sz = target
+            if cur and cur_bytes + sz > target:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += sz
+        if cur:
+            groups.append(cur)
+        return groups or [[]]
 
     @property
     def schema(self) -> T.Schema:
         return self._schema
 
     def node_desc(self) -> str:
-        return f"ParquetScanExec {self.paths}"
+        pf = ""
+        if self.pushed_filter is not None:
+            pf = f" pushed=[{self.pushed_filter.name}]"
+        return (f"ParquetScanExec [{len(self.paths)} files, "
+                f"{len(self._groups)} tasks]{pf}")
 
     def additional_metrics(self):
-        return [("scanTime", "MODERATE")]
+        return [("scanTime", "MODERATE"),
+                ("filesPruned", "ESSENTIAL"),
+                ("rowGroupsPruned", "ESSENTIAL")]
 
     @property
     def num_partitions(self) -> int:
-        return len(self.paths)  # one task per file (row-group splits later)
+        return len(self._groups)
 
     def _partition_value(self, p: int, f: T.Field):
         v = self.partition_values[p].get(f.name) \
@@ -141,33 +246,84 @@ class ParquetScanExec(TpuExec):
                 self._partition_value(p, f), f.dtype, n, cap))
         return ColumnarBatch(cols, batch.num_rows, self._schema)
 
-    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+    def _conjuncts(self):
+        if self.pushed_filter is None:
+            return None
+        from spark_rapids_tpu.io.pushdown import split_conjuncts
+
+        return split_conjuncts(self.pushed_filter)
+
+    def _file_batches(self, fi: int, conjuncts) -> Iterator[ColumnarBatch]:
+        """One file's surviving batches as zero-arg upload thunks.
+
+        Pruning and Parquet DECODE run while this generator is iterated
+        (on the prefetch thread); the H2D UPLOAD happens when the thunk
+        is called (on the consuming task thread, which holds the TPU
+        semaphore) so prefetched data waits on HOST and device residency
+        stays inside the semaphore's concurrency bound — the reference
+        cloud reader keeps its prefetched buffers on host the same way."""
         import pyarrow.parquet as pq
+
+        from spark_rapids_tpu.io.pushdown import (
+            partition_may_match,
+            row_group_may_match,
+        )
+
+        if conjuncts is not None and self.partition_fields:
+            pv = self.partition_values[fi] \
+                if fi < len(self.partition_values) else {}
+            if not partition_may_match(conjuncts, self._schema, pv,
+                                       self.partition_fields):
+                self.metrics["filesPruned"].add(1)
+                return
 
         if self.columns is not None and not self.columns:
             # partition-columns-only projection: no file columns to read
             from spark_rapids_tpu.columnar.column import pad_capacity
 
-            n_total = pq.read_metadata(self.paths[p]).num_rows
-            offs = range(0, n_total, self.batch_rows) if n_total \
-                else ([0] if p == 0 else [])
-            for off in offs:
+            n_total = pq.read_metadata(self.paths[fi]).num_rows
+            for off in range(0, n_total, self.batch_rows):
                 n = min(self.batch_rows, n_total - off)
                 cap = pad_capacity(max(n, 1))
-                cols = [constant_column(self._partition_value(p, f),
-                                        f.dtype, n, cap)
-                        for f in self.partition_fields]
-                yield self._count_output(
-                    ColumnarBatch(cols, n, self._schema))
+
+                def make_consts(n=n, cap=cap):
+                    cols = [constant_column(self._partition_value(fi, f),
+                                            f.dtype, n, cap)
+                            for f in self.partition_fields]
+                    return ColumnarBatch(cols, n, self._schema)
+
+                yield make_consts
             return
 
-        f = pq.ParquetFile(self.paths[p])
-        empty = True
+        f = pq.ParquetFile(self.paths[fi])
+        n_rgs = f.metadata.num_row_groups
+        if conjuncts is not None:
+            keep_rgs = [g for g in range(n_rgs)
+                        if row_group_may_match(
+                            conjuncts, self._schema,
+                            f.metadata.row_group(g))]
+            self.metrics["rowGroupsPruned"].add(n_rgs - len(keep_rgs))
+            if not keep_rgs:
+                return
+        else:
+            keep_rgs = list(range(n_rgs))
         for rb in f.iter_batches(batch_size=self.batch_rows,
-                                 columns=self.columns):
+                                 columns=self.columns,
+                                 row_groups=keep_rgs):
+            yield lambda rb=rb: self._with_partition_cols(
+                from_arrow(pa.Table.from_batches([rb])), fi)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        conjuncts = self._conjuncts()
+
+        def task():
+            for fi in self._groups[p]:
+                yield from self._file_batches(fi, conjuncts)
+
+        empty = True
+        for thunk in _prefetched(task()):
             empty = False
-            yield self._count_output(self._with_partition_cols(
-                from_arrow(pa.Table.from_batches([rb])), p))
+            yield self._count_output(thunk())
         if empty and p == 0:
             aschema = schema_to_arrow(self._schema)
             yield self._count_output(

@@ -330,8 +330,9 @@ class CpuFallbackExec(TpuExec):
 def convert_meta(meta: PlanMeta) -> TpuExec:
     p = meta.plan
     if not meta.can_replace:
-        return CpuFallbackExec(p, *[convert_meta(c)
-                                    for c in meta.children])
+        kids = [convert_meta(c) for c in meta.children]
+        _maybe_push_filter(p, kids)
+        return CpuFallbackExec(p, *kids)
     from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.execs.basic import (
         TpuFilterExec,
@@ -364,6 +365,7 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.Project):
         return TpuProjectExec(p.exprs, kids[0])
     if isinstance(p, L.Filter):
+        _maybe_push_filter(p, kids)
         return TpuFilterExec(p.condition, kids[0])
     if isinstance(p, L.Expand):
         from spark_rapids_tpu.execs.expand import TpuExpandExec
@@ -388,6 +390,18 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.Join):
         return _plan_join(p, kids)
     raise AssertionError(f"tagged-replaceable node unconvertible: {p.name}")
+
+
+def _maybe_push_filter(p: L.LogicalPlan, kids: list[TpuExec]) -> None:
+    """Attach a scan-adjacent Filter's condition to the Parquet scan for
+    row-group/partition pruning (ref: GpuParquetScan.scala:263-306).
+    Pure IO optimization on the fresh exec instance — the Filter still
+    evaluates exactly, whichever engine it runs on."""
+    from spark_rapids_tpu.io.scan import ParquetScanExec
+
+    if isinstance(p, L.Filter) and kids \
+            and isinstance(kids[0], ParquetScanExec):
+        kids[0].pushed_filter = p.condition
 
 
 BROADCAST_THRESHOLD = register(

@@ -12,3 +12,19 @@ run rides a fragile remote-TPU tunnel.
 from spark_rapids_tpu.platform import pin_cpu_platform
 
 pin_cpu_platform(8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_conf():
+    """Snapshot/restore the thread-local conf so a test's conf.set()
+    can't leak into later tests (sessions share the thread-local)."""
+    from spark_rapids_tpu.config import get_conf, set_conf
+
+    conf = get_conf()
+    saved = dict(conf._values)
+    yield
+    conf._values.clear()
+    conf._values.update(saved)
+    set_conf(conf)  # undo any set_conf() swap too

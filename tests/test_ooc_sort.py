@@ -154,6 +154,8 @@ def test_range_exchange_order_by(tmp_path):
     t = gen_table({"a": "int64", "b": "float64", "s": "string"}, 3000,
                   seed=21)
     session = TpuSession()
+    # defeat small-file coalescing: this test wants a multi-partition scan
+    session.conf.set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1)
     paths = _write_files(tmp_path, t, 4)
     # total order (every column a key): the threaded exchange does not
     # preserve input order between equal keys, as in Spark

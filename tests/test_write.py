@@ -59,6 +59,8 @@ def test_write_query_result_multi_partition(session, tmp_path):
 
     src = tmp_path / "src"
     os.makedirs(src)
+    # defeat small-file coalescing: this test wants one task per file
+    session.conf.set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1)
     tables = []
     for i in range(3):
         t = _sample_table(50)
