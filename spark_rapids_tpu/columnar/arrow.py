@@ -199,9 +199,9 @@ def _packed_enabled() -> bool:
 
         _PACKED_UPLOAD = register(
             "spark.rapids.tpu.sql.scan.packedUpload", True,
-            "Ship each scanned batch as a single packed host buffer and "
-            "unpack on device in one compiled program, instead of one "
-            "transfer per column component.")
+            "Ship each scanned batch's column components in one batched "
+            "device_put (a single transfer round) instead of one "
+            "transfer per component.")
     from spark_rapids_tpu.config import get_conf
 
     return get_conf().get(_PACKED_UPLOAD)
@@ -284,12 +284,9 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
                 comps.extend([data, vhost])
 
     if len(comps) > 1 and _packed_enabled():
-        buf, layout = _pack_components(comps)
-        from spark_rapids_tpu.execs.jit_cache import cached_jit
-
-        unpack = cached_jit(("unpack", layout),
-                            lambda: _make_unpack(layout))
-        dev = unpack(jnp.asarray(buf))
+        # one batched transfer round for every component (beats a packed
+        # staging buffer: no unpack program, and jax batches the copies)
+        dev = jax.device_put(comps)
     else:
         dev = [jnp.asarray(a) for a in comps]
 
@@ -308,16 +305,40 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
 
 
 def to_arrow(batch: ColumnarBatch) -> pa.Table:
-    """Device ColumnarBatch -> host Arrow table (the D2H download)."""
-    n = batch.concrete_num_rows()
+    """Device ColumnarBatch -> host Arrow table (the D2H download).
+
+    Every device component comes back in ONE batched jax.device_get:
+    D2H pays a latency round per call, not per buffer, so sequential
+    per-column reads would multiply the transfer latency by the column
+    count.  The batch is first SHRUNK on device to its live row count
+    (padding rows never cross the wire — a 1-row aggregate result in a
+    million-row capacity bucket is a 1-row transfer, not a 100MB one)."""
+    n_live = batch.concrete_num_rows()
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
+
+    shrunk_cap = max(128, -(-n_live // 128) * 128)
+    if shrunk_cap < batch.capacity:
+        batch = batch.shrink_to_capacity(shrunk_cap)
+    batch = _CB(batch.columns, n_live, batch.schema)
+    comps: list = []
+    for col in batch.columns:
+        if isinstance(col, ListColumn):
+            comps += [col.values, col.lengths, col.elem_validity,
+                      col.validity]
+        elif isinstance(col, StringColumn):
+            comps += [col.chars, col.lengths, col.validity]
+        else:
+            comps += [col.data, col.validity]
+    host = jax.device_get(comps)
+    n = n_live
+
     arrays = []
+    ci = 0
     aschema = schema_to_arrow(batch.schema)
     for f, col, afield in zip(batch.schema.fields, batch.columns, aschema):
         if isinstance(col, ListColumn):
-            vals = np.asarray(col.values)[:n]
-            lens = np.asarray(col.lengths)[:n]
-            ev = np.asarray(col.elem_validity)[:n]
-            rv = np.asarray(col.validity)[:n]
+            vals, lens, ev, rv = (a[:n] for a in host[ci:ci + 4])
+            ci += 4
             pylist = []
             for i in range(n):
                 if not rv[i]:
@@ -329,10 +350,18 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
                         for j in range(m)])
             arrays.append(pa.array(pylist, type=afield.type))
         elif isinstance(col, StringColumn):
-            arrays.append(pa.array(col.to_list(n), type=afield.type))
+            chars, lens, valid = (a[:n] for a in host[ci:ci + 3])
+            ci += 3
+            pylist = [
+                bytes(chars[i, :lens[i]]).decode("utf-8")
+                if valid[i] else None
+                for i in range(n)
+            ]
+            arrays.append(pa.array(pylist, type=afield.type))
         else:
-            vals = np.asarray(col.data)[:n]
-            valid = np.asarray(col.validity)[:n]
+            vals = host[ci][:n]
+            valid = host[ci + 1][:n]
+            ci += 2
             if isinstance(f.dtype, T.DecimalType):
                 import decimal
 

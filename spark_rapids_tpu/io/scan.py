@@ -91,14 +91,46 @@ def constant_column(value, dtype: T.DataType, n: int, cap: int):
 
 
 FILES_PER_TASK_BYTES = _config.register(
-    "spark.rapids.tpu.sql.scan.taskTargetBytes", 32 << 20,
+    "spark.rapids.tpu.sql.scan.taskTargetBytes", 512 << 20,
     "Target total file size per scan task: small files coalesce into one "
     "task up to this size (the multi-file reader analog, ref: "
     "GpuParquetScan.scala:882 MultiFileParquetPartitionReader).")
 
+MAX_READ_BATCH_BYTES = _config.register(
+    "spark.rapids.tpu.sql.scan.maxReadBatchSizeBytes", 64 << 20,
+    "Target device bytes per scanned batch (ref: "
+    "spark.rapids.sql.reader.batchSizeBytes, RapidsConf.scala:446). "
+    "Scan batches are sized rows = bytes/estimated-row-width: batches "
+    "this size amortize per-dispatch/per-transfer latency while still "
+    "pipelining decode -> upload -> compute across batches.")
+
 
 def _task_target_bytes() -> int:
     return _config.get_conf().get(FILES_PER_TASK_BYTES)
+
+
+def _scan_batch_rows(schema: T.Schema) -> int:
+    """Rows per scanned batch from the byte target; an explicitly set
+    global batchSizeRows still caps it exactly (tests and memory-tight
+    deployments rely on that), as does maxBatchCapacity."""
+    import numpy as np
+
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS, MAX_CAPACITY
+
+    conf = _config.get_conf()
+    rows_cap = conf.get(BATCH_SIZE_ROWS)
+    if rows_cap == BATCH_SIZE_ROWS.default:
+        rows_cap = 64 << 20  # defer to the byte target
+    est = 2  # validity byte + slack
+    for f in schema.fields:
+        if isinstance(f.dtype, T.StringType):
+            est += 40
+        elif isinstance(f.dtype, T.ListType):
+            est += 128
+        else:
+            est += np.dtype(T.to_numpy_dtype(f.dtype)).itemsize
+    by_bytes = max(1024, conf.get(MAX_READ_BATCH_BYTES) // est)
+    return int(max(1, min(rows_cap, by_bytes, conf.get(MAX_CAPACITY))))
 
 
 def _prefetched(gen, stop_depth: int = 2):
@@ -180,7 +212,7 @@ class ParquetScanExec(TpuExec):
         self.paths = list(paths)
         self._schema = schema
         self.columns = list(columns) if columns is not None else None
-        self.batch_rows = batch_rows or _conf_batch_rows()
+        self.batch_rows = batch_rows or _scan_batch_rows(schema)
         self.partition_values = list(partition_values or [])
         self.partition_fields = list(partition_fields)
         self.pushed_filter = None  # set by the planner (Filter above)
@@ -234,18 +266,6 @@ class ParquetScanExec(TpuExec):
             v = int(v)
         return v
 
-    def _with_partition_cols(self, batch: ColumnarBatch,
-                             p: int) -> ColumnarBatch:
-        if not self.partition_fields:
-            return batch
-        n = batch.concrete_num_rows()
-        cap = max(batch.capacity, 1)
-        cols = list(batch.columns)
-        for f in self.partition_fields:
-            cols.append(constant_column(
-                self._partition_value(p, f), f.dtype, n, cap))
-        return ColumnarBatch(cols, batch.num_rows, self._schema)
-
     def _conjuncts(self):
         if self.pushed_filter is None:
             return None
@@ -253,15 +273,30 @@ class ParquetScanExec(TpuExec):
 
         return split_conjuncts(self.pushed_filter)
 
-    def _file_batches(self, fi: int, conjuncts) -> Iterator[ColumnarBatch]:
-        """One file's surviving batches as zero-arg upload thunks.
+    def _host_partition_array(self, fi: int, f: T.Field,
+                              n: int) -> pa.Array:
+        """A host Arrow array repeating file fi's partition value."""
+        import numpy as np
 
-        Pruning and Parquet DECODE run while this generator is iterated
-        (on the prefetch thread); the H2D UPLOAD happens when the thunk
-        is called (on the consuming task thread, which holds the TPU
-        semaphore) so prefetched data waits on HOST and device residency
-        stays inside the semaphore's concurrency bound — the reference
-        cloud reader keeps its prefetched buffers on host the same way."""
+        atype = schema_to_arrow(T.Schema([f])).field(0).type
+        v = self._partition_value(fi, f)
+        if v is None:
+            return pa.nulls(n, atype)
+        if isinstance(f.dtype, T.StringType):
+            one = pa.array([str(v)], atype)
+        else:
+            one = pa.array([v]).cast(atype)
+        return one.take(pa.array(np.zeros(n, np.int32)))
+
+    def _file_tables(self, fi: int, conjuncts):
+        """One file's surviving data as HOST Arrow tables (full output
+        schema: file columns + repeated partition values), or bare ints
+        (row counts) when the projection has zero columns.
+
+        Pruning and Parquet decode run while this generator is iterated
+        (on the prefetch thread); uploads happen later on the consuming
+        task thread, which holds the TPU semaphore — prefetched data
+        waits on HOST, as in the reference's cloud reader."""
         import pyarrow.parquet as pq
 
         from spark_rapids_tpu.io.pushdown import (
@@ -278,21 +313,17 @@ class ParquetScanExec(TpuExec):
                 return
 
         if self.columns is not None and not self.columns:
-            # partition-columns-only projection: no file columns to read
-            from spark_rapids_tpu.columnar.column import pad_capacity
-
+            # no file columns to read: only row counts matter
             n_total = pq.read_metadata(self.paths[fi]).num_rows
             for off in range(0, n_total, self.batch_rows):
                 n = min(self.batch_rows, n_total - off)
-                cap = pad_capacity(max(n, 1))
-
-                def make_consts(n=n, cap=cap):
-                    cols = [constant_column(self._partition_value(fi, f),
-                                            f.dtype, n, cap)
-                            for f in self.partition_fields]
-                    return ColumnarBatch(cols, n, self._schema)
-
-                yield make_consts
+                if not self.partition_fields:
+                    yield n
+                else:
+                    yield pa.Table.from_arrays(
+                        [self._host_partition_array(fi, f, n)
+                         for f in self.partition_fields],
+                        [f.name for f in self.partition_fields])
             return
 
         f = pq.ParquetFile(self.paths[fi])
@@ -310,20 +341,59 @@ class ParquetScanExec(TpuExec):
         for rb in f.iter_batches(batch_size=self.batch_rows,
                                  columns=self.columns,
                                  row_groups=keep_rgs):
-            yield lambda rb=rb: self._with_partition_cols(
-                from_arrow(pa.Table.from_batches([rb])), fi)
+            tbl = pa.Table.from_batches([rb])
+            for f2 in self.partition_fields:
+                tbl = tbl.append_column(
+                    f2.name,
+                    self._host_partition_array(fi, f2, rb.num_rows))
+            yield tbl
+
+    def _upload(self, tables: list) -> ColumnarBatch:
+        tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        b = from_arrow(tbl)
+        return ColumnarBatch(b.columns, b.num_rows, self._schema)
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        """Accumulates decoded host tables ACROSS row groups and files
+        up to batch_rows, then uploads each accumulated chunk in one
+        transfer round: few big batches, not many small ones — on TPU
+        the per-dispatch/per-transfer latency dominates small batches."""
         conjuncts = self._conjuncts()
 
         def task():
             for fi in self._groups[p]:
-                yield from self._file_batches(fi, conjuncts)
+                yield from self._file_tables(fi, conjuncts)
 
         empty = True
-        for thunk in _prefetched(task()):
+        acc: list[pa.Table] = []
+        acc_rows = 0
+        pending_count = 0  # zero-column case: rows are pure counts
+        for item in _prefetched(task()):
+            if isinstance(item, int):
+                pending_count += item
+                if pending_count >= self.batch_rows:
+                    empty = False
+                    yield self._count_output(ColumnarBatch(
+                        [], pending_count, self._schema))
+                    pending_count = 0
+                continue
+            acc.append(item)
+            acc_rows += item.num_rows
+            while acc_rows >= self.batch_rows:
+                tbl = pa.concat_tables(acc) if len(acc) > 1 else acc[0]
+                head = tbl.slice(0, self.batch_rows)
+                tail = tbl.slice(self.batch_rows)
+                empty = False
+                yield self._count_output(self._upload([head]))
+                acc = [tail] if tail.num_rows else []
+                acc_rows = tail.num_rows
+        if pending_count:
             empty = False
-            yield self._count_output(thunk())
+            yield self._count_output(
+                ColumnarBatch([], pending_count, self._schema))
+        if acc_rows:
+            empty = False
+            yield self._count_output(self._upload(acc))
         if empty and p == 0:
             aschema = schema_to_arrow(self._schema)
             yield self._count_output(
