@@ -743,48 +743,55 @@ _AGG_MAP = {
 }
 
 
-def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
-    if isinstance(plan, L.InMemoryRelation):
-        return plan.table
+def _read_scan_file(plan: L.LogicalPlan, path: str) -> pa.Table:
+    """One file's (projected) columns as a host table; preserves row
+    counts even for an empty projection."""
     if isinstance(plan, L.ParquetRelation):
         import pyarrow.parquet as pq
 
-        aschema = schema_to_arrow(plan.schema)
-        tables = []
-        for i, p in enumerate(plan.paths):
-            t = pq.read_table(p, columns=plan.columns)
-            # trailing Hive partition-value columns (same layout as the
-            # TPU scan's constant-column appender)
-            for f in plan.partition_fields:
-                v = plan.partition_values[i].get(f.name) \
-                    if i < len(plan.partition_values) else None
-                if v is not None and isinstance(f.dtype, T.LongType):
-                    v = int(v)
-                t = t.append_column(
-                    pa.field(f.name, aschema.field(f.name).type, True),
-                    pa.array([v] * t.num_rows,
-                             aschema.field(f.name).type))
-            tables.append(t)
-        return pa.concat_tables(tables).cast(aschema)
-    if isinstance(plan, L.CsvRelation):
-        import pyarrow.csv as pacsv
+        return pq.read_table(path, columns=plan.columns)
+    if isinstance(plan, L.OrcRelation):
+        import pyarrow.orc as paorc
 
-        aschema = schema_to_arrow(plan.schema)
-        file_aschema = schema_to_arrow(plan.file_schema)
-        tables = []
-        for i, p in enumerate(plan.paths):
-            t = pacsv.read_csv(p).cast(file_aschema)
-            for f in plan.partition_fields:
-                v = plan.partition_values[i].get(f.name) \
-                    if i < len(plan.partition_values) else None
-                if v is not None and isinstance(f.dtype, T.LongType):
-                    v = int(v)
-                t = t.append_column(
-                    pa.field(f.name, aschema.field(f.name).type, True),
-                    pa.array([v] * t.num_rows,
-                             aschema.field(f.name).type))
-            tables.append(t)
-        return pa.concat_tables(tables).cast(aschema)
+        f = paorc.ORCFile(path)
+        if plan.columns == []:
+            # ORC read(columns=[]) loses num_rows (unlike parquet):
+            # read one column and drop it to keep the row count
+            names = [fl.name for fl in f.schema]
+            t = f.read(columns=names[:1]) if names else f.read()
+            return t.select([])
+        return f.read(columns=plan.columns)
+    import pyarrow.csv as pacsv
+
+    return pacsv.read_csv(path).cast(schema_to_arrow(plan.file_schema))
+
+
+def _scan_cpu(plan: L.LogicalPlan) -> pa.Table:
+    """File-relation leaf on the CPU engine, with trailing Hive
+    partition-value columns (same layout as the TPU scan's appender)."""
+    aschema = schema_to_arrow(plan.schema)
+    tables = []
+    for i, p in enumerate(plan.paths):
+        t = _read_scan_file(plan, p)
+        for f in plan.partition_fields:
+            v = plan.partition_values[i].get(f.name) \
+                if i < len(plan.partition_values) else None
+            if v is not None and isinstance(f.dtype, T.LongType):
+                v = int(v)
+            t = t.append_column(
+                pa.field(f.name, aschema.field(f.name).type, True),
+                pa.array([v] * t.num_rows,
+                         aschema.field(f.name).type))
+        tables.append(t)
+    return pa.concat_tables(tables).cast(aschema)
+
+
+def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
+    if isinstance(plan, L.InMemoryRelation):
+        return plan.table
+    if isinstance(plan, (L.ParquetRelation, L.OrcRelation,
+                         L.CsvRelation)):
+        return _scan_cpu(plan)
     if isinstance(plan, L.RangeRel):
         total = max(0, -(-(plan.end - plan.start) // plan.step))
         ids = plan.start + np.arange(total, dtype=np.int64) * plan.step

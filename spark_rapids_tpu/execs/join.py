@@ -375,33 +375,57 @@ class TpuBroadcastHashJoinExec(_HashJoinBase):
 
     full_outer is excluded: unmatched-build emission needs matched flags
     merged across ALL stream partitions, which a streaming narrow exec
-    cannot do (the planner keeps full_outer on the shuffled path)."""
+    cannot do (the planner keeps full_outer on the shuffled path).
+
+    The collected build batch lives in the buffer store as a spillable
+    entry (high BROADCAST priority, so it spills last) instead of being
+    pinned un-spillably for the exec's lifetime: each stream partition
+    pins it only while joining, and builds near the broadcast threshold
+    times many concurrent joins stay inside the HBM budget manager."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         assert self.join_type != "full_outer", \
             "broadcast join cannot implement full_outer"
         self._build_lock = threading.Lock()
-        self._build_cached: Optional[ColumnarBatch] = None
+        self._build_handle = None  # Optional[SpillableBatch]
         self._build_done = False
 
     @property
     def num_partitions(self) -> int:
         return self._stream_child.num_partitions
 
-    def _get_build(self) -> Optional[ColumnarBatch]:
+    def _get_build_handle(self):
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
+
         with self._build_lock:
             if not self._build_done:
-                self._build_cached = self._collect_batches(
-                    self._build_child.execute())
+                b = self._collect_batches(self._build_child.execute())
+                if b is not None:
+                    self._build_handle = get_store().register(
+                        b, SpillPriorities.BROADCAST)
+                    self._build_handle.unpin()
                 self._build_done = True
-            return self._build_cached
+            return self._build_handle
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        build = self._get_build()
-        yield from self._join_stream(
-            build, self._stream_child.execute_partition(p))
+        h = self._get_build_handle()
+        build = h.get() if h is not None else None
+        try:
+            yield from self._join_stream(
+                build, self._stream_child.execute_partition(p))
+        finally:
+            if h is not None:
+                h.unpin()
 
     def execute(self) -> Iterator[ColumnarBatch]:
         for p in range(self.num_partitions):
             yield from self.execute_partition(p)
+
+    def close(self) -> None:
+        with self._build_lock:
+            if self._build_handle is not None:
+                self._build_handle.close()
+                self._build_handle = None
+            self._build_done = False
+        super().close()

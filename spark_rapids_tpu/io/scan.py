@@ -288,6 +288,19 @@ class ParquetScanExec(TpuExec):
             one = pa.array([v]).cast(atype)
         return one.take(pa.array(np.zeros(n, np.int32)))
 
+    def _partition_only_tables(self, fi: int, n_total: int):
+        """Chunks for a projection with no file columns: bare row counts
+        (zero-column schema) or repeated partition values."""
+        for off in range(0, n_total, self.batch_rows):
+            n = min(self.batch_rows, n_total - off)
+            if not self.partition_fields:
+                yield n
+            else:
+                yield pa.Table.from_arrays(
+                    [self._host_partition_array(fi, f, n)
+                     for f in self.partition_fields],
+                    [f.name for f in self.partition_fields])
+
     def _file_tables(self, fi: int, conjuncts):
         """One file's surviving data as HOST Arrow tables (full output
         schema: file columns + repeated partition values), or bare ints
@@ -314,16 +327,8 @@ class ParquetScanExec(TpuExec):
 
         if self.columns is not None and not self.columns:
             # no file columns to read: only row counts matter
-            n_total = pq.read_metadata(self.paths[fi]).num_rows
-            for off in range(0, n_total, self.batch_rows):
-                n = min(self.batch_rows, n_total - off)
-                if not self.partition_fields:
-                    yield n
-                else:
-                    yield pa.Table.from_arrays(
-                        [self._host_partition_array(fi, f, n)
-                         for f in self.partition_fields],
-                        [f.name for f in self.partition_fields])
+            yield from self._partition_only_tables(
+                fi, pq.read_metadata(self.paths[fi]).num_rows)
             return
 
         f = pq.ParquetFile(self.paths[fi])
@@ -400,6 +405,48 @@ class ParquetScanExec(TpuExec):
                 from_arrow(pa.Table.from_arrays(
                     [pa.array([], fl.type) for fl in aschema],
                     schema=aschema)))
+
+
+class OrcScanExec(ParquetScanExec):
+    """ORC scan: stripes play the role of row groups (ref:
+    GpuOrcScan.scala — stripe-granular reads).  Reuses the Parquet
+    exec's task coalescing, host accumulation, partition pruning and
+    prefetching; footer min/max stripe pruning is skipped (pyarrow does
+    not expose ORC stripe statistics)."""
+
+    def node_desc(self) -> str:
+        pf = ""
+        if self.pushed_filter is not None:
+            pf = f" pushed=[{self.pushed_filter.name}]"
+        return (f"OrcScanExec [{len(self.paths)} files, "
+                f"{len(self._groups)} tasks]{pf}")
+
+    def _file_tables(self, fi: int, conjuncts):
+        import pyarrow.orc as paorc
+
+        from spark_rapids_tpu.io.pushdown import partition_may_match
+
+        if conjuncts is not None and self.partition_fields:
+            pv = self.partition_values[fi] \
+                if fi < len(self.partition_values) else {}
+            if not partition_may_match(conjuncts, self._schema, pv,
+                                       self.partition_fields):
+                self.metrics["filesPruned"].add(1)
+                return
+
+        f = paorc.ORCFile(self.paths[fi])
+        if self.columns is not None and not self.columns:
+            yield from self._partition_only_tables(fi, f.nrows)
+            return
+
+        for si in range(f.nstripes):
+            rb = f.read_stripe(si, columns=self.columns)
+            tbl = pa.Table.from_batches([rb])
+            for f2 in self.partition_fields:
+                tbl = tbl.append_column(
+                    f2.name,
+                    self._host_partition_array(fi, f2, tbl.num_rows))
+            yield tbl
 
 
 class CsvScanExec(TpuExec):

@@ -246,6 +246,34 @@ class CsvWriteExec(FileWriteExec):
         return _CsvWriter(path, schema)
 
 
+class _OrcWriter(_FormatWriter):
+    def __init__(self, path: str, schema: pa.Schema, compression: str):
+        import pyarrow.orc as paorc
+
+        self.path = path
+        # ORC has its own codec set; "snappy" (parquet's default here)
+        # is also a valid ORC codec
+        self._w = paorc.ORCWriter(path, compression=compression)
+
+    def write(self, table: pa.Table) -> None:
+        self._w.write(table)
+
+    def close(self) -> int:
+        self._w.close()
+        return os.path.getsize(self.path)
+
+
+class OrcWriteExec(FileWriteExec):
+    """ref: GpuOrcFileFormat.scala (ColumnarOutputWriter via cudf
+    writeORC)."""
+
+    FORMAT = "orc"
+    EXT = ".orc"
+
+    def _open(self, path: str, schema: pa.Schema) -> _FormatWriter:
+        return _OrcWriter(path, schema, self.compression)
+
+
 def _part_str(v) -> str:
     """Hive-style partition value encoding."""
     if v is None:

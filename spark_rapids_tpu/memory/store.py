@@ -57,6 +57,11 @@ class SpillPriorities:
     COALESCE_PENDING = 0
     AGGREGATE_PARTIAL = 50
     JOIN_BUILD = 80
+    #: broadcast builds are shared across every stream partition, so
+    #: respilling is paid many times over — spill them last (ref:
+    #: GpuBroadcastExchangeExec keeping broadcast batches as catalog
+    #: entries, GpuBroadcastExchangeExec.scala:237,271)
+    BROADCAST = 90
     ACTIVE_ON_DECK = 100
 
 
@@ -166,10 +171,17 @@ class _Entry:
     host: Optional[dict]  # HOST tier
     path: Optional[str]  # DISK tier
     schema: T.Schema
-    #: pinned entries are in active use and must not be evicted — an
+    #: pin COUNT: entries in active use must not be evicted — an
     #: acquire() that spills an already-acquired sibling would delete
-    #: device arrays the caller still holds
-    pinned: bool = False
+    #: device arrays the caller still holds.  A count (not a flag)
+    #: because shared entries (broadcast builds) are acquired by many
+    #: stream partitions concurrently; the first unpin must not make
+    #: the entry evictable under the others.
+    pins: int = 0
+
+    @property
+    def pinned(self) -> bool:
+        return self.pins > 0
 
 
 class SpillableBatch:
@@ -196,7 +208,7 @@ class SpillableBatch:
         with self._store._lock:
             e = self._store._entries.get(self.buffer_id)
             if e is not None:
-                e.pinned = False
+                e.pins = max(0, e.pins - 1)
 
     @property
     def tier(self) -> StorageTier:
@@ -272,18 +284,24 @@ class BufferStore:
     def acquire(self, buffer_id: int) -> ColumnarBatch:
         with self._lock:
             e = self._entries[buffer_id]
-            e.pinned = True  # before reserve(): a cascaded spill must
+            e.pins += 1  # before reserve(): a cascaded spill must
             # never select the entry being acquired (it could write a
             # disk file acquire would then orphan)
-            if e.tier == StorageTier.DEVICE:
-                return e.batch  # type: ignore[return-value]
-            if e.tier == StorageTier.HOST:
-                arrays = e.host
-            else:
-                with np.load(e.path) as z:  # type: ignore[arg-type]
-                    arrays = {k: z[k] for k in z.files}
-            self.reserve(e.nbytes)
-            batch = _host_to_batch(arrays, e.schema)  # H2D upload
+            try:
+                if e.tier == StorageTier.DEVICE:
+                    return e.batch  # type: ignore[return-value]
+                if e.tier == StorageTier.HOST:
+                    arrays = e.host
+                else:
+                    with np.load(e.path) as z:  # type: ignore[arg-type]
+                        arrays = {k: z[k] for k in z.files}
+                self.reserve(e.nbytes)
+                batch = _host_to_batch(arrays, e.schema)  # H2D upload
+            except BaseException:
+                # a failed acquire must not leak its pin (the entry
+                # would be unevictable forever)
+                e.pins = max(0, e.pins - 1)
+                raise
             if e.tier == StorageTier.HOST:
                 self.host_used -= _host_bytes(arrays)
             elif e.path:
@@ -296,7 +314,6 @@ class BufferStore:
                     pass
             e.batch, e.host, e.path = batch, None, None
             e.tier = StorageTier.DEVICE
-            e.pinned = True
             self.device_used += e.nbytes
             return batch
 
@@ -305,30 +322,35 @@ class BufferStore:
         DEVICE-tier entry is pulled D2H without changing tiers)."""
         with self._lock:
             e = self._entries[buffer_id]
-            e.pinned = True
-            if e.tier == StorageTier.HOST:
-                return e.host  # type: ignore[return-value]
-            if e.tier == StorageTier.DISK:
-                with np.load(e.path) as z:  # type: ignore[arg-type]
-                    return {k: z[k] for k in z.files}
-            b = e.batch  # DEVICE: pull without deleting
-            arrays: dict[str, np.ndarray] = {}
-            n = b.concrete_num_rows()  # type: ignore[union-attr]
-            for i, c in enumerate(b.columns):  # type: ignore[union-attr]
-                if isinstance(c, StringColumn):
-                    arrays[f"c{i}_chars"] = np.asarray(c.chars)
-                    arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
-                    arrays[f"c{i}_valid"] = np.asarray(c.validity)
-                elif isinstance(c, ListColumn):
-                    arrays[f"c{i}_lvalues"] = np.asarray(c.values)
-                    arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
-                    arrays[f"c{i}_levalid"] = np.asarray(c.elem_validity)
-                    arrays[f"c{i}_valid"] = np.asarray(c.validity)
-                else:
-                    arrays[f"c{i}_data"] = np.asarray(c.data)
-                    arrays[f"c{i}_valid"] = np.asarray(c.validity)
-            arrays["__num_rows"] = np.asarray(n, np.int64)
-            return arrays
+            e.pins += 1
+            try:
+                if e.tier == StorageTier.HOST:
+                    return e.host  # type: ignore[return-value]
+                if e.tier == StorageTier.DISK:
+                    with np.load(e.path) as z:  # type: ignore[arg-type]
+                        return {k: z[k] for k in z.files}
+                b = e.batch  # DEVICE: pull without deleting
+                arrays: dict[str, np.ndarray] = {}
+                n = b.concrete_num_rows()  # type: ignore[union-attr]
+                for i, c in enumerate(b.columns):  # type: ignore
+                    if isinstance(c, StringColumn):
+                        arrays[f"c{i}_chars"] = np.asarray(c.chars)
+                        arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
+                        arrays[f"c{i}_valid"] = np.asarray(c.validity)
+                    elif isinstance(c, ListColumn):
+                        arrays[f"c{i}_lvalues"] = np.asarray(c.values)
+                        arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
+                        arrays[f"c{i}_levalid"] = np.asarray(
+                            c.elem_validity)
+                        arrays[f"c{i}_valid"] = np.asarray(c.validity)
+                    else:
+                        arrays[f"c{i}_data"] = np.asarray(c.data)
+                        arrays[f"c{i}_valid"] = np.asarray(c.validity)
+                arrays["__num_rows"] = np.asarray(n, np.int64)
+                return arrays
+            except BaseException:
+                e.pins = max(0, e.pins - 1)  # failed acquire: no leak
+                raise
 
     def remove(self, buffer_id: int) -> None:
         with self._lock:

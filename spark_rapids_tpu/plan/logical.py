@@ -171,42 +171,43 @@ def infer_partition_fields(part_cols: Sequence[str],
     return fields
 
 
-class ParquetRelation(LogicalPlan):
-    """Parquet scan leaf (ref: GpuParquetScan.scala — here the footer/
-    row-group handling is pyarrow's; device decode is a later stage).
-    Directory paths are expanded with Hive partition discovery; partition
-    values surface as trailing columns."""
+class _FileRelation(LogicalPlan):
+    """Shared Hive-discovered file-scan leaf: path expansion, column/
+    partition projection resolution, lazy footer row estimates.
+    Partition columns trail the file columns (Spark's layout)."""
+
+    EXT = ""
 
     def __init__(self, paths: Sequence[str],
                  columns: Optional[Sequence[str]] = None):
-        import pyarrow.parquet as pq
-
-        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
-
         self.children = []
         self.paths, self.partition_values, part_cols = expand_scan_paths(
-            list(paths), ".parquet")
+            list(paths), self.EXT)
         if not self.paths:
-            raise FileNotFoundError(f"no parquet files under {paths}")
+            raise FileNotFoundError(f"no {self.EXT} files under {paths}")
         self.partition_fields = infer_partition_fields(
             part_cols, self.partition_values)
-        aschema = pq.read_schema(self.paths[0])
-        file_schema = schema_from_arrow(aschema)
+        file_schema = self._file_schema(self.paths[0])
         if columns is not None:
             part_names = {f.name for f in self.partition_fields}
             file_cols = [c for c in columns if c not in part_names]
             by_name = {f.name: f for f in file_schema.fields}
             file_fields = [by_name[c] for c in file_cols]
-            self.columns = file_cols
+            self.columns: Optional[list[str]] = file_cols
             self.partition_fields = [f for f in self.partition_fields
                                      if f.name in set(columns)]
         else:
             self.columns = None
             file_fields = list(file_schema.fields)
-        # partition columns trail the file columns (Spark's layout)
         self._schema = T.Schema(file_fields + self.partition_fields)
         self._est_rows: Optional[int] = None
         self._est_done = False
+
+    def _file_schema(self, path: str) -> T.Schema:
+        raise NotImplementedError
+
+    def _file_rows(self, path: str) -> int:
+        raise NotImplementedError
 
     @property
     def schema(self) -> T.Schema:
@@ -215,18 +216,37 @@ class ParquetRelation(LogicalPlan):
     def estimated_rows(self) -> Optional[int]:
         """Lazy (footer reads cost IO; only joins ever ask), memoized."""
         if not self._est_done:
-            import pyarrow.parquet as pq
-
             self._est_done = True
             try:
-                self._est_rows = sum(pq.read_metadata(p).num_rows
+                self._est_rows = sum(self._file_rows(p)
                                      for p in self.paths)
             except Exception:
                 pass
         return self._est_rows
 
     def node_desc(self) -> str:
-        return f"ParquetRelation {self.paths}"
+        return f"{type(self).__name__} {self.paths}"
+
+
+class ParquetRelation(_FileRelation):
+    """Parquet scan leaf (ref: GpuParquetScan.scala — here the footer/
+    row-group handling is pyarrow's; device decode is a later stage).
+    Directory paths are expanded with Hive partition discovery; partition
+    values surface as trailing columns."""
+
+    EXT = ".parquet"
+
+    def _file_schema(self, path: str) -> T.Schema:
+        import pyarrow.parquet as pq
+
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        return schema_from_arrow(pq.read_schema(path))
+
+    def _file_rows(self, path: str) -> int:
+        import pyarrow.parquet as pq
+
+        return pq.read_metadata(path).num_rows
 
 
 class CsvRelation(LogicalPlan):
@@ -258,6 +278,26 @@ class CsvRelation(LogicalPlan):
 
     def node_desc(self) -> str:
         return f"CsvRelation {self.paths}"
+
+
+class OrcRelation(_FileRelation):
+    """ORC scan leaf (ref: GpuOrcScan.scala — CPU footer parse + device
+    decode; here pyarrow's ORC reader decodes stripes on host and the
+    scan exec uploads them like Parquet row groups)."""
+
+    EXT = ".orc"
+
+    def _file_schema(self, path: str) -> T.Schema:
+        import pyarrow.orc as paorc
+
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        return schema_from_arrow(paorc.ORCFile(path).schema)
+
+    def _file_rows(self, path: str) -> int:
+        import pyarrow.orc as paorc
+
+        return paorc.ORCFile(path).nrows
 
 
 class RangeRel(LogicalPlan):

@@ -157,8 +157,9 @@ _EXEC_CONFS = {
     cls: register(f"spark.rapids.tpu.sql.exec.{cls.__name__}", True,
                   f"Enable TPU execution of {cls.__name__}.")
     for cls in (L.InMemoryRelation, L.ParquetRelation, L.CsvRelation,
-                L.RangeRel, L.Project, L.Filter, L.Aggregate, L.Sort,
-                L.Limit, L.Join, L.Union, L.Window, L.Expand, L.Generate)
+                L.OrcRelation, L.RangeRel, L.Project, L.Filter,
+                L.Aggregate, L.Sort, L.Limit, L.Join, L.Union, L.Window,
+                L.Expand, L.Generate)
 }
 
 
@@ -319,9 +320,35 @@ class CpuFallbackExec(TpuExec):
         plan.children = new_children
         return execute_cpu(plan)
 
+    #: logical nodes whose CPU evaluation is per-row: they can run on one
+    #: batch at a time, so the fallback boundary streams batch-wise
+    #: instead of materializing the whole child as a single Arrow table
+    #: (the reference's fallback is row-iterator streaming throughout)
+    _STREAMABLE = (L.Filter, L.Project, L.Generate)
+
+    def _execute_streaming(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.arrow import to_arrow
+        from spark_rapids_tpu.cpu.engine import execute_cpu
+        from spark_rapids_tpu.columnar.arrow import from_arrow
+
+        for b in self.children[0].execute():
+            tbl = to_arrow(b)
+            plan = copy.copy(self.plan)
+            plan.children = [L.InMemoryRelation(tbl)]
+            out = execute_cpu(plan)
+            yield self._count_output(from_arrow(out))
+
     def execute(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.io.scan import ArrowSourceExec
 
+        if isinstance(self.plan, self._STREAMABLE) \
+                and len(self.children) == 1 \
+                and not isinstance(self.children[0], CpuFallbackExec):
+            # adjacent CPU nodes keep the fusing cpu_table() path — the
+            # streaming boundary would bounce each batch through the
+            # device (from_arrow -> to_arrow) for nothing
+            yield from self._execute_streaming()
+            return
         src = ArrowSourceExec(self.cpu_table(), self.schema)
         for b in src.execute():
             yield self._count_output(b)
@@ -356,6 +383,12 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
         return ParquetScanExec(p.paths, p.schema, p.columns,
                                partition_values=p.partition_values,
                                partition_fields=p.partition_fields)
+    if isinstance(p, L.OrcRelation):
+        from spark_rapids_tpu.io.scan import OrcScanExec
+
+        return OrcScanExec(p.paths, p.schema, p.columns,
+                           partition_values=p.partition_values,
+                           partition_fields=p.partition_fields)
     if isinstance(p, L.CsvRelation):
         return CsvScanExec(p.paths, p.schema,
                            partition_values=p.partition_values,

@@ -220,6 +220,10 @@ class TpuSession:
                      columns: Optional[Sequence[str]] = None) -> "DataFrame":
         return DataFrame(L.ParquetRelation(list(paths), columns), self)
 
+    def read_orc(self, *paths: str,
+                 columns: Optional[Sequence[str]] = None) -> "DataFrame":
+        return DataFrame(L.OrcRelation(list(paths), columns), self)
+
     def read_csv(self, *paths: str,
                  schema: Optional[T.Schema] = None) -> "DataFrame":
         return DataFrame(L.CsvRelation(list(paths), schema), self)
@@ -508,6 +512,10 @@ class DataFrame:
                   partition_by: Sequence[str] = ()):
         return self.write.mode(mode).partition_by(*partition_by).csv(path)
 
+    def write_orc(self, path: str, mode: str = "error",
+                  partition_by: Sequence[str] = ()):
+        return self.write.mode(mode).partition_by(*partition_by).orc(path)
+
     # -- actions --------------------------------------------------------- #
 
     def collect(self, engine: Optional[str] = None) -> pa.Table:
@@ -565,6 +573,11 @@ class DataFrameWriter:
 
         return self._run(CsvWriteExec, path)
 
+    def orc(self, path: str):
+        from spark_rapids_tpu.io.write import OrcWriteExec
+
+        return self._run(OrcWriteExec, path)
+
     def _run(self, exec_cls, path: str):
         from spark_rapids_tpu.io.write import prepare_target
 
@@ -573,7 +586,7 @@ class DataFrameWriter:
         df = self._df
         child, _meta = plan_query(df._plan, df._session.conf)
         kwargs = {}
-        if exec_cls.FORMAT == "parquet":
+        if exec_cls.FORMAT in ("parquet", "orc"):
             kwargs["compression"] = self._compression
         w = exec_cls(path, child, partition_by=self._partition_by,
                      **kwargs)
