@@ -178,6 +178,32 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
 
     if isinstance(e, B.Alias):
         return cpu_eval(e.child, table)
+    from spark_rapids_tpu.udf.exprs import JaxScalarUDF, OpaquePythonUDF
+
+    if isinstance(e, OpaquePythonUDF):
+        # row-wise in-process evaluation (the python-worker analog);
+        # NULLs pass through to the function, as Spark's python UDFs do
+        cols = [cpu_eval(a, table).to_pylist() for a in e.args]
+        out = [e.fn(*vals) for vals in zip(*cols)] if cols \
+            else [e.fn() for _ in range(n)]
+        return pa.array(out, T.to_arrow_type(e.dtype))
+    if isinstance(e, JaxScalarUDF):
+        # mirror the device eval: fn over data arrays (NULL slots hold
+        # fill values), result NULL iff any input NULL
+        arrs = [cpu_eval(a, table) for a in e.args]
+        datas, valid = [], np.ones(n, bool)
+        for a, ax in zip(e.args, arrs):
+            atype = T.to_arrow_type(a.dtype)
+            v, ok = _np_vals(ax, atype)
+            datas.append(v)
+            valid &= ok
+        res = np.asarray(e.fn(*datas))
+        if res.shape != (n,):
+            raise ValueError(
+                f"jax UDF {e.fn_name!r} returned shape {res.shape}, "
+                f"expected ({n},)")
+        return _from_np(res.astype(T.to_numpy_dtype(e.dtype)), valid,
+                       T.to_arrow_type(e.dtype))
     if isinstance(e, COLL.Size):
         c = cpu_eval(e.child, table)
         return pc.list_value_length(c).cast(pa.int32())

@@ -113,6 +113,14 @@ from spark_rapids_tpu.exprs import collections as COLL  # noqa: E402
 for _cls in (COLL.Size, COLL.GetArrayItem, COLL.ArrayContains):
     register_expr(_cls, TS.ExprSig(TS.ALL, "array input required"))
 
+# columnar jax UDFs trace into the fused program like built-ins
+# (OpaquePythonUDF deliberately stays unregistered -> CPU fallback)
+from spark_rapids_tpu.udf.exprs import JaxScalarUDF  # noqa: E402
+
+register_expr(JaxScalarUDF, TS.ExprSig(
+    TS.NUMERIC + TS.BOOLEAN + TS.DATETIME + TS.NULLSIG,
+    "user columnar function over fixed-width device arrays"))
+
 # aggregate functions are checked by their own registry
 from spark_rapids_tpu.exprs import aggregates as AG  # noqa: E402
 

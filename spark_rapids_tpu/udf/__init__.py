@@ -1,0 +1,102 @@
+"""User-defined functions, TPU-first.
+
+Three tiers, best first (the reference's UDF story re-architected for
+XLA):
+
+1. `@udf(T)` — tries the AST compiler (compiler.py, the udf-compiler
+   analog): a compilable Python function becomes a pure Expression tree
+   and fuses into the XLA program like any built-in expression.
+2. `@jax_udf(T)` — the RapidsUDF analog (RapidsUDF.java:22-40): the
+   user writes the columnar kernel themselves against jax.numpy (or a
+   pallas_call) and it traces into the fused program.
+3. Anything else — an OpaquePythonUDF evaluated row-wise by the CPU
+   engine via planner fallback (the python-worker analog).
+
+`@udf` automatically degrades 1 -> 3; explain() shows which tier ran.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.udf.compiler import UncompilableUDF, compile_udf
+from spark_rapids_tpu.udf.exprs import JaxScalarUDF, OpaquePythonUDF
+
+
+class UserDefinedFunction:
+    """Callable wrapper binding a Python function to column expressions
+    (ref: sql/rapids/execution/python/ GpuPythonUDF + the compiled
+    GpuScalaUDF route)."""
+
+    def __init__(self, fn: Callable, return_type: Optional[T.DataType],
+                 columnar: bool = False):
+        self.fn = fn
+        self.return_type = return_type
+        self.columnar = columnar
+        self.name = getattr(fn, "__name__", "udf")
+        self._factory = None
+        self.tier = "opaque"
+        if columnar:
+            self.tier = "jax"
+        else:
+            try:
+                self._factory = compile_udf(fn)
+                self.tier = "compiled"
+            except UncompilableUDF:
+                if return_type is None:
+                    raise
+        if self.tier != "compiled" and return_type is None:
+            raise TypeError(
+                f"UDF {self.name!r} is not compilable to expressions, "
+                "so an explicit return_type is required")
+
+    def __call__(self, *cols) -> Expression:
+        from spark_rapids_tpu.session import _expr
+
+        args = tuple(_expr(c) for c in cols)
+        if self.tier == "compiled":
+            out = self._factory(*args)
+            if self.return_type is not None:
+                # dtype may be unresolvable before reference binding;
+                # a same-type Cast is a no-op, so wrap when in doubt
+                try:
+                    same = out.dtype == self.return_type
+                except Exception:
+                    same = False
+                if not same:
+                    from spark_rapids_tpu.exprs.cast import Cast
+
+                    out = Cast(out, self.return_type)
+            return out
+        if self.tier == "jax":
+            return JaxScalarUDF(self.fn, self.return_type, args,
+                                self.name)
+        return OpaquePythonUDF(self.fn, self.return_type, args,
+                               self.name)
+
+
+def udf(return_type: Optional[T.DataType] = None):
+    """Decorator/factory: `@udf(T.DOUBLE)` or `udf(T.DOUBLE)(fn)`.
+    Compiles to a TPU expression tree when possible, else falls back to
+    a CPU-evaluated opaque UDF (return_type then required)."""
+    if callable(return_type):  # bare @udf usage
+        return UserDefinedFunction(return_type, None)
+
+    def wrap(fn: Callable) -> UserDefinedFunction:
+        return UserDefinedFunction(fn, return_type)
+
+    return wrap
+
+
+def jax_udf(return_type: T.DataType):
+    """Decorator for columnar TPU UDFs: the function receives the
+    children's device data arrays (jax arrays, batch-capacity length)
+    and returns one; it is traced into the fused XLA program.  The
+    RapidsUDF.evaluateColumnar analog."""
+
+    def wrap(fn: Callable) -> UserDefinedFunction:
+        return UserDefinedFunction(fn, return_type, columnar=True)
+
+    return wrap

@@ -1,0 +1,86 @@
+"""UDF expression nodes.
+
+- JaxScalarUDF: the TPU-native UDF interface — the analog of the
+  reference's RapidsUDF (sql-plugin/src/main/java/com/nvidia/spark/
+  RapidsUDF.java:22-40 `evaluateColumnar(ColumnVector...)`): the user
+  supplies a columnar function over device arrays (jax.numpy / pallas)
+  that is traced INTO the surrounding fused XLA program — zero
+  per-batch Python cost after compile.
+
+- OpaquePythonUDF: an arbitrary Python scalar function.  Not TPU
+  replaceable; the planner's tagging walk leaves it on the CPU engine,
+  which evaluates it row-wise in-process — the analog of the
+  reference's Python-worker fallback path (2.15: python/ worker
+  pieces), minus the process boundary a JVM needs and Python doesn't.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+
+
+@dataclasses.dataclass(repr=False)
+class JaxScalarUDF(Expression):
+    """User columnar function over the children's device data arrays.
+
+    NULL semantics: result row is NULL iff any input row is NULL (the
+    common deterministic-UDF contract); the function sees raw data
+    arrays (garbage in NULL slots, like any expression eval)."""
+
+    fn: Callable
+    _dtype: T.DataType
+    args: tuple[Expression, ...]
+    fn_name: str = "jax_udf"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def name(self) -> str:
+        return self.fn_name
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cols = [a.eval(ctx) for a in self.args]
+        data = self.fn(*[c.data for c in cols])
+        data = jnp.asarray(data)
+        if data.shape != (ctx.batch.capacity,):
+            raise ValueError(
+                f"jax UDF {self.fn_name!r} returned shape {data.shape}, "
+                f"expected ({ctx.batch.capacity},)")
+        valid = ctx.row_mask
+        for c in cols:
+            valid = valid & c.validity
+        return Column(data.astype(T.to_numpy_dtype(self._dtype)), valid,
+                      self._dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class OpaquePythonUDF(Expression):
+    """Uncompiled Python scalar function; CPU-engine only (the tagging
+    walk reports it as not replaceable, ref: GpuOverrides' unsupported-
+    expression fallback)."""
+
+    fn: Callable
+    _dtype: T.DataType
+    args: tuple[Expression, ...]
+    fn_name: str = "python_udf"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def name(self) -> str:
+        return self.fn_name
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:  # pragma: no cover
+        raise NotImplementedError(
+            "OpaquePythonUDF runs on the CPU engine only")
