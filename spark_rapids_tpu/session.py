@@ -208,7 +208,11 @@ class TpuSession:
     (ref: SQLPlugin.scala — here session == plugin)."""
 
     def __init__(self, conf: Optional[TpuConf] = None):
+        from spark_rapids_tpu.tools.profiling import QueryHistory
+
         self.conf = conf or get_conf()
+        #: recent TPU-collected queries, input to the profiling tool
+        self.history = QueryHistory()
 
     # -- sources -------------------------------------------------------- #
 
@@ -528,8 +532,14 @@ class DataFrame:
             from spark_rapids_tpu.cpu.engine import execute_cpu
 
             return execute_cpu(self._plan)
-        exec_, _meta = plan_query(self._plan, conf)
-        return collect_exec(exec_)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        exec_, meta = plan_query(self._plan, conf)
+        out = collect_exec(exec_)
+        self._session.history.record(
+            meta.explain(), exec_, _time.perf_counter() - t0)
+        return out
 
     def explain(self) -> str:
         _, meta = plan_query(self._plan, self._session.conf)

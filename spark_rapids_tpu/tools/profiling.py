@@ -1,0 +1,168 @@
+"""Profiling tool: per-query operator reports and device traces.
+
+TPU analog of the reference's profiling tool (tools/src/main/scala/...
+/tool/profiling/ProfileMain.scala — ApplicationInfo/Analysis over event
+logs).  This engine is in-process, so the "event log" is the session's
+query history: every TPU collect records its exec tree, whose metrics
+(device-synced ns timers, row/batch counts, spill and prune counters)
+the report aggregates.
+
+For timeline-level work there is `device_trace(dir)`: a context manager
+around jax.profiler.trace producing a Perfetto/XPlane trace (the
+nvtx_profiling.md workflow analog, ref: SURVEY §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Iterator, Optional
+
+from spark_rapids_tpu.execs.base import TpuExec
+
+
+@dataclasses.dataclass
+class NodeSnapshot:
+    """One operator's description + settled metric values.  History
+    stores snapshots, NOT live exec trees — a live tree would pin the
+    query's input data (e.g. ArrowSourceExec.table) for the session
+    lifetime."""
+
+    desc: str
+    metrics: dict
+    children: list
+
+
+@dataclasses.dataclass
+class QueryEvent:
+    """One collected query (the ApplicationInfo analog)."""
+
+    query_id: int
+    explain: str
+    root: NodeSnapshot
+    wall_s: float
+    ts: float
+
+
+def snapshot_exec(node: TpuExec) -> NodeSnapshot:
+    from spark_rapids_tpu.execs.base import _MetricReaper
+
+    _MetricReaper.get().flush()  # settle device-synced timers
+    return _snap(node)
+
+
+def _snap(node: TpuExec) -> NodeSnapshot:
+    return NodeSnapshot(
+        node.node_desc(),
+        {name: m.value for name, m in node.metrics.items()},
+        [_snap(c) for c in node.children])
+
+
+class QueryHistory:
+    """Session-attached ring of recent QueryEvents."""
+
+    def __init__(self, capacity: int = 100):
+        self.capacity = capacity
+        self._events: list[QueryEvent] = []
+        self._next_id = 0
+
+    def record(self, explain: str, exec_tree: TpuExec,
+               wall_s: float) -> QueryEvent:
+        ev = QueryEvent(self._next_id, explain, snapshot_exec(exec_tree),
+                        wall_s, time.time())
+        self._next_id += 1
+        self._events.append(ev)
+        if len(self._events) > self.capacity:
+            self._events.pop(0)
+        return ev
+
+    @property
+    def events(self) -> list[QueryEvent]:
+        return list(self._events)
+
+
+def _walk_snap(s: NodeSnapshot):
+    yield s
+    for c in s.children:
+        yield from _walk_snap(c)
+
+
+def profile_query(ev: QueryEvent) -> str:
+    """Per-operator metrics table for one query (the Analysis /
+    ClassWarehouse per-SQL metrics view)."""
+    lines = [
+        f"== Query {ev.query_id} ({ev.wall_s:.3f}s wall) ==",
+        "",
+        "| operator | rows | batches | time_ms | other metrics |",
+        "|---|---|---|---|---|",
+    ]
+    for n in _walk_snap(ev.root):
+        m = dict(n.metrics)
+        rows = m.pop("numOutputRows", "")
+        batches = m.pop("numOutputBatches", "")
+        t = m.pop("totalTime", None)
+        others = [f"{k}={v}" for k, v in sorted(m.items()) if v]
+        t_ms = f"{t / 1e6:.2f}" if t is not None else ""
+        lines.append(
+            f"| {n.desc[:60]} | {rows} | {batches} | {t_ms} "
+            f"| {' '.join(others)} |")
+    return "\n".join(lines) + "\n"
+
+
+def profile_report(history: QueryHistory) -> str:
+    """Whole-session report: store/spill health plus per-query operator
+    tables (ProfileMain's aggregate + per-app sections)."""
+    from spark_rapids_tpu.memory import get_store
+
+    store = get_store()
+    lines = [
+        "# Profile report",
+        "",
+        f"queries: {len(history.events)}",
+        "",
+        "## Memory / spill health (HealthCheck analog)",
+        "",
+        f"- device bytes in store: {store.device_used}",
+        f"- host bytes in store: {store.host_used}",
+        f"- spilled device->host: {store.spilled_device_to_host}",
+        f"- spilled host->disk: {store.spilled_host_to_disk}",
+        "",
+        "## Queries",
+        "",
+    ]
+    for ev in history.events:
+        lines.append(profile_query(ev))
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str) -> Iterator[None]:
+    """Capture an XLA device trace viewable in Perfetto/TensorBoard
+    (jax.profiler.trace), the nsys/NVTX workflow analog."""
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def generate_dot(ev: QueryEvent) -> str:
+    """SQL-plan DOT graph (GenerateDot.scala analog)."""
+    lines = ["digraph plan {", "  node [shape=box fontname=monospace];"]
+    ids: dict[int, int] = {}
+
+    def nid(n) -> int:
+        if id(n) not in ids:
+            ids[id(n)] = len(ids)
+        return ids[id(n)]
+
+    for n in _walk_snap(ev.root):
+        rows = n.metrics.get("numOutputRows")
+        label = n.desc.replace("\\", "\\\\").replace('"', "'")[:80]
+        if rows:
+            label += f"\\nrows={rows}"
+        lines.append(f'  n{nid(n)} [label="{label}"];')
+        for c in n.children:
+            lines.append(f"  n{nid(c)} -> n{nid(n)};")
+    lines.append("}")
+    return "\n".join(lines)

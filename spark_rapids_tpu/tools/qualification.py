@@ -1,0 +1,96 @@
+"""Qualification tool: score queries for TPU-acceleration fit.
+
+TPU analog of the reference's qualification tool (tools/src/main/scala/
+.../tool/qualification/QualificationMain.scala — scores CPU event logs
+for GPU fit without needing a GPU).  Here the input is a DataFrame (or
+several): the tool runs ONLY the planner's tagging walk — no execution,
+no device — and reports which operators would run on TPU, which fall
+back and why, and an eligible-fraction score.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class QualReport:
+    total_ops: int
+    tpu_ops: int
+    fallback_ops: int
+    reasons: dict[str, int]          # reason -> occurrence count
+    explain: str
+
+    @property
+    def eligible_fraction(self) -> float:
+        return self.tpu_ops / self.total_ops if self.total_ops else 0.0
+
+    @property
+    def recommendation(self) -> str:
+        f = self.eligible_fraction
+        if f >= 0.75:
+            return "strongly recommended"
+        if f >= 0.5:
+            return "recommended"
+        if f > 0.0:
+            return "partial"
+        return "not recommended"
+
+
+def qualify(df, conf=None) -> QualReport:
+    """Tag one DataFrame's plan and score it (plan-only, no execution)."""
+    from spark_rapids_tpu.plan.planner import PlanMeta
+
+    if conf is None:
+        conf = getattr(getattr(df, "_session", None), "conf", None)
+    if conf is None:
+        from spark_rapids_tpu.config import get_conf
+
+        conf = get_conf()
+    meta = PlanMeta(df._plan, conf)
+    meta.tag()
+    total = tpu = fb = 0
+    reasons: dict[str, int] = {}
+
+    def walk(m):
+        nonlocal total, tpu, fb
+        total += 1
+        if m.can_replace:
+            tpu += 1
+        else:
+            fb += 1
+            for r in m.reasons:
+                reasons[r] = reasons.get(r, 0) + 1
+        for c in m.children:
+            walk(c)
+
+    walk(meta)
+    return QualReport(total, tpu, fb, reasons, meta.explain())
+
+
+def qualification_report(dfs: Sequence, names: Optional[Sequence[str]]
+                         = None) -> str:
+    """Multi-query report (the per-application qualification summary)."""
+    names = list(names or [f"query-{i}" for i in range(len(dfs))])
+    reports = [qualify(df) for df in dfs]
+    lines = [
+        "# Qualification report",
+        "",
+        "| query | operators | on TPU | fallback | eligible | "
+        "recommendation |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, r in zip(names, reports):
+        lines.append(
+            f"| {name} | {r.total_ops} | {r.tpu_ops} | {r.fallback_ops} "
+            f"| {r.eligible_fraction:.0%} | {r.recommendation} |")
+    all_reasons: dict[str, int] = {}
+    for r in reports:
+        for k, v in r.reasons.items():
+            all_reasons[k] = all_reasons.get(k, 0) + v
+    if all_reasons:
+        lines += ["", "## Fallback reasons", ""]
+        for k, v in sorted(all_reasons.items(), key=lambda kv: -kv[1]):
+            lines.append(f"- {v}x {k}")
+    return "\n".join(lines) + "\n"
