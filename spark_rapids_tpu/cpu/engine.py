@@ -371,6 +371,35 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
             p = pc.fill_null(cpu_eval(cond, table), False)
             out = pc.if_else(p, cpu_eval(val, table).cast(at), out)
         return out
+    from spark_rapids_tpu.exprs import nondeterministic as ND
+
+    if isinstance(e, ND.SparkPartitionID):
+        # the CPU engine is a single partition
+        return pa.array(np.zeros(n, np.int32))
+    if isinstance(e, ND.MonotonicallyIncreasingID):
+        return pa.array(np.arange(n, dtype=np.int64))
+    if isinstance(e, ND.Rand):
+        import jax
+
+        from spark_rapids_tpu.exprs.nondeterministic import _rand_uniform
+
+        with jax.default_device(jax.devices("cpu")[0]):
+            vals = np.asarray(_rand_uniform(
+                e.seed, 0, np.arange(n, dtype=np.int64)))
+        return pa.array(vals)
+    if isinstance(e, M.NaNvl):
+        at = T.to_arrow_type(e.dtype)
+        a = cpu_eval(e.left, table).cast(at)
+        b = cpu_eval(e.right, table).cast(at)
+        take_b = pc.fill_null(pc.is_nan(a), False)
+        return pc.if_else(take_b, b, a)
+    if isinstance(e, M.NormalizeNaNAndZero):
+        a = cpu_eval(e.child, table)
+        v, ok = _np_vals(a, a.type)
+        v = np.where(np.isnan(v), np.nan, v) + 0.0
+        return _from_np(v, ok, a.type)
+    if isinstance(e, M.KnownFloatingPointNormalized):
+        return cpu_eval(e.child, table)
     if isinstance(e, P.AtLeastNNonNulls):
         count = np.zeros(n, np.int32)
         for x in e.exprs:

@@ -21,6 +21,7 @@ import time
 from typing import Callable, Iterator, Optional
 
 import jax
+import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -302,6 +303,17 @@ class FusableExec(TpuExec):
         per exec instance)."""
         return None
 
+    def fusion_exprs(self):
+        """The expression trees this exec evaluates per batch; used to
+        detect PartitionAware expressions needing partition context."""
+        return ()
+
+    #: True for execs whose output row count differs from their input's
+    #: (Expand/Generate): a PartitionAware exec above one must not fuse
+    #: across it — the shared row_offset would advance by INPUT rows
+    #: while ids were assigned per OUTPUT row
+    MULTIPLIES_ROWS = False
+
     @property
     def num_partitions(self) -> int:
         return self.children[0].num_partitions
@@ -310,18 +322,41 @@ class FusableExec(TpuExec):
         cached = getattr(self, "_fused", None)
         if cached is not None:
             return cached
-        # walk down through fusable children, composing their batch fns
+        from spark_rapids_tpu.exprs.nondeterministic import (
+            tree_is_partition_aware,
+        )
+
+        def is_aware(x: "FusableExec") -> bool:
+            return any(tree_is_partition_aware(e)
+                       for e in x.fusion_exprs())
+
+        # walk down through fusable children, composing their batch fns;
+        # stop before a row-multiplying exec if anything above it needs
+        # partition context (its row_offset counts THIS chain's input)
         execs: list[FusableExec] = [self]
         node: TpuExec = self.children[0]
+        aware = is_aware(self)
         while isinstance(node, FusableExec):
+            if aware and node.MULTIPLIES_ROWS:
+                break
             execs.append(node)  # type: ignore[arg-type]
+            aware = aware or is_aware(node)
             node = node.children[0]
         fns: list[BatchFn] = [e.make_batch_fn() for e in reversed(execs)]
+        if aware:
+            from spark_rapids_tpu.exprs.base import partition_info
 
-        def pipeline(batch: ColumnarBatch) -> ColumnarBatch:
-            for f in fns:
-                batch = f(batch)
-            return batch
+            def pipeline(batch: ColumnarBatch, pidx,
+                         off) -> ColumnarBatch:
+                with partition_info(pidx, off):
+                    for f in fns:
+                        batch = f(batch)
+                return batch
+        else:
+            def pipeline(batch: ColumnarBatch) -> ColumnarBatch:  # type: ignore[misc]
+                for f in fns:
+                    batch = f(batch)
+                return batch
 
         keys = [e.fuse_key() for e in execs]
         if all(k is not None for k in keys):
@@ -330,14 +365,24 @@ class FusableExec(TpuExec):
             jitted = cached_jit(("fused", tuple(keys)), lambda: pipeline)
         else:
             jitted = jax.jit(pipeline)
-        self._fused = (jitted, node)
+        self._fused = (jitted, node, aware)
         return self._fused
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        fused, node = self._fused_pipeline()
+        fused, node, aware = self._fused_pipeline()
+        if aware:
+            pidx = jnp.asarray(p, jnp.int32)
+            off = jnp.asarray(0, jnp.int64)
         for batch in node.execute_partition(p):
+            b = batch.with_device_num_rows()
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:
-                out = t.observe(fused(batch.with_device_num_rows()))
+                if aware:
+                    out = t.observe(fused(b, pidx, off))
+                    # row_offset advances by the INPUT batch's live rows
+                    # (lazy device add; no sync)
+                    off = off + jnp.asarray(b.num_rows, jnp.int64)
+                else:
+                    out = t.observe(fused(b))
             yield self._count_output(out)
 
     def execute(self) -> Iterator[ColumnarBatch]:

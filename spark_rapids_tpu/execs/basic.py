@@ -90,6 +90,9 @@ class TpuProjectExec(FusableExec):
 
         return ("project", exprs_key(self.exprs), repr(self._schema))
 
+    def fusion_exprs(self):
+        return tuple(self.exprs)
+
 
 class TpuFilterExec(FusableExec):
     """Eval predicate -> compact (ref: basicPhysicalOperators.scala:184,230).
@@ -122,6 +125,9 @@ class TpuFilterExec(FusableExec):
         from spark_rapids_tpu.execs.jit_cache import expr_key
 
         return ("filter", expr_key(self.condition))
+
+    def fusion_exprs(self):
+        return (self.condition,)
 
 
 class TpuRangeExec(TpuExec):

@@ -22,6 +22,8 @@ from spark_rapids_tpu.exprs.base import EvalContext, Expression
 
 
 class TpuExpandExec(FusableExec):
+    MULTIPLIES_ROWS = True
+
     def __init__(self, projections: Sequence[Sequence[Expression]],
                  schema: T.Schema, child: TpuExec):
         super().__init__(child)
@@ -40,6 +42,9 @@ class TpuExpandExec(FusableExec):
 
         return ("expand", tuple(exprs_key(p) for p in self.projections),
                 repr(self._schema))
+
+    def fusion_exprs(self):
+        return tuple(e for p in self.projections for e in p)
 
     def make_batch_fn(self) -> BatchFn:
         projections = self.projections

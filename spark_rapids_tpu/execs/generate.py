@@ -20,6 +20,8 @@ from spark_rapids_tpu.exprs.base import EvalContext
 
 
 class TpuGenerateExec(FusableExec):
+    MULTIPLIES_ROWS = True
+
     def __init__(self, generator, schema: T.Schema, child: TpuExec):
         super().__init__(child)
         self.generator = generator
@@ -38,6 +40,9 @@ class TpuGenerateExec(FusableExec):
         return ("generate", expr_key(self.generator.child),
                 self.generator.pos, self.generator.outer,
                 repr(self._schema))
+
+    def fusion_exprs(self):
+        return (self.generator.child,)
 
     def make_batch_fn(self) -> BatchFn:
         gen = self.generator

@@ -15,7 +15,9 @@ travel in the validity array.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Any, Optional, Sequence
 
 import jax
@@ -30,14 +32,40 @@ from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
 @dataclasses.dataclass
 class EvalContext:
     """Evaluation context handed down an expression tree: the input batch
-    plus its live-row mask (rows past num_rows must stay NULL)."""
+    plus its live-row mask (rows past num_rows must stay NULL).
+
+    `partition_index` / `row_offset` serve PartitionAware expressions
+    (Rand, MonotonicallyIncreasingID, ...); they are device scalars when
+    the fused pipeline threads them in (so programs stay shared across
+    partitions) and plain 0 everywhere partition context is
+    meaningless (sort keys, join keys, aggregates — where Spark forbids
+    nondeterministic expressions too)."""
 
     batch: ColumnarBatch
     row_mask: jax.Array
+    partition_index: object = 0  # int or jax i32 scalar
+    row_offset: object = 0  # int or jax i64 scalar
 
     @staticmethod
     def for_batch(batch: ColumnarBatch) -> "EvalContext":
-        return EvalContext(batch, batch.row_mask())
+        pi = getattr(_PINFO, "v", None) or (0, 0)
+        return EvalContext(batch, batch.row_mask(), pi[0], pi[1])
+
+
+_PINFO = threading.local()
+
+
+@contextlib.contextmanager
+def partition_info(partition_index, row_offset):
+    """Scope PartitionAware context for expression evaluation: the fused
+    pipeline sets TRACED device scalars here while tracing, so compiled
+    programs stay shared across partitions."""
+    prev = getattr(_PINFO, "v", None)
+    _PINFO.v = (partition_index, row_offset)
+    try:
+        yield
+    finally:
+        _PINFO.v = prev
 
 
 class Expression:

@@ -279,3 +279,60 @@ class Round(Expression):
 
 class BRound(Round):
     half_even = True
+
+
+@dataclasses.dataclass(repr=False)
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN, else a (ref: GpuNaNvl,
+    mathExpressions.scala)."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.left.dtype  # registered for float/double only
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        take_b = jnp.isnan(a.data.astype(jnp.float64)) & a.validity
+        phys = T.to_numpy_dtype(self.dtype)
+        return Column(
+            jnp.where(take_b, b.data.astype(phys), a.data.astype(phys)),
+            jnp.where(take_b, b.validity, a.validity), self.dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class NormalizeNaNAndZero(Expression):
+    """Canonicalize NaN bit patterns and -0.0 -> +0.0 so float GROUP BY
+    / join keys compare equal (ref: GpuNormalizeNaNAndZero,
+    normalizedExpressions GpuOverrides.scala)."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        d = c.data
+        d = jnp.where(jnp.isnan(d), jnp.asarray(float("nan"), d.dtype), d)
+        d = d + jnp.zeros((), d.dtype)  # -0.0 + 0.0 == +0.0
+        return Column(d, c.validity, self.dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class KnownFloatingPointNormalized(Expression):
+    """Analyzer marker: input is already normalized; identity
+    (ref: GpuKnownFloatingPointNormalized)."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        return self.child.eval(ctx)

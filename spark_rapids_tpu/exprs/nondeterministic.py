@@ -1,0 +1,120 @@
+"""Partition-context and nondeterministic expressions.
+
+TPU analogs of the reference's task-context expressions
+(ref: GpuOverrides.scala Rand/MonotonicallyIncreasingID/
+SparkPartitionID rules; sql/rapids/catalyst/expressions/
+GpuRandomExpressions.scala:34 GpuRand).
+
+Design: expressions carrying the `PartitionAware` marker read
+`partition_index` / `row_offset` from the EvalContext; the fused
+pipeline threads those in as DEVICE scalars (no per-partition
+recompile), and pipelines without such expressions keep today's
+single-argument signature — zero overhead for the common case.
+
+Rand uses counter-based hashing (threefry via jax.random.fold_in on
+the GLOBAL row index) instead of the reference's sequential
+XORShiftRandom: same statistical contract, but the value of row i is
+independent of batch boundaries — the right construction for an
+engine whose batch sizes are a tuning knob, and the reason the CPU
+oracle can mirror it bit-exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+
+
+class PartitionAware:
+    """Marker: eval() reads ctx.partition_index / ctx.row_offset."""
+
+
+def tree_is_partition_aware(e: Expression) -> bool:
+    if isinstance(e, PartitionAware):
+        return True
+    return any(tree_is_partition_aware(c) for c in e.children)
+
+
+@dataclasses.dataclass(repr=False)
+class SparkPartitionID(Expression, PartitionAware):
+    """spark_partition_id() (ref: GpuSparkPartitionID)."""
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.INT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cap = ctx.batch.capacity
+        pid = jnp.asarray(ctx.partition_index, jnp.int32)
+        return Column(jnp.broadcast_to(pid, (cap,)), ctx.row_mask, T.INT)
+
+
+@dataclasses.dataclass(repr=False)
+class MonotonicallyIncreasingID(Expression, PartitionAware):
+    """monotonically_increasing_id(): partition index in the upper 31
+    bits, per-partition row position in the lower 33
+    (ref: GpuMonotonicallyIncreasingID)."""
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cap = ctx.batch.capacity
+        pid = jnp.asarray(ctx.partition_index, jnp.int64)
+        off = jnp.asarray(ctx.row_offset, jnp.int64)
+        ids = (pid << 33) + off + jnp.arange(cap, dtype=jnp.int64)
+        return Column(ids, ctx.row_mask, T.LONG)
+
+
+def _rand_uniform(seed: int, partition, global_idx) -> jax.Array:
+    """Counter-based uniform doubles in [0,1): threefry keyed on
+    (seed, partition), hashed per global row index."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), partition)
+
+    def one(i):
+        return jax.random.uniform(
+            jax.random.fold_in(key, i.astype(jnp.uint32)),
+            dtype=jnp.float64)
+
+    return jax.vmap(one)(global_idx)
+
+
+@dataclasses.dataclass(repr=False)
+class Rand(Expression, PartitionAware):
+    """rand(seed) (ref: GpuRand, GpuRandomExpressions.scala:34).  Values
+    are deterministic per (seed, partition, global row index) and
+    independent of batch boundaries."""
+
+    seed: int = 0
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DOUBLE
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        cap = ctx.batch.capacity
+        idx = jnp.asarray(ctx.row_offset, jnp.int64) \
+            + jnp.arange(cap, dtype=jnp.int64)
+        vals = _rand_uniform(self.seed,
+                             jnp.asarray(ctx.partition_index, jnp.int32),
+                             idx)
+        return Column(vals, ctx.row_mask, T.DOUBLE)

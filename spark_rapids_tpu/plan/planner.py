@@ -90,7 +90,11 @@ for _sig, _classes in (
              M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh,
              M.Acosh, M.Atanh, M.Rint, M.Signum, M.ToDegrees,
              M.ToRadians, M.Log, M.Log10, M.Log2, M.Log1p, M.Logarithm,
-             M.Pow, M.Ceil, M.Floor, M.Round, M.BRound)),
+             M.Pow, M.Ceil, M.Floor, M.Round, M.BRound,
+             M.KnownFloatingPointNormalized)),
+    (TS.ExprSig(TS.TypeSig.of("float", "double") + TS.NULLSIG,
+                "NaN semantics need floating inputs"),
+     (M.NaNvl, M.NormalizeNaNAndZero)),
     (_BITS, (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
              BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned)),
     (_DT, (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
@@ -112,6 +116,14 @@ from spark_rapids_tpu.exprs import collections as COLL  # noqa: E402
 
 for _cls in (COLL.Size, COLL.GetArrayItem, COLL.ArrayContains):
     register_expr(_cls, TS.ExprSig(TS.ALL, "array input required"))
+
+# partition-context / nondeterministic expressions
+from spark_rapids_tpu.exprs import nondeterministic as ND  # noqa: E402
+
+register_expr(ND.SparkPartitionID, TS.ExprSig(TS.ALL, "no inputs"))
+register_expr(ND.MonotonicallyIncreasingID,
+              TS.ExprSig(TS.ALL, "no inputs"))
+register_expr(ND.Rand, TS.ExprSig(TS.ALL, "no inputs"))
 
 # columnar jax UDFs trace into the fused program like built-ins
 # (OpaquePythonUDF deliberately stays unregistered -> CPU fallback)
@@ -213,6 +225,20 @@ class PlanMeta:
     def will_not_work(self, reason: str) -> None:
         self.reasons.add(reason)
 
+    def _forbid_partition_aware(self, e, where: str) -> None:
+        """Partition-context expressions (Rand, MID, ...) only get their
+        context in the fused Project/Filter/Expand/Generate pipeline;
+        anywhere else they would silently evaluate with partition 0 /
+        offset 0 per batch, so route those plans to the CPU engine."""
+        from spark_rapids_tpu.exprs.nondeterministic import (
+            tree_is_partition_aware,
+        )
+
+        if tree_is_partition_aware(e):
+            self.will_not_work(
+                f"nondeterministic expression as {where} is only "
+                "supported in project/filter on TPU")
+
     def tag(self) -> None:
         conf = self.conf
         entry = _EXEC_CONFS.get(type(self.plan))
@@ -246,7 +272,10 @@ class PlanMeta:
         elif isinstance(p, L.Aggregate):
             for g in p.groups:
                 _check_expr(g, conf, self.reasons)
+                self._forbid_partition_aware(g, "grouping key")
             for na in p.aggs:
+                for e in na.fn.inputs():
+                    self._forbid_partition_aware(e, "aggregate input")
                 if not isinstance(na.fn, SUPPORTED_AGGS):
                     self.will_not_work(
                         f"aggregate {na.fn.name} is not supported on TPU")
@@ -257,10 +286,12 @@ class PlanMeta:
         elif isinstance(p, L.Sort):
             for k in p.keys:
                 _check_expr(k.expr, conf, self.reasons)
+                self._forbid_partition_aware(k.expr, "sort key")
         elif isinstance(p, L.Window):
             for we, _name in p.window_exprs:
                 for e in we.children:
                     _check_expr(e, conf, self.reasons)
+                    self._forbid_partition_aware(e, "window input")
                 try:
                     we.check_supported()
                 except TypeError as exc:
@@ -268,6 +299,7 @@ class PlanMeta:
         elif isinstance(p, L.Join):
             for e in list(p.left_keys) + list(p.right_keys):
                 _check_expr(e, conf, self.reasons)
+                self._forbid_partition_aware(e, "join key")
             if p.condition is not None:
                 if p.join_type != "inner":
                     self.will_not_work(

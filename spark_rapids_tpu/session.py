@@ -82,6 +82,32 @@ def last(e: ExprLike, ignore_nulls: bool = False) -> Last:
     return Last(_expr(e), ignore_nulls)
 
 
+def rand(seed: int = 0):
+    from spark_rapids_tpu.exprs.nondeterministic import Rand
+
+    return Rand(seed)
+
+
+def monotonically_increasing_id():
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        MonotonicallyIncreasingID,
+    )
+
+    return MonotonicallyIncreasingID()
+
+
+def spark_partition_id():
+    from spark_rapids_tpu.exprs.nondeterministic import SparkPartitionID
+
+    return SparkPartitionID()
+
+
+def nanvl(a: ExprLike, b: ExprLike):
+    from spark_rapids_tpu.exprs.math import NaNvl
+
+    return NaNvl(_expr(a), _expr(b))
+
+
 def replace_(e: ExprLike, search: str, replacement: str):
     from spark_rapids_tpu.exprs.strings import StringReplace
 

@@ -77,6 +77,10 @@ _RENAMES = {
     "InSet": ("spark_rapids_tpu.exprs.predicates", "In"),
     "CountDistinct": ("spark_rapids_tpu.exprs.aggregates",
                       "CountDistinct"),
+    "UnixTimestamp": ("spark_rapids_tpu.exprs.datetime",
+                      "UnixTimestampFromTs"),
+    "ToUnixTimestamp": ("spark_rapids_tpu.exprs.datetime",
+                        "UnixTimestampFromTs"),
 }
 
 

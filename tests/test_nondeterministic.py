@@ -1,0 +1,148 @@
+"""Partition-context expressions (Rand, MonotonicallyIncreasingID,
+SparkPartitionID) + NaN normalization family
+(ref: GpuRandomExpressions.scala, GpuOverrides normalized-expr rules)."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.exprs.base import lit
+from spark_rapids_tpu.session import (
+    TpuSession,
+    col,
+    monotonically_increasing_id,
+    nanvl,
+    rand,
+    spark_partition_id,
+)
+from tests.differential import assert_tpu_cpu_equal, gen_table
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def test_mid_single_partition_matches_cpu(session):
+    t = gen_table({"a": "int64"}, 400, seed=1, null_prob=0.0)
+    q = session.create_dataframe(t).select(
+        col("a"), monotonically_increasing_id().alias("id"))
+    assert "!" not in q.explain()
+    assert_tpu_cpu_equal(q, ignore_order=False)
+    got = q.collect().to_pydict()["id"]
+    assert got == list(range(400))
+
+
+def test_mid_multi_partition_structure(session, tmp_path):
+    # two scan partitions -> ids carry the partition in the high bits
+    session.conf.set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1)
+    for i in range(2):
+        pq.write_table(pa.table({"x": pa.array(np.arange(100) + 100 * i)}),
+                       str(tmp_path / f"f{i}.parquet"))
+    df = session.read_parquet(str(tmp_path / "f0.parquet"),
+                              str(tmp_path / "f1.parquet")) \
+        .select(col("x"), monotonically_increasing_id().alias("id"),
+                spark_partition_id().alias("p"))
+    got = df.collect().to_pydict()
+    by_part: dict = {}
+    for x, i, p in zip(got["x"], got["id"], got["p"]):
+        by_part.setdefault(p, []).append(i)
+    assert sorted(by_part) == [0, 1]
+    for p, ids in by_part.items():
+        assert ids == [(p << 33) + k for k in range(len(ids))]
+
+
+def test_mid_offset_advances_across_batches(session, tmp_path):
+    # ONE scan task emitting many batches: the row offset must advance
+    # within the partition, keeping ids continuous
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"x": pa.array(np.arange(1000))}), p,
+                   row_group_size=100)
+    session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 128)
+    q = session.read_parquet(p).select(
+        monotonically_increasing_id().alias("id"))
+    got = q.collect().to_pydict()["id"]
+    assert got == list(range(1000))  # continuous across ~8 batches
+
+
+def test_rand_deterministic_and_batch_invariant(session, tmp_path):
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"x": pa.array(np.arange(600))}), p,
+                   row_group_size=100)
+    q = session.read_parquet(p).select(rand(42).alias("r"))
+    a = q.collect().to_pydict()["r"]
+    b = q.collect().to_pydict()["r"]
+    assert a == b  # deterministic per (seed, partition, row)
+    assert all(0.0 <= v < 1.0 for v in a)
+    assert len(set(a)) > 590  # actually random-looking
+    # batch-size invariance: same task, different batch boundaries
+    session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 128)
+    c = session.read_parquet(p).select(
+        rand(42).alias("r")).collect().to_pydict()["r"]
+    assert c == a
+    # and the CPU oracle mirrors it exactly (single partition)
+    assert q.collect(engine="cpu").to_pydict()["r"] == a
+
+
+def test_rand_seed_changes_stream(session):
+    t = gen_table({"a": "int64"}, 100, seed=4, null_prob=0.0)
+    df = session.create_dataframe(t)
+    a = df.select(rand(1).alias("r")).collect().to_pydict()["r"]
+    b = df.select(rand(2).alias("r")).collect().to_pydict()["r"]
+    assert a != b
+
+
+def test_order_by_rand_falls_back(session):
+    """ORDER BY rand(): sort keys get no partition context on TPU, so
+    the plan must route to the CPU engine instead of being silently
+    wrong (repeating streams per batch)."""
+    t = gen_table({"a": "int64"}, 50, seed=5, null_prob=0.0)
+    q = session.create_dataframe(t).order_by(rand(7))
+    assert "nondeterministic expression" in q.explain()
+    out = q.collect()  # still executes, via fallback
+    assert out.num_rows == 50
+
+
+def test_mid_unique_above_explode(session):
+    """MID above a row-multiplying Generate: ids must stay unique across
+    batches (fusion is cut so offsets count post-explode rows)."""
+    from spark_rapids_tpu.session import explode
+
+    session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 64)
+    t = pa.table({"l": pa.array([[1, 2, 3]] * 200, pa.list_(pa.int64()))})
+    q = session.create_dataframe(t) \
+        .select(explode(col("l")).alias("e")) \
+        .select(col("e"), monotonically_increasing_id().alias("id"))
+    got = q.collect().to_pydict()["id"]
+    assert len(got) == 600
+    assert len(set(got)) == 600, "duplicate ids above explode"
+
+
+def test_nanvl(session):
+    t = pa.table({"a": pa.array([1.0, float("nan"), None, 4.0]),
+                  "b": pa.array([9.0, 8.0, 7.0, None])})
+    q = session.create_dataframe(t).select(
+        nanvl(col("a"), col("b")).alias("v"))
+    got = q.collect().to_pydict()["v"]
+    assert got == [1.0, 8.0, None, 4.0]
+    assert_tpu_cpu_equal(q)
+
+
+def test_normalize_nan_and_zero_group_keys(session):
+    from spark_rapids_tpu.exprs.math import NormalizeNaNAndZero
+    from spark_rapids_tpu.session import sum_
+
+    t = pa.table({"k": pa.array([0.0, -0.0, float("nan"), float("nan")]),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+    q = session.create_dataframe(t).select(
+        NormalizeNaNAndZero(col("k")).alias("k"), col("v")) \
+        .group_by(col("k")).agg((sum_(col("v")), "s"))
+    got = q.collect()
+    assert got.num_rows == 2  # +-0 merged, NaNs merged
+    vals = dict()
+    for k, s in zip(got.to_pydict()["k"], got.to_pydict()["s"]):
+        vals["nan" if math.isnan(k) else k] = s
+    assert vals == {0.0: 3.0, "nan": 7.0}
