@@ -33,8 +33,10 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
 from spark_rapids_tpu.exprs.base import EvalContext, Expression
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# plain ints (weak-typed: uint32 math stays uint32) so kernels that
+# import the mix functions don't capture device constants
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
 
 DEFAULT_SEED = 42
 
@@ -103,9 +105,23 @@ def hash_string_bytes(chars: jax.Array, lengths: jax.Array,
 
     Aligned blocks are little-endian ints; tail bytes are processed one at
     a time *sign-extended* (Platform.getByte is a signed read).
+
+    On a TPU backend this routes to the Pallas kernel
+    (ops/pallas_kernels.py) — bit-identical, but walks the byte matrix
+    once per VMEM-resident row block instead of ~1.25*W masked
+    full-width passes.
     """
     n, width = chars.shape
-    h1 = jnp.broadcast_to(_u32(seed), (n,))
+    seeds = jnp.broadcast_to(_u32(seed), (n,))
+    from spark_rapids_tpu.ops.pallas_kernels import (
+        maybe_pallas_hash_string,
+    )
+
+    fast = maybe_pallas_hash_string(chars, lengths.astype(jnp.int32),
+                                    seeds)
+    if fast is not None:
+        return fast
+    h1 = seeds
     lengths = lengths.astype(jnp.int32)
     aligned = lengths - (lengths % 4)
     c32 = chars.astype(jnp.uint32)

@@ -4,3 +4,7 @@ This package plays the role cudf's C++ kernels play for the reference
 (L0 in SURVEY.md): dense, fixed-shape primitives the operator library
 calls into.  Here they are jax.numpy/XLA programs (Pallas where it pays).
 """
+
+# eager conf registration: the pallas.enabled entry must exist before
+# any TpuConf snapshot (env-var overrides, conf.set conversion, docs)
+from spark_rapids_tpu.ops import pallas_kernels  # noqa: E402,F401
