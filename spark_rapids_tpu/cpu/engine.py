@@ -371,6 +371,45 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
             p = pc.fill_null(cpu_eval(cond, table), False)
             out = pc.if_else(p, cpu_eval(val, table).cast(at), out)
         return out
+    from spark_rapids_tpu.exprs.subquery import ScalarSubquery
+
+    if isinstance(e, ScalarSubquery):
+        sub = execute_cpu(e.plan)
+        if sub.num_rows != 1 or sub.num_columns != 1:
+            raise ValueError(
+                f"scalar subquery must return 1x1, got "
+                f"{sub.num_rows}x{sub.num_columns}")
+        v = sub.column(0)[0].as_py()
+        return pa.array([v] * n, T.to_arrow_type(e.dtype))
+    if isinstance(e, COLL.CreateArray):
+        arrs = [cpu_eval(x, table) for x in e.exprs]
+        et = T.to_arrow_type(e.dtype.element)
+        rows = list(zip(*[a.cast(et).to_pylist() for a in arrs]))
+        return pa.array([list(r) for r in rows], pa.list_(et))
+    if isinstance(e, (DT.FromUnixTime, DT.DateFormatClass)):
+        import datetime as _dt
+
+        c = cpu_eval(e.child, table)
+        py_fmt = e.fmt.replace("yyyy", "%Y").replace(
+            "MM", "%m").replace("dd", "%d").replace(
+            "HH", "%H").replace("mm", "%M").replace("ss", "%S")
+        out = []
+        for v in c.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            if isinstance(e, DT.FromUnixTime):
+                t = _dt.datetime.fromtimestamp(int(v), _dt.timezone.utc)
+            elif isinstance(v, _dt.datetime):
+                t = v
+            elif isinstance(v, _dt.date):
+                t = _dt.datetime(v.year, v.month, v.day,
+                                 tzinfo=_dt.timezone.utc)
+            else:
+                t = _dt.datetime.fromtimestamp(int(v) / 1e6,
+                                               _dt.timezone.utc)
+            out.append(t.strftime(py_fmt))
+        return pa.array(out, pa.string())
     from spark_rapids_tpu.exprs import nondeterministic as ND
 
     if isinstance(e, ND.SparkPartitionID):

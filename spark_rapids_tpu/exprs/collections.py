@@ -156,3 +156,49 @@ class Explode(Expression):
     def eval(self, ctx: EvalContext):
         raise TypeError("explode must appear at the top level of a "
                         "select list")
+
+
+@dataclasses.dataclass(repr=False)
+class CreateArray(Expression):
+    """array(e1, e2, ...) — fixed-length list per row from N element
+    expressions (ref: GpuCreateArray, complexTypeCreator.scala).  The
+    dense matrix is just a stack: max_len == N for every row."""
+
+    exprs: tuple[Expression, ...]
+
+    def __init__(self, *exprs: Expression):
+        if not exprs:
+            raise TypeError("array() needs at least one element "
+                            "(empty arrays are not supported)")
+        self.exprs = tuple(exprs)
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    @property
+    def dtype(self) -> T.DataType:
+        from spark_rapids_tpu.exprs.arithmetic import _widen
+
+        return T.ListType(_widen([e.dtype for e in self.exprs]))
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def check_supported(self) -> None:
+        for e in self.exprs:
+            if isinstance(e.dtype, (T.StringType, T.ListType)):
+                raise TypeError(
+                    "array() of string/nested elements is not supported")
+
+    def eval(self, ctx: EvalContext) -> ListColumn:
+        elem_t = self.dtype.element
+        phys = T.to_numpy_dtype(elem_t)
+        cols = [e.eval(ctx) for e in self.exprs]
+        values = jnp.stack([c.data.astype(phys) for c in cols], axis=1)
+        evalid = jnp.stack([c.validity for c in cols], axis=1)
+        n = len(cols)
+        cap = ctx.batch.capacity
+        return ListColumn(values,
+                          jnp.full((cap,), n, jnp.int32),
+                          evalid, ctx.row_mask, T.ListType(elem_t))

@@ -239,3 +239,114 @@ class UnixTimestampFromTs(Expression):
         c = self.child.eval(ctx)
         us = c.data.astype(jnp.int64)
         return Column(us // US_PER_SECOND, c.validity, T.LONG)
+
+
+_SUPPORTED_FORMATS = ("yyyy-MM-dd HH:mm:ss", "yyyy-MM-dd")
+
+
+def _format_chars(days, sec_of_day, fmt: str, cap: int):
+    """Device-side date formatting: civil fields -> a uint8 char matrix
+    (one fixed-width program per supported format — the GpuOverrides
+    regexp-style policy: refuse exotic formats at tagging instead of
+    producing wrong output)."""
+    from spark_rapids_tpu.columnar.column import pad_width
+
+    y, m, d = civil_from_days(days)
+    fields = {
+        "yyyy": (y, 4), "MM": (m, 2), "dd": (d, 2),
+        "HH": (sec_of_day // 3600, 2),
+        "mm": ((sec_of_day // 60) % 60, 2),
+        "ss": (sec_of_day % 60, 2),
+    }
+    out_len = len(fmt)
+    width = pad_width(out_len)
+    chars = jnp.zeros((cap, width), jnp.uint8)
+    i = 0
+    pos = 0
+    while i < len(fmt):
+        for token, (val, nd) in fields.items():
+            if fmt.startswith(token, i):
+                v = val.astype(jnp.int64)
+                for k in range(nd):
+                    digit = (v // (10 ** (nd - 1 - k))) % 10
+                    chars = chars.at[:, pos + k].set(
+                        (digit + ord("0")).astype(jnp.uint8))
+                i += len(token)
+                pos += nd
+                break
+        else:
+            chars = chars.at[:, pos].set(jnp.uint8(ord(fmt[i])))
+            i += 1
+            pos += 1
+    return chars, out_len
+
+
+@dataclasses.dataclass(repr=False)
+class FromUnixTime(Expression):
+    """from_unixtime(seconds, fmt) -> formatted UTC string
+    (ref: GpuFromUnixTime, datetimeExpressions.scala)."""
+
+    child: Expression
+    fmt: str = "yyyy-MM-dd HH:mm:ss"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def check_supported(self) -> None:
+        if self.fmt not in _SUPPORTED_FORMATS:
+            raise TypeError(
+                f"from_unixtime format {self.fmt!r} not supported "
+                f"(supported: {', '.join(_SUPPORTED_FORMATS)})")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.columnar.column import StringColumn
+
+        c = self.child.eval(ctx)
+        secs = c.data.astype(jnp.int64)
+        days = secs // 86400  # jnp // floors, negatives included
+        sod = secs - days * 86400
+        chars, out_len = _format_chars(days, sod, self.fmt,
+                                       ctx.batch.capacity)
+        return StringColumn(
+            chars, jnp.full((ctx.batch.capacity,), out_len, jnp.int32),
+            c.validity & ctx.row_mask)
+
+
+@dataclasses.dataclass(repr=False)
+class DateFormatClass(Expression):
+    """date_format(ts, fmt) -> formatted UTC string
+    (ref: GpuDateFormatClass)."""
+
+    child: Expression
+    fmt: str = "yyyy-MM-dd"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    def check_supported(self) -> None:
+        if self.fmt not in _SUPPORTED_FORMATS:
+            raise TypeError(
+                f"date_format format {self.fmt!r} not supported "
+                f"(supported: {', '.join(_SUPPORTED_FORMATS)})")
+        if not isinstance(self.child.dtype,
+                          (T.DateType, T.TimestampType)):
+            raise TypeError("date_format needs a date/timestamp input")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.columnar.column import StringColumn
+
+        c = self.child.eval(ctx)
+        if isinstance(self.child.dtype, T.DateType):
+            days = c.data.astype(jnp.int64)
+            sod = jnp.zeros_like(days)
+        else:
+            us = c.data.astype(jnp.int64)
+            days = us // US_PER_DAY  # floor division, negatives included
+            sod = (us - days * US_PER_DAY) // US_PER_SECOND
+        chars, out_len = _format_chars(days, sod, self.fmt,
+                                       ctx.batch.capacity)
+        return StringColumn(
+            chars, jnp.full((ctx.batch.capacity,), out_len, jnp.int32),
+            c.validity & ctx.row_mask)

@@ -1,0 +1,58 @@
+"""Scalar subqueries (ref: GpuScalarSubquery in the reference's misc
+support, SURVEY §2.17): a single-row single-column child query used as
+a scalar value.
+
+Execution model: the planner's prepass runs the subplan ONCE per
+plan_query and splices the result in as a Literal — the XLA-friendly
+shape (no data-dependent control flow inside compiled programs), and
+the same eager-broadcast the reference performs on the driver."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import Expression
+
+
+@dataclasses.dataclass(repr=False)
+class ScalarSubquery(Expression):
+    """Placeholder replaced by the planner prepass (TPU path) or
+    evaluated eagerly by the CPU engine."""
+
+    plan: object  # L.LogicalPlan (1 row x 1 column)
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.plan.schema.fields[0].dtype
+
+    @property
+    def name(self) -> str:
+        return "scalar_subquery"
+
+    def eval(self, ctx):  # pragma: no cover - replaced before eval
+        raise NotImplementedError(
+            "ScalarSubquery must be rewritten by the planner prepass")
+
+
+def subquery_value(plan, conf):
+    """Run the subplan and return its scalar (Python value)."""
+    from spark_rapids_tpu.config import SQL_ENABLED
+
+    if conf.get(SQL_ENABLED):
+        from spark_rapids_tpu.plan.planner import (
+            collect_exec,
+            plan_query,
+        )
+
+        exec_, _ = plan_query(plan, conf)
+        tbl = collect_exec(exec_)
+    else:
+        from spark_rapids_tpu.cpu.engine import execute_cpu
+
+        tbl = execute_cpu(plan)
+    if tbl.num_rows != 1 or tbl.num_columns != 1:
+        raise ValueError(
+            f"scalar subquery must return 1x1, got "
+            f"{tbl.num_rows}x{tbl.num_columns}")
+    return tbl.column(0)[0].as_py()

@@ -100,7 +100,9 @@ for _sig, _classes in (
     (_DT, (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
            DT.DayOfYear, DT.Quarter, DT.LastDay, DT.Hour, DT.Minute,
            DT.Second, DT.DateAdd, DT.DateSub, DT.DateDiff,
-           DT.UnixTimestampFromTs)),
+           DT.UnixTimestampFromTs, DT.DateFormatClass)),
+    (TS.ExprSig(TS.INTEGRAL + TS.NULLSIG,
+                "epoch seconds input"), (DT.FromUnixTime,)),
     (_STR, (S.Length, S.Upper, S.Lower, S.StartsWith, S.EndsWith,
             S.Contains, S.Like, S.Substring, S.StringTrim,
             S.StringTrimLeft, S.StringTrimRight, S.Concat,
@@ -116,6 +118,10 @@ from spark_rapids_tpu.exprs import collections as COLL  # noqa: E402
 
 for _cls in (COLL.Size, COLL.GetArrayItem, COLL.ArrayContains):
     register_expr(_cls, TS.ExprSig(TS.ALL, "array input required"))
+
+register_expr(COLL.CreateArray, TS.ExprSig(
+    TS.NUMERIC + TS.BOOLEAN + TS.DATETIME + TS.NULLSIG,
+    "fixed-width elements only"))
 
 # partition-context / nondeterministic expressions
 from spark_rapids_tpu.exprs import nondeterministic as ND  # noqa: E402
@@ -657,8 +663,48 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
 # Entry points
 # ---------------------------------------------------------------------- #
 
+def _rewrite_scalar_subqueries(plan: L.LogicalPlan,
+                               conf) -> L.LogicalPlan:
+    """Prepass: run each ScalarSubquery's subplan once and splice its
+    value in as a Literal (ref: GpuScalarSubquery's driver-side eager
+    evaluation).  Non-mutating: nodes with rewritten expressions are
+    shallow-copied."""
+    from spark_rapids_tpu.exprs.base import Literal
+    from spark_rapids_tpu.exprs.subquery import (
+        ScalarSubquery,
+        subquery_value,
+    )
+
+    new_children = [_rewrite_scalar_subqueries(c, conf)
+                    for c in plan.children]
+
+    def rw(e):
+        if isinstance(e, ScalarSubquery):
+            return Literal.of(subquery_value(e.plan, conf), e.dtype)
+        return e
+
+    def has_sq(e) -> bool:
+        if isinstance(e, ScalarSubquery):
+            return True
+        return any(has_sq(c) for c in e.children)
+
+    replaced = False
+    out = copy.copy(plan)
+    out.children = new_children
+    if isinstance(plan, L.Project) and any(has_sq(e) for e in plan.exprs):
+        out.exprs = [e.transform_up(rw) for e in plan.exprs]
+        replaced = True
+    elif isinstance(plan, L.Filter) and has_sq(plan.condition):
+        out.condition = plan.condition.transform_up(rw)
+        replaced = True
+    if not replaced and new_children == plan.children:
+        return plan
+    return out
+
+
 def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
     conf = conf or get_conf()
+    plan = _rewrite_scalar_subqueries(plan, conf)
     meta = PlanMeta(plan, conf)
     if conf.get(SQL_ENABLED):
         meta.tag()

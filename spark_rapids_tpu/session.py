@@ -82,6 +82,32 @@ def last(e: ExprLike, ignore_nulls: bool = False) -> Last:
     return Last(_expr(e), ignore_nulls)
 
 
+def array(*exprs: ExprLike):
+    from spark_rapids_tpu.exprs.collections import CreateArray
+
+    return CreateArray(*[_expr(e) for e in exprs])
+
+
+def from_unixtime(e: ExprLike, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+    from spark_rapids_tpu.exprs.datetime import FromUnixTime
+
+    return FromUnixTime(_expr(e), fmt)
+
+
+def date_format(e: ExprLike, fmt: str = "yyyy-MM-dd"):
+    from spark_rapids_tpu.exprs.datetime import DateFormatClass
+
+    return DateFormatClass(_expr(e), fmt)
+
+
+def scalar_subquery(df) -> Expression:
+    """A 1x1 DataFrame as a scalar expression (ref: GpuScalarSubquery);
+    evaluated once at planning and spliced in as a literal."""
+    from spark_rapids_tpu.exprs.subquery import ScalarSubquery
+
+    return ScalarSubquery(df._plan)
+
+
 def rand(seed: int = 0):
     from spark_rapids_tpu.exprs.nondeterministic import Rand
 

@@ -81,6 +81,8 @@ _RENAMES = {
                       "UnixTimestampFromTs"),
     "ToUnixTimestamp": ("spark_rapids_tpu.exprs.datetime",
                         "UnixTimestampFromTs"),
+    "ScalarSubquery": ("spark_rapids_tpu.exprs.subquery",
+                       "ScalarSubquery"),
 }
 
 
