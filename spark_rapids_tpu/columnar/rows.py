@@ -1,0 +1,78 @@
+"""Row <-> columnar converters and the external export surface.
+
+TPU analogs of the reference's transition/export pieces:
+- GpuRowToColumnarExec / GpuColumnarToRowExec (row-iterator
+  boundaries at plan transitions);
+- ColumnarRdd (sql/rapids/execution/ColumnarRdd - the public API that
+  hands the accelerated columnar data to external ML libraries).
+
+Here the row form is plain Python tuples/dicts and the external
+columnar form is Arrow record batches (or numpy/pandas) — the natural
+interchange for the Python ecosystem this engine lives in."""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.arrow import (
+    from_arrow,
+    schema_to_arrow,
+    to_arrow,
+)
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+
+def rows_to_batch(rows: Iterable, schema: T.Schema) -> ColumnarBatch:
+    """Python row tuples/dicts -> one device ColumnarBatch
+    (GpuRowToColumnarExec's conversion, batched)."""
+    aschema = schema_to_arrow(schema)
+    names = [f.name for f in schema.fields]
+    cols: list[list] = [[] for _ in names]
+    for r in rows:
+        if isinstance(r, dict):
+            for i, n in enumerate(names):
+                cols[i].append(r.get(n))
+        else:
+            for i, v in enumerate(r):
+                cols[i].append(v)
+    arrays = [pa.array(c, aschema.field(i).type)
+              for i, c in enumerate(cols)]
+    return from_arrow(pa.Table.from_arrays(arrays, schema=aschema))
+
+
+def batch_to_rows(batch: ColumnarBatch) -> Iterator[tuple]:
+    """Device ColumnarBatch -> row tuples (GpuColumnarToRowExec)."""
+    tbl = to_arrow(batch)
+    cols = [c.to_pylist() for c in tbl.columns]
+    for i in range(tbl.num_rows):
+        yield tuple(c[i] for c in cols)
+
+
+def columnar_export(df, batch_rows: Optional[int] = None
+                    ) -> Iterator[pa.RecordBatch]:
+    """Stream a DataFrame's result as Arrow record batches without one
+    giant materialization — the ColumnarRdd analog for handing
+    accelerated data to external libraries."""
+    from spark_rapids_tpu.config import SQL_ENABLED
+
+    if not df._session.conf.get(SQL_ENABLED):
+        # honor the engine switch exactly as collect() does
+        from spark_rapids_tpu.cpu.engine import execute_cpu
+
+        yield from execute_cpu(df._plan).to_batches(
+            max_chunksize=batch_rows)
+        return
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    exec_, _ = plan_query(df._plan, df._session.conf)
+    try:
+        aschema = schema_to_arrow(exec_.schema)
+        for b in exec_.execute():
+            t = to_arrow(b).cast(aschema)
+            for rb in t.to_batches(max_chunksize=batch_rows):
+                yield rb
+    finally:
+        exec_.close()

@@ -154,7 +154,8 @@ def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:
             cols.append(Column(jnp.asarray(arrays[f"c{i}_data"]),
                                jnp.asarray(arrays[f"c{i}_valid"]),
                                f.dtype))
-    return ColumnarBatch(cols, int(arrays["__num_rows"]), schema)
+    n = int(np.asarray(arrays["__num_rows"]).reshape(-1)[0])
+    return ColumnarBatch(cols, n, schema)
 
 
 def _host_bytes(arrays: dict) -> int:
@@ -234,6 +235,11 @@ class BufferStore:
         self.host_budget = host_budget if host_budget is not None \
             else conf.get(HOST_SPILL_BYTES)
         self._spill_dir = spill_dir or conf.get(SPILL_DIR) or None
+        # snapshot at construction: spills run on worker threads whose
+        # thread-local conf is not the user's session conf
+        from spark_rapids_tpu.columnar.serde import spill_codec
+
+        self._spill_codec = spill_codec()
         self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
@@ -293,8 +299,11 @@ class BufferStore:
                 if e.tier == StorageTier.HOST:
                     arrays = e.host
                 else:
-                    with np.load(e.path) as z:  # type: ignore[arg-type]
-                        arrays = {k: z[k] for k in z.files}
+                    from spark_rapids_tpu.columnar.serde import (
+                        read_spill_file,
+                    )
+
+                    arrays = read_spill_file(e.path)  # type: ignore
                 self.reserve(e.nbytes)
                 batch = _host_to_batch(arrays, e.schema)  # H2D upload
             except BaseException:
@@ -327,8 +336,11 @@ class BufferStore:
                 if e.tier == StorageTier.HOST:
                     return e.host  # type: ignore[return-value]
                 if e.tier == StorageTier.DISK:
-                    with np.load(e.path) as z:  # type: ignore[arg-type]
-                        return {k: z[k] for k in z.files}
+                    from spark_rapids_tpu.columnar.serde import (
+                        read_spill_file,
+                    )
+
+                    return read_spill_file(e.path)  # type: ignore
                 b = e.batch  # DEVICE: pull without deleting
                 arrays: dict[str, np.ndarray] = {}
                 n = b.concrete_num_rows()  # type: ignore[union-attr]
@@ -407,8 +419,11 @@ class BufferStore:
             return False
         victim = min(candidates, key=lambda e: (e.priority, e.buffer_id))
         arrays = victim.host
-        path = os.path.join(self._dir(), f"spill-{victim.buffer_id}.npz")
-        np.savez(path, **arrays)  # type: ignore[arg-type]
+        path = os.path.join(self._dir(), f"spill-{victim.buffer_id}.tpub")
+        from spark_rapids_tpu.columnar.serde import write_spill_file
+
+        write_spill_file(path, arrays,  # type: ignore[arg-type]
+                         self._spill_codec)
         hb = _host_bytes(arrays)  # type: ignore[arg-type]
         victim.host = None
         victim.path = path

@@ -1,0 +1,93 @@
+"""Plugin entry point and lifecycle.
+
+TPU analog of the reference's plugin bring-up (ref: SQLPlugin.scala +
+Plugin.scala:179 RapidsExecutorPlugin — driver/executor init, config
+snapshot, shutdown hooks).  In this in-process engine the "plugin" owns
+process-wide runtime state: the buffer store, the task semaphore, the
+compiled-program cache, and the frontend adapter (shim).
+
+Frontend shims (ref: the shims/ spark301..spark311 version adapters,
+SURVEY §2.11): the reference re-targets one plugin across Spark
+versions by routing version-specific APIs through a shim layer.  Here
+the equivalent seam is the FRONTEND adapter — what translates a user
+API into this engine's logical plans.  The native DataFrame frontend is
+the default; a SQL-text or Substrait frontend plugs in through the
+same registry without touching the engine."""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Callable, Optional
+
+_SHIMS: dict[str, Callable] = {}
+_lock = threading.Lock()
+
+
+def register_frontend(name: str, factory: Callable) -> None:
+    """Register a frontend adapter: factory(conf) -> session-like
+    object exposing this engine's DataFrame surface."""
+    with _lock:
+        _SHIMS[name] = factory
+
+
+def frontend(name: str = "native"):
+    with _lock:
+        try:
+            return _SHIMS[name]
+        except KeyError:
+            raise KeyError(
+                f"no frontend {name!r} registered "
+                f"(have: {sorted(_SHIMS)})") from None
+
+
+class TpuPlugin:
+    """Process-wide lifecycle owner (SQLPlugin analog)."""
+
+    _instance: Optional["TpuPlugin"] = None
+
+    def __init__(self, conf=None):
+        from spark_rapids_tpu.config import TpuConf, set_conf
+
+        self.conf = conf or TpuConf()
+        set_conf(self.conf)
+        self._closed = False
+        atexit.register(self.shutdown)
+
+    @classmethod
+    def get_or_create(cls, conf=None) -> "TpuPlugin":
+        with _lock:
+            if cls._instance is None or cls._instance._closed:
+                cls._instance = TpuPlugin(conf)
+            return cls._instance
+
+    def session(self, frontend_name: str = "native"):
+        return frontend(frontend_name)(self.conf)
+
+    def shutdown(self) -> None:
+        """Release process-wide resources (executor shutdown hook,
+        ref: RapidsExecutorPlugin.shutdown)."""
+        if self._closed:
+            return
+        self._closed = True
+        from spark_rapids_tpu.execs import jit_cache
+        from spark_rapids_tpu.memory import get_store, reset_store
+
+        try:
+            get_store().close()
+            reset_store()
+        except Exception:
+            pass
+        try:
+            jit_cache.clear()
+        except Exception:
+            pass
+
+
+def _native_frontend(conf):
+    from spark_rapids_tpu.session import TpuSession
+
+    return TpuSession(conf)
+
+
+register_frontend("native", _native_frontend)

@@ -593,6 +593,22 @@ class DataFrame:
             meta.explain(), exec_, _time.perf_counter() - t0)
         return out
 
+    def to_batches(self, batch_rows: Optional[int] = None):
+        """Stream the result as Arrow record batches (the ColumnarRdd
+        export analog — hand accelerated data to external libraries
+        without one giant materialization)."""
+        from spark_rapids_tpu.columnar.rows import columnar_export
+
+        return columnar_export(self, batch_rows)
+
+    def rows(self):
+        """Iterate result rows as tuples (the columnar->row boundary,
+        ref: GpuColumnarToRowExec)."""
+        for rb in self.to_batches():
+            cols = [c.to_pylist() for c in rb.columns]
+            for i in range(rb.num_rows):
+                yield tuple(c[i] for c in cols)
+
     def explain(self) -> str:
         _, meta = plan_query(self._plan, self._session.conf)
         return meta.explain()

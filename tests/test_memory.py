@@ -70,10 +70,10 @@ def test_spill_chain_to_disk(tmp_path):
     _h2 = store.register(make_batch(150, 4))
     assert h1.tier == StorageTier.DISK
     assert store.spilled_host_to_disk > 0
-    assert list(tmp_path.glob("spill-*.npz"))
+    assert list(tmp_path.glob("spill-*.tpub"))
     assert batch_rows(h1.get()) == want
     store.close()
-    assert not list(tmp_path.glob("spill-*.npz"))
+    assert not list(tmp_path.glob("spill-*"))
 
 
 def test_spill_priority_order():

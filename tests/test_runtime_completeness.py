@@ -1,0 +1,119 @@
+"""Serializer/compression, row<->columnar converters, plugin lifecycle
+(ref: GpuColumnarBatchSerializer, GpuRowToColumnarExec/ColumnarToRow,
+ColumnarRdd, SQLPlugin lifecycle)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exprs.base import lit
+from spark_rapids_tpu.session import TpuSession, col, sum_
+from tests.differential import gen_table
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def test_serde_round_trip():
+    from spark_rapids_tpu.columnar.serde import (
+        deserialize_arrays,
+        serialize_arrays,
+    )
+
+    arrays = {
+        "a": np.arange(1000, dtype=np.int64),
+        "b": np.random.default_rng(0).random(1000),
+        "c": np.zeros((100, 8), np.uint8),
+        "v": np.ones(1000, bool),
+    }
+    for codec in ("none", "zlib"):
+        data = serialize_arrays(arrays, codec)
+        back = deserialize_arrays(data)
+        assert set(back) == set(arrays)
+        for k in arrays:
+            assert np.array_equal(back[k], arrays[k]), (codec, k)
+
+
+def test_serde_zlib_compresses():
+    from spark_rapids_tpu.columnar.serde import serialize_arrays
+
+    arrays = {"a": np.zeros(100_000, np.int64)}  # highly compressible
+    raw = serialize_arrays(arrays, "none")
+    z = serialize_arrays(arrays, "zlib")
+    assert len(z) < len(raw) // 10
+
+
+def test_compressed_disk_spill_round_trip(session, tmp_path):
+    """Force a spill chain to disk with zlib and read it back."""
+    from spark_rapids_tpu.columnar.arrow import from_arrow
+    from spark_rapids_tpu.memory.store import BufferStore, StorageTier
+
+    session.conf.set(
+        "spark.rapids.tpu.memory.spill.compression.codec", "zlib")
+    store = BufferStore(device_budget=1 << 16, host_budget=1 << 16,
+                        spill_dir=str(tmp_path))
+    b1 = from_arrow(pa.table({"x": pa.array(np.arange(5000))}))
+    b2 = from_arrow(pa.table({"x": pa.array(np.arange(5000) * 2)}))
+    h1 = store.register(b1)
+    h1.unpin()
+    h2 = store.register(b2)  # evicts b1 to host, then disk
+    h2.unpin()
+    store.reserve(1 << 15)  # push the chain
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".tpub")]
+    assert files, "expected a disk spill file"
+    got = h1.get()
+    assert np.asarray(got.columns[0].data)[:5000].tolist() \
+        == list(range(5000))
+    store.close()
+
+
+def test_rows_and_batches_export(session):
+    t = gen_table({"a": "int64", "s": "string"}, 300, seed=2)
+    df = session.create_dataframe(t).where(col("a").is_not_null())
+    rbs = list(df.to_batches(batch_rows=64))
+    assert sum(rb.num_rows for rb in rbs) == df.collect().num_rows
+    assert all(rb.num_rows <= 64 for rb in rbs)
+    rows = list(df.rows())
+    assert len(rows) == df.collect().num_rows
+    assert all(isinstance(r, tuple) and len(r) == 2 for r in rows)
+
+
+def test_rows_to_batch_round_trip():
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.rows import (
+        batch_to_rows,
+        rows_to_batch,
+    )
+
+    schema = T.Schema([T.Field("i", T.LONG, True),
+                       T.Field("s", T.STRING, True)])
+    rows = [(1, "a"), (None, "β"), (3, None)]
+    b = rows_to_batch(rows, schema)
+    assert list(batch_to_rows(b)) == rows
+    # dict form
+    b2 = rows_to_batch([{"i": 5, "s": "x"}], schema)
+    assert list(batch_to_rows(b2)) == [(5, "x")]
+
+
+def test_plugin_lifecycle():
+    from spark_rapids_tpu.plugin import TpuPlugin, frontend
+
+    p = TpuPlugin.get_or_create()
+    s = p.session()
+    out = s.create_dataframe(pa.table({"x": pa.array([1, 2, 3])})) \
+        .agg((sum_(col("x")), "s")).collect()
+    assert out.to_pydict()["s"] == [6]
+    p.shutdown()
+    assert p._closed
+    # a new plugin instance comes up cleanly after shutdown
+    p2 = TpuPlugin.get_or_create()
+    assert p2 is not p
+    s2 = p2.session("native")
+    assert s2.create_dataframe(pa.table({"x": pa.array([4])})) \
+        .collect().num_rows == 1
+    with pytest.raises(KeyError):
+        frontend("no-such-frontend")
