@@ -260,6 +260,18 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
         aschema = rb.schema
         n = rb.num_rows
     schema = schema_from_arrow(aschema)
+
+    if capacity is None and n > 0 and _packed_enabled():
+        # encoded single-buffer upload: one device_put + cached unpack
+        # program (bias/dict wire encodings, device-side validity synth)
+        from spark_rapids_tpu.columnar import transfer
+
+        enc = transfer.encode_for_device(arrays, schema, n)
+        if enc is not None:
+            staging, plan = enc
+            cols = transfer.decode_on_device(staging, plan, schema)
+            return ColumnarBatch(cols, n, schema)
+
     cap = capacity if capacity is not None else pad_capacity(n)
 
     # host-decode every column into padded component buffers
@@ -329,7 +341,17 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
             comps += [col.chars, col.lengths, col.validity]
         else:
             comps += [col.data, col.validity]
-    host = jax.device_get(comps)
+    from spark_rapids_tpu.columnar import transfer
+
+    # packed single-round fetch only where latency dominates: the pack
+    # program materializes a staging copy of every component on device,
+    # so big downloads (bandwidth-bound anyway) use direct gets and keep
+    # peak device memory at 1x; it is also the packedUpload fallback
+    total_bytes = sum(getattr(c, "nbytes", 0) for c in comps)
+    if comps and total_bytes <= (32 << 20) and _packed_enabled():
+        host = transfer.fetch_packed(comps)
+    else:
+        host = jax.device_get(comps)
     n = n_live
 
     arrays = []

@@ -1,0 +1,464 @@
+"""Encoded single-buffer H2D / D2H transfer.
+
+The interconnect between host and TPU pays (a) a per-transfer latency
+and (b) limited sustained bandwidth — on tunneled PJRT backends both are
+orders of magnitude worse than PCIe.  The reference sidesteps host
+bandwidth by decoding Parquet ON the accelerator (ref:
+GpuParquetScan.scala:495-560 assembles one device buffer and launches
+device decode kernels).  The TPU analog implemented here:
+
+- the host (scan prefetch thread) re-encodes each decoded column into a
+  compact wire form: bias-packed integers (uint8/uint16 deltas from a
+  per-batch base), dictionary-encoded low-cardinality floats/strings
+  (codes + values), raw bytes otherwise;
+- every component is packed into ONE contiguous uint8 staging buffer —
+  a single `jax.device_put` per batch regardless of column count;
+- a cached, jitted *unpack program* (keyed by the static wire plan)
+  reconstructs full-width padded device columns: bitcasts, gathers for
+  dictionary decode, base adds for bias decode, and validity-mask
+  synthesis (`iota < n_live`) so all-valid columns ship zero validity
+  bytes.
+
+Decode work thus moves from the wire to the VPU, where a gather over a
+few million rows is microseconds.  The same trick in reverse —
+`fetch_packed` — returns any set of device arrays in one D2H round.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (
+    Column,
+    StringColumn,
+    pad_capacity,
+    pad_width,
+)
+
+_ALIGN = 8
+_WIRE_BUCKET = 1 << 16  # wire row counts round up to this (compile-cache)
+
+_unpack_cache: dict = {}
+_pack_cache: dict = {}
+_cache_lock = threading.Lock()
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _wire_rows(n: int, cap: int) -> int:
+    # <= 8 distinct wire lengths per capacity bucket (compile-cache
+    # stability) at <= 12.5% padding waste on the wire
+    return min(cap, _round_up(n, max(64, cap // 8)))
+
+
+# ------------------------------------------------------------------ #
+# Host-side encoding
+# ------------------------------------------------------------------ #
+
+_INT_KINDS = "iu"
+
+
+def _decode_fixed_host(arr: pa.Array, dtype: T.DataType
+                       ) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    """One fixed-width pa.Array -> (values[n], validity[n] or None)."""
+    from spark_rapids_tpu.columnar.arrow import _zero_value
+
+    phys = T.to_numpy_dtype(dtype)
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+        arr = arr.fill_null(_zero_value(dtype))
+    else:
+        validity = None
+    if isinstance(dtype, T.DateType):
+        vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+    elif isinstance(dtype, T.TimestampType):
+        vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+    else:
+        vals = arr.to_numpy(zero_copy_only=False)
+    return np.ascontiguousarray(vals.astype(phys, copy=False)), validity
+
+
+def _sample_low_cardinality(vals: np.ndarray, limit: int = 1024) -> bool:
+    """Cheap gate: does a strided sample look low-cardinality?"""
+    n = len(vals)
+    if n <= 8192:
+        return True
+    s = vals[:: max(1, n // 4096)]
+    return len(np.unique(s)) <= min(limit, len(s) // 2)
+
+
+def _try_dict(vals: np.ndarray) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """(codes, values) when dictionary encoding pays off, else None."""
+    if vals.dtype.kind == "f" and np.isnan(vals).any():
+        return None  # NaN payload bits would not round-trip the dict
+    if not _sample_low_cardinality(vals):
+        return None
+    d = pa.array(vals).dictionary_encode()
+    nvals = len(d.dictionary)
+    if nvals > 0xFFFF or nvals * 2 > max(len(vals), 1):
+        return None
+    codes = d.indices.to_numpy(zero_copy_only=False)
+    values = d.dictionary.to_numpy(zero_copy_only=False).astype(
+        vals.dtype, copy=False)
+    return codes, values
+
+
+class _Builder:
+    """Accumulates aligned regions of the staging buffer."""
+
+    def __init__(self, n_header_slots: int):
+        self.chunks: list[tuple[int, np.ndarray]] = []
+        self.off = n_header_slots * 8
+        self.header = np.zeros(n_header_slots, np.int64)
+
+    def add(self, a: np.ndarray) -> int:
+        a = np.ascontiguousarray(a)
+        off = _round_up(self.off, _ALIGN)
+        self.chunks.append((off, a))
+        self.off = off + a.nbytes
+        return off
+
+    def finish(self) -> np.ndarray:
+        total = _round_up(self.off, _ALIGN)
+        buf = np.zeros(total, np.uint8)
+        buf[: len(self.header) * 8] = self.header.view(np.uint8)
+        for off, a in self.chunks:
+            buf[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
+        return buf
+
+
+def _padded(a: np.ndarray, wire: int) -> np.ndarray:
+    """Zero-pad a 1-D/2-D per-row array to `wire` rows."""
+    if len(a) == wire:
+        return a
+    out = np.zeros((wire,) + a.shape[1:], a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
+                      n: int) -> Optional[tuple[np.ndarray, tuple]]:
+    """Encode decoded host Arrow columns into (staging_buffer, plan).
+
+    Returns None when a column type has no wire encoding yet (decimal,
+    list) — callers fall back to the per-component upload path.
+    """
+    for f in schema.fields:
+        if isinstance(f.dtype, (T.DecimalType, T.ListType)):
+            return None
+    if n == 0:
+        return None
+
+    cap = pad_capacity(n)
+    wire = _wire_rows(n, cap)
+    # header: slot 0 = n_live; one base slot per column (bias encodings)
+    b = _Builder(1 + len(schema.fields))
+    b.header[0] = n
+    entries: list[tuple] = []
+
+    for ci, (arr, f) in enumerate(zip(arrays, schema.fields)):
+        if isinstance(f.dtype, T.StringType):
+            entries.append(_encode_string(b, arr, wire))
+            continue
+        vals, validity = _decode_fixed_host(arr, f.dtype)
+        voff = -1
+        if validity is not None:
+            voff = b.add(_padded(validity.astype(np.uint8), wire))
+        phys = vals.dtype
+        kind = "raw"
+        extra: tuple = ()
+        if phys.kind in _INT_KINDS and phys.itemsize > 1 and n > 0:
+            mn = int(vals.min())
+            rng = int(vals.max()) - mn
+            if rng <= 0xFF:
+                kind, extra = "bias8", ()
+                b.header[1 + ci] = mn
+                vals = (vals.astype(np.int64) - mn).astype(np.uint8)
+            elif phys.itemsize > 2 and rng <= 0xFFFF:
+                kind, extra = "bias16", ()
+                b.header[1 + ci] = mn
+                vals = (vals.astype(np.int64) - mn).astype(np.uint16)
+        elif phys.kind == "f":
+            enc = _try_dict(vals)
+            if enc is not None:
+                codes, dvals = enc
+                code_dt = np.uint8 if len(dvals) <= 0x100 else np.uint16
+                nvp = max(8, pad_capacity(len(dvals)))
+                kind = "dict"
+                doff = b.add(_padded(dvals, nvp))
+                extra = (doff, nvp, str(code_dt.__name__)
+                         if hasattr(code_dt, "__name__") else str(code_dt))
+                vals = codes.astype(code_dt)
+        if phys == np.bool_:
+            vals = vals.astype(np.uint8)
+        off = b.add(_padded(vals, wire))
+        entries.append(("fixed", kind, off, str(vals.dtype), str(phys),
+                        extra, voff))
+
+    plan = (cap, wire, tuple(entries))
+    return b.finish(), plan
+
+
+def _encode_string(b: _Builder, arr: pa.Array, wire: int) -> tuple:
+    """Encode one string column; returns its plan entry."""
+    sarr = arr.cast(pa.large_string())
+    n = len(sarr)
+    offsets = np.frombuffer(sarr.buffers()[1], dtype=np.int64,
+                            count=n + 1, offset=sarr.offset * 8)
+    validity = (np.asarray(arr.is_valid()) if arr.null_count
+                else None)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if validity is not None:
+        lens = np.where(validity, lens, 0).astype(np.int32)
+    voff = -1
+    if validity is not None:
+        voff = b.add(_padded(validity.astype(np.uint8), wire))
+
+    # dictionary attempt: low-cardinality string columns ship codes only
+    if _string_dict_gate(sarr):
+        d = sarr.dictionary_encode()
+        dvals = d.dictionary
+        if len(dvals) <= 0xFFFF and len(dvals) * 2 <= max(n, 1):
+            codes = d.indices.to_numpy(zero_copy_only=False)
+            if validity is not None:
+                codes = np.where(validity, codes, 0)
+            code_dt = np.uint8 if len(dvals) <= 0x100 else np.uint16
+            nvp = max(8, pad_capacity(len(dvals)))
+            dchars, dlens = _chars_matrix(dvals.cast(pa.large_string()))
+            if not dlens.size or int(dlens.max()) <= 0xFFFF:
+                w = dchars.shape[1] if dchars.size else 1
+                dcoff = b.add(_padded(dchars, nvp))
+                dloff = b.add(_padded(dlens.astype(np.uint16), nvp))
+                coff = b.add(_padded(codes.astype(code_dt), wire))
+                return ("sdict", coff, str(code_dt.__name__), dcoff,
+                        dloff, nvp, w, voff)
+            # >=64KB dictionary values would wrap the uint16 length
+            # wire format: fall through to the raw layout (int32 lens)
+
+    chars, _ = _chars_matrix(sarr, lens)
+    w = chars.shape[1] if chars.size else 1
+    coff = b.add(_padded(chars, wire))
+    loff = b.add(_padded(lens.astype(np.int32), wire))
+    return ("sraw", coff, loff, w, voff)
+
+
+def _string_dict_gate(sarr: pa.Array) -> bool:
+    n = len(sarr)
+    if n <= 8192:
+        return True
+    d = sarr.slice(0, 4096).dictionary_encode()
+    return len(d.dictionary) <= 1024
+
+
+def _chars_matrix(sarr: pa.Array,
+                  lens: Optional[np.ndarray] = None
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized fixed-width chars matrix for a large_string array:
+    (chars[n, w], lengths[n])."""
+    n = len(sarr)
+    offsets = np.frombuffer(sarr.buffers()[1], dtype=np.int64,
+                            count=n + 1, offset=sarr.offset * 8)
+    data_buf = sarr.buffers()[2]
+    raw = (np.frombuffer(data_buf, dtype=np.uint8)
+           if data_buf is not None else np.zeros(1, np.uint8))
+    if lens is None:
+        lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    maxw = int(lens.max()) if n else 0
+    w = pad_width(max(maxw, 1))
+    if n == 0:
+        return np.zeros((0, w), np.uint8), lens
+    idx = offsets[:-1, None] + np.arange(w)[None, :]
+    mask = np.arange(w)[None, :] < lens[:, None]
+    safe = np.clip(idx, 0, max(len(raw) - 1, 0))
+    chars = np.where(mask, raw[safe], 0).astype(np.uint8)
+    return chars, lens
+
+
+# ------------------------------------------------------------------ #
+# Device-side unpack program
+# ------------------------------------------------------------------ #
+
+
+def _bitcast_from_u8(raw: jax.Array, npdt: np.dtype, count: int):
+    if npdt == np.uint8:
+        return raw
+    if npdt.itemsize == 1:
+        return jax.lax.bitcast_convert_type(raw, jnp.dtype(npdt))
+    return jax.lax.bitcast_convert_type(
+        raw.reshape(count, npdt.itemsize), jnp.dtype(npdt))
+
+
+def _make_unpack(plan: tuple):
+    cap, wire, entries = plan
+
+    def unpack(buf: jax.Array):
+        n_live = jax.lax.bitcast_convert_type(buf[0:8], jnp.int64)
+        n_live = n_live.reshape(())
+        live_mask = jnp.arange(cap, dtype=jnp.int64) < n_live
+        pad = cap - wire
+
+        def grow(a):
+            if pad == 0:
+                return a
+            z = jnp.zeros((pad,) + a.shape[1:], a.dtype)
+            return jnp.concatenate([a, z], axis=0)
+
+        def read(off, npdt, count):
+            raw = jax.lax.slice(buf, (off,),
+                                (off + count * npdt.itemsize,))
+            return _bitcast_from_u8(raw, npdt, count)
+
+        def validity_of(voff):
+            if voff < 0:
+                return live_mask
+            return grow(read(voff, np.dtype(np.uint8), wire) != 0) \
+                & live_mask
+
+        out = []
+        for ci, e in enumerate(entries):
+            if e[0] == "fixed":
+                _, kind, off, wiredt, physdt, extra, voff = e
+                npw, npp = np.dtype(wiredt), np.dtype(physdt)
+                vals = read(off, npw, wire)
+                if kind.startswith("bias"):
+                    base = jax.lax.bitcast_convert_type(
+                        buf[(1 + ci) * 8:(1 + ci) * 8 + 8],
+                        jnp.int64).reshape(())
+                    vals = (vals.astype(jnp.int64) + base).astype(
+                        jnp.dtype(npp))
+                elif kind == "dict":
+                    doff, nvp, _ = extra
+                    dvals = read(doff, npp, nvp)
+                    vals = jnp.take(dvals, vals.astype(jnp.int32), axis=0)
+                elif npp == np.bool_:
+                    vals = vals != 0
+                else:
+                    vals = vals.astype(jnp.dtype(npp)) \
+                        if npw != npp else vals
+                out.append((grow(vals), validity_of(voff)))
+            elif e[0] == "sraw":
+                _, coff, loff, w, voff = e
+                chars = read(coff, np.dtype(np.uint8),
+                             wire * w).reshape(wire, w)
+                lens = read(loff, np.dtype(np.int32), wire)
+                v = validity_of(voff)
+                out.append((grow(chars), grow(lens) * v.astype(jnp.int32),
+                            v))
+            elif e[0] == "sdict":
+                _, coff, codedt, dcoff, dloff, nvp, w, voff = e
+                codes = read(coff, np.dtype(codedt), wire).astype(
+                    jnp.int32)
+                dchars = read(dcoff, np.dtype(np.uint8),
+                              nvp * w).reshape(nvp, w)
+                dlens = read(dloff, np.dtype(np.uint16), nvp).astype(
+                    jnp.int32)
+                v = validity_of(voff)
+                # invariant shared with every string kernel: chars are
+                # zero for null rows and beyond each row's length — a
+                # gathered dict[0] payload on null/padding rows would
+                # break byte-wise comparators
+                chars = grow(jnp.take(dchars, codes, axis=0)) \
+                    * v[:, None].astype(jnp.uint8)
+                lens = grow(jnp.take(dlens, codes, axis=0)) \
+                    * v.astype(jnp.int32)
+                out.append((chars, lens, v))
+        return out
+
+    return unpack
+
+
+def decode_on_device(staging: np.ndarray, plan: tuple,
+                     schema: T.Schema):
+    """Upload one staging buffer and run the cached unpack program.
+
+    Returns the list of device columns (order = schema order)."""
+    with _cache_lock:
+        fn = _unpack_cache.get(plan)
+        if fn is None:
+            fn = _unpack_cache[plan] = jax.jit(_make_unpack(plan))
+            while len(_unpack_cache) > 256:
+                _unpack_cache.pop(next(iter(_unpack_cache)))
+    dev = jax.device_put(staging)
+    parts = fn(dev)
+    cols = []
+    for f, p in zip(schema.fields, parts):
+        if isinstance(f.dtype, T.StringType):
+            chars, lens, valid = p
+            cols.append(StringColumn(chars, lens, valid))
+        else:
+            data, valid = p
+            cols.append(Column(data, valid, f.dtype))
+    return cols
+
+
+# ------------------------------------------------------------------ #
+# Packed D2H fetch
+# ------------------------------------------------------------------ #
+
+
+def fetch_packed(comps: Sequence[jax.Array]) -> list[np.ndarray]:
+    """Return host copies of device arrays in ONE D2H transfer.
+
+    A cached jitted pack program bitcasts every component to uint8 and
+    concatenates (8-aligned) into a single buffer; the host slices views
+    back out.  D2H on tunneled links pays a full latency round per
+    transfer, so one packed round beats per-array gets by ~column-count.
+    """
+    comps = list(comps)
+    if not comps:
+        return []
+    layout = []
+    off = 0
+    for a in comps:
+        npdt = np.dtype(a.dtype)
+        count = int(np.prod(a.shape)) if a.ndim else 1
+        off = _round_up(off, _ALIGN)
+        layout.append((off, tuple(a.shape), str(npdt), count))
+        off += count * npdt.itemsize
+    total = _round_up(max(off, _ALIGN), _ALIGN)
+    key = (total, tuple(layout))
+
+    with _cache_lock:
+        fn = _pack_cache.get(key)
+        if fn is None:
+            def make(layout=tuple(layout), total=total):
+                def pack(xs):
+                    buf = jnp.zeros(total, jnp.uint8)
+                    for a, (o, shape, dt, count) in zip(xs, layout):
+                        npdt = np.dtype(dt)
+                        flat = a.reshape(count) if a.ndim != 1 else a
+                        if npdt == np.bool_:
+                            rawb = flat.astype(jnp.uint8)
+                        elif npdt.itemsize == 1:
+                            rawb = jax.lax.bitcast_convert_type(
+                                flat, jnp.uint8)
+                        else:
+                            rawb = jax.lax.bitcast_convert_type(
+                                flat, jnp.uint8).reshape(
+                                    count * npdt.itemsize)
+                        buf = jax.lax.dynamic_update_slice(
+                            buf, rawb, (o,))
+                    return buf
+                return pack
+            fn = _pack_cache[key] = jax.jit(make())
+            while len(_pack_cache) > 256:
+                _pack_cache.pop(next(iter(_pack_cache)))
+    host = np.asarray(jax.device_get(fn(comps)))
+    out = []
+    for o, shape, dt, count in layout:
+        npdt = np.dtype(dt)
+        if npdt == np.bool_:
+            a = host[o: o + count] != 0
+        else:
+            a = host[o: o + count * npdt.itemsize].view(npdt)[:count]
+        out.append(a.reshape(shape))
+    return out

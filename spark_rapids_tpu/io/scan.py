@@ -130,6 +130,10 @@ def _scan_batch_rows(schema: T.Schema) -> int:
         else:
             est += np.dtype(T.to_numpy_dtype(f.dtype)).itemsize
     by_bytes = max(1024, conf.get(MAX_READ_BATCH_BYTES) // est)
+    # round down to a power of two: full batches then sit exactly on
+    # their capacity bucket — no device padding, no wire padding, and
+    # one compiled program shape for every full batch
+    by_bytes = 1 << (by_bytes.bit_length() - 1)
     return int(max(1, min(rows_cap, by_bytes, conf.get(MAX_CAPACITY))))
 
 

@@ -71,10 +71,11 @@ class TpuPlugin:
             return
         self._closed = True
         from spark_rapids_tpu.execs import jit_cache
-        from spark_rapids_tpu.memory import get_store, reset_store
+        from spark_rapids_tpu.memory import reset_store
 
         try:
-            get_store().close()
+            # reset_store() closes any existing store itself; calling
+            # get_store() here would lazily build one just to close it
             reset_store()
         except Exception:
             pass
