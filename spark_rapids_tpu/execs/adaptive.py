@@ -1,0 +1,245 @@
+"""Adaptive (runtime-statistics) execution.
+
+The AQE analog (ref: sql-plugin AQE integration —
+GpuCustomShuffleReaderExec.scala coalesced/skew shuffle reads,
+GpuTransitionOverrides.scala:65-99 adaptive transitions, and Spark's
+AdaptiveSparkPlanExec stage re-optimization): exchanges double as query
+stages, and once a map stage materializes, downstream strategy decisions
+re-plan against ACTUAL sizes instead of scan-time estimates.
+
+Two adaptive rewrites, both driven by `materialize_stats()` (the
+MapOutputStatistics analog on TpuShuffleExchangeExec):
+
+- `TpuAdaptiveJoinExec`: defers the shuffled-vs-broadcast decision to
+  runtime.  Both side's map stages run first; if one side's measured
+  bytes fit the broadcast threshold the join executes as a broadcast
+  hash join reading the already-shuffled blocks (no re-scan — the map
+  output IS the build input), otherwise as the planned partition-wise
+  join over coalesced reduce partitions.
+- `CoalescedShuffleReaderExec`: groups adjacent reduce partitions until
+  each group reaches the advisory byte target, so a shuffle that wrote
+  many tiny partitions runs few reduce tasks (the
+  coalesce-shuffle-partitions rule).
+
+Design note: on TPU the payoff is larger than on GPU — every reduce
+task dispatches compiled programs whose shapes bucket by batch size, so
+fewer, fuller partitions mean fewer dispatches and better MXU/VPU
+utilization, and a runtime broadcast switch removes a whole exchange's
+worth of device round trips.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import register, get_conf
+from spark_rapids_tpu.execs.base import TpuExec
+
+ADAPTIVE_ENABLED = register(
+    "spark.rapids.tpu.sql.adaptive.enabled", True,
+    "Re-plan join strategy and reduce-partition grouping against "
+    "measured map-output sizes once shuffle stages materialize (the "
+    "spark.sql.adaptive.enabled analog).")
+
+ADVISORY_PARTITION_BYTES = register(
+    "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeBytes", 64 << 20,
+    "Target bytes per reduce task after adaptive partition coalescing "
+    "(the spark.sql.adaptive.advisoryPartitionSizeInBytes analog).")
+
+
+def plan_coalesced_groups(part_bytes: Sequence[int],
+                          target: int) -> list[list[int]]:
+    """Group ADJACENT reduce partitions until each group reaches the
+    advisory target (hash co-partitioning is preserved only by identical
+    adjacent grouping on every side).  Empty partitions merge for free;
+    a single oversized partition stays its own group (skew splitting
+    would break build-side completeness for joins — documented gap)."""
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for rid, b in enumerate(part_bytes):
+        cur.append(rid)
+        cur_bytes += b
+        if cur_bytes >= target:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        groups.append(cur)
+    return groups or [[0]]
+
+
+class CoalescedShuffleReaderExec(TpuExec):
+    """Reduce-side reader exposing groups of adjacent shuffle partitions
+    as single partitions (ref: GpuCustomShuffleReaderExec's
+    CoalescedPartitionSpec handling)."""
+
+    def __init__(self, exchange, groups: list[list[int]]):
+        super().__init__(exchange)
+        self.groups = groups
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.groups)
+
+    @property
+    def output_partitioning(self):
+        # grouped partitions still co-partition with any reader using
+        # the SAME groups, but not with the raw partitioning width —
+        # adaptive join builds both sides with identical groups
+        return None
+
+    def node_desc(self) -> str:
+        n_raw = self.children[0].num_partitions
+        return (f"CoalescedShuffleReaderExec [{n_raw} -> "
+                f"{len(self.groups)} partitions]")
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for rid in self.groups[p]:
+            for b in self.children[0].execute_partition(rid):
+                yield self._count_output(b)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+
+class TpuAdaptiveJoinExec(TpuExec):
+    """Join whose physical strategy is chosen at first execution from
+    measured map-output statistics (ref: Spark's
+    DynamicJoinSelection/AdaptiveSparkPlanExec re-optimization, which
+    the reference plugs into via GpuCustomShuffleReaderExec).
+
+    Children are the two shuffle exchanges the static planner would
+    have used for a partition-wise join; the runtime decision only ever
+    *improves* on that plan (broadcast from materialized blocks, or
+    coalesced reduce groups), so there is no regression risk relative
+    to static planning."""
+
+    def __init__(self, left_keys, right_keys, join_type: str,
+                 left_exchange, right_exchange, condition=None):
+        super().__init__(left_exchange, right_exchange)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+        self._decided: Optional[TpuExec] = None
+        self._decision = "undecided"
+        self._lock = threading.Lock()
+        # schema comes from the inner join exec; build one eagerly so
+        # schema/explain work before execution (the static shape)
+        self._template = self._make_shuffled(left_exchange,
+                                             right_exchange)
+
+    def _make_shuffled(self, lex, rex) -> TpuExec:
+        from spark_rapids_tpu.execs.join import TpuShuffledHashJoinExec
+
+        return TpuShuffledHashJoinExec(
+            self.left_keys, self.right_keys, self.join_type, lex, rex,
+            condition=self.condition, partition_wise=True)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._template.schema
+
+    @property
+    def num_partitions(self) -> int:
+        # STATIC width (the template's): reading partition counts must
+        # never trigger _decide() — the planner inspects num_partitions
+        # while building the tree, and materializing map stages at plan
+        # time would execute scans for explain-only queries.  The
+        # decided exec only ever has <= this many partitions (broadcast
+        # keeps the stream width, coalescing shrinks it); the excess
+        # partitions execute as empty.
+        return self._template.num_partitions
+
+    def node_desc(self) -> str:
+        return (f"TpuAdaptiveJoinExec [{self.join_type}] "
+                f"strategy={self._decision}")
+
+    def additional_metrics(self):
+        return [("adaptiveBroadcasts", "ESSENTIAL"),
+                ("coalescedPartitions", "MODERATE")]
+
+    # -- runtime decision ------------------------------------------------ #
+
+    def _decide(self) -> TpuExec:
+        with self._lock:
+            if self._decided is not None:
+                return self._decided
+            from spark_rapids_tpu.execs.join import (
+                TpuBroadcastHashJoinExec,
+            )
+            from spark_rapids_tpu.plan.planner import (
+                BROADCAST_THRESHOLD,
+                broadcast_candidates,
+            )
+
+            conf = get_conf()
+            thr = conf.get(BROADCAST_THRESHOLD)
+            lex, rex = self.children
+            lstats = lex.materialize_stats()
+            rstats = rex.materialize_stats()
+            lbytes = sum(b for b, _ in lstats)
+            rbytes = sum(b for b, _ in rstats)
+
+            jt = self.join_type
+            candidates = broadcast_candidates(jt, lbytes, rbytes, thr)
+            if candidates:
+                side, nbytes = min(candidates, key=lambda c: c[1])
+                self.metrics["adaptiveBroadcasts"].add(1)
+                self._decision = (f"broadcast[{side} "
+                                  f"{nbytes >> 10}KiB<=thr]")
+                self._decided = TpuBroadcastHashJoinExec(
+                    self.left_keys, self.right_keys, jt, lex, rex,
+                    condition=self.condition, build_side=side)
+            else:
+                target = conf.get(ADVISORY_PARTITION_BYTES)
+                per_part = [lb + rb for (lb, _), (rb, _)
+                            in zip(lstats, rstats)]
+                groups = plan_coalesced_groups(per_part, target)
+                if len(groups) < len(per_part):
+                    self.metrics["coalescedPartitions"].add(
+                        len(per_part) - len(groups))
+                    self._decision = (f"shuffled[{len(per_part)}->"
+                                      f"{len(groups)} parts]")
+                    self._decided = self._make_shuffled(
+                        CoalescedShuffleReaderExec(lex, groups),
+                        CoalescedShuffleReaderExec(rex, groups))
+                else:
+                    self._decision = "shuffled"
+                    self._decided = self._template
+            # the decided exec is not a child, so metric collection
+            # would miss it: adopt its Metric objects (live references)
+            # under this node, keeping only the adaptive-specific ones
+            own = {"adaptiveBroadcasts", "coalescedPartitions"}
+            for k, v in self._decided.metrics.items():
+                if k not in own:
+                    self.metrics[k] = v
+            return self._decided
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        decided = self._decide()
+        if p >= decided.num_partitions:
+            return  # coalescing shrank the width; tail partitions empty
+        yield from decided.execute_partition(p)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        yield from self._decide().execute()
+
+    def close(self) -> None:
+        # the decided exec is NOT a child (children stay the two
+        # exchanges), so default propagation would miss its cleanup —
+        # e.g. a runtime broadcast join's spillable build handle
+        with self._lock:
+            decided = self._decided
+        if decided is not None and decided is not self._template:
+            decided.close()
+        self._template.close()  # idempotently closes the exchanges too
+        super().close()

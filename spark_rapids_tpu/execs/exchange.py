@@ -254,6 +254,14 @@ class TpuShuffleExchangeExec(TpuExec):
 
     # -- reduce side ------------------------------------------------------ #
 
+    def materialize_stats(self) -> list[tuple[int, int]]:
+        """Run the map stage (once) and return per-reduce-partition
+        (bytes, rows) — the query-stage materialization adaptive
+        execution builds on (ref: ShuffleQueryStageExec.mapStats)."""
+        self._ensure_map_stage()
+        return get_shuffle_manager().partition_stats(
+            self._shuffle_id, self.num_partitions)
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         self._ensure_map_stage()
         for b in get_shuffle_manager().read(self._shuffle_id, p):

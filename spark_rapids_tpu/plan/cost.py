@@ -1,0 +1,157 @@
+"""Cost-based optimizer: demote unprofitable TPU islands to the CPU.
+
+Analog of the reference's optional CBO (ref: CostBasedOptimizer.scala:34
+`Optimizer` trait, :62 `optimize` — CpuCostModel vs GpuCostModel per
+operator, forcing subtrees back to CPU when acceleration cannot repay
+the row/columnar transition cost).  The TPU version reasons about
+host<->device transfers instead of row<->columnar conversions, but the
+shape is the same:
+
+  island      = a maximal subtree of nodes the tagger left replaceable
+  tpu cost    = per-row device op cost * rows, summed over the island,
+                plus a per-row transfer cost at every boundary where
+                data enters (host-resident child or source leaf) or
+                leaves (island root) the device
+  cpu cost    = per-row host op cost * rows over the same nodes
+
+If the island's TPU cost (including transfers) exceeds its CPU cost,
+every node in it is tagged will-not-work — the planner then builds one
+fused CpuFallbackExec and the data never bounces through the device.
+Rows come from `LogicalPlan.estimated_rows()` upper bounds; an unknown
+estimate aborts demotion (never move unknown — possibly huge — work to
+the host on a guess).
+
+Disabled by default, like the reference
+(spark.rapids.sql.optimizer.enabled, RapidsConf.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.config import register, get_conf
+from spark_rapids_tpu.plan import logical as L
+
+CBO_ENABLED = register(
+    "spark.rapids.tpu.sql.optimizer.enabled", False,
+    "Cost-based demotion of small TPU subtrees whose host<->device "
+    "transfer cost exceeds the acceleration win (the "
+    "spark.rapids.sql.optimizer.enabled analog).")
+
+CPU_ROW_COST = register(
+    "spark.rapids.tpu.sql.optimizer.cpuRowCost", 1.0,
+    "Relative per-row cost of one operator on the CPU engine.")
+
+TPU_ROW_COST = register(
+    "spark.rapids.tpu.sql.optimizer.tpuRowCost", 0.05,
+    "Relative per-row cost of one operator on the TPU (compiled XLA "
+    "programs amortize to far below host per-row cost).")
+
+TRANSFER_ROW_COST = register(
+    "spark.rapids.tpu.sql.optimizer.transferRowCost", 1.5,
+    "Relative per-row cost of moving a boundary's rows across the "
+    "host<->device link (decode/pack + transfer latency).")
+
+DEMOTION_REASON = "not cost-effective on TPU (cost-based optimizer)"
+
+
+def _rows(p: L.LogicalPlan) -> Optional[int]:
+    return p.estimated_rows()
+
+
+def _work_rows(p: L.LogicalPlan) -> Optional[int]:
+    """Rows an operator actually processes: its inputs (an aggregate
+    reads a million rows to emit ten), falling back to its own output
+    estimate for leaves."""
+    if p.children:
+        total = 0
+        for c in p.children:
+            r = _rows(c)
+            if r is None:
+                return None
+            total += r
+        return total
+    return _rows(p)
+
+
+def optimize_costs(meta) -> None:
+    """Tag every node of each unprofitable replaceable island with
+    DEMOTION_REASON.  Runs after tag(), before conversion."""
+    conf = get_conf()
+    if not conf.get(CBO_ENABLED):
+        return
+    cpu_c = conf.get(CPU_ROW_COST)
+    tpu_c = conf.get(TPU_ROW_COST)
+    xfer_c = conf.get(TRANSFER_ROW_COST)
+
+    def walk(m, parent_replaceable: bool) -> None:
+        if m.can_replace and not parent_replaceable:
+            _consider_island(m, cpu_c, tpu_c, xfer_c)
+            # island internals were visited by _consider_island; recurse
+            # only into the non-replaceable frontier below it
+            for f in _frontier(m):
+                for c in f.children:
+                    walk(c, False)
+        else:
+            for c in m.children:
+                walk(c, m.can_replace)
+
+    walk(meta, False)
+
+
+def _frontier(island_root) -> list:
+    """Non-replaceable children hanging below an island (the CPU
+    boundary nodes)."""
+    out = []
+
+    def rec(m):
+        for c in m.children:
+            if c.can_replace:
+                rec(c)
+            else:
+                out.append(c)
+    rec(island_root)
+    return out
+
+
+def _consider_island(root, cpu_c: float, tpu_c: float,
+                     xfer_c: float) -> None:
+    nodes = []
+
+    def rec(m):
+        nodes.append(m)
+        for c in m.children:
+            if c.can_replace:
+                rec(c)
+    rec(root)
+
+    op_rows = []
+    entry_rows = []
+    for m in nodes:
+        w = _work_rows(m.plan)
+        if w is None:
+            return  # unknown work: never demote on a guess
+        op_rows.append(w)
+        if not m.plan.children:
+            # source leaf: its output must be uploaded
+            r = _rows(m.plan)
+            if r is None:
+                return
+            entry_rows.append(r)
+        else:
+            for c in m.children:
+                if not c.can_replace:
+                    r = _rows(c.plan)
+                    if r is None:
+                        return
+                    entry_rows.append(r)  # host-resident child
+    exit_rows = _rows(root.plan)
+    if exit_rows is None:
+        return
+
+    tpu_cost = (tpu_c * sum(op_rows)
+                + xfer_c * (sum(entry_rows) + exit_rows))
+    cpu_cost = cpu_c * sum(op_rows)
+    if tpu_cost > cpu_cost:
+        for m in nodes:
+            m.will_not_work(DEMOTION_REASON)

@@ -355,6 +355,11 @@ class Aggregate(LogicalPlan):
         self._schema = T.Schema(
             key_fields + [na.output_field() for na in self.aggs])
 
+    def estimated_rows(self) -> Optional[int]:
+        if not self.groups:
+            return 1  # grand aggregate: exactly one output row
+        return self.children[0].estimated_rows()  # upper bound
+
     @property
     def schema(self) -> T.Schema:
         return self._schema

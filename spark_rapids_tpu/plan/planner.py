@@ -490,6 +490,24 @@ BROADCAST_THRESHOLD = register(
     "disables broadcast joins.")
 
 
+def broadcast_candidates(join_type: str, lbytes, rbytes,
+                         thr: int) -> list[tuple[str, int]]:
+    """Legal (build_side, bytes) pairs for a broadcast hash join — ONE
+    legality table shared by static planning and the adaptive join's
+    runtime re-decision (which feeds measured instead of estimated
+    bytes)."""
+    out: list[tuple[str, int]] = []
+    if thr < 0 or join_type == "full_outer":
+        return out
+    if join_type in ("inner", "cross", "left_outer", "left_semi",
+                     "left_anti") and rbytes is not None and rbytes <= thr:
+        out.append(("right", rbytes))
+    if join_type in ("inner", "cross", "right_outer") \
+            and lbytes is not None and lbytes <= thr:
+        out.append(("left", lbytes))
+    return out
+
+
 def _plan_join(p: L.Join, kids: list[TpuExec]) -> TpuExec:
     """Physical join strategy (the role GpuOverrides plays when Spark has
     already chosen; here the planner chooses, like Spark's
@@ -512,19 +530,12 @@ def _plan_join(p: L.Join, kids: list[TpuExec]) -> TpuExec:
     lbytes = p.children[0].estimated_bytes()
     rbytes = p.children[1].estimated_bytes()
 
-    if thr >= 0 and jt != "full_outer":
-        candidates = []
-        if jt in ("inner", "cross", "left_outer", "left_semi",
-                  "left_anti") and rbytes is not None and rbytes <= thr:
-            candidates.append(("right", rbytes))
-        if jt in ("inner", "cross", "right_outer") \
-                and lbytes is not None and lbytes <= thr:
-            candidates.append(("left", lbytes))
-        if candidates:
-            side = min(candidates, key=lambda c: c[1])[0]
-            return TpuBroadcastHashJoinExec(
-                p.left_keys, p.right_keys, jt, kids[0], kids[1],
-                condition=p.condition, build_side=side)
+    candidates = broadcast_candidates(jt, lbytes, rbytes, thr)
+    if candidates:
+        side = min(candidates, key=lambda c: c[1])[0]
+        return TpuBroadcastHashJoinExec(
+            p.left_keys, p.right_keys, jt, kids[0], kids[1],
+            condition=p.condition, build_side=side)
 
     # partition-wise shuffled join: only for real equi-keys with equal
     # key dtypes on both sides (hash-parity requires identical physical
@@ -551,6 +562,19 @@ def _plan_join(p: L.Join, kids: list[TpuExec]) -> TpuExec:
             HashPartitioning(p.left_keys, n), kids[0])
         rex = kids[1] if rsat is not None else TpuShuffleExchangeExec(
             HashPartitioning(p.right_keys, n), kids[1])
+        from spark_rapids_tpu.execs.adaptive import (
+            ADAPTIVE_ENABLED,
+            TpuAdaptiveJoinExec,
+        )
+
+        if conf.get(ADAPTIVE_ENABLED) and lsat is None and rsat is None:
+            # both sides are fresh exchanges: defer shuffled-vs-broadcast
+            # and reduce-partition grouping to measured map-output sizes
+            # (reused child distributions can't re-group: their
+            # partitioning is fixed by the producing stage)
+            return TpuAdaptiveJoinExec(
+                p.left_keys, p.right_keys, jt, lex, rex,
+                condition=p.condition)
         return TpuShuffledHashJoinExec(
             p.left_keys, p.right_keys, jt, lex, rex,
             condition=p.condition, partition_wise=True)
@@ -708,6 +732,9 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
     meta = PlanMeta(plan, conf)
     if conf.get(SQL_ENABLED):
         meta.tag()
+        from spark_rapids_tpu.plan.cost import optimize_costs
+
+        optimize_costs(meta)
     else:
         meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
     return convert_meta(meta), meta

@@ -24,6 +24,10 @@ class ShuffleManager:
         self._lock = threading.Lock()
         #: (shuffle_id, reduce_id) -> list of SpillableBatch handles
         self._blocks: dict[tuple[int, int], list] = {}
+        #: (shuffle_id, reduce_id) -> [bytes, rows] written (MapStatus
+        #: analog: survives read() so adaptive re-planning can consult
+        #: sizes after map stages complete)
+        self._stats: dict[tuple[int, int], list] = {}
         self._next_shuffle = 0
 
     def new_shuffle_id(self) -> int:
@@ -36,12 +40,16 @@ class ShuffleManager:
               batch: ColumnarBatch) -> None:
         """Map side: register one partition slice (stays on device until
         pressure evicts it)."""
-        if batch.concrete_num_rows() == 0:
+        rows = batch.concrete_num_rows()
+        if rows == 0:
             return
         h = get_store().register(batch, SpillPriorities.OUTPUT_FOR_SHUFFLE)
         h.unpin()  # at rest until a reduce task fetches it
         with self._lock:
             self._blocks.setdefault((shuffle_id, reduce_id), []).append(h)
+            st = self._stats.setdefault((shuffle_id, reduce_id), [0, 0])
+            st[0] += h.nbytes
+            st[1] += rows
 
     def read(self, shuffle_id: int, reduce_id: int
              ) -> Iterator[ColumnarBatch]:
@@ -62,12 +70,24 @@ class ShuffleManager:
             for h in handles:
                 h.close()
 
+    def partition_stats(self, shuffle_id: int,
+                        n_partitions: int) -> list[tuple[int, int]]:
+        """Per-reduce-partition (bytes, rows) written by the map stage —
+        the MapOutputStatistics analog adaptive execution plans against
+        (ref: GpuShuffleExchangeExec's mapOutputStatistics via
+        ShuffledBatchRDD)."""
+        with self._lock:
+            return [tuple(self._stats.get((shuffle_id, rid), (0, 0)))
+                    for rid in range(n_partitions)]
+
     def unregister(self, shuffle_id: int) -> None:
         with self._lock:
             keys = [k for k in self._blocks if k[0] == shuffle_id]
             for k in keys:
                 for h in self._blocks.pop(k):
                     h.close()
+            for k in [k for k in self._stats if k[0] == shuffle_id]:
+                del self._stats[k]
 
 
 _MANAGER: Optional[ShuffleManager] = None

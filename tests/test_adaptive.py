@@ -1,0 +1,227 @@
+"""Adaptive execution: runtime join re-planning + partition coalescing.
+
+The AQE analog (ref: GpuCustomShuffleReaderExec coalesced reads,
+Spark's AdaptiveSparkPlanExec): static estimates are upper bounds, so a
+selective filter leaves the scan-time estimate too big to broadcast —
+the adaptive join must discover the real (small) size after the map
+stage materializes and switch strategy, while results stay identical to
+the CPU oracle.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.execs.adaptive import (
+    ADAPTIVE_ENABLED,
+    ADVISORY_PARTITION_BYTES,
+    TpuAdaptiveJoinExec,
+    plan_coalesced_groups,
+)
+from spark_rapids_tpu.plan.planner import BROADCAST_THRESHOLD
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tables_equal
+
+
+def test_plan_coalesced_groups():
+    # groups close when they reach the target; empties merge for free
+    assert plan_coalesced_groups([10, 10, 10, 10], 20) == [[0, 1], [2, 3]]
+    assert plan_coalesced_groups([0, 0, 0, 50], 20) == [[0, 1, 2, 3]]
+    assert plan_coalesced_groups([100, 0, 0, 0], 20) == [[0], [1, 2, 3]]
+    # an oversized partition stays alone (no skew split)
+    assert plan_coalesced_groups([5, 99, 5], 20) == [[0, 1], [2]]
+    assert plan_coalesced_groups([], 20) == [[0]]
+
+
+@pytest.fixture(autouse=True)
+def small_batches():
+    """Multi-partition sources so joins take the exchange path."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+
+    conf = get_conf()
+    old = conf.get(BATCH_SIZE_ROWS)
+    conf.set(BATCH_SIZE_ROWS.key, 1000)
+    yield
+    conf.set(BATCH_SIZE_ROWS.key, old)
+
+
+@pytest.fixture
+def joined_tables():
+    rng = np.random.default_rng(21)
+    n = 5000
+    fact = pa.table({
+        "k": rng.integers(0, 200, n),
+        "v": rng.random(n),
+        "sel": rng.integers(0, 100, n),
+    })
+    dim = pa.table({
+        "k": np.arange(200, dtype=np.int64),
+        "name": pa.array([f"name-{i}" for i in range(200)]),
+        "sel2": rng.integers(0, 100, 200),
+    })
+    return fact, dim
+
+
+def _adaptive_nodes(exec_root):
+    out = []
+
+    def walk(e):
+        if isinstance(e, TpuAdaptiveJoinExec):
+            out.append(e)
+        for c in e.children:
+            walk(c)
+    walk(exec_root)
+    return out
+
+
+def test_adaptive_broadcast_switch(joined_tables):
+    """Estimates say both sides are big (filters keep the child's upper
+    bound); measured map output of the filtered dim side is tiny, so the
+    join must execute as a runtime broadcast."""
+    fact, dim = joined_tables
+    conf = get_conf()
+    old_thr = conf.get(BROADCAST_THRESHOLD)
+    try:
+        conf.set(BROADCAST_THRESHOLD.key, 4 << 10)  # 4KiB: est never fits
+        session = TpuSession()
+        f = session.create_dataframe(fact)
+        # selective filter: ~10 of 200 dim rows survive -> tiny map output
+        d = session.create_dataframe(dim).where(col("sel2") < 5)
+        df = f.join(d, on="k")
+        tpu = df.collect(engine="tpu")
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu)
+        # the decision is visible on the executed tree
+        from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+        exec_, _ = plan_query(df._plan)
+        nodes = _adaptive_nodes(exec_)
+        assert nodes, "planner did not emit an adaptive join"
+        collect_exec(exec_)
+        assert "broadcast" in nodes[0]._decision, nodes[0]._decision
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old_thr)
+
+
+def test_adaptive_partition_coalescing(joined_tables):
+    """With broadcast impossible and a large advisory target, the 8
+    shuffle partitions must execute as one coalesced reduce group."""
+    fact, dim = joined_tables
+    conf = get_conf()
+    old_thr = conf.get(BROADCAST_THRESHOLD)
+    old_adv = conf.get(ADVISORY_PARTITION_BYTES)
+    try:
+        conf.set(BROADCAST_THRESHOLD.key, -1)  # broadcast disabled
+        conf.set(ADVISORY_PARTITION_BYTES.key, 1 << 30)
+        session = TpuSession()
+        df = session.create_dataframe(fact).join(
+            session.create_dataframe(dim), on="k")
+        from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+        exec_, _ = plan_query(df._plan)
+        nodes = _adaptive_nodes(exec_)
+        assert nodes
+        tpu = collect_exec(exec_)
+        assert "->1 parts" in nodes[0]._decision, nodes[0]._decision
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu)
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old_thr)
+        conf.set(ADVISORY_PARTITION_BYTES.key, old_adv)
+
+
+def test_adaptive_disabled_keeps_static_plan(joined_tables):
+    fact, dim = joined_tables
+    conf = get_conf()
+    old = conf.get(ADAPTIVE_ENABLED)
+    old_thr = conf.get(BROADCAST_THRESHOLD)
+    try:
+        conf.set(ADAPTIVE_ENABLED.key, False)
+        conf.set(BROADCAST_THRESHOLD.key, -1)
+        session = TpuSession()
+        df = session.create_dataframe(fact).join(
+            session.create_dataframe(dim), on="k")
+        from spark_rapids_tpu.plan.planner import plan_query
+
+        exec_, _ = plan_query(df._plan)
+        assert not _adaptive_nodes(exec_)
+        tpu = df.collect(engine="tpu")
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu)
+    finally:
+        conf.set(ADAPTIVE_ENABLED.key, old)
+        conf.set(BROADCAST_THRESHOLD.key, old_thr)
+
+
+def test_plan_query_does_not_materialize(joined_tables):
+    """Planning (and explain) must be side-effect free: building the
+    exec tree — including parents that read num_partitions — must not
+    run the adaptive join's map stages."""
+    fact, dim = joined_tables
+    conf = get_conf()
+    old_thr = conf.get(BROADCAST_THRESHOLD)
+    try:
+        conf.set(BROADCAST_THRESHOLD.key, -1)  # force the exchange path
+        session = TpuSession()
+        from spark_rapids_tpu.plan.planner import plan_query
+        from spark_rapids_tpu.session import sum_
+
+        df = (session.create_dataframe(fact)
+              .join(session.create_dataframe(dim), on="k")
+              .group_by(col("name")).agg((sum_(col("v")), "s")))
+        exec_, _ = plan_query(df._plan)
+        nodes = _adaptive_nodes(exec_)
+        assert nodes
+        assert all(n._decided is None for n in nodes), \
+            "plan_query materialized a shuffle stage"
+        assert all(n.num_partitions > 0 for n in nodes)  # still undecided
+        assert all(n._decided is None for n in nodes)
+        exec_.close()
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old_thr)
+
+
+def test_adaptive_broadcast_releases_build(joined_tables):
+    """The runtime-decided broadcast join is not a child of the adaptive
+    node; close() must still release its spillable build handle."""
+    fact, dim = joined_tables
+    conf = get_conf()
+    old_thr = conf.get(BROADCAST_THRESHOLD)
+    try:
+        conf.set(BROADCAST_THRESHOLD.key, 4 << 10)
+        session = TpuSession()
+        d = session.create_dataframe(dim).where(col("sel2") < 5)
+        df = session.create_dataframe(fact).join(d, on="k")
+        from spark_rapids_tpu.memory import get_store
+        from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+        store = get_store()
+        before = set(store._entries)
+        exec_, _ = plan_query(df._plan)
+        nodes = _adaptive_nodes(exec_)
+        collect_exec(exec_)  # drains AND closes
+        assert nodes and "broadcast" in nodes[0]._decision
+        leaked = set(store._entries) - before
+        assert not leaked, f"leaked {len(leaked)} buffers after close"
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old_thr)
+
+
+def test_adaptive_left_outer_differential(joined_tables):
+    """Strategy switches must not change join semantics: left_outer with
+    unmatched rows, both adaptive strategies vs the CPU oracle."""
+    fact, dim = joined_tables
+    conf = get_conf()
+    old_thr = conf.get(BROADCAST_THRESHOLD)
+    try:
+        session = TpuSession()
+        half = session.create_dataframe(dim.slice(0, 100))
+        f = session.create_dataframe(fact)
+        for thr in (4 << 10, 1 << 30):
+            conf.set(BROADCAST_THRESHOLD.key, thr)
+            df = f.join(half, on="k", how="left_outer")
+            assert_tables_equal(df.collect(engine="tpu"),
+                                df.collect(engine="cpu"))
+    finally:
+        conf.set(BROADCAST_THRESHOLD.key, old_thr)

@@ -1,0 +1,131 @@
+"""Cost-based optimizer: transition-thrash demotion.
+
+Mirrors the reference CBO's purpose (CostBasedOptimizer.scala): a small
+replaceable island sandwiched between CPU-only operators costs more in
+host<->device transfers than the acceleration saves, so the whole
+region should run as ONE fused CPU fallback.  Large islands must never
+be demoted, and unknown row estimates must abort demotion.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.plan.cost import CBO_ENABLED, DEMOTION_REASON
+from spark_rapids_tpu.plan.planner import CpuFallbackExec, plan_query
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tables_equal
+
+
+@pytest.fixture
+def cbo_conf():
+    conf = get_conf()
+    old = conf.get(CBO_ENABLED)
+    conf.set(CBO_ENABLED.key, True)
+    yield conf
+    conf.set(CBO_ENABLED.key, old)
+
+
+def _tpu_nodes(exec_root):
+    out = []
+
+    def walk(e):
+        if not isinstance(e, CpuFallbackExec):
+            out.append(e)
+        for c in e.children:
+            walk(c)
+    walk(exec_root)
+    return out
+
+
+def _filter_kill(conf, on: bool):
+    """Flip the Filter exec kill-switch to force CPU fallback around a
+    TPU island."""
+    from spark_rapids_tpu.plan.planner import _EXEC_CONFS
+    from spark_rapids_tpu.plan import logical as L
+
+    entry = _EXEC_CONFS[L.Filter]
+    old = conf.get(entry)
+    conf.set(entry.key, on)
+    return old
+
+
+def test_sandwiched_island_demoted(cbo_conf):
+    """filter(CPU) -> project (TPU island of one op) -> filter(CPU):
+    with CBO on, the lone project is not worth two transfers and the
+    whole plan fuses into one CPU fallback."""
+    conf = cbo_conf
+    rng = np.random.default_rng(5)
+    t = pa.table({"a": rng.integers(0, 100, 2000),
+                  "b": rng.random(2000)})
+    session = TpuSession()
+    old = _filter_kill(conf, False)
+    try:
+        from spark_rapids_tpu.exprs.base import lit
+
+        df = (session.create_dataframe(t)
+              .where(col("a") > lit(10))
+              .select((col("a") + col("a")).alias("a2"), col("b"))
+              .where(col("a2") > lit(50)))
+        exec_, meta = plan_query(df._plan)
+        reasons = set()
+
+        def walk(m):
+            reasons.update(m.reasons)
+            for c in m.children:
+                walk(c)
+        walk(meta)
+        assert DEMOTION_REASON in reasons, meta.explain()
+        # the demoted island leaves no TPU compute nodes in the tree
+        assert not _tpu_nodes(exec_), [type(e).__name__
+                                       for e in _tpu_nodes(exec_)]
+        assert_tables_equal(df.collect(engine="tpu"),
+                            df.collect(engine="cpu"))
+    finally:
+        _filter_kill(conf, old)
+
+
+def test_large_island_not_demoted(cbo_conf):
+    """A full scan->filter->aggregate pipeline amortizes its upload:
+    CBO must keep it on TPU."""
+    rng = np.random.default_rng(6)
+    t = pa.table({"a": rng.integers(0, 100, 50_000),
+                  "b": rng.random(50_000)})
+    session = TpuSession()
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import sum_
+
+    df = (session.create_dataframe(t)
+          .where(col("a") > lit(10))
+          .agg((sum_(col("b")), "s")))
+    exec_, meta = plan_query(df._plan)
+    reasons = set()
+
+    def walk(m):
+        reasons.update(m.reasons)
+        for c in m.children:
+            walk(c)
+    walk(meta)
+    assert DEMOTION_REASON not in reasons, meta.explain()
+    assert _tpu_nodes(exec_)
+
+
+def test_cbo_off_keeps_island(cbo_conf):
+    conf = cbo_conf
+    conf.set(CBO_ENABLED.key, False)
+    rng = np.random.default_rng(7)
+    t = pa.table({"a": rng.integers(0, 100, 2000)})
+    session = TpuSession()
+    old = _filter_kill(conf, False)
+    try:
+        from spark_rapids_tpu.exprs.base import lit
+
+        df = (session.create_dataframe(t)
+              .where(col("a") > lit(10))
+              .select((col("a") + col("a")).alias("a2"))
+              .where(col("a2") > lit(50)))
+        exec_, _ = plan_query(df._plan)
+        assert _tpu_nodes(exec_)  # island stays on TPU without CBO
+    finally:
+        _filter_kill(conf, old)

@@ -98,12 +98,28 @@ def test_partition_wise_join_when_both_sides_large(session):
             "k": rng.integers(0, 50, 4000),
             "vb": rng.integers(0, 100, 4000).astype(np.int64)}))
         df = a.join(b, on="k")
+        # adaptive on (the default): the planner defers the
+        # partition-wise shape behind an adaptive join over exchanges
+        from spark_rapids_tpu.execs.adaptive import TpuAdaptiveJoinExec
+
         exec_, _ = plan_query(df._plan, conf)
-        assert isinstance(exec_, TpuShuffledHashJoinExec)
-        assert exec_.partition_wise
+        assert isinstance(exec_, TpuAdaptiveJoinExec)
         assert TpuShuffleExchangeExec in _exec_types(df)
-        assert exec_.num_partitions > 1
         assert_tpu_cpu_equal(df)
+
+        # adaptive off: the static partition-wise plan
+        from spark_rapids_tpu.execs.adaptive import ADAPTIVE_ENABLED
+
+        old_adaptive = conf.get(ADAPTIVE_ENABLED)
+        conf.set(ADAPTIVE_ENABLED.key, False)
+        try:
+            exec_, _ = plan_query(df._plan, conf)
+            assert isinstance(exec_, TpuShuffledHashJoinExec)
+            assert exec_.partition_wise
+            assert exec_.num_partitions > 1
+            assert_tpu_cpu_equal(df)
+        finally:
+            conf.set(ADAPTIVE_ENABLED.key, old_adaptive)
     finally:
         conf.set(BROADCAST_THRESHOLD.key, old)
         conf.set(BATCH_SIZE_ROWS.key, old_bs)
