@@ -46,7 +46,9 @@ def make_lineitem(dirpath: str):
         t = pa.table({
             "l_quantity": rng.integers(1, 51, ROWS_PER_FILE).astype(
                 np.float64),
-            "l_extendedprice": rng.uniform(900, 105000, ROWS_PER_FILE),
+            # TPC-H spec: l_extendedprice is a 2-decimal money value
+            "l_extendedprice": np.round(
+                rng.uniform(900, 105000, ROWS_PER_FILE), 2),
             "l_discount": rng.integers(0, 11, ROWS_PER_FILE) / 100.0,
             "l_shipdate": rng.integers(8766, 10957, ROWS_PER_FILE).astype(
                 np.int32),

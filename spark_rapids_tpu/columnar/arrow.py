@@ -268,8 +268,8 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
 
         enc = transfer.encode_for_device(arrays, schema, n)
         if enc is not None:
-            staging, plan = enc
-            cols = transfer.decode_on_device(staging, plan, schema)
+            comps_list, plan = enc
+            cols = transfer.decode_on_device(comps_list, plan, schema)
             return ColumnarBatch(cols, n, schema)
 
     cap = capacity if capacity is not None else pad_capacity(n)
@@ -341,17 +341,7 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
             comps += [col.chars, col.lengths, col.validity]
         else:
             comps += [col.data, col.validity]
-    from spark_rapids_tpu.columnar import transfer
-
-    # packed single-round fetch only where latency dominates: the pack
-    # program materializes a staging copy of every component on device,
-    # so big downloads (bandwidth-bound anyway) use direct gets and keep
-    # peak device memory at 1x; it is also the packedUpload fallback
-    total_bytes = sum(getattr(c, "nbytes", 0) for c in comps)
-    if comps and total_bytes <= (32 << 20) and _packed_enabled():
-        host = transfer.fetch_packed(comps)
-    else:
-        host = jax.device_get(comps)
+    host = jax.device_get(comps)  # ONE batched D2H round for the batch
     n = n_live
 
     arrays = []

@@ -1,27 +1,31 @@
-"""Encoded single-buffer H2D / D2H transfer.
+"""Encoded H2D transfer: compact wire encodings + device-side decode.
 
-The interconnect between host and TPU pays (a) a per-transfer latency
-and (b) limited sustained bandwidth — on tunneled PJRT backends both are
-orders of magnitude worse than PCIe.  The reference sidesteps host
-bandwidth by decoding Parquet ON the accelerator (ref:
-GpuParquetScan.scala:495-560 assembles one device buffer and launches
-device decode kernels).  The TPU analog implemented here:
+The host-device link pays limited sustained bandwidth (and, on
+tunneled PJRT backends, orders of magnitude less than PCIe), so the
+bytes crossing the wire — not device compute — bound scan-heavy
+queries.  The reference sidesteps host bandwidth by decoding Parquet ON
+the accelerator (ref: GpuParquetScan.scala:495-560 assembles one device
+buffer and launches device decode kernels).  The TPU analog:
 
 - the host (scan prefetch thread) re-encodes each decoded column into a
   compact wire form: bias-packed integers (uint8/uint16 deltas from a
   per-batch base), dictionary-encoded low-cardinality floats/strings
   (codes + values), raw bytes otherwise;
-- every component is packed into ONE contiguous uint8 staging buffer —
-  a single `jax.device_put` per batch regardless of column count;
-- a cached, jitted *unpack program* (keyed by the static wire plan)
-  reconstructs full-width padded device columns: bitcasts, gathers for
-  dictionary decode, base adds for bias decode, and validity-mask
-  synthesis (`iota < n_live`) so all-valid columns ship zero validity
-  bytes.
+- all components upload in ONE batched `jax.device_put` call;
+- a cached, jitted *decode program* (keyed by the static wire plan)
+  reconstructs full-width padded device columns: gathers for dictionary
+  decode, base adds for bias decode, and validity-mask synthesis
+  (`iota < n_live`) so all-valid columns ship zero validity bytes.
 
 Decode work thus moves from the wire to the VPU, where a gather over a
-few million rows is microseconds.  The same trick in reverse —
-`fetch_packed` — returns any set of device arrays in one D2H round.
+few million rows is microseconds.  Everything is astype/gather/compare —
+deliberately NO bitcast_convert_type: the TPU X64 rewriter cannot
+compile 64-bit bitcasts, so 64-bit columns ride the list as native
+arrays and only sub-32-bit codes get widened on device.
+
+Wire row counts bucket to <=8 sizes per capacity (compile-cache
+stability) and live row count rides as a dynamic scalar, so one
+compiled decode program serves every batch of the same plan.
 """
 
 from __future__ import annotations
@@ -42,11 +46,7 @@ from spark_rapids_tpu.columnar.column import (
     pad_width,
 )
 
-_ALIGN = 8
-_WIRE_BUCKET = 1 << 16  # wire row counts round up to this (compile-cache)
-
 _unpack_cache: dict = {}
-_pack_cache: dict = {}
 _cache_lock = threading.Lock()
 
 
@@ -109,48 +109,111 @@ def _try_dict(vals: np.ndarray) -> Optional[tuple[np.ndarray, np.ndarray]]:
     codes = d.indices.to_numpy(zero_copy_only=False)
     values = d.dictionary.to_numpy(zero_copy_only=False).astype(
         vals.dtype, copy=False)
+    # bit-exactness gate (the contract is byte-identical round-trips):
+    # Arrow's dictionary_encode unifies -0.0 with +0.0, which flips
+    # sign bits downstream (1/x: -inf vs +inf) — verify reconstruction
+    if vals.dtype.kind == "f" and not np.array_equal(
+            values[codes].view(np.int64), vals.view(np.int64)):
+        return None
     return codes, values
 
 
-class _Builder:
-    """Accumulates aligned regions of the staging buffer."""
-
-    def __init__(self, n_header_slots: int):
-        self.chunks: list[tuple[int, np.ndarray]] = []
-        self.off = n_header_slots * 8
-        self.header = np.zeros(n_header_slots, np.int64)
-
-    def add(self, a: np.ndarray) -> int:
-        a = np.ascontiguousarray(a)
-        off = _round_up(self.off, _ALIGN)
-        self.chunks.append((off, a))
-        self.off = off + a.nbytes
-        return off
-
-    def finish(self) -> np.ndarray:
-        total = _round_up(self.off, _ALIGN)
-        buf = np.zeros(total, np.uint8)
-        buf[: len(self.header) * 8] = self.header.view(np.uint8)
-        for off, a in self.chunks:
-            buf[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
-        return buf
+def _try_scaled(vals: np.ndarray) -> Optional[np.ndarray]:
+    """int32 cents for decimal-valued doubles (prices, rates): data that
+    entered the file as 2-decimal values reconstructs BIT-EXACTLY via
+    round(v*100)/100.0, verified here before committing to the wire
+    format — int32 halves the dominant float column's bytes."""
+    if len(vals) == 0 or not np.isfinite(vals).all():
+        return None
+    s = np.rint(vals * 100.0)
+    if (np.abs(s) >= 2**31).any():
+        return None
+    s32 = s.astype(np.int32)
+    r = s32.astype(np.float64) / 100.0
+    if not np.array_equal(r.view(np.int64), vals.view(np.int64)):
+        return None
+    return s32
 
 
 def _padded(a: np.ndarray, wire: int) -> np.ndarray:
-    """Zero-pad a 1-D/2-D per-row array to `wire` rows."""
+    """Zero-pad a 1-D/2-D per-row array to `wire` rows (zero-copy when
+    it already fits exactly)."""
     if len(a) == wire:
-        return a
+        return np.ascontiguousarray(a)
     out = np.zeros((wire,) + a.shape[1:], a.dtype)
     out[: len(a)] = a
     return out
 
 
+class _Comps:
+    """Component accumulator producing the physical upload list.
+
+    Every wire array pays a full link round trip on tunneled PJRT
+    backends, so components are PHYSICALLY packed into as few arrays as
+    possible while keeping the decode program free of 64-bit bitcasts
+    (the TPU X64 rewriter cannot compile those):
+
+    - all <=4-byte components (codes, deltas, lengths, validity, chars,
+      scaled ints) pack into ONE uint8 buffer, recovered on device with
+      32-bit-safe bitcast_convert_type;
+    - all float64 values (dict values, scale divisors) concatenate into
+      ONE f64 sidecar;
+    - int64 scalars (bias bases) split into lo/hi uint32 halves inside
+      the byte buffer and recombine with i64 arithmetic;
+    - only raw 64-bit DATA columns remain individual arrays.
+
+    add() returns an opaque ref the plan stores; the decode program
+    resolves refs against (buffer, sidecar, extras...).
+    """
+
+    def __init__(self):
+        self.buf_parts: list[tuple[int, np.ndarray]] = []  # (off, arr)
+        self.buf_off = 0
+        self.f64_parts: list[np.ndarray] = []
+        self.f64_off = 0
+        self.extras: list[np.ndarray] = []
+
+    def add(self, a: np.ndarray):
+        a = np.ascontiguousarray(a)
+        if a.dtype == np.float64:
+            off = self.f64_off
+            self.f64_parts.append(a.reshape(-1))
+            self.f64_off += a.size
+            return ("f64", off, a.shape)
+        if a.dtype == np.int64 and a.ndim == 0:
+            lo = np.uint32(int(a) & 0xFFFFFFFF)
+            hi = np.uint32((int(a) >> 32) & 0xFFFFFFFF)
+            return ("i64s", self._add_bytes(np.stack([lo, hi])))
+        if a.dtype.itemsize <= 4 and a.dtype != np.int64:
+            return ("buf", self._add_bytes(a), a.shape, str(a.dtype))
+        off = len(self.extras)
+        self.extras.append(a)
+        return ("arr", off)
+
+    def _add_bytes(self, a: np.ndarray) -> int:
+        off = _round_up(self.buf_off, 4)
+        self.buf_parts.append((off, a))
+        self.buf_off = off + a.nbytes
+        return off
+
+    def finish(self) -> list[np.ndarray]:
+        total = _round_up(max(self.buf_off, 4), 4)
+        buf = np.zeros(total, np.uint8)
+        for off, a in self.buf_parts:
+            buf[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
+        out = [buf]
+        out.append(np.concatenate(self.f64_parts)
+                   if self.f64_parts else np.zeros(1, np.float64))
+        out.extend(self.extras)
+        return out
+
+
 def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
-                      n: int) -> Optional[tuple[np.ndarray, tuple]]:
-    """Encode decoded host Arrow columns into (staging_buffer, plan).
+                      n: int) -> Optional[tuple[list, tuple]]:
+    """Encode decoded host Arrow columns into (components, plan).
 
     Returns None when a column type has no wire encoding yet (decimal,
-    list) — callers fall back to the per-component upload path.
+    list) — callers fall back to the per-component padded upload path.
     """
     for f in schema.fields:
         if isinstance(f.dtype, (T.DecimalType, T.ListType)):
@@ -160,32 +223,31 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
 
     cap = pad_capacity(n)
     wire = _wire_rows(n, cap)
-    # header: slot 0 = n_live; one base slot per column (bias encodings)
-    b = _Builder(1 + len(schema.fields))
-    b.header[0] = n
+    comps = _Comps()
+    n_ref = comps.add(np.asarray(n, np.int32))  # dynamic live row count
     entries: list[tuple] = []
 
-    for ci, (arr, f) in enumerate(zip(arrays, schema.fields)):
+    for arr, f in zip(arrays, schema.fields):
         if isinstance(f.dtype, T.StringType):
-            entries.append(_encode_string(b, arr, wire))
+            entries.append(_encode_string(comps, arr, wire))
             continue
         vals, validity = _decode_fixed_host(arr, f.dtype)
-        voff = -1
+        vref = None
         if validity is not None:
-            voff = b.add(_padded(validity.astype(np.uint8), wire))
+            vref = comps.add(_padded(validity, wire))
         phys = vals.dtype
         kind = "raw"
         extra: tuple = ()
-        if phys.kind in _INT_KINDS and phys.itemsize > 1 and n > 0:
+        if phys.kind in _INT_KINDS and phys.itemsize > 1:
             mn = int(vals.min())
             rng = int(vals.max()) - mn
             if rng <= 0xFF:
-                kind, extra = "bias8", ()
-                b.header[1 + ci] = mn
+                kind = "bias"
+                extra = (comps.add(np.asarray(mn, np.int64)),)
                 vals = (vals.astype(np.int64) - mn).astype(np.uint8)
             elif phys.itemsize > 2 and rng <= 0xFFFF:
-                kind, extra = "bias16", ()
-                b.header[1 + ci] = mn
+                kind = "bias"
+                extra = (comps.add(np.asarray(mn, np.int64)),)
                 vals = (vals.astype(np.int64) - mn).astype(np.uint16)
         elif phys.kind == "f":
             enc = _try_dict(vals)
@@ -194,21 +256,26 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
                 code_dt = np.uint8 if len(dvals) <= 0x100 else np.uint16
                 nvp = max(8, pad_capacity(len(dvals)))
                 kind = "dict"
-                doff = b.add(_padded(dvals, nvp))
-                extra = (doff, nvp, str(code_dt.__name__)
-                         if hasattr(code_dt, "__name__") else str(code_dt))
+                extra = (comps.add(_padded(dvals, nvp)),)
                 vals = codes.astype(code_dt)
-        if phys == np.bool_:
-            vals = vals.astype(np.uint8)
-        off = b.add(_padded(vals, wire))
-        entries.append(("fixed", kind, off, str(vals.dtype), str(phys),
-                        extra, voff))
+            elif phys.itemsize == 8:
+                scaled = _try_scaled(vals)
+                if scaled is not None:
+                    kind = "scaled"
+                    # divisor rides as a RUNTIME scalar: a literal
+                    # constant lets XLA strength-reduce /100.0 into
+                    # *(1/100.0), which breaks the bit-exactness the
+                    # host encoder verified
+                    extra = (comps.add(np.asarray(100.0, np.float64)),)
+                    vals = scaled
+        dref = comps.add(_padded(vals, wire))
+        entries.append(("fixed", kind, dref, str(phys), extra, vref))
 
-    plan = (cap, wire, tuple(entries))
-    return b.finish(), plan
+    plan = (cap, wire, n_ref, tuple(entries))
+    return comps.finish(), plan
 
 
-def _encode_string(b: _Builder, arr: pa.Array, wire: int) -> tuple:
+def _encode_string(comps: _Comps, arr: pa.Array, wire: int) -> tuple:
     """Encode one string column; returns its plan entry."""
     sarr = arr.cast(pa.large_string())
     n = len(sarr)
@@ -219,9 +286,9 @@ def _encode_string(b: _Builder, arr: pa.Array, wire: int) -> tuple:
     lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
     if validity is not None:
         lens = np.where(validity, lens, 0).astype(np.int32)
-    voff = -1
+    vref = None
     if validity is not None:
-        voff = b.add(_padded(validity.astype(np.uint8), wire))
+        vref = comps.add(_padded(validity, wire))
 
     # dictionary attempt: low-cardinality string columns ship codes only
     if _string_dict_gate(sarr):
@@ -235,20 +302,21 @@ def _encode_string(b: _Builder, arr: pa.Array, wire: int) -> tuple:
             nvp = max(8, pad_capacity(len(dvals)))
             dchars, dlens = _chars_matrix(dvals.cast(pa.large_string()))
             if not dlens.size or int(dlens.max()) <= 0xFFFF:
-                w = dchars.shape[1] if dchars.size else 1
-                dcoff = b.add(_padded(dchars, nvp))
-                dloff = b.add(_padded(dlens.astype(np.uint16), nvp))
-                coff = b.add(_padded(codes.astype(code_dt), wire))
-                return ("sdict", coff, str(code_dt.__name__), dcoff,
-                        dloff, nvp, w, voff)
+                cref = comps.add(_padded(codes.astype(code_dt), wire))
+                dcref = comps.add(_padded(dchars, nvp))
+                dlref = comps.add(_padded(dlens.astype(np.uint16), nvp))
+                return ("sdict", cref, dcref, dlref, vref)
             # >=64KB dictionary values would wrap the uint16 length
             # wire format: fall through to the raw layout (int32 lens)
 
     chars, _ = _chars_matrix(sarr, lens)
-    w = chars.shape[1] if chars.size else 1
-    coff = b.add(_padded(chars, wire))
-    loff = b.add(_padded(lens.astype(np.int32), wire))
-    return ("sraw", coff, loff, w, voff)
+    cref = comps.add(_padded(chars, wire))
+    # lengths >= 64KiB would wrap uint16: widen the wire type (the
+    # decode side reads whatever dtype the ref carries)
+    len_dt = np.uint16 if (not lens.size or int(lens.max()) <= 0xFFFF) \
+        else np.int32
+    lref = comps.add(_padded(lens.astype(len_dt), wire))
+    return ("sraw", cref, lref, vref)
 
 
 def _string_dict_gate(sarr: pa.Array) -> bool:
@@ -284,110 +352,116 @@ def _chars_matrix(sarr: pa.Array,
 
 
 # ------------------------------------------------------------------ #
-# Device-side unpack program
+# Device-side decode program
 # ------------------------------------------------------------------ #
 
 
-def _bitcast_from_u8(raw: jax.Array, npdt: np.dtype, count: int):
-    if npdt == np.uint8:
-        return raw
-    if npdt.itemsize == 1:
-        return jax.lax.bitcast_convert_type(raw, jnp.dtype(npdt))
-    return jax.lax.bitcast_convert_type(
-        raw.reshape(count, npdt.itemsize), jnp.dtype(npdt))
+def _make_decode(plan: tuple):
+    cap, wire, n_ref, entries = plan
+    pad = cap - wire
 
+    def grow(a):
+        if pad == 0:
+            return a
+        z = jnp.zeros((pad,) + a.shape[1:], a.dtype)
+        return jnp.concatenate([a, z], axis=0)
 
-def _make_unpack(plan: tuple):
-    cap, wire, entries = plan
+    def decode(xs):
+        buf, sidecar = xs[0], xs[1]
 
-    def unpack(buf: jax.Array):
-        n_live = jax.lax.bitcast_convert_type(buf[0:8], jnp.int64)
-        n_live = n_live.reshape(())
-        live_mask = jnp.arange(cap, dtype=jnp.int64) < n_live
-        pad = cap - wire
+        def read(ref):
+            """Resolve one component ref against the physical arrays —
+            only 32-bit-safe bitcasts (see _Comps)."""
+            if ref[0] == "buf":
+                _, off, shape, dt = ref
+                npdt = np.dtype(dt)
+                count = int(np.prod(shape)) if shape else 1
+                raw = jax.lax.slice(buf, (off,),
+                                    (off + count * npdt.itemsize,))
+                if npdt == np.uint8:
+                    col = raw
+                elif npdt == np.bool_:
+                    col = raw != 0
+                elif npdt.itemsize == 1:
+                    col = jax.lax.bitcast_convert_type(
+                        raw, jnp.dtype(npdt))
+                else:
+                    col = jax.lax.bitcast_convert_type(
+                        raw.reshape(count, npdt.itemsize),
+                        jnp.dtype(npdt))
+                return col.reshape(shape)
+            if ref[0] == "f64":
+                _, off, shape = ref
+                count = int(np.prod(shape)) if shape else 1
+                return jax.lax.slice(
+                    sidecar, (off,), (off + count,)).reshape(shape)
+            if ref[0] == "i64s":
+                words = read(("buf", ref[1], (2,), "uint32"))
+                lo = words[0].astype(jnp.int64)
+                hi = words[1].astype(jnp.int64)
+                return (hi << 32) | lo
+            return xs[2 + ref[1]]  # "arr"
 
-        def grow(a):
-            if pad == 0:
-                return a
-            z = jnp.zeros((pad,) + a.shape[1:], a.dtype)
-            return jnp.concatenate([a, z], axis=0)
+        n_live = read(n_ref)
+        live_mask = jnp.arange(cap, dtype=jnp.int32) < n_live
 
-        def read(off, npdt, count):
-            raw = jax.lax.slice(buf, (off,),
-                                (off + count * npdt.itemsize,))
-            return _bitcast_from_u8(raw, npdt, count)
-
-        def validity_of(voff):
-            if voff < 0:
+        def validity_of(vref):
+            if vref is None:
                 return live_mask
-            return grow(read(voff, np.dtype(np.uint8), wire) != 0) \
-                & live_mask
+            return grow(read(vref)) & live_mask
 
         out = []
-        for ci, e in enumerate(entries):
+        for e in entries:
             if e[0] == "fixed":
-                _, kind, off, wiredt, physdt, extra, voff = e
-                npw, npp = np.dtype(wiredt), np.dtype(physdt)
-                vals = read(off, npw, wire)
-                if kind.startswith("bias"):
-                    base = jax.lax.bitcast_convert_type(
-                        buf[(1 + ci) * 8:(1 + ci) * 8 + 8],
-                        jnp.int64).reshape(())
-                    vals = (vals.astype(jnp.int64) + base).astype(
-                        jnp.dtype(npp))
+                _, kind, dref, physdt, extra, vref = e
+                phys = np.dtype(physdt)
+                vals = read(dref)
+                if kind == "bias":
+                    base = read(extra[0])
+                    vals = (vals.astype(jnp.int64) + base).astype(phys)
                 elif kind == "dict":
-                    doff, nvp, _ = extra
-                    dvals = read(doff, npp, nvp)
-                    vals = jnp.take(dvals, vals.astype(jnp.int32), axis=0)
-                elif npp == np.bool_:
-                    vals = vals != 0
-                else:
-                    vals = vals.astype(jnp.dtype(npp)) \
-                        if npw != npp else vals
-                out.append((grow(vals), validity_of(voff)))
+                    vals = jnp.take(read(extra[0]),
+                                    vals.astype(jnp.int32), axis=0)
+                elif kind == "scaled":
+                    # same op the host exactness check performed
+                    vals = vals.astype(phys) / read(extra[0])
+                out.append((grow(vals), validity_of(vref)))
             elif e[0] == "sraw":
-                _, coff, loff, w, voff = e
-                chars = read(coff, np.dtype(np.uint8),
-                             wire * w).reshape(wire, w)
-                lens = read(loff, np.dtype(np.int32), wire)
-                v = validity_of(voff)
-                out.append((grow(chars), grow(lens) * v.astype(jnp.int32),
-                            v))
+                _, cref, lref, vref = e
+                v = validity_of(vref)
+                out.append((grow(read(cref)),
+                            grow(read(lref).astype(jnp.int32))
+                            * v.astype(jnp.int32), v))
             elif e[0] == "sdict":
-                _, coff, codedt, dcoff, dloff, nvp, w, voff = e
-                codes = read(coff, np.dtype(codedt), wire).astype(
-                    jnp.int32)
-                dchars = read(dcoff, np.dtype(np.uint8),
-                              nvp * w).reshape(nvp, w)
-                dlens = read(dloff, np.dtype(np.uint16), nvp).astype(
-                    jnp.int32)
-                v = validity_of(voff)
+                _, cref, dcref, dlref, vref = e
+                codes = read(cref).astype(jnp.int32)
+                v = validity_of(vref)
                 # invariant shared with every string kernel: chars are
                 # zero for null rows and beyond each row's length — a
                 # gathered dict[0] payload on null/padding rows would
                 # break byte-wise comparators
-                chars = grow(jnp.take(dchars, codes, axis=0)) \
+                chars = grow(jnp.take(read(dcref), codes, axis=0)) \
                     * v[:, None].astype(jnp.uint8)
-                lens = grow(jnp.take(dlens, codes, axis=0)) \
+                lens = grow(jnp.take(read(dlref).astype(jnp.int32),
+                                     codes, axis=0)) \
                     * v.astype(jnp.int32)
                 out.append((chars, lens, v))
         return out
 
-    return unpack
+    return decode
 
 
-def decode_on_device(staging: np.ndarray, plan: tuple,
-                     schema: T.Schema):
-    """Upload one staging buffer and run the cached unpack program.
-
-    Returns the list of device columns (order = schema order)."""
+def decode_on_device(comps: list, plan: tuple, schema: T.Schema):
+    """Upload the component list (one batched transfer round) and run
+    the cached decode program.  Returns device columns in schema
+    order."""
     with _cache_lock:
         fn = _unpack_cache.get(plan)
         if fn is None:
-            fn = _unpack_cache[plan] = jax.jit(_make_unpack(plan))
+            fn = _unpack_cache[plan] = jax.jit(_make_decode(plan))
             while len(_unpack_cache) > 256:
                 _unpack_cache.pop(next(iter(_unpack_cache)))
-    dev = jax.device_put(staging)
+    dev = jax.device_put(comps)
     parts = fn(dev)
     cols = []
     for f, p in zip(schema.fields, parts):
@@ -398,67 +472,3 @@ def decode_on_device(staging: np.ndarray, plan: tuple,
             data, valid = p
             cols.append(Column(data, valid, f.dtype))
     return cols
-
-
-# ------------------------------------------------------------------ #
-# Packed D2H fetch
-# ------------------------------------------------------------------ #
-
-
-def fetch_packed(comps: Sequence[jax.Array]) -> list[np.ndarray]:
-    """Return host copies of device arrays in ONE D2H transfer.
-
-    A cached jitted pack program bitcasts every component to uint8 and
-    concatenates (8-aligned) into a single buffer; the host slices views
-    back out.  D2H on tunneled links pays a full latency round per
-    transfer, so one packed round beats per-array gets by ~column-count.
-    """
-    comps = list(comps)
-    if not comps:
-        return []
-    layout = []
-    off = 0
-    for a in comps:
-        npdt = np.dtype(a.dtype)
-        count = int(np.prod(a.shape)) if a.ndim else 1
-        off = _round_up(off, _ALIGN)
-        layout.append((off, tuple(a.shape), str(npdt), count))
-        off += count * npdt.itemsize
-    total = _round_up(max(off, _ALIGN), _ALIGN)
-    key = (total, tuple(layout))
-
-    with _cache_lock:
-        fn = _pack_cache.get(key)
-        if fn is None:
-            def make(layout=tuple(layout), total=total):
-                def pack(xs):
-                    buf = jnp.zeros(total, jnp.uint8)
-                    for a, (o, shape, dt, count) in zip(xs, layout):
-                        npdt = np.dtype(dt)
-                        flat = a.reshape(count) if a.ndim != 1 else a
-                        if npdt == np.bool_:
-                            rawb = flat.astype(jnp.uint8)
-                        elif npdt.itemsize == 1:
-                            rawb = jax.lax.bitcast_convert_type(
-                                flat, jnp.uint8)
-                        else:
-                            rawb = jax.lax.bitcast_convert_type(
-                                flat, jnp.uint8).reshape(
-                                    count * npdt.itemsize)
-                        buf = jax.lax.dynamic_update_slice(
-                            buf, rawb, (o,))
-                    return buf
-                return pack
-            fn = _pack_cache[key] = jax.jit(make())
-            while len(_pack_cache) > 256:
-                _pack_cache.pop(next(iter(_pack_cache)))
-    host = np.asarray(jax.device_get(fn(comps)))
-    out = []
-    for o, shape, dt, count in layout:
-        npdt = np.dtype(dt)
-        if npdt == np.bool_:
-            a = host[o: o + count] != 0
-        else:
-            a = host[o: o + count * npdt.itemsize].view(npdt)[:count]
-        out.append(a.reshape(shape))
-    return out

@@ -1,0 +1,148 @@
+"""Pushed-filter compilation to pyarrow.compute.
+
+The scan's host prefilter (io/scan.py) must run at decode speed: the
+CPU engine's cpu_eval is a per-batch Python/numpy interpreter (built
+for oracle fidelity, not throughput), while pyarrow.compute kernels are
+multi-threaded C++ that release the GIL — the same division of labor
+the reference gets from Arrow-native filtering before device transfer.
+
+`compile_filter` translates the supported predicate subset (column
+refs, literals, comparisons, boolean connectives, null checks, IN
+lists) into a callable `table -> bool Array`; anything outside the
+subset returns None and the caller falls back to cpu_eval.  SQL
+semantics note: the caller treats null mask slots as FALSE (rows only
+survive a Filter when the condition is TRUE), so kernels here may
+propagate nulls freely.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import pyarrow.compute as pc
+
+from spark_rapids_tpu.exprs import base as B
+
+
+def compile_filter(e) -> Optional[Callable]:
+    """expr -> (table -> pa.BooleanArray), or None when unsupported."""
+    try:
+        fn = _compile(e)
+    except _Unsupported:
+        return None
+    return fn
+
+
+class _Unsupported(Exception):
+    pass
+
+
+_CMP = {
+    "GreaterThan": pc.greater,
+    "GreaterThanOrEqual": pc.greater_equal,
+    "LessThan": pc.less,
+    "LessThanOrEqual": pc.less_equal,
+    "EqualTo": pc.equal,
+    "NotEqual": pc.not_equal,
+}
+
+
+def _compile(e) -> Callable:
+    name = type(e).__name__
+    if isinstance(e, B.BoundReference):
+        i = e.ordinal
+        return lambda t: t.column(i)
+    if isinstance(e, B.Literal):
+        v = e.value
+        return lambda t: v
+    if name in _CMP:
+        kids = _children(e)
+        if len(kids) != 2:
+            raise _Unsupported
+        lf, rf = _compile(kids[0]), _compile(kids[1])
+        if any(getattr(k, "dtype", None) is not None
+               and type(k.dtype).__name__ in ("FloatType", "DoubleType")
+               for k in kids):
+            # Spark float total order (predicates.py:53): NaN == NaN is
+            # true and NaN sorts greater than everything — IEEE kernels
+            # would silently drop NaN rows the device Filter keeps
+            return _float_cmp(name, lf, rf)
+        op = _CMP[name]
+        return lambda t: op(lf(t), rf(t))
+    if name == "And":
+        kids = _children(e)
+        lf, rf = _compile(kids[0]), _compile(kids[1])
+        return lambda t: pc.and_kleene(lf(t), rf(t))
+    if name == "Or":
+        kids = _children(e)
+        lf, rf = _compile(kids[0]), _compile(kids[1])
+        return lambda t: pc.or_kleene(lf(t), rf(t))
+    if name == "Not":
+        kf = _compile(_children(e)[0])
+        return lambda t: pc.invert(kf(t))
+    if name == "IsNull":
+        kf = _compile(_children(e)[0])
+        return lambda t: pc.is_null(kf(t))
+    if name == "IsNotNull":
+        kf = _compile(_children(e)[0])
+        return lambda t: pc.is_valid(kf(t))
+    if name == "In":
+        kids = _children(e)
+        kf = _compile(kids[0])
+        vals = getattr(e, "values", None)
+        if vals is None or not all(isinstance(v, B.Literal)
+                                   for v in vals):
+            raise _Unsupported
+        import pyarrow as pa
+
+        vset = pa.array([v.value for v in vals])
+        return lambda t: pc.is_in(kf(t), value_set=vset)
+    raise _Unsupported
+
+
+def _float_cmp(name: str, lf: Callable, rf: Callable) -> Callable:
+    def nan(x):
+        try:
+            return pc.is_nan(x)
+        except Exception:
+            return False  # integer literal side: never NaN
+
+    def fn(t):
+        l, r = lf(t), rf(t)
+        ln, rn = nan(l), nan(r)
+        eq = pc.or_kleene(pc.equal(l, r), pc.and_kleene(ln, rn)) \
+            if ln is not False and rn is not False \
+            else pc.equal(l, r)
+        lt = pc.less(l, r)
+        if rn is not False:
+            not_ln = pc.invert(ln) if ln is not False else True
+            lt = pc.or_kleene(lt, pc.and_kleene(not_ln, rn)
+                              if not_ln is not True else rn)
+        if name == "EqualTo":
+            return eq
+        if name == "NotEqual":
+            return pc.invert(eq)
+        if name == "LessThan":
+            return lt
+        if name == "LessThanOrEqual":
+            return pc.or_kleene(lt, eq)
+        if name == "GreaterThan":
+            return pc.invert(pc.or_kleene(lt, eq))
+        return pc.invert(lt)  # GreaterThanOrEqual
+
+    return fn
+
+
+def _children(e):
+    kids = getattr(e, "children", None)
+    if kids is None:
+        import dataclasses
+
+        if dataclasses.is_dataclass(e):
+            kids = [v for v in
+                    (getattr(e, f.name)
+                     for f in dataclasses.fields(e))
+                    if isinstance(v, B.Expression)]
+        else:
+            raise _Unsupported
+    return list(kids)

@@ -104,6 +104,22 @@ MAX_READ_BATCH_BYTES = _config.register(
     "this size amortize per-dispatch/per-transfer latency while still "
     "pipelining decode -> upload -> compute across batches.")
 
+HOST_PREFILTER = _config.register(
+    "spark.rapids.tpu.sql.scan.hostPrefilter", True,
+    "Evaluate a scan-adjacent Filter's deterministic condition on the "
+    "host right after decode and ship only surviving rows across the "
+    "host->device link (the filter-pushdown-into-scan contract of "
+    "DataSourceV2; ref: the reference's row-group/page pruning, "
+    "GpuParquetScan.scala:263-306, taken to row granularity).  The "
+    "exact Filter still runs on device — the prefilter only shrinks "
+    "the wire, it never decides semantics.")
+
+SCAN_DECODE_THREADS = _config.register(
+    "spark.rapids.tpu.sql.scan.decodeThreads", 4,
+    "Host threads decoding a task's files concurrently (the multi-file "
+    "cloud reader's pool, ref: GpuParquetScan.scala:882-895 "
+    "MultiFileCloudParquetPartitionReader).")
+
 
 def _task_target_bytes() -> int:
     return _config.get_conf().get(FILES_PER_TASK_BYTES)
@@ -257,7 +273,8 @@ class ParquetScanExec(TpuExec):
     def additional_metrics(self):
         return [("scanTime", "MODERATE"),
                 ("filesPruned", "ESSENTIAL"),
-                ("rowGroupsPruned", "ESSENTIAL")]
+                ("rowGroupsPruned", "ESSENTIAL"),
+                ("hostFilteredRows", "ESSENTIAL")]
 
     @property
     def num_partitions(self) -> int:
@@ -347,20 +364,73 @@ class ParquetScanExec(TpuExec):
                 return
         else:
             keep_rgs = list(range(n_rgs))
+
+        if f.metadata.num_rows <= self.batch_rows:
+            # whole file fits one scan batch: single threaded columnar
+            # read (iter_batches re-slices row groups and serializes
+            # column decode; read_row_groups decodes all columns with
+            # the Arrow C++ pool)
+            tbl = f.read_row_groups(keep_rgs, columns=self.columns,
+                                    use_threads=True)
+            for f2 in self.partition_fields:
+                tbl = tbl.append_column(
+                    f2.name,
+                    self._host_partition_array(fi, f2, tbl.num_rows))
+            yield self._host_prefilter(tbl)
+            return
         for rb in f.iter_batches(batch_size=self.batch_rows,
                                  columns=self.columns,
-                                 row_groups=keep_rgs):
+                                 row_groups=keep_rgs,
+                                 use_threads=True):
             tbl = pa.Table.from_batches([rb])
             for f2 in self.partition_fields:
                 tbl = tbl.append_column(
                     f2.name,
                     self._host_partition_array(fi, f2, rb.num_rows))
-            yield tbl
+            yield self._host_prefilter(tbl)
 
     def _upload(self, tables: list) -> ColumnarBatch:
         tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
         b = from_arrow(tbl)
         return ColumnarBatch(b.columns, b.num_rows, self._schema)
+
+    def _prefilter_active(self) -> bool:
+        if self.pushed_filter is None \
+                or not _config.get_conf().get(HOST_PREFILTER):
+            return False
+        from spark_rapids_tpu.exprs.nondeterministic import (
+            tree_is_partition_aware,
+        )
+
+        # a nondeterministic predicate must evaluate exactly once, on
+        # device, with its partition context — never pre-applied
+        return not tree_is_partition_aware(self.pushed_filter)
+
+    def _host_prefilter(self, tbl: pa.Table) -> pa.Table:
+        """Drop rows the pushed Filter must reject, BEFORE they cross
+        the wire.  Prefers the compiled pyarrow.compute form (C++
+        multi-threaded, GIL-free — decode-speed); falls back to the CPU
+        engine's interpreter for predicates outside that subset.
+        Conservative only in failure: any evaluation problem disables
+        prefiltering and ships everything; the device Filter is always
+        the source of truth."""
+        if not getattr(self, "_prefilter_on", False) or tbl.num_rows == 0:
+            return tbl
+        try:
+            import pyarrow.compute as pc
+
+            if self._pa_filter is not None:
+                mask = self._pa_filter(tbl)
+            else:
+                from spark_rapids_tpu.cpu.engine import cpu_eval
+
+                mask = cpu_eval(self.pushed_filter, tbl)
+            kept = tbl.filter(pc.fill_null(mask, False))
+        except Exception:
+            self._prefilter_on = False  # unsupported expr: stop trying
+            return tbl
+        self.metrics["hostFilteredRows"].add(tbl.num_rows - kept.num_rows)
+        return kept
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         """Accumulates decoded host tables ACROSS row groups and files
@@ -368,10 +438,52 @@ class ParquetScanExec(TpuExec):
         transfer round: few big batches, not many small ones — on TPU
         the per-dispatch/per-transfer latency dominates small batches."""
         conjuncts = self._conjuncts()
+        self._prefilter_on = self._prefilter_active()
+        self._pa_filter = None
+        if self._prefilter_on:
+            from spark_rapids_tpu.io.pa_filter import compile_filter
+
+            self._pa_filter = compile_filter(self.pushed_filter)
 
         def task():
-            for fi in self._groups[p]:
-                yield from self._file_tables(fi, conjuncts)
+            import os
+
+            files = self._groups[p]
+            conf = _config.get_conf()
+            # the pool materializes each file's decoded tables before
+            # yielding, so it is bounded to files that fit one scan
+            # batch (threads x batch bytes of host memory); bigger
+            # files keep the one-table-at-a-time streaming path
+            big = any(
+                os.path.getsize(self.paths[fi]) >
+                conf.get(MAX_READ_BATCH_BYTES)
+                for fi in files if os.path.exists(self.paths[fi]))
+            threads = min(conf.get(SCAN_DECODE_THREADS), len(files))
+            if threads <= 1 or big:
+                for fi in files:
+                    yield from self._file_tables(fi, conjuncts)
+                return
+            # per-file decode pool with a bounded in-flight window (the
+            # MultiFileCloud reader shape): file k+threads starts while
+            # file k's tables are being consumed, order preserved
+            from concurrent.futures import ThreadPoolExecutor
+
+            def decode(fi):
+                return list(self._file_tables(fi, conjuncts))
+
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                pending = []
+                it = iter(files)
+                for fi in it:
+                    pending.append(pool.submit(decode, fi))
+                    if len(pending) >= threads:
+                        break
+                while pending:
+                    done = pending.pop(0)
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        pending.append(pool.submit(decode, nxt))
+                    yield from done.result()
 
         empty = True
         acc: list[pa.Table] = []
@@ -450,7 +562,7 @@ class OrcScanExec(ParquetScanExec):
                 tbl = tbl.append_column(
                     f2.name,
                     self._host_partition_array(fi, f2, tbl.num_rows))
-            yield tbl
+            yield self._host_prefilter(tbl)
 
 
 class CsvScanExec(TpuExec):

@@ -60,25 +60,62 @@ def _snap(node: TpuExec) -> NodeSnapshot:
 
 
 class QueryHistory:
-    """Session-attached ring of recent QueryEvents."""
+    """Session-attached ring of recent QueryEvents.
+
+    `record` snapshots on a background worker: settling device-synced
+    timers means waiting for completion notifications, which on remote
+    PJRT links can lag the actual result by over a second — that wait
+    must not sit on collect()'s critical path.  Every reader drains the
+    worker first, so observable history is always consistent."""
+
+    #: ONE process-wide snapshot worker (daemon): per-session pools
+    #: would leak a thread per TpuSession for the process lifetime
+    _pool = None
+    _pool_lock = None
+
+    @classmethod
+    def _worker(cls):
+        import concurrent.futures
+        import threading
+
+        if cls._pool_lock is None:
+            cls._pool_lock = threading.Lock()
+        with cls._pool_lock:
+            if cls._pool is None:
+                cls._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="query-history")
+            return cls._pool
 
     def __init__(self, capacity: int = 100):
         self.capacity = capacity
         self._events: list[QueryEvent] = []
         self._next_id = 0
+        self._pending: list = []
 
     def record(self, explain: str, exec_tree: TpuExec,
-               wall_s: float) -> QueryEvent:
-        ev = QueryEvent(self._next_id, explain, snapshot_exec(exec_tree),
-                        wall_s, time.time())
+               wall_s: float) -> None:
+        qid = self._next_id
         self._next_id += 1
-        self._events.append(ev)
-        if len(self._events) > self.capacity:
-            self._events.pop(0)
-        return ev
+        ts = time.time()
+
+        def snap():
+            ev = QueryEvent(qid, explain, snapshot_exec(exec_tree),
+                            wall_s, ts)
+            self._events.append(ev)
+            if len(self._events) > self.capacity:
+                self._events.pop(0)
+        # drop settled futures so a never-inspected history stays O(1)
+        self._pending = [f for f in self._pending if not f.done()]
+        self._pending.append(self._worker().submit(snap))
+
+    def _drain(self) -> None:
+        pending, self._pending = self._pending, []
+        for f in pending:
+            f.result()
 
     @property
     def events(self) -> list[QueryEvent]:
+        self._drain()
         return list(self._events)
 
 

@@ -75,23 +75,25 @@ def test_encoded_strings_raw_and_dict():
 
 
 def test_encode_plan_kinds():
-    """The encoder actually picks the compact encodings (not just raw)."""
+    """The encoder actually picks the compact encodings (not just raw),
+    and nothing on the device path needs a 64-bit bitcast (the TPU X64
+    rewriter cannot compile those) — 64-bit data rides as native
+    arrays."""
     t = _mixed_table()
     from spark_rapids_tpu.columnar.arrow import schema_from_arrow
 
-    enc = transfer.encode_for_device(t.columns and
-                                     [c.combine_chunks() for c in
-                                      (t.combine_chunks().columns)],
-                                     schema_from_arrow(t.schema),
+    arrays = [c.combine_chunks() for c in t.combine_chunks().columns]
+    enc = transfer.encode_for_device(arrays, schema_from_arrow(t.schema),
                                      t.num_rows)
     assert enc is not None
-    staging, plan = enc
-    kinds = {e[1] if e[0] == "fixed" else e[0] for e in plan[2]}
-    assert "bias8" in kinds
-    assert "bias16" in kinds
-    assert "dict" in kinds
+    comps, plan = enc
+    kinds = [e[1] for e in plan[3] if e[0] == "fixed"]
+    assert kinds.count("bias") >= 2  # small_i64 and mid_i32
+    assert "dict" in kinds  # lowcard_f64
+    assert "raw" in kinds  # wide_i64, rand_f64
     # encoded wire is much smaller than the raw table bytes
-    assert staging.nbytes < 0.7 * t.nbytes
+    total = sum(a.nbytes for a in comps)
+    assert total < 0.7 * t.nbytes
 
 
 def test_wire_bytes_shrink_vs_raw():
@@ -109,21 +111,103 @@ def test_wire_bytes_shrink_vs_raw():
     arrays = [c.combine_chunks() for c in t.combine_chunks().columns]
     enc = transfer.encode_for_device(arrays, schema_from_arrow(t.schema),
                                      n)
-    staging, plan = enc
+    comps, plan = enc
     # price (8B) dominates; qty/disc ship as u8 codes, shipdate as u16
-    assert staging.nbytes < 0.45 * t.nbytes
+    total = sum(a.nbytes for a in comps)
+    assert total < 0.45 * t.nbytes
 
 
-def test_fetch_packed_matches_device_get():
-    import jax.numpy as jnp
+def test_scaled_decimal_floats():
+    """2-decimal money doubles ship as int32 cents and reconstruct
+    bit-exactly; NaN/wide values refuse the encoding."""
+    rng = np.random.default_rng(4)
+    n = 20000
+    prices = np.round(rng.uniform(900, 105000, n), 2)
+    t = pa.table({"price": prices,
+                  "wild": rng.random(n) * 1e18,
+                  "withnan": np.where(rng.random(n) < 0.01, np.nan,
+                                      np.round(rng.random(n), 2))})
+    from spark_rapids_tpu.columnar.arrow import schema_from_arrow
 
-    comps = [jnp.arange(100, dtype=jnp.float64),
-             jnp.arange(7, dtype=jnp.int32),
-             jnp.ones((5, 3), jnp.uint8),
-             jnp.array([True, False, True])]
-    host = transfer.fetch_packed(comps)
-    for h, c in zip(host, comps):
-        np.testing.assert_array_equal(h, np.asarray(c))
+    arrays = [c.combine_chunks() for c in t.combine_chunks().columns]
+    enc = transfer.encode_for_device(arrays, schema_from_arrow(t.schema),
+                                     n)
+    comps, plan = enc
+    kinds = {e[1] for e in plan[3] if e[0] == "fixed"}
+    entries = {f.name: e[1] for f, e in zip(t.schema, plan[3])}
+    assert entries["price"] == "scaled"
+    assert entries["wild"] == "raw"
+    assert entries["withnan"] == "raw"
+    got = roundtrip(t)
+    assert np.array_equal(
+        np.asarray(got.column("price")).view(np.int64),
+        prices.view(np.int64))
+
+
+def test_host_prefilter_differential(tmp_path):
+    """Scan-level host prefilter ships only matching rows; results are
+    identical to the unfiltered path and the CPU oracle (nulls in the
+    predicate column must not leak through)."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.io.scan import HOST_PREFILTER
+    from spark_rapids_tpu.session import TpuSession, col
+    from spark_rapids_tpu.exprs.base import lit
+
+    rng = np.random.default_rng(8)
+    n = 30000
+    vals = rng.integers(0, 100, n).astype(np.float64)
+    nulls = rng.random(n) < 0.1
+    t = pa.table({
+        "x": pa.array([None if m else float(v)
+                       for v, m in zip(vals, nulls)], pa.float64()),
+        "y": rng.random(n),
+    })
+    p = str(tmp_path / "pf.parquet")
+    pq.write_table(t, p)
+    session = TpuSession()
+    conf = get_conf()
+    df = session.read_parquet(p).where(col("x") < lit(10.0))
+
+    want = df.collect(engine="cpu")
+    got_on = df.collect(engine="tpu")
+    old = conf.get(HOST_PREFILTER)
+    try:
+        conf.set(HOST_PREFILTER.key, False)
+        got_off = df.collect(engine="tpu")
+    finally:
+        conf.set(HOST_PREFILTER.key, old)
+    for g in (got_on, got_off):
+        assert sorted(map(str, g.to_pylist()), key=str) \
+            == sorted(map(str, want.to_pylist()), key=str)
+
+
+def test_host_prefilter_spark_nan_semantics(tmp_path):
+    """Spark's float total order (NaN == NaN, NaN greatest) must survive
+    the pyarrow-compiled prefilter: NaN rows pass `x > 5` and `x >= x`
+    even though IEEE comparisons say false."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.session import TpuSession, col
+    from spark_rapids_tpu.exprs.base import lit
+
+    rng = np.random.default_rng(9)
+    n = 20000
+    x = rng.random(n) * 10
+    x[rng.random(n) < 0.05] = np.nan
+    t = pa.table({"x": x, "y": rng.random(n)})
+    p = str(tmp_path / "nanpf.parquet")
+    pq.write_table(t, p)
+    session = TpuSession()
+    for cond in (col("x") > lit(5.0), col("x") <= lit(5.0),
+                 col("x") >= col("x")):
+        df = session.read_parquet(p).where(cond)
+        got = df.collect(engine="tpu")
+        want = df.collect(engine="cpu")
+        assert got.num_rows == want.num_rows, str(cond)
+        assert sorted(map(str, got.to_pylist())) \
+            == sorted(map(str, want.to_pylist()))
 
 
 def test_legacy_fallback_for_decimal_and_list():
@@ -134,6 +218,21 @@ def test_legacy_fallback_for_decimal_and_list():
         "l": pa.array([[1, 2], None], pa.list_(pa.int64())),
     })
     assert_tables_equal(roundtrip(t), t)
+
+
+def test_long_string_lengths_survive():
+    """>=64KiB strings must not wrap the uint16 length wire format."""
+    t = pa.table({"s": pa.array(["A" * 70000, "short", None])})
+    got = roundtrip(t)
+    assert got.column("s").to_pylist() == ["A" * 70000, "short", None]
+
+
+def test_negative_zero_floats_survive():
+    """-0.0 must keep its sign bit through the dict encoding path."""
+    vals = np.array([0.0, -0.0, 1.5, -0.0, 0.0, 1.5] * 100)
+    t = pa.table({"z": vals})
+    got = np.asarray(roundtrip(t).column("z"))
+    assert np.array_equal(got.view(np.int64), vals.view(np.int64))
 
 
 def test_empty_and_single_row():
