@@ -1081,12 +1081,7 @@ def _window_cpu(plan: L.Window) -> pa.Table:
                             m - 1, pos + frame.end)
                         if hi < lo or hi < 0:  # empty frame (e.g. end
                             lo, hi = 0, -1  # still before the partition)
-                    else:
-                        if frame.start is not None or frame.end not in (
-                                0, None):
-                            raise NotImplementedError(
-                                "bounded RANGE window frames (value-based "
-                                "offsets) are not implemented")
+                    elif frame.start is None and frame.end in (0, None):
                         lo = 0
                         if frame.end is None:
                             hi = m - 1
@@ -1094,6 +1089,47 @@ def _window_cpu(plan: L.Window) -> pa.Table:
                             hi = pos
                             while hi + 1 < m and gok[hi + 1] == gok[pos]:
                                 hi += 1
+                    else:
+                        # bounded value-based RANGE frame: one numeric
+                        # order key; descending measures the offset the
+                        # other way; a null-key row's frame is its null
+                        # peer block (Spark RangeFrame semantics)
+                        sval = ovals[0]
+                        desc = spec.order_by[0].descending
+                        v = sval[g[pos]]
+
+                        def _ordnum(x):
+                            import datetime
+
+                            if isinstance(x, datetime.datetime):
+                                if x.tzinfo is None:
+                                    # Arrow hands back naive UTC; a
+                                    # bare .timestamp() would apply
+                                    # the machine's local timezone/DST
+                                    x = x.replace(
+                                        tzinfo=datetime.timezone.utc)
+                                return int(x.timestamp() * 1e6)
+                            if isinstance(x, datetime.date):
+                                return x.toordinal()
+                            return x
+
+                        def in_frame(q):
+                            u = sval[g[q]]
+                            if v is None or u is None:
+                                return v is None and u is None
+                            un, vn = _ordnum(u), _ordnum(v)
+                            d = (un - vn) if not desc else (vn - un)
+                            if frame.start is not None and d < frame.start:
+                                return False
+                            if frame.end is not None and d > frame.end:
+                                return False
+                            return True
+
+                        members = [q for q in range(m) if in_frame(q)]
+                        if members:
+                            lo, hi = members[0], members[-1]
+                        else:
+                            lo, hi = 0, -1
                     col[i] = _frame_agg(fn.agg, vals, g, lo, hi)
         # order within ties of the TPU sort may differ; that is fine — the
         # differential harness compares row sets, and ranking fns only

@@ -74,6 +74,19 @@ class TpuShuffleExchangeExec(TpuExec):
     # -- map stage -------------------------------------------------------- #
 
     def _run_map_task(self, child_part: int) -> None:
+        from spark_rapids_tpu.execs.retry import with_task_retries
+
+        with_task_retries(lambda: self._map_task_attempt(child_part),
+                          desc=f"map task {child_part}")
+        self.metrics["mapTasks"].add(1)
+
+    def _map_task_attempt(self, child_part: int) -> None:
+        """One attempt of a deterministic map task.  Output batches
+        register with the spill store immediately (spillable under
+        pressure) but publish to the shuffle manager only when the
+        whole attempt COMMITS — a failed attempt closes its handles
+        and leaves no partial blocks (MapStatus commit protocol; the
+        retry wrapper then re-runs from lineage)."""
         sem = TpuSemaphore.get()
         task_id = threading.get_ident() ^ (child_part << 20)
         manager = get_shuffle_manager()
@@ -101,7 +114,10 @@ class TpuShuffleExchangeExec(TpuExec):
                     pid_fn = self._pid_fns[key] = cached_jit(
                         ck, lambda: part.partition_ids)
         from spark_rapids_tpu.columnar.column import pad_capacity
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
 
+        store = get_store()
+        pending: list[tuple[int, object, int, int]] = []
         try:
             for batch in self.children[0].execute_partition(child_part):
                 sem.acquire_if_necessary(task_id)
@@ -114,11 +130,19 @@ class TpuShuffleExchangeExec(TpuExec):
                     rows = sub.concrete_num_rows()
                     if rows:
                         sub = sub.shrink_to_capacity(pad_capacity(rows))
-                        self.metrics["shuffleWriteRows"].add(rows)
-                        manager.write(self._shuffle_id, rid, sub)
+                        h = store.register(
+                            sub, SpillPriorities.OUTPUT_FOR_SHUFFLE)
+                        h.unpin()
+                        pending.append((rid, h, h.nbytes, rows))
+        except BaseException:
+            for _rid, h, _b, _r in pending:
+                h.close()
+            raise
         finally:
             sem.release_if_necessary(task_id)
-        self.metrics["mapTasks"].add(1)
+        for _rid, _h, _b, rows in pending:
+            self.metrics["shuffleWriteRows"].add(rows)
+        manager.commit_task(self._shuffle_id, pending)
 
     def _ensure_map_stage(self) -> None:
         from spark_rapids_tpu.ops.partition import RangePartitioning
@@ -168,29 +192,47 @@ class TpuShuffleExchangeExec(TpuExec):
         state_lock = threading.Lock()
 
         def pass1(child_part: int) -> None:
-            task_id = threading.get_ident() ^ (child_part << 20)
-            try:
-                for batch in self.children[0].execute_partition(child_part):
-                    sem.acquire_if_necessary(task_id)
-                    rows = batch.concrete_num_rows()
-                    if rows == 0:
-                        continue
-                    batch = _dc.replace(batch, num_rows=rows)
-                    jit_sample = cached_jit(
-                        ("rangesample", pkey, batch.capacity, n_sample,
-                         repr(batch.schema)),
-                        lambda: lambda b, p: part.key_batch(b).gather(
-                            p, n_sample))
-                    with rng_lock:
-                        pos = rng.integers(0, rows, n_sample).astype(
-                            np.int32)
-                    s = jit_sample(batch, jnp.asarray(pos, jnp.int32))
-                    with state_lock:
-                        samples.append(s)
-                        handles.append(store.register(
+            from spark_rapids_tpu.execs.retry import with_task_retries
+
+            def attempt():
+                """Accumulates locally; merges into the shared state
+                only on success so a retried attempt never double-adds
+                samples or leaks handles."""
+                task_id = threading.get_ident() ^ (child_part << 20)
+                local_s: list = []
+                local_h: list = []
+                try:
+                    for batch in self.children[0].execute_partition(
+                            child_part):
+                        sem.acquire_if_necessary(task_id)
+                        rows = batch.concrete_num_rows()
+                        if rows == 0:
+                            continue
+                        batch = _dc.replace(batch, num_rows=rows)
+                        jit_sample = cached_jit(
+                            ("rangesample", pkey, batch.capacity,
+                             n_sample, repr(batch.schema)),
+                            lambda: lambda b, p: part.key_batch(
+                                b).gather(p, n_sample))
+                        with rng_lock:
+                            pos = rng.integers(0, rows, n_sample).astype(
+                                np.int32)
+                        local_s.append(
+                            jit_sample(batch, jnp.asarray(pos,
+                                                          jnp.int32)))
+                        local_h.append(store.register(
                             batch, SpillPriorities.COALESCE_PENDING))
-            finally:
-                sem.release_if_necessary(task_id)
+                except BaseException:
+                    for h in local_h:
+                        h.close()
+                    raise
+                finally:
+                    sem.release_if_necessary(task_id)
+                with state_lock:
+                    samples.extend(local_s)
+                    handles.extend(local_h)
+
+            with_task_retries(attempt, desc=f"range pass1 {child_part}")
 
         n_tasks = self.children[0].num_partitions
         self._run_tasks(pass1, n_tasks, threads)
@@ -215,26 +257,45 @@ class TpuShuffleExchangeExec(TpuExec):
         from spark_rapids_tpu.columnar.column import pad_capacity
 
         def pass2(idx: int) -> None:
-            task_id = threading.get_ident() ^ (idx << 20) ^ 0x2
-            try:
+            from spark_rapids_tpu.execs.retry import with_task_retries
+
+            def attempt():
+                """Buffers output handles and commits atomically (same
+                MapStatus protocol as the hash map task)."""
+                task_id = threading.get_ident() ^ (idx << 20) ^ 0x2
+                pending: list = []
                 h = handles[idx]
-                batch = h.get()
-                sem.acquire_if_necessary(task_id)
-                pid_fn = cached_jit(
-                    ("rangepid", pkey, n, batch.capacity,
-                     repr(batch.schema)),
-                    lambda: lambda b, bd: part.partition_ids_with_bounds(
-                        b, bd))
-                subs = split_batch(batch, pid_fn(batch, bounds), n)
-                for rid, sub in enumerate(subs):
-                    rows = sub.concrete_num_rows()
-                    if rows:
-                        sub = sub.shrink_to_capacity(pad_capacity(rows))
-                        self.metrics["shuffleWriteRows"].add(rows)
-                        manager.write(self._shuffle_id, rid, sub)
+                try:
+                    batch = h.get()
+                    sem.acquire_if_necessary(task_id)
+                    pid_fn = cached_jit(
+                        ("rangepid", pkey, n, batch.capacity,
+                         repr(batch.schema)),
+                        lambda: lambda b, bd:
+                            part.partition_ids_with_bounds(b, bd))
+                    subs = split_batch(batch, pid_fn(batch, bounds), n)
+                    for rid, sub in enumerate(subs):
+                        rows = sub.concrete_num_rows()
+                        if rows:
+                            sub = sub.shrink_to_capacity(
+                                pad_capacity(rows))
+                            bh = store.register(
+                                sub, SpillPriorities.OUTPUT_FOR_SHUFFLE)
+                            bh.unpin()
+                            pending.append((rid, bh, bh.nbytes, rows))
+                except BaseException:
+                    for _rid, bh, _b, _r in pending:
+                        bh.close()
+                    h.unpin()  # input stays retryable
+                    raise
+                finally:
+                    sem.release_if_necessary(task_id)
+                for _rid, _bh, _b, rows in pending:
+                    self.metrics["shuffleWriteRows"].add(rows)
+                manager.commit_task(self._shuffle_id, pending)
                 h.close()
-            finally:
-                sem.release_if_necessary(task_id)
+
+            with_task_retries(attempt, desc=f"range pass2 {idx}")
 
         try:
             self._run_tasks(pass2, len(handles), threads)

@@ -1,0 +1,116 @@
+"""Task failure detection and recovery.
+
+The reference leans on two layers the TPU runtime must reproduce
+itself (SURVEY.md §5.3): Spark's task re-execution (deterministic
+lineage — a failed task re-runs from its inputs) and the plugin's
+OOM-retry framework (ref: RmmRapidsRetryIterator.scala `withRetry` —
+split-and-retry on GPU OOM after releasing what the task holds).
+
+TPU analog:
+
+- `classify(exc)`: device/transient failures (XLA RESOURCE_EXHAUSTED,
+  remote-link UNAVAILABLE/INTERNAL hiccups, our own reservation
+  failures) are RETRYABLE; everything else (assertion, user error)
+  fails fast.
+- `with_task_retries(fn)`: re-runs a deterministic task closure up to
+  `spark.rapids.tpu.task.maxFailures` times (Spark's
+  spark.task.maxFailures).  Between attempts it RELEASES pressure the
+  way the reference's retry framework does: spill every unpinned
+  device buffer to host and drop cached compiled-program handles that
+  pin donated buffers.
+- tasks that produce shuffle output buffer it locally and COMMIT
+  atomically at task end (exchange.py) so a failed attempt leaves no
+  partial blocks behind — the MapStatus commit protocol.
+
+Unrecoverable DEVICE loss degrades the whole query to the CPU engine
+when `spark.rapids.tpu.sql.recovery.cpuFallbackOnDeviceError` is on
+(the executor-blacklisting analog: keep answering queries on a sick
+host, just slower).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+from spark_rapids_tpu.config import register, get_conf
+
+TASK_MAX_FAILURES = register(
+    "spark.rapids.tpu.task.maxFailures", 3,
+    "Attempts per deterministic task before the failure propagates "
+    "(the spark.task.maxFailures analog).")
+
+CPU_FALLBACK_ON_DEVICE_ERROR = register(
+    "spark.rapids.tpu.sql.recovery.cpuFallbackOnDeviceError", True,
+    "After task retries are exhausted on a DEVICE/transient error, "
+    "re-run the whole query on the CPU engine instead of failing it "
+    "(the sick-executor blacklisting analog).")
+
+RETRY_BACKOFF_S = register(
+    "spark.rapids.tpu.task.retryBackoffSeconds", 0.2,
+    "Base sleep between task attempts (doubles per attempt).")
+
+#: substrings of device/transient error text that justify a retry
+_RETRYABLE_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "OutOfMemory",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "Socket closed",
+    "connection reset",
+    "INTERNAL: ",  # remote PJRT tunnel hiccups surface as INTERNAL
+)
+
+T = TypeVar("T")
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Device / transient failure (retry may succeed) vs logic error
+    (fail fast)."""
+    if isinstance(exc, MemoryError):
+        return True
+    if isinstance(exc, RuntimeError):  # XlaRuntimeError subclasses it
+        text = str(exc)
+        return any(m in text for m in _RETRYABLE_MARKERS)
+    return False
+
+
+def _release_pressure() -> None:
+    """Free what this process can before a retry attempt — the
+    spill-everything step of the reference's retry framework."""
+    try:
+        from spark_rapids_tpu.memory import get_store
+
+        get_store().spill_all_unpinned()
+    except Exception:
+        pass
+    import gc
+
+    gc.collect()
+
+
+def with_task_retries(fn: Callable[[], T], desc: str = "task") -> T:
+    """Run a deterministic task closure with device-error retries.
+    The closure must be safe to re-run from scratch (lineage: pure
+    function of its exec-tree inputs)."""
+    conf = get_conf()
+    attempts = max(1, conf.get(TASK_MAX_FAILURES))
+    backoff = conf.get(RETRY_BACKOFF_S)
+    last: BaseException | None = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if not is_retryable(e) or attempt == attempts - 1:
+                raise
+            last = e
+            _release_pressure()
+            time.sleep(backoff * (2 ** attempt))
+    raise last  # unreachable; keeps type checkers honest
+
+
+def should_cpu_fallback(exc: BaseException) -> bool:
+    """After retries: degrade the query to the CPU engine?"""
+    return get_conf().get(CPU_FALLBACK_ON_DEVICE_ERROR) \
+        and is_retryable(exc)

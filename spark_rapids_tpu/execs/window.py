@@ -119,12 +119,13 @@ class TpuWindowExec(TpuExec):
         for we, _name in self.named:
             out_cols.append(self._eval_window_fn(
                 we, sctx, live, idx, is_start, peer_start,
-                start_idx, end_idx, peer_end, cap))
+                start_idx, end_idx, peer_end, cap, sokeys))
         return ColumnarBatch(out_cols, saug.num_rows, self._schema)
 
     def _eval_window_fn(self, we: WindowExpression, sctx: EvalContext,
                         live, idx, is_start, peer_start,
-                        start_idx, end_idx, peer_end, cap: int) -> AnyColumn:
+                        start_idx, end_idx, peer_end, cap: int,
+                        sokeys=()) -> AnyColumn:
         fn = we.fn
         if isinstance(fn, RowNumber):
             rn = (idx - start_idx + 1).astype(jnp.int64)
@@ -149,18 +150,27 @@ class TpuWindowExec(TpuExec):
             return g.with_validity(g.validity & ok)
         assert isinstance(fn, WindowAgg), fn
         return self._eval_window_agg(fn, we, sctx, live, is_start,
-                                     start_idx, end_idx, peer_end, cap)
+                                     start_idx, end_idx, peer_end, cap,
+                                     peer_start, sokeys)
 
     def _eval_window_agg(self, fn: WindowAgg, we: WindowExpression, sctx,
                          live, is_start, start_idx, end_idx,
-                         peer_end, cap: int) -> Column:
+                         peer_end, cap: int, peer_start=None,
+                         sokeys=()) -> Column:
         frame = we.spec.resolved_frame()
         if frame.mode == "rows":
             lo, hi = W.frame_bounds(start_idx, end_idx, frame.start,
                                     frame.end, cap)
-        else:  # range: unbounded preceding .. current peer group / end
+        elif frame.start is None and frame.end in (None, 0):
+            # range: unbounded preceding .. current peer group / end
             lo = start_idx
             hi = end_idx if frame.end is None else peer_end
+        else:  # bounded value-based range frame over the one order key
+            k = we.spec.order_by[0]
+            lo, hi = W.range_frame_bounds(
+                sokeys[0], k.descending, not k.nulls_last,
+                frame.start, frame.end, start_idx, end_idx,
+                peer_start, peer_end, live, cap)
         agg = fn.agg
 
         if isinstance(agg, CountStar):

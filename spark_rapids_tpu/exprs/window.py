@@ -342,9 +342,25 @@ class WindowAgg(WindowFunction):
         if frame.mode == "range" and (frame.start is not UNBOUNDED or
                                       frame.end not in (CURRENT_ROW,
                                                         UNBOUNDED)):
-            raise TypeError(
-                "only RANGE BETWEEN UNBOUNDED PRECEDING AND "
-                "CURRENT ROW/UNBOUNDED FOLLOWING is supported")
+            # bounded value-based range frame (ref:
+            # GpuWindowExpression.scala:207-296): needs exactly one
+            # numeric/date order key for the device bisection kernel
+            if len(spec.order_by) != 1:
+                raise TypeError(
+                    "bounded RANGE frames need exactly one order-by "
+                    "key on TPU")
+            okdt = None
+            try:
+                okdt = spec.order_by[0].expr.dtype
+            except RuntimeError:
+                pass  # unbound; planner re-checks bound
+            if okdt is not None and not isinstance(
+                    okdt, (T.ByteType, T.ShortType, T.IntegerType,
+                           T.LongType, T.FloatType, T.DoubleType,
+                           T.DateType, T.TimestampType)):
+                raise TypeError(
+                    "bounded RANGE frames need a numeric/date order "
+                    "key on TPU")
         if isinstance(self.agg, (Min, Max)):
             if frame.start is not UNBOUNDED and frame.end is not UNBOUNDED:
                 raise TypeError(

@@ -453,10 +453,12 @@ class ParquetScanExec(TpuExec):
             # the pool materializes each file's decoded tables before
             # yielding, so it is bounded to files that fit one scan
             # batch (threads x batch bytes of host memory); bigger
-            # files keep the one-table-at-a-time streaming path
+            # files keep the one-table-at-a-time streaming path.  The
+            # gate compares COMPRESSED on-disk size, so it budgets a
+            # conservative 4x decode expansion (dict/RLE+snappy)
             big = any(
                 os.path.getsize(self.paths[fi]) >
-                conf.get(MAX_READ_BATCH_BYTES)
+                conf.get(MAX_READ_BATCH_BYTES) // 4
                 for fi in files if os.path.exists(self.paths[fi]))
             threads = min(conf.get(SCAN_DECODE_THREADS), len(files))
             if threads <= 1 or big:

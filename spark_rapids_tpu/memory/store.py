@@ -390,6 +390,17 @@ class BufferStore:
                 if not self._spill_one_device():
                     break  # nothing spillable left; let XLA try anyway
 
+    def spill_all_unpinned(self) -> int:
+        """Evict every unpinned DEVICE buffer to host — the
+        release-everything step between task retry attempts (ref:
+        RmmRapidsRetryIterator's spill-before-retry).  Returns the
+        number of buffers spilled."""
+        n = 0
+        with self._lock:
+            while self._spill_one_device():
+                n += 1
+        return n
+
     def _spill_one_device(self) -> bool:
         candidates = [e for e in self._entries.values()
                       if e.tier == StorageTier.DEVICE and not e.pinned]

@@ -1,0 +1,83 @@
+"""Native (C++) host-runtime components.
+
+Compiled lazily with the system toolchain into a per-source-hash
+shared object and loaded through ctypes (pybind11 is unavailable;
+a plain C ABI keeps the binding dependency-free).  Every native entry
+point has a numpy fallback in its caller, so a missing compiler only
+costs performance, never correctness — the same posture the reference
+takes toward its optional JNI acceleration libraries.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build(src: str, out: str) -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           src, "-o", out]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(out)
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The host codec library, building it on first use; None when no
+    toolchain is available (callers fall back to numpy)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        here = os.path.dirname(__file__)
+        src = os.path.join(here, "hostcodec.cpp")
+        try:
+            with open(src, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        except OSError:
+            return None
+        build_dir = os.path.join(here, "_build")
+        out = os.path.join(build_dir, f"hostcodec-{tag}.so")
+        if not os.path.exists(out):
+            try:
+                os.makedirs(build_dir, exist_ok=True)
+            except OSError:
+                return None
+            if not _build(src, out):
+                return None
+        try:
+            lib = ctypes.CDLL(out)
+        except OSError:
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.chars_fill.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                               c.c_int64, c.c_int64, c.c_void_p]
+    lib.chars_fill.restype = None
+    for name in ("minmax_i64", "minmax_i32"):
+        fn = getattr(lib, name)
+        fn.argtypes = [c.c_void_p, c.c_int64, c.c_void_p, c.c_void_p]
+        fn.restype = None
+    for name in ("bias_encode8_i64", "bias_encode16_i64",
+                 "bias_encode8_i32", "bias_encode16_i32"):
+        fn = getattr(lib, name)
+        fn.argtypes = [c.c_void_p, c.c_int64, c.c_int64, c.c_void_p]
+        fn.restype = None
+    lib.scaled_check_encode.argtypes = [c.c_void_p, c.c_int64, c.c_void_p]
+    lib.scaled_check_encode.restype = ctypes.c_int

@@ -1,0 +1,114 @@
+// Native host codec for the wire-encoding hot path.
+//
+// The TPU compute path is XLA/Pallas; the HOST side of the transfer
+// layer (columnar/transfer.py) is memory-bound C-style work — exactly
+// the part the reference implements natively (ref: the JNI host-side
+// copy/assembly helpers under sql-plugin's HostColumnarToGpu and the
+// native table assembly in GpuParquetScan.scala:495-560).  These
+// kernels replace the numpy fallbacks:
+//
+//   - chars_fill: ragged UTF-8 bytes + offsets -> fixed-width (n, w)
+//     byte matrix.  numpy needs two (n, w) int64 temp matrices
+//     (indices + mask) per call; this is one pass, zero temporaries.
+//   - minmax_i64 / bias encode: range scan + delta pack for the
+//     uint8/uint16 bias wire formats.
+//   - scaled_check_encode: verify bit-exact int32-cents
+//     reconstructibility of 2-decimal doubles and emit codes, one
+//     pass instead of numpy's four.
+//
+// Plain C ABI (ctypes-loadable; pybind11 is not available in this
+// image).  Single-threaded by design: callers already run on the scan
+// decode pool, so parallelism comes from files, not from within a
+// column.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// ragged bytes -> zero-padded fixed-width matrix.
+// offsets has n+1 entries into raw; lens[i] <= w must hold (caller
+// clamps); out is n*w bytes, PRE-ZEROED by the caller.
+void chars_fill(const uint8_t* raw, const int64_t* offsets,
+                const int32_t* lens, int64_t n, int64_t w,
+                uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t len = lens[i];
+        if (len > 0) {
+            std::memcpy(out + i * w, raw + offsets[i],
+                        static_cast<size_t>(len));
+        }
+    }
+}
+
+void minmax_i64(const int64_t* v, int64_t n, int64_t* out_min,
+                int64_t* out_max) {
+    int64_t mn = v[0], mx = v[0];
+    for (int64_t i = 1; i < n; ++i) {
+        int64_t x = v[i];
+        if (x < mn) mn = x;
+        if (x > mx) mx = x;
+    }
+    *out_min = mn;
+    *out_max = mx;
+}
+
+void minmax_i32(const int32_t* v, int64_t n, int64_t* out_min,
+                int64_t* out_max) {
+    int32_t mn = v[0], mx = v[0];
+    for (int64_t i = 1; i < n; ++i) {
+        int32_t x = v[i];
+        if (x < mn) mn = x;
+        if (x > mx) mx = x;
+    }
+    *out_min = mn;
+    *out_max = mx;
+}
+
+void bias_encode8_i64(const int64_t* v, int64_t n, int64_t base,
+                      uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = static_cast<uint8_t>(v[i] - base);
+}
+
+void bias_encode16_i64(const int64_t* v, int64_t n, int64_t base,
+                       uint16_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = static_cast<uint16_t>(v[i] - base);
+}
+
+void bias_encode8_i32(const int32_t* v, int64_t n, int64_t base,
+                      uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = static_cast<uint8_t>(static_cast<int64_t>(v[i]) - base);
+}
+
+void bias_encode16_i32(const int32_t* v, int64_t n, int64_t base,
+                       uint16_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = static_cast<uint16_t>(static_cast<int64_t>(v[i]) - base);
+}
+
+// 2-decimal money check+encode: out[i] = (int32) round(v[i] * 100)
+// when EVERY value reconstructs bit-exactly as out[i] / 100.0.
+// Returns 1 on success, 0 (out undefined) otherwise.
+int scaled_check_encode(const double* v, int64_t n, int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        double x = v[i];
+        if (!std::isfinite(x)) return 0;
+        double s = std::nearbyint(x * 100.0);
+        if (s < -2147483648.0 || s > 2147483647.0) return 0;
+        int32_t c = static_cast<int32_t>(s);
+        double r = static_cast<double>(c) / 100.0;
+        // bit comparison: catches -0.0 vs 0.0 and every rounding case
+        uint64_t rb, xb;
+        std::memcpy(&rb, &r, 8);
+        std::memcpy(&xb, &x, 8);
+        if (rb != xb) return 0;
+        out[i] = c;
+    }
+    return 1;
+}
+
+}  // extern "C"

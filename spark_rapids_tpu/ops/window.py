@@ -75,6 +75,67 @@ def frame_bounds(start_idx: jax.Array, end_idx: jax.Array,
     return lo, hi
 
 
+def bounded_bisect(keys: jax.Array, targets: jax.Array,
+                   lo_b: jax.Array, hi_b: jax.Array, side: str,
+                   cap: int) -> jax.Array:
+    """Vectorized per-row binary search over a segment-sorted key array:
+    for each row, the insertion point of `targets` within
+    [lo_b, hi_b + 1) of `keys` (side='left' -> first key >= target,
+    'right' -> first key > target).  log2(cap) gather/compare rounds —
+    the whole batch searches in lockstep on the VPU (no per-row loops),
+    which is how value-based RANGE frames (ref:
+    GpuWindowExpression.scala:207-296 bounded RangeFrame) map to TPU."""
+    lo = lo_b.astype(jnp.int32)
+    hi = (hi_b + 1).astype(jnp.int32)
+    for _ in range(max(cap, 2).bit_length() + 1):
+        cont = lo < hi
+        mid = (lo + hi) // 2
+        mv = jnp.take(keys, jnp.clip(mid, 0, cap - 1))
+        pred = (mv < targets) if side == "left" else (mv <= targets)
+        lo = jnp.where(cont & pred, mid + 1, lo)
+        hi = jnp.where(cont & ~pred, mid, hi)
+    return lo
+
+
+def range_frame_bounds(okey: Column, descending: bool,
+                       nulls_first_sorted: bool, fstart, fend,
+                       start_idx, end_idx, peer_start, peer_end,
+                       live, cap: int):
+    """Per-row [lo, hi] for a bounded value-based RANGE frame over ONE
+    numeric order key (Spark semantics, GpuWindowExpression.scala:207):
+    ascending, `s PRECEDING .. e FOLLOWING` covers rows whose key lies
+    in [v+s, v+e] (s negative); descending measures distance the other
+    way, handled by negating the working key.  Null-key rows form their
+    own frame (their peer group); null/padding slots get +-inf
+    sentinels consistent with their sorted position so finite targets
+    never include them."""
+    data = okey.data
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        w = data.astype(jnp.int64)
+        big = jnp.asarray(jnp.iinfo(jnp.int64).max, jnp.int64)
+        small = jnp.asarray(jnp.iinfo(jnp.int64).min, jnp.int64)
+    else:
+        w = data.astype(jnp.float64)
+        big = jnp.asarray(jnp.inf, jnp.float64)
+        small = jnp.asarray(-jnp.inf, jnp.float64)
+    if descending:
+        w = -w
+    w = jnp.where(okey.validity,
+                  w, small if nulls_first_sorted else big)
+    w = jnp.where(live, w, big)  # padding sorts to the back
+    cur = jnp.where(okey.validity & live, w, 0)
+    lo = start_idx if fstart is None else bounded_bisect(
+        w, cur + fstart, start_idx, end_idx, "left", cap)
+    hi = end_idx if fend is None else bounded_bisect(
+        w, cur + fend, start_idx, end_idx, "right", cap) - 1
+    # null-key rows: the null peer block is the frame
+    first_peer = jax.lax.cummax(jnp.where(peer_start, _idx(cap), 0))
+    isnull = live & ~okey.validity
+    lo = jnp.where(isnull, first_peer, lo)
+    hi = jnp.where(isnull, peer_end, hi)
+    return lo, hi
+
+
 def windowed_sum_count(col: Column, lo: jax.Array, hi: jax.Array,
                        live: jax.Array, out_dtype: T.DataType):
     """(sum over frame, non-null count over frame) for a value column."""

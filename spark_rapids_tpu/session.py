@@ -588,7 +588,31 @@ class DataFrame:
 
         t0 = _time.perf_counter()
         exec_, meta = plan_query(self._plan, conf)
-        out = collect_exec(exec_)
+        try:
+            out = collect_exec(exec_)
+        except BaseException as e:
+            from spark_rapids_tpu.execs.retry import should_cpu_fallback
+
+            if not should_cpu_fallback(e):
+                raise
+            # device lost / exhausted after task retries: degrade the
+            # query to the CPU engine (executor-blacklisting analog)
+            import warnings
+
+            from spark_rapids_tpu.cpu.engine import execute_cpu
+
+            warnings.warn(
+                f"TPU execution failed with a device error ({e}); "
+                "re-running this query on the CPU engine",
+                RuntimeWarning, stacklevel=2)
+            out = execute_cpu(self._plan)
+            # degraded queries are the ones operators most need to
+            # see in the history
+            self._session.history.record(
+                meta.explain() + "\n[degraded to CPU engine: "
+                f"{type(e).__name__}]",
+                exec_, _time.perf_counter() - t0)
+            return out
         self._session.history.record(
             meta.explain(), exec_, _time.perf_counter() - t0)
         return out

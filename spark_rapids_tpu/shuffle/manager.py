@@ -70,6 +70,20 @@ class ShuffleManager:
             for h in handles:
                 h.close()
 
+    def commit_task(self, shuffle_id: int,
+                    outputs: list[tuple[int, object, int, int]]) -> None:
+        """Atomically publish one map task's outputs: a list of
+        (reduce_id, spillable_handle, nbytes, rows).  Failed/retried
+        attempts never call this, so readers only ever observe complete
+        task output — the MapStatus commit protocol (Spark publishes a
+        task's shuffle blocks only when the task commits)."""
+        with self._lock:
+            for rid, h, nbytes, rows in outputs:
+                self._blocks.setdefault((shuffle_id, rid), []).append(h)
+                st = self._stats.setdefault((shuffle_id, rid), [0, 0])
+                st[0] += nbytes
+                st[1] += rows
+
     def partition_stats(self, shuffle_id: int,
                         n_partitions: int) -> list[tuple[int, int]]:
         """Per-reduce-partition (bytes, rows) written by the map stage —

@@ -201,3 +201,71 @@ def test_window_then_filter_then_agg(session):
     out = (ranked.where(col("rnk") <= 3)
            .group_by("k").agg((sum_("v"), "top3_sum")))
     assert_tpu_cpu_equal(out)
+
+
+def test_bounded_range_frames(session):
+    """Value-based RANGE frames (the bisection kernel) against the
+    oracle: duplicate order values, preceding/following combinations."""
+    rng = np.random.default_rng(17)
+    n = 400
+    t = pa.table({
+        "k": rng.integers(0, 6, n),
+        "ts": rng.integers(0, 40, n).astype(np.int64),  # many ties
+        "v": rng.integers(-50, 50, n).astype(np.float64),
+    })
+    df = session.create_dataframe(t)
+    for lo, hi in [(-5, 0), (-5, 5), (0, 10), (-10, -2), (2, 7),
+                   (None, 3), (-3, None)]:
+        w = (Window.partition_by("k").order_by("ts")
+             .range_between(lo, hi))
+        out = df.select("k", "ts", "v",
+                        sum_(col("v")).over(w).alias("s"),
+                        count(col("v")).over(w).alias("c"),
+                        avg(col("v")).over(w).alias("a"))
+        assert_tpu_cpu_equal(out)
+
+
+def test_bounded_range_frames_desc_and_nulls(session):
+    """Descending order keys measure range offsets the other way; null
+    order keys frame their own peer block."""
+    rng = np.random.default_rng(18)
+    n = 300
+    ts = [None if rng.random() < 0.1 else int(x)
+          for x in rng.integers(0, 30, n)]
+    t = pa.table({
+        "k": rng.integers(0, 5, n),
+        "ts": pa.array(ts, pa.int64()),
+        "v": rng.integers(-9, 9, n).astype(np.float64),
+    })
+    df = session.create_dataframe(t)
+    from spark_rapids_tpu.execs.sort import SortKey
+
+    wdesc = (Window.partition_by("k")
+             .order_by(SortKey(col("ts"), descending=True,
+                               nulls_last=True))
+             .range_between(-4, 2))
+    wasc = Window.partition_by("k").order_by("ts").range_between(-4, 2)
+    out = df.select("k", "ts", "v",
+                    sum_(col("v")).over(wdesc).alias("sd"),
+                    sum_(col("v")).over(wasc).alias("sa"),
+                    count_star().over(wasc).alias("n"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_bounded_range_minmax_one_side(session):
+    """min/max over range frames with one side unbounded (the scan
+    kernels); bounded-both-sides still falls back."""
+    rng = np.random.default_rng(19)
+    n = 250
+    t = pa.table({
+        "k": rng.integers(0, 4, n),
+        "ts": rng.integers(0, 25, n).astype(np.int64),
+        "v": rng.integers(-99, 99, n).astype(np.float64),
+    })
+    df = session.create_dataframe(t)
+    w1 = Window.partition_by("k").order_by("ts").range_between(None, 3)
+    w2 = Window.partition_by("k").order_by("ts").range_between(-3, None)
+    out = df.select("k", "ts", "v",
+                    max_(col("v")).over(w1).alias("m1"),
+                    min_(col("v")).over(w2).alias("m2"))
+    assert_tpu_cpu_equal(out)
