@@ -37,15 +37,28 @@ def serialize_arrays(arrays: dict, codec: str = "none") -> bytes:
     """Host component dict (str -> np.ndarray) -> framed bytes."""
     if codec not in ("none", "zlib"):
         raise ValueError(f"unknown codec {codec!r}")
+    from spark_rapids_tpu.memory.device_manager import HostBufferPool
+
     header = []
-    payload = io.BytesIO()
+    items = []
+    total = 0
     for name, a in arrays.items():
         a = np.ascontiguousarray(a)
-        raw = a.tobytes()
         header.append({"name": name, "dtype": a.dtype.str,
-                       "shape": list(a.shape), "nbytes": len(raw)})
-        payload.write(raw)
-    body = payload.getvalue()
+                       "shape": list(a.shape), "nbytes": a.nbytes})
+        items.append(a)
+        total += a.nbytes
+    # one recycled staging buffer instead of a tobytes() copy per
+    # array (the pinned-host-pool analog; spill writes are synchronous
+    # so the buffer can return to the pool immediately)
+    pool = HostBufferPool.get()
+    staging = pool.take(max(total, 1))
+    off = 0
+    for a in items:
+        staging[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
+        off += a.nbytes
+    body = bytes(staging[:total])
+    pool.give(staging)
     if codec == "zlib":
         body = zlib.compress(body, level=1)
     hjson = json.dumps({"cols": header, "codec": codec}).encode()

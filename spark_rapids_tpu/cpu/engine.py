@@ -26,7 +26,7 @@ from spark_rapids_tpu.exprs import predicates as P
 from spark_rapids_tpu.exprs import strings as S
 from spark_rapids_tpu.exprs import base as B
 from spark_rapids_tpu.exprs.cast import Cast
-from spark_rapids_tpu.exprs.hashing import Murmur3Hash
+from spark_rapids_tpu.exprs.hashing import Md5, Murmur3Hash
 from spark_rapids_tpu.plan import logical as L
 
 _PC_UNARY = {
@@ -452,6 +452,14 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
 
     if isinstance(e, Murmur3Hash):
         return _murmur3_cpu(e, table, n)
+    if isinstance(e, Md5):
+        import hashlib
+
+        vals = cpu_eval(e.child, table).to_pylist()
+        return pa.array(
+            [None if v is None
+             else hashlib.md5(str(v).encode()).hexdigest()
+             for v in vals], pa.string())
 
     out = _dispatch_extended(e, table, n)
     if out is NotImplemented:
@@ -899,6 +907,12 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
         child = execute_cpu(plan.children[0])
         mask = pc.fill_null(cpu_eval(plan.condition, child), False)
         return child.filter(mask)
+    if isinstance(plan, L.MapInArrow):
+        child = execute_cpu(plan.children[0])
+        out = plan.fn(child)
+        if isinstance(out, pa.RecordBatch):
+            out = pa.Table.from_batches([out])
+        return out.cast(schema_to_arrow(plan.schema))
     if isinstance(plan, L.Generate):
         child = execute_cpu(plan.children[0])
         gen = plan.generator
@@ -1114,9 +1128,18 @@ def _window_cpu(plan: L.Window) -> pa.Table:
                             return x
 
                         def in_frame(q):
+                            import math as _m
+
                             u = sval[g[q]]
                             if v is None or u is None:
                                 return v is None and u is None
+                            v_nan = isinstance(v, float) and _m.isnan(v)
+                            u_nan = isinstance(u, float) and _m.isnan(u)
+                            if v_nan or u_nan:
+                                # Spark total order: all NaN are equal
+                                # and greatest — a NaN row's frame is
+                                # the NaN peer block, nothing else
+                                return v_nan and u_nan
                             un, vn = _ordnum(u), _ordnum(v)
                             d = (un - vn) if not desc else (vn - un)
                             if frame.start is not None and d < frame.start:
@@ -1155,11 +1178,21 @@ def _frame_agg(agg, vals, g, lo, hi):
         return len(xs)
     if not xs:
         return None
+    import math as _math
+
+    def _nan(x):
+        return isinstance(x, float) and _math.isnan(x)
+
     if isinstance(agg, AGG.Sum):
         return sum(xs)
     if isinstance(agg, AGG.Min):
-        return min(xs)
+        # Spark float total order: NaN greatest — min ignores NaN
+        # unless the whole frame is NaN
+        non_nan = [x for x in xs if not _nan(x)]
+        return min(non_nan) if non_nan else float("nan")
     if isinstance(agg, AGG.Max):
+        if any(_nan(x) for x in xs):
+            return float("nan")
         return max(xs)
     if isinstance(agg, AGG.Average):
         return sum(float(x) for x in xs) / len(xs)
@@ -1204,7 +1237,8 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
             names=out_names).cast(schema_to_arrow(plan.schema))
 
     aggs = []
-    for in_names, fname, out_name, fn in agg_specs:
+    nan_fix: dict[int, str] = {}  # spec index -> '__aK__nan' source
+    for si, (in_names, fname, out_name, fn) in enumerate(agg_specs):
         if fname == "count_all":
             aggs.append(([], "count_all"))
         elif fname == "count":
@@ -1215,6 +1249,21 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
             # Spark defaults ignoreNulls=false; pyarrow defaults skip
             aggs.append((in_names[0], fname, pc.ScalarAggregateOptions(
                 skip_nulls=fn.ignore_nulls, min_count=0)))
+        elif fname in ("min", "max") and pa.types.is_floating(
+                proj.column(in_names[0]).type):
+            # Spark float total order: NaN greatest.  Aggregate the
+            # NaN-cleaned values plus a per-group any-NaN flag, then
+            # recompose (max: NaN if any NaN; min: NaN only when every
+            # non-null value is NaN).
+            src = in_names[0]
+            x = proj.column(src)
+            xnan = pc.fill_null(pc.is_nan(x), False)
+            clean = pc.if_else(xnan, pa.scalar(None, x.type), x)
+            proj = proj.append_column(f"{src}__clean", clean)
+            proj = proj.append_column(f"{src}__nan", xnan)
+            aggs.append((f"{src}__clean", fname))
+            aggs.append((f"{src}__nan", "any"))
+            nan_fix[si] = src
         else:
             aggs.append((in_names[0], fname))
     gb = proj.group_by(names[:n_keys], use_threads=False)
@@ -1225,12 +1274,31 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
     aschema = schema_to_arrow(plan.schema)
     for i in range(n_keys):
         out_arrays.append(res.column(names[i]))
-    for (in_names, fname, out_name, fn), spec in zip(agg_specs, aggs):
-        src, op = spec[0], spec[1]
+    ai = 0
+    for si, (in_names, fname, out_name, fn) in enumerate(agg_specs):
+        spec = aggs[ai]
+        src, op = (spec[0], spec[1]) if spec[0] else ("", spec[1])
+        if si in nan_fix:
+            base = nan_fix[si]
+            vals = res.column(f"{base}__clean_{fname}")
+            anynan = res.column(f"{base}__nan_any")
+            nan_scalar = pa.scalar(float("nan"), vals.type)
+            if fname == "max":
+                out = pc.if_else(pc.fill_null(anynan, False),
+                                 nan_scalar, vals)
+            else:  # min: NaN only when no non-NaN value existed
+                out = pc.if_else(
+                    pc.and_(pc.is_null(vals),
+                            pc.fill_null(anynan, False)),
+                    nan_scalar, vals)
+            out_arrays.append(out)
+            ai += 2
+            continue
         col_name = f"{src}_{op}" if src else f"{op}"
         if col_name not in res.column_names:
             col_name = f"{'_'.join(in_names)}_{op}" if in_names else op
         out_arrays.append(res.column(col_name))
+        ai += 1
     return pa.Table.from_arrays(out_arrays,
                                 names=aschema.names).cast(aschema)
 
@@ -1245,6 +1313,17 @@ def _grand_agg(proj: pa.Table, in_names, fname, fn=None) -> pa.Scalar:
         return pc.mean(col)
     if fname == "sum":
         return pc.sum(col)
+    if fname in ("min", "max") and pa.types.is_floating(col.type):
+        # Spark float total order: NaN greatest (see _aggregate_cpu)
+        xnan = pc.fill_null(pc.is_nan(col), False)
+        any_nan = pc.any(xnan).as_py()
+        clean = pc.if_else(xnan, pa.scalar(None, col.type), col)
+        v = pc.min(clean) if fname == "min" else pc.max(clean)
+        if fname == "max" and any_nan:
+            return pa.scalar(float("nan"), col.type)
+        if fname == "min" and v.as_py() is None and any_nan:
+            return pa.scalar(float("nan"), col.type)
+        return v
     if fname == "min":
         return pc.min(col)
     if fname == "max":

@@ -1,0 +1,78 @@
+"""Arrow python-worker exec.
+
+Counterpart of GpuArrowEvalPythonExec / GpuMapInPandasExec (ref:
+sql-plugin python exec rules + python/rapids/worker.py): each device
+batch crosses to host Arrow, runs through the process-isolated worker
+pool (bounded by the worker semaphore), and the declared-schema result
+re-enters the device path.  The transition cost is inherent to python
+UDFs on any accelerator — the reference pays the same GPU->JVM->python
+round trip."""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+
+
+class TpuMapInArrowExec(TpuExec):
+    def __init__(self, fn, schema: T.Schema, child: TpuExec):
+        super().__init__(child)
+        self.fn = fn
+        self._schema = schema
+        self._pool = None
+        self._pool_lock = threading.Lock()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        name = getattr(self.fn, "__name__", "fn")
+        return f"TpuMapInArrowExec [{name}]"
+
+    def additional_metrics(self):
+        return [("pythonBatches", "ESSENTIAL")]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    def _get_pool(self):
+        with self._pool_lock:
+            if self._pool is None:
+                from spark_rapids_tpu.python_worker import (
+                    PythonWorkerPool,
+                )
+
+                self._pool = PythonWorkerPool(self.fn)
+            return self._pool
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.arrow import (
+            from_arrow,
+            schema_to_arrow,
+            to_arrow,
+        )
+
+        aschema = schema_to_arrow(self._schema)
+        pool = self._get_pool()
+        for b in self.children[0].execute_partition(p):
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                out = pool.run(to_arrow(b)).cast(aschema)
+            self.metrics["pythonBatches"].add(1)
+            yield self._count_output(from_arrow(out))
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+    def close(self) -> None:
+        super().close()
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.close()
+                self._pool = None

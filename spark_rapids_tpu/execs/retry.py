@@ -50,7 +50,10 @@ RETRY_BACKOFF_S = register(
     "spark.rapids.tpu.task.retryBackoffSeconds", 0.2,
     "Base sleep between task attempts (doubles per attempt).")
 
-#: substrings of device/transient error text that justify a retry
+#: substrings of device/transient error text that justify a retry.
+#: Deliberately NOT "INTERNAL": compiler/unsupported-HLO failures are
+#: deterministic INTERNAL errors — retrying them wastes backoff and a
+#: CPU degrade would hide the bug from users and CI.
 _RETRYABLE_MARKERS = (
     "RESOURCE_EXHAUSTED",
     "out of memory",
@@ -59,7 +62,6 @@ _RETRYABLE_MARKERS = (
     "DEADLINE_EXCEEDED",
     "Socket closed",
     "connection reset",
-    "INTERNAL: ",  # remote PJRT tunnel hiccups surface as INTERNAL
 )
 
 T = TypeVar("T")

@@ -216,3 +216,136 @@ def partition_ids(cols: Sequence[AnyColumn], capacity: int,
     h = hash_columns(cols, capacity)
     m = h % jnp.int32(num_partitions)
     return jnp.where(m < 0, m + jnp.int32(num_partitions), m)
+
+
+# --------------------------------------------------------------------- #
+# MD5 (ref: HashFunctions.scala GpuMd5 -> cudf md5; Spark md5() returns
+# the lowercase hex digest of the UTF-8 bytes)
+# --------------------------------------------------------------------- #
+
+_MD5_K = tuple(int(abs(__import__("math").sin(i + 1)) * (1 << 32))
+               & 0xFFFFFFFF for i in range(64))
+_MD5_S = (7, 12, 17, 22) * 4 + (5, 9, 14, 20) * 4 \
+    + (4, 11, 16, 23) * 4 + (6, 10, 15, 21) * 4
+_MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+_HEX = tuple(b"0123456789abcdef")
+
+
+def _rotl32(x: jax.Array, s: int) -> jax.Array:
+    return (x << jnp.uint32(s)) | (x >> jnp.uint32(32 - s))
+
+
+def md5_string_bytes(chars: jax.Array, lengths: jax.Array,
+                     cap: int) -> tuple[jax.Array, jax.Array]:
+    """Per-row MD5 over the fixed-width chars matrix.
+
+    Rows of different byte lengths need different block counts; every
+    row runs the full (static) block schedule but only folds a block
+    into its state while the block index is below the row's own block
+    count — branch-free lockstep on the VPU, the TPU shape of cudf's
+    warp-per-row md5 kernel.  Returns (hex_chars[cap, 32],
+    lengths[cap] == 32)."""
+    w = int(chars.shape[1])
+    msg_len = ((w + 9 + 63) // 64) * 64
+    nblocks = msg_len // 64
+    L = lengths.astype(jnp.int32)
+    msg = jnp.concatenate(
+        [chars, jnp.zeros((cap, msg_len - w), jnp.uint8)], axis=1)
+    cols = jnp.arange(msg_len, dtype=jnp.int32)[None, :]
+    msg = jnp.where(cols == L[:, None], jnp.uint8(0x80), msg)
+    # per-row trailer: 64-bit little-endian BIT length at the end of
+    # the row's LAST block
+    row_blocks = (L + 9 + 63) // 64
+    len_pos = row_blocks * 64 - 8
+    bitlen = (L.astype(jnp.int64) * 8)
+    for k in range(8):
+        byte_k = ((bitlen >> (8 * k)) & 0xFF).astype(jnp.uint8)
+        msg = jnp.where(cols == (len_pos + k)[:, None],
+                        byte_k[:, None], msg)
+    # bytes -> little-endian u32 words: (cap, nblocks, 16)
+    bw = msg.reshape(cap, nblocks, 16, 4).astype(jnp.uint32)
+    words = (bw[..., 0] | (bw[..., 1] << 8) | (bw[..., 2] << 16)
+             | (bw[..., 3] << 24))
+
+    a0 = jnp.full((cap,), _MD5_INIT[0], jnp.uint32)
+    b0 = jnp.full((cap,), _MD5_INIT[1], jnp.uint32)
+    c0 = jnp.full((cap,), _MD5_INIT[2], jnp.uint32)
+    d0 = jnp.full((cap,), _MD5_INIT[3], jnp.uint32)
+    # g-schedule per round is static; the BLOCK loop is a fori_loop so
+    # the compiled graph is 64 rounds regardless of string width
+    gidx = []
+    for i in range(64):
+        if i < 16:
+            gidx.append(i)
+        elif i < 32:
+            gidx.append((5 * i + 1) % 16)
+        elif i < 48:
+            gidx.append((3 * i + 5) % 16)
+        else:
+            gidx.append((7 * i) % 16)
+
+    def body(blk, state):
+        a0, b0, c0, d0 = state
+        active = blk < row_blocks
+        m = jax.lax.dynamic_index_in_dim(words, blk, axis=1,
+                                         keepdims=False)
+        a, b, c, d = a0, b0, c0, d0
+        for i in range(64):
+            if i < 16:
+                f = (b & c) | (~b & d)
+            elif i < 32:
+                f = (d & b) | (~d & c)
+            elif i < 48:
+                f = b ^ c ^ d
+            else:
+                f = c ^ (b | ~d)
+            tmp = d
+            d = c
+            c = b
+            rot = a + f + jnp.uint32(_MD5_K[i]) + m[:, gidx[i]]
+            b = b + _rotl32(rot, _MD5_S[i])
+            a = tmp
+        return (jnp.where(active, a0 + a, a0),
+                jnp.where(active, b0 + b, b0),
+                jnp.where(active, c0 + c, c0),
+                jnp.where(active, d0 + d, d0))
+
+    a0, b0, c0, d0 = jax.lax.fori_loop(0, nblocks, body,
+                                       (a0, b0, c0, d0))
+
+    digest = jnp.stack([a0, b0, c0, d0], axis=1)  # (cap, 4) LE words
+    dbytes = jnp.stack(
+        [(digest >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)
+         for k in range(4)], axis=2).reshape(cap, 16).astype(jnp.uint8)
+    hex_lut = jnp.asarray(_HEX, jnp.uint8)
+    hi = jnp.take(hex_lut, (dbytes >> 4).astype(jnp.int32))
+    lo = jnp.take(hex_lut, (dbytes & 0xF).astype(jnp.int32))
+    hex_chars = jnp.stack([hi, lo], axis=2).reshape(cap, 32)
+    return hex_chars, jnp.full((cap,), 32, jnp.int32)
+
+
+@dataclasses.dataclass(repr=False)
+class Md5(Expression):
+    """SQL md5(string) -> lowercase hex digest (ref:
+    HashFunctions.scala GpuMd5)."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.columnar.column import StringColumn
+
+        col = self.child.eval(ctx)
+        assert isinstance(col, StringColumn), "md5 over non-string"
+        cap = ctx.batch.capacity
+        hex_chars, lens = md5_string_bytes(col.chars, col.lengths, cap)
+        valid = col.validity
+        return StringColumn(hex_chars * valid[:, None].astype(jnp.uint8),
+                            lens * valid.astype(jnp.int32), valid)

@@ -1,0 +1,167 @@
+"""Device discovery, selection, and memory-budget initialization.
+
+Analog of GpuDeviceManager (ref: GpuDeviceManager.scala:125
+`initializeGpuAndMemory` — one accelerator per executor, pool sizes
+computed from the device's physical memory, pinned-host pool setup).
+The TPU version asks the PJRT client instead of CUDA:
+
+- `discover()` enumerates `jax.devices()` with kind/ordinal/memory;
+- `select_device(conf)` picks this process's chip
+  (`spark.rapids.tpu.deviceOrdinal`, -1 = first of the preferred
+  platform) — the 1-accelerator-per-executor model;
+- `initialize(conf)` sizes the spill store's HBM budget as a FRACTION
+  of the selected chip's actual memory when the runtime reports it
+  (memory_stats()['bytes_limit']), falling back to the static conf —
+  the computeRmmInitSizes analog — and installs a BufferStore wired to
+  that budget;
+- `HostBufferPool` is the pinned-host-pool analog: recycled numpy
+  staging buffers for SYNCHRONOUS host paths (the spill serializer,
+  columnar/serde.py).  jax exposes no true pinned allocations and its
+  H2D transfers complete asynchronously (a recycled source buffer
+  would race the wire), so the win is alloc/zeroing churn on the
+  spill path, not DMA pinning — documented divergence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_tpu.config import register, get_conf
+
+DEVICE_ORDINAL = register(
+    "spark.rapids.tpu.deviceOrdinal", -1,
+    "Which local device this process owns (the 1-accelerator-per-"
+    "executor model, ref: GpuDeviceManager); -1 picks the first "
+    "device of the preferred platform.")
+
+MEMORY_FRACTION = register(
+    "spark.rapids.tpu.memory.fraction", 0.8,
+    "Fraction of the selected device's reported memory given to the "
+    "spill store's HBM budget when the runtime reports a limit (the "
+    "spark.rapids.memory.gpu.allocFraction analog).")
+
+HOST_POOL_BYTES = register(
+    "spark.rapids.tpu.memory.hostPool.maxBytes", 256 << 20,
+    "Upper bound on recycled host staging buffers held by the "
+    "HostBufferPool (the pinned-host pool analog).")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    ordinal: int
+    platform: str
+    kind: str
+    memory_bytes: Optional[int]
+
+
+def discover() -> list[DeviceInfo]:
+    """All PJRT devices visible to this process."""
+    import jax
+
+    out = []
+    for i, d in enumerate(jax.devices()):
+        mem = None
+        try:
+            stats = d.memory_stats()
+            if stats:
+                mem = stats.get("bytes_limit") or stats.get(
+                    "bytes_reservable_limit")
+        except Exception:
+            pass
+        out.append(DeviceInfo(i, d.platform, getattr(d, "device_kind",
+                                                     d.platform), mem))
+    return out
+
+
+def select_device(conf=None):
+    """This process's device (jax device object)."""
+    import jax
+
+    conf = conf or get_conf()
+    devs = jax.devices()
+    ordinal = conf.get(DEVICE_ORDINAL)
+    if 0 <= ordinal < len(devs):
+        return devs[ordinal]
+    return devs[0]
+
+
+def initialize(conf=None) -> "DeviceInfo":
+    """Size and install the process BufferStore from the selected
+    device's reported memory; returns the chosen device's info."""
+    from spark_rapids_tpu.memory.store import (
+        BufferStore,
+        HBM_BUDGET_BYTES,
+        reset_store,
+    )
+
+    conf = conf or get_conf()
+    dev = select_device(conf)
+    import jax
+
+    ordinal = jax.devices().index(dev)
+    info = discover()[ordinal]
+    budget = conf.get(HBM_BUDGET_BYTES)
+    if info.memory_bytes and info.platform != "cpu":
+        # CPU test backends report host RAM as "device" memory — the
+        # fraction sizing only makes sense against a real chip's HBM
+        budget = int(info.memory_bytes * conf.get(MEMORY_FRACTION))
+    reset_store(BufferStore(device_budget=budget))
+    return info
+
+
+class HostBufferPool:
+    """Recycled host staging buffers, bucketed by rounded size (the
+    pinned-host-pool shape without real page pinning)."""
+
+    _instance: Optional["HostBufferPool"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._held = 0
+        self.max_bytes = max_bytes if max_bytes is not None \
+            else get_conf().get(HOST_POOL_BYTES)
+
+    @classmethod
+    def get(cls) -> "HostBufferPool":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = HostBufferPool()
+            return cls._instance
+
+    @staticmethod
+    def _bucket(nbytes: int) -> int:
+        b = 4096
+        while b < nbytes:
+            b <<= 1
+        return b
+
+    def take(self, nbytes: int) -> np.ndarray:
+        """A uint8 buffer of >= nbytes (first nbytes NOT zeroed)."""
+        b = self._bucket(nbytes)
+        with self._lock:
+            lst = self._free.get(b)
+            if lst:
+                buf = lst.pop()
+                self._held -= buf.nbytes
+                return buf
+        return np.empty(b, np.uint8)
+
+    def give(self, buf: np.ndarray) -> None:
+        """Return a buffer taken from the pool (callers must not keep
+        references)."""
+        if buf.dtype != np.uint8 or buf.ndim != 1:
+            return
+        b = buf.nbytes
+        if (b & (b - 1)) or b < 4096:
+            return  # not a pool bucket
+        with self._lock:
+            if self._held + b > self.max_bytes:
+                return  # over budget: let it be collected
+            self._free.setdefault(b, []).append(buf)
+            self._held += b

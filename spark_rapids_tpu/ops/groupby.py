@@ -170,6 +170,22 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
         vals = jnp.where(valid, vcol.data.astype(phys),
                          _minmax_sentinel(phys, spec.op))
         f = jax.ops.segment_min if spec.op == "min" else jax.ops.segment_max
+        if jnp.issubdtype(jnp.dtype(phys), jnp.floating):
+            # Spark float total order: NaN is GREATEST.  segment_max's
+            # IEEE NaN propagation already realizes that; min must
+            # instead IGNORE NaN unless the whole group is NaN (then
+            # the answer is NaN, not NULL).
+            isnan = valid & jnp.isnan(vcol.data)
+            if spec.op == "min":
+                vals = jnp.where(isnan, _minmax_sentinel(phys, "min"),
+                                 vals)
+            n_nan = jax.ops.segment_sum(isnan.astype(jnp.int64), seg_id,
+                                        num_segments=cap)
+            out = f(vals, seg_id, num_segments=cap)
+            if spec.op == "min":
+                out = jnp.where(n_nan == nvalid,
+                                jnp.asarray(jnp.nan, phys), out)
+            return Column(out, group_live & (nvalid > 0), out_dtype)
         out = f(vals, seg_id, num_segments=cap)
         return Column(out, group_live & (nvalid > 0), out_dtype)
     if spec.op in ("first", "last"):
@@ -224,7 +240,22 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: Sequence[AggSpec],
         elif spec.op in ("min", "max"):
             vals = jnp.where(valid, vcol.data.astype(phys),
                              _minmax_sentinel(phys, spec.op))
-            s = jnp.min(vals) if spec.op == "min" else jnp.max(vals)
+            if jnp.issubdtype(jnp.dtype(phys), jnp.floating):
+                # Spark float total order (see _eval_agg): max keeps
+                # IEEE NaN propagation (NaN greatest); min ignores NaN
+                # unless every valid value is NaN
+                isnan = valid & jnp.isnan(vcol.data)
+                if spec.op == "min":
+                    vals = jnp.where(
+                        isnan, _minmax_sentinel(phys, "min"), vals)
+                    s = jnp.where(jnp.sum(isnan.astype(jnp.int64))
+                                  == nvalid,
+                                  jnp.asarray(jnp.nan, phys),
+                                  jnp.min(vals))
+                else:
+                    s = jnp.max(vals)
+            else:
+                s = jnp.min(vals) if spec.op == "min" else jnp.max(vals)
         elif spec.op in ("first", "last"):
             pos = _firstlast_pos(valid, spec.op, cap)
             sel = jnp.min(pos) if spec.op == "first" else jnp.max(pos)

@@ -120,6 +120,16 @@ def range_frame_bounds(okey: Column, descending: bool,
         small = jnp.asarray(-jnp.inf, jnp.float64)
     if descending:
         w = -w
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # NaN keys: greatest in Spark's total order, so the sort put
+        # them at the END of the ascending values (START descending);
+        # give them a position-consistent sentinel so the array stays
+        # bisectable, and (below) their frame = their NaN peer block —
+        # a NaN bound value matches exactly the NaN peers
+        isnan_key = okey.validity & jnp.isnan(data)
+        w = jnp.where(isnan_key, small if descending else big, w)
+    else:
+        isnan_key = jnp.zeros((cap,), bool)
     w = jnp.where(okey.validity,
                   w, small if nulls_first_sorted else big)
     w = jnp.where(live, w, big)  # padding sorts to the back
@@ -128,11 +138,11 @@ def range_frame_bounds(okey: Column, descending: bool,
         w, cur + fstart, start_idx, end_idx, "left", cap)
     hi = end_idx if fend is None else bounded_bisect(
         w, cur + fend, start_idx, end_idx, "right", cap) - 1
-    # null-key rows: the null peer block is the frame
+    # null-key and NaN-key rows: the peer block is the frame
     first_peer = jax.lax.cummax(jnp.where(peer_start, _idx(cap), 0))
-    isnull = live & ~okey.validity
-    lo = jnp.where(isnull, first_peer, lo)
-    hi = jnp.where(isnull, peer_end, hi)
+    special = live & (~okey.validity | isnan_key)
+    lo = jnp.where(special, first_peer, lo)
+    hi = jnp.where(special, peer_end, hi)
     return lo, hi
 
 
@@ -183,6 +193,14 @@ def windowed_minmax(col: Column, op: str, is_start: jax.Array,
     valid = col.validity & live
     sent = minmax_sentinel(col.data.dtype, op)
     vals = jnp.where(valid, col.data, sent)
+    is_float = jnp.issubdtype(col.data.dtype, jnp.floating)
+    if is_float and op == "min":
+        # Spark float total order: NaN is greatest, so MIN ignores NaN
+        # unless the whole frame is NaN (handled after the scan); MAX
+        # keeps IEEE NaN propagation, which already realizes it
+        isnan = valid & jnp.isnan(col.data)
+        vals = jnp.where(isnan, sent, vals)
+        cnan = jnp.cumsum(isnan.astype(jnp.int32))
     ccnt = jnp.cumsum(valid.astype(jnp.int32))
     if anchored_start:
         run = segmented_cummin_cummax(vals, is_start, op)
@@ -197,6 +215,10 @@ def windowed_minmax(col: Column, op: str, is_start: jax.Array,
         run = rev(segmented_cummin_cummax(rev(vals), rev(is_end), op))
         out = jnp.take(run, jnp.clip(lo, 0, cap - 1))
     n = range_sum(ccnt, lo, hi)
+    if is_float and op == "min":
+        n_nan = range_sum(cnan, lo, hi)
+        out = jnp.where((n > 0) & (n_nan == n),
+                        jnp.asarray(jnp.nan, out.dtype), out)
     return out, n > 0
 
 

@@ -553,3 +553,27 @@ class Union(LogicalPlan):
                 return None
             total += n
         return total
+
+
+class MapInArrow(LogicalPlan):
+    """Arrow-batch python transform over the child (the
+    mapInArrow/mapInPandas family the reference schedules onto GPU
+    python workers, ref: GpuArrowEvalPythonExec + python/rapids/
+    worker.py).  `fn` runs in a process-isolated worker pool; the
+    declared schema is the contract both engines cast results to."""
+
+    def __init__(self, fn, schema: T.Schema, child: LogicalPlan):
+        self.children = [child]
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def estimated_rows(self):
+        return None  # an arbitrary python transform may grow rows
+
+    def node_desc(self) -> str:
+        name = getattr(self.fn, "__name__", "fn")
+        return f"MapInArrow [{name}]"

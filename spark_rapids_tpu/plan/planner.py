@@ -30,7 +30,7 @@ from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.exprs import arithmetic as A
 from spark_rapids_tpu.exprs import base as B
 from spark_rapids_tpu.exprs import predicates as P
-from spark_rapids_tpu.exprs.hashing import Murmur3Hash
+from spark_rapids_tpu.exprs.hashing import Md5, Murmur3Hash
 from spark_rapids_tpu.plan import logical as L
 
 # ---------------------------------------------------------------------- #
@@ -86,6 +86,7 @@ for _sig, _classes in (
     (TS.ExprSig(TS.NUMERIC + TS.NULLSIG), (P.IsNaN,)),
     (_COND, (P.Coalesce, P.If, P.CaseWhen)),
     (TS.ExprSig(TS.COMMON_N), (Murmur3Hash,)),
+    (TS.ExprSig(TS.STRING + TS.NULLSIG), (Md5,)),
     (_MATH, (M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Cot,
              M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh,
              M.Acosh, M.Atanh, M.Rint, M.Signum, M.ToDegrees,
@@ -185,7 +186,7 @@ _EXEC_CONFS = {
     for cls in (L.InMemoryRelation, L.ParquetRelation, L.CsvRelation,
                 L.OrcRelation, L.RangeRel, L.Project, L.Filter,
                 L.Aggregate, L.Sort, L.Limit, L.Join, L.Union, L.Window,
-                L.Expand, L.Generate)
+                L.Expand, L.Generate, L.MapInArrow)
 }
 
 
@@ -454,6 +455,10 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
         from spark_rapids_tpu.execs.generate import TpuGenerateExec
 
         return TpuGenerateExec(p.generator, p.schema, kids[0])
+    if isinstance(p, L.MapInArrow):
+        from spark_rapids_tpu.execs.python_exec import TpuMapInArrowExec
+
+        return TpuMapInArrowExec(p.fn, p.schema, kids[0])
     if isinstance(p, L.Aggregate):
         return _plan_aggregate(p, kids[0])
     if isinstance(p, L.Sort):

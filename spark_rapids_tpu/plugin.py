@@ -52,6 +52,16 @@ class TpuPlugin:
         self.conf = conf or TpuConf()
         set_conf(self.conf)
         self._closed = False
+        self.device_info = None
+        try:
+            # device discovery + memory-budget sizing (the
+            # GpuDeviceManager.initializeGpuAndMemory step); never
+            # fatal — a budget-from-conf store works everywhere
+            from spark_rapids_tpu.memory import device_manager
+
+            self.device_info = device_manager.initialize(self.conf)
+        except Exception:
+            pass
         atexit.register(self.shutdown)
 
     @classmethod

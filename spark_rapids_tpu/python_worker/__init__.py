@@ -1,0 +1,142 @@
+"""Python worker pool (parent side): process-isolated arrow UDFs.
+
+The PythonWorkerSemaphore + daemon management analog (ref:
+rapids/python/PythonWorkerSemaphore.scala and python/rapids/daemon.py):
+a bounded pool of persistent child interpreters, one pickled UDF per
+pool, batches dispatched over Arrow IPC pipes.  Workers restart on
+death; UDF exceptions come back as UdfError without killing the
+worker.
+"""
+
+from __future__ import annotations
+
+import atexit
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+from typing import Callable, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.config import register, get_conf
+
+PYTHON_WORKERS = register(
+    "spark.rapids.tpu.python.concurrentWorkers", 2,
+    "Maximum concurrently running python UDF worker processes (the "
+    "PythonWorkerSemaphore analog).")
+
+_ERR = 0xFFFFFFFF
+
+
+class UdfError(RuntimeError):
+    """The user's UDF raised inside the worker."""
+
+
+class _Worker:
+    def __init__(self, fn_bytes: bytes):
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.python_worker.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+        paths = pickle.dumps([p for p in sys.path if p])
+        self._proc.stdin.write(struct.pack("<I", len(paths)))
+        self._proc.stdin.write(paths)
+        self._proc.stdin.write(struct.pack("<I", len(fn_bytes)))
+        self._proc.stdin.write(fn_bytes)
+        self._proc.stdin.flush()
+
+    @property
+    def alive(self) -> bool:
+        return self._proc.poll() is None
+
+    def run(self, tbl: pa.Table) -> pa.Table:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, tbl.schema) as w:
+            w.write_table(tbl)
+        data = sink.getvalue().to_pybytes()
+        self._proc.stdin.write(struct.pack("<I", len(data)))
+        self._proc.stdin.write(data)
+        self._proc.stdin.flush()
+        (n,) = struct.unpack("<I", self._read(4))
+        if n == _ERR:
+            (m,) = struct.unpack("<I", self._read(4))
+            raise UdfError(self._read(m).decode())
+        return pa.ipc.open_stream(self._read(n)).read_all()
+
+    def _read(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            b = self._proc.stdout.read(n)
+            if not b:
+                raise EOFError("python worker died")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            if self.alive:
+                self._proc.stdin.write(struct.pack("<I", 0))
+                self._proc.stdin.flush()
+                self._proc.wait(timeout=5)
+        except Exception:
+            self._proc.kill()
+
+
+class PythonWorkerPool:
+    """Bounded pool of persistent workers for ONE pickled function."""
+
+    def __init__(self, fn: Callable[[pa.Table], pa.Table],
+                 max_workers: Optional[int] = None):
+        self._fn_bytes = pickle.dumps(fn)
+        self._max = max_workers if max_workers is not None \
+            else get_conf().get(PYTHON_WORKERS)
+        self._sem = threading.Semaphore(self._max)
+        self._idle: list[_Worker] = []
+        self._lock = threading.Lock()
+        self._spawned = 0
+        self._closed = False
+        atexit.register(self.close)
+
+    def run(self, tbl: pa.Table) -> pa.Table:
+        """Apply the UDF to one batch in a worker process (blocks while
+        all workers are busy — the semaphore gate)."""
+        with self._sem:
+            w = self._take()
+            try:
+                out = w.run(tbl)
+            except UdfError:
+                self._give(w)  # worker survived the user error
+                raise
+            except Exception:
+                w.close()  # broken pipe / dead worker: do not recycle
+                with self._lock:
+                    self._spawned -= 1
+                raise
+            self._give(w)
+            return out
+
+    def _take(self) -> _Worker:
+        with self._lock:
+            while self._idle:
+                w = self._idle.pop()
+                if w.alive:
+                    return w
+                self._spawned -= 1
+            self._spawned += 1
+        return _Worker(self._fn_bytes)
+
+    def _give(self, w: _Worker) -> None:
+        with self._lock:
+            if not self._closed and w.alive:
+                self._idle.append(w)
+                return
+        w.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            workers, self._idle = self._idle, []
+        for w in workers:
+            w.close()

@@ -1,0 +1,81 @@
+"""Out-of-process Python UDF worker (child side).
+
+Analog of the reference's patched PySpark worker (ref:
+python/rapids/worker.py:21-50 — a dedicated python process per
+executor slot, initialized once, fed columnar batches).  The TPU
+version speaks length-prefixed Arrow IPC frames over stdin/stdout:
+
+    parent -> child:  [u32 len][pickled fn]            (once)
+                      [u32 len][arrow IPC stream]...   (per batch)
+                      [u32 0]                          (shutdown)
+    child  -> parent: [u32 len][arrow IPC stream]      (per batch)
+                      on error: [u32 0xFFFFFFFF][u32 len][utf-8 msg]
+
+Process isolation is the point: user code that segfaults, leaks, or
+monopolizes the GIL cannot take the engine down, and the parent's
+worker semaphore caps how many such processes run concurrently
+(PythonWorkerSemaphore analog).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+
+_ERR = 0xFFFFFFFF
+
+
+def _read_exact(f, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = f.read(n)
+        if not b:
+            raise EOFError
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def main() -> int:
+    import pyarrow as pa
+
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    # frame 0: the parent's sys.path — plain pickle resolves functions
+    # by module reference, so the child must see the same import roots
+    (n,) = struct.unpack("<I", _read_exact(stdin, 4))
+    for p in pickle.loads(_read_exact(stdin, n)):
+        if p not in sys.path:
+            sys.path.append(p)
+    (n,) = struct.unpack("<I", _read_exact(stdin, 4))
+    fn = pickle.loads(_read_exact(stdin, n))
+    while True:
+        (n,) = struct.unpack("<I", _read_exact(stdin, 4))
+        if n == 0:
+            return 0
+        payload = _read_exact(stdin, n)
+        try:
+            tbl = pa.ipc.open_stream(payload).read_all()
+            out = fn(tbl)
+            if isinstance(out, pa.RecordBatch):
+                out = pa.Table.from_batches([out])
+            if not isinstance(out, pa.Table):
+                raise TypeError(
+                    f"UDF must return a pyarrow Table/RecordBatch, "
+                    f"got {type(out).__name__}")
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, out.schema) as w:
+                w.write_table(out)
+            data = sink.getvalue().to_pybytes()
+            stdout.write(struct.pack("<I", len(data)))
+            stdout.write(data)
+        except Exception as e:  # report, stay alive for the next batch
+            msg = f"{type(e).__name__}: {e}".encode()
+            stdout.write(struct.pack("<II", _ERR, len(msg)))
+            stdout.write(msg)
+        stdout.flush()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -551,6 +551,21 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self._plan), self._session)
 
+    def map_in_arrow(self, fn, schema) -> "DataFrame":
+        """Apply `fn(pa.Table) -> pa.Table` batch-wise in a
+        process-isolated python worker pool (the mapInArrow analog;
+        ref: GpuArrowEvalPythonExec + python/rapids/worker.py).
+        `schema` (pyarrow or engine Schema) is the declared output
+        contract; `fn` must be picklable (module-level)."""
+        import pyarrow as _pa
+
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        if isinstance(schema, _pa.Schema):
+            schema = schema_from_arrow(schema)
+        return DataFrame(L.MapInArrow(fn, schema, self._plan),
+                         self._session)
+
     # -- writes ---------------------------------------------------------- #
 
     @property

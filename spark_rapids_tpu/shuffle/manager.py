@@ -38,18 +38,15 @@ class ShuffleManager:
 
     def write(self, shuffle_id: int, reduce_id: int,
               batch: ColumnarBatch) -> None:
-        """Map side: register one partition slice (stays on device until
-        pressure evicts it)."""
+        """Map side convenience: register + publish ONE partition slice
+        (a single-block commit — bulk task output should buffer and use
+        commit_task directly so failed attempts publish nothing)."""
         rows = batch.concrete_num_rows()
         if rows == 0:
             return
         h = get_store().register(batch, SpillPriorities.OUTPUT_FOR_SHUFFLE)
         h.unpin()  # at rest until a reduce task fetches it
-        with self._lock:
-            self._blocks.setdefault((shuffle_id, reduce_id), []).append(h)
-            st = self._stats.setdefault((shuffle_id, reduce_id), [0, 0])
-            st[0] += h.nbytes
-            st[1] += rows
+        self.commit_task(shuffle_id, [(reduce_id, h, h.nbytes, rows)])
 
     def read(self, shuffle_id: int, reduce_id: int
              ) -> Iterator[ColumnarBatch]:

@@ -1,0 +1,57 @@
+"""Device discovery / selection / budget init (GpuDeviceManager analog)
+and the recycled host staging pool."""
+
+import numpy as np
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.memory import device_manager as DM
+from spark_rapids_tpu.memory.store import HBM_BUDGET_BYTES, get_store, reset_store
+
+
+def test_discover_lists_devices():
+    devs = DM.discover()
+    assert devs, "no devices discovered"
+    assert devs[0].ordinal == 0
+    assert devs[0].platform
+
+
+def test_select_device_ordinal():
+    conf = get_conf()
+    old = conf.get(DM.DEVICE_ORDINAL)
+    try:
+        conf.set(DM.DEVICE_ORDINAL.key, 0)
+        import jax
+
+        assert DM.select_device(conf) is jax.devices()[0]
+        conf.set(DM.DEVICE_ORDINAL.key, 10_000)  # out of range -> first
+        assert DM.select_device(conf) is jax.devices()[0]
+    finally:
+        conf.set(DM.DEVICE_ORDINAL.key, old)
+
+
+def test_initialize_installs_store():
+    conf = get_conf()
+    info = DM.initialize(conf)
+    try:
+        store = get_store()
+        # CPU test backend: fraction sizing must NOT apply; the conf
+        # budget stands
+        assert store.device_budget == conf.get(HBM_BUDGET_BYTES)
+        assert info.platform == "cpu"
+    finally:
+        reset_store()
+
+
+def test_host_buffer_pool_recycles():
+    pool = DM.HostBufferPool(max_bytes=1 << 20)
+    a = pool.take(5000)
+    assert a.nbytes == 8192 and a.dtype == np.uint8
+    pool.give(a)
+    b = pool.take(6000)
+    assert b is a  # recycled, same bucket
+    # over-budget buffers are dropped, not held
+    big = pool.take(1 << 21)
+    pool.give(big)
+    pool.give(pool.take(1 << 21))
+    held = sum(x.nbytes for lst in pool._free.values() for x in lst)
+    assert held <= pool.max_bytes

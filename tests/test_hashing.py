@@ -174,3 +174,28 @@ def test_hash_float32():
             bits = struct.unpack("<I", struct.pack("<f", vv))[0]
         want.append(as_i32(spark_hash_int(bits, 42)))
     assert list(got) == want
+
+
+def test_md5_matches_hashlib():
+    """Device MD5 (lockstep block schedule on the VPU) vs hashlib, over
+    varied lengths incl. the 55/56-byte padding boundary and nulls."""
+    import hashlib
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.exprs.hashing import Md5
+    from spark_rapids_tpu.session import TpuSession, col
+
+    vals = ["", "a", "abc", "hello world", "é✓ünïcode",
+            "x" * 55, "y" * 56, "z" * 63, "w" * 64, "q" * 100,
+            None, "The quick brown fox jumps over the lazy dog"]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    session = TpuSession()
+    df = session.create_dataframe(t).select(
+        col("s"), Md5(col("s")).alias("h"))
+    got = df.collect(engine="tpu").to_pydict()["h"]
+    want = [None if v is None else hashlib.md5(v.encode()).hexdigest()
+            for v in vals]
+    assert got == want
+    cpu = df.collect(engine="cpu").to_pydict()["h"]
+    assert cpu == want

@@ -252,6 +252,43 @@ def test_bounded_range_frames_desc_and_nulls(session):
     assert_tpu_cpu_equal(out)
 
 
+def test_bounded_range_frames_nan_keys(session):
+    """NaN order keys are greatest-and-equal in Spark's total order:
+    their bounded-range frame is exactly the NaN peer block, and they
+    never fall inside a finite row's value range."""
+    rng = np.random.default_rng(23)
+    n = 200
+    ts = rng.integers(0, 20, n).astype(np.float64)
+    ts[rng.random(n) < 0.15] = np.nan
+    t = pa.table({
+        "k": rng.integers(0, 4, n),
+        "ts": ts,
+        "v": rng.integers(-9, 9, n).astype(np.float64),
+    })
+    df = session.create_dataframe(t)
+    w = Window.partition_by("k").order_by("ts").range_between(-2, 2)
+    out = df.select("k", "ts", "v",
+                    sum_(col("v")).over(w).alias("s"),
+                    count_star().over(w).alias("n"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_md5_wide_strings(session):
+    """The fori_loop block schedule handles strings past any width
+    bucket (no eval-time cliff)."""
+    import hashlib
+
+    from spark_rapids_tpu.exprs.hashing import Md5
+
+    vals = ["x" * 600, "y" * 2000, "short", None]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    df = session.create_dataframe(t).select(Md5(col("s")).alias("h"))
+    got = df.collect(engine="tpu").to_pydict()["h"]
+    assert got == [None if v is None
+                   else hashlib.md5(v.encode()).hexdigest()
+                   for v in vals]
+
+
 def test_bounded_range_minmax_one_side(session):
     """min/max over range frames with one side unbounded (the scan
     kernels); bounded-both-sides still falls back."""
