@@ -12,7 +12,6 @@ Format: MAGIC | version | codec | json header (names, dtypes, shapes)
 
 from __future__ import annotations
 
-import io
 import json
 import struct
 import zlib

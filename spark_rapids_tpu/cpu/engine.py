@@ -242,6 +242,31 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
     if isinstance(e, (A.Add, A.Subtract, A.Multiply)):
         l, r = _binary_operands(e, table, n)
         at = _widen_type(e)
+        if isinstance(e.dtype, T.DecimalType):
+            # exact python-Decimal reference for decimal arithmetic
+            # (arrow's own promotion rules differ from Spark's).  The
+            # declared type is capped at this engine's MAX_PRECISION;
+            # exact results that cannot fit become NULL — the
+            # nullOnOverflow contract for precision the engine cannot
+            # represent (Spark with p<=38 would hold them; documented
+            # 18-digit divergence)
+            import decimal as _dec
+            import operator as _op
+
+            dt = e.dtype
+            q = _dec.Decimal(1).scaleb(-dt.scale)
+            bound = _dec.Decimal(10) ** (dt.precision - dt.scale)
+            lv, rv = l.to_pylist(), r.to_pylist()
+            op = {A.Add: _op.add, A.Subtract: _op.sub,
+                  A.Multiply: _op.mul}[type(e)]
+            out = []
+            for a, b in zip(lv, rv):
+                if a is None or b is None:
+                    out.append(None)
+                    continue
+                v = op(a, b).quantize(q, rounding=_dec.ROUND_HALF_UP)
+                out.append(None if abs(v) >= bound else v)
+            return pa.array(out, at)
         fn = {A.Add: pc.add, A.Subtract: pc.subtract,
               A.Multiply: pc.multiply}[type(e)]
         return fn(l.cast(at), r.cast(at))
@@ -452,6 +477,31 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
 
     if isinstance(e, Murmur3Hash):
         return _murmur3_cpu(e, table, n)
+    from spark_rapids_tpu.exprs.decimal import CheckOverflow, PromotePrecision
+
+    if isinstance(e, PromotePrecision):
+        import decimal as _dec
+
+        vals = cpu_eval(e.child, table).to_pylist()
+        q = _dec.Decimal(1).scaleb(-e.target.scale)
+        return pa.array(
+            [None if v is None else v.quantize(q) for v in vals],
+            pa.decimal128(e.target.precision, e.target.scale))
+    if isinstance(e, CheckOverflow):
+        import decimal as _dec
+
+        vals = cpu_eval(e.child, table).to_pylist()
+        q = _dec.Decimal(1).scaleb(-e.target.scale)
+        bound = _dec.Decimal(10) ** (e.target.precision - e.target.scale)
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            r = v.quantize(q, rounding=_dec.ROUND_HALF_UP)
+            out.append(None if abs(r) >= bound else r)
+        return pa.array(out, pa.decimal128(e.target.precision,
+                                           e.target.scale))
     if isinstance(e, Md5):
         import hashlib
 

@@ -70,14 +70,69 @@ class BinaryArithmetic(Expression):
         raise NotImplementedError
 
 
-class Add(BinaryArithmetic):
+class _DecimalAddSub(BinaryArithmetic):
+    """Shared decimal path for +/-: Spark's analyzer result type
+    (p = max integral digits + max scale + 1, s = max scale, ref:
+    decimalExpressions.scala / DecimalPrecision) with operands rescaled
+    to the result scale — exact unscaled int64 math while the result
+    precision fits MAX_PRECISION; wider falls back."""
+
+    def _decimal_result(self, l: T.DecimalType,
+                        r: T.DecimalType) -> T.DecimalType:
+        s = max(l.scale, r.scale)
+        p = max(l.precision - l.scale, r.precision - r.scale) + s + 1
+        return T.DecimalType(min(p, T.DecimalType.MAX_PRECISION), s)
+
+    @property
+    def dtype(self) -> T.DataType:
+        l, r = self.left.dtype, self.right.dtype
+        if isinstance(l, T.DecimalType) and isinstance(r, T.DecimalType):
+            return self._decimal_result(l, r)
+        return result_numeric_type(l, r)
+
+    def check_supported(self) -> None:
+        try:
+            l, r = self.left.dtype, self.right.dtype
+        except RuntimeError:
+            return  # unbound; the planner re-checks after binding
+        ldec = isinstance(l, T.DecimalType)
+        rdec = isinstance(r, T.DecimalType)
+        if ldec != rdec:
+            raise TypeError("decimal +/- with a non-decimal operand "
+                            "falls back")
+        if ldec:
+            s = max(l.scale, r.scale)
+            p = max(l.precision - l.scale, r.precision - r.scale) + s + 1
+            if p > T.DecimalType.MAX_PRECISION:
+                raise TypeError(
+                    "decimal +/- beyond precision 18 falls back "
+                    "(unscaled int64 math would overflow)")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        out = self.dtype
+        if not isinstance(out, T.DecimalType):
+            return super().eval(ctx)
+        import jax.numpy as jnp
+
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        ls = out.scale - self.left.dtype.scale
+        rs = out.scale - self.right.dtype.scale
+        ld = lc.data * jnp.int64(10 ** ls) if ls else lc.data
+        rd = rc.data * jnp.int64(10 ** rs) if rs else rc.data
+        valid = broadcast_validity(lc, rc)
+        data, valid = self.compute(ld, rd, valid)
+        return Column(data, valid, out)
+
+
+class Add(_DecimalAddSub):
     symbol = "+"
 
     def compute(self, ld, rd, valid):
         return ld + rd, valid
 
 
-class Subtract(BinaryArithmetic):
+class Subtract(_DecimalAddSub):
     symbol = "-"
 
     def compute(self, ld, rd, valid):
@@ -86,6 +141,19 @@ class Subtract(BinaryArithmetic):
 
 class Multiply(BinaryArithmetic):
     symbol = "*"
+
+    @property
+    def dtype(self) -> T.DataType:
+        l, r = self.left.dtype, self.right.dtype
+        if isinstance(l, T.DecimalType) and isinstance(r, T.DecimalType):
+            # Spark DecimalPrecision: scale adds, precision p1+p2+1 —
+            # the declared type the CPU fallback must produce (device
+            # multiply over decimals is not supported; TypeSig refuses)
+            return T.DecimalType(
+                min(l.precision + r.precision + 1,
+                    T.DecimalType.MAX_PRECISION),
+                min(l.scale + r.scale, T.DecimalType.MAX_PRECISION))
+        return result_numeric_type(l, r)
 
     def compute(self, ld, rd, valid):
         return ld * rd, valid

@@ -1,0 +1,110 @@
+"""Decimal precision-management expressions.
+
+Analogs of the reference's decimal plumbing (ref:
+sql-plugin/.../decimalExpressions.scala — GpuPromotePrecision,
+GpuCheckOverflow): Spark's analyzer wraps decimal arithmetic as
+CheckOverflow(op(PromotePrecision(cast l), PromotePrecision(cast r))),
+and the physical layer works on UNSCALED integer values.  Our decimals
+are int64-backed (precision <= 18), so:
+
+- `PromotePrecision` rescales the unscaled value to the target scale
+  (one integer multiply by a power of ten — exact while the target
+  precision fits int64);
+- `CheckOverflow` re-asserts the declared precision after an
+  operation: values whose magnitude reaches 10^precision become NULL
+  (Spark's default nullOnOverflow=true; ANSI raise mode is a planner
+  fallback, like the reference's ansiEnabled tagging).
+
+Same-type decimal Add/Subtract themselves are exact unscaled int64
+adds, enabled in the arithmetic TypeSig when wrapped this way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import AnyColumn, Column
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+
+
+@dataclasses.dataclass(repr=False)
+class PromotePrecision(Expression):
+    """Rescale a decimal child to the target precision/scale (ref:
+    decimalExpressions.scala GpuPromotePrecision)."""
+
+    child: Expression
+    target: T.DecimalType
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.target
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def check_supported(self) -> None:
+        cdt = self.child.dtype
+        if not isinstance(cdt, T.DecimalType):
+            raise TypeError("PromotePrecision over non-decimal input")
+        if self.target.scale < cdt.scale:
+            raise TypeError(
+                "PromotePrecision cannot reduce scale (would round)")
+        if self.target.precision > T.DecimalType.MAX_PRECISION:
+            raise TypeError("decimal precision beyond int64 falls back")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, Column)
+        diff = self.target.scale - self.child.dtype.scale
+        data = c.data * jnp.int64(10 ** diff) if diff else c.data
+        return Column(data, c.validity, self.target)
+
+
+@dataclasses.dataclass(repr=False)
+class CheckOverflow(Expression):
+    """NULL out values exceeding the declared precision (ref:
+    decimalExpressions.scala GpuCheckOverflow, nullOnOverflow=true)."""
+
+    child: Expression
+    target: T.DecimalType
+    null_on_overflow: bool = True
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.target
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def check_supported(self) -> None:
+        if not self.null_on_overflow:
+            raise TypeError(
+                "ANSI overflow (exception mode) falls back, like the "
+                "reference's ansiEnabled tagging")
+        if self.target.precision > T.DecimalType.MAX_PRECISION:
+            raise TypeError("decimal precision beyond int64 falls back")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, Column)
+        cdt = self.child.dtype
+        assert isinstance(cdt, T.DecimalType)
+        diff = cdt.scale - self.target.scale
+        data = c.data
+        if diff > 0:
+            # scale down with HALF_UP (away from zero) rounding —
+            # Spark's toPrecision: round on |v|, restore the sign
+            p = jnp.int64(10 ** diff)
+            half = p // 2
+            mag = (jnp.abs(data) + half) // p
+            data = jnp.where(data < 0, -mag, mag)
+        elif diff < 0:
+            data = data * jnp.int64(10 ** (-diff))
+        bound = jnp.int64(10 ** self.target.precision)
+        ok = (data > -bound) & (data < bound)
+        return Column(data, c.validity & ok, self.target)

@@ -77,21 +77,31 @@ def frame_bounds(start_idx: jax.Array, end_idx: jax.Array,
 
 def bounded_bisect(keys: jax.Array, targets: jax.Array,
                    lo_b: jax.Array, hi_b: jax.Array, side: str,
-                   cap: int) -> jax.Array:
+                   cap: int, key_cls=None, target_cls=None) -> jax.Array:
     """Vectorized per-row binary search over a segment-sorted key array:
     for each row, the insertion point of `targets` within
     [lo_b, hi_b + 1) of `keys` (side='left' -> first key >= target,
     'right' -> first key > target).  log2(cap) gather/compare rounds —
     the whole batch searches in lockstep on the VPU (no per-row loops),
     which is how value-based RANGE frames (ref:
-    GpuWindowExpression.scala:207-296 bounded RangeFrame) map to TPU."""
+    GpuWindowExpression.scala:207-296 bounded RangeFrame) map to TPU.
+
+    `key_cls`/`target_cls` (int8) make the comparison LEXICOGRAPHIC on
+    (class, key): null/NaN/padding rows get their own ordering class so
+    their float sentinels can never collide with genuine +-inf keys."""
     lo = lo_b.astype(jnp.int32)
     hi = (hi_b + 1).astype(jnp.int32)
     for _ in range(max(cap, 2).bit_length() + 1):
         cont = lo < hi
         mid = (lo + hi) // 2
-        mv = jnp.take(keys, jnp.clip(mid, 0, cap - 1))
-        pred = (mv < targets) if side == "left" else (mv <= targets)
+        midc = jnp.clip(mid, 0, cap - 1)
+        mv = jnp.take(keys, midc)
+        kv_lt = (mv < targets) if side == "left" else (mv <= targets)
+        if key_cls is not None:
+            mc = jnp.take(key_cls, midc)
+            pred = (mc < target_cls) | ((mc == target_cls) & kv_lt)
+        else:
+            pred = kv_lt
         lo = jnp.where(cont & pred, mid + 1, lo)
         hi = jnp.where(cont & ~pred, mid, hi)
     return lo
@@ -120,24 +130,31 @@ def range_frame_bounds(okey: Column, descending: bool,
         small = jnp.asarray(-jnp.inf, jnp.float64)
     if descending:
         w = -w
+    # ordering CLASSES keep special rows bisectable without sentinel
+    # collisions (a real +-inf key must not capture NaN/null rows).
+    # Classes mirror the SORTED layout: nulls at -2 or +4 per the sort
+    # key's null placement, NaN (greatest VALUE in Spark's total order)
+    # at +2 ascending / -1 descending, finite values at 1, padding +5.
+    cls = jnp.ones((cap,), jnp.int8)
     if jnp.issubdtype(data.dtype, jnp.floating):
-        # NaN keys: greatest in Spark's total order, so the sort put
-        # them at the END of the ascending values (START descending);
-        # give them a position-consistent sentinel so the array stays
-        # bisectable, and (below) their frame = their NaN peer block —
-        # a NaN bound value matches exactly the NaN peers
         isnan_key = okey.validity & jnp.isnan(data)
-        w = jnp.where(isnan_key, small if descending else big, w)
+        nan_cls = jnp.int8(-1) if descending else jnp.int8(2)
+        cls = jnp.where(isnan_key, nan_cls, cls)
+        w = jnp.where(isnan_key, big, w)  # value irrelevant: own class
     else:
         isnan_key = jnp.zeros((cap,), bool)
-    w = jnp.where(okey.validity,
-                  w, small if nulls_first_sorted else big)
-    w = jnp.where(live, w, big)  # padding sorts to the back
+    null_cls = jnp.int8(-2) if nulls_first_sorted else jnp.int8(4)
+    cls = jnp.where(okey.validity, cls, null_cls)
+    w = jnp.where(okey.validity, w, big)
+    cls = jnp.where(live, cls, jnp.int8(5))  # padding at the back
     cur = jnp.where(okey.validity & live, w, 0)
+    tcls = jnp.ones((cap,), jnp.int8)  # finite targets: class 1
     lo = start_idx if fstart is None else bounded_bisect(
-        w, cur + fstart, start_idx, end_idx, "left", cap)
+        w, cur + fstart, start_idx, end_idx, "left", cap,
+        key_cls=cls, target_cls=tcls)
     hi = end_idx if fend is None else bounded_bisect(
-        w, cur + fend, start_idx, end_idx, "right", cap) - 1
+        w, cur + fend, start_idx, end_idx, "right", cap,
+        key_cls=cls, target_cls=tcls) - 1
     # null-key and NaN-key rows: the peer block is the frame
     first_peer = jax.lax.cummax(jnp.where(peer_start, _idx(cap), 0))
     special = live & (~okey.validity | isnan_key)

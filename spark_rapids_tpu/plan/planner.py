@@ -30,6 +30,7 @@ from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.exprs import arithmetic as A
 from spark_rapids_tpu.exprs import base as B
 from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import decimal as DEC
 from spark_rapids_tpu.exprs.hashing import Md5, Murmur3Hash
 from spark_rapids_tpu.plan import logical as L
 
@@ -76,7 +77,10 @@ _COND = TS.ExprSig(TS.ORDERABLE)
 for _sig, _classes in (
     (_PASSTHROUGH, (B.Alias, B.BoundReference, B.ColumnReference,
                     B.Literal)),
-    (_ARITH, (A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
+    (TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.NULLSIG,
+                "decimal operands must share precision/scale "
+                "(PromotePrecision)"), (A.Add, A.Subtract)),
+    (_ARITH, (A.Multiply, A.Divide, A.IntegralDivide,
               A.Remainder, A.Pmod, A.UnaryMinus, A.UnaryPositive, A.Abs,
               A.Least, A.Greatest)),
     (_COMPARE, (P.EqualTo, P.LessThan, P.LessThanOrEqual, P.GreaterThan,
@@ -87,6 +91,8 @@ for _sig, _classes in (
     (_COND, (P.Coalesce, P.If, P.CaseWhen)),
     (TS.ExprSig(TS.COMMON_N), (Murmur3Hash,)),
     (TS.ExprSig(TS.STRING + TS.NULLSIG), (Md5,)),
+    (TS.ExprSig(TS.DECIMAL + TS.NULLSIG),
+     (DEC.PromotePrecision, DEC.CheckOverflow)),
     (_MATH, (M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Cot,
              M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh,
              M.Acosh, M.Atanh, M.Rint, M.Signum, M.ToDegrees,

@@ -36,9 +36,19 @@ class UdfError(RuntimeError):
 
 class _Worker:
     def __init__(self, fn_bytes: bytes):
+        import os
+
+        # the child must locate this package BEFORE the sys.path frame
+        # arrives (the -m import happens at spawn), so propagate the
+        # parent's import roots through the environment
+        env = dict(os.environ)
+        extra = [p for p in sys.path if p]
+        prior = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = os.pathsep.join(
+            extra + ([prior] if prior else []))
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "spark_rapids_tpu.python_worker.worker"],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
         paths = pickle.dumps([p for p in sys.path if p])
         self._proc.stdin.write(struct.pack("<I", len(paths)))
         self._proc.stdin.write(paths)
@@ -140,3 +150,9 @@ class PythonWorkerPool:
             workers, self._idle = self._idle, []
         for w in workers:
             w.close()
+        try:
+            # drop the atexit reference so closed pools (and their
+            # pickled UDF bytes) can be collected
+            atexit.unregister(self.close)
+        except Exception:
+            pass

@@ -38,10 +38,19 @@ def _read_exact(f, n: int) -> bytes:
 
 
 def main() -> int:
+    import os
+
     import pyarrow as pa
 
     stdin = sys.stdin.buffer
-    stdout = sys.stdout.buffer
+    # claim the framing pipe on a PRIVATE fd and point the process's
+    # stdout at stderr: a UDF that print()s must not inject bytes into
+    # the length-prefixed protocol (the reference PySpark worker does
+    # the same stdout redirection)
+    framing_fd = os.dup(sys.stdout.fileno())
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    sys.stdout = sys.stderr
+    stdout = os.fdopen(framing_fd, "wb")
     # frame 0: the parent's sys.path — plain pickle resolves functions
     # by module reference, so the child must see the same import roots
     (n,) = struct.unpack("<I", _read_exact(stdin, 4))

@@ -15,17 +15,26 @@ def session():
     return TpuSession()
 
 
-def test_decimal_arithmetic_falls_back_correctly(session):
+def test_decimal_add_on_tpu_multiply_falls_back(session):
+    """Decimal +/- runs on TPU as unscaled int64 math (widened result
+    type); Multiply still refuses decimals and falls back with a
+    reason."""
     t = pa.table({"d": pa.array(
         [decimal.Decimal("1.25"), decimal.Decimal("-2.50"), None],
         pa.decimal128(10, 2))})
     df = session.create_dataframe(t).select(
         (col("d") + col("d")).alias("dbl"))
-    why = df.explain()
-    assert "does not support input type decimal(10,2)" in why, why
-    out = df.collect().to_pydict()  # CPU fallback computes it right
+    assert "does not support" not in df.explain()
+    out = df.collect().to_pydict()
     assert out["dbl"][0] == decimal.Decimal("2.50")
     assert out["dbl"][2] is None
+
+    dfm = session.create_dataframe(t).select(
+        (col("d") * col("d")).alias("sq"))
+    why = dfm.explain()
+    assert "does not support input type decimal(10,2)" in why, why
+    assert dfm.collect().to_pydict()["sq"][0] == \
+        decimal.Decimal("1.5625")  # CPU fallback computes it right
 
 
 def test_decimal_sum_stays_on_tpu(session):

@@ -273,6 +273,27 @@ def test_bounded_range_frames_nan_keys(session):
     assert_tpu_cpu_equal(out)
 
 
+def test_bounded_range_frames_inf_and_nan_keys(session):
+    """Genuine +-inf order keys must not capture NaN/null rows into
+    their frames (the ordering-class lexicographic bisect)."""
+    rng = np.random.default_rng(29)
+    base = [float("-inf"), -3.0, -1.0, 0.0, 2.0, float("inf"),
+            float("nan"), None]
+    n = 160
+    ts = [base[i] for i in rng.integers(0, len(base), n)]
+    t = pa.table({
+        "k": rng.integers(0, 3, n),
+        "ts": pa.array(ts, pa.float64()),
+        "v": rng.integers(1, 5, n).astype(np.float64),
+    })
+    df = session.create_dataframe(t)
+    w = Window.partition_by("k").order_by("ts").range_between(-2, 2)
+    out = df.select("k", "ts", "v",
+                    sum_(col("v")).over(w).alias("s"),
+                    count_star().over(w).alias("n"))
+    assert_tpu_cpu_equal(out)
+
+
 def test_md5_wide_strings(session):
     """The fori_loop block schedule handles strings past any width
     bucket (no eval-time cliff)."""
