@@ -325,24 +325,38 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
     count.  The batch is first SHRUNK on device to its live row count
     (padding rows never cross the wire — a 1-row aggregate result in a
     million-row capacity bucket is a 1-row transfer, not a 100MB one)."""
-    n_live = batch.concrete_num_rows()
     from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
 
-    shrunk_cap = max(128, -(-n_live // 128) * 128)
-    if shrunk_cap < batch.capacity:
-        batch = batch.shrink_to_capacity(shrunk_cap)
-    batch = _CB(batch.columns, n_live, batch.schema)
-    comps: list = []
-    for col in batch.columns:
-        if isinstance(col, ListColumn):
-            comps += [col.values, col.lengths, col.elem_validity,
-                      col.validity]
-        elif isinstance(col, StringColumn):
-            comps += [col.chars, col.lengths, col.validity]
-        else:
-            comps += [col.data, col.validity]
-    host = jax.device_get(comps)  # ONE batched D2H round for the batch
-    n = n_live
+    def _comps_of(b):
+        comps: list = []
+        for col in b.columns:
+            if isinstance(col, ListColumn):
+                comps += [col.values, col.lengths, col.elem_validity,
+                          col.validity]
+            elif isinstance(col, StringColumn):
+                comps += [col.chars, col.lengths, col.validity]
+            else:
+                comps += [col.data, col.validity]
+        return comps
+
+    if batch.capacity <= 1024 and not isinstance(batch.num_rows, int):
+        # small batch with a device-resident row count (aggregate
+        # results, limits): fetch the count WITH the components in one
+        # D2H round instead of syncing the count first — each round
+        # pays full link latency
+        host = jax.device_get([batch.num_rows] + _comps_of(batch))
+        n = n_live = int(host[0])
+        host = host[1:]
+        batch = _CB(batch.columns, n_live, batch.schema)
+    else:
+        n_live = batch.concrete_num_rows()
+        shrunk_cap = max(128, -(-n_live // 128) * 128)
+        if shrunk_cap < batch.capacity:
+            batch = batch.shrink_to_capacity(shrunk_cap)
+        batch = _CB(batch.columns, n_live, batch.schema)
+        # ONE batched D2H round for the whole batch
+        host = jax.device_get(_comps_of(batch))
+        n = n_live
 
     arrays = []
     ci = 0

@@ -260,12 +260,18 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
             op = {A.Add: _op.add, A.Subtract: _op.sub,
                   A.Multiply: _op.mul}[type(e)]
             out = []
-            for a, b in zip(lv, rv):
-                if a is None or b is None:
-                    out.append(None)
-                    continue
-                v = op(a, b).quantize(q, rounding=_dec.ROUND_HALF_UP)
-                out.append(None if abs(v) >= bound else v)
+            # wide context: the default 28-digit context would RAISE
+            # (or double-round) on products wider than 28 digits —
+            # exactly the values the overflow contract must NULL
+            with _dec.localcontext() as ctx:
+                ctx.prec = 76
+                for a, b in zip(lv, rv):
+                    if a is None or b is None:
+                        out.append(None)
+                        continue
+                    v = op(a, b).quantize(q,
+                                          rounding=_dec.ROUND_HALF_UP)
+                    out.append(None if abs(v) >= bound else v)
             return pa.array(out, at)
         fn = {A.Add: pc.add, A.Subtract: pc.subtract,
               A.Multiply: pc.multiply}[type(e)]
@@ -494,12 +500,14 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
         q = _dec.Decimal(1).scaleb(-e.target.scale)
         bound = _dec.Decimal(10) ** (e.target.precision - e.target.scale)
         out = []
-        for v in vals:
-            if v is None:
-                out.append(None)
-                continue
-            r = v.quantize(q, rounding=_dec.ROUND_HALF_UP)
-            out.append(None if abs(r) >= bound else r)
+        with _dec.localcontext() as ctx:
+            ctx.prec = 76  # wide children must NULL, not raise
+            for v in vals:
+                if v is None:
+                    out.append(None)
+                    continue
+                r = v.quantize(q, rounding=_dec.ROUND_HALF_UP)
+                out.append(None if abs(r) >= bound else r)
         return pa.array(out, pa.decimal128(e.target.precision,
                                            e.target.scale))
     if isinstance(e, Md5):

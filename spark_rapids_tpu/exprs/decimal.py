@@ -103,8 +103,17 @@ class CheckOverflow(Expression):
             half = p // 2
             mag = (jnp.abs(data) + half) // p
             data = jnp.where(data < 0, -mag, mag)
+            bound = jnp.int64(10 ** self.target.precision)
+            ok = (data > -bound) & (data < bound)
         elif diff < 0:
-            data = data * jnp.int64(10 ** (-diff))
-        bound = jnp.int64(10 ** self.target.precision)
-        ok = (data > -bound) & (data < bound)
+            # guard BEFORE scaling up: int64 wraparound could land a
+            # huge value back inside the bound and return a wrong
+            # non-null result — the exact rows this exists to NULL
+            mult = 10 ** (-diff)
+            limit = jnp.int64((10 ** self.target.precision - 1) // mult)
+            ok = (data >= -limit) & (data <= limit)
+            data = data * jnp.int64(mult)
+        else:
+            bound = jnp.int64(10 ** self.target.precision)
+            ok = (data > -bound) & (data < bound)
         return Column(data, c.validity & ok, self.target)

@@ -78,8 +78,8 @@ for _sig, _classes in (
     (_PASSTHROUGH, (B.Alias, B.BoundReference, B.ColumnReference,
                     B.Literal)),
     (TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.NULLSIG,
-                "decimal operands must share precision/scale "
-                "(PromotePrecision)"), (A.Add, A.Subtract)),
+                "decimal results wider than precision 18 fall back"),
+     (A.Add, A.Subtract)),
     (_ARITH, (A.Multiply, A.Divide, A.IntegralDivide,
               A.Remainder, A.Pmod, A.UnaryMinus, A.UnaryPositive, A.Abs,
               A.Least, A.Greatest)),

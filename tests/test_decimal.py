@@ -104,3 +104,29 @@ def test_decimal_add_beyond_precision_falls_back(session):
     exec_, meta = plan_query(df._plan)
     assert isinstance(exec_, CpuFallbackExec), meta.explain()
     assert df.collect(engine="tpu").to_pydict()["s"] == [D("2.20")]
+
+
+def test_check_overflow_scale_up_wraparound(session):
+    """Scaling UP near int64 limits must NULL, not wrap back inside
+    the bound (the int64 wraparound trap)."""
+    v = D("184467440737095517")  # *100 wraps modulo 2**64 to ~84
+    t = pa.table({"d": pa.array([v, D("1")], pa.decimal128(18, 0))})
+    tgt = T.DecimalType(18, 2)
+    df = (session.create_dataframe(t)
+          .select(CheckOverflow(col("d"), tgt).alias("o")))
+    got = df.collect(engine="tpu").to_pydict()["o"]
+    assert got[0] is None  # overflow -> NULL, never a wrong value
+    assert got[1] == D("1.00")
+    assert got == df.collect(engine="cpu").to_pydict()["o"]
+
+
+def test_wide_decimal_fallback_nulls_not_crashes(session):
+    """CPU-fallback decimal multiply beyond the 18-digit engine cap
+    returns NULL (documented divergence) instead of raising."""
+    t = pa.table({"d": pa.array([D("10000000000000000"), D("2")],
+                                pa.decimal128(18, 0))})
+    df = session.create_dataframe(t).select((col("d") * col("d"))
+                                            .alias("sq"))
+    out = df.collect(engine="tpu").to_pydict()["sq"]
+    assert out[0] is None  # 10^32 cannot fit 18 digits
+    assert out[1] == D("4")
