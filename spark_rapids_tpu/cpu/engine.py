@@ -1307,6 +1307,9 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
             # Spark defaults ignoreNulls=false; pyarrow defaults skip
             aggs.append((in_names[0], fname, pc.ScalarAggregateOptions(
                 skip_nulls=fn.ignore_nulls, min_count=0)))
+        elif fname in ("collectlist", "collectset"):
+            aggs.append((in_names[0], "list"))
+            nan_fix[si] = ("collect", in_names[0], fname)
         elif fname in ("min", "max") and pa.types.is_floating(
                 proj.column(in_names[0]).type):
             # Spark float total order: NaN greatest.  Aggregate the
@@ -1336,6 +1339,19 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
     for si, (in_names, fname, out_name, fn) in enumerate(agg_specs):
         spec = aggs[ai]
         src, op = (spec[0], spec[1]) if spec[0] else ("", spec[1])
+        if isinstance(nan_fix.get(si), tuple):
+            _tag, base, fname2 = nan_fix[si]
+            lists = res.column(f"{base}_list").to_pylist()
+            out = []
+            for lv in lists:
+                xs = [x for x in (lv or []) if x is not None]
+                if fname2 == "collectset":
+                    xs = _dedup_total_order(xs)
+                out.append(xs)
+            out_arrays.append(pa.array(
+                out, type=aschema.field(n_keys + si).type))
+            ai += 1
+            continue
         if si in nan_fix:
             base = nan_fix[si]
             vals = res.column(f"{base}__clean_{fname}")
@@ -1392,7 +1408,28 @@ def _grand_agg(proj: pa.Table, in_names, fname, fn=None) -> pa.Scalar:
         if len(vals) == 0:
             return pa.scalar(None, col.type)
         return vals[0] if fname == "first" else vals[-1]
+    if fname in ("collectlist", "collectset"):
+        xs = [x for x in col.to_pylist() if x is not None]
+        if fname == "collectset":
+            xs = _dedup_total_order(xs)
+        return pa.scalar(xs, pa.list_(col.type))
     raise NotImplementedError(fname)
+
+
+def _dedup_total_order(xs: list) -> list:
+    """Keep-first dedup under Spark's total-order equality (NaN == NaN)
+    — ONE implementation for grouped and grand collect_set."""
+    import math as _math
+
+    kept: list = []
+    for x in xs:
+        dup = any(
+            (isinstance(x, float) and isinstance(y, float)
+             and _math.isnan(x) and _math.isnan(y)) or x == y
+            for y in kept)
+        if not dup:
+            kept.append(x)
+    return kept
 
 
 def _spark_sortable(arr: pa.Array) -> pa.Array:

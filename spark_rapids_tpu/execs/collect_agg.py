@@ -1,0 +1,106 @@
+"""Dedicated exec for collect_list / collect_set aggregations.
+
+Ragged results break the one-compiled-program aggregate pipeline: the
+output list width is data-dependent.  This exec runs the two-phase
+design from ops/collect.py — phase 1 (sorted segments + kept counts)
+syncs exactly two scalars to the host, which become phase 2's static
+shapes (width bucket, group-capacity bucket), so each distinct result
+shape compiles once and is reused.
+
+Single input partition only; the planner falls back to the CPU engine
+for multi-partition or mixed-aggregate plans (the reference leans on
+cudf's native ragged lists here — a merge of dense list partials is a
+future widening, ref: AggregateFunctions.scala GpuCollectList)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.column import pad_capacity, pad_width
+from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
+from spark_rapids_tpu.exprs.base import EvalContext
+
+
+class TpuCollectAggExec(TpuExec):
+    def __init__(self, groups: Sequence, aggs: Sequence, child: TpuExec):
+        super().__init__(child)
+        self.groups = list(groups)
+        self.aggs = list(aggs)
+        self.kinds = [na.fn.collect_kind for na in self.aggs]
+        from spark_rapids_tpu.plan.logical import _output_fields
+
+        kf = list(_output_fields(self.groups).fields)
+        self._schema = T.Schema(
+            kf + [na.output_field() for na in self.aggs])
+        self._aug_schema = T.Schema(
+            kf + [T.Field(f"__v{i}", na.fn.child.dtype, True)
+                  for i, na in enumerate(self.aggs)])
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(g.name for g in self.groups)
+        vs = ", ".join(f"{na.fn.name}({na.fn.child.name})"
+                       for na in self.aggs)
+        return f"TpuCollectAggExec keys=[{ks}] [{vs}]"
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _project(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext.for_batch(batch)
+        cols = [g.eval(ctx) for g in self.groups] \
+            + [na.fn.child.eval(ctx) for na in self.aggs]
+        return ColumnarBatch(cols, batch.num_rows, self._aug_schema)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        import jax
+
+        from spark_rapids_tpu.execs.jit_cache import (
+            cached_jit,
+            exprs_key,
+        )
+        from spark_rapids_tpu.ops import collect as C
+
+        batches = list(self.children[0].execute())
+        big = batches[0] if len(batches) == 1 else concat_batches(batches)
+        key = ("collectagg", exprs_key(self.groups),
+               exprs_key([na.fn.child for na in self.aggs]),
+               tuple(self.kinds), repr(self._aug_schema))
+        n_keys = len(self.groups)
+        kinds = tuple(self.kinds)
+
+        def phase1(b):
+            return C.collect_phase1(self._project(b), n_keys, kinds)
+
+        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            sb, live_s, ng, mk = cached_jit(
+                key + ("p1", big.capacity), lambda: phase1)(big)
+            num_groups, max_kept = (int(x) for x in
+                                    jax.device_get([ng, mk]))
+            L = pad_width(max(max_kept, 1))
+            out_cap = pad_capacity(max(num_groups, 1))
+
+            def phase2(sb_, live_):
+                return C.collect_phase2(sb_, live_, n_keys, kinds, L,
+                                        out_cap, self._schema)
+
+            out = t.observe(cached_jit(
+                key + ("p2", L, out_cap, sb.capacity),
+                lambda: phase2)(sb, live_s))
+        import dataclasses
+
+        out = dataclasses.replace(
+            out, num_rows=num_groups if n_keys else max(num_groups, 1))
+        if n_keys and num_groups == 0:
+            return  # grouped collect over empty input: no rows
+        yield self._count_output(out)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        assert p == 0
+        yield from self.execute()

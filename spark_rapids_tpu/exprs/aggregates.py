@@ -233,3 +233,48 @@ class NamedAgg:
 
     def output_field(self) -> T.Field:
         return T.Field(self.out_name, self.fn.dtype, self.fn.nullable)
+
+
+class CollectList(AggregateFunction):
+    """collect_list(expr): non-null inputs gathered into an array per
+    group (ref: AggregateFunctions.scala GpuCollectList; element order
+    is unspecified, as in Spark).  Executes on a dedicated two-phase
+    dense-list exec (ops/collect.py); multi-partition plans fall back."""
+
+    collect_kind = "list"
+
+    def update_ops(self):
+        return ["collect"]
+
+    def merge_ops(self):
+        return ["collect"]
+
+    @property
+    def dtype(self) -> T.DataType:
+        cdt = self.child.dtype
+        if isinstance(cdt, T.ListType):
+            # nested arrays have no logical type in this engine —
+            # a query-construction error, not a fallback (documented
+            # divergence: the reference supports array<array<T>>)
+            raise TypeError(
+                f"{self.name} over an array column is not supported "
+                "by this engine (no nested array type)")
+        return T.ListType(cdt)
+
+    @property
+    def nullable(self) -> bool:
+        return False  # empty group -> empty list, never NULL
+
+    def check_supported(self) -> None:
+        dt = self.child.dtype
+        if isinstance(dt, (T.StringType, T.DecimalType)):
+            raise TypeError(
+                f"{self.name} over {dt.name} input runs on the CPU "
+                "engine (device lists hold fixed-width elements only)")
+
+
+class CollectSet(CollectList):
+    """collect_set(expr): distinct non-null inputs per group (total
+    order equality: NaN == NaN dedups)."""
+
+    collect_kind = "set"

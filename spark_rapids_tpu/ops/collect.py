@@ -1,0 +1,137 @@
+"""collect_list / collect_set device kernels.
+
+TPU shape of the reference's collect aggregations (ref:
+AggregateFunctions.scala GpuCollectList/GpuCollectSet over cudf
+collect_list): cudf emits ragged lists; XLA wants static shapes, so the
+result is the dense ListColumn layout (values[groups, L] + lengths)
+and L is discovered with ONE host sync between two compiled phases:
+
+  phase 1 (traced): sort rows by (keys, value), segment them, count
+     each group's kept elements (non-null; first-of-run for sets) —
+     returns the sorted batch plus (num_groups, max_kept) scalars;
+  phase 2 (traced, static L/out_cap from the sync): scatter each kept
+     element to (group, position) in one 2-D scatter, compact the key
+     rows, synthesize lengths/validities.
+
+Spark semantics: nulls are skipped, all-null groups produce EMPTY
+lists (never NULL), set dedup uses the total order (NaN == NaN)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, ListColumn, StringColumn
+from spark_rapids_tpu.ops.groupby import _keys_equal_adjacent
+from spark_rapids_tpu.ops.sort import SortOrder, sort_permutation
+
+
+def collect_phase1(batch: ColumnarBatch, n_keys: int,
+                   kinds: Sequence[str]):
+    """Sort/segment the (keys ++ values) batch.  Returns
+    (sorted_batch, num_groups, max_kept) — the last two are 0-d arrays
+    the driver syncs to size phase 2."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    n_vals = len(kinds)
+    orders = [SortOrder(o) for o in range(n_keys + n_vals)]
+    perm = sort_permutation(batch, orders)
+    sb = batch.gather(perm, batch.num_rows)
+    live_s = jnp.take(live, perm)
+
+    is_start, seg_id, num_groups = _segments(sb, n_keys, live_s, cap)
+    max_kept = jnp.zeros((), jnp.int32)
+    for vi, kind in enumerate(kinds):
+        kept = _kept_mask(sb.columns[n_keys + vi], kind, is_start,
+                          live_s)
+        counts = jax.ops.segment_sum(kept.astype(jnp.int32), seg_id,
+                                     num_segments=cap)
+        max_kept = jnp.maximum(max_kept, jnp.max(counts))
+    return sb, live_s, num_groups, max_kept
+
+
+def _segments(sb: ColumnarBatch, n_keys: int, live_s, cap: int):
+    same = jnp.ones((cap,), bool)
+    for kc in sb.columns[:n_keys]:
+        same = same & _keys_equal_adjacent(kc)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_start = live_s & ((idx == 0) | ~same)
+    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    seg_id = jnp.where(live_s, seg_id, cap)
+    return is_start, seg_id, jnp.sum(is_start.astype(jnp.int32))
+
+
+def _kept_mask(vc, kind: str, is_start, live_s):
+    kept = vc.validity & live_s
+    if kind == "set":
+        # rows are value-sorted within each segment: keep the first of
+        # each run of equal values (total-order equality: NaN == NaN)
+        same_val = _keys_equal_adjacent(vc)
+        prev_valid = jnp.concatenate(
+            [jnp.zeros((1,), bool), vc.validity[:-1]])
+        kept = kept & (is_start | ~same_val | ~prev_valid)
+    return kept
+
+
+def collect_phase2(sb: ColumnarBatch, live_s, n_keys: int,
+                   kinds: Sequence[str], L: int, out_cap: int,
+                   out_schema: T.Schema) -> ColumnarBatch:
+    """Assemble the output batch: compact keys ++ one ListColumn per
+    collect (L and out_cap are static, from the phase-1 sync)."""
+    cap = sb.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_start, seg_id, num_groups = _segments(sb, n_keys, live_s, cap)
+    group_live = jnp.arange(out_cap, dtype=jnp.int32) < num_groups
+    start_dest = jnp.where(is_start, seg_id, out_cap)
+
+    out_cols = []
+    for kc in sb.columns[:n_keys]:
+        if isinstance(kc, StringColumn):
+            chars = jnp.zeros((out_cap,) + kc.chars.shape[1:],
+                              kc.chars.dtype).at[start_dest].set(
+                kc.chars, mode="drop")
+            lengths = jnp.zeros(out_cap, jnp.int32).at[start_dest].set(
+                kc.lengths, mode="drop")
+            valid = jnp.zeros(out_cap, bool).at[start_dest].set(
+                kc.validity, mode="drop") & group_live
+            out_cols.append(StringColumn(chars, lengths, valid))
+        else:
+            data = jnp.zeros(out_cap, kc.data.dtype).at[start_dest].set(
+                kc.data, mode="drop")
+            valid = jnp.zeros(out_cap, bool).at[start_dest].set(
+                kc.validity, mode="drop") & group_live
+            out_cols.append(Column(data, valid, kc.dtype))
+
+    for vi, kind in enumerate(kinds):
+        vc = sb.columns[n_keys + vi]
+        kept = _kept_mask(vc, kind, is_start, live_s)
+        # position within the group among kept elements: inclusive
+        # running count minus the count at the segment's entry (the
+        # cummax trick works because the running count never decreases)
+        run = jnp.cumsum(kept.astype(jnp.int32))
+        seg_base = jax.lax.cummax(
+            jnp.where(is_start, run - kept.astype(jnp.int32), 0))
+        pos = run - 1 - seg_base
+        row_dest = jnp.where(kept, seg_id, out_cap)
+        col_dest = jnp.where(kept, pos, 0)
+        values = jnp.zeros((out_cap, L), vc.data.dtype).at[
+            row_dest, col_dest].set(vc.data, mode="drop")
+        lengths = jax.ops.segment_sum(
+            kept.astype(jnp.int32), seg_id,
+            num_segments=out_cap).astype(jnp.int32)
+        # grand collect over empty input still emits one EMPTY list
+        # (Spark: collect over no rows is [], never NULL)
+        row_valid = group_live if n_keys else group_live | (
+            jnp.arange(out_cap, dtype=jnp.int32) == 0)
+        lengths = jnp.where(group_live, lengths, 0)
+        evalid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+        elem_dtype = out_schema.fields[n_keys + vi].dtype.element
+        out_cols.append(ListColumn(values, lengths, evalid, row_valid,
+                                   T.ListType(elem_dtype)))
+
+    n_rows = num_groups if n_keys else jnp.maximum(num_groups, 1)
+    return ColumnarBatch(out_cols, n_rows, out_schema)

@@ -150,10 +150,17 @@ register_expr(JaxScalarUDF, TS.ExprSig(
 from spark_rapids_tpu.exprs import aggregates as AG  # noqa: E402
 
 SUPPORTED_AGGS = (AG.Sum, AG.Count, AG.CountStar, AG.Min, AG.Max,
-                  AG.Average, AG.First, AG.Last)
+                  AG.Average, AG.First, AG.Last, AG.CollectList,
+                  AG.CollectSet)
 
 #: per-aggregate input signatures (ref: TypeChecks on AggExprMeta)
 AGG_SIGS: dict[type, TS.ExprSig] = {
+    AG.CollectList: TS.ExprSig(
+        TS.NUMERIC + TS.DATETIME + TS.BOOLEAN + TS.NULLSIG,
+        "fixed-width elements only"),
+    AG.CollectSet: TS.ExprSig(
+        TS.NUMERIC + TS.DATETIME + TS.BOOLEAN + TS.NULLSIG,
+        "fixed-width elements only"),
     AG.Sum: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.NULLSIG),
     AG.Average: TS.ExprSig(TS.NUMERIC + TS.NULLSIG,
                            "decimal avg needs scale-aware division"),
@@ -184,6 +191,14 @@ def _check_agg(fn, conf, reasons: set[str]) -> None:
         reasons.add(
             f"aggregate {fn.name} does not support input type "
             f"{dt.name} on TPU (supported: {sig.inputs.describe()})")
+    # data-dependent capability checks (the AggExprMeta.tagAggForGpu
+    # hook): a raise becomes a fallback reason
+    check = getattr(fn, "check_supported", None)
+    if check is not None:
+        try:
+            check()
+        except TypeError as exc:
+            reasons.add(str(exc))
 
 # per-exec kill switches (ref: spark.rapids.sql.exec.*)
 _EXEC_CONFS = {
@@ -662,6 +677,19 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
     )
     from spark_rapids_tpu.ops.partition import HashPartitioning
 
+    has_collect = any(isinstance(na.fn, AG.CollectList)
+                      for na in p.aggs)
+    if has_collect:
+        # ragged results need the dedicated two-phase dense-list exec:
+        # single input partition, collect-only aggregate lists (mixed
+        # or multi-partition plans fall back — the merge of dense list
+        # partials is a future widening)
+        if child_exec.num_partitions > 1 or not all(
+                isinstance(na.fn, AG.CollectList) for na in p.aggs):
+            return CpuFallbackExec(p, child_exec)
+        from spark_rapids_tpu.execs.collect_agg import TpuCollectAggExec
+
+        return TpuCollectAggExec(p.groups, p.aggs, child_exec)
     if p.groups:
         # tier-2 lowering: with the collective transport active, the
         # whole partial->exchange->final pipeline becomes ONE fused
@@ -746,13 +774,58 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
         from spark_rapids_tpu.plan.cost import optimize_costs
 
         optimize_costs(meta)
+        _demote_unrepresentable_boundaries(meta)
     else:
         meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
     return convert_meta(meta), meta
 
 
+def _schema_device_representable(schema: T.Schema) -> bool:
+    """Can a batch of this schema live in device columns?  list<string>
+    / list<decimal> exist logically (CPU-engine results) but have no
+    dense device layout."""
+    for f in schema.fields:
+        if isinstance(f.dtype, T.ListType) and isinstance(
+                f.dtype.element, (T.StringType, T.DecimalType,
+                                  T.ListType)):
+            return False
+    return True
+
+
+def _demote_unrepresentable_boundaries(meta: PlanMeta) -> None:
+    """A TPU node above a CPU child whose output cannot be uploaded
+    would crash at the transition — push the CPU region up until every
+    host->device boundary carries representable types (iterates because
+    each demotion creates a new boundary one level up)."""
+    changed = True
+    while changed:
+        changed = False
+
+        def walk(m: PlanMeta) -> None:
+            nonlocal changed
+            for c in m.children:
+                if m.can_replace and not c.can_replace \
+                        and not _schema_device_representable(
+                            c.plan.schema):
+                    m.will_not_work(
+                        "child output type has no device layout "
+                        "(list of string/decimal) — runs on CPU")
+                    changed = True
+                walk(c)
+
+        walk(meta)
+
+
 def collect_exec(exec_: TpuExec) -> pa.Table:
     """Drain an exec to a host Arrow table (the D2H plan root)."""
+    if isinstance(exec_, CpuFallbackExec):
+        # a fully-CPU root: return the host table directly instead of
+        # bouncing it through device batches (also the only path for
+        # types the device layout cannot hold, e.g. list<string>)
+        try:
+            return exec_.cpu_table().cast(schema_to_arrow(exec_.schema))
+        finally:
+            exec_.close()
     try:
         tables = [to_arrow(b) for b in exec_.execute()]
     finally:

@@ -74,6 +74,18 @@ def avg(e: ExprLike) -> Average:
     return Average(_expr(e))
 
 
+def collect_list(e: ExprLike):
+    from spark_rapids_tpu.exprs.aggregates import CollectList
+
+    return CollectList(_expr(e))
+
+
+def collect_set(e: ExprLike):
+    from spark_rapids_tpu.exprs.aggregates import CollectSet
+
+    return CollectSet(_expr(e))
+
+
 def first(e: ExprLike, ignore_nulls: bool = False) -> First:
     return First(_expr(e), ignore_nulls)
 

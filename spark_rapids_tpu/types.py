@@ -161,10 +161,14 @@ class ListType(DataType):
     not map)."""
 
     def __init__(self, element: DataType):
-        if isinstance(element, (ListType, StringType)):
+        # string elements are representable LOGICALLY (schemas flowing
+        # through CPU-fallback plans, e.g. collect_list over strings);
+        # the DEVICE layout supports primitives only — TypeSig /
+        # check_supported route string-element lists to the CPU engine
+        if isinstance(element, ListType):
             raise TypeError(
-                f"list element type {element} not supported (primitive "
-                "elements only)")
+                f"list element type {element} not supported (no nested "
+                "lists)")
         self.element = element
 
     @property

@@ -1,0 +1,146 @@
+"""collect_list / collect_set — the two-phase dense-list exec vs the
+CPU oracle (element ORDER is unspecified in Spark, so comparisons
+canonicalize each list as a sorted multiset)."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import TpuSession, col, collect_list, collect_set
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _canon_cell(v):
+    if v is None:
+        return None
+    return sorted("NaN" if isinstance(x, float) and math.isnan(x)
+                  else str(x) for x in v)
+
+
+def _canon(tbl, keys):
+    rows = []
+    for r in tbl.to_pylist():
+        rows.append(tuple(
+            _canon_cell(v) if isinstance(v, list) else str(v)
+            for v in r.values()))
+    return sorted(rows)
+
+
+def test_grouped_collect_list_differential(session):
+    rng = np.random.default_rng(61)
+    n = 3000
+    t = pa.table({
+        "k": rng.integers(0, 12, n),
+        "v": pa.array([None if rng.random() < 0.15 else int(x)
+                       for x in rng.integers(0, 50, n)], pa.int64()),
+    })
+    df = (session.create_dataframe(t)
+          .group_by(col("k")).agg((collect_list(col("v")), "vs")))
+    got = df.collect(engine="tpu")
+    want = df.collect(engine="cpu")
+    assert _canon(got, 1) == _canon(want, 1)
+    # TPU plan, not fallback
+    from spark_rapids_tpu.execs.collect_agg import TpuCollectAggExec
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    exec_, _ = plan_query(df._plan)
+    assert isinstance(exec_, TpuCollectAggExec)
+
+
+def test_grouped_collect_set_dedups(session):
+    rng = np.random.default_rng(62)
+    n = 2000
+    vals = [None if rng.random() < 0.1
+            else float(rng.integers(0, 5)) for _ in range(n)]
+    for i in range(0, n, 37):
+        vals[i] = float("nan")  # NaN == NaN must dedup
+    t = pa.table({"k": rng.integers(0, 6, n),
+                  "v": pa.array(vals, pa.float64())})
+    df = (session.create_dataframe(t)
+          .group_by(col("k")).agg((collect_set(col("v")), "vs")))
+    got = df.collect(engine="tpu")
+    want = df.collect(engine="cpu")
+    assert _canon(got, 1) == _canon(want, 1)
+    for r in got.to_pylist():
+        nan_count = sum(1 for x in r["vs"]
+                        if isinstance(x, float) and math.isnan(x))
+        assert nan_count <= 1
+
+
+def test_grand_collect_and_empty(session):
+    t = pa.table({"v": pa.array([3, 1, None, 2], pa.int64())})
+    df = session.create_dataframe(t).agg((collect_list(col("v")), "vs"))
+    got = df.collect(engine="tpu").to_pydict()["vs"]
+    want = df.collect(engine="cpu").to_pydict()["vs"]
+    assert sorted(got[0]) == sorted(want[0]) == [1, 2, 3]
+
+    empty = session.create_dataframe(
+        pa.table({"v": pa.array([], pa.int64())}))
+    dfe = empty.agg((collect_list(col("v")), "vs"))
+    assert dfe.collect(engine="tpu").to_pydict()["vs"] == [[]]
+    assert dfe.collect(engine="cpu").to_pydict()["vs"] == [[]]
+
+
+def test_multipartition_collect_falls_back(session):
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+    from spark_rapids_tpu.plan.planner import CpuFallbackExec, plan_query
+
+    conf = get_conf()
+    old = conf.get(BATCH_SIZE_ROWS)
+    conf.set(BATCH_SIZE_ROWS.key, 100)
+    try:
+        rng = np.random.default_rng(63)
+        t = pa.table({"k": rng.integers(0, 4, 1000),
+                      "v": rng.integers(0, 9, 1000)})
+        df = (session.create_dataframe(t)
+              .group_by(col("k")).agg((collect_list(col("v")), "vs")))
+        exec_, _ = plan_query(df._plan)
+        assert isinstance(exec_, CpuFallbackExec)
+        got = df.collect(engine="tpu")
+        want = df.collect(engine="cpu")
+        assert _canon(got, 1) == _canon(want, 1)
+    finally:
+        conf.set(BATCH_SIZE_ROWS.key, old)
+
+
+def test_collect_over_strings_falls_back(session):
+    from spark_rapids_tpu.plan.planner import CpuFallbackExec, plan_query
+
+    t = pa.table({"k": [1, 1, 2], "s": ["a", "b", "a"]})
+    df = (session.create_dataframe(t)
+          .group_by(col("k")).agg((collect_list(col("s")), "vs")))
+    exec_, meta = plan_query(df._plan)
+    assert isinstance(exec_, CpuFallbackExec), meta.explain()
+    got = df.collect(engine="tpu")
+    want = df.collect(engine="cpu")
+    assert _canon(got, 1) == _canon(want, 1)
+
+
+def test_string_collect_below_tpu_parent(session):
+    """A TPU project above a CPU collect_list(string) would crash at
+    the upload boundary (list<string> has no device layout): the
+    planner must push the CPU region up over the parent."""
+    t = pa.table({"k": [1, 1, 2], "s": ["a", "b", "a"]})
+    df = (session.create_dataframe(t)
+          .group_by(col("k")).agg((collect_list(col("s")), "l"))
+          .select(col("k")))
+    from spark_rapids_tpu.plan.planner import CpuFallbackExec, plan_query
+
+    exec_, meta = plan_query(df._plan)
+    assert isinstance(exec_, CpuFallbackExec), meta.explain()
+    got = sorted(df.collect(engine="tpu").to_pydict()["k"])
+    assert got == sorted(df.collect(engine="cpu").to_pydict()["k"])
+
+
+def test_collect_over_array_column_is_construction_error(session):
+    t = pa.table({"k": [1, 1], "x": pa.array([[1, 2], [3]],
+                                             pa.list_(pa.int64()))})
+    with pytest.raises(TypeError, match="array column"):
+        (session.create_dataframe(t)
+         .group_by(col("k")).agg((collect_list(col("x")), "l")))
