@@ -134,9 +134,9 @@ class _MetricReaper:
         # observed arrays themselves would race the spill store's
         # .delete() (is_ready on a deleted PJRT buffer segfaults)
         try:
-            sentinels = [x[:0] for x in
-                         jax.tree_util.tree_leaves(observed)
-                         if isinstance(x, jax.Array) and x.ndim > 0]
+            sentinels = [x[:0] if x.ndim > 0 else x.reshape((1,))[:0]
+                         for x in jax.tree_util.tree_leaves(observed)
+                         if isinstance(x, jax.Array)]
         except Exception:
             return  # already deleted/donated: drop the sample
         self._q.put((metric, t0, sentinels))

@@ -140,9 +140,14 @@ class TpuShuffleExchangeExec(TpuExec):
             raise
         finally:
             sem.release_if_necessary(task_id)
+        try:
+            manager.commit_task(self._shuffle_id, pending)
+        except BaseException:
+            for _rid, h, _b, _r in pending:
+                h.close()
+            raise
         for _rid, _h, _b, rows in pending:
             self.metrics["shuffleWriteRows"].add(rows)
-        manager.commit_task(self._shuffle_id, pending)
 
     def _ensure_map_stage(self) -> None:
         from spark_rapids_tpu.ops.partition import RangePartitioning
@@ -290,12 +295,22 @@ class TpuShuffleExchangeExec(TpuExec):
                     raise
                 finally:
                     sem.release_if_necessary(task_id)
+                try:
+                    manager.commit_task(self._shuffle_id, pending)
+                except BaseException:
+                    for _rid, bh, _b, _r in pending:
+                        bh.close()
+                    h.unpin()
+                    raise
                 for _rid, _bh, _b, rows in pending:
                     self.metrics["shuffleWriteRows"].add(rows)
-                manager.commit_task(self._shuffle_id, pending)
-                h.close()
 
             with_task_retries(attempt, desc=f"range pass2 {idx}")
+            # Close the input AFTER the retry wrapper: anything that runs
+            # post-commit inside the retried closure would, on failure,
+            # re-run the attempt and publish the same reduce blocks twice
+            # (the commit must be the attempt's final observable effect).
+            handles[idx].close()
 
         try:
             self._run_tasks(pass2, len(handles), threads)

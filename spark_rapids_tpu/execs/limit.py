@@ -66,3 +66,31 @@ class TpuGlobalLimitExec(TpuLocalLimitExec):
 
     def execute(self) -> Iterator[ColumnarBatch]:
         yield from self._limited(self.children[0].execute())
+
+
+class TpuCollectLimitExec(TpuGlobalLimitExec):
+    """Collect-to-driver limit (ref: GpuCollectLimitExec): LocalLimit on
+    every child partition, then a single-partition global cap.  The
+    local stage prunes each partition to at most n rows BEFORE the
+    cross-partition drain, so a `LIMIT 10` over a wide child never
+    materializes more than n rows per partition."""
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        child = self.children[0]
+
+        def local_then_concat():
+            for p in range(child.num_partitions):
+                remaining = self.n
+                for b in child.execute_partition(p):
+                    if remaining <= 0:
+                        break
+                    rows = b.concrete_num_rows()
+                    if rows > remaining:
+                        out = b.slice_prefix(remaining)
+                        b = ColumnarBatch(out.columns, remaining,
+                                          out.schema)
+                        rows = remaining
+                    remaining -= rows
+                    yield b
+
+        yield from self._limited(local_then_concat())

@@ -120,6 +120,15 @@ SCAN_DECODE_THREADS = _config.register(
     "cloud reader's pool, ref: GpuParquetScan.scala:882-895 "
     "MultiFileCloudParquetPartitionReader).")
 
+FAST_DECODE = _config.register(
+    "spark.rapids.tpu.sql.scan.fastDecode", True,
+    "Decode supported Parquet column chunks with the native host codec "
+    "and evaluate pushed single-column predicates on dictionary values "
+    "(io/fastpar.py) instead of the general pyarrow read path — the "
+    "host-side mirror of the reference's device page decode (ref: "
+    "GpuParquetScan.scala:495-560).  Files with unsupported encodings, "
+    "nulls, or nested types silently use the standard path.")
+
 
 def _task_target_bytes() -> int:
     return _config.get_conf().get(FILES_PER_TASK_BYTES)
@@ -365,6 +374,18 @@ class ParquetScanExec(TpuExec):
         else:
             keep_rgs = list(range(n_rgs))
 
+        fast = self._try_fast_tables(f, fi, keep_rgs, conjuncts)
+        if fast is not None:
+            for tbl in fast:
+                for f2 in self.partition_fields:
+                    tbl = tbl.append_column(
+                        f2.name,
+                        self._host_partition_array(fi, f2, tbl.num_rows))
+                # multi-column conjuncts (not applied by the fast
+                # decoder) still prefilter here; survivors are few
+                yield self._host_prefilter(tbl)
+            return
+
         if f.metadata.num_rows <= self.batch_rows:
             # whole file fits one scan batch: single threaded columnar
             # read (iter_batches re-slices row groups and serializes
@@ -388,6 +409,35 @@ class ParquetScanExec(TpuExec):
                     f2.name,
                     self._host_partition_array(fi, f2, rb.num_rows))
             yield self._host_prefilter(tbl)
+
+    def _try_fast_tables(self, f, fi: int, keep_rgs,
+                         conjuncts) -> Optional[list]:
+        """Native fast-decode path (io/fastpar.py): returns the file's
+        surviving rows as host tables, or None to use pyarrow."""
+        if not getattr(self, "_fast_decode", True):
+            return None
+        from spark_rapids_tpu.io import fastpar
+
+        file_cols = self.columns
+        if file_cols is None:
+            pnames = {pf.name for pf in self.partition_fields}
+            file_cols = [fl.name for fl in self._schema.fields
+                         if fl.name not in pnames]
+        if not file_cols:
+            return None
+        use_conjs = conjuncts if getattr(self, "_prefilter_on", False) \
+            else None
+        tables = fastpar.read_file(
+            self.paths[fi], keep_rgs, file_cols, use_conjs,
+            self._schema, pqfile=f,
+            max_decoded_bytes=getattr(self, "_max_batch_bytes",
+                                      64 << 20))
+        if tables is not None and use_conjs:
+            kept_rg_rows = sum(f.metadata.row_group(g).num_rows
+                               for g in keep_rgs)
+            after = sum(t.num_rows for t in tables)
+            self.metrics["hostFilteredRows"].add(kept_rg_rows - after)
+        return tables
 
     def _upload(self, tables: list) -> ColumnarBatch:
         tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
@@ -444,22 +494,37 @@ class ParquetScanExec(TpuExec):
             from spark_rapids_tpu.io.pa_filter import compile_filter
 
             self._pa_filter = compile_filter(self.pushed_filter)
+        # conf is THREAD-LOCAL: snapshot on the calling (session) thread
+        # — task() runs on the prefetch producer thread, where get_conf()
+        # would return a fresh default and silently ignore session
+        # settings (decode threads, batch bytes, fastDecode)
+        conf = _config.get_conf()
+        self._fast_decode = conf.get(FAST_DECODE)
+        self._max_batch_bytes = conf.get(MAX_READ_BATCH_BYTES)
 
         def task():
             import os
 
             files = self._groups[p]
-            conf = _config.get_conf()
             # the pool materializes each file's decoded tables before
             # yielding, so it is bounded to files that fit one scan
             # batch (threads x batch bytes of host memory); bigger
             # files keep the one-table-at-a-time streaming path.  The
             # gate compares COMPRESSED on-disk size, so it budgets a
             # conservative 4x decode expansion (dict/RLE+snappy)
+            def _size_or_big(path: str) -> int:
+                # un-stat-able paths (object-store/remote URIs) must count
+                # as big: excluding them would let the pool materialize
+                # unbounded decoded tables, defeating the memory gate
+                try:
+                    return os.path.getsize(path)
+                except OSError:
+                    return 1 << 62
+
             big = any(
-                os.path.getsize(self.paths[fi]) >
-                conf.get(MAX_READ_BATCH_BYTES) // 4
-                for fi in files if os.path.exists(self.paths[fi]))
+                _size_or_big(self.paths[fi]) >
+                self._max_batch_bytes // 4
+                for fi in files)
             threads = min(conf.get(SCAN_DECODE_THREADS), len(files))
             if threads <= 1 or big:
                 for fi in files:

@@ -81,3 +81,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         fn.restype = None
     lib.scaled_check_encode.argtypes = [c.c_void_p, c.c_int64, c.c_void_p]
     lib.scaled_check_encode.restype = ctypes.c_int
+    lib.snappy_raw_decompress.argtypes = [c.c_void_p, c.c_int64,
+                                          c.c_void_p, c.c_int64]
+    lib.snappy_raw_decompress.restype = ctypes.c_int
+    lib.rle_unpack_u32.argtypes = [c.c_void_p, c.c_int64, c.c_int,
+                                   c.c_void_p, c.c_int64]
+    lib.rle_unpack_u32.restype = ctypes.c_int

@@ -489,6 +489,12 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
 
         return TpuWindowExec(p.window_exprs, kids[0])
     if isinstance(p, L.Limit):
+        if kids[0].num_partitions > 1:
+            # collect-limit shape: prune each partition locally before
+            # the single-partition drain (ref: GpuCollectLimitExec)
+            from spark_rapids_tpu.execs.limit import TpuCollectLimitExec
+
+            return TpuCollectLimitExec(p.n, kids[0])
         return TpuGlobalLimitExec(p.n, kids[0])
     if isinstance(p, L.Union):
         return TpuUnionExec(*kids)

@@ -110,6 +110,89 @@ def _known_expression_names() -> set:
     return names
 
 
+#: reference exec -> (module, class-name, note).  The class is resolved
+#: via importlib at validate() time, exactly like the expression path —
+#: a renamed or deleted implementation flips the entry to DRIFT instead
+#: of silently reporting phantom coverage.  None = known-missing.
+_EXEC_MAP: dict = {
+    "BatchScanExec": ("spark_rapids_tpu.io.scan", "ParquetScanExec",
+                      "+OrcScanExec/CsvScanExec"),
+    "FileSourceScanExec": ("spark_rapids_tpu.io.scan", "ParquetScanExec",
+                           "+pushdown, coalescing"),
+    "BroadcastExchangeExec": ("spark_rapids_tpu.execs.join",
+                              "TpuBroadcastHashJoinExec",
+                              "broadcast build collection inside"),
+    "BroadcastHashJoinExec": ("spark_rapids_tpu.execs.join",
+                              "TpuBroadcastHashJoinExec", ""),
+    "BroadcastNestedLoopJoinExec": ("spark_rapids_tpu.execs.join",
+                                    "TpuBroadcastHashJoinExec",
+                                    "cross/keyless-conditional path"),
+    "CartesianProductExec": ("spark_rapids_tpu.execs.join",
+                             "TpuShuffledHashJoinExec", "cross path"),
+    "CoalesceExec": ("spark_rapids_tpu.execs.coalesce",
+                     "TpuCoalescePartitionsExec", ""),
+    "CollectLimitExec": ("spark_rapids_tpu.execs.limit",
+                         "TpuCollectLimitExec", ""),
+    "CustomShuffleReaderExec": ("spark_rapids_tpu.execs.adaptive",
+                                "CoalescedShuffleReaderExec",
+                                "AQE coalesced partition specs"),
+    "DataWritingCommandExec": ("spark_rapids_tpu.io.write",
+                               "FileWriteExec", "+Parquet/Csv/Orc"),
+    "ExpandExec": ("spark_rapids_tpu.execs.expand", "TpuExpandExec", ""),
+    "FilterExec": ("spark_rapids_tpu.execs.basic", "TpuFilterExec", ""),
+    "GenerateExec": ("spark_rapids_tpu.execs.generate",
+                     "TpuGenerateExec", ""),
+    "GlobalLimitExec": ("spark_rapids_tpu.execs.limit",
+                        "TpuGlobalLimitExec", ""),
+    "LocalLimitExec": ("spark_rapids_tpu.execs.limit",
+                       "TpuLocalLimitExec", ""),
+    "HashAggregateExec": ("spark_rapids_tpu.execs.aggregate",
+                          "TpuHashAggregateExec", ""),
+    "SortAggregateExec": ("spark_rapids_tpu.execs.aggregate",
+                          "TpuHashAggregateExec", "sort-agnostic"),
+    "ProjectExec": ("spark_rapids_tpu.execs.basic", "TpuProjectExec", ""),
+    "RangeExec": ("spark_rapids_tpu.execs.basic", "TpuRangeExec", ""),
+    "ShuffleExchangeExec": ("spark_rapids_tpu.execs.exchange",
+                            "TpuShuffleExchangeExec", "+collective"),
+    "ShuffledHashJoinExec": ("spark_rapids_tpu.execs.join",
+                             "TpuShuffledHashJoinExec", ""),
+    "SortMergeJoinExec": ("spark_rapids_tpu.execs.join",
+                          "TpuShuffledHashJoinExec",
+                          "hash join instead, like the reference"),
+    "SortExec": ("spark_rapids_tpu.execs.sort", "TpuSortExec",
+                 "out-of-core"),
+    "TakeOrderedAndProjectExec": ("spark_rapids_tpu.execs.sort",
+                                  "TpuTakeOrderedAndProjectExec", ""),
+    "UnionExec": ("spark_rapids_tpu.execs.basic", "TpuUnionExec", ""),
+    "WindowExec": ("spark_rapids_tpu.execs.window", "TpuWindowExec", ""),
+}
+
+
+def _resolve_execs():
+    """Probe every _EXEC_MAP entry against the live modules.  Returns
+    (resolved {ref: display}, missing [ref], drift [ref]) where drift
+    means the map names a module/class that does not exist."""
+    import importlib
+
+    resolved: dict = {}
+    missing: list = []
+    drift: list = []
+    for ref, entry in _EXEC_MAP.items():
+        if entry is None:
+            missing.append(ref)
+            continue
+        mod, cls, note = entry
+        try:
+            ok = hasattr(importlib.import_module(mod), cls)
+        except ImportError:
+            ok = False
+        if ok:
+            resolved[ref] = f"{cls}" + (f" ({note})" if note else "")
+        else:
+            drift.append(ref)
+    return resolved, sorted(missing), sorted(drift)
+
+
 def validate() -> dict:
     """Return {'expressions': (supported, missing), 'execs': ...} by
     diffing the live registries against the reference checklist."""
@@ -117,41 +200,17 @@ def validate() -> dict:
     exprs_ok = sorted(n for n in REFERENCE_EXPRESSIONS if n in have)
     exprs_missing = sorted(n for n in set(REFERENCE_EXPRESSIONS) - have)
 
-    exec_map = {
-        "BatchScanExec": "ParquetScanExec/OrcScanExec/CsvScanExec",
-        "FileSourceScanExec": "ParquetScanExec (+pushdown, coalescing)",
-        "BroadcastExchangeExec": "broadcast build collection in "
-                                 "TpuBroadcastHashJoinExec",
-        "BroadcastHashJoinExec": "TpuBroadcastHashJoinExec",
-        "BroadcastNestedLoopJoinExec": "TpuNestedLoopJoinExec",
-        "CoalesceExec": "TpuCoalesceBatchesExec",
-        "CollectLimitExec": None,
-        "CartesianProductExec": "TpuNestedLoopJoinExec (cross)",
-        "CustomShuffleReaderExec": None,
-        "DataWritingCommandExec": "FileWriteExec (+Parquet/Csv/Orc)",
-        "ExpandExec": "TpuExpandExec",
-        "FilterExec": "TpuFilterExec",
-        "GenerateExec": "TpuGenerateExec",
-        "GlobalLimitExec": "TpuGlobalLimitExec",
-        "LocalLimitExec": "TpuGlobalLimitExec (per-partition mode)",
-        "HashAggregateExec": "TpuHashAggregateExec",
-        "SortAggregateExec": "TpuHashAggregateExec (sort-agnostic)",
-        "ProjectExec": "TpuProjectExec",
-        "RangeExec": "TpuRangeExec",
-        "ShuffleExchangeExec": "TpuShuffleExchangeExec (+collective)",
-        "ShuffledHashJoinExec": "TpuShuffledHashJoinExec",
-        "SortMergeJoinExec": "TpuShuffledHashJoinExec (hash instead)",
-        "SortExec": "TpuSortExec (out-of-core)",
-        "TakeOrderedAndProjectExec": "Sort+Limit composition",
-        "UnionExec": "TpuUnionExec",
-        "WindowExec": "TpuWindowExec",
-    }
-    execs_ok = sorted(k for k, v in exec_map.items() if v)
-    execs_missing = sorted(k for k, v in exec_map.items() if not v)
+    resolved, missing, drift = _resolve_execs()
+    exec_map = dict(resolved)
+    for ref in missing:
+        exec_map[ref] = None
+    for ref in drift:
+        exec_map[ref] = None
 
     return {
         "expressions": (exprs_ok, exprs_missing),
-        "execs": (execs_ok, execs_missing, exec_map),
+        "execs": (sorted(resolved), missing + drift, exec_map),
+        "exec_drift": drift,
         "scans": (list(REFERENCE_SCANS), []),
         "partitionings": (list(REFERENCE_PARTITIONINGS), []),
     }

@@ -87,36 +87,46 @@ class QueryHistory:
             return cls._pool
 
     def __init__(self, capacity: int = 100):
+        import threading
+
         self.capacity = capacity
         self._events: list[QueryEvent] = []
         self._next_id = 0
         self._pending: list = []
+        # guards _pending/_next_id/_events against caller-thread vs
+        # worker/reader races (a reader swapping _pending mid-append
+        # would drop a just-recorded snapshot future)
+        self._mu = threading.Lock()
 
     def record(self, explain: str, exec_tree: TpuExec,
                wall_s: float) -> None:
-        qid = self._next_id
-        self._next_id += 1
         ts = time.time()
 
-        def snap():
+        def snap(qid):
             ev = QueryEvent(qid, explain, snapshot_exec(exec_tree),
                             wall_s, ts)
-            self._events.append(ev)
-            if len(self._events) > self.capacity:
-                self._events.pop(0)
-        # drop settled futures so a never-inspected history stays O(1)
-        self._pending = [f for f in self._pending if not f.done()]
-        self._pending.append(self._worker().submit(snap))
+            with self._mu:
+                self._events.append(ev)
+                if len(self._events) > self.capacity:
+                    self._events.pop(0)
+        with self._mu:
+            qid = self._next_id
+            self._next_id += 1
+            # drop settled futures so a never-inspected history stays O(1)
+            self._pending = [f for f in self._pending if not f.done()]
+            self._pending.append(self._worker().submit(snap, qid))
 
     def _drain(self) -> None:
-        pending, self._pending = self._pending, []
+        with self._mu:
+            pending, self._pending = self._pending, []
         for f in pending:
             f.result()
 
     @property
     def events(self) -> list[QueryEvent]:
         self._drain()
-        return list(self._events)
+        with self._mu:
+            return list(self._events)
 
 
 def _walk_snap(s: NodeSnapshot):

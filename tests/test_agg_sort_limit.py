@@ -163,6 +163,34 @@ def test_local_limit_stream():
     assert out["k"] == [1, 2, 3]
 
 
+class _PartitionedSource(TpuBatchSourceExec):
+    """One partition per pre-built batch (exchange-shaped child)."""
+
+    @property
+    def num_partitions(self):
+        return len(self._batches)
+
+    def execute_partition(self, p):
+        yield self._count_output(self._batches[p])
+
+
+def test_collect_limit_multi_partition():
+    """CollectLimit = local limit per partition + global cap
+    (ref: GpuCollectLimitExec): partitions past the limit still get
+    locally pruned, and the total is exactly n in partition order."""
+    from spark_rapids_tpu.execs.limit import TpuCollectLimitExec
+
+    chunks = [([1, 2, 3], [0, 0, 0]), ([4, 5, 6], [0, 0, 0]),
+              ([7, 8, 9], [0, 0, 0])]
+    plain = batches(*chunks)
+    src = _PartitionedSource(plain._batches, SCHEMA)
+    out = run(TpuCollectLimitExec(5, src))
+    assert out["k"] == [1, 2, 3, 4, 5]
+    # limit larger than the input passes everything through
+    src2 = _PartitionedSource(plain._batches, SCHEMA)
+    assert run(TpuCollectLimitExec(100, src2))["k"] == list(range(1, 10))
+
+
 def test_count_star_only_grand_aggregate():
     """Regression: COUNT(*) with no keys and no value inputs must not
     lose the batch capacity through a zero-column projection."""

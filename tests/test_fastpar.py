@@ -1,0 +1,231 @@
+"""Fast Parquet decoder differential tests: io/fastpar.py (+ the native
+snappy/RLE kernels) must reproduce pyarrow's read exactly for every
+supported file shape, and must REFUSE (return None) anything outside
+its envelope so the scan falls back (mirrors the reference's
+GpuParquetScan fallback discipline)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io import fastpar
+
+
+@pytest.fixture
+def session():
+    from spark_rapids_tpu.session import TpuSession
+
+    return TpuSession()
+
+
+def _write(tmp_path, table, name="t.parquet", **kw):
+    p = str(tmp_path / name)
+    pq.write_table(table, p, **kw)
+    return p
+
+
+def _fast_read(path, columns=None):
+    f = pq.ParquetFile(path)
+    cols = columns or [c for c in f.schema_arrow.names]
+    rgs = list(range(f.metadata.num_row_groups))
+    return fastpar.read_file(path, rgs, cols, None, None)
+
+
+def _assert_matches(path, columns=None):
+    tables = _fast_read(path, columns)
+    assert tables is not None, "fast path refused a supported file"
+    got = pa.concat_tables(tables)
+    want = pq.read_table(path, columns=columns)
+    assert got.num_rows == want.num_rows
+    for name in want.schema.names:
+        gw, ww = got[name].combine_chunks(), want[name].combine_chunks()
+        assert gw.type == ww.type, (name, gw.type, ww.type)
+        assert gw.equals(ww), name
+
+
+@pytest.mark.parametrize("compression", ["snappy", "none"])
+def test_low_cardinality_dict_columns(tmp_path, compression):
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "i32": rng.integers(0, 50, 10_000).astype(np.int32),
+        "i64": rng.integers(-100, 100, 10_000),
+        "f32": rng.integers(0, 20, 10_000).astype(np.float32),
+        "f64": rng.integers(0, 11, 10_000) / 100.0,
+    })
+    p = _write(tmp_path, t, compression=compression)
+    _assert_matches(p)
+
+
+def test_plain_fallback_pages_high_cardinality(tmp_path):
+    """Dict overflow mid-chunk -> later pages PLAIN; both decode."""
+    rng = np.random.default_rng(8)
+    t = pa.table({
+        "x": np.round(rng.uniform(0, 1e6, 300_000), 2),
+        "y": rng.integers(0, 1 << 40, 300_000),
+    })
+    p = _write(tmp_path, t, row_group_size=150_000)
+    _assert_matches(p)
+
+
+def test_plain_only_no_dictionary(tmp_path):
+    rng = np.random.default_rng(9)
+    t = pa.table({"x": rng.random(50_000)})
+    p = _write(tmp_path, t, use_dictionary=False)
+    _assert_matches(p)
+
+
+def test_multi_row_group_and_column_subset(tmp_path):
+    rng = np.random.default_rng(10)
+    t = pa.table({
+        "a": rng.integers(0, 5, 40_000),
+        "b": rng.random(40_000),
+        "c": rng.integers(0, 3, 40_000).astype(np.int32),
+    })
+    p = _write(tmp_path, t, row_group_size=9_000)
+    _assert_matches(p, columns=["b", "a"])
+
+
+def test_dict_encoded_strings(tmp_path):
+    rng = np.random.default_rng(11)
+    vals = np.array(["N", "O", "F"])[rng.integers(0, 3, 20_000)]
+    t = pa.table({"flag": vals, "v": rng.integers(0, 9, 20_000)})
+    p = _write(tmp_path, t)
+    _assert_matches(p)
+
+
+def test_date_and_timestamp_logical_types(tmp_path):
+    rng = np.random.default_rng(12)
+    days = rng.integers(8766, 10957, 5_000).astype(np.int32)
+    t = pa.table({
+        "d": pa.array(days, pa.int32()).cast(pa.date32()),
+        "ts": pa.array(rng.integers(0, 1 << 48, 5_000)).cast(
+            pa.timestamp("us")),
+    })
+    p = _write(tmp_path, t)
+    _assert_matches(p)
+
+
+def test_nulls_refused(tmp_path):
+    x = pa.array([1.0, None, 3.0] * 1000)
+    p = _write(tmp_path, pa.table({"x": x}))
+    assert _fast_read(p) is None
+
+
+def test_nested_refused(tmp_path):
+    x = pa.array([[1, 2], [3]] * 100)
+    p = _write(tmp_path, pa.table({"x": x}))
+    assert _fast_read(p) is None
+
+
+def test_unsupported_codec_refused(tmp_path):
+    t = pa.table({"x": np.arange(1000).astype(np.float64)})
+    p = _write(tmp_path, t, compression="zstd")
+    assert _fast_read(p) is None
+
+
+def test_filter_on_dictionary_lut(tmp_path):
+    """Single-column pushed conjuncts evaluate on the dictionary."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exprs.base import bind_references, lit
+    from spark_rapids_tpu.session import col
+
+    rng = np.random.default_rng(13)
+    disc = rng.integers(0, 11, 30_000) / 100.0
+    qty = rng.integers(1, 51, 30_000).astype(np.float64)
+    t = pa.table({"disc": disc, "qty": qty})
+    p = _write(tmp_path, t)
+
+    schema = T.Schema([T.Field("disc", T.DOUBLE), T.Field("qty", T.DOUBLE)])
+    conj = [bind_references(col("disc") >= lit(0.05), schema),
+            bind_references(col("disc") <= lit(0.07), schema),
+            bind_references(col("qty") < lit(24.0), schema)]
+    tables = fastpar.read_file(p, [0], ["disc", "qty"], conj, schema)
+    assert tables is not None
+    got = pa.concat_tables(tables)
+    mask = (disc >= 0.05) & (disc <= 0.07) & (qty < 24.0)
+    assert got.num_rows == int(mask.sum())
+    np.testing.assert_array_equal(
+        np.asarray(got["disc"]), disc[mask])
+    np.testing.assert_array_equal(np.asarray(got["qty"]), qty[mask])
+
+
+def test_scan_exec_uses_fast_path_end_to_end(tmp_path, session):
+    """Full session query over a fast-decodable file matches the CPU
+    engine, and flipping the conf off gives the same answer."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.io.scan import FAST_DECODE
+    from spark_rapids_tpu.session import col, sum_
+
+    rng = np.random.default_rng(14)
+    t = pa.table({
+        "k": rng.integers(0, 9, 50_000),
+        "price": np.round(rng.uniform(1, 1000, 50_000), 2),
+        "disc": rng.integers(0, 11, 50_000) / 100.0,
+    })
+    p = _write(tmp_path, t)
+
+    def q():
+        return (session.read_parquet(p)
+                .where((col("disc") >= lit(0.03)) & (col("disc") <= lit(0.08)))
+                .agg((sum_(col("price") * col("disc")), "rev")))
+
+    want = q().collect(engine="cpu").to_pydict()["rev"][0]
+    got_fast = q().collect(engine="tpu").to_pydict()["rev"][0]
+    try:
+        get_conf().set("spark.rapids.tpu.sql.scan.fastDecode", False)
+        got_slow = q().collect(engine="tpu").to_pydict()["rev"][0]
+    finally:
+        get_conf().set("spark.rapids.tpu.sql.scan.fastDecode", True)
+    assert abs(got_fast - want) <= 1e-6 * max(1.0, abs(want))
+    assert abs(got_fast - got_slow) <= 1e-9 * max(1.0, abs(got_slow))
+
+
+def test_fast_decode_conf_actually_disables(tmp_path, session,
+                                            monkeypatch):
+    """Regression: the conf is read on the SESSION thread (thread-local
+    conf does not exist on the prefetch producer thread), so setting it
+    False must prevent any fastpar call."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.session import col, sum_
+
+    t = pa.table({"x": np.arange(1000) / 7.0})
+    p = _write(tmp_path, t)
+    calls = []
+    real = fastpar.read_file
+    monkeypatch.setattr(fastpar, "read_file",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    df = session.read_parquet(p).agg((sum_(col("x")), "s"))
+    try:
+        get_conf().set("spark.rapids.tpu.sql.scan.fastDecode", False)
+        df.collect(engine="tpu")
+        assert not calls, "fast path ran with fastDecode=False"
+        get_conf().set("spark.rapids.tpu.sql.scan.fastDecode", True)
+        df.collect(engine="tpu")
+        assert calls, "fast path did not run with fastDecode=True"
+    finally:
+        get_conf().set("spark.rapids.tpu.sql.scan.fastDecode", True)
+
+
+def test_native_snappy_roundtrip():
+    """Native snappy decode vs pyarrow's reference codec."""
+    from spark_rapids_tpu import native
+
+    lib = native.load()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(15)
+    for data in (
+        rng.integers(0, 5, 100_000).astype(np.uint8).tobytes(),
+        rng.integers(0, 256, 100_000).astype(np.uint8).tobytes(),
+        b"a" * 70_000,
+        b"",
+        bytes(rng.integers(0, 3, 10).astype(np.uint8)) * 9000,
+    ):
+        comp = pa.Codec("snappy").compress(data)
+        out = fastpar._snappy_decompress(
+            comp.to_pybytes() if hasattr(comp, "to_pybytes") else comp,
+            len(data))
+        assert out is not None
+        assert out.tobytes() == data

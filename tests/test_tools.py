@@ -87,6 +87,10 @@ def test_api_validation_counts():
     assert len(eo) >= 100, f"only {len(eo)} expressions covered"
     xo, xm, xmap = v["execs"]
     assert len(xo) >= 20
+    # the exec map must resolve to LIVE classes — a renamed/deleted
+    # implementation (or a phantom name in the map) is drift, not
+    # coverage (ref: ApiValidation.scala's reflection diff)
+    assert v["exec_drift"] == [], f"exec map drift: {v['exec_drift']}"
     md = coverage_md()
     assert "API coverage" in md and "Execs:" in md
 
