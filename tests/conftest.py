@@ -13,6 +13,16 @@ from spark_rapids_tpu.platform import pin_cpu_platform
 
 pin_cpu_platform(8)
 
+# Persistent XLA compilation cache: the suite's wall clock is dominated
+# by per-test jit compiles of the same operator programs; caching them
+# on disk makes repeat runs (the habitual pre-commit `-m "not slow"`
+# tier) skip recompilation entirely.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/spark_rapids_tpu_jitcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 import pytest  # noqa: E402
 
 

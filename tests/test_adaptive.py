@@ -75,6 +75,7 @@ def _adaptive_nodes(exec_root):
     return out
 
 
+@pytest.mark.slow
 def test_adaptive_broadcast_switch(joined_tables):
     """Estimates say both sides are big (filters keep the child's upper
     bound); measured map output of the filtered dim side is tiny, so the
@@ -104,6 +105,7 @@ def test_adaptive_broadcast_switch(joined_tables):
         conf.set(BROADCAST_THRESHOLD.key, old_thr)
 
 
+@pytest.mark.slow
 def test_adaptive_partition_coalescing(joined_tables):
     """With broadcast impossible and a large advisory target, the 8
     shuffle partitions must execute as one coalesced reduce group."""
@@ -208,6 +210,7 @@ def test_adaptive_broadcast_releases_build(joined_tables):
         conf.set(BROADCAST_THRESHOLD.key, old_thr)
 
 
+@pytest.mark.slow
 def test_adaptive_left_outer_differential(joined_tables):
     """Strategy switches must not change join semantics: left_outer with
     unmatched rows, both adaptive strategies vs the CPU oracle."""

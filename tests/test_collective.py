@@ -34,6 +34,7 @@ def _multi_file(tmp_path, t: pa.Table, n_files: int):
     return paths
 
 
+@pytest.mark.slow
 def test_collective_groupby_through_session(collective_session, tmp_path):
     t = gen_table({"k": "smallint64", "v": "float64"}, 2000, seed=7)
     paths = _multi_file(tmp_path, t, 5)
@@ -50,6 +51,7 @@ def test_collective_groupby_through_session(collective_session, tmp_path):
     assert_tpu_cpu_equal(df, approx_float=True)
 
 
+@pytest.mark.slow
 def test_collective_string_keys(collective_session):
     t = gen_table({"s": "string", "v": "int64"}, 600, seed=13)
     df = (collective_session.create_dataframe(t)
@@ -66,6 +68,7 @@ def test_collective_fewer_partitions_than_devices(collective_session):
     assert dict(zip(out["k"], out["s"])) == {1: 4.0, 2: 2.0}
 
 
+@pytest.mark.slow
 def test_collective_composes_with_filter_project(collective_session,
                                                  tmp_path):
     from spark_rapids_tpu.exprs.base import lit

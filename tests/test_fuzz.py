@@ -19,6 +19,9 @@ from spark_rapids_tpu.config import get_conf
 from spark_rapids_tpu.exprs.base import lit
 from spark_rapids_tpu.session import TpuSession, avg, col, count, max_, min_, sum_
 
+pytestmark = pytest.mark.slow  # TPC/fuzz/stress tier
+
+
 N_CASES = 25  # per shape family; seeds 0..N-1 reproduce failures
 
 

@@ -16,6 +16,9 @@ from spark_rapids_tpu.session import TpuSession, col
 from tests.differential import assert_tpu_cpu_equal
 
 
+pytestmark = pytest.mark.slow  # TPC/fuzz/stress tier
+
+
 @pytest.fixture
 def session():
     return TpuSession()

@@ -22,6 +22,9 @@ from spark_rapids_tpu.session import TpuSession, col
 from tests.differential import assert_tables_equal, gen_table
 
 
+pytestmark = pytest.mark.slow  # TPC/fuzz/stress tier
+
+
 @pytest.fixture
 def ooc_conf():
     """Tiny thresholds to force the OOC path, with the range exchange

@@ -26,6 +26,7 @@ def _string_matrix(n, width, seed, max_len=None):
 
 
 @pytest.mark.parametrize("width", [4, 8, 12, 20])
+@pytest.mark.slow
 def test_pallas_string_hash_parity(width):
     n = _BLOCK_N * 2
     chars, lengths = _string_matrix(n, width, seed=width)

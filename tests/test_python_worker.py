@@ -14,6 +14,9 @@ from spark_rapids_tpu.session import TpuSession, col
 from spark_rapids_tpu.exprs.base import lit
 
 
+pytestmark = pytest.mark.slow  # TPC/fuzz/stress tier
+
+
 def double_v(tbl: pa.Table) -> pa.Table:
     import pyarrow.compute as pc
 

@@ -136,6 +136,7 @@ def _table(n=4000, seed=23):
                      "v": rng.random(n)})
 
 
+@pytest.mark.slow
 def test_map_task_retry_no_duplicates():
     """A mid-stream map-task failure retries and the aggregate over the
     exchange is EXACT — duplicated partial writes would inflate it."""
@@ -185,6 +186,7 @@ def _fallback_compare(got, want):
     assert k(got) == k(want)
 
 
+@pytest.mark.slow
 def test_failed_attempt_leaves_no_partial_blocks():
     """Exhausted retries must close every buffered handle (no leaked
     store entries, no partial shuffle blocks)."""

@@ -99,6 +99,7 @@ def test_rows_to_batch_round_trip():
     assert list(batch_to_rows(b2)) == [(5, "x")]
 
 
+@pytest.mark.slow
 def test_plugin_lifecycle():
     from spark_rapids_tpu.plugin import TpuPlugin, frontend
 

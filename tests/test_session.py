@@ -54,6 +54,7 @@ def test_avg_differential_approx(spark):
     assert_tpu_cpu_equal(q, approx_float=True)
 
 
+@pytest.mark.slow
 def test_join_differential(spark):
     lt = gen_table({"k": "smallint64", "lv": "int64"}, 300, seed=4)
     rt = gen_table({"k": "smallint64", "rv": "string"}, 60, seed=5)

@@ -103,6 +103,7 @@ def test_single_partitioning():
     assert n == 30
 
 
+@pytest.mark.slow
 def test_multipartition_groupby_via_shuffle(small_batches):
     """Forces scan -> partial agg -> hash exchange -> final agg."""
     spark = TpuSession()
@@ -127,6 +128,7 @@ def test_multipartition_grand_aggregate(small_batches):
     assert_tpu_cpu_equal(q)
 
 
+@pytest.mark.slow
 def test_multipartition_full_query(small_batches):
     """scan+filter+join+groupby+sort across many partitions."""
     spark = TpuSession()
@@ -144,6 +146,7 @@ def test_multipartition_full_query(small_batches):
     assert_tpu_cpu_equal(q, ignore_order=False)
 
 
+@pytest.mark.slow
 def test_multipartition_parquet(small_batches, tmp_path):
     import pyarrow.parquet as pq
 

@@ -133,6 +133,7 @@ def test_concat_ws(session):
     assert_tpu_cpu_equal(df)
 
 
+@pytest.mark.slow
 def test_batch3_fuzz(session):
     t = gen_table({"s": "string"}, 300, seed=47)
     df = session.create_dataframe(t).select(

@@ -25,6 +25,9 @@ from spark_rapids_tpu.session import (
     sum_,
 )
 
+pytestmark = pytest.mark.slow  # TPC/fuzz/stress tier
+
+
 SF = 0.002  # ~12k lineitem rows: fast but multi-batch when batch conf drops
 N_LINE = int(6_000_000 * SF)
 N_ORDERS = int(1_500_000 * SF)

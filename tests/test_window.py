@@ -99,6 +99,7 @@ def test_rows_frames_sum_count_avg(session):
     assert_tpu_cpu_equal(out, approx_float=True)
 
 
+@pytest.mark.slow
 def test_min_max_running_and_whole_partition(session):
     df = session.create_dataframe(_sales())
     run = Window.partition_by("k").order_by("ts")
@@ -203,6 +204,7 @@ def test_window_then_filter_then_agg(session):
     assert_tpu_cpu_equal(out)
 
 
+@pytest.mark.slow
 def test_bounded_range_frames(session):
     """Value-based RANGE frames (the bisection kernel) against the
     oracle: duplicate order values, preceding/following combinations."""
@@ -225,6 +227,7 @@ def test_bounded_range_frames(session):
         assert_tpu_cpu_equal(out)
 
 
+@pytest.mark.slow
 def test_bounded_range_frames_desc_and_nulls(session):
     """Descending order keys measure range offsets the other way; null
     order keys frame their own peer block."""
@@ -252,6 +255,7 @@ def test_bounded_range_frames_desc_and_nulls(session):
     assert_tpu_cpu_equal(out)
 
 
+@pytest.mark.slow
 def test_bounded_range_frames_nan_keys(session):
     """NaN order keys are greatest-and-equal in Spark's total order:
     their bounded-range frame is exactly the NaN peer block, and they
@@ -310,6 +314,7 @@ def test_md5_wide_strings(session):
                    for v in vals]
 
 
+@pytest.mark.slow
 def test_bounded_range_minmax_one_side(session):
     """min/max over range frames with one side unbounded (the scan
     kernels); bounded-both-sides still falls back."""
