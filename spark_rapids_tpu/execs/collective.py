@@ -1,18 +1,28 @@
 """Collective (tier-2) exchange-bearing operators.
 
-When the collective shuffle transport is active, the planner lowers a
-grouped aggregate's partial -> exchange -> final pipeline into ONE fused
-SPMD program per query stage (ref: the role GpuShuffleExchangeExecBase +
-RapidsShuffleTransport play around GpuHashAggregateExec, re-designed for
-TPU: the map-side update aggregation, the murmur3-routed `all_to_all`
-over the mesh axis, and the reduce-side merge+finalize are a single
-shard_map/jit program — no host hop between map and reduce, collectives
-ride ICI scheduled by XLA; SURVEY.md §5.8)."""
+When the collective shuffle transport is active, the planner lowers
+EVERY exchange-bearing pipeline — grouped aggregation, shuffled hash
+join, distributed ORDER BY — into fused SPMD programs over the active
+mesh (ref: the role GpuShuffleExchangeExecBase + RapidsShuffleTransport
+play under GpuHashAggregateExec / GpuShuffledHashJoinBase /
+GpuSortExec, re-designed for TPU: map-side work, the murmur3- or
+range-routed `all_to_all` over the mesh axis, and reduce-side work are
+single shard_map/jit programs — no host hop between map and reduce,
+collectives ride ICI scheduled by XLA; SURVEY.md §5.8).
+
+Inputs stream through BOUNDED per-shard rounds (conf
+spark.rapids.tpu.shuffle.collective.roundRows): each round stacks at
+most that many rows per shard, runs the fused program, and parks the
+per-shard results on device — so a skewed or large child never forces
+one stop-the-world host gather (the streaming discipline of the
+reference's shuffle writer)."""
 
 from __future__ import annotations
 
-from typing import Iterator, Sequence
+import dataclasses as _dc
+from typing import Iterator, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -20,13 +30,23 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
 from spark_rapids_tpu.columnar.column import (
     Column,
     StringColumn,
+    pad_capacity,
     pad_width,
 )
+from spark_rapids_tpu.config import register, get_conf
 from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
 from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
 from spark_rapids_tpu.exprs.aggregates import NamedAgg
-from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
 from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+
+COLLECTIVE_ROUND_ROWS = register(
+    "spark.rapids.tpu.shuffle.collective.roundRows", 1 << 20,
+    "Per-shard row budget of one collective exchange round: child "
+    "batches stream through the fused all_to_all program in rounds of "
+    "at most this many rows per shard instead of one unbounded gather "
+    "(the batch-at-a-time discipline of the reference's shuffle "
+    "writer, GpuShuffleExchangeExec.scala:167-270).")
 
 
 def _repad(batch: ColumnarBatch, cap: int,
@@ -60,13 +80,134 @@ def _repad(batch: ColumnarBatch, cap: int,
     return ColumnarBatch(cols, batch.num_rows, batch.schema)
 
 
-class TpuCollectiveHashAggregateExec(TpuExec):
-    """Grouped aggregation as one SPMD program over the active mesh.
+def _unify_shards(shards: list[ColumnarBatch]) -> list[ColumnarBatch]:
+    """Pad shard batches to one capacity/width profile for stacking."""
+    cap = max(s.capacity for s in shards)
+    widths: dict[int, int] = {}
+    for s in shards:
+        for ci, c in enumerate(s.columns):
+            if isinstance(c, StringColumn):
+                widths[ci] = max(widths.get(ci, 1), c.width)
+    for ci in widths:
+        widths[ci] = pad_width(widths[ci])
+    return [_repad(s, cap, widths) for s in shards]
 
-    Host side only routes input: child partitions are drained round-robin
-    into one batch per shard; everything after the stack — update
-    aggregation, hash exchange, merge, finalization — is device code in
-    a single compiled step shared across queries with equal structure."""
+
+def _fold_groups(groups: list[list[ColumnarBatch]],
+                 schema: T.Schema) -> list[ColumnarBatch]:
+    """Per-shard batch lists -> one batch per shard (empty batches for
+    shards that received nothing)."""
+    out = []
+    for group in groups:
+        if not group:
+            out.append(ColumnarBatch.empty(schema))
+        elif len(group) == 1:
+            out.append(group[0])
+        else:
+            out.append(concat_batches(group))
+    return out
+
+
+class _CollectiveBase(TpuExec):
+    """Shared round-streaming driver for collective execs.
+
+    Subclasses produce their output as ONE batch per mesh shard
+    (`_materialize`); per-partition consumers (a sort, limit, or join
+    stacked above) read shard p through `execute_partition(p)`."""
+
+    mesh = None  # set by subclass __init__
+
+    @property
+    def num_partitions(self) -> int:
+        return int(self.mesh.shape[DATA_AXIS])
+
+    def _shard_rounds(self, child: TpuExec
+                      ) -> Iterator[list[ColumnarBatch]]:
+        """Drain child partitions into per-shard batch groups, yielding
+        a round whenever any shard reaches the row budget.  Always
+        yields at least one round (of empties) so downstream programs
+        emit schema-correct output for empty inputs."""
+        n = self.num_partitions
+        budget = get_conf().get(COLLECTIVE_ROUND_ROWS)
+        per_shard: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+        rows = [0] * n
+        yielded = False
+        for p in range(child.num_partitions):
+            for b in child.execute_partition(p):
+                r = b.concrete_num_rows()
+                tgt = rows.index(min(rows))  # least-loaded shard
+                per_shard[tgt].append(_dc.replace(b, num_rows=r))
+                rows[tgt] += r
+                if max(rows) >= budget:
+                    if "collectiveRounds" in self.metrics:
+                        self.metrics["collectiveRounds"].add(1)
+                    yield _fold_groups(per_shard, child.schema)
+                    yielded = True
+                    per_shard = [[] for _ in range(n)]
+                    rows = [0] * n
+        if any(rows) or not yielded:
+            if "collectiveRounds" in self.metrics:
+                self.metrics["collectiveRounds"].add(1)
+            yield _fold_groups(per_shard, child.schema)
+
+    def _exchange_rounds(self, child: TpuExec, step, *extras,
+                         out_schema: Optional[T.Schema] = None
+                         ) -> list[ColumnarBatch]:
+        """Stream the child through `step` round by round, parking each
+        round's per-shard outputs shrunk on device; returns one folded
+        batch per shard.  `out_schema` is the STEP's output schema
+        (defaults to the child's — right for pure routing steps)."""
+        from spark_rapids_tpu.parallel.exchange import unstack_batch
+
+        n = self.num_partitions
+        parts: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+        for shards in self._shard_rounds(child):
+            out = step(self._stack(shards), *extras)
+            for i, b in enumerate(unstack_batch(out)):
+                parts[i].append(self._shrunk(b))
+        return _fold_groups(parts, out_schema or child.schema)
+
+    # -- per-partition serving ----------------------------------------- #
+
+    def _materialize(self) -> list[list[ColumnarBatch]]:
+        """Output batches per mesh shard (subclass responsibility)."""
+        raise NotImplementedError
+
+    def _shard_outputs(self) -> list[list[ColumnarBatch]]:
+        out = getattr(self, "_shards_out", None)
+        if out is None:
+            out = self._shards_out = self._materialize()
+        return out
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for b in self._shard_outputs()[p]:
+            yield self._count_output(b)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+    def _stack(self, shards: list[ColumnarBatch]):
+        from spark_rapids_tpu.parallel.exchange import stack_batches
+
+        return stack_batches(_unify_shards(shards))
+
+    @staticmethod
+    def _shrunk(batch: ColumnarBatch) -> ColumnarBatch:
+        """Shrink a per-shard program output (capacity n_dest * cap) to
+        its live prefix so parked rounds don't hold inflated buffers."""
+        rows = batch.concrete_num_rows()
+        return batch.shrink_to_capacity(pad_capacity(rows))
+
+
+class TpuCollectiveHashAggregateExec(_CollectiveBase):
+    """Grouped aggregation as fused SPMD programs over the active mesh.
+
+    Per round: map-side update aggregation, hash all_to_all on the
+    group keys, and reduce-side merge run as ONE program; per-shard
+    round results park on device, and a final per-shard local program
+    (merge + finalize, no collectives) folds the rounds — same keys
+    always land on the same shard, so the cross-round merge is local."""
 
     def __init__(self, groups: Sequence[Expression],
                  aggs: Sequence[NamedAgg], child: TpuExec, mesh):
@@ -79,14 +220,11 @@ class TpuCollectiveHashAggregateExec(TpuExec):
             list(self._agg.partial_schema.fields[: self._agg.n_keys])
             + [na.output_field() for na in self._agg.aggs])
         self._step = None
+        self._final_step = None
 
     @property
     def schema(self) -> T.Schema:
         return self._schema
-
-    @property
-    def num_partitions(self) -> int:
-        return int(self.mesh.shape[DATA_AXIS])
 
     def node_desc(self) -> str:
         a = self._agg
@@ -96,71 +234,317 @@ class TpuCollectiveHashAggregateExec(TpuExec):
                 f"{self.num_partitions}]")
 
     def additional_metrics(self):
-        return [("collectiveRows", "MODERATE")]
+        return [("collectiveRows", "MODERATE"),
+                ("collectiveRounds", "MODERATE")]
 
     # -- fused phases ----------------------------------------------------- #
 
     def _pre(self, batch: ColumnarBatch) -> ColumnarBatch:
         return self._agg._update_batch(batch)
 
-    def _post(self, batch: ColumnarBatch) -> ColumnarBatch:
-        from spark_rapids_tpu.exprs.base import EvalContext
+    def _merge(self, batch: ColumnarBatch) -> ColumnarBatch:
+        return self._agg._merge_batch(batch)
 
+    def _finalize(self, batch: ColumnarBatch) -> ColumnarBatch:
         merged = self._agg._merge_batch(batch)
-        # finalize with THIS exec's output schema (the partial-mode
-        # helper's _schema is the partial layout)
         ctx = EvalContext.for_batch(merged)
         cols = [e.eval(ctx) for e in self._agg.final_exprs]
         return ColumnarBatch(cols, merged.num_rows, self._schema)
 
     # -- driver ----------------------------------------------------------- #
 
-    def _collect_shards(self) -> list[ColumnarBatch]:
-        """Drain child partitions round-robin into one batch per shard."""
-        import dataclasses as _dc
-
-        n = self.num_partitions
-        child = self.children[0]
-        per_shard: list[list[ColumnarBatch]] = [[] for _ in range(n)]
-        for p in range(child.num_partitions):
-            for b in child.execute_partition(p):
-                rows = b.concrete_num_rows()
-                per_shard[p % n].append(
-                    _dc.replace(b, num_rows=rows))
-        shards = []
-        for group in per_shard:
-            if not group:
-                shards.append(ColumnarBatch.empty(child.schema))
-            elif len(group) == 1:
-                shards.append(group[0])
-            else:
-                shards.append(concat_batches(group))
-        # unify shapes for stacking
-        cap = max(s.capacity for s in shards)
-        widths: dict[int, int] = {}
-        for s in shards:
-            for ci, c in enumerate(s.columns):
-                if isinstance(c, StringColumn):
-                    widths[ci] = max(widths.get(ci, 1), c.width)
-        for ci in widths:
-            widths[ci] = pad_width(widths[ci])
-        return [_repad(s, cap, widths) for s in shards]
-
-    def execute(self) -> Iterator[ColumnarBatch]:
+    def _materialize(self) -> list[list[ColumnarBatch]]:
         from spark_rapids_tpu.parallel.exchange import (
             make_hash_exchange_step,
-            stack_batches,
+            make_local_step,
             unstack_batch,
         )
 
-        shards = self._collect_shards()
         if self._step is None:
             self._step = make_hash_exchange_step(
                 self.mesh, list(range(self._agg.n_keys)),
-                pre=self._pre, post=self._post)
+                pre=self._pre, post=self._merge)
+            self._final_step = make_local_step(self.mesh,
+                                               self._finalize)
         with MetricTimer(self.metrics[TOTAL_TIME]) as t:
-            stacked = stack_batches(shards)
-            out = t.observe(self._step(stacked))
-        for b in unstack_batch(out):
+            merged = self._exchange_rounds(
+                self.children[0], self._step,
+                out_schema=self._agg.partial_schema)
+            final = t.observe(self._final_step(self._stack(merged)))
+        out = []
+        for b in unstack_batch(final):
             self.metrics["collectiveRows"].add(b.concrete_num_rows())
-            yield self._count_output(b)
+            out.append([b])
+        return out
+
+
+class TpuCollectiveHashJoinExec(_CollectiveBase):
+    """Shuffled equi-join as fused SPMD programs (the collective analog
+    of TpuShuffledHashJoinExec; ref: GpuShuffledHashJoinBase over
+    GpuShuffleExchangeExec).  The build (right) side exchanges once by
+    right-key hash; each stream round then routes by left-key hash and
+    joins locally in the SAME program — co-partitioning makes every
+    match shard-local, exactly the property the reference gets from
+    co-partitioned shuffle outputs."""
+
+    SUPPORTED_TYPES = ("inner", "left_outer", "left_semi", "left_anti")
+
+    def __init__(self, left_keys, right_keys, join_type: str,
+                 left: TpuExec, right: TpuExec, mesh):
+        from spark_rapids_tpu.execs.join import _nullable_fields
+
+        assert join_type in self.SUPPORTED_TYPES, join_type
+        super().__init__(left, right)
+        self.mesh = mesh
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        if join_type in ("left_semi", "left_anti"):
+            self._schema = left.schema
+        else:
+            rf = _nullable_fields(right.schema) \
+                if join_type == "left_outer" else list(right.schema.fields)
+            self._schema = T.Schema(list(left.schema.fields) + rf)
+        self._build_step = None
+        self._join_steps: dict[int, object] = {}
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(f"{lk.name}={rk.name}" for lk, rk in
+                       zip(self.left_keys, self.right_keys))
+        return (f"TpuCollectiveHashJoinExec {self.join_type} [{ks}] "
+                f"[all_to_all x{self.num_partitions}]")
+
+    def additional_metrics(self):
+        return [("buildRows", "MODERATE"),
+                ("collectiveRounds", "MODERATE")]
+
+    # -- fused bodies ------------------------------------------------------ #
+
+    def _route_build(self, batch: ColumnarBatch) -> jax.Array:
+        from spark_rapids_tpu.exprs.hashing import partition_ids
+
+        ctx = EvalContext.for_batch(batch)
+        cols = [k.eval(ctx) for k in self.right_keys]
+        return partition_ids(cols, batch.capacity, self.num_partitions)
+
+    def _join_shard(self, stream: ColumnarBatch, build: ColumnarBatch,
+                    out_cap: int):
+        from spark_rapids_tpu.exprs.hashing import partition_ids
+        from spark_rapids_tpu.ops.join import (
+            expand_pairs,
+            gather_joined,
+            join_state,
+        )
+        from spark_rapids_tpu.parallel.exchange import route_shard
+
+        n = self.num_partitions
+        sctx = EvalContext.for_batch(stream)
+        pid = partition_ids([k.eval(sctx) for k in self.left_keys],
+                            stream.capacity, n)
+        routed = route_shard(stream, pid, n, DATA_AXIS)
+
+        rctx = EvalContext.for_batch(routed)
+        bctx = EvalContext.for_batch(build)
+        skc = [k.eval(rctx) for k in self.left_keys]
+        bkc = [k.eval(bctx) for k in self.right_keys]
+        jt = self.join_type
+        st = join_state(build, routed, bkc, skc,
+                        "inner" if jt in ("left_semi", "left_anti")
+                        else jt)
+        if jt in ("left_semi", "left_anti"):
+            keep = st.matched_s if jt == "left_semi" \
+                else (st.live_s & ~st.matched_s)
+            out = routed.compact(keep)
+            return out, jnp.sum(keep).astype(jnp.int32)
+        total = jnp.sum(st.cnt_s).astype(jnp.int32)
+        s_idx, b_idx, pair_live, matched = expand_pairs(st, out_cap)
+        out = gather_joined(build, routed, s_idx, b_idx, pair_live,
+                            matched, jnp.minimum(total, out_cap),
+                            self._schema, stream_first=True)
+        return out, total
+
+    def _join_step(self, out_cap: int):
+        from spark_rapids_tpu.parallel.exchange import make_join_step
+
+        step = self._join_steps.get(out_cap)
+        if step is None:
+            step = self._join_steps[out_cap] = make_join_step(
+                self.mesh,
+                lambda s, b: self._join_shard(s, b, out_cap))
+        return step
+
+    # -- driver ------------------------------------------------------------ #
+
+    def _collect_build(self) -> ColumnarBatch:
+        """Exchange the build side by right-key hash, in rounds;
+        returns the stacked per-shard build batch."""
+        from spark_rapids_tpu.parallel.exchange import make_route_step
+
+        if self._build_step is None:
+            self._build_step = make_route_step(
+                self.mesh, lambda b: self._route_build(b))
+        merged = self._exchange_rounds(self.children[1],
+                                       self._build_step)
+        for b in merged:
+            self.metrics["buildRows"].add(b.concrete_num_rows())
+        return self._stack(merged)
+
+    def _materialize(self) -> list[list[ColumnarBatch]]:
+        from spark_rapids_tpu.parallel.exchange import unstack_batch
+
+        chunks: list[list[ColumnarBatch]] = [
+            [] for _ in range(self.num_partitions)]
+        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            build_stacked = self._collect_build()
+            build_rows = int(jnp.max(build_stacked.num_rows))
+            for shards in self._shard_rounds(self.children[0]):
+                n = self.num_partitions
+                cap_round = max(s.capacity for s in shards)
+                stacked = self._stack(shards)
+                # initial output guess: a shard can receive up to the
+                # whole round (n * cap_round); matches usually stay
+                # near stream row counts
+                cap_guess = 64 if self.join_type in (
+                    "left_semi", "left_anti") else pad_capacity(
+                        max(cap_round * n, build_rows, 64))
+                while True:
+                    step = self._join_step(cap_guess)
+                    out, totals = step(stacked, build_stacked)
+                    if self.join_type in ("left_semi", "left_anti"):
+                        break
+                    worst = int(jnp.max(totals))
+                    if worst <= cap_guess:
+                        break
+                    # JoinGatherer-style re-bucket: recompile at the
+                    # capacity the data actually needs
+                    cap_guess = pad_capacity(worst)
+                out = t.observe(out)
+                for i, b in enumerate(unstack_batch(out)):
+                    if b.concrete_num_rows():
+                        chunks[i].append(self._shrunk(b))
+        return chunks
+
+
+class TpuCollectiveSortExec(_CollectiveBase):
+    """Distributed ORDER BY as fused SPMD programs (the collective
+    analog of range-exchange + per-partition sort; ref:
+    GpuRangePartitioner sketch/determineBounds + GpuSortExec).
+
+    Pass 1 streams the child into parked device rounds while sampling
+    sort keys; bounds come from the pooled sample; pass 2 routes every
+    round through a range-bisect all_to_all (bounds ride as a
+    REPLICATED program argument, so one compiled program serves every
+    bounds value); each shard then sorts locally — shard index order
+    IS the total order."""
+
+    SAMPLE_PER_SHARD = 256
+
+    def __init__(self, keys, child: TpuExec, mesh):
+        super().__init__(child)
+        from spark_rapids_tpu.ops.partition import RangePartitioning
+
+        self.mesh = mesh
+        self.keys = list(keys)
+        n = int(mesh.shape[DATA_AXIS])
+        self._part = RangePartitioning(self.keys, n).bind(child.schema)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        ks = ", ".join(
+            f"{k.expr.name}{' DESC' if k.descending else ''}"
+            for k in self.keys)
+        return (f"TpuCollectiveSortExec [{ks}] "
+                f"[range all_to_all x{self.num_partitions}]")
+
+    def additional_metrics(self):
+        return [("collectiveRounds", "MODERATE")]
+
+    @staticmethod
+    def _sample_k(rows: int) -> int:
+        """Per-batch sample count ~ proportional to rows (one per 64,
+        power-of-two bucketed for compile-cache stability, capped) —
+        equal per-batch counts would let a 10-row tail batch weigh as
+        much as a million-row one when choosing bounds (the weighting
+        concern behind GpuRangePartitioner's size-scaled sketch)."""
+        k = max(16, min(256, rows // 64))
+        return 1 << (k - 1).bit_length()
+
+    def _materialize(self) -> list[list[ColumnarBatch]]:
+        import numpy as np
+
+        from spark_rapids_tpu.execs.jit_cache import cached_jit, exprs_key
+        from spark_rapids_tpu.ops.range_partition import choose_bounds
+        from spark_rapids_tpu.parallel.exchange import (
+            make_local_step,
+            make_route_step,
+            unstack_batch,
+        )
+
+        part = self._part
+        n = self.num_partitions
+        pkey = (exprs_key([k.expr for k in part.keys]),
+                tuple((k.descending, k.nulls_last) for k in part.keys))
+        rng = np.random.default_rng(0x52414E47)
+
+        # pass 1: park rounds + sample keys per shard (sample size
+        # scales with batch rows — see _sample_k)
+        rounds: list[list[ColumnarBatch]] = []
+        samples: list[ColumnarBatch] = []
+        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            for shards in self._shard_rounds(self.children[0]):
+                rounds.append(shards)
+                for s in shards:
+                    rows = s.concrete_num_rows()
+                    if not rows:
+                        continue
+                    n_sample = self._sample_k(rows)
+                    jit_sample = cached_jit(
+                        ("csortsample", pkey, s.capacity, n_sample,
+                         repr(s.schema)),
+                        lambda: lambda b, p: part.key_batch(
+                            b).gather(p, p.shape[0]))
+                    pos = jnp.asarray(
+                        rng.integers(0, rows, n_sample).astype(np.int32))
+                    samples.append(jit_sample(s, pos))
+            if not samples:
+                return [[ColumnarBatch.empty(self.schema)]
+                        for _ in range(n)]
+            n_live = sum(s.num_rows for s in samples)
+            jit_bounds = cached_jit(
+                ("csortbounds", pkey, n_live, n,
+                 tuple(s.capacity for s in samples)),
+                lambda: lambda ss: choose_bounds(
+                    concat_batches(ss), part.key_orders(), n, n_live))
+            bounds = jit_bounds(samples)
+
+            # pass 2: range-routed all_to_all per round, then local sort
+            route = make_route_step(
+                self.mesh,
+                lambda b, bd: part.partition_ids_with_bounds(b, bd),
+                n_extra=1)
+            parts: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+            for shards in rounds:
+                out = route(self._stack(shards), bounds)
+                for i, b in enumerate(unstack_batch(out)):
+                    parts[i].append(self._shrunk(b))
+            merged = _fold_groups(parts, self.schema)
+
+            def local_sort_fn(b: ColumnarBatch) -> ColumnarBatch:
+                # sort by the evaluated key batch (works for arbitrary
+                # key expressions, not just column refs)
+                from spark_rapids_tpu.ops.sort import sort_permutation
+
+                perm = sort_permutation(part.key_batch(b),
+                                        part.key_orders())
+                return b.gather(perm, b.num_rows)
+
+            local_sort = make_local_step(self.mesh, local_sort_fn)
+            final = t.observe(local_sort(self._stack(merged)))
+            return [[b] for b in unstack_batch(final)]

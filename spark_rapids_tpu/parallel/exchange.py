@@ -100,15 +100,14 @@ def _unsqueeze0(batch: ColumnarBatch) -> ColumnarBatch:
     return ColumnarBatch(cols, batch.num_rows[None], batch.schema)
 
 
-def exchange_shard(batch: ColumnarBatch, key_ordinals: Sequence[int],
-                   n_dest: int, axis_name: str) -> ColumnarBatch:
-    """Per-shard body: partition rows of this shard's batch by key hash and
-    all_to_all them; returns the rows this shard owns afterwards
-    (capacity = n_dest * input capacity, prefix-compact)."""
+def route_shard(batch: ColumnarBatch, pid: jax.Array,
+                n_dest: int, axis_name: str) -> ColumnarBatch:
+    """Per-shard body: send each live row of this shard's batch to the
+    destination in `pid` via all_to_all; returns the rows this shard
+    owns afterwards (capacity = n_dest * input capacity,
+    prefix-compact).  `pid` entries for dead rows are ignored."""
     cap = batch.capacity
     live = batch.row_mask()
-    key_cols = [batch.columns[o] for o in key_ordinals]
-    pid = partition_ids(key_cols, cap, n_dest)
     pid = jnp.where(live, pid, jnp.int32(n_dest))  # dead rows -> dropped
 
     order = jnp.argsort(pid, stable=True)
@@ -156,6 +155,14 @@ def exchange_shard(batch: ColumnarBatch, key_ordinals: Sequence[int],
     return ColumnarBatch(out_cols, n_out, batch.schema)
 
 
+def exchange_shard(batch: ColumnarBatch, key_ordinals: Sequence[int],
+                   n_dest: int, axis_name: str) -> ColumnarBatch:
+    """route_shard with Spark-parity murmur3-pmod hash routing."""
+    key_cols = [batch.columns[o] for o in key_ordinals]
+    pid = partition_ids(key_cols, batch.capacity, n_dest)
+    return route_shard(batch, pid, n_dest, axis_name)
+
+
 def make_hash_exchange_step(
     mesh: Mesh,
     key_ordinals: Sequence[int],
@@ -181,4 +188,69 @@ def make_hash_exchange_step(
 
     mapped = shard_map(shard_fn, mesh=mesh, in_specs=P(axis_name),
                        out_specs=P(axis_name), check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_route_step(
+    mesh: Mesh,
+    pid_fn: Callable[..., jax.Array],
+    axis_name: str = DATA_AXIS,
+    n_extra: int = 0,
+) -> Callable:
+    """Generalized exchange: `pid_fn(batch, *extras) -> int32[capacity]`
+    computes each row's destination shard (hash, range-bounds bisect,
+    round-robin — any traceable rule).  `extras` are REPLICATED batch
+    args (e.g. sampled range bounds) passed through to pid_fn, so one
+    compiled program serves every bounds value."""
+    n_dest = mesh.shape[axis_name]
+
+    def shard_fn(stacked: ColumnarBatch, *extras):
+        b = _squeeze0(stacked)
+        pid = pid_fn(b, *extras)
+        b = route_shard(b, pid, n_dest, axis_name)
+        return _unsqueeze0(b)
+
+    in_specs = (P(axis_name),) + (P(),) * n_extra
+    mapped = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(axis_name), check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_local_step(
+    mesh: Mesh,
+    fn: Callable[[ColumnarBatch], ColumnarBatch],
+    axis_name: str = DATA_AXIS,
+) -> Callable:
+    """Per-shard local transform (no collectives) over stacked shard
+    batches — the reduce-side tail of a multi-round exchange (final
+    merge, local sort) runs through this."""
+
+    def shard_fn(stacked: ColumnarBatch) -> ColumnarBatch:
+        return _unsqueeze0(fn(_squeeze0(stacked)))
+
+    mapped = shard_map(shard_fn, mesh=mesh, in_specs=P(axis_name),
+                       out_specs=P(axis_name), check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_join_step(
+    mesh: Mesh,
+    shard_fn: Callable[[ColumnarBatch, ColumnarBatch],
+                       tuple[ColumnarBatch, jax.Array]],
+    axis_name: str = DATA_AXIS,
+) -> Callable:
+    """Two-input SPMD step for the collective shuffled join: shard_fn
+    gets (stream_shard, build_shard) per device and returns the joined
+    shard plus a scalar diagnostic (the true output row count, for the
+    host-side capacity-overflow check)."""
+
+    def wrapped(stream_stacked, build_stacked):
+        out, total = shard_fn(_squeeze0(stream_stacked),
+                              _squeeze0(build_stacked))
+        return _unsqueeze0(out), total[None]
+
+    mapped = shard_map(wrapped, mesh=mesh,
+                       in_specs=(P(axis_name), P(axis_name)),
+                       out_specs=(P(axis_name), P(axis_name)),
+                       check_vma=False)
     return jax.jit(mapped)

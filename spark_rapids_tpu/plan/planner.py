@@ -575,6 +575,25 @@ def _plan_join(p: L.Join, kids: list[TpuExec]) -> TpuExec:
     key_dtypes_match = p.left_keys and all(
         lk.dtype == rk.dtype
         for lk, rk in zip(p.left_keys, p.right_keys))
+
+    # tier-2 lowering: with the collective transport active, the whole
+    # exchange+exchange+join pipeline becomes fused SPMD programs over
+    # the mesh — the route-everything-through-shuffle architecture of
+    # GpuShuffleExchangeExec applied to joins (SURVEY.md §5.8)
+    if key_dtypes_match and p.condition is None:
+        from spark_rapids_tpu.execs.collective import (
+            TpuCollectiveHashJoinExec,
+        )
+        from spark_rapids_tpu.shuffle.transport import get_transport
+
+        transport = get_transport()
+        if (transport.kind == "collective"
+                and jt in TpuCollectiveHashJoinExec.SUPPORTED_TYPES
+                and transport.supports_schema(kids[0].schema)
+                and transport.supports_schema(kids[1].schema)):
+            return TpuCollectiveHashJoinExec(
+                p.left_keys, p.right_keys, jt, kids[0], kids[1],
+                transport.mesh)
     if key_dtypes_match and (kids[0].num_partitions > 1
                              or kids[1].num_partitions > 1):
         # EnsureRequirements: a child already hash-partitioned on these
@@ -658,6 +677,19 @@ def _plan_sort(p: L.Sort, child_exec: TpuExec) -> TpuExec:
     from spark_rapids_tpu.ops.partition import RangePartitioning
 
     conf = get_conf()
+    # tier-2: distributed ORDER BY as a fused range-routed all_to_all
+    # plus per-shard local sorts (SURVEY.md §5.8)
+    from spark_rapids_tpu.shuffle.transport import get_transport
+
+    transport = get_transport()
+    if transport.kind == "collective" \
+            and transport.supports_schema(child_exec.schema):
+        from spark_rapids_tpu.execs.collective import (
+            TpuCollectiveSortExec,
+        )
+
+        return TpuCollectiveSortExec(p.keys, child_exec,
+                                     transport.mesh)
     if child_exec.num_partitions > 1 and conf.get(RANGE_SORT):
         n = conf.get(SHUFFLE_PARTITIONS)
         ex = TpuShuffleExchangeExec(

@@ -98,3 +98,152 @@ def test_local_transport_without_mesh_falls_back():
         assert get_transport().kind == "local"
     finally:
         conf.set(SHUFFLE_TRANSPORT.key, old)
+
+
+# ------------------------------------------------------------------ #
+# Collective JOIN / SORT lowering (round 4: every exchange-bearing
+# operator rides the fused all_to_all tier, not just aggregates)
+# ------------------------------------------------------------------ #
+
+
+@pytest.fixture
+def no_broadcast():
+    """Force the shuffled-join path (small test tables would otherwise
+    take the broadcast strategy before the collective lowering)."""
+    from spark_rapids_tpu.config import get_conf
+
+    conf = get_conf()
+    key = "spark.rapids.tpu.sql.autoBroadcastJoinThresholdBytes"
+    old = conf.get(key)
+    conf.set(key, -1)
+    yield
+    conf.set(key, old)
+
+
+def _join_tables(seed, n_left=900, n_right=300):
+    lt = gen_table({"k": "smallint64", "lv": "float64"}, n_left, seed=seed)
+    rt = gen_table({"k": "smallint64", "rv": "int64"}, n_right,
+                   seed=seed + 1)
+    return lt, rt
+
+
+@pytest.mark.parametrize("how", ["inner", "left_outer", "left_semi",
+                                 "left_anti"])
+def test_collective_join_differential(collective_session, no_broadcast, how):
+    lt, rt = _join_tables(31)
+    ldf = collective_session.create_dataframe(lt)
+    rdf = collective_session.create_dataframe(rt)
+    df = ldf.join(rdf, on="k", how=how)
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    exec_, _ = plan_query(df._plan, collective_session.conf)
+    assert "TpuCollectiveHashJoinExec" in exec_.tree_string(), \
+        exec_.tree_string()
+    assert_tpu_cpu_equal(df, approx_float=True)
+
+
+@pytest.mark.slow
+def test_collective_join_multi_round(collective_session, no_broadcast, tmp_path):
+    """Stream side larger than the round budget: bounded rounds, same
+    answer (the streaming-shards discipline)."""
+    from spark_rapids_tpu.config import get_conf
+
+    lt, rt = _join_tables(37, n_left=4000, n_right=500)
+    paths = _multi_file(tmp_path, lt, 6)
+    ldf = collective_session.read_parquet(*paths)
+    rdf = collective_session.create_dataframe(rt)
+    df = ldf.join(rdf, on="k", how="inner")
+    get_conf().set("spark.rapids.tpu.shuffle.collective.roundRows", 512)
+    try:
+        assert_tpu_cpu_equal(df, approx_float=True)
+    finally:
+        get_conf().set("spark.rapids.tpu.shuffle.collective.roundRows",
+                       1 << 20)
+
+
+def test_collective_join_string_keys(collective_session, no_broadcast):
+    lt = gen_table({"s": "string", "lv": "int64"}, 500, seed=41)
+    rt = gen_table({"s": "string", "rv": "int64"}, 200, seed=42)
+    df = collective_session.create_dataframe(lt).join(
+        collective_session.create_dataframe(rt), on="s", how="inner")
+    assert_tpu_cpu_equal(df)
+
+
+def test_collective_join_empty_build(collective_session, no_broadcast):
+    lt, rt = _join_tables(43, n_left=100, n_right=0)
+    df = collective_session.create_dataframe(lt).join(
+        collective_session.create_dataframe(rt), on="k",
+        how="left_outer")
+    assert_tpu_cpu_equal(df, approx_float=True)
+
+
+def test_collective_sort_differential(collective_session):
+    t = gen_table({"k": "int64", "v": "float64"}, 1200, seed=51)
+    df = collective_session.create_dataframe(t).order_by(col("k"),
+                                                         col("v"))
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    exec_, _ = plan_query(df._plan, collective_session.conf)
+    assert "TpuCollectiveSortExec" in exec_.tree_string(), \
+        exec_.tree_string()
+    assert_tpu_cpu_equal(df, ignore_order=False, approx_float=True)
+
+
+def test_collective_sort_desc_nulls(collective_session):
+    t = gen_table({"k": "int64", "v": "float64"}, 800, seed=53)
+    df = collective_session.create_dataframe(t).order_by(col("k"),
+                                                         desc=True)
+    assert_tpu_cpu_equal(df, ignore_order=False, approx_float=True)
+
+
+@pytest.mark.slow
+def test_collective_sort_multi_round(collective_session, tmp_path):
+    from spark_rapids_tpu.config import get_conf
+
+    t = gen_table({"k": "float64", "v": "int64"}, 5000, seed=57)
+    paths = _multi_file(tmp_path, t, 5)
+    df = collective_session.read_parquet(*paths).order_by(col("k"))
+    get_conf().set("spark.rapids.tpu.shuffle.collective.roundRows", 600)
+    try:
+        assert_tpu_cpu_equal(df, ignore_order=False, approx_float=True)
+    finally:
+        get_conf().set("spark.rapids.tpu.shuffle.collective.roundRows",
+                       1 << 20)
+
+
+@pytest.mark.slow
+def test_collective_agg_multi_round(collective_session, tmp_path):
+    from spark_rapids_tpu.config import get_conf
+
+    t = gen_table({"k": "smallint64", "v": "float64"}, 4000, seed=59)
+    paths = _multi_file(tmp_path, t, 5)
+    df = (collective_session.read_parquet(*paths)
+          .group_by(col("k")).agg((sum_(col("v")), "s"),
+                                  (count(col("v")), "c")))
+    get_conf().set("spark.rapids.tpu.shuffle.collective.roundRows", 512)
+    try:
+        assert_tpu_cpu_equal(df, approx_float=True)
+    finally:
+        get_conf().set("spark.rapids.tpu.shuffle.collective.roundRows",
+                       1 << 20)
+
+
+def test_collective_execs_compose_per_partition(collective_session,
+                                                no_broadcast):
+    """Regression: collective execs report mesh-width num_partitions,
+    so anything stacked above (sort, limit, another join) consumes
+    them through execute_partition — that must serve per-shard
+    output, not trip the single-partition assertion."""
+    t = gen_table({"k": "smallint64", "v": "float64"}, 900, seed=61)
+    df = (collective_session.create_dataframe(t)
+          .group_by(col("k")).agg((sum_(col("v")), "s"))
+          .order_by(col("k")))
+    assert_tpu_cpu_equal(df, ignore_order=False, approx_float=True)
+
+    rt = gen_table({"k": "smallint64", "rv": "int64"}, 300, seed=62)
+    df2 = (collective_session.create_dataframe(t)
+           .join(collective_session.create_dataframe(rt), on="k",
+                 how="inner")
+           .limit(7))
+    out = df2.collect(engine="tpu")
+    assert out.num_rows == 7
