@@ -72,6 +72,12 @@ def is_retryable(exc: BaseException) -> bool:
     (fail fast)."""
     if isinstance(exc, MemoryError):
         return True
+    from spark_rapids_tpu.shuffle.net import FetchFailedError
+
+    if isinstance(exc, FetchFailedError):
+        # remote shuffle peer died mid-fetch: the retried attempt
+        # re-resolves peers (the FetchFailedException contract)
+        return True
     if isinstance(exc, RuntimeError):  # XlaRuntimeError subclasses it
         text = str(exc)
         return any(m in text for m in _RETRYABLE_MARKERS)

@@ -20,6 +20,24 @@ import atexit
 import threading
 from typing import Callable, Optional
 
+from spark_rapids_tpu.config import register
+
+NET_SHUFFLE_REGISTRY = register(
+    "spark.rapids.tpu.shuffle.registry.address", "",
+    "host:port of the shuffle peer registry (shuffle/net.py "
+    "HeartbeatServer).  When set, plugin bring-up starts a TCP block "
+    "server for this process's shuffle outputs and joins the registry "
+    "with heartbeats (ref: Plugin.scala:197 heartbeat endpoint + "
+    "RapidsShuffleHeartbeatManager).  Empty disables the network tier.")
+
+NET_SHUFFLE_ADVERTISE = register(
+    "spark.rapids.tpu.shuffle.server.advertiseHost", "",
+    "Routable address peers should fetch this executor's blocks from. "
+    "Empty = auto: loopback when the registry is on loopback, else "
+    "this host's resolved address (cross-machine peers must never be "
+    "handed 127.0.0.1 — they would fetch from themselves).  The block "
+    "server binds 0.0.0.0 whenever the advertised host is non-local.")
+
 _SHIMS: dict[str, Callable] = {}
 _lock = threading.Lock()
 
@@ -53,6 +71,8 @@ class TpuPlugin:
         set_conf(self.conf)
         self._closed = False
         self.device_info = None
+        self.block_server = None
+        self.heartbeat_client = None
         try:
             # device discovery + memory-budget sizing (the
             # GpuDeviceManager.initializeGpuAndMemory step); never
@@ -62,7 +82,49 @@ class TpuPlugin:
             self.device_info = device_manager.initialize(self.conf)
         except Exception:
             pass
+        self._maybe_start_network_shuffle()
         atexit.register(self.shutdown)
+
+    def _maybe_start_network_shuffle(self) -> None:
+        """Executor bring-up of the cross-process shuffle tier (ref:
+        Plugin.scala:197 RapidsShuffleHeartbeatEndpoint start): when a
+        registry address is configured, serve this process's blocks
+        over TCP and join the peer registry with periodic heartbeats."""
+        registry = self.conf.get(NET_SHUFFLE_REGISTRY)
+        if not registry:
+            return
+        try:
+            import os
+            import socket as _socket
+
+            from spark_rapids_tpu.shuffle.net import (
+                HeartbeatClient,
+                ShuffleBlockServer,
+            )
+
+            host, port = registry.rsplit(":", 1)
+            local_registry = host in ("127.0.0.1", "localhost", "::1")
+            advertise = self.conf.get(NET_SHUFFLE_ADVERTISE)
+            if not advertise:
+                advertise = "127.0.0.1" if local_registry \
+                    else _socket.gethostbyname(_socket.gethostname())
+            bind = "127.0.0.1" if advertise in ("127.0.0.1",
+                                                "localhost") \
+                else "0.0.0.0"
+            self.block_server = ShuffleBlockServer(host=bind).start()
+            bport = self.block_server.address[1]
+            self.heartbeat_client = HeartbeatClient(
+                host, int(port), f"executor-{os.getpid()}",
+                advertise, bport)
+            self.heartbeat_client.register()
+            self.heartbeat_client.start_background()
+        except Exception:
+            # degraded mode: local + collective tiers still work (the
+            # reference likewise falls back when UCX cannot start)
+            if self.block_server is not None:
+                self.block_server.shutdown()
+                self.block_server = None
+            self.heartbeat_client = None
 
     @classmethod
     def get_or_create(cls, conf=None) -> "TpuPlugin":
@@ -83,6 +145,15 @@ class TpuPlugin:
         from spark_rapids_tpu.execs import jit_cache
         from spark_rapids_tpu.memory import reset_store
 
+        if self.heartbeat_client is not None:
+            self.heartbeat_client.stop()
+            self.heartbeat_client = None
+        if self.block_server is not None:
+            try:
+                self.block_server.shutdown()
+            except Exception:
+                pass
+            self.block_server = None
         try:
             # reset_store() closes any existing store itself; calling
             # get_store() here would lazily build one just to close it

@@ -15,3 +15,12 @@ from spark_rapids_tpu.shuffle.manager import (  # noqa: F401
     get_shuffle_manager,
     reset_shuffle_manager,
 )
+from spark_rapids_tpu.shuffle.net import (  # noqa: F401
+    FetchFailedError,
+    HeartbeatClient,
+    HeartbeatManager,
+    HeartbeatServer,
+    ShuffleBlockServer,
+    fetch_blocks,
+    read_remote,
+)

@@ -81,6 +81,24 @@ class ShuffleManager:
                 st[0] += nbytes
                 st[1] += rows
 
+    def serve_host(self, shuffle_id: int, reduce_id: int
+                   ) -> Iterator[dict]:
+        """NON-destructive host-side read for the network block server
+        (ref: RapidsShuffleServer serving catalog buffers): blocks stay
+        published so a reducer can re-fetch after a failure; each block
+        is pinned only while its host arrays are being read."""
+        with self._lock:
+            handles = list(self._blocks.get((shuffle_id, reduce_id), []))
+        for h in handles:
+            try:
+                arrays = h.get_host()
+            except KeyError:
+                continue  # unregistered concurrently
+            try:
+                yield arrays
+            finally:
+                h.unpin()
+
     def partition_stats(self, shuffle_id: int,
                         n_partitions: int) -> list[tuple[int, int]]:
         """Per-reduce-partition (bytes, rows) written by the map stage —
