@@ -23,7 +23,9 @@ from spark_rapids_tpu.columnar.column import (
     AnyColumn,
     Column,
     ListColumn,
+    MapColumn,
     StringColumn,
+    StructColumn,
     all_valid_mask,
     pad_capacity,
     pad_width,
@@ -180,6 +182,94 @@ def _list_host(arr: pa.Array, dtype: T.ListType, cap: int
     return values, lengths, evalid, valid
 
 
+def _host_any_column(arr: pa.Array, dtype: T.DataType, cap: int):
+    """Recursive host-side (numpy-backed) column builder for ANY dtype
+    — the nested-type entry point (struct-of-columns / twin-matrix
+    maps); flat types reuse the component decoders."""
+    if isinstance(dtype, T.StructType):
+        n = len(arr)
+        validity = np.zeros(cap, np.bool_)
+        validity[:n] = np.asarray(arr.is_valid()) if arr.null_count \
+            else True
+        kids = []
+        for i, f in enumerate(dtype.fields):
+            child = arr.field(i)
+            # a null struct row must null its children too (arrow may
+            # leave garbage under null parents)
+            kids.append(_host_any_column(child, f.dtype, cap))
+            kv = kids[-1].validity.copy()
+            kv[:n] &= validity[:n]
+            kids[-1] = kids[-1].with_validity(kv)
+        return StructColumn(tuple(kids), validity, dtype)
+    if isinstance(dtype, T.MapType):
+        return _map_host_column(arr, dtype, cap)
+    if isinstance(dtype, T.StringType):
+        chars, lengths, valid = _string_host(arr, cap)
+        return StringColumn(chars, lengths, valid)
+    if isinstance(dtype, T.ListType):
+        values, lengths, ev, valid = _list_host(arr, dtype, cap)
+        return ListColumn(values, lengths, ev, valid, dtype)
+    data, vhost = _fixed_host(arr, dtype, cap)
+    if vhost is None:
+        vhost = np.zeros(cap, np.bool_)
+        vhost[:len(arr)] = True
+    return Column(data, vhost, dtype)
+
+
+def _map_host_column(arr: pa.Array, dtype: T.MapType,
+                     cap: int) -> MapColumn:
+    """pa.MapArray -> dense twin matrices (keys/values share lengths)."""
+    n = len(arr)
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    offsets = np.asarray(arr.offsets)[: n + 1].astype(np.int64)
+    keys_flat = arr.keys
+    items_flat = arr.items
+    kphys = T.to_numpy_dtype(dtype.key)
+    vphys = T.to_numpy_dtype(dtype.value)
+
+    def _flat_np(a, dt, phys):
+        if len(a) == 0:
+            return np.zeros(0, phys), np.zeros(0, np.bool_)
+        fv = np.asarray(a.is_valid()) if a.null_count \
+            else np.ones(len(a), np.bool_)
+        if a.null_count:
+            a = a.fill_null(_zero_value(dt))
+        if isinstance(dt, T.DateType):
+            vals = a.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        elif isinstance(dt, T.TimestampType):
+            vals = a.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        else:
+            vals = a.to_numpy(zero_copy_only=False).astype(
+                phys, copy=False)
+        return vals, fv
+
+    kf, _ = _flat_np(keys_flat, dtype.key, kphys)
+    vf, vvalid = _flat_np(items_flat, dtype.value, vphys)
+    validity_np = np.asarray(arr.is_valid()) if arr.null_count \
+        else np.ones(n, np.bool_)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    lens = np.where(validity_np, lens, 0).astype(np.int32)
+    L = pad_width(max(int(lens.max()) if n else 0, 1))
+    keys = np.zeros((cap, L), kphys)
+    values = np.zeros((cap, L), vphys)
+    evalid = np.zeros((cap, L), np.bool_)
+    if n and len(kf):
+        # offsets are ABSOLUTE into the full (unsliced) child arrays
+        # that .keys/.items return — no base subtraction (a sliced
+        # MapArray would otherwise decode shifted entries)
+        idx = offsets[:-1, None] + np.arange(L)[None, :]
+        mask = np.arange(L)[None, :] < lens[:, None]
+        safe = np.clip(idx, 0, max(len(kf) - 1, 0))
+        keys[:n] = np.where(mask, kf[safe], 0)
+        values[:n] = np.where(mask, vf[safe], 0)
+        evalid[:n] = mask & vvalid[safe]
+    lengths = np.zeros(cap, np.int32)
+    lengths[:n] = lens
+    valid = np.zeros(cap, np.bool_)
+    valid[:n] = validity_np
+    return MapColumn(keys, values, evalid, lengths, valid, dtype)
+
+
 # --------------------------------------------------------------------- #
 # Packed upload: one H2D transfer per batch
 # --------------------------------------------------------------------- #
@@ -286,6 +376,11 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
             values, lengths, evalid, valid = _list_host(arr, f.dtype, cap)
             recipe.append(("list", len(comps), f.dtype))
             comps.extend([values, lengths, evalid, valid])
+        elif isinstance(f.dtype, (T.StructType, T.MapType)):
+            # nested: the column is itself a pytree of host buffers;
+            # device_put moves every leaf in the same batched transfer
+            recipe.append(("nested", len(comps), f.dtype))
+            comps.append(_host_any_column(arr, f.dtype, cap))
         else:
             data, vhost = _fixed_host(arr, f.dtype, cap)
             if vhost is None:
@@ -295,9 +390,12 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
                 recipe.append(("fixed", len(comps), f.dtype))
                 comps.extend([data, vhost])
 
-    if len(comps) > 1 and _packed_enabled():
+    if (len(comps) > 1 and _packed_enabled()) or any(
+            not isinstance(a, np.ndarray) for a in comps):
         # one batched transfer round for every component (beats a packed
-        # staging buffer: no unpack program, and jax batches the copies)
+        # staging buffer: no unpack program, and jax batches the
+        # copies); nested columns are pytrees — device_put moves every
+        # leaf, jnp.asarray would choke on the dataclass
         dev = jax.device_put(comps)
     else:
         dev = [jnp.asarray(a) for a in comps]
@@ -309,6 +407,8 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
         elif kind == "list":
             cols.append(ListColumn(dev[i], dev[i + 1], dev[i + 2],
                                    dev[i + 3], dtype))
+        elif kind == "nested":
+            cols.append(dev[i])
         elif kind == "fixed_shared":
             cols.append(Column(dev[i], all_valid_mask(cap), dtype))
         else:
@@ -327,86 +427,87 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
     million-row capacity bucket is a 1-row transfer, not a 100MB one)."""
     from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
 
-    def _comps_of(b):
-        comps: list = []
-        for col in b.columns:
-            if isinstance(col, ListColumn):
-                comps += [col.values, col.lengths, col.elem_validity,
-                          col.validity]
-            elif isinstance(col, StringColumn):
-                comps += [col.chars, col.lengths, col.validity]
-            else:
-                comps += [col.data, col.validity]
-        return comps
-
     if batch.capacity <= 1024 and not isinstance(batch.num_rows, int):
         # small batch with a device-resident row count (aggregate
         # results, limits): fetch the count WITH the components in one
         # D2H round instead of syncing the count first — each round
-        # pays full link latency
-        host = jax.device_get([batch.num_rows] + _comps_of(batch))
-        n = n_live = int(host[0])
-        host = host[1:]
-        batch = _CB(batch.columns, n_live, batch.schema)
+        # pays full link latency.  Columns are pytrees, so one
+        # device_get batches every leaf of every column (incl. nested).
+        n_host, host_cols = jax.device_get(
+            (batch.num_rows, list(batch.columns)))
+        n = int(n_host)
     else:
-        n_live = batch.concrete_num_rows()
-        shrunk_cap = max(128, -(-n_live // 128) * 128)
+        n = batch.concrete_num_rows()
+        shrunk_cap = max(128, -(-n // 128) * 128)
         if shrunk_cap < batch.capacity:
             batch = batch.shrink_to_capacity(shrunk_cap)
-        batch = _CB(batch.columns, n_live, batch.schema)
-        # ONE batched D2H round for the whole batch
-        host = jax.device_get(_comps_of(batch))
-        n = n_live
+            batch = _CB(batch.columns, n, batch.schema)
+        host_cols = jax.device_get(list(batch.columns))
 
     arrays = []
-    ci = 0
     aschema = schema_to_arrow(batch.schema)
-    for f, col, afield in zip(batch.schema.fields, batch.columns, aschema):
-        if isinstance(col, ListColumn):
-            vals, lens, ev, rv = (a[:n] for a in host[ci:ci + 4])
-            ci += 4
-            pylist = []
-            for i in range(n):
-                if not rv[i]:
-                    pylist.append(None)
-                else:
-                    m = int(lens[i])
-                    pylist.append([
-                        vals[i, j].item() if ev[i, j] else None
-                        for j in range(m)])
-            arrays.append(pa.array(pylist, type=afield.type))
-        elif isinstance(col, StringColumn):
-            chars, lens, valid = (a[:n] for a in host[ci:ci + 3])
-            ci += 3
-            pylist = [
-                bytes(chars[i, :lens[i]]).decode("utf-8")
-                if valid[i] else None
-                for i in range(n)
-            ]
-            arrays.append(pa.array(pylist, type=afield.type))
-        else:
-            vals = host[ci][:n]
-            valid = host[ci + 1][:n]
-            ci += 2
-            if isinstance(f.dtype, T.DecimalType):
-                import decimal
-
-                pylist = [
-                    decimal.Decimal(int(vals[i])).scaleb(-f.dtype.scale)
-                    if valid[i] else None
-                    for i in range(n)
-                ]
-                arrays.append(pa.array(pylist, type=afield.type))
-            else:
-                mask = ~valid if (~valid).any() else None
-                if isinstance(f.dtype, T.DateType):
-                    arrays.append(
-                        pa.array(vals.astype("int32"), pa.int32(),
-                                 mask=mask).cast(afield.type))
-                elif isinstance(f.dtype, T.TimestampType):
-                    arrays.append(
-                        pa.array(vals.astype("int64"), pa.int64(),
-                                 mask=mask).cast(afield.type))
-                else:
-                    arrays.append(pa.array(vals, type=afield.type, mask=mask))
+    for f, col, afield in zip(batch.schema.fields, host_cols, aschema):
+        arrays.append(_host_col_to_arrow(col, f.dtype, n, afield.type))
     return pa.Table.from_arrays(arrays, schema=aschema)
+
+
+def _host_col_to_arrow(col, dtype: T.DataType, n: int,
+                       atype) -> pa.Array:
+    """One HOST-resident (device_get) column -> pa.Array[:n]."""
+    if isinstance(col, ListColumn):
+        vals, lens = col.values[:n], col.lengths[:n]
+        ev, rv = col.elem_validity[:n], col.validity[:n]
+        pylist = []
+        for i in range(n):
+            if not rv[i]:
+                pylist.append(None)
+            else:
+                m = int(lens[i])
+                pylist.append([vals[i, j].item() if ev[i, j] else None
+                               for j in range(m)])
+        return pa.array(pylist, type=atype)
+    if isinstance(col, StringColumn):
+        chars, lens, valid = col.chars[:n], col.lengths[:n], \
+            col.validity[:n]
+        pylist = [bytes(chars[i, :lens[i]]).decode("utf-8")
+                  if valid[i] else None for i in range(n)]
+        return pa.array(pylist, type=atype)
+    if isinstance(col, StructColumn):
+        valid = np.asarray(col.validity[:n])
+        kids = [_host_col_to_arrow(c, f.dtype, n, atype.field(i).type)
+                for i, (c, f) in enumerate(zip(col.children,
+                                               dtype.fields))]
+        mask = pa.array(~valid) if (~valid).any() else None
+        return pa.StructArray.from_arrays(
+            kids, fields=list(atype), mask=mask)
+    if isinstance(col, MapColumn):
+        keys, vals = col.keys[:n], col.values[:n]
+        ev, lens, rv = col.entry_validity[:n], col.lengths[:n], \
+            col.validity[:n]
+        pylist = []
+        for i in range(n):
+            if not rv[i]:
+                pylist.append(None)
+            else:
+                m = int(lens[i])
+                pylist.append([
+                    (keys[i, j].item(),
+                     vals[i, j].item() if ev[i, j] else None)
+                    for j in range(m)])
+        return pa.array(pylist, type=atype)
+    # fixed-width
+    vals, valid = col.data[:n], col.validity[:n]
+    if isinstance(dtype, T.DecimalType):
+        import decimal
+
+        pylist = [decimal.Decimal(int(vals[i])).scaleb(-dtype.scale)
+                  if valid[i] else None for i in range(n)]
+        return pa.array(pylist, type=atype)
+    mask = ~valid if (~valid).any() else None
+    if isinstance(dtype, T.DateType):
+        return pa.array(vals.astype("int32"), pa.int32(),
+                        mask=mask).cast(atype)
+    if isinstance(dtype, T.TimestampType):
+        return pa.array(vals.astype("int64"), pa.int64(),
+                        mask=mask).cast(atype)
+    return pa.array(vals, type=atype, mask=mask)

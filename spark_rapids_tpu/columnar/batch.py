@@ -30,7 +30,9 @@ from spark_rapids_tpu.columnar.column import (
     AnyColumn,
     Column,
     ListColumn,
+    MapColumn,
     StringColumn,
+    StructColumn,
     pad_capacity,
     pad_width,
 )
@@ -160,14 +162,7 @@ class ColumnarBatch:
         n = self.concrete_num_rows()
         out: dict[str, list] = {}
         for f, col in zip(self.schema.fields, self.columns):
-            if isinstance(col, StringColumn):
-                out[f.name] = col.to_list(n)
-            else:
-                vals = np.asarray(col.data)[:n]
-                valid = np.asarray(col.validity)[:n]
-                out[f.name] = [
-                    (vals[i].item() if valid[i] else None) for i in range(n)
-                ]
+            out[f.name] = _col_to_pylist(col, f.dtype, n)
         return out
 
     # ------------------------------------------------------------------ #
@@ -210,20 +205,7 @@ class ColumnarBatch:
         bucket without this."""
         if not self.columns or new_cap >= self.capacity:
             return self
-        cols: list[AnyColumn] = []
-        for c in self.columns:
-            if isinstance(c, StringColumn):
-                cols.append(StringColumn(c.chars[:new_cap],
-                                         c.lengths[:new_cap],
-                                         c.validity[:new_cap]))
-            elif isinstance(c, ListColumn):
-                cols.append(ListColumn(c.values[:new_cap],
-                                       c.lengths[:new_cap],
-                                       c.elem_validity[:new_cap],
-                                       c.validity[:new_cap], c.dtype))
-            else:
-                cols.append(Column(c.data[:new_cap], c.validity[:new_cap],
-                                   c.dtype))
+        cols = [_shrink_col(c, new_cap) for c in self.columns]
         return ColumnarBatch(cols, self.num_rows, self.schema)
 
     def slice_prefix(self, n: RowCount) -> "ColumnarBatch":
@@ -237,6 +219,67 @@ class ColumnarBatch:
             new_n, jnp.int32)
         cols = [c.with_validity(c.validity & live) for c in self.columns]
         return ColumnarBatch(cols, new_n, self.schema)
+
+
+def _col_to_pylist(col, dtype: T.DataType, n: int) -> list:
+    """One column -> python values (recursive; host sync per leaf)."""
+    if isinstance(col, StringColumn):
+        return col.to_list(n)
+    if isinstance(col, StructColumn):
+        valid = np.asarray(col.validity)[:n]
+        kids = [_col_to_pylist(c, f.dtype, n)
+                for c, f in zip(col.children, dtype.fields)]
+        names = [f.name for f in dtype.fields]
+        return [dict(zip(names, vals)) if valid[i] else None
+                for i, vals in enumerate(zip(*kids))] if kids else \
+            [{} if v else None for v in valid]
+    if isinstance(col, MapColumn):
+        keys = np.asarray(col.keys)[:n]
+        vals = np.asarray(col.values)[:n]
+        ev = np.asarray(col.entry_validity)[:n]
+        lens = np.asarray(col.lengths)[:n]
+        valid = np.asarray(col.validity)[:n]
+        out = []
+        for i in range(n):
+            if not valid[i]:
+                out.append(None)
+            else:
+                m = int(lens[i])
+                out.append({keys[i, j].item():
+                            (vals[i, j].item() if ev[i, j] else None)
+                            for j in range(m)})
+        return out
+    if isinstance(col, ListColumn):
+        vals = np.asarray(col.values)[:n]
+        ev = np.asarray(col.elem_validity)[:n]
+        lens = np.asarray(col.lengths)[:n]
+        valid = np.asarray(col.validity)[:n]
+        return [[vals[i, j].item() if ev[i, j] else None
+                 for j in range(int(lens[i]))] if valid[i] else None
+                for i in range(n)]
+    vals = np.asarray(col.data)[:n]
+    valid = np.asarray(col.validity)[:n]
+    return [(vals[i].item() if valid[i] else None) for i in range(n)]
+
+
+def _shrink_col(c: AnyColumn, new_cap: int) -> AnyColumn:
+    """Slice a column to a smaller capacity (recursive for nesting)."""
+    if isinstance(c, StringColumn):
+        return StringColumn(c.chars[:new_cap], c.lengths[:new_cap],
+                            c.validity[:new_cap])
+    if isinstance(c, ListColumn):
+        return ListColumn(c.values[:new_cap], c.lengths[:new_cap],
+                          c.elem_validity[:new_cap],
+                          c.validity[:new_cap], c.dtype)
+    if isinstance(c, StructColumn):
+        return StructColumn(
+            tuple(_shrink_col(k, new_cap) for k in c.children),
+            c.validity[:new_cap], c.dtype)
+    if isinstance(c, MapColumn):
+        return MapColumn(c.keys[:new_cap], c.values[:new_cap],
+                         c.entry_validity[:new_cap], c.lengths[:new_cap],
+                         c.validity[:new_cap], c.dtype)
+    return Column(c.data[:new_cap], c.validity[:new_cap], c.dtype)
 
 
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
@@ -255,61 +298,111 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     out_cols: list[AnyColumn] = []
     for ci, f in enumerate(schema.fields):
         parts = [b.columns[ci] for b in batches]
-        if isinstance(f.dtype, T.ListType):
-            phys = T.to_numpy_dtype(f.dtype.element)
-            L = max(p.max_len for p in parts)  # type: ignore[union-attr]
-            values = jnp.zeros((cap, L), phys)
-            lengths = jnp.zeros(cap, jnp.int32)
-            evalid = jnp.zeros((cap, L), jnp.bool_)
-            valid = jnp.zeros(cap, jnp.bool_)
-            off = 0
-            for p, n in zip(parts, ns):
-                if n == 0:
-                    continue
-                pv, pe = p.values[:n], p.elem_validity[:n]
-                if p.max_len < L:
-                    pv = jnp.pad(pv, ((0, 0), (0, L - p.max_len)))
-                    pe = jnp.pad(pe, ((0, 0), (0, L - p.max_len)))
-                values = jax.lax.dynamic_update_slice(values, pv, (off, 0))
-                evalid = jax.lax.dynamic_update_slice(evalid, pe, (off, 0))
-                lengths = jax.lax.dynamic_update_slice(
-                    lengths, p.lengths[:n].astype(jnp.int32), (off,))
-                valid = jax.lax.dynamic_update_slice(
-                    valid, p.validity[:n], (off,))
-                off += n
-            out_cols.append(ListColumn(values, lengths, evalid, valid,
-                                       f.dtype))
-        elif isinstance(f.dtype, T.StringType):
-            w = pad_width(max(p.width for p in parts))  # type: ignore[union-attr]
-            chars = jnp.zeros((cap, w), jnp.uint8)
-            lengths = jnp.zeros(cap, jnp.int32)
-            valid = jnp.zeros(cap, jnp.bool_)
-            off = 0
-            for p, n in zip(parts, ns):
-                if n == 0:
-                    continue
-                pc = p.chars[:n]
-                if p.width < w:
-                    pc = jnp.pad(pc, ((0, 0), (0, w - p.width)))
-                chars = jax.lax.dynamic_update_slice(chars, pc, (off, 0))
-                lengths = jax.lax.dynamic_update_slice(
-                    lengths, p.lengths[:n].astype(jnp.int32), (off,))
-                valid = jax.lax.dynamic_update_slice(
-                    valid, p.validity[:n], (off,))
-                off += n
-            out_cols.append(StringColumn(chars, lengths, valid))
-        else:
-            phys = T.to_numpy_dtype(f.dtype)
-            data = jnp.zeros(cap, phys)
-            valid = jnp.zeros(cap, jnp.bool_)
-            off = 0
-            for p, n in zip(parts, ns):
-                if n == 0:
-                    continue
-                data = jax.lax.dynamic_update_slice(
-                    data, p.data[:n].astype(phys), (off,))
-                valid = jax.lax.dynamic_update_slice(
-                    valid, p.validity[:n], (off,))
-                off += n
-            out_cols.append(Column(data, valid, f.dtype))
+        out_cols.append(_concat_cols(parts, ns, cap, f.dtype))
     return ColumnarBatch(out_cols, total, schema)
+
+
+def _concat_cols(parts: list, ns: list[int], cap: int,
+                 dtype: T.DataType) -> AnyColumn:
+    """Concatenate column parts into one capacity-`cap` column
+    (recursive for nested types)."""
+    f = T.Field("_", dtype)
+    if isinstance(f.dtype, T.StructType):
+        valid = jnp.zeros(cap, jnp.bool_)
+        off = 0
+        for p, n in zip(parts, ns):
+            if n == 0:
+                continue
+            valid = jax.lax.dynamic_update_slice(
+                valid, p.validity[:n], (off,))
+            off += n
+        kids = tuple(
+            _concat_cols([p.children[i] for p in parts], ns, cap,
+                         cf.dtype)
+            for i, cf in enumerate(f.dtype.fields))
+        return StructColumn(kids, valid, f.dtype)
+    if isinstance(f.dtype, T.MapType):
+        kphys = T.to_numpy_dtype(f.dtype.key)
+        vphys = T.to_numpy_dtype(f.dtype.value)
+        L = max(p.max_len for p in parts)
+        keys = jnp.zeros((cap, L), kphys)
+        values = jnp.zeros((cap, L), vphys)
+        evalid = jnp.zeros((cap, L), jnp.bool_)
+        lengths = jnp.zeros(cap, jnp.int32)
+        valid = jnp.zeros(cap, jnp.bool_)
+        off = 0
+        for p, n in zip(parts, ns):
+            if n == 0:
+                continue
+            pk, pv, pe = p.keys[:n], p.values[:n], \
+                p.entry_validity[:n]
+            if p.max_len < L:
+                pad = ((0, 0), (0, L - p.max_len))
+                pk, pv, pe = (jnp.pad(x, pad) for x in (pk, pv, pe))
+            keys = jax.lax.dynamic_update_slice(keys, pk, (off, 0))
+            values = jax.lax.dynamic_update_slice(values, pv,
+                                                  (off, 0))
+            evalid = jax.lax.dynamic_update_slice(evalid, pe,
+                                                  (off, 0))
+            lengths = jax.lax.dynamic_update_slice(
+                lengths, p.lengths[:n].astype(jnp.int32), (off,))
+            valid = jax.lax.dynamic_update_slice(
+                valid, p.validity[:n], (off,))
+            off += n
+        return MapColumn(keys, values, evalid, lengths, valid,
+                         f.dtype)
+    if isinstance(f.dtype, T.ListType):
+        phys = T.to_numpy_dtype(f.dtype.element)
+        L = max(p.max_len for p in parts)  # type: ignore[union-attr]
+        values = jnp.zeros((cap, L), phys)
+        lengths = jnp.zeros(cap, jnp.int32)
+        evalid = jnp.zeros((cap, L), jnp.bool_)
+        valid = jnp.zeros(cap, jnp.bool_)
+        off = 0
+        for p, n in zip(parts, ns):
+            if n == 0:
+                continue
+            pv, pe = p.values[:n], p.elem_validity[:n]
+            if p.max_len < L:
+                pv = jnp.pad(pv, ((0, 0), (0, L - p.max_len)))
+                pe = jnp.pad(pe, ((0, 0), (0, L - p.max_len)))
+            values = jax.lax.dynamic_update_slice(values, pv, (off, 0))
+            evalid = jax.lax.dynamic_update_slice(evalid, pe, (off, 0))
+            lengths = jax.lax.dynamic_update_slice(
+                lengths, p.lengths[:n].astype(jnp.int32), (off,))
+            valid = jax.lax.dynamic_update_slice(
+                valid, p.validity[:n], (off,))
+            off += n
+        return ListColumn(values, lengths, evalid, valid, f.dtype)
+    if isinstance(f.dtype, T.StringType):
+        w = pad_width(max(p.width for p in parts))  # type: ignore[union-attr]
+        chars = jnp.zeros((cap, w), jnp.uint8)
+        lengths = jnp.zeros(cap, jnp.int32)
+        valid = jnp.zeros(cap, jnp.bool_)
+        off = 0
+        for p, n in zip(parts, ns):
+            if n == 0:
+                continue
+            pc = p.chars[:n]
+            if p.width < w:
+                pc = jnp.pad(pc, ((0, 0), (0, w - p.width)))
+            chars = jax.lax.dynamic_update_slice(chars, pc, (off, 0))
+            lengths = jax.lax.dynamic_update_slice(
+                lengths, p.lengths[:n].astype(jnp.int32), (off,))
+            valid = jax.lax.dynamic_update_slice(
+                valid, p.validity[:n], (off,))
+            off += n
+        return StringColumn(chars, lengths, valid)
+    phys = T.to_numpy_dtype(f.dtype)
+    data = jnp.zeros(cap, phys)
+    valid = jnp.zeros(cap, jnp.bool_)
+    off = 0
+    for p, n in zip(parts, ns):
+        if n == 0:
+            continue
+        data = jax.lax.dynamic_update_slice(
+            data, p.data[:n].astype(phys), (off,))
+        valid = jax.lax.dynamic_update_slice(
+            valid, p.validity[:n], (off,))
+        off += n
+    return Column(data, valid, f.dtype)

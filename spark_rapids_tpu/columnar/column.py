@@ -260,7 +260,102 @@ class ListColumn:
                           validity, self.dtype)
 
 
-AnyColumn = Union[Column, StringColumn, ListColumn]
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class StructColumn:
+    """Struct-of-columns: one child AnyColumn per field + row validity.
+
+    The TPU answer to cudf's nested column hierarchy (ref:
+    GpuColumnVector's nested support + TypeChecks.scala:129): children
+    recurse through the same column protocol, so gather/concat/spill
+    machinery needs no special cases beyond dispatch."""
+
+    children: tuple   # of AnyColumn, one per struct field
+    validity: ArrayLike
+    dtype: T.DataType = dataclasses.field(
+        default_factory=lambda: T.StructType([]))
+
+    def tree_flatten(self):
+        return (tuple(self.children), self.validity), (self.dtype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        kids, validity = children
+        return cls(tuple(kids), validity, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    def with_validity(self, validity: ArrayLike) -> "StructColumn":
+        return StructColumn(self.children, validity, self.dtype)
+
+    def gather(self, indices: ArrayLike,
+               index_valid: Optional[ArrayLike] = None) -> "StructColumn":
+        validity = jnp.take(self.validity,
+                            jnp.clip(indices, 0, self.capacity - 1),
+                            axis=0)
+        if index_valid is not None:
+            validity = validity & index_valid
+        return StructColumn(
+            tuple(c.gather(indices, index_valid) for c in self.children),
+            validity, self.dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class MapColumn:
+    """map<k,v> as two aligned dense list matrices sharing lengths:
+    `keys[capacity, max_len]`, `values[capacity, max_len]`, per-slot
+    `entry_validity` for values (map keys are non-null by SQL rules),
+    `lengths[capacity]`, row `validity` (ref: GpuGetMapValue,
+    complexTypeExtractors.scala — cudf walks list<struct<k,v>>; the
+    dense twin-matrix form makes lookup one vectorized compare)."""
+
+    keys: ArrayLike            # (capacity, max_len) key physical type
+    values: ArrayLike          # (capacity, max_len) value physical type
+    entry_validity: ArrayLike  # (capacity, max_len) value-slot validity
+    lengths: ArrayLike         # (capacity,) int32
+    validity: ArrayLike        # (capacity,) bool
+    dtype: T.DataType = dataclasses.field(
+        default_factory=lambda: T.MapType(T.LONG, T.LONG))
+
+    def tree_flatten(self):
+        return ((self.keys, self.values, self.entry_validity,
+                 self.lengths, self.validity), (self.dtype,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, values, ev, lengths, validity = children
+        return cls(keys, values, ev, lengths, validity, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.lengths.shape[0])
+
+    @property
+    def max_len(self) -> int:
+        return int(self.keys.shape[1])
+
+    def with_validity(self, validity: ArrayLike) -> "MapColumn":
+        return MapColumn(self.keys, self.values, self.entry_validity,
+                         self.lengths, validity, self.dtype)
+
+    def gather(self, indices: ArrayLike,
+               index_valid: Optional[ArrayLike] = None) -> "MapColumn":
+        idx = jnp.clip(indices, 0, self.capacity - 1)
+        validity = jnp.take(self.validity, idx, axis=0)
+        if index_valid is not None:
+            validity = validity & index_valid
+        return MapColumn(jnp.take(self.keys, idx, axis=0),
+                         jnp.take(self.values, idx, axis=0),
+                         jnp.take(self.entry_validity, idx, axis=0),
+                         jnp.take(self.lengths, idx, axis=0),
+                         validity, self.dtype)
+
+
+AnyColumn = Union[Column, StringColumn, ListColumn, StructColumn,
+                  MapColumn]
 
 
 def column_to_numpy(col: AnyColumn, num_rows: int

@@ -275,7 +275,8 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
     list) — callers fall back to the per-component padded upload path.
     """
     for f in schema.fields:
-        if isinstance(f.dtype, (T.DecimalType, T.ListType)):
+        if isinstance(f.dtype, (T.DecimalType, T.ListType,
+                                T.StructType, T.MapType)):
             return None
     if n == 0:
         return None

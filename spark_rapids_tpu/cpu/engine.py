@@ -213,6 +213,48 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
         out = [None if (v is None or k < 0 or k >= len(v)) else v[k]
                for v in c.to_pylist()]
         return pa.array(out, T.to_arrow_type(e.dtype))
+    from spark_rapids_tpu.exprs import complex as CX
+
+    if isinstance(e, CX.GetStructField):
+        c = cpu_eval(e.child, table)
+        dt = e.child.dtype
+        idx = dt.field_index(e.field_name)
+        field = pc.struct_field(c, [idx])
+        if c.null_count:
+            # null parent rows must surface as null fields
+            field = pc.if_else(pc.is_valid(c), field,
+                               pa.scalar(None, field.type))
+        return field
+    if isinstance(e, CX.CreateNamedStruct):
+        kids = [cpu_eval(v, table) for v in e.values]
+        return pc.make_struct(*kids, field_names=list(e.names))
+    if isinstance(e, (CX.GetMapValue, CX.ElementAt)) and isinstance(
+            e.child.dtype, T.MapType):
+        c = cpu_eval(e.child, table)
+        key = e.key.value if isinstance(e, CX.GetMapValue) \
+            else e.index.value
+        out = []
+        for row in c.to_pylist():
+            if row is None:
+                out.append(None)
+            else:
+                d = dict(row) if not isinstance(row, dict) else row
+                out.append(d.get(key))
+        return pa.array(out, T.to_arrow_type(e.dtype))
+    if isinstance(e, CX.ElementAt):
+        c = cpu_eval(e.child, table)
+        k = int(e.index.value)
+        if k == 0:
+            # Spark contract: index 0 is an error in EVERY mode
+            raise ValueError("SQL array indices start at 1")
+        out = []
+        for row in c.to_pylist():
+            if row is None:
+                out.append(None)
+            else:
+                pos = k - 1 if k > 0 else len(row) + k
+                out.append(row[pos] if 0 <= pos < len(row) else None)
+        return pa.array(out, T.to_arrow_type(e.dtype))
     if isinstance(e, COLL.ArrayContains):
         c = cpu_eval(e.child, table)
         v = e.value.value

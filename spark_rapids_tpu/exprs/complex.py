@@ -1,0 +1,214 @@
+"""Struct/map extractor and constructor expressions.
+
+TPU counterparts of the reference's complex-type expressions (ref:
+org/apache/spark/sql/rapids/complexTypeExtractors.scala GpuGetStructField
+/ GpuGetMapValue / GpuElementAt, complexTypeCreator.scala
+GpuCreateNamedStruct).  The struct-of-columns layout makes field access
+zero-copy (validity AND); the twin-matrix map layout makes key lookup
+one vectorized compare + first-match gather."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (
+    Column,
+    ListColumn,
+    MapColumn,
+    StructColumn,
+)
+from spark_rapids_tpu.exprs.base import EvalContext, Expression, Literal
+
+#: map/list element types the device kernels handle (fixed-width
+#: physical); strings inside maps fall back to the CPU engine
+_FIXED = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+          T.LongType, T.FloatType, T.DoubleType, T.DateType,
+          T.TimestampType, T.DecimalType)
+
+
+@dataclasses.dataclass(repr=False)
+class GetStructField(Expression):
+    """struct.field — child column with parent-validity AND (ref:
+    GpuGetStructField, complexTypeExtractors.scala)."""
+
+    child: Expression
+    field_name: str
+
+    @property
+    def dtype(self) -> T.DataType:
+        dt = self.child.dtype
+        if isinstance(dt, T.StructType):
+            try:
+                return dt.fields[dt.field_index(self.field_name)].dtype
+            except KeyError:
+                return T.NULL
+        return T.NULL
+
+    @property
+    def name(self) -> str:
+        return f"{self.child.name}.{self.field_name}"
+
+    def check_supported(self) -> None:
+        dt = self.child.dtype
+        if not isinstance(dt, T.StructType):
+            raise TypeError("getField requires a struct input")
+        dt.field_index(self.field_name)  # raises KeyError if absent
+
+    def eval(self, ctx: EvalContext):
+        sc = self.child.eval(ctx)
+        assert isinstance(sc, StructColumn), type(sc).__name__
+        dt = self.child.dtype
+        c = sc.children[dt.field_index(self.field_name)]
+        return c.with_validity(c.validity & sc.validity)
+
+
+@dataclasses.dataclass(repr=False)
+class CreateNamedStruct(Expression):
+    """named_struct(n1, v1, ...) (ref: GpuCreateNamedStruct,
+    complexTypeCreator.scala) — always-valid struct rows over the
+    evaluated children."""
+
+    names: tuple
+    values: tuple  # of Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.StructType([T.Field(n, v.dtype, True)
+                             for n, v in zip(self.names, self.values)])
+
+    @property
+    def name(self) -> str:
+        inner = ", ".join(f"{n}: {v.name}"
+                          for n, v in zip(self.names, self.values))
+        return f"named_struct({inner})"
+
+    @property
+    def children(self) -> tuple:
+        return tuple(self.values)
+
+    def with_children(self, children):
+        return CreateNamedStruct(self.names, tuple(children))
+
+    def check_supported(self) -> None:
+        if len(self.names) != len(self.values) or not self.values:
+            raise TypeError("named_struct needs matching names/values")
+
+    def eval(self, ctx: EvalContext) -> StructColumn:
+        kids = tuple(v.eval(ctx) for v in self.values)
+        cap = kids[0].capacity
+        return StructColumn(kids, jnp.ones(cap, bool), self.dtype)
+
+
+def _map_lookup(mc: MapColumn, key_value, value_dtype: T.DataType
+                ) -> Column:
+    """First-match lookup: NULL when the key is absent or the row is
+    NULL (ref: GpuGetMapValue)."""
+    slot = jnp.arange(mc.max_len, dtype=jnp.int32)[None, :]
+    in_len = slot < mc.lengths[:, None].astype(jnp.int32)
+    kphys = mc.keys.dtype
+    eq = (mc.keys == jnp.asarray(key_value, kphys)) & in_len
+    found = jnp.any(eq, axis=1)
+    idx = jnp.argmax(eq, axis=1)
+    rows = jnp.arange(mc.capacity)
+    vals = mc.values[rows, idx]
+    evalid = mc.entry_validity[rows, idx]
+    return Column(vals.astype(T.to_numpy_dtype(value_dtype)),
+                  mc.validity & found & evalid, value_dtype)
+
+
+def _check_map_device(dt: T.MapType) -> None:
+    if not isinstance(dt.key, _FIXED) or not isinstance(dt.value,
+                                                        _FIXED):
+        raise TypeError(
+            f"map {dt.name} has non-fixed-width key/value (device "
+            "lookup supports primitives; CPU fallback handles the rest)")
+
+
+@dataclasses.dataclass(repr=False)
+class GetMapValue(Expression):
+    """map[key] with a literal key (ref: GpuGetMapValue)."""
+
+    child: Expression
+    key: Expression  # Literal
+
+    @property
+    def dtype(self) -> T.DataType:
+        dt = self.child.dtype
+        return dt.value if isinstance(dt, T.MapType) else T.NULL
+
+    @property
+    def name(self) -> str:
+        return f"{self.child.name}[{self.key.name}]"
+
+    def check_supported(self) -> None:
+        dt = self.child.dtype
+        if not isinstance(dt, T.MapType):
+            raise TypeError("getMapValue requires a map input")
+        if not isinstance(self.key, Literal) or self.key.value is None:
+            raise TypeError("getMapValue key must be a non-null literal")
+        _check_map_device(dt)
+
+    def eval(self, ctx: EvalContext) -> Column:
+        mc = self.child.eval(ctx)
+        assert isinstance(mc, MapColumn), type(mc).__name__
+        return _map_lookup(mc, self.key.value, self.dtype)
+
+
+@dataclasses.dataclass(repr=False)
+class ElementAt(Expression):
+    """element_at(array, i) (1-based, negative from the end) or
+    element_at(map, key) (ref: GpuElementAt; Spark rejects index 0
+    outright, out-of-bounds yields NULL in non-ANSI mode)."""
+
+    child: Expression
+    index: Expression  # Literal
+
+    @property
+    def dtype(self) -> T.DataType:
+        dt = self.child.dtype
+        if isinstance(dt, T.ListType):
+            return dt.element
+        if isinstance(dt, T.MapType):
+            return dt.value
+        return T.NULL
+
+    @property
+    def name(self) -> str:
+        return f"element_at({self.child.name}, {self.index.name})"
+
+    def check_supported(self) -> None:
+        dt = self.child.dtype
+        if not isinstance(dt, (T.ListType, T.MapType)):
+            raise TypeError("element_at requires an array or map input")
+        if not isinstance(self.index, Literal) \
+                or self.index.value is None:
+            raise TypeError("element_at index must be a non-null literal")
+        if isinstance(dt, T.ListType):
+            if int(self.index.value) == 0:
+                raise ValueError("SQL array indices start at 1")
+        else:
+            _check_map_device(dt)
+
+    def eval(self, ctx: EvalContext) -> Column:
+        dt = self.child.dtype
+        if isinstance(dt, T.MapType):
+            mc = self.child.eval(ctx)
+            assert isinstance(mc, MapColumn)
+            return _map_lookup(mc, self.index.value, self.dtype)
+        c = self.child.eval(ctx)
+        assert isinstance(c, ListColumn), type(c).__name__
+        k = int(self.index.value)
+        lens = c.lengths.astype(jnp.int32)
+        # 1-based; negative counts back from the end
+        pos = jnp.where(jnp.int32(k) > 0, jnp.int32(k - 1),
+                        lens + jnp.int32(k))
+        in_bounds = (pos >= 0) & (pos < lens)
+        safe = jnp.clip(pos, 0, max(c.max_len - 1, 0))
+        rows = jnp.arange(c.capacity)
+        vals = c.values[rows, safe]
+        evalid = c.elem_validity[rows, safe]
+        return Column(vals.astype(T.to_numpy_dtype(self.dtype)),
+                      c.validity & in_bounds & evalid, self.dtype)

@@ -146,14 +146,20 @@ def _scan_batch_rows(schema: T.Schema) -> int:
     rows_cap = conf.get(BATCH_SIZE_ROWS)
     if rows_cap == BATCH_SIZE_ROWS.default:
         rows_cap = 64 << 20  # defer to the byte target
+    def _w(dt: T.DataType) -> int:
+        if isinstance(dt, T.StringType):
+            return 40
+        if isinstance(dt, T.ListType):
+            return 128
+        if isinstance(dt, T.StructType):
+            return 1 + sum(_w(f2.dtype) for f2 in dt.fields)
+        if isinstance(dt, T.MapType):
+            return 192
+        return np.dtype(T.to_numpy_dtype(dt)).itemsize
+
     est = 2  # validity byte + slack
     for f in schema.fields:
-        if isinstance(f.dtype, T.StringType):
-            est += 40
-        elif isinstance(f.dtype, T.ListType):
-            est += 128
-        else:
-            est += np.dtype(T.to_numpy_dtype(f.dtype)).itemsize
+        est += _w(f.dtype)
     by_bytes = max(1024, conf.get(MAX_READ_BATCH_BYTES) // est)
     # round down to a power of two: full batches then sit exactly on
     # their capacity bucket — no device padding, no wire padding, and

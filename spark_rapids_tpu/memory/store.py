@@ -36,6 +36,8 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import (
+    MapColumn,
+    StructColumn,
     AnyColumn,
     Column,
     ListColumn,
@@ -80,46 +82,72 @@ SPILL_DIR = register(
     "Directory for disk-tier spill files (default: a temp dir).")
 
 
+def _col_device_bytes(c) -> int:
+    if isinstance(c, StringColumn):
+        return c.chars.size * 1 + c.lengths.size * 4 + c.validity.size
+    if isinstance(c, ListColumn):
+        return (c.values.size * c.values.dtype.itemsize
+                + c.lengths.size * 4 + c.elem_validity.size
+                + c.validity.size)
+    if isinstance(c, StructColumn):
+        return sum(_col_device_bytes(k) for k in c.children) \
+            + c.validity.size
+    if isinstance(c, MapColumn):
+        return (c.keys.size * c.keys.dtype.itemsize
+                + c.values.size * c.values.dtype.itemsize
+                + c.entry_validity.size + c.lengths.size * 4
+                + c.validity.size)
+    return c.data.size * c.data.dtype.itemsize + c.validity.size
+
+
 def batch_device_bytes(batch: ColumnarBatch) -> int:
-    total = 0
-    for c in batch.columns:
-        if isinstance(c, StringColumn):
-            total += c.chars.size * 1 + c.lengths.size * 4 + c.validity.size
-        elif isinstance(c, ListColumn):
-            total += (c.values.size * c.values.dtype.itemsize
-                      + c.lengths.size * 4 + c.elem_validity.size
-                      + c.validity.size)
-        else:
-            total += c.data.size * c.data.dtype.itemsize + c.validity.size
+    total = sum(_col_device_bytes(c) for c in batch.columns)
     if not isinstance(batch.num_rows, int):
         total += 4
     return total
 
 
-def _batch_to_host(batch: ColumnarBatch) -> dict:
-    """Materialize to numpy and DELETE the device buffers."""
-    arrays: dict[str, np.ndarray] = {}
+def _col_leaves(c, prefix: str) -> list[tuple[str, object]]:
+    """(name, device array) leaves of one column (recursive)."""
+    if isinstance(c, StringColumn):
+        return [(f"{prefix}_chars", c.chars),
+                (f"{prefix}_lengths", c.lengths),
+                (f"{prefix}_valid", c.validity)]
+    if isinstance(c, ListColumn):
+        return [(f"{prefix}_lvalues", c.values),
+                (f"{prefix}_lengths", c.lengths),
+                (f"{prefix}_levalid", c.elem_validity),
+                (f"{prefix}_valid", c.validity)]
+    if isinstance(c, StructColumn):
+        out = []
+        for j, k in enumerate(c.children):
+            out += _col_leaves(k, f"{prefix}_f{j}")
+        return out + [(f"{prefix}_valid", c.validity)]
+    if isinstance(c, MapColumn):
+        return [(f"{prefix}_mkeys", c.keys),
+                (f"{prefix}_mvalues", c.values),
+                (f"{prefix}_mevalid", c.entry_validity),
+                (f"{prefix}_lengths", c.lengths),
+                (f"{prefix}_valid", c.validity)]
+    return [(f"{prefix}_data", c.data), (f"{prefix}_valid", c.validity)]
+
+
+def _batch_to_host(batch: ColumnarBatch,
+                   delete: bool = True) -> dict:
+    """Materialize to numpy; `delete` releases the device buffers
+    (spill), False leaves them resident (host VIEW, e.g. serve_host)."""
     n = batch.concrete_num_rows()
+    leaves: list[tuple[str, object]] = []
     for i, c in enumerate(batch.columns):
-        if isinstance(c, StringColumn):
-            arrays[f"c{i}_chars"] = np.asarray(jax.device_get(c.chars))
-            arrays[f"c{i}_lengths"] = np.asarray(jax.device_get(c.lengths))
-            arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
-            for a in (c.chars, c.lengths, c.validity):
-                _delete(a)
-        elif isinstance(c, ListColumn):
-            arrays[f"c{i}_lvalues"] = np.asarray(jax.device_get(c.values))
-            arrays[f"c{i}_lengths"] = np.asarray(jax.device_get(c.lengths))
-            arrays[f"c{i}_levalid"] = np.asarray(
-                jax.device_get(c.elem_validity))
-            arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
-            for a in (c.values, c.lengths, c.elem_validity, c.validity):
-                _delete(a)
-        else:
-            arrays[f"c{i}_data"] = np.asarray(jax.device_get(c.data))
-            arrays[f"c{i}_valid"] = np.asarray(jax.device_get(c.validity))
-            for a in (c.data, c.validity):
-                _delete(a)
+        leaves += _col_leaves(c, f"c{i}")
+    # ONE batched D2H round for every leaf (per-leaf gets would pay
+    # link latency per buffer)
+    host = jax.device_get([a for _, a in leaves])
+    arrays: dict[str, np.ndarray] = {
+        name: np.asarray(h) for (name, _), h in zip(leaves, host)}
+    if delete:
+        for _, a in leaves:
+            _delete(a)
     arrays["__num_rows"] = np.asarray(n, np.int64)
     return arrays
 
@@ -134,26 +162,41 @@ def _delete(a) -> None:
             pass  # already consumed/donated
 
 
-def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:
+def _host_to_col(arrays: dict, prefix: str, dtype: T.DataType):
     import jax.numpy as jnp
 
-    cols: list[AnyColumn] = []
-    for i, f in enumerate(schema.fields):
-        if isinstance(f.dtype, T.StringType):
-            cols.append(StringColumn(
-                jnp.asarray(arrays[f"c{i}_chars"]),
-                jnp.asarray(arrays[f"c{i}_lengths"]),
-                jnp.asarray(arrays[f"c{i}_valid"])))
-        elif isinstance(f.dtype, T.ListType):
-            cols.append(ListColumn(
-                jnp.asarray(arrays[f"c{i}_lvalues"]),
-                jnp.asarray(arrays[f"c{i}_lengths"]),
-                jnp.asarray(arrays[f"c{i}_levalid"]),
-                jnp.asarray(arrays[f"c{i}_valid"]), f.dtype))
-        else:
-            cols.append(Column(jnp.asarray(arrays[f"c{i}_data"]),
-                               jnp.asarray(arrays[f"c{i}_valid"]),
-                               f.dtype))
+    if isinstance(dtype, T.StringType):
+        return StringColumn(
+            jnp.asarray(arrays[f"{prefix}_chars"]),
+            jnp.asarray(arrays[f"{prefix}_lengths"]),
+            jnp.asarray(arrays[f"{prefix}_valid"]))
+    if isinstance(dtype, T.ListType):
+        return ListColumn(
+            jnp.asarray(arrays[f"{prefix}_lvalues"]),
+            jnp.asarray(arrays[f"{prefix}_lengths"]),
+            jnp.asarray(arrays[f"{prefix}_levalid"]),
+            jnp.asarray(arrays[f"{prefix}_valid"]), dtype)
+    if isinstance(dtype, T.StructType):
+        kids = tuple(_host_to_col(arrays, f"{prefix}_f{j}", cf.dtype)
+                     for j, cf in enumerate(dtype.fields))
+        return StructColumn(kids,
+                            jnp.asarray(arrays[f"{prefix}_valid"]),
+                            dtype)
+    if isinstance(dtype, T.MapType):
+        return MapColumn(
+            jnp.asarray(arrays[f"{prefix}_mkeys"]),
+            jnp.asarray(arrays[f"{prefix}_mvalues"]),
+            jnp.asarray(arrays[f"{prefix}_mevalid"]),
+            jnp.asarray(arrays[f"{prefix}_lengths"]),
+            jnp.asarray(arrays[f"{prefix}_valid"]), dtype)
+    return Column(jnp.asarray(arrays[f"{prefix}_data"]),
+                  jnp.asarray(arrays[f"{prefix}_valid"]), dtype)
+
+
+def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:
+    cols: list[AnyColumn] = [
+        _host_to_col(arrays, f"c{i}", f.dtype)
+        for i, f in enumerate(schema.fields)]
     n = int(np.asarray(arrays["__num_rows"]).reshape(-1)[0])
     return ColumnarBatch(cols, n, schema)
 
@@ -341,25 +384,8 @@ class BufferStore:
                     )
 
                     return read_spill_file(e.path)  # type: ignore
-                b = e.batch  # DEVICE: pull without deleting
-                arrays: dict[str, np.ndarray] = {}
-                n = b.concrete_num_rows()  # type: ignore[union-attr]
-                for i, c in enumerate(b.columns):  # type: ignore
-                    if isinstance(c, StringColumn):
-                        arrays[f"c{i}_chars"] = np.asarray(c.chars)
-                        arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
-                        arrays[f"c{i}_valid"] = np.asarray(c.validity)
-                    elif isinstance(c, ListColumn):
-                        arrays[f"c{i}_lvalues"] = np.asarray(c.values)
-                        arrays[f"c{i}_lengths"] = np.asarray(c.lengths)
-                        arrays[f"c{i}_levalid"] = np.asarray(
-                            c.elem_validity)
-                        arrays[f"c{i}_valid"] = np.asarray(c.validity)
-                    else:
-                        arrays[f"c{i}_data"] = np.asarray(c.data)
-                        arrays[f"c{i}_valid"] = np.asarray(c.validity)
-                arrays["__num_rows"] = np.asarray(n, np.int64)
-                return arrays
+                # DEVICE: pull without deleting
+                return _batch_to_host(e.batch, delete=False)
             except BaseException:
                 e.pins = max(0, e.pins - 1)  # failed acquire: no leak
                 raise

@@ -130,6 +130,14 @@ register_expr(COLL.CreateArray, TS.ExprSig(
     TS.NUMERIC + TS.BOOLEAN + TS.DATETIME + TS.NULLSIG,
     "fixed-width elements only"))
 
+from spark_rapids_tpu.exprs import complex as CX  # noqa: E402
+
+for _cls in (CX.GetStructField, CX.CreateNamedStruct, CX.GetMapValue,
+             CX.ElementAt):
+    register_expr(_cls, TS.ExprSig(
+        TS.ALL + TS.NESTED, "struct/map input; fixed-width map "
+        "key/value on device (check_supported)"))
+
 # partition-context / nondeterministic expressions
 from spark_rapids_tpu.exprs import nondeterministic as ND  # noqa: E402
 
@@ -275,6 +283,12 @@ class PlanMeta:
                 f"operator {self.plan.name} is not supported on TPU")
         elif not conf.get(entry):
             self.will_not_work(f"disabled by {entry.key}")
+        if not self.children and not _schema_device_representable(
+                self.plan.schema):
+            # a LEAF producing unrepresentable columns can never
+            # upload (list<string>, map<string,*>, ...): CPU source
+            self.will_not_work(
+                "source output type has no device layout")
         self._tag_exprs()
         for c in self.children:
             c.tag()
@@ -821,13 +835,25 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
 def _schema_device_representable(schema: T.Schema) -> bool:
     """Can a batch of this schema live in device columns?  list<string>
     / list<decimal> exist logically (CPU-engine results) but have no
-    dense device layout."""
-    for f in schema.fields:
-        if isinstance(f.dtype, T.ListType) and isinstance(
-                f.dtype.element, (T.StringType, T.DecimalType,
-                                  T.ListType)):
-            return False
-    return True
+    dense device layout; map key/value must be fixed-width (the twin
+    dense matrices hold physical scalars)."""
+
+    fixed = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+             T.LongType, T.FloatType, T.DoubleType, T.DateType,
+             T.TimestampType)
+
+    def ok(dt: T.DataType) -> bool:
+        if isinstance(dt, T.ListType):
+            # dense element matrix holds physical scalars only
+            return isinstance(dt.element, fixed)
+        if isinstance(dt, T.StructType):
+            return all(ok(f.dtype) for f in dt.fields)
+        if isinstance(dt, T.MapType):
+            return isinstance(dt.key, fixed) and isinstance(dt.value,
+                                                            fixed)
+        return True
+
+    return all(ok(f.dtype) for f in schema.fields)
 
 
 def _demote_unrepresentable_boundaries(meta: PlanMeta) -> None:

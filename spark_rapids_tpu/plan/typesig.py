@@ -35,11 +35,13 @@ _KIND_OF = {
     T.DecimalType: "decimal",
     T.NullType: "null",
     T.ListType: "array",
+    T.StructType: "struct",
+    T.MapType: "map",
 }
 
 KIND_ORDER = ["boolean", "byte", "short", "int", "long", "float",
               "double", "decimal", "string", "date", "timestamp",
-              "null", "array"]
+              "null", "array", "struct", "map"]
 
 
 def kind_of(dtype: T.DataType) -> str:
@@ -74,6 +76,9 @@ DATETIME = TypeSig.of("date", "timestamp")
 DECIMAL = TypeSig.of("decimal")
 NULLSIG = TypeSig.of("null")
 ARRAY = TypeSig.of("array")
+STRUCT = TypeSig.of("struct")
+MAP = TypeSig.of("map")
+NESTED = ARRAY + STRUCT + MAP
 
 #: the commonCudfTypes analog (ref: TypeSig.commonCudfTypes :427):
 #: everything the columnar kernels handle uniformly

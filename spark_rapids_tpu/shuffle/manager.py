@@ -81,6 +81,15 @@ class ShuffleManager:
                 st[0] += nbytes
                 st[1] += rows
 
+    def knows_shuffle(self, shuffle_id: int) -> bool:
+        """True when this manager has EVER seen the shuffle (stats
+        survive read(), so a restarted process — fresh manager — says
+        False and the network server can distinguish 'lost blocks'
+        from 'genuinely empty partition')."""
+        with self._lock:
+            return any(k[0] == shuffle_id for k in self._stats) \
+                or any(k[0] == shuffle_id for k in self._blocks)
+
     def serve_host(self, shuffle_id: int, reduce_id: int
                    ) -> Iterator[dict]:
         """NON-destructive host-side read for the network block server

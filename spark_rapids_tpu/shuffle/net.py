@@ -99,6 +99,14 @@ class _BlockHandler(socketserver.BaseRequestHandler):
             return
         manager = self.server.shuffle_manager  # type: ignore[attr-defined]
         sid, rid = int(req["shuffle_id"]), int(req["reduce_id"])
+        if not manager.knows_shuffle(sid):
+            # restarted peer / stale address: the blocks are LOST, not
+            # empty — the reducer must get a retryable failure, never
+            # silently consume zero rows
+            _send_msg(self.request, json.dumps(
+                {"error": f"unknown shuffle {sid} (blocks lost; "
+                          "peer restarted?)"}).encode())
+            return
         _send_msg(self.request, json.dumps({"streaming": True}).encode())
         # one block serialized + sent at a time (the bounce-buffer
         # windowing discipline: peak memory is one frame, each block

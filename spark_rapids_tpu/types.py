@@ -182,6 +182,56 @@ class ListType(DataType):
         return hash((ListType, self.element))
 
 
+class StructType(DataType):
+    """struct<name: type, ...> — device layout is struct-of-columns: one
+    child column per field plus a row validity, so every field access is
+    zero-copy and field-wise ops stay dense vector code (ref: the
+    reference's nested TypeSig support, TypeChecks.scala:129, and
+    complexTypeExtractors.scala GpuGetStructField)."""
+
+    def __init__(self, fields):
+        self.fields = tuple(fields)
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        inner = ", ".join(f"{f.name}: {f.dtype.name}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self) -> int:
+        return hash((StructType, self.fields))
+
+
+class MapType(DataType):
+    """map<key, value> — device layout is two aligned dense list
+    matrices (keys + values sharing per-row lengths).  Lookup is a
+    vectorized compare + argmax over the key matrix (ref:
+    GpuGetMapValue, complexTypeExtractors.scala)."""
+
+    def __init__(self, key: DataType, value: DataType):
+        self.key = key
+        self.value = value
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"map<{self.key.name},{self.value.name}>"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, MapType) and other.key == self.key
+                and other.value == self.value)
+
+    def __hash__(self) -> int:
+        return hash((MapType, self.key, self.value))
+
+
 # Singletons
 BOOLEAN = BooleanType()
 BYTE = ByteType()
@@ -253,6 +303,14 @@ def from_arrow_type(at) -> DataType:
         return DecimalType(at.precision, at.scale)
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return ListType(from_arrow_type(at.value_type))
+    if pa.types.is_struct(at):
+        return StructType([Field(at.field(i).name,
+                                 from_arrow_type(at.field(i).type),
+                                 at.field(i).nullable)
+                           for i in range(at.num_fields)])
+    if pa.types.is_map(at):
+        return MapType(from_arrow_type(at.key_type),
+                       from_arrow_type(at.item_type))
     raise TypeError(f"unsupported arrow type {at}")
 
 
@@ -275,6 +333,12 @@ def to_arrow_type(dt: DataType):
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, ListType):
         return pa.list_(to_arrow_type(dt.element))
+    if isinstance(dt, StructType):
+        return pa.struct([pa.field(f.name, to_arrow_type(f.dtype),
+                                   nullable=f.nullable)
+                          for f in dt.fields])
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow_type(dt.key), to_arrow_type(dt.value))
     try:
         return m[type(dt)]
     except KeyError:

@@ -117,6 +117,24 @@ def gen_table(spec: dict[str, str], n: int, seed: int = 0,
             arr = pa.array(
                 rng.integers(0, 2**45, n, dtype=np.int64), pa.int64(),
                 mask=nulls).cast(pa.timestamp("us", tz="UTC"))
+        elif kind == "struct":
+            inner_nulls = rng.random(n) < null_prob
+            a = pa.array(rng.integers(-100, 100, n), pa.int64(),
+                         mask=inner_nulls)
+            b = pa.array(rng.normal(0, 10, n), pa.float64())
+            arr = pa.StructArray.from_arrays(
+                [a, b], names=["a", "b"], mask=pa.array(nulls))
+        elif kind == "map":
+            rows = []
+            for i in range(n):
+                if nulls[i]:
+                    rows.append(None)
+                else:
+                    ks = dict.fromkeys(
+                        rng.integers(0, 8, rng.integers(0, 5)).tolist())
+                    rows.append([(int(k), float(rng.normal()))
+                                 for k in ks])
+            arr = pa.array(rows, pa.map_(pa.int64(), pa.float64()))
         else:
             raise ValueError(kind)
         arrays[name] = arr

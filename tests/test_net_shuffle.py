@@ -234,3 +234,30 @@ def test_heartbeat_client_reregisters_after_prune():
             c.stop()
     finally:
         srv.shutdown()
+
+
+def test_lost_blocks_raise_not_empty():
+    """Regression: a peer that never saw the shuffle (restart) must
+    fail the fetch, not serve zero rows as a silently empty result."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    fresh = ShuffleBlockServer(ShuffleManager()).start()
+    try:
+        with pytest.raises(FetchFailedError, match="unknown shuffle"):
+            fetch_blocks("127.0.0.1", fresh.address[1], 99, 0,
+                         timeout=2.0)
+        # ... but an EMPTY partition of a KNOWN shuffle is legit
+        mgr = ShuffleManager()
+        sid = mgr.new_shuffle_id()
+        mgr.write(sid, 1, ColumnarBatch.from_numpy(
+            {"k": np.arange(3, dtype=np.int64), "v": np.ones(3)},
+            SCHEMA))
+        srv2 = ShuffleBlockServer(mgr).start()
+        try:
+            assert fetch_blocks("127.0.0.1", srv2.address[1], sid, 0,
+                                timeout=2.0) == []
+        finally:
+            srv2.shutdown()
+    finally:
+        fresh.shutdown()
