@@ -315,6 +315,20 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
                                           rounding=_dec.ROUND_HALF_UP)
                     out.append(None if abs(v) >= bound else v)
             return pa.array(out, at)
+        from spark_rapids_tpu.exprs.base import AnsiError, ansi_enabled
+
+        if ansi_enabled() and pa.types.is_integer(at):
+            fn = {A.Add: pc.add_checked, A.Subtract: pc.subtract_checked,
+                  A.Multiply: pc.multiply_checked}[type(e)]
+            try:
+                return fn(l.cast(at), r.cast(at))
+            except pa.ArrowInvalid as exc:
+                msg = "long overflow" if pa.types.is_int64(at) \
+                    else "integer overflow"
+                raise AnsiError(
+                    msg + ". If necessary set "
+                    "spark.rapids.tpu.sql.ansi.enabled to false to "
+                    "bypass this error.") from exc
         fn = {A.Add: pc.add, A.Subtract: pc.subtract,
               A.Multiply: pc.multiply}[type(e)]
         return fn(l.cast(at), r.cast(at))
@@ -323,6 +337,11 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
         l = l.cast(pa.float64())
         r = r.cast(pa.float64())
         zero = pc.equal(r, 0.0)
+        # both-valid gating matches the device check (a NULL operand
+        # row never raises; Spark's right-only gating differs on the
+        # (NULL, 0) corner — documented engine behavior)
+        _cpu_ansi_div_check(l, pc.and_kleene(
+            pc.fill_null(zero, False), pc.is_valid(l)))
         safe = pc.if_else(pc.fill_null(zero, False), pa.scalar(1.0), r)
         out = pc.divide(l, safe)
         return pc.if_else(pc.fill_null(zero, True), pa.nulls(
@@ -334,6 +353,7 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
         lv, lva = _np_vals(l, at)
         rv, rva = _np_vals(r, at)
         valid = lva & rva
+        _cpu_ansi_div_check(None, pa.array((rv == 0) & valid))
         if np.issubdtype(npdt, np.floating):
             zero = rv == 0.0
             rv = np.where(zero, 1.0, rv)
@@ -762,7 +782,28 @@ def _dispatch_extended(e, table, n):  # noqa: C901
     return NotImplemented
 
 
+def _cpu_ansi_div_check(_l, zero_mask) -> None:
+    """Raise the ANSI division-by-zero error when the conf is on."""
+    from spark_rapids_tpu.exprs.base import AnsiError, ansi_enabled
+
+    if not ansi_enabled():
+        return
+    z = zero_mask
+    try:
+        any_zero = bool(pc.any(pc.fill_null(z, False)).as_py()) \
+            if isinstance(z, (pa.Array, pa.ChunkedArray)) \
+            else bool(np.asarray(z).any())
+    except Exception:
+        any_zero = False
+    if any_zero:
+        raise AnsiError(
+            "Division by zero. If necessary set "
+            "spark.rapids.tpu.sql.ansi.enabled to false to bypass "
+            "this error.")
+
+
 def _cast_cpu(e, table, n):
+    from spark_rapids_tpu.exprs.base import AnsiError, ansi_enabled
     from spark_rapids_tpu.exprs.cast import Cast  # noqa: F401
 
     src = e.child.dtype
@@ -771,8 +812,36 @@ def _cast_cpu(e, table, n):
     if src == dst:
         return c
     at = T.to_arrow_type(dst)
+    ansi = ansi_enabled()
     if isinstance(src, T.StringType):
-        return _cast_cpu_from_string(c, dst, at)
+        out = _cast_cpu_from_string(c, dst, at)
+        if ansi and out.null_count > c.null_count:
+            raise AnsiError(
+                f"invalid input syntax for type {dst.name} (ANSI "
+                "cast). If necessary set "
+                "spark.rapids.tpu.sql.ansi.enabled to false to "
+                "bypass this error.")
+        return out
+    if ansi and isinstance(dst, T.IntegralType):
+        info = np.iinfo(T.to_numpy_dtype(dst))
+        bad = None
+        if isinstance(src, (T.FloatType, T.DoubleType)):
+            v, ok = _np_vals(c.cast(pa.float64()), pa.float64())
+            t = np.trunc(v)
+            bad = ok & (np.isnan(v) | (t > float(info.max))
+                        | (t < float(info.min)))
+        elif isinstance(src, T.IntegralType):
+            # integer-space compare: a float64 round-trip would lose
+            # precision past 2^53 (and pyarrow's safe cast would raise
+            # its own non-ANSI error first)
+            v, ok = _np_vals(c, T.to_arrow_type(src))
+            bad = ok & ((v > info.max) | (v < info.min))
+        if bad is not None and bad.any():
+            raise AnsiError(
+                f"value out of range for {dst.name} (ANSI cast "
+                "overflow). If necessary set "
+                "spark.rapids.tpu.sql.ansi.enabled to false to "
+                "bypass this error.")
     if isinstance(dst, T.StringType):
         return pc.cast(c, pa.string())
     if isinstance(dst, T.BooleanType):

@@ -362,17 +362,33 @@ class FusableExec(TpuExec):
             aware = aware or is_aware(node)
             node = node.children[0]
         fns: list[BatchFn] = [e.make_batch_fn() for e in reversed(execs)]
+        from spark_rapids_tpu.exprs.base import (
+            ansi_capture,
+            ansi_enabled,
+            fold_ansi_flags,
+        )
+
+        ansi = ansi_enabled()
         if aware:
             from spark_rapids_tpu.exprs.base import partition_info
 
-            def pipeline(batch: ColumnarBatch, pidx,
-                         off) -> ColumnarBatch:
+            def pipeline(batch: ColumnarBatch, pidx, off):
                 with partition_info(pidx, off):
+                    if ansi:
+                        with ansi_capture() as flags:
+                            for f in fns:
+                                batch = f(batch)
+                        return batch, fold_ansi_flags(flags)
                     for f in fns:
                         batch = f(batch)
                 return batch
         else:
-            def pipeline(batch: ColumnarBatch) -> ColumnarBatch:  # type: ignore[misc]
+            def pipeline(batch: ColumnarBatch):  # type: ignore[misc]
+                if ansi:
+                    with ansi_capture() as flags:
+                        for f in fns:
+                            batch = f(batch)
+                    return batch, fold_ansi_flags(flags)
                 for f in fns:
                     batch = f(batch)
                 return batch
@@ -381,14 +397,17 @@ class FusableExec(TpuExec):
         if all(k is not None for k in keys):
             from spark_rapids_tpu.execs.jit_cache import cached_jit
 
-            jitted = cached_jit(("fused", tuple(keys)), lambda: pipeline)
+            jitted = cached_jit(("fused", tuple(keys), ansi),
+                                lambda: pipeline)
         else:
             jitted = jax.jit(pipeline)
-        self._fused = (jitted, node, aware)
+        self._fused = (jitted, node, aware, ansi)
         return self._fused
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        fused, node, aware = self._fused_pipeline()
+        from spark_rapids_tpu.exprs.base import raise_if_ansi_error
+
+        fused, node, aware, ansi = self._fused_pipeline()
         if aware:
             pidx = jnp.asarray(p, jnp.int32)
             off = jnp.asarray(0, jnp.int64)
@@ -396,12 +415,19 @@ class FusableExec(TpuExec):
             b = batch.with_device_num_rows()
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:
                 if aware:
-                    out = t.observe(fused(b, pidx, off))
+                    out = fused(b, pidx, off)
                     # row_offset advances by the INPUT batch's live rows
                     # (lazy device add; no sync)
                     off = off + jnp.asarray(b.num_rows, jnp.int64)
                 else:
-                    out = t.observe(fused(b))
+                    out = fused(b)
+                if ansi:
+                    out, err = out
+                    # the one host sync ANSI mode costs: the program
+                    # can't raise, so the error code is polled here
+                    # (the reference pays the same via cudf's throw)
+                    raise_if_ansi_error(jax.device_get(err))
+                out = t.observe(out)
             yield self._count_output(out)
 
     def execute(self) -> Iterator[ColumnarBatch]:

@@ -57,14 +57,23 @@ class BinaryArithmetic(Expression):
         return T.to_numpy_dtype(self.dtype)
 
     def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.exprs.base import ansi_active
+
         lc = self.left.eval(ctx)
         rc = self.right.eval(ctx)
         phys = self._phys()
         ld = lc.data.astype(phys)
         rd = rc.data.astype(phys)
         valid = broadcast_validity(lc, rc)
+        pre_valid = valid
         data, valid = self.compute(ld, rd, valid)
+        if ansi_active():
+            self._ansi_check(ld, rd, data, pre_valid, phys)
         return Column(data, valid, self.dtype)
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        """Per-op ANSI error detection (overflow / division by zero);
+        `valid` is the PRE-compute row validity."""
 
     def compute(self, ld, rd, valid):
         raise NotImplementedError
@@ -125,11 +134,27 @@ class _DecimalAddSub(BinaryArithmetic):
         return Column(data, valid, out)
 
 
+def _overflow_message(phys) -> str:
+    # java.lang.Math.addExact wording (what Spark surfaces)
+    return "long overflow" if jnp.dtype(phys).itemsize == 8 \
+        else "integer overflow"
+
+
 class Add(_DecimalAddSub):
     symbol = "+"
 
     def compute(self, ld, rd, valid):
         return ld + rd, valid
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        if not jnp.issubdtype(phys, jnp.integer):
+            return
+        # two same-sign operands whose sum flips sign overflowed
+        ovf = valid & ((ld >= 0) == (rd >= 0)) \
+            & ((data >= 0) != (ld >= 0))
+        ansi_report(ovf, _overflow_message(phys))
 
 
 class Subtract(_DecimalAddSub):
@@ -137,6 +162,16 @@ class Subtract(_DecimalAddSub):
 
     def compute(self, ld, rd, valid):
         return ld - rd, valid
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        if not jnp.issubdtype(phys, jnp.integer):
+            return
+        # mixed-sign operands whose difference flips sign overflowed
+        ovf = valid & ((ld >= 0) != (rd >= 0)) \
+            & ((data >= 0) != (ld >= 0))
+        ansi_report(ovf, _overflow_message(phys))
 
 
 class Multiply(BinaryArithmetic):
@@ -158,6 +193,21 @@ class Multiply(BinaryArithmetic):
     def compute(self, ld, rd, valid):
         return ld * rd, valid
 
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        if not jnp.issubdtype(phys, jnp.integer):
+            return
+        # multiplicative overflow: the product no longer divides back
+        # to the left operand (Math.multiplyExact's check), plus the
+        # MIN_VALUE * -1 corner
+        info = jnp.iinfo(phys)
+        back = jnp.where(rd != 0, _java_divmod(data, jnp.where(
+            rd != 0, rd, 1))[0], 0)
+        ovf = valid & (rd != 0) & (back != ld)
+        ovf = ovf | (valid & (ld == info.min) & (rd == -1))
+        ansi_report(ovf, _overflow_message(phys))
+
 
 class Divide(BinaryArithmetic):
     """Double division; x/0 -> NULL per Spark non-ANSI Divide semantics."""
@@ -176,6 +226,12 @@ class Divide(BinaryArithmetic):
         zero = rd == 0.0
         safe = jnp.where(zero, 1.0, rd)
         return ld / safe, valid & ~zero
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        ansi_report(valid & (rd == 0), "Division by zero")
+
 
 
 class IntegralDivide(BinaryArithmetic):
@@ -198,6 +254,12 @@ class IntegralDivide(BinaryArithmetic):
         qi, _ = _java_divmod(ld, safe)
         return qi, valid & ~zero
 
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        ansi_report(valid & (rd == 0), "Division by zero")
+
+
 
 class Remainder(BinaryArithmetic):
     """`%` with Java semantics (sign of dividend); x % 0 -> NULL."""
@@ -216,6 +278,12 @@ class Remainder(BinaryArithmetic):
         zero = rd == 0
         safe = jnp.where(zero, 1, rd)
         return _java_mod(ld, safe), valid & ~zero
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        ansi_report(valid & (rd == 0), "Division by zero")
+
 
 
 class Pmod(BinaryArithmetic):
@@ -240,6 +308,12 @@ class Pmod(BinaryArithmetic):
         r = _java_mod(ld, safe)
         r = jnp.where(r < 0, _java_mod(r + safe, safe), r)
         return r, valid & ~zero
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        ansi_report(valid & (rd == 0), "Division by zero")
+
 
 
 @dataclasses.dataclass(repr=False)

@@ -68,6 +68,98 @@ def partition_info(partition_index, row_offset):
         _PINFO.v = prev
 
 
+# ------------------------------------------------------------------ #
+# ANSI mode (ref: GpuCast.scala:166 ANSI cast matrix + the ANSI
+# arithmetic overflow gating in arithmetic.scala).  XLA programs can't
+# raise, so error conditions trace as per-row flags collected into one
+# int32 error-code scalar the EXEC polls after the program runs —
+# the host-side throw the reference gets synchronously from cudf.
+# ------------------------------------------------------------------ #
+
+def _register_ansi_conf():
+    from spark_rapids_tpu.config import register
+
+    return register(
+        "spark.rapids.tpu.sql.ansi.enabled", False,
+        "ANSI SQL mode (the spark.sql.ansi.enabled analog): overflow "
+        "in Add/Subtract/Multiply and invalid/overflowing casts RAISE "
+        "instead of wrapping/NULLing (ref: GpuCast.scala:166 ANSI "
+        "matrix; CheckOverflow).")
+
+
+ANSI_ENABLED = _register_ansi_conf()
+
+
+class AnsiError(RuntimeError):
+    """org.apache.spark.SparkArithmeticException analog."""
+
+
+def ansi_enabled() -> bool:
+    from spark_rapids_tpu.config import get_conf
+
+    return get_conf().get(ANSI_ENABLED)
+
+
+_ANSI_CAPTURE = threading.local()
+_ANSI_MESSAGES: dict[int, str] = {}
+_ANSI_CODES: dict[str, int] = {}
+_ansi_lock = threading.Lock()
+
+
+def ansi_code(message: str) -> int:
+    """Stable small int code for an error message (trace-time)."""
+    with _ansi_lock:
+        code = _ANSI_CODES.get(message)
+        if code is None:
+            code = _ANSI_CODES[message] = len(_ANSI_CODES) + 1
+            _ANSI_MESSAGES[code] = message
+        return code
+
+
+@contextlib.contextmanager
+def ansi_capture():
+    """Scope an ANSI flag accumulator around a traced pipeline; yields
+    the list the trace appends (code, any-flag scalar) pairs into."""
+    flags: list = []
+    prev = getattr(_ANSI_CAPTURE, "v", None)
+    _ANSI_CAPTURE.v = flags
+    try:
+        yield flags
+    finally:
+        _ANSI_CAPTURE.v = prev
+
+
+def ansi_active() -> bool:
+    """True while a capture is open (the pipeline only opens one when
+    ANSI mode is on, so expressions check this, not the conf)."""
+    return getattr(_ANSI_CAPTURE, "v", None) is not None
+
+
+def ansi_report(flag, message: str) -> None:
+    """Record a per-row error condition (traced bool array)."""
+    cap = getattr(_ANSI_CAPTURE, "v", None)
+    if cap is not None:
+        cap.append((ansi_code(message), jnp.any(flag)))
+
+
+def fold_ansi_flags(flags: list) -> jax.Array:
+    """(code, flag) pairs -> one int32 scalar (0 = no error)."""
+    err = jnp.int32(0)
+    for code, f in flags:
+        err = jnp.maximum(err, jnp.where(f, jnp.int32(code),
+                                         jnp.int32(0)))
+    return err
+
+
+def raise_if_ansi_error(err) -> None:
+    code = int(err)
+    if code:
+        raise AnsiError(
+            _ANSI_MESSAGES.get(code, f"ANSI error {code}")
+            + ". If necessary set spark.rapids.tpu.sql.ansi.enabled "
+            "to false to bypass this error.")
+
+
 class Expression:
     """Base expression. Subclasses define `dtype`, `nullable` and `eval`.
 

@@ -80,6 +80,8 @@ class Cast(Expression):
         return f"cast({self.child.name} as {self.to.name})"
 
     def eval(self, ctx: EvalContext) -> AnyColumn:
+        from spark_rapids_tpu.exprs.base import ansi_active, ansi_report
+
         self.check_supported()
         src = self.child.dtype
         dst = self.to
@@ -88,7 +90,15 @@ class Cast(Expression):
             return c
         ts, td = type(src), type(dst)
         if ts is T.StringType:
-            return _parse_integral(c, dst)
+            out = _parse_integral(c, dst)
+            if ansi_active():
+                # ANSI: malformed input RAISES instead of NULLing
+                # (ref: GpuCast ANSI matrix, GpuCast.scala:166)
+                ansi_report(
+                    c.validity & ~out.validity,
+                    f"invalid input syntax for type {dst.name} "
+                    "(ANSI cast)")
+            return out
         if td is T.StringType:
             return _integral_to_string(c, src, ctx)
         d = c.data
@@ -112,8 +122,25 @@ class Cast(Expression):
             return Column(d.astype(jnp.int64) * 1_000_000, valid, dst)
         phys = T.to_numpy_dtype(dst)
         if ts in _FLOATING and td in _INTEGRAL:
+            if ansi_active():
+                f = d.astype(jnp.float64)
+                info = jnp.iinfo(phys)
+                t = jnp.trunc(f)
+                bad = valid & (jnp.isnan(f)
+                               | (t > float(info.max))
+                               | (t < float(info.min)))
+                ansi_report(
+                    bad, f"value out of range for {dst.name} "
+                    "(ANSI cast overflow)")
             return Column(saturating_float_to_integral(d, phys), valid, dst)
-        return Column(d.astype(phys), valid, dst)
+        out_data = d.astype(phys)
+        if ansi_active() and ts in _INTEGRAL and td in _INTEGRAL \
+                and jnp.dtype(phys).itemsize < d.dtype.itemsize:
+            # narrowing truncation that loses value = ANSI overflow
+            ansi_report(valid & (out_data.astype(d.dtype) != d),
+                        f"value out of range for {dst.name} "
+                        "(ANSI cast overflow)")
+        return Column(out_data, valid, dst)
 
 
 def saturating_float_to_integral(d, phys):

@@ -293,6 +293,22 @@ class PlanMeta:
         for c in self.children:
             c.tag()
 
+    def _forbid_ansi_risky(self, e, where: str) -> None:
+        """ANSI error flags are captured only by the FUSED
+        project/filter/expand/generate pipelines; an overflow-capable
+        expression in any other position would silently keep legacy
+        semantics while the CPU engine raises — route those plans to
+        the CPU engine instead (the reference's partial-ANSI fallback
+        posture)."""
+        from spark_rapids_tpu.exprs.base import ansi_enabled
+
+        if not ansi_enabled():
+            return
+        if _tree_has_ansi_risk(e):
+            self.will_not_work(
+                f"ANSI-checked expression as {where} only runs on TPU "
+                "inside project/filter — CPU fallback")
+
     def _tag_exprs(self) -> None:
         p = self.plan
         conf = self.conf
@@ -315,6 +331,7 @@ class PlanMeta:
             for g in p.groups:
                 _check_expr(g, conf, self.reasons)
                 self._forbid_partition_aware(g, "grouping key")
+                self._forbid_ansi_risky(g, "grouping key")
             for na in p.aggs:
                 for e in na.fn.inputs():
                     self._forbid_partition_aware(e, "aggregate input")
@@ -325,15 +342,18 @@ class PlanMeta:
                     _check_agg(na.fn, conf, self.reasons)
                 for e in na.fn.inputs():
                     _check_expr(e, conf, self.reasons)
+                    self._forbid_ansi_risky(e, "aggregate input")
         elif isinstance(p, L.Sort):
             for k in p.keys:
                 _check_expr(k.expr, conf, self.reasons)
                 self._forbid_partition_aware(k.expr, "sort key")
+                self._forbid_ansi_risky(k.expr, "sort key")
         elif isinstance(p, L.Window):
             for we, _name in p.window_exprs:
                 for e in we.children:
                     _check_expr(e, conf, self.reasons)
                     self._forbid_partition_aware(e, "window input")
+                    self._forbid_ansi_risky(e, "window input")
                 try:
                     we.check_supported()
                 except TypeError as exc:
@@ -342,6 +362,7 @@ class PlanMeta:
             for e in list(p.left_keys) + list(p.right_keys):
                 _check_expr(e, conf, self.reasons)
                 self._forbid_partition_aware(e, "join key")
+                self._forbid_ansi_risky(e, "join key")
             if p.condition is not None:
                 if p.join_type != "inner":
                     self.will_not_work(
@@ -772,6 +793,24 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
         source = TpuCoalescePartitionsExec(partial)
     return TpuHashAggregateExec(p.groups, p.aggs, source, mode="final",
                                 input_schema=child_exec.schema)
+
+
+def _tree_has_ansi_risk(e) -> bool:
+    """True when the tree contains an expression whose ANSI error
+    checks only fire inside fused pipelines (integral
+    Add/Subtract/Multiply, division family, Cast)."""
+    from spark_rapids_tpu.exprs.cast import Cast as _Cast
+
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, _Cast):
+            return True
+        if isinstance(x, (A.Add, A.Subtract, A.Multiply, A.Divide,
+                          A.IntegralDivide, A.Remainder, A.Pmod)):
+            return True
+        stack.extend(x.children)
+    return False
 
 
 # ---------------------------------------------------------------------- #

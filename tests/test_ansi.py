@@ -1,0 +1,185 @@
+"""ANSI mode tests (ref: AnsiCastOpSuite + the ANSI arithmetic gating
+in arithmetic.scala / GpuCast.scala:166): with
+spark.rapids.tpu.sql.ansi.enabled, overflowing arithmetic and
+invalid/overflowing casts RAISE on BOTH engines; with it off, legacy
+wrap/NULL semantics are unchanged."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.exprs.base import AnsiError
+from spark_rapids_tpu.exprs.cast import Cast
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tpu_cpu_equal
+
+I64MAX = (1 << 63) - 1
+I64MIN = -(1 << 63)
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+@pytest.fixture
+def ansi():
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.ansi.enabled", True)
+    yield
+    conf.set("spark.rapids.tpu.sql.ansi.enabled", False)
+
+
+def _df(session, **cols):
+    return session.create_dataframe(pa.table(
+        {k: pa.array(v) for k, v in cols.items()}))
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_add_overflow_raises(session, ansi, engine):
+    df = _df(session, a=[1, I64MAX], b=[1, 1])
+    with pytest.raises(AnsiError, match="long overflow"):
+        df.select((col("a") + col("b")).alias("s")).collect(
+            engine=engine)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_subtract_overflow_raises(session, ansi, engine):
+    df = _df(session, a=[0, I64MIN], b=[5, 1])
+    with pytest.raises(AnsiError, match="long overflow"):
+        df.select((col("a") - col("b")).alias("s")).collect(
+            engine=engine)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_multiply_overflow_raises(session, ansi, engine):
+    df = _df(session, a=[2, 1 << 62], b=[3, 4])
+    with pytest.raises(AnsiError, match="long overflow"):
+        df.select((col("a") * col("b")).alias("s")).collect(
+            engine=engine)
+
+
+def test_no_overflow_passes_in_ansi(session, ansi):
+    df = _df(session, a=[1, 2, None], b=[10, 20, 30])
+    out = df.select((col("a") + col("b")).alias("s"),
+                    (col("a") * col("b")).alias("p"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_overflow_wraps_when_ansi_off(session):
+    """Legacy mode: Java wrap-around semantics, both engines agree."""
+    df = _df(session, a=[I64MAX], b=[1])
+    out = df.select((col("a") + col("b")).alias("s"))
+    assert_tpu_cpu_equal(out)
+    got = out.collect(engine="tpu").to_pydict()["s"]
+    assert got == [I64MIN]  # wrapped
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_ansi_cast_float_to_int_overflow_raises(session, ansi, engine):
+    df = _df(session, x=[1.5, 3.1e9])
+    with pytest.raises(AnsiError, match="overflow"):
+        df.select(Cast(col("x"), T.INT).alias("i")).collect(
+            engine=engine)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_ansi_cast_nan_to_int_raises(session, ansi, engine):
+    df = _df(session, x=[1.0, float("nan")])
+    with pytest.raises(AnsiError):
+        df.select(Cast(col("x"), T.LONG).alias("i")).collect(
+            engine=engine)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_ansi_cast_long_to_int_narrowing_raises(session, ansi, engine):
+    df = _df(session, x=[5, 1 << 40])
+    with pytest.raises(AnsiError, match="overflow"):
+        df.select(Cast(col("x"), T.INT).alias("i")).collect(
+            engine=engine)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_ansi_cast_malformed_string_raises(session, ansi, engine):
+    df = session.create_dataframe(pa.table(
+        {"s": pa.array(["12", "x9", "34"])}))
+    with pytest.raises(AnsiError, match="invalid input"):
+        df.select(Cast(col("s"), T.LONG).alias("i")).collect(
+            engine=engine)
+
+
+def test_legacy_cast_matches_across_engines(session):
+    """ANSI off: saturation + NULL-on-malformed, engines agree."""
+    df = _df(session, x=[1.5, 3.1e9, float("nan"), -2.9])
+    out = df.select(Cast(col("x"), T.INT).alias("i"))
+    assert_tpu_cpu_equal(out)
+    df2 = session.create_dataframe(pa.table(
+        {"s": pa.array(["12", "x9", None, "-7"])}))
+    out2 = df2.select(Cast(col("s"), T.LONG).alias("i"))
+    assert_tpu_cpu_equal(out2)
+
+
+def test_ansi_valid_casts_still_work(session, ansi):
+    df = _df(session, x=[1.0, -3.7, 2000000.2])
+    out = df.select(Cast(col("x"), T.INT).alias("i"))
+    assert_tpu_cpu_equal(out)
+    df2 = session.create_dataframe(pa.table(
+        {"s": pa.array([" 12 ", "-7", None])}))
+    out2 = df2.select(Cast(col("s"), T.LONG).alias("i"))
+    assert_tpu_cpu_equal(out2)
+
+
+def test_null_rows_never_trigger_ansi_errors(session, ansi):
+    """Error conditions on NULL inputs must not raise (valid-row
+    gating)."""
+    df = session.create_dataframe(pa.table({
+        "a": pa.array([None, 5], pa.int64()),
+        "b": pa.array([I64MAX, 7], pa.int64())}))
+    out = df.select((col("a") + col("b")).alias("s"))
+    assert_tpu_cpu_equal(out)
+    df2 = session.create_dataframe(pa.table(
+        {"s": pa.array([None, "33"])}))
+    out2 = df2.select(Cast(col("s"), T.LONG).alias("i"))
+    assert_tpu_cpu_equal(out2)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "cpu"])
+def test_ansi_divide_by_zero_raises(session, ansi, engine):
+    df = _df(session, a=[10, 7], b=[2, 0])
+    with pytest.raises(AnsiError, match="Division by zero"):
+        df.select((col("a") / col("b")).alias("q")).collect(
+            engine=engine)
+
+
+def test_divide_by_zero_nulls_when_ansi_off(session):
+    df = _df(session, a=[10, 7], b=[2, 0])
+    out = df.select((col("a") / col("b")).alias("q"))
+    assert_tpu_cpu_equal(out)
+    assert out.collect(engine="tpu").to_pydict()["q"] == [5.0, None]
+
+
+def test_ansi_risky_expr_outside_fused_positions_falls_back(session,
+                                                            ansi):
+    """Sort keys (etc.) can't capture ANSI flags on device: the
+    planner must route such plans to the CPU engine, which raises —
+    the engines never silently diverge."""
+    df = _df(session, a=[1, I64MAX], b=[3, 1])
+    q = df.order_by(col("a") + col("b"))
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    exec_, meta = plan_query(q._plan, session.conf)
+    assert not meta.can_replace or "CpuFallback" in exec_.tree_string()
+    with pytest.raises(AnsiError):
+        q.collect(engine="tpu")
+
+
+def test_ansi_long_to_int_pure_integer_check(session, ansi):
+    """Regression: a long beyond 2^53 must raise AnsiError, not a raw
+    pyarrow error from a float64 round-trip."""
+    from spark_rapids_tpu import types as T2
+
+    df = _df(session, x=[1 << 62])
+    with pytest.raises(AnsiError, match="overflow"):
+        df.select(Cast(col("x"), T2.INT).alias("i")).collect(
+            engine="cpu")
