@@ -789,12 +789,9 @@ def _cpu_ansi_div_check(_l, zero_mask) -> None:
     if not ansi_enabled():
         return
     z = zero_mask
-    try:
-        any_zero = bool(pc.any(pc.fill_null(z, False)).as_py()) \
-            if isinstance(z, (pa.Array, pa.ChunkedArray)) \
-            else bool(np.asarray(z).any())
-    except Exception:
-        any_zero = False
+    any_zero = bool(pc.any(pc.fill_null(z, False)).as_py()) \
+        if isinstance(z, (pa.Array, pa.ChunkedArray)) \
+        else bool(np.asarray(z).any())
     if any_zero:
         raise AnsiError(
             "Division by zero. If necessary set "

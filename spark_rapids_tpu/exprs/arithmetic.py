@@ -209,7 +209,18 @@ class Multiply(BinaryArithmetic):
         ansi_report(ovf, _overflow_message(phys))
 
 
-class Divide(BinaryArithmetic):
+class _DivByZeroAnsi(BinaryArithmetic):
+    """Shared ANSI division-by-zero detection for the divide family
+    (both-valid gating; Spark's right-only rule differs on the
+    (NULL, 0) corner — documented engine behavior)."""
+
+    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
+        from spark_rapids_tpu.exprs.base import ansi_report
+
+        ansi_report(valid & (rd == 0), "Division by zero")
+
+
+class Divide(_DivByZeroAnsi):
     """Double division; x/0 -> NULL per Spark non-ANSI Divide semantics."""
 
     symbol = "/"
@@ -227,14 +238,9 @@ class Divide(BinaryArithmetic):
         safe = jnp.where(zero, 1.0, rd)
         return ld / safe, valid & ~zero
 
-    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
-        from spark_rapids_tpu.exprs.base import ansi_report
-
-        ansi_report(valid & (rd == 0), "Division by zero")
 
 
-
-class IntegralDivide(BinaryArithmetic):
+class IntegralDivide(_DivByZeroAnsi):
     """`div`: long division truncated toward zero; x div 0 -> NULL."""
 
     symbol = "div"
@@ -254,14 +260,9 @@ class IntegralDivide(BinaryArithmetic):
         qi, _ = _java_divmod(ld, safe)
         return qi, valid & ~zero
 
-    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
-        from spark_rapids_tpu.exprs.base import ansi_report
-
-        ansi_report(valid & (rd == 0), "Division by zero")
 
 
-
-class Remainder(BinaryArithmetic):
+class Remainder(_DivByZeroAnsi):
     """`%` with Java semantics (sign of dividend); x % 0 -> NULL."""
 
     symbol = "%"
@@ -279,14 +280,9 @@ class Remainder(BinaryArithmetic):
         safe = jnp.where(zero, 1, rd)
         return _java_mod(ld, safe), valid & ~zero
 
-    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
-        from spark_rapids_tpu.exprs.base import ansi_report
-
-        ansi_report(valid & (rd == 0), "Division by zero")
 
 
-
-class Pmod(BinaryArithmetic):
+class Pmod(_DivByZeroAnsi):
     """Spark pmod: `r = a % n; if (r < 0) (r + n) % n else r` with Java `%`
     (ref: arithmetic.scala GpuPmod).  Note pmod(-7, -3) = -1, not 2."""
 
@@ -308,11 +304,6 @@ class Pmod(BinaryArithmetic):
         r = _java_mod(ld, safe)
         r = jnp.where(r < 0, _java_mod(r + safe, safe), r)
         return r, valid & ~zero
-
-    def _ansi_check(self, ld, rd, data, valid, phys) -> None:
-        from spark_rapids_tpu.exprs.base import ansi_report
-
-        ansi_report(valid & (rd == 0), "Division by zero")
 
 
 

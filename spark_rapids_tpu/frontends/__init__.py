@@ -1,0 +1,10 @@
+"""Frontend adapters behind the plugin's frontend seam.
+
+The reference's entire value proposition is transparently intercepting
+SOMEONE ELSE'S plans (ref: Plugin.scala:45-52 injecting into
+SparkSessionExtensions); `plugin.register_frontend` is this engine's
+equivalent seam, and each module here adapts one external plan surface
+onto plan/logical.py nodes.  `native` (the DataFrame API) registers in
+plugin.py; `substrait` registers on import."""
+
+from spark_rapids_tpu.frontends import substrait  # noqa: F401

@@ -1,0 +1,444 @@
+"""Substrait frontend: execute foreign plans on this engine.
+
+The PROOF of the frontend seam (ref: the reference's whole premise —
+Plugin.scala:45-52 intercepts plans Spark built, not plans the plugin's
+own API built): this adapter ingests the Substrait plan format
+(substrait.io — the cross-engine relational IR; its canonical JSON form
+is the protobuf JSON mapping) and lowers it onto plan/logical.py nodes,
+after which tagging, TPU conversion, and CPU fallback behave exactly as
+for native plans.  A producer like Spark/Ibis/DuckDB emits Substrait;
+this engine consumes it.
+
+Supported rels: read (namedTable over registered tables, or
+local_files parquet), filter, project, aggregate, sort, fetch, join.
+Supported expressions: field selections, literals, and the standard
+extension functions (comparison/boolean/arithmetic + sum/min/max/
+count/avg measures).  Anything else raises SubstraitError — and an
+expression that translates but is not TPU-supported falls back to the
+CPU engine through the normal planner path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs import aggregates as AG
+from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.plan import logical as L
+
+
+class SubstraitError(ValueError):
+    """Plan outside the supported Substrait subset."""
+
+
+#: substrait standard function name -> binary constructor
+_BINARY_FNS = {
+    "gt": P.GreaterThan,
+    "gte": P.GreaterThanOrEqual,
+    "lt": P.LessThan,
+    "lte": P.LessThanOrEqual,
+    "equal": P.EqualTo,
+    "add": A.Add,
+    "subtract": A.Subtract,
+    "multiply": A.Multiply,
+    "divide": A.Divide,
+    "modulus": A.Remainder,
+}
+
+_VARIADIC_BOOL = {"and": P.And, "or": P.Or}
+
+_MEASURE_FNS = {
+    "sum": AG.Sum,
+    "min": AG.Min,
+    "max": AG.Max,
+    "avg": AG.Average,
+    "count": AG.Count,
+}
+
+_LITERAL_KEYS = {
+    "boolean": T.BOOLEAN,
+    "i8": T.BYTE,
+    "i16": T.SHORT,
+    "i32": T.INT,
+    "i64": T.LONG,
+    "fp32": T.FLOAT,
+    "fp64": T.DOUBLE,
+    "string": T.STRING,
+    "date": T.DATE,
+}
+
+_TYPE_KEYS = {
+    "bool": T.BOOLEAN,
+    "i8": T.BYTE,
+    "i16": T.SHORT,
+    "i32": T.INT,
+    "i64": T.LONG,
+    "fp32": T.FLOAT,
+    "fp64": T.DOUBLE,
+    "string": T.STRING,
+    "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+    "timestampTz": T.TIMESTAMP,
+}
+
+
+class SubstraitFrontend:
+    """Session-like adapter: register tables, execute Substrait plans.
+
+    Constructed through the plugin seam:
+    `TpuPlugin.get_or_create().session("substrait")`."""
+
+    def __init__(self, conf=None):
+        from spark_rapids_tpu.session import TpuSession
+
+        self._session = TpuSession(conf)
+        self._tables: dict[str, L.LogicalPlan] = {}
+
+    # -- catalog ------------------------------------------------------- #
+
+    def register_table(self, name: str, source) -> None:
+        """`source`: pa.Table, or parquet path(s) (str / list)."""
+        import pyarrow as pa
+
+        if isinstance(source, pa.Table):
+            self._tables[name.lower()] = L.InMemoryRelation(source)
+        else:
+            paths = [source] if isinstance(source, str) else list(source)
+            df = self._session.read_parquet(*paths)
+            self._tables[name.lower()] = df._plan
+
+    # -- entry points --------------------------------------------------- #
+
+    def execute_plan(self, plan: Union[str, dict], engine=None):
+        """Substrait plan (JSON text or dict) -> pa.Table."""
+        return self.dataframe(plan).collect(engine=engine)
+
+    def dataframe(self, plan: Union[str, dict]):
+        from spark_rapids_tpu.session import DataFrame
+
+        if isinstance(plan, str):
+            plan = json.loads(plan)
+        logical = self._lower_root(plan)
+        return DataFrame(logical, self._session)
+
+    def explain(self, plan: Union[str, dict]) -> str:
+        return self.dataframe(plan).explain()
+
+    # -- plan lowering --------------------------------------------------- #
+
+    def _lower_root(self, plan: dict) -> L.LogicalPlan:
+        fns = _extension_functions(plan)
+        rels = plan.get("relations") or []
+        if len(rels) != 1:
+            raise SubstraitError(
+                f"expected exactly 1 relation, got {len(rels)}")
+        root = rels[0].get("root")
+        if root is None:
+            raise SubstraitError("relation has no root")
+        out = self._lower_rel(root["input"], fns)
+        names = root.get("names")
+        if names:
+            if len(names) != len(out.schema.fields):
+                raise SubstraitError(
+                    f"root names {names} do not match output arity "
+                    f"{len(out.schema.fields)}")
+            exprs = [B.Alias(B.BoundReference(i, f.dtype, f.nullable,
+                                              f.name), n)
+                     for i, (f, n) in enumerate(zip(out.schema.fields,
+                                                    names))]
+            out = L.Project(exprs, out)
+        return out
+
+    def _lower_rel(self, rel: dict, fns: dict) -> L.LogicalPlan:
+        common_emit = None
+        if len(rel) != 1:
+            raise SubstraitError(f"malformed rel object: {list(rel)}")
+        (kind, body), = rel.items()
+        common_emit = (body.get("common") or {}).get("emit")
+        if kind == "read":
+            out = self._lower_read(body)
+        elif kind == "filter":
+            child = self._lower_rel(body["input"], fns)
+            cond = self._expr(body["condition"], child.schema, fns)
+            out = L.Filter(cond, child)
+        elif kind == "project":
+            child = self._lower_rel(body["input"], fns)
+            new = [self._expr(e, child.schema, fns)
+                   for e in body.get("expressions", [])]
+            # substrait project OUTPUT = input fields ++ expressions
+            # (emit below then selects)
+            base = [B.BoundReference(i, f.dtype, f.nullable, f.name)
+                    for i, f in enumerate(child.schema.fields)]
+            out = L.Project(base + new, child)
+        elif kind == "aggregate":
+            child = self._lower_rel(body["input"], fns)
+            groupings = body.get("groupings", [])
+            if len(groupings) > 1:
+                raise SubstraitError("grouping sets not supported")
+            groups = [self._expr(g, child.schema, fns)
+                      for g in (groupings[0].get("groupingExpressions",
+                                                 [])
+                                if groupings else [])]
+            aggs = []
+            for i, m in enumerate(body.get("measures", [])):
+                if "filter" in m:
+                    raise SubstraitError(
+                        "measure-level FILTER is not supported")
+                fn = m.get("measure", {})
+                name = fns.get(fn.get("functionReference", 0))
+                base_name = (name or "").split(":", 1)[0]
+                ctor = _MEASURE_FNS.get(base_name)
+                if ctor is None:
+                    raise SubstraitError(
+                        f"aggregate function {name!r} not supported")
+                args = [self._expr(a["value"], child.schema, fns)
+                        for a in fn.get("arguments", [])]
+                if len(args) != 1:
+                    raise SubstraitError(
+                        f"{base_name} expects 1 argument")
+                aggs.append(AG.NamedAgg(ctor(args[0]), f"m{i}"))
+            out = L.Aggregate(groups, aggs, child)
+        elif kind == "fetch":
+            child = self._lower_rel(body["input"], fns)
+            off = int(body.get("offset", body.get("offsetExpr", {})
+                               .get("literal", {}).get("i64", 0)))
+            if off:
+                raise SubstraitError("fetch offset is not supported")
+            n = int(body.get("count", body.get("countExpr", {})
+                             .get("literal", {}).get("i64", 0)))
+            out = L.Limit(n, child)
+        elif kind == "sort":
+            from spark_rapids_tpu.execs.sort import SortKey
+
+            child = self._lower_rel(body["input"], fns)
+            keys = []
+            for s in body.get("sorts", []):
+                e = self._expr(s["expr"], child.schema, fns)
+                direction = s.get("direction",
+                                  "SORT_DIRECTION_ASC_NULLS_FIRST")
+                desc = "DESC" in direction
+                nulls_last = "NULLS_LAST" in direction
+                keys.append(SortKey(e, desc, nulls_last))
+            out = L.Sort(keys, child)
+        elif kind == "join":
+            jt = {
+                "JOIN_TYPE_INNER": "inner",
+                "JOIN_TYPE_LEFT": "left_outer",
+                "JOIN_TYPE_RIGHT": "right_outer",
+                "JOIN_TYPE_OUTER": "full_outer",
+                "JOIN_TYPE_LEFT_SEMI": "left_semi",
+                "JOIN_TYPE_LEFT_ANTI": "left_anti",
+            }.get(body.get("type"))
+            if jt is None:
+                raise SubstraitError(
+                    f"join type {body.get('type')!r} not supported")
+            left = self._lower_rel(body["left"], fns)
+            right = self._lower_rel(body["right"], fns)
+            lk, rk = _equi_keys(self._expr(
+                body["expression"],
+                _joined_schema(left.schema, right.schema), fns),
+                len(left.schema.fields))
+            out = L.Join(left, right, lk, rk, jt, None)
+        else:
+            raise SubstraitError(f"rel type {kind!r} not supported")
+        if common_emit:
+            idx = common_emit.get("outputMapping", [])
+            exprs = [B.BoundReference(i, out.schema.fields[i].dtype,
+                                      out.schema.fields[i].nullable,
+                                      out.schema.fields[i].name)
+                     for i in idx]
+            out = L.Project(exprs, out)
+        return out
+
+    def _lower_read(self, body: dict) -> L.LogicalPlan:
+        nt = body.get("namedTable")
+        if nt is not None:
+            name = ".".join(nt.get("names", [])).lower()
+            plan = self._tables.get(name)
+            if plan is None:
+                raise SubstraitError(
+                    f"table {name!r} is not registered "
+                    f"(have: {sorted(self._tables)})")
+        else:
+            lf = body.get("localFiles")
+            if lf is None:
+                raise SubstraitError(
+                    "read rel needs namedTable or localFiles")
+            paths = []
+            for item in lf.get("items", []):
+                uri = item.get("uriFile") or item.get("uriPath")
+                if not uri:
+                    raise SubstraitError("local_files item without uri")
+                fmt = [k for k in item
+                       if k.endswith(("parquet", "orc", "dwrf",
+                                      "arrow", "text"))
+                       or k in ("parquet",)]
+                if fmt and "parquet" not in fmt:
+                    raise SubstraitError(
+                        f"local_files format {fmt[0]!r} not supported "
+                        "(parquet only)")
+                paths.append(uri.removeprefix("file://"))
+            plan = self._session.read_parquet(*paths)._plan
+        schema = plan.schema
+        base_names = (body.get("baseSchema") or {}).get("names")
+        if base_names:
+            # projection by base-schema name order
+            idx = [schema.index_of(n) for n in base_names
+                   if n in schema.names]
+            if len(idx) != len(base_names):
+                missing = [n for n in base_names
+                           if n not in schema.names]
+                raise SubstraitError(
+                    f"read schema names {missing} not in table")
+            exprs = [B.BoundReference(i, schema.fields[i].dtype,
+                                      schema.fields[i].nullable,
+                                      schema.fields[i].name)
+                     for i in idx]
+            plan = L.Project(exprs, plan)
+        proj = body.get("projection")
+        if proj is not None:
+            idx = [int(r["field"]) for r in
+                   proj.get("select", {}).get("structItems", [])]
+            sch = plan.schema
+            exprs = [B.BoundReference(i, sch.fields[i].dtype,
+                                      sch.fields[i].nullable,
+                                      sch.fields[i].name) for i in idx]
+            plan = L.Project(exprs, plan)
+        return plan
+
+    # -- expressions ------------------------------------------------------ #
+
+    def _expr(self, e: dict, schema: T.Schema, fns: dict) -> B.Expression:
+        if "selection" in e:
+            ref = e["selection"].get("directReference", {})
+            sf = ref.get("structField", {})
+            i = int(sf.get("field", 0))
+            if i >= len(schema.fields):
+                raise SubstraitError(
+                    f"field reference {i} out of range "
+                    f"({len(schema.fields)} fields)")
+            f = schema.fields[i]
+            return B.BoundReference(i, f.dtype, f.nullable, f.name)
+        if "literal" in e:
+            return _literal(e["literal"])
+        if "scalarFunction" in e:
+            sf = e["scalarFunction"]
+            name = fns.get(sf.get("functionReference", 0))
+            base = (name or "").split(":", 1)[0]
+            args = [self._expr(a["value"], schema, fns)
+                    for a in sf.get("arguments", [])]
+            if base in _VARIADIC_BOOL:
+                if len(args) < 2:
+                    raise SubstraitError(f"{base} needs >= 2 args")
+                out = args[0]
+                for a in args[1:]:
+                    out = _VARIADIC_BOOL[base](out, a)
+                return out
+            ctor = _BINARY_FNS.get(base)
+            if ctor is not None:
+                if len(args) != 2:
+                    raise SubstraitError(f"{base} needs 2 args")
+                return ctor(args[0], args[1])
+            if base == "not":
+                return P.Not(args[0])
+            if base == "is_null":
+                return P.IsNull(args[0])
+            if base == "is_not_null":
+                return P.IsNotNull(args[0])
+            raise SubstraitError(
+                f"scalar function {name!r} not supported")
+        if "cast" in e:
+            from spark_rapids_tpu.exprs.cast import Cast
+
+            c = e["cast"]
+            dst = _type_of(c.get("type", {}))
+            return Cast(self._expr(c["input"], schema, fns), dst)
+        raise SubstraitError(f"expression {list(e)} not supported")
+
+
+def _extension_functions(plan: dict) -> dict:
+    fns: dict = {}
+    for ext in plan.get("extensions", []):
+        ef = ext.get("extensionFunction")
+        if ef is not None:
+            fns[ef.get("functionAnchor", 0)] = ef.get("name", "")
+    return fns
+
+
+def _literal(lit: dict) -> B.Literal:
+    for key, dtype in _LITERAL_KEYS.items():
+        if key in lit:
+            v = lit[key]
+            if dtype in (T.BYTE, T.SHORT, T.INT, T.LONG, T.DATE):
+                v = int(v)
+            elif dtype in (T.FLOAT, T.DOUBLE):
+                v = float(v)
+            return B.Literal.of(v, dtype)
+    if "null" in lit:
+        return B.Literal.of(None, _type_of(lit["null"]))
+    raise SubstraitError(f"literal {list(lit)} not supported")
+
+
+def _type_of(t: dict) -> T.DataType:
+    for key, dtype in _TYPE_KEYS.items():
+        if key in t:
+            return dtype
+    if "decimal" in t:
+        d = t["decimal"]
+        return T.DecimalType(int(d.get("precision", 10)),
+                             int(d.get("scale", 0)))
+    raise SubstraitError(f"type {list(t)} not supported")
+
+
+def _joined_schema(ls: T.Schema, rs: T.Schema) -> T.Schema:
+    return T.Schema(list(ls.fields) + list(rs.fields))
+
+
+def _equi_keys(cond: B.Expression, n_left: int):
+    """Decompose an AND-of-equalities join expression into
+    (left_keys, right_keys); anything else is unsupported."""
+    conjs = []
+    stack = [cond]
+    while stack:
+        c = stack.pop()
+        if isinstance(c, P.And):
+            stack += [c.left, c.right]
+        else:
+            conjs.append(c)
+    lk, rk = [], []
+    for c in conjs:
+        if not isinstance(c, P.EqualTo):
+            raise SubstraitError(
+                "join expression must be AND of equalities")
+        sides = []
+        for e in (c.left, c.right):
+            if not isinstance(e, B.BoundReference):
+                raise SubstraitError(
+                    "join keys must be field references")
+            sides.append(e)
+        a, b = sides
+        if a.ordinal < n_left <= b.ordinal:
+            lk.append(a)
+            rk.append(B.BoundReference(b.ordinal - n_left, b.dtype,
+                                       b.nullable, b.name))
+        elif b.ordinal < n_left <= a.ordinal:
+            lk.append(b)
+            rk.append(B.BoundReference(a.ordinal - n_left, a.dtype,
+                                       a.nullable, a.name))
+        else:
+            raise SubstraitError(
+                "join equality must reference one side each")
+    return lk, rk
+
+
+def _register() -> None:
+    from spark_rapids_tpu.plugin import register_frontend
+
+    register_frontend("substrait", SubstraitFrontend)
+
+
+_register()

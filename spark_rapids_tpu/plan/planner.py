@@ -369,6 +369,8 @@ class PlanMeta:
                         "non-inner join with residual condition")
                 else:
                     _check_expr(p.condition, conf, self.reasons)
+                    self._forbid_ansi_risky(p.condition,
+                                            "join condition")
             if not p.left_keys and p.join_type not in ("cross", "inner"):
                 # keyless inner joins run as conditional nested loops
                 # (constant-key cross); keyless outer joins fall back

@@ -51,6 +51,15 @@ def register_frontend(name: str, factory: Callable) -> None:
 
 def frontend(name: str = "native"):
     with _lock:
+        fe = _SHIMS.get(name)
+    if fe is not None:
+        return fe
+    # bundled adapters register on import; load them before giving up
+    try:
+        import spark_rapids_tpu.frontends  # noqa: F401
+    except ImportError:
+        pass
+    with _lock:
         try:
             return _SHIMS[name]
         except KeyError:
