@@ -7,10 +7,12 @@ syncs exactly two scalars to the host, which become phase 2's static
 shapes (width bucket, group-capacity bucket), so each distinct result
 shape compiles once and is reused.
 
-Single input partition only; the planner falls back to the CPU engine
-for multi-partition or mixed-aggregate plans (the reference leans on
-cudf's native ragged lists here — a merge of dense list partials is a
-future widening, ref: AggregateFunctions.scala GpuCollectList)."""
+Multi-partition plans hash-exchange on the group keys first (planner),
+making partitions KEY-DISJOINT — each reduce partition then collects
+independently on device and the union of outputs is the answer, no
+cross-partition list merge needed (the same co-partitioning argument
+the reference gets from its shuffle; ref: AggregateFunctions.scala
+GpuCollectList).  Mixed collect+scalar aggregates still fall back."""
 
 from __future__ import annotations
 
@@ -48,9 +50,14 @@ class TpuCollectAggExec(TpuExec):
                        for na in self.aggs)
         return f"TpuCollectAggExec keys=[{ks}] [{vs}]"
 
+    #: True when the child is hash-partitioned on the group keys
+    #: (key-disjoint): collect runs per partition, outputs union
+    partitioned = False
+
     @property
     def num_partitions(self) -> int:
-        return 1
+        return self.children[0].num_partitions if self.partitioned \
+            else 1
 
     def _project(self, batch: ColumnarBatch) -> ColumnarBatch:
         ctx = EvalContext.for_batch(batch)
@@ -59,6 +66,32 @@ class TpuCollectAggExec(TpuExec):
         return ColumnarBatch(cols, batch.num_rows, self._aug_schema)
 
     def execute(self) -> Iterator[ColumnarBatch]:
+        if self.partitioned:
+            # overlap per-partition host syncs/compiles with a small
+            # worker pool (the coalesce-partitions pull pattern)
+            from concurrent.futures import ThreadPoolExecutor
+
+            from spark_rapids_tpu.config import get_conf
+            from spark_rapids_tpu.execs.exchange import TASK_THREADS
+
+            n = self.num_partitions
+            workers = min(get_conf().get(TASK_THREADS), n)
+            if workers <= 1:
+                for p in range(n):
+                    yield from self.execute_partition(p)
+                return
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                futures = [pool.submit(
+                    lambda q: list(self.execute_partition(q)), p)
+                    for p in range(n)]
+                for f in futures:
+                    yield from f.result()
+            return
+        yield from self._collect(list(self.children[0].execute()),
+                                 emit_empty=True)
+
+    def _collect(self, batches: list,
+                 emit_empty: bool) -> Iterator[ColumnarBatch]:
         import jax
 
         from spark_rapids_tpu.execs.jit_cache import (
@@ -67,7 +100,8 @@ class TpuCollectAggExec(TpuExec):
         )
         from spark_rapids_tpu.ops import collect as C
 
-        batches = list(self.children[0].execute())
+        if not batches:
+            return
         big = batches[0] if len(batches) == 1 else concat_batches(batches)
         key = ("collectagg", exprs_key(self.groups),
                exprs_key([na.fn.child for na in self.aggs]),
@@ -95,12 +129,21 @@ class TpuCollectAggExec(TpuExec):
                 lambda: phase2)(sb, live_s))
         import dataclasses
 
-        out = dataclasses.replace(
-            out, num_rows=num_groups if n_keys else max(num_groups, 1))
+        n_rows = num_groups if n_keys else max(num_groups, 1)
         if n_keys and num_groups == 0:
             return  # grouped collect over empty input: no rows
+        if not n_keys and not emit_empty and num_groups == 0:
+            return  # empty partition of a partitioned grand collect
+        out = dataclasses.replace(out, num_rows=n_rows)
         yield self._count_output(out)
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        assert p == 0
-        yield from self.execute()
+        if not self.partitioned:
+            assert p == 0
+            yield from self.execute()
+            return
+        # key-disjoint partition (hash exchange upstream): independent
+        # device collect; the union across partitions is the answer
+        yield from self._collect(
+            list(self.children[0].execute_partition(p)),
+            emit_empty=False)

@@ -209,8 +209,9 @@ class SubstraitFrontend:
             if off:
                 raise SubstraitError("fetch offset is not supported")
             n = int(body.get("count", body.get("countExpr", {})
-                             .get("literal", {}).get("i64", 0)))
-            out = L.Limit(n, child)
+                             .get("literal", {}).get("i64", -1)))
+            # spec: count -1 (or absent) = all records -> no limit node
+            out = child if n < 0 else L.Limit(n, child)
         elif kind == "sort":
             from spark_rapids_tpu.execs.sort import SortKey
 
@@ -301,7 +302,7 @@ class SubstraitFrontend:
             plan = L.Project(exprs, plan)
         proj = body.get("projection")
         if proj is not None:
-            idx = [int(r["field"]) for r in
+            idx = [int(r.get("field", 0)) for r in
                    proj.get("select", {}).get("structItems", [])]
             sch = plan.schema
             exprs = [B.BoundReference(i, sch.fields[i].dtype,

@@ -755,15 +755,25 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
     has_collect = any(isinstance(na.fn, AG.CollectList)
                       for na in p.aggs)
     if has_collect:
-        # ragged results need the dedicated two-phase dense-list exec:
-        # single input partition, collect-only aggregate lists (mixed
-        # or multi-partition plans fall back — the merge of dense list
-        # partials is a future widening)
-        if child_exec.num_partitions > 1 or not all(
-                isinstance(na.fn, AG.CollectList) for na in p.aggs):
+        # ragged results need the dedicated two-phase dense-list exec;
+        # mixed collect+scalar aggregate lists still fall back
+        if not all(isinstance(na.fn, AG.CollectList) for na in p.aggs):
             return CpuFallbackExec(p, child_exec)
         from spark_rapids_tpu.execs.collect_agg import TpuCollectAggExec
 
+        if child_exec.num_partitions > 1:
+            if p.groups:
+                # hash exchange on the group keys makes partitions
+                # KEY-DISJOINT: each reduce partition collects
+                # independently, outputs union (ref: the reference's
+                # shuffle-then-aggregate shape for GpuCollectList)
+                n = get_conf().get(SHUFFLE_PARTITIONS)
+                ex = TpuShuffleExchangeExec(
+                    HashPartitioning(p.groups, n), child_exec)
+                agg = TpuCollectAggExec(p.groups, p.aggs, ex)
+                agg.partitioned = True
+                return agg
+            child_exec = TpuCoalescePartitionsExec(child_exec)
         return TpuCollectAggExec(p.groups, p.aggs, child_exec)
     if p.groups:
         # tier-2 lowering: with the collective transport active, the
