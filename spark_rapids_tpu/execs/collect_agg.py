@@ -67,25 +67,13 @@ class TpuCollectAggExec(TpuExec):
 
     def execute(self) -> Iterator[ColumnarBatch]:
         if self.partitioned:
-            # overlap per-partition host syncs/compiles with a small
-            # worker pool (the coalesce-partitions pull pattern)
-            from concurrent.futures import ThreadPoolExecutor
-
-            from spark_rapids_tpu.config import get_conf
-            from spark_rapids_tpu.execs.exchange import TASK_THREADS
-
-            n = self.num_partitions
-            workers = min(get_conf().get(TASK_THREADS), n)
-            if workers <= 1:
-                for p in range(n):
-                    yield from self.execute_partition(p)
-                return
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                futures = [pool.submit(
-                    lambda q: list(self.execute_partition(q)), p)
-                    for p in range(n)]
-                for f in futures:
-                    yield from f.result()
+            # sequential per-partition collects: each two-phase
+            # program can approach the device budget, so concurrent
+            # partitions without the semaphore/backpressure machinery
+            # of TpuCoalescePartitionsExec would OOM exactly when the
+            # out-of-core path matters most
+            for p in range(self.num_partitions):
+                yield from self.execute_partition(p)
             return
         yield from self._collect(list(self.children[0].execute()),
                                  emit_empty=True)

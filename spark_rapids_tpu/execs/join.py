@@ -46,11 +46,18 @@ from spark_rapids_tpu.exprs.base import (
     Expression,
     bind_references,
 )
+from spark_rapids_tpu.config import get_conf, register
 from spark_rapids_tpu.ops.join import (
     expand_pairs,
     gather_joined,
     join_state,
 )
+
+JOIN_OUTPUT_CHUNK_ROWS = register(
+    "spark.rapids.tpu.sql.join.outputChunkRows", 1 << 22,
+    "Join output is produced in spillable chunks of at most this many "
+    "rows per stream batch instead of one data-dependent gather (the "
+    "JoinGatherer target-size chunking, ref: JoinGatherer.scala:55).")
 
 JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
               "left_semi", "left_anti", "cross")
@@ -176,8 +183,12 @@ class _HashJoinBase(TpuExec):
         total = jnp.sum(st.cnt_s).astype(jnp.int32)
         return st, total
 
-    def _expand(self, build, stream, st, num_rows, out_cap: int):
-        s_idx, b_idx, pair_live, matched = expand_pairs(st, out_cap)
+    def _expand(self, build, stream, st, total, offset, out_cap: int):
+        s_idx, b_idx, pair_live, matched = expand_pairs(st, out_cap,
+                                                        offset)
+        num_rows = jnp.clip(
+            jnp.asarray(total, jnp.int32)
+            - jnp.asarray(offset, jnp.int32), 0, out_cap)
         stream_first = self.build_is_right
         return gather_joined(build, stream, s_idx, b_idx, pair_live,
                              matched, num_rows, self._schema,
@@ -261,6 +272,7 @@ class _HashJoinBase(TpuExec):
         for stream in stream_batches:
             self.metrics["probeBatches"].add(1)
             out = None
+            n_total = 0
             with MetricTimer(self.metrics[TOTAL_TIME]):
                 stream = stream.with_device_num_rows()
                 st, total = jit_probe(build, stream)
@@ -274,13 +286,25 @@ class _HashJoinBase(TpuExec):
                     out = jit_semi_compact(stream, keep)
                 else:
                     n_total = int(jax.device_get(total))
-                    if n_total:
-                        out_cap = pad_capacity(n_total)
-                        out = self._jit_expand(out_cap)(build, stream, st,
-                                                        total)
-                        if self.condition is not None:
-                            out = self._jit_condition(out)
             if out is not None:
+                yield self._count_output(out)
+                continue
+            if not n_total:
+                continue
+            chunk = get_conf().get(JOIN_OUTPUT_CHUNK_ROWS)
+            out_cap = pad_capacity(min(n_total, chunk))
+            # target-size chunks, spillable between yields (ref:
+            # JoinGatherer.scala:55,138 — output in bounded gathers,
+            # never one giant batch).  Each chunk's compute gets its
+            # own timed region so consumer time between yields never
+            # lands in this operator's clock.
+            for off in range(0, n_total, out_cap):
+                with MetricTimer(self.metrics[TOTAL_TIME]):
+                    out = self._jit_expand(out_cap)(
+                        build, stream, st, total,
+                        jnp.asarray(off, jnp.int32))
+                    if self.condition is not None:
+                        out = self._jit_condition(out)
                 yield self._count_output(out)
 
         if self.join_type == "full_outer":

@@ -9,10 +9,14 @@ derives from shared segmented-scan primitives (ops.window) inside one
 fused program.  Output rows are in sorted order (row order of a window
 exec's output is unspecified in SQL, as in Spark).
 
-The exec consumes its whole input as one batch (spill-registered while
-collecting, like the sort exec).  Per-partition streaming arrives with
-hash-partitioned exchanges over partition_by.
-"""
+Out-of-core scaling (ref: GpuWindowExec streaming): with a
+partition_by, the planner inserts a hash exchange over the partition
+keys and sets `partitioned` — window groups are then co-located per
+reduce partition and each partition windows independently, bounding
+memory to the largest reduce partition instead of the whole input.
+Without partition keys (or on single-partition children) the exec
+consumes its input as one batch (spill-registered while collecting,
+like the sort exec)."""
 
 from __future__ import annotations
 
@@ -60,13 +64,23 @@ class TpuWindowExec(TpuExec):
             + [T.Field(name, we.dtype, we.nullable)
                for we, name in self.named])
 
+    #: True when the child is hash-partitioned on partition_by: window
+    #: groups are partition-local, so each partition windows alone
+    partitioned = False
+
     @property
     def schema(self) -> T.Schema:
         return self._schema
 
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions if self.partitioned \
+            else 1
+
     def node_desc(self) -> str:
         fns = ", ".join(f"{we.fn.describe()}->{n}" for we, n in self.named)
-        return f"TpuWindowExec [{fns}] over ({self.spec.describe()})"
+        tag = " [per-partition]" if self.partitioned else ""
+        return f"TpuWindowExec [{fns}] over ({self.spec.describe()})" + tag
 
     # -- traceable window program --------------------------------------- #
 
@@ -210,14 +224,14 @@ class TpuWindowExec(TpuExec):
                 tuple((expr_key_fn(we), n) for we, n in self.named),
                 repr(self._schema))
 
-    def execute(self) -> Iterator[ColumnarBatch]:
+    def _window_source(self, source) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.execs.jit_cache import cached_jit
         from spark_rapids_tpu.memory import SpillPriorities, get_store
 
         store = get_store()
         handles = []
         try:
-            for b in self.children[0].execute():
+            for b in source:
                 handles.append(store.register(
                     b, SpillPriorities.COALESCE_PENDING))
             if not handles:
@@ -228,10 +242,29 @@ class TpuWindowExec(TpuExec):
         finally:
             for h in handles:
                 h.close()
+        if big.concrete_num_rows() == 0 and self.partitioned:
+            return  # empty reduce partition
         fn = cached_jit(self._cache_key(), lambda: self._window_batch)
         with MetricTimer(self.metrics[TOTAL_TIME]):
             out = fn(big.with_device_num_rows())
         yield self._count_output(out)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if not self.partitioned:
+            assert p == 0
+            yield from self.execute()
+            return
+        # hash exchange upstream co-located each window group in one
+        # reduce partition: window it independently (bounded memory)
+        yield from self._window_source(
+            self.children[0].execute_partition(p))
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        if not self.partitioned:
+            yield from self._window_source(self.children[0].execute())
+            return
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
 
 
 def expr_key_fn(we: WindowExpression) -> tuple:

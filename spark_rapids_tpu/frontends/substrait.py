@@ -208,9 +208,11 @@ class SubstraitFrontend:
                                .get("literal", {}).get("i64", 0)))
             if off:
                 raise SubstraitError("fetch offset is not supported")
+            # spec: count -1 = all records (always serialized since
+            # it is non-default); an ABSENT count is proto3's omitted
+            # zero -> LIMIT 0
             n = int(body.get("count", body.get("countExpr", {})
-                             .get("literal", {}).get("i64", -1)))
-            # spec: count -1 (or absent) = all records -> no limit node
+                             .get("literal", {}).get("i64", 0)))
             out = child if n < 0 else L.Limit(n, child)
         elif kind == "sort":
             from spark_rapids_tpu.execs.sort import SortKey

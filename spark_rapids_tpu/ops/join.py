@@ -157,12 +157,16 @@ def join_state(build: ColumnarBatch, stream: ColumnarBatch,
                      matched_b=matched_b, live_b=live_b)
 
 
-def expand_pairs(state: JoinState, out_cap: int
+def expand_pairs(state: JoinState, out_cap: int, offset=0
                  ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Produce (stream_idx, build_idx, pair_live, build_matched) arrays
-    of static length out_cap for the first out_cap output pairs."""
+    of static length out_cap for output pairs [offset, offset+out_cap)
+    — the JoinGatherer chunk window (ref: JoinGatherer.scala:55
+    gatherNext(n)); offset may be a traced scalar so ONE compiled
+    program serves every chunk."""
     total = jnp.sum(state.cnt_s).astype(jnp.int32)
-    i = jnp.arange(out_cap, dtype=jnp.int32)
+    i = jnp.arange(out_cap, dtype=jnp.int32) + jnp.asarray(
+        offset, jnp.int32)
     s = jnp.searchsorted(state.cum_excl, i, side="right").astype(
         jnp.int32) - 1
     s = jnp.clip(s, 0, state.cum_excl.shape[0] - 1)

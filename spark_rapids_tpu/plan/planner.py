@@ -524,6 +524,28 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     if isinstance(p, L.Window):
         from spark_rapids_tpu.execs.window import TpuWindowExec
 
+        part_by = p.window_exprs[0][0].spec.partition_by
+        if part_by and kids[0].num_partitions > 1:
+            # out-of-core: hash exchange on the partition keys makes
+            # window groups partition-local, each reduce partition
+            # windows independently (ref: GpuWindowExec's required
+            # child distribution = ClusteredDistribution(partitionBy));
+            # EnsureRequirements: an already-satisfying distribution
+            # (e.g. a final aggregate keyed the same) skips the shuffle
+            from spark_rapids_tpu.execs.exchange import (
+                SHUFFLE_PARTITIONS,
+                TpuShuffleExchangeExec,
+            )
+            from spark_rapids_tpu.ops.partition import HashPartitioning
+
+            source = kids[0]
+            if _hash_satisfies(source, list(part_by)) is None:
+                n = get_conf().get(SHUFFLE_PARTITIONS)
+                source = TpuShuffleExchangeExec(
+                    HashPartitioning(list(part_by), n), source)
+            w = TpuWindowExec(p.window_exprs, source)
+            w.partitioned = True
+            return w
         return TpuWindowExec(p.window_exprs, kids[0])
     if isinstance(p, L.Limit):
         if kids[0].num_partitions > 1:
@@ -766,11 +788,14 @@ def _plan_aggregate(p: L.Aggregate, child_exec: TpuExec) -> TpuExec:
                 # hash exchange on the group keys makes partitions
                 # KEY-DISJOINT: each reduce partition collects
                 # independently, outputs union (ref: the reference's
-                # shuffle-then-aggregate shape for GpuCollectList)
-                n = get_conf().get(SHUFFLE_PARTITIONS)
-                ex = TpuShuffleExchangeExec(
-                    HashPartitioning(p.groups, n), child_exec)
-                agg = TpuCollectAggExec(p.groups, p.aggs, ex)
+                # shuffle-then-aggregate shape for GpuCollectList);
+                # a child already distributed by the keys skips it
+                source = child_exec
+                if _hash_satisfies(source, list(p.groups)) is None:
+                    n = get_conf().get(SHUFFLE_PARTITIONS)
+                    source = TpuShuffleExchangeExec(
+                        HashPartitioning(p.groups, n), source)
+                agg = TpuCollectAggExec(p.groups, p.aggs, source)
                 agg.partitioned = True
                 return agg
             child_exec = TpuCoalescePartitionsExec(child_exec)

@@ -1,0 +1,120 @@
+"""Out-of-core window + chunked join output (VERDICT r3 #5): window and
+join must survive inputs far larger than one working batch — window via
+the hash exchange on partition_by (per-reduce-partition windowing,
+ref: GpuWindowExec's ClusteredDistribution requirement), join via
+target-size output chunks (ref: JoinGatherer.scala:55,138)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _multifile(tmp_path, t, n_files, stem):
+    paths = []
+    per = t.num_rows // n_files
+    for i in range(n_files):
+        p = str(tmp_path / f"{stem}{i}.parquet")
+        pq.write_table(t.slice(i * per, per if i < n_files - 1
+                               else t.num_rows - i * per), p)
+        paths.append(p)
+    return paths
+
+
+def test_window_partitioned_streaming(session, tmp_path):
+    """Multi-partition child: the planner exchanges on partition_by and
+    windows per reduce partition — the plan shows the exchange and the
+    result matches the CPU oracle."""
+    from spark_rapids_tpu.exprs.window import Window, row_number
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    rng = np.random.default_rng(3)
+    n = 6000
+    t = pa.table({
+        "k": rng.integers(0, 40, n),
+        "o": rng.integers(0, 1000, n),
+        "v": rng.random(n),
+    })
+    paths = _multifile(tmp_path, t, 6, "w")
+    get_conf().set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1024)
+    spec = Window.partition_by("k").order_by("o", "v")
+    df = session.read_parquet(*paths).select(
+        col("k"), col("o"), col("v"),
+        row_number().over(spec).alias("rn"))
+    exec_, _ = plan_query(df._plan, session.conf)
+    tree = exec_.tree_string()
+    assert "per-partition" in tree and "TpuShuffleExchangeExec" in tree, \
+        tree
+    assert_tpu_cpu_equal(df, approx_float=True)
+
+
+def test_window_10x_budget(session, tmp_path):
+    """Input ~10x one scan batch: per-partition windowing keeps every
+    program bounded to a reduce partition."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+    from spark_rapids_tpu.exprs.window import Window
+    from spark_rapids_tpu.session import sum_
+
+    rng = np.random.default_rng(5)
+    n = 8000
+    t = pa.table({
+        "k": rng.integers(0, 16, n),
+        "o": rng.integers(0, 100, n),
+        "v": rng.integers(0, 50, n),
+    })
+    paths = _multifile(tmp_path, t, 8, "x")
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 800)  # ~10 batches of input
+    conf.set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1024)
+    spec = Window.partition_by("k").order_by("o")
+    df = session.read_parquet(*paths).select(
+        col("k"),
+        sum_(col("v")).over(spec).alias("s"))
+    assert_tpu_cpu_equal(df, approx_float=True)
+
+
+def test_join_output_chunking_exact(session):
+    """Join output larger than the chunk size arrives in multiple
+    bounded batches with exactly the right rows (forced tiny chunks)."""
+    rng = np.random.default_rng(7)
+    left = pa.table({"k": rng.integers(0, 5, 400),
+                     "lv": np.arange(400)})
+    right = pa.table({"k": rng.integers(0, 5, 50),
+                      "rv": np.arange(50)})
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.join.outputChunkRows", 256)
+    df = session.create_dataframe(left).join(
+        session.create_dataframe(right), on="k", how="inner")
+    got = df.collect(engine="tpu")
+    # expected join cardinality ~ 400*50/5 = 4000 rows >> 256-row chunks
+    want = df.collect(engine="cpu")
+    assert got.num_rows == want.num_rows
+    assert sorted(zip(*got.to_pydict().values())) == \
+        sorted(zip(*want.to_pydict().values()))
+
+
+def test_join_chunking_with_condition_and_outer(session):
+    rng = np.random.default_rng(9)
+    from spark_rapids_tpu.exprs.base import lit
+
+    left = pa.table({"k": rng.integers(0, 4, 300),
+                     "lv": rng.integers(0, 100, 300)})
+    right = pa.table({"k": rng.integers(0, 6, 60),
+                      "rv": rng.integers(0, 100, 60)})
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.join.outputChunkRows", 128)
+    ldf, rdf = (session.create_dataframe(x) for x in (left, right))
+    df = ldf.join(rdf, on="k", how="left_outer")
+    assert_tpu_cpu_equal(df)
+    df2 = ldf.join(rdf, on="k", how="inner",
+                   condition=col("lv") > col("rv"))
+    assert_tpu_cpu_equal(df2)
