@@ -124,13 +124,19 @@ def _bench_q1(session, d: str) -> dict:
     PJRT links every dispatch pays full round-trip latency."""
     from spark_rapids_tpu.config import get_conf
 
-    get_conf().set("spark.rapids.tpu.sql.shuffle.partitions", 1)
-    q1_files = make_lineitem(os.path.join(d, "q1"), n_files=2,
-                             with_q1_cols=True)
-    df = q1_dataframe(session, q1_files)
-    df.collect(engine="tpu")  # warmup
-    tpu_t, tpu_r = _time_collect(df, "tpu", 3)
-    cpu_t, cpu_r = _time_collect(df, "cpu", 2)
+    conf = get_conf()
+    key = "spark.rapids.tpu.sql.shuffle.partitions"
+    old_sp = conf.get(key)
+    conf.set(key, 1)
+    try:
+        q1_files = make_lineitem(os.path.join(d, "q1"), n_files=2,
+                                 with_q1_cols=True)
+        df = q1_dataframe(session, q1_files)
+        df.collect(engine="tpu")  # warmup
+        tpu_t, tpu_r = _time_collect(df, "tpu", 3)
+        cpu_t, cpu_r = _time_collect(df, "cpu", 2)
+    finally:
+        conf.set(key, old_sp)
     got = sorted(zip(*tpu_r.to_pydict().values()))
     want = sorted(zip(*cpu_r.to_pydict().values()))
     assert len(got) == len(want), (len(got), len(want))

@@ -21,6 +21,7 @@ from spark_rapids_tpu.columnar.arrow import schema_to_arrow
 from spark_rapids_tpu.exprs import arithmetic as A
 from spark_rapids_tpu.exprs import bitwise as BW
 from spark_rapids_tpu.exprs import datetime as DT
+from spark_rapids_tpu.exprs import decimal as DEC
 from spark_rapids_tpu.exprs import math as M
 from spark_rapids_tpu.exprs import predicates as P
 from spark_rapids_tpu.exprs import strings as S
@@ -269,6 +270,11 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
             else:
                 out.append(False)
         return pa.array(out, pa.bool_())
+    from spark_rapids_tpu.exprs import nondeterministic as ND
+
+    if isinstance(e, ND.InputFileName):
+        # no file context on this path: Spark's documented defaults
+        return pa.array([e.DEFAULT] * n, T.to_arrow_type(e.dtype))
     if isinstance(e, B.BoundReference):
         return table.column(e.ordinal).combine_chunks()
     if isinstance(e, B.ColumnReference):
@@ -699,6 +705,56 @@ def _dispatch_extended(e, table, n):  # noqa: C901
         last = (m + 1).astype("datetime64[D]") - 1
         return _from_np(last.astype(np.int32), ok,
                         pa.int32()).cast(pa.date32())
+    if isinstance(e, DT.TimeAdd):  # TimeSub subclasses TimeAdd
+        c = cpu_eval(e.child, table)
+        v, ok = _np_vals(c.cast(pa.int64()), pa.int64())
+        if e.interval.months:
+            # calendar month arithmetic (day-of-month clamped to the
+            # target month's end, Spark's add_months rule) — the case
+            # the device path rejects and this fallback exists for
+            out = np.array([_add_interval_us(
+                int(x), e.interval.months * e._sign,
+                e.interval.days * e._sign,
+                e.interval.microseconds * e._sign) for x in v],
+                np.int64)
+            return _from_np(out, ok, pa.int64()).cast(
+                T.to_arrow_type(T.TIMESTAMP))
+        delta = (e.interval.days * 86_400_000_000
+                 + e.interval.microseconds) * e._sign
+        return _from_np(v + delta, ok, pa.int64()).cast(
+            T.to_arrow_type(T.TIMESTAMP))
+    if isinstance(e, DT.DateAddInterval):
+        c = cpu_eval(e.child, table)
+        v, ok = _np_vals(c.cast(pa.int32()), pa.int32())
+        if e.interval.months:
+            us_day = 86_400_000_000
+            out = np.array([
+                _add_interval_us(int(x) * us_day, e.interval.months,
+                                 e.interval.days,
+                                 e.interval.microseconds) // us_day
+                for x in v], np.int32)
+            return _from_np(out, ok, pa.int32()).cast(pa.date32())
+        days = e.interval.days + int(
+            e.interval.microseconds / 86_400_000_000)
+        return _from_np((v + days).astype(np.int32), ok,
+                        pa.int32()).cast(pa.date32())
+    if isinstance(e, DEC.UnscaledValue):
+        import decimal as _dec
+
+        c = cpu_eval(e.child, table)
+        scale = e.child.dtype.scale
+        out = [None if v is None else int(v.scaleb(scale))
+               for v in c.to_pylist()]
+        return pa.array(out, pa.int64())
+    if isinstance(e, DEC.MakeDecimal):
+        import decimal as _dec
+
+        c = cpu_eval(e.child, table)
+        bound = 10 ** e.precision
+        out = [None if (v is None or not (-bound < v < bound))
+               else _dec.Decimal(int(v)).scaleb(-e.scale)
+               for v in c.cast(pa.int64()).to_pylist()]
+        return pa.array(out, T.to_arrow_type(e.dtype))
     if isinstance(e, (DT.DateAdd, DT.DateSub)):
         l = cpu_eval(e.left, table).cast(pa.int32())
         r = cpu_eval(e.right, table).cast(pa.int32())
@@ -1690,3 +1746,24 @@ def _join_cpu(plan: L.Join) -> pa.Table:
         mask = pc.fill_null(cpu_eval(plan.condition, out), False)
         out = out.filter(mask)
     return out.cast(schema_to_arrow(plan.schema))
+
+
+def _add_interval_us(us: int, months: int, days: int,
+                     microseconds: int) -> int:
+    """Epoch-us + calendar interval with Spark's add_months rule:
+    month arithmetic clamps day-of-month to the target month's end;
+    days/microseconds add after."""
+    import calendar
+    import datetime
+
+    utc = datetime.timezone.utc
+    dt = (datetime.datetime(1970, 1, 1, tzinfo=utc)
+          + datetime.timedelta(microseconds=us))
+    m0 = dt.month - 1 + months
+    y = dt.year + m0 // 12
+    m = m0 % 12 + 1
+    day = min(dt.day, calendar.monthrange(y, m)[1])
+    dt = dt.replace(year=y, month=m, day=day)
+    dt += datetime.timedelta(days=days, microseconds=microseconds)
+    return int((dt - datetime.datetime(1970, 1, 1, tzinfo=utc))
+               / datetime.timedelta(microseconds=1))

@@ -350,3 +350,110 @@ class DateFormatClass(Expression):
         return StringColumn(
             chars, jnp.full((ctx.batch.capacity,), out_len, jnp.int32),
             c.validity & ctx.row_mask)
+
+
+@dataclasses.dataclass(repr=False)
+class CalendarInterval:
+    """A literal calendar interval (months, days, microseconds) — the
+    Spark CalendarIntervalType value TimeAdd/DateAddInterval consume
+    (ref: TimeSub/TimeAdd in datetimeExpressions.scala)."""
+
+    months: int = 0
+    days: int = 0
+    microseconds: int = 0
+
+
+@dataclasses.dataclass(repr=False)
+class TimeAdd(Expression):
+    """timestamp + interval (ref: GpuTimeAdd/GpuTimeSub,
+    datetimeExpressions.scala).  Month components are calendar-
+    dependent and fall back (matching the reference, which rejects
+    intervals with months)."""
+
+    child: Expression
+    interval: CalendarInterval
+    _sign = 1
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.TIMESTAMP
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    @property
+    def name(self) -> str:
+        iv = self.interval
+        return (f"{self.child.name} + interval({iv.months}m "
+                f"{iv.days}d {iv.microseconds}us)")
+
+    @property
+    def children(self) -> tuple:
+        return (self.child,)
+
+    def with_children(self, children):
+        out = type(self)(children[0], self.interval)
+        return out
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.TimestampType):
+            raise TypeError("TimeAdd needs a timestamp input")
+        if self.interval.months:
+            raise TypeError(
+                "interval months are calendar-dependent — CPU fallback "
+                "(the reference rejects them too)")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        delta = (self.interval.days * US_PER_DAY
+                 + self.interval.microseconds) * self._sign
+        return Column(c.data.astype(jnp.int64) + jnp.int64(delta),
+                      c.validity, T.TIMESTAMP)
+
+
+class TimeSub(TimeAdd):
+    _sign = -1
+
+
+@dataclasses.dataclass(repr=False)
+class DateAddInterval(Expression):
+    """date + interval -> DATE (ref: GpuDateAddInterval,
+    datetimeExpressions.scala: microseconds must be a whole number of
+    days in practice; Spark truncates toward zero)."""
+
+    child: Expression
+    interval: CalendarInterval
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DATE
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    @property
+    def name(self) -> str:
+        iv = self.interval
+        return f"{self.child.name} + interval({iv.days}d)"
+
+    @property
+    def children(self) -> tuple:
+        return (self.child,)
+
+    def with_children(self, children):
+        return DateAddInterval(children[0], self.interval)
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.DateType):
+            raise TypeError("DateAddInterval needs a date input")
+        if self.interval.months:
+            raise TypeError("interval months fall back")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        days = self.interval.days + int(
+            self.interval.microseconds / US_PER_DAY)
+        return Column(c.data.astype(jnp.int32) + jnp.int32(days),
+                      c.validity, T.DATE)

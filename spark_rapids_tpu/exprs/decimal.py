@@ -117,3 +117,77 @@ class CheckOverflow(Expression):
             bound = jnp.int64(10 ** self.target.precision)
             ok = (data > -bound) & (data < bound)
         return Column(data, c.validity & ok, self.target)
+
+
+@dataclasses.dataclass(repr=False)
+class UnscaledValue(Expression):
+    """decimal -> LONG unscaled backing value (ref:
+    decimalExpressions.scala GpuUnscaledValue) — zero-copy here: the
+    device representation IS the unscaled int64."""
+
+    child: Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    @property
+    def name(self) -> str:
+        return f"unscaled({self.child.name})"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.DecimalType):
+            raise TypeError("UnscaledValue needs a decimal input")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        return Column(c.data.astype(jnp.int64), c.validity, T.LONG)
+
+
+@dataclasses.dataclass(repr=False)
+class MakeDecimal(Expression):
+    """LONG unscaled -> decimal(p, s) (ref: GpuMakeDecimal): values
+    beyond the declared precision become NULL (nullOnOverflow)."""
+
+    child: Expression
+    precision: int
+    scale: int
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DecimalType(self.precision, self.scale)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return (f"make_decimal({self.child.name}, "
+                f"{self.precision}, {self.scale})")
+
+    @property
+    def children(self) -> tuple:
+        return (self.child,)
+
+    def with_children(self, children):
+        return MakeDecimal(children[0], self.precision, self.scale)
+
+    def check_supported(self) -> None:
+        from spark_rapids_tpu import types as _T
+
+        if not isinstance(self.child.dtype, _T.IntegralType):
+            raise TypeError("MakeDecimal needs an integral input")
+        if self.precision > T.DecimalType.MAX_PRECISION:
+            raise TypeError("decimal precision beyond int64 falls back")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        data = c.data.astype(jnp.int64)
+        bound = jnp.int64(10 ** self.precision)
+        ok = (data > -bound) & (data < bound)
+        return Column(data, c.validity & ok, self.dtype)

@@ -118,3 +118,72 @@ class Rand(Expression, PartitionAware):
                              jnp.asarray(ctx.partition_index, jnp.int32),
                              idx)
         return Column(vals, ctx.row_mask, T.DOUBLE)
+
+
+@dataclasses.dataclass(repr=False)
+class InputFileName(Expression):
+    """input_file_name() (ref: GpuInputFileName, GpuOverrides.scala).
+
+    The planner rewrites this to a hidden per-file constant column the
+    scan appends (the ColumnarPartitionReaderWithPartitionValues
+    mechanism); un-rewritten occurrences (no file scan below, or a
+    widening operator between) evaluate to Spark's no-context default
+    on the CPU engine."""
+
+    #: Spark's value when no file context exists
+    DEFAULT = ""
+    HIDDEN = "__input_file_name"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def name(self) -> str:
+        return "input_file_name()"
+
+    def check_supported(self) -> None:
+        raise TypeError(
+            "input_file_name() is only supported directly above a file "
+            "scan (project/filter chain) — CPU fallback")
+
+    def eval(self, ctx: EvalContext):
+        raise AssertionError("rewritten by the planner before eval")
+
+
+@dataclasses.dataclass(repr=False)
+class InputFileBlockStart(InputFileName):
+    """input_file_block_start() (ref: GpuInputFileBlockStart): whole
+    files are read as one split, so the start is 0."""
+
+    DEFAULT = -1
+    HIDDEN = "__input_file_block_start"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def name(self) -> str:
+        return "input_file_block_start()"
+
+
+@dataclasses.dataclass(repr=False)
+class InputFileBlockLength(InputFileName):
+    """input_file_block_length() (ref: GpuInputFileBlockLength): the
+    split is the whole file, so the length is the file size."""
+
+    DEFAULT = -1
+    HIDDEN = "__input_file_block_length"
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def name(self) -> str:
+        return "input_file_block_length()"

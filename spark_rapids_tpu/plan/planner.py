@@ -92,7 +92,9 @@ for _sig, _classes in (
     (TS.ExprSig(TS.COMMON_N), (Murmur3Hash,)),
     (TS.ExprSig(TS.STRING + TS.NULLSIG), (Md5,)),
     (TS.ExprSig(TS.DECIMAL + TS.NULLSIG),
-     (DEC.PromotePrecision, DEC.CheckOverflow)),
+     (DEC.PromotePrecision, DEC.CheckOverflow, DEC.UnscaledValue)),
+    (TS.ExprSig(TS.INTEGRAL + TS.DECIMAL + TS.NULLSIG),
+     (DEC.MakeDecimal,)),
     (_MATH, (M.Sqrt, M.Cbrt, M.Exp, M.Expm1, M.Sin, M.Cos, M.Tan, M.Cot,
              M.Asin, M.Acos, M.Atan, M.Sinh, M.Cosh, M.Tanh, M.Asinh,
              M.Acosh, M.Atanh, M.Rint, M.Signum, M.ToDegrees,
@@ -107,7 +109,8 @@ for _sig, _classes in (
     (_DT, (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
            DT.DayOfYear, DT.Quarter, DT.LastDay, DT.Hour, DT.Minute,
            DT.Second, DT.DateAdd, DT.DateSub, DT.DateDiff,
-           DT.UnixTimestampFromTs, DT.DateFormatClass)),
+           DT.UnixTimestampFromTs, DT.DateFormatClass, DT.TimeAdd,
+           DT.TimeSub, DT.DateAddInterval)),
     (TS.ExprSig(TS.INTEGRAL + TS.NULLSIG,
                 "epoch seconds input"), (DT.FromUnixTime,)),
     (_STR, (S.Length, S.Upper, S.Lower, S.StartsWith, S.EndsWith,
@@ -142,6 +145,11 @@ for _cls in (CX.GetStructField, CX.CreateNamedStruct, CX.GetMapValue,
 from spark_rapids_tpu.exprs import nondeterministic as ND  # noqa: E402
 
 register_expr(ND.SparkPartitionID, TS.ExprSig(TS.ALL, "no inputs"))
+for _cls in (ND.InputFileName, ND.InputFileBlockStart,
+             ND.InputFileBlockLength):
+    register_expr(_cls, TS.ExprSig(
+        TS.ALL, "rewritten to hidden scan columns above file scans; "
+        "other positions fall back (Spark default values)"))
 register_expr(ND.MonotonicallyIncreasingID,
               TS.ExprSig(TS.ALL, "no inputs"))
 register_expr(ND.Rand, TS.ExprSig(TS.ALL, "no inputs"))
@@ -854,6 +862,117 @@ def _tree_has_ansi_risk(e) -> bool:
 # Entry points
 # ---------------------------------------------------------------------- #
 
+def _rewrite_input_file_exprs(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Prepass: InputFileName/BlockStart/BlockLength become hidden
+    per-file constant columns appended by the scan (the reference's
+    ColumnarPartitionReaderWithPartitionValues mechanism), provided the
+    path from the expression down to a file relation crosses only
+    Project/Filter nodes.  Anything else is left in place: the
+    expression's check_supported then routes the subtree to the CPU
+    engine, which evaluates Spark's no-file-context defaults."""
+    import copy as _copy
+    import os
+
+    from spark_rapids_tpu.exprs.nondeterministic import InputFileName
+
+    def tree_has(e) -> bool:
+        stack = [e]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, InputFileName):
+                return True
+            stack.extend(x.children)
+        return False
+
+    def node_exprs(p):
+        if isinstance(p, L.Project):
+            return p.exprs
+        if isinstance(p, L.Filter):
+            return [p.condition]
+        return []
+
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        InputFileBlockLength,
+        InputFileBlockStart,
+    )
+
+    def augment_relation(rel: L.LogicalPlan) -> L.LogicalPlan:
+        rel2 = _copy.copy(rel)
+        hidden = [T.Field(InputFileName.HIDDEN, T.STRING, False),
+                  T.Field(InputFileBlockStart.HIDDEN, T.LONG, False),
+                  T.Field(InputFileBlockLength.HIDDEN, T.LONG, False)]
+        pvs = []
+        for i, path in enumerate(rel.paths):
+            pv = dict(rel.partition_values[i]
+                      if i < len(rel.partition_values) else {})
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            pv[InputFileName.HIDDEN] = path
+            pv[InputFileBlockStart.HIDDEN] = 0
+            pv[InputFileBlockLength.HIDDEN] = size
+            pvs.append(pv)
+        rel2.partition_values = pvs
+        rel2.partition_fields = list(rel.partition_fields) + hidden
+        rel2._schema = T.Schema(list(rel.schema.fields) + hidden)
+        return rel2
+
+    def augment_chain(p: L.LogicalPlan):
+        """Rebuild the Project/Filter chain below `p` over an augmented
+        relation; returns the new child or None (unsupported shape)."""
+        if isinstance(p, (L.ParquetRelation, L.OrcRelation,
+                          L.CsvRelation)):
+            return augment_relation(p)
+        if isinstance(p, L.Project):
+            child = augment_chain(p.children[0])
+            if child is None:
+                return None
+            from spark_rapids_tpu.exprs.base import ColumnReference
+
+            exprs = list(p.exprs) + [
+                ColumnReference(f.name)
+                for f in child.schema.fields[-3:]]
+            return L.Project(exprs, child)
+        if isinstance(p, L.Filter):
+            child = augment_chain(p.children[0])
+            if child is None:
+                return None
+            return L.Filter(p.condition, child)
+        return None
+
+    def replace_exprs(e, schema):
+        from spark_rapids_tpu.exprs.base import Alias, ColumnReference
+
+        if isinstance(e, InputFileName):
+            return Alias(ColumnReference(e.HIDDEN), e.name)
+        kids = [replace_exprs(c, schema) for c in e.children]
+        return e.with_children(kids) if e.children else e
+
+    def walk(p: L.LogicalPlan) -> L.LogicalPlan:
+        new_children = [walk(c) for c in p.children]
+        if new_children != p.children:
+            p = _copy.copy(p)
+            p.children = new_children
+        if not any(tree_has(e) for e in node_exprs(p)):
+            return p
+        child = augment_chain(p.children[0])
+        if child is None:
+            return p  # leave for check_supported -> CPU fallback
+        if isinstance(p, L.Project):
+            return L.Project([replace_exprs(e, child.schema)
+                              for e in p.exprs], child)
+        # Filter: rewrite the condition, then strip the hidden columns
+        # so the output schema is unchanged
+        cond = replace_exprs(p.condition, child.schema)
+        filtered = L.Filter(cond, child)
+        keep = [B.BoundReference(i, f.dtype, f.nullable, f.name)
+                for i, f in enumerate(p.children[0].schema.fields)]
+        return L.Project(keep, filtered)
+
+    return walk(plan)
+
+
 def _rewrite_scalar_subqueries(plan: L.LogicalPlan,
                                conf) -> L.LogicalPlan:
     """Prepass: run each ScalarSubquery's subplan once and splice its
@@ -895,6 +1014,7 @@ def _rewrite_scalar_subqueries(plan: L.LogicalPlan,
 
 def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
     conf = conf or get_conf()
+    plan = _rewrite_input_file_exprs(plan)
     plan = _rewrite_scalar_subqueries(plan, conf)
     meta = PlanMeta(plan, conf)
     if conf.get(SQL_ENABLED):

@@ -1,0 +1,195 @@
+"""Round-4 expression-long-tail tests: TimeAdd/TimeSub,
+DateAddInterval, MakeDecimal, UnscaledValue, InputFileName/BlockStart/
+BlockLength (ref: datetimeExpressions.scala, decimalExpressions.scala,
+GpuInputFileName et al. in GpuOverrides.scala)."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col
+from tests.differential import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def test_time_add_sub_differential(session):
+    from spark_rapids_tpu.exprs.datetime import (
+        CalendarInterval,
+        TimeAdd,
+        TimeSub,
+    )
+
+    rng = np.random.default_rng(1)
+    ts = pa.array(rng.integers(0, 2**45, 500),
+                  pa.int64()).cast(pa.timestamp("us", tz="UTC"))
+    df = session.create_dataframe(pa.table({"t": ts}))
+    iv = CalendarInterval(days=3, microseconds=5_000_000)
+    out = df.select(TimeAdd(col("t"), iv).alias("plus"),
+                    TimeSub(col("t"), iv).alias("minus"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_time_add_months_falls_back(session):
+    from spark_rapids_tpu.exprs.datetime import CalendarInterval, TimeAdd
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    ts = pa.array([0, 10**12], pa.int64()).cast(
+        pa.timestamp("us", tz="UTC"))
+    df = session.create_dataframe(pa.table({"t": ts})).select(
+        TimeAdd(col("t"), CalendarInterval(months=1)).alias("x"))
+    _, meta = plan_query(df._plan, session.conf)
+    assert not meta.can_replace
+
+
+def test_date_add_interval_differential(session):
+    from spark_rapids_tpu.exprs.datetime import (
+        CalendarInterval,
+        DateAddInterval,
+    )
+
+    rng = np.random.default_rng(2)
+    d = pa.array(rng.integers(0, 20000, 400).astype(np.int32),
+                 pa.int32()).cast(pa.date32())
+    df = session.create_dataframe(pa.table({"d": d}))
+    out = df.select(
+        DateAddInterval(col("d"),
+                        CalendarInterval(days=-45)).alias("back"))
+    assert_tpu_cpu_equal(out)
+
+
+def test_unscaled_and_make_decimal_roundtrip(session):
+    from spark_rapids_tpu.exprs.decimal import MakeDecimal, UnscaledValue
+
+    vals = [decimal.Decimal("12.34"), None, decimal.Decimal("-0.07"),
+            decimal.Decimal("99999.99")] * 50
+    df = session.create_dataframe(pa.table(
+        {"d": pa.array(vals, pa.decimal128(10, 2))}))
+    out = df.select(UnscaledValue(col("d")).alias("u"))
+    assert_tpu_cpu_equal(out)
+    # round trip: make_decimal(unscaled(d), 10, 2) == d
+    out2 = df.select(
+        MakeDecimal(UnscaledValue(col("d")), 10, 2).alias("d2"))
+    got = out2.collect(engine="tpu").to_pydict()["d2"]
+    assert got == vals
+
+
+def test_make_decimal_overflow_nulls(session):
+    from spark_rapids_tpu.exprs.decimal import MakeDecimal
+
+    df = session.create_dataframe(pa.table(
+        {"x": pa.array([5, 10**7, -(10**7), 123], pa.int64())}))
+    out = df.select(MakeDecimal(col("x"), 5, 2).alias("d"))
+    assert_tpu_cpu_equal(out)
+    got = out.collect(engine="tpu").to_pydict()["d"]
+    assert got[1] is None and got[2] is None
+    assert got[0] == decimal.Decimal("0.05")
+
+
+def test_input_file_exprs_above_scan(session, tmp_path):
+    """input_file_name()/block_start()/block_length() above a Parquet
+    scan resolve per row to the originating file."""
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        InputFileBlockLength,
+        InputFileBlockStart,
+        InputFileName,
+    )
+
+    rng = np.random.default_rng(3)
+    paths, sizes = [], {}
+    for i in range(3):
+        t = pa.table({"v": rng.integers(0, 100, 200 + i)})
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+        import os
+
+        sizes[p] = os.path.getsize(p)
+    df = session.read_parquet(*paths).select(
+        col("v"), InputFileName().alias("fn"),
+        InputFileBlockStart().alias("bs"),
+        InputFileBlockLength().alias("bl"))
+    out = df.collect(engine="tpu").to_pydict()
+    assert set(out["fn"]) == set(paths)
+    assert set(out["bs"]) == {0}
+    assert all(out["bl"][i] == sizes[out["fn"][i]]
+               for i in range(len(out["fn"])))
+    # row counts per file are preserved
+    from collections import Counter
+
+    counts = Counter(out["fn"])
+    assert sorted(counts.values()) == [200, 201, 202]
+
+
+def test_input_file_name_in_filter(session, tmp_path):
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.nondeterministic import InputFileName
+    from spark_rapids_tpu.exprs.strings import Contains
+
+    rng = np.random.default_rng(4)
+    for i in range(2):
+        pq.write_table(pa.table({"v": rng.integers(0, 9, 100)}),
+                       str(tmp_path / f"part{i}.parquet"))
+    df = session.read_parquet(str(tmp_path)).where(
+        Contains(InputFileName(), lit("part1")))
+    out = df.collect(engine="tpu")
+    assert out.num_rows == 100
+    assert out.column_names == ["v"]  # hidden columns stripped
+
+
+def test_input_file_name_without_scan_falls_back(session):
+    """No file scan below: Spark's default '' via the CPU engine."""
+    from spark_rapids_tpu.exprs.nondeterministic import InputFileName
+
+    df = session.create_dataframe(pa.table(
+        {"v": pa.array([1, 2, 3])})).select(
+        col("v"), InputFileName().alias("fn"))
+    out = df.collect(engine="tpu").to_pydict()
+    assert out["fn"] == ["", "", ""]
+
+
+def test_interval_months_on_cpu_fallback(session):
+    """Month intervals route to the CPU engine and do REAL calendar
+    arithmetic (add_months day clamping), not silently-dropped months."""
+    import datetime
+
+    from spark_rapids_tpu.exprs.datetime import (
+        CalendarInterval,
+        DateAddInterval,
+        TimeAdd,
+    )
+
+    jan31 = datetime.datetime(2021, 1, 31, 12, 30,
+                              tzinfo=datetime.timezone.utc)
+    ts = pa.array([jan31], pa.timestamp("us", tz="UTC"))
+    df = session.create_dataframe(pa.table({"t": ts})).select(
+        TimeAdd(col("t"), CalendarInterval(months=1)).alias("x"))
+    got = df.collect(engine="tpu").to_pydict()["x"][0]
+    assert got.month == 2 and got.day == 28 and got.hour == 12
+
+    d = pa.array([datetime.date(2020, 1, 31)], pa.date32())
+    df2 = session.create_dataframe(pa.table({"d": d})).select(
+        DateAddInterval(col("d"),
+                        CalendarInterval(months=1)).alias("x"))
+    got2 = df2.collect(engine="tpu").to_pydict()["x"][0]
+    assert got2 == datetime.date(2020, 2, 29)  # leap clamp
+
+
+def test_input_file_name_over_csv(session, tmp_path):
+    """Regression: CSV scans get file context too."""
+    from spark_rapids_tpu.exprs.nondeterministic import InputFileName
+
+    p = str(tmp_path / "a.csv")
+    with open(p, "w") as f:
+        f.write("v\n1\n2\n")
+    df = session.read_csv(p).select(col("v"),
+                                    InputFileName().alias("fn"))
+    out = df.collect(engine="tpu").to_pydict()
+    assert out["fn"] == [p, p]
