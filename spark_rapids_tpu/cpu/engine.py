@@ -823,6 +823,65 @@ def _dispatch_extended(e, table, n):  # noqa: C901
             end = len(v) if e.length is None else start + max(e.length, 0)
             out.append(v[max(start, 0):max(end, 0)])
         return pa.array(out, pa.string())
+    if isinstance(e, S.GetJsonObject):
+        import json as _json
+
+        c = cpu_eval(e.child, table)
+        path = e.path.value
+        if any(tok in path for tok in ("*", "..")):
+            raise NotImplementedError(
+                f"get_json_object path {path!r}: wildcard/recursive "
+                "descent is not implemented (simple $.a.b[i] paths "
+                "only) — refusing rather than returning wrong NULLs")
+        steps = S.GetJsonObject.parse_path(path)
+        out = []
+        for v in c.to_pylist():
+            if v is None or steps is None:
+                out.append(None)
+                continue
+            try:
+                cur = _json.loads(v)
+                for st in steps:
+                    if isinstance(st, int):
+                        cur = cur[st] if isinstance(cur, list) \
+                            and 0 <= st < len(cur) else None
+                    else:
+                        cur = cur.get(st) if isinstance(cur, dict) \
+                            else None
+                    if cur is None:
+                        break
+                if cur is None:
+                    out.append(None)
+                elif isinstance(cur, str):
+                    out.append(cur)  # Spark strips quotes on scalars
+                elif isinstance(cur, bool):
+                    out.append("true" if cur else "false")
+                else:
+                    out.append(_json.dumps(
+                        cur, separators=(",", ":"),
+                        ensure_ascii=False))
+            except (ValueError, TypeError):
+                out.append(None)
+        return pa.array(out, pa.string())
+    if isinstance(e, S.SplitPart):
+        import re as _re
+
+        c = cpu_eval(e.child, table)
+        d = e.delim.value
+        out = [None if v is None else
+               (lambda parts: parts[e.index]
+                if 0 <= e.index < len(parts) else None)(
+                   _java_split(_re.escape(d), v, -1))
+               for v in c.to_pylist()]
+        return pa.array(out, pa.string())
+    if isinstance(e, S.StringSplit):
+        c = cpu_eval(e.child, table)
+        d = e.delim.value
+        if d is None:
+            return pa.nulls(n, pa.list_(pa.string()))
+        out = [None if v is None else _java_split(d, v, e.limit)
+               for v in c.to_pylist()]
+        return pa.array(out, pa.list_(pa.string()))
     if isinstance(e, S.StringTrim):
         c = cpu_eval(e.child, table)
         if isinstance(e, S.StringTrimLeft):
@@ -1767,3 +1826,31 @@ def _add_interval_us(us: int, months: int, days: int,
     dt += datetime.timedelta(days=days, microseconds=microseconds)
     return int((dt - datetime.datetime(1970, 1, 1, tzinfo=utc))
                / datetime.timedelta(microseconds=1))
+
+
+def _java_split(pattern: str, s: str, limit: int) -> list[str]:
+    """java.lang.String.split semantics: captured groups never leak
+    into the result (unlike re.split), a leading zero-width match is
+    skipped, limit > 0 caps the piece count, and limit == 0 drops
+    trailing empty pieces."""
+    import re
+
+    out = []
+    last = 0
+    pieces = 0
+    for m in re.finditer(pattern, s):
+        if limit > 0 and pieces >= limit - 1:
+            break
+        if m.start() == m.end():
+            if m.start() == 0 or m.start() == len(s):
+                continue  # Java skips boundary zero-width matches
+            if m.start() < last:
+                continue
+        out.append(s[last:m.start()])
+        last = m.end()
+        pieces += 1
+    out.append(s[last:])
+    if limit == 0:
+        while out and out[-1] == "":
+            out.pop()
+    return out

@@ -278,3 +278,77 @@ class CollectSet(CollectList):
     order equality: NaN == NaN dedups)."""
 
     collect_kind = "set"
+
+
+@dataclasses.dataclass(repr=False)
+class PivotFirst(AggregateFunction):
+    """pivot aggregate marker (ref: GpuPivotFirst,
+    AggregateFunctions.scala): first value of `child` per group per
+    pivot value.  Expanded at aggregate construction into one masked
+    First per pivot value — PivotFirst(p, v, [a, b]) becomes
+    first(if(p = a, v, null) ignore nulls), first(if(p = b, ...)) —
+    the exact per-slot semantics the reference's array-building kernel
+    computes, laid out straight into the output columns."""
+
+    pivot: Expression = None  # type: ignore[assignment]
+    pivot_values: tuple = ()
+
+    @property
+    def name(self) -> str:
+        return (f"pivot_first({self.pivot.name}, {self.child.name}, "
+                f"{list(self.pivot_values)})")
+
+    def inputs(self):
+        return (self.child, self.pivot)
+
+    def bind(self, schema):
+        from spark_rapids_tpu.exprs.base import bind_references
+
+        return PivotFirst(bind_references(self.child, schema),
+                          bind_references(self.pivot, schema),
+                          tuple(self.pivot_values))
+
+    def expand(self, out_name: str) -> list["NamedAgg"]:
+        """The masked-First expansion (one output column per value)."""
+        return expand_pivot_aggs(
+            self.pivot, self.pivot_values,
+            [NamedAgg(First(self.child, ignore_nulls=True), out_name)],
+            single=out_name == "__pivot")
+
+
+def expand_pivot_aggs(pcol, values, named: list["NamedAgg"],
+                      single: bool) -> list["NamedAgg"]:
+    """Masked-aggregate pivot expansion shared by PivotFirst and
+    GroupedData.pivot(): F(v) becomes F(if(p <=> val, v, null)) per
+    pivot value.  A None pivot value matches NULL keys (null-safe);
+    First/Last flip to ignore_nulls so masked-out rows never win the
+    slot (the reference's PivotFirst updates only on a pivot match)."""
+    import dataclasses as _dc
+
+    from spark_rapids_tpu.exprs.base import Literal
+    from spark_rapids_tpu.exprs.predicates import EqualTo, If, IsNull
+
+    out = []
+    for v in values:
+        for na in named:
+            ins = na.fn.inputs()
+            if len(ins) != 1:
+                raise ValueError(
+                    f"pivot over {na.fn.name} is not supported")
+            child = ins[0]
+            try:
+                null_dt = child.dtype  # bound children know their type
+            except RuntimeError:
+                null_dt = None  # unbound: NULL literal widens in If
+            cond = IsNull(pcol) if v is None \
+                else EqualTo(pcol, Literal.of(v))
+            masked = If(cond, child, Literal.of(None, null_dt))
+            fn2 = _dc.replace(na.fn, child=masked)
+            if isinstance(fn2, First) and not fn2.ignore_nulls:
+                # non-null-ignoring First/Last would treat masked-out
+                # rows as candidate values — Spark's pivot only
+                # considers matching rows
+                fn2 = _dc.replace(fn2, ignore_nulls=True)
+            name = str(v) if single else f"{v}_{na.out_name}"
+            out.append(NamedAgg(fn2, name))
+    return out

@@ -839,3 +839,183 @@ class ConcatWs(Expression):
         else:
             valid = jnp.ones((n,), bool)
         return StringColumn(chars, offset, valid & ctx.row_mask)
+
+
+@dataclasses.dataclass(repr=False)
+class StringSplit(Expression):
+    """split(str, pattern[, limit]) (ref: GpuStringSplit,
+    stringFunctions.scala) restricted to regex-free literal delimiters
+    (the canRegexpBeTreatedLikeARegularString policy,
+    GpuOverrides.scala:440-473).
+
+    A bare split produces array<string>, which has no dense device
+    layout — the planner rewrites the dominant `split(s, d)[i]` form
+    (GetArrayItem over the split) into the device SplitPart kernel;
+    other uses run on the CPU engine."""
+
+    child: Expression
+    delim: Expression  # Literal, plain string
+    limit: int = -1
+
+    _META = set("\\^$.|?*+()[]{}")
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.ListType(T.STRING)
+
+    @property
+    def name(self) -> str:
+        return f"split({self.child.name}, {self.delim.name})"
+
+    @property
+    def children(self) -> tuple:
+        return (self.child, self.delim)
+
+    def with_children(self, children):
+        return StringSplit(children[0], children[1], self.limit)
+
+    def check_supported(self) -> None:
+        if not isinstance(self.delim, Literal) or not self.delim.value:
+            raise TypeError("split delimiter must be a non-empty literal")
+        if any(ch in self._META for ch in self.delim.value):
+            raise TypeError(
+                f"split pattern {self.delim.value!r} is a real regular "
+                "expression; TPU runs only plain-string delimiters")
+        if self.limit != -1:
+            raise TypeError("split with an explicit limit falls back")
+        raise TypeError(
+            "bare split() produces array<string> (no dense device "
+            "layout); only the split(s, d)[i] form runs on device — "
+            "CPU fallback")
+
+    def eval(self, ctx: EvalContext):
+        raise AssertionError("rewritten by the planner or CPU-run")
+
+
+@dataclasses.dataclass(repr=False)
+class SplitPart(Expression):
+    """split(str, delim)[idx] as one device kernel: the idx-th
+    delimiter-separated piece, NULL when idx is out of range (Spark
+    GetArrayItem semantics over GpuStringSplit's output; Java
+    split(_, -1) keeps trailing empty pieces)."""
+
+    child: Expression
+    delim: Expression  # Literal, plain string, non-empty
+    index: int         # 0-based
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    @property
+    def name(self) -> str:
+        return f"split({self.child.name}, {self.delim.name})[{self.index}]"
+
+    @property
+    def children(self) -> tuple:
+        return (self.child, self.delim)
+
+    def with_children(self, children):
+        return SplitPart(children[0], children[1], self.index)
+
+    def check_supported(self) -> None:
+        if not isinstance(self.delim, Literal) or not self.delim.value:
+            raise TypeError("split delimiter must be a non-empty literal")
+        if any(ch in StringSplit._META for ch in self.delim.value):
+            raise TypeError("regex delimiters fall back")
+        if self.index < 0:
+            raise TypeError("negative split index falls back")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        assert isinstance(c, StringColumn)
+        db = _needle_bytes(self.delim)
+        m = len(db)
+        k = self.index
+        if m > c.width:
+            # delimiter longer than any value: piece 0 = whole string
+            if k == 0:
+                return c
+            return StringColumn(
+                jnp.zeros((c.capacity, c.width), jnp.uint8),
+                jnp.zeros(c.capacity, jnp.int32),
+                jnp.zeros(c.capacity, bool))
+        reals = _greedy_matches(_match_starts(c, db), m)
+        occ = jnp.cumsum(reals.astype(jnp.int32), axis=1)
+        total = occ[:, -1]  # delimiter count -> total+1 pieces
+        pos = jnp.arange(c.width, dtype=jnp.int32)[None, :]
+        # start: 0 for piece 0, else one past the k-th delimiter's end
+        if k == 0:
+            start = jnp.zeros(c.capacity, jnp.int32)
+        else:
+            s = jnp.where(reals & (occ == k), pos + m, jnp.int32(-1))
+            start = jnp.max(s, axis=1).astype(jnp.int32)
+        # end: position of the (k+1)-th delimiter, else the length
+        e = jnp.where(reals & (occ == k + 1), pos, jnp.int32(2**30))
+        end = jnp.minimum(jnp.min(e, axis=1),
+                          c.lengths).astype(jnp.int32)
+        in_range = (jnp.int32(k) <= total) & (start >= 0)
+        start = jnp.maximum(start, 0)
+        new_len = jnp.maximum(end - start, 0)
+        src = pos + start[:, None]
+        chars = jnp.take_along_axis(
+            c.chars, jnp.clip(src, 0, c.width - 1), axis=1)
+        chars = jnp.where(pos < new_len[:, None], chars, 0).astype(
+            jnp.uint8)
+        return StringColumn(chars, new_len, c.validity & in_range)
+
+
+@dataclasses.dataclass(repr=False)
+class GetJsonObject(Expression):
+    """get_json_object(json, path) with a literal path (ref:
+    GpuGetJsonObject.scala — the reference drives a native cudf JSON
+    kernel; here JSON-path evaluation runs on the CPU engine, declared
+    via check_supported so the planner routes the subtree there).
+    Path grammar: $ root, .field / ['field'] access, [n] array index."""
+
+    child: Expression
+    path: Expression  # Literal string
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.STRING
+
+    @property
+    def name(self) -> str:
+        return f"get_json_object({self.child.name}, {self.path.name})"
+
+    def check_supported(self) -> None:
+        if not isinstance(self.path, Literal) or not self.path.value:
+            raise TypeError("get_json_object path must be a literal")
+        raise TypeError(
+            "get_json_object evaluates JSON paths on the CPU engine "
+            "(no device JSON kernel yet)")
+
+    def eval(self, ctx: EvalContext):
+        raise AssertionError("CPU-engine only")
+
+    @staticmethod
+    def parse_path(path: str):
+        """'$.a.b[2]' -> ['a', 'b', 2]; None on malformed paths
+        (Spark returns NULL for every row then)."""
+        import re
+
+        if not path.startswith("$"):
+            return None
+        steps = []
+        rest = path[1:]
+        token = re.compile(
+            r"\.(\w+)|\[(\d+)\]|\['([^']*)'\]|\[\"([^\"]*)\"\]")
+        pos = 0
+        while pos < len(rest):
+            m = token.match(rest, pos)
+            if m is None:
+                return None
+            field, idx, q1, q2 = m.groups()
+            if idx is not None:
+                steps.append(int(idx))
+            else:
+                steps.append(field if field is not None
+                             else (q1 if q1 is not None else q2))
+            pos = m.end()
+        return steps

@@ -117,7 +117,8 @@ for _sig, _classes in (
             S.Contains, S.Like, S.Substring, S.StringTrim,
             S.StringTrimLeft, S.StringTrimRight, S.Concat,
             S.StringReplace, S.RegExpReplace, S.StringLPad, S.StringRPad,
-            S.StringLocate, S.SubstringIndex, S.InitCap, S.ConcatWs)),
+            S.StringLocate, S.SubstringIndex, S.InitCap, S.ConcatWs,
+            S.StringSplit, S.SplitPart, S.GetJsonObject)),
     (TS.ExprSig(TS.ALL, "per-pair support matrix in check_supported"),
      (Cast,)),
 ):
@@ -167,7 +168,7 @@ from spark_rapids_tpu.exprs import aggregates as AG  # noqa: E402
 
 SUPPORTED_AGGS = (AG.Sum, AG.Count, AG.CountStar, AG.Min, AG.Max,
                   AG.Average, AG.First, AG.Last, AG.CollectList,
-                  AG.CollectSet)
+                  AG.CollectSet, AG.PivotFirst)
 
 #: per-aggregate input signatures (ref: TypeChecks on AggExprMeta)
 AGG_SIGS: dict[type, TS.ExprSig] = {
@@ -862,6 +863,43 @@ def _tree_has_ansi_risk(e) -> bool:
 # Entry points
 # ---------------------------------------------------------------------- #
 
+def _rewrite_split_extracts(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Prepass: split(s, d)[i] (GetArrayItem over StringSplit with a
+    plain literal delimiter and non-negative literal index) fuses into
+    the device SplitPart kernel — the dominant consumption pattern of
+    GpuStringSplit; other split uses stay and fall back to the CPU
+    engine via StringSplit.check_supported."""
+
+    def xform(e):
+        kids = [xform(c) for c in e.children]
+        if kids != list(e.children):
+            e = e.with_children(kids)
+        if isinstance(e, COLL.GetArrayItem) \
+                and isinstance(e.child, S.StringSplit) \
+                and isinstance(e.index, B.Literal) \
+                and e.index.value is not None \
+                and int(e.index.value) >= 0:
+            sp = e.child
+            if isinstance(sp.delim, B.Literal) and sp.delim.value \
+                    and not any(ch in S.StringSplit._META
+                                for ch in sp.delim.value) \
+                    and sp.limit == -1:
+                return S.SplitPart(sp.child, sp.delim,
+                                   int(e.index.value))
+        return e
+
+    def walk(p: L.LogicalPlan) -> None:
+        if isinstance(p, L.Project):
+            p.exprs = [xform(e) for e in p.exprs]
+        elif isinstance(p, L.Filter):
+            p.condition = xform(p.condition)
+        for c in p.children:
+            walk(c)
+
+    walk(plan)
+    return plan
+
+
 def _rewrite_input_file_exprs(plan: L.LogicalPlan) -> L.LogicalPlan:
     """Prepass: InputFileName/BlockStart/BlockLength become hidden
     per-file constant columns appended by the scan (the reference's
@@ -1014,6 +1052,7 @@ def _rewrite_scalar_subqueries(plan: L.LogicalPlan,
 
 def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
     conf = conf or get_conf()
+    plan = _rewrite_split_extracts(plan)
     plan = _rewrite_input_file_exprs(plan)
     plan = _rewrite_scalar_subqueries(plan, conf)
     meta = PlanMeta(plan, conf)

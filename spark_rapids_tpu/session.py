@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Union
 
+import dataclasses
+
 import pyarrow as pa
 
 from spark_rapids_tpu import types as T
@@ -334,6 +336,19 @@ class GroupedData:
         self._df = df
         self._keys = keys
         self._sets = grouping_sets
+        self._pivot: Optional[tuple] = None
+
+    def pivot(self, pivot_col: ExprLike,
+              values: Sequence) -> "GroupedData":
+        """pyspark-shaped pivot with an EXPLICIT value list (ref:
+        GpuPivotFirst; Spark's implicit-distinct-values mode needs a
+        pre-query and is not supported): each aggregate expands into
+        one masked aggregate per pivot value, named `{value}` for a
+        single aggregate or `{value}_{name}` otherwise."""
+        if self._sets is not None:
+            raise ValueError("pivot over rollup/cube is not supported")
+        self._pivot = (_expr(pivot_col), list(values))
+        return self
 
     def _named(self, aggs) -> list[NamedAgg]:
         named = []
@@ -351,6 +366,11 @@ class GroupedData:
         from spark_rapids_tpu.exprs.aggregates import CountDistinct
 
         named = self._named(aggs)
+        if self._pivot is not None:
+            named = self._expand_pivot(named)
+        named = [na2 for na in named
+                 for na2 in (na.fn.expand(na.out_name)
+                             if hasattr(na.fn, "expand") else (na,))]
         if any(isinstance(na.fn, CountDistinct) for na in named):
             return self._agg_distinct(named)
         if self._sets is not None:
@@ -358,6 +378,13 @@ class GroupedData:
         return DataFrame(
             L.Aggregate(self._keys, named, self._df._plan),
             self._df._session)
+
+    def _expand_pivot(self, named: list[NamedAgg]) -> list[NamedAgg]:
+        from spark_rapids_tpu.exprs.aggregates import expand_pivot_aggs
+
+        pcol, values = self._pivot
+        return expand_pivot_aggs(pcol, values, named,
+                                 single=len(named) == 1)
 
     def _agg_distinct(self, named: list[NamedAgg]) -> "DataFrame":
         """count(DISTINCT x) as a two-level aggregate: group by

@@ -394,8 +394,14 @@ class Schema:
 
 
 def common_type(a: DataType, b: DataType) -> Optional[DataType]:
-    """Numeric widening a la Spark's implicit cast promotion."""
+    """Numeric widening a la Spark's implicit cast promotion; NULL
+    widens to anything (a NULL literal branch takes the other side's
+    type, as in Spark's TypeCoercion)."""
     if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
         return a
     order = {ByteType: 0, ShortType: 1, IntegerType: 2, LongType: 3,
              FloatType: 4, DoubleType: 5}

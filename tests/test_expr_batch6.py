@@ -193,3 +193,209 @@ def test_input_file_name_over_csv(session, tmp_path):
                                     InputFileName().alias("fn"))
     out = df.collect(engine="tpu").to_pydict()
     assert out["fn"] == [p, p]
+
+
+def test_string_split_indexed_on_device(session):
+    """split(s, d)[i] fuses into the device SplitPart kernel."""
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.collections import GetArrayItem
+    from spark_rapids_tpu.exprs.strings import StringSplit
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    vals = ["a,b,c", "", None, "x", ",lead", "trail,", "a,,c",
+            "日本,語", "one,two,three,four"]
+    df = session.create_dataframe(pa.table(
+        {"s": pa.array(vals * 30)})).select(
+        GetArrayItem(StringSplit(col("s"), lit(",")),
+                     lit(0)).alias("p0"),
+        GetArrayItem(StringSplit(col("s"), lit(",")),
+                     lit(1)).alias("p1"),
+        GetArrayItem(StringSplit(col("s"), lit(",")),
+                     lit(3)).alias("p3"))
+    exec_, meta = plan_query(df._plan, session.conf)
+    assert meta.can_replace, exec_.tree_string()
+    assert_tpu_cpu_equal(df)
+    got = df.collect(engine="tpu").to_pydict()
+    assert got["p0"][:9] == ["a", "", None, "x", "", "trail", "a",
+                             "日本", "one"]
+    assert got["p1"][:9] == ["b", None, None, None, "lead", "", "",
+                             "語", "two"]
+    assert got["p3"][:9] == [None, None, None, None, None, None, None,
+                             None, "four"]
+
+
+def test_bare_string_split_falls_back(session):
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.strings import StringSplit
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    df = session.create_dataframe(pa.table(
+        {"s": pa.array(["a,b", "c"])})).select(
+        StringSplit(col("s"), lit(",")).alias("parts"))
+    _, meta = plan_query(df._plan, session.conf)
+    assert not meta.can_replace
+    out = df.collect(engine="tpu").to_pydict()
+    assert out["parts"] == [["a", "b"], ["c"]]
+
+
+def test_regex_split_falls_back_correct(session):
+    """A REAL regex delimiter: CPU engine evaluates the regex."""
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.collections import GetArrayItem
+    from spark_rapids_tpu.exprs.strings import StringSplit
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    df = session.create_dataframe(pa.table(
+        {"s": pa.array(["a1b22c", "x9y"])})).select(
+        GetArrayItem(StringSplit(col("s"), lit("[0-9]+")),
+                     lit(1)).alias("p"))
+    _, meta = plan_query(df._plan, session.conf)
+    assert not meta.can_replace
+    assert df.collect(engine="tpu").to_pydict()["p"] == ["b", "y"]
+
+
+def test_split_multichar_delimiter(session):
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.collections import GetArrayItem
+    from spark_rapids_tpu.exprs.strings import StringSplit
+
+    df = session.create_dataframe(pa.table(
+        {"s": pa.array(["a::b::c", "::x", "y::", "zz"])})).select(
+        GetArrayItem(StringSplit(col("s"), lit("::")),
+                     lit(1)).alias("p"))
+    assert_tpu_cpu_equal(df)
+    assert df.collect(engine="tpu").to_pydict()["p"] == \
+        ["b", "x", "", None]
+
+
+def test_pivot_single_agg(session):
+    """groupBy().pivot(values).agg(sum) — masked-aggregate expansion
+    (ref: GpuPivotFirst)."""
+    from spark_rapids_tpu.session import sum_
+
+    rng = np.random.default_rng(21)
+    t = pa.table({
+        "k": rng.integers(0, 4, 2000),
+        "p": np.array(["x", "y", "z"])[rng.integers(0, 3, 2000)],
+        "v": rng.integers(0, 100, 2000),
+    })
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .pivot(col("p"), ["x", "y"])
+          .agg((sum_(col("v")), "s")))
+    out = df.collect(engine="tpu")
+    assert out.column_names == ["k", "x", "y"]
+    # oracle by hand
+    import collections
+
+    want = collections.defaultdict(lambda: [0, 0])
+    kk, pp, vv = (t[c].to_pylist() for c in ("k", "p", "v"))
+    for k, p, v in zip(kk, pp, vv):
+        if p == "x":
+            want[k][0] += v
+        elif p == "y":
+            want[k][1] += v
+    got = {r["k"]: (r["x"], r["y"]) for r in out.to_pylist()}
+    assert got == {k: tuple(v) for k, v in want.items()}
+
+
+def test_pivot_first_expression(session):
+    """PivotFirst constructed directly (the physical-agg surface a
+    frontend would hand us) expands identically."""
+    from spark_rapids_tpu.exprs.aggregates import NamedAgg, PivotFirst
+
+    t = pa.table({
+        "k": pa.array([1, 1, 2, 2, 1]),
+        "p": pa.array(["a", "b", "a", "c", "a"]),
+        "v": pa.array([10, 20, 30, 40, 50]),
+    })
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .agg(NamedAgg(PivotFirst(col("v"), col("p"), ("a", "b")),
+                        "__pivot")))
+    out = {r["k"]: (r["a"], r["b"]) for r in
+           df.collect(engine="tpu").to_pylist()}
+    assert out == {1: (10, 20), 2: (30, None)}
+
+
+def test_pivot_multi_agg_names(session):
+    from spark_rapids_tpu.session import count, sum_
+
+    t = pa.table({
+        "k": pa.array([1, 1, 2]),
+        "p": pa.array(["a", "b", "a"]),
+        "v": pa.array([5, 6, 7]),
+    })
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .pivot(col("p"), ["a", "b"])
+          .agg((sum_(col("v")), "s"), (count(col("v")), "c")))
+    out = df.collect(engine="tpu")
+    assert out.column_names == ["k", "a_s", "a_c", "b_s", "b_c"]
+
+
+def test_get_json_object(session):
+    """get_json_object: CPU-engine JSON-path evaluation (the planner
+    routes it there; the reference uses a native cudf kernel)."""
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.strings import GetJsonObject
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    rows = ['{"a": 1, "b": {"c": "hi"}, "d": [10, 20]}',
+            '{"a": null}', "not json", None,
+            '{"b": {"c": "日本"}}', '{"d": [true, false]}']
+    df = session.create_dataframe(pa.table({"j": pa.array(rows)}))
+    out = df.select(
+        GetJsonObject(col("j"), lit("$.a")).alias("a"),
+        GetJsonObject(col("j"), lit("$.b.c")).alias("bc"),
+        GetJsonObject(col("j"), lit("$.d[1]")).alias("d1"),
+        GetJsonObject(col("j"), lit("$.b")).alias("b"))
+    _, meta = plan_query(out._plan, session.conf)
+    assert not meta.can_replace  # documented CPU routing
+    got = out.collect(engine="tpu").to_pydict()
+    assert got["a"] == ["1", None, None, None, None, None]
+    assert got["bc"] == ["hi", None, None, None, "日本", None]
+    assert got["d1"] == ["20", None, None, None, None, "false"]
+    assert got["b"] == ['{"c":"hi"}', None, None, None,
+                        '{"c":"日本"}', None]
+
+
+def test_pivot_first_semantics_regressions(session):
+    """Review regressions: First without ignore_nulls still picks the
+    matching row's value (masked NULLs never win); a None pivot value
+    matches NULL keys; split with explicit limit uses Java limit
+    semantics on the CPU; capture-group delimiters don't leak."""
+    from spark_rapids_tpu.exprs.aggregates import First, NamedAgg
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.collections import GetArrayItem
+    from spark_rapids_tpu.exprs.strings import StringSplit
+
+    t = pa.table({"k": pa.array([1, 1]),
+                  "p": pa.array(["b", "a"]),
+                  "v": pa.array([10, 20])})
+    df = (session.create_dataframe(t).group_by(col("k"))
+          .pivot(col("p"), ["a"])
+          .agg(NamedAgg(First(col("v")), "f")))
+    assert df.collect(engine="tpu").to_pylist() == [{"k": 1, "a": 20}]
+
+    from spark_rapids_tpu.session import sum_
+
+    t2 = pa.table({"k": pa.array([1, 1, 1]),
+                   "p": pa.array(["x", None, None]),
+                   "v": pa.array([1, 2, 3])})
+    df2 = (session.create_dataframe(t2).group_by(col("k"))
+           .pivot(col("p"), ["x", None]).agg((sum_(col("v")), "s")))
+    row = df2.collect(engine="tpu").to_pylist()[0]
+    assert row["x"] == 1 and row["None"] == 5
+
+    # limit semantics on the CPU path
+    df3 = session.create_dataframe(pa.table(
+        {"s": pa.array(["a,b,c"])})).select(
+        StringSplit(col("s"), lit(","), limit=2).alias("p"))
+    assert df3.collect(engine="tpu").to_pydict()["p"] == [["a", "b,c"]]
+    # capture-group regex delimiter: groups do not leak (Java split)
+    df4 = session.create_dataframe(pa.table(
+        {"s": pa.array(["a1b"])})).select(
+        GetArrayItem(StringSplit(col("s"), lit("([0-9])")),
+                     lit(1)).alias("p"))
+    assert df4.collect(engine="tpu").to_pydict()["p"] == ["b"]
