@@ -1190,10 +1190,72 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
         return child.filter(mask)
     if isinstance(plan, L.MapInArrow):
         child = execute_cpu(plan.children[0])
+        if getattr(plan, "pandas", False):
+            from spark_rapids_tpu.execs.python_exec import (
+                _map_in_pandas_wrapper,
+            )
+
+            aschema = schema_to_arrow(plan.schema)
+            return _map_in_pandas_wrapper(
+                child, fn=plan.fn, aschema=aschema).cast(aschema)
         out = plan.fn(child)
         if isinstance(out, pa.RecordBatch):
             out = pa.Table.from_batches([out])
         return out.cast(schema_to_arrow(plan.schema))
+    if isinstance(plan, L.CoGroupedPandas):
+        import functools
+
+        from spark_rapids_tpu.execs import python_exec as PE
+
+        lt = execute_cpu(plan.children[0])
+        rt = execute_cpu(plan.children[1])
+        aschema = schema_to_arrow(plan.schema)
+        side = pa.array(np.concatenate(
+            [np.zeros(lt.num_rows, np.int8),
+             np.ones(rt.num_rows, np.int8)]))
+        arrays = [side]
+        names = ["__side"]
+        for i, f in enumerate(lt.schema):
+            arrays.append(pa.concat_arrays(
+                [lt.column(i).combine_chunks(),
+                 pa.nulls(rt.num_rows, f.type)]))
+            names.append(f"__l_{f.name}")
+        for i, f in enumerate(rt.schema):
+            arrays.append(pa.concat_arrays(
+                [pa.nulls(lt.num_rows, f.type),
+                 rt.column(i).combine_chunks()]))
+            names.append(f"__r_{f.name}")
+        combined = pa.Table.from_arrays(arrays, names)
+        fn = functools.partial(
+            PE._cogroup_wrapper, fn=plan.fn,
+            left_keys=plan.left_key_names,
+            right_keys=plan.right_key_names,
+            aschema=aschema, n_left_cols=lt.num_columns,
+            left_names=lt.column_names, right_names=rt.column_names)
+        return fn(combined).cast(aschema)
+    if isinstance(plan, L.GroupedPandas):
+        import functools
+
+        from spark_rapids_tpu.execs import python_exec as PE
+
+        child = execute_cpu(plan.children[0])
+        aschema = schema_to_arrow(plan.schema)
+        if plan.kind == "flatmap":
+            fn = functools.partial(PE._grouped_apply_wrapper,
+                                   fn=plan.payload,
+                                   key_names=plan.key_names,
+                                   aschema=aschema)
+        elif plan.kind == "agg":
+            fn = functools.partial(PE._grouped_agg_wrapper,
+                                   aggs=plan.payload,
+                                   key_names=plan.key_names,
+                                   aschema=aschema)
+        else:
+            fn = functools.partial(PE._window_in_pandas_wrapper,
+                                   fns=plan.payload,
+                                   key_names=plan.key_names,
+                                   aschema=aschema)
+        return fn(child).cast(aschema)
     if isinstance(plan, L.Generate):
         child = execute_cpu(plan.children[0])
         gen = plan.generator

@@ -76,3 +76,372 @@ class TpuMapInArrowExec(TpuExec):
             if self._pool is not None:
                 self._pool.close()
                 self._pool = None
+
+
+# ------------------------------------------------------------------ #
+# Pandas exec family (ref: sql/rapids/execution/python/* —
+# GpuMapInPandasExec, GpuFlatMapGroupsInPandasExec,
+# GpuAggregateInPandasExec, GpuWindowInPandasExecBase).  All ride the
+# same process-isolated Arrow worker pool; pandas conversion happens
+# INSIDE the worker so the parent never imports the user's frame.
+# Grouped variants rely on the planner's hash exchange making reduce
+# partitions key-disjoint, exactly like the reference's required
+# ClusteredDistribution.
+# ------------------------------------------------------------------ #
+
+
+def _map_in_pandas_wrapper(tbl, fn=None, aschema=None):
+    import pyarrow as pa
+
+    out = fn(tbl.to_pandas())
+    return pa.Table.from_pandas(out, schema=aschema,
+                                preserve_index=False)
+
+
+def _grouped_apply_wrapper(tbl, fn=None, key_names=None, aschema=None):
+    """applyInPandas: fn(group frame) -> frame, concatenated."""
+    import pandas as pd
+    import pyarrow as pa
+
+    df = tbl.to_pandas()
+    if df.empty:
+        return aschema.empty_table()
+    if not key_names:  # keyless: the whole frame is one group
+        groups = [df]
+    else:
+        groups = [g for _, g in df.groupby(key_names, dropna=False,
+                                           sort=False)]
+    outs = [fn(g.reset_index(drop=True)) for g in groups]
+    out = pd.concat(outs, ignore_index=True) if outs else None
+    if out is None or out.empty:
+        return aschema.empty_table()
+    return pa.Table.from_pandas(out, schema=aschema,
+                                preserve_index=False)
+
+
+def _grouped_agg_wrapper(tbl, aggs=None, key_names=None, aschema=None):
+    """AggregateInPandas: per group, each (fn, input_col) produces one
+    scalar; output = keys + scalars."""
+    import pandas as pd
+    import pyarrow as pa
+
+    df = tbl.to_pandas()
+    if df.empty:
+        return aschema.empty_table()
+    rows = []
+    if not key_names:  # keyless: one grand aggregate row
+        rows.append({out_name: fn(df[in_col])
+                     for out_name, fn, in_col in aggs})
+        out = pd.DataFrame(rows, columns=[f.name for f in aschema])
+        return pa.Table.from_pandas(out, schema=aschema,
+                                    preserve_index=False)
+    for key, g in df.groupby(key_names, dropna=False, sort=False):
+        if not isinstance(key, tuple):
+            key = (key,)
+        row = dict(zip(key_names, key))
+        for out_name, fn, in_col in aggs:
+            row[out_name] = fn(g[in_col])
+        rows.append(row)
+    out = pd.DataFrame(rows, columns=[f.name for f in aschema])
+    return pa.Table.from_pandas(out, schema=aschema,
+                                preserve_index=False)
+
+
+def _window_in_pandas_wrapper(tbl, fns=None, key_names=None,
+                              aschema=None):
+    """WindowInPandas, unbounded frames: fn(series) -> scalar
+    broadcast to every row of its group (the frame shape
+    GpuWindowInPandasExecBase serves)."""
+    import pyarrow as pa
+
+    df = tbl.to_pandas()
+    if df.empty:
+        return aschema.empty_table()
+    for out_name, fn, in_col in fns:
+        if key_names:
+            df[out_name] = df.groupby(
+                key_names, dropna=False)[in_col].transform(fn)
+        else:
+            df[out_name] = fn(df[in_col])
+    return pa.Table.from_pandas(df, schema=aschema,
+                                preserve_index=False)
+
+
+class TpuMapInPandasExec(TpuMapInArrowExec):
+    """mapInPandas (ref: GpuMapInPandasExec): the arrow exec with
+    pandas conversion in the worker."""
+
+    def __init__(self, fn, schema: T.Schema, child: TpuExec):
+        import functools
+
+        from spark_rapids_tpu.columnar.arrow import schema_to_arrow
+
+        wrapped = functools.partial(_map_in_pandas_wrapper, fn=fn,
+                                    aschema=schema_to_arrow(schema))
+        super().__init__(wrapped, schema, child)
+        self._user_fn = fn
+
+    def node_desc(self) -> str:
+        name = getattr(self._user_fn, "__name__", "fn")
+        return f"TpuMapInPandasExec [{name}]"
+
+
+class _GroupedPandasBase(TpuMapInArrowExec):
+    """Shared driver for key-disjoint grouped pandas execs: each
+    (hash-exchanged) partition concats to one table and makes ONE
+    worker round (groups are complete within a partition)."""
+
+    def execute_partition(self, p: int):
+        from spark_rapids_tpu.columnar.arrow import (
+            from_arrow,
+            schema_to_arrow,
+            to_arrow,
+        )
+        from spark_rapids_tpu.columnar.batch import concat_batches
+
+        aschema = schema_to_arrow(self._schema)
+        batches = list(self.children[0].execute_partition(p))
+        if not batches:
+            return
+        big = batches[0] if len(batches) == 1 else \
+            concat_batches(batches)
+        if big.concrete_num_rows() == 0 and p != 0:
+            return
+        with MetricTimer(self.metrics[TOTAL_TIME]):
+            out = self._get_pool().run(to_arrow(big)).cast(aschema)
+        self.metrics["pythonBatches"].add(1)
+        yield self._count_output(from_arrow(out))
+
+
+class TpuFlatMapGroupsInPandasExec(_GroupedPandasBase):
+    """applyInPandas / flatMapGroupsInPandas
+    (ref: GpuFlatMapGroupsInPandasExec)."""
+
+    def __init__(self, key_names, fn, schema: T.Schema, child: TpuExec):
+        import functools
+
+        from spark_rapids_tpu.columnar.arrow import schema_to_arrow
+
+        wrapped = functools.partial(
+            _grouped_apply_wrapper, fn=fn, key_names=list(key_names),
+            aschema=schema_to_arrow(schema))
+        super().__init__(wrapped, schema, child)
+        self._user_fn = fn
+        self.key_names = list(key_names)
+
+    def node_desc(self) -> str:
+        name = getattr(self._user_fn, "__name__", "fn")
+        return (f"TpuFlatMapGroupsInPandasExec [{name}] "
+                f"keys={self.key_names}")
+
+
+class TpuAggregateInPandasExec(_GroupedPandasBase):
+    """Pandas UDAFs per group (ref: GpuAggregateInPandasExec):
+    `aggs` = [(out_name, fn(series) -> scalar, input_col)]."""
+
+    def __init__(self, key_names, aggs, schema: T.Schema,
+                 child: TpuExec):
+        import functools
+
+        from spark_rapids_tpu.columnar.arrow import schema_to_arrow
+
+        wrapped = functools.partial(
+            _grouped_agg_wrapper, aggs=list(aggs),
+            key_names=list(key_names),
+            aschema=schema_to_arrow(schema))
+        super().__init__(wrapped, schema, child)
+        self.key_names = list(key_names)
+        self.aggs = list(aggs)
+
+    def node_desc(self) -> str:
+        fns = ", ".join(n for n, _, _ in self.aggs)
+        return (f"TpuAggregateInPandasExec [{fns}] "
+                f"keys={self.key_names}")
+
+
+class TpuWindowInPandasExec(_GroupedPandasBase):
+    """Pandas window UDFs over UNBOUNDED frames
+    (ref: GpuWindowInPandasExecBase — the whole-partition-frame case):
+    fn(series) -> scalar, broadcast to the group's rows."""
+
+    def __init__(self, key_names, fns, schema: T.Schema,
+                 child: TpuExec):
+        import functools
+
+        from spark_rapids_tpu.columnar.arrow import schema_to_arrow
+
+        wrapped = functools.partial(
+            _window_in_pandas_wrapper, fns=list(fns),
+            key_names=list(key_names),
+            aschema=schema_to_arrow(schema))
+        super().__init__(wrapped, schema, child)
+        self.key_names = list(key_names)
+        self.fns = list(fns)
+
+    def node_desc(self) -> str:
+        fns = ", ".join(n for n, _, _ in self.fns)
+        return (f"TpuWindowInPandasExec [{fns}] "
+                f"keys={self.key_names}")
+
+
+def _cogroup_wrapper(tbl, fn=None, left_keys=None, right_keys=None,
+                     aschema=None, n_left_cols=None, left_names=None,
+                     right_names=None):
+    """flatMapCoGroupsInPandas: the exec ships BOTH co-partitioned
+    sides in one table (left rows then right rows, prefixed columns);
+    the worker splits at the ARROW level — slicing before to_pandas so
+    null padding never degrades dtypes (int64 keys stay int64) — then
+    co-groups left keys against right keys and applies
+    fn(left_df, right_df)."""
+    import pandas as pd
+    import pyarrow as pa
+
+    n_l = int(pa.compute.sum(
+        pa.compute.equal(tbl["__side"], 0)).as_py() or 0)
+    lt = tbl.slice(0, n_l).select(
+        list(range(1, 1 + n_left_cols))).rename_columns(left_names)
+    rt = tbl.slice(n_l).select(
+        list(range(1 + n_left_cols,
+                   tbl.num_columns))).rename_columns(right_names)
+    left = lt.to_pandas()
+    right = rt.to_pandas()
+    lgroups = {k: g for k, g in left.groupby(left_keys, dropna=False,
+                                             sort=False)}
+    rgroups = {k: g for k, g in right.groupby(right_keys, dropna=False,
+                                              sort=False)}
+    outs = []
+    empty_l = left.iloc[0:0]
+    empty_r = right.iloc[0:0]
+    for key in dict.fromkeys(list(lgroups) + list(rgroups)):
+        g_l = lgroups.get(key, empty_l).reset_index(drop=True)
+        g_r = rgroups.get(key, empty_r).reset_index(drop=True)
+        outs.append(fn(g_l, g_r))
+    out = pd.concat(outs, ignore_index=True) if outs else None
+    if out is None or out.empty:
+        return aschema.empty_table()
+    return pa.Table.from_pandas(out, schema=aschema,
+                                preserve_index=False)
+
+
+class TpuFlatMapCoGroupsInPandasExec(TpuExec):
+    """cogroup().applyInPandas (ref: GpuFlatMapCoGroupsInPandasExec):
+    both sides hash-exchanged on their keys (co-partitioned), each
+    reduce partition ships as one combined table to the worker."""
+
+    def __init__(self, left_keys, right_keys, fn, schema: T.Schema,
+                 left: TpuExec, right: TpuExec):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = schema
+        self._pool = None
+        self._pool_lock = threading.Lock()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    def node_desc(self) -> str:
+        name = getattr(self.fn, "__name__", "fn")
+        return (f"TpuFlatMapCoGroupsInPandasExec [{name}] "
+                f"keys={self.left_keys}")
+
+    def additional_metrics(self):
+        return [("pythonBatches", "ESSENTIAL")]
+
+    def _get_pool(self):
+        import functools
+
+        from spark_rapids_tpu.columnar.arrow import schema_to_arrow
+
+        with self._pool_lock:
+            if self._pool is None:
+                from spark_rapids_tpu.python_worker import (
+                    PythonWorkerPool,
+                )
+
+                ls = self.children[0].schema
+                rs = self.children[1].schema
+                wrapped = functools.partial(
+                    _cogroup_wrapper, fn=self.fn,
+                    left_keys=self.left_keys,
+                    right_keys=self.right_keys,
+                    aschema=schema_to_arrow(self._schema),
+                    n_left_cols=len(ls.fields),
+                    left_names=[f.name for f in ls.fields],
+                    right_names=[f.name for f in rs.fields])
+                self._pool = PythonWorkerPool(wrapped)
+            return self._pool
+
+    def _combined(self, p: int):
+        """One host table carrying both sides of partition p."""
+        import pyarrow as pa
+
+        from spark_rapids_tpu.columnar.arrow import (
+            schema_to_arrow,
+            to_arrow,
+        )
+        from spark_rapids_tpu.columnar.batch import concat_batches
+
+        sides = []
+        for ci in (0, 1):
+            batches = list(self.children[ci].execute_partition(p))
+            if batches:
+                big = batches[0] if len(batches) == 1 else \
+                    concat_batches(batches)
+                sides.append(to_arrow(big))
+            else:
+                sides.append(schema_to_arrow(
+                    self.children[ci].schema).empty_table())
+        lt, rt = sides
+        n_l, n_r = lt.num_rows, rt.num_rows
+        if n_l == 0 and n_r == 0:
+            return None
+        import numpy as np
+
+        side = pa.array(np.concatenate(
+            [np.zeros(n_l, np.int8), np.ones(n_r, np.int8)]))
+        arrays = [side]
+        names = ["__side"]
+        for i, f in enumerate(lt.schema):
+            arrays.append(pa.concat_arrays(
+                [lt.column(i).combine_chunks(),
+                 pa.nulls(n_r, f.type)]))
+            names.append(f"__l_{f.name}")
+        for i, f in enumerate(rt.schema):
+            arrays.append(pa.concat_arrays(
+                [pa.nulls(n_l, f.type),
+                 rt.column(i).combine_chunks()]))
+            names.append(f"__r_{f.name}")
+        return pa.Table.from_arrays(arrays, names)
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.arrow import (
+            from_arrow,
+            schema_to_arrow,
+        )
+
+        combined = self._combined(p)
+        if combined is None:
+            return
+        aschema = schema_to_arrow(self._schema)
+        with MetricTimer(self.metrics[TOTAL_TIME]):
+            out = self._get_pool().run(combined).cast(aschema)
+        self.metrics["pythonBatches"].add(1)
+        yield self._count_output(from_arrow(out))
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+    def close(self) -> None:
+        super().close()
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.close()
+                self._pool = None

@@ -555,7 +555,61 @@ class Union(LogicalPlan):
         return total
 
 
+class GroupedPandas(LogicalPlan):
+    """Grouped pandas-UDF nodes (ref: the reference's python exec
+    family): kind in {"flatmap", "agg", "window"}; `payload` is the
+    user fn (flatmap) or [(out_name, fn, in_col)] (agg/window).
+    Requires ClusteredDistribution on `key_names` — the planner
+    inserts the hash exchange."""
+
+    def __init__(self, key_names, payload, schema, kind: str,
+                 child: LogicalPlan):
+        assert kind in ("flatmap", "agg", "window"), kind
+        self.children = [child]
+        self.key_names = list(key_names)
+        self.payload = payload
+        self.kind = kind
+        self._schema = schema
+        for k in self.key_names:
+            child.schema.index_of(k)  # raises on unknown key
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"GroupedPandas[{self.kind}] keys={self.key_names}"
+
+
+class CoGroupedPandas(LogicalPlan):
+    """cogroup(...).applyInPandas (ref: GpuFlatMapCoGroupsInPandasExec):
+    fn(left group frame, right group frame) -> frame."""
+
+    def __init__(self, left_keys, right_keys, fn, schema,
+                 left: LogicalPlan, right: LogicalPlan):
+        self.children = [left, right]
+        self.left_key_names = list(left_keys)
+        self.right_key_names = list(right_keys)
+        self.fn = fn
+        self._schema = schema
+        for k in self.left_key_names:
+            left.schema.index_of(k)
+        for k in self.right_key_names:
+            right.schema.index_of(k)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def node_desc(self) -> str:
+        return f"CoGroupedPandas keys={self.left_key_names}"
+
+
 class MapInArrow(LogicalPlan):
+    #: True when `fn` is a pandas-frame function (mapInPandas); the
+    #: planner then lowers to the pandas exec variant
+    pandas = False
+
     """Arrow-batch python transform over the child (the
     mapInArrow/mapInPandas family the reference schedules onto GPU
     python workers, ref: GpuArrowEvalPythonExec + python/rapids/

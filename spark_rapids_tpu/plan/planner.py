@@ -224,7 +224,8 @@ _EXEC_CONFS = {
     for cls in (L.InMemoryRelation, L.ParquetRelation, L.CsvRelation,
                 L.OrcRelation, L.RangeRel, L.Project, L.Filter,
                 L.Aggregate, L.Sort, L.Limit, L.Join, L.Union, L.Window,
-                L.Expand, L.Generate, L.MapInArrow)
+                L.Expand, L.Generate, L.MapInArrow, L.GroupedPandas,
+                L.CoGroupedPandas)
 }
 
 
@@ -523,9 +524,72 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
 
         return TpuGenerateExec(p.generator, p.schema, kids[0])
     if isinstance(p, L.MapInArrow):
-        from spark_rapids_tpu.execs.python_exec import TpuMapInArrowExec
+        from spark_rapids_tpu.execs.python_exec import (
+            TpuMapInArrowExec,
+            TpuMapInPandasExec,
+        )
 
+        if getattr(p, "pandas", False):
+            return TpuMapInPandasExec(p.fn, p.schema, kids[0])
         return TpuMapInArrowExec(p.fn, p.schema, kids[0])
+    if isinstance(p, L.CoGroupedPandas):
+        from spark_rapids_tpu.execs.exchange import (
+            SHUFFLE_PARTITIONS,
+            TpuShuffleExchangeExec,
+        )
+        from spark_rapids_tpu.execs.python_exec import (
+            TpuFlatMapCoGroupsInPandasExec,
+        )
+        from spark_rapids_tpu.ops.partition import HashPartitioning
+
+        n = get_conf().get(SHUFFLE_PARTITIONS)
+        sides = []
+        for kid, keys in ((kids[0], p.left_key_names),
+                          (kids[1], p.right_key_names)):
+            kexprs = [B.ColumnReference(k) for k in keys]
+            sides.append(TpuShuffleExchangeExec(
+                HashPartitioning(kexprs, n), kid))
+        return TpuFlatMapCoGroupsInPandasExec(
+            p.left_key_names, p.right_key_names, p.fn, p.schema,
+            sides[0], sides[1])
+    if isinstance(p, L.GroupedPandas):
+        from spark_rapids_tpu.execs.exchange import (
+            SHUFFLE_PARTITIONS,
+            TpuShuffleExchangeExec,
+        )
+        from spark_rapids_tpu.execs.python_exec import (
+            TpuAggregateInPandasExec,
+            TpuFlatMapGroupsInPandasExec,
+            TpuWindowInPandasExec,
+        )
+        from spark_rapids_tpu.ops.partition import HashPartitioning
+
+        source = kids[0]
+        keys = [B.ColumnReference(k) for k in p.key_names]
+        if source.num_partitions > 1 and p.key_names \
+                and _hash_satisfies(source, [
+                    B.BoundReference(
+                        source.schema.index_of(k),
+                        source.schema.field(k).dtype,
+                        source.schema.field(k).nullable, k)
+                    for k in p.key_names]) is None:
+            n = get_conf().get(SHUFFLE_PARTITIONS)
+            source = TpuShuffleExchangeExec(
+                HashPartitioning(keys, n), source)
+        elif source.num_partitions > 1 and not p.key_names:
+            from spark_rapids_tpu.execs.coalesce import (
+                TpuCoalescePartitionsExec,
+            )
+
+            source = TpuCoalescePartitionsExec(source)
+        if p.kind == "flatmap":
+            return TpuFlatMapGroupsInPandasExec(
+                p.key_names, p.payload, p.schema, source)
+        if p.kind == "agg":
+            return TpuAggregateInPandasExec(
+                p.key_names, p.payload, p.schema, source)
+        return TpuWindowInPandasExec(
+            p.key_names, p.payload, p.schema, source)
     if isinstance(p, L.Aggregate):
         return _plan_aggregate(p, kids[0])
     if isinstance(p, L.Sort):

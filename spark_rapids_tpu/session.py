@@ -326,6 +326,24 @@ class TpuSession:
         self.conf.set(SHUFFLE_TRANSPORT.key, "local")
 
 
+class _CoGrouped:
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        if isinstance(schema, pa.Schema):
+            schema = schema_from_arrow(schema)
+        return DataFrame(
+            L.CoGroupedPandas(
+                self._left._key_names(), self._right._key_names(),
+                fn, schema, self._left._df._plan,
+                self._right._df._plan),
+            self._left._df._session)
+
+
 class GroupedData:
     """Grouped frame; `grouping_sets` (a list of included-key-name sets)
     switches to the Expand-based grouping-set rewrite that Spark's
@@ -377,6 +395,67 @@ class GroupedData:
             return self._agg_grouping_sets(named)
         return DataFrame(
             L.Aggregate(self._keys, named, self._df._plan),
+            self._df._session)
+
+    def _key_names(self) -> list[str]:
+        names = []
+        for k in self._keys:
+            if isinstance(k, ColumnReference):
+                names.append(k.col_name)
+            elif hasattr(k, "out_name"):
+                names.append(k.out_name)
+            else:
+                raise ValueError(
+                    "grouped pandas UDFs need plain column keys")
+        return names
+
+    def cogroup(self, other: "GroupedData") -> "_CoGrouped":
+        """pyspark cogroup: pair with another grouped frame for
+        applyInPandas over co-grouped frames."""
+        return _CoGrouped(self, other)
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """pyspark applyInPandas (ref: GpuFlatMapGroupsInPandasExec):
+        fn(pd.DataFrame per group) -> pd.DataFrame with `schema`."""
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        if isinstance(schema, pa.Schema):
+            schema = schema_from_arrow(schema)
+        return DataFrame(
+            L.GroupedPandas(self._key_names(), fn, schema, "flatmap",
+                            self._df._plan),
+            self._df._session)
+
+    def agg_in_pandas(self, *aggs) -> "DataFrame":
+        """Pandas UDAFs (ref: GpuAggregateInPandasExec): each agg is
+        (out_name, fn(pd.Series) -> scalar, input_col); output =
+        group keys + one DOUBLE column per agg."""
+        from spark_rapids_tpu import types as T
+
+        child_schema = self._df._plan.schema
+        key_names = self._key_names()
+        fields = [child_schema.field(k) for k in key_names]
+        fields += [T.Field(name, T.DOUBLE, True)
+                   for name, _fn, _c in aggs]
+        return DataFrame(
+            L.GroupedPandas(key_names, list(aggs), T.Schema(fields),
+                            "agg", self._df._plan),
+            self._df._session)
+
+    def transform_in_pandas(self, *fns) -> "DataFrame":
+        """Pandas window UDFs over unbounded frames (ref:
+        GpuWindowInPandasExecBase): each entry is (out_name,
+        fn(pd.Series) -> scalar, input_col); the scalar broadcasts to
+        every row of its group, appended after the child's columns."""
+        from spark_rapids_tpu import types as T
+
+        child_schema = self._df._plan.schema
+        fields = list(child_schema.fields) + [
+            T.Field(name, T.DOUBLE, True) for name, _fn, _c in fns]
+        return DataFrame(
+            L.GroupedPandas(self._key_names(), list(fns),
+                            T.Schema(fields), "window",
+                            self._df._plan),
             self._df._session)
 
     def _expand_pivot(self, named: list[NamedAgg]) -> list[NamedAgg]:
@@ -589,6 +668,19 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self._plan), self._session)
+
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """pyspark mapInPandas (ref: GpuMapInPandasExec): fn over
+        pd.DataFrame batches in the isolated python worker pool."""
+        from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+        if isinstance(schema, pa.Schema):
+            eng_schema = schema_from_arrow(schema)
+        else:
+            eng_schema = schema
+        node = L.MapInArrow(fn, eng_schema, self._plan)
+        node.pandas = True
+        return DataFrame(node, self._session)
 
     def map_in_arrow(self, fn, schema) -> "DataFrame":
         """Apply `fn(pa.Table) -> pa.Table` batch-wise in a

@@ -49,7 +49,9 @@ DataWritingCommandExec ExpandExec FilterExec GenerateExec GlobalLimitExec
 HashAggregateExec LocalLimitExec ProjectExec RangeExec ShuffleExchangeExec
 SortAggregateExec SortExec TakeOrderedAndProjectExec UnionExec WindowExec
 BroadcastHashJoinExec FileSourceScanExec ShuffledHashJoinExec
-SortMergeJoinExec
+SortMergeJoinExec ArrowEvalPythonExec MapInPandasExec
+FlatMapGroupsInPandasExec AggregateInPandasExec WindowInPandasExec
+FlatMapCoGroupsInPandasExec
 """.split()
 
 REFERENCE_SCANS = ["CSVScan", "ParquetScan", "OrcScan"]
@@ -165,6 +167,21 @@ _EXEC_MAP: dict = {
                                   "TpuTakeOrderedAndProjectExec", ""),
     "UnionExec": ("spark_rapids_tpu.execs.basic", "TpuUnionExec", ""),
     "WindowExec": ("spark_rapids_tpu.execs.window", "TpuWindowExec", ""),
+    "ArrowEvalPythonExec": ("spark_rapids_tpu.execs.python_exec",
+                            "TpuMapInArrowExec",
+                            "arrow-batch python eval"),
+    "MapInPandasExec": ("spark_rapids_tpu.execs.python_exec",
+                        "TpuMapInPandasExec", ""),
+    "FlatMapGroupsInPandasExec": ("spark_rapids_tpu.execs.python_exec",
+                                  "TpuFlatMapGroupsInPandasExec", ""),
+    "AggregateInPandasExec": ("spark_rapids_tpu.execs.python_exec",
+                              "TpuAggregateInPandasExec", ""),
+    "WindowInPandasExec": ("spark_rapids_tpu.execs.python_exec",
+                           "TpuWindowInPandasExec",
+                           "unbounded frames"),
+    "FlatMapCoGroupsInPandasExec": (
+        "spark_rapids_tpu.execs.python_exec",
+        "TpuFlatMapCoGroupsInPandasExec", ""),
 }
 
 

@@ -1,0 +1,249 @@
+"""Pandas exec family tests (ref: sql/rapids/execution/python/* —
+GpuMapInPandasExec, GpuFlatMapGroupsInPandasExec,
+GpuAggregateInPandasExec, GpuWindowInPandasExecBase): user pandas code
+runs in the process-isolated worker pool; grouped variants ride a hash
+exchange making partitions key-disjoint."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import TpuSession, col
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+# worker fns must be module-level (pickled into the worker process)
+def _double_frame(df):
+    df = df.copy()
+    df["v"] = df["v"] * 2
+    return df
+
+
+def _group_summary(g):
+    import pandas as pd
+
+    return pd.DataFrame({"k": [g["k"].iloc[0]],
+                         "total": [g["v"].sum()],
+                         "n": [len(g)]})
+
+
+def _span(s):
+    return float(s.max() - s.min())
+
+
+def _mean(s):
+    return float(s.mean())
+
+
+def _table(n=600, seed=3, nulls=False):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 6, n)
+    v = rng.integers(0, 100, n)
+    if nulls:
+        k = pa.array([None if rng.random() < 0.1 else int(x)
+                      for x in k], pa.int64())
+    return pa.table({"k": k, "v": pa.array(v)})
+
+
+def test_map_in_pandas(session):
+    t = _table()
+    df = session.create_dataframe(t).map_in_pandas(
+        _double_frame, pa.schema([("k", pa.int64()),
+                                  ("v", pa.int64())]))
+    out = df.collect(engine="tpu").to_pydict()
+    assert out["v"] == [v * 2 for v in t["v"].to_pylist()]
+    tree_df = df.explain()
+    assert "MapInArrow" in tree_df or "MapInPandas" in tree_df
+
+
+def test_apply_in_pandas_grouped(session):
+    t = _table(nulls=True)
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .apply_in_pandas(_group_summary,
+                           pa.schema([("k", pa.int64()),
+                                      ("total", pa.int64()),
+                                      ("n", pa.int64())])))
+    got = {r["k"]: (r["total"], r["n"])
+           for r in df.collect(engine="tpu").to_pylist()}
+    import collections
+
+    want = collections.defaultdict(lambda: [0, 0])
+    for k, v in zip(t["k"].to_pylist(), t["v"].to_pylist()):
+        want[k][0] += v
+        want[k][1] += 1
+    assert got == {k: tuple(v) for k, v in want.items()}
+
+
+def test_apply_in_pandas_multi_partition_exchange(session, tmp_path):
+    """Multi-partition child: the planner inserts the hash exchange so
+    every group is complete within one worker call."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    t = _table(3000, seed=9)
+    for i in range(5):
+        pq.write_table(t.slice(i * 600, 600),
+                       str(tmp_path / f"p{i}.parquet"))
+    get_conf().set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1024)
+    df = (session.read_parquet(str(tmp_path))
+          .group_by(col("k"))
+          .apply_in_pandas(_group_summary,
+                           pa.schema([("k", pa.int64()),
+                                      ("total", pa.int64()),
+                                      ("n", pa.int64())])))
+    exec_, meta = plan_query(df._plan, session.conf)
+    tree = exec_.tree_string()
+    assert "TpuFlatMapGroupsInPandasExec" in tree, tree
+    assert "TpuShuffleExchangeExec" in tree, tree
+    got = {r["k"]: r["n"] for r in
+           df.collect(engine="tpu").to_pylist()}
+    import collections
+
+    assert got == collections.Counter(t["k"].to_pylist())
+
+
+def test_aggregate_in_pandas(session):
+    t = _table()
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .agg_in_pandas(("span", _span, "v"), ("m", _mean, "v")))
+    rows = df.collect(engine="tpu").to_pylist()
+    assert df.collect(engine="tpu").column_names == ["k", "span", "m"]
+    kk, vv = t["k"].to_pylist(), t["v"].to_pylist()
+    for r in rows:
+        vs = [v for k, v in zip(kk, vv) if k == r["k"]]
+        assert r["span"] == max(vs) - min(vs)
+        assert abs(r["m"] - sum(vs) / len(vs)) < 1e-9
+
+
+def test_window_in_pandas_unbounded(session):
+    t = _table(400, seed=11)
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .transform_in_pandas(("gmean", _mean, "v")))
+    out = df.collect(engine="tpu")
+    assert out.num_rows == 400
+    assert out.column_names == ["k", "v", "gmean"]
+    rows = out.to_pylist()
+    kk, vv = t["k"].to_pylist(), t["v"].to_pylist()
+    means = {}
+    for r in rows:
+        vs = [v for k, v in zip(kk, vv) if k == r["k"]]
+        means.setdefault(r["k"], sum(vs) / len(vs))
+        assert abs(r["gmean"] - means[r["k"]]) < 1e-9
+
+
+def test_grouped_pandas_cpu_engine_matches(session):
+    """The CPU engine evaluates the same grouped wrappers (fallback
+    parity)."""
+    t = _table(300, seed=13)
+    df = (session.create_dataframe(t)
+          .group_by(col("k"))
+          .agg_in_pandas(("span", _span, "v")))
+    got = sorted(map(tuple, (r.values() for r in
+                             df.collect(engine="tpu").to_pylist())))
+    want = sorted(map(tuple, (r.values() for r in
+                              df.collect(engine="cpu").to_pylist())))
+    assert got == want
+
+
+def test_udf_error_surfaces(session):
+    df = session.create_dataframe(_table(50)).map_in_pandas(
+        _failing, pa.schema([("k", pa.int64()), ("v", pa.int64())]))
+    from spark_rapids_tpu.python_worker import UdfError
+
+    with pytest.raises(UdfError):
+        df.collect(engine="tpu")
+
+
+def _failing(df):
+    raise ValueError("user code exploded")
+
+
+def _cogroup_merge(gl, gr):
+    import pandas as pd
+
+    k = gl["k"].iloc[0] if len(gl) else gr["k"].iloc[0]
+    return pd.DataFrame({
+        "k": [k],
+        "nl": [len(gl)],
+        "nr": [len(gr)],
+        "sum_both": [float((gl["v"].sum() if len(gl) else 0)
+                           + (gr["w"].sum() if len(gr) else 0))],
+    })
+
+
+def test_cogroup_apply_in_pandas(session):
+    """cogroup().applyInPandas (ref: GpuFlatMapCoGroupsInPandasExec):
+    keys present on only one side still produce a group."""
+    rng = np.random.default_rng(17)
+    left = pa.table({"k": rng.integers(0, 5, 400),
+                     "v": rng.integers(0, 50, 400)})
+    right = pa.table({"k": pa.array([0, 1, 2, 9, 9]),
+                      "w": pa.array([10, 20, 30, 40, 50])})
+    gl = session.create_dataframe(left).group_by(col("k"))
+    gr = session.create_dataframe(right).group_by(col("k"))
+    df = gl.cogroup(gr).apply_in_pandas(
+        _cogroup_merge,
+        pa.schema([("k", pa.int64()), ("nl", pa.int64()),
+                   ("nr", pa.int64()), ("sum_both", pa.float64())]))
+    rows = {r["k"]: r for r in df.collect(engine="tpu").to_pylist()}
+    import collections
+
+    lc = collections.Counter(left["k"].to_pylist())
+    for k in set(lc) | {9}:
+        assert rows[k]["nl"] == lc.get(k, 0)
+    assert rows[9]["nr"] == 2 and rows[9]["sum_both"] == 90.0
+
+
+def _cg_diffkeys(gl, gr):
+    import pandas as pd
+
+    k = gl["id"].iloc[0] if len(gl) else gr["rid"].iloc[0]
+    return pd.DataFrame({"id": [k], "nl": [len(gl)], "nr": [len(gr)]})
+
+
+def test_cogroup_different_key_names_and_big_int_keys(session):
+    """Review regressions: right side groups by ITS key names, and
+    int64 keys past 2**53 stay exact (no float degradation)."""
+    big = 2**53
+    left = pa.table({"id": pa.array([big, big + 1], pa.int64()),
+                     "v": pa.array([1, 2])})
+    right = pa.table({"rid": pa.array([big + 1], pa.int64()),
+                      "w": pa.array([10])})
+    gl = session.create_dataframe(left).group_by(col("id"))
+    gr = session.create_dataframe(right).group_by(col("rid"))
+    df = gl.cogroup(gr).apply_in_pandas(
+        _cg_diffkeys, pa.schema([("id", pa.int64()),
+                                 ("nl", pa.int64()),
+                                 ("nr", pa.int64())]))
+    rows = {r["id"]: (r["nl"], r["nr"])
+            for r in df.collect(engine="tpu").to_pylist()}
+    assert rows == {big: (1, 0), big + 1: (1, 1)}, rows
+
+
+def test_keyless_grouped_pandas(session):
+    t = pa.table({"k": pa.array([1, 2]), "v": pa.array([3.0, 5.0])})
+    df = (session.create_dataframe(t).group_by()
+          .agg_in_pandas(("m", _mean, "v")))
+    assert df.collect(engine="tpu").to_pylist() == [{"m": 4.0}]
+
+
+def test_map_in_pandas_plans_dedicated_exec(session):
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    df = session.create_dataframe(_table(50)).map_in_pandas(
+        _double_frame, pa.schema([("k", pa.int64()),
+                                  ("v", pa.int64())]))
+    exec_, _ = plan_query(df._plan, session.conf)
+    assert "TpuMapInPandasExec" in exec_.tree_string()
+    # CPU fallback path evaluates the pandas fn too
+    got = df.collect(engine="cpu").to_pydict()["v"]
+    assert got == [v * 2 for v in _table(50)["v"].to_pylist()]
