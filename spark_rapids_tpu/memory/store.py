@@ -416,6 +416,24 @@ class BufferStore:
                 if not self._spill_one_device():
                     break  # nothing spillable left; let XLA try anyway
 
+    def leak_report(self) -> list[str]:
+        """Still-registered buffers (the all-buffers-released invariant
+        check SURVEY.md §5.2 calls for; the reference leans on cudf's
+        RefCount debugging — here the store itself is the registry, so
+        leak detection is a dictionary walk).  Healthy shutdown (and
+        end-of-test) state: empty."""
+        with self._lock:
+            return [
+                f"buffer {bid}: tier={e.tier.name} pins={e.pins} "
+                f"bytes={e.nbytes}"
+                for bid, e in self._entries.items()]
+
+    def assert_all_released(self) -> None:
+        leaks = self.leak_report()
+        assert not leaks, (
+            f"{len(leaks)} buffer(s) never released:\n  "
+            + "\n  ".join(leaks))
+
     def spill_all_unpinned(self) -> int:
         """Evict every unpinned DEVICE buffer to host — the
         release-everything step between task retry attempts (ref:

@@ -142,3 +142,59 @@ def test_query_correct_under_forced_spill():
     finally:
         conf.set(BATCH_SIZE_ROWS.key, old_rows)
         reset_store()
+
+
+def test_store_leak_invariant():
+    """SURVEY.md §5.2: a store-wide all-buffers-released check exists
+    and reports leaked registrations precisely."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.store import BufferStore, SpillPriorities
+
+    store = BufferStore(device_budget=1 << 30)
+    store.assert_all_released()  # fresh store is clean
+    schema = T.Schema([T.Field("x", T.LONG)])
+    b = ColumnarBatch.from_numpy(
+        {"x": np.arange(10, dtype=np.int64)}, schema)
+    h = store.register(b, SpillPriorities.ACTIVE_ON_DECK)
+    leaks = store.leak_report()
+    assert len(leaks) == 1 and "tier=DEVICE" in leaks[0]
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError, match="never released"):
+        store.assert_all_released()
+    h.close()
+    store.assert_all_released()
+
+
+def test_query_leaves_store_clean():
+    """End-to-end query lifecycle releases every spill-store buffer
+    (shuffle blocks, build sides, coalesce parking)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+    from spark_rapids_tpu.memory import get_store
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+    from spark_rapids_tpu.shuffle import reset_shuffle_manager
+
+    session = TpuSession()
+    conf = get_conf()
+    old = conf.get(BATCH_SIZE_ROWS)
+    conf.set(BATCH_SIZE_ROWS.key, 128)
+    try:
+        rng = np.random.default_rng(3)
+        t = pa.table({"k": rng.integers(0, 5, 1000),
+                      "v": rng.integers(0, 9, 1000)})
+        df = (session.create_dataframe(t)
+              .group_by(col("k")).agg((sum_(col("v")), "s")))
+        df.collect(engine="tpu")
+        # shuffle blocks live until their shuffle unregisters; reset
+        # releases them — afterwards NOTHING may remain registered
+        reset_shuffle_manager()
+        leaks = get_store().leak_report()
+        assert not leaks, leaks
+    finally:
+        conf.set(BATCH_SIZE_ROWS.key, old)
