@@ -720,6 +720,36 @@ class DataFrame:
 
     # -- actions --------------------------------------------------------- #
 
+    def to_device_arrays(self) -> list[dict]:
+        """Execute on TPU and hand back the DEVICE-RESIDENT results as
+        jax arrays — no D2H round trip (the ColumnarRdd analog, ref:
+        sql/rapids/execution/InternalColumnarRddConverter.scala /
+        ColumnarRdd.scala exposing GPU Tables to ML libraries
+        zero-copy).  Returns one dict per batch:
+        {column_name: jax.Array (physical values),
+         column_name + "__valid": jax.Array bool} plus "__num_rows";
+        a jax model consumes the SQL output straight from HBM.
+
+        Nested (struct/map/list) output columns are not exposed this
+        way — project to flat columns first."""
+        from spark_rapids_tpu.columnar.column import Column
+
+        conf = self._session.conf
+        exec_, _meta = plan_query(self._plan, conf)
+        out = []
+        for b in exec_.execute():
+            d: dict = {}
+            for f, c in zip(b.schema.fields, b.columns):
+                if not isinstance(c, Column):
+                    raise TypeError(
+                        f"column {f.name!r} ({f.dtype.name}) has no "
+                        "flat device array form — project it first")
+                d[f.name] = c.data
+                d[f.name + "__valid"] = c.validity
+            d["__num_rows"] = b.num_rows
+            out.append(d)
+        return out
+
     def collect(self, engine: Optional[str] = None) -> pa.Table:
         """engine: 'tpu' (plan rewrite + fallback), 'cpu' (reference
         engine), default from spark.rapids.tpu.sql.enabled."""

@@ -205,3 +205,30 @@ def test_tpch_q1_shape(spark):
                 (count_star(), "count_order"))
            .order_by("l_returnflag", "l_linestatus"))
     assert_tpu_cpu_equal(q, ignore_order=False, approx_float=True)
+
+
+def test_to_device_arrays_zero_copy_into_jax():
+    """ColumnarRdd-analog export (ref: ColumnarRdd.scala): SQL results
+    stay on device and feed jax code directly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.exprs.base import lit
+
+    session = TpuSession()
+    rng = np.random.default_rng(4)
+    t = pa.table({"x": rng.random(500), "y": rng.random(500)})
+    df = (session.create_dataframe(t)
+          .where(col("x") > lit(0.5))
+          .select(col("x"), (col("x") * col("y")).alias("xy")))
+    batches = df.to_device_arrays()
+    assert batches and all(isinstance(b["x"], jax.Array)
+                           for b in batches)
+    # consume straight from HBM: a jitted reduction over the batches
+    total = sum(float(jnp.sum(jnp.where(b["xy__valid"], b["xy"], 0.0)))
+                for b in batches)
+    x, y = np.asarray(t["x"]), np.asarray(t["y"])
+    want = float((x[x > 0.5] * y[x > 0.5]).sum())
+    assert abs(total - want) < 1e-6 * max(1.0, abs(want))
