@@ -1,0 +1,64 @@
+"""Datetime rebase policy for Parquet reads.
+
+Counterpart of the reference's RebaseHelper + GpuParquetScan rebase
+gating (ref: com/nvidia/spark/RebaseHelper.scala,
+GpuParquetScan.scala:226-241): files written by Spark 2.x — or by
+Spark 3.x in LEGACY mode (the `org.apache.spark.legacyDateTime` file
+metadata marker) — carry hybrid Julian/Gregorian datetimes that would
+silently read shifted for pre-1582 values.  Policy mirrors Spark's
+`datetimeRebaseModeInRead`:
+
+- EXCEPTION (default): legacy-calendar files with date/timestamp
+  columns are refused with guidance;
+- CORRECTED: values are trusted as proleptic Gregorian (correct for
+  post-1582 data, the overwhelmingly common case);
+- LEGACY rebase arithmetic is not implemented (falls under EXCEPTION).
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import register
+
+_SPARK_VERSION_KEY = b"org.apache.spark.version"
+_SPARK_LEGACY_KEY = b"org.apache.spark.legacyDateTime"
+
+REBASE_MODE_READ = register(
+    "spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead", "EXCEPTION",
+    "Handling of Parquet files written under the legacy hybrid "
+    "Julian/Gregorian calendar (Spark 2.x, or Spark 3.x LEGACY mode): "
+    "EXCEPTION refuses them when the read includes date/timestamp "
+    "columns; CORRECTED trusts the stored values as proleptic "
+    "Gregorian (the spark.sql.parquet.datetimeRebaseModeInRead "
+    "analog; ref: RebaseHelper.scala + GpuParquetScan.scala:226).",
+    check=lambda v: v in ("EXCEPTION", "CORRECTED"))
+
+
+def file_is_legacy_calendar(file_metadata) -> bool:
+    """True when the file's key-value metadata marks hybrid-calendar
+    datetimes (the isCorrectedRebaseMode logic, inverted)."""
+    kv = file_metadata.metadata or {}
+    version = kv.get(_SPARK_VERSION_KEY)
+    if version is None:
+        return False  # not Spark-written: proleptic (pyarrow et al.)
+    if kv.get(_SPARK_LEGACY_KEY) is not None:
+        return True  # Spark 3.x LEGACY mode marker
+    return version.decode(errors="replace") < "3.0.0"
+
+
+def check_rebase(path: str, file_metadata, schema: T.Schema,
+                 mode: str) -> None:
+    """Raise under EXCEPTION mode for legacy-calendar files whose read
+    touches datetime columns."""
+    if mode == "CORRECTED":
+        return
+    has_datetime = any(isinstance(f.dtype, (T.DateType, T.TimestampType))
+                       for f in schema.fields)
+    if has_datetime and file_is_legacy_calendar(file_metadata):
+        raise ValueError(
+            f"Parquet file {path!r} was written with the legacy hybrid "
+            "Julian/Gregorian calendar; pre-1582 datetimes would read "
+            "shifted. Set "
+            "spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead="
+            "CORRECTED to read the stored values as proleptic "
+            "Gregorian (ref: Spark's datetimeRebaseModeInRead).")

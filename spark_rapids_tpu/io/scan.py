@@ -368,6 +368,13 @@ class ParquetScanExec(TpuExec):
             return
 
         f = pq.ParquetFile(self.paths[fi])
+        from spark_rapids_tpu.io.rebase import REBASE_MODE_READ, check_rebase
+
+        read_fields = [fl for fl in self._schema.fields
+                       if self.columns is None or fl.name in self.columns]
+        check_rebase(self.paths[fi], f.metadata, T.Schema(read_fields),
+                     getattr(self, "_rebase_mode", None)
+                     or _config.get_conf().get(REBASE_MODE_READ))
         n_rgs = f.metadata.num_row_groups
         if conjuncts is not None:
             keep_rgs = [g for g in range(n_rgs)
@@ -507,6 +514,9 @@ class ParquetScanExec(TpuExec):
         conf = _config.get_conf()
         self._fast_decode = conf.get(FAST_DECODE)
         self._max_batch_bytes = conf.get(MAX_READ_BATCH_BYTES)
+        from spark_rapids_tpu.io.rebase import REBASE_MODE_READ
+
+        self._rebase_mode = conf.get(REBASE_MODE_READ)
 
         def task():
             import os
