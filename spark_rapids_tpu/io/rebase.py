@@ -52,8 +52,22 @@ def check_rebase(path: str, file_metadata, schema: T.Schema,
     touches datetime columns."""
     if mode == "CORRECTED":
         return
-    has_datetime = any(isinstance(f.dtype, (T.DateType, T.TimestampType))
-                       for f in schema.fields)
+
+    def has_dt(dt: T.DataType) -> bool:
+        # recurse like Spark's dataTypeExistsRecursively: nested
+        # datetimes (list<timestamp>, struct fields, map values) are
+        # just as rebase-sensitive as top-level ones
+        if isinstance(dt, (T.DateType, T.TimestampType)):
+            return True
+        if isinstance(dt, T.ListType):
+            return has_dt(dt.element)
+        if isinstance(dt, T.StructType):
+            return any(has_dt(f.dtype) for f in dt.fields)
+        if isinstance(dt, T.MapType):
+            return has_dt(dt.key) or has_dt(dt.value)
+        return False
+
+    has_datetime = any(has_dt(f.dtype) for f in schema.fields)
     if has_datetime and file_is_legacy_calendar(file_metadata):
         raise ValueError(
             f"Parquet file {path!r} was written with the legacy hybrid "

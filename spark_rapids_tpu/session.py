@@ -326,6 +326,59 @@ class TpuSession:
         self.conf.set(SHUFFLE_TRANSPORT.key, "local")
 
 
+def _prune_scan_columns(plan, exprs):
+    """Column pruning into file scans (Spark's ColumnPruning rule, at
+    the logical-build seam where references are still by NAME): a
+    select directly above an unpruned file relation rebuilds the
+    relation to read only the referenced columns — fewer bytes
+    decoded, and rebase/fastpar checks see the true read schema."""
+    import copy as _copy
+
+    from spark_rapids_tpu.plan.logical import OrcRelation, ParquetRelation
+
+    if not isinstance(plan, (ParquetRelation, OrcRelation)) \
+            or plan.columns is not None:
+        return plan
+    refs: set = set()
+
+    def walk(e) -> bool:
+        """Collect referenced names; False = unprunable reference."""
+        from spark_rapids_tpu.exprs.base import BoundReference
+        from spark_rapids_tpu.exprs.nondeterministic import InputFileName
+        from spark_rapids_tpu.exprs.window import WindowExpression
+
+        if isinstance(e, BoundReference):
+            return False  # pre-bound ordinals would shift
+        if isinstance(e, InputFileName):
+            return True  # rewritten later; reads no file column
+        if isinstance(e, ColumnReference):
+            refs.add(e.col_name)
+            return True
+        return all(walk(c) for c in e.children)
+
+    if not all(walk(e) for e in exprs):
+        return plan
+    names = [f.name for f in plan.schema.fields if f.name in refs]
+    if not names or len(names) == len(plan.schema.fields):
+        # nothing referenced (pure generated columns) or nothing to
+        # prune: keep the full scan — the zero-column count-only path
+        # belongs to aggregates, not projections
+        return plan
+    # COPY the relation instead of re-running __init__: the ctor would
+    # re-expand paths (losing Hive partition discovery on bare file
+    # lists) and re-read a footer
+    part_names = {f.name for f in plan.partition_fields}
+    by_name = {f.name: f for f in plan.schema.fields}
+    rel2 = _copy.copy(plan)
+    rel2.columns = [n for n in names if n not in part_names]
+    rel2.partition_fields = [f for f in plan.partition_fields
+                             if f.name in refs]
+    rel2._schema = T.Schema(
+        [by_name[n] for n in names if n not in part_names]
+        + rel2.partition_fields)
+    return rel2
+
+
 class _CoGrouped:
     def __init__(self, left: "GroupedData", right: "GroupedData"):
         self._left = left
@@ -551,7 +604,9 @@ class DataFrame:
         exprs_ = [_expr(e) for e in exprs]
         acc: list[tuple[WindowExpression, str]] = []
         rewritten = [_extract_windows(e, acc) for e in exprs_]
-        plan = self._plan
+        # prune on the ORIGINAL exprs: window/generator extraction
+        # introduces synthetic refs that hide the real columns
+        plan = _prune_scan_columns(self._plan, exprs_)
 
         # generator extraction (ref: Spark's ExtractGenerator rule):
         # a top-level explode/posexplode becomes a Generate node under

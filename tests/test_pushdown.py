@@ -165,6 +165,56 @@ def test_legacy_rebase_files_refused_then_corrected(tmp_path):
     assert session.read_parquet(p2).collect(engine="tpu").num_rows == 5
     # reading only non-datetime columns from the legacy file is fine
     # (the check covers the READ schema, like the reference's clipped
-    # schema; NOTE a bare select() does not prune scan columns here)
+    # schema — via explicit columns= or select-time pruning)
     out = session.read_parquet(p, columns=["v"]).collect(engine="tpu")
     assert out.num_rows == 5
+
+
+def test_select_prunes_scan_columns(tmp_path):
+    """ColumnPruning analog: a select above an unpruned file relation
+    rebuilds the scan to read only the referenced columns."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import TpuSession, col
+
+    t = pa.table({"a": np.arange(100), "b": np.arange(100) * 2.0,
+                  "c": np.arange(100) * 3})
+    p = str(tmp_path / "wide.parquet")
+    pq.write_table(t, p)
+    session = TpuSession()
+    df = session.read_parquet(p).select(
+        (col("a") + lit(1)).alias("a1"), col("c"))
+    rel = df._plan.children[0] if df._plan.children else None
+    assert rel is not None and rel.columns == ["a", "c"], rel.columns
+    out = df.collect(engine="tpu").to_pydict()
+    assert out["a1"][:3] == [1, 2, 3] and out["c"][:3] == [0, 3, 6]
+    # unprunable shapes keep the full scan (select *)
+    df2 = session.read_parquet(p).select(col("a"), col("b"), col("c"))
+    assert df2._plan.children[0].columns is None
+
+
+def test_prune_preserves_hive_partition_columns(tmp_path):
+    """Regression: pruning copies the relation (never re-expands
+    paths), so Hive partition columns survive."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.session import TpuSession, col
+
+    for part in ("x=1", "x=2"):
+        d = tmp_path / part
+        d.mkdir()
+        pq.write_table(pa.table({"a": np.arange(10),
+                                 "b": np.arange(10) * 2.0}),
+                       str(d / "f.parquet"))
+    session = TpuSession()
+    df = session.read_parquet(str(tmp_path)).select(col("a"), col("x"))
+    rel = df._plan.children[0]
+    assert rel.columns == ["a"]
+    assert [f.name for f in rel.partition_fields] == ["x"]
+    out = df.collect(engine="tpu").to_pydict()
+    assert sorted(set(out["x"])) == [1, 2] and len(out["a"]) == 20
