@@ -173,10 +173,27 @@ class _CollectiveBase(TpuExec):
         """Output batches per mesh shard (subclass responsibility)."""
         raise NotImplementedError
 
+    #: guards per-instance materialization-lock creation
+    _MAT_GUARD = __import__("threading").Lock()
+
     def _shard_outputs(self) -> list[list[ColumnarBatch]]:
+        """Materialize EXACTLY once even under concurrent per-partition
+        consumers (an exchange's map-task pool drives every partition
+        from its own thread; unsynchronized, N threads would run N
+        overlapping SPMD programs and race the jit caches)."""
+        import threading
+
         out = getattr(self, "_shards_out", None)
-        if out is None:
-            out = self._shards_out = self._materialize()
+        if out is not None:
+            return out
+        with _CollectiveBase._MAT_GUARD:
+            lk = getattr(self, "_mat_lock", None)
+            if lk is None:
+                lk = self._mat_lock = threading.Lock()
+        with lk:
+            out = getattr(self, "_shards_out", None)
+            if out is None:
+                out = self._shards_out = self._materialize()
         return out
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
