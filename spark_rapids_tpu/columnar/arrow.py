@@ -368,6 +368,10 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
     comps: list[np.ndarray] = []
     recipe: list[tuple] = []  # (kind, first-component index, dtype)
     for arr, f in zip(arrays, schema.fields):
+        if isinstance(arr, pa.DictionaryArray):
+            # only the wire encoder ships dicts as-is; this fallback
+            # materializes (cast through the value type)
+            arr = arr.cast(arr.type.value_type)
         if isinstance(f.dtype, T.StringType):
             chars, lengths, valid = _string_host(arr, cap)
             recipe.append(("str", len(comps), f.dtype))
@@ -427,6 +431,17 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
     million-row capacity bucket is a 1-row transfer, not a 100MB one)."""
     from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
 
+    # the host rebuild reads only chars/lengths/validity: drop the dict
+    # sidecar so its codes (full capacity) never cross the D2H link
+    import dataclasses as _dc
+
+    if any(isinstance(c, StringColumn) and c.codes is not None
+           for c in batch.columns):
+        batch = _CB([
+            _dc.replace(c, codes=None, dict_chars=None, dict_lens=None)
+            if isinstance(c, StringColumn) and c.codes is not None else c
+            for c in batch.columns], batch.num_rows, batch.schema)
+
     if batch.capacity <= 1024 and not isinstance(batch.num_rows, int):
         # small batch with a device-resident row count (aggregate
         # results, limits): fetch the count WITH the components in one
@@ -435,7 +450,7 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
         # device_get batches every leaf of every column (incl. nested).
         n_host, host_cols = jax.device_get(
             (batch.num_rows, list(batch.columns)))
-        n = int(n_host)
+        n = int(np.asarray(n_host).reshape(()))
     else:
         n = batch.concrete_num_rows()
         shrunk_cap = max(128, -(-n // 128) * 128)

@@ -265,8 +265,11 @@ def _col_to_pylist(col, dtype: T.DataType, n: int) -> list:
 def _shrink_col(c: AnyColumn, new_cap: int) -> AnyColumn:
     """Slice a column to a smaller capacity (recursive for nesting)."""
     if isinstance(c, StringColumn):
-        return StringColumn(c.chars[:new_cap], c.lengths[:new_cap],
-                            c.validity[:new_cap])
+        return StringColumn(
+            c.chars[:new_cap], c.lengths[:new_cap], c.validity[:new_cap],
+            c.dtype,
+            c.codes[:new_cap] if c.codes is not None else None,
+            c.dict_chars, c.dict_lens)
     if isinstance(c, ListColumn):
         return ListColumn(c.values[:new_cap], c.lengths[:new_cap],
                           c.elem_validity[:new_cap],
@@ -300,6 +303,48 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         parts = [b.columns[ci] for b in batches]
         out_cols.append(_concat_cols(parts, ns, cap, f.dtype))
     return ColumnarBatch(out_cols, total, schema)
+
+
+def concat_batches_traced(batches: Sequence[ColumnarBatch]
+                          ) -> Optional[ColumnarBatch]:
+    """Concatenate small batches WITHOUT host row counts: stack every
+    part at full capacity, then compact the dead interior rows inside
+    the program, yielding a prefix-compact batch with a traced total.
+
+    This is the sizing-sync-free sibling of concat_batches: on
+    high-latency device links each host sizing fetch costs a full D2H
+    round trip, which dominates small-partial pipelines (aggregate
+    partials are a few hundred rows in <=4K-capacity buckets).  The
+    compact pays O(total_cap log total_cap) device work — trivial at
+    these sizes, never worth it for scan-sized batches.
+
+    Returns None when a column kind has no stacked form yet (nested
+    types) — callers fall back to the host-pinned path."""
+    schema = batches[0].schema
+    caps = [b.capacity for b in batches]
+    out_cols: list[AnyColumn] = []
+    for ci, f in enumerate(schema.fields):
+        parts = [b.columns[ci] for b in batches]
+        if isinstance(f.dtype, T.StringType):
+            w = pad_width(max(p.width for p in parts))
+            chars = jnp.concatenate(
+                [jnp.pad(p.chars, ((0, 0), (0, w - p.width)))
+                 if p.width < w else p.chars for p in parts])
+            lengths = jnp.concatenate(
+                [p.lengths.astype(jnp.int32) for p in parts])
+            valid = jnp.concatenate([p.validity for p in parts])
+            out_cols.append(StringColumn(chars, lengths, valid))
+        elif isinstance(f.dtype, (T.ListType, T.StructType, T.MapType)):
+            return None
+        else:
+            phys = T.to_numpy_dtype(f.dtype)
+            data = jnp.concatenate(
+                [p.data.astype(phys) for p in parts])
+            valid = jnp.concatenate([p.validity for p in parts])
+            out_cols.append(Column(data, valid, f.dtype))
+    keep = jnp.concatenate([b.row_mask() for b in batches])
+    stacked = ColumnarBatch(out_cols, sum(caps), schema)
+    return stacked.compact(keep)
 
 
 def _concat_cols(parts: list, ns: list[int], cap: int,

@@ -147,13 +147,27 @@ class StringColumn:
 
     dtype: T.DataType = dataclasses.field(default_factory=lambda: T.STRING)
 
+    #: Optional dictionary sidecar, populated when the wire encoder
+    #: shipped this column dict-encoded (columnar/transfer.py "sdict"):
+    #: `codes[capacity]` int32 (0 on null/padding rows), plus the
+    #: device-resident dictionary `dict_chars[k, w]` / `dict_lens[k]`.
+    #: The group-by coded fast path (ops/groupby.py) uses the codes as
+    #: dense group ids, skipping the O(n log n) lexsort entirely.  Ops
+    #: that cannot cheaply preserve the sidecar (concat, expression
+    #: results) drop it; consumers must treat it as a hint, never a
+    #: requirement.
+    codes: Optional[ArrayLike] = None
+    dict_chars: Optional[ArrayLike] = None
+    dict_lens: Optional[ArrayLike] = None
+
     def tree_flatten(self):
-        return (self.chars, self.lengths, self.validity), (self.dtype,)
+        return (self.chars, self.lengths, self.validity, self.codes,
+                self.dict_chars, self.dict_lens), (self.dtype,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        chars, lengths, validity = children
-        return cls(chars, lengths, validity, aux[0])
+        chars, lengths, validity, codes, dchars, dlens = children
+        return cls(chars, lengths, validity, aux[0], codes, dchars, dlens)
 
     @property
     def capacity(self) -> int:
@@ -164,7 +178,7 @@ class StringColumn:
         return int(self.chars.shape[1])
 
     def with_validity(self, validity: ArrayLike) -> "StringColumn":
-        return StringColumn(self.chars, self.lengths, validity)
+        return dataclasses.replace(self, validity=validity)
 
     def gather(self, indices: ArrayLike, index_valid: Optional[ArrayLike] = None
                ) -> "StringColumn":
@@ -174,7 +188,11 @@ class StringColumn:
         validity = jnp.take(self.validity, idx, axis=0)
         if index_valid is not None:
             validity = validity & index_valid
-        return StringColumn(chars, lengths, validity)
+        # per-row codes follow the gather; the dictionary is row-invariant
+        codes = (jnp.take(self.codes, idx, axis=0)
+                 if self.codes is not None else None)
+        return StringColumn(chars, lengths, validity, self.dtype,
+                            codes, self.dict_chars, self.dict_lens)
 
     @staticmethod
     def from_list(values: list[Optional[str]],

@@ -30,6 +30,7 @@ compiled decode program serves every batch of the same plan.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Optional, Sequence
 
@@ -207,64 +208,32 @@ def _padded(a: np.ndarray, wire: int) -> np.ndarray:
 class _Comps:
     """Component accumulator producing the physical upload list.
 
-    Every wire array pays a full link round trip on tunneled PJRT
-    backends, so components are PHYSICALLY packed into as few arrays as
-    possible while keeping the decode program free of 64-bit bitcasts
-    (the TPU X64 rewriter cannot compile those):
-
-    - all <=4-byte components (codes, deltas, lengths, validity, chars,
-      scaled ints) pack into ONE uint8 buffer, recovered on device with
-      32-bit-safe bitcast_convert_type;
-    - all float64 values (dict values, scale divisors) concatenate into
-      ONE f64 sidecar;
-    - int64 scalars (bias bases) split into lo/hi uint32 halves inside
-      the byte buffer and recombine with i64 arithmetic;
-    - only raw 64-bit DATA columns remain individual arrays.
+    Each component rides as its OWN array in one batched
+    ``jax.device_put`` call (PJRT moves the whole list in one transfer
+    round, measured at parity with a single staging buffer on the
+    tunneled backend).  An earlier design packed all sub-4-byte
+    components into one uint8 buffer recovered with device slices +
+    bitcast_convert_type; that was abandoned after XLA:TPU's layout
+    pass was observed taking 100-500 SECONDS to compile decode programs
+    whose big slices did not exactly tile the staging buffer (the
+    multi-megabyte slice-of-uint8 copies defeat the bitcast-view
+    recognition and send tiling assignment into a pathological search).
+    Separate typed arrays compile in ~2s, need zero bitcasts, and make
+    the X64-rewriter caveat moot.
 
     add() returns an opaque ref the plan stores; the decode program
-    resolves refs against (buffer, sidecar, extras...).
+    resolves refs against the uploaded list.
     """
 
     def __init__(self):
-        self.buf_parts: list[tuple[int, np.ndarray]] = []  # (off, arr)
-        self.buf_off = 0
-        self.f64_parts: list[np.ndarray] = []
-        self.f64_off = 0
-        self.extras: list[np.ndarray] = []
+        self.arrays: list[np.ndarray] = []
 
     def add(self, a: np.ndarray):
-        a = np.ascontiguousarray(a)
-        if a.dtype == np.float64:
-            off = self.f64_off
-            self.f64_parts.append(a.reshape(-1))
-            self.f64_off += a.size
-            return ("f64", off, a.shape)
-        if a.dtype == np.int64 and a.ndim == 0:
-            lo = np.uint32(int(a) & 0xFFFFFFFF)
-            hi = np.uint32((int(a) >> 32) & 0xFFFFFFFF)
-            return ("i64s", self._add_bytes(np.stack([lo, hi])))
-        if a.dtype.itemsize <= 4 and a.dtype != np.int64:
-            return ("buf", self._add_bytes(a), a.shape, str(a.dtype))
-        off = len(self.extras)
-        self.extras.append(a)
-        return ("arr", off)
-
-    def _add_bytes(self, a: np.ndarray) -> int:
-        off = _round_up(self.buf_off, 4)
-        self.buf_parts.append((off, a))
-        self.buf_off = off + a.nbytes
-        return off
+        self.arrays.append(np.ascontiguousarray(a))
+        return ("arr", len(self.arrays) - 1)
 
     def finish(self) -> list[np.ndarray]:
-        total = _round_up(max(self.buf_off, 4), 4)
-        buf = np.zeros(total, np.uint8)
-        for off, a in self.buf_parts:
-            buf[off: off + a.nbytes] = a.view(np.uint8).reshape(-1)
-        out = [buf]
-        out.append(np.concatenate(self.f64_parts)
-                   if self.f64_parts else np.zeros(1, np.float64))
-        out.extend(self.extras)
-        return out
+        return self.arrays
 
 
 def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
@@ -288,6 +257,17 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
     entries: list[tuple] = []
 
     for arr, f in zip(arrays, schema.fields):
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if isinstance(arr, pa.DictionaryArray):
+            # dictionary came straight from the Parquet page (fastpar):
+            # ship codes + values with no re-encode and, for strings,
+            # no full-column materialization at all
+            e = _encode_dict_direct(comps, arr, f.dtype, wire)
+            if e is not None:
+                entries.append(e)
+                continue
+            arr = arr.cast(arr.type.value_type)
         if isinstance(f.dtype, T.StringType):
             entries.append(_encode_string(comps, arr, wire))
             continue
@@ -334,6 +314,61 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
     return comps.finish(), plan
 
 
+def _encode_dict_direct(comps: _Comps, arr: pa.DictionaryArray,
+                        dtype: T.DataType, wire: int) -> Optional[tuple]:
+    """A pre-dictionary-encoded column -> wire dict/sdict entry, trusting
+    the source dictionary (values came FROM it, so the round trip is
+    exact by construction).  None = no dict wire form for this type."""
+    dvals = arr.dictionary
+    nvals = len(dvals)
+    if nvals > 0xFFFF or dvals.null_count:
+        # a null INSIDE the dictionary hides row nulls from
+        # arr.is_valid() (index-level only): take the plain path,
+        # which decodes through the value type and keeps the nulls
+        return None
+    validity = np.asarray(arr.is_valid()) if arr.null_count else None
+    codes = arr.indices.to_numpy(zero_copy_only=False)
+    if validity is not None:
+        codes = np.where(validity, codes, 0)
+    if isinstance(dtype, T.StringType):
+        return _sdict_entry(comps, codes, dvals, validity, wire)
+    if isinstance(dtype, (T.DecimalType, T.ListType, T.StructType,
+                          T.MapType)):
+        return None
+    dnp, dvalid = _decode_fixed_host(dvals, dtype)
+    if dvalid is not None:
+        return None
+    code_dt = np.uint8 if nvals <= 0x100 else np.uint16
+    nvp = max(8, pad_capacity(max(nvals, 1)))
+    vref = comps.add(_padded(validity, wire)) if validity is not None \
+        else None
+    cref = comps.add(_padded(codes.astype(code_dt), wire))
+    extra = (comps.add(_padded(dnp, nvp)),)
+    return ("fixed", "dict", cref, str(dnp.dtype), extra, vref)
+
+
+def _sdict_entry(comps: _Comps, codes: np.ndarray, dvals: pa.Array,
+                 validity: Optional[np.ndarray],
+                 wire: int) -> Optional[tuple]:
+    """Assemble one string-dictionary wire entry (shared by the direct
+    DictionaryArray path and the host re-encode path); None when the
+    dictionary exceeds the wire's uint16 length/size format."""
+    nvals = len(dvals)
+    if nvals > 0xFFFF:
+        return None
+    dchars, dlens = _chars_matrix(dvals.cast(pa.large_string()))
+    if dlens.size and int(dlens.max()) > 0xFFFF:
+        return None
+    code_dt = np.uint8 if nvals <= 0x100 else np.uint16
+    nvp = max(8, pad_capacity(max(nvals, 1)))
+    vref = comps.add(_padded(validity, wire)) if validity is not None \
+        else None
+    cref = comps.add(_padded(codes.astype(code_dt), wire))
+    dcref = comps.add(_padded(dchars, nvp))
+    dlref = comps.add(_padded(dlens.astype(np.uint16), nvp))
+    return ("sdict", cref, dcref, dlref, vref)
+
+
 def _encode_string(comps: _Comps, arr: pa.Array, wire: int) -> tuple:
     """Encode one string column; returns its plan entry."""
     sarr = arr.cast(pa.large_string())
@@ -345,29 +380,25 @@ def _encode_string(comps: _Comps, arr: pa.Array, wire: int) -> tuple:
     lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
     if validity is not None:
         lens = np.where(validity, lens, 0).astype(np.int32)
-    vref = None
-    if validity is not None:
-        vref = comps.add(_padded(validity, wire))
 
     # dictionary attempt: low-cardinality string columns ship codes only
     if _string_dict_gate(sarr):
         d = sarr.dictionary_encode()
         dvals = d.dictionary
-        if len(dvals) <= 0xFFFF and len(dvals) * 2 <= max(n, 1):
+        if (len(dvals) * 2 <= max(n, 1)
+                and not dvals.null_count):
             codes = d.indices.to_numpy(zero_copy_only=False)
             if validity is not None:
                 codes = np.where(validity, codes, 0)
-            code_dt = np.uint8 if len(dvals) <= 0x100 else np.uint16
-            nvp = max(8, pad_capacity(len(dvals)))
-            dchars, dlens = _chars_matrix(dvals.cast(pa.large_string()))
-            if not dlens.size or int(dlens.max()) <= 0xFFFF:
-                cref = comps.add(_padded(codes.astype(code_dt), wire))
-                dcref = comps.add(_padded(dchars, nvp))
-                dlref = comps.add(_padded(dlens.astype(np.uint16), nvp))
-                return ("sdict", cref, dcref, dlref, vref)
+            e = _sdict_entry(comps, codes, dvals, validity, wire)
+            if e is not None:
+                return e
             # >=64KB dictionary values would wrap the uint16 length
             # wire format: fall through to the raw layout (int32 lens)
 
+    vref = None
+    if validity is not None:
+        vref = comps.add(_padded(validity, wire))
     chars, _ = _chars_matrix(sarr, lens)
     cref = comps.add(_padded(chars, wire))
     # lengths >= 64KiB would wrap uint16: widen the wire type (the
@@ -435,40 +466,8 @@ def _make_decode(plan: tuple):
         return jnp.concatenate([a, z], axis=0)
 
     def decode(xs):
-        buf, sidecar = xs[0], xs[1]
-
         def read(ref):
-            """Resolve one component ref against the physical arrays —
-            only 32-bit-safe bitcasts (see _Comps)."""
-            if ref[0] == "buf":
-                _, off, shape, dt = ref
-                npdt = np.dtype(dt)
-                count = int(np.prod(shape)) if shape else 1
-                raw = jax.lax.slice(buf, (off,),
-                                    (off + count * npdt.itemsize,))
-                if npdt == np.uint8:
-                    col = raw
-                elif npdt == np.bool_:
-                    col = raw != 0
-                elif npdt.itemsize == 1:
-                    col = jax.lax.bitcast_convert_type(
-                        raw, jnp.dtype(npdt))
-                else:
-                    col = jax.lax.bitcast_convert_type(
-                        raw.reshape(count, npdt.itemsize),
-                        jnp.dtype(npdt))
-                return col.reshape(shape)
-            if ref[0] == "f64":
-                _, off, shape = ref
-                count = int(np.prod(shape)) if shape else 1
-                return jax.lax.slice(
-                    sidecar, (off,), (off + count,)).reshape(shape)
-            if ref[0] == "i64s":
-                words = read(("buf", ref[1], (2,), "uint32"))
-                lo = words[0].astype(jnp.int64)
-                hi = words[1].astype(jnp.int64)
-                return (hi << 32) | lo
-            return xs[2 + ref[1]]  # "arr"
+            return xs[ref[1]]  # one typed array per component
 
         n_live = read(n_ref)
         live_mask = jnp.arange(cap, dtype=jnp.int32) < n_live
@@ -513,10 +512,33 @@ def _make_decode(plan: tuple):
                 lens = grow(jnp.take(read(dlref).astype(jnp.int32),
                                      codes, axis=0)) \
                     * v.astype(jnp.int32)
-                out.append((chars, lens, v))
+                # codes + dictionary ride along as the column's dict
+                # sidecar: the group-by coded fast path uses codes as
+                # dense group ids (no sort).  grow() pads dead rows
+                # with code 0; consumers gate on validity/row masks.
+                out.append((chars, lens, v, grow(codes), read(dcref),
+                            read(dlref).astype(jnp.int32)))
         return out
 
     return decode
+
+
+def _wrap_cols(parts, schema: T.Schema):
+    """Decode-program outputs -> AnyColumn list (traceable)."""
+    cols = []
+    for f, p in zip(schema.fields, parts):
+        if isinstance(f.dtype, T.StringType):
+            if len(p) == 6:  # sdict: dictionary sidecar rides along
+                chars, lens, valid, codes, dchars, dlens = p
+                cols.append(StringColumn(chars, lens, valid, f.dtype,
+                                         codes, dchars, dlens))
+                continue
+            chars, lens, valid = p
+            cols.append(StringColumn(chars, lens, valid))
+        else:
+            data, valid = p
+            cols.append(Column(data, valid, f.dtype))
+    return cols
 
 
 def decode_on_device(comps: list, plan: tuple, schema: T.Schema):
@@ -531,12 +553,74 @@ def decode_on_device(comps: list, plan: tuple, schema: T.Schema):
                 _unpack_cache.pop(next(iter(_unpack_cache)))
     dev = jax.device_put(comps)
     parts = fn(dev)
-    cols = []
-    for f, p in zip(schema.fields, parts):
-        if isinstance(f.dtype, T.StringType):
-            chars, lens, valid = p
-            cols.append(StringColumn(chars, lens, valid))
-        else:
-            data, valid = p
-            cols.append(Column(data, valid, f.dtype))
-    return cols
+    return _wrap_cols(parts, schema)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class EncodedBatch:
+    """A scan batch still in WIRE form: uploaded components + static
+    decode plan.  Consumers that jit their per-batch work (the fusable
+    pipeline driver, the hash aggregate's update phase) decode INSIDE
+    their own program, so scan->filter->aggregate is one program
+    execution per batch — on the tunneled backend every execution pays
+    a link round trip once any D2H fetch has happened, so collapsing
+    decode+transform+update into one program is a direct latency win
+    (the reference gets the same effect by chaining cudf kernels inside
+    one task, GpuParquetScan.scala:495-560 -> GpuFilterExec).
+
+    `num_rows` is the host-known live count for metrics/accumulation
+    bookkeeping; it deliberately does NOT survive tracing (the decode
+    derives the traced count from the wire components), so one compiled
+    consumer program serves every ragged tail.
+    """
+
+    comps: list
+    plan: tuple
+    schema: T.Schema
+    num_rows: Optional[int] = None
+
+    def tree_flatten(self):
+        return (tuple(self.comps),), (self.plan, self.schema)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (comps,) = children
+        return cls(list(comps), aux[0], aux[1], None)
+
+    @property
+    def capacity(self) -> int:
+        return self.plan[0]
+
+    def decode(self):
+        """Traceable: wire components -> ColumnarBatch with a traced
+        live-row count (read off the wire's n component)."""
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+        decode = _make_decode(self.plan)
+        cols = _wrap_cols(decode(self.comps), self.schema)
+        n_ref = self.plan[2]
+        n_live = self.comps[n_ref[1]]
+        return ColumnarBatch(cols, jnp.asarray(n_live, jnp.int32),
+                             self.schema)
+
+    def decode_now(self):
+        """Eager fallback for consumers that do not fuse the decode."""
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+        cols = decode_on_device(self.comps, self.plan, self.schema)
+        n = self.num_rows
+        if n is None:
+            n = int(jax.device_get(self.comps[self.plan[2][1]]))
+        return ColumnarBatch(cols, n, self.schema)
+
+
+def encode_batch(arrays: Sequence[pa.Array], schema: T.Schema,
+                 n: int) -> Optional[EncodedBatch]:
+    """Host Arrow columns -> EncodedBatch (one batched H2D upload), or
+    None when a column type has no wire encoding."""
+    enc = encode_for_device(arrays, schema, n)
+    if enc is None:
+        return None
+    comps, plan = enc
+    return EncodedBatch(jax.device_put(comps), plan, schema, n)

@@ -43,7 +43,9 @@ from spark_rapids_tpu.ops.groupby import (
 )
 
 
-def _as_device_rows(batch: ColumnarBatch) -> ColumnarBatch:
+def _as_device_rows(batch):
+    if not isinstance(batch, ColumnarBatch):
+        return batch  # EncodedBatch: traced count rides the wire comps
     return batch.with_device_num_rows()
 
 
@@ -187,8 +189,12 @@ class TpuHashAggregateExec(TpuExec):
             io += n_in
         return specs
 
-    def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
-        """Project inputs then run the update aggregation (traceable)."""
+    def _update_batch(self, batch: ColumnarBatch,
+                      live_mask=None) -> ColumnarBatch:
+        """Project inputs then run the update aggregation (traceable).
+        `live_mask` carries fused WHERE predicates from an absorbed
+        filter chain — masked rows never existed, but no compaction
+        kernels are paid for them."""
         from spark_rapids_tpu.columnar.column import MIN_CAPACITY
 
         ctx = EvalContext.for_batch(batch)
@@ -196,13 +202,14 @@ class TpuHashAggregateExec(TpuExec):
         proj = ColumnarBatch(cols, batch.num_rows, self.update_input_schema)
         specs = self._update_specs()
         if self.n_keys == 0:
-            out = reduce_aggregate(proj, specs, self.partial_schema)
+            out = reduce_aggregate(proj, specs, self.partial_schema,
+                                   live_mask)
             # exactly one live row: compact to the minimum bucket INSIDE
             # the program so no eager slicing (or giant partial buffers)
             # happens outside it
             return out.shrink_to_capacity(MIN_CAPACITY)
         return groupby_aggregate(proj, list(range(self.n_keys)), specs,
-                                 self.partial_schema)
+                                 self.partial_schema, live_mask)
 
     def _merge_batch(self, partial: ColumnarBatch) -> ColumnarBatch:
         if self.n_keys == 0:
@@ -213,6 +220,66 @@ class TpuHashAggregateExec(TpuExec):
                 self.partial_schema).shrink_to_capacity(MIN_CAPACITY)
         return groupby_aggregate(partial, list(range(self.n_keys)),
                                  self.merge_specs, self.partial_schema)
+
+    def _drain_final_fused(self, pending):
+        """Final drain as ONE program: concat (traced stack+compact) +
+        merge + finalize, mode-dependent.  Saves 2-3 program executions
+        per stream tail vs the stepwise drain — each execution is a
+        link round trip on the tunneled backend.  Returns None when the
+        shapes don't qualify (large/nested partials), in which case the
+        caller runs the stepwise path."""
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        batches = [h.get() for h in pending]
+        if (len(batches) == 1 and self.mode == "partial") \
+                or sum(b.capacity for b in batches) > 4 * 4096 \
+                or any(isinstance(f.dtype,
+                                  (T.ListType, T.StructType, T.MapType))
+                       for f in batches[0].schema.fields):
+            return None
+        from spark_rapids_tpu.columnar.batch import concat_batches_traced
+
+        mode, n_parts = self.mode, len(batches)
+
+        def prog(bs):
+            b = concat_batches_traced(bs) if len(bs) > 1 else bs[0]
+            if n_parts > 1 or mode == "final":
+                b = self._merge_batch(b)
+            if mode != "partial":
+                b = self._finalize_batch(b)
+            return b
+
+        struct = tuple(
+            (b.capacity, isinstance(b.num_rows, int),
+             tuple(c.width for c in b.columns if hasattr(c, "width")))
+            for b in batches)
+        fn = cached_jit(("aggdrainfused", self._cache_key(), struct),
+                        lambda: prog)
+        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            out = t.observe(fn([b.with_device_num_rows()
+                                for b in batches]))
+        for h in pending:
+            h.close()
+        pending.clear()
+        return out
+
+    def _jit_concat_traced(self, batches: list[ColumnarBatch]):
+        """Device-side stack+compact concat for small partials with
+        traced row counts (see columnar.batch.concat_batches_traced).
+        Returns None when a column kind is unsupported there."""
+        from spark_rapids_tpu.columnar.batch import concat_batches_traced
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        if any(isinstance(f.dtype, (T.ListType, T.StructType, T.MapType))
+               for f in batches[0].schema.fields):
+            return None
+        struct = tuple(
+            (b.capacity,
+             tuple(c.width for c in b.columns if hasattr(c, "width")))
+            for b in batches)
+        fn = cached_jit(("aggconcat_traced", self._cache_key(), struct),
+                        lambda: concat_batches_traced)
+        return fn(batches)
 
     def _jit_concat(self, batches: list[ColumnarBatch]) -> ColumnarBatch:
         """Concatenate pending partials in ONE compiled program: eager
@@ -262,18 +329,48 @@ class TpuHashAggregateExec(TpuExec):
             return part
         return None
 
+    def _absorbed_chain(self):
+        """(fns, source_node, keys) when the fusable child chain folds
+        into the update program — the whole filter/project/update path
+        then runs as ONE program execution per batch (each execution
+        pays a link round trip on the tunneled backend once any D2H
+        fetch has happened).  None when the chain needs its own driver
+        (ANSI error polling, partition-aware exprs, uncacheable keys).
+        Side effect of absorption: the absorbed execs' per-node metrics
+        do not tick (their execute() never runs)."""
+        with self._jit_lock:
+            cached = getattr(self, "_absorb", "unset")
+            if cached != "unset":
+                return cached
+            from spark_rapids_tpu.execs.base import FusableExec
+            from spark_rapids_tpu.exprs.base import ansi_enabled
+
+            result = None
+            child = self.children[0]
+            if (self.mode != "final" and isinstance(child, FusableExec)
+                    and not ansi_enabled()):
+                chain, node, aware, keys = child.fusion_chain()
+                if not aware and all(k is not None for k in keys):
+                    result = (chain, node, tuple(keys))
+            self._absorb = result
+            return result
+
+    def _source_node(self) -> TpuExec:
+        ch = self._absorbed_chain()
+        return ch[1] if ch is not None else self.children[0]
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         if self.mode == "complete":
             assert self.num_partitions == 1
             if p == 0:
                 yield from self.execute()
             return
-        yield from self._run_stream(self.children[0].execute_partition(p),
+        yield from self._run_stream(self._source_node().execute_partition(p),
                                     emit_empty_default=(p == 0))
 
     def execute(self) -> Iterator[ColumnarBatch]:
         if self.mode == "complete":
-            yield from self._run_stream(self.children[0].execute(),
+            yield from self._run_stream(self._source_node().execute(),
                                         emit_empty_default=True)
         else:
             for p in range(self.num_partitions):
@@ -281,6 +378,7 @@ class TpuHashAggregateExec(TpuExec):
 
     def _run_stream(self, source,
                     emit_empty_default: bool) -> Iterator[ColumnarBatch]:
+        chain = self._absorbed_chain()
         with self._jit_lock:
             # exchange map tasks run partial aggregates concurrently; a
             # field-by-field lazy init could be observed half-done
@@ -288,8 +386,44 @@ class TpuHashAggregateExec(TpuExec):
                 from spark_rapids_tpu.execs.jit_cache import cached_jit
 
                 key = self._cache_key()
+                execs = chain[0] if chain is not None else []
+                ckeys = chain[2] if chain is not None else ()
+                from spark_rapids_tpu.execs.basic import TpuFilterExec
+
+                # filters become row MASKS (no compaction kernels) when
+                # nothing in the chain multiplies rows — row positions
+                # then stay stable through the whole chain, and the
+                # masked rows simply never join a group
+                as_masks = not any(e.MULTIPLIES_ROWS for e in execs)
+                stages = []  # ("mask", cond) | ("fn", batch_fn)
+                for e in execs:
+                    if as_masks and isinstance(e, TpuFilterExec):
+                        stages.append(("mask", e.condition))
+                    else:
+                        stages.append(("fn", e.make_batch_fn()))
+
+                def update_full(b):
+                    from spark_rapids_tpu.columnar.transfer import (
+                        EncodedBatch,
+                    )
+                    from spark_rapids_tpu.exprs.base import EvalContext
+
+                    if isinstance(b, EncodedBatch):
+                        b = b.decode()  # wire decode fused in-program
+                    mask = None
+                    for kind, st in stages:
+                        if kind == "mask":
+                            pred = st.eval(EvalContext.for_batch(b))
+                            m = pred.data.astype(bool) & pred.validity
+                            mask = m if mask is None else (mask & m)
+                        else:
+                            b = st(b)
+                    return self._update_batch(b, mask)
+
+                upd = cached_jit(key + ("absorb", ckeys, "update"),
+                                 lambda: update_full)
                 self._jits = (
-                    cached_jit(key + ("update",), lambda: self._update_batch),
+                    upd,
                     cached_jit(key + ("merge",), lambda: self._merge_batch),
                     cached_jit(key + ("final",),
                                lambda: self._finalize_batch))
@@ -304,7 +438,31 @@ class TpuHashAggregateExec(TpuExec):
         pending: list = []  # SpillableBatch handles
 
         def drain_pending() -> ColumnarBatch:
+            import dataclasses
+
             batches = [h.get() for h in pending]
+            traced = [i for i, b in enumerate(batches)
+                      if not isinstance(b.num_rows, int)]
+            if (traced and len(batches) > 1
+                    and sum(b.capacity for b in batches) <= 4 * 4096):
+                # small partials: concatenate ON DEVICE (stack+compact,
+                # traced total) so the drain needs no sizing fetch at
+                # all — the query's only D2H round trip stays the final
+                # result pull
+                out = self._jit_concat_traced(batches)
+                if out is not None:
+                    for h in pending:
+                        h.close()
+                    pending.clear()
+                    return out
+            # deferred sizing: pin every traced row count in ONE batched
+            # D2H fetch (per-batch device_get round trips dominate
+            # grouped-aggregate wall time on high-latency device links)
+            if traced:
+                ns = jax.device_get([batches[i].num_rows for i in traced])
+                for i, n in zip(traced, ns):
+                    batches[i] = dataclasses.replace(batches[i],
+                                                     num_rows=int(n))
             if len(batches) == 1:
                 out = batches[0]
             elif self.n_keys == 0:
@@ -337,13 +495,36 @@ class TpuHashAggregateExec(TpuExec):
 
         import dataclasses
 
+        #: partials at or below this capacity skip the per-batch sizing
+        #: sync and shrink: the drain pins all their sizes in one batched
+        #: fetch instead.  Each skipped sync saves a full device_get
+        #: round trip — hundreds of ms on a degraded tunnel link.
+        DEFER_SYNC_CAP = 4096
+
         pending_rows = 0
         for batch in source:
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:
                 if self.mode == "final":
-                    part = _as_device_rows(batch)  # already partial layout
+                    part = batch  # already partial layout
                 else:
                     part = t.observe(self._jit_update(_as_device_rows(batch)))
+            if (not isinstance(part.num_rows, int)
+                    and part.capacity <= DEFER_SYNC_CAP):
+                pending.append(store.register(
+                    part, SpillPriorities.AGGREGATE_PARTIAL))
+                pending_rows += part.capacity  # upper bound; drain pins
+                if len(pending) > 1 and pending_rows >= min(
+                        self.goal_rows, 2 * DEFER_SYNC_CAP):
+                    # bound pending without a sizing sync: re-merge via
+                    # the traced concat; the merged partial stays traced
+                    with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                        merged = t.observe(self._jit_merge(
+                            _as_device_rows(drain_pending())))
+                    self.metrics["numMerges"].add(1)
+                    pending.append(store.register(
+                        merged, SpillPriorities.AGGREGATE_PARTIAL))
+                    pending_rows = merged.capacity
+                continue
             # one sizing sync per batch (free when the update emitted a
             # static count, e.g. grand aggregates); pin the host int into
             # the batch so downstream concat/shrink never re-syncs
@@ -369,13 +550,18 @@ class TpuHashAggregateExec(TpuExec):
             if self.n_keys > 0 or not emit_empty_default:
                 return  # grouped aggregate of empty input: no rows
             # grand aggregate of empty input: one default row (only the
-            # first partition emits it)
-            eb = ColumnarBatch.empty(self.children[0].schema)
+            # first partition emits it); absorbed chains start from the
+            # SOURCE node's schema (the chain may include projections)
+            eb = ColumnarBatch.empty(self._source_node().schema)
             if self.mode != "final":
                 eb = self._jit_update(_as_device_rows(eb))
             pending.append(store.register(
                 eb, SpillPriorities.AGGREGATE_PARTIAL))
 
+        out = self._drain_final_fused(pending)
+        if out is not None:
+            yield self._count_output(out)
+            return
         with MetricTimer(self.metrics[TOTAL_TIME]) as t:
             single = len(pending) == 1
             merged = drain_pending()

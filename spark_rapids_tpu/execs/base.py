@@ -65,7 +65,9 @@ class TpuMetric:
                 return
             pending, self._pending = self._pending, []
         # blocking transfer outside the lock
-        s = sum(int(x) for x in jax.device_get(pending))
+        import numpy as _np
+
+        s = sum(int(_np.asarray(x).sum()) for x in jax.device_get(pending))
         with self._lock:
             self._value += s
 
@@ -74,7 +76,10 @@ class TpuMetric:
         with self._lock:
             pending, self._pending = self._pending, []
         if pending:
-            s = sum(int(x) for x in jax.device_get(pending))
+            import numpy as _np
+
+            s = sum(int(_np.asarray(x).sum())
+                    for x in jax.device_get(pending))
             with self._lock:
                 self._value += s
         with self._lock:
@@ -337,10 +342,16 @@ class FusableExec(TpuExec):
     def num_partitions(self) -> int:
         return self.children[0].num_partitions
 
-    def _fused_pipeline(self):
-        cached = getattr(self, "_fused", None)
-        if cached is not None:
-            return cached
+    def fusion_chain(self):
+        """(fns, source_node, aware, keys): the composed per-batch
+        transform chain rooted here, UN-jitted — minor-first (fns[0]
+        runs first).  `keys` are the per-exec fuse keys (None entries =
+        uncacheable).  Lets a non-fusable CONSUMER (e.g. the hash
+        aggregate's update phase) absorb this chain into its own traced
+        program, so the whole scan->filter->update path is one program
+        execution per batch — on the tunneled backend each execution
+        pays a link round trip once any D2H fetch has occurred, so
+        program count, not FLOPs, bounds small-query latency."""
         from spark_rapids_tpu.exprs.nondeterministic import (
             tree_is_partition_aware,
         )
@@ -361,7 +372,15 @@ class FusableExec(TpuExec):
             execs.append(node)  # type: ignore[arg-type]
             aware = aware or is_aware(node)
             node = node.children[0]
-        fns: list[BatchFn] = [e.make_batch_fn() for e in reversed(execs)]
+        return (list(reversed(execs)), node, aware,
+                [e.fuse_key() for e in execs])
+
+    def _fused_pipeline(self):
+        cached = getattr(self, "_fused", None)
+        if cached is not None:
+            return cached
+        chain, node, aware, keys = self.fusion_chain()
+        fns: list[BatchFn] = [e.make_batch_fn() for e in chain]
         from spark_rapids_tpu.exprs.base import (
             ansi_capture,
             ansi_enabled,
@@ -393,7 +412,6 @@ class FusableExec(TpuExec):
                     batch = f(batch)
                 return batch
 
-        keys = [e.fuse_key() for e in execs]
         if all(k is not None for k in keys):
             from spark_rapids_tpu.execs.jit_cache import cached_jit
 
@@ -404,7 +422,46 @@ class FusableExec(TpuExec):
         self._fused = (jitted, node, aware, ansi)
         return self._fused
 
+    def _fused_pipeline_encoded(self):
+        """Jitted pipeline variant whose input is a wire-form
+        EncodedBatch: the decode runs inside the same program as the
+        transform chain (one execution per batch)."""
+        cached = getattr(self, "_fused_enc", None)
+        if cached is not None:
+            return cached
+        chain, node, aware, keys = self.fusion_chain()
+        fns = [e.make_batch_fn() for e in chain]
+        from spark_rapids_tpu.exprs.base import (
+            ansi_capture,
+            ansi_enabled,
+            fold_ansi_flags,
+        )
+
+        ansi = ansi_enabled()
+
+        def pipeline(eb):
+            batch = eb.decode()
+            if ansi:
+                with ansi_capture() as flags:
+                    for f in fns:
+                        batch = f(batch)
+                return batch, fold_ansi_flags(flags)
+            for f in fns:
+                batch = f(batch)
+            return batch
+
+        if all(k is not None for k in keys):
+            from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+            jitted = cached_jit(("fusedenc", tuple(keys), ansi),
+                                lambda: pipeline)
+        else:
+            jitted = jax.jit(pipeline)
+        self._fused_enc = jitted
+        return jitted
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.transfer import EncodedBatch
         from spark_rapids_tpu.exprs.base import raise_if_ansi_error
 
         fused, node, aware, ansi = self._fused_pipeline()
@@ -412,6 +469,20 @@ class FusableExec(TpuExec):
             pidx = jnp.asarray(p, jnp.int32)
             off = jnp.asarray(0, jnp.int64)
         for batch in node.execute_partition(p):
+            if isinstance(batch, EncodedBatch):
+                if aware:
+                    # partition-aware chains thread (pidx, off) through
+                    # a different signature; decode eagerly instead
+                    batch = batch.decode_now()
+                else:
+                    with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                        out = self._fused_pipeline_encoded()(batch)
+                        if ansi:
+                            out, err = out
+                            raise_if_ansi_error(jax.device_get(err))
+                        out = t.observe(out)
+                    yield self._count_output(out)
+                    continue
             b = batch.with_device_num_rows()
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:
                 if aware:

@@ -564,6 +564,23 @@ def _filter_project(cols, filter_cols, n_rows, engine_schema, columns,
     arrays = []
     for name in columns:
         fc = cols[name]
+        if fc.codes is not None and len(fc.dict_values) <= 0xFFFF:
+            # keep the PARQUET dictionary: ship codes + dict values as a
+            # pa.DictionaryArray so the wire encoder maps them straight
+            # to its dict entries — no host materialization of the full
+            # column and no re-dictionary_encode (the dominant host
+            # costs of dict-heavy scans)
+            codes = fc.codes if idx is None else fc.codes[idx]
+            try:
+                dvals = pa.array(fc.dict_values)
+                want = arrow_types.get(name)
+                if want is not None and dvals.type != want:
+                    dvals = dvals.cast(want)  # cast the SMALL dict side
+                arrays.append(pa.DictionaryArray.from_arrays(
+                    pa.array(codes.astype(np.int32)), dvals))
+                continue
+            except Exception:
+                pass  # fall through to materialized path
         vals = fc.materialize() if idx is None else fc.take(idx)
         arr = pa.array(vals)
         want = arrow_types.get(name)

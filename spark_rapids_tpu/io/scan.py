@@ -97,7 +97,7 @@ FILES_PER_TASK_BYTES = _config.register(
     "GpuParquetScan.scala:882 MultiFileParquetPartitionReader).")
 
 MAX_READ_BATCH_BYTES = _config.register(
-    "spark.rapids.tpu.sql.scan.maxReadBatchSizeBytes", 64 << 20,
+    "spark.rapids.tpu.sql.scan.maxReadBatchSizeBytes", 128 << 20,
     "Target device bytes per scanned batch (ref: "
     "spark.rapids.sql.reader.batchSizeBytes, RapidsConf.scala:446). "
     "Scan batches are sized rows = bytes/estimated-row-width: batches "
@@ -452,8 +452,44 @@ class ParquetScanExec(TpuExec):
             self.metrics["hostFilteredRows"].add(kept_rg_rows - after)
         return tables
 
+    @staticmethod
+    def _harmonize_dicts(tables: list) -> list:
+        """Decode dictionary columns to plain wherever the accumulated
+        tables disagree (one file kept its Parquet dict, another came
+        back plain) — pa.concat_tables requires identical schemas."""
+        if len(tables) <= 1 or len({t.schema for t in tables}) <= 1:
+            return tables
+        out = []
+        for t in tables:
+            cols, changed = {}, False
+            for name in t.schema.names:
+                c = t[name]
+                if pa.types.is_dictionary(c.type):
+                    c = c.cast(c.type.value_type)
+                    changed = True
+                cols[name] = c
+            out.append(pa.table(cols) if changed else t)
+        return out
+
     def _upload(self, tables: list) -> ColumnarBatch:
+        tables = self._harmonize_dicts(tables)
         tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        if getattr(self, "emit_encoded", False) and tbl.num_rows > 0:
+            # planner marked the consumer as decode-fusing: ship the
+            # batch in wire form; the consumer's program decodes it
+            # (one program execution per batch instead of two)
+            from spark_rapids_tpu.columnar.transfer import encode_batch
+
+            tbl = tbl.combine_chunks()
+            arrays = []
+            for c in tbl.columns:
+                a = c.combine_chunks() if isinstance(c, pa.ChunkedArray) \
+                    else c
+                arrays.append(a.chunk(0) if isinstance(a, pa.ChunkedArray)
+                              else a)
+            eb = encode_batch(arrays, self._schema, tbl.num_rows)
+            if eb is not None:
+                return eb
         b = from_arrow(tbl)
         return ColumnarBatch(b.columns, b.num_rows, self._schema)
 
@@ -584,6 +620,7 @@ class ParquetScanExec(TpuExec):
             acc.append(item)
             acc_rows += item.num_rows
             while acc_rows >= self.batch_rows:
+                acc = self._harmonize_dicts(acc)
                 tbl = pa.concat_tables(acc) if len(acc) > 1 else acc[0]
                 head = tbl.slice(0, self.batch_rows)
                 tail = tbl.slice(self.batch_rows)

@@ -84,7 +84,11 @@ SPILL_DIR = register(
 
 def _col_device_bytes(c) -> int:
     if isinstance(c, StringColumn):
-        return c.chars.size * 1 + c.lengths.size * 4 + c.validity.size
+        n = c.chars.size * 1 + c.lengths.size * 4 + c.validity.size
+        if c.codes is not None:
+            n += (c.codes.size * 4 + c.dict_chars.size
+                  + c.dict_lens.size * 4)
+        return n
     if isinstance(c, ListColumn):
         return (c.values.size * c.values.dtype.itemsize
                 + c.lengths.size * 4 + c.elem_validity.size
@@ -110,9 +114,14 @@ def batch_device_bytes(batch: ColumnarBatch) -> int:
 def _col_leaves(c, prefix: str) -> list[tuple[str, object]]:
     """(name, device array) leaves of one column (recursive)."""
     if isinstance(c, StringColumn):
-        return [(f"{prefix}_chars", c.chars),
-                (f"{prefix}_lengths", c.lengths),
-                (f"{prefix}_valid", c.validity)]
+        out = [(f"{prefix}_chars", c.chars),
+               (f"{prefix}_lengths", c.lengths),
+               (f"{prefix}_valid", c.validity)]
+        if c.codes is not None:  # dict sidecar spills/restores with it
+            out += [(f"{prefix}_codes", c.codes),
+                    (f"{prefix}_dchars", c.dict_chars),
+                    (f"{prefix}_dlens", c.dict_lens)]
+        return out
     if isinstance(c, ListColumn):
         return [(f"{prefix}_lvalues", c.values),
                 (f"{prefix}_lengths", c.lengths),
@@ -166,10 +175,16 @@ def _host_to_col(arrays: dict, prefix: str, dtype: T.DataType):
     import jax.numpy as jnp
 
     if isinstance(dtype, T.StringType):
+        codes = arrays.get(f"{prefix}_codes")
         return StringColumn(
             jnp.asarray(arrays[f"{prefix}_chars"]),
             jnp.asarray(arrays[f"{prefix}_lengths"]),
-            jnp.asarray(arrays[f"{prefix}_valid"]))
+            jnp.asarray(arrays[f"{prefix}_valid"]), dtype,
+            jnp.asarray(codes) if codes is not None else None,
+            jnp.asarray(arrays[f"{prefix}_dchars"])
+            if codes is not None else None,
+            jnp.asarray(arrays[f"{prefix}_dlens"])
+            if codes is not None else None)
     if isinstance(dtype, T.ListType):
         return ListColumn(
             jnp.asarray(arrays[f"{prefix}_lvalues"]),

@@ -20,10 +20,16 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
+from spark_rapids_tpu.columnar.column import (
+    AnyColumn,
+    Column,
+    StringColumn,
+    pad_capacity,
+)
 from spark_rapids_tpu.ops.sort import SortOrder, sort_permutation
 
 
@@ -78,15 +84,185 @@ def agg_output_dtype(spec: AggSpec, value_dtype: Optional[T.DataType]
     return value_dtype
 
 
-def groupby_aggregate(batch: ColumnarBatch, key_ordinals: Sequence[int],
-                      aggs: Sequence[AggSpec],
-                      out_schema: T.Schema) -> ColumnarBatch:
-    """One-batch group-by.  Output columns = keys ++ aggs, prefix-compact
-    with num_groups live rows.  Traceable (fixed shapes throughout)."""
+#: widest combined (dict ++ NULL) key domain the coded fast path takes;
+#: past this the padded segment arrays outgrow the win over sorting.
+MAX_CODED_DOMAIN = 1 << 14
+
+
+def _coded_key_domains(key_cols: Sequence[AnyColumn]) -> Optional[list[int]]:
+    """Per-key dictionary sizes when EVERY key column carries the wire
+    dict sidecar (codes + device dictionary) and the combined domain is
+    small, else None.  Static decision: dict sizes are array shapes."""
+    ks: list[int] = []
+    total = 1
+    for kc in key_cols:
+        if not (isinstance(kc, StringColumn) and kc.codes is not None):
+            return None
+        k = int(kc.dict_chars.shape[0])
+        ks.append(k)
+        total *= k + 1  # +1: the NULL group rides past the dictionary
+        if total > MAX_CODED_DOMAIN:
+            return None
+    return ks
+
+
+def _coded_groupby(batch: ColumnarBatch, key_ordinals: Sequence[int],
+                   ks: list[int], aggs: Sequence[AggSpec],
+                   out_schema: T.Schema,
+                   live_mask=None) -> ColumnarBatch:
+    """Sort-free group-by over dictionary codes (the analog of cudf's
+    hash groupby for low-cardinality keys, ref: aggregate.scala:240-430):
+    each row's combined code IS its dense group id, so the whole
+    aggregation is segment reductions over a static code domain — no
+    O(n log n) lexsort of the key bytes.
+
+    Kernel-budget design (the tunneled backend charges ~10ms per
+    non-fusable kernel launch once any D2H fetch has happened, so
+    LAUNCH COUNT, not FLOPs, is the cost): every sum/count-family
+    aggregate packs into ONE (rows, m) matrix reduced by a single N-D
+    segment_sum; compaction is a cumsum + one gather (no scatters);
+    only min/max/first/last fall back to per-spec segment ops.  Output
+    is compact (capacity = padded domain size), orders of magnitude
+    below the input bucket."""
+    from spark_rapids_tpu.columnar.column import MIN_CAPACITY
+
     cap = batch.capacity
     live = batch.row_mask()
+    if live_mask is not None:
+        live = live & live_mask
+    key_cols = [batch.columns[o] for o in key_ordinals]
+
+    K = 1
+    for k in ks:
+        K *= k + 1
+    seg = jnp.zeros((cap,), jnp.int32)
+    for kc, k in zip(key_cols, ks):
+        pid = jnp.where(kc.validity, jnp.clip(kc.codes.astype(jnp.int32),
+                                              0, k - 1), k)
+        seg = seg * (k + 1) + pid
+    seg = jnp.where(live, seg, K)  # dead rows drop out of segment ops
+
+    # pack the sum/count family into one f64 matrix (and one i64 matrix
+    # for integer-typed sums, whose wrap-on-overflow semantics f64
+    # cannot reproduce); slot 0 = live-ones: count_star AND occupancy
+    f64_cols: list = [jnp.where(live, 1.0, 0.0)]
+    i64_cols: list = []
+    slots: list = []  # per spec: ("f64"/"i64", value_slot, nvalid_slot)
+    for spec in aggs:
+        if spec.op == "count_star":
+            slots.append(("star",))
+            continue
+        vcol = batch.columns[spec.ordinal]
+        valid = vcol.validity & live
+        if spec.op == "count":
+            f64_cols.append(valid.astype(jnp.float64))
+            slots.append(("count", len(f64_cols) - 1))
+            continue
+        if spec.op == "sum" and isinstance(vcol, Column):
+            out_dtype = agg_output_dtype(spec, vcol.dtype)
+            phys = np.dtype(T.to_numpy_dtype(out_dtype))
+            f64_cols.append(valid.astype(jnp.float64))
+            nv = len(f64_cols) - 1
+            if phys.kind == "f":
+                f64_cols.append(jnp.where(
+                    valid, vcol.data.astype(jnp.float64), 0.0))
+                slots.append(("f64", len(f64_cols) - 1, nv, out_dtype))
+            else:
+                i64_cols.append(jnp.where(
+                    valid, vcol.data.astype(jnp.int64),
+                    jnp.asarray(0, jnp.int64)))
+                slots.append(("i64", len(i64_cols) - 1, nv, out_dtype))
+            continue
+        slots.append(("segop",))
+
+    S = jax.ops.segment_sum(jnp.stack(f64_cols, axis=1), seg,
+                            num_segments=K)
+    Si = (jax.ops.segment_sum(jnp.stack(i64_cols, axis=1), seg,
+                              num_segments=K)
+          if i64_cols else None)
+
+    occ = S[:, 0] > 0.0
+    ranks = jnp.cumsum(occ.astype(jnp.int32))
+    num_groups = ranks[-1]
+    out_cap = max(MIN_CAPACITY, pad_capacity(K))
+    # inv[g] = segment id of the g-th occupied segment (binary search of
+    # the rank prefix — one gather-free kernel, no scatter)
+    inv = jnp.clip(
+        jnp.searchsorted(ranks, jnp.arange(out_cap, dtype=jnp.int32) + 1,
+                         side="left").astype(jnp.int32), 0, K - 1)
+    group_live = jnp.arange(out_cap, dtype=jnp.int32) < num_groups
+    Sc = jnp.take(S, inv, axis=0)
+    Sic = jnp.take(Si, inv, axis=0) if Si is not None else None
+
+    need_segop = any(s[0] == "segop" for s in slots)
+    if need_segop:
+        dest = jnp.where(occ, ranks - 1, out_cap)
+        row_seg = jnp.take(
+            jnp.concatenate([dest, jnp.full((1,), out_cap, jnp.int32)]),
+            jnp.minimum(seg, K))
+
+    # keys: decode each compact slot's segment id back to its dict entry
+    out_cols: list[AnyColumn] = []
+    key_ids: list[jax.Array] = []
+    sid = inv
+    for k in reversed(ks):
+        key_ids.append(sid % (k + 1))
+        sid = sid // (k + 1)
+    key_ids.reverse()
+    for kc, k, kid in zip(key_cols, ks, key_ids):
+        valid_g = (kid < k) & group_live
+        dchars = jnp.concatenate(
+            [kc.dict_chars,
+             jnp.zeros((1, kc.dict_chars.shape[1]), jnp.uint8)])
+        dlens = jnp.concatenate(
+            [kc.dict_lens.astype(jnp.int32), jnp.zeros((1,), jnp.int32)])
+        chars = jnp.take(dchars, kid, axis=0) \
+            * valid_g[:, None].astype(jnp.uint8)
+        lengths = jnp.take(dlens, kid) * valid_g.astype(jnp.int32)
+        out_cols.append(StringColumn(chars, lengths, valid_g))
+
+    for spec, slot in zip(aggs, slots):
+        if slot[0] == "star":
+            out_cols.append(Column(Sc[:, 0].astype(jnp.int64),
+                                   group_live, T.LONG))
+        elif slot[0] == "count":
+            out_cols.append(Column(Sc[:, slot[1]].astype(jnp.int64),
+                                   group_live, T.LONG))
+        elif slot[0] == "f64":
+            _, vs, nv, out_dtype = slot
+            out_cols.append(Column(
+                Sc[:, vs].astype(T.to_numpy_dtype(out_dtype)),
+                group_live & (Sc[:, nv] > 0), out_dtype))
+        elif slot[0] == "i64":
+            _, vs, nv, out_dtype = slot
+            out_cols.append(Column(
+                Sic[:, vs].astype(T.to_numpy_dtype(out_dtype)),
+                group_live & (Sc[:, nv] > 0), out_dtype))
+        else:
+            out_cols.append(_eval_agg(spec, batch, row_seg, live,
+                                      group_live, out_cap, cap))
+    assert len(out_schema) == len(key_cols) + len(aggs)
+    return ColumnarBatch(out_cols, num_groups, out_schema)
+
+
+def groupby_aggregate(batch: ColumnarBatch, key_ordinals: Sequence[int],
+                      aggs: Sequence[AggSpec],
+                      out_schema: T.Schema,
+                      live_mask=None) -> ColumnarBatch:
+    """One-batch group-by.  Output columns = keys ++ aggs, prefix-compact
+    with num_groups live rows.  Traceable (fixed shapes throughout).
+    `live_mask` further restricts the live rows (a fused WHERE: the
+    aggregate masks filtered rows instead of paying a compaction)."""
+    ks = _coded_key_domains([batch.columns[o] for o in key_ordinals])
+    if ks is not None:
+        return _coded_groupby(batch, key_ordinals, ks, aggs, out_schema,
+                              live_mask)
+    cap = batch.capacity
+    live = batch.row_mask()
+    if live_mask is not None:
+        live = live & live_mask
     orders = [SortOrder(o) for o in key_ordinals]
-    perm = sort_permutation(batch, orders)
+    perm = sort_permutation(batch, orders, live=live)
     sorted_batch = batch.gather(perm, batch.num_rows)
     live_sorted = jnp.take(live, perm)
 
@@ -123,7 +299,7 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: Sequence[int],
 
     for spec in aggs:
         out_cols.append(_eval_agg(spec, sorted_batch, seg_id, live_sorted,
-                                  group_live, cap))
+                                  group_live, cap, cap))
     n_keys = len(key_cols)
     assert len(out_schema) == n_keys + len(aggs)
     return ColumnarBatch(out_cols, num_groups, out_schema)
@@ -145,16 +321,21 @@ def _firstlast_pos(valid: jax.Array, op: str, cap: int) -> jax.Array:
 
 def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
               live_sorted: jax.Array, group_live: jax.Array,
-              cap: int) -> Column:
+              num_segments: int, row_cap: int) -> Column:
+    """One aggregation as segment reductions.  `seg_id[row_cap]` maps
+    each row to its output segment in [0, num_segments) (out-of-range =
+    dropped); output arrays have length `num_segments`.  The sort path
+    passes num_segments == row_cap; the coded path a compact domain."""
     if spec.op == "count_star":
         ones = live_sorted.astype(jnp.int64)
-        counts = jax.ops.segment_sum(ones, seg_id, num_segments=cap)
+        counts = jax.ops.segment_sum(ones, seg_id,
+                                     num_segments=num_segments)
         return Column(counts, group_live, T.LONG)
 
     vcol = sorted_batch.columns[spec.ordinal]
     valid = vcol.validity & live_sorted
     nvalid = jax.ops.segment_sum(valid.astype(jnp.int64), seg_id,
-                                 num_segments=cap)
+                                 num_segments=num_segments)
 
     if spec.op == "count":  # validity-only: works for ANY column kind
         return Column(nvalid, group_live, T.LONG)
@@ -164,7 +345,7 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
     phys = T.to_numpy_dtype(out_dtype)
     if spec.op == "sum":
         vals = jnp.where(valid, vcol.data.astype(phys), jnp.asarray(0, phys))
-        sums = jax.ops.segment_sum(vals, seg_id, num_segments=cap)
+        sums = jax.ops.segment_sum(vals, seg_id, num_segments=num_segments)
         return Column(sums, group_live & (nvalid > 0), out_dtype)
     if spec.op in ("min", "max"):
         vals = jnp.where(valid, vcol.data.astype(phys),
@@ -180,30 +361,30 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
                 vals = jnp.where(isnan, _minmax_sentinel(phys, "min"),
                                  vals)
             n_nan = jax.ops.segment_sum(isnan.astype(jnp.int64), seg_id,
-                                        num_segments=cap)
-            out = f(vals, seg_id, num_segments=cap)
+                                        num_segments=num_segments)
+            out = f(vals, seg_id, num_segments=num_segments)
             if spec.op == "min":
                 out = jnp.where(n_nan == nvalid,
                                 jnp.asarray(jnp.nan, phys), out)
             return Column(out, group_live & (nvalid > 0), out_dtype)
-        out = f(vals, seg_id, num_segments=cap)
+        out = f(vals, seg_id, num_segments=num_segments)
         return Column(out, group_live & (nvalid > 0), out_dtype)
     if spec.op in ("first", "last"):
         # first/last non-null within the segment, in sorted-batch order
-        pos = _firstlast_pos(valid, spec.op, cap)
+        pos = _firstlast_pos(valid, spec.op, row_cap)
         f = jax.ops.segment_min if spec.op == "first" else jax.ops.segment_max
-        sel = f(pos, seg_id, num_segments=cap)
-        sel_clipped = jnp.clip(sel, 0, cap - 1)
+        sel = f(pos, seg_id, num_segments=num_segments)
+        sel_clipped = jnp.clip(sel, 0, row_cap - 1)
         out = jnp.take(vcol.data, sel_clipped).astype(phys)
         return Column(out, group_live & (nvalid > 0), out_dtype)
     if spec.op in ("first_any", "last_any"):
         # Spark default (ignoreNulls=false): first/last LIVE row of the
         # segment regardless of validity; a NULL first value stays NULL
         base = "first" if spec.op == "first_any" else "last"
-        pos = _firstlast_pos(live_sorted, base, cap)
+        pos = _firstlast_pos(live_sorted, base, row_cap)
         f = jax.ops.segment_min if base == "first" else jax.ops.segment_max
-        sel = f(pos, seg_id, num_segments=cap)
-        sel_clipped = jnp.clip(sel, 0, cap - 1)
+        sel = f(pos, seg_id, num_segments=num_segments)
+        sel_clipped = jnp.clip(sel, 0, row_cap - 1)
         out = jnp.take(vcol.data, sel_clipped).astype(phys)
         sel_valid = jnp.take(vcol.validity, sel_clipped)
         return Column(out, group_live & sel_valid, out_dtype)
@@ -211,11 +392,14 @@ def _eval_agg(spec: AggSpec, sorted_batch: ColumnarBatch, seg_id: jax.Array,
 
 
 def reduce_aggregate(batch: ColumnarBatch, aggs: Sequence[AggSpec],
-                     out_schema: T.Schema) -> ColumnarBatch:
+                     out_schema: T.Schema,
+                     live_mask=None) -> ColumnarBatch:
     """Grand aggregate (no keys): one output row.  Separate path because
     there is no sort: plain masked reductions."""
     cap = batch.capacity
     live = batch.row_mask()
+    if live_mask is not None:
+        live = live & live_mask
     out_cols: list[AnyColumn] = []
     one_live = jnp.arange(cap, dtype=jnp.int32) < 1
     for spec in aggs:

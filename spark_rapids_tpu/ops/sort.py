@@ -101,13 +101,18 @@ def column_sort_keys(col: AnyColumn, descending: bool,
 
 
 def sort_permutation(batch: ColumnarBatch,
-                     orders: Sequence[SortOrder]) -> jax.Array:
-    """Stable permutation realizing the SQL ORDER BY; padding rows last."""
+                     orders: Sequence[SortOrder],
+                     live=None) -> jax.Array:
+    """Stable permutation realizing the SQL ORDER BY; padding rows last.
+    `live` overrides the default prefix liveness (masked-filter callers
+    mark additional rows dead without compacting first)."""
     keys: list[jax.Array] = []
     for o in reversed(orders):  # minor keys first for lexsort
         col = batch.columns[o.ordinal]
         keys.extend(column_sort_keys(col, o.descending, o.nulls_last))
-    keys.append(batch.row_mask().astype(jnp.int32) * -1)  # live rows first
+    if live is None:
+        live = batch.row_mask()
+    keys.append(live.astype(jnp.int32) * -1)  # live rows first
     return jnp.lexsort(keys)
 
 

@@ -1128,7 +1128,29 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
         _demote_unrepresentable_boundaries(meta)
     else:
         meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
-    return convert_meta(meta), meta
+    root = convert_meta(meta)
+    _mark_encoded_scans(root)
+    return root, meta
+
+
+def _mark_encoded_scans(root: TpuExec) -> None:
+    """Mark scans whose DIRECT parent fuses the wire decode into its own
+    program (fusable chains, hash-aggregate update): those scans emit
+    wire-form EncodedBatches, collapsing decode+transform(+update) to
+    one program execution per batch (each execution pays a link round
+    trip on the tunneled backend)."""
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.base import FusableExec
+    from spark_rapids_tpu.io.scan import ParquetScanExec
+
+    for node in root._walk():
+        for c in node.children:
+            if not isinstance(c, ParquetScanExec):
+                continue
+            if isinstance(node, FusableExec) or (
+                    isinstance(node, TpuHashAggregateExec)
+                    and node.mode != "final"):
+                c.emit_encoded = True
 
 
 def _schema_device_representable(schema: T.Schema) -> bool:

@@ -277,6 +277,10 @@ def from_arrow_type(at) -> DataType:
     """Map a pyarrow DataType to ours."""
     import pyarrow as pa
 
+    if pa.types.is_dictionary(at):
+        # dictionary encoding is a physical detail (fastpar keeps the
+        # Parquet dict); the logical type is the value type
+        return from_arrow_type(at.value_type)
     if pa.types.is_boolean(at):
         return BOOLEAN
     if pa.types.is_int8(at):

@@ -40,6 +40,11 @@ def _assert_matches(path, columns=None):
     assert got.num_rows == want.num_rows
     for name in want.schema.names:
         gw, ww = got[name].combine_chunks(), want[name].combine_chunks()
+        if pa.types.is_dictionary(gw.type):
+            # fastpar deliberately keeps the Parquet dictionary (codes
+            # ride to the device wire untouched); logical content must
+            # still match the plain read
+            gw = gw.cast(gw.type.value_type)
         assert gw.type == ww.type, (name, gw.type, ww.type)
         assert gw.equals(ww), name
 
