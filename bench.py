@@ -1,26 +1,29 @@
-"""Benchmark driver: TPC-H q6 + q1 end-to-end through the framework,
-one chip.
+"""Benchmark driver: TPC-H q6 + q1 + a q3-shaped join, end-to-end
+through the framework, one chip.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} —
-headline = q6 (BASELINE.md config #1); q1 (config #2's shape: group-by
-hash aggregate with 8 aggregates over string keys) rides as q1_*
-diagnostic fields in the same object.
+headline = q6 (BASELINE.md config #1); q1 (config #2's shape: grouped
+8-aggregate over string keys) and q3 (config #3's shape: two-table hash
+join -> grouped aggregate -> top-k) ride as q1_*/q3_* fields.
 
-Unlike a kernel microbenchmark, this measures the REAL query path
-(BASELINE.md config #1): `TpuSession.read_parquet -> where -> agg ->
-collect`, which includes the host Parquet decode, plan tagging, H2D
-upload, the jitted filter+project+aggregate programs, the partial->
-exchange->final aggregation shape over multiple scan partitions, and the
-D2H result materialization.  Every timed iteration is a full collect()
-(the returned Arrow table forces a sync, so no async-dispatch artifact).
+Unlike a kernel microbenchmark, this measures the REAL query path:
+`TpuSession.read_parquet -> ... -> collect`, which includes the host
+Parquet decode, plan tagging, wire encode + H2D upload, the fused jitted
+programs, and the D2H result materialization.  Every timed iteration is
+a full collect() (the returned Arrow table forces a sync, so no
+async-dispatch artifact).
 
 `vs_baseline` is measured IN-RUN: the same logical plan executed by the
 CPU reference engine (pyarrow compute — the "CPU Spark" stand-in this
 repo uses for differential testing), same files, same process.
 
-A bytes/s figure against the chip's HBM roofline is included as a sanity
-check (q6 input is ~28 B/row); rows/s claims that exceed the roofline
-are physically impossible and mean the harness is broken.
+Attribution fields (so round-over-round deltas are explainable):
+- per-config min/median/max seconds (link weather varies ~100x between
+  runs; a median alone cannot distinguish regression from weather);
+- a link probe (scalar-fetch round-trip + upload bandwidth) taken right
+  before timing;
+- a q6 stage breakdown: host decode / wire encode+upload / the final
+  fetch (which inlines the remaining device execution wait).
 """
 
 import json
@@ -40,7 +43,9 @@ HBM_BYTES_PER_S = 819e9
 
 
 def make_lineitem(dirpath: str, n_files: int = N_FILES,
-                  with_q1_cols: bool = False):
+                  with_q1_cols: bool = False,
+                  with_orderkey: bool = False,
+                  n_orders: int = 1 << 20):
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -64,11 +69,31 @@ def make_lineitem(dirpath: str, n_files: int = N_FILES,
                 rng.integers(0, 3, ROWS_PER_FILE)]
             cols["l_linestatus"] = np.array(["F", "O"])[
                 rng.integers(0, 2, ROWS_PER_FILE)]
+        if with_orderkey:
+            cols["l_orderkey"] = rng.integers(
+                0, n_orders, ROWS_PER_FILE).astype(np.int64)
         t = pa.table(cols)
         p = os.path.join(dirpath, f"lineitem-{i}.parquet")
         pq.write_table(t, p, row_group_size=ROWS_PER_FILE)
         paths.append(p)
     return paths
+
+
+def make_orders(dirpath: str, n_orders: int = 1 << 20):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "o_orderkey": np.arange(n_orders, dtype=np.int64),
+        "o_orderdate": rng.integers(8766, 10957, n_orders).astype(
+            np.int32),
+        "o_shippriority": rng.integers(0, 5, n_orders).astype(np.int32),
+    })
+    p = os.path.join(dirpath, "orders.parquet")
+    pq.write_table(t, p, row_group_size=n_orders)
+    return p
 
 
 def q6_dataframe(session, paths):
@@ -105,15 +130,154 @@ def q1_dataframe(session, paths):
                  (count_star(), "count_order")))
 
 
-def _time_collect(df, engine: str, iters: int) -> tuple[float, float]:
-    """(median seconds per full collect, last result)."""
+def q3_dataframe(session, li_paths, orders_path):
+    """TPC-H q3 shape on two tables: lineitem JOIN orders on orderkey,
+    date filters on both sides, revenue per order, top-10 by revenue
+    (exchange + shuffled hash join + high-cardinality group-by +
+    sort/limit — BASELINE config #3's moving parts)."""
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import col, sum_
+
+    li = (session.read_parquet(*li_paths)
+          .where(col("l_shipdate") > lit(9500)))
+    orders = (session.read_parquet(orders_path)
+              .where(col("o_orderdate") < lit(9500)))
+    joined = li.join(orders, left_on=[col("l_orderkey")],
+                     right_on=[col("o_orderkey")])
+    rev = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    return (joined
+            .group_by(col("l_orderkey"), col("o_orderdate"),
+                      col("o_shippriority"))
+            .agg((sum_(rev), "revenue"))
+            .order_by(col("revenue"), desc=True)
+            .limit(10))
+
+
+def _time_collect(df, engine: str, iters: int):
+    """([seconds per full collect...], last result)."""
     times = []
     result = None
     for _ in range(iters):
         t0 = time.perf_counter()
         result = df.collect(engine=engine)
         times.append(time.perf_counter() - t0)
-    return statistics.median(times), result
+    return times, result
+
+
+def _stats(times, prefix: str) -> dict:
+    return {
+        f"{prefix}_s_min": round(min(times), 4),
+        f"{prefix}_s_median": round(statistics.median(times), 4),
+        f"{prefix}_s_max": round(max(times), 4),
+    }
+
+
+def _link_probe() -> dict:
+    """Scalar-fetch round trips + one 8MB upload: the weather report.
+    Taken AFTER the first result fetch, i.e. in the same degraded client
+    mode the timed queries run in."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rtts = []
+    x = jnp.asarray(1.0)
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(jax.device_get(x + 1.0))
+        rtts.append(time.perf_counter() - t0)
+    a = np.random.default_rng(0).random(1 << 20)
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(a))
+    up = time.perf_counter() - t0
+    return {
+        "link_rtt_ms_median": round(statistics.median(rtts) * 1e3, 1),
+        "link_upload_mb_s": round(8.0 / max(up, 1e-9), 1),
+    }
+
+
+class _StageTaps:
+    """Wall-clock accumulated in the scan host decode, the wire
+    encode+upload, and the final result fetch, for ONE collect."""
+
+    def __init__(self):
+        import spark_rapids_tpu.io.scan as scan_mod
+        import spark_rapids_tpu.plan.planner as planner_mod
+        from spark_rapids_tpu.columnar.arrow import to_arrow
+        from spark_rapids_tpu.io import fastpar
+
+        self.host_s = 0.0
+        self.wire_s = 0.0
+        self.fetch_s = 0.0
+        self._mods = (scan_mod, planner_mod, fastpar)
+        self._orig = (scan_mod.ParquetScanExec._upload,
+                      planner_mod.to_arrow, fastpar.read_file)
+
+        taps = self
+
+        def upload(inner_self, tables):
+            t0 = time.perf_counter()
+            try:
+                return taps._orig[0](inner_self, tables)
+            finally:
+                taps.wire_s += time.perf_counter() - t0
+
+        def fetch(b):
+            t0 = time.perf_counter()
+            try:
+                return to_arrow(b)
+            finally:
+                taps.fetch_s += time.perf_counter() - t0
+
+        def read_file(*a, **k):
+            t0 = time.perf_counter()
+            try:
+                return taps._orig[2](*a, **k)
+            finally:
+                taps.host_s += time.perf_counter() - t0
+
+        scan_mod.ParquetScanExec._upload = upload
+        planner_mod.to_arrow = fetch
+        fastpar.read_file = read_file
+
+    def restore(self):
+        scan_mod, planner_mod, fastpar = self._mods
+        scan_mod.ParquetScanExec._upload = self._orig[0]
+        planner_mod.to_arrow = self._orig[1]
+        fastpar.read_file = self._orig[2]
+
+
+def _q6_breakdown(df) -> dict:
+    """One instrumented collect: where does a q6 iteration go?  The
+    final-fetch figure inlines the wait for any device execution still
+    in flight (dispatch is async) — if the residual is dominated by
+    fetch at near-zero decode/wire time, the bottleneck is the link, not
+    the engine."""
+    taps = _StageTaps()
+    try:
+        t0 = time.perf_counter()
+        df.collect(engine="tpu")
+        total = time.perf_counter() - t0
+    finally:
+        taps.restore()
+    return {
+        "q6_stage_host_decode_s": round(taps.host_s, 4),
+        "q6_stage_wire_upload_s": round(taps.wire_s, 4),
+        "q6_stage_final_fetch_s": round(taps.fetch_s, 4),
+        "q6_stage_other_s": round(
+            max(0.0, total - taps.host_s - taps.wire_s - taps.fetch_s),
+            4),
+    }
+
+
+def _check_rows(tpu_tbl, cpu_tbl, float_from: int, key_cols: int):
+    got = sorted(zip(*tpu_tbl.to_pydict().values()))
+    want = sorted(zip(*cpu_tbl.to_pydict().values()))
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        assert g[:key_cols] == w[:key_cols], (g[:key_cols], w[:key_cols])
+        for gv, wv in zip(g[float_from:], w[float_from:]):
+            assert abs(gv - wv) <= 1e-6 * max(1.0, abs(wv)), (gv, wv)
 
 
 def _bench_q1(session, d: str) -> dict:
@@ -133,23 +297,52 @@ def _bench_q1(session, d: str) -> dict:
                                  with_q1_cols=True)
         df = q1_dataframe(session, q1_files)
         df.collect(engine="tpu")  # warmup
-        tpu_t, tpu_r = _time_collect(df, "tpu", 3)
-        cpu_t, cpu_r = _time_collect(df, "cpu", 2)
+        tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+        cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     finally:
         conf.set(key, old_sp)
-    got = sorted(zip(*tpu_r.to_pydict().values()))
-    want = sorted(zip(*cpu_r.to_pydict().values()))
-    assert len(got) == len(want), (len(got), len(want))
-    for g, w in zip(got, want):
-        assert g[0] == w[0] and g[1] == w[1], (g[:2], w[:2])  # keys
-        for gv, wv in zip(g[2:], w[2:]):  # 8 aggregates, float-tolerant
-            assert abs(gv - wv) <= 1e-6 * max(1.0, abs(wv)), (gv, wv)
-    return {
+    _check_rows(tpu_r, cpu_r, float_from=2, key_cols=2)
+    tpu_t = statistics.median(tpu_ts)
+    cpu_t = statistics.median(cpu_ts)
+    out = {
         "q1_tpu_s_per_query": round(tpu_t, 4),
         "q1_cpu_s_per_query": round(cpu_t, 4),
         "q1_vs_cpu": round(cpu_t / tpu_t, 3),
         "q1_rows": ROWS_PER_FILE * 2,
     }
+    out.update(_stats(tpu_ts, "q1_tpu"))
+    return out
+
+
+def _bench_q3(session, d: str) -> dict:
+    """BASELINE config #3's shape: two-table shuffled hash join ->
+    grouped aggregate -> top-k, correctness-gated against the CPU
+    engine."""
+    q3dir = os.path.join(d, "q3")
+    os.makedirs(q3dir, exist_ok=True)
+    li = make_lineitem(q3dir, n_files=2, with_orderkey=True)
+    orders = make_orders(q3dir)
+    df = q3_dataframe(session, li, orders)
+    df.collect(engine="tpu")  # warmup
+    tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+    cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
+    # top-k by float revenue: compare the revenue VALUES (ties may order
+    # differently) and the grouped rows' exactness via set inclusion
+    got = sorted(tpu_r.to_pydict()["revenue"], reverse=True)
+    want = sorted(cpu_r.to_pydict()["revenue"], reverse=True)
+    assert len(got) == len(want) == 10, (len(got), len(want))
+    for gv, wv in zip(got, want):
+        assert abs(gv - wv) <= 1e-6 * max(1.0, abs(wv)), (gv, wv)
+    tpu_t = statistics.median(tpu_ts)
+    cpu_t = statistics.median(cpu_ts)
+    out = {
+        "q3_tpu_s_per_query": round(tpu_t, 4),
+        "q3_cpu_s_per_query": round(cpu_t, 4),
+        "q3_vs_cpu": round(cpu_t / tpu_t, 3),
+        "q3_rows": ROWS_PER_FILE * 2 + (1 << 20),
+    }
+    out.update(_stats(tpu_ts, "q3_tpu"))
+    return out
 
 
 def main() -> None:
@@ -164,22 +357,28 @@ def main() -> None:
         df = q6_dataframe(session, paths)
 
         df.collect(engine="tpu")  # warmup: compile cache, page cache
-        tpu_t, tpu_result = _time_collect(df, "tpu", TPU_ITERS)
-        cpu_t, cpu_result = _time_collect(df, "cpu", CPU_ITERS)
+        link = _link_probe()
+        tpu_ts, tpu_result = _time_collect(df, "tpu", TPU_ITERS)
+        cpu_ts, cpu_result = _time_collect(df, "cpu", CPU_ITERS)
+        tpu_t = statistics.median(tpu_ts)
+        cpu_t = statistics.median(cpu_ts)
 
         # correctness gate: a fast wrong answer is not a benchmark
         got = tpu_result.to_pydict()["revenue"][0]
         want = cpu_result.to_pydict()["revenue"][0]
         assert abs(got - want) <= 1e-6 * max(1.0, abs(want)), (got, want)
 
+        breakdown = _q6_breakdown(df)
+
         if tpu_t > 10.0:
             # degraded tunnel (per-dispatch latency in the seconds):
-            # a q1 run would take tens of minutes and measure the
-            # network, not the engine — record the skip instead
-            q1_fields = {"q1_skipped": "slow device link "
-                         f"(q6 took {tpu_t:.1f}s)"}
+            # further configs would take tens of minutes and measure
+            # the network, not the engine — record the skip instead
+            extra = {"q1_skipped": f"slow device link (q6 {tpu_t:.1f}s)",
+                     "q3_skipped": f"slow device link (q6 {tpu_t:.1f}s)"}
         else:
-            q1_fields = _bench_q1(session, d)
+            extra = _bench_q1(session, d)
+            extra.update(_bench_q3(session, d))
 
     rows_per_s = n_rows / tpu_t
     bytes_per_s = rows_per_s * ROW_BYTES
@@ -195,7 +394,10 @@ def main() -> None:
         "bytes_per_s": round(bytes_per_s, 1),
         "hbm_roofline_fraction": round(bytes_per_s / HBM_BYTES_PER_S, 4),
     }
-    out.update(q1_fields)
+    out.update(_stats(tpu_ts, "q6_tpu"))
+    out.update(link)
+    out.update(breakdown)
+    out.update(extra)
     print(json.dumps(out))
 
 

@@ -36,4 +36,24 @@ if _os.environ.get("SPARK_RAPIDS_TPU_NO_X64", "") != "1":
 
     _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: remote-compile backends take 20-100s+
+# PER sort/scan program, and every new process would pay it again.  The
+# cache is keyed by program+topology, survives across processes, and was
+# measured cutting a 20s sort compile to 0.2s on the tunneled TPU
+# backend.  Opt out (or redirect) via SPARK_RAPIDS_TPU_JAX_CACHE.
+_cache_dir = _os.environ.get(
+    "SPARK_RAPIDS_TPU_JAX_CACHE",
+    _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), _os.pardir,
+                  ".jax_cache"))
+if _cache_dir and _cache_dir != "0":
+    import jax as _jax
+
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           1.0)
+    except Exception:
+        pass  # read-only installs: in-memory cache only
+
 from spark_rapids_tpu.config import TpuConf, get_conf, set_conf  # noqa: F401

@@ -221,21 +221,25 @@ class TpuHashAggregateExec(TpuExec):
         return groupby_aggregate(partial, list(range(self.n_keys)),
                                  self.merge_specs, self.partial_schema)
 
-    def _drain_final_fused(self, pending):
+    def _drain_final_fused(self, pending, rows_hint: int):
         """Final drain as ONE program: concat (traced stack+compact) +
         merge + finalize, mode-dependent.  Saves 2-3 program executions
         per stream tail vs the stepwise drain — each execution is a
         link round trip on the tunneled backend.  Returns None when the
-        shapes don't qualify (large/nested partials), in which case the
-        caller runs the stepwise path."""
+        shapes don't qualify (large/nested partials), decided WITHOUT
+        touching the handles (h.get() would unspill large partials to
+        device just to reject them); the caller then runs the stepwise
+        path."""
         from spark_rapids_tpu.execs.jit_cache import cached_jit
 
-        batches = [h.get() for h in pending]
-        if (len(batches) == 1 and self.mode == "partial") \
-                or sum(b.capacity for b in batches) > 4 * 4096 \
+        if (len(pending) == 1 and self.mode == "partial") \
+                or rows_hint > 4 * 4096 \
                 or any(isinstance(f.dtype,
                                   (T.ListType, T.StructType, T.MapType))
-                       for f in batches[0].schema.fields):
+                       for f in self.partial_schema.fields):
+            return None
+        batches = [h.get() for h in pending]
+        if sum(b.capacity for b in batches) > 4 * 4096:
             return None
         from spark_rapids_tpu.columnar.batch import concat_batches_traced
 
@@ -558,7 +562,7 @@ class TpuHashAggregateExec(TpuExec):
             pending.append(store.register(
                 eb, SpillPriorities.AGGREGATE_PARTIAL))
 
-        out = self._drain_final_fused(pending)
+        out = self._drain_final_fused(pending, pending_rows)
         if out is not None:
             yield self._count_output(out)
             return

@@ -126,10 +126,13 @@ def _grouped_agg_wrapper(tbl, aggs=None, key_names=None, aschema=None):
     import pyarrow as pa
 
     df = tbl.to_pandas()
-    if df.empty:
+    if df.empty and key_names:
         return aschema.empty_table()
     rows = []
-    if not key_names:  # keyless: one grand aggregate row
+    if not key_names:
+        # keyless: ONE grand-aggregate row even over empty input (each
+        # fn sees an empty Series), matching Spark's global-aggregate
+        # convention
         rows.append({out_name: fn(df[in_col])
                      for out_name, fn, in_col in aggs})
         out = pd.DataFrame(rows, columns=[f.name for f in aschema])
@@ -191,6 +194,11 @@ class _GroupedPandasBase(TpuMapInArrowExec):
     (hash-exchanged) partition concats to one table and makes ONE
     worker round (groups are complete within a partition)."""
 
+    def _keyless_emits_on_empty(self) -> bool:
+        """Keyless AGGREGATES emit one grand row over empty input;
+        apply/map-style grouped execs emit nothing."""
+        return False
+
     def execute_partition(self, p: int):
         from spark_rapids_tpu.columnar.arrow import (
             from_arrow,
@@ -202,7 +210,15 @@ class _GroupedPandasBase(TpuMapInArrowExec):
         aschema = schema_to_arrow(self._schema)
         batches = list(self.children[0].execute_partition(p))
         if not batches:
-            return
+            if p == 0 and self._keyless_emits_on_empty():
+                # keyless pandas aggregate over empty input: Spark's
+                # global-aggregate convention emits one row computed
+                # over the empty series
+                from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+                batches = [ColumnarBatch.empty(self.children[0].schema)]
+            else:
+                return
         big = batches[0] if len(batches) == 1 else \
             concat_batches(batches)
         if big.concrete_num_rows() == 0 and p != 0:
@@ -252,6 +268,9 @@ class TpuAggregateInPandasExec(_GroupedPandasBase):
         super().__init__(wrapped, schema, child)
         self.key_names = list(key_names)
         self.aggs = list(aggs)
+
+    def _keyless_emits_on_empty(self) -> bool:
+        return not self.key_names
 
     def node_desc(self) -> str:
         fns = ", ".join(n for n, _, _ in self.aggs)

@@ -368,7 +368,6 @@ class Least(Expression):
 
     exprs: tuple[Expression, ...]
 
-    _take_new = staticmethod(lambda k, acc_k: k < acc_k)
 
     def __init__(self, *exprs: Expression):
         self.exprs = tuple(exprs)
@@ -381,15 +380,19 @@ class Least(Expression):
         return _widen([e.dtype for e in self.exprs])
 
     def eval(self, ctx: EvalContext) -> AnyColumn:
-        from spark_rapids_tpu.ops.sort import float_total_order_bits
-
         cols = [e.eval(ctx) for e in self.exprs]
         phys = T.to_numpy_dtype(self.dtype)
         is_float = jnp.issubdtype(phys, jnp.floating)
         acc_val = acc_key = acc_valid = None
         for c in cols:
             d = c.data.astype(phys)
-            key = float_total_order_bits(d) if is_float else d
+            # floats: Spark total order with NaN largest, realized by
+            # canonicalizing NaN to +inf plus an is-NaN tiebreak INSIDE
+            # _take_new (a 64-bit bitcast to order bits would not
+            # compile through the TPU X64 rewriter); exact f64
+            # comparisons are preserved
+            key = (jnp.where(jnp.isnan(d), jnp.inf, d), jnp.isnan(d)) \
+                if is_float else d
             if acc_val is None:
                 acc_val, acc_key, acc_valid = d, key, c.validity
             else:
@@ -398,10 +401,26 @@ class Least(Expression):
                 take = c.validity & (~acc_valid
                                      | self._take_new(key, acc_key))
                 acc_val = jnp.where(take, d, acc_val)
-                acc_key = jnp.where(take, key, acc_key)
+                if isinstance(key, tuple):
+                    acc_key = tuple(jnp.where(take, k, a)
+                                    for k, a in zip(key, acc_key))
+                else:
+                    acc_key = jnp.where(take, key, acc_key)
                 acc_valid = acc_valid | c.validity
         return Column(acc_val, acc_valid, self.dtype)
 
+    @staticmethod
+    def _lt(a, b):
+        """Total-order less-than over plain or (value, is_nan) keys."""
+        if isinstance(a, tuple):
+            (va, na), (vb, nb) = a, b
+            return (va < vb) | ((va == vb) & ~na & nb)
+        return a < b
+
+    def _take_new(self, k, acc_k):
+        return self._lt(k, acc_k)
+
 
 class Greatest(Least):
-    _take_new = staticmethod(lambda k, acc_k: k > acc_k)
+    def _take_new(self, k, acc_k):
+        return self._lt(acc_k, k)

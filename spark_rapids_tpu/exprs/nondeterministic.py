@@ -83,12 +83,17 @@ class MonotonicallyIncreasingID(Expression, PartitionAware):
 
 def _rand_uniform(seed: int, partition, global_idx) -> jax.Array:
     """Counter-based uniform doubles in [0,1): threefry keyed on
-    (seed, partition), hashed per global row index."""
+    (seed, partition), hashed per global row index.  The int64 index
+    folds in as two 32-bit halves so the counter stays injective over
+    the full index range (a single uint32 fold would repeat the stream
+    every 2^32 rows)."""
     key = jax.random.fold_in(jax.random.PRNGKey(seed), partition)
 
     def one(i):
+        hi = (i >> 32).astype(jnp.uint32)
+        lo = (i & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
         return jax.random.uniform(
-            jax.random.fold_in(key, i.astype(jnp.uint32)),
+            jax.random.fold_in(jax.random.fold_in(key, hi), lo),
             dtype=jnp.float64)
 
     return jax.vmap(one)(global_idx)

@@ -8,3 +8,4 @@ onto plan/logical.py nodes.  `native` (the DataFrame API) registers in
 plugin.py; `substrait` registers on import."""
 
 from spark_rapids_tpu.frontends import substrait  # noqa: F401
+from spark_rapids_tpu.frontends import sql  # noqa: F401

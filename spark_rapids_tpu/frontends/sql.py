@@ -1,0 +1,996 @@
+"""SQL-text frontend: run real SQL strings through the engine.
+
+The reference's entire premise is accelerating the user's SQL,
+unmodified (ref: sql-plugin/src/main/scala/com/nvidia/spark/
+SQLPlugin.scala:26-31 — the plugin intercepts plans Spark built from
+SQL text; the user changes nothing).  This frontend is the SQL-shaped
+occupant of the `register_frontend` seam: a self-contained
+tokenizer + recursive-descent parser that lowers a practical SQL subset
+directly onto the engine's DataFrame/logical-plan surface, after which
+tagging, TPU conversion and CPU fallback behave exactly as for native
+plans.
+
+Supported (enough to run the actual text of TPC-H q1/q3/q6 and
+TPC-DS q3, and the common shapes around them):
+
+- SELECT projections with aliases, `*`;
+- FROM with comma joins and explicit [INNER|LEFT|RIGHT|FULL] JOIN ..
+  ON; single-table WHERE conjuncts are pushed to their table and
+  cross-table equality conjuncts become equi-join keys (left-deep, in
+  FROM order — the textbook rewrite Spark's analyzer performs);
+- WHERE / GROUP BY / HAVING / ORDER BY [ASC|DESC] (names, aliases or
+  1-based ordinals) / LIMIT;
+- aggregates sum/avg/min/max/count/count(*) over arbitrary input
+  expressions;
+- expressions: arithmetic, comparisons, AND/OR/NOT, BETWEEN, IN,
+  [NOT] LIKE, IS [NOT] NULL, CASE (searched + simple), CAST(x AS t),
+  EXTRACT(field FROM x), scalar functions (substring, upper, lower,
+  length, coalesce, abs, round, year/month/day, concat, trim, nullif),
+  string/number/date literals, and `date '...' +/- interval 'N' day`
+  arithmetic (folded at parse time, as in TPC-H predicates).
+
+Identifiers resolve case-insensitively against the registered tables'
+schemas; qualified refs (`alias.col`) check the alias but lower to the
+bare column name (TPC schemas have globally unique column names, and
+the engine resolves by name).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from typing import Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.execs.sort import SortKey
+from spark_rapids_tpu.exprs import aggregates as AG
+from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.exprs import cast as C
+from spark_rapids_tpu.exprs import datetime as DT
+from spark_rapids_tpu.exprs import math as M
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import strings as S
+
+
+class SqlError(ValueError):
+    """Query outside the supported SQL subset (with position info)."""
+
+
+# ------------------------------------------------------------------ #
+# Tokenizer
+# ------------------------------------------------------------------ #
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?
+           |\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qid>"(?:[^"]|"")*")
+  | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><>|!=|>=|<=|=|<|>|\|\||[(),.*/%+\-;])
+""", re.VERBOSE)
+
+
+def _tokenize(text: str) -> list[tuple[str, str, int]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise SqlError(f"cannot tokenize at offset {pos}: "
+                           f"{text[pos:pos + 20]!r}")
+        kind = m.lastgroup
+        if kind != "ws":
+            out.append((kind, m.group(), pos))
+        pos = m.end()
+    out.append(("eof", "", len(text)))
+    return out
+
+
+_AGG_FNS = {"sum": AG.Sum, "min": AG.Min, "max": AG.Max,
+            "avg": AG.Average, "mean": AG.Average, "count": AG.Count}
+
+def _lit_int(e, what: str) -> int:
+    if isinstance(e, B.Literal) and isinstance(e.value, int):
+        return e.value
+    raise SqlError(f"{what} must be an integer literal")
+
+
+#: scalar function name -> constructor over positional expr args
+_SCALAR_FNS = {
+    "upper": lambda x: S.Upper(x),
+    "lower": lambda x: S.Lower(x),
+    "length": lambda x: S.Length(x),
+    "char_length": lambda x: S.Length(x),
+    "substring": lambda x, p, n=None: S.Substring(
+        x, _lit_int(p, "substring position"),
+        None if n is None else _lit_int(n, "substring length")),
+    "substr": lambda x, p, n=None: S.Substring(
+        x, _lit_int(p, "substring position"),
+        None if n is None else _lit_int(n, "substring length")),
+    "trim": lambda x: S.StringTrim(x),
+    "ltrim": lambda x: S.StringTrimLeft(x),
+    "rtrim": lambda x: S.StringTrimRight(x),
+    "concat": lambda *xs: S.Concat(*xs),
+    "coalesce": lambda *xs: P.Coalesce(*xs),
+    "abs": lambda x: A.Abs(x),
+    "round": lambda x, n=None: M.Round(
+        x, n if n is not None else B.Literal.of(0)),
+    "year": lambda x: DT.Year(x),
+    "month": lambda x: DT.Month(x),
+    "day": lambda x: DT.DayOfMonth(x),
+    "dayofmonth": lambda x: DT.DayOfMonth(x),
+    "quarter": lambda x: DT.Quarter(x),
+    "nullif": lambda a, b: P.If(P.EqualTo(a, b),
+                                B.Literal(None, T.NULL), a),
+    "if": lambda c, a, b: P.If(c, a, b),
+    "least": lambda *xs: A.Least(*xs),
+    "greatest": lambda *xs: A.Greatest(*xs),
+}
+
+_EXTRACT_FIELDS = {
+    "year": DT.Year, "month": DT.Month, "day": DT.DayOfMonth,
+    "quarter": DT.Quarter, "hour": DT.Hour, "minute": DT.Minute,
+    "second": DT.Second, "dayofyear": DT.DayOfYear,
+}
+
+_CAST_TYPES = {
+    "int": T.INT, "integer": T.INT, "bigint": T.LONG, "long": T.LONG,
+    "smallint": T.SHORT, "tinyint": T.BYTE, "float": T.FLOAT,
+    "real": T.FLOAT, "double": T.DOUBLE, "string": T.STRING,
+    "varchar": T.STRING, "char": T.STRING, "boolean": T.BOOLEAN,
+    "date": T.DATE, "timestamp": T.TIMESTAMP,
+}
+
+_EPOCH = _dt.date(1970, 1, 1)
+
+_INTERVAL_UNITS = {"day": 1, "days": 1, "month": 30, "months": 30,
+                   "year": 365, "years": 365, "week": 7, "weeks": 7}
+
+
+class _Interval:
+    """Parse-time interval value; only valid folded into date ± or as
+    a calendar interval for month/year arithmetic."""
+
+    def __init__(self, n: int, unit: str):
+        self.n = n
+        self.unit = unit.rstrip("s") if unit.endswith("s") else unit
+
+
+def _date_lit(s: str) -> B.Literal:
+    d = _dt.date.fromisoformat(s)
+    return B.Literal((d - _EPOCH).days, T.DATE)
+
+
+def _shift_date(lit: B.Literal, iv: _Interval, sign: int) -> B.Literal:
+    d = _EPOCH + _dt.timedelta(days=int(lit.value))
+    if iv.unit == "day":
+        d2 = d + _dt.timedelta(days=sign * iv.n)
+    elif iv.unit == "week":
+        d2 = d + _dt.timedelta(days=7 * sign * iv.n)
+    elif iv.unit in ("month", "year"):
+        months = iv.n * (12 if iv.unit == "year" else 1) * sign
+        mi = d.year * 12 + (d.month - 1) + months
+        y, m = divmod(mi, 12)
+        import calendar
+
+        day = min(d.day, calendar.monthrange(y, m + 1)[1])
+        d2 = _dt.date(y, m + 1, day)
+    else:
+        raise SqlError(f"unsupported interval unit {iv.unit!r}")
+    return B.Literal((d2 - _EPOCH).days, T.DATE)
+
+
+# ------------------------------------------------------------------ #
+# Parser
+# ------------------------------------------------------------------ #
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    # -- token helpers -- #
+
+    def peek(self, k: int = 0):
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def kw(self, k: int = 0) -> str:
+        t = self.peek(k)
+        return t[1].lower() if t[0] == "id" else ""
+
+    def at(self, *words: str) -> bool:
+        return self.kw() in words
+
+    def accept(self, word: str) -> bool:
+        if self.kw() == word:
+            self.i += 1
+            return True
+        return False
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t[0] == "op" and t[1] == op:
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, word: str) -> None:
+        if not self.accept(word):
+            t = self.peek()
+            raise SqlError(f"expected {word!r}, got {t[1]!r} at {t[2]}")
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            t = self.peek()
+            raise SqlError(f"expected {op!r}, got {t[1]!r} at {t[2]}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t[0] == "id":
+            self.i += 1
+            return t[1].lower()
+        if t[0] == "qid":
+            self.i += 1
+            return t[1][1:-1].replace('""', '"')
+        raise SqlError(f"expected identifier, got {t[1]!r} at {t[2]}")
+
+    # -- statement -- #
+
+    def parse_select(self) -> dict:
+        self.expect("select")
+        distinct = self.accept("distinct")
+        items: list[tuple] = []  # (expr|"*", alias|None)
+        while True:
+            if self.accept_op("*"):
+                items.append(("*", None))
+            else:
+                e = self.expr()
+                alias = None
+                if self.accept("as"):
+                    alias = self.ident()
+                elif (self.peek()[0] in ("id", "qid")
+                      and self.kw() not in _CLAUSE_KWS):
+                    alias = self.ident()
+                items.append((e, alias))
+            if not self.accept_op(","):
+                break
+        self.expect("from")
+        tables = [self.table_ref()]
+        joins: list[tuple] = []  # ("cross"|how, table_ref, on_expr|None)
+        while True:
+            if self.accept_op(","):
+                joins.append(("cross", self.table_ref(), None))
+                continue
+            how = None
+            if self.at("inner") and self.kw(1) == "join":
+                self.i += 2
+                how = "inner"
+            elif self.at("left", "right", "full"):
+                how = {"left": "left_outer", "right": "right_outer",
+                       "full": "full_outer"}[self.kw()]
+                self.i += 1
+                self.accept("outer")
+                if self.accept("semi"):
+                    how = "left_semi"
+                elif self.accept("anti"):
+                    how = "left_anti"
+                self.expect("join")
+            elif self.accept("join"):
+                how = "inner"
+            if how is None:
+                break
+            tr = self.table_ref()
+            self.expect("on")
+            joins.append((how, tr, self.expr()))
+        where = self.expr() if self.accept("where") else None
+        group_by: list = []
+        if self.accept("group"):
+            self.expect("by")
+            while True:
+                group_by.append(self.expr())
+                if not self.accept_op(","):
+                    break
+        having = self.expr() if self.accept("having") else None
+        order_by: list[tuple] = []
+        if self.accept("order"):
+            self.expect("by")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.accept("desc"):
+                    desc = True
+                else:
+                    self.accept("asc")
+                nulls_last = desc
+                if self.accept("nulls"):
+                    if self.accept("last"):
+                        nulls_last = True
+                    else:
+                        self.expect("first")
+                        nulls_last = False
+                order_by.append((e, desc, nulls_last))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        if self.accept("limit"):
+            t = self.peek()
+            if t[0] != "num":
+                raise SqlError(f"expected LIMIT count at {t[2]}")
+            limit = int(t[1])
+            self.i += 1
+        self.accept_op(";")
+        if self.peek()[0] != "eof":
+            t = self.peek()
+            raise SqlError(f"unexpected trailing {t[1]!r} at {t[2]}")
+        return {"items": items, "distinct": distinct, "tables": tables,
+                "joins": joins, "where": where, "group_by": group_by,
+                "having": having, "order_by": order_by, "limit": limit}
+
+    def table_ref(self) -> tuple:
+        name = self.ident()
+        alias = None
+        if self.accept("as"):
+            alias = self.ident()
+        elif (self.peek()[0] in ("id", "qid")
+              and self.kw() not in _TABLE_STOP_KWS):
+            alias = self.ident()
+        return (name, alias or name)
+
+    # -- expressions (precedence climbing) -- #
+
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        e = self.and_expr()
+        while self.accept("or"):
+            e = P.Or(e, self.and_expr())
+        return e
+
+    def and_expr(self):
+        e = self.not_expr()
+        while self.accept("and"):
+            e = P.And(e, self.not_expr())
+        return e
+
+    def not_expr(self):
+        if self.accept("not"):
+            return P.Not(self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self):
+        e = self.add_expr()
+        negate = False
+        if self.at("not") and self.kw(1) in ("between", "in", "like"):
+            self.i += 1
+            negate = True
+        if self.accept("between"):
+            lo = self.add_expr()
+            self.expect("and")
+            hi = self.add_expr()
+            out = P.And(P.GreaterThanOrEqual(e, lo),
+                        P.LessThanOrEqual(e, hi))
+            return P.Not(out) if negate else out
+        if self.accept("in"):
+            self.expect_op("(")
+            vals = [self.expr()]
+            while self.accept_op(","):
+                vals.append(self.expr())
+            self.expect_op(")")
+            for v in vals:
+                if not isinstance(v, B.Literal):
+                    raise SqlError("IN list must be literals")
+            out = P.In(e, tuple(v.value for v in vals))
+            return P.Not(out) if negate else out
+        if self.accept("like"):
+            pat = self.add_expr()
+            if not isinstance(pat, B.Literal):
+                raise SqlError("LIKE pattern must be a literal")
+            out = S.Like(e, str(pat.value))
+            return P.Not(out) if negate else out
+        if self.accept("is"):
+            neg = self.accept("not")
+            self.expect("null")
+            return P.IsNotNull(e) if neg else P.IsNull(e)
+        _ne = lambda a, b: P.Not(P.EqualTo(a, b))
+        for op, ctor in (("=", P.EqualTo), ("<>", _ne),
+                         ("!=", _ne), ("<=", P.LessThanOrEqual),
+                         (">=", P.GreaterThanOrEqual), ("<", P.LessThan),
+                         (">", P.GreaterThan)):
+            if self.accept_op(op):
+                return ctor(e, self.add_expr())
+        return e
+
+    def add_expr(self):
+        e = self.mul_expr()
+        while True:
+            if self.accept_op("+"):
+                r = self.mul_expr()
+                e = self._plus_minus(e, r, +1)
+            elif self.accept_op("-"):
+                r = self.mul_expr()
+                e = self._plus_minus(e, r, -1)
+            elif self.accept_op("||"):
+                e = S.Concat(e, self.mul_expr())
+            else:
+                return e
+
+    @staticmethod
+    def _plus_minus(left, right, sign: int):
+        if isinstance(right, _Interval):
+            if isinstance(left, B.Literal) \
+                    and isinstance(left.dtype, T.DateType):
+                return _shift_date(left, right, sign)
+            # date column ± interval: day/week lower to DateAdd/DateSub
+            days = right.n * (7 if right.unit == "week" else 1)
+            if right.unit in ("day", "week"):
+                ctor = DT.DateAdd if sign > 0 else DT.DateSub
+                return ctor(left, B.Literal.of(days))
+            raise SqlError("month/year interval arithmetic is only "
+                           "supported on date literals")
+        if isinstance(left, _Interval):
+            raise SqlError("interval must be the right operand")
+        return (A.Add if sign > 0 else A.Subtract)(left, right)
+
+    def mul_expr(self):
+        e = self.unary_expr()
+        while True:
+            if self.accept_op("*"):
+                e = A.Multiply(e, self.unary_expr())
+            elif self.accept_op("/"):
+                e = A.Divide(e, self.unary_expr())
+            elif self.accept_op("%"):
+                e = A.Remainder(e, self.unary_expr())
+            else:
+                return e
+
+    def unary_expr(self):
+        if self.accept_op("-"):
+            e = self.unary_expr()
+            if isinstance(e, B.Literal) and not isinstance(
+                    e.dtype, (T.StringType, T.DateType)):
+                return B.Literal(-e.value, e.dtype)
+            return A.UnaryMinus(e)
+        self.accept_op("+")
+        return self.primary()
+
+    def primary(self):
+        t = self.peek()
+        if t[0] == "num":
+            self.i += 1
+            txt = t[1]
+            if "." in txt or "e" in txt or "E" in txt:
+                return B.Literal.of(float(txt))
+            return B.Literal.of(int(txt))
+        if t[0] == "str":
+            self.i += 1
+            return B.Literal.of(t[1][1:-1].replace("''", "'"))
+        if self.accept_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t[0] not in ("id", "qid"):
+            raise SqlError(f"unexpected {t[1]!r} at {t[2]}")
+
+        word = self.kw()
+        if word == "date" and self.peek(1)[0] == "str":
+            self.i += 1
+            s = self.peek()
+            self.i += 1
+            return _date_lit(s[1][1:-1])
+        if word == "interval":
+            self.i += 1
+            n_t = self.peek()
+            if n_t[0] == "str":
+                n = int(n_t[1][1:-1])
+            elif n_t[0] == "num":
+                n = int(n_t[1])
+            else:
+                raise SqlError(f"expected interval count at {n_t[2]}")
+            self.i += 1
+            unit = self.ident()
+            if unit.rstrip("s") not in ("day", "week", "month", "year"):
+                raise SqlError(f"unsupported interval unit {unit!r}")
+            return _Interval(n, unit)
+        if word == "case":
+            return self._case()
+        if word == "cast":
+            self.i += 1
+            self.expect_op("(")
+            e = self.expr()
+            self.expect("as")
+            tname = self.ident()
+            if tname == "decimal":
+                # DECIMAL(p, s)
+                self.expect_op("(")
+                p = int(self.peek()[1])
+                self.i += 1
+                sc = 0
+                if self.accept_op(","):
+                    sc = int(self.peek()[1])
+                    self.i += 1
+                self.expect_op(")")
+                dtype: T.DataType = T.DecimalType(p, sc)
+            else:
+                if tname not in _CAST_TYPES:
+                    raise SqlError(f"unsupported cast type {tname!r}")
+                dtype = _CAST_TYPES[tname]
+                if self.accept_op("("):  # varchar(n) etc.
+                    while not self.accept_op(")"):
+                        self.i += 1
+            self.expect_op(")")
+            return C.Cast(e, dtype)
+        if word == "extract":
+            self.i += 1
+            self.expect_op("(")
+            field = self.ident()
+            self.expect("from")
+            e = self.expr()
+            self.expect_op(")")
+            if field not in _EXTRACT_FIELDS:
+                raise SqlError(f"unsupported extract field {field!r}")
+            return _EXTRACT_FIELDS[field](e)
+        if word in ("null",):
+            self.i += 1
+            return B.Literal(None, T.NULL)
+        if word in ("true", "false"):
+            self.i += 1
+            return B.Literal.of(word == "true")
+
+        # function call or column reference
+        if self.peek(1)[0] == "op" and self.peek(1)[1] == "(":
+            fname = self.ident()
+            self.expect_op("(")
+            if fname == "count" and self.accept_op("*"):
+                self.expect_op(")")
+                return AG.CountStar()
+            distinct = self.accept("distinct")
+            args: list = []
+            if not self.accept_op(")"):
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+                self.expect_op(")")
+            if fname in _AGG_FNS:
+                if len(args) != 1:
+                    raise SqlError(f"{fname} takes one argument")
+                if distinct:
+                    if fname != "count":
+                        raise SqlError(
+                            f"DISTINCT unsupported for {fname}")
+                    from spark_rapids_tpu.session import count_distinct
+
+                    return count_distinct(args[0])
+                return _AGG_FNS[fname](args[0])
+            if fname in _SCALAR_FNS:
+                try:
+                    return _SCALAR_FNS[fname](*args)
+                except TypeError as e:
+                    raise SqlError(f"bad arguments for {fname}: {e}")
+            raise SqlError(f"unknown function {fname!r}")
+
+        name = self.ident()
+        if self.accept_op("."):
+            col = self.ident()
+            return _QualifiedRef(name, col)
+        return B.ColumnReference(name)
+
+    def _case(self):
+        self.expect("case")
+        operand = None
+        if not self.at("when"):
+            operand = self.expr()
+        branches: list[tuple] = []
+        while self.accept("when"):
+            cond = self.expr()
+            if operand is not None:
+                cond = P.EqualTo(operand, cond)
+            self.expect("then")
+            branches.append((cond, self.expr()))
+        default = self.expr() if self.accept("else") else None
+        self.expect("end")
+        return P.CaseWhen(tuple(branches), default)
+
+
+_CLAUSE_KWS = {"from", "where", "group", "having", "order", "limit",
+               "as", "on", "join", "inner", "left", "right", "full",
+               "and", "or", "not", "asc", "desc", "nulls", "union",
+               "when", "then", "else", "end", "between", "in", "like",
+               "is", "by"}
+_TABLE_STOP_KWS = _CLAUSE_KWS
+
+
+class _QualifiedRef(B.ColumnReference):
+    """alias.col — carries the qualifier for alias checking, lowers to
+    a bare name reference (engine resolution is by column name)."""
+
+    def __init__(self, qualifier: str, col: str):
+        super().__init__(col)
+        self.qualifier = qualifier
+
+
+# ------------------------------------------------------------------ #
+# Lowering onto the DataFrame surface
+# ------------------------------------------------------------------ #
+
+
+def _walk(e):
+    """Every sub-node, crossing BOTH Expression children and aggregate
+    functions hiding in expression slots (AggregateFunction is not an
+    Expression, so `children` alone would miss e.g. the count(*) inside
+    a HAVING comparison)."""
+    import dataclasses as _dcs
+
+    yield e
+    if isinstance(e, AG.AggregateFunction):
+        if e.child is not None:
+            yield from _walk(e.child)
+        return
+    for c in getattr(e, "children", ()):
+        yield from _walk(c)
+    if _dcs.is_dataclass(e):
+        for f in _dcs.fields(e):
+            v = getattr(e, f.name)
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vs:
+                if isinstance(x, AG.AggregateFunction):
+                    yield from _walk(x)
+
+
+def _has_agg(e) -> bool:
+    return any(isinstance(x, AG.AggregateFunction) for x in _walk(e))
+
+
+def _refs(e) -> set:
+    return {x.col_name for x in _walk(e)
+            if isinstance(x, B.ColumnReference)}
+
+
+def _conjuncts(e) -> list:
+    if isinstance(e, P.And):
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _and_all(es: Sequence):
+    out = None
+    for e in es:
+        out = e if out is None else P.And(out, e)
+    return out
+
+
+class SqlSession:
+    """The `frontend("sql")` object: register tables, run SQL text.
+
+    Registered tables are engine DataFrames (from `register_parquet`,
+    `register_table`, or any DataFrame built with the native API); the
+    planner then treats SQL-built plans identically to native ones."""
+
+    def __init__(self, conf=None):
+        from spark_rapids_tpu.session import TpuSession
+
+        self.session = TpuSession(conf) if conf is not None \
+            else TpuSession()
+        self._tables: dict[str, object] = {}
+
+    # -- registry -- #
+
+    def register_parquet(self, name: str, *paths: str) -> None:
+        self._tables[name.lower()] = self.session.read_parquet(*paths)
+
+    def register_table(self, name: str, df) -> None:
+        """Register an engine DataFrame (or a pyarrow Table)."""
+        import pyarrow as pa
+
+        if isinstance(df, pa.Table):
+            df = self.session.create_dataframe(df)
+        self._tables[name.lower()] = df
+
+    def table(self, name: str):
+        try:
+            return self._tables[name.lower()]
+        except KeyError:
+            raise SqlError(f"table {name!r} is not registered "
+                           f"(have: {sorted(self._tables)})") from None
+
+    # -- execution -- #
+
+    def sql(self, text: str):
+        """Parse + lower one SELECT; returns an engine DataFrame."""
+        q = _Parser(text).parse_select()
+        return self._lower(q)
+
+    def _lower(self, q: dict):
+        # resolve tables and alias -> column-set mapping
+        frames = []  # (alias, df, colnames)
+        for name, alias in [q["tables"][0]] + [j[1] for j in q["joins"]]:
+            df = self.table(name)
+            cols = {f.name.lower() for f in df.schema.fields}
+            frames.append((alias.lower(), df, cols))
+        self._check_qualifiers(q, frames)
+
+        where_conjs = _conjuncts(q["where"]) if q["where"] is not None \
+            else []
+        joins = q["joins"]
+
+        # push single-table conjuncts down to their frame (the textbook
+        # predicate-pushdown rewrite; lets the scan prefilter see them).
+        # ONLY sound when every join is inner: a WHERE conjunct over the
+        # null-producing side of an outer join filters post-join NULLs,
+        # which a pre-join filter cannot reproduce — with any outer join
+        # present, all WHERE conjuncts stay above the joins.
+        all_inner = all(j[0] in ("cross", "inner") for j in joins)
+        pushed_ids: set = set()
+        frames2 = []
+        for alias, df, cols in frames:
+            mine = []
+            if all_inner:
+                for cj in where_conjs:
+                    r = _refs(cj)
+                    if id(cj) not in pushed_ids and r and r <= cols \
+                            and not _has_agg(cj):
+                        mine.append(cj)
+                        pushed_ids.add(id(cj))
+            pushed = _and_all(mine)
+            if pushed is not None:
+                df = df.where(pushed)
+            frames2.append((alias, df, cols))
+        remaining = [cj for cj in where_conjs
+                     if id(cj) not in pushed_ids]
+
+        # left-deep join in FROM order; comma joins consume equality
+        # conjuncts from WHERE as join keys
+        acc_alias, acc_df, acc_cols = frames2[0]
+        acc_cols = set(acc_cols)
+        for (how, _tr, on_expr), (alias, df, cols) in zip(
+                joins, frames2[1:]):
+            lk, rk, extra = [], [], []
+            if how == "cross":
+                how = "inner"
+                take = []
+                for cj in remaining:
+                    sides = self._equi_sides(cj, acc_cols, cols)
+                    if sides is not None:
+                        lk.append(sides[0])
+                        rk.append(sides[1])
+                        take.append(cj)
+                remaining = [c for c in remaining if c not in take]
+                if not lk:
+                    raise SqlError(
+                        f"no join condition links table "
+                        f"{alias!r} to the preceding tables "
+                        "(cartesian products are not supported)")
+            else:
+                for cj in _conjuncts(on_expr):
+                    sides = self._equi_sides(cj, acc_cols, cols)
+                    if sides is not None:
+                        lk.append(sides[0])
+                        rk.append(sides[1])
+                    else:
+                        extra.append(cj)
+                if not lk:
+                    raise SqlError("JOIN ON needs at least one "
+                                   "equality condition")
+            acc_df = acc_df.join(df, left_on=lk, right_on=rk, how=how,
+                                 condition=_and_all(extra))
+            acc_cols |= cols
+
+        post_where = _and_all(remaining)
+        if post_where is not None:
+            acc_df = acc_df.where(post_where)
+
+        return self._project(q, acc_df)
+
+    @staticmethod
+    def _equi_sides(cj, left_cols: set, right_cols: set):
+        if not isinstance(cj, P.EqualTo):
+            return None
+        a, b = cj.left, cj.right
+        if not (isinstance(a, B.ColumnReference)
+                and isinstance(b, B.ColumnReference)):
+            return None
+        an, bn = a.col_name, b.col_name
+        if an in left_cols and bn in right_cols:
+            return (B.ColumnReference(an), B.ColumnReference(bn))
+        if bn in left_cols and an in right_cols:
+            return (B.ColumnReference(bn), B.ColumnReference(an))
+        return None
+
+    def _check_qualifiers(self, q: dict, frames) -> None:
+        alias_cols = {a: cols for a, _df, cols in frames}
+
+        def check(e):
+            for x in _walk(e):
+                if isinstance(x, _QualifiedRef):
+                    cols = alias_cols.get(x.qualifier.lower())
+                    if cols is None:
+                        raise SqlError(
+                            f"unknown table alias {x.qualifier!r}")
+                    if x.col_name.lower() not in cols:
+                        raise SqlError(
+                            f"column {x.col_name!r} not in table "
+                            f"{x.qualifier!r}")
+
+        for item, _alias in q["items"]:
+            if item != "*":
+                check(item)
+        for part in ("where", "having"):
+            if q[part] is not None:
+                check(q[part])
+        for e in q["group_by"]:
+            check(e)
+        for e, _d, _n in q["order_by"]:
+            check(e)
+
+    def _project(self, q: dict, df):
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        items = q["items"]
+        group_by = q["group_by"]
+        has_aggs = any(item != "*" and _has_agg(item)
+                       for item, _ in items) or q["having"] is not None
+
+        if not group_by and not has_aggs:
+            out = self._plain_select(items, df, q["distinct"])
+        else:
+            out = self._grouped_select(items, group_by, df, q)
+
+        # ORDER BY: output names, aliases, 1-based ordinals, or (for
+        # non-aggregate queries) arbitrary expressions over the input
+        out_names = [f.name for f in out.schema.fields]
+        if q["order_by"]:
+            keys = []
+            for e, desc, nulls_last in q["order_by"]:
+                if isinstance(e, B.Literal) and isinstance(e.value, int) \
+                        and 1 <= e.value <= len(out_names):
+                    e = B.ColumnReference(out_names[e.value - 1])
+                keys.append(SortKey(e, descending=desc,
+                                    nulls_last=nulls_last))
+            out = out.order_by(*keys)
+        if q["limit"] is not None:
+            out = out.limit(q["limit"])
+        return out
+
+    @staticmethod
+    def _agg_key(a) -> tuple:
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        return (type(a).__name__,
+                expr_key(a.child) if a.child is not None else None)
+
+    def _rewrite_having(self, hv, aggs, hidden):
+        """Replace aggregate calls inside HAVING with references to the
+        aggregate's output column, adding hidden aggregates for calls
+        not already in the SELECT list (dropped by the re-projection)."""
+        import dataclasses as _dcs
+
+        def ref_for(a):
+            k = self._agg_key(a)
+            for fn, name in aggs:
+                if self._agg_key(fn) == k:
+                    return B.ColumnReference(name)
+            for fn, name in hidden:
+                if self._agg_key(fn) == k:
+                    return B.ColumnReference(name)
+            name = f"__having{len(hidden)}"
+            hidden.append((a, name))
+            return B.ColumnReference(name)
+
+        def rw(e):
+            if isinstance(e, AG.AggregateFunction):
+                return ref_for(e)
+            if not _dcs.is_dataclass(e):
+                return e
+            changed = False
+            vals = {}
+            for f in _dcs.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (B.Expression, AG.AggregateFunction)):
+                    nv = rw(v)
+                elif isinstance(v, tuple):
+                    nv = tuple(
+                        rw(x) if isinstance(
+                            x, (B.Expression, AG.AggregateFunction))
+                        else x for x in v)
+                else:
+                    nv = v
+                vals[f.name] = nv
+                changed = changed or nv is not v
+            return _dcs.replace(e, **vals) if changed else e
+
+        return rw(hv)
+
+    def _plain_select(self, items, df, distinct):
+        star = [f.name for f in df.schema.fields]
+        exprs = []
+        for item, alias in items:
+            if item == "*":
+                exprs.extend(B.ColumnReference(n) for n in star)
+            elif alias:
+                exprs.append(B.Alias(item, alias))
+            else:
+                exprs.append(item)
+        out = df.select(*exprs)
+        if distinct:
+            out = out.group_by(
+                *[B.ColumnReference(f.name)
+                  for f in out.schema.fields]).agg()
+        return out
+
+    def _grouped_select(self, items, group_by, df, q):
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        # SELECT items must be group keys or single aggregate calls
+        # (arbitrary input expressions inside the aggregate are fine)
+        aliases = {al.lower(): it for it, al in items
+                   if al and it != "*"}
+        # GROUP BY may name select ALIASES (Spark allows it)
+        group_exprs = []
+        for g in group_by:
+            if isinstance(g, B.ColumnReference) \
+                    and g.col_name.lower() in aliases \
+                    and g.col_name.lower() not in {
+                        f.name.lower() for f in df.schema.fields}:
+                g = aliases[g.col_name.lower()]
+            group_exprs.append(g)
+        gkeys = {expr_key(e) for e in group_exprs}
+
+        aggs = []
+        key_items = []  # (expr, out_name)
+        for item, alias in items:
+            if item == "*":
+                raise SqlError("SELECT * with GROUP BY is not supported")
+            if _has_agg(item):
+                if not isinstance(item, AG.AggregateFunction):
+                    raise SqlError(
+                        "arithmetic over aggregate results is not yet "
+                        "supported; alias the aggregate and post-process")
+                aggs.append((item, alias or item.name))
+            else:
+                if expr_key(item) not in gkeys:
+                    raise SqlError(
+                        f"non-aggregate select item {item.name!r} must "
+                        "appear in GROUP BY")
+                key_items.append((item, alias))
+
+        having = q["having"]
+        hidden: list = []
+        if having is not None and _has_agg(having):
+            having = self._rewrite_having(having, aggs, hidden)
+        out = df.group_by(*group_exprs).agg(*aggs, *hidden)
+        if having is not None:
+            out = out.where(having)
+
+        # aggregate output = [group keys..., aggs...]; re-project when
+        # the SELECT order/aliases differ from that layout
+        out_fields = [f.name for f in out.schema.fields]
+        n_keys = len(group_exprs)
+        sel = []
+        for item, alias in items:
+            if _has_agg(item):
+                name = alias or item.name
+                sel.append(B.ColumnReference(name))
+            else:
+                from spark_rapids_tpu.execs.jit_cache import expr_key
+
+                idx = [i for i, g in enumerate(group_exprs)
+                       if expr_key(g) == expr_key(item)][0]
+                ref = B.ColumnReference(out_fields[idx])
+                sel.append(B.Alias(ref, alias) if alias else ref)
+        want = [a or (it.name if it != "*" else "*")
+                for it, a in items]
+        if want != out_fields or any(al for _it, al in items):
+            out = out.select(*sel)
+        return out
+
+
+def _sql_frontend(conf=None) -> SqlSession:
+    return SqlSession(conf)
+
+
+from spark_rapids_tpu.plugin import register_frontend  # noqa: E402
+
+register_frontend("sql", _sql_frontend)

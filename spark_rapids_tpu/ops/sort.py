@@ -43,15 +43,31 @@ class SortOrder:
 
 
 def float_total_order_bits(x: jax.Array) -> jax.Array:
-    """Map float array to ints whose ascending order is IEEE total order
-    (with canonical NaN > +inf, as Spark sorts NaN largest)."""
-    if x.dtype == jnp.float64:
-        bits = jax.lax.bitcast_convert_type(x, jnp.int64)
-        bits = jnp.where(jnp.isnan(x), jnp.int64(0x7FF8000000000000), bits)
-        return jnp.where(bits < 0, bits ^ jnp.int64(2**63 - 1), bits)
+    """Map a FLOAT32 array to ints whose ascending order is IEEE total
+    order (with canonical NaN > +inf, as Spark sorts NaN largest).
+    float64 has no bitcast form on TPU (the X64 rewriter cannot compile
+    64-bit bitcast-convert) — use float64_order_keys instead."""
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
     bits = jnp.where(jnp.isnan(x), jnp.int32(0x7FC00000), bits)
     return jnp.where(bits < 0, bits ^ jnp.int32(2**31 - 1), bits)
+
+
+def float64_order_keys(x: jax.Array, descending: bool) -> list:
+    """float64 total order WITHOUT a 64-bit bitcast (which the TPU X64
+    rewriter cannot compile): sort by the value itself with NaN
+    canonicalized to +inf, break the +inf tie with an is-NaN flag (NaN
+    strictly above +inf), and break the IEEE ±0.0 tie with the sign bit
+    (-0.0 strictly below 0.0, matching the bit-order the CPU oracle
+    sorts by).  Returned minor-first (flags are tiebreakers)."""
+    isnan = jnp.isnan(x)
+    vals = jnp.where(isnan, jnp.inf, x)
+    flag = isnan.astype(jnp.int32)
+    zkey = jnp.where(isnan, 1, 1 - jnp.signbit(x).astype(jnp.int32))
+    if descending:
+        vals = -vals
+        flag = 1 - flag
+        zkey = 1 - zkey
+    return [flag, zkey, vals]
 
 
 def _string_word_keys(col: StringColumn) -> list[jax.Array]:
@@ -82,9 +98,11 @@ def column_sort_keys(col: AnyColumn, descending: bool,
         if descending:
             vals = [~v for v in vals]
         vals = list(reversed(vals))  # minor-first
+    elif isinstance(col.dtype, T.DoubleType):
+        vals = float64_order_keys(col.data, descending)
     else:
         d = col.data
-        if isinstance(col.dtype, (T.FloatType, T.DoubleType)):
+        if isinstance(col.dtype, T.FloatType):
             k = float_total_order_bits(d)
         elif col.dtype == T.BOOLEAN:
             k = d.astype(jnp.int32)

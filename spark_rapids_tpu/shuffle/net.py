@@ -362,9 +362,17 @@ class HeartbeatClient:
                     except FetchFailedError:
                         pass
                 # registry unreachable: keep last-known peers
-            self._timer = threading.Timer(interval, tick)
-            self._timer.daemon = True
-            self._timer.start()
+            except Exception:
+                # any other failure (malformed registry response, socket
+                # teardown race) must not kill the heartbeat chain — a
+                # dead chain silently ages this executor out of the
+                # registry
+                pass
+            finally:
+                if not self._stopped:
+                    self._timer = threading.Timer(interval, tick)
+                    self._timer.daemon = True
+                    self._timer.start()
 
         tick()
 
