@@ -40,11 +40,14 @@ if _os.environ.get("SPARK_RAPIDS_TPU_NO_X64", "") != "1":
 # PER sort/scan program, and every new process would pay it again.  The
 # cache is keyed by program+topology, survives across processes, and was
 # measured cutting a 20s sort compile to 0.2s on the tunneled TPU
-# backend.  Opt out (or redirect) via SPARK_RAPIDS_TPU_JAX_CACHE.
-_cache_dir = _os.environ.get(
-    "SPARK_RAPIDS_TPU_JAX_CACHE",
-    _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), _os.pardir,
-                  ".jax_cache"))
+# backend.  Default lives under the user cache dir (XDG) — NOT the
+# package parent, which for pip installs would pollute site-packages.
+# Opt out with SPARK_RAPIDS_TPU_JAX_CACHE=0, or redirect it.
+_cache_dir = _os.environ.get("SPARK_RAPIDS_TPU_JAX_CACHE")
+if _cache_dir is None:
+    _xdg = _os.environ.get("XDG_CACHE_HOME",
+                           _os.path.expanduser("~/.cache"))
+    _cache_dir = _os.path.join(_xdg, "spark_rapids_tpu", "jax-cache")
 if _cache_dir and _cache_dir != "0":
     import jax as _jax
 
@@ -54,6 +57,6 @@ if _cache_dir and _cache_dir != "0":
         _jax.config.update("jax_persistent_cache_min_compile_time_secs",
                            1.0)
     except Exception:
-        pass  # read-only installs: in-memory cache only
+        pass  # unwritable cache home: in-memory cache only
 
 from spark_rapids_tpu.config import TpuConf, get_conf, set_conf  # noqa: F401

@@ -23,9 +23,15 @@ from spark_rapids_tpu.config import get_conf, register
 _MAGIC = b"TPUB"
 _VERSION = 1
 
-#: (spark.rapids.tpu.shuffle.compression.codec is reserved for a
-#: network shuffle transport; it is intentionally NOT registered until
-#: a consumer exists — the in-process shuffle never serializes.)
+SHUFFLE_COMPRESSION = register(
+    "spark.rapids.tpu.shuffle.compression.codec", "none",
+    "Codec for shuffle payloads crossing the TCP block transport: "
+    "'none' or 'zlib' (ref: the reference compresses shuffle buffers "
+    "on device via nvcomp, NvcompLZ4CompressionCodec.scala:25, conf "
+    "spark.rapids.shuffle.compression.codec RapidsConf.scala:905; "
+    "this engine's transport is host-side, so the codec runs on the "
+    "serialized frame).")
+
 SPILL_COMPRESSION = register(
     "spark.rapids.tpu.memory.spill.compression.codec", "none",
     "Codec for the disk spill tier: 'none' or 'zlib' (ref: "

@@ -1184,6 +1184,16 @@ def execute_cpu(plan: L.LogicalPlan) -> pa.Table:
         arrays = [cpu_eval(e, child) for e in plan.exprs]
         return pa.Table.from_arrays(arrays,
                                     schema=schema_to_arrow(plan.schema))
+    if isinstance(plan, L.Cached):
+        # CPU engine caches the materialized table in the same slot
+        with plan.slot.lock:
+            if plan.slot.cpu_table is not None:
+                return plan.slot.cpu_table
+        t = execute_cpu(plan.children[0])
+        with plan.slot.lock:
+            if plan.slot.cpu_table is None:
+                plan.slot.cpu_table = t
+        return t
     if isinstance(plan, L.Filter):
         child = execute_cpu(plan.children[0])
         mask = pc.fill_null(cpu_eval(plan.condition, child), False)
@@ -1548,7 +1558,14 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
     # project keys + agg inputs with partial-dtype casts applied
     cols, names, agg_specs = [], [], []
     for i, g in enumerate(plan.groups):
-        cols.append(cpu_eval(g, child))
+        arr = cpu_eval(g, child)
+        if pa.types.is_floating(arr.type):
+            # Spark's NormalizeFloatingNumbers under grouping keys:
+            # -0.0 groups (and reports) as 0.0; NaNs as one canonical
+            # NaN (pyarrow already groups NaNs together)
+            zero = pa.scalar(0.0, arr.type)
+            arr = pc.if_else(pc.equal(arr, zero), zero, arr)
+        cols.append(arr)
         names.append(plan.schema.fields[i].name)
     seen = 0
     for na in plan.aggs:
@@ -1569,7 +1586,13 @@ def _aggregate_cpu(plan: L.Aggregate) -> pa.Table:
         names.append(in_name)
         agg_specs.append(([in_name], fn.name, na.out_name, fn))
 
-    proj = pa.Table.from_arrays(cols, names=names)
+    if cols:
+        proj = pa.Table.from_arrays(cols, names=names)
+    else:
+        # COUNT(*)-only grand aggregate: a zero-column table would
+        # report zero rows; count against the child's row count (the
+        # TPU exec pads with a constant column for the same reason)
+        proj = child
     if n_keys == 0:
         out_cols, out_names = [], []
         for in_names, fname, out_name, fn in agg_specs:
@@ -1740,7 +1763,9 @@ def _cast_cpu_from_string(c: pa.Array, dst, at) -> pa.Array:
     import re
 
     if _INT_RE is None:
-        _INT_RE = re.compile(r"^[+-]?[0-9]+$")
+        # Spark accepts a fractional tail and truncates toward zero
+        # (cast('3.5' as int) = 3); exponents stay rejected
+        _INT_RE = re.compile(r"^([+-]?)([0-9]*)(?:\.([0-9]*))?$")
     out = []
     if isinstance(dst, T.IntegralType):
         lo = np.iinfo(T.to_numpy_dtype(dst)).min
@@ -1750,10 +1775,11 @@ def _cast_cpu_from_string(c: pa.Array, dst, at) -> pa.Array:
                 out.append(None)
                 continue
             s = v.strip()
-            if not _INT_RE.match(s):
+            m = _INT_RE.match(s)
+            if not m or not (m.group(2) or m.group(3)):
                 out.append(None)
                 continue
-            iv = int(s)
+            iv = int((m.group(1) or "") + (m.group(2) or "0"))
             out.append(iv if lo <= iv <= hi else None)
         return pa.array(out, at)
     if isinstance(dst, (T.FloatType, T.DoubleType)):

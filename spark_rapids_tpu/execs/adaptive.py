@@ -49,14 +49,34 @@ ADVISORY_PARTITION_BYTES = register(
     "Target bytes per reduce task after adaptive partition coalescing "
     "(the spark.sql.adaptive.advisoryPartitionSizeInBytes analog).")
 
+SKEW_FACTOR = register(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor", 5.0,
+    "A reduce partition is skewed when its bytes exceed this multiple "
+    "of the median partition size (and the threshold below) — the "
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor analog.")
+
+SKEW_THRESHOLD_BYTES = register(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThresholdBytes",
+    64 << 20,
+    "Minimum bytes before a partition is considered skewed (the "
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdBytes "
+    "analog).")
+
+
+#: one reduce-side read unit: (reduce_id, slice_index, slice_count).
+#: (rid, 0, 1) reads the whole partition; (rid, i, k) reads the i-th of
+#: k block-wise slices — the stream side of a skew split.  The build
+#: side pairs each slice with a FULL (rid, 0, 1) read (build-side
+#: completeness per split, Spark's OptimizeSkewedJoin contract).
+PartSpec = tuple
+
 
 def plan_coalesced_groups(part_bytes: Sequence[int],
                           target: int) -> list[list[int]]:
     """Group ADJACENT reduce partitions until each group reaches the
     advisory target (hash co-partitioning is preserved only by identical
-    adjacent grouping on every side).  Empty partitions merge for free;
-    a single oversized partition stays its own group (skew splitting
-    would break build-side completeness for joins — documented gap)."""
+    adjacent grouping on every side).  Empty partitions merge for
+    free."""
     groups: list[list[int]] = []
     cur: list[int] = []
     cur_bytes = 0
@@ -71,14 +91,129 @@ def plan_coalesced_groups(part_bytes: Sequence[int],
     return groups or [[0]]
 
 
-class CoalescedShuffleReaderExec(TpuExec):
-    """Reduce-side reader exposing groups of adjacent shuffle partitions
-    as single partitions (ref: GpuCustomShuffleReaderExec's
-    CoalescedPartitionSpec handling)."""
+def _skew_split_side(join_type: str) -> Optional[str]:
+    """Which side may be sliced without changing join semantics: a
+    sliced side's rows each appear in exactly one slice, so inner and
+    <side>-preserving joins stay correct; the OTHER side must stay
+    complete per slice (it is the hash-build / null-producing side)."""
+    if join_type == "inner":
+        return "either"
+    if join_type in ("left_outer", "left_semi", "left_anti"):
+        return "left"
+    if join_type == "right_outer":
+        return "right"
+    return None  # full_outer: no sound single-side split
 
-    def __init__(self, exchange, groups: list[list[int]]):
+
+def plan_skew_groups(lbytes: Sequence[int], rbytes: Sequence[int],
+                     target: int, factor: float, threshold: int,
+                     join_type: str,
+                     lblocks: Optional[Sequence[int]] = None,
+                     rblocks: Optional[Sequence[int]] = None
+                     ) -> Optional[tuple[list, list, int]]:
+    """Skew-aware aligned read plans for both sides.
+
+    Returns (left_groups, right_groups, n_splits) where each group is a
+    list of PartSpec read units and the two lists pair 1:1 into
+    partition-wise join tasks — or None when nothing is skewed (caller
+    falls back to plain coalescing).  A skewed partition becomes k
+    tasks: k slices on the splittable side, each paired with a FULL
+    read of the partition on the other side (ref:
+    GpuCustomShuffleReaderExec's PartialReducerPartitionSpec handling /
+    Spark's OptimizeSkewedJoin)."""
+    import statistics as _st
+
+    side = _skew_split_side(join_type)
+    if side is None or not lbytes:
+        return None
+    med_l = _st.median(lbytes)
+    med_r = _st.median(rbytes)
+
+    def skewed(b, med) -> bool:
+        return b > threshold and b > factor * max(med, 1)
+
+    lgroups: list[list[PartSpec]] = []
+    rgroups: list[list[PartSpec]] = []
+    plain: list[int] = []
+    plain_bytes: list[int] = []
+    n_splits = 0
+
+    def flush_plain():
+        if not plain:
+            return
+        for grp in plan_coalesced_groups(plain_bytes, target):
+            rids = [plain[i] for i in grp]
+            lgroups.append([(r, 0, 1) for r in rids])
+            rgroups.append([(r, 0, 1) for r in rids])
+        plain.clear()
+        plain_bytes.clear()
+
+    for rid, (lb, rb) in enumerate(zip(lbytes, rbytes)):
+        split_left = skewed(lb, med_l) and side in ("left", "either")
+        split_right = skewed(rb, med_r) and side in ("right", "either")
+        if split_left and split_right:
+            # slicing both sides of one partition needs the cartesian
+            # pairing of slices; split only the bigger side instead
+            if lb >= rb:
+                split_right = False
+            else:
+                split_left = False
+        if not (split_left or split_right):
+            plain.append(rid)
+            plain_bytes.append(lb + rb)
+            continue
+        flush_plain()
+        big = lb if split_left else rb
+        k = max(2, -(-big // max(target, 1)))
+        # slices deal BLOCKS round-robin: more slices than committed
+        # blocks would be empty tasks that still pay a full build-side
+        # read + hash build each
+        blocks = (lblocks if split_left else rblocks)
+        if blocks is not None and rid < len(blocks):
+            k = min(k, max(2, blocks[rid]))
+        if blocks is not None and rid < len(blocks) and blocks[rid] <= 1:
+            # a single-block partition cannot slice: leave it whole
+            plain.append(rid)
+            plain_bytes.append(lb + rb)
+            continue
+        n_splits += k
+        for i in range(k):
+            if split_left:
+                lgroups.append([(rid, i, k)])
+                rgroups.append([(rid, 0, 1)])
+            else:
+                lgroups.append([(rid, 0, 1)])
+                rgroups.append([(rid, i, k)])
+    if n_splits == 0:
+        return None
+    flush_plain()
+    return lgroups, rgroups, n_splits
+
+
+class CoalescedShuffleReaderExec(TpuExec):
+    """Reduce-side reader exposing groups of shuffle-partition read
+    units as single partitions (ref: GpuCustomShuffleReaderExec —
+    CoalescedPartitionSpec for adjacent grouping and
+    PartialReducerPartitionSpec for skew slices).
+
+    Groups hold PartSpec units: plain int rids (whole partitions) or
+    (rid, i, k) tuples reading the i-th of k block-wise slices of a
+    skewed partition (blocks deal round-robin by index, which is
+    deterministic: the map output order is fixed once committed)."""
+
+    def __init__(self, exchange, groups: list):
         super().__init__(exchange)
-        self.groups = groups
+        self.groups = [[(g, 0, 1) if isinstance(g, int) else tuple(g)
+                        for g in grp] for grp in groups]
+        # rids visited more than once (a sliced partition, or the full
+        # partition paired against each slice) need the NON-consuming
+        # exchange read; single-visit rids keep the consuming read that
+        # frees blocks as early as possible
+        counts: dict[int, int] = {}
+        for grp in self.groups:
+            for rid, _i, _k in grp:
+                counts[rid] = counts.get(rid, 0) + 1
+        self._multi_read = {r for r, c in counts.items() if c > 1}
 
     @property
     def schema(self) -> T.Schema:
@@ -97,13 +232,23 @@ class CoalescedShuffleReaderExec(TpuExec):
 
     def node_desc(self) -> str:
         n_raw = self.children[0].num_partitions
+        n_split = sum(1 for grp in self.groups
+                      for (_r, _i, k) in grp if k > 1)
+        extra = f", {n_split} skew slices" if n_split else ""
         return (f"CoalescedShuffleReaderExec [{n_raw} -> "
-                f"{len(self.groups)} partitions]")
+                f"{len(self.groups)} partitions{extra}]")
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        for rid in self.groups[p]:
-            for b in self.children[0].execute_partition(rid):
-                yield self._count_output(b)
+        ex = self.children[0]
+        for rid, i, k in self.groups[p]:
+            if rid in self._multi_read and hasattr(
+                    ex, "execute_partition_keep"):
+                source = ex.execute_partition_keep(rid)
+            else:
+                source = ex.execute_partition(rid)
+            for bi, b in enumerate(source):
+                if k == 1 or bi % k == i:
+                    yield self._count_output(b)
 
     def execute(self) -> Iterator[ColumnarBatch]:
         for p in range(self.num_partitions):
@@ -153,10 +298,10 @@ class TpuAdaptiveJoinExec(TpuExec):
         # STATIC width (the template's): reading partition counts must
         # never trigger _decide() — the planner inspects num_partitions
         # while building the tree, and materializing map stages at plan
-        # time would execute scans for explain-only queries.  The
-        # decided exec only ever has <= this many partitions (broadcast
-        # keeps the stream width, coalescing shrinks it); the excess
-        # partitions execute as empty.
+        # time would execute scans for explain-only queries.  Shrunken
+        # widths (broadcast/coalescing) leave the tail partitions empty;
+        # EXPANDED widths (skew splits) overflow-drain through the last
+        # static partition (see execute_partition).
         return self._template.num_partitions
 
     def node_desc(self) -> str:
@@ -165,7 +310,8 @@ class TpuAdaptiveJoinExec(TpuExec):
 
     def additional_metrics(self):
         return [("adaptiveBroadcasts", "ESSENTIAL"),
-                ("coalescedPartitions", "MODERATE")]
+                ("coalescedPartitions", "MODERATE"),
+                ("skewSplits", "ESSENTIAL")]
 
     # -- runtime decision ------------------------------------------------ #
 
@@ -201,8 +347,26 @@ class TpuAdaptiveJoinExec(TpuExec):
                     condition=self.condition, build_side=side)
             else:
                 target = conf.get(ADVISORY_PARTITION_BYTES)
-                per_part = [lb + rb for (lb, _), (rb, _)
-                            in zip(lstats, rstats)]
+                lb_list = [b for b, _ in lstats]
+                rb_list = [b for b, _ in rstats]
+                skew = plan_skew_groups(
+                    lb_list, rb_list, target, conf.get(SKEW_FACTOR),
+                    conf.get(SKEW_THRESHOLD_BYTES), jt,
+                    lblocks=lex.block_counts()
+                    if hasattr(lex, "block_counts") else None,
+                    rblocks=rex.block_counts()
+                    if hasattr(rex, "block_counts") else None)
+                if skew is not None:
+                    lgroups, rgroups, n_splits = skew
+                    self.metrics["skewSplits"].add(n_splits)
+                    self._decision = (f"shuffled[skew: {n_splits} "
+                                      f"splits, {len(lgroups)} tasks]")
+                    self._decided = self._make_shuffled(
+                        CoalescedShuffleReaderExec(lex, lgroups),
+                        CoalescedShuffleReaderExec(rex, rgroups))
+                    self._adopt_metrics()
+                    return self._decided
+                per_part = [lb + rb for lb, rb in zip(lb_list, rb_list)]
                 groups = plan_coalesced_groups(per_part, target)
                 if len(groups) < len(per_part):
                     self.metrics["coalescedPartitions"].add(
@@ -215,20 +379,32 @@ class TpuAdaptiveJoinExec(TpuExec):
                 else:
                     self._decision = "shuffled"
                     self._decided = self._template
-            # the decided exec is not a child, so metric collection
-            # would miss it: adopt its Metric objects (live references)
-            # under this node, keeping only the adaptive-specific ones
-            own = {"adaptiveBroadcasts", "coalescedPartitions"}
-            for k, v in self._decided.metrics.items():
-                if k not in own:
-                    self.metrics[k] = v
+            self._adopt_metrics()
             return self._decided
+
+    def _adopt_metrics(self) -> None:
+        # the decided exec is not a child, so metric collection would
+        # miss it: adopt its Metric objects (live references) under
+        # this node, keeping only the adaptive-specific ones
+        own = {"adaptiveBroadcasts", "coalescedPartitions", "skewSplits"}
+        for k, v in self._decided.metrics.items():
+            if k not in own:
+                self.metrics[k] = v
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         decided = self._decide()
-        if p >= decided.num_partitions:
-            return  # coalescing shrank the width; tail partitions empty
-        yield from decided.execute_partition(p)
+        n_static = self._template.num_partitions
+        if p < decided.num_partitions:
+            yield from decided.execute_partition(p)
+        # skew splitting can EXPAND the task count past the static
+        # width the parent iterates (num_partitions must stay static:
+        # parents read it before any partition executes, and deciding
+        # at plan time would materialize map stages for explain-only
+        # queries).  The last static partition drains the overflow so
+        # no task is silently dropped.
+        if p == n_static - 1:
+            for q in range(n_static, decided.num_partitions):
+                yield from decided.execute_partition(q)
 
     def execute(self) -> Iterator[ColumnarBatch]:
         yield from self._decide().execute()

@@ -199,6 +199,20 @@ class TpuHashAggregateExec(TpuExec):
 
         ctx = EvalContext.for_batch(batch)
         cols = [e.eval(ctx) for e in self.input_exprs]
+        # Spark inserts NormalizeNaNAndZero under grouping keys (the
+        # analyzer's NormalizeFloatingNumbers rule): -0.0 groups AS 0.0
+        # and every NaN as the one canonical NaN — normalize here so
+        # the emitted key VALUE is canonical too, not just the grouping
+        from spark_rapids_tpu.columnar.column import Column as _Col
+
+        for i in range(self.n_keys):
+            c = cols[i]
+            if isinstance(c, _Col) and isinstance(
+                    c.dtype, (T.FloatType, T.DoubleType)):
+                d = jnp.where(jnp.isnan(c.data), jnp.nan,
+                              jnp.where(c.data == 0, 0.0, c.data)
+                              ).astype(c.data.dtype)
+                cols[i] = _Col(d, c.validity, c.dtype)
         proj = ColumnarBatch(cols, batch.num_rows, self.update_input_schema)
         specs = self._update_specs()
         if self.n_keys == 0:

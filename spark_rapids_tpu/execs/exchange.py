@@ -338,9 +338,24 @@ class TpuShuffleExchangeExec(TpuExec):
         return get_shuffle_manager().partition_stats(
             self._shuffle_id, self.num_partitions)
 
+    def block_counts(self) -> list[int]:
+        """Committed blocks per reduce partition (map stage must have
+        materialized; callers go through materialize_stats first)."""
+        self._ensure_map_stage()
+        return get_shuffle_manager().block_counts(
+            self._shuffle_id, self.num_partitions)
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         self._ensure_map_stage()
         for b in get_shuffle_manager().read(self._shuffle_id, p):
+            yield self._count_output(b)
+
+    def execute_partition_keep(self, p: int) -> Iterator[ColumnarBatch]:
+        """Non-consuming variant for readers that visit a reduce
+        partition more than once (skew-split slices); blocks stay
+        registered until close()/unregister."""
+        self._ensure_map_stage()
+        for b in get_shuffle_manager().read_keep(self._shuffle_id, p):
             yield self._count_output(b)
 
     def execute(self) -> Iterator[ColumnarBatch]:

@@ -222,9 +222,23 @@ def _parse_integral(c: StringColumn, dst: T.DataType) -> Column:
         (chars == 45) | (chars == 43))
     sign_neg = jnp.any(has_sign & (chars == 45), axis=1)
     dstart = start + jnp.any(has_sign, axis=1).astype(jnp.int32)
-    is_digit_pos = (pos >= dstart[:, None]) & (pos < end[:, None])
+    payload = (pos >= dstart[:, None]) & (pos < end[:, None])
     is_digit = (chars >= 48) & (chars <= 57)
-    ok = jnp.all(~is_digit_pos | is_digit, axis=1) & (end > dstart)
+    # Spark accepts a fractional tail and TRUNCATES toward zero
+    # (cast('3.5' as int) = 3, cast('-3.5' as int) = -3): digits up to
+    # an optional single '.', digits after it ignored for the value
+    dot = payload & (chars == 46)
+    any_dot = jnp.any(dot, axis=1)
+    first_dot = jnp.where(any_dot,
+                          jnp.argmax(dot, axis=1).astype(jnp.int32),
+                          end)
+    int_pos = payload & (pos < first_dot[:, None])
+    frac_pos = payload & (pos > first_dot[:, None])
+    n_digits = jnp.sum((int_pos | frac_pos) & is_digit, axis=1)
+    ok = jnp.all(~int_pos | is_digit, axis=1) \
+        & jnp.all(~frac_pos | is_digit, axis=1) \
+        & (n_digits > 0)
+    is_digit_pos = int_pos
     digit_vals = jnp.where(is_digit_pos & is_digit, chars - 48, 0)
     # Horner in uint64 magnitude with overflow detection (19-digit
     # values can exceed INT64_MAX and must become NULL, not wrap)

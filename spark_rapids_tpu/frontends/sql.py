@@ -116,7 +116,10 @@ _SCALAR_FNS = {
     "coalesce": lambda *xs: P.Coalesce(*xs),
     "abs": lambda x: A.Abs(x),
     "round": lambda x, n=None: M.Round(
-        x, n if n is not None else B.Literal.of(0)),
+        x, 0 if n is None else _lit_int(n, "round scale")),
+    "bround": lambda x, n=None: M.BRound(
+        x, 0 if n is None else _lit_int(n, "round scale")),
+    "pmod": lambda a, b: A.Pmod(a, b),
     "year": lambda x: DT.Year(x),
     "month": lambda x: DT.Month(x),
     "day": lambda x: DT.DayOfMonth(x),
@@ -144,10 +147,6 @@ _CAST_TYPES = {
 }
 
 _EPOCH = _dt.date(1970, 1, 1)
-
-_INTERVAL_UNITS = {"day": 1, "days": 1, "month": 30, "months": 30,
-                   "year": 365, "years": 365, "week": 7, "weeks": 7}
-
 
 class _Interval:
     """Parse-time interval value; only valid folded into date ± or as
@@ -603,6 +602,28 @@ _CLAUSE_KWS = {"from", "where", "group", "having", "order", "limit",
 _TABLE_STOP_KWS = _CLAUSE_KWS
 
 
+def _rebuild(e, vals: dict, changed: bool):
+    """dataclasses.replace with a with_children fallback for expression
+    classes whose custom *args __init__ rejects keyword field names
+    (Concat, Coalesce, Least/Greatest)."""
+    import dataclasses as _dcs
+
+    if not changed:
+        return e
+    try:
+        return _dcs.replace(e, **vals)
+    except TypeError:
+        kids = [vals.get(f.name, getattr(e, f.name))
+                for f in _dcs.fields(e)]
+        flat = []
+        for k in kids:
+            if isinstance(k, (tuple, list)):
+                flat.extend(k)
+            else:
+                flat.append(k)
+        return e.with_children(flat)
+
+
 class _QualifiedRef(B.ColumnReference):
     """alias.col — carries the qualifier for alias checking, lowers to
     a bare name reference (engine resolution is by column name)."""
@@ -711,6 +732,7 @@ class SqlSession:
             cols = {f.name.lower() for f in df.schema.fields}
             frames.append((alias.lower(), df, cols))
         self._check_qualifiers(q, frames)
+        self._strip_qualifiers(q)
 
         where_conjs = _conjuncts(q["where"]) if q["where"] is not None \
             else []
@@ -799,6 +821,56 @@ class SqlSession:
             return (B.ColumnReference(bn), B.ColumnReference(an))
         return None
 
+    def _strip_qualifiers(self, q: dict) -> None:
+        """Lower every alias.col reference to a plain ColumnReference
+        AFTER alias validation: qualified and bare references to the
+        same column must compare equal (expr_key embeds the class name,
+        so leaving _QualifiedRef in the tree would falsely reject
+        `select t.a ... group by a`)."""
+        import dataclasses as _dcs
+
+        def rw(e):
+            if isinstance(e, _QualifiedRef):
+                return B.ColumnReference(e.col_name)
+            if not _dcs.is_dataclass(e):
+                return e
+            changed = False
+            vals = {}
+            for f in _dcs.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (B.Expression, AG.AggregateFunction)):
+                    nv = rw(v)
+                elif isinstance(v, (tuple, list)):
+                    nv = type(v)(
+                        rw(x) if isinstance(
+                            x, (B.Expression, AG.AggregateFunction))
+                        else x for x in v)
+                else:
+                    nv = v
+                vals[f.name] = nv
+                changed = changed or nv is not v
+            return _rebuild(e, vals, changed)
+
+        def rwa(a):
+            if a is None:
+                return None
+            if isinstance(a, AG.AggregateFunction):
+                if a.child is not None:
+                    return _dcs.replace(a, child=rw(a.child)) \
+                        if _dcs.is_dataclass(a) else a
+                return a
+            return rw(a)
+
+        q["items"] = [(it if it == "*" else rwa(it), al)
+                      for it, al in q["items"]]
+        for part in ("where", "having"):
+            if q[part] is not None:
+                q[part] = rwa(q[part])
+        q["group_by"] = [rwa(e) for e in q["group_by"]]
+        q["order_by"] = [(rwa(e), d, n) for e, d, n in q["order_by"]]
+        q["joins"] = [(how, tr, rwa(on) if on is not None else None)
+                      for how, tr, on in q["joins"]]
+
     def _check_qualifiers(self, q: dict, frames) -> None:
         alias_cols = {a: cols for a, _df, cols in frames}
 
@@ -826,22 +898,71 @@ class SqlSession:
             check(e)
 
     def _project(self, q: dict, df):
-        from spark_rapids_tpu.execs.jit_cache import expr_key
-
         items = q["items"]
         group_by = q["group_by"]
         has_aggs = any(item != "*" and _has_agg(item)
                        for item, _ in items) or q["having"] is not None
 
-        if not group_by and not has_aggs:
+        plain = not group_by and not has_aggs
+        pre_sorted = False
+        if plain and q["order_by"]:
+            # Spark resolves ORDER BY against the CHILD when a key is
+            # not in the SELECT output (order by a dropped column):
+            # sort BEFORE projecting in that case (a projection is
+            # order-preserving), resolving select aliases to their
+            # expressions
+            out_names = {a.lower() for _it, a in items if a}
+            in_names = {f.name.lower() for f in df.schema.fields}
+
+            def post_resolvable(e) -> bool:
+                if isinstance(e, B.Literal) and isinstance(e.value, int):
+                    return True
+                if isinstance(e, B.ColumnReference):
+                    n = e.col_name.lower()
+                    if n in out_names:
+                        return True
+                    return n in in_names and any(
+                        it != "*" and (
+                            (a is None and isinstance(
+                                it, B.ColumnReference)
+                             and it.col_name.lower() == n)
+                            or a == e.col_name)
+                        for it, a in items) or any(
+                        it == "*" for it, _a in items)
+                return False
+
+            if not all(post_resolvable(e)
+                       for e, _d, _n in q["order_by"]):
+                if q["distinct"]:
+                    raise SqlError("ORDER BY column must appear in "
+                                   "SELECT DISTINCT output")
+                aliases = {a.lower(): it for it, a in items
+                           if a and it != "*"}
+                keys = []
+                for e, desc, nulls_last in q["order_by"]:
+                    if isinstance(e, B.ColumnReference) \
+                            and e.col_name.lower() in aliases \
+                            and e.col_name.lower() not in in_names:
+                        e = aliases[e.col_name.lower()]
+                    keys.append(SortKey(e, descending=desc,
+                                        nulls_last=nulls_last))
+                df = df.order_by(*keys)
+                pre_sorted = True
+
+        if plain:
             out = self._plain_select(items, df, q["distinct"])
         else:
             out = self._grouped_select(items, group_by, df, q)
+            if q["distinct"]:
+                # SELECT DISTINCT over an aggregate: dedup the result
+                out = out.group_by(
+                    *[B.ColumnReference(f.name)
+                      for f in out.schema.fields]).agg()
 
         # ORDER BY: output names, aliases, 1-based ordinals, or (for
         # non-aggregate queries) arbitrary expressions over the input
         out_names = [f.name for f in out.schema.fields]
-        if q["order_by"]:
+        if q["order_by"] and not pre_sorted:
             keys = []
             for e, desc, nulls_last in q["order_by"]:
                 if isinstance(e, B.Literal) and isinstance(e.value, int) \
@@ -899,7 +1020,7 @@ class SqlSession:
                     nv = v
                 vals[f.name] = nv
                 changed = changed or nv is not v
-            return _dcs.replace(e, **vals) if changed else e
+            return _rebuild(e, vals, changed)
 
         return rw(hv)
 
@@ -974,8 +1095,6 @@ class SqlSession:
                 name = alias or item.name
                 sel.append(B.ColumnReference(name))
             else:
-                from spark_rapids_tpu.execs.jit_cache import expr_key
-
                 idx = [i for i, g in enumerate(group_exprs)
                        if expr_key(g) == expr_key(item)][0]
                 ref = B.ColumnReference(out_fields[idx])

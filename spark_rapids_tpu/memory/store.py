@@ -57,6 +57,10 @@ class SpillPriorities:
 
     OUTPUT_FOR_SHUFFLE = -100
     COALESCE_PENDING = 0
+    #: cached (df.cache) batches are re-served across queries but are
+    #: rebuildable by re-running the subtree: spill them before the
+    #: working set of the running query
+    CACHED = 20
     AGGREGATE_PARTIAL = 50
     JOIN_BUILD = 80
     #: broadcast builds are shared across every stream partition, so

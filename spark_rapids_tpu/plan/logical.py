@@ -317,6 +317,65 @@ class RangeRel(LogicalPlan):
         return f"Range ({self.start}, {self.end}, step={self.step})"
 
 
+class CacheSlot:
+    """Shared materialization slot behind `df.cache()`: filled once by
+    the first TPU collect that drains the cached subtree, then every
+    plan referencing the slot re-serves the stored batches instead of
+    re-running the subtree (the InMemoryTableScanExec replacement the
+    reference installs per shim, Spark311Shims.scala + the cache
+    serializer doc).  Device batches live in the BufferStore — spillable
+    and pin-counted like every other long-lived buffer."""
+
+    def __init__(self):
+        import threading
+
+        self.lock = threading.Lock()
+        #: list per partition of SpillableBatch handles (None = empty)
+        self.parts = None
+        self.cpu_table = None  # CPU-engine materialization
+
+    @property
+    def filled(self) -> bool:
+        return self.parts is not None
+
+    def publish(self, parts) -> None:
+        with self.lock:
+            if self.parts is None:
+                self.parts = parts
+            else:  # lost the race: keep first, drop ours
+                for handles in parts:
+                    for h in handles:
+                        h.close()
+
+    def clear(self) -> None:
+        with self.lock:
+            parts, self.parts = self.parts, None
+            self.cpu_table = None
+        if parts:
+            for handles in parts:
+                for h in handles:
+                    h.close()
+
+
+class Cached(LogicalPlan):
+    """df.cache()/persist() marker (ref: SURVEY Appendix A
+    InMemoryTableScanExec + docs/additional-functionality/
+    cache-serializer.md)."""
+
+    def __init__(self, child: LogicalPlan, slot: Optional[CacheSlot]
+                 = None):
+        self.children = [child]
+        self.slot = slot or CacheSlot()
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def node_desc(self) -> str:
+        state = "materialized" if self.slot.filled else "pending"
+        return f"Cached [{state}]"
+
+
 class Project(LogicalPlan):
     def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
         self.children = [child]

@@ -225,7 +225,7 @@ _EXEC_CONFS = {
                 L.OrcRelation, L.RangeRel, L.Project, L.Filter,
                 L.Aggregate, L.Sort, L.Limit, L.Join, L.Union, L.Window,
                 L.Expand, L.Generate, L.MapInArrow, L.GroupedPandas,
-                L.CoGroupedPandas)
+                L.CoGroupedPandas, L.Cached)
 }
 
 
@@ -510,6 +510,10 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
                            partition_fields=p.partition_fields)
     if isinstance(p, L.RangeRel):
         return TpuRangeExec(p.start, p.end, p.step)
+    if isinstance(p, L.Cached):
+        from spark_rapids_tpu.execs.cache import TpuCacheExec
+
+        return TpuCacheExec(p.slot, kids[0])
     if isinstance(p, L.Project):
         return TpuProjectExec(p.exprs, kids[0])
     if isinstance(p, L.Filter):

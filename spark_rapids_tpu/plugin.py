@@ -120,7 +120,13 @@ class TpuPlugin:
             bind = "127.0.0.1" if advertise in ("127.0.0.1",
                                                 "localhost") \
                 else "0.0.0.0"
-            self.block_server = ShuffleBlockServer(host=bind).start()
+            from spark_rapids_tpu.columnar.serde import (
+                SHUFFLE_COMPRESSION,
+            )
+
+            self.block_server = ShuffleBlockServer(
+                host=bind,
+                codec=self.conf.get(SHUFFLE_COMPRESSION)).start()
             bport = self.block_server.address[1]
             self.heartbeat_client = HeartbeatClient(
                 host, int(port), f"executor-{os.getpid()}",

@@ -724,6 +724,25 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self._plan), self._session)
 
+    def cache(self) -> "DataFrame":
+        """Mark this frame for materialize-once re-serving (Spark
+        df.cache; ref: InMemoryTableScanExec, SURVEY Appendix A).  The
+        first TPU collect that fully drains the subtree stores its
+        batches in the spillable BufferStore; later collects (of this
+        frame or frames derived AFTER cache()) skip the subtree."""
+        if not isinstance(self._plan, L.Cached):
+            self._plan = L.Cached(self._plan)
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        """Drop the cached batches (store entries close; accounting
+        returns to zero)."""
+        if isinstance(self._plan, L.Cached):
+            self._plan.slot.clear()
+        return self
+
     def map_in_pandas(self, fn, schema) -> "DataFrame":
         """pyspark mapInPandas (ref: GpuMapInPandasExec): fn over
         pd.DataFrame batches in the isolated python worker pool."""

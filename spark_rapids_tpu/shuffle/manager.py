@@ -67,6 +67,22 @@ class ShuffleManager:
             for h in handles:
                 h.close()
 
+    def read_keep(self, shuffle_id: int, reduce_id: int
+                  ) -> Iterator[ColumnarBatch]:
+        """Reduce side, NON-consuming: iterate this partition's blocks
+        leaving them registered.  Skew-split join tasks read the same
+        reduce partition once per slice (ref: Spark's
+        PartialReducerPartitionSpec re-reads map output ranges); the
+        blocks are freed when the exchange unregisters the shuffle."""
+        with self._lock:
+            handles = list(self._blocks.get((shuffle_id, reduce_id), []))
+        for h in handles:
+            b = h.get()
+            try:
+                yield b
+            finally:
+                h.unpin()  # spillable again between readers
+
     def commit_task(self, shuffle_id: int,
                     outputs: list[tuple[int, object, int, int]]) -> None:
         """Atomically publish one map task's outputs: a list of
@@ -116,6 +132,15 @@ class ShuffleManager:
         ShuffledBatchRDD)."""
         with self._lock:
             return [tuple(self._stats.get((shuffle_id, rid), (0, 0)))
+                    for rid in range(n_partitions)]
+
+    def block_counts(self, shuffle_id: int,
+                     n_partitions: int) -> list[int]:
+        """Committed blocks per reduce partition — the upper bound on
+        how many skew slices of a partition can carry any data (slices
+        deal blocks round-robin)."""
+        with self._lock:
+            return [len(self._blocks.get((shuffle_id, rid), []))
                     for rid in range(n_partitions)]
 
     def unregister(self, shuffle_id: int) -> None:

@@ -113,8 +113,10 @@ class _BlockHandler(socketserver.BaseRequestHandler):
         # pinned only while its bytes stream out); an EMPTY frame
         # terminates the stream (frames always start with the magic)
         for arrays in manager.serve_host(sid, rid):
-            _send_msg(self.request,
-                      serialize_arrays(arrays, self.server.codec))  # type: ignore
+            frame = serialize_arrays(arrays, self.server.codec)  # type: ignore
+            raw = sum(int(a.nbytes) for a in arrays.values())
+            self.server.count_bytes(raw, len(frame))  # type: ignore
+            _send_msg(self.request, frame)
         _send_msg(self.request, b"")
 
 
@@ -133,9 +135,28 @@ class ShuffleBlockServer:
         self._srv.daemon_threads = True
         self._srv.shuffle_manager = manager or get_shuffle_manager()
         self._srv.codec = codec
+        # bytes accounting (the shuffleWriteBytes/compression-ratio
+        # observability the reference surfaces per-codec)
+        self._bytes_lock = threading.Lock()
+        self._raw_bytes = 0
+        self._wire_bytes = 0
+
+        srv_self = self
+
+        def count_bytes(raw: int, wire: int) -> None:
+            with srv_self._bytes_lock:
+                srv_self._raw_bytes += raw
+                srv_self._wire_bytes += wire
+
+        self._srv.count_bytes = count_bytes
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True,
             name="tpu-shuffle-server")
+
+    def bytes_stats(self) -> dict:
+        """{'raw': bytes before codec, 'wire': framed bytes sent}."""
+        with self._bytes_lock:
+            return {"raw": self._raw_bytes, "wire": self._wire_bytes}
 
     @property
     def address(self) -> tuple[str, int]:

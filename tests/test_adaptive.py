@@ -20,7 +20,7 @@ from spark_rapids_tpu.execs.adaptive import (
     plan_coalesced_groups,
 )
 from spark_rapids_tpu.plan.planner import BROADCAST_THRESHOLD
-from spark_rapids_tpu.session import TpuSession, col
+from spark_rapids_tpu.session import TpuSession, col, count_star
 from tests.differential import assert_tables_equal
 
 
@@ -228,3 +228,116 @@ def test_adaptive_left_outer_differential(joined_tables):
                                 df.collect(engine="cpu"))
     finally:
         conf.set(BROADCAST_THRESHOLD.key, old_thr)
+
+
+def test_plan_skew_groups_unit():
+    from spark_rapids_tpu.execs.adaptive import plan_skew_groups
+
+    # partition 1 is 100x the median and above threshold: split side=left
+    lb = [10, 1000, 10, 10]
+    rb = [10, 10, 10, 10]
+    out = plan_skew_groups(lb, rb, target=300, factor=5.0, threshold=100,
+                           join_type="inner")
+    assert out is not None
+    lg, rg, n = out
+    assert n >= 2 and len(lg) == len(rg)
+    # skewed partition appears as k slices on the left, full reads right
+    slices = [g for g in lg if any(k > 1 for (_r, _i, k) in g)]
+    assert slices and all(r == 1 for g in slices for (r, _i, _k) in g)
+    for li, ri in zip(lg, rg):
+        if any(k > 1 for (_r, _i, k) in li):
+            assert ri == [(1, 0, 1)]
+    # full_outer: no sound split
+    assert plan_skew_groups(lb, rb, 300, 5.0, 100, "full_outer") is None
+    # left_outer: only the left side may split
+    assert plan_skew_groups(rb, lb, 300, 5.0, 100,
+                            "left_outer") is None
+
+
+@pytest.mark.slow
+def test_adaptive_skew_split_differential(joined_tables):
+    """A heavily skewed join key: the adaptive reader slices the skewed
+    reduce partition (plan shows split groups) and results still match
+    the oracle (ref: GpuCustomShuffleReaderExec's
+    PartialReducerPartitionSpec / Spark's OptimizeSkewedJoin)."""
+    from spark_rapids_tpu.execs.adaptive import (
+        SKEW_FACTOR,
+        SKEW_THRESHOLD_BYTES,
+        ADVISORY_PARTITION_BYTES,
+    )
+
+    rng = np.random.default_rng(99)
+    n = 20_000
+    # 85% of fact rows share ONE key -> one giant reduce partition
+    keys = np.where(rng.random(n) < 0.85, 7,
+                    rng.integers(0, 200, n)).astype(np.int64)
+    fact = pa.table({"k": keys, "v": rng.random(n)})
+    dim = pa.table({"k": np.arange(200, dtype=np.int64),
+                    "name": pa.array([f"n{i}" for i in range(200)])})
+    conf = get_conf()
+    old = {k.key: conf.get(k) for k in
+           (BROADCAST_THRESHOLD, SKEW_FACTOR, SKEW_THRESHOLD_BYTES,
+            ADVISORY_PARTITION_BYTES)}
+    try:
+        conf.set(BROADCAST_THRESHOLD.key, 1)       # no broadcast escape
+        conf.set(SKEW_THRESHOLD_BYTES.key, 8 << 10)
+        conf.set(SKEW_FACTOR.key, 3.0)
+        conf.set(ADVISORY_PARTITION_BYTES.key, 32 << 10)
+        session = TpuSession()
+        f = session.create_dataframe(fact)
+        d = session.create_dataframe(dim)
+        df = f.join(d, on="k")
+        from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+        exec_, _ = plan_query(df._plan)
+        nodes = _adaptive_nodes(exec_)
+        assert nodes
+        tpu = collect_exec(exec_)
+        assert "skew" in nodes[0]._decision, nodes[0]._decision
+        cpu = df.collect(engine="cpu")
+        assert_tables_equal(tpu, cpu)
+    finally:
+        for k, v in old.items():
+            conf.set(k, v)
+
+
+@pytest.mark.slow
+def test_skew_split_wider_than_static_width(joined_tables):
+    """Skew splitting may produce MORE join tasks than the static
+    partition width the parent iterates; the overflow must drain (rows
+    were silently dropped before the last-partition overflow drain)."""
+    from spark_rapids_tpu.config import SHUFFLE_PARTITIONS
+    from spark_rapids_tpu.execs.adaptive import (
+        ADVISORY_PARTITION_BYTES,
+        SKEW_FACTOR,
+        SKEW_THRESHOLD_BYTES,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 12_000
+    keys = np.where(rng.random(n) < 0.9, 1,
+                    rng.integers(0, 40, n)).astype(np.int64)
+    fact = pa.table({"k": keys, "v": rng.random(n)})
+    dim = pa.table({"k": np.arange(40, dtype=np.int64),
+                    "name": pa.array([f"n{i}" for i in range(40)])})
+    conf = get_conf()
+    old = {k.key: conf.get(k) for k in
+           (BROADCAST_THRESHOLD, SKEW_FACTOR, SKEW_THRESHOLD_BYTES,
+            ADVISORY_PARTITION_BYTES, SHUFFLE_PARTITIONS)}
+    try:
+        conf.set(SHUFFLE_PARTITIONS.key, 2)  # narrow static width
+        conf.set(BROADCAST_THRESHOLD.key, 1)
+        conf.set(SKEW_THRESHOLD_BYTES.key, 4 << 10)
+        conf.set(SKEW_FACTOR.key, 2.0)
+        conf.set(ADVISORY_PARTITION_BYTES.key, 16 << 10)
+        session = TpuSession()
+        df = (session.create_dataframe(fact)
+              .join(session.create_dataframe(dim), on="k"))
+        # drive through a PARENT that iterates child.num_partitions
+        total = df.agg((count_star(), "n"))
+        got = total.collect(engine="tpu").to_pydict()["n"][0]
+        want = total.collect(engine="cpu").to_pydict()["n"][0]
+        assert got == want == n, (got, want)
+    finally:
+        for k, v in old.items():
+            conf.set(k, v)

@@ -1,0 +1,114 @@
+"""df.cache()/persist(): materialize-once through the spillable
+BufferStore, re-serve without re-scanning (ref: SURVEY Appendix A
+InMemoryTableScanExec + docs/additional-functionality/
+cache-serializer.md)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.memory import get_store
+from spark_rapids_tpu.session import TpuSession, col, count_star, sum_
+
+
+@pytest.fixture
+def lineitem(tmp_path):
+    rng = np.random.default_rng(5)
+    n = 5000
+    t = pa.table({
+        "k": pa.array(np.array(["a", "b", "c"])[rng.integers(0, 3, n)]),
+        "v": rng.normal(size=n),
+        "i": rng.integers(0, 100, n),
+    })
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def _scan_counter(monkeypatch):
+    """Count fastpar + pyarrow scan reads (both host decode paths)."""
+    import pyarrow.parquet as _pq
+
+    from spark_rapids_tpu.io import fastpar
+
+    calls = {"n": 0}
+    orig_fp = fastpar.read_file
+
+    def spy_fp(*a, **k):
+        calls["n"] += 1
+        return orig_fp(*a, **k)
+
+    monkeypatch.setattr(fastpar, "read_file", spy_fp)
+    return calls
+
+
+def test_second_collect_skips_scan(lineitem, monkeypatch):
+    calls = _scan_counter(monkeypatch)
+    s = TpuSession()
+    df = s.read_parquet(lineitem).where(col("i") < 50).cache()
+    agg = df.group_by(col("k")).agg((sum_(col("v")), "sv"),
+                                    (count_star(), "n"))
+
+    store = get_store()
+    r1 = agg.collect(engine="tpu")
+    scans_after_first = calls["n"]
+    assert scans_after_first > 0
+    r2 = agg.collect(engine="tpu")
+    assert calls["n"] == scans_after_first, "second collect re-scanned"
+
+    a = sorted(zip(*r1.to_pydict().values()))
+    b = sorted(zip(*r2.to_pydict().values()))
+    assert [x[0] for x in a] == [x[0] for x in b]
+    for x, y in zip(a, b):
+        assert abs(x[1] - y[1]) < 1e-9 and x[2] == y[2]
+
+    # differential vs CPU through the cached plan
+    c = sorted(zip(*agg.collect(engine="cpu").to_pydict().values()))
+    for x, y in zip(a, c):
+        assert x[0] == y[0] and x[2] == y[2]
+        assert abs(x[1] - y[1]) <= 1e-9 * max(1, abs(y[1]))
+
+    # derived frames AFTER cache() reuse the slot too
+    cnt = df.agg((count_star(), "n")).collect(engine="tpu")
+    assert calls["n"] == scans_after_first
+    assert cnt.to_pydict()["n"][0] == sum(x[2] for x in a)
+
+    # unpersist: store accounting returns to baseline, next collect
+    # re-scans
+    df.unpersist()
+    r3 = agg.collect(engine="tpu")
+    assert calls["n"] > scans_after_first
+    assert sorted(zip(*r3.to_pydict().values())) is not None
+
+
+def test_partial_drain_does_not_publish(lineitem, monkeypatch):
+    """A LIMIT that stops early must not publish a truncated cache."""
+    calls = _scan_counter(monkeypatch)
+    s = TpuSession()
+    df = s.read_parquet(lineitem).cache()
+    few = df.limit(3).collect(engine="tpu")
+    assert few.num_rows == 3
+    first = calls["n"]
+    total = df.agg((count_star(), "n")).collect(engine="tpu")
+    assert total.to_pydict()["n"][0] == 5000
+    assert calls["n"] >= first  # had to scan again (cache not published)
+
+
+def test_store_accounting_clean_after_unpersist(lineitem):
+    s = TpuSession()
+    store = get_store()
+    df = s.read_parquet(lineitem).cache()
+    from spark_rapids_tpu.plan import logical as L
+
+    baseline = len(store._entries)
+    df.agg((count_star(), "n")).collect(engine="tpu")
+    assert isinstance(df._plan, L.Cached)
+    slot = df._plan.slot
+    assert slot.filled
+    n_entries = len(store._entries)
+    assert n_entries > baseline, "cache registered no store entries"
+    df.unpersist()
+    assert not slot.filled
+    # every cached entry released; accounting back at the pre-cache mark
+    assert len(store._entries) == baseline, (baseline, store._entries)

@@ -296,3 +296,23 @@ def test_string_concat_operator():
     rows = _diff(fe.sql("select a || '-' || b as c from t order by c"),
                  ordered=True)
     assert rows == [("x-1",), ("y-2",)]
+
+
+def test_mixed_qualified_and_bare_refs():
+    """Qualified and bare references to the same column must unify
+    (TPC-DS queries mix them freely)."""
+    fe = SqlSession()
+    fe.register_table("t", pa.table({"a": [1, 1, 2], "v": [1.0, 2.0, 3.0]}))
+    rows = _diff(fe.sql(
+        "select t.a, sum(v) as s from t group by a order by t.a"),
+        ordered=True)
+    assert rows == [(1, 3.0), (2, 3.0)]
+
+
+def test_distinct_over_aggregate():
+    fe = SqlSession()
+    fe.register_table("t", pa.table(
+        {"g": [1, 1, 2, 2, 3], "v": [1, 1, 1, 1, 5]}))
+    rows = _diff(fe.sql(
+        "select distinct sum(v) as s from t group by g order by 1"))
+    assert rows == [(2,), (5,)]
