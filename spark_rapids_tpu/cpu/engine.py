@@ -64,6 +64,16 @@ def _widen_type(e: B.Expression) -> pa.DataType:
     return T.to_arrow_type(e.dtype)
 
 
+def _plain(arr):
+    """Decode dictionary encodings at the engine boundary: the CPU
+    oracle computes over plain arrays (fastpar ships scan columns as
+    pa.DictionaryArray to keep the wire cheap)."""
+    t = arr.type
+    if pa.types.is_dictionary(t):
+        return arr.cast(t.value_type)
+    return arr
+
+
 def _binary_operands(e, table, n):
     l = cpu_eval(e.left, table)
     r = cpu_eval(e.right, table)
@@ -276,9 +286,9 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
         # no file context on this path: Spark's documented defaults
         return pa.array([e.DEFAULT] * n, T.to_arrow_type(e.dtype))
     if isinstance(e, B.BoundReference):
-        return table.column(e.ordinal).combine_chunks()
+        return _plain(table.column(e.ordinal).combine_chunks())
     if isinstance(e, B.ColumnReference):
-        return table.column(e.col_name).combine_chunks()
+        return _plain(table.column(e.col_name).combine_chunks())
     if isinstance(e, B.Literal):
         if e.value is None:
             return pa.nulls(n, type=T.to_arrow_type(e.dtype)
@@ -393,6 +403,14 @@ def _dispatch(e, table, n):  # noqa: C901 - a dispatcher is a big switch
     # predicates --------------------------------------------------------- #
     if isinstance(e, P.BinaryComparison):
         l, r = _binary_operands(e, table, n)
+        # the engine's physical view lets dates compare against their
+        # day counts (int literals); pyarrow has no date-vs-int kernel
+        for a, b in ((l, r), (r, l)):
+            if pa.types.is_date32(a.type) and pa.types.is_integer(b.type):
+                if a is l:
+                    l = a.cast(pa.int32()).cast(b.type)
+                else:
+                    r = a.cast(pa.int32()).cast(b.type)
         if isinstance(e, P.EqualNullSafe):
             ln, rn = pc.is_null(l), pc.is_null(r)
             eq = pc.fill_null(pc.equal(l, r), False)

@@ -29,10 +29,17 @@ from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
 
 class TpuCacheExec(TpuExec):
     def __init__(self, slot, child: TpuExec):
+        import threading
+
         super().__init__(child)
         self.slot = slot
         self._staged: dict[int, list] = {}
         self._complete: set[int] = set()
+        # partitions may drain concurrently (exchange task threads);
+        # the completion check + publish must be one atomic step or two
+        # finishers can double-publish (the loser's cleanup would close
+        # the winner's handles)
+        self._stage_lock = threading.Lock()
 
     @property
     def schema(self) -> T.Schema:
@@ -71,27 +78,25 @@ class TpuCacheExec(TpuExec):
 
         store = get_store()
         staged: list = []
-        self._staged[p] = staged
-        try:
-            for batch in self.children[0].execute_partition(p):
-                n = batch.concrete_num_rows()
-                pinned = dataclasses.replace(batch, num_rows=n)
-                h = store.register(pinned, SpillPriorities.CACHED)
-                h.unpin()
-                staged.append(h)
-                self.metrics["cacheWrites"].add(1)
-                yield self._count_output(batch)
+        with self._stage_lock:
+            self._staged[p] = staged
+        for batch in self.children[0].execute_partition(p):
+            n = batch.concrete_num_rows()
+            pinned = dataclasses.replace(batch, num_rows=n)
+            h = store.register(pinned, SpillPriorities.CACHED)
+            h.unpin()
+            staged.append(h)
+            self.metrics["cacheWrites"].add(1)
+            yield self._count_output(batch)
+        n_parts = self.children[0].num_partitions
+        with self._stage_lock:
             self._complete.add(p)
-            if len(self._complete) == self.children[0].num_partitions:
-                parts = [self._staged.get(i, [])
-                         for i in range(self.children[0].num_partitions)]
-                self._staged = {}
-                self._complete = set()
-                self.slot.publish(parts)
-        finally:
-            # anything still staged when the exec closes without a full
-            # drain is discarded by close()
-            pass
+            if len(self._complete) < n_parts:
+                return
+            parts = [self._staged.get(i, []) for i in range(n_parts)]
+            self._staged = {}
+            self._complete = set()
+        self.slot.publish(parts)
 
     def execute(self) -> Iterator[ColumnarBatch]:
         for p in range(self.num_partitions):
@@ -99,9 +104,10 @@ class TpuCacheExec(TpuExec):
 
     def close(self) -> None:
         # a partial drain (LIMIT, error) must not leak store entries
-        for handles in self._staged.values():
+        with self._stage_lock:
+            staged, self._staged = self._staged, {}
+            self._complete = set()
+        for handles in staged.values():
             for h in handles:
                 h.close()
-        self._staged = {}
-        self._complete = set()
         super().close()

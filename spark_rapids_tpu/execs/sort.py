@@ -556,3 +556,140 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
                 ("topn_proj", exprs_key(self.project), repr(self._schema)),
                 lambda: proj)(out)
         yield self._count_output(out)
+
+
+class TpuTopNExec(_SortMixin):
+    """ORDER BY + LIMIT n as a streaming top-n (ref: GpuTopN /
+    Spark's TakeOrderedAndProject) — the full global sort a LIMIT
+    would otherwise pay is replaced by a per-batch candidate filter
+    plus one tiny final sort.
+
+    Exactness argument: per batch, rows are pruned against the batch's
+    n-th best PRIMARY key value under a monotone scalar image of the
+    primary order (floats canonicalize NaN to +inf and collapse ±0 —
+    order-preserving, possibly tie-collapsing).  Any row strictly worse
+    than n rows on the primary alone cannot be in the global top n
+    regardless of tiebreak keys, so keeping every row at-or-beyond the
+    threshold (ties included, NULLs per null-placement) is a provable
+    superset of the answer.  The final multi-key lexsort then runs over
+    only the accumulated candidates (typically O(n) per batch)."""
+
+    def __init__(self, n: int, keys: Sequence[SortKey], child: TpuExec):
+        super().__init__(child)
+        self.n = n
+        self._bind(keys, child)
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+        self._jit_cand = cached_jit(
+            ("topn_cand", self.n, self._keys_cache_key()),
+            lambda: self._candidates)
+        self._jit_final = cached_jit(
+            ("topnfinal", self.n, self._keys_cache_key()),
+            lambda: self._final)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        ks = ", ".join(
+            f"{k.expr.name}{' DESC' if k.descending else ''}"
+            for k in self.keys)
+        return f"TpuTopNExec n={self.n} [{ks}]"
+
+    def additional_metrics(self):
+        return [("candidateRows", "MODERATE")]
+
+    # -- traceable ------------------------------------------------------- #
+
+    def _primary_scalar(self, kc):
+        """Monotone 'larger = selected by top_k' image of the primary
+        sort order (descending keeps the value sense; ascending flips
+        with overflow-safe bitwise NOT for ints)."""
+        k0 = self.keys[0]
+        d = kc.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            v = jnp.where(jnp.isnan(d), jnp.inf, d).astype(jnp.float64)
+            return v if k0.descending else -v
+        v = d.astype(jnp.int64)
+        return v if k0.descending else ~v
+
+    def _candidates(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext.for_batch(batch)
+        kc = self.keys[0].expr.eval(ctx)
+        live = batch.row_mask()
+        valid = kc.validity & live
+        s = self._primary_scalar(kc)
+        if jnp.issubdtype(s.dtype, jnp.floating):
+            lo = jnp.asarray(-jnp.inf, s.dtype)
+        else:
+            lo = jnp.asarray(jnp.iinfo(jnp.int64).min, s.dtype)
+        sm = jnp.where(valid, s, lo)
+        k = min(self.n, batch.capacity)
+        thr = jax.lax.top_k(sm, k)[0][k - 1]
+        mask = valid & (sm >= thr)
+        nulls = live & ~kc.validity
+        if self.keys[0].nulls_last:
+            # NULLs only matter when non-null rows cannot fill the top n
+            short = jnp.sum(valid.astype(jnp.int32)) < self.n
+            mask = mask | (nulls & short)
+        else:
+            # NULLs sort first: every one is a candidate (their mutual
+            # order is decided by the tiebreak keys)
+            mask = mask | nulls
+        return batch.compact(mask)
+
+    def _final(self, batch: ColumnarBatch) -> ColumnarBatch:
+        return self._sorted(batch).slice_prefix(self.n)
+
+    # -- driver ---------------------------------------------------------- #
+
+    def execute_partition(self, p: int):
+        if p == 0:
+            yield from self.execute()
+
+    def execute(self):
+        import dataclasses
+
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        from spark_rapids_tpu.columnar.column import pad_capacity
+        from spark_rapids_tpu.memory import SpillPriorities, get_store
+
+        store = get_store()
+        pending: list = []
+        try:
+            for batch in self.children[0].execute():
+                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    cand = t.observe(self._jit_cand(
+                        batch.with_device_num_rows()))
+                pending.append(store.register(
+                    cand, SpillPriorities.COALESCE_PENDING))
+            if not pending:
+                return
+            batches = [h.get() for h in pending]
+            # ONE batched sizing fetch, then shrink candidates to their
+            # (typically O(n)) real size before the final sort
+            ns = [int(v) for v in jax.device_get(
+                [b.num_rows for b in batches])]
+            self.metrics["candidateRows"].add(sum(ns))
+            shrunk = []
+            for b, nn in zip(batches, ns):
+                if nn == 0:
+                    continue
+                b = dataclasses.replace(b, num_rows=nn)
+                shrunk.append(b.shrink_to_capacity(pad_capacity(nn)))
+            if not shrunk:
+                return
+            big = shrunk[0] if len(shrunk) == 1 else \
+                concat_batches(shrunk)
+            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                out = t.observe(self._jit_final(
+                    big.with_device_num_rows()))
+            yield self._count_output(out)
+        finally:
+            for h in pending:
+                h.close()

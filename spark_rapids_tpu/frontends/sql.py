@@ -940,7 +940,14 @@ class SqlSession:
                            if a and it != "*"}
                 keys = []
                 for e, desc, nulls_last in q["order_by"]:
-                    if isinstance(e, B.ColumnReference) \
+                    if isinstance(e, B.Literal) \
+                            and isinstance(e.value, int) \
+                            and 1 <= e.value <= len(items) \
+                            and items[e.value - 1][0] != "*":
+                        # ordinal keys resolve to the select-list
+                        # EXPRESSION when sorting pre-projection
+                        e = items[e.value - 1][0]
+                    elif isinstance(e, B.ColumnReference) \
                             and e.col_name.lower() in aliases \
                             and e.col_name.lower() not in in_names:
                         e = aliases[e.col_name.lower()]

@@ -518,14 +518,26 @@ class ParquetScanExec(TpuExec):
         try:
             import pyarrow.compute as pc
 
+            mask = None
             if self._pa_filter is not None:
-                mask = self._pa_filter(tbl)
-            else:
+                try:
+                    mask = self._pa_filter(tbl)
+                except Exception:
+                    # compiled form hit a kernel gap (e.g. date32 vs
+                    # int literal): the CPU engine's interpreter below
+                    # is the complete fallback
+                    self._pa_filter = None
+            if mask is None:
                 from spark_rapids_tpu.cpu.engine import cpu_eval
 
                 mask = cpu_eval(self.pushed_filter, tbl)
             kept = tbl.filter(pc.fill_null(mask, False))
         except Exception:
+            if getattr(self, "exact_prefilter", False):
+                # the planner ELIDED the device Filter on the promise
+                # that this prefilter is exact — failing silently here
+                # would return unfiltered rows as final results
+                raise
             self._prefilter_on = False  # unsupported expr: stop trying
             return tbl
         self.metrics["hostFilteredRows"].add(tbl.num_rows - kept.num_rows)
@@ -537,7 +549,8 @@ class ParquetScanExec(TpuExec):
         transfer round: few big batches, not many small ones — on TPU
         the per-dispatch/per-transfer latency dominates small batches."""
         conjuncts = self._conjuncts()
-        self._prefilter_on = self._prefilter_active()
+        self._prefilter_on = self._prefilter_active() \
+            or getattr(self, "exact_prefilter", False)
         self._pa_filter = None
         if self._prefilter_on:
             from spark_rapids_tpu.io.pa_filter import compile_filter

@@ -62,12 +62,20 @@ def float64_order_keys(x: jax.Array, descending: bool) -> list:
     isnan = jnp.isnan(x)
     vals = jnp.where(isnan, jnp.inf, x)
     flag = isnan.astype(jnp.int32)
-    zkey = jnp.where(isnan, 1, 1 - jnp.signbit(x).astype(jnp.int32))
+    # sign of zero WITHOUT jnp.signbit (it lowers to a 64-bit bitcast
+    # the TPU X64 rewriter rejects): 1/-0.0 = -inf < 0; the tiebreak
+    # only matters on the ±0.0 value tie, so nonzero rows can take any
+    # constant
+    neg_zero = (x == 0) & (1.0 / x < 0)
+    zkey = jnp.where(isnan | ~neg_zero, 1, 0)
     if descending:
         vals = -vals
         flag = 1 - flag
         zkey = 1 - zkey
-    return [flag, zkey, vals]
+    # one combined tiebreak: among value-ties only ±0 (zkey) and
+    # inf-vs-NaN (flag) need ordering, and zkey outranks flag — every
+    # sort operand is a whole bitonic pass, so fold them
+    return [zkey * 2 + flag, vals]
 
 
 def _string_word_keys(col: StringColumn) -> list[jax.Array]:
@@ -110,6 +118,15 @@ def column_sort_keys(col: AnyColumn, descending: bool,
             k = d
         if descending:
             k = ~k
+        if jnp.dtype(k.dtype).itemsize <= 4:
+            # pack the null flag INTO the key: every lexsort operand is
+            # a whole extra bitonic pass over the batch, and 32-bit
+            # keys have the headroom ((flag << 32) | zero-extended key)
+            null_flag = col.validity.astype(jnp.int64)  # 0 = null
+            if nulls_last:
+                null_flag = 1 - null_flag
+            u = k.astype(jnp.int64) + jnp.int64(2 ** 31)
+            return [(null_flag << 32) | u]
         vals = [k]
     null_flag = col.validity.astype(jnp.int32)  # 0 = null
     if nulls_last:

@@ -518,6 +518,14 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
         return TpuProjectExec(p.exprs, kids[0])
     if isinstance(p, L.Filter):
         _maybe_push_filter(p, kids)
+        if _can_elide_device_filter(p, kids):
+            # the host prefilter applies the FULL condition exactly
+            # (exact mode raises instead of silently disabling), so the
+            # device Filter would re-verify already-filtered rows — on
+            # a per-program-cost link that is a whole program execution
+            # per batch for nothing
+            kids[0].exact_prefilter = True
+            return kids[0]
         return TpuFilterExec(p.condition, kids[0])
     if isinstance(p, L.Expand):
         from spark_rapids_tpu.execs.expand import TpuExpandExec
@@ -625,6 +633,9 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
             return w
         return TpuWindowExec(p.window_exprs, kids[0])
     if isinstance(p, L.Limit):
+        topn = _maybe_topn(p, kids)
+        if topn is not None:
+            return topn
         if kids[0].num_partitions > 1:
             # collect-limit shape: prune each partition locally before
             # the single-partition drain (ref: GpuCollectLimitExec)
@@ -639,6 +650,53 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
     raise AssertionError(f"tagged-replaceable node unconvertible: {p.name}")
 
 
+ELIDE_DEVICE_FILTER = register(
+    "spark.rapids.tpu.sql.scan.elideDeviceFilter", True,
+    "Drop the device Filter above a Parquet scan when the host "
+    "prefilter provably applies the full condition (deterministic, "
+    "non-ANSI, prefilter enabled): the prefilter then runs in EXACT "
+    "mode — any host evaluation failure raises instead of shipping "
+    "unfiltered rows.")
+
+
+def _can_elide_device_filter(p: L.LogicalPlan,
+                             kids: list[TpuExec]) -> bool:
+    from spark_rapids_tpu.exprs.base import ansi_enabled
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        tree_is_partition_aware,
+    )
+    from spark_rapids_tpu.io.scan import HOST_PREFILTER, ParquetScanExec
+
+    conf = get_conf()
+    if not (conf.get(ELIDE_DEVICE_FILTER) and conf.get(HOST_PREFILTER)):
+        return False
+    if not (kids and type(kids[0]) in (ParquetScanExec,)
+            and kids[0].pushed_filter is p.condition):
+        return False
+    if ansi_enabled() or tree_is_partition_aware(p.condition):
+        return False
+    # count-only scans never run the row-wise prefilter: the condition
+    # must read at least one column so rows flow as tables
+    refs = [e for e in _walk_expr(p.condition)
+            if isinstance(e, (B.BoundReference, B.ColumnReference))]
+    if not refs:
+        return False
+    # only elide when the compiled pyarrow prefilter subset covers the
+    # whole condition: a condition only the DEVICE expression engine
+    # supports must keep its device Filter (before elision the host
+    # prefilter would just disable itself; with elision the exact-mode
+    # prefilter would hard-fail the query instead)
+    from spark_rapids_tpu.io.pa_filter import compile_filter
+
+    return compile_filter(p.condition) is not None
+
+
+def _walk_expr(e):
+    yield e
+    for c in getattr(e, "children", ()):
+        yield from _walk_expr(c)
+
+
 def _maybe_push_filter(p: L.LogicalPlan, kids: list[TpuExec]) -> None:
     """Attach a scan-adjacent Filter's condition to the Parquet scan for
     row-group/partition pruning (ref: GpuParquetScan.scala:263-306).
@@ -649,6 +707,33 @@ def _maybe_push_filter(p: L.LogicalPlan, kids: list[TpuExec]) -> None:
     if isinstance(p, L.Filter) and kids \
             and isinstance(kids[0], ParquetScanExec):
         kids[0].pushed_filter = p.condition
+
+
+TOPN_MAX_ROWS = register(
+    "spark.rapids.tpu.sql.topn.maxRows", 1 << 14,
+    "LIMIT values up to this use the streaming top-n rewrite of "
+    "ORDER BY + LIMIT (GpuTopN / TakeOrderedAndProject analog) instead "
+    "of a full global sort.")
+
+
+def _maybe_topn(p: "L.Limit", kids: list[TpuExec]) -> Optional[TpuExec]:
+    """LIMIT over a just-planned global Sort with a fixed-width primary
+    key -> streaming top-n (per-batch candidate pruning; the full
+    multi-key sort runs only over the candidates)."""
+    from spark_rapids_tpu.execs.sort import TpuSortExec, TpuTopNExec
+
+    sort = kids[0]
+    if not (isinstance(sort, TpuSortExec) and sort.scope == "global"
+            and 0 < p.n <= get_conf().get(TOPN_MAX_ROWS)
+            and sort.keys):
+        return None
+    primary = sort.keys[0].expr.dtype
+    if not isinstance(primary, (T.ByteType, T.ShortType, T.IntegerType,
+                                T.LongType, T.FloatType, T.DoubleType,
+                                T.DateType, T.TimestampType,
+                                T.BooleanType)):
+        return None
+    return TpuTopNExec(p.n, sort.keys, sort.children[0])
 
 
 BROADCAST_THRESHOLD = register(

@@ -197,3 +197,81 @@ def test_count_star_only_grand_aggregate():
     src = batches(([1, 2, 3], [0, 0, 0]), ([4, 5], [0, 0]))
     agg = TpuHashAggregateExec([], [NamedAgg(CountStar(), "n")], src)
     assert run(agg) == {"n": [5]}
+
+
+@pytest.fixture
+def session():
+    from spark_rapids_tpu.session import TpuSession
+
+    return TpuSession()
+
+
+def test_topn_ties_nulls_differential(session):
+    """ORDER BY + LIMIT lowers to the streaming top-n; ties on the
+    primary key (secondary decides), NULLS FIRST/LAST, asc/desc, and
+    n larger than the row count must all match the oracle."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.execs.sort import SortKey, TpuTopNExec
+    from spark_rapids_tpu.plan.planner import plan_query
+    from spark_rapids_tpu.session import col
+
+    rng = np.random.default_rng(0)
+    n = 4000
+    t = pa.table({
+        "a": pa.array([None if i % 37 == 0 else float(v % 17)
+                       for i, v in enumerate(rng.integers(0, 100, n))]),
+        "b": rng.integers(0, 1000, n),
+    })
+    df0 = session.create_dataframe(t)
+    for desc in (True, False):
+        df = df0.order_by(SortKey(col("a"), descending=desc,
+                                  nulls_last=desc),
+                          SortKey(col("b"))).limit(25)
+        exec_, _ = plan_query(df._plan)
+        assert any(isinstance(e, TpuTopNExec) for e in exec_._walk()), \
+            "planner did not use top-n"
+        exec_.close()
+        got = list(zip(*df.collect(engine="tpu").to_pydict().values()))
+        want = list(zip(*df.collect(engine="cpu").to_pydict().values()))
+        assert len(got) == len(want) == 25
+        assert [repr(r) for r in got] == [repr(r) for r in want], (
+            desc, got[:5], want[:5])
+    # n beyond the row count: everything, fully ordered
+    df = df0.order_by(col("b")).limit(10_000)
+    got = df.collect(engine="tpu").to_pydict()["b"]
+    want = df.collect(engine="cpu").to_pydict()["b"]
+    assert got == want and len(got) == n
+
+
+def test_elided_device_filter_still_exact(session, tmp_path):
+    """With the device filter elided above a Parquet scan, the host
+    prefilter is the filter — results must match the oracle exactly,
+    and the plan must contain no TpuFilterExec."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.execs.basic import TpuFilterExec
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.plan.planner import plan_query
+    from spark_rapids_tpu.session import col, count_star, sum_
+
+    rng = np.random.default_rng(4)
+    nn = 9000
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "x": rng.integers(0, 100, nn),
+        "v": rng.normal(size=nn)}), p)
+    df = (session.read_parquet(p)
+          .where((col("x") >= lit(10)) & (col("x") < lit(60)))
+          .agg((count_star(), "n"), (sum_(col("v")), "s")))
+    exec_, _ = plan_query(df._plan)
+    assert not any(isinstance(e, TpuFilterExec) for e in exec_._walk()), \
+        "device filter not elided"
+    exec_.close()
+    a = df.collect(engine="tpu").to_pydict()
+    b = df.collect(engine="cpu").to_pydict()
+    assert a["n"] == b["n"]
+    assert abs(a["s"][0] - b["s"][0]) <= 1e-9 * max(1, abs(b["s"][0]))

@@ -316,3 +316,15 @@ def test_distinct_over_aggregate():
     rows = _diff(fe.sql(
         "select distinct sum(v) as s from t group by g order by 1"))
     assert rows == [(2,), (5,)]
+
+
+def test_presort_with_ordinal_key():
+    """Mixed ordinal + dropped-column ORDER BY keys sort BEFORE the
+    projection with the ordinal resolved to the select expression."""
+    fe = SqlSession()
+    fe.register_table("t", pa.table({
+        "v": [3, 1, 2, 2], "w": ["a", "b", "c", "d"],
+        "x": [9, 8, 7, 1]}))
+    rows = _diff(fe.sql("select v, w from t order by 1, x"),
+                 ordered=True)
+    assert rows == [(1, "b"), (2, "d"), (2, "c"), (3, "a")]
