@@ -684,6 +684,41 @@ class TpuTopNExec(_SortMixin):
                 shrunk.append(b.shrink_to_capacity(pad_capacity(nn)))
             if not shrunk:
                 return
+            # candidate volume is unbounded in degenerate shapes (a
+            # mostly-NULL nulls-first key keeps every null row): reduce
+            # HIERARCHICALLY so no single device batch exceeds the cap
+            # — each chunk's top n provably contains every global
+            # top-n row the chunk holds, so chunk winners compose
+            cap_rows = getattr(self, "reduce_cap_rows",
+                               max(4 * self.n, 1 << 16))
+            while True:
+                total = sum(b.concrete_num_rows() for b in shrunk)
+                if len(shrunk) == 1 or total <= cap_rows:
+                    break
+                chunks: list = []
+                cur: list = []
+                cur_rows = 0
+                for b in shrunk:
+                    nb = b.concrete_num_rows()
+                    if cur and cur_rows + nb > cap_rows:
+                        chunks.append(cur)
+                        cur, cur_rows = [], 0
+                    cur.append(b)
+                    cur_rows += nb
+                if cur:
+                    chunks.append(cur)
+                nxt = []
+                for ch in chunks:
+                    big = ch[0] if len(ch) == 1 else concat_batches(ch)
+                    with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                        win = t.observe(self._jit_final(
+                            big.with_device_num_rows()))
+                    wn = win.concrete_num_rows()
+                    win = dataclasses.replace(win, num_rows=wn)
+                    nxt.append(win.shrink_to_capacity(pad_capacity(wn)))
+                if len(nxt) == len(shrunk):
+                    break  # no further reduction possible
+                shrunk = nxt
             big = shrunk[0] if len(shrunk) == 1 else \
                 concat_batches(shrunk)
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:

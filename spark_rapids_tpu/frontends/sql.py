@@ -938,15 +938,25 @@ class SqlSession:
                                    "SELECT DISTINCT output")
                 aliases = {a.lower(): it for it, a in items
                            if a and it != "*"}
+                # ordinals resolve against the STAR-EXPANDED output
+                # layout (a bare `*` occupies one position per input
+                # column)
+                positions: list = []
+                for it, _a in items:
+                    if it == "*":
+                        positions.extend(
+                            B.ColumnReference(f.name)
+                            for f in df.schema.fields)
+                    else:
+                        positions.append(it)
                 keys = []
                 for e, desc, nulls_last in q["order_by"]:
                     if isinstance(e, B.Literal) \
                             and isinstance(e.value, int) \
-                            and 1 <= e.value <= len(items) \
-                            and items[e.value - 1][0] != "*":
+                            and 1 <= e.value <= len(positions):
                         # ordinal keys resolve to the select-list
                         # EXPRESSION when sorting pre-projection
-                        e = items[e.value - 1][0]
+                        e = positions[e.value - 1]
                     elif isinstance(e, B.ColumnReference) \
                             and e.col_name.lower() in aliases \
                             and e.col_name.lower() not in in_names:

@@ -21,8 +21,10 @@ What makes this faster than the general pyarrow read path:
 Scope (anything else returns None and the caller uses pyarrow):
 - physical types INT32/INT64/FLOAT/DOUBLE, plus BYTE_ARRAY when every
   data page is dictionary-encoded;
-- SNAPPY or UNCOMPRESSED codecs; data page v1/v2; no repetition
-  levels; definition levels only when no value is actually null.
+- SNAPPY, GZIP, ZSTD or UNCOMPRESSED codecs; data page v1/v2; no
+  repetition levels; definition levels with real nulls decode into a
+  validity mask (null-aware filter evaluation, dict LUT kept for
+  all-valid chunks).
 
 Everything degrades per FILE: one unsupported chunk sends the whole
 file down the standard path, so results are always exact.
@@ -231,14 +233,18 @@ def _rle_decode(data: np.ndarray, bit_width: int,
 
 
 class FastColumn:
-    """Decoded chunk: either (dict_values, codes) or plain values."""
+    """Decoded chunk: either (dict_values, codes) or plain values.
+    `validity` (None = all valid) marks rows whose definition level was
+    below max_def; their code/value slots hold zeros."""
 
-    __slots__ = ("dict_values", "codes", "values")
+    __slots__ = ("dict_values", "codes", "values", "validity")
 
-    def __init__(self, dict_values=None, codes=None, values=None):
+    def __init__(self, dict_values=None, codes=None, values=None,
+                 validity=None):
         self.dict_values = dict_values
         self.codes = codes
         self.values = values
+        self.validity = validity
 
     @property
     def n(self) -> int:
@@ -288,7 +294,7 @@ def _decode_chunk(fh, col_meta, max_def: int,
     if np_dt is None and not is_ba:
         return None
     codec = col_meta.compression
-    if codec not in ("SNAPPY", "UNCOMPRESSED"):
+    if codec not in ("SNAPPY", "UNCOMPRESSED", "GZIP", "ZSTD"):
         return None
     n_total = col_meta.num_values
 
@@ -307,6 +313,7 @@ def _decode_chunk(fh, col_meta, max_def: int,
     code_parts: list = []
     plain_parts: list = []  # (order, np values)
     order: list = []        # 'dict'/'plain' per data page, in order
+    valid_parts: list = []  # per data page bool[n_vals] or None
     seen = 0
     def_bw = max(1, (max_def).bit_length()) if max_def > 0 else 0
 
@@ -348,6 +355,7 @@ def _decode_chunk(fh, col_meta, max_def: int,
             if buf is None:
                 return None
             off = 0
+            page_valid = None
             if max_def > 0:
                 if len(buf) < 4:
                     return None
@@ -356,14 +364,16 @@ def _decode_chunk(fh, col_meta, max_def: int,
                 off = 4 + dl_len
                 if not _def_levels_all_valid(dl, def_bw, n_vals,
                                              max_def):
-                    return None
+                    page_valid = _decode_validity(dl, def_bw, n_vals,
+                                                  max_def)
+                    if page_valid is None:
+                        return None
         elif ptype == _DATA_PAGE_V2:
             dh = hdr.get(8)
             if dh is None:
                 return None
             n_vals = dh.get(1, 0)
-            if dh.get(2, 0) != 0:   # num_nulls
-                return None
+            n_nulls = dh.get(2, 0)
             enc = dh.get(4, _ENC_PLAIN)
             dl_len = dh.get(5, 0)
             rl_len = dh.get(6, 0)
@@ -373,16 +383,20 @@ def _decode_chunk(fh, col_meta, max_def: int,
             compressed = dh.get(7, True) and codec != "UNCOMPRESSED"
             if compressed:
                 levels = payload[:dl_len]
-                vals_part = _snappy_decompress(
-                    payload[dl_len:].tobytes(), uncomp_sz - dl_len)
+                vals_part = _page_bytes(payload[dl_len:],
+                                        uncomp_sz - dl_len, codec)
                 if vals_part is None:
                     return None
             else:
                 levels = payload[:dl_len]
                 vals_part = payload[dl_len:]
-            if max_def > 0 and dl_len:
-                if not _def_levels_all_valid(levels, def_bw, n_vals,
-                                             max_def):
+            page_valid = None
+            if max_def > 0 and dl_len and (
+                    n_nulls or not _def_levels_all_valid(
+                        levels, def_bw, n_vals, max_def)):
+                page_valid = _decode_validity(levels, def_bw, n_vals,
+                                              max_def)
+                if page_valid is None:
                     return None
             buf = vals_part
             off = 0
@@ -390,29 +404,57 @@ def _decode_chunk(fh, col_meta, max_def: int,
             return None
 
         vals = buf[off:]
+        # with nulls, the value stream holds PRESENT entries only:
+        # decode n_present then scatter into the page's n_vals slots
+        n_present = int(page_valid.sum()) if page_valid is not None \
+            else n_vals
         if enc in (_ENC_RLE_DICT, _ENC_PLAIN_DICT):
             if len(vals) < 1:
                 return None
             bw = int(vals[0])
-            codes = _rle_decode(vals[1:], bw, n_vals)
+            codes = _rle_decode(vals[1:], bw, n_present)
             if codes is None:
                 return None
+            if page_valid is not None:
+                full = np.zeros(n_vals, np.uint32)
+                full[page_valid] = codes
+                codes = full
             code_parts.append(codes)
             order.append("dict")
         elif enc == _ENC_PLAIN and not is_ba:
-            arr = np.frombuffer(vals.tobytes(), np_dt, count=n_vals)
+            arr = np.frombuffer(vals.tobytes(), np_dt, count=n_present)
+            if page_valid is not None:
+                full = np.zeros(n_vals, np_dt)
+                full[page_valid] = arr
+                arr = full
             plain_parts.append(arr)
             order.append("plain")
         else:
             return None
+        valid_parts.append(page_valid)
         seen += n_vals
 
     if seen != n_total:
         return None
+    validity = None
+    if any(v is not None for v in valid_parts):
+        counts = []
+        di = pi = 0
+        for kind in order:
+            if kind == "dict":
+                counts.append(len(code_parts[di]))
+                di += 1
+            else:
+                counts.append(len(plain_parts[pi]))
+                pi += 1
+        validity = np.concatenate(
+            [v if v is not None else np.ones(c, bool)
+             for v, c in zip(valid_parts, counts)])
     if plain_parts and not code_parts:
         return FastColumn(values=np.concatenate(plain_parts)
                           if len(plain_parts) > 1 else
-                          np.asarray(plain_parts[0]))
+                          np.asarray(plain_parts[0]),
+                          validity=validity)
     if code_parts and not plain_parts:
         if dict_values is None:
             return None
@@ -420,7 +462,8 @@ def _decode_chunk(fh, col_meta, max_def: int,
             if len(code_parts) > 1 else code_parts[0]
         if codes.size and int(codes.max()) >= len(dict_values):
             return None
-        return FastColumn(dict_values=dict_values, codes=codes)
+        return FastColumn(dict_values=dict_values, codes=codes,
+                          validity=validity)
     if not code_parts and not plain_parts:
         return None
     # mixed dict->plain fallback within one chunk: materialize
@@ -438,14 +481,32 @@ def _decode_chunk(fh, col_meta, max_def: int,
         else:
             parts.append(plain_parts[pi])
             pi += 1
-    return FastColumn(values=np.concatenate(parts))
+    return FastColumn(values=np.concatenate(parts), validity=validity)
 
 
 def _page_bytes(payload: np.ndarray, uncomp_sz: int,
                 codec: str) -> Optional[np.ndarray]:
     if codec == "UNCOMPRESSED":
         return payload
-    return _snappy_decompress(payload.tobytes(), uncomp_sz)
+    if codec == "SNAPPY":
+        return _snappy_decompress(payload.tobytes(), uncomp_sz)
+    if codec in ("GZIP", "ZSTD"):
+        try:
+            dec = pa.Codec(codec.lower()).decompress(
+                payload.tobytes(), decompressed_size=uncomp_sz)
+        except Exception:
+            return None
+        return np.frombuffer(dec, np.uint8)
+    return None
+
+
+def _decode_validity(levels: np.ndarray, bw: int, n: int,
+                     max_def: int) -> Optional[np.ndarray]:
+    """Definition levels -> bool[n] validity (True = value present)."""
+    dl = _rle_decode(levels, bw, n)
+    if dl is None:
+        return None
+    return dl == max_def
 
 
 def _def_levels_all_valid(dl: np.ndarray, bw: int, n: int,
@@ -564,6 +625,9 @@ def _filter_project(cols, filter_cols, n_rows, engine_schema, columns,
     arrays = []
     for name in columns:
         fc = cols[name]
+        validity = fc.validity
+        if idx is not None and validity is not None:
+            validity = validity[idx]
         if fc.codes is not None and len(fc.dict_values) <= 0xFFFF:
             # keep the PARQUET dictionary: ship codes + dict values as a
             # pa.DictionaryArray so the wire encoder maps them straight
@@ -576,13 +640,16 @@ def _filter_project(cols, filter_cols, n_rows, engine_schema, columns,
                 want = arrow_types.get(name)
                 if want is not None and dvals.type != want:
                     dvals = dvals.cast(want)  # cast the SMALL dict side
+                null_mask = None if validity is None else ~validity
                 arrays.append(pa.DictionaryArray.from_arrays(
-                    pa.array(codes.astype(np.int32)), dvals))
+                    pa.array(codes.astype(np.int32), mask=null_mask),
+                    dvals))
                 continue
             except Exception:
                 pass  # fall through to materialized path
         vals = fc.materialize() if idx is None else fc.take(idx)
-        arr = pa.array(vals)
+        arr = pa.array(vals, mask=None if validity is None
+                       else ~validity)
         want = arrow_types.get(name)
         if want is not None and arr.type != want:
             # physical->logical mapping (int32 -> date32,
@@ -646,14 +713,18 @@ def _eval_filter_mask(cols: dict, filter_cols: dict, n_rows: int,
             if fn is None:
                 continue  # device filter will handle it
             try:
-                if fc.codes is not None:
+                if fc.codes is not None and fc.validity is None:
                     # evaluate on the dictionary -> per-code LUT
                     t = _eval_table(name, fc.dict_values, engine_schema)
                     lut = np.asarray(fn(t)).astype(bool)
                     m = lut[fc.codes]
                 else:
-                    t = _eval_table(name, fc.values, engine_schema)
-                    m = np.asarray(fn(t)).astype(bool)
+                    vals = fc.materialize()
+                    arr = pa.array(vals, mask=None
+                                   if fc.validity is None
+                                   else ~fc.validity)
+                    t = _eval_table(name, arr, engine_schema)
+                    m = np.asarray(fn(t).fill_null(False)).astype(bool)
             except Exception:
                 continue
             mask = m if mask is None else (mask & m)

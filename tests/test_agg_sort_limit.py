@@ -275,3 +275,49 @@ def test_elided_device_filter_still_exact(session, tmp_path):
     b = df.collect(engine="cpu").to_pydict()
     assert a["n"] == b["n"]
     assert abs(a["s"][0] - b["s"][0]) <= 1e-9 * max(1, abs(b["s"][0]))
+
+
+def test_topn_null_flood_hierarchical(session):
+    """Degenerate top-n shape: a mostly-NULL nulls-first key keeps
+    every null row as a candidate; the hierarchical reduction must
+    bound device batches and still match the oracle."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.execs.sort import SortKey
+    from spark_rapids_tpu.session import col
+
+    rng = np.random.default_rng(6)
+    n = 30_000
+    t = pa.table({
+        "x": pa.array([None if rng.random() < 0.9 else float(v)
+                       for v in rng.integers(0, 50, n)]),
+        "y": list(range(n)),
+    })
+    df = (session.create_dataframe(t)
+          .order_by(SortKey(col("x")), SortKey(col("y"))).limit(12))
+    from spark_rapids_tpu.execs.sort import TpuTopNExec
+    from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+    exec_, _ = plan_query(df._plan)
+    topn = [e for e in exec_._walk() if isinstance(e, TpuTopNExec)]
+    assert topn
+    topn[0].reduce_cap_rows = 4096  # force several reduction rounds
+    got = list(zip(*collect_exec(exec_).to_pydict().values()))
+    want = list(zip(*df.collect(engine="cpu").to_pydict().values()))
+    assert [repr(r) for r in got] == [repr(r) for r in want]
+
+
+def test_sql_star_with_ordinal_order_by():
+    import pyarrow as pa
+
+    from spark_rapids_tpu.frontends.sql import SqlSession
+
+    fe = SqlSession()
+    fe.register_table("t", pa.table({
+        "a": [3, 1, 2], "b": ["x", "y", "z"], "c": [9, 7, 8]}))
+    # `*` expands to (a, b, c); ordinal 1 = a; c+0 forces the pre-sort
+    df = fe.sql("select *, a as a2 from t order by 1, c + 0")
+    got = df.collect(engine="tpu").to_pydict()["a"]
+    want = df.collect(engine="cpu").to_pydict()["a"]
+    assert got == want == [1, 2, 3]
