@@ -716,9 +716,10 @@ class TpuTopNExec(_SortMixin):
                     wn = win.concrete_num_rows()
                     win = dataclasses.replace(win, num_rows=wn)
                     nxt.append(win.shrink_to_capacity(pad_capacity(wn)))
-                if len(nxt) == len(shrunk):
+                nxt_total = sum(b.concrete_num_rows() for b in nxt)
+                shrunk = nxt  # winners are <= n rows each: keep them
+                if nxt_total >= total:
                     break  # no further reduction possible
-                shrunk = nxt
             big = shrunk[0] if len(shrunk) == 1 else \
                 concat_batches(shrunk)
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:

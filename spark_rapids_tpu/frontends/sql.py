@@ -999,8 +999,8 @@ class SqlSession:
         return (type(a).__name__,
                 expr_key(a.child) if a.child is not None else None)
 
-    def _rewrite_having(self, hv, aggs, hidden):
-        """Replace aggregate calls inside HAVING with references to the
+    def _rewrite_agg_refs(self, hv, aggs, hidden):
+        """Replace aggregate calls inside an expression with references to the
         aggregate's output column, adding hidden aggregates for calls
         not already in the SELECT list (dropped by the re-projection)."""
         import dataclasses as _dcs
@@ -1077,27 +1077,42 @@ class SqlSession:
         gkeys = {expr_key(e) for e in group_exprs}
 
         aggs = []
-        key_items = []  # (expr, out_name)
+        #: per select item: ("agg", out_name) | ("post", rewritten
+        #: expr, out_name) | ("key", idx)
+        plan_items: list = []
         for item, alias in items:
             if item == "*":
                 raise SqlError("SELECT * with GROUP BY is not supported")
             if _has_agg(item):
-                if not isinstance(item, AG.AggregateFunction):
-                    raise SqlError(
-                        "arithmetic over aggregate results is not yet "
-                        "supported; alias the aggregate and post-process")
-                aggs.append((item, alias or item.name))
+                if isinstance(item, AG.AggregateFunction):
+                    aggs.append((item, alias or item.name))
+                    plan_items.append(("agg", alias or item.name))
+                else:
+                    # arithmetic over aggregate results (sum(a)/sum(b),
+                    # 100*sum(case..)/sum(x)): each aggregate call
+                    # becomes a (possibly hidden) aggregate output and
+                    # the arithmetic projects over those outputs —
+                    # Spark's physical split between the aggregate and
+                    # its result expressions
+                    plan_items.append(("post", item,
+                                       alias or item.name))
             else:
                 if expr_key(item) not in gkeys:
                     raise SqlError(
                         f"non-aggregate select item {item.name!r} must "
                         "appear in GROUP BY")
-                key_items.append((item, alias))
+                idx = [i for i, g in enumerate(group_exprs)
+                       if expr_key(g) == expr_key(item)][0]
+                plan_items.append(("key", idx, alias))
 
-        having = q["having"]
         hidden: list = []
+        plan_items = [
+            ("post", self._rewrite_agg_refs(it[1], aggs, hidden), it[2])
+            if it[0] == "post" else it
+            for it in plan_items]
+        having = q["having"]
         if having is not None and _has_agg(having):
-            having = self._rewrite_having(having, aggs, hidden)
+            having = self._rewrite_agg_refs(having, aggs, hidden)
         out = df.group_by(*group_exprs).agg(*aggs, *hidden)
         if having is not None:
             out = out.where(having)
@@ -1105,20 +1120,20 @@ class SqlSession:
         # aggregate output = [group keys..., aggs...]; re-project when
         # the SELECT order/aliases differ from that layout
         out_fields = [f.name for f in out.schema.fields]
-        n_keys = len(group_exprs)
         sel = []
-        for item, alias in items:
-            if _has_agg(item):
-                name = alias or item.name
-                sel.append(B.ColumnReference(name))
+        for it in plan_items:
+            if it[0] == "agg":
+                sel.append(B.ColumnReference(it[1]))
+            elif it[0] == "post":
+                sel.append(B.Alias(it[1], it[2]))
             else:
-                idx = [i for i, g in enumerate(group_exprs)
-                       if expr_key(g) == expr_key(item)][0]
+                _k, idx, alias = it
                 ref = B.ColumnReference(out_fields[idx])
                 sel.append(B.Alias(ref, alias) if alias else ref)
         want = [a or (it.name if it != "*" else "*")
                 for it, a in items]
-        if want != out_fields or any(al for _it, al in items):
+        if want != out_fields or any(al for _it, al in items) \
+                or any(it[0] == "post" for it in plan_items):
             out = out.select(*sel)
         return out
 

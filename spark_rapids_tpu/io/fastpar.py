@@ -391,9 +391,13 @@ def _decode_chunk(fh, col_meta, max_def: int,
                 levels = payload[:dl_len]
                 vals_part = payload[dl_len:]
             page_valid = None
-            if max_def > 0 and dl_len and (
-                    n_nulls or not _def_levels_all_valid(
-                        levels, def_bw, n_vals, max_def)):
+            if max_def > 0 and (
+                    n_nulls or (dl_len and not _def_levels_all_valid(
+                        levels, def_bw, n_vals, max_def))):
+                if not dl_len:
+                    # nulls recorded but no definition levels: a
+                    # nonconforming page — degrade, never misread
+                    return None
                 page_valid = _decode_validity(levels, def_bw, n_vals,
                                               max_def)
                 if page_valid is None:
@@ -713,11 +717,28 @@ def _eval_filter_mask(cols: dict, filter_cols: dict, n_rows: int,
             if fn is None:
                 continue  # device filter will handle it
             try:
-                if fc.codes is not None and fc.validity is None:
-                    # evaluate on the dictionary -> per-code LUT
+                if fc.codes is not None:
+                    # evaluate on the dictionary -> per-code LUT; null
+                    # rows take the conjunct's NULL-INPUT result
+                    # (False for ordinary predicates, True for IS NULL)
                     t = _eval_table(name, fc.dict_values, engine_schema)
                     lut = np.asarray(fn(t)).astype(bool)
                     m = lut[fc.codes]
+                    if fc.validity is not None:
+                        import pyarrow.compute as _pc
+
+                        nt = _eval_table(
+                            name,
+                            pa.array([None],
+                                     type=pa.array(
+                                         fc.dict_values).type),
+                            engine_schema)
+                        res = fn(nt)
+                        if isinstance(res, pa.ChunkedArray):
+                            res = res.combine_chunks()
+                        keep_null = bool(
+                            _pc.fill_null(res, False)[0].as_py())
+                        m = np.where(fc.validity, m, keep_null)
                 else:
                     vals = fc.materialize()
                     arr = pa.array(vals, mask=None

@@ -720,12 +720,24 @@ def _maybe_topn(p: "L.Limit", kids: list[TpuExec]) -> Optional[TpuExec]:
     """LIMIT over a just-planned global Sort with a fixed-width primary
     key -> streaming top-n (per-batch candidate pruning; the full
     multi-key sort runs only over the candidates)."""
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
     from spark_rapids_tpu.execs.sort import TpuSortExec, TpuTopNExec
+    from spark_rapids_tpu.ops.partition import RangePartitioning
 
     sort = kids[0]
-    if not (isinstance(sort, TpuSortExec) and sort.scope == "global"
+    if not (isinstance(sort, TpuSortExec)
             and 0 < p.n <= get_conf().get(TOPN_MAX_ROWS)
             and sort.keys):
+        return None
+    child = sort.children[0]
+    if sort.scope == "partition" and isinstance(
+            child, TpuShuffleExchangeExec) and isinstance(
+            child.partitioning, RangePartitioning):
+        # distributed ORDER BY shape (range exchange + per-partition
+        # sort): top-n needs no exchange at all — consume the
+        # pre-exchange child directly
+        child = child.children[0]
+    elif sort.scope != "global":
         return None
     primary = sort.keys[0].expr.dtype
     if not isinstance(primary, (T.ByteType, T.ShortType, T.IntegerType,
@@ -733,7 +745,7 @@ def _maybe_topn(p: "L.Limit", kids: list[TpuExec]) -> Optional[TpuExec]:
                                 T.DateType, T.TimestampType,
                                 T.BooleanType)):
         return None
-    return TpuTopNExec(p.n, sort.keys, sort.children[0])
+    return TpuTopNExec(p.n, sort.keys, child)
 
 
 BROADCAST_THRESHOLD = register(

@@ -39,6 +39,7 @@ StringTrimLeft StringTrimRight Substring SubstringIndex Subtract Sum Tan
 Tanh TimeAdd ToDegrees ToRadians ToUnixTimestamp UnaryMinus UnaryPositive
 UnboundedFollowing UnboundedPreceding UnixTimestamp UnscaledValue Upper
 WeekDay WindowExpression WindowSpecDefinition Year Cast RegExpReplace
+AnsiCast TimeSub
 """.split()
 
 #: reference exec rules (GpuOverrides.scala:2774-3041 + shims)

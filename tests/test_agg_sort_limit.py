@@ -287,6 +287,8 @@ def test_topn_null_flood_hierarchical(session):
     from spark_rapids_tpu.execs.sort import SortKey
     from spark_rapids_tpu.session import col
 
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+
     rng = np.random.default_rng(6)
     n = 30_000
     t = pa.table({
@@ -294,18 +296,27 @@ def test_topn_null_flood_hierarchical(session):
                        for v in rng.integers(0, 50, n)]),
         "y": list(range(n)),
     })
-    df = (session.create_dataframe(t)
-          .order_by(SortKey(col("x")), SortKey(col("y"))).limit(12))
-    from spark_rapids_tpu.execs.sort import TpuTopNExec
-    from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+    conf = get_conf()
+    old_rows = conf.get(BATCH_SIZE_ROWS)
+    conf.set(BATCH_SIZE_ROWS.key, 2000)  # many candidate batches
+    try:
+        df = (session.create_dataframe(t)
+              .order_by(SortKey(col("x")), SortKey(col("y"))).limit(12))
+        from spark_rapids_tpu.execs.sort import TpuTopNExec
+        from spark_rapids_tpu.plan.planner import collect_exec, plan_query
 
-    exec_, _ = plan_query(df._plan)
-    topn = [e for e in exec_._walk() if isinstance(e, TpuTopNExec)]
-    assert topn
-    topn[0].reduce_cap_rows = 4096  # force several reduction rounds
-    got = list(zip(*collect_exec(exec_).to_pydict().values()))
-    want = list(zip(*df.collect(engine="cpu").to_pydict().values()))
-    assert [repr(r) for r in got] == [repr(r) for r in want]
+        exec_, _ = plan_query(df._plan)
+        topn = [e for e in exec_._walk() if isinstance(e, TpuTopNExec)]
+        assert topn
+        topn[0].reduce_cap_rows = 4096  # force several reduction rounds
+        got = list(zip(*collect_exec(exec_).to_pydict().values()))
+        want = list(zip(*df.collect(engine="cpu").to_pydict().values()))
+        assert [repr(r) for r in got] == [repr(r) for r in want]
+        # the reduction must actually have run: candidates far exceed
+        # the forced cap
+        assert topn[0].metrics["candidateRows"].value > 4096
+    finally:
+        conf.set(BATCH_SIZE_ROWS.key, old_rows)
 
 
 def test_sql_star_with_ordinal_order_by():

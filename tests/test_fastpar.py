@@ -271,3 +271,18 @@ def test_null_aware_filter_on_dict(tmp_path):
     b = df.collect(engine="cpu")
     assert a.num_rows == b.num_rows == 1000
     assert sorted(a.to_pydict()["v"]) == sorted(b.to_pydict()["v"])
+
+
+def test_is_null_predicate_on_null_dict_column(tmp_path, session):
+    """IS NULL pushed onto a null-carrying dict column must KEEP the
+    null rows in the host filter (null-input result is True)."""
+    from spark_rapids_tpu.exprs.predicates import IsNull
+    from spark_rapids_tpu.session import col
+
+    t = pa.table({"x": pa.array([1, None, 2, None, 1] * 100,
+                                pa.int64())})
+    p = _write(tmp_path, t)
+    df = session.read_parquet(p).where(IsNull(col("x")))
+    a = df.collect(engine="tpu")
+    b = df.collect(engine="cpu")
+    assert a.num_rows == b.num_rows == 200

@@ -328,3 +328,22 @@ def test_presort_with_ordinal_key():
     rows = _diff(fe.sql("select v, w from t order by 1, x"),
                  ordered=True)
     assert rows == [(1, "b"), (2, "d"), (2, "c"), (3, "a")]
+
+
+def test_post_aggregate_arithmetic(tpch):
+    """Arithmetic over aggregate results (the TPC-H q8/q14 shape):
+    100 * sum(case..) / sum(x), avg ratios, shared aggregates."""
+    q = """
+    select l_linestatus,
+           100.0 * sum(case when l_returnflag = 'A'
+                            then l_extendedprice else 0 end)
+                 / sum(l_extendedprice) as promo_pct,
+           sum(l_quantity) / count(*) as avg_qty
+    from lineitem
+    group by l_linestatus
+    order by l_linestatus
+    """
+    rows = _diff(tpch.sql(q), expect_rows=2, ordered=True)
+    for _ls, pct, avg_qty in rows:
+        assert 0 < pct < 100
+        assert 20 < avg_qty < 30
