@@ -3,8 +3,10 @@ through the framework, one chip.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} —
 headline = q6 (BASELINE.md config #1); q1 (config #2's shape: grouped
-8-aggregate over string keys) and q3 (config #3's shape: two-table hash
-join -> grouped aggregate -> top-k) ride as q1_*/q3_* fields.
+8-aggregate over string keys), q3 (config #3's shape: two-table hash
+join -> grouped aggregate -> top-k) and q67 (config #4's shape:
+grouped aggregate -> rank window -> rank filter -> sort) ride as
+q1_*/q3_*/q67_* fields.
 
 Unlike a kernel microbenchmark, this measures the REAL query path:
 `TpuSession.read_parquet -> ... -> collect`, which includes the host
@@ -151,6 +153,49 @@ def q3_dataframe(session, li_paths, orders_path):
             .agg((sum_(rev), "revenue"))
             .order_by(col("revenue"), desc=True)
             .limit(10))
+
+
+def make_store_sales(dirpath: str, n_rows: int = 1 << 21,
+                     n_files: int = 2):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(67)
+    per = n_rows // n_files
+    paths = []
+    for i in range(n_files):
+        t = pa.table({
+            "ss_store_sk": rng.integers(1, 9, per),
+            "ss_item_sk": rng.integers(1, 2000, per),
+            "ss_quantity": rng.integers(1, 20, per).astype(np.float64),
+            "ss_sales_price": np.round(rng.uniform(1, 300, per), 2),
+        })
+        p = os.path.join(dirpath, f"ss-{i}.parquet")
+        pq.write_table(t, p, row_group_size=per)
+        paths.append(p)
+    return paths
+
+
+def q67_dataframe(session, paths):
+    """TPC-DS q67 shape: grouped aggregate -> rank window partitioned
+    by store -> rank filter -> ordered output (BASELINE config #4's
+    sort + window moving parts)."""
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.exprs.window import Window, rank
+    from spark_rapids_tpu.session import col, sum_
+
+    agg = (session.read_parquet(*paths)
+           .group_by(col("ss_store_sk"), col("ss_item_sk"))
+           .agg((sum_(col("ss_sales_price") * col("ss_quantity")),
+                 "sumsales")))
+    spec = Window.partition_by("ss_store_sk").order_by(
+        "sumsales", desc=True)
+    ranked = agg.select(col("ss_store_sk"), col("ss_item_sk"),
+                        col("sumsales"),
+                        rank().over(spec).alias("rk"))
+    return (ranked.where(col("rk") <= lit(10))
+            .order_by(col("ss_store_sk"), col("rk"), col("ss_item_sk")))
 
 
 def _time_collect(df, engine: str, iters: int):
@@ -345,6 +390,35 @@ def _bench_q3(session, d: str) -> dict:
     return out
 
 
+def _bench_q67(session, d: str) -> dict:
+    """BASELINE config #4's shape: grouped aggregate under a ranking
+    window under a rank filter under a global sort, correctness-gated
+    against the CPU engine."""
+    q67dir = os.path.join(d, "q67")
+    os.makedirs(q67dir, exist_ok=True)
+    paths = make_store_sales(q67dir)
+    df = q67_dataframe(session, paths)
+    df.collect(engine="tpu")  # warmup
+    tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+    cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
+    got = list(zip(*tpu_r.to_pydict().values()))
+    want = list(zip(*cpu_r.to_pydict().values()))
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[3] == w[3], (g, w)  # store, rank
+        assert abs(g[2] - w[2]) <= 1e-6 * max(1.0, abs(w[2])), (g, w)
+    tpu_t = statistics.median(tpu_ts)
+    cpu_t = statistics.median(cpu_ts)
+    out = {
+        "q67_tpu_s_per_query": round(tpu_t, 4),
+        "q67_cpu_s_per_query": round(cpu_t, 4),
+        "q67_vs_cpu": round(cpu_t / tpu_t, 3),
+        "q67_rows": 1 << 21,
+    }
+    out.update(_stats(tpu_ts, "q67_tpu"))
+    return out
+
+
 def main() -> None:
     n_rows = ROWS_PER_FILE * N_FILES
     with tempfile.TemporaryDirectory(prefix="q6bench_") as d:
@@ -379,6 +453,7 @@ def main() -> None:
         else:
             extra = _bench_q1(session, d)
             extra.update(_bench_q3(session, d))
+            extra.update(_bench_q67(session, d))
 
     rows_per_s = n_rows / tpu_t
     bytes_per_s = rows_per_s * ROW_BYTES
