@@ -100,16 +100,25 @@ def _string_word_keys(col: StringColumn) -> list[jax.Array]:
 def column_sort_keys(col: AnyColumn, descending: bool,
                      nulls_last: bool) -> list[jax.Array]:
     """Minor-to-major int key arrays for one SQL sort key.  Returned
-    minor-first (callers feed jnp.lexsort, whose LAST key is primary)."""
+    minor-first (callers feed jnp.lexsort, whose LAST key is primary).
+
+    Value keys are neutralized to a constant under NULL: the slot data
+    beneath a null is decoder garbage (fastpar leaves the previous
+    value), and if it leaked into the key, NULL rows would order by
+    garbage instead of falling through to the next SQL sort key — a
+    divergence from Spark that only bites multi-key sorts."""
     if isinstance(col, StringColumn):
-        vals = _string_word_keys(col)
+        vals = [jnp.where(col.validity, v, 0)
+                for v in _string_word_keys(col)]
         if descending:
             vals = [~v for v in vals]
         vals = list(reversed(vals))  # minor-first
     elif isinstance(col.dtype, T.DoubleType):
-        vals = float64_order_keys(col.data, descending)
+        vals = float64_order_keys(
+            jnp.where(col.validity, col.data, 0.0), descending)
     else:
-        d = col.data
+        d = jnp.where(col.validity, col.data,
+                      jnp.zeros((), col.data.dtype))
         if isinstance(col.dtype, T.FloatType):
             k = float_total_order_bits(d)
         elif col.dtype == T.BOOLEAN:

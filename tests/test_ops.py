@@ -54,6 +54,58 @@ def test_sort_floats_nan_largest():
     assert np.isnan(vals[5])
 
 
+def test_sort_null_slot_garbage_falls_to_next_key():
+    """Data beneath a NULL slot is decoder garbage and must NOT order
+    rows: among equal (null) primary keys the NEXT sort key decides.
+    Regression for the OOC multi-key sort divergence where fastpar's
+    real-null decode left varying values under nulls."""
+    schema = T.Schema([T.Field("a", T.LONG), T.Field("b", T.DOUBLE)])
+    # a is null everywhere with DIFFERENT garbage beneath; order must
+    # come entirely from b (nulls first, then ascending)
+    b = make_batch(
+        {"a": np.array([900, 5, 777, 42]),
+         "b": np.array([2.0, 1.0, np.nan, 3.0])},
+        schema,
+        {"a": np.array([False, False, False, False]),
+         "b": np.array([True, True, False, True])})
+    out = sort_batch(b, [SortOrder(0), SortOrder(1)])
+    assert col_values(out, "a") == [None, None, None, None]
+    assert col_values(out, "b") == [None, 1.0, 2.0, 3.0]
+    # string keys: garbage bytes under a null string slot likewise
+    schema2 = T.Schema([T.Field("s", T.STRING), T.Field("v", T.LONG)])
+    b2 = make_batch(
+        {"s": np.array(["zzz", "aaa", "mmm"], object),
+         "v": np.array([2, 3, 1])},
+        schema2,
+        {"s": np.array([False, False, False]),
+         "v": np.array([True, True, True])})
+    out2 = sort_batch(b2, [SortOrder(0), SortOrder(1)])
+    assert col_values(out2, "v") == [1, 2, 3]
+    # DOUBLE primary key (float64_order_keys branch): garbage incl. NaN
+    schema3 = T.Schema([T.Field("d", T.DOUBLE), T.Field("v", T.LONG)])
+    b3 = make_batch(
+        {"d": np.array([np.nan, 5e300, -7.25]),
+         "v": np.array([2, 3, 1])},
+        schema3,
+        {"d": np.array([False, False, False]),
+         "v": np.array([True, True, True])})
+    out3 = sort_batch(b3, [SortOrder(0), SortOrder(1)])
+    assert col_values(out3, "v") == [1, 2, 3]
+    # packed <=4-byte primary key (INT32 branch), descending too
+    schema4 = T.Schema([T.Field("i", T.INT), T.Field("v", T.LONG)])
+    b4 = make_batch(
+        {"i": np.array([77, -3, 2**31 - 1], np.int32),
+         "v": np.array([2, 3, 1])},
+        schema4,
+        {"i": np.array([False, False, False]),
+         "v": np.array([True, True, True])})
+    out4 = sort_batch(b4, [SortOrder(0), SortOrder(1)])
+    assert col_values(out4, "v") == [1, 2, 3]
+    out4d = sort_batch(b4, [SortOrder(0, descending=True,
+                                      nulls_last=True), SortOrder(1)])
+    assert col_values(out4d, "v") == [1, 2, 3]
+
+
 def test_sort_strings():
     schema = T.Schema([T.Field("s", T.STRING)])
     b = make_batch({"s": np.array(["banana", "a", "apple", "ab", ""],
