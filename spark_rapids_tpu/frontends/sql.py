@@ -91,6 +91,59 @@ def _tokenize(text: str) -> list[tuple[str, str, int]]:
 _AGG_FNS = {"sum": AG.Sum, "min": AG.Min, "max": AG.Max,
             "avg": AG.Average, "mean": AG.Average, "count": AG.Count}
 
+
+def _window_fn_table():
+    from spark_rapids_tpu.exprs import window as W
+
+    return {"rank": W.rank, "dense_rank": W.dense_rank,
+            "row_number": W.row_number}
+
+
+_WINDOW_FNS = _window_fn_table()
+
+
+class _SubqueryExpr(B.Expression):
+    """Parse-time marker for an uncorrelated scalar subquery; the
+    lowering pass replaces it with the engine's ScalarSubquery over the
+    lowered subplan (evaluated once by the planner prepass, ref:
+    GpuScalarSubquery)."""
+
+    def __init__(self, q: dict):
+        self.q = q
+
+    @property
+    def dtype(self) -> T.DataType:
+        raise RuntimeError("unresolved scalar subquery")
+
+    @property
+    def name(self) -> str:
+        return "scalar_subquery"
+
+    @property
+    def children(self):
+        return ()
+
+
+class _InSubquery(B.Expression):
+    """Parse-time marker for `expr IN (SELECT ...)`; lowered to a
+    LEFT SEMI join (Spark's RewritePredicateSubquery)."""
+
+    def __init__(self, lhs, q: dict):
+        self.lhs = lhs
+        self.q = q
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def name(self) -> str:
+        return "in_subquery"
+
+    @property
+    def children(self):
+        return (self.lhs,)
+
 def _lit_int(e, what: str) -> int:
     if isinstance(e, B.Literal) and isinstance(e.value, int):
         return e.value
@@ -238,7 +291,56 @@ class _Parser:
 
     # -- statement -- #
 
-    def parse_select(self) -> dict:
+    def parse_select(self, sub: bool = False) -> dict:
+        """One full query: core (UNION [ALL] core)* ORDER BY/LIMIT.
+        `sub` parses a parenthesized subquery (stops at the closing
+        paren instead of requiring end-of-input)."""
+        q = self._select_core()
+        unions: list[tuple] = []  # (core dict, dedup?)
+        while self.at("union"):
+            self.i += 1
+            dedup = not self.accept("all")
+            unions.append((self._select_core(), dedup))
+        q["unions"] = unions
+        q["order_by"] = self._order_by_clause()
+        q["limit"] = None
+        if self.accept("limit"):
+            t = self.peek()
+            if t[0] != "num":
+                raise SqlError(f"expected LIMIT count at {t[2]}")
+            q["limit"] = int(t[1])
+            self.i += 1
+        if not sub:
+            self.accept_op(";")
+            if self.peek()[0] != "eof":
+                t = self.peek()
+                raise SqlError(f"unexpected trailing {t[1]!r} at {t[2]}")
+        return q
+
+    def _order_by_clause(self) -> list[tuple]:
+        order_by: list[tuple] = []
+        if self.accept("order"):
+            self.expect("by")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.accept("desc"):
+                    desc = True
+                else:
+                    self.accept("asc")
+                nulls_last = desc
+                if self.accept("nulls"):
+                    if self.accept("last"):
+                        nulls_last = True
+                    else:
+                        self.expect("first")
+                        nulls_last = False
+                order_by.append((e, desc, nulls_last))
+                if not self.accept_op(","):
+                    break
+        return order_by
+
+    def _select_core(self) -> dict:
         self.expect("select")
         distinct = self.accept("distinct")
         items: list[tuple] = []  # (expr|"*", alias|None)
@@ -286,49 +388,48 @@ class _Parser:
             joins.append((how, tr, self.expr()))
         where = self.expr() if self.accept("where") else None
         group_by: list = []
+        group_kind = None  # None | "rollup" | "cube"
         if self.accept("group"):
             self.expect("by")
-            while True:
-                group_by.append(self.expr())
-                if not self.accept_op(","):
-                    break
+            if self.at("rollup") or self.at("cube"):
+                group_kind = self.kw()
+                self.i += 1
+                self.expect_op("(")
+                while True:
+                    group_by.append(self.expr())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                while True:
+                    group_by.append(self.expr())
+                    if not self.accept_op(","):
+                        break
         having = self.expr() if self.accept("having") else None
-        order_by: list[tuple] = []
-        if self.accept("order"):
-            self.expect("by")
-            while True:
-                e = self.expr()
-                desc = False
-                if self.accept("desc"):
-                    desc = True
-                else:
-                    self.accept("asc")
-                nulls_last = desc
-                if self.accept("nulls"):
-                    if self.accept("last"):
-                        nulls_last = True
-                    else:
-                        self.expect("first")
-                        nulls_last = False
-                order_by.append((e, desc, nulls_last))
-                if not self.accept_op(","):
-                    break
-        limit = None
-        if self.accept("limit"):
-            t = self.peek()
-            if t[0] != "num":
-                raise SqlError(f"expected LIMIT count at {t[2]}")
-            limit = int(t[1])
-            self.i += 1
-        self.accept_op(";")
-        if self.peek()[0] != "eof":
-            t = self.peek()
-            raise SqlError(f"unexpected trailing {t[1]!r} at {t[2]}")
         return {"items": items, "distinct": distinct, "tables": tables,
                 "joins": joins, "where": where, "group_by": group_by,
-                "having": having, "order_by": order_by, "limit": limit}
+                "group_kind": group_kind, "having": having,
+                "order_by": [], "limit": None, "unions": []}
 
     def table_ref(self) -> tuple:
+        if self.peek()[0] == "op" and self.peek()[1] == "(":
+            # derived table: FROM ( SELECT ... ) [AS] alias
+            self.i += 1
+            if self.kw() != "select":
+                raise SqlError(
+                    f"expected SELECT in derived table at "
+                    f"{self.peek()[2]}")
+            subq = self.parse_select(sub=True)
+            self.expect_op(")")
+            alias = None
+            if self.accept("as"):
+                alias = self.ident()
+            elif (self.peek()[0] in ("id", "qid")
+                  and self.kw() not in _TABLE_STOP_KWS):
+                alias = self.ident()
+            if alias is None:
+                raise SqlError("derived table requires an alias")
+            return (("__sub__", subq), alias)
         name = self.ident()
         alias = None
         if self.accept("as"):
@@ -375,6 +476,15 @@ class _Parser:
             return P.Not(out) if negate else out
         if self.accept("in"):
             self.expect_op("(")
+            if self.kw() == "select":
+                subq = self.parse_select(sub=True)
+                self.expect_op(")")
+                if negate:
+                    raise SqlError(
+                        "NOT IN (subquery) is not supported (Spark's "
+                        "null-aware anti-join semantics; rewrite with "
+                        "NOT EXISTS or an explicit anti join)")
+                return _InSubquery(e, subq)
             vals = [self.expr()]
             while self.accept_op(","):
                 vals.append(self.expr())
@@ -468,6 +578,11 @@ class _Parser:
             self.i += 1
             return B.Literal.of(t[1][1:-1].replace("''", "'"))
         if self.accept_op("("):
+            if self.kw() == "select":
+                # uncorrelated scalar subquery: (SELECT <agg> FROM ...)
+                subq = self.parse_select(sub=True)
+                self.expect_op(")")
+                return _SubqueryExpr(subq)
             e = self.expr()
             self.expect_op(")")
             return e
@@ -545,7 +660,11 @@ class _Parser:
             self.expect_op("(")
             if fname == "count" and self.accept_op("*"):
                 self.expect_op(")")
-                return AG.CountStar()
+                star = AG.CountStar()
+                if self.at("over"):
+                    self.i += 1
+                    return star.over(self._window_spec())
+                return star
             distinct = self.accept("distinct")
             args: list = []
             if not self.accept_op(")"):
@@ -553,6 +672,29 @@ class _Parser:
                 while self.accept_op(","):
                     args.append(self.expr())
                 self.expect_op(")")
+            if fname in _WINDOW_FNS:
+                if args or distinct:
+                    raise SqlError(f"{fname}() takes no arguments")
+                self.expect("over")
+                return _WINDOW_FNS[fname]().over(self._window_spec())
+            if fname in ("lead", "lag"):
+                from spark_rapids_tpu.exprs.window import lag, lead
+
+                if not 1 <= len(args) <= 3 or distinct:
+                    raise SqlError(f"{fname}(expr[, offset[, default]])")
+                off = 1
+                if len(args) >= 2:
+                    off = _lit_int(args[1], f"{fname} offset")
+                dflt = None
+                if len(args) == 3:
+                    if not isinstance(args[2], B.Literal):
+                        raise SqlError(
+                            f"{fname} default must be a literal")
+                    dflt = args[2].value
+                fn = (lead if fname == "lead" else lag)(
+                    args[0], off, dflt)
+                self.expect("over")
+                return fn.over(self._window_spec())
             if fname in _AGG_FNS:
                 if len(args) != 1:
                     raise SqlError(f"{fname} takes one argument")
@@ -563,7 +705,11 @@ class _Parser:
                     from spark_rapids_tpu.session import count_distinct
 
                     return count_distinct(args[0])
-                return _AGG_FNS[fname](args[0])
+                agg = _AGG_FNS[fname](args[0])
+                if self.at("over"):
+                    self.i += 1
+                    return agg.over(self._window_spec())
+                return agg
             if fname in _SCALAR_FNS:
                 try:
                     return _SCALAR_FNS[fname](*args)
@@ -576,6 +722,69 @@ class _Parser:
             col = self.ident()
             return _QualifiedRef(name, col)
         return B.ColumnReference(name)
+
+    def _window_spec(self):
+        """OVER ( [PARTITION BY e,..] [ORDER BY e [ASC|DESC],..]
+        [ROWS|RANGE BETWEEN <bound> AND <bound>] )"""
+        from spark_rapids_tpu.execs.sort import SortKey
+        from spark_rapids_tpu.exprs.window import WindowSpecBuilder
+
+        self.expect_op("(")
+        b = WindowSpecBuilder()
+        if self.accept("partition"):
+            self.expect("by")
+            parts = [self.expr()]
+            while self.accept_op(","):
+                parts.append(self.expr())
+            b.partition_by(*parts)
+        if self.at("order"):
+            b.order_by(*[SortKey(e, descending=d, nulls_last=n)
+                         for e, d, n in self._order_by_clause()])
+        if self.at("rows") or self.at("range"):
+            mode = self.kw()
+            self.i += 1
+            self.expect("between")
+            lo = self._frame_bound(start=True)
+            self.expect("and")
+            hi = self._frame_bound(start=False)
+            if mode == "rows":
+                b.rows_between(lo, hi)
+            else:
+                b.range_between(lo, hi)
+        self.expect_op(")")
+        return b
+
+    def _frame_bound(self, start: bool):
+        """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | n PRECEDING |
+        n FOLLOWING -> the builder's signed-offset convention
+        (None = unbounded, 0 = current row).  `start` validates the
+        direction: a frame may not start at UNBOUNDED FOLLOWING nor end
+        at UNBOUNDED PRECEDING."""
+        if self.accept("unbounded"):
+            if self.accept("preceding"):
+                if not start:
+                    raise SqlError(
+                        "frame cannot end at UNBOUNDED PRECEDING")
+            elif self.accept("following"):
+                if start:
+                    raise SqlError(
+                        "frame cannot start at UNBOUNDED FOLLOWING")
+            else:
+                raise SqlError("expected PRECEDING/FOLLOWING after "
+                               "UNBOUNDED")
+            return None
+        if self.accept("current"):
+            self.expect("row")
+            return 0
+        t = self.peek()
+        if t[0] != "num":
+            raise SqlError(f"expected frame bound at {t[2]}")
+        n = int(t[1])
+        self.i += 1
+        if self.accept("preceding"):
+            return -n
+        self.expect("following")
+        return n
 
     def _case(self):
         self.expect("case")
@@ -725,17 +934,49 @@ class SqlSession:
         return self._lower(q)
 
     def _lower(self, q: dict):
-        # resolve tables and alias -> column-set mapping
+        if q.get("unions"):
+            # left-associative UNION chain; plain UNION dedups (Spark's
+            # Distinct over Union), outer ORDER BY/LIMIT bind the chain
+            core = dict(q, unions=[], order_by=[], limit=None)
+            out = self._lower(core)
+            for member, dedup in q["unions"]:
+                m = self._lower(member)
+                if len(m.schema.fields) != len(out.schema.fields):
+                    raise SqlError(
+                        "UNION members must have the same column count")
+                out = out.union(m)
+                if dedup:
+                    out = out.group_by(
+                        *[B.ColumnReference(f.name)
+                          for f in out.schema.fields]).agg()
+            return self._order_and_limit(out, q)
+
+        # resolve tables and alias -> column-set mapping (a table name
+        # may be a parsed derived-table subquery)
         frames = []  # (alias, df, colnames)
         for name, alias in [q["tables"][0]] + [j[1] for j in q["joins"]]:
-            df = self.table(name)
+            if isinstance(name, tuple) and name[0] == "__sub__":
+                df = self._lower(name[1])
+            else:
+                df = self.table(name)
             cols = {f.name.lower() for f in df.schema.fields}
             frames.append((alias.lower(), df, cols))
         self._check_qualifiers(q, frames)
         self._strip_qualifiers(q)
+        self._resolve_scalar_subqueries(q)
 
         where_conjs = _conjuncts(q["where"]) if q["where"] is not None \
             else []
+        # `x IN (SELECT ...)` conjuncts become LEFT SEMI joins applied
+        # after the FROM joins (Spark's RewritePredicateSubquery)
+        in_subs = [cj for cj in where_conjs
+                   if isinstance(cj, _InSubquery)]
+        where_conjs = [cj for cj in where_conjs
+                       if not isinstance(cj, _InSubquery)]
+        for cj in where_conjs:
+            if any(isinstance(x, _InSubquery) for x in _walk(cj)):
+                raise SqlError("IN (subquery) is only supported as a "
+                               "top-level AND condition")
         joins = q["joins"]
 
         # push single-table conjuncts down to their frame (the textbook
@@ -804,7 +1045,102 @@ class SqlSession:
         if post_where is not None:
             acc_df = acc_df.where(post_where)
 
+        for isq in in_subs:
+            sub = self._lower(isq.q)
+            if len(sub.schema.fields) != 1:
+                raise SqlError(
+                    "IN subquery must select exactly one column")
+            rcol = B.ColumnReference(sub.schema.fields[0].name)
+            acc_df = acc_df.join(sub, left_on=[isq.lhs],
+                                 right_on=[rcol], how="left_semi")
+
         return self._project(q, acc_df)
+
+    def _resolve_scalar_subqueries(self, q: dict) -> None:
+        """Replace scalar-subquery markers with the engine's
+        ScalarSubquery over the recursively lowered subplan."""
+        import dataclasses as _dcs
+
+        from spark_rapids_tpu.exprs.subquery import ScalarSubquery
+
+        def rw(e):
+            if isinstance(e, _SubqueryExpr):
+                sub = self._lower(e.q)
+                if len(sub.schema.fields) != 1:
+                    raise SqlError("scalar subquery must select "
+                                   "exactly one column")
+                return ScalarSubquery(sub._plan)
+            if isinstance(e, _InSubquery):
+                return _InSubquery(rw(e.lhs), e.q)
+            if isinstance(e, AG.AggregateFunction):
+                if _dcs.is_dataclass(e) and e.child is not None:
+                    nc = rw(e.child)
+                    return _dcs.replace(e, child=nc) \
+                        if nc is not e.child else e
+                return e
+            if not _dcs.is_dataclass(e):
+                return e
+            vals = {}
+            changed = False
+            for f in _dcs.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, (B.Expression, AG.AggregateFunction)):
+                    nv = rw(v)
+                elif isinstance(v, (tuple, list)):
+                    nv = type(v)(
+                        rw(x) if isinstance(
+                            x, (B.Expression, AG.AggregateFunction))
+                        else x for x in v)
+                else:
+                    nv = v
+                vals[f.name] = nv
+                changed = changed or nv is not v
+            return _rebuild(e, vals, changed)
+
+        q["items"] = [(it if it == "*" else rw(it), al)
+                      for it, al in q["items"]]
+        for part in ("where", "having"):
+            if q[part] is not None:
+                q[part] = rw(q[part])
+        q["order_by"] = [(rw(e), d, n) for e, d, n in q["order_by"]]
+        q["group_by"] = [rw(e) for e in q["group_by"]]
+        q["joins"] = [(how, tr, rw(on) if on is not None else None)
+                      for how, tr, on in q["joins"]]
+        # IN (subquery) lowers only from top-level WHERE conjuncts;
+        # anywhere else would reach the engine as an unplannable marker
+        def no_insub(e, where_word):
+            if e is not None and any(isinstance(x, _InSubquery)
+                                     for x in _walk(e)):
+                raise SqlError("IN (subquery) is only supported as a "
+                               f"top-level WHERE condition, not in "
+                               f"{where_word}")
+
+        for it, _al in q["items"]:
+            if it != "*":
+                no_insub(it, "the SELECT list")
+        no_insub(q["having"], "HAVING")
+        for e in q["group_by"]:
+            no_insub(e, "GROUP BY")
+        for e, _d, _n in q["order_by"]:
+            no_insub(e, "ORDER BY")
+        for _how, _tr, on in q["joins"]:
+            no_insub(on, "JOIN ON")
+
+    def _order_and_limit(self, out, q: dict):
+        """Outer ORDER BY (names or 1-based ordinals) + LIMIT."""
+        out_names = [f.name for f in out.schema.fields]
+        if q["order_by"]:
+            keys = []
+            for e, desc, nulls_last in q["order_by"]:
+                if isinstance(e, B.Literal) and isinstance(e.value, int) \
+                        and 1 <= e.value <= len(out_names):
+                    e = B.ColumnReference(out_names[e.value - 1])
+                keys.append(SortKey(e, descending=desc,
+                                    nulls_last=nulls_last))
+            out = out.order_by(*keys)
+        if q["limit"] is not None:
+            out = out.limit(q["limit"])
+        return out
 
     @staticmethod
     def _equi_sides(cj, left_cols: set, right_cols: set):
@@ -832,6 +1168,10 @@ class SqlSession:
         def rw(e):
             if isinstance(e, _QualifiedRef):
                 return B.ColumnReference(e.col_name)
+            if isinstance(e, _InSubquery):
+                return _InSubquery(rw(e.lhs), e.q)
+            if isinstance(e, _SubqueryExpr):
+                return e
             if not _dcs.is_dataclass(e):
                 return e
             changed = False
@@ -1113,7 +1453,17 @@ class SqlSession:
         having = q["having"]
         if having is not None and _has_agg(having):
             having = self._rewrite_agg_refs(having, aggs, hidden)
-        out = df.group_by(*group_exprs).agg(*aggs, *hidden)
+        if q.get("group_kind"):
+            names = []
+            for g in group_exprs:
+                if not isinstance(g, B.ColumnReference):
+                    raise SqlError(f"{q['group_kind']} keys must be "
+                                   "plain columns")
+                names.append(g.col_name)
+            grouped = getattr(df, q["group_kind"])(*names)
+        else:
+            grouped = df.group_by(*group_exprs)
+        out = grouped.agg(*aggs, *hidden)
         if having is not None:
             out = out.where(having)
 

@@ -347,3 +347,167 @@ def test_post_aggregate_arithmetic(tpch):
     for _ls, pct, avg_qty in rows:
         assert 0 < pct < 100
         assert 20 < avg_qty < 30
+
+
+# -- round-5 grammar: subqueries, unions, windows, rollup ------------- #
+
+TPCDS_Q67 = """
+select * from
+    (select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+            d_moy, s_store_id, sumsales,
+            rank() over (partition by i_category
+                         order by sumsales desc) rk
+     from (select i_category, i_class, i_brand, i_product_name, d_year,
+                  d_qoy, d_moy, s_store_id,
+                  sum(coalesce(ss_sales_price*ss_quantity, 0)) sumsales
+           from store_sales, date_dim, store, item
+           where ss_sold_date_sk = d_date_sk
+             and ss_item_sk = i_item_sk
+             and ss_store_sk = s_store_sk
+             and d_month_seq between 1200 and 1200 + 11
+           group by rollup(i_category, i_class, i_brand, i_product_name,
+                           d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+where rk <= 100
+order by i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales, rk
+limit 100
+"""
+
+
+@pytest.fixture(scope="module")
+def tpcds67(tmp_path_factory):
+    """Schema-subset tables for the REAL q67 text (8 rollup keys)."""
+    rng = np.random.default_rng(67)
+    n = 6000
+    fe = SqlSession()
+    fe.register_table("store_sales", pa.table({
+        "ss_sold_date_sk": rng.integers(0, 200, n),
+        "ss_item_sk": rng.integers(0, 60, n),
+        "ss_store_sk": rng.integers(0, 4, n),
+        "ss_quantity": rng.integers(1, 20, n).astype(np.float64),
+        "ss_sales_price": np.round(rng.uniform(1, 300, n), 2),
+    }))
+    fe.register_table("date_dim", pa.table({
+        "d_date_sk": np.arange(200),
+        "d_month_seq": rng.integers(1195, 1215, 200).astype(np.int32),
+        "d_year": (1999 + rng.integers(0, 2, 200)).astype(np.int32),
+        "d_qoy": rng.integers(1, 5, 200).astype(np.int32),
+        "d_moy": rng.integers(1, 13, 200).astype(np.int32),
+    }))
+    fe.register_table("store", pa.table({
+        "s_store_sk": np.arange(4),
+        "s_store_id": pa.array([f"S{i:04d}" for i in range(4)]),
+    }))
+    fe.register_table("item", pa.table({
+        "i_item_sk": np.arange(60),
+        "i_category": pa.array(
+            np.array(["Books", "Music", "Sports"])[
+                rng.integers(0, 3, 60)]),
+        "i_class": pa.array(
+            np.array(["c1", "c2"])[rng.integers(0, 2, 60)]),
+        "i_brand": pa.array(
+            np.array(["b1", "b2", "b3"])[rng.integers(0, 3, 60)]),
+        "i_product_name": pa.array([f"p{i}" for i in range(60)]),
+    }))
+    return fe
+
+
+def test_tpcds_q67_text(tpcds67):
+    """The ACTUAL TPC-DS q67: derived tables, 8-key rollup, rank()
+    window, rank filter, 10-key ORDER BY + LIMIT."""
+    _diff(tpcds67.sql(TPCDS_Q67), expect_rows=100)
+
+
+def test_derived_table(tpch):
+    q = """
+    select f, q from
+        (select l_returnflag f, l_quantity q from lineitem
+         where l_quantity > 10) t
+    where q < 20
+    """
+    rows = _diff(tpch.sql(q))
+    assert rows and all(10 < r[1] < 20 for r in rows)
+
+
+def test_scalar_subquery(tpch):
+    q = """
+    select sum(l_extendedprice) as s, count(*) as n from lineitem
+    where l_quantity < (select avg(l_quantity) from lineitem)
+    """
+    rows = _diff(tpch.sql(q), expect_rows=1)
+    assert rows[0][1] > 0
+
+
+def test_in_subquery_semi_join(tpch):
+    """TPC-H q18's signature shape: IN (grouped HAVING subquery)."""
+    q = """
+    select o_orderkey, sum(l_quantity) as total
+    from orders, lineitem
+    where o_orderkey in (select l_orderkey from lineitem
+                         group by l_orderkey
+                         having sum(l_quantity) > 250)
+      and o_orderkey = l_orderkey
+    group by o_orderkey
+    order by total desc, o_orderkey
+    limit 20
+    """
+    rows = _diff(tpch.sql(q), ordered=True)
+    assert all(r[1] > 250 for r in rows)
+
+
+def test_union_all_and_union_distinct(tpch):
+    rows = _diff(tpch.sql("""
+        select l_returnflag r, sum(l_quantity) q from lineitem
+        group by l_returnflag
+        union all
+        select l_linestatus, sum(l_quantity) from lineitem
+        group by l_linestatus
+        order by 2 desc
+    """), expect_rows=5, ordered=True)
+    assert sorted(r[0] for r in rows) == ["A", "F", "N", "O", "R"]
+    dedup = _diff(tpch.sql("""
+        select l_returnflag r from lineitem
+        union
+        select l_linestatus from lineitem
+        order by r
+    """), expect_rows=5, ordered=True)
+    assert [r[0] for r in dedup] == ["A", "F", "N", "O", "R"]
+
+
+def test_window_functions_text(tpch):
+    """row_number / window aggregate / lead over real window specs."""
+    rows = _diff(tpch.sql("""
+        select l_orderkey,
+               row_number() over (partition by l_orderkey
+                                  order by l_quantity desc,
+                                           l_extendedprice) rn,
+               sum(l_quantity) over (partition by l_orderkey) okq
+        from lineitem
+        where l_orderkey < 40
+    """))
+    assert rows and all(r[1] >= 1 for r in rows)
+    rows = _diff(tpch.sql("""
+        select l_orderkey,
+               avg(l_extendedprice) over
+                   (partition by l_returnflag
+                    order by l_extendedprice
+                    rows between 3 preceding and current row) m
+        from lineitem where l_orderkey < 40
+    """))
+    assert rows
+
+
+def test_rollup_text(tpch):
+    rows = _diff(tpch.sql("""
+        select l_returnflag, l_linestatus, sum(l_quantity) q
+        from lineitem
+        group by rollup(l_returnflag, l_linestatus)
+        order by 1 nulls first, 2 nulls first
+    """), expect_rows=3 * 2 + 3 + 1, ordered=True)
+    assert rows[0][0] is None and rows[0][1] is None  # grand total
+
+
+def test_not_in_subquery_rejected(tpch):
+    with pytest.raises(SqlError, match="NOT IN"):
+        tpch.sql("select l_orderkey from lineitem where l_orderkey "
+                 "not in (select o_orderkey from orders)")
