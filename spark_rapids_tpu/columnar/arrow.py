@@ -420,6 +420,27 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
     return ColumnarBatch(cols, n, schema)
 
 
+#: one-round fetch threshold: below this FULL-CAPACITY size, fetching
+#: count+data together beats a count sync followed by a shrunk fetch
+#: (breakeven = link_rtt * bandwidth; ~1-2MB on the tunneled link)
+_FUSED_FETCH_BYTES = 2 << 20
+
+
+def _full_fetch_bytes(batch: ColumnarBatch) -> int:
+    """Static D2H size estimate if the batch shipped at full capacity."""
+    total = 0
+    for c in batch.columns:
+        if isinstance(c, StringColumn):
+            total += c.chars.shape[0] * (c.chars.shape[1] + 5)
+        elif hasattr(c, "data"):
+            total += c.data.shape[0] * (c.data.dtype.itemsize + 1)
+        else:
+            # nested (list/struct/map): no cheap estimate — report
+            # over-threshold so the classic count-then-shrink path runs
+            return _FUSED_FETCH_BYTES + 1
+    return total
+
+
 def to_arrow(batch: ColumnarBatch) -> pa.Table:
     """Device ColumnarBatch -> host Arrow table (the D2H download).
 
@@ -435,18 +456,26 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
     # sidecar so its codes (full capacity) never cross the D2H link
     import dataclasses as _dc
 
-    if any(isinstance(c, StringColumn) and c.codes is not None
-           for c in batch.columns):
-        batch = _CB([
-            _dc.replace(c, codes=None, dict_chars=None, dict_lens=None)
-            if isinstance(c, StringColumn) and c.codes is not None else c
-            for c in batch.columns], batch.num_rows, batch.schema)
+    if any(getattr(c, "codes", None) is not None for c in batch.columns):
+        def strip(c):
+            if isinstance(c, StringColumn) and c.codes is not None:
+                return _dc.replace(c, codes=None, dict_chars=None,
+                                   dict_lens=None)
+            if isinstance(c, Column) and c.codes is not None:
+                return _dc.replace(c, codes=None, dict_values=None)
+            return c
 
-    if batch.capacity <= 1024 and not isinstance(batch.num_rows, int):
-        # small batch with a device-resident row count (aggregate
+        batch = _CB([strip(c) for c in batch.columns], batch.num_rows,
+                    batch.schema)
+
+    if not isinstance(batch.num_rows, int) \
+            and _full_fetch_bytes(batch) <= _FUSED_FETCH_BYTES:
+        # modest batch with a device-resident row count (aggregate
         # results, limits): fetch the count WITH the components in one
         # D2H round instead of syncing the count first — each round
-        # pays full link latency.  Columns are pytrees, so one
+        # pays full link latency (>=100ms tunneled), so up to the
+        # bandwidth-breakeven size, shipping the padding is cheaper
+        # than a second round trip.  Columns are pytrees, so one
         # device_get batches every leaf of every column (incl. nested).
         n_host, host_cols = jax.device_get(
             (batch.num_rows, list(batch.columns)))

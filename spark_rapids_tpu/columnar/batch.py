@@ -282,7 +282,9 @@ def _shrink_col(c: AnyColumn, new_cap: int) -> AnyColumn:
         return MapColumn(c.keys[:new_cap], c.values[:new_cap],
                          c.entry_validity[:new_cap], c.lengths[:new_cap],
                          c.validity[:new_cap], c.dtype)
-    return Column(c.data[:new_cap], c.validity[:new_cap], c.dtype)
+    return Column(c.data[:new_cap], c.validity[:new_cap], c.dtype,
+                  c.codes[:new_cap] if c.codes is not None else None,
+                  c.dict_values)
 
 
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:

@@ -88,30 +88,49 @@ class Column:
     validity: ArrayLike
     dtype: T.DataType
 
+    #: Optional dictionary sidecar, populated when the wire encoder
+    #: shipped this fixed-width column dict-encoded
+    #: (columnar/transfer.py "dict"): `codes[capacity]` (0 on
+    #: null/padding rows) indexing the device-resident
+    #: `dict_values[k]`.  Mirrors StringColumn's sidecar: the coded
+    #: group-by (ops/groupby.py) uses codes as dense group ids for
+    #: low-cardinality INTEGER/FLOAT keys, replacing the device
+    #: lexsort.  Ops that cannot cheaply preserve it drop it; it is a
+    #: hint, never a requirement.
+    codes: Optional[ArrayLike] = None
+    dict_values: Optional[ArrayLike] = None
+
     def tree_flatten(self):
-        return (self.data, self.validity), (self.dtype,)
+        return (self.data, self.validity, self.codes,
+                self.dict_values), (self.dtype,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        data, validity = children
-        return cls(data, validity, aux[0])
+        data, validity, codes, dvals = children
+        return cls(data, validity, aux[0], codes, dvals)
 
     @property
     def capacity(self) -> int:
         return int(self.data.shape[0])
 
     def with_validity(self, validity: ArrayLike) -> "Column":
-        return Column(self.data, validity, self.dtype)
+        # codes describe data, not validity: the sidecar survives
+        return Column(self.data, validity, self.dtype, self.codes,
+                      self.dict_values)
 
     def gather(self, indices: ArrayLike, index_valid: Optional[ArrayLike] = None
                ) -> "Column":
-        """Take rows by index; out-of-range/invalid indices produce NULLs."""
+        """Take rows by index; out-of-range/invalid indices produce NULLs.
+        A dictionary sidecar rides along (codes gather like data)."""
         idx = jnp.clip(indices, 0, self.capacity - 1)
         data = jnp.take(self.data, idx, axis=0)
         validity = jnp.take(self.validity, idx, axis=0)
         if index_valid is not None:
             validity = validity & index_valid
-        return Column(data, validity, self.dtype)
+        codes = None if self.codes is None \
+            else jnp.take(self.codes, idx, axis=0)
+        return Column(data, validity, self.dtype, codes,
+                      self.dict_values)
 
     @staticmethod
     def from_numpy(values: np.ndarray, dtype: T.DataType,

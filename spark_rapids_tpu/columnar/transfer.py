@@ -487,8 +487,15 @@ def _make_decode(plan: tuple):
                     base = read(extra[0])
                     vals = (vals.astype(jnp.int64) + base).astype(phys)
                 elif kind == "dict":
-                    vals = jnp.take(read(extra[0]),
-                                    vals.astype(jnp.int32), axis=0)
+                    codes = vals.astype(jnp.int32)
+                    vals = jnp.take(read(extra[0]), codes, axis=0)
+                    # codes + dictionary ride along as the column's
+                    # sidecar (grow pads dead rows with code 0): the
+                    # coded group-by uses them as dense group ids for
+                    # low-cardinality numeric keys, skipping the sort
+                    out.append((grow(vals), validity_of(vref),
+                                grow(codes), read(extra[0])))
+                    continue
                 elif kind == "scaled":
                     # same op the host exactness check performed
                     vals = vals.astype(phys) / read(extra[0])
@@ -536,6 +543,10 @@ def _wrap_cols(parts, schema: T.Schema):
             chars, lens, valid = p
             cols.append(StringColumn(chars, lens, valid))
         else:
+            if len(p) == 4:  # dict: numeric dictionary sidecar
+                data, valid, codes, dvals = p
+                cols.append(Column(data, valid, f.dtype, codes, dvals))
+                continue
             data, valid = p
             cols.append(Column(data, valid, f.dtype))
     return cols

@@ -42,6 +42,13 @@ from spark_rapids_tpu.ops.groupby import (
     reduce_aggregate,
 )
 
+#: total partial capacity the one-program fused drain (and the traced
+#: device concat) accepts.  The stack+compact inside the program is
+#: O(cap log cap) device work — trivial next to the 2-3 link round
+#: trips the fusion saves — and must admit coded-group-by partials
+#: whose capacity is the padded key domain (MAX_CODED_DOMAIN).
+_FUSED_DRAIN_CAP = 1 << 18
+
 
 def _as_device_rows(batch):
     if not isinstance(batch, ColumnarBatch):
@@ -247,13 +254,13 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.execs.jit_cache import cached_jit
 
         if (len(pending) == 1 and self.mode == "partial") \
-                or rows_hint > 4 * 4096 \
+                or rows_hint > _FUSED_DRAIN_CAP \
                 or any(isinstance(f.dtype,
                                   (T.ListType, T.StructType, T.MapType))
                        for f in self.partial_schema.fields):
             return None
         batches = [h.get() for h in pending]
-        if sum(b.capacity for b in batches) > 4 * 4096:
+        if sum(b.capacity for b in batches) > _FUSED_DRAIN_CAP:
             return None
         from spark_rapids_tpu.columnar.batch import concat_batches_traced
 
@@ -462,7 +469,7 @@ class TpuHashAggregateExec(TpuExec):
             traced = [i for i, b in enumerate(batches)
                       if not isinstance(b.num_rows, int)]
             if (traced and len(batches) > 1
-                    and sum(b.capacity for b in batches) <= 4 * 4096):
+                    and sum(b.capacity for b in batches) <= _FUSED_DRAIN_CAP):
                 # small partials: concatenate ON DEVICE (stack+compact,
                 # traced total) so the drain needs no sizing fetch at
                 # all — the query's only D2H round trip stays the final
@@ -516,8 +523,10 @@ class TpuHashAggregateExec(TpuExec):
         #: partials at or below this capacity skip the per-batch sizing
         #: sync and shrink: the drain pins all their sizes in one batched
         #: fetch instead.  Each skipped sync saves a full device_get
-        #: round trip — hundreds of ms on a degraded tunnel link.
-        DEFER_SYNC_CAP = 4096
+        #: round trip — hundreds of ms on a degraded tunnel link.  Sized
+        #: to cover coded-group-by partials (capacity = padded key
+        #: domain, up to MAX_CODED_DOMAIN).
+        DEFER_SYNC_CAP = 1 << 18
 
         pending_rows = 0
         for batch in source:

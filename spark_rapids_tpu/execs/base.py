@@ -88,6 +88,28 @@ class TpuMetric:
     def __repr__(self) -> str:
         return f"{self.name}={self.value}"
 
+    @staticmethod
+    def flush_many(metrics: "Sequence[TpuMetric]") -> None:
+        """Settle deferred device counts for MANY metrics with ONE
+        device transfer.  Per-metric flushing costs a full link round
+        trip each on tunneled backends (~100ms); a whole-tree metrics
+        snapshot must pay one."""
+        import numpy as _np
+
+        grabbed: list[tuple["TpuMetric", list]] = []
+        for m in metrics:
+            with m._lock:
+                if m._pending:
+                    grabbed.append((m, m._pending))
+                    m._pending = []
+        if not grabbed:
+            return
+        fetched = jax.device_get([p for _m, p in grabbed])
+        for (m, _p), vals in zip(grabbed, fetched):
+            s = sum(int(_np.asarray(x).sum()) for x in vals)
+            with m._lock:
+                m._value += s
+
 
 METRICS_DEVICE_SYNC = None  # registered lazily to avoid an import cycle
 

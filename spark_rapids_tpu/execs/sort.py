@@ -239,22 +239,55 @@ class TpuSortExec(_SortMixin):
                 lambda: lambda a, p: a.gather(p, n_sample))
             samples.append(jit_sample(aug, jnp.asarray(pos, jnp.int32)))
 
+        def pin_deferred() -> None:
+            """Fix up capacity-bound row counts with ONE batched fetch
+            (deferred batches must not feed sampling or bucket math
+            with padding rows counted as live)."""
+            nonlocal total
+            idxs = list(deferred)
+            if not idxs:
+                return
+            batches = [handles[i].get() for i in idxs]
+            ns = jax.device_get([b.num_rows for b in batches])
+            for i, b, nn in zip(idxs, batches, ns):
+                nn = int(nn)
+                total += nn - rows[i]
+                rows[i] = nn
+                handles[i].unpin()
+            deferred.clear()
+
         try:
             total = 0
+            deferred: list[int] = []  # handle indices with capacity-
+            # bound row counts (sizing sync skipped)
             for b in source:
                 if depth == 0:
                     aug = jit_aug(b.with_device_num_rows())
                 else:
                     aug = b  # recursive input is already augmented
-                n = aug.concrete_num_rows()
-                if n == 0:
-                    continue
-                aug = _dc.replace(aug, num_rows=n)
+                if not isinstance(aug.num_rows, int) \
+                        and total + aug.capacity <= single_rows:
+                    # defer the sizing sync: capacity bounds the rows,
+                    # and while the running total stays below the
+                    # single-batch threshold the exact count changes no
+                    # decision (the sort handles dead rows).  Each
+                    # skipped sync saves a device round trip.  Batches
+                    # kept capacity-bound never feed the sample pool.
+                    n = aug.capacity
+                else:
+                    if deferred:
+                        pin_deferred()
+                    n = aug.concrete_num_rows()
+                    if n == 0:
+                        continue
+                    aug = _dc.replace(aug, num_rows=n)
                 crossing = total <= single_rows < total + n
                 total += n
                 handles.append(store.register(
                     aug, SpillPriorities.COALESCE_PENDING))
                 rows.append(n)
+                if not isinstance(aug.num_rows, int):
+                    deferred.append(len(handles) - 1)
                 if crossing and len(handles) > 1:
                     # threshold just crossed: back-sample earlier batches
                     for h, hn in zip(handles[:-1], rows[:-1]):
@@ -267,6 +300,17 @@ class TpuSortExec(_SortMixin):
                 return
             if total <= single_rows or len(handles) == 1:
                 batches = [h.get() for h in handles]
+                if len(batches) > 1:
+                    # pin every deferred count in one batched fetch so
+                    # the host concat sizes on true rows
+                    traced = [i for i, bb in enumerate(batches)
+                              if not isinstance(bb.num_rows, int)]
+                    if traced:
+                        ns = jax.device_get(
+                            [batches[i].num_rows for i in traced])
+                        for i, nn in zip(traced, ns):
+                            batches[i] = _dc.replace(batches[i],
+                                                     num_rows=int(nn))
                 big = batches[0] if len(batches) == 1 \
                     else concat_batches(batches)
                 with MetricTimer(self.metrics[TOTAL_TIME]) as t:

@@ -242,7 +242,10 @@ class TpuWindowExec(TpuExec):
         finally:
             for h in handles:
                 h.close()
-        if big.concrete_num_rows() == 0 and self.partitioned:
+        # partitioned check first: the unpartitioned path must not pay
+        # a sizing round trip just to test emptiness (the window program
+        # handles zero live rows; empty SOURCES returned above)
+        if self.partitioned and big.concrete_num_rows() == 0:
             return  # empty reduce partition
         fn = cached_jit(self._cache_key(), lambda: self._window_batch)
         with MetricTimer(self.metrics[TOTAL_TIME]):

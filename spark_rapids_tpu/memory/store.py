@@ -142,7 +142,14 @@ def _col_leaves(c, prefix: str) -> list[tuple[str, object]]:
                 (f"{prefix}_mevalid", c.entry_validity),
                 (f"{prefix}_lengths", c.lengths),
                 (f"{prefix}_valid", c.validity)]
-    return [(f"{prefix}_data", c.data), (f"{prefix}_valid", c.validity)]
+    out = [(f"{prefix}_data", c.data), (f"{prefix}_valid", c.validity)]
+    if getattr(c, "codes", None) is not None:
+        # numeric dict sidecar spills/restores with the column (as the
+        # StringColumn sidecar does): dropping it would silently demote
+        # a restored group-by key to the lexsort path
+        out += [(f"{prefix}_codes", c.codes),
+                (f"{prefix}_dvals", c.dict_values)]
+    return out
 
 
 def _batch_to_host(batch: ColumnarBatch,
@@ -208,8 +215,12 @@ def _host_to_col(arrays: dict, prefix: str, dtype: T.DataType):
             jnp.asarray(arrays[f"{prefix}_mevalid"]),
             jnp.asarray(arrays[f"{prefix}_lengths"]),
             jnp.asarray(arrays[f"{prefix}_valid"]), dtype)
+    codes = arrays.get(f"{prefix}_codes")
     return Column(jnp.asarray(arrays[f"{prefix}_data"]),
-                  jnp.asarray(arrays[f"{prefix}_valid"]), dtype)
+                  jnp.asarray(arrays[f"{prefix}_valid"]), dtype,
+                  None if codes is None else jnp.asarray(codes),
+                  None if codes is None
+                  else jnp.asarray(arrays[f"{prefix}_dvals"]))
 
 
 def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:

@@ -86,19 +86,33 @@ def agg_output_dtype(spec: AggSpec, value_dtype: Optional[T.DataType]
 
 #: widest combined (dict ++ NULL) key domain the coded fast path takes;
 #: past this the padded segment arrays outgrow the win over sorting.
-MAX_CODED_DOMAIN = 1 << 14
+#: 2^17 keeps the segment matrix a few MB (trivial next to a
+#: multi-hundred-ms device lexsort of the input rows) while admitting
+#: e.g. a (store x item) TPC-DS grouping of ~18K combined domain.
+MAX_CODED_DOMAIN = 1 << 17
 
 
 def _coded_key_domains(key_cols: Sequence[AnyColumn]) -> Optional[list[int]]:
     """Per-key dictionary sizes when EVERY key column carries the wire
     dict sidecar (codes + device dictionary) and the combined domain is
-    small, else None.  Static decision: dict sizes are array shapes."""
+    small, else None.  Static decision: dict sizes are array shapes.
+    Both string ("sdict") and fixed-width numeric ("dict") sidecars
+    qualify."""
     ks: list[int] = []
     total = 1
     for kc in key_cols:
-        if not (isinstance(kc, StringColumn) and kc.codes is not None):
+        if getattr(kc, "codes", None) is None:
             return None
-        k = int(kc.dict_chars.shape[0])
+        if isinstance(kc, StringColumn):
+            k = int(kc.dict_chars.shape[0])
+        else:
+            if isinstance(kc.dtype, (T.FloatType, T.DoubleType)):
+                # a Parquet dictionary may hold -0.0 and 0.0 (or two
+                # NaN payloads) as distinct entries; raw codes would
+                # split groups SQL merges.  Float keys take the sort
+                # path, whose keys normalize both.
+                return None
+            k = int(kc.dict_values.shape[0])
         ks.append(k)
         total *= k + 1  # +1: the NULL group rides past the dictionary
         if total > MAX_CODED_DOMAIN:
@@ -211,15 +225,23 @@ def _coded_groupby(batch: ColumnarBatch, key_ordinals: Sequence[int],
     key_ids.reverse()
     for kc, k, kid in zip(key_cols, ks, key_ids):
         valid_g = (kid < k) & group_live
-        dchars = jnp.concatenate(
-            [kc.dict_chars,
-             jnp.zeros((1, kc.dict_chars.shape[1]), jnp.uint8)])
-        dlens = jnp.concatenate(
-            [kc.dict_lens.astype(jnp.int32), jnp.zeros((1,), jnp.int32)])
-        chars = jnp.take(dchars, kid, axis=0) \
-            * valid_g[:, None].astype(jnp.uint8)
-        lengths = jnp.take(dlens, kid) * valid_g.astype(jnp.int32)
-        out_cols.append(StringColumn(chars, lengths, valid_g))
+        if isinstance(kc, StringColumn):
+            dchars = jnp.concatenate(
+                [kc.dict_chars,
+                 jnp.zeros((1, kc.dict_chars.shape[1]), jnp.uint8)])
+            dlens = jnp.concatenate(
+                [kc.dict_lens.astype(jnp.int32),
+                 jnp.zeros((1,), jnp.int32)])
+            chars = jnp.take(dchars, kid, axis=0) \
+                * valid_g[:, None].astype(jnp.uint8)
+            lengths = jnp.take(dlens, kid) * valid_g.astype(jnp.int32)
+            out_cols.append(StringColumn(chars, lengths, valid_g))
+        else:
+            dvals = jnp.concatenate(
+                [kc.dict_values,
+                 jnp.zeros((1,), kc.dict_values.dtype)])
+            out_cols.append(Column(jnp.take(dvals, kid), valid_g,
+                                   kc.dtype))
 
     for spec, slot in zip(aggs, slots):
         if slot[0] == "star":

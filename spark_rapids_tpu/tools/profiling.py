@@ -46,9 +46,20 @@ class QueryEvent:
 
 
 def snapshot_exec(node: TpuExec) -> NodeSnapshot:
-    from spark_rapids_tpu.execs.base import _MetricReaper
+    from spark_rapids_tpu.execs.base import TpuMetric, _MetricReaper
 
     _MetricReaper.get().flush()  # settle device-synced timers
+    # settle ALL deferred device counts in one transfer: per-metric
+    # flushes would pay one link round trip each
+    mets: list = []
+
+    def gather(n: TpuExec) -> None:
+        mets.extend(n.metrics.values())
+        for c in n.children:
+            gather(c)
+
+    gather(node)
+    TpuMetric.flush_many(mets)
     return _snap(node)
 
 
