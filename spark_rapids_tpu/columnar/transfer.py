@@ -288,6 +288,13 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
                 kind = "bias"
                 extra = (comps.add(np.asarray(mn, np.int64)),)
                 vals = enc16(vals, mn)
+            elif phys.itemsize > 4 and rng <= 0xFFFFFFFF:
+                # 64-bit ints with a 32-bit range (join/order keys)
+                # halve the dominant upload; base + zero-extended u32
+                # round-trips exactly (vals-mn <= rng, no overflow)
+                kind = "bias"
+                extra = (comps.add(np.asarray(mn, np.int64)),)
+                vals = (vals - mn).astype(np.uint32)
         elif phys.kind == "f":
             enc = _try_dict(vals)
             if enc is not None:

@@ -241,3 +241,34 @@ def test_empty_and_single_row():
     t1 = pa.table({"x": pa.array([42], pa.int64()),
                    "s": pa.array(["hi"], pa.string())})
     assert_tables_equal(roundtrip(t1), t1)
+
+
+def test_bias32_wire_for_wide_range_i64():
+    """int64 with a 32-bit (but not 16-bit) value range ships as u32
+    bias — half the raw bytes — and round-trips bit-exactly, including
+    a base near INT64_MIN."""
+    from spark_rapids_tpu.columnar.arrow import schema_from_arrow
+
+    rng = np.random.default_rng(11)
+    n = 4096
+    lo = np.iinfo(np.int64).min
+    t = pa.table({
+        "orderkey": pa.array(
+            7_000_000_000 + rng.integers(0, 1 << 31, n), pa.int64()),
+        "deep_neg": pa.array(
+            lo + rng.integers(0, (1 << 32) - 1, n).astype(np.uint64)
+            .astype(np.int64), pa.int64()),
+    })
+    arrays = [c.combine_chunks() for c in t.combine_chunks().columns]
+    enc = transfer.encode_for_device(arrays, schema_from_arrow(t.schema),
+                                     t.num_rows)
+    assert enc is not None
+    comps, plan = enc
+    fixed = [e for e in plan[3] if e[0] == "fixed"]
+    assert [e[1] for e in fixed] == ["bias", "bias"]
+    for e in fixed:
+        assert e[3] == "int64"  # decode target stays 64-bit
+    # the data components are uint32 on the wire
+    data_comps = [a for a in comps if a.dtype == np.uint32]
+    assert len(data_comps) == 2
+    assert_tables_equal(roundtrip(t), t)
