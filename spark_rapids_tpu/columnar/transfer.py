@@ -272,6 +272,14 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
             entries.append(_encode_string(comps, arr, wire))
             continue
         vals, validity = _decode_fixed_host(arr, f.dtype)
+        if validity is not None and not validity.any():
+            # all-NULL column: nothing crosses the wire at all (real
+            # all-null data, and the scan's filter-only column
+            # suppression which nulls columns no operator above the
+            # elided filter reads)
+            entries.append(("fixed", "null", -1, str(vals.dtype), (),
+                            None))
+            continue
         vref = None
         if validity is not None:
             vref = comps.add(_padded(validity, wire))
@@ -489,6 +497,10 @@ def _make_decode(plan: tuple):
             if e[0] == "fixed":
                 _, kind, dref, physdt, extra, vref = e
                 phys = np.dtype(physdt)
+                if kind == "null":
+                    out.append((jnp.zeros((cap,), phys),
+                                jnp.zeros((cap,), jnp.bool_)))
+                    continue
                 vals = read(dref)
                 if kind == "bias":
                     base = read(extra[0])

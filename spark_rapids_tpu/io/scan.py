@@ -514,7 +514,9 @@ class ParquetScanExec(TpuExec):
         prefiltering and ships everything; the device Filter is always
         the source of truth."""
         if not getattr(self, "_prefilter_on", False) or tbl.num_rows == 0:
-            return tbl
+            # suppression must still run (accumulated tables are
+            # concatenated and need one consistent schema)
+            return self._suppress_upload_cols(tbl)
         try:
             import pyarrow.compute as pc
 
@@ -541,7 +543,26 @@ class ParquetScanExec(TpuExec):
             self._prefilter_on = False  # unsupported expr: stop trying
             return tbl
         self.metrics["hostFilteredRows"].add(tbl.num_rows - kept.num_rows)
-        return kept
+        return self._suppress_upload_cols(kept)
+
+    def _suppress_upload_cols(self, tbl: pa.Table) -> pa.Table:
+        """Replace filter-only columns with all-NULL arrays AFTER the
+        host prefilter consumed their values: the planner proved no
+        operator above the elided Filter reads them, and the wire
+        encoder ships an all-null column as zero bytes (kind 'null').
+        Schema and ordinals stay intact, so bound references above are
+        unaffected."""
+        cols = getattr(self, "null_upload_cols", None)
+        if not cols:
+            return tbl
+        for i, name in enumerate(tbl.schema.names):
+            if name in cols:
+                ft = tbl.schema.field(i).type
+                if pa.types.is_dictionary(ft):
+                    ft = ft.value_type
+                tbl = tbl.set_column(i, pa.field(name, ft),
+                                     pa.nulls(tbl.num_rows, ft))
+        return tbl
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         """Accumulates decoded host tables ACROSS row groups and files

@@ -525,6 +525,17 @@ def convert_meta(meta: PlanMeta) -> TpuExec:
             # a per-program-cost link that is a whole program execution
             # per batch for nothing
             kids[0].exact_prefilter = True
+            refs = getattr(p, "upload_refs", None)
+            if refs is not None:
+                # columns referenced ONLY by the (now host-applied)
+                # condition ship as zero-byte all-NULL placeholders
+                scan = kids[0]
+                keep = {scan.schema.fields[i].name for i in refs}
+                part = {f.name for f in getattr(
+                    scan, "partition_fields", [])}
+                drop = {f.name for f in scan.schema.fields} - keep - part
+                if drop:
+                    scan.null_upload_cols = drop
             return kids[0]
         return TpuFilterExec(p.condition, kids[0])
     if isinstance(p, L.Expand):
@@ -695,6 +706,112 @@ def _walk_expr(e):
     yield e
     for c in getattr(e, "children", ()):
         yield from _walk_expr(c)
+
+
+def _annotate_filter_upload(root: L.LogicalPlan) -> None:
+    """Column-pruning-through-Filter analysis (the interplay of Spark's
+    ColumnPruning and PushDownPredicates): for every Filter sitting
+    directly on a file relation, record which relation ordinals any
+    operator ABOVE the filter reads.  If the device filter is later
+    elided (exact host prefilter), columns referenced ONLY by the
+    filter condition need not cross the host->device wire at all —
+    the scan ships them as zero-byte all-NULL placeholders, keeping
+    the schema (and every bound ordinal above) intact.
+
+    Conservative by construction: the walk ends at the nearest
+    'bounding' ancestor whose output drops the relation's columns
+    (Project/Aggregate/semi-anti-join's dropped side); any node kind
+    outside the modeled set, or reaching the root with the columns
+    still in the output, yields no annotation (upload everything)."""
+    from spark_rapids_tpu.exprs import aggregates as AG
+    from spark_rapids_tpu.plan.logical import OrcRelation, ParquetRelation
+
+    def collect(e, pos: int, n: int, req: set) -> None:
+        for x in _walk_expr(e):
+            if isinstance(x, B.BoundReference) \
+                    and pos <= x.ordinal < pos + n:
+                req.add(x.ordinal - pos)
+            elif isinstance(x, AG.AggregateFunction):
+                for c in x.inputs():
+                    collect(c, pos, n, req)
+
+    def required_above(path: list, f: L.Filter):
+        """`path` is [(ancestor, child_slot), ...] from root to the
+        filter's parent; slots disambiguate self-joins where both
+        children are the same object."""
+        n = len(f.schema.fields)
+        pos = 0
+        req: set = set()
+        for anc, ci in reversed(path):
+            if isinstance(anc, L.Filter):
+                collect(anc.condition, pos, n, req)
+            elif isinstance(anc, L.Sort):
+                for k in anc.keys:
+                    collect(k.expr, pos, n, req)
+            elif isinstance(anc, L.Limit):
+                pass
+            elif isinstance(anc, L.Project):
+                for e in anc.exprs:
+                    collect(e, pos, n, req)
+                return req  # bounding: output drops pass-through cols
+            elif isinstance(anc, L.Aggregate):
+                for g in anc.groups:
+                    collect(g, pos, n, req)
+                for na in anc.aggs:
+                    for e in na.fn.inputs():
+                        collect(e, pos, n, req)
+                return req  # bounding
+            elif isinstance(anc, L.Window):
+                for we, _name in anc.window_exprs:
+                    for e in we.children:
+                        collect(e, pos, n, req)
+                # output = child ++ window cols: position unchanged
+            elif isinstance(anc, L.Join):
+                n_left = len(anc.children[0].schema.fields)
+                if ci == 0:
+                    for k in anc.left_keys:
+                        collect(k, pos, n, req)
+                    if anc.condition is not None:
+                        collect(anc.condition, pos, n, req)
+                    # output keeps the left side first (or alone, for
+                    # semi/anti): position unchanged
+                else:
+                    for k in anc.right_keys:
+                        collect(k, pos, n, req)
+                    if anc.condition is not None:
+                        collect(anc.condition, pos + n_left, n, req)
+                    if anc.join_type in ("left_semi", "left_anti"):
+                        # the right side never reaches the output (the
+                        # condition above still reads it)
+                        return req
+                    pos += n_left
+            else:
+                return None  # unmodeled shape: no pruning
+        return None  # columns reach the final output
+
+    # plans are DAGs (DataFrame reuse, self-joins): gather EVERY path
+    # to each filter-over-relation and union the requirements — a
+    # column any consumer path reads must upload
+    targets: dict[int, tuple[L.Filter, list]] = {}
+    budget = [4096]  # visit cap: degenerate shared DAGs bail out
+
+    def visit(node: L.LogicalPlan, path: list) -> None:
+        budget[0] -= 1
+        if budget[0] < 0:
+            return
+        for i, c in enumerate(node.children):
+            visit(c, path + [(node, i)])
+        if isinstance(node, L.Filter) and isinstance(
+                node.children[0], (ParquetRelation, OrcRelation)):
+            targets.setdefault(id(node), (node, []))[1].append(path)
+
+    visit(root, [])
+    if budget[0] < 0:
+        return
+    for node, paths in targets.values():
+        reqs = [required_above(p, node) for p in paths]
+        node.upload_refs = (None if any(r is None for r in reqs)
+                            else set().union(*reqs))
 
 
 def _maybe_push_filter(p: L.LogicalPlan, kids: list[TpuExec]) -> None:
@@ -1220,6 +1337,7 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
     plan = _rewrite_split_extracts(plan)
     plan = _rewrite_input_file_exprs(plan)
     plan = _rewrite_scalar_subqueries(plan, conf)
+    _annotate_filter_upload(plan)
     meta = PlanMeta(plan, conf)
     if conf.get(SQL_ENABLED):
         meta.tag()

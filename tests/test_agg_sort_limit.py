@@ -277,6 +277,78 @@ def test_elided_device_filter_still_exact(session, tmp_path):
     assert abs(a["s"][0] - b["s"][0]) <= 1e-9 * max(1, abs(b["s"][0]))
 
 
+def test_filter_only_columns_skip_upload(session, tmp_path):
+    """With the device filter elided, columns referenced ONLY by the
+    filter condition ship as zero-byte all-NULL placeholders; columns
+    the query reads above the filter are untouched and results match
+    the oracle."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.io.scan import ParquetScanExec
+    from spark_rapids_tpu.plan.planner import plan_query
+    from spark_rapids_tpu.session import col, sum_
+
+    rng = np.random.default_rng(8)
+    nn = 6000
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 7, nn),
+        "flt": rng.integers(0, 100, nn),
+        "both": rng.integers(0, 50, nn),
+        "v": rng.normal(size=nn)}), p)
+    # flt is filter-only; both is filter AND aggregate input
+    df = (session.read_parquet(p)
+          .where((col("flt") < lit(70)) & (col("both") >= lit(5)))
+          .group_by(col("k"))
+          .agg((sum_(col("v")), "s"), (sum_(col("both")), "b")))
+    exec_, _ = plan_query(df._plan)
+    scans = [e for e in exec_._walk() if isinstance(e, ParquetScanExec)]
+    exec_.close()
+    assert scans and getattr(scans[0], "null_upload_cols", None) == \
+        {"flt"}, getattr(scans[0], "null_upload_cols", None)
+    a = sorted(zip(*df.collect(engine="tpu").to_pydict().values()))
+    b = sorted(zip(*df.collect(engine="cpu").to_pydict().values()))
+    assert len(a) == len(b) == 7
+    for x, y in zip(a, b):
+        assert x[0] == y[0] and x[2] == y[2]
+        assert abs(x[1] - y[1]) <= 1e-9 * max(1, abs(y[1]))
+    # when the filter column IS selected it is NOT suppressed (it must
+    # cross the wire for the group keys); unreferenced columns are
+    df2 = (session.read_parquet(p).where(col("flt") < lit(70))
+           .group_by(col("flt")).agg((sum_(col("v")), "s")))
+    exec2, _ = plan_query(df2._plan)
+    scans2 = [e for e in exec2._walk()
+              if isinstance(e, ParquetScanExec)]
+    exec2.close()
+    assert getattr(scans2[0], "null_upload_cols", None) == {"k", "both"}
+    a2 = sorted(zip(*df2.collect(engine="tpu").to_pydict().values()))
+    b2 = sorted(zip(*df2.collect(engine="cpu").to_pydict().values()))
+    assert [r[0] for r in a2] == [r[0] for r in b2]
+    for x, y in zip(a2, b2):  # the KEPT aggregate column stays real
+        assert y[1] is not None
+        assert abs(x[1] - y[1]) <= 1e-9 * max(1, abs(y[1])), (x, y)
+
+    # DAG reuse: one filtered frame consumed by two branches — the
+    # union of both branches' needs uploads (a per-path overwrite
+    # would null v for the left branch and return NULL sums)
+    dfF = session.read_parquet(p).where(col("flt") < lit(70))
+    left = dfF.group_by(col("k")).agg((sum_(col("v")), "sv"))
+    right = dfF.group_by(col("k")).agg((sum_(col("both")), "sb"))
+    dj = left.join(right, left_on=[col("k")], right_on=[col("k")])
+    aj = sorted(zip(*[dj.collect(engine="tpu").column(i).to_pylist()
+                      for i in (0, 1, 3)]))
+    bj = sorted(zip(*[dj.collect(engine="cpu").column(i).to_pylist()
+                      for i in (0, 1, 3)]))
+    assert len(aj) == len(bj) == 7
+    for x, y in zip(aj, bj):
+        assert y[1] is not None and y[2] is not None
+        assert abs(x[1] - y[1]) <= 1e-9 * max(1, abs(y[1])), (x, y)
+        assert abs(x[2] - y[2]) <= 1e-9 * max(1, abs(y[2])), (x, y)
+
+
 def test_topn_null_flood_hierarchical(session):
     """Degenerate top-n shape: a mostly-NULL nulls-first key keeps
     every null row as a candidate; the hierarchical reduction must
