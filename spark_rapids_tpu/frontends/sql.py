@@ -885,6 +885,57 @@ def _conjuncts(e) -> list:
     return [e]
 
 
+def _disjuncts(e) -> list:
+    if isinstance(e, P.Or):
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _factor_common_conjuncts(e):
+    """(A ∧ X ∧ ...) ∨ (A ∧ Y ∧ ...) -> A ∧ (X... ∨ Y...): Spark's
+    common-conjunct extraction from disjunctive predicates (the rewrite
+    that surfaces TPC-H q19's join condition out of its OR blocks)."""
+    from functools import reduce
+
+    from spark_rapids_tpu.execs.jit_cache import expr_key
+
+    if not isinstance(e, P.Or):
+        return e
+    branches = [_conjuncts(b) for b in _disjuncts(e)]
+    try:
+        common = set.intersection(
+            *({expr_key(c) for c in cs} for cs in branches))
+    except TypeError:
+        # a branch holds an unkeyable marker (e.g. an IN-subquery
+        # inside OR): skip factoring; downstream checks report it
+        return e
+    if not common:
+        return e
+    kept = []
+    seen0: set = set()
+    for c in branches[0]:
+        k = expr_key(c)
+        if k in common and k not in seen0:
+            seen0.add(k)
+            kept.append(c)
+    residues = []
+    for cs in branches:
+        seen: set = set()
+        res = []
+        for c in cs:
+            k = expr_key(c)
+            if k in common and k not in seen:
+                seen.add(k)
+                continue
+            res.append(c)
+        residues.append(_and_all(res))
+    if any(r is None for r in residues):
+        # some branch was ENTIRELY common conjuncts: the residue
+        # disjunction is a tautology
+        return _and_all(kept)
+    return _and_all(kept + [reduce(P.Or, residues)])
+
+
 def _and_all(es: Sequence):
     out = None
     for e in es:
@@ -965,6 +1016,9 @@ class SqlSession:
         self._strip_qualifiers(q)
         self._resolve_scalar_subqueries(q)
 
+        if q["where"] is not None:
+            q["where"] = _and_all([_factor_common_conjuncts(c)
+                                   for c in _conjuncts(q["where"])])
         where_conjs = _conjuncts(q["where"]) if q["where"] is not None \
             else []
         # `x IN (SELECT ...)` conjuncts become LEFT SEMI joins applied

@@ -511,3 +511,204 @@ def test_not_in_subquery_rejected(tpch):
     with pytest.raises(SqlError, match="NOT IN"):
         tpch.sql("select l_orderkey from lineitem where l_orderkey "
                  "not in (select o_orderkey from orders)")
+
+
+# -- more verbatim TPC-H texts (multi-table joins, IN lists, CASE) ---- #
+
+@pytest.fixture(scope="module")
+def tpch_full():
+    """Schema-subset synthetic TPC-H catalog for q5/q10/q12/q14/q19."""
+    rng = np.random.default_rng(22)
+    n_li = 12_000
+    n_ord = 2500
+    n_cust = 400
+    n_supp = 50
+    n_part = 300
+    fe = SqlSession()
+    nations = ["ALGERIA", "BRAZIL", "CANADA", "EGYPT", "FRANCE"]
+    fe.register_table("region", pa.table({
+        "r_regionkey": np.arange(3),
+        "r_name": pa.array(["ASIA", "AMERICA", "AFRICA"]),
+    }))
+    fe.register_table("nation", pa.table({
+        "n_nationkey": np.arange(5),
+        "n_name": pa.array(nations),
+        "n_regionkey": rng.integers(0, 3, 5),
+    }))
+    fe.register_table("customer", pa.table({
+        "c_custkey": np.arange(n_cust),
+        "c_name": pa.array([f"Customer#{i:09d}" for i in range(n_cust)]),
+        "c_address": pa.array([f"addr{i}" for i in range(n_cust)]),
+        "c_nationkey": rng.integers(0, 5, n_cust),
+        "c_phone": pa.array([f"{rng.integers(10,35)}-555-{i:04d}"
+                             for i in range(n_cust)]),
+        "c_acctbal": np.round(rng.uniform(-999, 9999, n_cust), 2),
+        "c_comment": pa.array([f"comment {i}" for i in range(n_cust)]),
+    }))
+    fe.register_table("supplier", pa.table({
+        "s_suppkey": np.arange(n_supp),
+        "s_nationkey": rng.integers(0, 5, n_supp),
+    }))
+    fe.register_table("part", pa.table({
+        "p_partkey": np.arange(n_part),
+        "p_type": pa.array(np.array(
+            ["PROMO BRUSHED", "STANDARD POLISHED", "ECONOMY BURNISHED"]
+        )[rng.integers(0, 3, n_part)]),
+        "p_brand": pa.array(np.array(
+            ["Brand#12", "Brand#23", "Brand#34"])[
+                rng.integers(0, 3, n_part)]),
+        "p_container": pa.array(np.array(
+            ["SM CASE", "SM BOX", "MED BAG", "MED BOX", "LG CASE",
+             "LG BOX"])[rng.integers(0, 6, n_part)]),
+        "p_size": rng.integers(1, 16, n_part),
+    }))
+    fe.register_table("orders", pa.table({
+        "o_orderkey": np.arange(n_ord),
+        "o_custkey": rng.integers(0, n_cust, n_ord),
+        "o_orderdate": pa.array(
+            rng.integers(8766, 10957, n_ord).astype(np.int32),
+            type=pa.date32()),
+        "o_orderpriority": pa.array(np.array(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM"])[
+                rng.integers(0, 3, n_ord)]),
+    }))
+    fe.register_table("lineitem", pa.table({
+        "l_orderkey": rng.integers(0, n_ord, n_li),
+        "l_partkey": rng.integers(0, n_part, n_li),
+        "l_suppkey": rng.integers(0, n_supp, n_li),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900, 105000, n_li), 2),
+        "l_discount": rng.integers(0, 11, n_li) / 100.0,
+        "l_returnflag": pa.array(np.array(["A", "N", "R"])[
+            rng.integers(0, 3, n_li)]),
+        "l_shipdate": pa.array(rng.integers(8766, 10957, n_li)
+                               .astype(np.int32), type=pa.date32()),
+        "l_commitdate": pa.array(rng.integers(8766, 10957, n_li)
+                                 .astype(np.int32), type=pa.date32()),
+        "l_receiptdate": pa.array(rng.integers(8766, 10957, n_li)
+                                  .astype(np.int32), type=pa.date32()),
+        "l_shipmode": pa.array(np.array(
+            ["MAIL", "SHIP", "AIR", "TRUCK"])[
+                rng.integers(0, 4, n_li)]),
+        "l_shipinstruct": pa.array(np.array(
+            ["DELIVER IN PERSON", "COLLECT COD", "NONE"])[
+                rng.integers(0, 3, n_li)]),
+    }))
+    return fe
+
+
+def test_tpch_q5_text(tpch_full):
+    """q5 verbatim: 6-table join chain with a region filter."""
+    _diff(tpch_full.sql("""
+select
+    n_name,
+    sum(l_extendedprice * (1 - l_discount)) as revenue
+from
+    customer, orders, lineitem, supplier, nation, region
+where
+    c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and l_suppkey = s_suppkey
+    and c_nationkey = s_nationkey
+    and s_nationkey = n_nationkey
+    and n_regionkey = r_regionkey
+    and r_name = 'AMERICA'
+    and o_orderdate >= date '1994-01-01'
+    and o_orderdate < date '1994-01-01' + interval '1' year
+group by n_name
+order by revenue desc
+"""))
+
+
+def test_tpch_q10_text(tpch_full):
+    """q10 verbatim: returned-item revenue per customer, top 20."""
+    _diff(tpch_full.sql("""
+select
+    c_custkey, c_name,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    c_acctbal, n_name, c_address, c_phone, c_comment
+from customer, orders, lineitem, nation
+where
+    c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and o_orderdate >= date '1993-10-01'
+    and o_orderdate < date '1993-10-01' + interval '3' month
+    and l_returnflag = 'R'
+    and c_nationkey = n_nationkey
+group by c_custkey, c_name, c_acctbal, c_phone, n_name,
+         c_address, c_comment
+order by revenue desc, c_custkey
+limit 20
+""", ), ordered=True)
+
+
+def test_tpch_q12_text(tpch_full):
+    """q12 verbatim: IN list + multi-date comparisons + CASE counts."""
+    _diff(tpch_full.sql("""
+select
+    l_shipmode,
+    sum(case when o_orderpriority = '1-URGENT'
+              or o_orderpriority = '2-HIGH'
+         then 1 else 0 end) as high_line_count,
+    sum(case when o_orderpriority <> '1-URGENT'
+              and o_orderpriority <> '2-HIGH'
+         then 1 else 0 end) as low_line_count
+from orders, lineitem
+where
+    o_orderkey = l_orderkey
+    and l_shipmode in ('MAIL', 'SHIP')
+    and l_commitdate < l_receiptdate
+    and l_shipdate < l_commitdate
+    and l_receiptdate >= date '1994-01-01'
+    and l_receiptdate < date '1994-01-01' + interval '1' year
+group by l_shipmode
+order by l_shipmode
+"""), ordered=True)
+
+
+def test_tpch_q14_text(tpch_full):
+    """q14 verbatim: promo revenue ratio (CASE inside the aggregate,
+    post-aggregate arithmetic)."""
+    rows = _diff(tpch_full.sql("""
+select
+    100.00 * sum(case when p_type like 'PROMO%'
+                  then l_extendedprice * (1 - l_discount)
+                  else 0 end)
+        / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+from lineitem, part
+where
+    l_partkey = p_partkey
+    and l_shipdate >= date '1995-09-01'
+    and l_shipdate < date '1995-09-01' + interval '1' month
+"""), expect_rows=1)
+    assert 0 < rows[0][0] < 100
+
+
+def test_tpch_q19_text(tpch_full):
+    """q19 verbatim: disjunction of conjunctive blocks with IN lists
+    and BETWEEN over two tables."""
+    _diff(tpch_full.sql("""
+select
+    sum(l_extendedprice * (1 - l_discount)) as revenue
+from lineitem, part
+where
+    (
+        p_partkey = l_partkey
+        and p_brand = 'Brand#12'
+        and p_container in ('SM CASE', 'SM BOX')
+        and l_quantity >= 1 and l_quantity <= 1 + 10
+        and p_size between 1 and 5
+        and l_shipmode in ('AIR', 'TRUCK')
+        and l_shipinstruct = 'DELIVER IN PERSON'
+    )
+    or
+    (
+        p_partkey = l_partkey
+        and p_brand = 'Brand#23'
+        and p_container in ('MED BAG', 'MED BOX')
+        and l_quantity >= 10 and l_quantity <= 10 + 10
+        and p_size between 1 and 10
+        and l_shipmode in ('AIR', 'TRUCK')
+        and l_shipinstruct = 'DELIVER IN PERSON'
+    )
+"""), expect_rows=1)
