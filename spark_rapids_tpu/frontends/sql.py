@@ -124,6 +124,29 @@ class _SubqueryExpr(B.Expression):
         return ()
 
 
+class _ExistsSubquery(B.Expression):
+    """Parse-time marker for [NOT] EXISTS (SELECT ... WHERE
+    outer.col = inner.col ...); lowered to a LEFT SEMI / LEFT ANTI
+    join on the correlated equality conjuncts (Spark's
+    RewritePredicateSubquery)."""
+
+    def __init__(self, q: dict, negated: bool):
+        self.q = q
+        self.negated = negated
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def name(self) -> str:
+        return "exists_subquery"
+
+    @property
+    def children(self):
+        return ()
+
+
 class _InSubquery(B.Expression):
     """Parse-time marker for `expr IN (SELECT ...)`; lowered to a
     LEFT SEMI join (Spark's RewritePredicateSubquery)."""
@@ -457,9 +480,23 @@ class _Parser:
         return e
 
     def not_expr(self):
+        if self.at("not") and self.kw(1) == "exists":
+            self.i += 2
+            return self._exists(negated=True)
         if self.accept("not"):
             return P.Not(self.not_expr())
+        if self.accept("exists"):
+            return self._exists(negated=False)
         return self.cmp_expr()
+
+    def _exists(self, negated: bool):
+        self.expect_op("(")
+        if self.kw() != "select":
+            raise SqlError(f"expected SELECT after EXISTS at "
+                           f"{self.peek()[2]}")
+        subq = self.parse_select(sub=True)
+        self.expect_op(")")
+        return _ExistsSubquery(subq, negated)
 
     def cmp_expr(self):
         e = self.add_expr()
@@ -1021,16 +1058,21 @@ class SqlSession:
                                    for c in _conjuncts(q["where"])])
         where_conjs = _conjuncts(q["where"]) if q["where"] is not None \
             else []
-        # `x IN (SELECT ...)` conjuncts become LEFT SEMI joins applied
-        # after the FROM joins (Spark's RewritePredicateSubquery)
+        # `x IN (SELECT ...)` and [NOT] EXISTS conjuncts become LEFT
+        # SEMI / LEFT ANTI joins applied after the FROM joins (Spark's
+        # RewritePredicateSubquery)
         in_subs = [cj for cj in where_conjs
                    if isinstance(cj, _InSubquery)]
+        exists_subs = [cj for cj in where_conjs
+                       if isinstance(cj, _ExistsSubquery)]
         where_conjs = [cj for cj in where_conjs
-                       if not isinstance(cj, _InSubquery)]
+                       if not isinstance(cj, (_InSubquery,
+                                              _ExistsSubquery))]
         for cj in where_conjs:
-            if any(isinstance(x, _InSubquery) for x in _walk(cj)):
-                raise SqlError("IN (subquery) is only supported as a "
-                               "top-level AND condition")
+            if any(isinstance(x, (_InSubquery, _ExistsSubquery))
+                   for x in _walk(cj)):
+                raise SqlError("IN/EXISTS (subquery) is only supported "
+                               "as a top-level AND condition")
         joins = q["joins"]
 
         # push single-table conjuncts down to their frame (the textbook
@@ -1108,7 +1150,83 @@ class SqlSession:
             acc_df = acc_df.join(sub, left_on=[isq.lhs],
                                  right_on=[rcol], how="left_semi")
 
+        for ex in exists_subs:
+            acc_df = self._lower_exists(acc_df, acc_cols, ex)
+
         return self._project(q, acc_df)
+
+    def _lower_exists(self, acc_df, acc_cols: set, ex: "_ExistsSubquery"):
+        """[NOT] EXISTS with equality correlation -> LEFT SEMI/ANTI
+        join: correlated equality conjuncts in the subquery's WHERE
+        become join keys; everything else must be inner-only and stays
+        the subquery's filter."""
+        q = ex.q
+        if q.get("unions"):
+            raise SqlError("EXISTS over UNION is not supported")
+        if q["group_by"] or q["having"] is not None or any(
+                it != "*" and _has_agg(it) for it, _a in q["items"]):
+            # an ungrouped aggregate subquery always returns one row
+            # (EXISTS trivially true) and a grouped one filters on
+            # group existence — neither maps to the plain semi join
+            # this rewrite produces
+            raise SqlError("EXISTS over an aggregating subquery is "
+                           "not supported")
+        inner_cols: set = set()
+        refs = [q["tables"][0]] + [j[1] for j in q["joins"]]
+        resolved: list[tuple] = []  # table refs for q2, derived tables
+        # pre-lowered ONCE (("__df__", df) entries)
+        for name, alias in refs:
+            if isinstance(name, tuple) and name[0] == "__sub__":
+                df = self._lower(name[1])
+                inner_cols |= {f.name.lower() for f in df.schema.fields}
+                resolved.append((("__df__", df), alias))
+            else:
+                inner_cols |= {f.name.lower()
+                               for f in self.table(name).schema.fields}
+                resolved.append((name, alias))
+
+        def colname(e):
+            if isinstance(e, (B.ColumnReference, _QualifiedRef)):
+                return e.col_name.lower()
+            return None
+
+        outer_keys, inner_keys, keep = [], [], []
+        for cj in (_conjuncts(q["where"])
+                   if q["where"] is not None else []):
+            sides = None
+            if isinstance(cj, P.EqualTo):
+                an, bn = colname(cj.left), colname(cj.right)
+                if an is not None and bn is not None:
+                    if an in inner_cols and bn not in inner_cols \
+                            and bn in acc_cols:
+                        sides = (bn, an)
+                    elif bn in inner_cols and an not in inner_cols \
+                            and an in acc_cols:
+                        sides = (an, bn)
+            if sides is not None:
+                outer_keys.append(B.ColumnReference(sides[0]))
+                inner_keys.append(B.ColumnReference(sides[1]))
+                continue
+            for x in _walk(cj):
+                n = colname(x)
+                if n is not None and n not in inner_cols:
+                    raise SqlError(
+                        f"EXISTS correlation on {n!r} must be a plain "
+                        "equality conjunct (non-equality correlated "
+                        "predicates are not supported)")
+            keep.append(cj)
+        if not outer_keys:
+            raise SqlError("EXISTS subquery must correlate with the "
+                           "outer query through at least one equality")
+        q2 = dict(q, where=_and_all(keep),
+                  items=[(B.ColumnReference(n), None)
+                         for n in dict.fromkeys(
+                             k.col_name for k in inner_keys)],
+                  distinct=False, order_by=[], limit=None)
+        sub = self._lower(q2)
+        how = "left_anti" if ex.negated else "left_semi"
+        return acc_df.join(sub, left_on=outer_keys,
+                           right_on=inner_keys, how=how)
 
     def _resolve_scalar_subqueries(self, q: dict) -> None:
         """Replace scalar-subquery markers with the engine's
@@ -1126,6 +1244,8 @@ class SqlSession:
                 return ScalarSubquery(sub._plan)
             if isinstance(e, _InSubquery):
                 return _InSubquery(rw(e.lhs), e.q)
+            if isinstance(e, _ExistsSubquery):
+                return e
             if isinstance(e, AG.AggregateFunction):
                 if _dcs.is_dataclass(e) and e.child is not None:
                     nc = rw(e.child)
@@ -1224,7 +1344,7 @@ class SqlSession:
                 return B.ColumnReference(e.col_name)
             if isinstance(e, _InSubquery):
                 return _InSubquery(rw(e.lhs), e.q)
-            if isinstance(e, _SubqueryExpr):
+            if isinstance(e, (_SubqueryExpr, _ExistsSubquery)):
                 return e
             if not _dcs.is_dataclass(e):
                 return e

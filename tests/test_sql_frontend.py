@@ -712,3 +712,51 @@ where
         and l_shipinstruct = 'DELIVER IN PERSON'
     )
 """), expect_rows=1)
+
+
+TPCH_Q4 = """
+select
+    o_orderpriority,
+    count(*) as order_count
+from
+    orders
+where
+    o_orderdate >= date '1993-07-01'
+    and o_orderdate < date '1993-07-01' + interval '3' month
+    and exists (
+        select * from lineitem
+        where l_orderkey = o_orderkey
+          and l_commitdate < l_receiptdate)
+group by o_orderpriority
+order by o_orderpriority
+"""
+
+
+def test_tpch_q4_text(tpch_full):
+    """q4 verbatim: correlated EXISTS -> semi join."""
+    rows = _diff(tpch_full.sql(TPCH_Q4), ordered=True)
+    assert [r[0] for r in rows] == sorted(r[0] for r in rows)
+
+
+def test_not_exists_anti_join(tpch_full):
+    """NOT EXISTS keeps exactly the orders with no qualifying line."""
+    both = _diff(tpch_full.sql("""
+        select count(*) n from orders
+        where exists (select * from lineitem
+                      where l_orderkey = o_orderkey)"""))
+    none = _diff(tpch_full.sql("""
+        select count(*) n from orders
+        where not exists (select * from lineitem
+                          where l_orderkey = o_orderkey)"""))
+    total = _diff(tpch_full.sql("select count(*) n from orders"))
+    assert both[0][0] + none[0][0] == total[0][0]
+
+
+def test_exists_errors(tpch_full):
+    with pytest.raises(SqlError, match="correlate"):
+        tpch_full.sql("select count(*) n from orders where exists "
+                      "(select * from lineitem where l_quantity > 1)")
+    with pytest.raises(SqlError, match="equality conjunct"):
+        tpch_full.sql(
+            "select count(*) n from orders where exists "
+            "(select * from lineitem where l_orderkey < o_orderkey)")
