@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# tpulint gate: static analysis over engine source, registries, and the
+# live planner's plan corpus.  Mirrors
+# tests/test_lint.py::test_repo_is_clean_or_baselined (the tier-1 hook);
+# run it standalone for fast pre-commit feedback.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m spark_rapids_tpu.tools.lint --strict "$@"

@@ -441,6 +441,34 @@ def _full_fetch_bytes(batch: ColumnarBatch) -> int:
     return total
 
 
+def _strip_dict_sidecar(batch: ColumnarBatch) -> ColumnarBatch:
+    """Drop dictionary sidecars before D2H: the host rebuild reads only
+    chars/lengths/validity, so the codes (full capacity) must never
+    cross the link.  dict_len goes with them — it is jit-cache-keying
+    aux (tree_flatten), and leaving it set on a column whose dictionary
+    was just dropped would fragment the shrink/fetch program cache by
+    the deleted dictionary's cardinality bucket."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
+
+    import dataclasses as _dc
+
+    if not any(getattr(c, "codes", None) is not None
+               for c in batch.columns):
+        return batch
+
+    def strip(c):
+        if isinstance(c, StringColumn) and c.codes is not None:
+            return _dc.replace(c, codes=None, dict_chars=None,
+                               dict_lens=None, dict_len=None)
+        if isinstance(c, Column) and c.codes is not None:
+            return _dc.replace(c, codes=None, dict_values=None,
+                               dict_len=None)
+        return c
+
+    return _CB([strip(c) for c in batch.columns], batch.num_rows,
+               batch.schema)
+
+
 def to_arrow(batch: ColumnarBatch) -> pa.Table:
     """Device ColumnarBatch -> host Arrow table (the D2H download).
 
@@ -452,21 +480,7 @@ def to_arrow(batch: ColumnarBatch) -> pa.Table:
     million-row capacity bucket is a 1-row transfer, not a 100MB one)."""
     from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
 
-    # the host rebuild reads only chars/lengths/validity: drop the dict
-    # sidecar so its codes (full capacity) never cross the D2H link
-    import dataclasses as _dc
-
-    if any(getattr(c, "codes", None) is not None for c in batch.columns):
-        def strip(c):
-            if isinstance(c, StringColumn) and c.codes is not None:
-                return _dc.replace(c, codes=None, dict_chars=None,
-                                   dict_lens=None)
-            if isinstance(c, Column) and c.codes is not None:
-                return _dc.replace(c, codes=None, dict_values=None)
-            return c
-
-        batch = _CB([strip(c) for c in batch.columns], batch.num_rows,
-                    batch.schema)
+    batch = _strip_dict_sidecar(batch)
 
     if not isinstance(batch.num_rows, int) \
             and _full_fetch_bytes(batch) <= _FUSED_FETCH_BYTES:

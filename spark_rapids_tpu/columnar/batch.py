@@ -269,7 +269,7 @@ def _shrink_col(c: AnyColumn, new_cap: int) -> AnyColumn:
             c.chars[:new_cap], c.lengths[:new_cap], c.validity[:new_cap],
             c.dtype,
             c.codes[:new_cap] if c.codes is not None else None,
-            c.dict_chars, c.dict_lens)
+            c.dict_chars, c.dict_lens, c.dict_len)
     if isinstance(c, ListColumn):
         return ListColumn(c.values[:new_cap], c.lengths[:new_cap],
                           c.elem_validity[:new_cap],
@@ -284,7 +284,7 @@ def _shrink_col(c: AnyColumn, new_cap: int) -> AnyColumn:
                          c.validity[:new_cap], c.dtype)
     return Column(c.data[:new_cap], c.validity[:new_cap], c.dtype,
                   c.codes[:new_cap] if c.codes is not None else None,
-                  c.dict_values)
+                  c.dict_values, c.dict_len)
 
 
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:

@@ -99,15 +99,22 @@ class Column:
     #: hint, never a requirement.
     codes: Optional[ArrayLike] = None
     dict_values: Optional[ArrayLike] = None
+    #: tight upper bound on the TRUE dictionary entry count
+    #: (`dict_values` is padded to its pow2 capacity bucket by the
+    #: wire; this is bucketed to a multiple of 16 so jit treedefs do
+    #: not fragment per exact cardinality).  Consumers sizing code
+    #: domains must use this, not the padded shape.  Static aux data:
+    #: it survives jit boundaries alongside dtype.
+    dict_len: Optional[int] = None
 
     def tree_flatten(self):
         return (self.data, self.validity, self.codes,
-                self.dict_values), (self.dtype,)
+                self.dict_values), (self.dtype, self.dict_len)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         data, validity, codes, dvals = children
-        return cls(data, validity, aux[0], codes, dvals)
+        return cls(data, validity, aux[0], codes, dvals, aux[1])
 
     @property
     def capacity(self) -> int:
@@ -115,8 +122,7 @@ class Column:
 
     def with_validity(self, validity: ArrayLike) -> "Column":
         # codes describe data, not validity: the sidecar survives
-        return Column(self.data, validity, self.dtype, self.codes,
-                      self.dict_values)
+        return dataclasses.replace(self, validity=validity)
 
     def gather(self, indices: ArrayLike, index_valid: Optional[ArrayLike] = None
                ) -> "Column":
@@ -130,7 +136,7 @@ class Column:
         codes = None if self.codes is None \
             else jnp.take(self.codes, idx, axis=0)
         return Column(data, validity, self.dtype, codes,
-                      self.dict_values)
+                      self.dict_values, self.dict_len)
 
     @staticmethod
     def from_numpy(values: np.ndarray, dtype: T.DataType,
@@ -178,15 +184,21 @@ class StringColumn:
     codes: Optional[ArrayLike] = None
     dict_chars: Optional[ArrayLike] = None
     dict_lens: Optional[ArrayLike] = None
+    #: tight (16-bucketed) upper bound on the TRUE dictionary entry
+    #: count (dict_chars/dict_lens are padded to their pow2 capacity
+    #: bucket by the wire); domain sizing must use this.
+    dict_len: Optional[int] = None
 
     def tree_flatten(self):
         return (self.chars, self.lengths, self.validity, self.codes,
-                self.dict_chars, self.dict_lens), (self.dtype,)
+                self.dict_chars, self.dict_lens), (self.dtype,
+                                                   self.dict_len)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         chars, lengths, validity, codes, dchars, dlens = children
-        return cls(chars, lengths, validity, aux[0], codes, dchars, dlens)
+        return cls(chars, lengths, validity, aux[0], codes, dchars,
+                   dlens, aux[1])
 
     @property
     def capacity(self) -> int:
@@ -211,7 +223,8 @@ class StringColumn:
         codes = (jnp.take(self.codes, idx, axis=0)
                  if self.codes is not None else None)
         return StringColumn(chars, lengths, validity, self.dtype,
-                            codes, self.dict_chars, self.dict_lens)
+                            codes, self.dict_chars, self.dict_lens,
+                            self.dict_len)
 
     @staticmethod
     def from_list(values: list[Optional[str]],

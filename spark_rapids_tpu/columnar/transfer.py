@@ -278,7 +278,7 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
             # suppression which nulls columns no operator above the
             # elided filter reads)
             entries.append(("fixed", "null", -1, str(vals.dtype), (),
-                            None))
+                            None, None))
             continue
         vref = None
         if validity is not None:
@@ -286,6 +286,7 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
         phys = vals.dtype
         kind = "raw"
         extra: tuple = ()
+        dict_n = None  # bucketed dictionary entry bound, dict entries
         if phys.kind in _INT_KINDS and phys.itemsize > 1:
             mn, rng, enc8, enc16 = _int_range(vals, phys)
             if rng <= 0xFF:
@@ -310,6 +311,7 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
                 code_dt = np.uint8 if len(dvals) <= 0x100 else np.uint16
                 nvp = max(8, pad_capacity(len(dvals)))
                 kind = "dict"
+                dict_n = _dict_len_bound(len(dvals), nvp)
                 extra = (comps.add(_padded(dvals, nvp)),)
                 vals = codes.astype(code_dt)
             elif phys.itemsize == 8:
@@ -323,10 +325,23 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
                     extra = (comps.add(np.asarray(100.0, np.float64)),)
                     vals = scaled
         dref = comps.add(_padded(vals, wire))
-        entries.append(("fixed", kind, dref, str(phys), extra, vref))
+        entries.append(("fixed", kind, dref, str(phys), extra, vref,
+                        dict_n))
 
     plan = (cap, wire, n_ref, tuple(entries))
     return comps.finish(), plan
+
+
+def _dict_len_bound(n: int, nvp: int) -> int:
+    """Tight upper bound on a dictionary's true entry count, bucketed
+    to a multiple of 16 (min 8) and clamped to the padded capacity.
+    The bound rides in pytree aux data / the wire plan, both of which
+    key jit compile caches — an EXACT per-row-group cardinality would
+    mint a distinct program per dictionary size, while the full padded
+    capacity (pow2) overestimates coded-key domains (compounding per
+    group key).  The bucket keeps domains within 16 of tight and the
+    program-variant count small."""
+    return min(nvp, max(8, -(-n // 16) * 16))
 
 
 def _encode_dict_direct(comps: _Comps, arr: pa.DictionaryArray,
@@ -359,7 +374,8 @@ def _encode_dict_direct(comps: _Comps, arr: pa.DictionaryArray,
         else None
     cref = comps.add(_padded(codes.astype(code_dt), wire))
     extra = (comps.add(_padded(dnp, nvp)),)
-    return ("fixed", "dict", cref, str(dnp.dtype), extra, vref)
+    return ("fixed", "dict", cref, str(dnp.dtype), extra, vref,
+            _dict_len_bound(nvals, nvp))
 
 
 def _sdict_entry(comps: _Comps, codes: np.ndarray, dvals: pa.Array,
@@ -381,7 +397,8 @@ def _sdict_entry(comps: _Comps, codes: np.ndarray, dvals: pa.Array,
     cref = comps.add(_padded(codes.astype(code_dt), wire))
     dcref = comps.add(_padded(dchars, nvp))
     dlref = comps.add(_padded(dlens.astype(np.uint16), nvp))
-    return ("sdict", cref, dcref, dlref, vref)
+    return ("sdict", cref, dcref, dlref, vref,
+            _dict_len_bound(nvals, nvp))
 
 
 def _encode_string(comps: _Comps, arr: pa.Array, wire: int) -> tuple:
@@ -495,7 +512,7 @@ def _make_decode(plan: tuple):
         out = []
         for e in entries:
             if e[0] == "fixed":
-                _, kind, dref, physdt, extra, vref = e
+                _, kind, dref, physdt, extra, vref, _dict_n = e
                 phys = np.dtype(physdt)
                 if kind == "null":
                     out.append((jnp.zeros((cap,), phys),
@@ -526,7 +543,7 @@ def _make_decode(plan: tuple):
                             grow(read(lref).astype(jnp.int32))
                             * v.astype(jnp.int32), v))
             elif e[0] == "sdict":
-                _, cref, dcref, dlref, vref = e
+                _, cref, dcref, dlref, vref, _dict_n = e
                 codes = read(cref).astype(jnp.int32)
                 v = validity_of(vref)
                 # invariant shared with every string kernel: chars are
@@ -549,22 +566,30 @@ def _make_decode(plan: tuple):
     return decode
 
 
-def _wrap_cols(parts, schema: T.Schema):
-    """Decode-program outputs -> AnyColumn list (traceable)."""
+def _wrap_cols(parts, schema: T.Schema, entries=None):
+    """Decode-program outputs -> AnyColumn list (traceable).  `entries`
+    (the plan's per-column entry tuples) supplies the bucketed
+    dictionary entry bound for dict-encoded columns — the device
+    arrays are padded to pow2 capacity buckets, so consumers sizing
+    code domains need the tighter bound carried separately."""
     cols = []
-    for f, p in zip(schema.fields, parts):
+    for i, (f, p) in enumerate(zip(schema.fields, parts)):
+        e = entries[i] if entries is not None else None
+        dict_n = e[-1] if e is not None and e[0] in ("fixed",
+                                                     "sdict") else None
         if isinstance(f.dtype, T.StringType):
             if len(p) == 6:  # sdict: dictionary sidecar rides along
                 chars, lens, valid, codes, dchars, dlens = p
                 cols.append(StringColumn(chars, lens, valid, f.dtype,
-                                         codes, dchars, dlens))
+                                         codes, dchars, dlens, dict_n))
                 continue
             chars, lens, valid = p
             cols.append(StringColumn(chars, lens, valid))
         else:
             if len(p) == 4:  # dict: numeric dictionary sidecar
                 data, valid, codes, dvals = p
-                cols.append(Column(data, valid, f.dtype, codes, dvals))
+                cols.append(Column(data, valid, f.dtype, codes, dvals,
+                                   dict_n))
                 continue
             data, valid = p
             cols.append(Column(data, valid, f.dtype))
@@ -575,15 +600,21 @@ def decode_on_device(comps: list, plan: tuple, schema: T.Schema):
     """Upload the component list (one batched transfer round) and run
     the cached decode program.  Returns device columns in schema
     order."""
+    # the compiled decode ignores dict_n (it is applied by _wrap_cols
+    # OUTSIDE the program here): strip it from the cache key so row
+    # groups differing only in dictionary cardinality bucket share one
+    # program (the fused EncodedBatch path legitimately keys on it)
+    key = plan[:3] + (tuple(
+        e[:-1] if e[0] in ("fixed", "sdict") else e for e in plan[3]),)
     with _cache_lock:
-        fn = _unpack_cache.get(plan)
+        fn = _unpack_cache.get(key)
         if fn is None:
-            fn = _unpack_cache[plan] = jax.jit(_make_decode(plan))
+            fn = _unpack_cache[key] = jax.jit(_make_decode(plan))
             while len(_unpack_cache) > 256:
                 _unpack_cache.pop(next(iter(_unpack_cache)))
     dev = jax.device_put(comps)
     parts = fn(dev)
-    return _wrap_cols(parts, schema)
+    return _wrap_cols(parts, schema, plan[3])
 
 
 @jax.tree_util.register_pytree_node_class
@@ -628,7 +659,7 @@ class EncodedBatch:
         from spark_rapids_tpu.columnar.batch import ColumnarBatch
 
         decode = _make_decode(self.plan)
-        cols = _wrap_cols(decode(self.comps), self.schema)
+        cols = _wrap_cols(decode(self.comps), self.schema, self.plan[3])
         n_ref = self.plan[2]
         n_live = self.comps[n_ref[1]]
         return ColumnarBatch(cols, jnp.asarray(n_live, jnp.int32),

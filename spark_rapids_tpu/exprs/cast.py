@@ -10,7 +10,11 @@ semantics for the supported matrix:
 - date -> timestamp: midnight UTC; timestamp -> date: floor to day;
 - timestamp <-> long: seconds (Spark casts ts to epoch *seconds*);
 - integral -> string: device-side digit expansion;
-- string -> integral: device-side parse, NULL on malformed (non-ANSI).
+- string -> integral: device-side parse, NULL on malformed (non-ANSI);
+- decimal -> wider decimal: int64 unscaled rescale (widening shapes
+  only — scale and integral digits both non-decreasing);
+- integral -> decimal (when every source digit fits) and
+  decimal -> float/double.
 
 Unsupported pairs raise at construction; the planner turns that into a
 will-not-work reason and falls back (the reference gates the same way
@@ -54,6 +58,20 @@ def cast_supported(src: T.DataType, dst: T.DataType) -> bool:
     if ts in _INTEGRAL + (T.BooleanType,) and td is T.StringType:
         return True
     if ts is T.StringType and td in _INTEGRAL:
+        return True
+    if ts is T.DecimalType and td is T.DecimalType:
+        # pure widening only (no value can overflow the int64 unscaled
+        # backing): integral digits and scale both non-decreasing.  This
+        # is the shape UNION member coercion produces; a narrowing
+        # decimal cast (overflow -> NULL/ANSI raise) is future work.
+        return (dst.scale >= src.scale
+                and dst.precision - dst.scale >= src.precision - src.scale)
+    if ts in _INTEGRAL and td is T.DecimalType:
+        # widening only: the target must hold every integral digit the
+        # source type can produce
+        return (dst.precision - dst.scale
+                >= T.INTEGRAL_DECIMAL_DIGITS[ts])
+    if ts is T.DecimalType and td in _FLOATING:
         return True
     return False
 
@@ -107,6 +125,20 @@ class Cast(Expression):
             return Column(d != 0, valid, dst)
         if ts is T.BooleanType:
             return Column(d.astype(T.to_numpy_dtype(dst)), valid, dst)
+        if ts is T.DecimalType and td is T.DecimalType:
+            # rescale the int64 unscaled value; cast_supported admits
+            # only widening shapes, so the shift is >= 0 and the result
+            # provably fits MAX_PRECISION digits (no overflow check)
+            shift = dst.scale - src.scale
+            return Column(d.astype(jnp.int64) * (10 ** shift), valid, dst)
+        if ts in _INTEGRAL and td is T.DecimalType:
+            # widening only (cast_supported): value * 10^scale fits the
+            # MAX_PRECISION-digit unscaled int64
+            return Column(d.astype(jnp.int64) * (10 ** dst.scale),
+                          valid, dst)
+        if ts is T.DecimalType and td in _FLOATING:
+            out = d.astype(jnp.float64) / (10.0 ** src.scale)
+            return Column(out.astype(T.to_numpy_dtype(dst)), valid, dst)
         if (ts, td) == (T.DateType, T.TimestampType):
             from spark_rapids_tpu.exprs.datetime import US_PER_DAY
 

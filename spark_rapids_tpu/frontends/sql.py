@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import re
-from typing import Optional, Sequence
+from typing import Sequence
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.execs.sort import SortKey
@@ -51,6 +51,7 @@ from spark_rapids_tpu.exprs import datetime as DT
 from spark_rapids_tpu.exprs import math as M
 from spark_rapids_tpu.exprs import predicates as P
 from spark_rapids_tpu.exprs import strings as S
+from spark_rapids_tpu.session import AnalysisException
 
 
 class SqlError(ValueError):
@@ -1029,10 +1030,14 @@ class SqlSession:
             out = self._lower(core)
             for member, dedup in q["unions"]:
                 m = self._lower(member)
-                if len(m.schema.fields) != len(out.schema.fields):
-                    raise SqlError(
-                        "UNION members must have the same column count")
-                out = out.union(m)
+                try:
+                    # DataFrame.union validates column count and applies
+                    # WidenSetOperationTypes at the engine layer;
+                    # surface its deliberate analysis failures as
+                    # SqlError (incidental TypeErrors still propagate)
+                    out = out.union(m)
+                except AnalysisException as e:
+                    raise SqlError(str(e)) from None
                 if dedup:
                     out = out.group_by(
                         *[B.ColumnReference(f.name)
@@ -1045,6 +1050,8 @@ class SqlSession:
         for name, alias in [q["tables"][0]] + [j[1] for j in q["joins"]]:
             if isinstance(name, tuple) and name[0] == "__sub__":
                 df = self._lower(name[1])
+            elif isinstance(name, tuple) and name[0] == "__df__":
+                df = name[1]  # pre-lowered derived table (EXISTS path)
             else:
                 df = self.table(name)
             cols = {f.name.lower() for f in df.schema.fields}
@@ -1173,8 +1180,9 @@ class SqlSession:
                            "not supported")
         inner_cols: set = set()
         refs = [q["tables"][0]] + [j[1] for j in q["joins"]]
-        resolved: list[tuple] = []  # table refs for q2, derived tables
-        # pre-lowered ONCE (("__df__", df) entries)
+        resolved: list[tuple] = []  # table refs for q2: derived tables
+        # pre-lowered ONCE here as ("__df__", df) entries, so the
+        # _lower(q2) below reuses them instead of lowering them again
         for name, alias in refs:
             if isinstance(name, tuple) and name[0] == "__sub__":
                 df = self._lower(name[1])
@@ -1219,6 +1227,9 @@ class SqlSession:
             raise SqlError("EXISTS subquery must correlate with the "
                            "outer query through at least one equality")
         q2 = dict(q, where=_and_all(keep),
+                  tables=[resolved[0]],
+                  joins=[(how, r, on) for (how, _tr, on), r
+                         in zip(q["joins"], resolved[1:])],
                   items=[(B.ColumnReference(n), None)
                          for n in dict.fromkeys(
                              k.col_name for k in inner_keys)],

@@ -1,0 +1,28 @@
+"""tpulint: static analysis for plans, registries, and engine source.
+
+Four analyzers share one Diagnostic model and one baseline:
+
+- ``dtype_flow``   — dtype propagation through lowered physical plans
+                     (DT*: the UNION-truncation bug class, statically)
+- ``registry``     — registry/TypeSig/docs consistency (REG*)
+- ``plan_rules``   — plan anti-patterns: fallback islands, redundant
+                     sorts, nondeterminism above exchanges (PL*)
+- ``source_rules`` — host-device sync hazards in traced code (SRC*)
+
+CLI: ``python -m spark_rapids_tpu.tools.lint [--strict]``.
+Docs: ``docs/lint.md``.
+"""
+
+from spark_rapids_tpu.lint.diagnostic import (  # noqa: F401
+    Diagnostic,
+    SEVERITIES,
+    default_baseline_path,
+    load_baseline,
+    save_baseline,
+    sort_diags,
+)
+from spark_rapids_tpu.lint.runner import (  # noqa: F401
+    evaluate,
+    lint_exec_tree,
+    run_lint,
+)

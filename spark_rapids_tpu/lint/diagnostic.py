@@ -1,0 +1,121 @@
+"""Shared diagnostic model for the tpulint static-analysis subsystem.
+
+The reference never trusts operator code: TypeChecks verifies declared
+TypeSigs during tagging, api_validation diffs registries against Spark,
+and docs/supported_ops.md is generated from the rule tables.  tpulint is
+the unifying pass over all of that — every analyzer (dtype flow,
+registry consistency, plan anti-patterns, engine-source hazards) emits
+the same Diagnostic record, so one CLI, one baseline file and one
+explain() feed serve them all.
+
+Baselines: a checked-in JSON file of accepted finding keys.  Keys are
+line-number-free (rule + location symbol + message) so routine edits
+above a finding do not churn the baseline; a finding is NEW only when
+its key is absent from the baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable, Optional, Sequence
+
+#: severity order, weakest first
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: rule id, severity, location, message, fix hint.
+
+    `location` is a stable symbol — ``path/to/file.py::qualname`` for
+    source findings, ``plan::NodeName`` / ``registry::ClassName`` for
+    the others.  `line` (0 = unknown) is display-only and deliberately
+    excluded from the baseline key."""
+
+    rule: str
+    severity: str
+    location: str
+    message: str
+    hint: str = ""
+    line: int = 0
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+
+    @property
+    def key(self) -> str:
+        """Stable baseline identity."""
+        return f"{self.rule}::{self.location}::{self.message}"
+
+    def render(self) -> str:
+        loc = self.location + (f":{self.line}" if self.line else "")
+        s = f"{self.severity:7s} {self.rule} {loc} — {self.message}"
+        if self.hint:
+            s += f"\n        hint: {self.hint}"
+        return s
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def max_severity(diags: Sequence[Diagnostic]) -> Optional[str]:
+    if not diags:
+        return None
+    return max((d.severity for d in diags), key=SEVERITIES.index)
+
+
+def filter_at_least(diags: Iterable[Diagnostic],
+                    severity: str) -> list[Diagnostic]:
+    floor = SEVERITIES.index(severity)
+    return [d for d in diags if SEVERITIES.index(d.severity) >= floor]
+
+
+def sort_diags(diags: Iterable[Diagnostic]) -> list[Diagnostic]:
+    return sorted(diags, key=lambda d: (-SEVERITIES.index(d.severity),
+                                        d.rule, d.location, d.line,
+                                        d.message))
+
+
+# ------------------------------------------------------------------ #
+# Baseline handling
+# ------------------------------------------------------------------ #
+
+def default_baseline_path() -> str:
+    """The checked-in accepted-findings file, next to this module."""
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> set[str]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("accepted", []))
+
+
+def save_baseline(diags: Sequence[Diagnostic],
+                  path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    payload = {
+        "comment": "Accepted tpulint findings; regenerate with "
+                   "python -m spark_rapids_tpu.tools.lint "
+                   "--update-baseline",
+        "accepted": sorted({d.key for d in diags}),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def split_new(diags: Sequence[Diagnostic],
+              baseline: set[str]) -> tuple[list[Diagnostic],
+                                           list[Diagnostic]]:
+    """(new, accepted) partition against a baseline key set."""
+    new, accepted = [], []
+    for d in diags:
+        (accepted if d.key in baseline else new).append(d)
+    return new, accepted

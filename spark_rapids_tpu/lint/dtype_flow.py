@@ -1,0 +1,254 @@
+"""Dtype-flow checker: verify dtypes across every edge of a lowered
+physical plan WITHOUT executing it.
+
+The round-5 UNION bug is the motivating class: TpuUnionExec re-tags
+every member batch with the first member's schema, so an INT first
+member unioned with a DOUBLE second member ships float data under an
+int tag and downstream ops silently truncate.  Nothing at runtime can
+catch that — the data is already mislabeled — but it is fully visible
+statically: the second child's declared schema disagrees with the
+union's output schema.  This analyzer propagates declared dtypes
+through bound expression trees and exec edges and flags every
+disagreement between what a node DECLARES and what its inputs/
+evaluators actually produce (the physical-level twin of the tagging
+pass's TypeSig checks, ref: TypeChecks.scala:483).
+
+Rules
+-----
+- DT000 (warning): a node the analyzer crashed on — analysis never
+  kills the caller, but --strict fails so a refactor that breaks
+  _check_node cannot silently turn the other rules off
+- DT001 (error): set-operation member schema mismatch (the UNION class)
+- DT002 (error): bound reference out of range / stale dtype vs the
+  input schema it is evaluated against
+- DT003 (warning): expression input dtype outside its declared TypeSig
+  (the tagging pass should have routed this to the CPU engine — seeing
+  it in a lowered plan means tagging drifted)
+- DT004 (error): predicate position (filter/join condition) whose
+  expression is not boolean-typed
+- DT005 (error): declared output field dtype disagrees with the
+  evaluator's expression dtype
+- DT006 (error): equi-join key dtype mismatch between sides (hash
+  parity requires identical physical hashing)
+- DT007 (error): schema-preserving exec whose declared schema disagrees
+  with its child's
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.lint.diagnostic import Diagnostic
+
+
+def _loc(node) -> str:
+    return f"plan::{type(node).__name__}"
+
+
+def _check_bound_tree(e, schema: Optional[T.Schema], where: str,
+                      node, out: list[Diagnostic]) -> None:
+    """Walk one bound expression tree: reference/TypeSig/dtype checks."""
+    from spark_rapids_tpu.exprs import base as B
+    from spark_rapids_tpu.plan import planner as PL
+    from spark_rapids_tpu.plan import typesig as TS
+
+    if isinstance(e, B.BoundReference) and schema is not None:
+        if not (0 <= e.ordinal < len(schema.fields)):
+            out.append(Diagnostic(
+                "DT002", "error", _loc(node),
+                f"{where}: bound reference ordinal {e.ordinal} out of "
+                f"range for input schema of {len(schema.fields)} "
+                "columns",
+                hint="re-bind the expression against the exec's actual "
+                     "input schema"))
+            return
+        f = schema.fields[e.ordinal]
+        if f.dtype != e.dtype:
+            out.append(Diagnostic(
+                "DT002", "error", _loc(node),
+                f"{where}: bound reference input[{e.ordinal}] declares "
+                f"{e.dtype.name} but the input column "
+                f"{f.name!r} is {f.dtype.name}",
+                hint="stale binding — re-bind after schema-changing "
+                     "rewrites"))
+    sig = PL.EXPR_SIGS.get(type(e))
+    if sig is not None:
+        for c in e.children:
+            try:
+                dt = c.dtype
+            except Exception:
+                continue
+            if not sig.inputs.supports(dt):
+                out.append(Diagnostic(
+                    "DT003", "warning", _loc(node),
+                    f"{where}: {type(e).__name__} evaluates a "
+                    f"{dt.name} input outside its declared TypeSig "
+                    f"({sig.inputs.describe()})",
+                    hint="the tagging pass should have kept this on "
+                         "the CPU engine; widen the TypeSig or fix "
+                         "tagging"))
+    for c in e.children:
+        _check_bound_tree(c, schema, where, node, out)
+
+
+def _expr_dtype(e) -> Optional[T.DataType]:
+    try:
+        return e.dtype
+    except Exception:
+        return None
+
+
+def _check_predicate(e, schema, where: str, node,
+                     out: list[Diagnostic]) -> None:
+    _check_bound_tree(e, schema, where, node, out)
+    dt = _expr_dtype(e)
+    if dt is not None and not isinstance(dt, (T.BooleanType, T.NullType)):
+        out.append(Diagnostic(
+            "DT004", "error", _loc(node),
+            f"{where}: predicate expression {e.name} has type "
+            f"{dt.name}, not boolean",
+            hint="wrap the condition in an explicit comparison"))
+
+
+def _schemas_equal(a: T.Schema, b: T.Schema) -> bool:
+    return len(a.fields) == len(b.fields) and all(
+        fa.dtype == fb.dtype for fa, fb in zip(a.fields, b.fields))
+
+
+def _check_union(node, out: list[Diagnostic]) -> None:
+    first = node.children[0].schema
+    for mi, child in enumerate(node.children[1:], start=2):
+        s = child.schema
+        if len(s.fields) != len(first.fields):
+            out.append(Diagnostic(
+                "DT001", "error", _loc(node),
+                f"union member {mi} has {len(s.fields)} columns, "
+                f"member 1 has {len(first.fields)}"))
+            continue
+        for i, (fa, fb) in enumerate(zip(first.fields, s.fields)):
+            if fa.dtype != fb.dtype:
+                out.append(Diagnostic(
+                    "DT001", "error", _loc(node),
+                    f"union member {mi} column {i + 1} ({fb.name!r}) "
+                    f"is {fb.dtype.name} but member 1 declares "
+                    f"{fa.dtype.name}: batches would be re-tagged and "
+                    "silently coerced",
+                    hint="insert widening casts on the members "
+                         "(Spark's WidenSetOperationTypes) before the "
+                         "union"))
+
+
+def _check_node(node, out: list[Diagnostic]) -> None:
+    from spark_rapids_tpu.execs import basic as XB
+    from spark_rapids_tpu.execs import sort as XS
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.coalesce import TpuCoalescePartitionsExec
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.execs.limit import (
+        TpuCollectLimitExec,
+        TpuGlobalLimitExec,
+        TpuLocalLimitExec,
+    )
+    from spark_rapids_tpu.plan.planner import CpuFallbackExec
+
+    if isinstance(node, CpuFallbackExec):
+        return  # the CPU engine re-derives types itself
+
+    if isinstance(node, XB.TpuUnionExec):
+        _check_union(node, out)
+        return
+
+    child_schema = node.children[0].schema if node.children else None
+
+    if isinstance(node, XB.TpuProjectExec):
+        for i, e in enumerate(node.exprs):
+            _check_bound_tree(e, child_schema, f"projection {i + 1}",
+                              node, out)
+            dt, declared = _expr_dtype(e), node.schema.fields[i].dtype
+            if dt is not None and dt != declared:
+                out.append(Diagnostic(
+                    "DT005", "error", _loc(node),
+                    f"projection {i + 1} ({node.schema.fields[i].name!r})"
+                    f" declares {declared.name} but its expression "
+                    f"evaluates to {dt.name}"))
+    elif isinstance(node, XB.TpuFilterExec):
+        _check_predicate(node.condition, child_schema, "filter condition",
+                         node, out)
+    elif isinstance(node, XS._SortMixin):
+        for i, k in enumerate(getattr(node, "keys", [])):
+            _check_bound_tree(k.expr, child_schema, f"sort key {i + 1}",
+                              node, out)
+    elif isinstance(node, TpuHashAggregateExec):
+        if node.mode != "final":
+            for i, g in enumerate(node.groups):
+                _check_bound_tree(g, child_schema,
+                                  f"grouping key {i + 1}", node, out)
+        if node.mode != "partial":
+            # declared output vs the finalize projection's dtypes
+            for i, (f, fe) in enumerate(zip(node.schema.fields,
+                                            node.final_exprs)):
+                dt = _expr_dtype(fe)
+                if dt is not None and dt != f.dtype:
+                    out.append(Diagnostic(
+                        "DT005", "error", _loc(node),
+                        f"aggregate output {i + 1} ({f.name!r}) "
+                        f"declares {f.dtype.name} but finalizes to "
+                        f"{dt.name}"))
+    elif hasattr(node, "left_keys") and hasattr(node, "right_keys") \
+            and len(node.children) >= 2:
+        ls, rs = node.children[0].schema, node.children[1].schema
+        for i, (lk, rk) in enumerate(zip(node.left_keys,
+                                         node.right_keys)):
+            _check_bound_tree(lk, ls, f"left join key {i + 1}", node, out)
+            _check_bound_tree(rk, rs, f"right join key {i + 1}", node,
+                              out)
+            ld, rd = _expr_dtype(lk), _expr_dtype(rk)
+            if ld is not None and rd is not None and ld != rd:
+                out.append(Diagnostic(
+                    "DT006", "error", _loc(node),
+                    f"join key {i + 1} dtypes differ: {ld.name} vs "
+                    f"{rd.name} — hash partitioning would disagree "
+                    "between sides",
+                    hint="cast both sides to their common type before "
+                         "the join"))
+        cond = getattr(node, "condition", None)
+        if cond is not None:
+            _check_predicate(cond, None, "join condition", node, out)
+    elif isinstance(node, (TpuShuffleExchangeExec,
+                           TpuCoalescePartitionsExec,
+                           XB.TpuCoalesceBatchesExec,
+                           TpuGlobalLimitExec, TpuLocalLimitExec,
+                           TpuCollectLimitExec)):
+        if child_schema is not None \
+                and not _schemas_equal(node.schema, child_schema):
+            out.append(Diagnostic(
+                "DT007", "error", _loc(node),
+                f"{type(node).__name__} is schema-preserving but its "
+                "declared schema disagrees with its child's"))
+
+
+def check_exec_tree(root) -> list[Diagnostic]:
+    """Dtype-flow diagnostics for one lowered physical plan."""
+    out: list[Diagnostic] = []
+    seen: set[int] = set()
+
+    def walk(node) -> None:
+        if id(node) in seen:  # plans are DAGs (reused subtrees)
+            return
+        seen.add(id(node))
+        try:
+            _check_node(node, out)
+        except Exception as exc:  # never let analysis kill the caller
+            # warning, not info: an analyzer crash silently disables
+            # DT001-DT007 for this node, and --strict must notice that
+            # coverage shrink (same rationale as PL000 in runner.py)
+            out.append(Diagnostic(
+                "DT000", "warning", _loc(node),
+                f"dtype-flow analysis skipped: {type(exc).__name__}: "
+                f"{exc}"))
+        for c in node.children:
+            walk(c)
+
+    walk(root)
+    return out

@@ -1,0 +1,141 @@
+"""Plan linter: walk a lowered physical plan (no execution) and flag
+structural anti-patterns.
+
+The reference's GpuTransitionOverrides pass polices the same shapes on
+the GPU side — device/host transition placement, redundant exchanges
+and sorts.  Here the patterns are advisory diagnostics feeding the CLI
+and explain() output instead of plan mutations.
+
+Rules
+-----
+- PL001 (warning): CPU-fallback island — a CpuFallbackExec sandwiched
+  between TPU execs; every batch bounces device->host->device
+- PL002 (info): shuffle exchange whose child streams raw (un-coalesced)
+  batches — many small map blocks inflate shuffle bookkeeping
+- PL003 (warning): nondeterministic (partition-aware) expression above
+  an exchange — a retried/recomputed reduce partition would observe
+  different values than the original attempt
+- PL004 (warning): redundant sort-under-sort — an inner sort whose
+  ordering is destroyed by an outer sort reachable through
+  order-agnostic narrow execs
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.lint.diagnostic import Diagnostic
+
+
+def _loc(node) -> str:
+    return f"plan::{type(node).__name__}"
+
+
+def _node_exprs(node):
+    """Expression trees an exec evaluates per batch (for PL003)."""
+    from spark_rapids_tpu.execs.base import FusableExec
+
+    if isinstance(node, FusableExec):
+        return node.fusion_exprs()
+    keys = getattr(node, "keys", None)
+    if keys:
+        return tuple(k.expr for k in keys if hasattr(k, "expr"))
+    return ()
+
+
+def check_plan(root) -> list[Diagnostic]:
+    from spark_rapids_tpu.execs.basic import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.execs.sort import TpuSortExec
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        tree_is_partition_aware,
+    )
+    from spark_rapids_tpu.plan.planner import CpuFallbackExec
+
+    out: list[Diagnostic] = []
+    has_exchange: dict[int, bool] = {}
+
+    def exchange_below(node) -> bool:
+        k = id(node)
+        if k not in has_exchange:
+            has_exchange[k] = isinstance(node, TpuShuffleExchangeExec) \
+                or any(exchange_below(c) for c in node.children)
+        return has_exchange[k]
+
+    #: narrow per-batch execs that neither produce nor rely on an
+    #: ordering — an outer sort looking through these at an inner sort
+    #: proves the inner sort's work is discarded
+    from spark_rapids_tpu.execs.basic import TpuFilterExec, TpuProjectExec
+
+    ORDER_AGNOSTIC = (TpuProjectExec, TpuFilterExec,
+                      TpuCoalesceBatchesExec)
+
+    def inner_sort_through_narrow(node):
+        n = node.children[0] if node.children else None
+        while isinstance(n, ORDER_AGNOSTIC):
+            n = n.children[0]
+        return n if isinstance(n, TpuSortExec) else None
+
+    seen: set[int] = set()
+
+    def walk(node, parent) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+
+        if isinstance(node, CpuFallbackExec):
+            if parent is not None \
+                    and not isinstance(parent, CpuFallbackExec) \
+                    and node.children \
+                    and any(not isinstance(c, CpuFallbackExec)
+                            for c in node.children):
+                out.append(Diagnostic(
+                    "PL001", "warning", _loc(node),
+                    "CPU-fallback island between TPU execs "
+                    f"({node.plan.name} falls back): every batch "
+                    "bounces device->host->device",
+                    hint="add TPU support for the falling-back "
+                         "operator, or check explain() for the "
+                         "will-not-work reason"))
+        elif isinstance(node, TpuShuffleExchangeExec):
+            child = node.children[0]
+            if not isinstance(child, (TpuCoalesceBatchesExec,
+                                      TpuShuffleExchangeExec)):
+                out.append(Diagnostic(
+                    "PL002", "info", _loc(node),
+                    "shuffle exchange consumes raw "
+                    f"{type(child).__name__} batches without a "
+                    "coalesce: many small map blocks inflate shuffle "
+                    "bookkeeping",
+                    hint="insert TpuCoalesceBatchesExec below the "
+                         "exchange when map batches are small"))
+        elif isinstance(node, TpuSortExec):
+            inner = inner_sort_through_narrow(node)
+            if inner is not None and inner.scope != "partition":
+                out.append(Diagnostic(
+                    "PL004", "warning", _loc(node),
+                    "redundant sort-under-sort: the inner "
+                    f"{inner.node_desc()} ordering is destroyed by "
+                    "this sort",
+                    hint="drop the inner sort, or order once"))
+
+        for e in _node_exprs(node):
+            try:
+                aware = tree_is_partition_aware(e)
+            except Exception:
+                aware = False
+            if aware and any(exchange_below(c) for c in node.children):
+                out.append(Diagnostic(
+                    "PL003", "warning", _loc(node),
+                    "nondeterministic expression "
+                    f"{getattr(e, 'name', type(e).__name__)!r} above "
+                    "an exchange: a recomputed reduce partition "
+                    "observes different values than the original "
+                    "attempt",
+                    hint="evaluate nondeterministic columns below the "
+                         "exchange and ship them as data"))
+                break
+
+        for c in node.children:
+            walk(c, node)
+
+    walk(root, None)
+    return out

@@ -1,0 +1,184 @@
+"""Registry consistency checker: the hard-pass extension of
+tools/api_validation.
+
+The reference generates docs/supported_ops.md from its rule tables and
+diffs its registries against Spark via api_validation, so a rule
+without an implementation (or an implementation without a rule) is
+caught before it ships wrong results.  This analyzer makes the same
+properties hard-checkable here:
+
+- REG001 (error): registered expression has no declared TypeSig — the
+  tagging pass would trust the operator code it is supposed to check
+- REG002 (error): registered expression/aggregate has no evaluator
+  implementation (phantom registry entry: tagging says TPU, execution
+  has nothing to run)
+- REG003 (error): registered entry missing its docs/supported_ops.md
+  row — the generated docs drifted from the live registries
+- REG004 (warning): an evaluator exists but is unregistered — it can
+  never engage, or worse engages through a side door without tagging
+- REG005 (error): api_validation exec-map drift — the coverage map
+  names a module/class that no longer exists
+- REG006 (error): registered aggregate has no AGG_SIGS entry
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import pkgutil
+
+from spark_rapids_tpu.lint.diagnostic import Diagnostic
+
+#: evaluators that are deliberately NOT in SUPPORTED_EXPRS, with the
+#: reason — anything new landing here should either be registered or
+#: get an entry with a justification
+UNREGISTERED_OK = {
+    "OpaquePythonUDF": "deliberately unregistered: opaque row UDFs "
+                       "always fall back to the CPU engine",
+    "ScalarSubquery": "rewritten to a Literal by the planner prepass; "
+                      "never evaluated as a device expression",
+    "Explode": "generator expression: tagged through the Generate "
+               "exec's check_supported, not the expression registry",
+}
+
+
+def _loc(name: str) -> str:
+    return f"registry::{name}"
+
+
+def _docs_text(docs_dir: str = None) -> str:
+    if docs_dir is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        docs_dir = os.path.join(root, "docs")
+    path = os.path.join(docs_dir, "supported_ops.md")
+    if not os.path.exists(path):
+        return ""
+    with open(path) as f:
+        return f.read()
+
+
+def _expr_classes():
+    """Every Expression subclass defined under spark_rapids_tpu.exprs
+    (plus the UDF expression module), keyed by class."""
+    import spark_rapids_tpu.exprs as EX
+    from spark_rapids_tpu.exprs.base import Expression
+
+    mods = ["spark_rapids_tpu.exprs." + m.name
+            for m in pkgutil.iter_modules(EX.__path__)]
+    mods.append("spark_rapids_tpu.udf.exprs")
+    out = []
+    for mn in mods:
+        mod = importlib.import_module(mn)
+        for cls in vars(mod).values():
+            if inspect.isclass(cls) and issubclass(cls, Expression) \
+                    and cls is not Expression and cls.__module__ == mn:
+                out.append(cls)
+    return out
+
+
+def check_registries(docs_dir: str = None) -> list[Diagnostic]:
+    from spark_rapids_tpu.exprs.base import Expression
+    from spark_rapids_tpu.plan import planner as PL
+    from spark_rapids_tpu.tools import api_validation as AV
+
+    out: list[Diagnostic] = []
+    docs = _docs_text(docs_dir)
+    if not docs:
+        out.append(Diagnostic(
+            "REG003", "error", _loc("docs/supported_ops.md"),
+            "docs/supported_ops.md is missing",
+            hint="run python -m spark_rapids_tpu.tools.gen_docs"))
+
+    # -- registered expressions: sig + implementation + doc row -------- #
+    for cls in PL.SUPPORTED_EXPRS:
+        name = cls.__name__
+        if cls not in PL.EXPR_SIGS:
+            out.append(Diagnostic(
+                "REG001", "error", _loc(name),
+                f"expression {name} is registered without a TypeSig: "
+                "tagging cannot check its input types",
+                hint="pass a TS.ExprSig to register_expr"))
+        if "eval" not in cls.__dict__ and not any(
+                "eval" in b.__dict__ for b in cls.__mro__[1:-1]
+                if b is not Expression):
+            out.append(Diagnostic(
+                "REG002", "error", _loc(name),
+                f"expression {name} is registered but implements no "
+                "eval(): tagging would accept plans execution cannot "
+                "run"))
+        if docs and f"| {name} |" not in docs:
+            out.append(Diagnostic(
+                "REG003", "error", _loc(name),
+                f"registered expression {name} has no "
+                "docs/supported_ops.md row",
+                hint="regenerate: python -m "
+                     "spark_rapids_tpu.tools.gen_docs"))
+
+    # -- registered aggregates ---------------------------------------- #
+    for cls in PL.SUPPORTED_AGGS:
+        name = cls.__name__
+        if cls not in PL.AGG_SIGS:
+            out.append(Diagnostic(
+                "REG006", "error", _loc(name),
+                f"aggregate {name} is registered without an AGG_SIGS "
+                "entry: its input types go unchecked at tagging",
+                hint="add a TS.ExprSig to planner.AGG_SIGS"))
+        impl = any("update_ops" in b.__dict__ for b in cls.__mro__[:-1])
+        if not impl and "expand" not in cls.__dict__:
+            out.append(Diagnostic(
+                "REG002", "error", _loc(name),
+                f"aggregate {name} defines neither update_ops nor an "
+                "expand() rewrite: it cannot execute"))
+        if docs and f"| {name} |" not in docs:
+            out.append(Diagnostic(
+                "REG003", "error", _loc(name),
+                f"registered aggregate {name} has no "
+                "docs/supported_ops.md row",
+                hint="regenerate: python -m "
+                     "spark_rapids_tpu.tools.gen_docs"))
+
+    # -- exec conf table: doc rows ------------------------------------ #
+    for cls in PL._EXEC_CONFS:
+        name = cls.__name__
+        if docs and f"| {name} |" not in docs:
+            out.append(Diagnostic(
+                "REG003", "error", _loc(name),
+                f"exec conf entry {name} has no "
+                "docs/supported_ops.md row",
+                hint="regenerate: python -m "
+                     "spark_rapids_tpu.tools.gen_docs"))
+
+    # -- api_validation drift becomes a hard failure ------------------- #
+    for ref in AV.validate()["exec_drift"]:
+        out.append(Diagnostic(
+            "REG005", "error", _loc(ref),
+            f"api_validation exec map names a missing implementation "
+            f"for {ref}: the coverage doc would report phantom "
+            "coverage",
+            hint="update _EXEC_MAP in tools/api_validation.py"))
+
+    # -- no evaluator exists unregistered ------------------------------ #
+    registered = set(PL.SUPPORTED_EXPRS)
+
+    def covered(cls) -> bool:
+        if cls in registered:
+            return True
+        return any(covered(sub) for sub in cls.__subclasses__())
+
+    for cls in _expr_classes():
+        if "eval" not in cls.__dict__:
+            continue  # abstract helper: no own evaluator
+        if covered(cls):
+            continue
+        if cls.__name__ in UNREGISTERED_OK:
+            continue
+        out.append(Diagnostic(
+            "REG004", "warning", _loc(cls.__name__),
+            f"evaluator {cls.__module__}.{cls.__name__} is not in "
+            "SUPPORTED_EXPRS (and no subclass is): it can never be "
+            "tagged for TPU execution",
+            hint="register_expr it with a TypeSig, or add it to "
+                 "lint.registry.UNREGISTERED_OK with a justification"))
+    return out

@@ -1,0 +1,112 @@
+"""tpulint runner: compose the four analyzers into one pass.
+
+A repo run covers:
+- the engine-source linter over spark_rapids_tpu/ (source_rules);
+- the registry consistency checker (registry);
+- dtype-flow + plan lint over a built-in corpus of representative
+  plans lowered by the LIVE planner — every lint run statically
+  re-verifies that the planner still produces dtype-consistent,
+  anti-pattern-free physical plans for the core shapes (the UNION
+  truncation bug would have been caught right here).
+
+Callers with a specific plan in hand (explain(), tests) use
+``lint_exec_tree`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from spark_rapids_tpu.lint.diagnostic import (
+    Diagnostic,
+    filter_at_least,
+    load_baseline,
+    sort_diags,
+    split_new,
+)
+
+
+def lint_exec_tree(root) -> list[Diagnostic]:
+    """Dtype-flow + plan anti-pattern diagnostics for one lowered
+    physical plan (the explain() feed)."""
+    from spark_rapids_tpu.lint.dtype_flow import check_exec_tree
+    from spark_rapids_tpu.lint.plan_rules import check_plan
+
+    return sort_diags(check_exec_tree(root) + check_plan(root))
+
+
+def _corpus_plans(errors: Optional[list] = None):
+    """Lower a handful of representative queries with the live planner
+    and yield their physical roots.  In-memory sources, CPU-friendly:
+    plans are built, never executed.  A query that fails to LOWER is
+    itself a finding (appended to `errors`) — swallowing it would
+    silently shrink the coverage this corpus exists to provide."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.plan.planner import plan_query
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+
+    s = TpuSession()
+    t = pa.table({"k": [1, 2, 1, 3], "v": [1.5, 2.5, 3.5, 4.5],
+                  "s": ["a", "b", "a", "c"]})
+    left = s.create_dataframe(t)
+    right = s.create_dataframe(pa.table({"k": [1, 2], "w": [10, 20]}))
+
+    frames = [
+        # project/filter pipeline
+        left.filter(col("v") > 2.0).select(
+            (col("v") * 2).alias("v2"), col("s")),
+        # partial -> exchange -> final aggregate
+        left.group_by("k").agg((sum_("v"), "sv")),
+        # shuffled equi-join
+        left.join(right, on="k"),
+        # distributed sort
+        left.order_by(col("v")),
+        # union of identically-typed members
+        left.select(col("k")).union(right.select(col("k"))),
+    ]
+    for i, df in enumerate(frames):
+        try:
+            root, _meta = plan_query(df._plan, s.conf)
+        except Exception as exc:  # never crash the linter itself
+            if errors is not None:
+                errors.append(Diagnostic(
+                    "PL000", "warning", f"plan::corpus[{i}]",
+                    f"corpus query failed to lower: "
+                    f"{type(exc).__name__}: {exc}",
+                    hint="a planner regression broke a core query "
+                         "shape; see lint/runner.py _corpus_plans"))
+            continue
+        yield root
+
+
+def run_lint(source: bool = True, registry: bool = True,
+             plans: bool = True,
+             extra_roots: Sequence = ()) -> list[Diagnostic]:
+    """Run the selected analyzers; returns ALL findings (unbaselined)."""
+    out: list[Diagnostic] = []
+    if source:
+        from spark_rapids_tpu.lint.source_rules import check_sources
+
+        out.extend(check_sources())
+    if registry:
+        from spark_rapids_tpu.lint.registry import check_registries
+
+        out.extend(check_registries())
+    roots = list(extra_roots)
+    if plans:
+        roots.extend(_corpus_plans(errors=out))
+    for root in roots:
+        out.extend(lint_exec_tree(root))
+    return sort_diags(out)
+
+
+def evaluate(diags: Sequence[Diagnostic], strict: bool = False,
+             baseline_path: Optional[str] = None
+             ) -> tuple[list[Diagnostic], list[Diagnostic], int]:
+    """(new, accepted, exit_code) against the baseline.  Non-strict
+    fails on new errors; --strict fails on new warnings too."""
+    new, accepted = split_new(list(diags), load_baseline(baseline_path))
+    floor = "warning" if strict else "error"
+    failing = filter_at_least(new, floor)
+    return new, accepted, (1 if failing else 0)

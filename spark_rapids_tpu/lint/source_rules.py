@@ -1,0 +1,320 @@
+"""Engine-source linter: AST pass over spark_rapids_tpu/ flagging
+host-device sync hazards inside traced (jit) regions.
+
+The JAX/TPU analog of a race/sanitizer pass: inside a `jax.jit` trace,
+`.item()`, `float(arr)`, `np.asarray(traced)` and Python `if` on a
+traced boolean either fail at trace time or — far worse, when they
+happen to run on concrete values during warmup paths — silently insert
+a blocking device->host transfer into a hot loop (on the tunneled
+backend each costs a full link round trip, the dominant latency term;
+see execs/base.py's deferred-metric design for how much the codebase
+works to avoid exactly this).
+
+Traced-region discovery (per module, purely syntactic):
+- functions decorated with jit / jax.jit / partial(jax.jit, ...)
+- functions passed by name to jit()/jax.jit()/pjit()/cached_jit()
+  (including `cached_jit(key, lambda: fn)` thunks)
+- Expression.eval methods (signature `eval(self, ctx)`) — they run
+  inside the fused pipeline's trace
+- inner functions returned by `make_*_fn`/`_make_decode` factories —
+  the fusion machinery jits them
+
+Taint: a region's parameters (minus self/cls) are traced values;
+assignments propagate taint; reads through shape/ndim/dtype/size,
+len(), isinstance() etc. are static and clear it.
+
+Rules
+-----
+- SRC001 (error): .item() inside a traced region
+- SRC002 (warning): host materialization of a traced value
+  (np.asarray/np.array/jax.device_get/.tolist()/.block_until_ready())
+- SRC003 (error): Python scalar conversion float()/int()/bool() of a
+  traced value
+- SRC004 (warning): Python if/while branching on a traced boolean
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from spark_rapids_tpu.lint.diagnostic import Diagnostic
+
+#: attribute reads that yield static (trace-time) values — includes the
+#: codebase's shape-derived properties (Column.capacity/width/max_len
+#: are all static functions of array shapes)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "name", "names",
+                "fields", "itemsize", "kind", "capacity", "width",
+                "max_len", "num_cols"}
+#: calls whose results are static regardless of argument taint
+STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type",
+                "repr", "str", "range", "enumerate", "zip", "id"}
+JIT_NAMES = {"jit", "pjit", "cached_jit"}
+FACTORY_NAMES = {"_make_decode"}
+
+
+def _terminal_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    name = _terminal_name(dec)
+    if name in JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = _terminal_name(dec.func)
+        if fname in JIT_NAMES:
+            return True
+        if fname == "partial" and dec.args \
+                and _terminal_name(dec.args[0]) in JIT_NAMES:
+            return True
+    return False
+
+
+def _static_params(fn: ast.FunctionDef) -> set[str]:
+    """Parameter names a jit decorator declares static
+    (static_argnames / static_argnums): host values, never traced."""
+    out: set[str] = set()
+    all_args = fn.args.posonlyargs + fn.args.args
+    for dec in fn.decorator_list:
+        if not (isinstance(dec, ast.Call) and _is_jit_decorator(dec)):
+            continue
+        for kw in dec.keywords:
+            v = kw.value
+            items = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                else [v]
+            if kw.arg == "static_argnames":
+                out |= {x.value for x in items
+                        if isinstance(x, ast.Constant)
+                        and isinstance(x.value, str)}
+            elif kw.arg == "static_argnums":
+                for x in items:
+                    if isinstance(x, ast.Constant) \
+                            and isinstance(x.value, int) \
+                            and x.value < len(all_args):
+                        out.add(all_args[x.value].arg)
+    return out
+
+
+def _is_factory(name: str) -> bool:
+    return name in FACTORY_NAMES or (
+        name.startswith("make_") and name.endswith("_fn")) or (
+        name.startswith("_make_") and name.endswith("_fn"))
+
+
+class _RegionFinder(ast.NodeVisitor):
+    """Collect (FunctionDef, why) traced regions in one module."""
+
+    def __init__(self):
+        self.by_name: dict[str, list[ast.FunctionDef]] = {}
+        self.regions: dict[int, tuple[ast.FunctionDef, str]] = {}
+        self.jit_referenced: set[str] = set()
+        self._parent_fn: list[ast.FunctionDef] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.by_name.setdefault(node.name, []).append(node)
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            self.regions[id(node)] = (node, "@jit")
+        elif node.name == "eval" and len(node.args.args) >= 2 \
+                and node.args.args[0].arg == "self" \
+                and node.args.args[1].arg == "ctx":
+            self.regions[id(node)] = (node, "Expression.eval")
+        elif self._parent_fn and _is_factory(self._parent_fn[-1].name):
+            self.regions[id(node)] = (
+                node, f"returned by {self._parent_fn[-1].name}")
+        self._parent_fn.append(node)
+        self.generic_visit(node)
+        self._parent_fn.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _terminal_name(node.func) in JIT_NAMES:
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    self.jit_referenced.add(a.id)
+                elif isinstance(a, ast.Lambda) \
+                        and isinstance(a.body, ast.Name):
+                    self.jit_referenced.add(a.body.id)
+        self.generic_visit(node)
+
+    def finish(self) -> list[tuple[ast.FunctionDef, str]]:
+        for name in self.jit_referenced:
+            for fn in self.by_name.get(name, []):
+                self.regions.setdefault(id(fn), (fn, "passed to jit()"))
+        return list(self.regions.values())
+
+
+class _Taint:
+    def __init__(self, params: set[str]):
+        self.names = set(params)
+
+    def expr(self, e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.names
+        if isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return False
+            return self.expr(e.value)
+        if isinstance(e, ast.Call):
+            fname = _terminal_name(e.func)
+            if fname in STATIC_CALLS:
+                return False
+            parts = [e.func] + list(e.args) \
+                + [k.value for k in e.keywords]
+            return any(self.expr(x) for x in parts)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False  # identity tests are static
+            return any(self.expr(x) for x in [e.left] + e.comparators)
+        if isinstance(e, ast.Lambda):
+            return False
+        return any(self.expr(c) for c in ast.iter_child_nodes(e)
+                   if isinstance(c, ast.expr))
+
+
+class _RegionChecker(ast.NodeVisitor):
+    def __init__(self, region: ast.FunctionDef, why: str, path: str,
+                 out: list[Diagnostic]):
+        self.path = path
+        self.why = why
+        self.qual = region.name
+        params = {a.arg for a in (region.args.posonlyargs
+                                  + region.args.args
+                                  + region.args.kwonlyargs)}
+        params.discard("self")
+        params.discard("cls")
+        params -= _static_params(region)
+        self.taint = _Taint(params)
+        self.out = out
+
+    def _loc(self) -> str:
+        return f"{self.path}::{self.qual}"
+
+    def _emit(self, rule: str, severity: str, node: ast.AST,
+              message: str, hint: str = "") -> None:
+        self.out.append(Diagnostic(
+            rule, severity, self._loc(),
+            f"{message} (traced region: {self.why})", hint=hint,
+            line=getattr(node, "lineno", 0)))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if self.taint.expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.taint.names.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            self.taint.names.add(el.id)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fname = _terminal_name(node.func)
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item" and not node.args \
+                    and self.taint.expr(node.func.value):
+                self._emit(
+                    "SRC001", "error", node,
+                    "`.item()` forces a blocking device->host sync",
+                    hint="keep the value on device, or move the read "
+                         "outside the traced region")
+            elif node.func.attr in ("tolist", "block_until_ready") \
+                    and self.taint.expr(node.func.value):
+                self._emit(
+                    "SRC002", "warning", node,
+                    f"`.{node.func.attr}()` materializes a traced "
+                    "value on the host")
+            elif node.func.attr in ("asarray", "array") \
+                    and _terminal_name(node.func.value) in ("np",
+                                                            "numpy") \
+                    and any(self.taint.expr(a) for a in node.args):
+                self._emit(
+                    "SRC002", "warning", node,
+                    "np.asarray/np.array on a traced value forces a "
+                    "host transfer (or fails at trace time)",
+                    hint="use jnp.asarray, or hoist the conversion "
+                         "out of the traced region")
+            elif node.func.attr == "device_get" \
+                    and _terminal_name(node.func.value) == "jax":
+                self._emit(
+                    "SRC002", "warning", node,
+                    "jax.device_get inside a traced region blocks on "
+                    "the device")
+        elif fname in ("float", "int", "bool") and len(node.args) == 1 \
+                and self.taint.expr(node.args[0]):
+            self._emit(
+                "SRC003", "error", node,
+                f"{fname}() of a traced value fails at trace time "
+                "(ConcretizationTypeError) or hides a host sync",
+                hint="keep the computation in jnp, or compute the "
+                     "scalar before tracing")
+        self.generic_visit(node)
+
+    def _check_branch(self, node, kind: str) -> None:
+        if self.taint.expr(node.test):
+            self._emit(
+                "SRC004", "warning", node,
+                f"Python `{kind}` on a traced boolean: the branch is "
+                "resolved at TRACE time, not per batch",
+                hint="use jnp.where / lax.cond, or branch on static "
+                     "metadata (shape/dtype) only")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+
+def lint_source_text(src: str, path: str) -> list[Diagnostic]:
+    """Lint one module's source text (unit-test entry point)."""
+    out: list[Diagnostic] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        out.append(Diagnostic(
+            "SRC000", "error", path, f"syntax error: {exc}",
+            line=exc.lineno or 0))
+        return out
+    finder = _RegionFinder()
+    finder.visit(tree)
+    for region, why in finder.finish():
+        _RegionChecker(region, why, path, out).visit(region)
+    return out
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_source_files(root: Optional[str] = None) -> Iterable[str]:
+    root = root or _package_root()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith(("_", ".")))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def check_sources(root: Optional[str] = None) -> list[Diagnostic]:
+    """Lint every engine source file under spark_rapids_tpu/."""
+    root = root or _package_root()
+    base = os.path.dirname(root)
+    out: list[Diagnostic] = []
+    for path in iter_source_files(root):
+        with open(path) as f:
+            src = f.read()
+        rel = os.path.relpath(path, base)
+        out.extend(lint_source_text(src, rel))
+    return out

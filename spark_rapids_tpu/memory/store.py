@@ -125,6 +125,13 @@ def _col_leaves(c, prefix: str) -> list[tuple[str, object]]:
             out += [(f"{prefix}_codes", c.codes),
                     (f"{prefix}_dchars", c.dict_chars),
                     (f"{prefix}_dlens", c.dict_lens)]
+            if c.dict_len is not None:
+                # static entry-count bound: a host scalar leaf (passes
+                # device_get untouched, skipped by _delete) — dropping
+                # it would demote restored keys to padded-capacity
+                # domains and fork the pytree aux
+                out.append((f"{prefix}_dictlen",
+                            np.asarray(c.dict_len, np.int64)))
         return out
     if isinstance(c, ListColumn):
         return [(f"{prefix}_lvalues", c.values),
@@ -149,6 +156,9 @@ def _col_leaves(c, prefix: str) -> list[tuple[str, object]]:
         # a restored group-by key to the lexsort path
         out += [(f"{prefix}_codes", c.codes),
                 (f"{prefix}_dvals", c.dict_values)]
+        if c.dict_len is not None:
+            out.append((f"{prefix}_dictlen",
+                        np.asarray(c.dict_len, np.int64)))
     return out
 
 
@@ -195,7 +205,8 @@ def _host_to_col(arrays: dict, prefix: str, dtype: T.DataType):
             jnp.asarray(arrays[f"{prefix}_dchars"])
             if codes is not None else None,
             jnp.asarray(arrays[f"{prefix}_dlens"])
-            if codes is not None else None)
+            if codes is not None else None,
+            _restore_dict_len(arrays, prefix))
     if isinstance(dtype, T.ListType):
         return ListColumn(
             jnp.asarray(arrays[f"{prefix}_lvalues"]),
@@ -220,7 +231,13 @@ def _host_to_col(arrays: dict, prefix: str, dtype: T.DataType):
                   jnp.asarray(arrays[f"{prefix}_valid"]), dtype,
                   None if codes is None else jnp.asarray(codes),
                   None if codes is None
-                  else jnp.asarray(arrays[f"{prefix}_dvals"]))
+                  else jnp.asarray(arrays[f"{prefix}_dvals"]),
+                  _restore_dict_len(arrays, prefix))
+
+
+def _restore_dict_len(arrays: dict, prefix: str):
+    v = arrays.get(f"{prefix}_dictlen")
+    return None if v is None else int(np.asarray(v))
 
 
 def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:

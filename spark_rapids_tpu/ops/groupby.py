@@ -104,7 +104,7 @@ def _coded_key_domains(key_cols: Sequence[AnyColumn]) -> Optional[list[int]]:
         if getattr(kc, "codes", None) is None:
             return None
         if isinstance(kc, StringColumn):
-            k = int(kc.dict_chars.shape[0])
+            padded = int(kc.dict_chars.shape[0])
         else:
             if isinstance(kc.dtype, (T.FloatType, T.DoubleType)):
                 # a Parquet dictionary may hold -0.0 and 0.0 (or two
@@ -112,7 +112,13 @@ def _coded_key_domains(key_cols: Sequence[AnyColumn]) -> Optional[list[int]]:
                 # split groups SQL merges.  Float keys take the sort
                 # path, whose keys normalize both.
                 return None
-            k = int(kc.dict_values.shape[0])
+            padded = int(kc.dict_values.shape[0])
+        # the wire pads the dictionary to its pow2 capacity bucket; a
+        # tight (16-bucketed) bound on the true entry count rides in
+        # dict_len — using the padded capacity would overestimate the
+        # combined domain (compounding per key), spuriously exceeding
+        # MAX_CODED_DOMAIN and padding the segment matrix
+        k = kc.dict_len if kc.dict_len is not None else padded
         ks.append(k)
         total *= k + 1  # +1: the NULL group rides past the dictionary
         if total > MAX_CODED_DOMAIN:

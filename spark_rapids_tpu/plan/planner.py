@@ -193,6 +193,9 @@ AGG_SIGS: dict[type, TS.ExprSig] = {
                          + TS.BOOLEAN + TS.NULLSIG),
     AG.Last: TS.ExprSig(TS.NUMERIC + TS.DECIMAL + TS.DATETIME
                         + TS.BOOLEAN + TS.NULLSIG),
+    AG.PivotFirst: TS.ExprSig(
+        TS.NUMERIC + TS.DECIMAL + TS.DATETIME + TS.BOOLEAN + TS.NULLSIG,
+        "expanded into one masked First per pivot value"),
 }
 
 

@@ -37,12 +37,41 @@ ExprLike = Union[str, Expression]
 AggLike = Union[NamedAgg, tuple]
 
 
+class AnalysisException(TypeError):
+    """Engine-layer analysis failure (ref: Spark's AnalysisException):
+    the plan is rejected before execution — e.g. UNION members with no
+    common column type.  Subclasses TypeError so generic type-error
+    handling keeps working, but frontends should catch THIS (a blanket
+    `except TypeError` would rebrand incidental engine bugs as user
+    errors)."""
+
+
 def col(name: str) -> ColumnReference:
     return ColumnReference(name)
 
 
 def _expr(e: ExprLike) -> Expression:
     return ColumnReference(e) if isinstance(e, str) else e
+
+
+def _coerce_union_member(plan: "L.LogicalPlan",
+                         widened: Sequence[Optional[T.DataType]]):
+    """Project a UNION member onto the widened column types (positional
+    bound references: name-based ones would resolve duplicate output
+    names to the first occurrence); no-op when nothing changes."""
+    from spark_rapids_tpu.exprs.base import Alias, BoundReference
+    from spark_rapids_tpu.exprs.cast import Cast
+
+    exprs: list[Expression] = []
+    changed = False
+    for i, (f, ct) in enumerate(zip(plan.schema.fields, widened)):
+        ref = BoundReference(i, f.dtype, f.nullable, f.name)
+        if ct is not None and f.dtype != ct:
+            exprs.append(Alias(Cast(ref, ct), f.name))
+            changed = True
+        else:
+            exprs.append(ref)
+    return L.Project(exprs, plan) if changed else plan
 
 
 # function-style aggregate constructors (pyspark.sql.functions shape)
@@ -709,7 +738,34 @@ class DataFrame:
             self._session)
 
     def union(self, other: "DataFrame") -> "DataFrame":
-        return DataFrame(L.Union([self._plan, other._plan]), self._session)
+        """Spark's WidenSetOperationTypes, enforced at the engine layer
+        (every frontend funnels through here): members are coerced
+        per-column to a common type, or analysis fails.  Without this,
+        TpuUnionExec re-tags every member batch with the first member's
+        schema, silently truncating e.g. DOUBLE data shipped under an
+        INT tag.  The lint dtype-flow checker (DT001) remains the
+        backstop for hand-built L.Union plans that bypass this method."""
+        lf, rf = self.schema.fields, other.schema.fields
+        if len(lf) != len(rf):
+            raise AnalysisException(
+                f"UNION members must have the same column count "
+                f"({len(lf)} vs {len(rf)})")
+        widened: list[Optional[T.DataType]] = []
+        for i, (a, b) in enumerate(zip(lf, rf)):
+            if a.dtype == b.dtype:
+                widened.append(None)
+                continue
+            ct = T.common_type(a.dtype, b.dtype)
+            if ct is None:
+                raise AnalysisException(
+                    f"UNION member column {i + 1} ({a.name!r}) has "
+                    f"incompatible types {a.dtype.name} and "
+                    f"{b.dtype.name}")
+            widened.append(ct)
+        return DataFrame(
+            L.Union([_coerce_union_member(self._plan, widened),
+                     _coerce_union_member(other._plan, widened)]),
+            self._session)
 
     def order_by(self, *keys, desc: bool = False) -> "DataFrame":
         sks = []
@@ -884,8 +940,17 @@ class DataFrame:
                 yield tuple(c[i] for c in cols)
 
     def explain(self) -> str:
-        _, meta = plan_query(self._plan, self._session.conf)
-        return meta.explain()
+        exec_, meta = plan_query(self._plan, self._session.conf)
+        out = meta.explain()
+        # static-analysis findings over the lowered physical plan
+        # (tpulint dtype-flow + plan anti-patterns; docs/lint.md)
+        from spark_rapids_tpu.lint import lint_exec_tree
+
+        diags = lint_exec_tree(exec_)
+        if diags:
+            out += "Lint:\n" + "\n".join(
+                "  " + d.render() for d in diags) + "\n"
+        return out
 
     def __repr__(self) -> str:
         return f"DataFrame[{self.schema}]"

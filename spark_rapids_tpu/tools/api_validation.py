@@ -86,6 +86,9 @@ _RENAMES = {
                         "UnixTimestampFromTs"),
     "ScalarSubquery": ("spark_rapids_tpu.exprs.subquery",
                        "ScalarSubquery"),
+    # ANSI cast is the same Cast evaluator under the ansi.enabled conf
+    # (the GpuCast.scala:166 ANSI matrix lives in exprs/cast.py)
+    "AnsiCast": ("spark_rapids_tpu.exprs.cast", "Cast"),
 }
 
 
@@ -234,6 +237,19 @@ def validate() -> dict:
     }
 
 
+def assert_no_drift() -> None:
+    """Hard pass: raise when the exec map names implementations that no
+    longer resolve (the lint REG005 rule; tpulint calls this module the
+    same way).  Missing-by-design entries (None) are fine — only DRIFT
+    (a named module/class that vanished) fails."""
+    drift = validate()["exec_drift"]
+    if drift:
+        raise AssertionError(
+            "api_validation exec map drift (implementation vanished): "
+            + ", ".join(drift)
+            + " — update _EXEC_MAP in tools/api_validation.py")
+
+
 def coverage_md() -> str:
     v = validate()
     eo, em = v["expressions"]
@@ -267,3 +283,12 @@ def coverage_md() -> str:
         "",
     ]
     return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    assert_no_drift()
+    v = validate()
+    eo, em = v["expressions"]
+    xo, xm, _ = v["execs"]
+    print(f"expressions {len(eo)} supported / {len(em)} missing; "
+          f"execs {len(xo)} supported / {len(xm)} missing; no drift")

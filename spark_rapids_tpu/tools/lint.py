@@ -1,0 +1,85 @@
+"""tpulint CLI: static analysis for plans, registries, and engine
+source.
+
+Usage::
+
+    python -m spark_rapids_tpu.tools.lint [options]
+
+    --strict            fail on NEW warnings too (default: new errors)
+    --baseline PATH     accepted-findings file
+                        (default: spark_rapids_tpu/lint/baseline.json)
+    --update-baseline   accept all current findings and rewrite the
+                        baseline file
+    --json              machine-readable output
+    --no-source / --no-registry / --no-plans
+                        skip individual analyzers
+
+Exit status: 0 when every finding at/above the failing severity is in
+the baseline; 1 otherwise.  Rule ids and examples: docs/lint.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.lint",
+        description="tpulint: static analysis for plans, registries, "
+                    "and engine source (rules: docs/lint.md)")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on new warnings too")
+    ap.add_argument("--baseline", default=None,
+                    help="accepted-findings file")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept all current findings")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--no-source", action="store_true")
+    ap.add_argument("--no-registry", action="store_true")
+    ap.add_argument("--no-plans", action="store_true")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.lint import (
+        evaluate,
+        run_lint,
+        save_baseline,
+    )
+
+    diags = run_lint(source=not args.no_source,
+                     registry=not args.no_registry,
+                     plans=not args.no_plans)
+
+    if args.update_baseline:
+        path = save_baseline(diags, args.baseline)
+        print(f"baseline updated: {path} ({len(diags)} accepted)")
+        return 0
+
+    new, accepted, code = evaluate(diags, strict=args.strict,
+                                   baseline_path=args.baseline)
+    if args.json:
+        print(json.dumps({
+            "new": [d.to_json() for d in new],
+            "accepted": [d.to_json() for d in accepted],
+            "exit": code,
+        }, indent=1))
+        return code
+    for d in new:
+        print(d.render())
+    if accepted:
+        print(f"[{len(accepted)} baselined finding(s) suppressed]")
+    if new:
+        print(f"{len(new)} new finding(s)")
+    if code:
+        print("tpulint: FAIL (new findings at failing severity; fix "
+              "them or --update-baseline)")
+    else:
+        print("tpulint: OK")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -249,6 +249,13 @@ INTEGRAL_TYPES = (BYTE, SHORT, INT, LONG)
 NUMERIC_TYPES = INTEGRAL_TYPES + (FLOAT, DOUBLE)
 ALL_BASIC_TYPES = NUMERIC_TYPES + (BOOLEAN, STRING, DATE, TIMESTAMP)
 
+#: decimal integral digits needed to hold each integral type losslessly
+#: (Spark's DecimalType.forType precision counts).  Shared by
+#: common_type and Cast.cast_supported: they MUST agree, or union
+#: widening would pick a target the cast then rejects.
+INTEGRAL_DECIMAL_DIGITS = {ByteType: 3, ShortType: 5, IntegerType: 10,
+                           LongType: 19}
+
 
 _NUMPY_DTYPES = {
     BooleanType: np.bool_,
@@ -412,4 +419,34 @@ def common_type(a: DataType, b: DataType) -> Optional[DataType]:
     ta, tb = type(a), type(b)
     if ta in order and tb in order:
         return [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE][max(order[ta], order[tb])]
+    if ta is DecimalType and tb is DecimalType:
+        # Spark's DecimalPrecision.widerDecimalType: keep every integral
+        # digit and every fractional digit of both sides.  Past the
+        # int64-backed MAX_PRECISION Spark starts dropping scale; this
+        # engine cannot (no 128-bit unscaled), so that pair has no
+        # lossless common type here.
+        scale = max(a.scale, b.scale)
+        integral = max(a.precision - a.scale, b.precision - b.scale)
+        if integral + scale > DecimalType.MAX_PRECISION:
+            return None
+        return DecimalType(integral + scale, scale)
+    if ta is DecimalType or tb is DecimalType:
+        dec, other = (a, b) if ta is DecimalType else (b, a)
+        if type(other) in (FloatType, DoubleType):
+            # Spark's DecimalPrecision: decimal + fractional -> double
+            return DOUBLE
+        # integral -> decimal via DecimalType.forType digit counts;
+        # LONG needs 19 integral digits, past the int64-backed
+        # MAX_PRECISION, so decimal+long has no lossless common type
+        digits = INTEGRAL_DECIMAL_DIGITS.get(type(other))
+        if digits is None:
+            return None
+        integral = max(dec.precision - dec.scale, digits)
+        if integral + dec.scale > DecimalType.MAX_PRECISION:
+            return None
+        return DecimalType(integral + dec.scale, dec.scale)
+    if {ta, tb} == {DateType, TimestampType}:
+        # Spark's findWiderTypeForTwo promotes date+timestamp to
+        # timestamp (the date side casts to midnight UTC)
+        return TIMESTAMP
     return None

@@ -130,3 +130,34 @@ def test_decimal_round_trip():
     back = to_arrow(batch)
     assert back.column("dec").to_pylist() == [
         decimal.Decimal("1.23"), None, decimal.Decimal("-99.99")]
+
+
+def test_strip_dict_sidecar_clears_cache_keying_aux():
+    """Stripping the dict sidecar for D2H must also clear dict_len:
+    it is jit-cache-keying aux (tree_flatten), so a stale value on a
+    dictionary-less column would give two otherwise-identical batches
+    distinct treedefs and compile separate shrink/fetch programs."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.arrow import _strip_dict_sidecar
+
+    plain = Column.from_numpy(np.array([5, 7, 5, 7]), T.LONG)
+    coded = dataclasses.replace(
+        plain, codes=jnp.asarray([0, 1, 0, 1] + [0] * 4),
+        dict_values=jnp.asarray([5, 7, 0, 0], jnp.int64), dict_len=16)
+    s_plain = StringColumn.from_list(["a", "b", "a", "b"])
+    s_coded = dataclasses.replace(
+        s_plain, codes=jnp.asarray([0, 1, 0, 1] + [0] * 4),
+        dict_chars=s_plain.chars[:2], dict_lens=s_plain.lengths[:2],
+        dict_len=16)
+    schema = T.Schema([T.Field("x", T.LONG, True),
+                       T.Field("s", T.STRING, True)])
+    out = _strip_dict_sidecar(ColumnarBatch([coded, s_coded], 4, schema))
+    for c, ref in zip(out.columns, (plain, s_plain)):
+        assert c.codes is None and c.dict_len is None
+        _, t_stripped = jax.tree_util.tree_flatten(c)
+        _, t_plain = jax.tree_util.tree_flatten(ref)
+        assert t_stripped == t_plain

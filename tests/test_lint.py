@@ -1,0 +1,330 @@
+"""tpulint: seeded-violation fixtures per analyzer + the repo-clean
+gate that hooks the linter into the tier-1 test run."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs import base as B
+from spark_rapids_tpu.lint import evaluate, lint_exec_tree, run_lint
+from spark_rapids_tpu.lint.source_rules import lint_source_text
+from spark_rapids_tpu.session import TpuSession, col
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def rules(diags):
+    return {d.rule for d in diags}
+
+
+# -- dtype-flow checker ------------------------------------------------- #
+
+def test_dtype_flow_flags_prefix_union_truncation(session):
+    """The round-5 UNION bug, reconstructed: an INT member unioned with
+    a DOUBLE member.  DataFrame.union now widens, so the mismatched
+    plan is built from raw L.Union — the hand-built-plan class the
+    checker exists to backstop.  It must flag it WITHOUT executing."""
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    a = session.create_dataframe(
+        pa.table({"x": pa.array([1, 2], pa.int32())}))
+    b = session.create_dataframe(pa.table({"x": [1.5, 2.5]}))
+    root, _meta = plan_query(L.Union([a._plan, b._plan]), session.conf)
+    diags = lint_exec_tree(root)
+    dt = [d for d in diags if d.rule == "DT001"]
+    assert dt, f"DT001 expected, got {diags}"
+    assert dt[0].severity == "error"
+    assert "double" in dt[0].message and "int" in dt[0].message
+    # ... and the seeded violation makes the evaluation gate fail
+    assert evaluate(diags)[2] != 0
+
+
+def test_dtype_flow_clean_union_is_silent(session):
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    a = session.create_dataframe(pa.table({"x": [1, 2]}))
+    b = session.create_dataframe(pa.table({"x": [3, 4]}))
+    root, _ = plan_query(a.union(b)._plan, session.conf)
+    assert "DT001" not in rules(lint_exec_tree(root))
+
+
+def test_dtype_flow_flags_stale_bound_reference(session):
+    """Seed a DT002: a projection whose BoundReference declares DOUBLE
+    over an INT input column (the stale-binding class)."""
+    from spark_rapids_tpu.execs.basic import (
+        TpuBatchSourceExec,
+        TpuProjectExec,
+    )
+
+    schema = T.Schema([T.Field("x", T.INT, True)])
+    src = TpuBatchSourceExec([], schema)
+    stale = B.BoundReference(0, T.DOUBLE, True, "x")
+    root = TpuProjectExec([stale], src)
+    diags = lint_exec_tree(root)
+    assert "DT002" in rules(diags)
+    assert evaluate(diags)[2] != 0
+
+
+def test_dtype_flow_flags_nonboolean_filter(session):
+    from spark_rapids_tpu.execs.basic import (
+        TpuBatchSourceExec,
+        TpuFilterExec,
+    )
+
+    schema = T.Schema([T.Field("x", T.LONG, True)])
+    src = TpuBatchSourceExec([], schema)
+    root = TpuFilterExec(col("x") + 1, src)  # long-typed "condition"
+    assert "DT004" in rules(lint_exec_tree(root))
+
+
+def test_explain_surfaces_lint_findings(session):
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.session import DataFrame
+
+    a = session.create_dataframe(
+        pa.table({"x": pa.array([1, 2], pa.int32())}))
+    b = session.create_dataframe(pa.table({"x": [1.5, 2.5]}))
+    # raw L.Union: DataFrame.union would widen the mismatch away
+    out = DataFrame(L.Union([a._plan, b._plan]), session).explain()
+    assert "Lint:" in out and "DT001" in out
+
+
+# -- plan linter -------------------------------------------------------- #
+
+@dataclasses.dataclass(repr=False)
+class _Opaque(B.Expression):
+    """Deliberately unregistered expression: tagging must fall back."""
+
+    child: B.Expression
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    def eval(self, ctx):  # pragma: no cover - never executed
+        return self.child.eval(ctx)
+
+
+def test_plan_lint_flags_fallback_island(session):
+    """TPU filter over a CPU-falling-back project over a TPU project:
+    the classic device->host->device bounce."""
+    from spark_rapids_tpu.plan.planner import CpuFallbackExec, plan_query
+
+    df = session.create_dataframe(pa.table({"v": [1.0, 2.0, 3.0]}))
+    mid = df.select((col("v") * 2).alias("v2"))
+    island = mid.select(_Opaque(col("v2")).alias("u"))
+    top = island.filter(col("u") > 2.0)
+    root, meta = plan_query(top._plan, session.conf)
+    # precondition: the plan really contains a sandwiched fallback
+    assert any(isinstance(n, CpuFallbackExec) for n in root._walk())
+    diags = lint_exec_tree(root)
+    pl = [d for d in diags if d.rule == "PL001"]
+    assert pl, f"PL001 expected, got {diags}"
+    assert "device->host->device" in pl[0].message
+    assert evaluate(diags, strict=True)[2] != 0
+
+
+def test_plan_lint_flags_sort_under_sort(session):
+    df = session.create_dataframe(pa.table({"a": [3, 1], "b": [1, 2]}))
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    double_sorted = df.order_by(col("a")).order_by(col("b"))
+    root, _ = plan_query(double_sorted._plan, session.conf)
+    diags = lint_exec_tree(root)
+    assert "PL004" in rules(diags)
+    assert evaluate(diags, strict=True)[2] != 0
+
+
+def test_plan_lint_nondeterministic_above_exchange(session):
+    from spark_rapids_tpu.execs.basic import (
+        TpuBatchSourceExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exprs.nondeterministic import Rand
+    from spark_rapids_tpu.ops.partition import HashPartitioning
+
+    schema = T.Schema([T.Field("k", T.LONG, True)])
+    src = TpuBatchSourceExec([], schema)
+    ex = TpuShuffleExchangeExec(
+        HashPartitioning([col("k")], 2), src)
+    root = TpuProjectExec([col("k"), B.Alias(Rand(seed=7), "r")], ex)
+    diags = lint_exec_tree(root)
+    assert "PL003" in rules(diags)
+    assert "PL002" in rules(diags)  # raw batches straight into shuffle
+    assert evaluate(diags, strict=True)[2] != 0
+
+
+def test_corpus_lowering_failure_is_a_finding(monkeypatch):
+    """A planner regression that breaks a corpus query must surface as
+    PL000 instead of silently shrinking lint coverage."""
+    from spark_rapids_tpu.plan import planner as PL
+
+    def boom(plan, conf=None):
+        raise RuntimeError("planner regression")
+
+    monkeypatch.setattr(PL, "plan_query", boom)
+    diags = run_lint(source=False, registry=False)
+    assert any(d.rule == "PL000" and "planner regression" in d.message
+               for d in diags)
+    assert evaluate(diags, strict=True)[2] != 0
+
+
+# -- registry checker --------------------------------------------------- #
+
+def test_registry_flags_unregistered_evaluator(monkeypatch):
+    from spark_rapids_tpu.exprs.hashing import Md5
+    from spark_rapids_tpu.lint.registry import check_registries
+    from spark_rapids_tpu.plan import planner as PL
+
+    monkeypatch.delitem(PL.SUPPORTED_EXPRS, Md5)
+    diags = check_registries()
+    hits = [d for d in diags
+            if d.rule == "REG004" and "Md5" in d.message]
+    assert hits, f"REG004 for Md5 expected, got {diags}"
+    assert evaluate(diags, strict=True)[2] != 0
+
+
+def test_registry_flags_missing_typesig(monkeypatch):
+    from spark_rapids_tpu.exprs.hashing import Md5
+    from spark_rapids_tpu.lint.registry import check_registries
+    from spark_rapids_tpu.plan import planner as PL
+
+    monkeypatch.delitem(PL.EXPR_SIGS, Md5)
+    assert any(d.rule == "REG001" and "Md5" in d.message
+               for d in check_registries())
+
+
+def test_registry_flags_missing_agg_sig(monkeypatch):
+    from spark_rapids_tpu.exprs.aggregates import PivotFirst
+    from spark_rapids_tpu.lint.registry import check_registries
+    from spark_rapids_tpu.plan import planner as PL
+
+    monkeypatch.delitem(PL.AGG_SIGS, PivotFirst)
+    assert any(d.rule == "REG006" and "PivotFirst" in d.message
+               for d in check_registries())
+
+
+def test_registry_flags_missing_doc_row(tmp_path):
+    from spark_rapids_tpu.lint.registry import check_registries
+
+    # an empty docs dir: every registered entry lacks its row
+    (tmp_path / "supported_ops.md").write_text("# nothing\n")
+    diags = check_registries(docs_dir=str(tmp_path))
+    assert sum(d.rule == "REG003" for d in diags) > 100
+
+
+def test_api_validation_drift_is_hard(monkeypatch):
+    from spark_rapids_tpu.tools import api_validation as AV
+
+    monkeypatch.setitem(AV._EXEC_MAP, "FilterExec",
+                        ("spark_rapids_tpu.execs.basic", "Gone", ""))
+    with pytest.raises(AssertionError, match="FilterExec"):
+        AV.assert_no_drift()
+    from spark_rapids_tpu.lint.registry import check_registries
+
+    assert any(d.rule == "REG005" and "FilterExec" in d.message
+               for d in check_registries())
+
+
+# -- engine-source linter ----------------------------------------------- #
+
+_ITEM_FIXTURE = """
+import jax
+
+@jax.jit
+def hot(x):
+    return x.sum().item()
+"""
+
+_BRANCH_FIXTURE = """
+import jax.numpy as jnp
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("flag",))
+def f(x, flag):
+    if flag:            # static: fine
+        x = x + 1
+    if x.sum() > 0:     # traced: SRC004
+        return float(x[0])   # SRC003
+    return x
+
+def make_batch_fn(self):
+    import numpy as np
+
+    def fn(batch):
+        return np.asarray(batch)  # SRC002 inside the jitted inner fn
+    return fn
+"""
+
+
+def test_source_lint_flags_item_in_jit_region():
+    diags = lint_source_text(_ITEM_FIXTURE, "fixture.py")
+    hits = [d for d in diags if d.rule == "SRC001"]
+    assert hits and hits[0].severity == "error"
+    assert hits[0].line == 6
+    assert evaluate(diags)[2] != 0
+
+
+def test_source_lint_taint_and_static_args():
+    got = rules(lint_source_text(_BRANCH_FIXTURE, "fixture.py"))
+    assert {"SRC002", "SRC003", "SRC004"} <= got
+    # exactly one SRC004: the static-arg branch must NOT be flagged
+    diags = lint_source_text(_BRANCH_FIXTURE, "fixture.py")
+    assert sum(d.rule == "SRC004" for d in diags) == 1
+
+
+def test_source_lint_eval_methods_are_regions():
+    src = """
+class Thing:
+    def eval(self, ctx):
+        v = ctx.batch.columns[0]
+        return v.data.item()
+"""
+    assert "SRC001" in rules(lint_source_text(src, "fixture.py"))
+
+
+def test_source_lint_static_shape_reads_are_clean():
+    src = """
+import jax
+
+@jax.jit
+def f(x):
+    if x.ndim > 1 and x.shape[0] > 4:
+        return x[:4]
+    if x is None:
+        return x
+    n = len(x)
+    if n:
+        return x
+    return x
+"""
+    assert rules(lint_source_text(src, "fixture.py")) == set()
+
+
+# -- the repo gate (tier-1 hook) ---------------------------------------- #
+
+def test_repo_is_clean_or_baselined():
+    """The scripts/lint.sh contract, in-process: the full lint pass over
+    the repo must produce no NEW findings even in --strict mode."""
+    diags = run_lint()
+    new, _accepted, code = evaluate(diags, strict=True)
+    assert code == 0, "new lint findings:\n" + "\n".join(
+        d.render() for d in new)
+
+
+def test_cli_exits_zero_on_repo():
+    from spark_rapids_tpu.tools.lint import main
+
+    # source+registry only: the plan corpus ran in the previous test;
+    # keep the CLI check cheap inside the tier-1 run
+    assert main(["--strict", "--no-plans"]) == 0

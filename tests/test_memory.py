@@ -198,3 +198,36 @@ def test_query_leaves_store_clean():
         assert not leaks, leaks
     finally:
         conf.set(BATCH_SIZE_ROWS.key, old)
+
+
+def test_spill_preserves_dict_len_sidecar():
+    """The dictionary entry-count bound (Column/StringColumn.dict_len)
+    must survive a spill round trip with the rest of the dict sidecar —
+    dropping it demotes restored group-by keys to padded-capacity
+    domains and forks the pytree aux (recompiles)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import Column, StringColumn
+
+    schema = T.Schema([T.Field("k", T.LONG), T.Field("s", T.STRING)])
+    kcol = Column(jnp.arange(16, dtype=jnp.int64),
+                  jnp.ones(16, bool), T.LONG,
+                  codes=jnp.zeros(16, jnp.int32),
+                  dict_values=jnp.zeros(8, jnp.int64), dict_len=3)
+    scol = StringColumn(jnp.zeros((16, 4), jnp.uint8),
+                        jnp.zeros(16, jnp.int32),
+                        jnp.ones(16, bool), T.STRING,
+                        codes=jnp.zeros(16, jnp.int32),
+                        dict_chars=jnp.zeros((8, 4), jnp.uint8),
+                        dict_lens=jnp.zeros(8, jnp.uint16), dict_len=5)
+    b = ColumnarBatch([kcol, scol], 16, schema)
+    store = BufferStore(device_budget=1, host_budget=1 << 30)
+    h = store.register(b, SpillPriorities.COALESCE_PENDING)
+    # a second registration under the 1-byte budget evicts the first
+    h2 = store.register(make_batch(64), SpillPriorities.ACTIVE_ON_DECK)
+    assert h.tier == StorageTier.HOST
+    restored = h.get()
+    assert restored.columns[0].dict_len == 3
+    assert restored.columns[1].dict_len == 5
+    assert restored.columns[0].codes is not None
+    store.close()
