@@ -292,12 +292,16 @@ class _StageTaps:
         fastpar.read_file = self._orig[2]
 
 
-def _q6_breakdown(df) -> dict:
-    """One instrumented collect: where does a q6 iteration go?  The
-    final-fetch figure inlines the wait for any device execution still
-    in flight (dispatch is async) — if the residual is dominated by
-    fetch at near-zero decode/wire time, the bottleneck is the link, not
-    the engine."""
+def _stage_breakdown(df, prefix: str) -> dict:
+    """One instrumented collect: where does an iteration of this query
+    go?  host_decode / wire_upload / final_fetch accumulate wall time
+    in the tapped stages; `other` is the residual — with the software
+    pipeline on, stages OVERLAP, so the residual approximates the
+    non-overlapped compute+dispatch and the four fields can sum past
+    the total.  The final-fetch figure inlines the wait for any device
+    execution still in flight (dispatch is async) — if the residual is
+    dominated by fetch at near-zero decode/wire time, the bottleneck is
+    the link, not the engine."""
     taps = _StageTaps()
     try:
         t0 = time.perf_counter()
@@ -306,12 +310,34 @@ def _q6_breakdown(df) -> dict:
     finally:
         taps.restore()
     return {
-        "q6_stage_host_decode_s": round(taps.host_s, 4),
-        "q6_stage_wire_upload_s": round(taps.wire_s, 4),
-        "q6_stage_final_fetch_s": round(taps.fetch_s, 4),
-        "q6_stage_other_s": round(
+        f"{prefix}_stage_host_decode_s": round(taps.host_s, 4),
+        f"{prefix}_stage_wire_upload_s": round(taps.wire_s, 4),
+        f"{prefix}_stage_final_fetch_s": round(taps.fetch_s, 4),
+        f"{prefix}_stage_other_s": round(
             max(0.0, total - taps.host_s - taps.wire_s - taps.fetch_s),
             4),
+    }
+
+
+def _pipeline_occupancy() -> dict:
+    """Aggregate the software pipeline's stage counters
+    (parallel.pipeline.stage_snapshot) into one occupancy figure:
+    item-weighted mean of each stage's queue-occupancy fraction.  ~1.0
+    means producers stay ahead of consumers (the pipeline is full);
+    ~0.0 means stages run starved/serial.  Per-stage detail rides as a
+    sub-object so round-over-round deltas are attributable."""
+    from spark_rapids_tpu.parallel.pipeline import stage_snapshot
+
+    snap = stage_snapshot()
+    weighted = 0.0
+    items = 0
+    for s in snap.values():
+        if s["items"]:
+            weighted += s["occupancy_fraction"] * s["items"]
+            items += s["items"]
+    return {
+        "pipeline_occupancy": round(weighted / items, 3) if items else 0.0,
+        "pipeline_stages": snap,
     }
 
 
@@ -344,6 +370,7 @@ def _bench_q1(session, d: str) -> dict:
         df.collect(engine="tpu")  # warmup
         tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
+        breakdown = _stage_breakdown(df, "q1")
     finally:
         conf.set(key, old_sp)
     _check_rows(tpu_r, cpu_r, float_from=2, key_cols=2)
@@ -356,6 +383,7 @@ def _bench_q1(session, d: str) -> dict:
         "q1_rows": ROWS_PER_FILE * 2,
     }
     out.update(_stats(tpu_ts, "q1_tpu"))
+    out.update(breakdown)
     return out
 
 
@@ -387,6 +415,7 @@ def _bench_q3(session, d: str) -> dict:
         "q3_rows": ROWS_PER_FILE * 2 + (1 << 20),
     }
     out.update(_stats(tpu_ts, "q3_tpu"))
+    out.update(_stage_breakdown(df, "q3"))
     return out
 
 
@@ -442,7 +471,7 @@ def main() -> None:
         want = cpu_result.to_pydict()["revenue"][0]
         assert abs(got - want) <= 1e-6 * max(1.0, abs(want)), (got, want)
 
-        breakdown = _q6_breakdown(df)
+        breakdown = _stage_breakdown(df, "q6")
 
         if tpu_t > 10.0:
             # degraded tunnel (per-dispatch latency in the seconds):
@@ -473,6 +502,7 @@ def main() -> None:
     out.update(link)
     out.update(breakdown)
     out.update(extra)
+    out.update(_pipeline_occupancy())
     print(json.dumps(out))
 
 

@@ -672,7 +672,10 @@ class EncodedBatch:
         cols = decode_on_device(self.comps, self.plan, self.schema)
         n = self.num_rows
         if n is None:
-            n = int(jax.device_get(self.comps[self.plan[2][1]]))
+            from spark_rapids_tpu.parallel.pipeline import device_read_int
+
+            n = device_read_int(self.comps[self.plan[2][1]],
+                                tag="transfer.decode")
         return ColumnarBatch(cols, n, self.schema)
 
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -484,7 +483,13 @@ class TpuHashAggregateExec(TpuExec):
             # D2H fetch (per-batch device_get round trips dominate
             # grouped-aggregate wall time on high-latency device links)
             if traced:
-                ns = jax.device_get([batches[i].num_rows for i in traced])
+                from spark_rapids_tpu.parallel.pipeline import (
+                    device_read_many,
+                )
+
+                ns = device_read_many(
+                    [batches[i].num_rows for i in traced],
+                    tag="agg.drain")
                 for i, n in zip(traced, ns):
                     batches[i] = dataclasses.replace(batches[i],
                                                      num_rows=int(n))
@@ -528,13 +533,21 @@ class TpuHashAggregateExec(TpuExec):
         #: domain, up to MAX_CODED_DOMAIN).
         DEFER_SYNC_CAP = 1 << 18
 
+        from spark_rapids_tpu.parallel import pipeline as P
+
         pending_rows = 0
-        for batch in source:
+
+        def dispatch(batch):
+            """Async half: the update program for batch k+1 is
+            dispatched before batch k's sizing sync retires (the same
+            lookahead shape as the join probe loop)."""
             with MetricTimer(self.metrics[TOTAL_TIME]) as t:
                 if self.mode == "final":
-                    part = batch  # already partial layout
-                else:
-                    part = t.observe(self._jit_update(_as_device_rows(batch)))
+                    return batch  # already partial layout
+                return t.observe(self._jit_update(_as_device_rows(batch)))
+
+        def retire(part):
+            nonlocal pending_rows
             if (not isinstance(part.num_rows, int)
                     and part.capacity <= DEFER_SYNC_CAP):
                 pending.append(store.register(
@@ -551,11 +564,11 @@ class TpuHashAggregateExec(TpuExec):
                     pending.append(store.register(
                         merged, SpillPriorities.AGGREGATE_PARTIAL))
                     pending_rows = merged.capacity
-                continue
+                return
             # one sizing sync per batch (free when the update emitted a
             # static count, e.g. grand aggregates); pin the host int into
             # the batch so downstream concat/shrink never re-syncs
-            n = part.concrete_num_rows()
+            n = P.device_read_int(part.num_rows, tag="agg.size")
             part = dataclasses.replace(part, num_rows=n)
             part = part.shrink_to_capacity(pad_capacity(n))
             pending.append(store.register(
@@ -566,12 +579,17 @@ class TpuHashAggregateExec(TpuExec):
                     merged = t.observe(self._jit_merge(
                         _as_device_rows(drain_pending())))
                 self.metrics["numMerges"].add(1)
-                pending_rows = merged.concrete_num_rows()  # before register:
-                # a register under pressure may immediately spill `merged`
-                merged = dataclasses.replace(merged, num_rows=pending_rows)
-                merged = merged.shrink_to_capacity(pad_capacity(pending_rows))
+                # sized before register: a register under pressure may
+                # immediately spill `merged`
+                pr = P.device_read_int(merged.num_rows, tag="agg.size")
+                pending_rows = pr
+                merged = dataclasses.replace(merged, num_rows=pr)
+                merged = merged.shrink_to_capacity(pad_capacity(pr))
                 pending.append(store.register(
                     merged, SpillPriorities.AGGREGATE_PARTIAL))
+
+        for _ in P.pipelined(source, dispatch, retire, tag="agg.update"):
+            pass  # retire yields nothing; pipelined drives the overlap
 
         if not pending:
             if self.n_keys > 0 or not emit_empty_default:

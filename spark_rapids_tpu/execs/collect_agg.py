@@ -103,8 +103,11 @@ class TpuCollectAggExec(TpuExec):
         with MetricTimer(self.metrics[TOTAL_TIME]) as t:
             sb, live_s, ng, mk = cached_jit(
                 key + ("p1", big.capacity), lambda: phase1)(big)
+            from spark_rapids_tpu.parallel.pipeline import device_read_many
+
             num_groups, max_kept = (int(x) for x in
-                                    jax.device_get([ng, mk]))
+                                    device_read_many([ng, mk],
+                                                     tag="collect.size"))
             L = pad_width(max(max_kept, 1))
             out_cap = pad_capacity(max(num_groups, 1))
 

@@ -17,7 +17,6 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
-import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -115,25 +114,53 @@ class TpuShuffleExchangeExec(TpuExec):
                         ck, lambda: part.partition_ids)
         from spark_rapids_tpu.columnar.column import pad_capacity
         from spark_rapids_tpu.memory import SpillPriorities, get_store
+        from spark_rapids_tpu.ops.partition import (
+            split_batch_dispatch,
+            split_batch_finish,
+        )
+        from spark_rapids_tpu.parallel import pipeline as P
 
         store = get_store()
         pending: list[tuple[int, object, int, int]] = []
+
+        def dispatch(batch):
+            """Async half: partition-id program + grouping sort for
+            batch k+1 dispatch before batch k's count readback."""
+            sem.acquire_if_necessary(task_id)
+            batch = batch.with_device_num_rows()
+            if pid_fn is None:
+                return batch, None
+            return split_batch_dispatch(batch, pid_fn(batch), n)
+
+        def retire(entry):
+            """Blocking half: ONE batched sizing readback per input
+            batch (previously one sync per REDUCE slice), then register
+            the non-empty slices."""
+            grouped, counts = entry
+            if counts is None:
+                rows = P.device_read_int(grouped.num_rows,
+                                         tag="exchange.split")
+                subs = [(grouped, rows)]
+            else:
+                import numpy as np
+
+                counts_np = np.asarray(
+                    P.device_read(counts, tag="exchange.split"))
+                subs = [(sub, sub.num_rows) for sub in
+                        split_batch_finish(grouped, counts_np, n)]
+            for rid, (sub, rows) in enumerate(subs):
+                if rows:
+                    sub = sub.shrink_to_capacity(pad_capacity(rows))
+                    h = store.register(
+                        sub, SpillPriorities.OUTPUT_FOR_SHUFFLE)
+                    h.unpin()
+                    pending.append((rid, h, h.nbytes, rows))
+
         try:
-            for batch in self.children[0].execute_partition(child_part):
-                sem.acquire_if_necessary(task_id)
-                batch = batch.with_device_num_rows()
-                if pid_fn is None:
-                    subs = [batch]
-                else:
-                    subs = split_batch(batch, pid_fn(batch), n)
-                for rid, sub in enumerate(subs):
-                    rows = sub.concrete_num_rows()
-                    if rows:
-                        sub = sub.shrink_to_capacity(pad_capacity(rows))
-                        h = store.register(
-                            sub, SpillPriorities.OUTPUT_FOR_SHUFFLE)
-                        h.unpin()
-                        pending.append((rid, h, h.nbytes, rows))
+            for _ in P.pipelined(
+                    self.children[0].execute_partition(child_part),
+                    dispatch, retire, tag="exchange.map"):
+                pass
         except BaseException:
             for _rid, h, _b, _r in pending:
                 h.close()
@@ -323,8 +350,20 @@ class TpuShuffleExchangeExec(TpuExec):
             for p in range(n_tasks):
                 fn(p)
             return
+        # conf is THREAD-LOCAL: install the calling (session) thread's
+        # snapshot on every pool thread, or each task silently reads
+        # defaults (batch sizing, pipeline depth/kill-switch, chunk
+        # rows) for everything executing below the exchange
+        from spark_rapids_tpu.config import get_conf, set_conf
+
+        conf = get_conf()
+
+        def run(p: int) -> None:
+            set_conf(conf)
+            fn(p)
+
         with ThreadPoolExecutor(max_workers=threads) as pool:
-            futures = [pool.submit(fn, p) for p in range(n_tasks)]
+            futures = [pool.submit(run, p) for p in range(n_tasks)]
             for f in futures:
                 f.result()
 

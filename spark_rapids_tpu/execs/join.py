@@ -253,13 +253,22 @@ class _HashJoinBase(TpuExec):
     def _join_stream(self, build: Optional[ColumnarBatch],
                      stream_batches) -> Iterator[ColumnarBatch]:
         """Probe every stream batch against the build batch; for
-        full_outer, finish with the unmatched build rows."""
+        full_outer, finish with the unmatched build rows.
+
+        The stream loop is SOFTWARE-PIPELINED (parallel.pipeline): the
+        probe for batch k+1 is dispatched before batch k's single
+        blocking pair-count readback, so JAX's async dispatch runs
+        probe(k+1) concurrently with the readback wait — the one
+        structural serialization BENCH_r05 traced the Q3 deficit to
+        (ref: the reference gets the same overlap from JoinGatherer's
+        bounded gathers + the stream iterator's prefetch)."""
         if build is None:
             if self.join_type in ("inner", "left_semi", "cross"):
                 return  # empty build: no output
             build = self._empty_build()
 
         from spark_rapids_tpu.execs.jit_cache import cached_jit
+        from spark_rapids_tpu.parallel import pipeline as P
 
         jit_probe = cached_jit(self._cache_key() + ("probe",),
                                lambda: self._probe)
@@ -269,11 +278,14 @@ class _HashJoinBase(TpuExec):
         matched_b_acc = None
 
         build = build.with_device_num_rows()
-        for stream in stream_batches:
+
+        def dispatch(stream):
+            """Async half: probe dispatch (+ semi/anti compaction,
+            which needs no readback).  Returns the in-flight state."""
+            nonlocal matched_b_acc
             self.metrics["probeBatches"].add(1)
             out = None
-            n_total = 0
-            with MetricTimer(self.metrics[TOTAL_TIME]):
+            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
                 stream = stream.with_device_num_rows()
                 st, total = jit_probe(build, stream)
                 if self.join_type == "full_outer":
@@ -283,14 +295,23 @@ class _HashJoinBase(TpuExec):
                 if self.join_type in ("left_semi", "left_anti"):
                     keep = st.matched_s if self.join_type == "left_semi" \
                         else (st.live_s & ~st.matched_s)
-                    out = jit_semi_compact(stream, keep)
+                    out = t.observe(jit_semi_compact(stream, keep))
                 else:
-                    n_total = int(jax.device_get(total))
+                    t.observe(total)
+            return stream, st, total, out
+
+        def retire(entry):
+            """Blocking half: the ONE device->host readback per stream
+            batch (the pair count), then the statically-shaped
+            expansion chunks."""
+            stream, st, total, out = entry
             if out is not None:
                 yield self._count_output(out)
-                continue
+                return
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                n_total = P.device_read_int(total, tag="join.probe")
             if not n_total:
-                continue
+                return
             chunk = get_conf().get(JOIN_OUTPUT_CHUNK_ROWS)
             out_cap = pad_capacity(min(n_total, chunk))
             # target-size chunks, spillable between yields (ref:
@@ -300,12 +321,15 @@ class _HashJoinBase(TpuExec):
             # lands in this operator's clock.
             for off in range(0, n_total, out_cap):
                 with MetricTimer(self.metrics[TOTAL_TIME]):
-                    out = self._jit_expand(out_cap)(
+                    o = self._jit_expand(out_cap)(
                         build, stream, st, total,
                         jnp.asarray(off, jnp.int32))
                     if self.condition is not None:
-                        out = self._jit_condition(out)
-                yield self._count_output(out)
+                        o = self._jit_condition(o)
+                yield self._count_output(o)
+
+        yield from P.pipelined(stream_batches, dispatch, retire,
+                               tag="join.probe")
 
         if self.join_type == "full_outer":
             yield from self._emit_unmatched_build(build, matched_b_acc)

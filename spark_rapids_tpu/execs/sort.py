@@ -248,7 +248,10 @@ class TpuSortExec(_SortMixin):
             if not idxs:
                 return
             batches = [handles[i].get() for i in idxs]
-            ns = jax.device_get([b.num_rows for b in batches])
+            from spark_rapids_tpu.parallel.pipeline import device_read_many
+
+            ns = device_read_many([b.num_rows for b in batches],
+                                  tag="sort.size")
             for i, b, nn in zip(idxs, batches, ns):
                 nn = int(nn)
                 total += nn - rows[i]
@@ -306,8 +309,13 @@ class TpuSortExec(_SortMixin):
                     traced = [i for i, bb in enumerate(batches)
                               if not isinstance(bb.num_rows, int)]
                     if traced:
-                        ns = jax.device_get(
-                            [batches[i].num_rows for i in traced])
+                        from spark_rapids_tpu.parallel.pipeline import (
+                            device_read_many,
+                        )
+
+                        ns = device_read_many(
+                            [batches[i].num_rows for i in traced],
+                            tag="sort.size")
                         for i, nn in zip(traced, ns):
                             batches[i] = _dc.replace(batches[i],
                                                      num_rows=int(nn))
@@ -390,7 +398,10 @@ class TpuSortExec(_SortMixin):
                     grouped, counts = jit_group(
                         aug.with_device_num_rows(), bounds)
                     t.observe(grouped)
-                counts_np = np.asarray(jax.device_get(counts))
+                from spark_rapids_tpu.parallel.pipeline import device_read
+
+                counts_np = np.asarray(device_read(counts,
+                                                   tag="sort.split"))
                 import dataclasses as _dc
 
                 grouped = _dc.replace(grouped, num_rows=n)
@@ -717,8 +728,10 @@ class TpuTopNExec(_SortMixin):
             batches = [h.get() for h in pending]
             # ONE batched sizing fetch, then shrink candidates to their
             # (typically O(n)) real size before the final sort
-            ns = [int(v) for v in jax.device_get(
-                [b.num_rows for b in batches])]
+            from spark_rapids_tpu.parallel.pipeline import device_read_many
+
+            ns = [int(v) for v in device_read_many(
+                [b.num_rows for b in batches], tag="sort.size")]
             self.metrics["candidateRows"].add(sum(ns))
             shrunk = []
             for b, nn in zip(batches, ns):

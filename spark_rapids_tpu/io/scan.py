@@ -168,62 +168,19 @@ def _scan_batch_rows(schema: T.Schema) -> int:
     return int(max(1, min(rows_cap, by_bytes, conf.get(MAX_CAPACITY))))
 
 
-def _prefetched(gen, stop_depth: int = 2):
-    """Run a generator on a background thread with a bounded queue so
-    host-side work (footer pruning, Parquet decode) overlaps the
-    consumer's upload + device compute (the cloud-reader thread-pool
-    idea, ref: GpuParquetScan.scala:882-895
-    MultiFileCloudParquetPartitionReader).  Items must stay host-side;
-    device residency belongs to the consuming task thread."""
-    import queue
-    import threading
-    import time
+def _prefetched(gen, stage: str = "scan.decode",
+                depth: Optional[int] = None):
+    """Run a generator on a background pipeline stage so host-side work
+    (footer pruning, Parquet decode) overlaps the consumer's upload +
+    device compute (the cloud-reader thread-pool idea, ref:
+    GpuParquetScan.scala:882-895 MultiFileCloudParquetPartitionReader).
+    Items must stay host-side; device residency belongs to the
+    consuming task thread.  Thin shim over the shared
+    parallel.pipeline stage (clean join-on-abort shutdown, error
+    propagation, occupancy metrics)."""
+    from spark_rapids_tpu.parallel.pipeline import prefetch
 
-    q: "queue.Queue" = queue.Queue(maxsize=stop_depth)
-    stop = threading.Event()
-    _DONE = object()
-
-    def put_or_abort(item) -> None:
-        # never block forever: give up once the consumer signalled stop
-        while True:
-            try:
-                q.put(item, timeout=0.1)
-                return
-            except queue.Full:
-                if stop.is_set():
-                    return
-
-    def producer():
-        try:
-            for item in gen:
-                put_or_abort(item)
-                if stop.is_set():
-                    return
-        except BaseException as e:
-            put_or_abort(e)
-        finally:
-            put_or_abort(_DONE)
-
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()  # producer's put loops notice within 0.1s
-        while True:  # drop whatever it had already queued
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                if not t.is_alive():
-                    break
-                time.sleep(0.01)
-        t.join()
+    return prefetch(gen, depth=depth, stage=stage)
 
 
 class ParquetScanExec(TpuExec):
@@ -564,6 +521,37 @@ class ParquetScanExec(TpuExec):
                                      pa.nulls(tbl.num_rows, ft))
         return tbl
 
+    def _upload_units(self, items):
+        """Accumulate decoded host tables ACROSS row groups and files up
+        to batch_rows; yield upload-ready units — int row counts
+        (zero-column projections) or lists of host tables summing to at
+        most batch_rows.  Pure host work: runs on the decode->upload
+        pipeline stage when the planner inserted one."""
+        acc: list[pa.Table] = []
+        acc_rows = 0
+        pending_count = 0  # zero-column case: rows are pure counts
+        for item in items:
+            if isinstance(item, int):
+                pending_count += item
+                if pending_count >= self.batch_rows:
+                    yield pending_count
+                    pending_count = 0
+                continue
+            acc.append(item)
+            acc_rows += item.num_rows
+            while acc_rows >= self.batch_rows:
+                acc = self._harmonize_dicts(acc)
+                tbl = pa.concat_tables(acc) if len(acc) > 1 else acc[0]
+                head = tbl.slice(0, self.batch_rows)
+                tail = tbl.slice(self.batch_rows)
+                yield [head]
+                acc = [tail] if tail.num_rows else []
+                acc_rows = tail.num_rows
+        if pending_count:
+            yield pending_count
+        if acc_rows:
+            yield acc
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         """Accumulates decoded host tables ACROSS row groups and files
         up to batch_rows, then uploads each accumulated chunk in one
@@ -638,37 +626,27 @@ class ParquetScanExec(TpuExec):
                         pending.append(pool.submit(decode, nxt))
                     yield from done.result()
 
+        from spark_rapids_tpu.parallel import pipeline as P
+
+        depth = getattr(self, "_pipeline_depth", None)
+        if depth is None:
+            depth = P.stage_depth(conf)
+        units = self._upload_units(
+            _prefetched(task(), stage="scan.decode", depth=depth))
+        if depth:
+            # decode->upload boundary: accumulation/slicing (host CPU
+            # work) runs one stage ahead of the consumer's upload +
+            # device compute; units are host tables (no device
+            # residency crosses the stage queue)
+            units = P.prefetch(units, depth=depth, stage="scan.upload")
         empty = True
-        acc: list[pa.Table] = []
-        acc_rows = 0
-        pending_count = 0  # zero-column case: rows are pure counts
-        for item in _prefetched(task()):
-            if isinstance(item, int):
-                pending_count += item
-                if pending_count >= self.batch_rows:
-                    empty = False
-                    yield self._count_output(ColumnarBatch(
-                        [], pending_count, self._schema))
-                    pending_count = 0
-                continue
-            acc.append(item)
-            acc_rows += item.num_rows
-            while acc_rows >= self.batch_rows:
-                acc = self._harmonize_dicts(acc)
-                tbl = pa.concat_tables(acc) if len(acc) > 1 else acc[0]
-                head = tbl.slice(0, self.batch_rows)
-                tail = tbl.slice(self.batch_rows)
-                empty = False
-                yield self._count_output(self._upload([head]))
-                acc = [tail] if tail.num_rows else []
-                acc_rows = tail.num_rows
-        if pending_count:
+        for unit in units:
             empty = False
-            yield self._count_output(
-                ColumnarBatch([], pending_count, self._schema))
-        if acc_rows:
-            empty = False
-            yield self._count_output(self._upload(acc))
+            if isinstance(unit, int):
+                yield self._count_output(
+                    ColumnarBatch([], unit, self._schema))
+            else:
+                yield self._count_output(self._upload(unit))
         if empty and p == 0:
             aschema = schema_to_arrow(self._schema)
             yield self._count_output(

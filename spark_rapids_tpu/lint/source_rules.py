@@ -31,6 +31,14 @@ Rules
 - SRC003 (error): Python scalar conversion float()/int()/bool() of a
   traced value
 - SRC004 (warning): Python if/while branching on a traced boolean
+- SRC005 (warning): raw blocking device->host readback
+  (jax.device_get / .item()) in an exec module (execs/) instead of the
+  software pipeline's deferred-readback helper
+  (parallel.pipeline.device_read / device_read_many) — an inline sync
+  in a stream loop stalls the loop for a full link round trip per
+  batch where the pipelined form overlaps it with the next batch's
+  dispatch.  Intentional syncs (metric settlement, ANSI error polls)
+  are baselined, not suppressed inline.
 """
 
 from __future__ import annotations
@@ -276,6 +284,63 @@ class _RegionChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: call names whose results are the BLESSED readback path — calls inside
+#: parallel/pipeline.py itself, and call sites routed through it
+_PIPELINE_HELPERS = {"device_read", "device_read_int", "device_read_many"}
+
+
+class _ExecSyncChecker(ast.NodeVisitor):
+    """SRC005: raw blocking device->host readbacks inside exec modules.
+
+    Exec `execute`/stream-loop bodies must route their syncs through
+    parallel.pipeline.device_read* so the software pipeline can defer
+    the readback behind the next batch's dispatch (and so tests can
+    trace readback ordering).  Scope is syntactic and module-wide for
+    execs/: a raw sync in ANY exec helper ends up in some per-batch
+    driver path."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _loc(self) -> str:
+        qual = self._fn_stack[-1] if self._fn_stack else "<module>"
+        return f"{self.path}::{qual}"
+
+    def _emit(self, node: ast.AST, what: str) -> None:
+        self.out.append(Diagnostic(
+            "SRC005", "warning", self._loc(),
+            f"{what} is a raw blocking device->host readback in an "
+            "exec body",
+            hint="route it through parallel.pipeline.device_read / "
+                 "device_read_many (pipelined stream loops defer it "
+                 "behind the next batch's dispatch); baseline it only "
+                 "if the sync is intentional",
+            line=getattr(node, "lineno", 0)))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "device_get" \
+                    and _terminal_name(node.func.value) == "jax":
+                self._emit(node, "jax.device_get")
+            elif node.func.attr == "item" and not node.args:
+                self._emit(node, ".item()")
+        self.generic_visit(node)
+
+
+def _is_exec_module(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "execs" in parts
+
+
 def lint_source_text(src: str, path: str) -> list[Diagnostic]:
     """Lint one module's source text (unit-test entry point)."""
     out: list[Diagnostic] = []
@@ -290,6 +355,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
     finder.visit(tree)
     for region, why in finder.finish():
         _RegionChecker(region, why, path, out).visit(region)
+    if _is_exec_module(path):
+        _ExecSyncChecker(path, out).visit(tree)
     return out
 
 

@@ -142,6 +142,38 @@ class SinglePartitioning(Partitioning):
         return "single"
 
 
+def split_batch_dispatch(batch: ColumnarBatch, pids: jax.Array,
+                         n_parts: int):
+    """Device half of split_batch, NO sync: group rows by partition id
+    and count them.  Returns (grouped_batch, device_counts) — the
+    sizing readback is the caller's, so a pipelined map loop can
+    dispatch batch k+1's sort while batch k's counts are in flight."""
+    live = batch.row_mask()
+    key = jnp.where(live, pids, jnp.int32(n_parts))
+    order = jnp.argsort(key, stable=True)
+    grouped = batch.gather(order, batch.num_rows)
+    counts = jax.ops.segment_sum(live.astype(jnp.int32), key,
+                                 num_segments=n_parts)
+    return grouped, counts
+
+
+def split_batch_finish(grouped: ColumnarBatch, counts_np: np.ndarray,
+                       n_parts: int) -> list[ColumnarBatch]:
+    """Slice the per-partition batches once the counts are host-side."""
+    offsets = np.concatenate([[0], np.cumsum(counts_np)])
+    out = []
+    cap = grouped.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    for p in range(n_parts):
+        off, cnt = int(offsets[p]), int(counts_np[p])
+        take = jnp.clip(idx + off, 0, cap - 1)
+        sub = grouped.gather(take, cnt)
+        live_p = idx < cnt
+        cols = [c.with_validity(c.validity & live_p) for c in sub.columns]
+        out.append(ColumnarBatch(cols, cnt, grouped.schema))
+    return out
+
+
 def split_batch(batch: ColumnarBatch, pids: jax.Array, n_parts: int
                 ) -> list[ColumnarBatch]:
     """Group rows by partition id and slice out per-partition batches.
@@ -151,22 +183,8 @@ def split_batch(batch: ColumnarBatch, pids: jax.Array, n_parts: int
         # single destination: the batch IS the slice (grand-aggregate
         # exchanges hit this constantly)
         return [batch]
-    live = batch.row_mask()
-    key = jnp.where(live, pids, jnp.int32(n_parts))
-    order = jnp.argsort(key, stable=True)
-    grouped = batch.gather(order, batch.num_rows)
-    counts = jax.ops.segment_sum(live.astype(jnp.int32), key,
-                                 num_segments=n_parts)
-    counts_np = np.asarray(jax.device_get(counts))
-    offsets = np.concatenate([[0], np.cumsum(counts_np)])
-    out = []
-    cap = batch.capacity
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    for p in range(n_parts):
-        off, cnt = int(offsets[p]), int(counts_np[p])
-        take = jnp.clip(idx + off, 0, cap - 1)
-        sub = grouped.gather(take, cnt)
-        live_p = idx < cnt
-        cols = [c.with_validity(c.validity & live_p) for c in sub.columns]
-        out.append(ColumnarBatch(cols, cnt, batch.schema))
-    return out
+    from spark_rapids_tpu.parallel.pipeline import device_read
+
+    grouped, counts = split_batch_dispatch(batch, pids, n_parts)
+    counts_np = np.asarray(device_read(counts, tag="exchange.split"))
+    return split_batch_finish(grouped, counts_np, n_parts)

@@ -13,3 +13,11 @@ from spark_rapids_tpu.parallel.exchange import (  # noqa: F401
     stack_batches,
     unstack_batch,
 )
+from spark_rapids_tpu.parallel.pipeline import (  # noqa: F401
+    device_read,
+    device_read_int,
+    device_read_many,
+    pipelined,
+    prefetch,
+    stage_snapshot,
+)

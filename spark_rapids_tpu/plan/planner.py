@@ -1352,6 +1352,7 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
         meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
     root = convert_meta(meta)
     _mark_encoded_scans(root)
+    _plan_pipeline(root, conf)
     return root, meta
 
 
@@ -1373,6 +1374,33 @@ def _mark_encoded_scans(root: TpuExec) -> None:
                     isinstance(node, TpuHashAggregateExec)
                     and node.mode != "final"):
                 c.emit_encoded = True
+
+
+def _plan_pipeline(root: TpuExec, conf) -> None:
+    """Choose the software-pipeline stage insertion points for this plan
+    (spark.rapids.tpu.sql.pipeline.*; parallel/pipeline.py): every
+    Parquet/ORC scan gets its scan->decode and decode->upload stages,
+    and the plan root gets the last-exec->fetch stage that collect_exec
+    applies — so compute for batch k+1 dispatches while batch k's
+    result is fetched D2H.  The chosen list is recorded on the root for
+    DataFrame.explain()'s "Pipeline:" section."""
+    from spark_rapids_tpu.io.scan import ParquetScanExec
+    from spark_rapids_tpu.parallel.pipeline import stage_depth
+
+    depth = stage_depth(conf)
+    stages: list[str] = []
+    if depth:
+        for node in root._walk():
+            if isinstance(node, ParquetScanExec):
+                node._pipeline_depth = depth
+                stages.append(
+                    f"{node.name}: scan->decode + decode->upload "
+                    f"stages (depth={depth})")
+        if not isinstance(root, CpuFallbackExec):
+            root._pipeline_fetch = depth
+            stages.append(
+                f"{root.name}: last-exec->fetch stage (depth={depth})")
+    root._pipeline_stages = stages
 
 
 def _schema_device_representable(schema: T.Schema) -> bool:
@@ -1434,7 +1462,22 @@ def collect_exec(exec_: TpuExec) -> pa.Table:
         finally:
             exec_.close()
     try:
-        tables = [to_arrow(b) for b in exec_.execute()]
+        it = exec_.execute()
+        fetch_depth = getattr(exec_, "_pipeline_fetch", 0)
+        if fetch_depth:
+            from spark_rapids_tpu.parallel.pipeline import prefetch
+
+            # last-exec->fetch stage: the producer thread drives the
+            # plan (dispatching device programs) while this thread does
+            # the blocking D2H Arrow fetches — fetch(k) overlaps
+            # compute(k+1); depth bounds device batches in the queue
+            it = prefetch(it, depth=fetch_depth, stage="result.fetch")
+        try:
+            tables = [to_arrow(b) for b in it]
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
     finally:
         exec_.close()  # release shuffle blocks even on partial drains
     aschema = schema_to_arrow(exec_.schema)

@@ -950,6 +950,12 @@ class DataFrame:
         if diags:
             out += "Lint:\n" + "\n".join(
                 "  " + d.render() for d in diags) + "\n"
+        # where the planner inserted software-pipeline stages
+        # (spark.rapids.tpu.sql.pipeline.*; docs/pipeline.md)
+        stages = getattr(exec_, "_pipeline_stages", None)
+        if stages:
+            out += "Pipeline:\n" + "\n".join(
+                "  " + s for s in stages) + "\n"
         return out
 
     def __repr__(self) -> str:

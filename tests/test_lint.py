@@ -311,6 +311,52 @@ def f(x):
     assert rules(lint_source_text(src, "fixture.py")) == set()
 
 
+_SYNC_FIXTURE = """
+import jax
+
+class FakeExec:
+    def _join_stream(self, batches):
+        for b in batches:
+            n = int(jax.device_get(b.total))      # SRC005
+            m = b.count.item()                    # SRC005
+            yield n + m
+"""
+
+
+def test_source_lint_flags_raw_sync_in_exec_module():
+    """SRC005: raw device_get/.item() in execs/ must route through the
+    pipeline's deferred-readback helper (parallel.pipeline.device_read),
+    so stream loops can overlap the sync with the next dispatch."""
+    diags = lint_source_text(_SYNC_FIXTURE,
+                             "spark_rapids_tpu/execs/fake.py")
+    hits = [d for d in diags if d.rule == "SRC005"]
+    assert len(hits) == 2, diags
+    assert all(h.severity == "warning" for h in hits)
+    assert "_join_stream" in hits[0].location
+    # strict mode (the repo gate) fails on the seeded violation
+    assert evaluate(diags, strict=True)[2] != 0
+
+
+def test_source_lint_sync_rule_scoped_to_exec_modules():
+    """The same code OUTSIDE execs/ (e.g. the pipeline helper itself,
+    the metrics layer) is not SRC005's business."""
+    diags = lint_source_text(_SYNC_FIXTURE,
+                             "spark_rapids_tpu/parallel/fake.py")
+    assert "SRC005" not in rules(diags)
+
+
+def test_repo_baseline_covers_only_intentional_syncs():
+    """The checked-in baseline holds exactly the intentional execs/
+    base.py syncs (metric settlement + ANSI error poll) — nothing may
+    hide behind it silently."""
+    from spark_rapids_tpu.lint.diagnostic import load_baseline
+
+    keys = load_baseline()
+    assert keys, "baseline should hold the intentional SRC005 syncs"
+    assert all(k.startswith("SRC005::spark_rapids_tpu/execs/base.py::")
+               for k in keys), keys
+
+
 # -- the repo gate (tier-1 hook) ---------------------------------------- #
 
 def test_repo_is_clean_or_baselined():
