@@ -319,13 +319,18 @@ def _stage_breakdown(df, prefix: str) -> dict:
     }
 
 
-def _pipeline_occupancy() -> dict:
+def _pipeline_occupancy(prefix: str = "pipeline") -> dict:
     """Aggregate the software pipeline's stage counters
     (parallel.pipeline.stage_snapshot) into one occupancy figure:
     item-weighted mean of each stage's queue-occupancy fraction.  ~1.0
     means producers stay ahead of consumers (the pipeline is full);
     ~0.0 means stages run starved/serial.  Per-stage detail rides as a
-    sub-object so round-over-round deltas are attributable."""
+    sub-object so round-over-round deltas are attributable.
+
+    Counters are RESET between benchmark configs
+    (parallel.pipeline.reset_stage_counters), so each
+    `{q}_pipeline_occupancy` reflects that query alone instead of
+    accumulating across q6/q1/q3/q67."""
     from spark_rapids_tpu.parallel.pipeline import stage_snapshot
 
     snap = stage_snapshot()
@@ -336,9 +341,16 @@ def _pipeline_occupancy() -> dict:
             weighted += s["occupancy_fraction"] * s["items"]
             items += s["items"]
     return {
-        "pipeline_occupancy": round(weighted / items, 3) if items else 0.0,
-        "pipeline_stages": snap,
+        f"{prefix}_occupancy": round(weighted / items, 3)
+        if items else 0.0,
+        f"{prefix}_stages": snap,
     }
+
+
+def _reset_pipeline_counters() -> None:
+    from spark_rapids_tpu.parallel.pipeline import reset_stage_counters
+
+    reset_stage_counters()
 
 
 def _check_rows(tpu_tbl, cpu_tbl, float_from: int, key_cols: int):
@@ -368,9 +380,14 @@ def _bench_q1(session, d: str) -> dict:
                                  with_q1_cols=True)
         df = q1_dataframe(session, q1_files)
         df.collect(engine="tpu")  # warmup
+        _reset_pipeline_counters()  # per-query occupancy
         tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+        # occupancy read BEFORE the tapped breakdown collect, so it
+        # reflects only the timed runs
+        occ = _pipeline_occupancy("q1_pipeline")
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
+        breakdown.update(occ)
     finally:
         conf.set(key, old_sp)
     _check_rows(tpu_r, cpu_r, float_from=2, key_cols=2)
@@ -397,7 +414,9 @@ def _bench_q3(session, d: str) -> dict:
     orders = make_orders(q3dir)
     df = q3_dataframe(session, li, orders)
     df.collect(engine="tpu")  # warmup
+    _reset_pipeline_counters()  # per-query occupancy
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+    occ = _pipeline_occupancy("q3_pipeline")  # timed runs only
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     # top-k by float revenue: compare the revenue VALUES (ties may order
     # differently) and the grouped rows' exactness via set inclusion
@@ -416,6 +435,7 @@ def _bench_q3(session, d: str) -> dict:
     }
     out.update(_stats(tpu_ts, "q3_tpu"))
     out.update(_stage_breakdown(df, "q3"))
+    out.update(occ)
     return out
 
 
@@ -428,7 +448,9 @@ def _bench_q67(session, d: str) -> dict:
     paths = make_store_sales(q67dir)
     df = q67_dataframe(session, paths)
     df.collect(engine="tpu")  # warmup
+    _reset_pipeline_counters()  # per-query occupancy
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+    occ = _pipeline_occupancy("q67_pipeline")  # timed runs only
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     got = list(zip(*tpu_r.to_pydict().values()))
     want = list(zip(*cpu_r.to_pydict().values()))
@@ -445,6 +467,7 @@ def _bench_q67(session, d: str) -> dict:
         "q67_rows": 1 << 21,
     }
     out.update(_stats(tpu_ts, "q67_tpu"))
+    out.update(occ)
     return out
 
 
@@ -461,6 +484,7 @@ def main() -> None:
 
         df.collect(engine="tpu")  # warmup: compile cache, page cache
         link = _link_probe()
+        _reset_pipeline_counters()  # q6 occupancy = timed runs only
         tpu_ts, tpu_result = _time_collect(df, "tpu", TPU_ITERS)
         cpu_ts, cpu_result = _time_collect(df, "cpu", CPU_ITERS)
         tpu_t = statistics.median(tpu_ts)
@@ -471,7 +495,11 @@ def main() -> None:
         want = cpu_result.to_pydict()["revenue"][0]
         assert abs(got - want) <= 1e-6 * max(1.0, abs(want)), (got, want)
 
+        # headline occupancy is q6's own (counters reset per config),
+        # read BEFORE the tapped breakdown collect
+        occ = _pipeline_occupancy("pipeline")
         breakdown = _stage_breakdown(df, "q6")
+        breakdown.update(occ)
 
         if tpu_t > 10.0:
             # degraded tunnel (per-dispatch latency in the seconds):
@@ -502,7 +530,6 @@ def main() -> None:
     out.update(link)
     out.update(breakdown)
     out.update(extra)
-    out.update(_pipeline_occupancy())
     print(json.dumps(out))
 
 

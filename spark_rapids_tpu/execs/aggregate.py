@@ -279,7 +279,7 @@ class TpuHashAggregateExec(TpuExec):
             for b in batches)
         fn = cached_jit(("aggdrainfused", self._cache_key(), struct),
                         lambda: prog)
-        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             out = t.observe(fn([b.with_device_num_rows()
                                 for b in batches]))
         for h in pending:
@@ -541,7 +541,7 @@ class TpuHashAggregateExec(TpuExec):
             """Async half: the update program for batch k+1 is
             dispatched before batch k's sizing sync retires (the same
             lookahead shape as the join probe loop)."""
-            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 if self.mode == "final":
                     return batch  # already partial layout
                 return t.observe(self._jit_update(_as_device_rows(batch)))
@@ -557,7 +557,7 @@ class TpuHashAggregateExec(TpuExec):
                         self.goal_rows, 2 * DEFER_SYNC_CAP):
                     # bound pending without a sizing sync: re-merge via
                     # the traced concat; the merged partial stays traced
-                    with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                         merged = t.observe(self._jit_merge(
                             _as_device_rows(drain_pending())))
                     self.metrics["numMerges"].add(1)
@@ -575,7 +575,7 @@ class TpuHashAggregateExec(TpuExec):
                 part, SpillPriorities.AGGREGATE_PARTIAL))
             pending_rows += n
             if len(pending) > 1 and pending_rows >= self.goal_rows:
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     merged = t.observe(self._jit_merge(
                         _as_device_rows(drain_pending())))
                 self.metrics["numMerges"].add(1)
@@ -607,7 +607,7 @@ class TpuHashAggregateExec(TpuExec):
         if out is not None:
             yield self._count_output(out)
             return
-        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             single = len(pending) == 1
             merged = drain_pending()
             if not single or self.mode == "final":

@@ -23,6 +23,7 @@ from typing import Callable, Iterator, Optional
 import jax
 import jax.numpy as jnp
 
+from spark_rapids_tpu import trace as _trace
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.config import METRICS_LEVEL, get_conf
@@ -166,7 +167,9 @@ class _MetricReaper:
                          if isinstance(x, jax.Array)]
         except Exception:
             return  # already deleted/donated: drop the sample
-        self._q.put((metric, t0, sentinels))
+        # correlation context crosses to the reaper thread by capture
+        ctx = _trace.current_context() if _trace.TRACER.enabled else None
+        self._q.put((metric, t0, sentinels, ctx))
 
     def flush(self) -> None:
         """Wait until every submitted region has been timed."""
@@ -174,7 +177,7 @@ class _MetricReaper:
 
     def _run(self) -> None:
         while True:
-            metric, t0, sentinels = self._q.get()
+            metric, t0, sentinels, ctx = self._q.get()
             try:
                 # POLL readiness instead of block_until_ready: on remote
                 # PJRT backends a blocking wait from this thread
@@ -183,10 +186,17 @@ class _MetricReaper:
                 # (measured: 4ms -> 2.5s per 24MB upload).  is_ready()
                 # is a local, lock-free check; 1ms polling granularity
                 # is far below any per-op time worth recording.
+                w0 = time.perf_counter_ns()
                 for x in sentinels:
                     while not x.is_ready():
                         time.sleep(0.001)
-                metric.add(time.perf_counter_ns() - t0)
+                now = time.perf_counter_ns()
+                metric.add(now - t0)
+                if _trace.TRACER.enabled:
+                    with _trace.attach_context(ctx):
+                        _trace.record_complete(
+                            f"metric.settle.{metric.name}", w0, now - w0,
+                            metric=metric.name)
             except Exception:
                 pass
             finally:
@@ -201,10 +211,17 @@ class MetricTimer:
     timed region registers its output via `observe(batch)` and the elapsed
     time is recorded when the output's device work completes (measured on
     a background thread so the pipeline keeps overlapping).  Disable via
-    spark.rapids.tpu.sql.metrics.deviceSync to time dispatch only."""
+    spark.rapids.tpu.sql.metrics.deviceSync to time dispatch only.
 
-    def __init__(self, metric: Optional[TpuMetric]):
+    With `op` set (the owning exec's name) and tracing enabled, the
+    timed region is also recorded as an ``exec.<op>`` span — the
+    NvtxWithMetrics pairing: operators get timeline spans for free
+    wherever they already time themselves."""
+
+    def __init__(self, metric: Optional[TpuMetric],
+                 op: Optional[str] = None):
         self.metric = metric
+        self.op = op
         self._observed = None
 
     def observe(self, out):
@@ -217,6 +234,12 @@ class MetricTimer:
         return self
 
     def __exit__(self, *exc):
+        if self.op is not None and _trace.TRACER.enabled:
+            # the dispatch-side interval (device settlement is the
+            # reaper's metric.settle span)
+            _trace.record_complete(
+                f"exec.{self.op}", self.t0,
+                time.perf_counter_ns() - self.t0, op=self.op)
         if self.metric is None:
             return False
         if self._observed is not None and exc[0] is None \
@@ -497,7 +520,7 @@ class FusableExec(TpuExec):
                     # a different signature; decode eagerly instead
                     batch = batch.decode_now()
                 else:
-                    with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                         out = self._fused_pipeline_encoded()(batch)
                         if ansi:
                             out, err = out
@@ -506,7 +529,7 @@ class FusableExec(TpuExec):
                     yield self._count_output(out)
                     continue
             b = batch.with_device_num_rows()
-            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 if aware:
                     out = fused(b, pidx, off)
                     # row_offset advances by the INPUT batch's live rows

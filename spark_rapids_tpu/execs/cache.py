@@ -64,7 +64,7 @@ class TpuCacheExec(TpuExec):
             if p >= len(parts):
                 return
             for h in parts[p]:
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     out = t.observe(h.get())
                     # keep the entry spillable between queries: the
                     # consumer's pipeline holds the device arrays it

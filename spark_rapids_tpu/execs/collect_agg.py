@@ -100,7 +100,7 @@ class TpuCollectAggExec(TpuExec):
         def phase1(b):
             return C.collect_phase1(self._project(b), n_keys, kinds)
 
-        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             sb, live_s, ng, mk = cached_jit(
                 key + ("p1", big.capacity), lambda: phase1)(big)
             from spark_rapids_tpu.parallel.pipeline import device_read_many

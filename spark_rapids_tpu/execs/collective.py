@@ -283,7 +283,7 @@ class TpuCollectiveHashAggregateExec(_CollectiveBase):
                 pre=self._pre, post=self._merge)
             self._final_step = make_local_step(self.mesh,
                                                self._finalize)
-        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             merged = self._exchange_rounds(
                 self.children[0], self._step,
                 out_schema=self._agg.partial_schema)
@@ -415,7 +415,7 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
 
         chunks: list[list[ColumnarBatch]] = [
             [] for _ in range(self.num_partitions)]
-        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             build_stacked = self._collect_build()
             build_rows = int(jnp.max(build_stacked.num_rows))
             for shards in self._shard_rounds(self.children[0]):
@@ -514,7 +514,7 @@ class TpuCollectiveSortExec(_CollectiveBase):
         # scales with batch rows — see _sample_k)
         rounds: list[list[ColumnarBatch]] = []
         samples: list[ColumnarBatch] = []
-        with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             for shards in self._shard_rounds(self.children[0]):
                 rounds.append(shards)
                 for s in shards:

@@ -185,7 +185,7 @@ class TpuShuffleExchangeExec(TpuExec):
             self._shuffle_id = get_shuffle_manager().new_shuffle_id()
             n_tasks = self.children[0].num_partitions
             threads = min(get_conf().get(TASK_THREADS), max(n_tasks, 1))
-            with MetricTimer(self.metrics[TOTAL_TIME]):
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
                 if isinstance(self.partitioning, RangePartitioning):
                     self._run_range_map_stage(threads)
                 else:
@@ -353,14 +353,23 @@ class TpuShuffleExchangeExec(TpuExec):
         # conf is THREAD-LOCAL: install the calling (session) thread's
         # snapshot on every pool thread, or each task silently reads
         # defaults (batch sizing, pipeline depth/kill-switch, chunk
-        # rows) for everything executing below the exchange
+        # rows) for everything executing below the exchange.  The trace
+        # correlation context makes the same hop, so map-task spans
+        # stay attributable to the query that dispatched them.
+        from spark_rapids_tpu import trace as _trace
         from spark_rapids_tpu.config import get_conf, set_conf
 
         conf = get_conf()
+        tctx = _trace.current_context()
 
         def run(p: int) -> None:
             set_conf(conf)
-            fn(p)
+            # no op= attr here: the exec's MetricTimer span already
+            # covers the map stage, and a second op-keyed span per task
+            # would double-count the exchange in span_stats
+            with _trace.attach_context(tctx), \
+                    _trace.span("exchange.task", task=p):
+                fn(p)
 
         with ThreadPoolExecutor(max_workers=threads) as pool:
             futures = [pool.submit(run, p) for p in range(n_tasks)]

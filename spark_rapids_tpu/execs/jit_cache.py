@@ -24,6 +24,7 @@ from typing import Callable, Sequence
 
 import jax
 
+from spark_rapids_tpu import trace as _trace
 from spark_rapids_tpu.exprs.base import Expression
 
 _LOCK = threading.Lock()
@@ -76,6 +77,11 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
     with _LOCK:
         fn = _CACHE.get(key)
         if fn is None:
+            if _trace.TRACER.enabled:
+                # a miss means a fresh trace+compile is coming for this
+                # program shape: the timeline shows WHICH key paid it
+                _trace.event("jit.cache_miss", key=repr(key)[:200],
+                             cache_size=len(_CACHE))
             fn = _CACHE[key] = jax.jit(make_fn())
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)

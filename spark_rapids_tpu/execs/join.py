@@ -285,7 +285,7 @@ class _HashJoinBase(TpuExec):
             nonlocal matched_b_acc
             self.metrics["probeBatches"].add(1)
             out = None
-            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 stream = stream.with_device_num_rows()
                 st, total = jit_probe(build, stream)
                 if self.join_type == "full_outer":
@@ -308,7 +308,7 @@ class _HashJoinBase(TpuExec):
             if out is not None:
                 yield self._count_output(out)
                 return
-            with MetricTimer(self.metrics[TOTAL_TIME]):
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
                 n_total = P.device_read_int(total, tag="join.probe")
             if not n_total:
                 return
@@ -320,7 +320,7 @@ class _HashJoinBase(TpuExec):
             # own timed region so consumer time between yields never
             # lands in this operator's clock.
             for off in range(0, n_total, out_cap):
-                with MetricTimer(self.metrics[TOTAL_TIME]):
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
                     o = self._jit_expand(out_cap)(
                         build, stream, st, total,
                         jnp.asarray(off, jnp.int32))

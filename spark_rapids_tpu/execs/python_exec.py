@@ -61,7 +61,7 @@ class TpuMapInArrowExec(TpuExec):
         aschema = schema_to_arrow(self._schema)
         pool = self._get_pool()
         for b in self.children[0].execute_partition(p):
-            with MetricTimer(self.metrics[TOTAL_TIME]):
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
                 out = pool.run(to_arrow(b)).cast(aschema)
             self.metrics["pythonBatches"].add(1)
             yield self._count_output(from_arrow(out))
@@ -223,7 +223,7 @@ class _GroupedPandasBase(TpuMapInArrowExec):
             concat_batches(batches)
         if big.concrete_num_rows() == 0 and p != 0:
             return
-        with MetricTimer(self.metrics[TOTAL_TIME]):
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
             out = self._get_pool().run(to_arrow(big)).cast(aschema)
         self.metrics["pythonBatches"].add(1)
         yield self._count_output(from_arrow(out))
@@ -449,7 +449,7 @@ class TpuFlatMapCoGroupsInPandasExec(TpuExec):
         if combined is None:
             return
         aschema = schema_to_arrow(self._schema)
-        with MetricTimer(self.metrics[TOTAL_TIME]):
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
             out = self._get_pool().run(combined).cast(aschema)
         self.metrics["pythonBatches"].add(1)
         yield self._count_output(from_arrow(out))

@@ -191,7 +191,7 @@ class TpuSortExec(_SortMixin):
             return
         if self.scope == "batch":
             for b in self.children[0].execute_partition(p):
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     out = t.observe(self._jit_sorted(
                         b.with_device_num_rows()))
                 yield self._count_output(out)
@@ -321,7 +321,7 @@ class TpuSortExec(_SortMixin):
                                                      num_rows=int(nn))
                 big = batches[0] if len(batches) == 1 \
                     else concat_batches(batches)
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     out = t.observe(self._jit_sort_drop()(
                         big.with_device_num_rows()))
                 for h in handles:
@@ -394,7 +394,7 @@ class TpuSortExec(_SortMixin):
                      tuple(getattr(c, "width", 0) for c in aug.columns)),
                     lambda: lambda a, bd: self._group_by_bounds(
                         a, bd, n_parts))
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     grouped, counts = jit_group(
                         aug.with_device_num_rows(), bounds)
                     t.observe(grouped)
@@ -429,7 +429,7 @@ class TpuSortExec(_SortMixin):
                         depth + 1)
                     continue
                 bucket = self._assemble_bucket(runs, b)
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     out = t.observe(fn(bucket.with_device_num_rows()))
                 yield self._count_output(out)
         finally:
@@ -592,7 +592,7 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
             ("topn", self.n, self._keys_cache_key()), lambda: self._topn)
         top: Optional[ColumnarBatch] = None
         for b in self.children[0].execute():
-            with MetricTimer(self.metrics[TOTAL_TIME]):
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
                 merged = b if top is None else concat_batches([top, b])
                 top = jit_topn(merged.with_device_num_rows())
                 # compact so concat_batches sees the concrete top-n rows
@@ -718,7 +718,7 @@ class TpuTopNExec(_SortMixin):
         pending: list = []
         try:
             for batch in self.children[0].execute():
-                with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                     cand = t.observe(self._jit_cand(
                         batch.with_device_num_rows()))
                 pending.append(store.register(
@@ -767,7 +767,7 @@ class TpuTopNExec(_SortMixin):
                 nxt = []
                 for ch in chunks:
                     big = ch[0] if len(ch) == 1 else concat_batches(ch)
-                    with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+                    with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                         win = t.observe(self._jit_final(
                             big.with_device_num_rows()))
                     wn = win.concrete_num_rows()
@@ -779,7 +779,7 @@ class TpuTopNExec(_SortMixin):
                     break  # no further reduction possible
             big = shrunk[0] if len(shrunk) == 1 else \
                 concat_batches(shrunk)
-            with MetricTimer(self.metrics[TOTAL_TIME]) as t:
+            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 out = t.observe(self._jit_final(
                     big.with_device_num_rows()))
             yield self._count_output(out)

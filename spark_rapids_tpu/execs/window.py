@@ -248,7 +248,7 @@ class TpuWindowExec(TpuExec):
         if self.partitioned and big.concrete_num_rows() == 0:
             return  # empty reduce partition
         fn = cached_jit(self._cache_key(), lambda: self._window_batch)
-        with MetricTimer(self.metrics[TOTAL_TIME]):
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
             out = fn(big.with_device_num_rows())
         yield self._count_output(out)
 

@@ -205,7 +205,7 @@ class FileWriteExec(TpuExec):
         child = self.children[0]
         n = child.num_partitions
         threads = min(get_conf().get(TASK_THREADS), max(n, 1))
-        with MetricTimer(self.metrics[TOTAL_TIME]):
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
             if threads <= 1 or n <= 1:
                 for p in range(n):
                     self._write_task(p)

@@ -39,6 +39,14 @@ Rules
   batch where the pipelined form overlaps it with the next batch's
   dispatch.  Intentional syncs (metric settlement, ANSI error polls)
   are baselined, not suppressed inline.
+- SRC006 (warning): raw wall-clock timing (time.time /
+  time.perf_counter / time.perf_counter_ns / time.monotonic) in an
+  exec or pipeline module (execs/, parallel/) instead of MetricTimer
+  (device-aware, feeds the metric tree) or trace.span (lands on the
+  correlated timeline).  Ad-hoc timing is invisible to profile_query,
+  EXPLAIN ANALYZE and the Chrome-trace export; the timing
+  INFRASTRUCTURE itself (MetricTimer, the metric reaper, the pipeline
+  wait counters) is baselined, mirroring SRC005's posture.
 """
 
 from __future__ import annotations
@@ -336,9 +344,59 @@ class _ExecSyncChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: time-module attributes whose call is a raw wall-clock measurement
+_TIMING_ATTRS = {"time", "perf_counter", "perf_counter_ns",
+                 "monotonic", "monotonic_ns"}
+
+
+class _RawTimingChecker(ast.NodeVisitor):
+    """SRC006: raw time.* readings inside exec/pipeline modules.
+
+    Engine timing must flow through MetricTimer (settled, device-aware,
+    visible to profile_query/EXPLAIN ANALYZE) or trace.span (on the
+    correlated timeline); a bare perf_counter in an exec body produces
+    numbers no tool can see or correlate.  Like SRC005, the rule is
+    syntactic and module-wide; the blessed timing infrastructure
+    (MetricTimer itself, the reaper, the pipeline wait counters) lives
+    in these modules too and is baselined rather than special-cased."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _TIMING_ATTRS \
+                and _terminal_name(node.func.value) == "time":
+            qual = self._fn_stack[-1] if self._fn_stack else "<module>"
+            self.out.append(Diagnostic(
+                "SRC006", "warning", f"{self.path}::{qual}",
+                f"raw `time.{node.func.attr}()` timing in an engine "
+                "module bypasses MetricTimer/span",
+                hint="time the region with MetricTimer (device-aware "
+                     "metrics) or trace.span (correlated timeline); "
+                     "baseline only timing-infrastructure sites",
+                line=getattr(node, "lineno", 0)))
+        self.generic_visit(node)
+
+
 def _is_exec_module(path: str) -> bool:
     parts = path.replace("\\", "/").split("/")
     return "execs" in parts
+
+
+def _is_timed_module(path: str) -> bool:
+    """SRC006 scope: exec bodies and the pipeline layer."""
+    parts = path.replace("\\", "/").split("/")
+    return "execs" in parts or "parallel" in parts
 
 
 def lint_source_text(src: str, path: str) -> list[Diagnostic]:
@@ -357,6 +415,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _RegionChecker(region, why, path, out).visit(region)
     if _is_exec_module(path):
         _ExecSyncChecker(path, out).visit(tree)
+    if _is_timed_module(path):
+        _RawTimingChecker(path, out).visit(tree)
     return out
 
 

@@ -33,6 +33,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from spark_rapids_tpu import trace as _trace
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import (
@@ -386,16 +387,18 @@ class BufferStore:
             try:
                 if e.tier == StorageTier.DEVICE:
                     return e.batch  # type: ignore[return-value]
-                if e.tier == StorageTier.HOST:
-                    arrays = e.host
-                else:
-                    from spark_rapids_tpu.columnar.serde import (
-                        read_spill_file,
-                    )
+                with _trace.span("spill.restore", tier=e.tier.name,
+                                 bytes=e.nbytes, buffer=e.buffer_id):
+                    if e.tier == StorageTier.HOST:
+                        arrays = e.host
+                    else:
+                        from spark_rapids_tpu.columnar.serde import (
+                            read_spill_file,
+                        )
 
-                    arrays = read_spill_file(e.path)  # type: ignore
-                self.reserve(e.nbytes)
-                batch = _host_to_batch(arrays, e.schema)  # H2D upload
+                        arrays = read_spill_file(e.path)  # type: ignore
+                    self.reserve(e.nbytes)
+                    batch = _host_to_batch(arrays, e.schema)  # H2D
             except BaseException:
                 # a failed acquire must not leak its pin (the entry
                 # would be unevictable forever)
@@ -502,7 +505,9 @@ class BufferStore:
         return True
 
     def _spill_to_host(self, e: _Entry) -> None:
-        arrays = _batch_to_host(e.batch)  # type: ignore[arg-type]
+        with _trace.span("spill.device_to_host", tier="DEVICE",
+                         bytes=e.nbytes, buffer=e.buffer_id):
+            arrays = _batch_to_host(e.batch)  # type: ignore[arg-type]
         e.batch = None
         e.tier = StorageTier.HOST
         e.host = arrays
@@ -524,9 +529,11 @@ class BufferStore:
         path = os.path.join(self._dir(), f"spill-{victim.buffer_id}.tpub")
         from spark_rapids_tpu.columnar.serde import write_spill_file
 
-        write_spill_file(path, arrays,  # type: ignore[arg-type]
-                         self._spill_codec)
         hb = _host_bytes(arrays)  # type: ignore[arg-type]
+        with _trace.span("spill.host_to_disk", tier="HOST", bytes=hb,
+                         buffer=victim.buffer_id):
+            write_spill_file(path, arrays,  # type: ignore[arg-type]
+                             self._spill_codec)
         victim.host = None
         victim.path = path
         victim.tier = StorageTier.DISK

@@ -44,6 +44,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
+from spark_rapids_tpu import trace as _tr
 from spark_rapids_tpu.config import get_conf, register, set_conf
 
 PIPELINE_ENABLED = register(
@@ -140,7 +141,10 @@ def stage_snapshot() -> dict[str, dict]:
     return {m.name: m.snapshot() for m in stages}
 
 
-def reset_stage_metrics() -> None:
+def reset_stage_counters() -> None:
+    """Clear every stage's counters — bench.py calls this between
+    benchmark queries so pipeline_occupancy reports PER QUERY instead
+    of accumulating across configs."""
     with _STAGES_LOCK:
         _STAGES.clear()
 
@@ -197,6 +201,9 @@ def device_read(x, tag: Optional[str] = None):
         m = _stage_metrics(tag)
         with m._lock:
             m.readbacks += 1
+    if _tr.TRACER.enabled:
+        with _tr.span("pipe.readback", tag=tag or ""):
+            return jax.device_get(x)
     return jax.device_get(x)
 
 
@@ -220,6 +227,9 @@ def device_read_many(xs: Sequence, tag: Optional[str] = None) -> list:
         m = _stage_metrics(tag)
         with m._lock:
             m.readbacks += 1
+    if _tr.TRACER.enabled:
+        with _tr.span("pipe.readback", tag=tag or "", n=len(xs)):
+            return list(jax.device_get(xs))
     return list(jax.device_get(xs))
 
 
@@ -288,9 +298,15 @@ class _Chan:
                 dt = time.perf_counter_ns() - t0
                 with m._lock:
                     m.producer_wait_ns += dt
+                if _tr.TRACER.enabled:  # reuse the wait already timed
+                    _tr.record_complete(f"pipe.{m.name}.wait_full",
+                                        t0, dt, stage=m.name)
             if self.aborted:
                 return False
             self.buf.append(item)
+            if _tr.TRACER.enabled:
+                _tr.event(f"pipe.{m.name}.enqueue", stage=m.name,
+                          qlen=len(self.buf))
             self.not_empty.notify()
             return True
 
@@ -319,10 +335,16 @@ class _Chan:
                 dt = time.perf_counter_ns() - t0
                 with m._lock:
                     m.consumer_wait_ns += dt
+                if _tr.TRACER.enabled:
+                    _tr.record_complete(f"pipe.{m.name}.wait_empty",
+                                        t0, dt, stage=m.name)
             if self.buf:
                 with m._lock:
                     m.items += 1
                 item = self.buf.popleft()
+                if _tr.TRACER.enabled:
+                    _tr.event(f"pipe.{m.name}.dequeue", stage=m.name,
+                              qlen=len(self.buf))
                 self.not_full.notify()
                 return item, True
             return None, False
@@ -365,25 +387,31 @@ def prefetch(gen: Iterable, depth: Optional[int] = None,
         m.depth = max(m.depth, depth)
     chan = _Chan(depth)
     conf = get_conf()
+    # trace correlation (query_id, ...) is thread-local and does NOT
+    # follow the generator onto the stage thread: capture here, attach
+    # there — the same hop the conf snapshot makes
+    tctx = _tr.current_context()
 
     def produce() -> None:
         err: Optional[BaseException] = None
         set_conf(conf)
-        try:
+        with _tr.attach_context(tctx), \
+                _tr.span(f"pipe.{stage}.run", stage=stage):
             try:
-                for item in gen:
-                    if not chan.put(item, m):
-                        return
-            except BaseException as e:  # noqa: BLE001 — re-raised at consumer
-                err = e
-        finally:
-            close = getattr(gen, "close", None)
-            if close is not None:
                 try:
-                    close()
-                except BaseException as e:  # noqa: BLE001
-                    err = err or e
-            chan.finish(err)
+                    for item in gen:
+                        if not chan.put(item, m):
+                            return
+                except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+                    err = e
+            finally:
+                close = getattr(gen, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except BaseException as e:  # noqa: BLE001
+                        err = err or e
+                chan.finish(err)
 
     t = threading.Thread(target=produce, daemon=True,
                          name=f"tpu-pipe-{stage}")

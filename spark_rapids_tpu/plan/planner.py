@@ -1336,23 +1336,27 @@ def _rewrite_scalar_subqueries(plan: L.LogicalPlan,
 
 
 def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
-    conf = conf or get_conf()
-    plan = _rewrite_split_extracts(plan)
-    plan = _rewrite_input_file_exprs(plan)
-    plan = _rewrite_scalar_subqueries(plan, conf)
-    _annotate_filter_upload(plan)
-    meta = PlanMeta(plan, conf)
-    if conf.get(SQL_ENABLED):
-        meta.tag()
-        from spark_rapids_tpu.plan.cost import optimize_costs
+    from spark_rapids_tpu import trace as _trace
 
-        optimize_costs(meta)
-        _demote_unrepresentable_boundaries(meta)
-    else:
-        meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
-    root = convert_meta(meta)
-    _mark_encoded_scans(root)
-    _plan_pipeline(root, conf)
+    conf = conf or get_conf()
+    with _trace.span("query.tag"):
+        plan = _rewrite_split_extracts(plan)
+        plan = _rewrite_input_file_exprs(plan)
+        plan = _rewrite_scalar_subqueries(plan, conf)
+        _annotate_filter_upload(plan)
+        meta = PlanMeta(plan, conf)
+        if conf.get(SQL_ENABLED):
+            meta.tag()
+            from spark_rapids_tpu.plan.cost import optimize_costs
+
+            optimize_costs(meta)
+            _demote_unrepresentable_boundaries(meta)
+        else:
+            meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
+    with _trace.span("query.lower"):
+        root = convert_meta(meta)
+        _mark_encoded_scans(root)
+        _plan_pipeline(root, conf)
     return root, meta
 
 
@@ -1461,6 +1465,8 @@ def collect_exec(exec_: TpuExec) -> pa.Table:
             return exec_.cpu_table().cast(schema_to_arrow(exec_.schema))
         finally:
             exec_.close()
+    from spark_rapids_tpu import trace as _trace
+
     try:
         it = exec_.execute()
         fetch_depth = getattr(exec_, "_pipeline_fetch", 0)
@@ -1473,7 +1479,13 @@ def collect_exec(exec_: TpuExec) -> pa.Table:
             # compute(k+1); depth bounds device batches in the queue
             it = prefetch(it, depth=fetch_depth, stage="result.fetch")
         try:
-            tables = [to_arrow(b) for b in it]
+            tables = []
+            for b in it:
+                if _trace.TRACER.enabled:
+                    with _trace.span("query.fetch.batch"):
+                        tables.append(to_arrow(b))
+                else:
+                    tables.append(to_arrow(b))
         finally:
             close = getattr(it, "close", None)
             if close is not None:

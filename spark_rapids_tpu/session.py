@@ -303,11 +303,25 @@ class TpuSession:
     (ref: SQLPlugin.scala — here session == plugin)."""
 
     def __init__(self, conf: Optional[TpuConf] = None):
-        from spark_rapids_tpu.tools.profiling import QueryHistory
+        from spark_rapids_tpu.tools.profiling import (
+            HISTORY_CAPACITY,
+            QueryHistory,
+        )
 
         self.conf = conf or get_conf()
         #: recent TPU-collected queries, input to the profiling tool
-        self.history = QueryHistory()
+        self.history = QueryHistory(
+            int(self.conf.get(HISTORY_CAPACITY)))
+
+    def export_trace(self, path: str) -> str:
+        """Write the process's collected engine trace as Chrome Trace
+        Format JSON (viewable in Perfetto / chrome://tracing).  Run
+        queries with spark.rapids.tpu.trace.enabled=true first; see
+        docs/observability.md for overlaying the device_trace()
+        XPlane capture."""
+        from spark_rapids_tpu.trace.export import export_chrome_trace
+
+        return export_chrome_trace(path)
 
     # -- sources -------------------------------------------------------- #
 
@@ -890,38 +904,61 @@ class DataFrame:
             from spark_rapids_tpu.cpu.engine import execute_cpu
 
             return execute_cpu(self._plan)
+        return self._collect_tpu()[0]
+
+    def _collect_tpu(self) -> tuple[pa.Table, int]:
+        """TPU-engine collect; returns (result, query_id) so callers
+        that need the history/trace correlation key (EXPLAIN ANALYZE)
+        can find THEIR event instead of trusting events[-1] under
+        concurrent collects."""
+        conf = self._session.conf
         import time as _time
 
+        from spark_rapids_tpu import trace as _trace
+
+        # align the process tracer with this session's conf, and make
+        # the query's id the correlation attribute every span records —
+        # including spans from prefetch stages, the exchange map pool
+        # and the metric reaper, which receive it by context capture
+        _trace.sync_conf(conf)
+        qid = self._session.history.allocate_id()
         t0 = _time.perf_counter()
-        exec_, meta = plan_query(self._plan, conf)
-        try:
-            out = collect_exec(exec_)
-        except BaseException as e:
-            from spark_rapids_tpu.execs.retry import should_cpu_fallback
+        with _trace.trace_context(query_id=qid):
+            with _trace.span("query.plan"):
+                exec_, meta = plan_query(self._plan, conf)
+            try:
+                with _trace.span("query.execute"):
+                    out = collect_exec(exec_)
+            except BaseException as e:
+                from spark_rapids_tpu.execs.retry import (
+                    should_cpu_fallback,
+                )
 
-            if not should_cpu_fallback(e):
-                raise
-            # device lost / exhausted after task retries: degrade the
-            # query to the CPU engine (executor-blacklisting analog)
-            import warnings
+                if not should_cpu_fallback(e):
+                    raise
+                # device lost / exhausted after task retries: degrade
+                # the query to the CPU engine (executor-blacklisting
+                # analog)
+                import warnings
 
-            from spark_rapids_tpu.cpu.engine import execute_cpu
+                from spark_rapids_tpu.cpu.engine import execute_cpu
 
-            warnings.warn(
-                f"TPU execution failed with a device error ({e}); "
-                "re-running this query on the CPU engine",
-                RuntimeWarning, stacklevel=2)
-            out = execute_cpu(self._plan)
-            # degraded queries are the ones operators most need to
-            # see in the history
+                warnings.warn(
+                    f"TPU execution failed with a device error ({e}); "
+                    "re-running this query on the CPU engine",
+                    RuntimeWarning, stacklevel=2)
+                out = execute_cpu(self._plan)
+                # degraded queries are the ones operators most need to
+                # see in the history
+                self._session.history.record(
+                    meta.explain() + "\n[degraded to CPU engine: "
+                    f"{type(e).__name__}]",
+                    exec_, _time.perf_counter() - t0, query_id=qid)
+                return out, qid
             self._session.history.record(
-                meta.explain() + "\n[degraded to CPU engine: "
-                f"{type(e).__name__}]",
-                exec_, _time.perf_counter() - t0)
-            return out
-        self._session.history.record(
-            meta.explain(), exec_, _time.perf_counter() - t0)
-        return out
+                meta.explain(), exec_, _time.perf_counter() - t0,
+                query_id=qid)
+        return out, qid
 
     def to_batches(self, batch_rows: Optional[int] = None):
         """Stream the result as Arrow record batches (the ColumnarRdd
@@ -939,7 +976,26 @@ class DataFrame:
             for i in range(rb.num_rows):
                 yield tuple(c[i] for c in cols)
 
-    def explain(self) -> str:
+    def explain(self, mode: str = "simple") -> str:
+        """Plan explanation.  mode="simple" (default): the static
+        replacement/lint/pipeline report.  mode="analyze": EXPLAIN
+        ANALYZE — run the query on the TPU engine, then render the
+        plan annotated per-operator with SETTLED metrics (device-synced
+        wall time, rows, batches) and, when tracing is on, span-derived
+        busy/self/overlap times (docs/observability.md)."""
+        if mode.lower() == "analyze":
+            from spark_rapids_tpu import trace as _trace
+            from spark_rapids_tpu.tools.profiling import render_analyze
+
+            _out, qid = self._collect_tpu()
+            # find OUR event by id — events[-1] may be a concurrent
+            # collect's record (fall back to it only if concurrent
+            # collects evicted ours from a tiny history ring)
+            events_ = self._session.history.events
+            ev = next((e for e in reversed(events_)
+                       if e.query_id == qid), events_[-1])
+            events = _trace.snapshot() if _trace.is_enabled() else None
+            return render_analyze(ev, events)
         exec_, meta = plan_query(self._plan, self._session.conf)
         out = meta.explain()
         # static-analysis findings over the lowered physical plan

@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 from typing import Iterator, Optional
 
+from spark_rapids_tpu import trace as _trace
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.memory import SpillPriorities, get_store
 
@@ -60,7 +61,15 @@ class ShuffleManager:
             while handles:
                 h = handles.pop(0)
                 try:
-                    yield h.get()
+                    if _trace.TRACER.enabled:
+                        with _trace.span("shuffle.block.recv",
+                                         shuffle=shuffle_id,
+                                         reduce=reduce_id,
+                                         bytes=h.nbytes):
+                            b = h.get()
+                    else:
+                        b = h.get()
+                    yield b
                 finally:
                     h.close()
         finally:
@@ -90,6 +99,12 @@ class ShuffleManager:
         attempts never call this, so readers only ever observe complete
         task output — the MapStatus commit protocol (Spark publishes a
         task's shuffle blocks only when the task commits)."""
+        if outputs and _trace.TRACER.enabled:
+            _trace.event(
+                "shuffle.block.send", shuffle=shuffle_id,
+                blocks=len(outputs),
+                bytes=sum(nb for _r, _h, nb, _n in outputs),
+                rows=sum(n for _r, _h, _nb, n in outputs))
         with self._lock:
             for rid, h, nbytes, rows in outputs:
                 self._blocks.setdefault((shuffle_id, rid), []).append(h)

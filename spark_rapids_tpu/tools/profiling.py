@@ -16,10 +16,26 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import time
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Sequence
 
+from spark_rapids_tpu.config import register
 from spark_rapids_tpu.execs.base import TpuExec
+
+HISTORY_CAPACITY = register(
+    "spark.rapids.tpu.sql.queryHistory.capacity", 100,
+    "How many collected queries the session's QueryHistory ring "
+    "retains (operator snapshots + explain text per query; the oldest "
+    "event is dropped past the cap).",
+    check=lambda v: v >= 1)
+
+#: PROCESS-global query-id source: the id doubles as the trace
+#: subsystem's correlation key in a process-wide buffer, so two
+#: sessions must never both hand out id 0 (their spans would merge in
+#: span_stats / EXPLAIN ANALYZE).  itertools.count.__next__ is atomic
+#: in CPython.
+_QUERY_IDS = itertools.count()
 
 
 @dataclasses.dataclass
@@ -97,21 +113,32 @@ class QueryHistory:
                     max_workers=1, thread_name_prefix="query-history")
             return cls._pool
 
-    def __init__(self, capacity: int = 100):
+    def __init__(self, capacity: Optional[int] = None):
         import threading
 
+        if capacity is None:
+            from spark_rapids_tpu.config import get_conf
+
+            capacity = int(get_conf().get(HISTORY_CAPACITY))
         self.capacity = capacity
         self._events: list[QueryEvent] = []
-        self._next_id = 0
         self._pending: list = []
-        # guards _pending/_next_id/_events against caller-thread vs
+        # guards _pending/_events against caller-thread vs
         # worker/reader races (a reader swapping _pending mid-append
         # would drop a just-recorded snapshot future)
         self._mu = threading.Lock()
 
+    def allocate_id(self) -> int:
+        """Claim the next query id BEFORE execution, so trace spans and
+        the eventual history event share one correlation key.  Ids are
+        process-global: the trace buffer is shared by every session."""
+        return next(_QUERY_IDS)
+
     def record(self, explain: str, exec_tree: TpuExec,
-               wall_s: float) -> None:
+               wall_s: float, query_id: Optional[int] = None) -> None:
         ts = time.time()
+        if query_id is None:
+            query_id = next(_QUERY_IDS)
 
         def snap(qid):
             ev = QueryEvent(qid, explain, snapshot_exec(exec_tree),
@@ -121,11 +148,9 @@ class QueryHistory:
                 if len(self._events) > self.capacity:
                     self._events.pop(0)
         with self._mu:
-            qid = self._next_id
-            self._next_id += 1
             # drop settled futures so a never-inspected history stays O(1)
             self._pending = [f for f in self._pending if not f.done()]
-            self._pending.append(self._worker().submit(snap, qid))
+            self._pending.append(self._worker().submit(snap, query_id))
 
     def _drain(self) -> None:
         with self._mu:
@@ -146,14 +171,32 @@ def _walk_snap(s: NodeSnapshot):
         yield from _walk_snap(c)
 
 
-def profile_query(ev: QueryEvent) -> str:
+def _op_key(desc: str) -> str:
+    """The exec class name a snapshot desc starts with — the join key
+    against trace spans' `op` attribute."""
+    return desc.split(" ", 1)[0].split("[", 1)[0]
+
+
+def profile_query(ev: QueryEvent,
+                  trace_events: Optional[Sequence] = None) -> str:
     """Per-operator metrics table for one query (the Analysis /
-    ClassWarehouse per-SQL metrics view)."""
+    ClassWarehouse per-SQL metrics view).  With `trace_events` (a
+    spark_rapids_tpu.trace snapshot), a `self_ms` column reports each
+    operator's span-derived self-time: the union of its trace spans for
+    this query — time the operator was actively running on SOME thread,
+    as opposed to summed per-thread busy time."""
+    stats: dict = {}
+    if trace_events is not None:
+        from spark_rapids_tpu.trace.export import span_stats
+
+        stats = span_stats(trace_events, query_id=ev.query_id)
+    self_col = " self_ms |" if stats else ""
     lines = [
         f"== Query {ev.query_id} ({ev.wall_s:.3f}s wall) ==",
         "",
-        "| operator | rows | batches | time_ms | other metrics |",
-        "|---|---|---|---|---|",
+        f"| operator | rows | batches | time_ms |{self_col}"
+        " other metrics |",
+        f"|---|---|---|---|{'---|' if stats else ''}---|",
     ]
     for n in _walk_snap(ev.root):
         m = dict(n.metrics)
@@ -162,9 +205,62 @@ def profile_query(ev: QueryEvent) -> str:
         t = m.pop("totalTime", None)
         others = [f"{k}={v}" for k, v in sorted(m.items()) if v]
         t_ms = f"{t / 1e6:.2f}" if t is not None else ""
+        extra = ""
+        if stats:
+            st = stats.get(_op_key(n.desc))
+            extra = (f" {st['wall_ns'] / 1e6:.2f} |" if st
+                     else "  |")
         lines.append(
-            f"| {n.desc[:60]} | {rows} | {batches} | {t_ms} "
-            f"| {' '.join(others)} |")
+            f"| {n.desc[:60]} | {rows} | {batches} | {t_ms} |{extra}"
+            f" {' '.join(others)} |")
+    return "\n".join(lines) + "\n"
+
+
+def render_analyze(ev: QueryEvent,
+                   trace_events: Optional[Sequence] = None) -> str:
+    """EXPLAIN ANALYZE: the post-run plan tree, each operator annotated
+    with its SETTLED metrics (wall time per device-synced totalTime,
+    rows, batches) and — when a trace is available — span-derived
+    busy/self/overlap: busy sums this operator's span time across all
+    threads, self is the union of those intervals, and overlap =
+    busy - self (concurrent execution the aggregate timers hide).
+    Span figures aggregate per operator CLASS (spans carry the exec
+    name), so two instances of one class — a partial and a final
+    aggregate — show the class total on each."""
+    stats: dict = {}
+    if trace_events is not None:
+        from spark_rapids_tpu.trace.export import span_stats
+
+        stats = span_stats(trace_events, query_id=ev.query_id)
+    lines = [f"== Physical Plan (ANALYZE, query {ev.query_id}, "
+             f"{ev.wall_s:.3f}s wall) =="]
+
+    def walk(n: NodeSnapshot, indent: int) -> None:
+        m = n.metrics
+        ann = []
+        t = m.get("totalTime")
+        if t is not None:
+            ann.append(f"time={t / 1e6:.2f}ms")
+        ann.append(f"rows={m.get('numOutputRows', 0)}")
+        ann.append(f"batches={m.get('numOutputBatches', 0)}")
+        st = stats.get(_op_key(n.desc))
+        if st:
+            ann.append(
+                f"span(busy={st['busy_ns'] / 1e6:.2f}ms "
+                f"self={st['wall_ns'] / 1e6:.2f}ms "
+                f"overlap={st['overlap_ns'] / 1e6:.2f}ms)")
+        extras = {k: v for k, v in m.items()
+                  if k not in ("totalTime", "numOutputRows",
+                               "numOutputBatches") and v}
+        if extras:
+            ann.append(" ".join(f"{k}={v}"
+                                for k, v in sorted(extras.items())))
+        lines.append("  " * indent + "+- " + n.desc
+                     + "  [" + " ".join(ann) + "]")
+        for c in n.children:
+            walk(c, indent + 1)
+
+    walk(ev.root, 0)
     return "\n".join(lines) + "\n"
 
 

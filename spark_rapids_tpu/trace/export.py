@@ -1,0 +1,97 @@
+"""Trace exporters and span analytics.
+
+- :func:`chrome_trace` / :func:`export_chrome_trace` — Chrome Trace
+  Format JSON (the ``traceEvents`` array form) from the process trace
+  buffer.  Loads in Perfetto (ui.perfetto.dev) or chrome://tracing,
+  side by side with the XPlane capture ``device_trace()`` produces —
+  the nsys-timeline analog of the reference's NVTX workflow.
+- :func:`span_stats` — per-operator busy/wall/overlap aggregation over
+  ``exec.*`` spans, the ``df.explain("analyze")`` feed: *busy* is the
+  summed span time (across threads/partitions), *wall* the union of
+  the intervals (span-derived self-time: how long the operator was
+  running anywhere), and *overlap* = busy - wall (time at least two of
+  its spans ran concurrently — proof the pipeline actually overlapped).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+from spark_rapids_tpu.trace import TraceEvent, snapshot
+
+
+def chrome_trace(events: Optional[Sequence[TraceEvent]] = None) -> dict:
+    """Chrome Trace Format dict (JSON Object Format with a
+    ``traceEvents`` array; timestamps in microseconds)."""
+    if events is None:
+        events = snapshot()
+    pid = os.getpid()
+    out: list[dict] = []
+    named: set[int] = set()
+    for ev in events:
+        if ev.tid not in named:
+            named.add(ev.tid)
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": ev.tid,
+                        "args": {"name": ev.thread_name}})
+        rec = {"name": ev.name, "ph": ev.ph, "pid": pid, "tid": ev.tid,
+               "ts": ev.ts_ns / 1e3, "cat": "engine",
+               "args": dict(ev.attrs)}
+        if ev.ph == "X":
+            rec["dur"] = ev.dur_ns / 1e3
+        else:
+            rec["s"] = "t"  # thread-scoped instant
+        out.append(rec)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str,
+                        events: Optional[Sequence[TraceEvent]] = None
+                        ) -> str:
+    """Write the Chrome-trace JSON; returns the path."""
+    doc = chrome_trace(events)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _union_ns(intervals: list[tuple[int, int]]) -> int:
+    intervals.sort()
+    total = 0
+    cs, ce = intervals[0]
+    for s, e in intervals[1:]:
+        if s > ce:
+            total += ce - cs
+            cs, ce = s, e
+        else:
+            ce = max(ce, e)
+    return total + (ce - cs)
+
+
+def span_stats(events: Sequence[TraceEvent],
+               query_id: Optional[int] = None,
+               attr: str = "op") -> dict[str, dict]:
+    """Aggregate spans by an attribute (default: the exec spans' `op`),
+    optionally restricted to one query id.  Per key:
+    ``{"spans", "busy_ns", "wall_ns", "overlap_ns"}`` (see module doc
+    for the busy/wall/overlap semantics)."""
+    per: dict[str, list[tuple[int, int]]] = {}
+    for ev in events:
+        if ev.ph != "X":
+            continue
+        key = ev.attrs.get(attr)
+        if key is None:
+            continue
+        if query_id is not None \
+                and ev.attrs.get("query_id") != query_id:
+            continue
+        per.setdefault(str(key), []).append((ev.ts_ns, ev.end_ns))
+    out: dict[str, dict] = {}
+    for key, iv in per.items():
+        busy = sum(e - s for s, e in iv)
+        wall = _union_ns(iv)
+        out[key] = {"spans": len(iv), "busy_ns": busy, "wall_ns": wall,
+                    "overlap_ns": busy - wall}
+    return out

@@ -345,16 +345,67 @@ def test_source_lint_sync_rule_scoped_to_exec_modules():
     assert "SRC005" not in rules(diags)
 
 
+_TIMING_FIXTURE = """
+import time
+
+class FakeExec:
+    def execute(self, batches):
+        t0 = time.perf_counter_ns()           # SRC006
+        out = [b for b in batches]
+        self.elapsed = time.time() - t0       # SRC006
+        return out
+
+    def untimed(self):
+        time.sleep(0.1)                       # not a clock read
+"""
+
+
+def test_source_lint_flags_raw_timing_in_engine_modules():
+    """SRC006: bare time.* readings in execs/ and parallel/ bypass
+    MetricTimer (settled metrics) and trace.span (the correlated
+    timeline) — nobody can see the number they produce."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/parallel/fake.py"):
+        diags = lint_source_text(_TIMING_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC006"]
+        assert len(hits) == 2, (path, diags)
+        assert all(h.severity == "warning" for h in hits)
+        assert "execute" in hits[0].location
+    # strict mode (the repo gate) fails on the seeded violation
+    assert evaluate(lint_source_text(
+        _TIMING_FIXTURE, "spark_rapids_tpu/execs/fake.py"),
+        strict=True)[2] != 0
+
+
+def test_source_lint_timing_rule_scoped_to_engine_modules():
+    """The same code elsewhere (io/, tools/, bench drivers) is not
+    SRC006's business."""
+    diags = lint_source_text(_TIMING_FIXTURE,
+                             "spark_rapids_tpu/io/fake.py")
+    assert "SRC006" not in rules(diags)
+
+
 def test_repo_baseline_covers_only_intentional_syncs():
     """The checked-in baseline holds exactly the intentional execs/
-    base.py syncs (metric settlement + ANSI error poll) — nothing may
-    hide behind it silently."""
+    base.py syncs (metric settlement + ANSI error poll) and the
+    SRC006 timing-infrastructure sites (MetricTimer + reaper, the
+    coalesce fetch-wait metric, the pipeline wait counters) — nothing
+    may hide behind it silently."""
     from spark_rapids_tpu.lint.diagnostic import load_baseline
 
     keys = load_baseline()
-    assert keys, "baseline should hold the intentional SRC005 syncs"
-    assert all(k.startswith("SRC005::spark_rapids_tpu/execs/base.py::")
-               for k in keys), keys
+    assert keys, "baseline should hold the intentional findings"
+    timing_infra = ("spark_rapids_tpu/execs/base.py",
+                    "spark_rapids_tpu/execs/coalesce.py",
+                    "spark_rapids_tpu/parallel/pipeline.py")
+    for k in keys:
+        if k.startswith("SRC005::"):
+            assert k.startswith(
+                "SRC005::spark_rapids_tpu/execs/base.py::"), k
+        else:
+            assert k.startswith("SRC006::"), k
+            assert any(k.startswith(f"SRC006::{p}::")
+                       for p in timing_infra), k
 
 
 # -- the repo gate (tier-1 hook) ---------------------------------------- #

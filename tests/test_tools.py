@@ -95,6 +95,78 @@ def test_api_validation_counts():
     assert "API coverage" in md and "Execs:" in md
 
 
+def test_query_history_ring_respects_capacity_conf():
+    """QueryHistory is a bounded ring whose capacity comes from
+    spark.rapids.tpu.sql.queryHistory.capacity: the oldest event drops
+    past the cap while query ids keep increasing."""
+    conf = TpuConf()
+    conf.set("spark.rapids.tpu.sql.queryHistory.capacity", 2)
+    session = TpuSession(conf)
+    assert session.history.capacity == 2
+    t = gen_table({"a": "int64"}, 50, seed=6)
+    df = session.create_dataframe(t).where(col("a") > lit(0))
+    df.collect(engine="tpu")
+    first = session.history.events[-1].query_id
+    for _ in range(2):
+        df.collect(engine="tpu")
+    events = session.history.events
+    assert len(events) == 2
+    # the SURVIVORS are the two newest; ids are PROCESS-global and
+    # monotone (they double as the trace correlation key)
+    assert [ev.query_id for ev in events] == [first + 1, first + 2]
+
+
+def test_query_history_drain_makes_snapshots_consistent(session):
+    """record() snapshots on a background worker; every reader drains
+    it first, so events observed right after collect() are complete and
+    in submission order."""
+    t = gen_table({"a": "int64", "b": "float64"}, 200, seed=7)
+    df = session.create_dataframe(t).where(col("a") > lit(0)) \
+        .agg((sum_(col("b")), "s"))
+    for _ in range(3):
+        df.collect(engine="tpu")
+    events = session.history.events
+    ids = [ev.query_id for ev in events]
+    assert ids == [ids[0], ids[0] + 1, ids[0] + 2]
+    # pending futures all settled by the drain
+    assert session.history._pending == []
+    for ev in events:
+        assert ev.root is not None and ev.wall_s >= 0
+        assert "TpuHashAggregateExec" in ev.explain \
+            or "Aggregate" in ev.explain
+
+
+def test_query_ids_unique_across_sessions():
+    """Query ids are process-global: two sessions tracing into the
+    shared buffer must never hand out the same correlation key."""
+    a, b = TpuSession(), TpuSession()
+    ids = {a.history.allocate_id(), b.history.allocate_id(),
+           a.history.allocate_id()}
+    assert len(ids) == 3
+
+
+def test_profile_query_span_self_time_column(session):
+    """With a trace snapshot, profile_query adds the span-derived
+    self_ms column for operators that recorded spans."""
+    from spark_rapids_tpu import trace
+    from spark_rapids_tpu.tools.profiling import profile_query
+
+    trace.enable()
+    try:
+        t = gen_table({"a": "int64", "b": "float64"}, 500, seed=8)
+        df = session.create_dataframe(t).where(col("a") > lit(0)) \
+            .agg((sum_(col("b")), "s"))
+        df.collect(engine="tpu")
+        ev = session.history.events[-1]
+        rep = profile_query(ev, trace.snapshot())
+        assert "self_ms" in rep
+        # without a trace the column stays absent (schema unchanged)
+        assert "self_ms" not in profile_query(ev)
+    finally:
+        trace.disable()
+        trace.clear()
+
+
 def test_device_trace_smoke(session, tmp_path):
     from spark_rapids_tpu.tools.profiling import device_trace
 

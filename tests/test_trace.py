@@ -1,0 +1,358 @@
+"""Unified tracing: span nesting, cross-thread correlation (prefetch
+stages, the exchange map pool), ring-buffer eviction, Chrome-trace
+export, EXPLAIN ANALYZE, and the tracing-off no-op contract."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import trace
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exprs.base import lit
+from spark_rapids_tpu.session import TpuSession, col, sum_
+from tests.differential import gen_table
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with a disabled, empty tracer (the
+    tracer is process-global)."""
+    trace.disable()
+    trace.clear()
+    yield
+    trace.disable()
+    trace.clear()
+
+
+@pytest.fixture
+def traced_session():
+    conf = TpuConf()
+    conf.set("spark.rapids.tpu.trace.enabled", "true")
+    return TpuSession(conf)
+
+
+# -- core span API ------------------------------------------------------ #
+
+def test_span_nesting_and_ordering():
+    trace.enable()
+    with trace.span("outer", layer=1):
+        with trace.span("inner", layer=2):
+            pass
+    evs = {e.name: e for e in trace.snapshot()}
+    outer, inner = evs["outer"], evs["inner"]
+    assert outer.tid == inner.tid
+    # proper nesting: inner's interval sits inside outer's
+    assert outer.ts_ns <= inner.ts_ns
+    assert inner.end_ns <= outer.end_ns
+    assert inner.attrs["layer"] == 2
+
+
+def test_context_attrs_merge_into_spans_and_events():
+    trace.enable()
+    with trace.trace_context(query_id=11, stage="s"):
+        with trace.span("a", extra=1):
+            pass
+        trace.event("b")
+    a, b = {e.name: e for e in trace.snapshot()}["a"], \
+        {e.name: e for e in trace.snapshot()}["b"]
+    assert a.attrs == {"query_id": 11, "stage": "s", "extra": 1}
+    assert b.attrs["query_id"] == 11
+    # context popped on exit
+    with trace.span("c"):
+        pass
+    c = [e for e in trace.snapshot() if e.name == "c"][0]
+    assert "query_id" not in c.attrs
+
+
+def test_disabled_tracing_is_noop():
+    assert not trace.is_enabled()
+    # one shared no-op object: no per-call allocation beyond the kwargs
+    s1 = trace.span("x", a=1)
+    s2 = trace.span("y")
+    assert s1 is s2
+    with s1:
+        pass
+    trace.event("z")
+    trace.record_complete("w", 0, 10)
+    assert trace.snapshot() == []
+
+
+def test_ring_buffer_evicts_oldest():
+    trace.enable(buffer_size=16)
+    for i in range(100):
+        trace.event("e", i=i)
+    evs = [e for e in trace.snapshot() if e.name == "e"]
+    assert len(evs) == 16
+    # the SURVIVORS are the newest 16, in order
+    assert [e.attrs["i"] for e in evs] == list(range(84, 100))
+    assert trace.TRACER.dropped() == 84
+
+
+# -- cross-thread correlation ------------------------------------------- #
+
+def test_prefetch_carries_context_to_stage_thread():
+    from spark_rapids_tpu.parallel.pipeline import prefetch
+
+    trace.enable()
+
+    def gen():
+        for i in range(3):
+            with trace.span("produce.item", i=i):
+                pass
+            yield i
+
+    with trace.trace_context(query_id=7):
+        with trace.span("caller.mark"):
+            pass
+        assert list(prefetch(gen(), depth=2, stage="t.stage")) == [0, 1, 2]
+    evs = trace.snapshot()
+    prod = [e for e in evs if e.name == "produce.item"]
+    assert len(prod) == 3
+    # track ids are per-ring synthetic, so compare against the track
+    # the caller's own span landed on
+    main_tid = [e for e in evs if e.name == "caller.mark"][0].tid
+    # the items were produced on the stage thread, not the caller...
+    assert all(e.tid != main_tid for e in prod)
+    assert all(e.thread_name.startswith("tpu-pipe-") for e in prod)
+    # ...yet carry the caller's correlation context across the hop
+    assert all(e.attrs["query_id"] == 7 for e in prod)
+    # the stage run span + enqueue/dequeue markers carry it too
+    run = [e for e in evs if e.name == "pipe.t.stage.run"]
+    assert run and run[0].attrs["query_id"] == 7
+    enq = [e for e in evs if e.name == "pipe.t.stage.enqueue"]
+    deq = [e for e in evs if e.name == "pipe.t.stage.dequeue"]
+    assert enq and deq
+    assert all(e.attrs["query_id"] == 7 for e in enq)
+
+
+def test_query_spans_multiple_thread_families(traced_session, tmp_path):
+    """A real shuffled query records spans from at least three thread
+    families — the calling thread, a prefetch stage producer, and the
+    exchange map pool — all correlated by the query id (the acceptance
+    shape: a q3-like scan -> exchange -> aggregate pipeline)."""
+    import numpy as np
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(5)
+    paths = []
+    for i in range(2):  # 2 files -> 2 scan partitions -> 2 map tasks
+        t = pa.table({"k": rng.integers(0, 50, 4000),
+                      "v": rng.random(4000)})
+        p = str(tmp_path / f"part-{i}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    # one scan task per file (the default byte target would coalesce
+    # these small files into one task and plan no exchange at all);
+    # scan grouping reads the THREAD-LOCAL conf (conftest restores it)
+    from spark_rapids_tpu.config import get_conf
+
+    get_conf().set("spark.rapids.tpu.sql.scan.taskTargetBytes", 1 << 10)
+    df = (traced_session.read_parquet(*paths)
+          .where(col("v") > lit(0.2))
+          .group_by(col("k"))
+          .agg((sum_(col("v")), "sv")))
+    df.collect(engine="tpu")
+    qid = traced_session.history.events[-1].query_id
+    evs = [e for e in trace.snapshot()
+           if e.attrs.get("query_id") == qid]
+    assert evs, "no spans correlated to the query id"
+    names = {e.name for e in evs}
+    assert "query.plan" in names and "query.execute" in names
+    # exchange map tasks ran on the pool with the query's context
+    tasks = [e for e in evs if e.name == "exchange.task"]
+    assert tasks, names
+    # prefetch stage producers (scan decode/upload) traced + correlated
+    stage_runs = [e for e in evs if e.name.startswith("pipe.")
+                  and e.name.endswith(".run")]
+    assert stage_runs, names
+    families = set()
+    for e in evs:
+        if e.thread_name == "MainThread" or e.name.startswith("query."):
+            families.add("caller")
+        elif e.thread_name.startswith("tpu-pipe-"):
+            families.add("prefetch")
+        elif e.name == "exchange.task":
+            families.add("map-pool")
+    assert {"caller", "prefetch", "map-pool"} <= families
+    assert len({e.tid for e in evs}) >= 3
+    # per-exec spans piggybacked on MetricTimer
+    assert any(e.name.startswith("exec.") for e in evs)
+
+
+# -- exporters ----------------------------------------------------------- #
+
+def test_chrome_trace_schema(traced_session, tmp_path):
+    t = gen_table({"a": "int64", "b": "float64"}, 500, seed=3)
+    df = traced_session.create_dataframe(t).where(col("a") > lit(0)) \
+        .agg((sum_(col("b")), "s"))
+    df.collect(engine="tpu")
+    out = traced_session.export_trace(str(tmp_path / "trace.json"))
+    with open(out) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert metas and all(e["name"] == "thread_name" for e in metas)
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert spans, "no complete spans exported"
+    for e in spans:
+        assert {"name", "pid", "tid", "ts", "dur", "args"} <= set(e)
+        assert e["dur"] >= 0
+    # instants are thread-scoped
+    for e in evs:
+        if e["ph"] == "i":
+            assert e["s"] == "t"
+    # query spans carry the correlation arg
+    assert any(e.get("args", {}).get("query_id") is not None
+               for e in spans)
+
+
+def test_span_stats_busy_wall_overlap():
+    from spark_rapids_tpu.trace import TraceEvent
+    from spark_rapids_tpu.trace.export import span_stats
+
+    def ev(ts, dur, tid):
+        return TraceEvent("exec.X", "X", ts, dur, tid, f"t{tid}",
+                          {"op": "X", "query_id": 1})
+
+    # two overlapping spans on different threads: busy 200, union 150
+    stats = span_stats([ev(0, 100, 1), ev(50, 100, 2)], query_id=1)
+    assert stats["X"]["busy_ns"] == 200
+    assert stats["X"]["wall_ns"] == 150
+    assert stats["X"]["overlap_ns"] == 50
+    # query filter drops foreign spans
+    assert span_stats([ev(0, 10, 1)], query_id=2) == {}
+
+
+def test_trace_cli_runs_script_and_exports(tmp_path):
+    from spark_rapids_tpu.tools import trace as trace_cli
+
+    script = tmp_path / "workload.py"
+    script.write_text(
+        "from spark_rapids_tpu import trace\n"
+        "with trace.span('cli.work', step=1):\n"
+        "    pass\n")
+    out = tmp_path / "out.json"
+    code = trace_cli.main(["-o", str(out), str(script)])
+    assert code == 0
+    doc = json.loads(out.read_text())
+    assert any(e.get("name") == "cli.work"
+               for e in doc["traceEvents"])
+
+
+# -- EXPLAIN ANALYZE ----------------------------------------------------- #
+
+def test_explain_analyze_reports_settled_metrics():
+    session = TpuSession()
+    t = gen_table({"a": "int64", "b": "float64"}, 1000, seed=9)
+    df = session.create_dataframe(t).where(col("a") > lit(0)) \
+        .agg((sum_(col("b")), "s"))
+    out = df.explain("analyze")
+    assert "ANALYZE" in out
+    assert "TpuHashAggregateExec" in out
+    assert "rows=" in out and "batches=" in out and "time=" in out
+
+
+def test_explain_analyze_includes_span_times_when_traced(traced_session):
+    t = gen_table({"a": "int64", "b": "float64"}, 1000, seed=10)
+    df = traced_session.create_dataframe(t).where(col("a") > lit(0)) \
+        .agg((sum_(col("b")), "s"))
+    out = df.explain("analyze")
+    assert "span(busy=" in out and "overlap=" in out, out
+
+
+def test_span_crossing_clear_or_disable_is_dropped():
+    """A span that ends after a clear() (or disable()) belongs to the
+    discarded capture — it must not bleed into the next one."""
+    trace.enable()
+    zombie = trace.span("zombie")
+    zombie.__enter__()
+    trace.clear()
+    zombie.__exit__(None, None, None)
+    assert [e for e in trace.snapshot() if e.name == "zombie"] == []
+    late = trace.span("late")
+    late.__enter__()
+    trace.disable()
+    late.__exit__(None, None, None)
+    trace.enable()
+    assert [e for e in trace.snapshot() if e.name == "late"] == []
+
+
+def test_thread_tracks_stay_distinct_and_dead_rings_prune():
+    """Each thread gets its own synthetic track id (OS idents are
+    recycled and would merge Perfetto tracks), and clear() reclaims
+    dead threads' stale rings instead of leaking them forever."""
+    trace.enable()
+
+    def emit():
+        trace.event("from.thread")
+
+    for _ in range(2):
+        t = threading.Thread(target=emit)
+        t.start()
+        t.join()
+    evs = [e for e in trace.snapshot() if e.name == "from.thread"]
+    assert len(evs) == 2
+    assert evs[0].tid != evs[1].tid  # distinct tracks despite reuse
+    n_before = len(trace.TRACER._rings)
+    trace.clear()  # dead owners can't lazily reset: rings are pruned
+    assert len(trace.TRACER._rings) < n_before
+    assert trace.snapshot() == []
+
+
+def test_record_complete_predating_clear_is_dropped():
+    """Caller-timed spans (the reaper's settle, pipeline waits) whose
+    interval STARTED before a clear() belong to the discarded capture."""
+    import time as _time
+
+    trace.enable()
+    t0 = _time.perf_counter_ns()
+    trace.clear()
+    trace.record_complete("stale", t0, 500)
+    trace.record_complete("fresh", _time.perf_counter_ns(), 500)
+    names = {e.name for e in trace.snapshot()}
+    assert "stale" not in names and "fresh" in names
+
+
+def test_sync_conf_only_enabling_conf_may_disable():
+    """A session whose conf merely defaults to tracing-off must not
+    kill another session's in-flight capture; the conf that enabled
+    tracing still can turn it off."""
+    on = TpuConf()
+    on.set("spark.rapids.tpu.trace.enabled", "true")
+    off = TpuConf()
+    trace.sync_conf(on)
+    assert trace.is_enabled()
+    trace.sync_conf(off)  # a bystander session's collect
+    assert trace.is_enabled()
+    on.set("spark.rapids.tpu.trace.enabled", "false")
+    trace.sync_conf(on)  # the enabler itself opting out
+    assert not trace.is_enabled()
+
+
+def test_conf_off_on_toggle_preserves_capture():
+    """Disabling and re-enabling via conf (same buffer size) must not
+    silently discard the events captured before the toggle — only an
+    actual resize or clear() resets."""
+    on = TpuConf()
+    on.set("spark.rapids.tpu.trace.enabled", "true")
+    trace.sync_conf(on)
+    trace.event("survivor")
+    on.set("spark.rapids.tpu.trace.enabled", "false")
+    trace.sync_conf(on)
+    on.set("spark.rapids.tpu.trace.enabled", "true")
+    trace.sync_conf(on)
+    assert any(e.name == "survivor" for e in trace.snapshot())
+
+
+def test_reset_stage_counters_clears_snapshot():
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    list(P.prefetch(iter(range(4)), depth=2, stage="reset.me"))
+    assert "reset.me" in P.stage_snapshot()
+    P.reset_stage_counters()
+    assert P.stage_snapshot() == {}
