@@ -25,7 +25,12 @@ Attribution fields (so round-over-round deltas are explainable):
 - a link probe (scalar-fetch round-trip + upload bandwidth) taken right
   before timing;
 - a q6 stage breakdown: host decode / wire encode+upload / the final
-  fetch (which inlines the remaining device execution wait).
+  fetch (which inlines the remaining device execution wait);
+- per-query `q*_host_sync_count` (blocking device->host readbacks per
+  collect — the number speculative output sizing drives to zero) and
+  `q{1,3,67}_speculation_hit_rate` (fraction of speculative dispatches
+  whose predicted capacity covered the true count), so the sync
+  elimination is visible in the perf trajectory.
 """
 
 import json
@@ -349,8 +354,36 @@ def _pipeline_occupancy(prefix: str = "pipeline") -> dict:
 
 def _reset_pipeline_counters() -> None:
     from spark_rapids_tpu.parallel.pipeline import reset_stage_counters
+    from spark_rapids_tpu.parallel.speculation import reset_stats
 
     reset_stage_counters()
+    reset_stats()  # per-query speculation hit rates, same discipline
+
+
+def _sync_spec_fields(prefix: str, iters: int,
+                      with_hit_rate: bool = True) -> dict:
+    """Host-sync + speculation attribution for the timed window:
+
+    - `{prefix}_host_sync_count`: BLOCKING device->host readbacks per
+      collect (stage-counter `readbacks`, which speculative sizing's
+      async harvest does not tick) — the number the speculation layer
+      exists to drive to zero; on a ~100ms-RTT link each unit is a
+      stalled link round trip on the critical path;
+    - `{prefix}_speculation_hit_rate`: fraction of speculative
+      dispatches whose predicted capacity covered the true count
+      (sized-output queries only — a grand aggregate never sizes)."""
+    from spark_rapids_tpu.parallel import speculation
+    from spark_rapids_tpu.parallel.pipeline import stage_snapshot
+
+    snap = stage_snapshot()
+    syncs = sum(s["readbacks"] for s in snap.values())
+    out = {f"{prefix}_host_sync_count": round(syncs / max(iters, 1), 2)}
+    if with_hit_rate:
+        out[f"{prefix}_speculation_hit_rate"] = speculation.hit_rate()
+        st = speculation.stats()
+        out[f"{prefix}_speculation_overflows"] = sum(
+            s["overflows"] for s in st.values())
+    return out
 
 
 def _check_rows(tpu_tbl, cpu_tbl, float_from: int, key_cols: int):
@@ -382,9 +415,10 @@ def _bench_q1(session, d: str) -> dict:
         df.collect(engine="tpu")  # warmup
         _reset_pipeline_counters()  # per-query occupancy
         tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
-        # occupancy read BEFORE the tapped breakdown collect, so it
-        # reflects only the timed runs
+        # occupancy + sync/speculation counters read BEFORE the tapped
+        # breakdown collect, so they reflect only the timed runs
         occ = _pipeline_occupancy("q1_pipeline")
+        occ.update(_sync_spec_fields("q1", 3))
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
         breakdown.update(occ)
@@ -417,6 +451,7 @@ def _bench_q3(session, d: str) -> dict:
     _reset_pipeline_counters()  # per-query occupancy
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q3_pipeline")  # timed runs only
+    occ.update(_sync_spec_fields("q3", 3))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     # top-k by float revenue: compare the revenue VALUES (ties may order
     # differently) and the grouped rows' exactness via set inclusion
@@ -451,6 +486,7 @@ def _bench_q67(session, d: str) -> dict:
     _reset_pipeline_counters()  # per-query occupancy
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q67_pipeline")  # timed runs only
+    occ.update(_sync_spec_fields("q67", 3))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     got = list(zip(*tpu_r.to_pydict().values()))
     want = list(zip(*cpu_r.to_pydict().values()))
@@ -498,6 +534,10 @@ def main() -> None:
         # headline occupancy is q6's own (counters reset per config),
         # read BEFORE the tapped breakdown collect
         occ = _pipeline_occupancy("pipeline")
+        # q6 is a grand aggregate: its partials carry static counts, so
+        # there is nothing to speculate — host_sync_count only
+        occ.update(_sync_spec_fields("q6", TPU_ITERS,
+                                     with_hit_rate=False))
         breakdown = _stage_breakdown(df, "q6")
         breakdown.update(occ)
 

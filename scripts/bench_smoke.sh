@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Bench smoke: one tiny query per hot exec (join, aggregate, exchange)
+# with speculative output sizing on/off, asserting result equality —
+# the cheap pre-merge check that the speculation layer stays a pure
+# latency optimization.  The same check runs inside tier-1 as
+# tests/test_speculation.py::test_bench_smoke_queries_match.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m spark_rapids_tpu.tools.bench_smoke "$@"

@@ -48,6 +48,15 @@ from spark_rapids_tpu.ops.groupby import (
 #: whose capacity is the padded key domain (MAX_CODED_DOMAIN).
 _FUSED_DRAIN_CAP = 1 << 18
 
+#: partials at or below this capacity skip the per-batch sizing sync
+#: and shrink entirely: the drain pins all their sizes in one batched
+#: fetch instead.  Each skipped sync saves a full device_get round
+#: trip — hundreds of ms on a degraded tunnel link.  Sized to cover
+#: coded-group-by partials (capacity = padded key domain, up to
+#: MAX_CODED_DOMAIN).  Module-level so tests can force the sizing path
+#: on small data.
+_DEFER_SYNC_CAP = 1 << 18
+
 
 def _as_device_rows(batch):
     if not isinstance(batch, ColumnarBatch):
@@ -177,7 +186,8 @@ class TpuHashAggregateExec(TpuExec):
         return f"TpuHashAggregateExec[{self.mode}] keys=[{keys}] [{outs}]"
 
     def additional_metrics(self):
-        return [("numMerges", "MODERATE")]
+        return [("numMerges", "MODERATE"), ("specHits", "MODERATE"),
+                ("specOverflows", "MODERATE")]
 
     # -- traceable phases ------------------------------------------------ #
 
@@ -455,16 +465,43 @@ class TpuHashAggregateExec(TpuExec):
              self._jit_finalize) = self._jits
 
         from spark_rapids_tpu.memory import SpillPriorities, get_store
+        from spark_rapids_tpu.parallel import speculation as SP
 
         store = get_store()
         # pending partials are spillable between merges (the reference
         # plans the same: aggregate.scala:378-386 spill-of-running-agg)
         pending: list = []  # SpillableBatch handles
+        #: id(handle) -> (ReadbackFuture, est) for partials whose
+        #: sizing readback rides the async harvester (speculative
+        #: sizing): the drain reconciles them before its batched fetch
+        futs: dict = {}
+        pred = SP.predictor(self._cache_key() + ("sizing",)) \
+            if SP.speculation_enabled() else None
 
         def drain_pending() -> ColumnarBatch:
             import dataclasses
 
             batches = [h.get() for h in pending]
+            # reconcile async sizing futures first: in steady state the
+            # harvester already holds the counts, so this is free — a
+            # not-yet-done future is the one place the old blocking
+            # per-batch sync can still surface (accounted as such)
+            for i, h in enumerate(pending):
+                entry = futs.pop(id(h), None)
+                if entry is None or isinstance(batches[i].num_rows, int):
+                    continue
+                fut, est, speculated = entry
+                n = int(fut.result())
+                if pred is not None:
+                    pred.observe(n)
+                    if speculated:
+                        if n <= est:
+                            self.metrics["specHits"].add(1)
+                            SP.record_hit("agg.size", est, n)
+                        else:
+                            self.metrics["specOverflows"].add(1)
+                            SP.record_overflow("agg.size", est, n)
+                batches[i] = dataclasses.replace(batches[i], num_rows=n)
             traced = [i for i, b in enumerate(batches)
                       if not isinstance(b.num_rows, int)]
             if (traced and len(batches) > 1
@@ -510,28 +547,23 @@ class TpuHashAggregateExec(TpuExec):
             return out
 
         try:
-            yield from self._execute_inner(store, pending, drain_pending,
-                                           source, emit_empty_default)
+            yield from self._execute_inner(store, pending, futs, pred,
+                                           drain_pending, source,
+                                           emit_empty_default)
         finally:
             # a raise (or generator close) anywhere above must not leak
             # registrations into the process-global store
             for h in pending:
                 h.close()
             pending.clear()
+            futs.clear()
 
-    def _execute_inner(self, store, pending, drain_pending, source,
-                       emit_empty_default):
+    def _execute_inner(self, store, pending, futs, pred, drain_pending,
+                       source, emit_empty_default):
         from spark_rapids_tpu.memory import SpillPriorities
+        from spark_rapids_tpu.parallel import speculation as SP
 
         import dataclasses
-
-        #: partials at or below this capacity skip the per-batch sizing
-        #: sync and shrink: the drain pins all their sizes in one batched
-        #: fetch instead.  Each skipped sync saves a full device_get
-        #: round trip — hundreds of ms on a degraded tunnel link.  Sized
-        #: to cover coded-group-by partials (capacity = padded key
-        #: domain, up to MAX_CODED_DOMAIN).
-        DEFER_SYNC_CAP = 1 << 18
 
         from spark_rapids_tpu.parallel import pipeline as P
 
@@ -546,15 +578,37 @@ class TpuHashAggregateExec(TpuExec):
                     return batch  # already partial layout
                 return t.observe(self._jit_update(_as_device_rows(batch)))
 
+        def _register_speculative(part) -> None:
+            """Speculative sizing for a big partial: the count readback
+            goes to the async harvester (submitted BEFORE register — a
+            register under pressure may immediately spill the batch),
+            the partial stays unshrunk until the drain reconciles, and
+            merge bookkeeping runs on the predicted estimate.  An
+            overshoot only costs the dead padded rows the drain trims;
+            an undershoot only means one merge triggers a batch late."""
+            nonlocal pending_rows
+            est = pred.predict(cap_ceiling=part.capacity) \
+                if pred is not None else None
+            speculated = est is not None
+            if est is None:
+                est = part.capacity
+                SP.record_sync("agg.size")  # warm-up: estimate is the
+                # conservative capacity bound, not a prediction
+            fut = P.device_read_async(part.num_rows, tag="agg.size")
+            h = store.register(part, SpillPriorities.AGGREGATE_PARTIAL)
+            pending.append(h)
+            futs[id(h)] = (fut, est, speculated)
+            pending_rows += est
+
         def retire(part):
             nonlocal pending_rows
             if (not isinstance(part.num_rows, int)
-                    and part.capacity <= DEFER_SYNC_CAP):
+                    and part.capacity <= _DEFER_SYNC_CAP):
                 pending.append(store.register(
                     part, SpillPriorities.AGGREGATE_PARTIAL))
                 pending_rows += part.capacity  # upper bound; drain pins
                 if len(pending) > 1 and pending_rows >= min(
-                        self.goal_rows, 2 * DEFER_SYNC_CAP):
+                        self.goal_rows, 2 * _DEFER_SYNC_CAP):
                     # bound pending without a sizing sync: re-merge via
                     # the traced concat; the merged partial stays traced
                     with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
@@ -564,6 +618,17 @@ class TpuHashAggregateExec(TpuExec):
                     pending.append(store.register(
                         merged, SpillPriorities.AGGREGATE_PARTIAL))
                     pending_rows = merged.capacity
+                return
+            if pred is not None and not isinstance(part.num_rows, int):
+                _register_speculative(part)
+                if len(pending) > 1 and pending_rows >= self.goal_rows:
+                    with MetricTimer(self.metrics[TOTAL_TIME],
+                                     op=self.name) as t:
+                        merged = t.observe(self._jit_merge(
+                            _as_device_rows(drain_pending())))
+                    self.metrics["numMerges"].add(1)
+                    pending_rows = 0
+                    _register_speculative(merged)
                 return
             # one sizing sync per batch (free when the update emitted a
             # static count, e.g. grand aggregates); pin the host int into

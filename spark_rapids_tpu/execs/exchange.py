@@ -112,6 +112,8 @@ class TpuShuffleExchangeExec(TpuExec):
                           exprs_key(getattr(part, "exprs", ())))
                     pid_fn = self._pid_fns[key] = cached_jit(
                         ck, lambda: part.partition_ids)
+        from collections import deque
+
         from spark_rapids_tpu.columnar.column import pad_capacity
         from spark_rapids_tpu.memory import SpillPriorities, get_store
         from spark_rapids_tpu.ops.partition import (
@@ -119,9 +121,22 @@ class TpuShuffleExchangeExec(TpuExec):
             split_batch_finish,
         )
         from spark_rapids_tpu.parallel import pipeline as P
+        from spark_rapids_tpu.parallel import speculation as SP
 
         store = get_store()
         pending: list[tuple[int, object, int, int]] = []
+        spec_on = SP.speculation_enabled()
+        #: (grouped, counts-or-None, ReadbackFuture) whose split counts
+        #: ride the async harvester; finished opportunistically in
+        #: stream order, drained at task end (map output order does not
+        #: matter, only the commit does).  BOUNDED: queued grouped
+        #: batches are full-capacity device buffers the spill store
+        #: cannot see yet (they register only once their counts
+        #: arrive), so past the bound the head is finished BLOCKING —
+        #: the same natural backpressure the synchronous readback gave,
+        #: just `max_inflight` batches later
+        inflight: deque = deque()
+        max_inflight = P.stage_depth() + 1
 
         def dispatch(batch):
             """Async half: partition-id program + grouping sort for
@@ -132,22 +147,9 @@ class TpuShuffleExchangeExec(TpuExec):
                 return batch, None
             return split_batch_dispatch(batch, pid_fn(batch), n)
 
-        def retire(entry):
-            """Blocking half: ONE batched sizing readback per input
-            batch (previously one sync per REDUCE slice), then register
-            the non-empty slices."""
-            grouped, counts = entry
-            if counts is None:
-                rows = P.device_read_int(grouped.num_rows,
-                                         tag="exchange.split")
-                subs = [(grouped, rows)]
-            else:
-                import numpy as np
-
-                counts_np = np.asarray(
-                    P.device_read(counts, tag="exchange.split"))
-                subs = [(sub, sub.num_rows) for sub in
-                        split_batch_finish(grouped, counts_np, n)]
+        def register_slices(subs) -> None:
+            """Host half: register the non-empty reduce slices once the
+            per-partition counts are host-side."""
             for rid, (sub, rows) in enumerate(subs):
                 if rows:
                     sub = sub.shrink_to_capacity(pad_capacity(rows))
@@ -156,11 +158,50 @@ class TpuShuffleExchangeExec(TpuExec):
                     h.unpin()
                     pending.append((rid, h, h.nbytes, rows))
 
+        def finish_inflight(item) -> None:
+            grouped, has_counts, fut = item
+            v = fut.result()
+            if has_counts:
+                register_slices(
+                    (sub, sub.num_rows) for sub in
+                    split_batch_finish(grouped, v, n))
+            else:
+                register_slices([(grouped, int(v))])
+
+        def retire(entry):
+            """Sizing half.  With speculation on, the count readback is
+            HARVESTED asynchronously: the map loop keeps dispatching
+            while the harvester pulls counts, and slices register as
+            their counts arrive (zero blocking syncs in steady state).
+            Off, it is the one blocking batched readback per input
+            batch, as before."""
+            grouped, counts = entry
+            if spec_on:
+                fut = P.device_read_async(
+                    counts if counts is not None else grouped.num_rows,
+                    tag="exchange.split")
+                inflight.append((grouped, counts is not None, fut))
+                while inflight and (inflight[0][2].done()
+                                    or len(inflight) > max_inflight):
+                    finish_inflight(inflight.popleft())
+                return
+            if counts is None:
+                rows = P.device_read_int(grouped.num_rows,
+                                         tag="exchange.split")
+                register_slices([(grouped, rows)])
+            else:
+                counts_np = P.device_read(counts, tag="exchange.split")
+                register_slices(
+                    (sub, sub.num_rows) for sub in
+                    split_batch_finish(grouped, counts_np, n))
+
         try:
             for _ in P.pipelined(
                     self.children[0].execute_partition(child_part),
                     dispatch, retire, tag="exchange.map"):
                 pass
+            while inflight:
+                finish_inflight(inflight.popleft())
         except BaseException:
             for _rid, h, _b, _r in pending:
                 h.close()

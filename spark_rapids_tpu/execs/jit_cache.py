@@ -33,6 +33,13 @@ _LOCK = threading.Lock()
 #: instance that created them) and jax executable forever.
 _CACHE: "collections.OrderedDict" = collections.OrderedDict()
 MAX_ENTRIES = 512
+#: lookup counters (under _LOCK): a low hit rate on a steady workload
+#: means keys are unstable (per-query state leaking into them) and
+#: every query is paying trace+compile again — surfaced by
+#: cache_stats() in explain("analyze") next to the per-miss
+#: jit.cache_miss trace events
+_HITS = 0
+_MISSES = 0
 
 
 def _field_key(v) -> str:
@@ -74,9 +81,11 @@ def exprs_key(es: Sequence) -> tuple:
 def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
     """Return a jitted callable shared by every caller presenting `key`.
     `make_fn` is invoked (once) only on a cache miss."""
+    global _HITS, _MISSES
     with _LOCK:
         fn = _CACHE.get(key)
         if fn is None:
+            _MISSES += 1
             if _trace.TRACER.enabled:
                 # a miss means a fresh trace+compile is coming for this
                 # program shape: the timeline shows WHICH key paid it
@@ -86,6 +95,7 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)
         else:
+            _HITS += 1
             _CACHE.move_to_end(key)
         return fn
 
@@ -93,6 +103,28 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
 def cache_size() -> int:
     with _LOCK:
         return len(_CACHE)
+
+
+def cache_stats() -> dict:
+    """Cumulative lookup counters: {hits, misses, size, hit_rate}.
+    Callers wanting PER-QUERY figures (explain("analyze")) snapshot
+    before/after and diff."""
+    with _LOCK:
+        total = _HITS + _MISSES
+        return {
+            "hits": _HITS,
+            "misses": _MISSES,
+            "size": len(_CACHE),
+            "hit_rate": round(_HITS / total, 3) if total else 0.0,
+        }
+
+
+def reset_cache_stats() -> None:
+    """Zero the lookup counters (the cache itself is untouched)."""
+    global _HITS, _MISSES
+    with _LOCK:
+        _HITS = 0
+        _MISSES = 0
 
 
 def clear() -> None:

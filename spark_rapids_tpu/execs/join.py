@@ -130,7 +130,8 @@ class _HashJoinBase(TpuExec):
         return f"{self.name} {self.join_type} [{ks}]"
 
     def additional_metrics(self):
-        return [("buildRows", "MODERATE"), ("probeBatches", "MODERATE")]
+        return [("buildRows", "MODERATE"), ("probeBatches", "MODERATE"),
+                ("specHits", "MODERATE"), ("specOverflows", "MODERATE")]
 
     @property
     def _build_child(self) -> TpuExec:
@@ -257,11 +258,22 @@ class _HashJoinBase(TpuExec):
 
         The stream loop is SOFTWARE-PIPELINED (parallel.pipeline): the
         probe for batch k+1 is dispatched before batch k's single
-        blocking pair-count readback, so JAX's async dispatch runs
-        probe(k+1) concurrently with the readback wait — the one
-        structural serialization BENCH_r05 traced the Q3 deficit to
-        (ref: the reference gets the same overlap from JoinGatherer's
-        bounded gathers + the stream iterator's prefetch)."""
+        pair-count readback, so JAX's async dispatch runs probe(k+1)
+        concurrently with the readback wait — the one structural
+        serialization BENCH_r05 traced the Q3 deficit to (ref: the
+        reference gets the same overlap from JoinGatherer's bounded
+        gathers + the stream iterator's prefetch).
+
+        With SPECULATIVE SIZING on (parallel.speculation, the default),
+        even that readback leaves the critical path: the expansion for
+        batch k is dispatched at the predictor's capacity bucket inside
+        dispatch(k) itself — before anyone knows the true pair count —
+        and the count is harvested asynchronously.  retire(k) then only
+        reconciles: a hit yields the already-dispatched chunk, an
+        undershoot appends continuation chunks from offset=cap (the
+        expand_pairs live mask makes both safe; no rollback exists).
+        Steady state runs with ZERO blocking sizing readbacks; warm-up
+        batches pay the conservative sync and seed the predictor."""
         if build is None:
             if self.join_type in ("inner", "left_semi", "cross"):
                 return  # empty build: no output
@@ -269,6 +281,7 @@ class _HashJoinBase(TpuExec):
 
         from spark_rapids_tpu.execs.jit_cache import cached_jit
         from spark_rapids_tpu.parallel import pipeline as P
+        from spark_rapids_tpu.parallel import speculation as SP
 
         jit_probe = cached_jit(self._cache_key() + ("probe",),
                                lambda: self._probe)
@@ -276,15 +289,24 @@ class _HashJoinBase(TpuExec):
             ("semi_compact",), lambda: lambda stream, keep:
             stream.compact(keep))
         matched_b_acc = None
+        sizes_output = self.join_type not in ("left_semi", "left_anti")
+        pred = SP.predictor(self._cache_key() + ("sizing",)) \
+            if sizes_output and SP.speculation_enabled() else None
+        chunk = get_conf().get(JOIN_OUTPUT_CHUNK_ROWS)
+        chunk_cap_ceiling = pad_capacity(chunk)
 
         build = build.with_device_num_rows()
 
         def dispatch(stream):
             """Async half: probe dispatch (+ semi/anti compaction,
-            which needs no readback).  Returns the in-flight state."""
+            which needs no readback).  With a warmed-up predictor the
+            output expansion at the SPECULATED bucket is dispatched
+            here too, and the true pair count goes to the async
+            harvester — nothing in this batch waits on the link."""
             nonlocal matched_b_acc
             self.metrics["probeBatches"].add(1)
             out = None
+            spec = None
             with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 stream = stream.with_device_num_rows()
                 st, total = jit_probe(build, stream)
@@ -292,34 +314,77 @@ class _HashJoinBase(TpuExec):
                     m = st.matched_b
                     matched_b_acc = m if matched_b_acc is None \
                         else (matched_b_acc | m)
-                if self.join_type in ("left_semi", "left_anti"):
+                if not sizes_output:
                     keep = st.matched_s if self.join_type == "left_semi" \
                         else (st.live_s & ~st.matched_s)
                     out = t.observe(jit_semi_compact(stream, keep))
                 else:
                     t.observe(total)
-            return stream, st, total, out
+                    cap = pred.predict(cap_ceiling=chunk_cap_ceiling) \
+                        if pred is not None else None
+                    if cap is not None:
+                        o = self._jit_expand(cap)(
+                            build, stream, st, total,
+                            jnp.asarray(0, jnp.int32))
+                        if self.condition is not None:
+                            o = self._jit_condition(o)
+                        spec = (cap, t.observe(o))
+            fut = P.device_read_async(total, tag="join.probe") \
+                if spec is not None else None
+            return stream, st, total, out, spec, fut
 
         def retire(entry):
-            """Blocking half: the ONE device->host readback per stream
-            batch (the pair count), then the statically-shaped
-            expansion chunks."""
-            stream, st, total, out = entry
+            """Reconciliation half.  Speculated batches harvest the
+            (usually already-fetched) count and either yield the
+            in-flight chunk (hit) or continue from offset=cap
+            (undershoot).  Warm-up / speculation-off batches pay the
+            one blocking readback per stream batch, as before."""
+            stream, st, total, out, spec, fut = entry
             if out is not None:
                 yield self._count_output(out)
                 return
-            with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
-                n_total = P.device_read_int(total, tag="join.probe")
+            if fut is not None:
+                # usually free (harvested); a genuine stall on a
+                # backlogged harvester must still land in this
+                # operator's clock like the sync it replaced
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
+                    n_total = int(fut.result())
+            else:
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
+                    n_total = P.device_read_int(total, tag="join.probe")
+                if pred is not None:
+                    SP.record_sync("join.probe")
+            if pred is not None:
+                pred.observe(n_total)
             if not n_total:
+                if spec is not None:
+                    # sync-free even though the chunk is discarded
+                    self.metrics["specHits"].add(1)
+                    SP.record_hit("join.probe", spec[0], 0)
                 return
-            chunk = get_conf().get(JOIN_OUTPUT_CHUNK_ROWS)
-            out_cap = pad_capacity(min(n_total, chunk))
+            start = 0
+            if spec is not None:
+                cap, o = spec
+                if n_total <= cap:
+                    self.metrics["specHits"].add(1)
+                    SP.record_hit("join.probe", cap, n_total)
+                    yield self._count_output(o)
+                    return
+                # undershoot: the speculated chunk covers [0, cap);
+                # continuation chunks pick up from there — expand_pairs
+                # is offset-windowed, so no work is redone or rolled
+                # back
+                self.metrics["specOverflows"].add(1)
+                SP.record_overflow("join.probe", cap, n_total)
+                yield self._count_output(o)
+                start = cap
+            out_cap = pad_capacity(min(n_total - start, chunk))
             # target-size chunks, spillable between yields (ref:
             # JoinGatherer.scala:55,138 — output in bounded gathers,
             # never one giant batch).  Each chunk's compute gets its
             # own timed region so consumer time between yields never
             # lands in this operator's clock.
-            for off in range(0, n_total, out_cap):
+            for off in range(start, n_total, out_cap):
                 with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
                     o = self._jit_expand(out_cap)(
                         build, stream, st, total,

@@ -47,6 +47,16 @@ Rules
   EXPLAIN ANALYZE and the Chrome-trace export; the timing
   INFRASTRUCTURE itself (MetricTimer, the metric reaper, the pipeline
   wait counters) is baselined, mirroring SRC005's posture.
+- SRC007 (warning): `.block_until_ready()` or `np.asarray(...)` /
+  `np.array(...)` on a (potential) device value inside an exec or ops
+  module (execs/, ops/) — the sync hazards SRC005's
+  `device_get`/`.item()` patterns miss.  Both force a blocking
+  device->host wait when handed a device array; a stream loop must
+  route the sync through parallel.pipeline.device_read* /
+  device_read_async instead (np.asarray of a device_read* RESULT is
+  exempt — the value is already host memory).  Intentional
+  infrastructure sites (metric settlement in execs/base.py, the
+  split-count conversion in ops/partition.py) are baselined.
 """
 
 from __future__ import annotations
@@ -344,6 +354,65 @@ class _ExecSyncChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: numpy module aliases seen in engine code
+_NP_NAMES = {"np", "numpy", "_np"}
+
+
+class _HostMaterializeChecker(ast.NodeVisitor):
+    """SRC007: `.block_until_ready()` / `np.asarray` / `np.array` on
+    potential device values in execs/ and ops/ modules.
+
+    SRC005 catches the explicit sync spellings (`jax.device_get`,
+    `.item()`); these two are the quiet ones — `np.asarray(device_arr)`
+    is a full blocking transfer that LOOKS like a free host-side cast.
+    The rule is syntactic and module-wide like SRC005; converting the
+    RESULT of a blessed `device_read*` call is exempt (that value is
+    already host memory), and intentional infrastructure conversions
+    are baselined, not suppressed inline."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _emit(self, node: ast.AST, what: str) -> None:
+        qual = self._fn_stack[-1] if self._fn_stack else "<module>"
+        self.out.append(Diagnostic(
+            "SRC007", "warning", f"{self.path}::{qual}",
+            f"{what} on a device value blocks on the device in an "
+            "engine hot path",
+            hint="route the sync through parallel.pipeline.device_read"
+                 " / device_read_async (speculative sizing harvests it "
+                 "off the critical path); np.asarray of a device_read* "
+                 "result is already exempt; baseline only intentional "
+                 "infrastructure sites",
+            line=getattr(node, "lineno", 0)))
+
+    @staticmethod
+    def _is_blessed(arg: ast.expr) -> bool:
+        return isinstance(arg, ast.Call) \
+            and _terminal_name(arg.func) in _PIPELINE_HELPERS
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "block_until_ready" and not node.args:
+                self._emit(node, "`.block_until_ready()`")
+            elif node.func.attr in ("asarray", "array") \
+                    and _terminal_name(node.func.value) in _NP_NAMES \
+                    and node.args \
+                    and not self._is_blessed(node.args[0]):
+                self._emit(node,
+                           f"`np.{node.func.attr}(...)`")
+        self.generic_visit(node)
+
+
 #: time-module attributes whose call is a raw wall-clock measurement
 _TIMING_ATTRS = {"time", "perf_counter", "perf_counter_ns",
                  "monotonic", "monotonic_ns"}
@@ -399,6 +468,12 @@ def _is_timed_module(path: str) -> bool:
     return "execs" in parts or "parallel" in parts
 
 
+def _is_sync_hazard_module(path: str) -> bool:
+    """SRC007 scope: exec bodies and the device kernels under ops/."""
+    parts = path.replace("\\", "/").split("/")
+    return "execs" in parts or "ops" in parts
+
+
 def lint_source_text(src: str, path: str) -> list[Diagnostic]:
     """Lint one module's source text (unit-test entry point)."""
     out: list[Diagnostic] = []
@@ -417,6 +492,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _ExecSyncChecker(path, out).visit(tree)
     if _is_timed_module(path):
         _RawTimingChecker(path, out).visit(tree)
+    if _is_sync_hazard_module(path):
+        _HostMaterializeChecker(path, out).visit(tree)
     return out
 
 

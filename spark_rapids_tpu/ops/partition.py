@@ -157,9 +157,14 @@ def split_batch_dispatch(batch: ColumnarBatch, pids: jax.Array,
     return grouped, counts
 
 
-def split_batch_finish(grouped: ColumnarBatch, counts_np: np.ndarray,
+def split_batch_finish(grouped: ColumnarBatch, counts_np,
                        n_parts: int) -> list[ColumnarBatch]:
-    """Slice the per-partition batches once the counts are host-side."""
+    """Slice the per-partition batches once the counts are host-side.
+    `counts_np` is any host array-like — typically the harvested value
+    of a `device_read`/`device_read_async` on split_batch_dispatch's
+    counts (already host memory; the asarray below is a view, not a
+    device sync)."""
+    counts_np = np.asarray(counts_np)
     offsets = np.concatenate([[0], np.cumsum(counts_np)])
     out = []
     cap = grouped.capacity
@@ -186,5 +191,5 @@ def split_batch(batch: ColumnarBatch, pids: jax.Array, n_parts: int
     from spark_rapids_tpu.parallel.pipeline import device_read
 
     grouped, counts = split_batch_dispatch(batch, pids, n_parts)
-    counts_np = np.asarray(device_read(counts, tag="exchange.split"))
+    counts_np = device_read(counts, tag="exchange.split")
     return split_batch_finish(grouped, counts_np, n_parts)

@@ -31,6 +31,12 @@ Two primitives fix both, shared by every exec:
   blessed blocking-sync helper (the tpulint SRC005 rule flags raw
   ``jax.device_get`` in exec bodies) and is traceable in tests via
   :func:`trace_events`.
+- :func:`device_read_async` + :class:`ReadbackFuture` — the
+  future-style sibling for SPECULATIVE sizing (parallel/speculation.py,
+  docs/speculation.md): the exec dispatches work at a predicted
+  capacity and the true count is harvested off-thread; ``result()``
+  one batch later is free in steady state, so even the deferred
+  readback leaves the critical path.
 
 Per-stage occupancy and wait counters feed bench.py's
 ``pipeline_occupancy`` metric and the docs/pipeline.md tuning guide.
@@ -92,7 +98,7 @@ class StageMetrics:
 
     __slots__ = ("name", "depth", "items", "occupancy_sum", "samples",
                  "producer_wait_ns", "consumer_wait_ns", "readbacks",
-                 "_lock")
+                 "async_readbacks", "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -103,6 +109,7 @@ class StageMetrics:
         self.producer_wait_ns = 0
         self.consumer_wait_ns = 0
         self.readbacks = 0
+        self.async_readbacks = 0
         self._lock = threading.Lock()
 
     def snapshot(self) -> dict:
@@ -118,6 +125,7 @@ class StageMetrics:
                 "producer_wait_s": round(self.producer_wait_ns / 1e9, 4),
                 "consumer_wait_s": round(self.consumer_wait_ns / 1e9, 4),
                 "readbacks": self.readbacks,
+                "async_readbacks": self.async_readbacks,
             }
 
 
@@ -231,6 +239,99 @@ def device_read_many(xs: Sequence, tag: Optional[str] = None) -> list:
         with _tr.span("pipe.readback", tag=tag or "", n=len(xs)):
             return list(jax.device_get(xs))
     return list(jax.device_get(xs))
+
+
+#: how long ReadbackFuture.result() waits for the harvester before the
+#: wait counts as a BLOCKING sizing sync: scheduling jitter on a local
+#: backend is well under this, while a genuine link round trip on the
+#: tunneled backend (~100ms median) is far over it — so the counter
+#: measures critical-path stalls, not thread-scheduling noise
+_HARVEST_GRACE_S = 0.005
+
+_HARVESTER = None
+_HARVESTER_LOCK = threading.Lock()
+
+
+def _harvester():
+    """ONE process-wide harvest pool (the readbacks it runs serialize on
+    the device link anyway; per-call threads would leak)."""
+    global _HARVESTER
+    with _HARVESTER_LOCK:
+        if _HARVESTER is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _HARVESTER = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="tpu-pipe-harvest")
+        return _HARVESTER
+
+
+class ReadbackFuture:
+    """A device->host readback in flight on the harvester thread — the
+    speculative-sizing counterpart of :func:`device_read`: the exec
+    dispatches work sized by a PREDICTION and reconciles with the true
+    count when this resolves, so the sizing sync leaves the critical
+    path entirely.  ``result()`` only counts as a blocking readback
+    (trace event + stage counter) when the harvest genuinely was not
+    finished — the zero-blocking-sync acceptance tests key off that."""
+
+    __slots__ = ("_fut", "_value", "_tag", "_resolved")
+
+    def __init__(self, fut, tag: Optional[str], value=None):
+        self._fut = fut
+        self._tag = tag
+        self._value = value
+        self._resolved = fut is None
+
+    def done(self) -> bool:
+        return self._resolved or self._fut.done()
+
+    def result(self):
+        if self._resolved:
+            return self._value
+        fut = self._fut
+        if fut.done():
+            v = fut.result()
+        else:
+            import concurrent.futures as _cf
+
+            try:
+                v = fut.result(timeout=_HARVEST_GRACE_S)
+            except _cf.TimeoutError:
+                # a real critical-path stall: account it like an inline
+                # device_read so host_sync_count stays honest
+                _trace("readback", self._tag)
+                if self._tag is not None:
+                    m = _stage_metrics(self._tag)
+                    with m._lock:
+                        m.readbacks += 1
+                if _tr.TRACER.enabled:
+                    with _tr.span("pipe.readback", tag=self._tag or "",
+                                  blocking=True):
+                        v = fut.result()
+                else:
+                    v = fut.result()
+        self._value = v
+        self._resolved = True
+        self._fut = None
+        return v
+
+
+def device_read_async(x, tag: Optional[str] = None) -> ReadbackFuture:
+    """Submit a device->host readback to the harvester thread and return
+    a :class:`ReadbackFuture` — the future-style sibling of
+    :func:`device_read` for speculative stream loops: dispatch at the
+    predicted size NOW, reconcile with ``result()`` (usually already
+    harvested) one batch later.  Host scalars resolve immediately."""
+    if isinstance(x, (int, float, bool)):
+        return ReadbackFuture(None, tag, value=x)
+    import jax
+
+    _trace("readback_async", tag)
+    if tag is not None:
+        m = _stage_metrics(tag)
+        with m._lock:
+            m.async_readbacks += 1
+    return ReadbackFuture(_harvester().submit(jax.device_get, x), tag)
 
 
 def pipelined(items: Iterable, dispatch: Callable[[Any], Any],

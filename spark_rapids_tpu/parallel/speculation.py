@@ -1,0 +1,229 @@
+"""Speculative output sizing: predict data-dependent output counts so
+stream loops never block on a per-batch sizing readback.
+
+BENCH_r05 traced the two worst numbers in the suite (Q3 join at 0.248x
+CPU, Q1 at 0.566x) to the one remaining structural serialization: the
+per-batch device->host SIZING sync (join pair count, aggregate partial
+row count, exchange split counts) that the software pipeline can only
+defer by a single batch — the expansion/shrink for batch k still waits
+on batch k's count before it can dispatch.  The reference never pays
+this shape-driven sync: JoinGatherer sizes output chunks from a target
+(ref: JoinGatherer.scala:55), and the OOM-retry framework
+(RmmRapidsRetryIterator.scala ``withRetry``, mirrored by
+``execs/retry.py``) is the repo's blessed "guess, then recover" shape.
+
+This module is that pattern for sizing:
+
+- :class:`SizePredictor` — per-program-key EWMA of observed output
+  counts (keyed by the same structural key ``jit_cache.cached_jit``
+  uses), scaled by a safety factor and clamped to pow2 capacity
+  buckets, with a conservative sync-on-first-batches warm-up;
+- the exec dispatches its expansion/gather at the SPECULATED bucket
+  immediately and harvests the true count asynchronously
+  (``parallel.pipeline.device_read_async``);
+- reconciliation is cheap by construction: ``ops/join.py``
+  ``expand_pairs(state, out_cap, offset)`` emits statically-shaped
+  chunks with a live mask, so an undershoot is not a rollback — the
+  exec emits continuation chunks from ``offset`` — and an overshoot
+  only costs masked dead rows (trimmed when chunks are
+  spilled/coalesced).
+
+Hit/overflow counters feed ``bench.py``'s
+``q*_speculation_hit_rate`` fields and the per-exec
+``specHits``/``specOverflows`` metrics shown by
+``df.explain("analyze")``; ``speculation.hit``/``speculation.overflow``
+instants land on the structured trace timeline.  Docs:
+``docs/speculation.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from spark_rapids_tpu import trace as _tr
+from spark_rapids_tpu.config import get_conf, register
+from spark_rapids_tpu.parallel import pipeline as _P
+
+SPECULATION_ENABLED = register(
+    "spark.rapids.tpu.sql.speculation.enabled", True,
+    "Enable speculative output sizing: joins/aggregates/exchanges "
+    "dispatch their output expansion at a predicted pow2 capacity "
+    "bucket (per-program-key EWMA of observed counts) and harvest the "
+    "true count asynchronously, instead of blocking on a per-batch "
+    "device->host sizing readback (the JoinGatherer guess-then-recover "
+    "shape, ref: JoinGatherer.scala:55).  Undershoots emit "
+    "continuation chunks; overshoots only cost masked dead rows.")
+
+SPECULATION_SAFETY_FACTOR = register(
+    "spark.rapids.tpu.sql.speculation.safetyFactor", 1.5,
+    "Multiplier applied to the predicted output count before pow2 "
+    "bucket clamping.  Larger values trade dead padded rows for fewer "
+    "undershoot continuation chunks.",
+    check=lambda v: v >= 1.0)
+
+SPECULATION_WARMUP_BATCHES = register(
+    "spark.rapids.tpu.sql.speculation.warmupBatches", 1,
+    "Observed batches per program key before the predictor speculates; "
+    "warm-up batches pay the conservative blocking sizing sync and "
+    "seed the EWMA.",
+    check=lambda v: v >= 1)
+
+SPECULATION_TEST_FORCE_CAPACITY = register(
+    "spark.rapids.tpu.sql.speculation.testForceCapacity", 0,
+    "Test aid: when > 0, a warmed-up predictor returns exactly this "
+    "capacity bucket instead of its EWMA-derived one (forces the "
+    "under-/over-speculation paths deterministically).",
+    internal=True)
+
+#: EWMA step: ~4 batches of memory — fast enough to track a selectivity
+#: shift mid-stream, slow enough that one outlier batch does not thrash
+#: the bucket choice
+_EWMA_ALPHA = 0.4
+
+
+def speculation_enabled(conf=None) -> bool:
+    conf = conf or get_conf()
+    return bool(conf.get(SPECULATION_ENABLED))
+
+
+class SizePredictor:
+    """EWMA of observed output counts for ONE program key.  Thread-safe:
+    partition-wise joins and exchange map tasks observe concurrently."""
+
+    __slots__ = ("key", "ewma", "observations", "_lock")
+
+    def __init__(self, key):
+        self.key = key
+        self.ewma = 0.0
+        self.observations = 0
+        self._lock = threading.Lock()
+
+    def observe(self, n: int) -> None:
+        with self._lock:
+            self.observations += 1
+            if self.observations == 1:
+                self.ewma = float(n)
+            else:
+                self.ewma += _EWMA_ALPHA * (float(n) - self.ewma)
+
+    def predict(self, conf=None,
+                cap_ceiling: Optional[int] = None) -> Optional[int]:
+        """Speculated pow2 capacity bucket, or None during warm-up (the
+        caller then pays the conservative blocking sizing sync)."""
+        from spark_rapids_tpu.columnar.column import pad_capacity
+
+        conf = conf or get_conf()
+        with self._lock:
+            obs, ewma = self.observations, self.ewma
+        if obs < int(conf.get(SPECULATION_WARMUP_BATCHES)):
+            return None
+        forced = int(conf.get(SPECULATION_TEST_FORCE_CAPACITY))
+        if forced > 0:
+            cap = pad_capacity(forced)
+        else:
+            est = ewma * float(conf.get(SPECULATION_SAFETY_FACTOR))
+            cap = pad_capacity(max(1, int(est)))
+        if cap_ceiling is not None:
+            cap = min(cap, cap_ceiling)
+        return cap
+
+
+#: LRU like jit_cache's MAX_ENTRIES: a long-lived process serving many
+#: distinct ad-hoc query shapes must not pin one predictor per key
+#: forever (the key space is the compile-cache key space)
+_PREDICTORS: "collections.OrderedDict" = collections.OrderedDict()
+MAX_PREDICTORS = 512
+_PRED_LOCK = threading.Lock()
+
+
+def predictor(key) -> SizePredictor:
+    """Get-or-create the process-global predictor for a structural
+    program key (the jit_cache key discipline: two execs whose sizing
+    is determined by equal expression trees/specs share one)."""
+    with _PRED_LOCK:
+        p = _PREDICTORS.get(key)
+        if p is None:
+            p = _PREDICTORS[key] = SizePredictor(key)
+            while len(_PREDICTORS) > MAX_PREDICTORS:
+                _PREDICTORS.popitem(last=False)
+        else:
+            _PREDICTORS.move_to_end(key)
+        return p
+
+
+def reset_predictors() -> None:
+    """Drop every predictor (test isolation)."""
+    with _PRED_LOCK:
+        _PREDICTORS.clear()
+
+
+# ------------------------------------------------------------------ #
+# Hit/overflow accounting (bench.py + explain("analyze") source)
+# ------------------------------------------------------------------ #
+
+_STATS: dict[str, dict] = {}
+_STATS_LOCK = threading.Lock()
+
+
+def _stat(tag: str) -> dict:
+    s = _STATS.get(tag)
+    if s is None:
+        s = _STATS[tag] = {"hits": 0, "overflows": 0, "synced": 0}
+    return s
+
+
+def record_hit(tag: str, cap: int = 0, actual: int = 0) -> None:
+    """The speculated capacity covered the true count: the batch ran
+    with ZERO blocking sizing syncs."""
+    with _STATS_LOCK:
+        _stat(tag)["hits"] += 1
+    _P._trace("spec_hit", tag)
+    if _tr.TRACER.enabled:
+        _tr.event("speculation.hit", tag=tag, cap=cap, actual=actual)
+
+
+def record_overflow(tag: str, cap: int = 0, actual: int = 0) -> None:
+    """Undershoot: the speculated chunk was emitted, and the exec
+    continued with chunks from offset=cap (no rollback)."""
+    with _STATS_LOCK:
+        _stat(tag)["overflows"] += 1
+    _P._trace("spec_overflow", tag)
+    if _tr.TRACER.enabled:
+        _tr.event("speculation.overflow", tag=tag, cap=cap,
+                  actual=actual)
+
+
+def record_sync(tag: str) -> None:
+    """A conservative blocking sizing sync (warm-up batch)."""
+    with _STATS_LOCK:
+        _stat(tag)["synced"] += 1
+
+
+def stats() -> dict[str, dict]:
+    """Per-tag {hits, overflows, synced} counters since the last
+    reset."""
+    with _STATS_LOCK:
+        return {k: dict(v) for k, v in _STATS.items()}
+
+
+def reset_stats() -> None:
+    """bench.py resets between benchmark queries so hit rates report
+    PER QUERY (the reset_stage_counters discipline)."""
+    with _STATS_LOCK:
+        _STATS.clear()
+
+
+def hit_rate(tags=None) -> float:
+    """Fraction of speculative dispatches whose capacity covered the
+    true count, over `tags` (default: all)."""
+    snap = stats()
+    hits = ovf = 0
+    for tag, s in snap.items():
+        if tags is not None and tag not in tags:
+            continue
+        hits += s["hits"]
+        ovf += s["overflows"]
+    total = hits + ovf
+    return round(hits / total, 3) if total else 0.0

@@ -985,9 +985,17 @@ class DataFrame:
         busy/self/overlap times (docs/observability.md)."""
         if mode.lower() == "analyze":
             from spark_rapids_tpu import trace as _trace
+            from spark_rapids_tpu.execs.jit_cache import cache_stats
             from spark_rapids_tpu.tools.profiling import render_analyze
 
+            before = cache_stats()
             _out, qid = self._collect_tpu()
+            after = cache_stats()
+            # per-QUERY compile-cache delta (counters are process-wide
+            # cumulative; concurrent collects can bleed into the diff,
+            # which is fine for a diagnostics line)
+            cs = {"hits": after["hits"] - before["hits"],
+                  "misses": after["misses"] - before["misses"]}
             # find OUR event by id — events[-1] may be a concurrent
             # collect's record (fall back to it only if concurrent
             # collects evicted ours from a tiny history ring)
@@ -995,7 +1003,7 @@ class DataFrame:
             ev = next((e for e in reversed(events_)
                        if e.query_id == qid), events_[-1])
             events = _trace.snapshot() if _trace.is_enabled() else None
-            return render_analyze(ev, events)
+            return render_analyze(ev, events, cache_stats=cs)
         exec_, meta = plan_query(self._plan, self._session.conf)
         out = meta.explain()
         # static-analysis findings over the lowered physical plan

@@ -1,0 +1,110 @@
+"""Bench smoke: one tiny query per hot exec (join, aggregate,
+exchange), each collected with speculative sizing ON and OFF, asserting
+result equality.
+
+The acceptance contract of the speculation layer is that it is a pure
+latency optimization — `speculation.enabled=false` must reproduce the
+same results bit-for-bit.  This driver is the cheap CI hook for that
+contract: `scripts/bench_smoke.sh` runs it standalone, and
+`tests/test_speculation.py::test_bench_smoke_queries_match` runs the
+same function inside the tier-1 `not slow` suite.
+
+Run: python -m spark_rapids_tpu.tools.bench_smoke
+"""
+
+from __future__ import annotations
+
+
+def _queries(session):
+    """(name, DataFrame) per hot exec, tiny enough for seconds-scale
+    CPU runs but multi-batch so the stream loops actually stream."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.session import col, sum_
+
+    rng = np.random.default_rng(0x5BEC)
+    n = 4096
+    lineitem = pa.table({
+        "k": rng.integers(0, 64, n).astype(np.int64),
+        "v": rng.random(n),
+    })
+    dim = pa.table({
+        "k": np.arange(64, dtype=np.int64),
+        "w": rng.integers(0, 9, 64).astype(np.int64),
+    })
+    li = session.create_dataframe(lineitem)
+    joined = li.join(session.create_dataframe(dim),
+                     left_on=[col("k")], right_on=[col("k")])
+    yield "join", joined
+    yield "aggregate", li.group_by(col("k")).agg((sum_(col("v")), "sv"))
+    # the grouped aggregate above plans partial -> exchange -> final;
+    # an ORDER BY adds the range-partitioned exchange shape too
+    yield "exchange", (li.group_by(col("k"))
+                       .agg((sum_(col("v")), "sv"))
+                       .order_by(col("k")))
+
+
+def _assert_rows_match(name: str, on, off) -> None:
+    """Row-set equality with float tolerance: the engine documents
+    run-to-run float aggregation order variability
+    (spark.rapids.tpu.sql.variableFloatAgg.enabled), so exact float
+    equality would flake at the ULP level regardless of speculation."""
+    assert on.num_rows == off.num_rows, (name, on.num_rows,
+                                         off.num_rows)
+    on_rows = sorted(map(tuple, zip(*on.to_pydict().values())))
+    off_rows = sorted(map(tuple, zip(*off.to_pydict().values())))
+    for a, b in zip(on_rows, off_rows):
+        for x, y in zip(a, b):
+            if isinstance(x, float):
+                assert abs(x - y) <= 1e-9 * max(1.0, abs(y)), \
+                    f"{name}: speculation on/off results differ: {a} {b}"
+            else:
+                assert x == y, \
+                    f"{name}: speculation on/off results differ: {a} {b}"
+
+
+def run_smoke() -> dict:
+    """Collect each smoke query with speculation on, then off, assert
+    table equality, and return {query_name: rows}."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.session import TpuSession
+
+    key = "spark.rapids.tpu.sql.speculation.enabled"
+    batch_key = "spark.rapids.tpu.sql.batchSizeRows"
+    conf = get_conf()
+    saved = {k: conf.get(k) for k in (key, batch_key)}
+    session = TpuSession()
+    # small batches so every stream loop sees several batches (the
+    # warm-up -> steady-state transition is the interesting part)
+    conf.set(batch_key, 512)
+    out: dict = {}
+    try:
+        for name, df in _queries(session):
+            conf.set(key, True)
+            on = df.collect(engine="tpu")
+            conf.set(key, False)
+            off = df.collect(engine="tpu")
+            _assert_rows_match(name, on, off)
+            out[name] = on.num_rows
+    finally:
+        for k, v in saved.items():
+            conf.set(k, v)
+    return out
+
+
+def main() -> int:
+    import json
+
+    # stand-alone runs ride the CPU backend: this is a correctness
+    # smoke, and the container's sitecustomize would otherwise pin a
+    # fragile remote-TPU tunnel (config.update beats the env var)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps({"bench_smoke": run_smoke(), "ok": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

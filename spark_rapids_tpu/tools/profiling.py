@@ -177,14 +177,31 @@ def _op_key(desc: str) -> str:
     return desc.split(" ", 1)[0].split("[", 1)[0]
 
 
+def _jit_cache_line(cache_stats: Optional[dict]) -> Optional[str]:
+    """One-line compile-cache summary (callers pass a PER-QUERY delta
+    of jit_cache.cache_stats(), next to the per-miss jit.cache_miss
+    trace events)."""
+    if cache_stats is None:
+        return None
+    hits = cache_stats.get("hits", 0)
+    misses = cache_stats.get("misses", 0)
+    total = hits + misses
+    rate = f"{hits / total:.2f}" if total else "n/a"
+    return (f"jit cache: hits={hits} misses={misses} "
+            f"hit_rate={rate}")
+
+
 def profile_query(ev: QueryEvent,
-                  trace_events: Optional[Sequence] = None) -> str:
+                  trace_events: Optional[Sequence] = None,
+                  cache_stats: Optional[dict] = None) -> str:
     """Per-operator metrics table for one query (the Analysis /
     ClassWarehouse per-SQL metrics view).  With `trace_events` (a
     spark_rapids_tpu.trace snapshot), a `self_ms` column reports each
     operator's span-derived self-time: the union of its trace spans for
     this query — time the operator was actively running on SOME thread,
-    as opposed to summed per-thread busy time."""
+    as opposed to summed per-thread busy time.  With `cache_stats` (a
+    per-query jit_cache.cache_stats() delta), a compile-cache hit-rate
+    footer rides along."""
     stats: dict = {}
     if trace_events is not None:
         from spark_rapids_tpu.trace.export import span_stats
@@ -213,11 +230,15 @@ def profile_query(ev: QueryEvent,
         lines.append(
             f"| {n.desc[:60]} | {rows} | {batches} | {t_ms} |{extra}"
             f" {' '.join(others)} |")
+    jc = _jit_cache_line(cache_stats)
+    if jc is not None:
+        lines += ["", jc]
     return "\n".join(lines) + "\n"
 
 
 def render_analyze(ev: QueryEvent,
-                   trace_events: Optional[Sequence] = None) -> str:
+                   trace_events: Optional[Sequence] = None,
+                   cache_stats: Optional[dict] = None) -> str:
     """EXPLAIN ANALYZE: the post-run plan tree, each operator annotated
     with its SETTLED metrics (wall time per device-synced totalTime,
     rows, batches) and — when a trace is available — span-derived
@@ -226,7 +247,12 @@ def render_analyze(ev: QueryEvent,
     busy - self (concurrent execution the aggregate timers hide).
     Span figures aggregate per operator CLASS (spans carry the exec
     name), so two instances of one class — a partial and a final
-    aggregate — show the class total on each."""
+    aggregate — show the class total on each.  Speculative-sizing
+    operators surface their `specHits`/`specOverflows` counters through
+    the regular metric annotations — a join showing only specHits ran
+    its stream loop sync-free.  `cache_stats` (a per-query
+    jit_cache.cache_stats() delta) appends the compile-cache hit
+    rate."""
     stats: dict = {}
     if trace_events is not None:
         from spark_rapids_tpu.trace.export import span_stats
@@ -261,6 +287,9 @@ def render_analyze(ev: QueryEvent,
             walk(c, indent + 1)
 
     walk(ev.root, 0)
+    jc = _jit_cache_line(cache_stats)
+    if jc is not None:
+        lines.append(jc)
     return "\n".join(lines) + "\n"
 
 

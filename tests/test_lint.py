@@ -385,12 +385,60 @@ def test_source_lint_timing_rule_scoped_to_engine_modules():
     assert "SRC006" not in rules(diags)
 
 
+_MATERIALIZE_FIXTURE = """
+import numpy as np
+
+class FakeExec:
+    def _drain(self, batches):
+        out = []
+        for b in batches:
+            b.total.block_until_ready()          # SRC007
+            out.append(np.asarray(b.counts))     # SRC007
+        return out
+
+    def blessed(self, counts):
+        from spark_rapids_tpu.parallel.pipeline import device_read
+
+        return np.asarray(device_read(counts))   # exempt: host already
+"""
+
+
+def test_source_lint_flags_host_materialization_in_engine_modules():
+    """SRC007: raw `.block_until_ready()` / `np.asarray` on device
+    values in execs/ AND ops/ (the sync spellings SRC005 misses) must
+    route through device_read*/device_read_async; converting a
+    device_read* RESULT is exempt (already host memory)."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/ops/fake.py"):
+        diags = lint_source_text(_MATERIALIZE_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC007"]
+        assert len(hits) == 2, (path, diags)
+        assert all(h.severity == "warning" for h in hits)
+        assert "_drain" in hits[0].location
+    # strict mode (the repo gate) fails on the seeded violation
+    assert evaluate(lint_source_text(
+        _MATERIALIZE_FIXTURE, "spark_rapids_tpu/ops/fake.py"),
+        strict=True)[2] != 0
+
+
+def test_source_lint_materialize_rule_scoped_to_engine_modules():
+    """The same code elsewhere (io/, the pipeline helper itself) is
+    not SRC007's business."""
+    for path in ("spark_rapids_tpu/io/fake.py",
+                 "spark_rapids_tpu/parallel/fake.py"):
+        assert "SRC007" not in rules(
+            lint_source_text(_MATERIALIZE_FIXTURE, path))
+
+
 def test_repo_baseline_covers_only_intentional_syncs():
     """The checked-in baseline holds exactly the intentional execs/
-    base.py syncs (metric settlement + ANSI error poll) and the
-    SRC006 timing-infrastructure sites (MetricTimer + reaper, the
-    coalesce fetch-wait metric, the pipeline wait counters) — nothing
-    may hide behind it silently."""
+    base.py syncs (metric settlement + ANSI error poll), the SRC006
+    timing-infrastructure sites (MetricTimer + reaper, the coalesce
+    fetch-wait metric, the pipeline wait counters) and the SRC007
+    host-conversion infrastructure (metric settlement's np.asarray of
+    already-fetched values in execs/base.py, the split-count
+    conversion in ops/partition.py) — nothing may hide behind it
+    silently."""
     from spark_rapids_tpu.lint.diagnostic import load_baseline
 
     keys = load_baseline()
@@ -398,10 +446,15 @@ def test_repo_baseline_covers_only_intentional_syncs():
     timing_infra = ("spark_rapids_tpu/execs/base.py",
                     "spark_rapids_tpu/execs/coalesce.py",
                     "spark_rapids_tpu/parallel/pipeline.py")
+    sync_infra = ("spark_rapids_tpu/execs/base.py",
+                  "spark_rapids_tpu/ops/partition.py")
     for k in keys:
         if k.startswith("SRC005::"):
             assert k.startswith(
                 "SRC005::spark_rapids_tpu/execs/base.py::"), k
+        elif k.startswith("SRC007::"):
+            assert any(k.startswith(f"SRC007::{p}::")
+                       for p in sync_infra), k
         else:
             assert k.startswith("SRC006::"), k
             assert any(k.startswith(f"SRC006::{p}::")
