@@ -30,7 +30,15 @@ Attribution fields (so round-over-round deltas are explainable):
   collect — the number speculative output sizing drives to zero) and
   `q{1,3,67}_speculation_hit_rate` (fraction of speculative dispatches
   whose predicted capacity covered the true count), so the sync
-  elimination is visible in the perf trajectory.
+  elimination is visible in the perf trajectory;
+- `q3_rf_*` runtime-filter attribution (pruned rows, build ms, pruned
+  row groups per collect) plus `q3_upload_rows` vs
+  `q3_upload_rows_no_rf` — the probe-side wire-shrink runtime join
+  filters buy (docs/runtime_filters.md);
+- `q6_warm_*` / `q1_warm_*` + `hbm_roofline_fraction_warm`: a second
+  pass against df.cache()-materialized DEVICE-resident batches, so
+  actual device throughput is measured with the H2D wire out of the
+  loop.
 """
 
 import json
@@ -355,9 +363,11 @@ def _pipeline_occupancy(prefix: str = "pipeline") -> dict:
 def _reset_pipeline_counters() -> None:
     from spark_rapids_tpu.parallel.pipeline import reset_stage_counters
     from spark_rapids_tpu.parallel.speculation import reset_stats
+    from spark_rapids_tpu.plan import runtime_filter
 
     reset_stage_counters()
     reset_stats()  # per-query speculation hit rates, same discipline
+    runtime_filter.reset_stats()  # per-query pruned-row counts too
 
 
 def _sync_spec_fields(prefix: str, iters: int,
@@ -383,6 +393,53 @@ def _sync_spec_fields(prefix: str, iters: int,
         st = speculation.stats()
         out[f"{prefix}_speculation_overflows"] = sum(
             s["overflows"] for s in st.values())
+    return out
+
+
+def _rf_fields(df, iters: int) -> dict:
+    """q3 runtime-filter attribution: pruned rows + build cost over the
+    timed window (per collect), plus uploaded-row counts with filters
+    on vs off — the wire-shrink the filters buy, measured."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.plan import runtime_filter
+    from spark_rapids_tpu.tools.bench_smoke import count_upload_rows
+
+    st = runtime_filter.stats()
+    per = max(iters, 1)
+    out = {
+        "q3_rf_pruned_rows": round(st["pruned_rows"] / per, 1),
+        "q3_rf_build_ms": round(st["build_ms"] / per, 2),
+        "q3_rf_row_groups_pruned": round(
+            st["row_groups_pruned"] / per, 1),
+    }
+    key = "spark.rapids.tpu.sql.runtimeFilter.enabled"
+    conf = get_conf()
+    old = conf.get(key)
+    try:
+        conf.set(key, True)
+        out["q3_upload_rows"] = count_upload_rows(df)
+        conf.set(key, False)
+        out["q3_upload_rows_no_rf"] = count_upload_rows(df)
+    finally:
+        conf.set(key, old)
+    return out
+
+
+def _bench_warm(df, prefix: str, n_rows: int, iters: int = 3) -> dict:
+    """Warm device-resident pass: `df` reads a df.cache()-materialized
+    subtree, so timed collects run against batches already in HBM — the
+    first measurement of actual DEVICE throughput, with the H2D wire
+    out of the loop (VERDICT weak #3).  Caller collects once to fill
+    the cache before timing."""
+    times, _r = _time_collect(df, "tpu", iters)
+    t = statistics.median(times)
+    rows_per_s = n_rows / t
+    out = {
+        f"{prefix}_s_median": round(t, 4),
+        f"{prefix}_s_min": round(min(times), 4),
+        f"{prefix}_s_max": round(max(times), 4),
+        f"{prefix}_rows_per_s": round(rows_per_s, 1),
+    }
     return out
 
 
@@ -422,6 +479,26 @@ def _bench_q1(session, d: str) -> dict:
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
         breakdown.update(occ)
+        # warm device-resident pass: cache the scan output, re-run the
+        # aggregate against HBM-resident batches (no H2D in the loop)
+        from spark_rapids_tpu.session import avg, col, count_star, sum_
+        from spark_rapids_tpu.exprs.base import lit
+
+        cached = session.read_parquet(*q1_files).cache()
+        qty, price = col("l_quantity"), col("l_extendedprice")
+        disc, tax = col("l_discount"), col("l_tax")
+        warm_df = (cached.where(col("l_shipdate") <= lit(10471))
+                   .group_by(col("l_returnflag"), col("l_linestatus"))
+                   .agg((sum_(qty), "sum_qty"),
+                        (sum_(price), "sum_base_price"),
+                        (avg(disc), "avg_disc"),
+                        (count_star(), "count_order")))
+        try:
+            warm_df.collect(engine="tpu")  # fills the cache slot
+            breakdown.update(_bench_warm(warm_df, "q1_warm",
+                                         ROWS_PER_FILE * 2))
+        finally:
+            cached.unpersist()
     finally:
         conf.set(key, old_sp)
     _check_rows(tpu_r, cpu_r, float_from=2, key_cols=2)
@@ -452,6 +529,9 @@ def _bench_q3(session, d: str) -> dict:
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q3_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q3", 3))
+    # runtime-filter attribution for the timed window + the on/off
+    # uploaded-row delta (the wire-shrink the filters buy)
+    occ.update(_rf_fields(df, 3))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     # top-k by float revenue: compare the revenue VALUES (ties may order
     # differently) and the grouped rows' exactness via set inclusion
@@ -540,6 +620,30 @@ def main() -> None:
                                      with_hit_rate=False))
         breakdown = _stage_breakdown(df, "q6")
         breakdown.update(occ)
+
+        # warm device-resident q6: the same filter+aggregate against a
+        # df.cache()-materialized scan — batches already in HBM, so
+        # this finally measures DEVICE throughput instead of the wire
+        # (VERDICT weak #3); roofline fraction rides along
+        from spark_rapids_tpu.session import col as _col, sum_ as _sum
+        from spark_rapids_tpu.exprs.base import lit as _lit
+
+        cached = session.read_parquet(*paths).cache()
+        ship, disc = _col("l_shipdate"), _col("l_discount")
+        qty, price = _col("l_quantity"), _col("l_extendedprice")
+        cond = ((ship >= _lit(8766)) & (ship < _lit(9131))
+                & (disc >= _lit(0.05)) & (disc <= _lit(0.07))
+                & (qty < _lit(24.0)))
+        warm_df = cached.where(cond).agg((_sum(price * disc), "revenue"))
+        try:
+            warm_df.collect(engine="tpu")  # fills the cache slot
+            warm = _bench_warm(warm_df, "q6_warm", n_rows)
+            warm["hbm_roofline_fraction_warm"] = round(
+                warm["q6_warm_rows_per_s"] * ROW_BYTES
+                / HBM_BYTES_PER_S, 4)
+        finally:
+            cached.unpersist()
+        breakdown.update(warm)
 
         if tpu_t > 10.0:
             # degraded tunnel (per-dispatch latency in the seconds):

@@ -1838,6 +1838,32 @@ def _cast_cpu_from_string(c: pa.Array, dst, at) -> pa.Array:
     raise NotImplementedError(f"CPU cast string -> {dst}")
 
 
+_SORT_KEY_PLACEMENT: list = []  # lazy probe: [] unknown, [bool] known
+
+
+def _sort_indices(data, sort_keys, null_placement: str):
+    """pyarrow >= 25 deprecates SortOptions-level ``null_placement``
+    (FutureWarning on every call) in favor of per-sort-key placement
+    passed as (name, order, null_placement) triples; older pyarrow
+    rejects the triple form.  Probe once, then stick to whichever form
+    this runtime supports."""
+    if not _SORT_KEY_PLACEMENT:
+        try:
+            probe = pa.table({"__p": [1]})
+            pc.sort_indices(
+                probe,
+                sort_keys=[("__p", "ascending", null_placement)])
+            _SORT_KEY_PLACEMENT.append(True)
+        except Exception:
+            _SORT_KEY_PLACEMENT.append(False)
+    if _SORT_KEY_PLACEMENT[0]:
+        return pc.sort_indices(
+            data, sort_keys=[(n, o, null_placement)
+                             for n, o in sort_keys])
+    return pc.sort_indices(data, sort_keys=sort_keys,
+                           null_placement=null_placement)
+
+
 def _sort_cpu(plan: L.Sort) -> pa.Table:
     child = execute_cpu(plan.children[0])
     # project sort keys as temp columns
@@ -1850,9 +1876,9 @@ def _sort_cpu(plan: L.Sort) -> pa.Table:
         keys.append((name, "descending" if k.descending else "ascending"))
     placements = {k.nulls_last for k in plan.keys}
     if len(placements) == 1:
-        idx = pc.sort_indices(
-            tmp, sort_keys=keys,
-            null_placement="at_end" if placements.pop() else "at_start")
+        idx = _sort_indices(
+            tmp, keys,
+            "at_end" if placements.pop() else "at_start")
     else:
         # mixed per-key null placement: stable multi-pass sort from the
         # least significant key (python fallback, oracle-grade only)
@@ -1860,9 +1886,9 @@ def _sort_cpu(plan: L.Sort) -> pa.Table:
         for (name, order), k in reversed(list(zip(keys, plan.keys))):
             col = tmp.column(name).combine_chunks().take(
                 pa.array(idx_np, pa.int64()))
-            sidx = pc.sort_indices(
-                col, sort_keys=[("", order)],
-                null_placement="at_end" if k.nulls_last else "at_start")
+            sidx = _sort_indices(
+                col, [("", order)],
+                "at_end" if k.nulls_last else "at_start")
             idx_np = idx_np[np.asarray(sidx)]
         idx = pa.array(idx_np, pa.int64())
     return child.take(idx)

@@ -277,6 +277,11 @@ class TpuAdaptiveJoinExec(TpuExec):
         self._decided: Optional[TpuExec] = None
         self._decision = "undecided"
         self._lock = threading.Lock()
+        #: set by the runtime-filter planner pass: which side hosts a
+        #: filter-building map stage and must materialize FIRST, so the
+        #: published filter prunes the other side's scans
+        #: (plan/runtime_filter.py build-before-probe ordering)
+        self.rf_build_first: Optional[str] = None
         # schema comes from the inner join exec; build one eagerly so
         # schema/explain work before execution (the static shape)
         self._template = self._make_shuffled(left_exchange,
@@ -330,8 +335,16 @@ class TpuAdaptiveJoinExec(TpuExec):
             conf = get_conf()
             thr = conf.get(BROADCAST_THRESHOLD)
             lex, rex = self.children
-            lstats = lex.materialize_stats()
-            rstats = rex.materialize_stats()
+            if self.rf_build_first == "right":
+                # build-before-probe: the right map stage streams the
+                # join's build input through its runtime-filter
+                # collector; materializing it first publishes the
+                # filter before the left (probe) map stage scans
+                rstats = rex.materialize_stats()
+                lstats = lex.materialize_stats()
+            else:
+                lstats = lex.materialize_stats()
+                rstats = rex.materialize_stats()
             lbytes = sum(b for b, _ in lstats)
             rbytes = sum(b for b, _ in rstats)
 

@@ -435,6 +435,131 @@ class _HashJoinBase(TpuExec):
             yield self._count_output(out)
 
 
+class TpuRuntimeFilterBuildExec(TpuExec):
+    """Streaming pass-through inserted by the runtime-filter planner
+    pass (plan/runtime_filter.py) on the BUILD side of an eligible
+    join: every batch flows through unchanged while its join-key
+    columns fold into device-resident Bloom bits + min/max
+    accumulators; when the last partition drains, the finished filter
+    is fetched once (a few KB) and published to the probe side's
+    scans.
+
+    Sits either directly under the join (wide/broadcast shapes — the
+    join collects build before streaming probe) or under the build
+    exchange (partition-wise/adaptive shapes — the map stage drains the
+    whole build input before the probe stage materializes, with
+    execs/adaptive.py ordering build-before-probe).  Per-batch updates
+    are async device dispatches; the one blocking readback happens at
+    finalize, through the sanctioned pipeline API."""
+
+    def __init__(self, child: TpuExec, entries):
+        super().__init__(child)
+        #: [(bound key Expression, RuntimeFilter)]
+        self.entries = list(entries)
+        self._lock = threading.Lock()
+        self._acc = None  # merged per-filter device states
+        self._parts_done: set = set()
+        self._published = False
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    @property
+    def output_partitioning(self):
+        return self.children[0].output_partitioning
+
+    def node_desc(self) -> str:
+        ks = ", ".join(rf.describe() for _k, rf in self.entries)
+        return f"{self.name} [{ks}]"
+
+    def additional_metrics(self):
+        return [("rfBuildTime", "ESSENTIAL"), ("rfKeys", "MODERATE")]
+
+    def _jit_update(self):
+        fn = getattr(self, "_update_fn", None)
+        if fn is None:
+            from spark_rapids_tpu.execs.jit_cache import (
+                cached_jit,
+                exprs_key,
+            )
+            from spark_rapids_tpu.plan import runtime_filter as RF
+
+            entries = self.entries
+            specs = tuple((rf.n_bits, rf.n_hashes, rf.is64, rf.use_bloom)
+                          for _k, rf in entries)
+
+            def update(states, batch):
+                ctx = EvalContext.for_batch(batch)
+                live = batch.row_mask()
+                out = []
+                for (key, rf), st in zip(entries, states):
+                    col = key.eval(ctx)
+                    contrib = live & col.validity
+                    out.append(RF.device_update(
+                        st, col, contrib, rf.n_bits, rf.n_hashes,
+                        rf.is64, rf.use_bloom))
+                return tuple(out)
+
+            fn = self._update_fn = cached_jit(
+                ("rf.update", exprs_key([k for k, _ in entries]), specs,
+                 repr(self.schema)), lambda: update)
+        return fn
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan import runtime_filter as RF
+
+        states = [RF.device_init_state(rf.n_bits, rf.use_bloom)
+                  for _k, rf in self.entries]
+        update = self._jit_update()
+        for batch in self.children[0].execute_partition(p):
+            from spark_rapids_tpu.columnar.transfer import EncodedBatch
+
+            if isinstance(batch, EncodedBatch):
+                # key eval needs decoded columns; the consumer above
+                # still receives the original wire-form batch
+                decoded = batch.decode_now()
+            else:
+                decoded = batch
+            with MetricTimer(self.metrics[TOTAL_TIME],
+                             op=self.name) as t:
+                states = update(tuple(states),
+                                decoded.with_device_num_rows())
+                t.observe(states)
+            yield self._count_output(batch)
+        self._merge_and_maybe_publish(p, states)
+
+    def _merge_and_maybe_publish(self, p: int, states) -> None:
+        from spark_rapids_tpu.plan import runtime_filter as RF
+
+        with self._lock:
+            if self._published:
+                return
+            if self._acc is None:
+                self._acc = list(states)
+            else:
+                self._acc = [RF.device_merge_states(a, s)
+                             for a, s in zip(self._acc, states)]
+            self._parts_done.add(p)
+            if len(self._parts_done) < self.num_partitions:
+                return
+            self._published = True
+            acc = self._acc
+            self._acc = None
+        for (_k, rf), st in zip(self.entries, acc):
+            RF.finalize(rf, st)
+            self.metrics["rfKeys"].add(rf.n_keys)
+            self.metrics["rfBuildTime"].add(int(rf.build_ms * 1e6))
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+
 class TpuShuffledHashJoinExec(_HashJoinBase):
     """partition_wise=False: wide — collect the whole build side, stream
     every partition, one output partition.  partition_wise=True: children

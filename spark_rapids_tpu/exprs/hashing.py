@@ -77,6 +77,66 @@ def hash_int32_block(word: jax.Array, seed: jax.Array) -> jax.Array:
     return _fmix(h1, 4)
 
 
+# --------------------------------------------------------------------- #
+# Host (numpy) mirrors of the fixed-width block hashes.
+#
+# The runtime-filter subsystem (plan/runtime_filter.py) builds its Bloom
+# bitset ON DEVICE from build-side join keys and probes it ON HOST
+# against freshly decoded scan columns — before any byte crosses the
+# host->device link.  Both sides must agree bit-for-bit, so the host
+# probe mirrors the jax functions above in pure numpy uint32 arithmetic
+# (numpy integer ops wrap exactly like XLA's).  Any edit to the device
+# functions must be mirrored here; test_runtime_filter.py pins parity
+# on randomized keys.
+# --------------------------------------------------------------------- #
+
+
+def np_hash_int32_block(word, seed):
+    """numpy mirror of :func:`hash_int32_block`: uint32[n] hashes of
+    int32-block values (int/short/byte/date/bool lanes)."""
+    import numpy as np
+
+    k1 = np.asarray(word).astype(np.uint32)
+    k1 = k1 * np.uint32(_C1)
+    k1 = (k1 << np.uint32(15)) | (k1 >> np.uint32(17))
+    k1 = k1 * np.uint32(_C2)
+    h1 = np.asarray(seed).astype(np.uint32) ^ k1
+    h1 = (h1 << np.uint32(13)) | (h1 >> np.uint32(19))
+    h1 = h1 * np.uint32(5) + np.uint32(0xE6546B64)
+    return _np_fmix(h1, 4)
+
+
+def np_hash_int64_blocks(value, seed):
+    """numpy mirror of :func:`hash_int64_blocks`: uint32[n] hashes of
+    8-byte values, low word first (long/timestamp lanes)."""
+    import numpy as np
+
+    v = np.asarray(value).astype(np.int64)
+    low = (v & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    high = ((v >> np.int64(32)) & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    h1 = np.asarray(seed).astype(np.uint32)
+    for k1 in (low, high):
+        k1 = k1 * np.uint32(_C1)
+        k1 = (k1 << np.uint32(15)) | (k1 >> np.uint32(17))
+        k1 = k1 * np.uint32(_C2)
+        h1 = h1 ^ k1
+        h1 = (h1 << np.uint32(13)) | (h1 >> np.uint32(19))
+        h1 = h1 * np.uint32(5) + np.uint32(0xE6546B64)
+    return _np_fmix(h1, 8)
+
+
+def _np_fmix(h1, length: int):
+    import numpy as np
+
+    h1 = h1 ^ np.uint32(length)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    return h1
+
+
 def hash_int64_blocks(value: jax.Array, seed: jax.Array) -> jax.Array:
     """Murmur3 of an 8-byte value, low 32-bit word first (Spark hashLong)."""
     v = value.astype(jnp.int64)

@@ -552,7 +552,8 @@ def _def_levels_all_valid(dl: np.ndarray, bw: int, n: int,
 def read_file(path: str, keep_rgs: Sequence[int],
               columns: Sequence[str], conjuncts,
               engine_schema, pqfile=None,
-              max_decoded_bytes: Optional[int] = None
+              max_decoded_bytes: Optional[int] = None,
+              runtime_filters=None, counters: Optional[dict] = None
               ) -> Optional[list]:
     """Decode + filter one file -> list of pa.Table (survivor rows,
     one per row group), or None when any part is unsupported.
@@ -561,6 +562,14 @@ def read_file(path: str, keep_rgs: Sequence[int],
     referencing exactly one decoded column are applied here (on the
     dictionary when possible), the rest are left for the device
     Filter — the result is conservative, never wrong.
+
+    `runtime_filters` ([(column_name, RuntimeFilter)], may be None) are
+    build-side join-key filters (plan/runtime_filter.py application
+    point 2): probed per DICTIONARY value when the chunk is
+    dict-encoded — a per-code LUT turning key-reachability filtering
+    into one numpy gather — else per value.  Rows they drop (beyond
+    what the conjuncts already dropped) are counted into
+    ``counters["rf_pruned"]``.
 
     Reads each needed column chunk with seek+read (never the whole
     file) and refuses any row group whose decoded size exceeds
@@ -584,6 +593,16 @@ def read_file(path: str, keep_rgs: Sequence[int],
         if conjuncts else {}
     for c in filter_cols:
         if c not in needed and c in name_to_idx:
+            needed.append(c)
+    rfs = [(n, rf) for n, rf in (runtime_filters or [])
+           if n in name_to_idx]
+    if counters is not None and runtime_filters:
+        # True until proven otherwise: a filter column missing from the
+        # file (e.g. a partition column) or any per-group application
+        # gap flips it, and the caller must then re-probe post-decode
+        counters["rf_complete"] = len(rfs) == len(runtime_filters)
+    for c, _rf in rfs:
+        if c not in needed:
             needed.append(c)
     for c in needed:
         if c not in name_to_idx:
@@ -610,16 +629,68 @@ def read_file(path: str, keep_rgs: Sequence[int],
                     return None
                 cols[name] = fc
             tbl = _filter_project(cols, filter_cols, rg_meta.num_rows,
-                                  engine_schema, columns, arrow_types)
+                                  engine_schema, columns, arrow_types,
+                                  runtime_filters=rfs,
+                                  counters=counters)
             if tbl is None:
                 return None
             out.append(tbl)
     return out
 
 
+def _eval_runtime_filter_mask(cols: dict, rfs
+                              ) -> tuple[Optional[np.ndarray], bool]:
+    """(AND of the runtime filters' keep masks over decoded chunks,
+    complete) — dict-encoded chunks probe the dictionary once (per-code
+    LUT), plain chunks probe values.  ``complete`` is True only when
+    EVERY filter produced a mask, letting the scan skip the redundant
+    point-3 re-probe of these rows.  mask None = nothing applied."""
+    mask = None
+    complete = True
+    for name, rf in rfs:
+        fc = cols.get(name)
+        if fc is None:
+            return None, False  # partial would miscount pruning
+        try:
+            if fc.codes is not None:
+                dv = np.asarray(fc.dict_values)
+                if not np.issubdtype(dv.dtype, np.integer):
+                    complete = False
+                    continue
+                lut = rf.probe_host(dv.astype(np.int64))
+                m = lut[fc.codes]
+                if fc.validity is not None:
+                    m = np.where(fc.validity, m, False)
+            else:
+                vals = fc.values
+                if not np.issubdtype(vals.dtype, np.integer):
+                    complete = False
+                    continue
+                m = rf.probe_host(vals.astype(np.int64), fc.validity)
+        except Exception:
+            complete = False
+            continue
+        mask = m if mask is None else (mask & m)
+    return mask, complete
+
+
 def _filter_project(cols, filter_cols, n_rows, engine_schema, columns,
-                    arrow_types) -> Optional[pa.Table]:
+                    arrow_types, runtime_filters=(),
+                    counters: Optional[dict] = None
+                    ) -> Optional[pa.Table]:
     mask = _eval_filter_mask(cols, filter_cols, n_rows, engine_schema)
+    if runtime_filters:
+        rf_mask, rf_complete = _eval_runtime_filter_mask(
+            cols, runtime_filters)
+        if counters is not None and not rf_complete:
+            counters["rf_complete"] = False
+        if rf_mask is not None:
+            base = mask if mask is not None else np.ones(n_rows, bool)
+            rf_pruned = int((base & ~rf_mask).sum())
+            if counters is not None and rf_pruned:
+                counters["rf_pruned"] = counters.get("rf_pruned", 0) \
+                    + rf_pruned
+            mask = base & rf_mask
     if mask is None:
         idx = None
     else:

@@ -133,6 +133,58 @@ def _float_cmp(name: str, lf: Callable, rf: Callable) -> Callable:
     return fn
 
 
+def _int64_values(arr) -> Optional[tuple]:
+    """pa array -> (np.int64 values, np.bool validity) in the engine's
+    integer key representation; None when not losslessly convertible."""
+    import numpy as np
+    import pyarrow as pa
+
+    t = arr.type
+    try:
+        if pa.types.is_date32(t):
+            arr = arr.cast(pa.int32())
+        elif pa.types.is_timestamp(t):
+            arr = arr.cast(pa.int64())
+        elif not (pa.types.is_integer(t)):
+            return None
+        valid = np.asarray(pc.is_valid(arr))
+        vals = np.asarray(arr.fill_null(0).cast(pa.int64()))
+        return vals, valid
+    except Exception:
+        return None
+
+
+def runtime_filter_column_mask(col, rf):
+    """Host-side runtime-filter probe of one table column -> np.bool
+    keep mask, or None when the column shape is outside the probe's
+    scope (caller then skips this filter — pruning is best-effort,
+    never semantics).
+
+    Dictionary-encoded columns probe the DICTIONARY once and gather by
+    code (the fastpar LUT trick at the arrow layer); plain columns
+    probe values directly.  This is application point 3 of
+    plan/runtime_filter.py — the post-decode mask in the hostFilter
+    path."""
+    import numpy as np
+    import pyarrow as pa
+
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    if pa.types.is_dictionary(col.type):
+        dv = _int64_values(col.dictionary)
+        if dv is None:
+            return None
+        lut = rf.probe_host(dv[0], dv[1])
+        codes = col.indices
+        code_valid = np.asarray(pc.is_valid(codes))
+        code_vals = np.asarray(codes.fill_null(0)).astype(np.int64)
+        return np.where(code_valid, lut[code_vals], False)
+    v = _int64_values(col)
+    if v is None:
+        return None
+    return rf.probe_host(v[0], v[1])
+
+
 def _children(e):
     kids = getattr(e, "children", None)
     if kids is None:

@@ -138,6 +138,52 @@ def _coerce_like(v, stat_sample):
     return v
 
 
+def _stat_to_int(v) -> Optional[int]:
+    """Footer stat value -> the engine's integer key representation
+    (epoch days / epoch micros / plain int); None = not convertible
+    (conservative: caller keeps the row group)."""
+    import datetime
+
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, int):
+        return v
+    if isinstance(v, datetime.datetime):
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+        d = v - epoch
+        # exact integer micros: float total_seconds() rounds at ~0.25us,
+        # enough to shift a boundary stat and wrongly prune a row group
+        return (d.days * 86_400_000_000 + d.seconds * 1_000_000
+                + d.microseconds)
+    if isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    return None
+
+
+def runtime_range_may_match(name: str, rf, rg_meta) -> bool:
+    """Runtime-filter min/max vs a row group's footer statistics: False
+    only when the stats PROVE no row's key can fall in the filter's
+    [min, max] (plan/runtime_filter.py application point 1 — pruned
+    row groups are never decoded).  An empty build side proves no key
+    matches anywhere, stats or not."""
+    if not rf.ready:
+        return True
+    if rf.n_keys == 0:
+        return False
+    st = None
+    for ci in range(rg_meta.num_columns):
+        col = rg_meta.column(ci)
+        if col.path_in_schema.split(".")[0] == name:
+            st = col.statistics
+            break
+    if st is None or not st.has_min_max:
+        return True
+    lo, hi = _stat_to_int(st.min), _stat_to_int(st.max)
+    if lo is None or hi is None:
+        return True
+    return rf.range_may_match(lo, hi)
+
+
 def partition_may_match(conjuncts: Sequence[B.Expression],
                         schema: T.Schema, part_values: dict,
                         part_fields: Sequence[T.Field]) -> bool:

@@ -208,6 +208,10 @@ class ParquetScanExec(TpuExec):
         self.partition_values = list(partition_values or [])
         self.partition_fields = list(partition_fields)
         self.pushed_filter = None  # set by the planner (Filter above)
+        #: [(column_name, RuntimeFilter)] registered by the
+        #: runtime-filter planner pass (plan/runtime_filter.py): build-
+        #: side join-key filters applied host-side before encode+upload
+        self.runtime_filters: list = []
         self._groups = self._group_files()
 
     def _group_files(self) -> list[list[int]]:
@@ -246,7 +250,14 @@ class ParquetScanExec(TpuExec):
         return [("scanTime", "MODERATE"),
                 ("filesPruned", "ESSENTIAL"),
                 ("rowGroupsPruned", "ESSENTIAL"),
-                ("hostFilteredRows", "ESSENTIAL")]
+                ("hostFilteredRows", "ESSENTIAL"),
+                ("rfPrunedRows", "ESSENTIAL"),
+                ("rfRowGroupsPruned", "ESSENTIAL")]
+
+    def _ready_runtime_filters(self) -> list:
+        """Published filters only — an unpublished filter applies
+        nothing (never block the scan on the build side)."""
+        return [(n, rf) for n, rf in self.runtime_filters if rf.ready]
 
     @property
     def num_partitions(self) -> int:
@@ -344,16 +355,43 @@ class ParquetScanExec(TpuExec):
         else:
             keep_rgs = list(range(n_rgs))
 
+        rfs = self._ready_runtime_filters()
+        if rfs:
+            # runtime-filter min/max as an extra footer conjunct: the
+            # build side's key range decides row-group reachability
+            # before any byte is decoded
+            from spark_rapids_tpu.io.pushdown import (
+                runtime_range_may_match,
+            )
+
+            before = len(keep_rgs)
+            keep_rgs = [g for g in keep_rgs
+                        if all(runtime_range_may_match(
+                            n, rf, f.metadata.row_group(g))
+                            for n, rf in rfs)]
+            if before != len(keep_rgs):
+                from spark_rapids_tpu.plan import runtime_filter as _RF
+
+                self.metrics["rfRowGroupsPruned"].add(
+                    before - len(keep_rgs))
+                _RF.record_row_groups_pruned(before - len(keep_rgs))
+            if not keep_rgs:
+                return
+
         fast = self._try_fast_tables(f, fi, keep_rgs, conjuncts)
         if fast is not None:
-            for tbl in fast:
+            tables, fast_rf_complete = fast
+            for tbl in tables:
                 for f2 in self.partition_fields:
                     tbl = tbl.append_column(
                         f2.name,
                         self._host_partition_array(fi, f2, tbl.num_rows))
                 # multi-column conjuncts (not applied by the fast
-                # decoder) still prefilter here; survivors are few
-                yield self._host_prefilter(tbl)
+                # decoder) still prefilter here; survivors are few.
+                # Runtime filters the decoder fully applied are NOT
+                # re-probed (skip_rf) — the mask is deterministic
+                yield self._host_prefilter(tbl,
+                                           skip_rf=fast_rf_complete)
             return
 
         if f.metadata.num_rows <= self.batch_rows:
@@ -381,9 +419,11 @@ class ParquetScanExec(TpuExec):
             yield self._host_prefilter(tbl)
 
     def _try_fast_tables(self, f, fi: int, keep_rgs,
-                         conjuncts) -> Optional[list]:
-        """Native fast-decode path (io/fastpar.py): returns the file's
-        surviving rows as host tables, or None to use pyarrow."""
+                         conjuncts) -> Optional[tuple]:
+        """Native fast-decode path (io/fastpar.py): returns (the
+        file's surviving rows as host tables, whether runtime filters
+        were FULLY applied inside the decoder — so the prefilter can
+        skip its redundant re-probe), or None to use pyarrow."""
         if not getattr(self, "_fast_decode", True):
             return None
         from spark_rapids_tpu.io import fastpar
@@ -397,17 +437,29 @@ class ParquetScanExec(TpuExec):
             return None
         use_conjs = conjuncts if getattr(self, "_prefilter_on", False) \
             else None
+        rfs = self._ready_runtime_filters()
+        counters: dict = {}
         tables = fastpar.read_file(
             self.paths[fi], keep_rgs, file_cols, use_conjs,
             self._schema, pqfile=f,
             max_decoded_bytes=getattr(self, "_max_batch_bytes",
-                                      64 << 20))
-        if tables is not None and use_conjs:
+                                      64 << 20),
+            runtime_filters=rfs or None, counters=counters)
+        if tables is None:
+            return None
+        rf_pruned = counters.get("rf_pruned", 0)
+        if rf_pruned:
+            from spark_rapids_tpu.plan import runtime_filter as _RF
+
+            self.metrics["rfPrunedRows"].add(rf_pruned)
+            _RF.record_pruned_rows(rf_pruned)
+        if use_conjs:
             kept_rg_rows = sum(f.metadata.row_group(g).num_rows
                                for g in keep_rgs)
             after = sum(t.num_rows for t in tables)
-            self.metrics["hostFilteredRows"].add(kept_rg_rows - after)
-        return tables
+            self.metrics["hostFilteredRows"].add(
+                kept_rg_rows - after - rf_pruned)
+        return tables, bool(rfs) and counters.get("rf_complete", False)
 
     @staticmethod
     def _harmonize_dicts(tables: list) -> list:
@@ -462,7 +514,8 @@ class ParquetScanExec(TpuExec):
         # device, with its partition context — never pre-applied
         return not tree_is_partition_aware(self.pushed_filter)
 
-    def _host_prefilter(self, tbl: pa.Table) -> pa.Table:
+    def _host_prefilter(self, tbl: pa.Table,
+                        skip_rf: bool = False) -> pa.Table:
         """Drop rows the pushed Filter must reject, BEFORE they cross
         the wire.  Prefers the compiled pyarrow.compute form (C++
         multi-threaded, GIL-free — decode-speed); falls back to the CPU
@@ -470,6 +523,8 @@ class ParquetScanExec(TpuExec):
         Conservative only in failure: any evaluation problem disables
         prefiltering and ships everything; the device Filter is always
         the source of truth."""
+        if not skip_rf:
+            tbl = self._apply_runtime_filters(tbl)
         if not getattr(self, "_prefilter_on", False) or tbl.num_rows == 0:
             # suppression must still run (accumulated tables are
             # concatenated and need one consistent schema)
@@ -501,6 +556,46 @@ class ParquetScanExec(TpuExec):
             return tbl
         self.metrics["hostFilteredRows"].add(tbl.num_rows - kept.num_rows)
         return self._suppress_upload_cols(kept)
+
+    def _apply_runtime_filters(self, tbl: pa.Table) -> pa.Table:
+        """Application point 3 (plan/runtime_filter.py): drop decoded
+        rows whose join key provably/probabilistically matches no build
+        key, BEFORE they are encoded and cross the wire.  Dictionary
+        columns probe their dictionary once (LUT + gather); anything
+        the probe cannot model is skipped — pruning is an IO
+        optimization, the join stays the source of truth."""
+        rfs = self._ready_runtime_filters()
+        if not rfs or tbl.num_rows == 0:
+            return tbl
+        names = set(tbl.schema.names)
+        rfs = [(n, rf) for n, rf in rfs if n in names]
+        if not rfs:
+            return tbl
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.io.pa_filter import (
+            runtime_filter_column_mask,
+        )
+
+        with _trace.span("rf.apply", scan=self.name,
+                         rows=tbl.num_rows):
+            keep = None
+            for name, rf in rfs:
+                m = runtime_filter_column_mask(tbl.column(name), rf)
+                if m is None:
+                    continue
+                keep = m if keep is None else (keep & m)
+            if keep is None:
+                return tbl
+            n_keep = int(keep.sum())
+            if n_keep == tbl.num_rows:
+                return tbl
+            kept = tbl.filter(pa.array(keep))
+        pruned = tbl.num_rows - kept.num_rows
+        from spark_rapids_tpu.plan import runtime_filter as _RF
+
+        self.metrics["rfPrunedRows"].add(pruned)
+        _RF.record_pruned_rows(pruned)
+        return kept
 
     def _suppress_upload_cols(self, tbl: pa.Table) -> pa.Table:
         """Replace filter-only columns with all-NULL arrays AFTER the

@@ -18,6 +18,11 @@ Rules
 - PL004 (warning): redundant sort-under-sort — an inner sort whose
   ordering is destroyed by an outer sort reachable through
   order-agnostic narrow execs
+- PL005 (error): a runtime join filter attached to an INELIGIBLE join
+  type — outer/anti joins preserve non-matching rows, so pruning the
+  probe side by build-key reachability would silently drop output rows
+  (the planner pass only ever creates inner/left_semi filters; this
+  rule is the backstop for hand-built plans)
 """
 
 from __future__ import annotations
@@ -116,6 +121,29 @@ def check_plan(root) -> list[Diagnostic]:
                     f"{inner.node_desc()} ordering is destroyed by "
                     "this sort",
                     hint="drop the inner sort, or order once"))
+
+        from spark_rapids_tpu.execs.join import TpuRuntimeFilterBuildExec
+        from spark_rapids_tpu.plan.runtime_filter import (
+            ELIGIBLE_JOIN_TYPES,
+        )
+
+        bad_rfs = []
+        if isinstance(node, TpuRuntimeFilterBuildExec):
+            bad_rfs = [rf for _k, rf in node.entries
+                       if rf.join_type not in ELIGIBLE_JOIN_TYPES]
+        for _name, rf in getattr(node, "runtime_filters", ()):
+            if rf.join_type not in ELIGIBLE_JOIN_TYPES:
+                bad_rfs.append(rf)
+        for rf in bad_rfs:
+            out.append(Diagnostic(
+                "PL005", "error", _loc(node),
+                f"runtime filter {rf.describe()} derives from a "
+                f"{rf.join_type!r} join: outer/anti joins preserve "
+                "non-matching rows, so build-key pruning would drop "
+                "output rows",
+                hint="runtime filters are only sound for "
+                     f"{'/'.join(ELIGIBLE_JOIN_TYPES)} joins; remove "
+                     "the filter or change the join type"))
 
         for e in _node_exprs(node):
             try:

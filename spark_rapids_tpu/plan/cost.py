@@ -74,6 +74,63 @@ def _work_rows(p: L.LogicalPlan) -> Optional[int]:
     return _rows(p)
 
 
+def exec_estimated_rows(node) -> Optional[int]:
+    """Upper-bound row estimate for a lowered PHYSICAL subtree — the
+    runtime-filter pass's build-side selectivity gate (the same posture
+    as logical `estimated_rows`: narrow nodes propagate, a filter can
+    only shrink, unknown shapes return None and the caller never acts
+    on a guess).  File scans answer from footer metadata, which the
+    logical layer already read for the join-strategy choice (OS page
+    cache makes the re-read free)."""
+    from spark_rapids_tpu.io.scan import (
+        ArrowSourceExec,
+        CsvScanExec,
+        OrcScanExec,
+        ParquetScanExec,
+    )
+
+    if isinstance(node, ArrowSourceExec):
+        return node.table.num_rows
+    if isinstance(node, (ParquetScanExec, OrcScanExec)):
+        cached = getattr(node, "_est_rows", None)
+        if cached is not None:
+            return cached
+        try:
+            if isinstance(node, OrcScanExec):
+                import pyarrow.orc as paorc
+
+                n = sum(paorc.ORCFile(p).nrows for p in node.paths)
+            else:
+                import pyarrow.parquet as pq
+
+                n = sum(pq.read_metadata(p).num_rows
+                        for p in node.paths)
+        except Exception:
+            return None
+        node._est_rows = n
+        return n
+    if isinstance(node, CsvScanExec):
+        return None
+    from spark_rapids_tpu.execs.adaptive import CoalescedShuffleReaderExec
+    from spark_rapids_tpu.execs.basic import (
+        TpuCoalesceBatchesExec,
+        TpuFilterExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.execs.cache import TpuCacheExec
+    from spark_rapids_tpu.execs.coalesce import TpuCoalescePartitionsExec
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.execs.join import TpuRuntimeFilterBuildExec
+
+    if isinstance(node, (TpuFilterExec, TpuProjectExec,
+                         TpuShuffleExchangeExec, TpuCoalesceBatchesExec,
+                         TpuCoalescePartitionsExec, TpuCacheExec,
+                         CoalescedShuffleReaderExec,
+                         TpuRuntimeFilterBuildExec)):
+        return exec_estimated_rows(node.children[0])
+    return None
+
+
 def optimize_costs(meta) -> None:
     """Tag every node of each unprofitable replaceable island with
     DEMOTION_REASON.  Runs after tag(), before conversion."""

@@ -1355,6 +1355,14 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
             meta.will_not_work(f"disabled by {SQL_ENABLED.key}")
     with _trace.span("query.lower"):
         root = convert_meta(meta)
+        # runtime join filters must inject BEFORE the encoded-scan
+        # marking: the build wrapper changes which exec is a scan's
+        # direct parent (plan/runtime_filter.py)
+        from spark_rapids_tpu.plan.runtime_filter import (
+            inject_runtime_filters,
+        )
+
+        inject_runtime_filters(root, conf)
         _mark_encoded_scans(root)
         _plan_pipeline(root, conf)
     return root, meta

@@ -1020,6 +1020,17 @@ class DataFrame:
         if stages:
             out += "Pipeline:\n" + "\n".join(
                 "  " + s for s in stages) + "\n"
+        # runtime join filters: build sites + probe-scan application
+        # points (spark.rapids.tpu.sql.runtimeFilter.*;
+        # docs/runtime_filters.md)
+        from spark_rapids_tpu.plan.runtime_filter import (
+            render_runtime_filters,
+        )
+
+        rf_lines = render_runtime_filters(exec_)
+        if rf_lines:
+            out += "RuntimeFilters:\n" + "\n".join(
+                "  " + s for s in rf_lines) + "\n"
         return out
 
     def __repr__(self) -> str:

@@ -9,6 +9,12 @@ contract: `scripts/bench_smoke.sh` runs it standalone, and
 `tests/test_speculation.py::test_bench_smoke_queries_match` runs the
 same function inside the tier-1 `not slow` suite.
 
+`run_rf_smoke` holds the twin contract for runtime join filters
+(plan/runtime_filter.py): a parquet-backed q3-shaped join must return
+identical rows with `runtimeFilter.enabled` on and off, AND must have
+actually pruned probe rows when on (tier-1 via
+tests/test_runtime_filter.py).
+
 Run: python -m spark_rapids_tpu.tools.bench_smoke
 """
 
@@ -64,6 +70,98 @@ def _assert_rows_match(name: str, on, off) -> None:
                     f"{name}: speculation on/off results differ: {a} {b}"
 
 
+def count_upload_rows(df) -> int:
+    """One TPU collect with ParquetScanExec._upload tapped: total rows
+    actually crossing the host->device wire — the number runtime join
+    filters exist to shrink.  Shared by bench.py's q3_upload_rows
+    fields and the test-suite acceptance assertions."""
+    import spark_rapids_tpu.io.scan as scan_mod
+
+    counted = [0]
+    orig = scan_mod.ParquetScanExec._upload
+
+    def upload(inner_self, tables):
+        counted[0] += sum(t.num_rows for t in tables
+                          if not isinstance(t, int))
+        return orig(inner_self, tables)
+
+    scan_mod.ParquetScanExec._upload = upload
+    try:
+        df.collect(engine="tpu")
+    finally:
+        scan_mod.ParquetScanExec._upload = orig
+    return counted[0]
+
+
+def run_rf_smoke() -> dict:
+    """Runtime-filter acceptance contract, cheap CI form: a q3-shaped
+    parquet join (date-filtered build side, larger probe side)
+    collected with spark.rapids.tpu.sql.runtimeFilter.enabled on and
+    off must return identical rows — the filter is a pure IO
+    optimization.  With filters on, the probe scan must actually have
+    pruned rows (asserted via the runtime_filter stats registry), so
+    the q3 win this subsystem targets stays measurable."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.plan import runtime_filter
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+
+    key = "spark.rapids.tpu.sql.runtimeFilter.enabled"
+    conf = get_conf()
+    saved = conf.get(key)
+    session = TpuSession()
+    out: dict = {}
+    rng = np.random.default_rng(0xF11)
+    with tempfile.TemporaryDirectory(prefix="rf_smoke_") as d:
+        n = 8192
+        li = pa.table({
+            "l_orderkey": rng.integers(0, 512, n).astype(np.int64),
+            "l_price": rng.random(n),
+        })
+        li_path = os.path.join(d, "li.parquet")
+        pq.write_table(li, li_path, row_group_size=2048)
+        orders = pa.table({
+            "o_orderkey": np.arange(512, dtype=np.int64),
+            "o_date": rng.integers(0, 100, 512).astype(np.int32),
+        })
+        o_path = os.path.join(d, "orders.parquet")
+        pq.write_table(orders, o_path)
+
+        def q():
+            lidf = session.read_parquet(li_path)
+            odf = (session.read_parquet(o_path)
+                   .where(col("o_date") < lit(20)))
+            return (lidf.join(odf, left_on=[col("l_orderkey")],
+                              right_on=[col("o_orderkey")])
+                    .group_by(col("l_orderkey"))
+                    .agg((sum_(col("l_price")), "rev")))
+
+        try:
+            conf.set(key, True)
+            runtime_filter.reset_stats()
+            on = q().collect(engine="tpu")
+            st = runtime_filter.stats()
+            assert st["filters_built"] >= 1, \
+                "runtime filter did not build on the q3-shaped join"
+            assert st["pruned_rows"] > 0, \
+                "runtime filter pruned nothing on a selective build"
+            conf.set(key, False)
+            off = q().collect(engine="tpu")
+            _assert_rows_match("runtime_filter", on, off)
+            out["runtime_filter"] = on.num_rows
+            out["runtime_filter_pruned_rows"] = st["pruned_rows"]
+        finally:
+            conf.set(key, saved)
+    return out
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -102,7 +200,9 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    print(json.dumps({"bench_smoke": run_smoke(), "ok": True}))
+    results = run_smoke()
+    results.update(run_rf_smoke())
+    print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 
 

@@ -1,0 +1,409 @@
+"""Runtime join filters (plan/runtime_filter.py): host/device Bloom
+parity, probe-side upload pruning, the join-type safety matrix, and the
+enabled=false bit-for-bit contract."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.exprs.base import lit
+from spark_rapids_tpu.plan import runtime_filter as RF
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+RF_KEY = "spark.rapids.tpu.sql.runtimeFilter.enabled"
+BCAST_KEY = "spark.rapids.tpu.sql.autoBroadcastJoinThresholdBytes"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    RF.reset_stats()
+    yield
+    RF.reset_stats()
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _write(d, name, table, row_group_size=None):
+    p = os.path.join(str(d), name)
+    pq.write_table(table, p, row_group_size=row_group_size)
+    return p
+
+
+def _lineitem(d, n=8192, n_keys=512, seed=0, rg=2048):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "l_orderkey": rng.integers(0, n_keys, n).astype(np.int64),
+        "l_price": rng.random(n),
+    })
+    return _write(d, "li.parquet", t, rg)
+
+
+def _orders(d, n_keys=512, seed=1):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "o_orderkey": np.arange(n_keys, dtype=np.int64),
+        "o_date": rng.integers(0, 100, n_keys).astype(np.int32),
+    })
+    return _write(d, "orders.parquet", t)
+
+
+def _q3(session, li_path, o_path, date_lt=20, how="inner"):
+    lidf = session.read_parquet(li_path)
+    odf = session.read_parquet(o_path).where(col("o_date") < lit(date_lt))
+    return lidf.join(odf, left_on=[col("l_orderkey")],
+                     right_on=[col("o_orderkey")], how=how)
+
+
+def _sorted_rows(tbl):
+    return sorted(map(tuple, zip(*tbl.to_pydict().values())),
+                  key=lambda t: tuple((x is None, x) for x in t))
+
+
+def _assert_matches_cpu(df):
+    got = _sorted_rows(df.collect(engine="tpu"))
+    want = _sorted_rows(df.collect(engine="cpu"))
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        for x, y in zip(g, w):
+            if isinstance(x, float):
+                assert abs(x - y) <= 1e-9 * max(1.0, abs(y)), (g, w)
+            else:
+                assert x == y, (g, w)
+
+
+def _count_upload_rows(df) -> int:
+    from spark_rapids_tpu.tools.bench_smoke import count_upload_rows
+
+    return count_upload_rows(df)
+
+
+def _rf_nodes(df):
+    """(build execs, scans-with-filters) in the lowered plan."""
+    from spark_rapids_tpu.execs.join import TpuRuntimeFilterBuildExec
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    root, _meta = plan_query(df._plan, get_conf())
+    builds, scans = [], []
+    for node in root._walk():
+        if isinstance(node, TpuRuntimeFilterBuildExec):
+            builds.append(node)
+        if getattr(node, "runtime_filters", None):
+            scans.append(node)
+    return builds, scans
+
+
+# -------------------------------------------------------------------- #
+# Bloom bit-layout parity: host numpy probe vs device build
+# -------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("is64", [False, True])
+def test_host_device_bloom_parity_randomized(is64):
+    """Every key inserted on DEVICE must probe positive on HOST, and
+    the two murmur3 lanes must agree bit-for-bit — the layout contract
+    the whole subsystem rests on."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.exprs import hashing as H
+
+    rng = np.random.default_rng(7)
+    n = 1024
+    if is64:
+        keys = rng.integers(-2**62, 2**62, n, dtype=np.int64)
+        dt = T.LONG
+    else:
+        keys = rng.integers(-2**31, 2**31, n).astype(np.int32)
+        dt = T.INT
+    # hash-lane parity
+    d1 = np.asarray(H.hash_int64_blocks(jnp.asarray(keys), RF.BLOOM_SEED1)
+                    if is64 else
+                    H.hash_int32_block(jnp.asarray(keys), RF.BLOOM_SEED1))
+    h1 = (H.np_hash_int64_blocks(keys, RF.BLOOM_SEED1) if is64
+          else H.np_hash_int32_block(keys, RF.BLOOM_SEED1))
+    assert (d1 == h1).all()
+
+    m, k = RF.bloom_params(n, 0.01)
+    rf = RF.RuntimeFilter("k", dt, "inner", m, k, True, True)
+    col_ = Column(jnp.asarray(keys), jnp.ones(n, bool), dt)
+    state = RF.device_init_state(m, True)
+    state = RF.device_update(state, col_, jnp.ones(n, bool), m, k,
+                             is64, True)
+    RF.finalize(rf, state)
+    assert rf.n_keys == n
+    assert rf.min_val == int(keys.min())
+    assert rf.max_val == int(keys.max())
+    # inserted keys: no false negatives, ever
+    assert rf.probe_host(keys.astype(np.int64)).all()
+    # non-inserted keys: mostly rejected (fpp-bounded; generous margin)
+    probe = rng.integers(-2**31 if not is64 else -2**62,
+                         2**31 if not is64 else 2**62, 4096,
+                         dtype=np.int64)
+    fresh = probe[~np.isin(probe, keys.astype(np.int64))]
+    rf_no_minmax = RF.RuntimeFilter("k", dt, "inner", m, k, False, True)
+    rf_no_minmax.publish(rf.min_val, rf.max_val, rf.n_keys,
+                         rf.bloom_words, 0.0)
+    hits = rf_no_minmax.probe_host(fresh).mean()
+    assert hits < 0.1, f"false-positive rate {hits} far above fpp"
+
+
+def test_null_keys_never_probe_true():
+    rf = RF.RuntimeFilter("k", __import__(
+        "spark_rapids_tpu.types", fromlist=["LONG"]).LONG,
+        "inner", 1 << 10, 3, True, True)
+    rf.publish(0, 100, 5, np.zeros(32, np.uint32), 0.0)
+    vals = np.array([1, 2, 3], np.int64)
+    validity = np.array([True, False, True])
+    mask = rf.probe_host(vals, validity)
+    assert not mask[1]
+
+
+# -------------------------------------------------------------------- #
+# End-to-end pruning + correctness
+# -------------------------------------------------------------------- #
+
+
+def test_probe_upload_rows_drop_with_filters_on(tmp_path, session):
+    """THE acceptance criterion: the q3-shaped join's probe-side
+    uploaded row count drops when runtime filters are on, and results
+    match the CPU oracle."""
+    li = _lineitem(tmp_path)
+    orders = _orders(tmp_path)
+    conf = get_conf()
+    df = (_q3(session, li, orders)
+          .group_by(col("l_orderkey")).agg((sum_(col("l_price")), "rev")))
+    conf.set(RF_KEY, True)
+    RF.reset_stats()
+    rows_on = _count_upload_rows(df)
+    st = RF.stats()
+    conf.set(RF_KEY, False)
+    rows_off = _count_upload_rows(df)
+    assert st["filters_built"] >= 1
+    assert st["pruned_rows"] > 0
+    assert rows_on < rows_off, (rows_on, rows_off)
+    conf.set(RF_KEY, True)
+    _assert_matches_cpu(df)
+
+
+def test_adaptive_exchange_path_prunes(tmp_path, session):
+    """Shuffled/adaptive shape (broadcast disabled): the build
+    collector rides the build exchange's map stage, which must
+    materialize BEFORE the probe side (rf_build_first ordering)."""
+    li = _lineitem(tmp_path)
+    orders = _orders(tmp_path)
+    conf = get_conf()
+    conf.set(BCAST_KEY, -1)
+    df = (_q3(session, li, orders)
+          .group_by(col("l_orderkey")).agg((sum_(col("l_price")), "rev")))
+    RF.reset_stats()
+    out = df.collect(engine="tpu")
+    st = RF.stats()
+    assert st["filters_built"] >= 1
+    assert st["pruned_rows"] > 0
+    want = df.collect(engine="cpu")
+    assert out.num_rows == want.num_rows
+
+
+def test_empty_build_prunes_everything(tmp_path, session):
+    li = _lineitem(tmp_path)
+    orders = _orders(tmp_path)
+    df = _q3(session, li, orders, date_lt=-1)  # no order survives
+    RF.reset_stats()
+    rows = _count_upload_rows(df)
+    st = RF.stats()
+    assert st["filters_built"] >= 1 and st["build_rows"] == 0
+    # every probe row group is pruned at the footer: zero uploads from
+    # the probe scan (the build scan's rows still upload)
+    assert st["row_groups_pruned"] >= 4
+    assert rows <= 512  # only the (filtered-to-empty) orders side
+    assert df.collect(engine="tpu").num_rows == 0
+
+
+def test_rowgroup_minmax_pruning(tmp_path, session):
+    """Sorted probe keys + a narrow build range: whole row groups must
+    be skipped on the filter's [min, max] before decode."""
+    t = pa.table({
+        "l_orderkey": np.arange(8192, dtype=np.int64),  # sorted
+        "l_price": np.random.default_rng(3).random(8192),
+    })
+    li = _write(tmp_path, "li_sorted.parquet", t, 2048)
+    orders = pa.table({
+        "o_orderkey": np.arange(100, dtype=np.int64),  # keys 0..99
+        "o_date": np.zeros(100, np.int32),
+    })
+    op = _write(tmp_path, "orders_small.parquet", orders)
+    lidf = session.read_parquet(li)
+    odf = session.read_parquet(op).where(col("o_date") >= lit(0))
+    df = lidf.join(odf, left_on=[col("l_orderkey")],
+                   right_on=[col("o_orderkey")])
+    RF.reset_stats()
+    out = df.collect(engine="tpu")
+    st = RF.stats()
+    # keys 0..99 live in row group 0 of 4: three groups prune
+    assert st["row_groups_pruned"] >= 3, st
+    assert out.num_rows == 100
+
+
+def test_bloom_false_positive_path_joins_correctly(tmp_path, session):
+    """A deliberately tiny, collision-heavy Bloom (min/max off so the
+    range can't rescue it) must still produce exact join results — the
+    device join is the source of truth for FP rows."""
+    rng = np.random.default_rng(5)
+    li = _write(tmp_path, "li.parquet", pa.table({
+        "l_orderkey": rng.integers(0, 4096, 4096).astype(np.int64),
+        "l_price": rng.random(4096),
+    }))
+    # build keys interleaved across the probe range
+    op = _write(tmp_path, "orders.parquet", pa.table({
+        "o_orderkey": np.arange(0, 4096, 37, dtype=np.int64),
+        "o_date": np.zeros(len(range(0, 4096, 37)), np.int32),
+    }))
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.runtimeFilter.minMaxEnabled", False)
+    conf.set("spark.rapids.tpu.sql.runtimeFilter.fpp", 0.5)
+    df = _q3(session, li, op, date_lt=1)
+    RF.reset_stats()
+    _assert_matches_cpu(df)
+    assert RF.stats()["filters_built"] >= 1
+
+
+def test_null_probe_keys_pruned_and_correct(tmp_path, session):
+    li_t = pa.table({
+        "l_orderkey": pa.array([1, 2, None, 3, None, 2], pa.int64()),
+        "l_price": pa.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+    })
+    li = _write(tmp_path, "li_nulls.parquet", li_t)
+    op = _write(tmp_path, "orders.parquet", pa.table({
+        "o_orderkey": np.arange(3, dtype=np.int64),
+        "o_date": np.zeros(3, np.int32),
+    }))
+    df = _q3(session, li, op, date_lt=1)
+    _assert_matches_cpu(df)
+
+
+@pytest.mark.parametrize("how", ["left_outer", "full_outer",
+                                 "left_anti"])
+def test_ineligible_join_types_never_inject(tmp_path, session, how):
+    li = _lineitem(tmp_path, n=512, rg=None)
+    orders = _orders(tmp_path)
+    df = _q3(session, li, orders, how=how)
+    builds, scans = _rf_nodes(df)
+    assert not builds and not scans, how
+    _assert_matches_cpu(df)
+
+
+def test_left_semi_injects_and_matches(tmp_path, session):
+    li = _lineitem(tmp_path)
+    orders = _orders(tmp_path)
+    df = _q3(session, li, orders, how="left_semi")
+    builds, scans = _rf_nodes(df)
+    assert builds and scans
+    _assert_matches_cpu(df)
+
+
+def test_disabled_reproduces_unfiltered_plan(tmp_path, session):
+    """runtimeFilter.enabled=false: no build nodes, no scan filters —
+    the PR4 plan, bit-for-bit — and identical results."""
+    li = _lineitem(tmp_path)
+    orders = _orders(tmp_path)
+    conf = get_conf()
+    df = (_q3(session, li, orders)
+          .group_by(col("l_orderkey")).agg((sum_(col("l_price")), "rev")))
+    conf.set(RF_KEY, False)
+    builds, scans = _rf_nodes(df)
+    assert not builds and not scans
+    off_rows = _sorted_rows(df.collect(engine="tpu"))
+    assert RF.stats()["filters_built"] == 0
+    conf.set(RF_KEY, True)
+    builds, scans = _rf_nodes(df)
+    assert builds and scans
+    on_rows = _sorted_rows(df.collect(engine="tpu"))
+    assert len(on_rows) == len(off_rows)
+    for a, b in zip(on_rows, off_rows):
+        assert a[0] == b[0]
+        assert abs(a[1] - b[1]) <= 1e-9 * max(1.0, abs(b[1]))
+
+
+def test_unselective_build_skips_injection(tmp_path, session):
+    li = _lineitem(tmp_path, n=512, rg=None)
+    orders = _orders(tmp_path)
+    get_conf().set("spark.rapids.tpu.sql.runtimeFilter.maxBuildRows", 10)
+    df = _q3(session, li, orders)
+    builds, scans = _rf_nodes(df)
+    assert not builds and not scans
+
+
+def test_explain_shows_runtime_filters(tmp_path, session):
+    li = _lineitem(tmp_path, n=512, rg=None)
+    orders = _orders(tmp_path)
+    df = _q3(session, li, orders)
+    out = df.explain()
+    assert "RuntimeFilters:" in out
+    assert "rf#" in out
+
+
+def test_lint_pl005_flags_ineligible_filter(tmp_path, session):
+    """The PL005 backstop: a hand-built plan attaching a runtime
+    filter to an outer join is a plan ERROR."""
+    from spark_rapids_tpu.lint.plan_rules import check_plan
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    li = _lineitem(tmp_path, n=64, rg=None)
+    orders = _orders(tmp_path, n_keys=16)
+    df = _q3(session, li, orders, how="left_outer")
+    root, _meta = plan_query(df._plan, get_conf())
+    # no filter injected for left_outer; attach one by hand
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.io.scan import ParquetScanExec
+
+    scan = next(n for n in root._walk()
+                if isinstance(n, ParquetScanExec))
+    bad = RF.RuntimeFilter("l_orderkey", T.LONG, "left_outer",
+                           1 << 10, 3, True, True)
+    scan.runtime_filters.append(("l_orderkey", bad))
+    diags = check_plan(root)
+    assert any(d.rule == "PL005" and d.severity == "error"
+               for d in diags), [d.rule for d in diags]
+
+
+def test_bench_smoke_rf_on_off_equality():
+    """Tier-1 wiring of the bench_smoke runtime-filter contract."""
+    from spark_rapids_tpu.tools.bench_smoke import run_rf_smoke
+
+    out = run_rf_smoke()
+    assert out["runtime_filter"] > 0
+    assert out["runtime_filter_pruned_rows"] > 0
+
+
+def test_date_key_rowgroup_stats(tmp_path, session):
+    """date32 join keys: footer stats come back as datetime.date while
+    the filter's min/max are epoch days — the coercion must prune."""
+    days = np.arange(8192, dtype=np.int32)
+    li_t = pa.table({
+        "l_date": pa.array(days, pa.int32()).cast(pa.date32()),
+        "l_price": np.random.default_rng(9).random(8192),
+    })
+    li = _write(tmp_path, "li_date.parquet", li_t, 2048)
+    o_days = np.arange(50, dtype=np.int32)
+    o_t = pa.table({
+        "o_date_key": pa.array(o_days, pa.int32()).cast(pa.date32()),
+        "o_flag": np.zeros(50, np.int32),
+    })
+    op = _write(tmp_path, "orders_date.parquet", o_t)
+    lidf = session.read_parquet(li)
+    odf = session.read_parquet(op).where(col("o_flag") >= lit(0))
+    df = lidf.join(odf, left_on=[col("l_date")],
+                   right_on=[col("o_date_key")])
+    RF.reset_stats()
+    out = df.collect(engine="tpu")
+    st = RF.stats()
+    assert out.num_rows == 50
+    assert st["row_groups_pruned"] >= 3, st
