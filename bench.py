@@ -38,12 +38,22 @@ Attribution fields (so round-over-round deltas are explainable):
 - `q6_warm_*` / `q1_warm_*` + `hbm_roofline_fraction_warm`: a second
   pass against df.cache()-materialized DEVICE-resident batches, so
   actual device throughput is measured with the H2D wire out of the
-  loop.
+  loop;
+- `q{1,3,6,67}_retry_splits` / `_spills_under_pressure` /
+  `_recovered_faults` (reset per query like the pipeline/speculation
+  counters): recovery activity in the timed window.  On a clean run
+  all three are 0; under `--chaos` — which arms the deterministic
+  fault schedule below for every query (robustness/faults.py,
+  docs/robustness.md) — they record what the recovery ladder absorbed,
+  so BENCH_r06+ measures recovery OVERHEAD, not just happy-path speed
+  (the correctness gates still run, so a chaos round that survives is
+  a chaos round that answered exactly).
 """
 
 import json
 import os
 import statistics
+import sys
 import tempfile
 import time
 
@@ -55,6 +65,16 @@ CPU_ITERS = 3
 # HBM bandwidth of the bench chip (TPU v5e ~819 GB/s); only used for the
 # roofline sanity fraction in the diagnostic fields.
 HBM_BYTES_PER_S = 819e9
+
+#: --chaos schedule, re-armed (fresh counters, so the nth-call policies
+#: re-fire) at every per-query counter reset: one device-alloc OOM
+#: early, one upload fault, one compile fault, one stage fault, and a
+#: two-deep batch fault that drives the ladder past the spill rung into
+#: an actual bisection.
+CHAOS_SPEC = ("alloc.device:nth=2;transfer.upload:nth=2;"
+              "jit.compile:nth=1;pipeline.stage:nth=2;"
+              "exec.batch:nth=3,times=2")
+_CHAOS = False
 
 
 def make_lineitem(dirpath: str, n_files: int = N_FILES,
@@ -361,13 +381,45 @@ def _pipeline_occupancy(prefix: str = "pipeline") -> dict:
 
 
 def _reset_pipeline_counters() -> None:
+    from spark_rapids_tpu.execs.retry import reset_retry_stats
     from spark_rapids_tpu.parallel.pipeline import reset_stage_counters
     from spark_rapids_tpu.parallel.speculation import reset_stats
     from spark_rapids_tpu.plan import runtime_filter
+    from spark_rapids_tpu.robustness import faults
 
     reset_stage_counters()
     reset_stats()  # per-query speculation hit rates, same discipline
     runtime_filter.reset_stats()  # per-query pruned-row counts too
+    reset_retry_stats()  # per-query split/spill-retry attribution
+    if _CHAOS:
+        # fresh schedule per query: counters zero, nth policies re-fire
+        faults.install(CHAOS_SPEC, forced=True)
+    else:
+        faults.reset_stats()
+
+
+def _robustness_fields(prefix: str, spilled_before: int = 0) -> dict:
+    """Recovery activity in the timed window (reset per query by
+    _reset_pipeline_counters): ladder bisections, device->host bytes
+    spilled under pressure, and recovered injected faults (nonzero
+    only under --chaos)."""
+    from spark_rapids_tpu.execs.retry import retry_stats
+    from spark_rapids_tpu.memory import get_store
+    from spark_rapids_tpu.robustness import faults
+
+    st = retry_stats()
+    return {
+        f"{prefix}_retry_splits": st["splits"],
+        f"{prefix}_spills_under_pressure":
+            get_store().spilled_device_to_host - spilled_before,
+        f"{prefix}_recovered_faults": faults.recovered_total(),
+    }
+
+
+def _spilled_now() -> int:
+    from spark_rapids_tpu.memory import get_store
+
+    return get_store().spilled_device_to_host
 
 
 def _sync_spec_fields(prefix: str, iters: int,
@@ -471,11 +523,13 @@ def _bench_q1(session, d: str) -> dict:
         df = q1_dataframe(session, q1_files)
         df.collect(engine="tpu")  # warmup
         _reset_pipeline_counters()  # per-query occupancy
+        sp0 = _spilled_now()
         tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
         # occupancy + sync/speculation counters read BEFORE the tapped
         # breakdown collect, so they reflect only the timed runs
         occ = _pipeline_occupancy("q1_pipeline")
         occ.update(_sync_spec_fields("q1", 3))
+        occ.update(_robustness_fields("q1", sp0))
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
         breakdown.update(occ)
@@ -526,9 +580,11 @@ def _bench_q3(session, d: str) -> dict:
     df = q3_dataframe(session, li, orders)
     df.collect(engine="tpu")  # warmup
     _reset_pipeline_counters()  # per-query occupancy
+    sp0 = _spilled_now()
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q3_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q3", 3))
+    occ.update(_robustness_fields("q3", sp0))
     # runtime-filter attribution for the timed window + the on/off
     # uploaded-row delta (the wire-shrink the filters buy)
     occ.update(_rf_fields(df, 3))
@@ -564,9 +620,11 @@ def _bench_q67(session, d: str) -> dict:
     df = q67_dataframe(session, paths)
     df.collect(engine="tpu")  # warmup
     _reset_pipeline_counters()  # per-query occupancy
+    sp0 = _spilled_now()
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q67_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q67", 3))
+    occ.update(_robustness_fields("q67", sp0))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     got = list(zip(*tpu_r.to_pydict().values()))
     want = list(zip(*cpu_r.to_pydict().values()))
@@ -588,6 +646,13 @@ def _bench_q67(session, d: str) -> dict:
 
 
 def main() -> None:
+    global _CHAOS
+    if "--chaos" in sys.argv[1:]:
+        # chaos mode: every query below runs under the deterministic
+        # fault schedule (re-armed per query by the counter reset) —
+        # the correctness gates stay on, so what gets measured is the
+        # cost of RECOVERING, not a different answer
+        _CHAOS = True
     n_rows = ROWS_PER_FILE * N_FILES
     with tempfile.TemporaryDirectory(prefix="q6bench_") as d:
         paths = make_lineitem(d)
@@ -601,6 +666,7 @@ def main() -> None:
         df.collect(engine="tpu")  # warmup: compile cache, page cache
         link = _link_probe()
         _reset_pipeline_counters()  # q6 occupancy = timed runs only
+        sp0 = _spilled_now()
         tpu_ts, tpu_result = _time_collect(df, "tpu", TPU_ITERS)
         cpu_ts, cpu_result = _time_collect(df, "cpu", CPU_ITERS)
         tpu_t = statistics.median(tpu_ts)
@@ -618,6 +684,7 @@ def main() -> None:
         # there is nothing to speculate — host_sync_count only
         occ.update(_sync_spec_fields("q6", TPU_ITERS,
                                      with_hit_rate=False))
+        occ.update(_robustness_fields("q6", sp0))
         breakdown = _stage_breakdown(df, "q6")
         breakdown.update(occ)
 
@@ -674,6 +741,11 @@ def main() -> None:
     out.update(link)
     out.update(breakdown)
     out.update(extra)
+    if _CHAOS:
+        from spark_rapids_tpu.robustness import faults
+
+        out["chaos"] = CHAOS_SPEC
+        faults.disarm()
     print(json.dumps(out))
 
 

@@ -399,8 +399,11 @@ def from_arrow(rb: pa.RecordBatch | pa.Table,
         # one batched transfer round for every component (beats a packed
         # staging buffer: no unpack program, and jax batches the
         # copies); nested columns are pytrees — device_put moves every
-        # leaf, jnp.asarray would choke on the dataclass
-        dev = jax.device_put(comps)
+        # leaf, jnp.asarray would choke on the dataclass.  Routed
+        # through the transfer.upload fault seam + in-place retry.
+        from spark_rapids_tpu.columnar.transfer import upload_components
+
+        dev = upload_components(comps)
     else:
         dev = [jnp.asarray(a) for a in comps]
 

@@ -51,6 +51,25 @@ _unpack_cache: dict = {}
 _cache_lock = threading.Lock()
 
 
+def upload_components(comps):
+    """THE batched H2D upload (one ``jax.device_put`` for the whole
+    component list) with the ``transfer.upload`` fault seam in front
+    and in-place recovery behind it: a retryable failure (injected, or
+    a real device-side allocation failure materializing the upload)
+    spills every unpinned store buffer and re-uploads once — the
+    upload is restartable by construction (host components are still
+    in hand).  A second failure propagates to the batch
+    split-and-retry ladder / task retry."""
+    from spark_rapids_tpu.execs.retry import absorb_once
+    from spark_rapids_tpu.robustness import faults as _faults
+
+    def attempt():
+        _faults.fault_point("transfer.upload", n_comps=len(comps))
+        return jax.device_put(comps)
+
+    return absorb_once(attempt, action="upload_retry")
+
+
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
@@ -612,7 +631,7 @@ def decode_on_device(comps: list, plan: tuple, schema: T.Schema):
             fn = _unpack_cache[key] = jax.jit(_make_decode(plan))
             while len(_unpack_cache) > 256:
                 _unpack_cache.pop(next(iter(_unpack_cache)))
-    dev = jax.device_put(comps)
+    dev = upload_components(comps)
     parts = fn(dev)
     return _wrap_cols(parts, schema, plan[3])
 
@@ -653,6 +672,13 @@ class EncodedBatch:
     def capacity(self) -> int:
         return self.plan[0]
 
+    @property
+    def live_count(self):
+        """The wire `n` component: a device scalar holding the live
+        row count (the one place the plan's n-ref layout is decoded —
+        consumers must not index comps/plan themselves)."""
+        return self.comps[self.plan[2][1]]
+
     def decode(self):
         """Traceable: wire components -> ColumnarBatch with a traced
         live-row count (read off the wire's n component)."""
@@ -660,9 +686,8 @@ class EncodedBatch:
 
         decode = _make_decode(self.plan)
         cols = _wrap_cols(decode(self.comps), self.schema, self.plan[3])
-        n_ref = self.plan[2]
-        n_live = self.comps[n_ref[1]]
-        return ColumnarBatch(cols, jnp.asarray(n_live, jnp.int32),
+        return ColumnarBatch(cols,
+                             jnp.asarray(self.live_count, jnp.int32),
                              self.schema)
 
     def decode_now(self):
@@ -674,8 +699,7 @@ class EncodedBatch:
         if n is None:
             from spark_rapids_tpu.parallel.pipeline import device_read_int
 
-            n = device_read_int(self.comps[self.plan[2][1]],
-                                tag="transfer.decode")
+            n = device_read_int(self.live_count, tag="transfer.decode")
         return ColumnarBatch(cols, n, self.schema)
 
 
@@ -687,4 +711,4 @@ def encode_batch(arrays: Sequence[pa.Array], schema: T.Schema,
     if enc is None:
         return None
     comps, plan = enc
-    return EncodedBatch(jax.device_put(comps), plan, schema, n)
+    return EncodedBatch(upload_components(comps), plan, schema, n)

@@ -478,78 +478,113 @@ class TpuHashAggregateExec(TpuExec):
         pred = SP.predictor(self._cache_key() + ("sizing",)) \
             if SP.speculation_enabled() else None
 
-        def drain_pending() -> ColumnarBatch:
-            import dataclasses
+        #: handle-ids whose sizing future already fed the predictor —
+        #: a drain RE-RUN after an OOM (spill-retry rung) must not
+        #: double-observe the same count
+        observed: set = set()
 
-            batches = [h.get() for h in pending]
-            # reconcile async sizing futures first: in steady state the
-            # harvester already holds the counts, so this is free — a
-            # not-yet-done future is the one place the old blocking
-            # per-batch sync can still surface (accounted as such)
-            for i, h in enumerate(pending):
-                entry = futs.pop(id(h), None)
-                if entry is None or isinstance(batches[i].num_rows, int):
-                    continue
-                fut, est, speculated = entry
-                n = int(fut.result())
-                if pred is not None:
-                    pred.observe(n)
-                    if speculated:
-                        if n <= est:
-                            self.metrics["specHits"].add(1)
-                            SP.record_hit("agg.size", est, n)
-                        else:
-                            self.metrics["specOverflows"].add(1)
-                            SP.record_overflow("agg.size", est, n)
-                batches[i] = dataclasses.replace(batches[i], num_rows=n)
-            traced = [i for i, b in enumerate(batches)
-                      if not isinstance(b.num_rows, int)]
-            if (traced and len(batches) > 1
-                    and sum(b.capacity for b in batches) <= _FUSED_DRAIN_CAP):
-                # small partials: concatenate ON DEVICE (stack+compact,
-                # traced total) so the drain needs no sizing fetch at
-                # all — the query's only D2H round trip stays the final
-                # result pull
-                out = self._jit_concat_traced(batches)
-                if out is not None:
-                    for h in pending:
-                        h.close()
-                    pending.clear()
-                    return out
-            # deferred sizing: pin every traced row count in ONE batched
-            # D2H fetch (per-batch device_get round trips dominate
-            # grouped-aggregate wall time on high-latency device links)
-            if traced:
-                from spark_rapids_tpu.parallel.pipeline import (
-                    device_read_many,
-                )
-
-                ns = device_read_many(
-                    [batches[i].num_rows for i in traced],
-                    tag="agg.drain")
-                for i, n in zip(traced, ns):
-                    batches[i] = dataclasses.replace(batches[i],
-                                                     num_rows=int(n))
-            if len(batches) == 1:
-                out = batches[0]
-            elif self.n_keys == 0:
-                # grand aggregate: partials are fixed one-row min-bucket
-                # batches, so the concat program's static key is stable —
-                # compile once, then one dispatch per drain
-                out = self._jit_concat(batches)
-            else:
-                # grouped: partial sizes are data-dependent; jitting here
-                # would recompile per distinct row-count combination
-                out = concat_batches(batches)
+        def finish_drain() -> None:
+            """COMMIT a drain: release the drained partials.  Kept
+            separate from drain_pending so the escalation ladder can
+            build (and re-build, after a spill) the drained batch while
+            the source partials stay registered — only after the
+            consumer of the drain succeeded are they dropped."""
             for h in pending:
+                futs.pop(id(h), None)
                 h.close()
             pending.clear()
+            observed.clear()
+
+        def drain_pending(commit: bool = True) -> ColumnarBatch:
+            import dataclasses
+
+            acquired: list = []
+            try:
+                batches = []
+                for h in pending:
+                    batches.append(h.get())
+                    acquired.append(h)
+                # reconcile async sizing futures first: in steady state
+                # the harvester already holds the counts, so this is
+                # free — a not-yet-done future is the one place the old
+                # blocking per-batch sync can still surface (accounted
+                # as such)
+                for i, h in enumerate(pending):
+                    entry = futs.get(id(h))
+                    if entry is None \
+                            or isinstance(batches[i].num_rows, int):
+                        continue
+                    fut, est, speculated = entry
+                    n = int(fut.result())
+                    if pred is not None and id(h) not in observed:
+                        observed.add(id(h))
+                        pred.observe(n)
+                        if speculated:
+                            if n <= est:
+                                self.metrics["specHits"].add(1)
+                                SP.record_hit("agg.size", est, n)
+                            else:
+                                self.metrics["specOverflows"].add(1)
+                                SP.record_overflow("agg.size", est, n)
+                    batches[i] = dataclasses.replace(batches[i],
+                                                     num_rows=n)
+                traced = [i for i, b in enumerate(batches)
+                          if not isinstance(b.num_rows, int)]
+                if (traced and len(batches) > 1
+                        and sum(b.capacity for b in batches)
+                        <= _FUSED_DRAIN_CAP):
+                    # small partials: concatenate ON DEVICE
+                    # (stack+compact, traced total) so the drain needs
+                    # no sizing fetch at all — the query's only D2H
+                    # round trip stays the final result pull
+                    out = self._jit_concat_traced(batches)
+                    if out is not None:
+                        if commit:
+                            finish_drain()
+                        return out
+                # deferred sizing: pin every traced row count in ONE
+                # batched D2H fetch (per-batch device_get round trips
+                # dominate grouped-aggregate wall time on high-latency
+                # device links)
+                if traced:
+                    from spark_rapids_tpu.parallel.pipeline import (
+                        device_read_many,
+                    )
+
+                    ns = device_read_many(
+                        [batches[i].num_rows for i in traced],
+                        tag="agg.drain")
+                    for i, n in zip(traced, ns):
+                        batches[i] = dataclasses.replace(
+                            batches[i], num_rows=int(n))
+                if len(batches) == 1:
+                    out = batches[0]
+                elif self.n_keys == 0:
+                    # grand aggregate: partials are fixed one-row
+                    # min-bucket batches, so the concat program's static
+                    # key is stable — compile once, then one dispatch
+                    # per drain
+                    out = self._jit_concat(batches)
+                else:
+                    # grouped: partial sizes are data-dependent; jitting
+                    # here would recompile per distinct row-count
+                    # combination
+                    out = concat_batches(batches)
+            except BaseException:
+                # a failed (uncommitted) drain must leave every partial
+                # evictable again so the spill-retry rung can actually
+                # release pressure before the re-run
+                for h in acquired:
+                    h.unpin()
+                raise
+            if commit:
+                finish_drain()
             return out
 
         try:
             yield from self._execute_inner(store, pending, futs, pred,
-                                           drain_pending, source,
-                                           emit_empty_default)
+                                           drain_pending, finish_drain,
+                                           source, emit_empty_default)
         finally:
             # a raise (or generator close) anywhere above must not leak
             # registrations into the process-global store
@@ -559,12 +594,13 @@ class TpuHashAggregateExec(TpuExec):
             futs.clear()
 
     def _execute_inner(self, store, pending, futs, pred, drain_pending,
-                       source, emit_empty_default):
+                       finish_drain, source, emit_empty_default):
         from spark_rapids_tpu.memory import SpillPriorities
         from spark_rapids_tpu.parallel import speculation as SP
 
         import dataclasses
 
+        from spark_rapids_tpu.execs import retry as R
         from spark_rapids_tpu.parallel import pipeline as P
 
         pending_rows = 0
@@ -577,6 +613,51 @@ class TpuHashAggregateExec(TpuExec):
                 if self.mode == "final":
                     return batch  # already partial layout
                 return t.observe(self._jit_update(_as_device_rows(batch)))
+
+        def merge_and_park(park):
+            """Re-merge the pending partials as ONE transaction on the
+            OOM escalation ladder: drain (uncommitted, restartable) +
+            merge under spill-retry, then `park(merged)` registers the
+            result — only after THAT succeeds are the drained partials
+            released.  Any retryable failure up to the park leaves
+            `pending` intact, so the batch ladder can re-run the whole
+            unit without losing drained state (the failure mode a
+            naive drain-then-merge would silently corrupt)."""
+            state: dict = {}
+
+            def att():
+                if "b" not in state:
+                    state["b"] = drain_pending(commit=False)
+                return self._jit_merge(_as_device_rows(state["b"]))
+
+            try:
+                merged = R.run_with_oom_retry(att, desc="agg.merge")
+                self.metrics["numMerges"].add(1)
+                old = list(pending)
+                del pending[:]  # park appends the merged entry fresh
+                try:
+                    R.run_with_oom_retry(lambda: park(merged),
+                                         desc="agg.park")
+                except BaseException:
+                    # park failed for good: restore the drained
+                    # partials — the ladder re-runs from intact state
+                    pending[:0] = old
+                    raise
+            except BaseException:
+                # ESCALATION with a completed (uncommitted) drain in
+                # hand: drop the drain's pins so the partials are
+                # evictable again — otherwise each ladder re-run
+                # re-drains and re-pins, and release_pressure can
+                # never spill exactly the dominant memory
+                if "b" in state:
+                    for h in pending:
+                        h.unpin()
+                raise
+            fresh = list(pending)
+            pending[:] = old
+            finish_drain()  # release old partials (+ their futs/marks)
+            pending[:] = fresh
+            return merged
 
         def _register_speculative(part) -> None:
             """Speculative sizing for a big partial: the count readback
@@ -611,24 +692,25 @@ class TpuHashAggregateExec(TpuExec):
                         self.goal_rows, 2 * _DEFER_SYNC_CAP):
                     # bound pending without a sizing sync: re-merge via
                     # the traced concat; the merged partial stays traced
+                    def park(m):
+                        pending.append(store.register(
+                            m, SpillPriorities.AGGREGATE_PARTIAL))
+
                     with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
-                        merged = t.observe(self._jit_merge(
-                            _as_device_rows(drain_pending())))
-                    self.metrics["numMerges"].add(1)
-                    pending.append(store.register(
-                        merged, SpillPriorities.AGGREGATE_PARTIAL))
+                        merged = t.observe(merge_and_park(park))
                     pending_rows = merged.capacity
                 return
             if pred is not None and not isinstance(part.num_rows, int):
                 _register_speculative(part)
                 if len(pending) > 1 and pending_rows >= self.goal_rows:
+                    def park(m):
+                        nonlocal pending_rows
+                        pending_rows = 0
+                        _register_speculative(m)
+
                     with MetricTimer(self.metrics[TOTAL_TIME],
                                      op=self.name) as t:
-                        merged = t.observe(self._jit_merge(
-                            _as_device_rows(drain_pending())))
-                    self.metrics["numMerges"].add(1)
-                    pending_rows = 0
-                    _register_speculative(merged)
+                        t.observe(merge_and_park(park))
                 return
             # one sizing sync per batch (free when the update emitted a
             # static count, e.g. grand aggregates); pin the host int into
@@ -640,20 +722,46 @@ class TpuHashAggregateExec(TpuExec):
                 part, SpillPriorities.AGGREGATE_PARTIAL))
             pending_rows += n
             if len(pending) > 1 and pending_rows >= self.goal_rows:
-                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
-                    merged = t.observe(self._jit_merge(
-                        _as_device_rows(drain_pending())))
-                self.metrics["numMerges"].add(1)
-                # sized before register: a register under pressure may
-                # immediately spill `merged`
-                pr = P.device_read_int(merged.num_rows, tag="agg.size")
-                pending_rows = pr
-                merged = dataclasses.replace(merged, num_rows=pr)
-                merged = merged.shrink_to_capacity(pad_capacity(pr))
-                pending.append(store.register(
-                    merged, SpillPriorities.AGGREGATE_PARTIAL))
+                def park(m):
+                    nonlocal pending_rows
+                    # sized before register: a register under pressure
+                    # may immediately spill the merged batch
+                    pr = P.device_read_int(m.num_rows, tag="agg.size")
+                    m = dataclasses.replace(m, num_rows=pr)
+                    m = m.shrink_to_capacity(pad_capacity(pr))
+                    pending.append(store.register(
+                        m, SpillPriorities.AGGREGATE_PARTIAL))
+                    pending_rows = pr
 
-        for _ in P.pipelined(source, dispatch, retire, tag="agg.update"):
+                with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
+                    t.observe(merge_and_park(park))
+
+        # Batch-granular OOM split-and-retry: one ladder unit =
+        # update-dispatch + retire for one input batch.  retire's side
+        # effects (partial registration, merge bookkeeping) roll back
+        # on failure so a re-run — at full size or at the split size —
+        # starts from clean state; the merge itself is transactional
+        # (merge_and_park) so drained partials are never lost to a
+        # mid-merge OOM.
+        def guarded_retire(part):
+            nonlocal pending_rows
+            n0 = len(pending)
+            r0 = pending_rows
+            try:
+                retire(part)
+            except BaseException:
+                for h in pending[n0:]:
+                    futs.pop(id(h), None)
+                    h.close()
+                del pending[n0:]
+                pending_rows = r0
+                raise
+            return ()
+
+        dispatch_guarded, retire_guarded = R.guarded_pipeline(
+            dispatch, guarded_retire, desc="agg.update")
+        for _ in P.pipelined(source, dispatch_guarded, retire_guarded,
+                             tag="agg.update"):
             pass  # retire yields nothing; pipelined drives the overlap
 
         if not pending:
@@ -674,12 +782,22 @@ class TpuHashAggregateExec(TpuExec):
             return
         with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             single = len(pending) == 1
-            merged = drain_pending()
-            if not single or self.mode == "final":
-                merged = self._jit_merge(_as_device_rows(merged))
-            if self.mode == "partial":
-                out = merged
-            else:
-                out = self._jit_finalize(_as_device_rows(merged))
+            state: dict = {}
+
+            def final_att():
+                # uncommitted drain: a retryable failure anywhere in
+                # the tail (concat, merge, finalize) spills + re-runs
+                # with every partial still registered
+                if "b" not in state:
+                    state["b"] = drain_pending(commit=False)
+                m = state["b"]
+                if not single or self.mode == "final":
+                    m = self._jit_merge(_as_device_rows(m))
+                if self.mode == "partial":
+                    return m
+                return self._jit_finalize(_as_device_rows(m))
+
+            out = R.run_with_oom_retry(final_att, desc="agg.drain")
+            finish_drain()
             t.observe(out)
         yield self._count_output(out)

@@ -158,47 +158,94 @@ class TpuShuffleExchangeExec(TpuExec):
                     h.unpin()
                     pending.append((rid, h, h.nbytes, rows))
 
-        def finish_inflight(item) -> None:
-            grouped, has_counts, fut = item
-            v = fut.result()
-            if has_counts:
-                register_slices(
-                    (sub, sub.num_rows) for sub in
-                    split_batch_finish(grouped, v, n))
-            else:
-                register_slices([(grouped, int(v))])
+        from spark_rapids_tpu.execs import retry as R
 
-        def retire(entry):
-            """Sizing half.  With speculation on, the count readback is
+        def finish_inflight(item) -> None:
+            """Register the slices of one harvested batch — its own
+            spill-retry transaction (slice registrations roll back, the
+            cached ReadbackFuture re-resolves for free); an exhausted
+            retry escalates to the whole-task rung, where the atomic
+            commit protocol keeps correctness."""
+            grouped, has_counts, fut = item
+
+            def att():
+                n0 = len(pending)
+                try:
+                    v = fut.result()
+                    if has_counts:
+                        register_slices(
+                            (sub, sub.num_rows) for sub in
+                            split_batch_finish(grouped, v, n))
+                    else:
+                        register_slices([(grouped, int(v))])
+                except BaseException:
+                    for _rid, h, _b, _r in pending[n0:]:
+                        h.close()
+                    del pending[n0:]
+                    raise
+
+            R.run_with_oom_retry(att, desc="exchange.finish")
+
+        def finish_entry(entry):
+            """Sizing half for one dispatched batch — the split-retry
+            unit's tail.  With speculation on, the count readback is
             HARVESTED asynchronously: the map loop keeps dispatching
             while the harvester pulls counts, and slices register as
             their counts arrive (zero blocking syncs in steady state).
             Off, it is the one blocking batched readback per input
-            batch, as before."""
+            batch, as before.  Rolls back its own slice registrations
+            (and its own in-flight entry) on failure so the ladder can
+            re-run the batch — at the split size after a bisect —
+            without duplicating reduce blocks."""
             grouped, counts = entry
-            if spec_on:
-                fut = P.device_read_async(
-                    counts if counts is not None else grouped.num_rows,
-                    tag="exchange.split")
-                inflight.append((grouped, counts is not None, fut))
-                while inflight and (inflight[0][2].done()
-                                    or len(inflight) > max_inflight):
-                    finish_inflight(inflight.popleft())
-                return
-            if counts is None:
-                rows = P.device_read_int(grouped.num_rows,
-                                         tag="exchange.split")
-                register_slices([(grouped, rows)])
-            else:
-                counts_np = P.device_read(counts, tag="exchange.split")
-                register_slices(
-                    (sub, sub.num_rows) for sub in
-                    split_batch_finish(grouped, counts_np, n))
+            n0 = len(pending)
+            own = None
+            try:
+                if spec_on:
+                    fut = P.device_read_async(
+                        counts if counts is not None
+                        else grouped.num_rows,
+                        tag="exchange.split")
+                    own = (grouped, counts is not None, fut)
+                    inflight.append(own)
+                elif counts is None:
+                    rows = P.device_read_int(grouped.num_rows,
+                                             tag="exchange.split")
+                    register_slices([(grouped, rows)])
+                else:
+                    counts_np = P.device_read(counts,
+                                              tag="exchange.split")
+                    register_slices(
+                        (sub, sub.num_rows) for sub in
+                        split_batch_finish(grouped, counts_np, n))
+            except BaseException:
+                if own is not None:
+                    try:
+                        inflight.remove(own)
+                    except ValueError:
+                        pass  # already drained (its slices roll back)
+                for _rid, h, _b, _r in pending[n0:]:
+                    h.close()
+                del pending[n0:]
+                raise
+            return ()
+
+        def drain_opportunistic():
+            # opportunistic in-flight drain OUTSIDE the ladder: each
+            # harvested item is its own retry transaction above
+            while inflight and (inflight[0][2].done()
+                                or len(inflight) > max_inflight):
+                finish_inflight(inflight.popleft())
+
+        dispatch_guarded, retire_guarded = R.guarded_pipeline(
+            dispatch, finish_entry, desc="exchange.map",
+            after=drain_opportunistic)
 
         try:
             for _ in P.pipelined(
                     self.children[0].execute_partition(child_part),
-                    dispatch, retire, tag="exchange.map"):
+                    dispatch_guarded, retire_guarded,
+                    tag="exchange.map"):
                 pass
             while inflight:
                 finish_inflight(inflight.popleft())

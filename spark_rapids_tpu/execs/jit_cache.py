@@ -91,6 +91,20 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
                 # program shape: the timeline shows WHICH key paid it
                 _trace.event("jit.cache_miss", key=repr(key)[:200],
                              cache_size=len(_CACHE))
+            # the jit.compile fault seam sits on the miss path only (a
+            # cache hit compiles nothing), with in-place recovery
+            # (absorb_once) for INJECTED compile faults: spill
+            # unpinned buffers, re-check once.  Real XLA compilation
+            # happens lazily at the wrapper's first invocation — a
+            # real compile OOM therefore surfaces at the CALLER, where
+            # the batch ladder / task retry / CPU degrade handle it
+            from spark_rapids_tpu.execs.retry import absorb_once
+            from spark_rapids_tpu.robustness import faults as _faults
+
+            absorb_once(
+                lambda: _faults.fault_point("jit.compile",
+                                            key=repr(key)[:80]),
+                action="compile_retry")
             fn = _CACHE[key] = jax.jit(make_fn())
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)

@@ -355,6 +355,11 @@ class _HashJoinBase(TpuExec):
                 if pred is not None:
                     SP.record_sync("join.probe")
             if pred is not None:
+                # a ladder re-run of a failed batch re-dispatches and
+                # observes the same count again (and may re-tick
+                # specHits/specOverflows): the EWMA skew is bounded to
+                # failure paths and re-observing the true count is
+                # harmless, so no cross-attempt dedup is attempted
                 pred.observe(n_total)
             if not n_total:
                 if spec is not None:
@@ -393,8 +398,19 @@ class _HashJoinBase(TpuExec):
                         o = self._jit_condition(o)
                 yield self._count_output(o)
 
-        yield from P.pipelined(stream_batches, dispatch, retire,
-                               tag="join.probe")
+        # Batch-granular OOM split-and-retry (execs/retry.py): each
+        # stream batch is one ladder unit.  dispatch failures carry
+        # their error into the ladder as the first failure; retire
+        # failures discard the in-flight (possibly speculated) entry
+        # and RE-DISPATCH from the input batch — at the split size
+        # after a bisect, re-predicting through the live predictor, so
+        # no stale predictor capacity leaks into the retried chunks.
+        from spark_rapids_tpu.execs.retry import guarded_pipeline
+
+        dispatch_guarded, retire_guarded = guarded_pipeline(
+            dispatch, retire, desc="join.probe")
+        yield from P.pipelined(stream_batches, dispatch_guarded,
+                               retire_guarded, tag="join.probe")
 
         if self.join_type == "full_outer":
             yield from self._emit_unmatched_build(build, matched_b_acc)

@@ -247,11 +247,26 @@ class TpuSortExec(_SortMixin):
             idxs = list(deferred)
             if not idxs:
                 return
-            batches = [handles[i].get() for i in idxs]
-            from spark_rapids_tpu.parallel.pipeline import device_read_many
+            acquired: list = []
+            try:
+                batches = []
+                for i in idxs:
+                    batches.append(handles[i].get())
+                    acquired.append(handles[i])
+                from spark_rapids_tpu.parallel.pipeline import (
+                    device_read_many,
+                )
 
-            ns = device_read_many([b.num_rows for b in batches],
-                                  tag="sort.size")
+                ns = device_read_many([b.num_rows for b in batches],
+                                      tag="sort.size")
+            except BaseException:
+                # a failed acquire/readback must leave the runs
+                # evictable: the ladder re-runs this path, and pins
+                # left behind would accumulate per attempt, making the
+                # out-of-core sort's main memory unspillable
+                for h in acquired:
+                    h.unpin()
+                raise
             for i, b, nn in zip(idxs, batches, ns):
                 nn = int(nn)
                 total += nn - rows[i]
@@ -259,46 +274,81 @@ class TpuSortExec(_SortMixin):
                 handles[i].unpin()
             deferred.clear()
 
+        from spark_rapids_tpu.execs import retry as R
+
         try:
             total = 0
             deferred: list[int] = []  # handle indices with capacity-
             # bound row counts (sizing sync skipped)
+
+            def ingest(b) -> None:
+                """Augment + register ONE input batch — the
+                split-and-retry unit of the OOC sort's collect phase.
+                Rolls back its partial bookkeeping (handles/rows/
+                samples/deferred/total) on failure so the ladder can
+                spill-and-re-run it, or bisect it into two smaller
+                runs (more runs is always valid input to the bucket
+                merge)."""
+                nonlocal total
+                h0, r0, s0 = len(handles), len(rows), len(samples)
+                d0, t0, rows0 = list(deferred), total, list(rows)
+                try:
+                    if depth == 0:
+                        aug = jit_aug(b.with_device_num_rows())
+                    else:
+                        aug = b  # recursive input: already augmented
+                    if not isinstance(aug.num_rows, int) \
+                            and total + aug.capacity <= single_rows:
+                        # defer the sizing sync: capacity bounds the
+                        # rows, and while the running total stays below
+                        # the single-batch threshold the exact count
+                        # changes no decision (the sort handles dead
+                        # rows).  Each skipped sync saves a device
+                        # round trip.  Batches kept capacity-bound
+                        # never feed the sample pool.
+                        n = aug.capacity
+                    else:
+                        if deferred:
+                            pin_deferred()
+                        n = aug.concrete_num_rows()
+                        if n == 0:
+                            return
+                        aug = _dc.replace(aug, num_rows=n)
+                    crossing = total <= single_rows < total + n
+                    total += n
+                    handles.append(store.register(
+                        aug, SpillPriorities.COALESCE_PENDING))
+                    rows.append(n)
+                    if not isinstance(aug.num_rows, int):
+                        deferred.append(len(handles) - 1)
+                    if crossing and len(handles) > 1:
+                        # threshold crossed: back-sample earlier batches
+                        for h, hn in zip(handles[:-1], rows[:-1]):
+                            prev = h.get()
+                            try:
+                                take_sample(prev, hn)
+                            finally:
+                                # a mid-sample failure must not leave
+                                # the batch pinned: the ladder's spill
+                                # rung needs it evictable on the re-run
+                                h.unpin()
+                    if total > single_rows:
+                        take_sample(aug, n)
+                except BaseException:
+                    for h in handles[h0:]:
+                        h.close()
+                    del handles[h0:]
+                    rows[:] = rows0[:r0]
+                    del samples[s0:]
+                    deferred[:] = d0
+                    total = t0
+                    raise
+
             for b in source:
-                if depth == 0:
-                    aug = jit_aug(b.with_device_num_rows())
-                else:
-                    aug = b  # recursive input is already augmented
-                if not isinstance(aug.num_rows, int) \
-                        and total + aug.capacity <= single_rows:
-                    # defer the sizing sync: capacity bounds the rows,
-                    # and while the running total stays below the
-                    # single-batch threshold the exact count changes no
-                    # decision (the sort handles dead rows).  Each
-                    # skipped sync saves a device round trip.  Batches
-                    # kept capacity-bound never feed the sample pool.
-                    n = aug.capacity
-                else:
-                    if deferred:
-                        pin_deferred()
-                    n = aug.concrete_num_rows()
-                    if n == 0:
-                        continue
-                    aug = _dc.replace(aug, num_rows=n)
-                crossing = total <= single_rows < total + n
-                total += n
-                handles.append(store.register(
-                    aug, SpillPriorities.COALESCE_PENDING))
-                rows.append(n)
-                if not isinstance(aug.num_rows, int):
-                    deferred.append(len(handles) - 1)
-                if crossing and len(handles) > 1:
-                    # threshold just crossed: back-sample earlier batches
-                    for h, hn in zip(handles[:-1], rows[:-1]):
-                        prev = h.get()
-                        take_sample(prev, hn)
-                        h.unpin()
-                if total > single_rows:
-                    take_sample(aug, n)
+                for _ in R.with_split_retry(
+                        lambda bb: ingest(bb) or (), b,
+                        desc="sort.collect"):
+                    pass
             if total == 0:
                 return
             if total <= single_rows or len(handles) == 1:

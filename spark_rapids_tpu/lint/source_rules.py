@@ -57,6 +57,18 @@ Rules
   exempt — the value is already host memory).  Intentional
   infrastructure sites (metric settlement in execs/base.py, the
   split-count conversion in ops/partition.py) are baselined.
+- SRC008 (warning): a broad `except` clause (bare / Exception /
+  BaseException / RuntimeError) in an exec, io, or shuffle module
+  that SWALLOWS the exception — no re-raise anywhere in the handler
+  and no routing through the retry classification gate
+  (execs/retry.classify / is_retryable / should_cpu_fallback /
+  note_recovered).  A bare `except Exception: pass` in those layers
+  can eat a retryable device error (XlaRuntimeError subclasses
+  RuntimeError), silently skipping the spill/split/task-retry
+  escalation ladder AND the chaos-mode fault accounting.  Intentional
+  fall-back-to-slow-path sites (the fastpar decoder's per-column
+  bailouts) are baselined, not suppressed inline.  execs/retry.py
+  itself — the classification gate — is exempt by construction.
 """
 
 from __future__ import annotations
@@ -457,6 +469,89 @@ class _RawTimingChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: handler-body calls that prove the exception was CLASSIFIED before
+#: being absorbed (the execs/retry gate + the fault-accounting hooks)
+_CLASSIFY_CALLS = {"classify", "is_retryable", "should_cpu_fallback",
+                   "note_recovered"}
+#: broad exception type names whose swallow can eat a retryable device
+#: error (XlaRuntimeError subclasses RuntimeError)
+_BROAD_EXC = {"Exception", "BaseException", "RuntimeError"}
+
+
+class _SwallowChecker(ast.NodeVisitor):
+    """SRC008: broad except clauses that swallow without consulting
+    the retry classification gate in recovery-critical modules
+    (execs/, io/, shuffle/).
+
+    A handler is CLEAN when its body re-raises anywhere (`raise`,
+    bare or not) or calls one of the classification/fault-accounting
+    helpers; everything else absorbing Exception/BaseException/
+    RuntimeError (or a bare except) is flagged.  Narrow catches
+    (OSError, ValueError, a project error type) are out of scope —
+    they cannot eat an XlaRuntimeError."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except
+        types = t.elts if isinstance(t, ast.Tuple) else [t]
+        return any(_terminal_name(x) in _BROAD_EXC for x in types)
+
+    @staticmethod
+    def _routes(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                if _terminal_name(n.func) in _CLASSIFY_CALLS:
+                    return True
+                # FORWARDING the caught exception object as a call's
+                # SOLE argument (queue.put(e), chan.finish(e),
+                # callback(e)) is propagation, not a swallow — the
+                # consumer re-raises it.  Deliberately narrow: a
+                # logging call (`log.warning("failed: %s", e)`) passes
+                # the exception among other args and IS a swallow.
+                if handler.name and len(n.args) == 1 \
+                        and not n.keywords \
+                        and isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id == handler.name:
+                    return True
+        return False
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if self._is_broad(handler) and not self._routes(handler):
+                qual = self._fn_stack[-1] if self._fn_stack \
+                    else "<module>"
+                caught = "bare except" if handler.type is None else \
+                    f"except {ast.unparse(handler.type)}"
+                self.out.append(Diagnostic(
+                    "SRC008", "warning", f"{self.path}::{qual}",
+                    f"`{caught}` swallows without routing through "
+                    "retry.classify — it can eat a retryable device "
+                    "error and skip the recovery ladder",
+                    hint="re-raise, or consult execs/retry.classify / "
+                         "is_retryable before absorbing (and "
+                         "note_recovered for absorbed injected "
+                         "faults); baseline only intentional "
+                         "fall-back-to-slow-path sites",
+                    line=getattr(handler, "lineno", 0)))
+        self.generic_visit(node)
+
+
 def _is_exec_module(path: str) -> bool:
     parts = path.replace("\\", "/").split("/")
     return "execs" in parts
@@ -472,6 +567,16 @@ def _is_sync_hazard_module(path: str) -> bool:
     """SRC007 scope: exec bodies and the device kernels under ops/."""
     parts = path.replace("\\", "/").split("/")
     return "execs" in parts or "ops" in parts
+
+
+def _is_recovery_module(path: str) -> bool:
+    """SRC008 scope: the layers whose exceptions feed the recovery
+    ladder.  execs/retry.py IS the classification gate — exempt."""
+    norm = path.replace("\\", "/")
+    parts = norm.split("/")
+    if norm.endswith("execs/retry.py"):
+        return False
+    return any(p in parts for p in ("execs", "io", "shuffle"))
 
 
 def lint_source_text(src: str, path: str) -> list[Diagnostic]:
@@ -494,6 +599,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _RawTimingChecker(path, out).visit(tree)
     if _is_sync_hazard_module(path):
         _HostMaterializeChecker(path, out).visit(tree)
+    if _is_recovery_module(path):
+        _SwallowChecker(path, out).visit(tree)
     return out
 
 

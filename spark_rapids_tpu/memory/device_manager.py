@@ -50,6 +50,19 @@ HOST_POOL_BYTES = register(
     "HostBufferPool (the pinned-host pool analog).")
 
 
+def device_alloc_checkpoint(nbytes: int) -> None:
+    """The ``alloc.device`` fault-injection seam (robustness/faults.py):
+    BufferStore.reserve consults it before admitting a device
+    reservation, standing in for the alloc-failure hook XLA does not
+    expose (the reference's DeviceMemoryEventHandler.onAllocFailure).
+    Disarmed it is one global read; armed, an injected
+    RESOURCE_EXHAUSTED here drives the store's spill-and-retry path and,
+    past that, the batch split-and-retry ladder (execs/retry.py)."""
+    from spark_rapids_tpu.robustness import faults as _faults
+
+    _faults.fault_point("alloc.device", nbytes=nbytes)
+
+
 @dataclasses.dataclass(frozen=True)
 class DeviceInfo:
     ordinal: int

@@ -163,6 +163,17 @@ def _col_leaves(c, prefix: str) -> list[tuple[str, object]]:
     return out
 
 
+#: leaf-name suffixes of DICTIONARY SIDECAR arrays.  gather/compact/
+#: split pass the row-invariant dictionary through BY REFERENCE, so
+#: every child batch of a dict-encoded column shares ONE device array —
+#: spilling one registered child must not .delete() it out from under
+#: its siblings (the "Array has been deleted" crash under a tight
+#: budget).  Skipping the explicit delete only defers release to the
+#: last Python reference dropping; dictionaries are bounded at 0xFFFF
+#: entries, so the nondeterminism is a few KB, not a batch.
+_SHARED_SIDECAR_SUFFIXES = ("_dchars", "_dlens", "_dvals")
+
+
 def _batch_to_host(batch: ColumnarBatch,
                    delete: bool = True) -> dict:
     """Materialize to numpy; `delete` releases the device buffers
@@ -177,8 +188,9 @@ def _batch_to_host(batch: ColumnarBatch,
     arrays: dict[str, np.ndarray] = {
         name: np.asarray(h) for (name, _), h in zip(leaves, host)}
     if delete:
-        for _, a in leaves:
-            _delete(a)
+        for name, a in leaves:
+            if not name.endswith(_SHARED_SIDECAR_SUFFIXES):
+                _delete(a)
     arrays["__num_rows"] = np.asarray(n, np.int64)
     return arrays
 
@@ -460,8 +472,29 @@ class BufferStore:
     def reserve(self, nbytes: int) -> None:
         """Make room for an nbytes device allocation, spilling if needed
         (the proactive analog of DeviceMemoryEventHandler.onAllocFailure
-        -> synchronousSpill)."""
+        -> synchronousSpill).  The alloc.device fault checkpoint sits in
+        front: a (injected or real) RESOURCE_EXHAUSTED from admission is
+        absorbed once by spilling EVERYTHING unpinned and re-admitting —
+        the onAllocFailure -> synchronousSpill -> retry-the-alloc loop;
+        a second failure propagates to the batch split-and-retry
+        ladder."""
+        from spark_rapids_tpu.memory.device_manager import (
+            device_alloc_checkpoint,
+        )
+
         with self._lock:
+            try:
+                device_alloc_checkpoint(nbytes)
+            except BaseException as e:  # noqa: BLE001 - classified below
+                from spark_rapids_tpu.execs.retry import is_retryable
+                from spark_rapids_tpu.robustness import faults as _faults
+
+                if not is_retryable(e):
+                    raise
+                while self._spill_one_device():
+                    pass
+                device_alloc_checkpoint(nbytes)  # 2nd failure escalates
+                _faults.note_recovered(e, action="alloc_spill_retry")
             while self.device_used + nbytes > self.device_budget:
                 if not self._spill_one_device():
                     break  # nothing spillable left; let XLA try anyway

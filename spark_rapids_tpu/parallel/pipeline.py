@@ -363,6 +363,39 @@ def pipelined(items: Iterable, dispatch: Callable[[Any], Any],
             yield from out
 
 
+def _stage_checkpoint(stage: str) -> None:
+    """The ``pipeline.stage`` fault seam, hit once per produced item ON
+    the producer thread, with in-place bounded recovery: an INJECTED
+    stage fault releases pressure and re-checks instead of tearing the
+    stage down — only a persistent one re-raises at the consumer in
+    stream order (the prefetch contract).  Real failures from the
+    producer's own work (`gen`) keep that contract untouched: they
+    re-raise at the consumer, whose recovery ladder owns them (the
+    producer cannot re-run a generator it does not control).
+    Disarmed, this is one global read per item."""
+    from spark_rapids_tpu.robustness import faults as _faults
+
+    attempts = 3
+    caught = []
+    for attempt in range(attempts):
+        try:
+            _faults.fault_point("pipeline.stage", stage=stage)
+        except BaseException as e:  # noqa: BLE001 - classified below
+            from spark_rapids_tpu.execs.retry import (
+                is_retryable,
+                release_pressure,
+            )
+
+            if not is_retryable(e) or attempt == attempts - 1:
+                raise
+            caught.append(e)
+            release_pressure()
+            continue
+        for e in caught:
+            _faults.note_recovered(e, action="stage_retry")
+        return
+
+
 # ------------------------------------------------------------------ #
 # Bounded background stage
 # ------------------------------------------------------------------ #
@@ -501,6 +534,7 @@ def prefetch(gen: Iterable, depth: Optional[int] = None,
             try:
                 try:
                     for item in gen:
+                        _stage_checkpoint(stage)
                         if not chan.put(item, m):
                             return
                 except BaseException as e:  # noqa: BLE001 — re-raised at consumer

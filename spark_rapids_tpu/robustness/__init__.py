@@ -1,0 +1,15 @@
+"""Robustness subsystem: deterministic fault injection (faults.py) and
+the batch-granular OOM split-and-retry ladder (execs/retry.py builds on
+it).  See docs/robustness.md."""
+
+from spark_rapids_tpu.robustness.faults import (  # noqa: F401
+    InjectedFault,
+    fault_point,
+    fault_stats,
+    install,
+    disarm,
+    note_recovered,
+    recovered_total,
+    reset_stats,
+    sync_conf,
+)

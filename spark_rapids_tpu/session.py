@@ -921,6 +921,11 @@ class DataFrame:
         # including spans from prefetch stages, the exchange map pool
         # and the metric reaper, which receive it by context capture
         _trace.sync_conf(conf)
+        # same boundary sync for the fault-injection registry (chaos
+        # mode): conf-armed schedules take effect per query
+        from spark_rapids_tpu.robustness import faults as _faults
+
+        _faults.sync_conf(conf)
         qid = self._session.history.allocate_id()
         t0 = _time.perf_counter()
         with _trace.trace_context(query_id=qid):
@@ -938,16 +943,18 @@ class DataFrame:
                     raise
                 # device lost / exhausted after task retries: degrade
                 # the query to the CPU engine (executor-blacklisting
-                # analog)
+                # analog) — the LAST rung of the escalation ladder
                 import warnings
 
                 from spark_rapids_tpu.cpu.engine import execute_cpu
+                from spark_rapids_tpu.execs import retry as _retry
 
                 warnings.warn(
                     f"TPU execution failed with a device error ({e}); "
                     "re-running this query on the CPU engine",
                     RuntimeWarning, stacklevel=2)
                 out = execute_cpu(self._plan)
+                _retry.note_cpu_fallback(e)
                 # degraded queries are the ones operators most need to
                 # see in the history
                 self._session.history.record(

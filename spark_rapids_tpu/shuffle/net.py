@@ -48,6 +48,28 @@ HEARTBEAT_TIMEOUT_S = register(
     "A peer missing heartbeats this long is pruned from the registry "
     "and no longer handed to new executors.")
 
+FETCH_MAX_ATTEMPTS = register(
+    "spark.rapids.tpu.shuffle.fetch.maxAttempts", 3,
+    "Connection/read attempts per block fetch before FetchFailedError "
+    "propagates to the task-retry layer (ref: "
+    "spark.shuffle.io.maxRetries).  Between attempts the client backs "
+    "off exponentially with jitter; callers that supply a resolver "
+    "(net.peer_resolver over the heartbeat registry) get the peer "
+    "address re-resolved before the final attempt.",
+    check=lambda v: v >= 1)
+
+FETCH_BACKOFF_S = register(
+    "spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.05,
+    "Base sleep between fetch attempts (doubles per attempt, +-50% "
+    "jitter so reducers hammered off the same dying peer do not "
+    "reconnect in lockstep; ref: spark.shuffle.io.retryWait).")
+
+FETCH_TIMEOUT_S = register(
+    "spark.rapids.tpu.shuffle.fetch.timeoutSeconds", 30.0,
+    "Per-ATTEMPT socket timeout (connect and reads) for block "
+    "fetches; a hung peer costs one attempt, not the whole fetch "
+    "budget.")
+
 
 class FetchFailedError(RuntimeError):
     """A remote shuffle block could not be fetched (peer died,
@@ -171,11 +193,16 @@ class ShuffleBlockServer:
         self._srv.server_close()
 
 
-def fetch_blocks(host: str, port: int, shuffle_id: int, reduce_id: int,
-                 timeout: float = 30.0) -> list[dict]:
-    """Fetch one reduce partition's blocks from a peer as host-array
-    dicts.  Any transport problem raises FetchFailedError."""
+def _fetch_once(host: str, port: int, shuffle_id: int, reduce_id: int,
+                timeout: float) -> list[dict]:
+    """One fetch attempt (the previous whole-fetch body): any transport
+    problem raises FetchFailedError.  ``timeout`` bounds the connect
+    AND every read on this attempt's socket."""
+    from spark_rapids_tpu.robustness import faults as _faults
+
     try:
+        _faults.fault_point("shuffle.fetch", shuffle_id=shuffle_id,
+                            reduce_id=reduce_id)
         with socket.create_connection((host, port),
                                       timeout=timeout) as sock:
             _send_msg(sock, json.dumps({
@@ -197,16 +224,94 @@ def fetch_blocks(host: str, port: int, shuffle_id: int, reduce_id: int,
         raise FetchFailedError(
             f"fetch {shuffle_id}/{reduce_id} from {host}:{port} "
             f"failed: {e}") from e
+    except RuntimeError as e:
+        # the shuffle.fetch fault seam injects RuntimeErrors carrying
+        # transport markers; surface them under the same contract a
+        # real connection reset would
+        from spark_rapids_tpu.execs.retry import classify
+
+        if classify(e) != "retryable":
+            raise
+        raise FetchFailedError(
+            f"fetch {shuffle_id}/{reduce_id} from {host}:{port} "
+            f"failed: {e}") from e
+
+
+def fetch_blocks(host: str, port: int, shuffle_id: int, reduce_id: int,
+                 timeout: Optional[float] = None,
+                 resolve_peer=None) -> list[dict]:
+    """Fetch one reduce partition's blocks from a peer as host-array
+    dicts, with BOUNDED RETRIES inside the fetch itself (ref:
+    RetryingBlockTransferor / spark.shuffle.io.maxRetries): each
+    attempt gets its own socket timeout; between attempts the client
+    sleeps a jittered doubling backoff; before the LAST attempt a
+    persistent failure re-resolves the peer through ``resolve_peer``
+    (typically HeartbeatManager.live_peers via ``peer_resolver``) in
+    case the executor came back on a new port.  Only after the budget
+    is spent does FetchFailedError propagate — the task-retry layer
+    then provides the coarser elasticity, as before."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.robustness import faults as _faults
+
+    conf = get_conf()
+    if timeout is None:
+        timeout = conf.get(FETCH_TIMEOUT_S)
+    attempts = max(1, conf.get(FETCH_MAX_ATTEMPTS))
+    backoff = conf.get(FETCH_BACKOFF_S)
+    caught: list[BaseException] = []
+    for attempt in range(attempts):
+        try:
+            out = _fetch_once(host, port, shuffle_id, reduce_id,
+                              timeout)
+        except FetchFailedError as e:
+            if attempt == attempts - 1:
+                raise
+            caught.append(e)
+            from spark_rapids_tpu.execs.retry import _sleep_backoff
+
+            _sleep_backoff(backoff, attempt)
+            if resolve_peer is not None and attempt == attempts - 2:
+                # persistent failure: one re-resolution before the
+                # final attempt (a restarted peer re-registers with a
+                # fresh endpoint; its old address never recovers)
+                try:
+                    fresh = resolve_peer()
+                except Exception as re_exc:  # noqa: BLE001 — resolver is best-effort
+                    from spark_rapids_tpu.execs.retry import classify
+
+                    classify(re_exc)
+                    fresh = None
+                if fresh is not None:
+                    host, port = fresh
+            continue
+        for e in caught:
+            _faults.note_recovered(e, action="fetch_retry")
+        return out
+    raise caught[-1]  # unreachable; keeps type checkers honest
+
+
+def peer_resolver(registry, executor_id: str):
+    """A ``resolve_peer`` callback over a HeartbeatManager (or any
+    object with ``live_peers()``): the freshest (host, port) the
+    registry knows for ``executor_id``, else None."""
+    def resolve() -> Optional[tuple[str, int]]:
+        for eid, h, p in registry.live_peers():
+            if eid == executor_id:
+                return h, p
+        return None
+
+    return resolve
 
 
 def read_remote(host: str, port: int, shuffle_id: int, reduce_id: int,
-                schema, timeout: float = 30.0
-                ) -> Iterator[ColumnarBatch]:
+                schema, timeout: Optional[float] = None,
+                resolve_peer=None) -> Iterator[ColumnarBatch]:
     """Fetch + upload: remote blocks as device batches."""
     from spark_rapids_tpu.memory.store import _host_to_batch
 
     for arrays in fetch_blocks(host, port, shuffle_id, reduce_id,
-                               timeout=timeout):
+                               timeout=timeout,
+                               resolve_peer=resolve_peer):
         yield _host_to_batch(arrays, schema)
 
 

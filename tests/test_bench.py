@@ -33,6 +33,34 @@ def test_bench_q6_differential(tmp_path):
     assert_tpu_cpu_equal(df, approx_float=True)
 
 
+def test_bench_chaos_mode_records_recovery(tmp_path):
+    """bench.py --chaos: the per-query reset re-arms the schedule, the
+    query answers correctly under it, and the q*_retry_splits /
+    _spills_under_pressure / _recovered_faults fields attribute the
+    recovery work (recovered > 0 under chaos, all-zero off)."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.execs.retry import RETRY_BACKOFF_S
+    from spark_rapids_tpu.robustness import faults
+
+    get_conf().set(RETRY_BACKOFF_S.key, 0.0)
+    paths = _tiny_lineitem(tmp_path)
+    df = bench.q6_dataframe(TpuSession(), paths)
+    try:
+        bench._CHAOS = True
+        bench._reset_pipeline_counters()  # arms CHAOS_SPEC
+        sp0 = bench._spilled_now()
+        assert_tpu_cpu_equal(df, approx_float=True)
+        fields = bench._robustness_fields("q6", sp0)
+        assert fields["q6_recovered_faults"] > 0, fields
+    finally:
+        bench._CHAOS = False
+        faults.disarm()
+    bench._reset_pipeline_counters()
+    clean = bench._robustness_fields("q6", bench._spilled_now())
+    assert clean["q6_retry_splits"] == 0
+    assert clean["q6_recovered_faults"] == 0
+
+
 def test_repeat_collect_reuses_compiled_programs(tmp_path):
     from spark_rapids_tpu.execs import jit_cache
 

@@ -1,0 +1,460 @@
+"""Chaos-mode acceptance: deterministic fault injection drives every
+recovery path (robustness/faults.py + the execs/retry.py escalation
+ladder) and the answers stay BIT-FOR-BIT identical to the fault-free
+run — the reference proves its OOM machinery the same way
+(RmmRapidsRetryIterator's forced-OOM/forced-split test harness).
+
+Covers ISSUE 6's acceptance criteria:
+- golden-query chaos parity: the full golden pack under a seeded fault
+  schedule (device OOM on an early alloc, one upload fault, one
+  compile fault, one pipeline-stage fault, one mid-stream batch fault)
+  returns exactly the fault-free tables, with every injected fault
+  recovered and at least one recovery per core site across the pack;
+- shuffle-fetch chaos: an injected connection reset inside
+  fetch_blocks recovers through the new bounded-retry/backoff path
+  (and peer re-resolution picks up a moved server);
+- OOC under real pressure: the BufferStore device budget shrinks
+  mid-query and the sort/join still answer exactly;
+- fully DISABLED, the robustness subsystem is behavior-identical:
+  same table, same plan, same dispatch/readback pattern, zero
+  counters."""
+
+import json
+import pathlib
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+from spark_rapids_tpu.execs import retry as R
+from spark_rapids_tpu.robustness import faults
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+from tests.test_golden import FIXTURES, _column
+
+
+def assert_bitwise_equal(got: pa.Table, want: pa.Table, ctx="") -> None:
+    """BIT-FOR-BIT table parity: repr-level comparison distinguishes
+    NaN (equal to itself here, unlike ==) and -0.0 from 0.0 — the
+    float corners plain dict equality gets wrong in both directions."""
+    assert got.schema == want.schema, ctx
+    g, w = got.to_pydict(), want.to_pydict()
+    for name in w:
+        assert [repr(v) for v in g[name]] \
+            == [repr(v) for v in w[name]], (ctx, name)
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_disarmed():
+    conf = get_conf()
+    conf.set(R.RETRY_BACKOFF_S.key, 0.0)
+    R.reset_retry_stats()
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------------ #
+# fault registry unit behavior
+# ------------------------------------------------------------------ #
+
+
+def test_spec_parsing_and_determinism():
+    st = faults.parse_spec(
+        "alloc.device:nth=3,times=2;shuffle.fetch:prob=0.5,seed=7;"
+        "transfer.upload:latency=5,marker=UNAVAILABLE boom")
+    assert st["alloc.device"].nth == 3
+    assert st["alloc.device"].times == 2
+    assert st["shuffle.fetch"].prob == 0.5
+    assert st["transfer.upload"].latency_s == 0.005
+    assert "UNAVAILABLE" in st["transfer.upload"].marker
+    with pytest.raises(ValueError):
+        faults.parse_spec("alloc.device")  # missing ':'
+    with pytest.raises(ValueError):
+        faults.parse_spec("alloc.device:bogus=1")
+    with pytest.raises(ValueError):
+        # a typo'd site would arm a schedule that never fires — the
+        # chaos run would read green without testing anything
+        faults.parse_spec("alloc.devices:nth=1")
+
+
+def test_nth_and_every_policies_fire_deterministically():
+    faults.install("exec.batch:nth=2,times=2;jit.compile:every=3",
+                   forced=True)
+    fired = []
+    for i in range(1, 7):
+        try:
+            faults.fault_point("exec.batch")
+            fired.append(False)
+        except faults.InjectedFault as e:
+            fired.append(True)
+            assert R.is_retryable(e)  # default markers classify
+            assert e.site == "exec.batch"
+    assert fired == [False, True, True, False, False, False]
+    fired = []
+    for i in range(1, 7):
+        try:
+            faults.fault_point("jit.compile")
+            fired.append(False)
+        except faults.InjectedFault:
+            fired.append(True)
+    assert fired == [False, False, True, False, False, True]
+
+
+def test_seeded_probability_is_reproducible():
+    def run():
+        faults.install("exec.batch:prob=0.5,seed=42", forced=True)
+        out = []
+        for _ in range(32):
+            try:
+                faults.fault_point("exec.batch")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = run(), run()
+    assert a == b and 0 < sum(a) < 32
+
+
+def test_note_recovered_walks_cause_chain():
+    faults.install("shuffle.fetch:nth=1", forced=True)
+    try:
+        faults.fault_point("shuffle.fetch")
+    except faults.InjectedFault as inner:
+        try:
+            raise RuntimeError("wrapped") from inner
+        except RuntimeError as outer:
+            faults.note_recovered(outer, action="test")
+    assert faults.fault_stats()["shuffle.fetch"]["recovered"] == 1
+
+
+def test_disarmed_fault_point_is_noop():
+    faults.disarm()
+    for site in faults.SITES:
+        faults.fault_point(site)  # never raises
+    assert faults.fault_stats() == {}
+
+
+# ------------------------------------------------------------------ #
+# golden-query chaos parity (THE acceptance test)
+# ------------------------------------------------------------------ #
+
+#: one fault per core site: an early device-alloc OOM, one H2D upload
+#: fault, one compile fault, one producer-stage fault, and one
+#: batch fault for the split-retry ladder's spill rung.
+#: times=1 everywhere keeps recovery on-device (spill+retry re-runs
+#: the same programs), so parity is bit-for-bit by construction.
+_GOLDEN_SCHEDULE = ("alloc.device:nth=1;transfer.upload:nth=1;"
+                    "jit.compile:nth=1;pipeline.stage:nth=1;"
+                    "exec.batch:nth=1")
+
+_CORE_SITES = ("alloc.device", "transfer.upload", "jit.compile",
+               "pipeline.stage", "exec.batch")
+
+
+def test_golden_pack_chaos_parity():
+    """Every golden query under the seeded fault schedule returns
+    bit-for-bit the same table as its fault-free run; every injected
+    fault is recovered; across the pack every core site records at
+    least one recovery."""
+    from spark_rapids_tpu.execs import jit_cache
+    from spark_rapids_tpu.frontends.sql import SqlSession
+
+    recovered_by_site = {s: 0 for s in _CORE_SITES}
+    injected_total = 0
+    for path in FIXTURES:
+        fx = json.loads(pathlib.Path(path).read_text())
+        fe = SqlSession()
+        for name, cols in fx["tables"].items():
+            fe.register_table(
+                name, pa.table({c: _column(v)
+                                for c, v in cols.items()}))
+        df = fe.sql(fx["sql"])
+        want = df.collect(engine="tpu")  # fault-free reference
+        jit_cache.clear()  # force a compile miss for jit.compile
+        faults.install(_GOLDEN_SCHEDULE, forced=True)
+        try:
+            got = df.collect(engine="tpu")
+            stats = faults.fault_stats()
+        finally:
+            faults.disarm()
+        assert_bitwise_equal(got, want, ctx=path.stem)
+        for site, st in stats.items():
+            # every injected fault was absorbed by a recovery path
+            assert st["recovered"] == st["injected"], (path.stem, site,
+                                                       stats)
+            if site in recovered_by_site:
+                recovered_by_site[site] += st["recovered"]
+            injected_total += st["injected"]
+    assert injected_total > 0
+    for site in _CORE_SITES:
+        assert recovered_by_site[site] > 0, (
+            f"site {site} never exercised a recovery across the "
+            f"golden pack: {recovered_by_site}")
+
+
+def test_chaos_never_degrades_to_cpu():
+    """The golden schedule recovers on-device: no query-level CPU
+    fallback is part of the parity story (a degraded query would still
+    be correct, but would not prove the TPU recovery paths)."""
+    import warnings
+
+    rng = np.random.default_rng(11)
+    t = pa.table({"k": rng.integers(0, 8, 3000), "v": rng.random(3000)})
+    s = TpuSession()
+    df = (s.create_dataframe(t).group_by(col("k"))
+          .agg((sum_(col("v")), "s")))
+    want = df.collect(engine="tpu")
+    faults.install(_GOLDEN_SCHEDULE, forced=True)
+    R.reset_retry_stats()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # no degrade
+        got = df.collect(engine="tpu")
+    assert_bitwise_equal(got, want)
+    assert R.retry_stats()["cpu_fallbacks"] == 0
+    assert faults.recovered_total() == faults.injected_total() > 0
+
+
+# ------------------------------------------------------------------ #
+# shuffle-fetch chaos: bounded retries + peer re-resolution
+# ------------------------------------------------------------------ #
+
+
+def _serve_blocks():
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.shuffle import ShuffleBlockServer
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    schema = T.Schema([T.Field("k", T.LONG), T.Field("v", T.DOUBLE)])
+    mgr = ShuffleManager()
+    sid = mgr.new_shuffle_id()
+    rng = np.random.default_rng(3)
+    v = rng.random(64)
+    mgr.write(sid, 0, ColumnarBatch.from_numpy(
+        {"k": rng.integers(0, 9, 64).astype(np.int64), "v": v}, schema))
+    srv = ShuffleBlockServer(mgr).start()
+    return srv, sid, float(v.sum())
+
+
+def test_fetch_blocks_retries_injected_reset():
+    """An injected connection reset on the first attempt recovers
+    inside fetch_blocks (bounded retries with backoff) — the task
+    layer never sees it, and the recovery is credited to the site."""
+    from spark_rapids_tpu.shuffle import fetch_blocks
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.0)
+    srv, sid, want = _serve_blocks()
+    try:
+        faults.install("shuffle.fetch:nth=1", forced=True)
+        blocks = fetch_blocks("127.0.0.1", srv.address[1], sid, 0)
+        assert len(blocks) == 1
+        got = float(np.asarray(blocks[0]["c1_data"])[:64].sum())
+        assert abs(got - want) < 1e-9
+        st = faults.fault_stats()["shuffle.fetch"]
+        assert st["injected"] == 1 and st["recovered"] == 1
+    finally:
+        faults.disarm()
+        srv.shutdown()
+
+
+def test_fetch_blocks_exhausts_then_raises():
+    from spark_rapids_tpu.shuffle import FetchFailedError, fetch_blocks
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.0)
+    conf.set("spark.rapids.tpu.shuffle.fetch.maxAttempts", 3)
+    srv, sid, _ = _serve_blocks()
+    try:
+        faults.install("shuffle.fetch:nth=1,times=3", forced=True)
+        with pytest.raises(FetchFailedError):
+            fetch_blocks("127.0.0.1", srv.address[1], sid, 0)
+        assert faults.fault_stats()["shuffle.fetch"]["injected"] == 3
+        assert faults.fault_stats()["shuffle.fetch"]["recovered"] == 0
+    finally:
+        faults.disarm()
+        srv.shutdown()
+
+
+def test_fetch_re_resolves_peer_before_last_attempt():
+    """Persistent failure against a stale address re-resolves the peer
+    through the heartbeat registry (live_peers) and the final attempt
+    lands on the moved server."""
+    from spark_rapids_tpu.shuffle import HeartbeatManager, fetch_blocks
+    from spark_rapids_tpu.shuffle.net import peer_resolver
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.0)
+    conf.set("spark.rapids.tpu.shuffle.fetch.timeoutSeconds", 2.0)
+    srv, sid, want = _serve_blocks()
+    registry = HeartbeatManager()
+    registry.register("exec-1", "127.0.0.1", srv.address[1])
+    try:
+        # a port nothing listens on: connect fails until re-resolution
+        blocks = fetch_blocks(
+            "127.0.0.1", 1, sid, 0,
+            resolve_peer=peer_resolver(registry, "exec-1"))
+        assert len(blocks) == 1
+        got = float(np.asarray(blocks[0]["c1_data"])[:64].sum())
+        assert abs(got - want) < 1e-9
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# OOC under real pressure: device budget shrunk mid-query
+# ------------------------------------------------------------------ #
+
+
+class BudgetShrinkExec:
+    """Pass-through exec that collapses the BufferStore's device budget
+    after the first batch flows by — everything registered afterwards
+    spills immediately (the mid-query pressure drop a multi-tenant
+    serving tier produces when a neighbor session lands)."""
+
+    def __new__(cls, child, shrink_to):
+        from spark_rapids_tpu.execs.base import TpuExec
+
+        class _Shrink(TpuExec):
+            def __init__(self):
+                super().__init__(child)
+                self._done = False
+
+            @property
+            def schema(self):
+                return child.schema
+
+            @property
+            def num_partitions(self):
+                return child.num_partitions
+
+            def node_desc(self):
+                return "BudgetShrinkExec"
+
+            def execute_partition(self, p):
+                from spark_rapids_tpu.memory import get_store
+
+                for b in child.execute_partition(p):
+                    yield b
+                    if not self._done:
+                        self._done = True
+                        get_store().device_budget = shrink_to
+
+            def execute(self):
+                for p in range(self.num_partitions):
+                    yield from self.execute_partition(p)
+
+        return _Shrink()
+
+
+def _mkstore(budget=None):
+    from spark_rapids_tpu.memory.store import BufferStore, reset_store
+
+    store = BufferStore(device_budget=budget or (12 << 30))
+    reset_store(store)
+    return store
+
+
+def test_ooc_sort_with_budget_shrunk_mid_query():
+    from spark_rapids_tpu.execs.sort import SortKey, TpuSortExec
+    from spark_rapids_tpu.exprs import base as B
+    from spark_rapids_tpu.io.scan import ArrowSourceExec
+    from spark_rapids_tpu.plan.planner import collect_exec
+
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 512)
+    conf.set("spark.rapids.tpu.sql.sort.singleBatchRows", 1024)
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 1 << 30, 6000)
+    t = pa.table({"x": vals})
+    store = _mkstore()
+    try:
+        src = BudgetShrinkExec(ArrowSourceExec(t), shrink_to=1 << 16)
+        keys = [SortKey(B.BoundReference(0, T.LONG, False, "x"))]
+        got = collect_exec(TpuSortExec(keys, src, scope="global"))
+        assert got.column("x").to_pylist() == sorted(vals.tolist())
+        assert store.spilled_device_to_host > 0, \
+            "shrunken budget never forced a spill"
+    finally:
+        _mkstore()  # fresh store for later tests
+
+
+def test_ooc_join_with_budget_shrunk_mid_query():
+    from spark_rapids_tpu.execs.join import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.exprs import base as B
+    from spark_rapids_tpu.io.scan import ArrowSourceExec
+    from spark_rapids_tpu.plan.planner import collect_exec
+
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 512)
+    rng = np.random.default_rng(9)
+    left = pa.table({"k": rng.integers(0, 64, 4000),
+                     "a": rng.integers(0, 1000, 4000)})
+    right = pa.table({"k2": np.arange(64), "b": np.arange(64) * 10})
+    store = _mkstore()
+    try:
+        lsrc = BudgetShrinkExec(ArrowSourceExec(left), shrink_to=1 << 16)
+        rsrc = ArrowSourceExec(right)
+        join = TpuShuffledHashJoinExec(
+            [B.BoundReference(0, T.LONG, False, "k")],
+            [B.BoundReference(0, T.LONG, False, "k2")],
+            "inner", lsrc, rsrc)
+        got = collect_exec(join)
+        assert got.num_rows == 4000
+        ks = got.column("k").to_pylist()
+        bs = got.column("b").to_pylist()
+        assert all(b == k * 10 for k, b in zip(ks, bs))
+    finally:
+        _mkstore()
+
+
+# ------------------------------------------------------------------ #
+# fully disabled = behavior-identical
+# ------------------------------------------------------------------ #
+
+
+def test_disabled_robustness_is_plan_and_readback_identical():
+    """With robustness.faults fully disabled (the default), a query's
+    plan, result AND dispatch/readback pattern are identical to the
+    armed-but-empty-schedule run — the subsystem's off-state is
+    asserted to be a no-op, not assumed."""
+    from spark_rapids_tpu.parallel import pipeline as P
+    from spark_rapids_tpu.robustness.faults import (
+        FAULTS_ENABLED,
+        FAULTS_SPEC,
+    )
+
+    rng = np.random.default_rng(13)
+    t = pa.table({"v": rng.random(4000), "w": rng.random(4000)})
+    s = TpuSession()
+    df = (s.create_dataframe(t)
+          .where(col("v") > col("w"))
+          .agg((sum_(col("v")), "sv")))
+    df.collect(engine="tpu")  # warm compile caches / predictors
+
+    assert not faults._ARMED
+    plan_off = df.explain()
+    with P.trace_events() as ev_off:
+        out_off = df.collect(engine="tpu")
+    pattern_off = list(ev_off)
+    assert faults.fault_stats() == {}
+
+    conf = get_conf()
+    conf.set(FAULTS_ENABLED.key, True)
+    conf.set(FAULTS_SPEC.key, "")  # armed, zero policies
+    try:
+        plan_on = df.explain()
+        with P.trace_events() as ev_on:
+            out_on = df.collect(engine="tpu")
+        pattern_on = list(ev_on)
+        assert faults._ARMED
+    finally:
+        conf.set(FAULTS_ENABLED.key, False)
+        df.collect(engine="tpu")  # boundary sync disarms (owner conf)
+    assert not faults._ARMED
+    assert plan_on == plan_off
+    assert_bitwise_equal(out_on, out_off)
+    assert pattern_on == pattern_off
+    assert R.retry_stats()["splits"] == 0

@@ -430,15 +430,95 @@ def test_source_lint_materialize_rule_scoped_to_engine_modules():
             lint_source_text(_MATERIALIZE_FIXTURE, path))
 
 
+_SWALLOW_FIXTURE = """
+class FakeExec:
+    def execute(self, batches):
+        for b in batches:
+            try:
+                yield self._process(b)
+            except Exception:
+                pass                         # SRC008: eats OOM too
+
+    def narrow(self, path):
+        try:
+            return open(path)
+        except OSError:
+            return None                      # narrow: not SRC008
+
+    def routed(self, b):
+        from spark_rapids_tpu.execs.retry import classify
+        try:
+            return self._process(b)
+        except Exception as e:
+            if classify(e) == "retryable":
+                return None                  # classified: clean
+            raise
+
+    def reraised(self, b):
+        try:
+            return self._process(b)
+        except BaseException:
+            self.cleanup()
+            raise                            # propagates: clean
+
+    def forwarded(self, q, b):
+        try:
+            return self._process(b)
+        except Exception as e:
+            q.put(e)                         # forwarded: clean
+
+    def logged(self, log, b):
+        try:
+            return self._process(b)
+        except Exception as e:
+            log.warning("failed: %s", e)     # SRC008: logging a
+                                             # swallow is a swallow
+"""
+
+
+def test_source_lint_flags_swallowed_exceptions():
+    """SRC008: a broad except in execs//io//shuffle/ that swallows
+    without routing through retry.classify can eat a retryable device
+    error — the recovery ladder (and chaos-mode fault accounting)
+    never sees it.  Forwarding the exception as a call's SOLE argument
+    is propagation; passing it among other args (logging) is not."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/io/fake.py",
+                 "spark_rapids_tpu/shuffle/fake.py"):
+        diags = lint_source_text(_SWALLOW_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC008"]
+        assert len(hits) == 2, (path, diags)
+        assert all(h.severity == "warning" for h in hits)
+        assert "execute" in hits[0].location
+        assert "logged" in hits[1].location
+    # strict mode (the repo gate) fails on the seeded violation
+    assert evaluate(lint_source_text(
+        _SWALLOW_FIXTURE, "spark_rapids_tpu/execs/fake.py"),
+        strict=True)[2] != 0
+
+
+def test_source_lint_swallow_rule_scoped_and_exempt():
+    """SRC008 does not police modules outside the recovery layers,
+    nor execs/retry.py itself (it IS the classification gate)."""
+    for path in ("spark_rapids_tpu/parallel/fake.py",
+                 "spark_rapids_tpu/ops/fake.py",
+                 "spark_rapids_tpu/execs/retry.py"):
+        assert "SRC008" not in rules(
+            lint_source_text(_SWALLOW_FIXTURE, path)), path
+
+
 def test_repo_baseline_covers_only_intentional_syncs():
     """The checked-in baseline holds exactly the intentional execs/
     base.py syncs (metric settlement + ANSI error poll), the SRC006
     timing-infrastructure sites (MetricTimer + reaper, the coalesce
-    fetch-wait metric, the pipeline wait counters) and the SRC007
+    fetch-wait metric, the pipeline wait counters), the SRC007
     host-conversion infrastructure (metric settlement's np.asarray of
     already-fetched values in execs/base.py, the split-count
-    conversion in ops/partition.py) — nothing may hide behind it
-    silently."""
+    conversion in ops/partition.py) and the SRC008 intentional
+    broad-except sites (the metric reaper's drop-the-sample guards,
+    the fastpar/pa_filter/scan fall-back-to-slow-path bailouts, the
+    shuffle server's bad-request guards and the heartbeat chain's
+    keep-alive swallow) — nothing may hide behind it silently."""
     from spark_rapids_tpu.lint.diagnostic import load_baseline
 
     keys = load_baseline()
@@ -448,6 +528,11 @@ def test_repo_baseline_covers_only_intentional_syncs():
                     "spark_rapids_tpu/parallel/pipeline.py")
     sync_infra = ("spark_rapids_tpu/execs/base.py",
                   "spark_rapids_tpu/ops/partition.py")
+    swallow_infra = ("spark_rapids_tpu/execs/base.py",
+                     "spark_rapids_tpu/io/fastpar.py",
+                     "spark_rapids_tpu/io/pa_filter.py",
+                     "spark_rapids_tpu/io/scan.py",
+                     "spark_rapids_tpu/shuffle/net.py")
     for k in keys:
         if k.startswith("SRC005::"):
             assert k.startswith(
@@ -455,6 +540,9 @@ def test_repo_baseline_covers_only_intentional_syncs():
         elif k.startswith("SRC007::"):
             assert any(k.startswith(f"SRC007::{p}::")
                        for p in sync_infra), k
+        elif k.startswith("SRC008::"):
+            assert any(k.startswith(f"SRC008::{p}::")
+                       for p in swallow_infra), k
         else:
             assert k.startswith("SRC006::"), k
             assert any(k.startswith(f"SRC006::{p}::")

@@ -200,6 +200,48 @@ def test_query_leaves_store_clean():
         conf.set(BATCH_SIZE_ROWS.key, old)
 
 
+def test_spill_never_deletes_shared_dict_sidecar():
+    """gather/compact/split pass the row-invariant dictionary through
+    BY REFERENCE, so sibling batches share ONE device dict array.
+    Spilling one registered sibling must not .delete() the shared
+    dictionary out from under the others (pre-PR6 this crashed with
+    'Array has been deleted' whenever a split/sliced dict-encoded
+    batch spilled under a tight budget — exactly the OOC-under-
+    pressure scenario)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import StringColumn
+
+    schema = T.Schema([T.Field("s", T.STRING)])
+    base = StringColumn(jnp.zeros((16, 4), jnp.uint8),
+                        jnp.zeros(16, jnp.int32),
+                        jnp.ones(16, bool), T.STRING,
+                        codes=jnp.zeros(16, jnp.int32),
+                        dict_chars=jnp.arange(32, dtype=jnp.uint8)
+                        .reshape(8, 4),
+                        dict_lens=jnp.full(8, 4, jnp.uint16),
+                        dict_len=8)
+    b1 = ColumnarBatch([base], 16, schema)
+    # a gathered sibling: fresh per-row arrays, SAME dict arrays
+    sib = ColumnarBatch(
+        [base.gather(jnp.arange(16, dtype=jnp.int32))], 16, schema)
+    assert sib.columns[0].dict_chars is base.dict_chars
+    store = BufferStore(device_budget=1, host_budget=1 << 30)
+    h1 = store.register(b1, SpillPriorities.COALESCE_PENDING)
+    h2 = store.register(sib, SpillPriorities.COALESCE_PENDING)
+    # spill BOTH (registration order spills b1 first): spilling b1
+    # deleted its per-row arrays but must have left the shared
+    # dictionary alive, so spilling + restoring the sibling still works
+    store.spill_all_unpinned()
+    assert h1.tier == StorageTier.HOST and h2.tier == StorageTier.HOST
+    restored = h2.get()
+    assert restored.columns[0].dict_len == 8
+    np.testing.assert_array_equal(
+        np.asarray(restored.columns[0].dict_chars),
+        np.arange(32, dtype=np.uint8).reshape(8, 4))
+    store.close()
+
+
 def test_spill_preserves_dict_len_sidecar():
     """The dictionary entry-count bound (Column/StringColumn.dict_len)
     must survive a spill round trip with the rest of the dict sidecar —

@@ -218,6 +218,265 @@ def test_failed_attempt_leaves_no_partial_blocks():
         conf.set(TASK_MAX_FAILURES.key, old_mf)
 
 
+# ------------------------------------------------------------------ #
+# batch-granular OOM split-and-retry (ISSUE 6 acceptance)
+# ------------------------------------------------------------------ #
+
+
+@pytest.fixture
+def disarm_faults():
+    from spark_rapids_tpu.robustness import faults
+
+    yield faults
+    faults.disarm()
+
+
+def test_bisect_batch_halves_rows_and_strings():
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.execs.retry import bisect_batch
+
+    schema = T.Schema([T.Field("x", T.LONG), T.Field("s", T.STRING)])
+    vals = list(range(1000))
+    strs = [f"s{i}" for i in vals]
+    b = ColumnarBatch.from_numpy(
+        {"x": np.asarray(vals), "s": np.asarray(strs, object)}, schema)
+    first, second = bisect_batch(b)
+    assert first.concrete_num_rows() == 500
+    assert second.concrete_num_rows() == 500
+    got = first.to_pydict()
+    assert got["x"] == vals[:500] and got["s"] == strs[:500]
+    got2 = second.to_pydict()
+    assert got2["x"] == vals[500:] and got2["s"] == strs[500:]
+
+
+def test_with_split_retry_ladder_rungs(disarm_faults):
+    """Rung order: spill+retry at full size first; a second failure
+    bisects; sub-batches recurse; the split counter ticks."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.execs import retry as R
+
+    schema = T.Schema([T.Field("x", T.LONG)])
+    b = ColumnarBatch.from_numpy(
+        {"x": np.arange(4096, dtype=np.int64)}, schema)
+    conf = get_conf()
+    conf.set(R.SPLIT_MIN_ROWS.key, 16)
+    seen = []
+    fails = {"n": 2}  # first two attempts die -> spill rung, then split
+
+    def run(batch):
+        if fails["n"]:
+            fails["n"] -= 1
+            raise FakeDeviceOOM()
+        seen.append(batch.concrete_num_rows())
+        yield batch
+
+    R.reset_retry_stats()
+    out = list(R.with_split_retry(run, b, desc="t"))
+    # the split emits the two 2048-row halves
+    assert seen == [2048, 2048] and len(out) == 2
+    st = R.retry_stats()
+    assert st["spill_retries"] == 1 and st["splits"] == 1
+
+
+def test_with_split_retry_floor_escalates():
+    """At the min-rows floor the ladder re-raises instead of splitting
+    (whole-task retry / CPU fallback take over)."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.execs import retry as R
+
+    schema = T.Schema([T.Field("x", T.LONG)])
+    b = ColumnarBatch.from_numpy(
+        {"x": np.arange(64, dtype=np.int64)}, schema)
+    conf = get_conf()
+    conf.set(R.SPLIT_MIN_ROWS.key, 1024)  # 64 rows is under the floor
+
+    def always(batch):
+        raise FakeDeviceOOM()
+        yield  # pragma: no cover
+
+    with pytest.raises(FakeDeviceOOM):
+        list(R.with_split_retry(always, b, desc="t"))
+
+
+def test_with_split_retry_never_duplicates_streamed_output():
+    """Once a chunk streamed downstream, a re-run would duplicate rows:
+    the ladder must escalate instead of retrying."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.execs import retry as R
+
+    schema = T.Schema([T.Field("x", T.LONG)])
+    b = ColumnarBatch.from_numpy(
+        {"x": np.arange(256, dtype=np.int64)}, schema)
+
+    def yields_then_dies(batch):
+        yield batch
+        raise FakeDeviceOOM()
+
+    got = []
+    with pytest.raises(FakeDeviceOOM):
+        for out in R.with_split_retry(yields_then_dies, b, desc="t"):
+            got.append(out)
+    assert len(got) == 1  # the one real chunk, never re-emitted
+
+
+def test_run_with_oom_retry_restartable_closure():
+    from spark_rapids_tpu.execs import retry as R
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise FakeDeviceOOM()
+        return "ok"
+
+    assert R.run_with_oom_retry(flaky, desc="t") == "ok"
+    with pytest.raises(ValueError):
+        R.run_with_oom_retry(lambda: (_ for _ in ()).throw(
+            ValueError("logic")), desc="t")
+
+
+def test_classify_and_new_markers():
+    from spark_rapids_tpu.execs.retry import classify
+
+    assert classify(RuntimeError("DEADLINE_EXCEEDED: rpc")) \
+        == "retryable"
+    assert classify(RuntimeError("connection reset by peer")) \
+        == "retryable"
+    assert classify(RuntimeError("[Errno 104] ECONNRESET")) \
+        == "retryable"
+    assert classify(ValueError("user bug")) == "fatal"
+
+
+def _split_acceptance(df, want, faults, spec, min_split=32):
+    """Run df under an injected mid-stream RESOURCE_EXHAUSTED schedule:
+    must complete via batch bisection — split counter > 0, zero CPU
+    fallbacks — with speculation and pipelining at their (enabled)
+    defaults."""
+    import warnings
+
+    from spark_rapids_tpu.execs import retry as R
+    from spark_rapids_tpu.parallel.pipeline import stage_depth
+    from spark_rapids_tpu.parallel.speculation import speculation_enabled
+
+    assert stage_depth() > 0 and speculation_enabled()
+    conf = get_conf()
+    conf.set(R.SPLIT_MIN_ROWS.key, min_split)
+    faults.install(spec, forced=True)
+    R.reset_retry_stats()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            got = df.collect(engine="tpu")
+    finally:
+        faults.disarm()
+    st = R.retry_stats()
+    assert st["splits"] > 0, st
+    assert st["cpu_fallbacks"] == 0, st
+    k = lambda tbl: sorted(  # noqa: E731
+        tuple(round(v, 9) if isinstance(v, float) else v for v in row)
+        for row in zip(*tbl.to_pydict().values()))
+    assert k(got) == k(want)
+
+
+def test_join_split_retry_acceptance(disarm_faults):
+    """THE split acceptance: a join stream hit with RESOURCE_EXHAUSTED
+    mid-stream (twice for the same batch, defeating the spill rung)
+    completes via bisection with speculation + pipelining on."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 500)
+    rng = np.random.default_rng(31)
+    facts = pa.table({"k": rng.integers(0, 64, 4000),
+                      "v": rng.random(4000)})
+    dims = pa.table({"k2": np.arange(64), "name": np.arange(64) * 7})
+    s = TpuSession()
+    df = (s.create_dataframe(facts)
+          .join(s.create_dataframe(dims), how="inner",
+                left_on=[col("k")], right_on=[col("k2")]))
+    want = df.collect(engine="cpu")
+    _split_acceptance(df, want, disarm_faults,
+                      "exec.batch:nth=3,times=2")
+
+
+def test_aggregate_split_retry_acceptance(disarm_faults):
+    """Same ladder through the hash aggregate's update stream (driven
+    as one exec so the fault schedule's call numbering is sequential —
+    in a planned query, concurrent guarded loops each absorb injected
+    faults at their own spill rung, which is also correct but does not
+    pin the split rung)."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+    from spark_rapids_tpu.execs import retry as R
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exprs import base as B
+    from spark_rapids_tpu.exprs.aggregates import NamedAgg, Sum
+    from spark_rapids_tpu.plan.planner import collect_exec
+
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 500)
+    conf.set(R.SPLIT_MIN_ROWS.key, 32)
+    rng = np.random.default_rng(33)
+    t = pa.table({"k": rng.integers(0, 16, 4000),
+                  "v": rng.random(4000)})
+    s = TpuSession()
+    want = (s.create_dataframe(t).group_by(col("k"))
+            .agg((sum_(col("v")), "s")).collect(engine="cpu"))
+    keys = [B.BoundReference(0, T.LONG, False, "k")]
+    agg = TpuHashAggregateExec(
+        keys, [NamedAgg(Sum(B.BoundReference(1, T.DOUBLE, False, "v")),
+                        "s")],
+        ArrowSourceExec(t), mode="complete")
+    disarm_faults.install("exec.batch:nth=3,times=2", forced=True)
+    R.reset_retry_stats()
+    try:
+        got = collect_exec(agg)
+    finally:
+        disarm_faults.disarm()
+    st = R.retry_stats()
+    assert st["splits"] > 0, st
+    assert st["cpu_fallbacks"] == 0 and st["task_retries"] == 0, st
+    k = lambda tbl: sorted(  # noqa: E731
+        (r["k"], round(r["s"], 9)) for r in tbl.to_pylist())
+    assert k(got) == k(want)
+
+
+def test_exchange_map_split_retry(disarm_faults):
+    """The exchange map task bisects too: injected OOM mid-map-stage
+    splits the input batch into more (correct) reduce slices instead
+    of burning a whole-task retry."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS
+    from spark_rapids_tpu.execs import retry as R
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exprs import base as B
+    from spark_rapids_tpu.ops.partition import HashPartitioning
+    from spark_rapids_tpu.plan.planner import collect_exec
+
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 500)
+    conf.set(R.SPLIT_MIN_ROWS.key, 32)
+    # one map thread: concurrent map tasks interleave the fault
+    # schedule's call numbering, which makes WHERE the two consecutive
+    # failures land nondeterministic (each lands in a different unit's
+    # spill rung — recovered, but no split to assert on)
+    conf.set("spark.rapids.tpu.sql.taskThreads", 1)
+    t = _table()
+    src = ArrowSourceExec(t)
+    keys = [B.BoundReference(0, T.LONG, False, "k")]
+    ex = TpuShuffleExchangeExec(HashPartitioning(keys, 4), src)
+    disarm_faults.install("exec.batch:nth=3,times=2", forced=True)
+    R.reset_retry_stats()
+    try:
+        got = collect_exec(ex)
+    finally:
+        disarm_faults.disarm()
+    st = R.retry_stats()
+    assert st["splits"] > 0 and st["task_retries"] == 0, st
+    assert got.num_rows == t.num_rows
+    assert sorted(got.column("k").to_pylist()) \
+        == sorted(t.column("k").to_pylist())
+
+
 def test_query_level_cpu_fallback(monkeypatch):
     """Device errors surviving retries degrade collect() to the CPU
     engine (with a warning) instead of failing the query."""
