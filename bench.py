@@ -47,7 +47,14 @@ Attribution fields (so round-over-round deltas are explainable):
   docs/robustness.md) — they record what the recovery ladder absorbed,
   so BENCH_r06+ measures recovery OVERHEAD, not just happy-path speed
   (the correctness gates still run, so a chaos round that survives is
-  a chaos round that answered exactly).
+  a chaos round that answered exactly);
+- a persistent EVENT LOG per round (on by default; `--no-eventlog` to
+  opt out, `--eventlog DIR` / $BENCH_EVENTLOG_DIR to place it): every
+  collect's plan, settled operator metrics and counter deltas, so
+  rounds are diffable offline via
+  `python -m spark_rapids_tpu.tools.history report` instead of
+  hand-diffing these JSON fields (docs/eventlog.md); the file path
+  rides in the output as `eventlog`.
 """
 
 import json
@@ -645,6 +652,25 @@ def _bench_q67(session, d: str) -> dict:
     return out
 
 
+def _eventlog_dir() -> str:
+    """Where this round's event log lands: --eventlog DIR, else
+    $BENCH_EVENTLOG_DIR, else ./bench_eventlog.  On by default so
+    every BENCH round is self-documenting — the per-query records
+    (plan, settled operator metrics, counter deltas) reload via
+    `python -m spark_rapids_tpu.tools.history report` for cross-round
+    regression triage (docs/eventlog.md); --no-eventlog opts out."""
+    argv = sys.argv[1:]
+    if "--eventlog" in argv:
+        i = argv.index("--eventlog")
+        if i + 1 >= len(argv) or argv[i + 1].startswith("-"):
+            # silently falling back would write the round's log
+            # somewhere the operator didn't ask for
+            raise SystemExit(
+                "bench.py: --eventlog requires a directory operand")
+        return argv[i + 1]
+    return os.environ.get("BENCH_EVENTLOG_DIR", "bench_eventlog")
+
+
 def main() -> None:
     global _CHAOS
     if "--chaos" in sys.argv[1:]:
@@ -658,8 +684,14 @@ def main() -> None:
         paths = make_lineitem(d)
         os.makedirs(os.path.join(d, "q1"), exist_ok=True)
 
+        from spark_rapids_tpu.config import get_conf
         from spark_rapids_tpu.session import TpuSession
 
+        ev_dir = None
+        if "--no-eventlog" not in sys.argv[1:]:
+            ev_dir = _eventlog_dir()
+            get_conf().set("spark.rapids.tpu.eventLog.enabled", True)
+            get_conf().set("spark.rapids.tpu.eventLog.dir", ev_dir)
         session = TpuSession()
         df = q6_dataframe(session, paths)
 
@@ -746,6 +778,11 @@ def main() -> None:
 
         out["chaos"] = CHAOS_SPEC
         faults.disarm()
+    if session.event_log_path is not None:
+        # reading events drains the snapshot worker: the log holds
+        # every query of this round before we report its path
+        _ = session.history.events
+        out["eventlog"] = session.event_log_path
     print(json.dumps(out))
 
 

@@ -1,0 +1,457 @@
+"""Persistent event log: the engine's signals, written to disk.
+
+The reference ships a whole ops-tooling layer over PERSISTED event
+logs: ``ProfileMain`` parses them into ``ApplicationInfo``, and
+``CompareApplications`` / ``HealthCheck`` / ``GenerateDot`` operate on
+that model (SURVEY §2.14).  This engine had rich in-process signals —
+PR3 spans, settled operator metrics, speculation/runtime-filter/retry/
+fault counters, jit-cache and spill accounting — but they all
+evaporated at process exit.  This package persists them:
+
+- an append-only JSONL log per session (optionally gzip), one
+  ``header`` record (env/conf/mesh fingerprint) followed by one
+  ``query`` record per TPU collect;
+- each query record carries the annotated lowered plan (lint +
+  runtime-filter sections), the settled per-operator metric tree,
+  span-derived busy/self/overlap when tracing is on, the full counter
+  surface as PER-QUERY deltas, a result digest, and a pointer to an
+  optional sidecar Chrome-trace export;
+- the reader/analysis layer (``ApplicationInfo``, ``compare``,
+  ``health``, ``report``, ``dot``) lives in
+  :mod:`spark_rapids_tpu.tools.history`.
+
+Cost discipline: with ``spark.rapids.tpu.eventLog.enabled=false`` (the
+default) a session holds ``_eventlog = None`` and the only per-query
+cost is one attribute check in ``_collect_tpu`` — no writer thread
+exists (enabled sessions piggyback on the QueryHistory snapshot
+worker, which already settles the metrics the record needs), and
+nothing touches the per-batch hot path either way.  Docs:
+``docs/eventlog.md``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Optional
+
+from spark_rapids_tpu.config import TpuConf, register
+
+EVENTLOG_ENABLED = register(
+    "spark.rapids.tpu.eventLog.enabled", False,
+    "Persist one JSONL event-log record per collected query (header "
+    "with env/conf/mesh fingerprint, then per-query plan + settled "
+    "operator metrics + counter deltas), the input to "
+    "`python -m spark_rapids_tpu.tools.history` "
+    "(ref: spark.eventLog.enabled feeding the profiling tool's "
+    "ApplicationInfo). Off by default: a disabled session performs "
+    "one attribute check per query and starts no writer thread.")
+
+EVENTLOG_DIR = register(
+    "spark.rapids.tpu.eventLog.dir", "/tmp/spark_rapids_tpu_eventlog",
+    "Directory for event-log files (one per session; ref: "
+    "spark.eventLog.dir).")
+
+EVENTLOG_COMPRESS = register(
+    "spark.rapids.tpu.eventLog.compress", False,
+    "Gzip the event-log file (ref: spark.eventLog.compress). Appended "
+    "gzip members stay valid, so incremental per-query writes survive "
+    "a crash mid-run.")
+
+EVENTLOG_TRACE_SIDECAR = register(
+    "spark.rapids.tpu.eventLog.traceSidecar", False,
+    "When tracing is also enabled, export a per-query Chrome-trace "
+    "JSON sidecar next to the event log and record its path in the "
+    "query record (docs/observability.md).")
+
+#: process-unique session-log discriminator (two sessions in one
+#: process must not interleave into one file)
+_SESSION_SEQ = itertools.count()
+
+#: counter keys that are MONOTONIC cumulative process totals — the
+#: writer records per-query deltas of exactly these
+MONOTONIC_COUNTERS = (
+    "jit.hits", "jit.misses",
+    "retry.splits", "retry.spill_retries", "retry.task_retries",
+    "retry.cpu_fallbacks",
+    "faults.injected", "faults.recovered",
+    "rf.filters_built", "rf.build_rows", "rf.build_ms",
+    "rf.pruned_rows", "rf.row_groups_pruned",
+    "speculation.hits", "speculation.overflows", "speculation.synced",
+    "pipeline.readbacks", "pipeline.async_readbacks", "pipeline.items",
+    "spill.device_to_host_bytes", "spill.host_to_disk_bytes",
+)
+
+
+def counters_snapshot() -> dict[str, float]:
+    """One flat snapshot of every process-global cumulative counter the
+    engine exposes (the full counter surface the event log persists).
+    Keys match :data:`MONOTONIC_COUNTERS` plus the two store GAUGES
+    (``store.device_used`` / ``store.host_used``), which are recorded
+    as-is rather than delta'd."""
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.execs.retry import retry_stats
+    from spark_rapids_tpu.memory import get_store
+    from spark_rapids_tpu.parallel import speculation
+    from spark_rapids_tpu.parallel.pipeline import stage_snapshot
+    from spark_rapids_tpu.plan import runtime_filter
+    from spark_rapids_tpu.robustness import faults
+
+    out: dict[str, float] = {}
+    jc = cache_stats()
+    out["jit.hits"] = jc["hits"]
+    out["jit.misses"] = jc["misses"]
+    rs = retry_stats()
+    out["retry.splits"] = rs["splits"]
+    out["retry.spill_retries"] = rs["spill_retries"]
+    out["retry.task_retries"] = rs["task_retries"]
+    out["retry.cpu_fallbacks"] = rs["cpu_fallbacks"]
+    out["faults.injected"] = faults.injected_total()
+    out["faults.recovered"] = faults.recovered_total()
+    rf = runtime_filter.stats()
+    out["rf.filters_built"] = rf["filters_built"]
+    out["rf.build_rows"] = rf["build_rows"]
+    out["rf.build_ms"] = round(rf["build_ms"], 3)
+    out["rf.pruned_rows"] = rf["pruned_rows"]
+    out["rf.row_groups_pruned"] = rf["row_groups_pruned"]
+    sp = speculation.stats()
+    out["speculation.hits"] = sum(s["hits"] for s in sp.values())
+    out["speculation.overflows"] = sum(
+        s["overflows"] for s in sp.values())
+    out["speculation.synced"] = sum(s["synced"] for s in sp.values())
+    st = stage_snapshot()
+    out["pipeline.readbacks"] = sum(s["readbacks"] for s in st.values())
+    out["pipeline.async_readbacks"] = sum(
+        s["async_readbacks"] for s in st.values())
+    out["pipeline.items"] = sum(s["items"] for s in st.values())
+    ss = get_store().spill_stats()
+    out["spill.device_to_host_bytes"] = ss["spilled_device_to_host"]
+    out["spill.host_to_disk_bytes"] = ss["spilled_host_to_disk"]
+    out["store.device_used"] = ss["device_used"]
+    out["store.host_used"] = ss["host_used"]
+    return out
+
+
+def counters_delta(before: dict, after: dict) -> dict[str, float]:
+    """Per-query counter attribution: after - before for the monotonic
+    keys (clamped at 0 — a concurrent ``reset_*`` between the two
+    snapshots must not produce negative activity), gauges verbatim."""
+    out: dict[str, float] = {}
+    for k in MONOTONIC_COUNTERS:
+        d = after.get(k, 0) - before.get(k, 0)
+        out[k] = round(max(0, d), 3) if isinstance(d, float) else max(0, d)
+    for k, v in after.items():
+        if k not in MONOTONIC_COUNTERS:
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------------------ #
+# Fingerprints / hashes
+# ------------------------------------------------------------------ #
+
+_PATH_RE = re.compile(r"(?:/[\w.\-]+){2,}")
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def plan_fingerprint(plan_text: str) -> str:
+    """A stable cross-run identity for a query template: the plan text
+    with run-varying tokens (temp-dir paths, object addresses)
+    normalized away, hashed.  `compare` matches queries across runs by
+    this key, so the same bench query run against two different temp
+    dirs still lines up."""
+    norm = _ADDR_RE.sub("<addr>", plan_text)
+    norm = _PATH_RE.sub("<path>", norm)
+    return hashlib.sha256(norm.encode()).hexdigest()[:16]
+
+
+def conf_fingerprint(conf: TpuConf) -> str:
+    """Hash of the conf's effective values — the conf-epoch key that
+    lets cross-run compares align runs (two runs with different
+    settings are not comparable apples-to-apples)."""
+    payload = json.dumps(
+        sorted((k, str(v)) for k, v in conf._values.items()))
+    return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
+def table_digest(tbl) -> str:
+    """Content digest of an Arrow result table (IPC stream bytes).
+    Chaos-mode acceptance rests on this: a fault-injected run's record
+    must carry the SAME digest as the fault-free run — recovery that
+    changes the answer is not recovery."""
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        for b in tbl.combine_chunks().to_batches():
+            w.write_batch(b)
+    return hashlib.sha256(memoryview(sink.getvalue())).hexdigest()[:16]
+
+
+def env_fingerprint() -> dict:
+    """Host/runtime identity for the header record."""
+    import platform as _plat
+
+    out: dict[str, Any] = {
+        "python": _plat.python_version(),
+        "hostname": _plat.node(),
+        "machine": _plat.machine(),
+    }
+    try:
+        import jax
+
+        out["jax"] = jax.__version__
+        out["devices"] = [
+            {"platform": d.platform,
+             "kind": getattr(d, "device_kind", "")}
+            for d in jax.devices()]
+    except Exception:
+        out["jax"] = None
+        out["devices"] = []
+    return out
+
+
+def mesh_fingerprint() -> Optional[dict]:
+    try:
+        from spark_rapids_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+        if mesh is None:
+            return None
+        return {"axis_names": [str(a) for a in mesh.axis_names],
+                "shape": {str(k): int(v)
+                          for k, v in dict(mesh.shape).items()}}
+    except Exception:
+        return None
+
+
+def render_plan_report(exec_, meta) -> str:
+    """The lowered plan with its static annotation sections (lint
+    findings, pipeline stages, runtime-filter sites) — exactly what
+    ``DataFrame.explain()`` shows, shared so the persisted plan and the
+    in-process view can never drift apart."""
+    out = meta.explain()
+    from spark_rapids_tpu.lint import lint_exec_tree
+
+    diags = lint_exec_tree(exec_)
+    if diags:
+        out += "Lint:\n" + "\n".join(
+            "  " + d.render() for d in diags) + "\n"
+    stages = getattr(exec_, "_pipeline_stages", None)
+    if stages:
+        out += "Pipeline:\n" + "\n".join("  " + s for s in stages) + "\n"
+    from spark_rapids_tpu.plan.runtime_filter import (
+        render_runtime_filters,
+    )
+
+    rf_lines = render_runtime_filters(exec_)
+    if rf_lines:
+        out += "RuntimeFilters:\n" + "\n".join(
+            "  " + s for s in rf_lines) + "\n"
+    return out
+
+
+def _snapshot_to_dict(snap) -> dict:
+    """NodeSnapshot tree -> the schema's operator-node shape."""
+    return {"desc": snap.desc,
+            "metrics": {k: v for k, v in snap.metrics.items()},
+            "children": [_snapshot_to_dict(c) for c in snap.children]}
+
+
+# ------------------------------------------------------------------ #
+# Writer
+# ------------------------------------------------------------------ #
+
+
+class EventLogWriter:
+    """Append-only JSONL event-log writer for one session.
+
+    The file opens lazily on the first record (so a session that never
+    collects writes nothing) and every ``append`` flushes — a crashed
+    run keeps every completed query's record.  Query records are built
+    and appended on the QueryHistory snapshot worker (which already
+    waits for metric settlement), never on collect()'s critical path;
+    the session's only synchronous work is the two
+    :meth:`query_begin` / :meth:`query_end` counter snapshots, which
+    MUST run at the query boundaries (a later reset/disarm would
+    erase the attribution)."""
+
+    def __init__(self, conf: TpuConf):
+        self.directory = str(conf.get(EVENTLOG_DIR))
+        self.compress = bool(conf.get(EVENTLOG_COMPRESS))
+        self.trace_sidecar = bool(conf.get(EVENTLOG_TRACE_SIDECAR))
+        self.session_id = (f"s{os.getpid()}-{int(time.time() * 1e3)}"
+                           f"-{next(_SESSION_SEQ)}")
+        ext = ".jsonl.gz" if self.compress else ".jsonl"
+        self.path = os.path.join(
+            self.directory, f"eventlog-{self.session_id}{ext}")
+        self._conf = conf
+        self._f = None
+        self._wrote_header = False
+        self._mu = threading.Lock()
+
+    # -- low-level ------------------------------------------------- #
+
+    def _write_locked(self, lines: list[str]) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        if self.compress:
+            # one gzip MEMBER per append (open/write/close): the
+            # member trailer lands with every record, so a crashed run
+            # leaves a fully readable file — concatenated members are
+            # valid gzip.  A held-open GzipFile only finalizes at
+            # close, which would make the log unreadable mid-run.
+            import gzip
+
+            with gzip.open(self.path, "at", encoding="utf-8") as f:
+                for line in lines:
+                    f.write(line + "\n")
+            return
+        if self._f is None:
+            self._f = open(self.path, "a", encoding="utf-8")
+        for line in lines:
+            self._f.write(line + "\n")
+        self._f.flush()
+
+    def append(self, rec: dict) -> None:
+        """Validate + write one record (writer-side validation: an
+        invalid record must fail HERE, in the session that can still
+        see the bug, not in a reader weeks later)."""
+        from spark_rapids_tpu.eventlog.schema import validate_record
+
+        validate_record(rec)
+        lines = [json.dumps(rec, default=str)]
+        with self._mu:
+            if not self._wrote_header:
+                # under the same lock so two racing first queries emit
+                # exactly one header, before either record
+                hdr = self._header_record()
+                validate_record(hdr)
+                lines.insert(0, json.dumps(hdr, default=str))
+            self._write_locked(lines)
+            # only after the write SUCCEEDS: a failed first append
+            # must retry the header next time, or the log would carry
+            # query records with no env/conf fingerprint
+            self._wrote_header = True
+
+    def close(self) -> None:
+        with self._mu:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    # -- record builders ------------------------------------------- #
+
+    def _header_record(self) -> dict:
+        from spark_rapids_tpu.eventlog.schema import SCHEMA_VERSION
+
+        conf_values = {k: str(v) for k, v in
+                       sorted(self._conf._values.items())}
+        return {
+            "type": "header",
+            "schema_version": SCHEMA_VERSION,
+            "ts": time.time(),
+            "session": self.session_id,
+            "pid": os.getpid(),
+            "env": env_fingerprint(),
+            "conf": conf_values,
+            "conf_hash": conf_fingerprint(self._conf),
+            "mesh": mesh_fingerprint(),
+        }
+
+    def query_begin(self) -> dict:
+        """Pre-query capture: the counter surface before execution (the
+        record stores per-query deltas)."""
+        return {"counters": counters_snapshot()}
+
+    def query_end(self, pre: dict) -> dict:
+        """End-of-query capture, ON THE CALLING THREAD: counter deltas,
+        the pipeline stage snapshot, and per-site fault stats.  These
+        must be read at query end, not later on the snapshot worker —
+        by then a bench harness may have reset the counters or
+        disarmed the fault schedule, and the record would lie."""
+        from spark_rapids_tpu.robustness import faults
+
+        return {
+            "counters": counters_delta(pre["counters"],
+                                       counters_snapshot()),
+            "pipeline": _pipeline_surface(),
+            "faults": faults.fault_stats() or None,
+        }
+
+    def build_query_record(self, ev, post: dict, plan_text: str,
+                           engine: str,
+                           result_digest: Optional[str] = None,
+                           rows: Optional[int] = None) -> dict:
+        """Build the per-query record from a settled QueryEvent plus
+        the :meth:`query_end` capture (runs on the snapshot worker;
+        `ev.root` metrics are already device-settled there)."""
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.eventlog.schema import SCHEMA_VERSION
+
+        spans = None
+        trace_file = None
+        if _trace.is_enabled():
+            from spark_rapids_tpu.trace.export import (
+                export_chrome_trace,
+                span_stats,
+            )
+
+            events = _trace.snapshot()
+            spans = span_stats(events, query_id=ev.query_id)
+            if self.trace_sidecar:
+                trace_file = os.path.join(
+                    self.directory,
+                    f"{self.session_id}-q{ev.query_id}.trace.json")
+                try:
+                    os.makedirs(self.directory, exist_ok=True)
+                    export_chrome_trace(trace_file, events)
+                except OSError:
+                    trace_file = None
+        return {
+            "type": "query",
+            "schema_version": SCHEMA_VERSION,
+            "query_id": ev.query_id,
+            "plan": plan_text,
+            "plan_hash": plan_fingerprint(plan_text),
+            "engine": engine,
+            "wall_s": ev.wall_s,
+            "start_ts": ev.start_ts,
+            "end_ts": ev.end_ts,
+            "start_ns": ev.start_ns,
+            "end_ns": ev.end_ns,
+            "conf_hash": ev.conf_hash,
+            "counters": post["counters"],
+            "operators": _snapshot_to_dict(ev.root),
+            "spans": spans,
+            "pipeline": post["pipeline"],
+            "faults": post["faults"],
+            "result_digest": result_digest,
+            "rows": rows,
+            "trace_file": trace_file,
+        }
+
+    def log_query(self, ev, post: dict, plan_text: str, engine: str,
+                  result_digest: Optional[str] = None,
+                  rows: Optional[int] = None) -> None:
+        self.append(self.build_query_record(
+            ev, post, plan_text, engine, result_digest, rows))
+
+
+def _pipeline_surface() -> dict:
+    from spark_rapids_tpu.parallel.pipeline import stage_snapshot
+
+    return stage_snapshot()
+
+
+def maybe_writer(conf: TpuConf) -> Optional[EventLogWriter]:
+    """The session hook: a writer when the event log is enabled, else
+    None (and the disabled session's whole per-query cost is the
+    caller's ``is not None`` check)."""
+    if not conf.get(EVENTLOG_ENABLED):
+        return None
+    return EventLogWriter(conf)

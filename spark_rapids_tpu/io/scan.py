@@ -17,7 +17,7 @@ from spark_rapids_tpu import config as _config
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.arrow import from_arrow, schema_to_arrow
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.execs.base import MetricTimer, TpuExec
 
 
 def _conf_batch_rows() -> int:
@@ -737,11 +737,17 @@ class ParquetScanExec(TpuExec):
         empty = True
         for unit in units:
             empty = False
-            if isinstance(unit, int):
-                yield self._count_output(
-                    ColumnarBatch([], unit, self._schema))
-            else:
-                yield self._count_output(self._upload(unit))
+            # scanTime: host-unit -> device-batch (encode + upload
+            # dispatch, settled when the device work completes) — the
+            # reference's GpuScan scan-time metric; the decode wait
+            # ahead of it lives on the scan.decode pipeline stage
+            with MetricTimer(self.metrics["scanTime"],
+                             op=self.name) as t:
+                if isinstance(unit, int):
+                    b = ColumnarBatch([], unit, self._schema)
+                else:
+                    b = t.observe(self._upload(unit))
+            yield self._count_output(b)
         if empty and p == 0:
             aschema = schema_to_arrow(self._schema)
             yield self._count_output(

@@ -1,0 +1,213 @@
+"""Metric-registry consistency checker (MET*).
+
+The event log persists the per-operator metric tree verbatim
+(docs/eventlog.md), and tools/history compares those names across
+runs — so a metric an exec REGISTERS but never settles is a column of
+permanent zeros in every report, and a name settled without
+registration is a KeyError waiting in a rarely-taken branch (metrics
+live in a plain dict populated from ``additional_metrics()``).  Both
+are silent schema rot in the persisted record.
+
+MET001 (error) cross-checks the two sides statically over the exec
+modules (``execs/``, ``io/`` — the layers that define TpuExec
+subclasses):
+
+- every name returned by an ``additional_metrics()`` implementation
+  must be SETTLED somewhere in those modules (referenced as
+  ``<x>.metrics[name]`` — add/add_lazy/MetricTimer all go through the
+  subscript);
+- every constant-keyed ``<x>.metrics[name]`` reference must resolve to
+  a registered name (an ``additional_metrics`` entry, or one of the
+  standard metrics the TpuExec base registers).
+
+Name resolution is syntactic: string literals, plus module-level
+``NAME = "literal"`` constants of any scanned module (the
+``execs/base.py`` standard-name constants resolve this way at every
+import site).  Dynamic keys (``self.metrics[k] = v`` copies) are
+skipped, and a class whose ``additional_metrics`` returns a COMPUTED
+list is exempt on both sides — but only for itself: its registration
+can't be enumerated and its own settle sites may name what that list
+declares; every other class stays fully checked.  The rule is a
+typo/rot catcher, not an alias tracker.  Intentional exceptions are
+baselined, not suppressed inline (the SRC005 posture).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from spark_rapids_tpu.lint.diagnostic import Diagnostic
+
+#: metric names the TpuExec base class registers for every exec
+#: (execs/base.py TpuExec.__init__) — always valid to settle
+BASE_METRICS = {"numOutputRows", "numOutputBatches", "totalTime"}
+
+
+def _module_str_constants(tree: ast.Module) -> dict[str, str]:
+    """Module-level NAME = "literal" assignments (the standard metric
+    name constants and their re-exports)."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve_key(node: ast.expr, consts: dict[str, str]
+                 ) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One module's registrations + settle references."""
+
+    def __init__(self, path: str, consts: dict[str, str]):
+        self.path = path
+        self.consts = consts
+        #: (name, class, line) per additional_metrics entry
+        self.registered: list[tuple[str, str, int]] = []
+        #: (name, qualname, line, owning class|None) per resolvable
+        #: metrics[...] subscript
+        self.used: list[tuple[str, str, int, Optional[str]]] = []
+        #: classes whose additional_metrics we could not fully resolve
+        self.dynamic_classes: set[str] = set()
+        self._cls: list[str] = []
+        self._fn: list[str] = []
+
+    # -- structure ------------------------------------------------- #
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn.append(node.name)
+        if node.name == "additional_metrics" and self._cls:
+            self._collect_registrations(node)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _collect_registrations(self, fn: ast.FunctionDef) -> None:
+        cls = self._cls[-1]
+        for ret in ast.walk(fn):
+            if not isinstance(ret, ast.Return) or ret.value is None:
+                continue
+            if not isinstance(ret.value, (ast.List, ast.Tuple)):
+                # computed list (super() + extras, comprehension):
+                # can't enumerate — exempt this class from the
+                # never-settled side rather than guessing
+                self.dynamic_classes.add(cls)
+                continue
+            for el in ret.value.elts:
+                if isinstance(el, ast.Tuple) and el.elts:
+                    name = _resolve_key(el.elts[0], self.consts)
+                    if name is not None:
+                        self.registered.append(
+                            (name, cls, el.lineno))
+                        continue
+                self.dynamic_classes.add(cls)
+
+    # -- settle references ----------------------------------------- #
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        v = node.value
+        if isinstance(v, ast.Attribute) and v.attr == "metrics":
+            name = _resolve_key(node.slice, self.consts)
+            if name is not None:
+                cls = self._cls[-1] if self._cls else None
+                qual = self._fn[-1] if self._fn else "<module>"
+                if cls:
+                    qual = f"{cls}.{qual}"
+                self.used.append((name, qual, node.lineno, cls))
+        self.generic_visit(node)
+
+
+def _is_metric_module(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "execs" in parts or "io" in parts
+
+
+def check_metric_sources(sources: dict[str, str]) -> list[Diagnostic]:
+    """Cross-check registrations vs settle sites over a set of
+    modules ({relpath: source}); unit-test entry point."""
+    scans: list[_ModuleScan] = []
+    all_consts: dict[str, str] = {}
+    trees: dict[str, ast.Module] = {}
+    for path, src in sources.items():
+        try:
+            trees[path] = ast.parse(src)
+        except SyntaxError:
+            continue  # SRC000's problem, not ours
+        all_consts.update(_module_str_constants(trees[path]))
+    out: list[Diagnostic] = []
+    for path, tree in trees.items():
+        scan = _ModuleScan(path, all_consts)
+        scan.visit(tree)
+        scans.append(scan)
+    registered_names = BASE_METRICS | {
+        n for s in scans for (n, _c, _l) in s.registered}
+    used_names = {n for s in scans for (n, _q, _l, _cls) in s.used}
+    for s in scans:
+        for name, cls, line in s.registered:
+            if name not in used_names:
+                out.append(Diagnostic(
+                    "MET001", "error", f"{s.path}::{cls}",
+                    f"metric {name!r} is registered by "
+                    f"additional_metrics but never settled — it will "
+                    "persist as a permanent zero in every event-log "
+                    "record and report",
+                    hint="settle it via self.metrics[...] "
+                         ".add/.add_lazy/MetricTimer, or drop the "
+                         "registration; baseline only intentional "
+                         "placeholders",
+                    line=line))
+        for name, qual, line, cls in s.used:
+            # a dynamic class may settle names its computed
+            # registration list declares — exempt ITS uses only (a
+            # repo-wide exemption would let one dynamic class turn
+            # the typo catcher off everywhere)
+            if cls is not None and cls in s.dynamic_classes:
+                continue
+            if name not in registered_names:
+                out.append(Diagnostic(
+                    "MET001", "error", f"{s.path}::{qual}",
+                    f"metric {name!r} is settled but registered "
+                    "nowhere — a KeyError in waiting, and a name the "
+                    "persisted metric schema never declares",
+                    hint="add it to the owning exec's "
+                         "additional_metrics() so readers can trust "
+                         "the name set",
+                    line=line))
+    return out
+
+
+def check_metric_registry(root: Optional[str] = None
+                          ) -> list[Diagnostic]:
+    """Run MET001 over the repo's exec modules (execs/, io/)."""
+    from spark_rapids_tpu.lint.source_rules import (
+        _package_root,
+        iter_source_files,
+    )
+
+    root = root or _package_root()
+    base = os.path.dirname(root)
+    sources: dict[str, str] = {}
+    for path in iter_source_files(root):
+        rel = os.path.relpath(path, base)
+        if not _is_metric_module(rel):
+            continue
+        with open(path) as f:
+            sources[rel] = f.read()
+    return check_metric_sources(sources)

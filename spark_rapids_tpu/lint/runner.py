@@ -81,7 +81,7 @@ def _corpus_plans(errors: Optional[list] = None):
 
 
 def run_lint(source: bool = True, registry: bool = True,
-             plans: bool = True,
+             plans: bool = True, metrics: bool = True,
              extra_roots: Sequence = ()) -> list[Diagnostic]:
     """Run the selected analyzers; returns ALL findings (unbaselined)."""
     out: list[Diagnostic] = []
@@ -93,6 +93,14 @@ def run_lint(source: bool = True, registry: bool = True,
         from spark_rapids_tpu.lint.registry import check_registries
 
         out.extend(check_registries())
+    if metrics:
+        # MET001: exec metric registrations vs settle sites — the
+        # names the event log persists must stay trustworthy
+        from spark_rapids_tpu.lint.metric_rules import (
+            check_metric_registry,
+        )
+
+        out.extend(check_metric_registry())
     roots = list(extra_roots)
     if plans:
         roots.extend(_corpus_plans(errors=out))

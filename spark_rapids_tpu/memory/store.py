@@ -353,6 +353,20 @@ class BufferStore:
         self.spilled_device_to_host = 0
         self.spilled_host_to_disk = 0
 
+    def spill_stats(self) -> dict[str, int]:
+        """Point-in-time spill/occupancy accounting — the store's
+        contribution to the event log's counter surface (the two
+        ``spilled_*`` totals are monotonic; the ``*_used`` figures are
+        gauges).  One locked read so the four values are mutually
+        consistent."""
+        with self._lock:
+            return {
+                "device_used": self.device_used,
+                "host_used": self.host_used,
+                "spilled_device_to_host": self.spilled_device_to_host,
+                "spilled_host_to_disk": self.spilled_host_to_disk,
+            }
+
     # -- registration --------------------------------------------------- #
 
     def register(self, batch: ColumnarBatch,

@@ -303,6 +303,7 @@ class TpuSession:
     (ref: SQLPlugin.scala — here session == plugin)."""
 
     def __init__(self, conf: Optional[TpuConf] = None):
+        from spark_rapids_tpu.eventlog import maybe_writer
         from spark_rapids_tpu.tools.profiling import (
             HISTORY_CAPACITY,
             QueryHistory,
@@ -312,6 +313,20 @@ class TpuSession:
         #: recent TPU-collected queries, input to the profiling tool
         self.history = QueryHistory(
             int(self.conf.get(HISTORY_CAPACITY)))
+        #: persistent event-log writer, or None when
+        #: spark.rapids.tpu.eventLog.enabled=false — the disabled
+        #: path's entire per-query cost is one `is not None` check in
+        #: _collect_tpu (docs/eventlog.md)
+        self._eventlog = maybe_writer(self.conf)
+
+    @property
+    def event_log_path(self) -> Optional[str]:
+        """Path of this session's event-log file (None when the event
+        log is disabled).  Records are appended by the history snapshot
+        worker; reading ``session.history.events`` drains it, so the
+        file is complete afterwards."""
+        return self._eventlog.path if self._eventlog is not None \
+            else None
 
     def export_trace(self, path: str) -> str:
         """Write the process's collected engine trace as Chrome Trace
@@ -926,8 +941,40 @@ class DataFrame:
         from spark_rapids_tpu.robustness import faults as _faults
 
         _faults.sync_conf(conf)
+        from spark_rapids_tpu.eventlog import (
+            conf_fingerprint,
+            render_plan_report,
+            table_digest,
+        )
+
         qid = self._session.history.allocate_id()
+        # THE per-query event-log check: None when disabled (no writer
+        # thread, no conf lookup, nothing on the batch loop)
+        elog = self._session._eventlog
+        pre = elog.query_begin() if elog is not None else None
+        conf_hash = conf_fingerprint(conf)
+        start_ts = _time.time()
         t0 = _time.perf_counter()
+        t0_ns = _time.perf_counter_ns()
+
+        def _on_event(render_plan, engine: str, result):
+            """History-worker hook appending the event-log record once
+            metrics have settled (None when the log is disabled).
+            Counter/pipeline/fault capture happens HERE, at query end
+            on the calling thread — a later reset/disarm (bench
+            between queries, tests tearing down chaos) must not erase
+            this query's attribution.  The result digest and the
+            annotated-plan render are deferred to the worker: both
+            read immutable state, and neither belongs on collect()'s
+            critical path."""
+            if elog is None:
+                return None
+            post = elog.query_end(pre)
+            return lambda ev: elog.log_query(
+                ev, post, render_plan(), engine,
+                result_digest=table_digest(result),
+                rows=result.num_rows)
+
         with _trace.trace_context(query_id=qid):
             with _trace.span("query.plan"):
                 exec_, meta = plan_query(self._plan, conf)
@@ -956,15 +1003,27 @@ class DataFrame:
                 out = execute_cpu(self._plan)
                 _retry.note_cpu_fallback(e)
                 # degraded queries are the ones operators most need to
-                # see in the history
+                # see in the history (and the event log: the health
+                # checker's CPU-fallback rule keys off this record)
+                expl = (meta.explain() + "\n[degraded to CPU engine: "
+                        f"{type(e).__name__}]")
                 self._session.history.record(
-                    meta.explain() + "\n[degraded to CPU engine: "
-                    f"{type(e).__name__}]",
-                    exec_, _time.perf_counter() - t0, query_id=qid)
+                    expl, exec_, _time.perf_counter() - t0,
+                    query_id=qid, start_ts=start_ts,
+                    end_ts=_time.time(), start_ns=t0_ns,
+                    end_ns=_time.perf_counter_ns(),
+                    conf_hash=conf_hash,
+                    on_event=_on_event(lambda: expl, "cpu_fallback",
+                                       out))
                 return out, qid
             self._session.history.record(
                 meta.explain(), exec_, _time.perf_counter() - t0,
-                query_id=qid)
+                query_id=qid, start_ts=start_ts, end_ts=_time.time(),
+                start_ns=t0_ns, end_ns=_time.perf_counter_ns(),
+                conf_hash=conf_hash,
+                on_event=_on_event(
+                    lambda: render_plan_report(exec_, meta), "tpu",
+                    out))
         return out, qid
 
     def to_batches(self, batch_rows: Optional[int] = None):
@@ -993,16 +1052,32 @@ class DataFrame:
         if mode.lower() == "analyze":
             from spark_rapids_tpu import trace as _trace
             from spark_rapids_tpu.execs.jit_cache import cache_stats
+            from spark_rapids_tpu.execs.retry import retry_stats
+            from spark_rapids_tpu.plan import runtime_filter as _rf
+            from spark_rapids_tpu.robustness import faults as _faults
             from spark_rapids_tpu.tools.profiling import render_analyze
 
             before = cache_stats()
+            retry0 = retry_stats()
+            faults0 = _faults.recovered_total()
+            rf0 = _rf.stats()
             _out, qid = self._collect_tpu()
             after = cache_stats()
-            # per-QUERY compile-cache delta (counters are process-wide
-            # cumulative; concurrent collects can bleed into the diff,
-            # which is fine for a diagnostics line)
+            # per-QUERY deltas (counters are process-wide cumulative;
+            # concurrent collects can bleed into the diff, which is
+            # fine for a diagnostics footer) — the same counter
+            # surface the event log persists per query
             cs = {"hits": after["hits"] - before["hits"],
                   "misses": after["misses"] - before["misses"]}
+            retry1 = retry_stats()
+            rf1 = _rf.stats()
+            counters = {
+                "retry": {k: max(0, retry1[k] - retry0[k])
+                          for k in retry1},
+                "faults_recovered": max(
+                    0, _faults.recovered_total() - faults0),
+                "rf": {k: max(0, rf1[k] - rf0[k]) for k in rf1},
+            }
             # find OUR event by id — events[-1] may be a concurrent
             # collect's record (fall back to it only if concurrent
             # collects evicted ours from a tiny history ring)
@@ -1010,35 +1085,16 @@ class DataFrame:
             ev = next((e for e in reversed(events_)
                        if e.query_id == qid), events_[-1])
             events = _trace.snapshot() if _trace.is_enabled() else None
-            return render_analyze(ev, events, cache_stats=cs)
+            return render_analyze(ev, events, cache_stats=cs,
+                                  counters=counters)
         exec_, meta = plan_query(self._plan, self._session.conf)
-        out = meta.explain()
-        # static-analysis findings over the lowered physical plan
-        # (tpulint dtype-flow + plan anti-patterns; docs/lint.md)
-        from spark_rapids_tpu.lint import lint_exec_tree
+        # the lowered plan + its static annotation sections (lint
+        # findings, pipeline stages, runtime-filter sites) — shared
+        # with the event-log writer so the persisted plan matches this
+        # in-process view exactly (docs/eventlog.md)
+        from spark_rapids_tpu.eventlog import render_plan_report
 
-        diags = lint_exec_tree(exec_)
-        if diags:
-            out += "Lint:\n" + "\n".join(
-                "  " + d.render() for d in diags) + "\n"
-        # where the planner inserted software-pipeline stages
-        # (spark.rapids.tpu.sql.pipeline.*; docs/pipeline.md)
-        stages = getattr(exec_, "_pipeline_stages", None)
-        if stages:
-            out += "Pipeline:\n" + "\n".join(
-                "  " + s for s in stages) + "\n"
-        # runtime join filters: build sites + probe-scan application
-        # points (spark.rapids.tpu.sql.runtimeFilter.*;
-        # docs/runtime_filters.md)
-        from spark_rapids_tpu.plan.runtime_filter import (
-            render_runtime_filters,
-        )
-
-        rf_lines = render_runtime_filters(exec_)
-        if rf_lines:
-            out += "RuntimeFilters:\n" + "\n".join(
-                "  " + s for s in rf_lines) + "\n"
-        return out
+        return render_plan_report(exec_, meta)
 
     def __repr__(self) -> str:
         return f"DataFrame[{self.schema}]"

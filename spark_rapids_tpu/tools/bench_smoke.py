@@ -15,6 +15,12 @@ identical rows with `runtimeFilter.enabled` on and off, AND must have
 actually pruned probe rows when on (tier-1 via
 tests/test_runtime_filter.py).
 
+`run_eventlog_smoke` holds the persistence contract for the event log
+(spark_rapids_tpu/eventlog/): a query collected with
+`eventLog.enabled` must reload through tools/history with per-operator
+metrics identical to the session's settled QueryHistory snapshot
+(tier-1 via tests/test_eventlog.py).
+
 Run: python -m spark_rapids_tpu.tools.bench_smoke
 """
 
@@ -162,6 +168,72 @@ def run_rf_smoke() -> dict:
     return out
 
 
+def run_eventlog_smoke() -> dict:
+    """Event-log acceptance contract, cheap CI form (tier-1 via
+    tests/test_eventlog.py): a tiny grouped aggregate collected with
+    ``spark.rapids.tpu.eventLog.enabled`` must produce a log that
+    reloads through tools/history into an ApplicationInfo whose
+    per-operator metric tree EQUALS the session's settled QueryHistory
+    snapshot — what the file says must be what the process measured."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+    from spark_rapids_tpu.tools.history import load_application
+
+    conf = get_conf()
+    keys = ("spark.rapids.tpu.eventLog.enabled",
+            "spark.rapids.tpu.eventLog.dir")
+    saved = {k: conf.get(k) for k in keys}
+    out: dict = {}
+    with tempfile.TemporaryDirectory(prefix="eventlog_smoke_") as d:
+        try:
+            conf.set(keys[0], True)
+            conf.set(keys[1], os.path.join(d, "log"))
+            session = TpuSession()
+            rng = np.random.default_rng(0xE7)
+            n = 2048
+            t = pa.table({
+                "k": rng.integers(0, 32, n).astype(np.int64),
+                "v": rng.random(n),
+            })
+            df = (session.create_dataframe(t)
+                  .group_by(col("k"))
+                  .agg((sum_(col("v")), "sv")))
+            result = df.collect(engine="tpu")
+            # reading events DRAINS the snapshot worker, which also
+            # appends the event-log record — the file is complete now
+            ev = session.history.events[-1]
+            app = load_application(session.event_log_path)
+            assert app.header, "event log is missing its header record"
+            assert len(app.queries) == 1, len(app.queries)
+            q = app.queries[0]
+            assert q.query_id == ev.query_id, (q.query_id, ev.query_id)
+            assert q.rows == result.num_rows, (q.rows, result.num_rows)
+            assert q.conf_hash == ev.conf_hash and q.conf_hash
+
+            def check(node, snap):
+                assert node.desc == snap.desc, (node.desc, snap.desc)
+                assert node.metrics == snap.metrics, \
+                    (node.desc, node.metrics, snap.metrics)
+                assert len(node.children) == len(snap.children)
+                for c, sc in zip(node.children, snap.children):
+                    check(c, sc)
+
+            check(q.operators, ev.root)
+            out["eventlog"] = q.rows
+            out["eventlog_operators"] = sum(
+                1 for _ in q.operators.walk())
+        finally:
+            for k, v in saved.items():
+                conf.set(k, v)
+    return out
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -202,6 +274,7 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
     results = run_smoke()
     results.update(run_rf_smoke())
+    results.update(run_eventlog_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

@@ -1,0 +1,700 @@
+"""Cross-run query-history analysis over persisted event logs.
+
+TPU analog of the reference profiling tool's offline side
+(tools/.../profiling/ProfileMain.scala): ``ApplicationInfo`` loads one
+run's event log (spark_rapids_tpu/eventlog/) into a typed model, and
+four analyses operate on one or many of them:
+
+- ``compare``  — CompareApplications: per-query wall-clock and
+  per-operator deltas across runs, with a configurable regression
+  threshold.  Queries match across runs by *plan fingerprint*
+  (normalized-plan hash), so the same query template lines up even
+  when query ids and temp paths differ.  Committed ``BENCH_r0*.json``
+  round artifacts load as pseudo-applications, so the whole perf
+  trajectory is diffable with one command.
+- ``health``   — HealthCheck: a rule registry flagging unhealthy runs
+  (CPU fallbacks, retry storms, spill thrash, jit-cache miss-budget
+  blowouts, steady-state blocking readbacks, starved pipelines,
+  runtime filters that pruned nothing).
+- ``report``   — the fleet-style regression report: one markdown
+  document with run fingerprints, the compare matrix, and per-run
+  health findings.
+- ``dot``      — GenerateDot: the recorded plan as annotated graphviz.
+
+CLI::
+
+    python -m spark_rapids_tpu.tools.history compare  LOG LOG... \
+        [--threshold 1.25] [--json] [-o FILE]
+    python -m spark_rapids_tpu.tools.history health   LOG... [--json]
+    python -m spark_rapids_tpu.tools.history report   LOG LOG... \
+        [--threshold 1.25] [-o FILE]
+    python -m spark_rapids_tpu.tools.history dot      LOG \
+        [--query ID] [-o FILE]
+
+Docs: docs/eventlog.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Optional, Sequence
+
+# -- thresholds (health-rule defaults; compare takes --threshold) ----- #
+
+#: wall-clock ratio at/above which compare flags a per-query regression
+DEFAULT_REGRESSION_THRESHOLD = 1.25
+#: ladder activity per query that reads as a retry STORM, not a blip
+RETRY_STORM_FLOOR = 3
+#: per-query device->host spill volume that reads as thrash
+SPILL_THRASH_BYTES = 32 << 20
+#: per-query compile-cache miss budget (a steady-state query should
+#: re-use programs; sustained misses mean shape-bucketing is broken)
+JIT_MISS_BUDGET = 16
+#: per-query blocking-readback budget (speculative sizing exists to
+#: drive the STEADY-STATE count to ~0; warm-up syncs, sort sample
+#: fetches and the final result fetch are legitimate, hence the slack)
+BLOCKING_READBACK_BUDGET = 32
+#: pipeline occupancy below this, with real traffic, means stages ran
+#: starved/serial (the items floor keeps tiny unit-test-sized queries
+#: from reading as starvation)
+OCCUPANCY_FLOOR = 0.05
+OCCUPANCY_MIN_ITEMS = 32
+
+
+# ------------------------------------------------------------------ #
+# Model (the ApplicationInfo analog)
+# ------------------------------------------------------------------ #
+
+
+@dataclasses.dataclass
+class OpNode:
+    """One recorded operator: desc + settled metrics."""
+
+    desc: str
+    metrics: dict
+    children: list
+
+    @property
+    def op(self) -> str:
+        return self.desc.split(" ", 1)[0].split("[", 1)[0]
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+@dataclasses.dataclass
+class QueryRecord:
+    """One collected query, loaded from a log record."""
+
+    query_id: object
+    plan: str
+    plan_hash: str
+    engine: str
+    wall_s: float
+    start_ts: float
+    end_ts: float
+    conf_hash: str
+    counters: dict
+    operators: Optional[OpNode]
+    spans: Optional[dict]
+    pipeline: Optional[dict]
+    faults: Optional[dict]
+    result_digest: Optional[str]
+    rows: Optional[int]
+    raw: dict
+
+    def counter(self, key: str, default: float = 0) -> float:
+        return self.counters.get(key, default) or 0
+
+    def occupancy(self) -> Optional[float]:
+        """Item-weighted pipeline occupancy (bench.py's formula), or
+        None when the record carries no pipeline surface."""
+        if not self.pipeline:
+            return None
+        weighted = items = 0.0
+        for s in self.pipeline.values():
+            n = s.get("items", 0)
+            if n:
+                weighted += s.get("occupancy_fraction", 0.0) * n
+                items += n
+        return round(weighted / items, 3) if items else None
+
+
+@dataclasses.dataclass
+class ApplicationInfo:
+    """One run: header fingerprint + its query records."""
+
+    path: str
+    kind: str  # "eventlog" | "bench"
+    header: dict
+    queries: list
+
+    @property
+    def label(self) -> str:
+        return os.path.basename(self.path)
+
+    @property
+    def conf_hash(self) -> str:
+        return self.header.get("conf_hash", "")
+
+    def by_plan(self) -> dict[str, list]:
+        out: dict[str, list] = {}
+        for q in self.queries:
+            out.setdefault(q.plan_hash, []).append(q)
+        return out
+
+
+def _op_from_dict(d: Optional[dict]) -> Optional[OpNode]:
+    if not d:
+        return None
+    return OpNode(d.get("desc", "?"), dict(d.get("metrics", {})),
+                  [_op_from_dict(c) for c in d.get("children", [])])
+
+
+def _query_from_record(rec: dict) -> QueryRecord:
+    return QueryRecord(
+        query_id=rec.get("query_id"),
+        plan=rec.get("plan", ""),
+        plan_hash=rec.get("plan_hash", ""),
+        engine=rec.get("engine", "tpu"),
+        wall_s=float(rec.get("wall_s", 0.0)),
+        start_ts=float(rec.get("start_ts", 0.0)),
+        end_ts=float(rec.get("end_ts", 0.0)),
+        conf_hash=rec.get("conf_hash", ""),
+        counters=dict(rec.get("counters", {}) or {}),
+        operators=_op_from_dict(rec.get("operators")),
+        spans=rec.get("spans"),
+        pipeline=rec.get("pipeline"),
+        faults=rec.get("faults"),
+        result_digest=rec.get("result_digest"),
+        rows=rec.get("rows"),
+        raw=rec,
+    )
+
+
+# ------------------------------------------------------------------ #
+# Loading (event logs + committed bench rounds)
+# ------------------------------------------------------------------ #
+
+#: bench queries a BENCH_r0*.json round reports, with their wall field
+_BENCH_QUERIES = (("q6", "tpu_s_per_query"),
+                  ("q1", "q1_tpu_s_per_query"),
+                  ("q3", "q3_tpu_s_per_query"),
+                  ("q67", "q67_tpu_s_per_query"))
+
+
+def load_bench_round(path: str) -> ApplicationInfo:
+    """Adapt one committed BENCH_rNN.json round artifact into a
+    pseudo-application: one QueryRecord per benchmark query (q6/q1/
+    q3/q67) keyed ``bench:<q>`` so rounds line up with each other (and
+    never accidentally with real event-log queries)."""
+    with open(path) as f:
+        data = json.load(f)
+    # rounds are stored as the driver's wrapper {"tail": "...json..."}
+    # OR as the bare bench.py output line
+    if "metric" not in data and isinstance(data.get("tail"), str):
+        for line in reversed(data["tail"].splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                data = json.loads(line)
+                break
+    queries = []
+    for q, wall_field in _BENCH_QUERIES:
+        wall = data.get(wall_field)
+        if wall is None:
+            continue
+        counters = {
+            "retry.splits": data.get(f"{q}_retry_splits", 0),
+            "retry.cpu_fallbacks": 0,
+            "faults.recovered": data.get(f"{q}_recovered_faults", 0),
+            "spill.device_to_host_bytes":
+                data.get(f"{q}_spills_under_pressure", 0),
+            "pipeline.readbacks": data.get(f"{q}_host_sync_count", 0),
+        }
+        queries.append(QueryRecord(
+            query_id=q, plan=f"bench:{q}", plan_hash=f"bench:{q}",
+            engine="tpu", wall_s=float(wall),
+            start_ts=0.0, end_ts=0.0, conf_hash="",
+            counters=counters, operators=None, spans=None,
+            pipeline=None, faults=None, result_digest=None,
+            rows=data.get(f"{q}_rows") or data.get("rows"),
+            raw={k: v for k, v in data.items()
+                 if k == "metric" or k.startswith(q)}))
+    header = {"session": os.path.basename(path), "conf_hash": "",
+              "env": {"link_rtt_ms_median":
+                      data.get("link_rtt_ms_median"),
+                      "link_upload_mb_s": data.get("link_upload_mb_s")}}
+    return ApplicationInfo(path, "bench", header, queries)
+
+
+def load_application(path: str) -> ApplicationInfo:
+    """Load one run: an event log (.jsonl[.gz]) or a committed bench
+    round JSON (detected by content, not extension)."""
+    from spark_rapids_tpu.eventlog.reader import read_log
+
+    if not path.endswith(".gz"):
+        try:
+            with open(path) as f:
+                head = f.read(1 << 16).lstrip()
+            if head.startswith("{") and ("\"metric\"" in head
+                                         or "\"tail\"" in head):
+                return load_bench_round(path)
+        except UnicodeDecodeError:
+            pass
+    header, recs = read_log(path)
+    return ApplicationInfo(path, "eventlog", header or {},
+                           [_query_from_record(r) for r in recs])
+
+
+# ------------------------------------------------------------------ #
+# compare (the CompareApplications analog)
+# ------------------------------------------------------------------ #
+
+
+def _median_query(qs: Sequence[QueryRecord]) -> QueryRecord:
+    """Representative record for repeated runs of one plan: the one
+    with the median wall clock (a real record, so operator trees and
+    counters stay attached)."""
+    qs = sorted(qs, key=lambda q: q.wall_s)
+    return qs[len(qs) // 2]
+
+
+def _query_label(q: QueryRecord) -> str:
+    if isinstance(q.query_id, str):
+        return q.query_id
+    root = q.operators.desc if q.operators else ""
+    return f"q{q.query_id} [{root[:40]}]" if root \
+        else f"q{q.query_id}"
+
+
+def _operator_deltas(base: OpNode, run: OpNode,
+                     threshold: float) -> list[dict]:
+    """Positional walk of two recorded operator trees (same plan hash
+    => same shape; a mismatch just truncates), reporting per-operator
+    totalTime ratios past the threshold."""
+    out: list[dict] = []
+
+    def walk(a: Optional[OpNode], b: Optional[OpNode]) -> None:
+        if a is None or b is None or a.op != b.op:
+            return
+        ta = a.metrics.get("totalTime") or 0
+        tb = b.metrics.get("totalTime") or 0
+        if ta >= 1e6 and tb >= 1e6:  # ignore sub-ms noise
+            ratio = tb / ta
+            if ratio >= threshold or ratio <= 1.0 / threshold:
+                out.append({
+                    "operator": a.desc[:60],
+                    "base_ms": round(ta / 1e6, 2),
+                    "run_ms": round(tb / 1e6, 2),
+                    "ratio": round(ratio, 3),
+                })
+        for ca, cb in zip(a.children, b.children):
+            walk(ca, cb)
+
+    walk(base, run)
+    return sorted(out, key=lambda d: -d["ratio"])
+
+
+def compare_applications(apps: Sequence[ApplicationInfo],
+                         threshold: float =
+                         DEFAULT_REGRESSION_THRESHOLD) -> dict:
+    """Per-query wall-clock (and per-operator) deltas of every app
+    against the FIRST (the baseline).  Queries match by plan
+    fingerprint; repeated collects of one plan collapse to the
+    median-wall record.  Returns a JSON-able result dict."""
+    assert len(apps) >= 2, "compare needs a baseline and 1+ runs"
+    base = apps[0]
+    base_by_plan = {h: _median_query(qs)
+                    for h, qs in base.by_plan().items()}
+    rows: list[dict] = []
+    regressions: list[dict] = []
+    unmatched: list[dict] = []
+    for app in apps[1:]:
+        for h, qs in app.by_plan().items():
+            rq = _median_query(qs)
+            bq = base_by_plan.get(h)
+            if bq is None or bq.wall_s <= 0:
+                unmatched.append({"run": app.label,
+                                  "query": _query_label(rq),
+                                  "plan_hash": h,
+                                  "wall_s": round(rq.wall_s, 4)})
+                continue
+            ratio = rq.wall_s / bq.wall_s
+            flag = ("regression" if ratio >= threshold
+                    else "improvement" if ratio <= 1.0 / threshold
+                    else "ok")
+            row = {
+                "run": app.label,
+                "query": _query_label(rq),
+                "plan_hash": h,
+                "base_wall_s": round(bq.wall_s, 4),
+                "wall_s": round(rq.wall_s, 4),
+                "ratio": round(ratio, 3),
+                "flag": flag,
+                "conf_changed": (bq.conf_hash != rq.conf_hash
+                                 and bool(bq.conf_hash)
+                                 and bool(rq.conf_hash)),
+            }
+            if bq.operators and rq.operators:
+                row["operator_deltas"] = _operator_deltas(
+                    bq.operators, rq.operators, threshold)
+            rows.append(row)
+            if flag == "regression":
+                regressions.append(row)
+        seen = set(app.by_plan())
+        for h, bq in base_by_plan.items():
+            if h not in seen:
+                unmatched.append({"run": base.label,
+                                  "query": _query_label(bq),
+                                  "plan_hash": h,
+                                  "wall_s": round(bq.wall_s, 4),
+                                  "missing_in": app.label})
+    return {"baseline": base.label, "threshold": threshold,
+            "rows": rows, "regressions": regressions,
+            "unmatched": unmatched}
+
+
+# ------------------------------------------------------------------ #
+# health (the HealthCheck analog)
+# ------------------------------------------------------------------ #
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthFinding:
+    rule: str
+    severity: str  # "info" | "warning" | "error"
+    query: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.severity:7s} {self.rule} {self.query} — " \
+               f"{self.message}"
+
+
+#: rule registry: (rule_id, severity, check(QueryRecord) -> msg|None).
+#: Register additional rules with :func:`register_health_rule`.
+HEALTH_RULES: list[tuple[str, str,
+                         Callable[[QueryRecord], Optional[str]]]] = []
+
+
+def register_health_rule(rule_id: str, severity: str,
+                         check: Callable[[QueryRecord], Optional[str]]
+                         ) -> None:
+    HEALTH_RULES.append((rule_id, severity, check))
+
+
+def _hc_cpu_fallback(q: QueryRecord) -> Optional[str]:
+    # engine + plan marker only: retry.cpu_fallbacks is a
+    # process-global delta, and a CONCURRENT session's fallback
+    # bleeding into this query's window must not flag a healthy run
+    if q.engine != "tpu" or "[degraded to CPU engine" in q.plan:
+        return ("query degraded to the CPU engine — the last ladder "
+                "rung fired (docs/robustness.md)")
+    return None
+
+
+def _hc_retry_storm(q: QueryRecord) -> Optional[str]:
+    n = q.counter("retry.splits") + q.counter("retry.task_retries")
+    if n >= RETRY_STORM_FLOOR:
+        return (f"retry storm: {int(q.counter('retry.splits'))} splits"
+                f" + {int(q.counter('retry.task_retries'))} task "
+                f"retries in one query (floor {RETRY_STORM_FLOOR}) — "
+                "the device budget is undersized for this plan")
+    return None
+
+
+def _hc_spill_thrash(q: QueryRecord) -> Optional[str]:
+    b = q.counter("spill.device_to_host_bytes")
+    if b >= SPILL_THRASH_BYTES:
+        disk = q.counter("spill.host_to_disk_bytes")
+        msg = (f"spill thrash: {int(b)} device->host bytes in one "
+               f"query (floor {SPILL_THRASH_BYTES})")
+        if disk:
+            msg += f", {int(disk)} of it on to disk"
+        return msg
+    return None
+
+
+def _hc_jit_blowout(q: QueryRecord) -> Optional[str]:
+    m = q.counter("jit.misses")
+    if m > JIT_MISS_BUDGET:
+        return (f"jit-cache miss budget blown: {int(m)} compiles in "
+                f"one query (budget {JIT_MISS_BUDGET}) — shape "
+                "bucketing / fuse keys are not stabilizing")
+    return None
+
+
+def _hc_blocking_readbacks(q: QueryRecord) -> Optional[str]:
+    r = q.counter("pipeline.readbacks")
+    if r > BLOCKING_READBACK_BUDGET:
+        return (f"{int(r)} blocking device->host readbacks (budget "
+                f"{BLOCKING_READBACK_BUDGET}) — speculative sizing is "
+                "not engaging (docs/speculation.md)")
+    return None
+
+
+def _hc_starved_pipeline(q: QueryRecord) -> Optional[str]:
+    occ = q.occupancy()
+    if occ is None or not q.pipeline:
+        return None
+    items = sum(s.get("items", 0) for s in q.pipeline.values())
+    if items >= OCCUPANCY_MIN_ITEMS and occ < OCCUPANCY_FLOOR:
+        return (f"pipeline occupancy {occ} over {items} items — "
+                "stages ran starved/serial (docs/pipeline.md)")
+    return None
+
+
+def _hc_rf_no_prune(q: QueryRecord) -> Optional[str]:
+    if q.counter("rf.filters_built") > 0 \
+            and q.counter("rf.pruned_rows") == 0 \
+            and q.counter("rf.row_groups_pruned") == 0:
+        return ("runtime filter built but pruned nothing — build cost "
+                "paid for zero wire savings (docs/runtime_filters.md)")
+    return None
+
+
+def _hc_recovered_faults(q: QueryRecord) -> Optional[str]:
+    n = q.counter("faults.recovered")
+    if n > 0:
+        return (f"{int(n)} injected fault(s) recovered in this query "
+                "(chaos mode)")
+    return None
+
+
+for _id, _sev, _fn in (
+        ("HC001", "error", _hc_cpu_fallback),
+        ("HC002", "warning", _hc_retry_storm),
+        ("HC003", "warning", _hc_spill_thrash),
+        ("HC004", "warning", _hc_jit_blowout),
+        ("HC005", "warning", _hc_blocking_readbacks),
+        ("HC006", "warning", _hc_starved_pipeline),
+        ("HC007", "warning", _hc_rf_no_prune),
+        ("HC008", "info", _hc_recovered_faults)):
+    register_health_rule(_id, _sev, _fn)
+
+
+def health_check(app: ApplicationInfo) -> list[HealthFinding]:
+    """Run every registered rule over every query of one run."""
+    out: list[HealthFinding] = []
+    for q in app.queries:
+        for rule_id, severity, check in HEALTH_RULES:
+            msg = check(q)
+            if msg is not None:
+                out.append(HealthFinding(rule_id, severity,
+                                         _query_label(q), msg))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# report (the fleet-style regression report)
+# ------------------------------------------------------------------ #
+
+
+def _fmt_ratio(row: dict) -> str:
+    mark = {"regression": " ⚠ REGRESSION", "improvement": " ✓",
+            "ok": ""}[row["flag"]]
+    extra = " (conf changed)" if row.get("conf_changed") else ""
+    return f"{row['ratio']:.3f}x{mark}{extra}"
+
+
+def render_compare_md(result: dict) -> str:
+    lines = [
+        f"## Compare (baseline: {result['baseline']}, "
+        f"threshold {result['threshold']}x)",
+        "",
+        "| run | query | base_s | run_s | ratio |",
+        "|---|---|---|---|---|",
+    ]
+    for row in result["rows"]:
+        lines.append(
+            f"| {row['run']} | {row['query']} | {row['base_wall_s']} "
+            f"| {row['wall_s']} | {_fmt_ratio(row)} |")
+    for row in result["rows"]:
+        for od in row.get("operator_deltas", []):
+            lines.append(
+                f"- {row['run']} / {row['query']}: "
+                f"`{od['operator']}` {od['base_ms']}ms -> "
+                f"{od['run_ms']}ms ({od['ratio']}x)")
+    if result["unmatched"]:
+        lines += ["", "Unmatched queries (no counterpart run):"]
+        for u in result["unmatched"]:
+            lines.append(f"- {u['run']}: {u['query']} "
+                         f"({u['wall_s']}s)")
+    n = len(result["regressions"])
+    lines += ["", f"**{n} regression(s) at >= "
+                  f"{result['threshold']}x**" if n else
+              "No regressions at the threshold."]
+    return "\n".join(lines) + "\n"
+
+
+def render_health_md(apps: Sequence[ApplicationInfo]) -> str:
+    lines = ["## Health"]
+    for app in apps:
+        findings = health_check(app)
+        lines += ["", f"### {app.label}", ""]
+        if not findings:
+            lines.append("no findings — run is healthy")
+            continue
+        for f in findings:
+            lines.append(f"- **{f.rule}** ({f.severity}) {f.query}: "
+                         f"{f.message}")
+    return "\n".join(lines) + "\n"
+
+
+def render_report(apps: Sequence[ApplicationInfo],
+                  threshold: float = DEFAULT_REGRESSION_THRESHOLD
+                  ) -> str:
+    """The full fleet-style markdown report: run fingerprints, the
+    cross-run compare, per-run health."""
+    lines = ["# Fleet regression report", "",
+             "| run | kind | queries | conf hash | jax | devices |",
+             "|---|---|---|---|---|---|"]
+    for app in apps:
+        env = app.header.get("env", {}) or {}
+        devs = env.get("devices") or []
+        dev = f"{len(devs)}x {devs[0]['platform']}" if devs else ""
+        lines.append(
+            f"| {app.label} | {app.kind} | {len(app.queries)} | "
+            f"{app.conf_hash or '-'} | {env.get('jax') or '-'} | "
+            f"{dev or '-'} |")
+    lines.append("")
+    if len(apps) >= 2:
+        lines.append(render_compare_md(
+            compare_applications(apps, threshold)))
+    lines.append(render_health_md(apps))
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ #
+# dot (the GenerateDot analog)
+# ------------------------------------------------------------------ #
+
+
+def generate_dot(q: QueryRecord) -> str:
+    """Annotated plan graph for one recorded query (rows + wall time
+    per operator, health-relevant counters in the graph label)."""
+    lines = ["digraph plan {",
+             "  node [shape=box fontname=monospace];",
+             f'  label="query {q.query_id} — {q.wall_s:.3f}s wall '
+             f'({q.engine})";']
+    if q.operators is None:
+        lines.append('  n0 [label="(no operator snapshot recorded)"];')
+        lines.append("}")
+        return "\n".join(lines)
+    ids: dict[int, int] = {}
+
+    def nid(n: OpNode) -> int:
+        if id(n) not in ids:
+            ids[id(n)] = len(ids)
+        return ids[id(n)]
+
+    for n in q.operators.walk():
+        label = n.desc.replace("\\", "\\\\").replace('"', "'")[:80]
+        rows = n.metrics.get("numOutputRows")
+        t = n.metrics.get("totalTime")
+        if rows:
+            label += f"\\nrows={rows}"
+        if t:
+            label += f"\\ntime={t / 1e6:.2f}ms"
+        lines.append(f'  n{nid(n)} [label="{label}"];')
+        for c in n.children:
+            lines.append(f"  n{nid(c)} -> n{nid(n)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ #
+# CLI
+# ------------------------------------------------------------------ #
+
+
+def _write_out(text: str, out: Optional[str]) -> None:
+    if out:
+        with open(out, "w") as f:
+            f.write(text)
+        print(f"wrote {out}")
+    else:
+        print(text)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.history",
+        description="event-log analysis: compare / health / report / "
+                    "dot (docs/eventlog.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("compare", help="per-query deltas across runs")
+    p.add_argument("logs", nargs="+",
+                   help="event logs or BENCH_r*.json (first = baseline)")
+    p.add_argument("--threshold", type=float,
+                   default=DEFAULT_REGRESSION_THRESHOLD)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("-o", "--out", default=None)
+
+    p = sub.add_parser("health", help="flag unhealthy runs")
+    p.add_argument("logs", nargs="+")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("-o", "--out", default=None)
+
+    p = sub.add_parser("report",
+                       help="markdown fleet regression report")
+    p.add_argument("logs", nargs="+")
+    p.add_argument("--threshold", type=float,
+                   default=DEFAULT_REGRESSION_THRESHOLD)
+    p.add_argument("-o", "--out", default=None)
+
+    p = sub.add_parser("dot", help="annotated plan graphviz")
+    p.add_argument("logs", nargs=1)
+    p.add_argument("--query", type=int, default=None,
+                   help="query id (default: the slowest query)")
+    p.add_argument("-o", "--out", default=None)
+
+    args = ap.parse_args(argv)
+    apps = [load_application(p) for p in args.logs]
+
+    if args.cmd == "compare":
+        if len(apps) < 2:
+            ap.error("compare needs >= 2 logs")
+        result = compare_applications(apps, args.threshold)
+        text = json.dumps(result, indent=1) if args.json \
+            else render_compare_md(result)
+        _write_out(text, args.out)
+        return 1 if result["regressions"] else 0
+    if args.cmd == "health":
+        findings = {app.label: health_check(app) for app in apps}
+        if args.json:
+            text = json.dumps(
+                {k: [dataclasses.asdict(f) for f in v]
+                 for k, v in findings.items()}, indent=1)
+        else:
+            text = render_health_md(apps)
+        _write_out(text, args.out)
+        return 1 if any(f.severity == "error"
+                        for v in findings.values() for f in v) else 0
+    if args.cmd == "report":
+        _write_out(render_report(apps, args.threshold), args.out)
+        return 0
+    # dot
+    app = apps[0]
+    if not app.queries:
+        ap.error(f"{app.label} holds no query records")
+    if args.query is not None:
+        q = next((q for q in app.queries
+                  if q.query_id == args.query), None)
+        if q is None:
+            ap.error(f"query id {args.query} not in {app.label}")
+    else:
+        q = max(app.queries, key=lambda q: q.wall_s)
+    _write_out(generate_dot(q), args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

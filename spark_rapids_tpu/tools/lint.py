@@ -11,7 +11,7 @@ Usage::
     --update-baseline   accept all current findings and rewrite the
                         baseline file
     --json              machine-readable output
-    --no-source / --no-registry / --no-plans
+    --no-source / --no-registry / --no-plans / --no-metrics
                         skip individual analyzers
 
 Exit status: 0 when every finding at/above the failing severity is in
@@ -41,6 +41,7 @@ def main(argv=None) -> int:
     ap.add_argument("--no-source", action="store_true")
     ap.add_argument("--no-registry", action="store_true")
     ap.add_argument("--no-plans", action="store_true")
+    ap.add_argument("--no-metrics", action="store_true")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.lint import (
@@ -51,7 +52,8 @@ def main(argv=None) -> int:
 
     diags = run_lint(source=not args.no_source,
                      registry=not args.no_registry,
-                     plans=not args.no_plans)
+                     plans=not args.no_plans,
+                     metrics=not args.no_metrics)
 
     if args.update_baseline:
         path = save_baseline(diags, args.baseline)

@@ -52,13 +52,26 @@ class NodeSnapshot:
 
 @dataclasses.dataclass
 class QueryEvent:
-    """One collected query (the ApplicationInfo analog)."""
+    """One collected query (the ApplicationInfo analog).
+
+    Beyond the id-keyed snapshot, events carry WHEN the query ran —
+    ``start_ts``/``end_ts`` epoch seconds for human alignment and
+    ``start_ns``/``end_ns`` monotonic (perf_counter_ns, same clock as
+    the tracer) for in-process interval math — and ``conf_hash``, the
+    active conf's fingerprint at collect time.  Event-log records and
+    cross-run compares align runs on exactly these fields; ids alone
+    are process-local and restart at 0 every run."""
 
     query_id: int
     explain: str
     root: NodeSnapshot
     wall_s: float
     ts: float
+    start_ts: float = 0.0
+    end_ts: float = 0.0
+    start_ns: int = 0
+    end_ns: int = 0
+    conf_hash: str = ""
 
 
 def snapshot_exec(node: TpuExec) -> NodeSnapshot:
@@ -135,18 +148,42 @@ class QueryHistory:
         return next(_QUERY_IDS)
 
     def record(self, explain: str, exec_tree: TpuExec,
-               wall_s: float, query_id: Optional[int] = None) -> None:
+               wall_s: float, query_id: Optional[int] = None,
+               start_ts: float = 0.0, end_ts: float = 0.0,
+               start_ns: int = 0, end_ns: int = 0,
+               conf_hash: str = "",
+               on_event=None) -> None:
+        """`on_event(ev)` (optional) runs on the snapshot worker AFTER
+        the settled event is appended — the event-log writer's hook:
+        it sees device-settled metrics without adding a second settle
+        wait to collect()'s critical path."""
         ts = time.time()
         if query_id is None:
             query_id = next(_QUERY_IDS)
 
         def snap(qid):
             ev = QueryEvent(qid, explain, snapshot_exec(exec_tree),
-                            wall_s, ts)
+                            wall_s, ts, start_ts=start_ts,
+                            end_ts=end_ts, start_ns=start_ns,
+                            end_ns=end_ns, conf_hash=conf_hash)
             with self._mu:
                 self._events.append(ev)
                 if len(self._events) > self.capacity:
                     self._events.pop(0)
+            if on_event is not None:
+                try:
+                    on_event(ev)
+                except Exception as exc:
+                    # a failed event-log append (disk full, revoked
+                    # dir) must not poison this future: _drain()
+                    # re-raises worker exceptions into EVERY later
+                    # history read — explain("analyze"), bench's
+                    # final drain — after the query itself succeeded
+                    import warnings
+
+                    warnings.warn(
+                        f"query-history on_event hook failed for "
+                        f"query {qid}: {exc!r}", RuntimeWarning)
         with self._mu:
             # drop settled futures so a never-inspected history stays O(1)
             self._pending = [f for f in self._pending if not f.done()]
@@ -191,9 +228,38 @@ def _jit_cache_line(cache_stats: Optional[dict]) -> Optional[str]:
             f"hit_rate={rate}")
 
 
+def _counter_footer(counters: Optional[dict]) -> list[str]:
+    """Recovery + runtime-filter footer lines (callers pass PER-QUERY
+    deltas of execs/retry.retry_stats, robustness/faults recovered
+    counts and plan/runtime_filter.stats) — the in-process view of
+    exactly the counters the event log persists, so explain("analyze")
+    and tools/history can never tell a different story."""
+    if not counters:
+        return []
+    lines = []
+    r = counters.get("retry")
+    if r is not None:
+        line = (f"retry: splits={r.get('splits', 0)} "
+                f"spill_retries={r.get('spill_retries', 0)} "
+                f"task_retries={r.get('task_retries', 0)} "
+                f"cpu_fallbacks={r.get('cpu_fallbacks', 0)}")
+        if "faults_recovered" in counters:
+            line += (f"; recovered_faults="
+                     f"{counters['faults_recovered']}")
+        lines.append(line)
+    rf = counters.get("rf")
+    if rf is not None:
+        lines.append(
+            f"runtime filters: built={rf.get('filters_built', 0)} "
+            f"pruned_rows={rf.get('pruned_rows', 0)} "
+            f"row_groups_pruned={rf.get('row_groups_pruned', 0)}")
+    return lines
+
+
 def profile_query(ev: QueryEvent,
                   trace_events: Optional[Sequence] = None,
-                  cache_stats: Optional[dict] = None) -> str:
+                  cache_stats: Optional[dict] = None,
+                  counters: Optional[dict] = None) -> str:
     """Per-operator metrics table for one query (the Analysis /
     ClassWarehouse per-SQL metrics view).  With `trace_events` (a
     spark_rapids_tpu.trace snapshot), a `self_ms` column reports each
@@ -230,15 +296,18 @@ def profile_query(ev: QueryEvent,
         lines.append(
             f"| {n.desc[:60]} | {rows} | {batches} | {t_ms} |{extra}"
             f" {' '.join(others)} |")
-    jc = _jit_cache_line(cache_stats)
-    if jc is not None:
-        lines += ["", jc]
+    footer = ([] if cache_stats is None
+              else [_jit_cache_line(cache_stats)])
+    footer += _counter_footer(counters)
+    if footer:
+        lines += [""] + footer
     return "\n".join(lines) + "\n"
 
 
 def render_analyze(ev: QueryEvent,
                    trace_events: Optional[Sequence] = None,
-                   cache_stats: Optional[dict] = None) -> str:
+                   cache_stats: Optional[dict] = None,
+                   counters: Optional[dict] = None) -> str:
     """EXPLAIN ANALYZE: the post-run plan tree, each operator annotated
     with its SETTLED metrics (wall time per device-synced totalTime,
     rows, batches) and — when a trace is available — span-derived
@@ -290,6 +359,7 @@ def render_analyze(ev: QueryEvent,
     jc = _jit_cache_line(cache_stats)
     if jc is not None:
         lines.append(jc)
+    lines.extend(_counter_footer(counters))
     return "\n".join(lines) + "\n"
 
 

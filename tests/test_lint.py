@@ -507,6 +507,105 @@ def test_source_lint_swallow_rule_scoped_and_exempt():
             lint_source_text(_SWALLOW_FIXTURE, path)), path
 
 
+# -- metric-registry checker (MET001) ----------------------------------- #
+
+_MET_UNSETTLED = """
+TOTAL_TIME = "totalTime"
+
+class FooExec:
+    def additional_metrics(self):
+        return [("fooTime", "MODERATE"), ("fooRows", "ESSENTIAL")]
+
+    def execute(self, batches):
+        for b in batches:
+            self.metrics["fooRows"].add_lazy(b.num_rows)
+            with MetricTimer(self.metrics[TOTAL_TIME]):
+                yield b
+"""
+
+_MET_UNREGISTERED = """
+class BarExec:
+    def additional_metrics(self):
+        return [("barTime", "MODERATE")]
+
+    def execute(self, batches):
+        for b in batches:
+            self.metrics["barTime"].add(1)
+            self.metrics["barRowz"].add(b.num_rows)  # typo: never reg
+            yield b
+"""
+
+_MET_DYNAMIC = """
+class DynExec:
+    def additional_metrics(self):
+        return super().additional_metrics() + [("dynTime", "DEBUG")]
+
+    def execute(self, b):
+        self.metrics["somethingInherited"].add(1)
+"""
+
+
+def test_met001_flags_registered_but_never_settled():
+    from spark_rapids_tpu.lint.metric_rules import check_metric_sources
+
+    diags = check_metric_sources(
+        {"spark_rapids_tpu/execs/fake.py": _MET_UNSETTLED})
+    hits = [d for d in diags if d.rule == "MET001"]
+    assert len(hits) == 1, diags
+    assert hits[0].severity == "error"
+    assert "fooTime" in hits[0].message
+    assert "FooExec" in hits[0].location
+    # TOTAL_TIME resolved through the module constant: no false
+    # positive on the standard names, and fooRows is settled
+
+
+def test_met001_flags_settled_but_unregistered():
+    from spark_rapids_tpu.lint.metric_rules import check_metric_sources
+
+    diags = check_metric_sources(
+        {"spark_rapids_tpu/execs/fake.py": _MET_UNREGISTERED})
+    hits = [d for d in diags if d.rule == "MET001"]
+    assert len(hits) == 1, diags
+    assert "barRowz" in hits[0].message
+
+
+def test_met001_cross_module_settles_count():
+    """Registration in one exec module, settle site in another (the
+    scan registers what planner-side helpers tick): no finding."""
+    from spark_rapids_tpu.lint.metric_rules import check_metric_sources
+
+    reg = ("class AExec:\n"
+           "    def additional_metrics(self):\n"
+           "        return [(\"sharedRows\", \"ESSENTIAL\")]\n")
+    use = ("def tick(node, n):\n"
+           "    node.metrics[\"sharedRows\"].add(n)\n")
+    diags = check_metric_sources({
+        "spark_rapids_tpu/execs/a.py": reg,
+        "spark_rapids_tpu/io/b.py": use,
+    })
+    assert [d for d in diags if d.rule == "MET001"] == [], diags
+
+
+def test_met001_dynamic_registration_is_exempt():
+    """A computed additional_metrics (super() + extras) cannot be
+    enumerated statically — the class is exempt instead of guessed
+    at, on BOTH sides of the check."""
+    from spark_rapids_tpu.lint.metric_rules import check_metric_sources
+
+    diags = check_metric_sources(
+        {"spark_rapids_tpu/execs/fake.py": _MET_DYNAMIC})
+    assert [d for d in diags if d.rule == "MET001"] == [], diags
+
+
+def test_met001_repo_is_clean():
+    """The live exec registry has no rot (MET001's first run caught
+    ParquetScanExec's never-settled scanTime — now settled around the
+    upload in io/scan.py; this pins that it stays settled)."""
+    from spark_rapids_tpu.lint.metric_rules import check_metric_registry
+
+    assert check_metric_registry() == []
+
+
 def test_repo_baseline_covers_only_intentional_syncs():
     """The checked-in baseline holds exactly the intentional execs/
     base.py syncs (metric settlement + ANSI error poll), the SRC006
@@ -533,10 +632,17 @@ def test_repo_baseline_covers_only_intentional_syncs():
                      "spark_rapids_tpu/io/pa_filter.py",
                      "spark_rapids_tpu/io/scan.py",
                      "spark_rapids_tpu/shuffle/net.py")
+    metric_infra = ("spark_rapids_tpu/execs/", "spark_rapids_tpu/io/")
     for k in keys:
         if k.startswith("SRC005::"):
             assert k.startswith(
                 "SRC005::spark_rapids_tpu/execs/base.py::"), k
+        elif k.startswith("MET001::"):
+            # intentional metric-registry placeholders may be
+            # baselined, but only inside the exec layers the rule
+            # scans (none today: scanTime was fixed, not baselined)
+            assert any(k.startswith(f"MET001::{p}")
+                       for p in metric_infra), k
         elif k.startswith("SRC007::"):
             assert any(k.startswith(f"SRC007::{p}::")
                        for p in sync_infra), k

@@ -136,6 +136,41 @@ def test_query_history_drain_makes_snapshots_consistent(session):
             or "Aggregate" in ev.explain
 
 
+def test_query_history_id_and_timestamps_roundtrip(session):
+    """Regression (PR7 satellite): QueryHistory events were keyed by
+    query id but carried no wall-clock timestamps or conf epoch —
+    cross-run alignment was impossible.  Every recorded event must now
+    carry consistent monotonic + epoch start/end times and the active
+    conf hash, keyed to the id the collect allocated."""
+    import time
+
+    t = gen_table({"a": "int64", "b": "float64"}, 200, seed=8)
+    df = session.create_dataframe(t).where(col("a") > lit(0)) \
+        .agg((sum_(col("b")), "s"))
+    wall0 = time.time()
+    _out, qid = df._collect_tpu()
+    wall1 = time.time()
+    ev = next(e for e in session.history.events if e.query_id == qid)
+    # monotonic pair: ordered, and consistent with the wall figure
+    assert 0 < ev.start_ns <= ev.end_ns
+    assert abs((ev.end_ns - ev.start_ns) / 1e9 - ev.wall_s) < 0.5
+    # epoch pair: ordered and inside the observed collect window
+    assert wall0 - 1 <= ev.start_ts <= ev.end_ts <= wall1 + 1
+    # conf epoch: present, and stable across an unchanged conf...
+    assert ev.conf_hash
+    _out2, qid2 = df._collect_tpu()
+    ev2 = next(e for e in session.history.events
+               if e.query_id == qid2)
+    assert ev2.conf_hash == ev.conf_hash
+    assert ev2.start_ns >= ev.end_ns  # sequential collects
+    # ...and different once the conf changes (the alignment key)
+    session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 4096)
+    _out3, qid3 = df._collect_tpu()
+    ev3 = next(e for e in session.history.events
+               if e.query_id == qid3)
+    assert ev3.conf_hash != ev.conf_hash
+
+
 def test_query_ids_unique_across_sessions():
     """Query ids are process-global: two sessions tracing into the
     shared buffer must never hand out the same correlation key."""
