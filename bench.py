@@ -55,6 +55,15 @@ Attribution fields (so round-over-round deltas are explainable):
   `python -m spark_rapids_tpu.tools.history report` instead of
   hand-diffing these JSON fields (docs/eventlog.md); the file path
   rides in the output as `eventlog`.
+
+`bench.py --sessions N [--tenants K]` switches to the SERVING bench
+(docs/serving.md): N concurrent sessions across K tenants drive
+deterministic golden templates through admission control and the
+prepared-plan cache, emitting `serving_qps`, `serving_p50_ms` /
+`serving_p99_ms`, `admission_wait_p99_ms` and `plan_cache_hit_rate`,
+with a bit-for-bit digest gate against serial execution and a
+repeat-template pass asserting hit rate 1.0 with zero plan/tag/lower
+spans and zero jit-cache misses.
 """
 
 import json
@@ -652,6 +661,263 @@ def _bench_q67(session, d: str) -> dict:
     return out
 
 
+def _serving_queries(session, li_paths, orders_path):
+    """The serving bench's golden templates.  Every one is
+    DETERMINISTIC to the bit: aggregates are exact (sums of
+    integer-valued doubles far below 2^53, counts, min/max) and output
+    order is pinned by ORDER BY — so the concurrent-vs-serial digest
+    gate can demand bit-for-bit equality, which thread-timing-dependent
+    float aggregation order could not honor."""
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import (
+        col,
+        count_star,
+        max_,
+        min_,
+        sum_,
+    )
+
+    qty = col("l_quantity")
+    qa = (session.read_parquet(*li_paths)
+          .where(col("l_shipdate") <= lit(10471))
+          .group_by(col("l_returnflag"), col("l_linestatus"))
+          .agg((sum_(qty), "sum_qty"), (count_star(), "n"),
+               (min_(col("l_shipdate")), "d0"),
+               (max_(col("l_shipdate")), "d1"))
+          .order_by(col("l_returnflag"), col("l_linestatus")))
+    li = (session.read_parquet(*li_paths)
+          .where(col("l_shipdate") > lit(9500)))
+    orders = (session.read_parquet(orders_path)
+              .where(col("o_orderdate") < lit(9500)))
+    qb = (li.join(orders, left_on=[col("l_orderkey")],
+                  right_on=[col("o_orderkey")])
+          .group_by(col("o_shippriority"))
+          .agg((sum_(qty), "sum_qty"), (count_star(), "n"))
+          .order_by(col("o_shippriority")))
+    qc = (session.read_parquet(*li_paths)
+          .agg((count_star(), "n"),
+               (min_(col("l_shipdate")), "d0"),
+               (max_(col("l_shipdate")), "d1")))
+    return [("qa", qa), ("qb", qb), ("qc", qc)]
+
+
+def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
+    """The multi-session serving bench (bench.py --sessions N
+    [--tenants K]): N concurrent sessions across K tenants drive the
+    deterministic golden templates through the serving tier — admission
+    control + prepared-plan cache + per-session event logs — and the
+    output makes 'heavy traffic' a measured claim:
+
+    - serving_qps, serving_p50_ms / serving_p99_ms over the measured
+      window (all sessions, all templates);
+    - admission_wait_p99_ms from the scheduler's wait ring;
+    - plan_cache_hit_rate over the REPEAT-template pass, asserted 1.0,
+      with serving_repeat_plan_spans (query.plan/tag/lower spans seen
+      during that pass — asserted 0: hits skip lowering entirely) and
+      serving_repeat_jit_misses (asserted 0: cached trees re-use their
+      compiled programs);
+    - a bit-for-bit digest gate: every concurrent result must hash
+      identical to the serial run's, and one streamed fetch must hash
+      identical to its collect.
+    """
+    import threading
+
+    from spark_rapids_tpu import trace as _trace
+    from spark_rapids_tpu.config import TpuConf, set_conf
+    from spark_rapids_tpu.eventlog import table_digest
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.serving import plan_cache as _plan_cache
+    from spark_rapids_tpu.serving import scheduler as _scheduler
+    from spark_rapids_tpu.session import TpuSession
+
+    repeat_iters = 3
+    max_concurrent = max(1, min(2, n_sessions))
+    ev_dir = None
+    if "--no-eventlog" not in sys.argv[1:]:
+        ev_dir = _eventlog_dir()
+
+    def _conf(extra=None) -> TpuConf:
+        over = {
+            "spark.rapids.tpu.serving.maxConcurrent": max_concurrent,
+            "spark.rapids.tpu.serving.queueDepth": 4 * n_sessions + 8,
+            # admission slots must not outnumber device permits, or the
+            # scheduler clamp makes maxConcurrent a dead knob here
+            "spark.rapids.tpu.sql.concurrentTpuTasks":
+                max(2, max_concurrent),
+        }
+        if ev_dir is not None:
+            over["spark.rapids.tpu.eventLog.enabled"] = True
+            over["spark.rapids.tpu.eventLog.dir"] = ev_dir
+        over.update(extra or {})
+        return TpuConf(over)
+
+    with tempfile.TemporaryDirectory(prefix="serve_bench_") as d:
+        li = make_lineitem(d, n_files=2, with_q1_cols=True,
+                           with_orderkey=True)
+        orders = make_orders(d)
+
+        # -- serial reference: digests + latency baseline ----------- #
+        serial_conf = _conf(
+            {"spark.rapids.tpu.serving.maxConcurrent": 0})
+        set_conf(serial_conf)
+        s0 = TpuSession(serial_conf)
+        digests = {}
+        serial_ts = []
+        for name, df in _serving_queries(s0, li, orders):
+            df.collect(engine="tpu")  # warm compile caches
+            t0 = time.perf_counter()
+            r = df.collect(engine="tpu")
+            serial_ts.append(time.perf_counter() - t0)
+            digests[name] = table_digest(r)
+
+        # -- concurrent sessions ------------------------------------ #
+        _scheduler.reset()
+        _plan_cache.reset_stats()
+        lat_lock = threading.Lock()
+        latencies: list = []
+        mismatches: list = []
+        prepared: list = []  # (session, {name: PreparedQuery})
+        # the main thread is a barrier party: it arms the measured
+        # window's instrumentation strictly AFTER every warm pass and
+        # strictly BEFORE any repeat execution
+        warm_done = threading.Barrier(n_sessions + 1)
+        go_repeat = threading.Event()
+
+        def run_session(i: int) -> None:
+            pqs = {}
+            try:
+                conf = _conf()
+                set_conf(conf)
+                session = TpuSession(conf, tenant=f"t{i % n_tenants}")
+                for name, df in _serving_queries(session, li, orders):
+                    pqs[name] = session.prepare(df)
+                with lat_lock:
+                    prepared.append((session, pqs))
+                # warm pass: every template once (prepare already
+                # lowered; this compiles + validates), digest-gated
+                for name, pq in pqs.items():
+                    r = pq.execute()
+                    if table_digest(r) != digests[name]:
+                        with lat_lock:
+                            mismatches.append((i, name, "warm"))
+            except BaseException as e:  # noqa: BLE001 — reported below
+                with lat_lock:
+                    mismatches.append((i, "session-error", repr(e)))
+                pqs = {}
+            finally:
+                # ALWAYS reach the barrier: a dead party would leave
+                # the main thread blocked in warm_done.wait() forever
+                # instead of failing with the recorded error
+                warm_done.wait()
+            if not pqs:
+                return
+            go_repeat.wait()
+            # measured REPEAT pass: pure cache hits, timed
+            try:
+                for _ in range(repeat_iters):
+                    for name, pq in pqs.items():
+                        t0 = time.perf_counter()
+                        r = pq.execute()
+                        dt = time.perf_counter() - t0
+                        if table_digest(r) != digests[name]:
+                            with lat_lock:
+                                mismatches.append((i, name, "repeat"))
+                        with lat_lock:
+                            latencies.append(dt)
+            except BaseException as e:  # noqa: BLE001 — reported below
+                with lat_lock:
+                    mismatches.append((i, "repeat-error", repr(e)))
+
+        threads = [threading.Thread(target=run_session, args=(i,),
+                                    name=f"serve-bench-{i}")
+                   for i in range(n_sessions)]
+        for t in threads:
+            t.start()
+        warm_done.wait()
+        # measured-window instrumentation, armed while every session
+        # sits at go_repeat: plan-cache stats reset (repeats must show
+        # hit rate 1.0), jit snapshot (zero misses on hits), tracer on
+        # (zero query.plan/tag/lower spans on hits)
+        _plan_cache.reset_stats()
+        _scheduler.reset()  # fresh wait ring for the measured window
+        jit0 = cache_stats()
+        _trace.clear()
+        _trace.enable()
+        wall0 = time.perf_counter()
+        go_repeat.set()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - wall0
+        _trace.disable()
+        spans = _trace.snapshot()
+        _trace.clear()
+        jit1 = cache_stats()
+        pc = _plan_cache.stats()
+        sched = _scheduler.scheduler_stats()
+
+        # -- streaming gate: stream == collect, to the bit ---------- #
+        stream_ok = False
+        if prepared and not mismatches:
+            import pyarrow as pa
+
+            _s_last, pqs_last = prepared[-1]
+            batches = list(pqs_last["qa"].execute_stream())
+            stream_tbl = pa.Table.from_batches(batches)
+            stream_ok = table_digest(stream_tbl) == digests["qa"]
+
+        # event logs hold every query before the dir is reported
+        for session, _p in prepared:
+            if session.event_log_path is not None:
+                _ = session.history.events
+
+    assert not mismatches, (
+        f"serving results diverged from serial digests: {mismatches}")
+    assert stream_ok, "streamed result digest != collect digest"
+    plan_spans = sum(1 for e in spans
+                     if e.name in ("query.plan", "query.tag",
+                                   "query.lower"))
+    n_execs = len(latencies)
+    latencies.sort()
+
+    def q(p: float) -> float:
+        return latencies[min(n_execs - 1,
+                             int(round(p * (n_execs - 1))))]
+
+    out = {
+        "metric": "serving_bench",
+        "value": round(n_execs / wall, 2),
+        "unit": "qps",
+        "serving_sessions": n_sessions,
+        "serving_tenants": n_tenants,
+        "serving_max_concurrent": max_concurrent,
+        "serving_qps": round(n_execs / wall, 2),
+        "serving_p50_ms": round(q(0.50) * 1e3, 1),
+        "serving_p99_ms": round(q(0.99) * 1e3, 1),
+        "serving_executions": n_execs,
+        "serial_p50_ms": round(
+            statistics.median(serial_ts) * 1e3, 1),
+        "admission_wait_p99_ms": sched["wait_p99_ms"],
+        "admission_total_wait_ms": sched["total_wait_ms"],
+        "admitted": sched["admitted"],
+        "rejected": sched["rejected"],
+        "plan_cache_hit_rate": pc["hit_rate"],
+        "plan_cache_hits": pc["hits"],
+        "plan_cache_misses": pc["misses"],
+        "serving_repeat_plan_spans": plan_spans,
+        "serving_repeat_jit_misses": jit1["misses"] - jit0["misses"],
+        "digests_match": True,
+        "stream_matches_collect": True,
+    }
+    if ev_dir is not None:
+        out["eventlog"] = ev_dir
+    # the acceptance contract, enforced where it is measured: repeats
+    # are pure hits that lowered nothing and compiled nothing
+    assert pc["hit_rate"] == 1.0, pc
+    assert plan_spans == 0, plan_spans
+    assert out["serving_repeat_jit_misses"] == 0, out
+    return out
+
+
 def _eventlog_dir() -> str:
     """Where this round's event log lands: --eventlog DIR, else
     $BENCH_EVENTLOG_DIR, else ./bench_eventlog.  On by default so
@@ -671,8 +937,25 @@ def _eventlog_dir() -> str:
     return os.environ.get("BENCH_EVENTLOG_DIR", "bench_eventlog")
 
 
+def _int_flag(name: str) -> int:
+    argv = sys.argv[1:]
+    if name not in argv:
+        return 0
+    i = argv.index(name)
+    if i + 1 >= len(argv) or not argv[i + 1].isdigit():
+        raise SystemExit(f"bench.py: {name} requires an integer operand")
+    return int(argv[i + 1])
+
+
 def main() -> None:
     global _CHAOS
+    sessions = _int_flag("--sessions")
+    if sessions:
+        # serving mode: the multi-session concurrency bench ONLY (the
+        # single-session q6/q1/q3/q67 rounds are the plain invocation)
+        tenants = _int_flag("--tenants") or min(2, sessions)
+        print(json.dumps(_bench_serving(sessions, tenants)))
+        return
     if "--chaos" in sys.argv[1:]:
         # chaos mode: every query below runs under the deterministic
         # fault schedule (re-armed per query by the counter reset) —

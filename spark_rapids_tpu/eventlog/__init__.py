@@ -373,14 +373,31 @@ class EventLogWriter:
         the pipeline stage snapshot, and per-site fault stats.  These
         must be read at query end, not later on the snapshot worker —
         by then a bench harness may have reset the counters or
-        disarmed the fault schedule, and the record would lie."""
-        from spark_rapids_tpu.robustness import faults
+        disarmed the fault schedule, and the record would lie.
 
+        The serving tier's per-query facts (admission wait, tenant,
+        plan-cache hit) ride the thread-local serving context rather
+        than the counter deltas: admission and the plan-cache lookup
+        happen BEFORE query_begin's snapshot, outside the delta
+        window.  They land both as counters (serve.admit_wait_ms /
+        serve.plan_cache_hit — the HC009 health-rule inputs) and as
+        the structured `serving` record field."""
+        from spark_rapids_tpu.robustness import faults
+        from spark_rapids_tpu.serving import current_serving_context
+
+        counters = counters_delta(pre["counters"], counters_snapshot())
+        sctx = current_serving_context()
+        if sctx:
+            if "admit_wait_ms" in sctx:
+                counters["serve.admit_wait_ms"] = sctx["admit_wait_ms"]
+            if "plan_cache" in sctx:
+                counters["serve.plan_cache_hit"] = \
+                    1 if sctx["plan_cache"] == "hit" else 0
         return {
-            "counters": counters_delta(pre["counters"],
-                                       counters_snapshot()),
+            "counters": counters,
             "pipeline": _pipeline_surface(),
             "faults": faults.fault_stats() or None,
+            "serving": sctx,
         }
 
     def build_query_record(self, ev, post: dict, plan_text: str,
@@ -430,6 +447,7 @@ class EventLogWriter:
             "spans": spans,
             "pipeline": post["pipeline"],
             "faults": post["faults"],
+            "serving": post.get("serving"),
             "result_digest": result_digest,
             "rows": rows,
             "trace_file": trace_file,

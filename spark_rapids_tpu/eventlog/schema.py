@@ -66,6 +66,7 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
         "spans": (dict, type(None)),
         "pipeline": (dict, type(None)),
         "faults": (dict, type(None)),
+        "serving": (dict, type(None)),
         "result_digest": (str, type(None)),
         "trace_file": (str, type(None)),
         "rows": (int, type(None)),

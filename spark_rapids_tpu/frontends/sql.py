@@ -27,7 +27,12 @@ TPC-DS q3, and the common shapes around them):
   EXTRACT(field FROM x), scalar functions (substring, upper, lower,
   length, coalesce, abs, round, year/month/day, concat, trim, nullif),
   string/number/date literals, and `date '...' +/- interval 'N' day`
-  arithmetic (folded at parse time, as in TPC-H predicates).
+  arithmetic (folded at parse time, as in TPC-H predicates);
+- named parameters (`WHERE k = :k`, bound via `sql(text, params=...)` /
+  `PreparedQuery.execute(params=...)`): each reference binds to a
+  literal at parse time; unbound names raise SqlError with position —
+  the template substrate of the serving tier's prepared-plan cache
+  (docs/serving.md).
 
 Identifiers resolve case-insensitively against the registered tables'
 schemas; qualified refs (`alias.col`) check the alias but lower to the
@@ -39,7 +44,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import re
-from typing import Sequence
+from typing import Optional, Sequence
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.execs.sort import SortKey
@@ -68,6 +73,7 @@ _TOKEN_RE = re.compile(r"""
            |\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<qid>"(?:[^"]|"")*")
+  | (?P<param>:[A-Za-z_][A-Za-z_0-9]*)
   | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
   | (?P<op><>|!=|>=|<=|=|<|>|\|\||[(),.*/%+\-;])
 """, re.VERBOSE)
@@ -87,6 +93,34 @@ def _tokenize(text: str) -> list[tuple[str, str, int]]:
         pos = m.end()
     out.append(("eof", "", len(text)))
     return out
+
+
+def param_names(text: str) -> frozenset:
+    """The named parameters (``:name``) a query template references —
+    the prepared-statement substrate: ``SqlSession.prepare`` collects
+    these up front so an unbound execute fails before any parsing."""
+    return frozenset(tok[1][1:] for tok in _tokenize(text)
+                     if tok[0] == "param")
+
+
+def _param_literal(name: str, value, pos: int) -> B.Literal:
+    """Bind one parameter value as an engine literal (the 'literal
+    rebinding' seam: bound values become plain literals, so the lowered
+    plan is indistinguishable from inline-literal SQL and keys into the
+    jit/plan caches the same way)."""
+    if isinstance(value, _dt.datetime):
+        raise SqlError(
+            f"parameter :{name}: timestamp parameters are not "
+            "supported yet (bind epoch seconds or a date)")
+    if isinstance(value, _dt.date):
+        return B.Literal((value - _EPOCH).days, T.DATE)
+    try:
+        return B.Literal.of(value)
+    except TypeError:
+        raise SqlError(
+            f"parameter :{name} at offset {pos} has unsupported type "
+            f"{type(value).__name__} (bind int/float/str/bool/date/"
+            f"None)") from None
 
 
 _AGG_FNS = {"sum": AG.Sum, "min": AG.Min, "max": AG.Max,
@@ -264,9 +298,14 @@ def _shift_date(lit: B.Literal, iv: _Interval, sign: int) -> B.Literal:
 
 
 class _Parser:
-    def __init__(self, text: str):
+    def __init__(self, text: str, params: Optional[dict] = None):
         self.toks = _tokenize(text)
         self.i = 0
+        #: named-parameter bindings (:name -> python value); every
+        #: reference binds to a literal at parse time, unbound names
+        #: raise SqlError at their position
+        self.params: dict = params or {}
+        self.params_used: set = set()
 
     # -- token helpers -- #
 
@@ -615,6 +654,15 @@ class _Parser:
         if t[0] == "str":
             self.i += 1
             return B.Literal.of(t[1][1:-1].replace("''", "'"))
+        if t[0] == "param":
+            self.i += 1
+            name = t[1][1:]
+            if name not in self.params:
+                raise SqlError(
+                    f"unbound parameter :{name} at offset {t[2]} — "
+                    f"pass params={{'{name}': ...}} to sql()/execute()")
+            self.params_used.add(name)
+            return _param_literal(name, self.params[name], t[2])
         if self.accept_op("("):
             if self.kw() == "select":
                 # uncorrelated scalar subquery: (SELECT <agg> FROM ...)
@@ -1017,10 +1065,40 @@ class SqlSession:
 
     # -- execution -- #
 
-    def sql(self, text: str):
-        """Parse + lower one SELECT; returns an engine DataFrame."""
-        q = _Parser(text).parse_select()
+    def sql(self, text: str, params: Optional[dict] = None):
+        """Parse + lower one SELECT; returns an engine DataFrame.
+
+        ``params`` binds named parameters (``WHERE k = :k`` with
+        ``params={"k": 5}``) as literals at parse time — the template
+        substrate of the prepared-plan cache (docs/serving.md).
+        Unbound references and unreferenced bindings both raise
+        SqlError (a silently ignored binding is a typo'd template)."""
+        p = _Parser(text, params=params)
+        q = p.parse_select()
+        if params:
+            unused = sorted(set(params) - p.params_used)
+            if unused:
+                raise SqlError(
+                    "unknown parameter(s) "
+                    + ", ".join(f":{n}" for n in unused)
+                    + " — not referenced by the query")
         return self._lower(q)
+
+    def prepare(self, text: str):
+        """Prepare a SQL template (named ``:name`` parameters allowed):
+        returns a PreparedQuery whose ``execute(params=...)`` parses +
+        lowers once PER BINDING and re-drains the cached lowered plan
+        on repeats — the repeated-template path skips parse/plan/tag/
+        lower entirely (docs/serving.md).  Parameterless templates are
+        lowered eagerly here; parameterized ones on first execute."""
+        from spark_rapids_tpu.serving.prepared import PreparedQuery
+
+        names = param_names(text)
+        pq = PreparedQuery(self.session, sql_text=text,
+                           sql_session=self, param_names=names)
+        if not names:
+            pq._resolve(None)  # validate + warm the cache now
+        return pq
 
     def _lower(self, q: dict):
         if q.get("unions"):

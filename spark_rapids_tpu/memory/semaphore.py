@@ -6,23 +6,39 @@ HBM oversubscription when the scheduler runs partitions on a thread
 pool.  On TPU a core runs one program at a time anyway, so the semaphore
 guards *memory residency*, not kernel concurrency — acquired on first
 batch materialization, released at task end (same protocol as the
-reference)."""
+reference).
+
+The permit count is conf-driven (spark.rapids.tpu.sql.concurrentTpuTasks)
+but the instance is process-global: :meth:`sync_conf` aligns the two at
+each query boundary with the same ownership rule as the tracer and the
+fault registry — a conf asking for a NON-default size resizes the live
+semaphore and becomes its owner; a conf that merely carries the default
+never shrinks another session's explicit resize; only the owner (or a
+new explicit setting) moves it again.  Resizing wakes waiters, so tests
+and per-session conf changes take effect without a process restart.
+The serving tier's admission control (serving/scheduler.py) reads
+:attr:`permits` as the device-side concurrency cap, so a resize here
+re-sizes query admission too (docs/serving.md)."""
 
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Optional
 
 
 class TpuSemaphore:
     _instance: Optional["TpuSemaphore"] = None
     _lock = threading.Lock()
+    #: weakref to the conf that last resized the live instance to a
+    #: non-default permit count (None = sized at the registry default)
+    _owner: Optional["weakref.ref"] = None
 
     def __init__(self, permits: int):
         self.permits = permits
         self._available = permits
         self._cv = threading.Condition()
-        self._holders: set[int] = set()
+        self._holders: set = set()
 
     @classmethod
     def get(cls) -> "TpuSemaphore":
@@ -41,8 +57,56 @@ class TpuSemaphore:
     def reset(cls) -> None:
         with cls._lock:
             cls._instance = None
+            cls._owner = None
 
-    def acquire_if_necessary(self, task_id: int) -> None:
+    @classmethod
+    def sync_conf(cls, conf=None) -> None:
+        """Align the process semaphore with the session conf at a query
+        boundary (the conf is a thread-local snapshot; the semaphore is
+        process-global).  Ownership mirrors trace/faults.sync_conf: an
+        explicit (non-default) size resizes the live instance and owns
+        it; a conf carrying the registry default only resizes back if
+        it IS the owner — another session's default conf must not
+        shrink a concurrently resized semaphore mid-query."""
+        from spark_rapids_tpu.config import CONCURRENT_TPU_TASKS, get_conf
+
+        conf = conf or get_conf()
+        want = int(conf.get(CONCURRENT_TPU_TASKS))
+        default = int(CONCURRENT_TPU_TASKS.default)
+        with cls._lock:
+            inst = cls._instance
+            if inst is None:
+                return  # the next get() reads this conf's value anyway
+            if want == inst.permits:
+                if want != default:
+                    cls._owner = weakref.ref(conf)
+                return
+            if want == default:
+                owner = cls._owner() if cls._owner is not None else None
+                if owner is not conf:
+                    return
+                cls._owner = None
+            else:
+                cls._owner = weakref.ref(conf)
+        inst.resize(want)
+
+    def resize(self, permits: int) -> None:
+        """Change the permit count of a LIVE semaphore.  Growing wakes
+        waiters immediately; shrinking lets in-flight holders finish —
+        `_available` may go transiently negative and new acquisitions
+        block until enough holders release (the acquire loop only
+        admits while `_available > 0`)."""
+        if permits < 1:
+            raise ValueError(f"semaphore permits must be >= 1, "
+                             f"got {permits}")
+        with self._cv:
+            delta = permits - self.permits
+            self.permits = permits
+            self._available += delta
+            if delta > 0:
+                self._cv.notify_all()
+
+    def acquire_if_necessary(self, task_id) -> None:
         """Idempotent per task (ref: GpuSemaphore.acquireIfNecessary).
 
         Membership check, permit take, and holder registration happen in
@@ -61,7 +125,7 @@ class TpuSemaphore:
                     return
                 self._cv.wait()
 
-    def release_if_necessary(self, task_id: int) -> None:
+    def release_if_necessary(self, task_id) -> None:
         with self._cv:
             if task_id not in self._holders:
                 return

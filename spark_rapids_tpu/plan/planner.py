@@ -1464,43 +1464,60 @@ def _demote_unrepresentable_boundaries(meta: PlanMeta) -> None:
 
 
 def collect_exec(exec_: TpuExec) -> pa.Table:
-    """Drain an exec to a host Arrow table (the D2H plan root)."""
-    if isinstance(exec_, CpuFallbackExec):
-        # a fully-CPU root: return the host table directly instead of
-        # bouncing it through device batches (also the only path for
-        # types the device layout cannot hold, e.g. list<string>)
-        try:
-            return exec_.cpu_table().cast(schema_to_arrow(exec_.schema))
-        finally:
-            exec_.close()
+    """Drain an exec to a host Arrow table (the D2H plan root): the
+    materialized form of :func:`stream_exec` — ONE drain loop serves
+    both the classic collect and the serving tier's streaming fetch,
+    so the drain protocol (prefetch wiring, traced fetches, iterator/
+    exec close invariants) can never diverge between them."""
+    tables = list(stream_exec(exec_))
+    if not tables:
+        return schema_to_arrow(exec_.schema).empty_table()
+    return pa.concat_tables(tables)
+
+
+def stream_exec(exec_: TpuExec, stage: str = "result.fetch"):
+    """Drain an exec INCREMENTALLY: one host Arrow table per device
+    batch (already cast to the output schema), yielded as produced —
+    the serving tier's streaming result fetch (docs/serving.md) and
+    the single drain loop under :func:`collect_exec`.
+
+    With the software pipeline on, the plan runs on a prefetch
+    producer thread whose bounded queue (`pipeline.depth`) holds the
+    in-flight result batches — a slow consumer blocks the producer at
+    the queue, so backpressure is the stage depth, not unbounded
+    buffering; fetch(k) overlaps compute(k+1) exactly as the classic
+    collect's last-exec->fetch stage did.  Closing the generator early
+    aborts the stage and closes the exec tree (partial drains release
+    shuffle blocks).  A fully-CPU root yields its host table directly
+    (also the only path for types with no device layout,
+    e.g. list<string>)."""
     from spark_rapids_tpu import trace as _trace
 
+    if isinstance(exec_, CpuFallbackExec):
+        try:
+            yield exec_.cpu_table().cast(schema_to_arrow(exec_.schema))
+        finally:
+            exec_.close()
+        return
+    aschema = schema_to_arrow(exec_.schema)
     try:
         it = exec_.execute()
         fetch_depth = getattr(exec_, "_pipeline_fetch", 0)
         if fetch_depth:
             from spark_rapids_tpu.parallel.pipeline import prefetch
 
-            # last-exec->fetch stage: the producer thread drives the
-            # plan (dispatching device programs) while this thread does
-            # the blocking D2H Arrow fetches — fetch(k) overlaps
-            # compute(k+1); depth bounds device batches in the queue
-            it = prefetch(it, depth=fetch_depth, stage="result.fetch")
+            it = prefetch(it, depth=fetch_depth, stage=stage)
         try:
-            tables = []
             for b in it:
                 if _trace.TRACER.enabled:
                     with _trace.span("query.fetch.batch"):
-                        tables.append(to_arrow(b))
+                        t = to_arrow(b)
                 else:
-                    tables.append(to_arrow(b))
+                    t = to_arrow(b)
+                yield t.cast(aschema)
         finally:
             close = getattr(it, "close", None)
             if close is not None:
                 close()
     finally:
         exec_.close()  # release shuffle blocks even on partial drains
-    aschema = schema_to_arrow(exec_.schema)
-    if not tables:
-        return aschema.empty_table()
-    return pa.concat_tables([t.cast(aschema) for t in tables])

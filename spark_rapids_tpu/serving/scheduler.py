@@ -1,0 +1,339 @@
+"""Admission control: weighted-fair, priority-aware query scheduling.
+
+The reference's GpuSemaphore answers "how many TASKS may hold device
+memory"; a serving tier must also answer "WHICH query runs next" when
+more sessions arrive than the device can admit.  The
+:class:`QueryScheduler` is that answer: start-time weighted fair
+queuing (WFQ) across tenants — each tenant carries a virtual clock that
+advances by ``1/priority`` per admitted query, and the waiting entry
+with the smallest virtual start time is granted next — so a
+priority-4 tenant receives 4x the admission share of a priority-1
+tenant under contention, while every tenant keeps making progress (no
+starvation: virtual clocks are monotone, so a light tenant's entry is
+always eventually the minimum).
+
+Coupling to the device (the "gates on TpuSemaphore" contract): the
+effective concurrency limit is ``min(serving.maxConcurrent,
+TpuSemaphore permits)``.  Admitted queries still acquire per-task
+semaphore permits inside execs exactly as before — the scheduler never
+HOLDS device permits across a query (doing so would deadlock against
+the per-task acquisitions of the queries it admitted); it bounds how
+many queries compete for them, and a
+:meth:`~spark_rapids_tpu.memory.semaphore.TpuSemaphore.resize` (via its
+sync_conf) re-sizes admission on the next grant decision.
+
+Load shedding: a query arriving with the queue at
+``serving.queueDepth`` is rejected immediately
+(:class:`AdmissionRejected`) — bounded latency beats unbounded queues.
+
+Observability: every admission records its wait in the scheduler stats
+(p50/p99 come from a bounded ring of recent waits) and — when tracing
+is on — as a ``serve.admit`` span on the correlated timeline; the wait
+also lands in the query's event-log record as the
+``serve.admit_wait_ms`` counter (the HC009 health-rule input).
+
+Process-global, LAST-WRITER-WINS configuration: the scheduler is one
+per process (like the tracer), and :func:`get_scheduler` applies the
+admitting conf's ``maxConcurrent``/``queueDepth``/``defaultPriority``
+whenever they differ from the live values — a serving fleet is
+expected to share one serving-conf epoch, and two sessions admitting
+with different explicit limits will flip the shared limits back and
+forth (deliberately simple; the admission COUNTS stay consistent
+either way).  :func:`reset` tears the instance down for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+from spark_rapids_tpu import trace as _tr
+from spark_rapids_tpu.serving import (
+    DEFAULT_PRIORITY,
+    MAX_CONCURRENT,
+    QUEUE_DEPTH,
+    clear_serving_context,
+    current_serving_context,
+    update_serving_context,
+)
+
+
+class AdmissionRejected(RuntimeError):
+    """The admission queue is full (serving.queueDepth): the serving
+    tier sheds this query instead of queuing it unboundedly.  Callers
+    should retry with backoff or route to another replica."""
+
+
+class _Tenant:
+    __slots__ = ("name", "vtime")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.vtime = 0.0
+
+
+class _Entry:
+    __slots__ = ("tenant", "priority", "vtime", "seq", "granted")
+
+    def __init__(self, tenant: str, priority: int, vtime: float,
+                 seq: int):
+        self.tenant = tenant
+        self.priority = priority
+        self.vtime = vtime
+        self.seq = seq
+        self.granted = False
+
+
+class QueryScheduler:
+    """One device's admission scheduler (see module doc)."""
+
+    def __init__(self, max_concurrent: int, queue_depth: int,
+                 default_priority: int = 1):
+        self.max_concurrent = int(max_concurrent)
+        self.queue_depth = int(queue_depth)
+        self.default_priority = int(default_priority)
+        self._cv = threading.Condition()
+        self._running = 0
+        self._waiting: list[_Entry] = []
+        self._tenants: dict[str, _Tenant] = {}
+        self._vclock = 0.0
+        self._seq = 0
+        # stats (under _cv): totals + a bounded ring of recent waits so
+        # p50/p99 stay O(1) memory on a long-lived server
+        self._admitted = 0
+        self._rejected = 0
+        self._total_wait_ms = 0.0
+        self._waits_ms: deque = deque(maxlen=4096)
+
+    # -- limit ------------------------------------------------------- #
+
+    def _limit(self) -> int:
+        """Effective concurrency: serving.maxConcurrent clamped to the
+        device semaphore's permit count — admission control rides the
+        same budget that caps device batch residency, so resizing the
+        semaphore (its sync_conf) re-sizes admission too."""
+        from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+        return max(1, min(self.max_concurrent,
+                          TpuSemaphore.get().permits))
+
+    # -- core -------------------------------------------------------- #
+
+    def _pump_locked(self) -> None:
+        """Grant waiting entries while capacity remains: smallest
+        virtual start time first (WFQ), FIFO within ties.  Virtual
+        times were assigned at ENQUEUE (each tenant's clock advances
+        1/priority per queued request), so a burst from one tenant
+        interleaves with other tenants' queued work instead of
+        draining FIFO."""
+        limit = self._limit()
+        while self._running < limit and self._waiting:
+            nxt = min(self._waiting,
+                      key=lambda e: (e.vtime, e.seq))
+            self._waiting.remove(nxt)
+            nxt.granted = True
+            self._running += 1
+            self._vclock = max(self._vclock, nxt.vtime)
+        self._cv.notify_all()
+
+    def admit(self, tenant: str = "default",
+              priority: Optional[int] = None) -> _Entry:
+        """Block until this query is admitted (or raise
+        :class:`AdmissionRejected` when the queue is full).  Returns
+        the ticket to hand back to :meth:`release`."""
+        prio = int(priority) if priority is not None \
+            else self.default_priority
+        t0 = time.perf_counter_ns()
+        with self._cv:
+            te = self._tenants.get(tenant)
+            if te is None:
+                te = self._tenants[tenant] = _Tenant(tenant)
+                # a brand-new tenant starts at the current virtual
+                # clock, not 0 — joining late must not grant it a
+                # catch-up burst over tenants that queued all along
+                te.vtime = self._vclock
+            if self._running >= self._limit() \
+                    and len(self._waiting) >= self.queue_depth:
+                self._rejected += 1
+                raise AdmissionRejected(
+                    f"admission queue full ({len(self._waiting)} "
+                    f"waiting >= serving.queueDepth="
+                    f"{self.queue_depth}, {self._running} running); "
+                    f"tenant={tenant!r}")
+            self._seq += 1
+            entry = _Entry(tenant, prio,
+                           max(te.vtime, self._vclock), self._seq)
+            # advance the tenant clock AT ENQUEUE: its next request
+            # starts 1/priority later in virtual time, which is what
+            # spaces a burst out against other tenants' queued work
+            te.vtime = entry.vtime + 1.0 / max(1, prio)
+            self._waiting.append(entry)
+            self._pump_locked()
+            waited = not entry.granted
+            try:
+                while not entry.granted:
+                    self._cv.wait()
+            except BaseException:
+                # interrupted wait (KeyboardInterrupt, injected test
+                # abort): unwind the entry, or the pump would later
+                # grant a slot nobody will ever release and admission
+                # wedges for the process lifetime
+                if entry in self._waiting:
+                    self._waiting.remove(entry)
+                elif entry.granted:
+                    self._running -= 1
+                    self._pump_locked()
+                raise
+            dt_ns = (time.perf_counter_ns() - t0) if waited else 0
+            wait_ms = dt_ns / 1e6
+            self._admitted += 1
+            self._total_wait_ms += wait_ms
+            self._waits_ms.append(wait_ms)
+        if _tr.TRACER.enabled:
+            # the admission wait as a first-class span on the
+            # correlated timeline (zero-length for immediate grants)
+            _tr.record_complete("serve.admit", t0, dt_ns,
+                                tenant=tenant, priority=prio)
+        update_serving_context(tenant=tenant, priority=prio,
+                               admit_wait_ms=round(wait_ms, 3))
+        return entry
+
+    def release(self, entry: _Entry) -> None:
+        with self._cv:
+            self._running -= 1
+            self._pump_locked()
+
+    # -- stats ------------------------------------------------------- #
+
+    @staticmethod
+    def _quantile(xs: list, q: float) -> float:
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        i = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        return xs[i]
+
+    def stats(self) -> dict:
+        with self._cv:
+            waits = list(self._waits_ms)
+            out = {
+                "admitted": self._admitted,
+                "rejected": self._rejected,
+                "running": self._running,
+                "waiting": len(self._waiting),
+                "total_wait_ms": round(self._total_wait_ms, 3),
+            }
+        out["wait_p50_ms"] = round(self._quantile(waits, 0.50), 3)
+        out["wait_p99_ms"] = round(self._quantile(waits, 0.99), 3)
+        return out
+
+    def reset_stats(self) -> None:
+        with self._cv:
+            self._admitted = 0
+            self._rejected = 0
+            self._total_wait_ms = 0.0
+            self._waits_ms.clear()
+
+
+# ------------------------------------------------------------------ #
+# Process-global instance (tracer/faults ownership discipline)
+# ------------------------------------------------------------------ #
+
+_SCHED: Optional[QueryScheduler] = None
+_LOCK = threading.Lock()
+
+
+def get_scheduler(conf=None) -> QueryScheduler:
+    """The process scheduler, created (and re-configured) from the
+    given conf.  Conf changes apply in place — live waiters see the new
+    limits at the next grant decision."""
+    from spark_rapids_tpu.config import get_conf
+
+    global _SCHED
+    conf = conf or get_conf()
+    want_max = int(conf.get(MAX_CONCURRENT))
+    want_depth = int(conf.get(QUEUE_DEPTH))
+    want_prio = int(conf.get(DEFAULT_PRIORITY))
+    with _LOCK:
+        if _SCHED is None:
+            _SCHED = QueryScheduler(want_max, want_depth, want_prio)
+            return _SCHED
+        s = _SCHED
+    if (s.max_concurrent, s.queue_depth, s.default_priority) != \
+            (want_max, want_depth, want_prio):
+        with s._cv:
+            s.max_concurrent = want_max
+            s.queue_depth = want_depth
+            s.default_priority = want_prio
+            s._pump_locked()
+    return s
+
+
+def scheduler_stats() -> dict:
+    with _LOCK:
+        s = _SCHED
+    return s.stats() if s is not None else {
+        "admitted": 0, "rejected": 0, "running": 0, "waiting": 0,
+        "total_wait_ms": 0.0, "wait_p50_ms": 0.0, "wait_p99_ms": 0.0}
+
+
+def reset() -> None:
+    """Drop the process scheduler (tests).  In-flight tickets release
+    against the old instance harmlessly."""
+    global _SCHED
+    with _LOCK:
+        _SCHED = None
+
+
+@contextmanager
+def admission(conf, tenant: str = "default",
+              priority: Optional[int] = None):
+    """The query-boundary hook: a no-op single conf read when serving
+    admission is disabled (maxConcurrent <= 0); otherwise admit through
+    the process scheduler for the duration of the block.  Re-entrant
+    per thread — a nested collect on an admitted thread (scalar
+    subquery prepass, CPU-compare runs inside an admitted bench driver)
+    passes straight through instead of deadlocking against itself."""
+    if int(conf.get(MAX_CONCURRENT)) <= 0:
+        try:
+            yield None
+        finally:
+            # a prepared query's plan-cache verdict was deposited (and
+            # consumed by query_end) inside this block; drop it so it
+            # cannot leak into a later query's record.  Conditional:
+            # the common plain-collect path never touched the context
+            if current_serving_context() is not None:
+                clear_serving_context()
+        return
+    tl = _ADMITTED_TL
+    if getattr(tl, "depth", 0) > 0:
+        # nested query on an admitted thread: pass through, but stash
+        # the OUTER query's serving context for the duration — the
+        # nested query's event-log capture must not report the outer
+        # admission wait / tenant / plan-cache verdict as its own
+        outer_ctx = current_serving_context()
+        clear_serving_context()
+        tl.depth += 1
+        try:
+            yield None
+        finally:
+            tl.depth -= 1
+            clear_serving_context()
+            if outer_ctx:
+                update_serving_context(**outer_ctx)
+        return
+    sched = get_scheduler(conf)
+    ticket = sched.admit(tenant, priority)
+    tl.depth = 1
+    try:
+        yield ticket
+    finally:
+        tl.depth = 0
+        sched.release(ticket)
+        clear_serving_context()
+
+
+_ADMITTED_TL = threading.local()

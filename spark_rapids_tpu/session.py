@@ -302,7 +302,9 @@ class TpuSession:
     """Counterpart of the SparkSession with the plugin installed
     (ref: SQLPlugin.scala — here session == plugin)."""
 
-    def __init__(self, conf: Optional[TpuConf] = None):
+    def __init__(self, conf: Optional[TpuConf] = None,
+                 tenant: str = "default",
+                 priority: Optional[int] = None):
         from spark_rapids_tpu.eventlog import maybe_writer
         from spark_rapids_tpu.tools.profiling import (
             HISTORY_CAPACITY,
@@ -310,6 +312,12 @@ class TpuSession:
         )
 
         self.conf = conf or get_conf()
+        #: serving-tier identity: which admission queue this session's
+        #: queries join, and with what weighted-fair share (None =
+        #: spark.rapids.tpu.serving.defaultPriority).  Inert unless
+        #: serving.maxConcurrent > 0 (docs/serving.md).
+        self.tenant = tenant
+        self.priority = priority
         #: recent TPU-collected queries, input to the profiling tool
         self.history = QueryHistory(
             int(self.conf.get(HISTORY_CAPACITY)))
@@ -318,6 +326,37 @@ class TpuSession:
         #: path's entire per-query cost is one `is not None` check in
         #: _collect_tpu (docs/eventlog.md)
         self._eventlog = maybe_writer(self.conf)
+        self._plan_cache = None  # lazy; most sessions never prepare
+
+    @property
+    def plan_cache(self):
+        """This session's prepared-plan cache (LRU of lowered exec
+        trees, spark.rapids.tpu.serving.planCache.capacity); created on
+        first use so non-serving sessions pay nothing."""
+        if self._plan_cache is None:
+            from spark_rapids_tpu.serving import PLAN_CACHE_CAPACITY
+            from spark_rapids_tpu.serving.plan_cache import PlanCache
+
+            self._plan_cache = PlanCache(
+                int(self.conf.get(PLAN_CACHE_CAPACITY)))
+        return self._plan_cache
+
+    def prepare(self, df: "DataFrame") -> "PreparedQuery":
+        """Prepare a DataFrame template: lower it ONCE into the plan
+        cache and return a PreparedQuery whose execute()/
+        execute_stream() re-drain the cached lowered plan — repeated
+        templates skip parse/plan/tag/lower entirely (docs/serving.md).
+        SQL-text templates with :name parameters prepare through
+        ``frontends.sql.SqlSession.prepare``."""
+        from spark_rapids_tpu.serving.prepared import PreparedQuery
+
+        if not isinstance(df, DataFrame):
+            raise TypeError(
+                "TpuSession.prepare takes a DataFrame; for SQL text "
+                "use frontends.sql.SqlSession.prepare(sql)")
+        pq = PreparedQuery(self, df=df)
+        pq._resolve(None)  # warm: pay the lowering at prepare time
+        return pq
 
     @property
     def event_log_path(self) -> Optional[str]:
@@ -382,6 +421,52 @@ class TpuSession:
 
         set_active_mesh(None)
         self.conf.set(SHUFFLE_TRANSPORT.key, "local")
+
+
+def _begin_query(session: "TpuSession", conf) -> tuple:
+    """Per-query prologue, ONE definition shared by the materialized
+    (`_collect_tpu_admitted`) and streaming (`_stream_tpu`) collect
+    paths so they can never drift: align the process-global subsystems
+    with this session's conf — the tracer (spans carry this query),
+    the fault registry (conf-armed chaos schedules take effect per
+    query) and the device semaphore (per-session concurrentTpuTasks
+    changes resize the live permit pool, which also re-sizes serving
+    admission) — then allocate the query id, snapshot the event-log
+    counters (the per-query event-log check: `elog` is None when
+    disabled — no writer thread, nothing on the batch loop) and stamp
+    the clocks.
+
+    Returns (qid, elog, pre, conf_hash, start_ts, t0, t0_ns)."""
+    import time as _time
+
+    from spark_rapids_tpu import trace as _trace
+    from spark_rapids_tpu.eventlog import conf_fingerprint
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.robustness import faults as _faults
+
+    _trace.sync_conf(conf)
+    _faults.sync_conf(conf)
+    TpuSemaphore.sync_conf(conf)
+    qid = session.history.allocate_id()
+    elog = session._eventlog
+    pre = elog.query_begin() if elog is not None else None
+    return (qid, elog, pre, conf_fingerprint(conf), _time.time(),
+            _time.perf_counter(), _time.perf_counter_ns())
+
+
+def _record_query(session: "TpuSession", explain_text: str, exec_tree,
+                  qid: int, conf_hash: str, start_ts: float, t0: float,
+                  t0_ns: int, on_event) -> None:
+    """Per-query epilogue shared by the collect paths: the history
+    record with the full clock set (the event-log hook rides
+    `on_event` onto the snapshot worker)."""
+    import time as _time
+
+    session.history.record(
+        explain_text, exec_tree, _time.perf_counter() - t0,
+        query_id=qid, start_ts=start_ts, end_ts=_time.time(),
+        start_ns=t0_ns, end_ns=_time.perf_counter_ns(),
+        conf_hash=conf_hash, on_event=on_event)
 
 
 def _prune_scan_columns(plan, exprs):
@@ -921,41 +1006,53 @@ class DataFrame:
             return execute_cpu(self._plan)
         return self._collect_tpu()[0]
 
-    def _collect_tpu(self) -> tuple[pa.Table, int]:
+    def _collect_tpu(self, exec_=None, meta=None, drain_lock=None,
+                     serving_facts=None) -> tuple[pa.Table, int]:
         """TPU-engine collect; returns (result, query_id) so callers
         that need the history/trace correlation key (EXPLAIN ANALYZE)
         can find THEIR event instead of trusting events[-1] under
-        concurrent collects."""
+        concurrent collects.
+
+        With a prebuilt (exec_, meta) — the prepared-plan-cache hit
+        path (serving/prepared.py) — planning is skipped entirely: no
+        query.plan/tag/lower spans, the cached lowered tree is drained
+        directly.  Either way the query passes through the serving
+        tier's admission control first (a single conf read when
+        serving.maxConcurrent is 0, the default).
+
+        `drain_lock` (the cache entry's re-drain lock) is acquired
+        INSIDE admission: taking it before would deadlock when an
+        admitted query nested-executes the template a waiting thread
+        already locked.  `serving_facts` (the plan-cache verdict) is
+        deposited into the serving context inside the query's
+        admission scope, so a nested query's facts land in ITS record
+        and never pollute the outer query's."""
+        import contextlib
+
         conf = self._session.conf
-        import time as _time
+        from spark_rapids_tpu.serving import update_serving_context
+        from spark_rapids_tpu.serving.scheduler import admission
+
+        with admission(conf, tenant=self._session.tenant,
+                       priority=self._session.priority):
+            if serving_facts:
+                update_serving_context(**serving_facts)
+            with drain_lock if drain_lock is not None \
+                    else contextlib.nullcontext():
+                return self._collect_tpu_admitted(exec_, meta)
+
+    def _collect_tpu_admitted(self, exec_=None,
+                              meta=None) -> tuple[pa.Table, int]:
+        conf = self._session.conf
 
         from spark_rapids_tpu import trace as _trace
-
-        # align the process tracer with this session's conf, and make
-        # the query's id the correlation attribute every span records —
-        # including spans from prefetch stages, the exchange map pool
-        # and the metric reaper, which receive it by context capture
-        _trace.sync_conf(conf)
-        # same boundary sync for the fault-injection registry (chaos
-        # mode): conf-armed schedules take effect per query
-        from spark_rapids_tpu.robustness import faults as _faults
-
-        _faults.sync_conf(conf)
         from spark_rapids_tpu.eventlog import (
-            conf_fingerprint,
             render_plan_report,
             table_digest,
         )
 
-        qid = self._session.history.allocate_id()
-        # THE per-query event-log check: None when disabled (no writer
-        # thread, no conf lookup, nothing on the batch loop)
-        elog = self._session._eventlog
-        pre = elog.query_begin() if elog is not None else None
-        conf_hash = conf_fingerprint(conf)
-        start_ts = _time.time()
-        t0 = _time.perf_counter()
-        t0_ns = _time.perf_counter_ns()
+        qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
+            _begin_query(self._session, conf)
 
         def _on_event(render_plan, engine: str, result):
             """History-worker hook appending the event-log record once
@@ -976,8 +1073,9 @@ class DataFrame:
                 rows=result.num_rows)
 
         with _trace.trace_context(query_id=qid):
-            with _trace.span("query.plan"):
-                exec_, meta = plan_query(self._plan, conf)
+            if exec_ is None:
+                with _trace.span("query.plan"):
+                    exec_, meta = plan_query(self._plan, conf)
             try:
                 with _trace.span("query.execute"):
                     out = collect_exec(exec_)
@@ -1007,24 +1105,96 @@ class DataFrame:
                 # checker's CPU-fallback rule keys off this record)
                 expl = (meta.explain() + "\n[degraded to CPU engine: "
                         f"{type(e).__name__}]")
-                self._session.history.record(
-                    expl, exec_, _time.perf_counter() - t0,
-                    query_id=qid, start_ts=start_ts,
-                    end_ts=_time.time(), start_ns=t0_ns,
-                    end_ns=_time.perf_counter_ns(),
-                    conf_hash=conf_hash,
-                    on_event=_on_event(lambda: expl, "cpu_fallback",
-                                       out))
+                _record_query(
+                    self._session, expl, exec_, qid, conf_hash,
+                    start_ts, t0, t0_ns,
+                    _on_event(lambda: expl, "cpu_fallback", out))
                 return out, qid
-            self._session.history.record(
-                meta.explain(), exec_, _time.perf_counter() - t0,
-                query_id=qid, start_ts=start_ts, end_ts=_time.time(),
-                start_ns=t0_ns, end_ns=_time.perf_counter_ns(),
-                conf_hash=conf_hash,
-                on_event=_on_event(
-                    lambda: render_plan_report(exec_, meta), "tpu",
-                    out))
+            _record_query(
+                self._session, meta.explain(), exec_, qid, conf_hash,
+                start_ts, t0, t0_ns,
+                _on_event(lambda: render_plan_report(exec_, meta),
+                          "tpu", out))
         return out, qid
+
+    def _stream_tpu(self, exec_=None, meta=None,
+                    batch_rows: Optional[int] = None,
+                    drain_lock=None, serving_facts=None):
+        """Streaming TPU collect (serving tier): yield the result as
+        Arrow record batches INCREMENTALLY off the pipelined fetch path
+        (planner.stream_exec) instead of one materialized table, with
+        backpressure from the prefetch stage's bounded queue.  Admitted,
+        traced and history/event-log-recorded like _collect_tpu (the
+        record carries rows but no result digest — the batches were
+        never held together); no CPU-degrade ladder mid-stream: a
+        device failure raises to the consumer, who may re-run via
+        collect().  The admission slot is held until the stream drains
+        or the generator is closed."""
+        import contextlib
+        import time as _time
+
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.plan.planner import stream_exec
+        from spark_rapids_tpu.serving import update_serving_context
+        from spark_rapids_tpu.serving.scheduler import admission
+
+        conf = self._session.conf
+        with admission(conf, tenant=self._session.tenant,
+                       priority=self._session.priority), \
+                (drain_lock if drain_lock is not None
+                 else contextlib.nullcontext()):
+            if serving_facts:
+                update_serving_context(**serving_facts)
+            qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
+                _begin_query(self._session, conf)
+            with _trace.trace_context(query_id=qid):
+                if exec_ is None:
+                    with _trace.span("query.plan"):
+                        exec_, meta = plan_query(self._plan, conf)
+                tctx = _trace.current_context()
+            rows = 0
+            gen = stream_exec(exec_, stage="serve.stream.fetch")
+            try:
+                while True:
+                    # re-attach the query's trace context around each
+                    # pull (NOT across yields: the consumer's own work
+                    # between pulls must not inherit this query's id)
+                    with _trace.attach_context(tctx):
+                        try:
+                            tbl = next(gen)
+                        except StopIteration:
+                            break
+                    rows += tbl.num_rows
+                    for rb in tbl.to_batches(max_chunksize=batch_rows):
+                        yield rb
+            finally:
+                gen.close()
+            # fully drained: record the query (an ABANDONED stream —
+            # generator closed early — records nothing; its partial
+            # metrics would read as a complete run).  The execute span
+            # is recorded whole-drain so span-derived busy/self
+            # analytics see streamed queries like collected ones.
+            if _trace.TRACER.enabled:
+                _trace.record_complete(
+                    "query.execute", t0_ns,
+                    _time.perf_counter_ns() - t0_ns, query_id=qid,
+                    streamed=True)
+            streamed = rows
+
+            def _on_event(render_plan):
+                if elog is None:
+                    return None
+                post = elog.query_end(pre)
+                return lambda ev: elog.log_query(
+                    ev, post, render_plan(), "tpu",
+                    result_digest=None, rows=streamed)
+
+            from spark_rapids_tpu.eventlog import render_plan_report
+
+            _record_query(
+                self._session, meta.explain(), exec_, qid, conf_hash,
+                start_ts, t0, t0_ns,
+                _on_event(lambda: render_plan_report(exec_, meta)))
 
     def to_batches(self, batch_rows: Optional[int] = None):
         """Stream the result as Arrow record batches (the ColumnarRdd
@@ -1057,10 +1227,13 @@ class DataFrame:
             from spark_rapids_tpu.robustness import faults as _faults
             from spark_rapids_tpu.tools.profiling import render_analyze
 
+            from spark_rapids_tpu.serving import plan_cache as _pc
+
             before = cache_stats()
             retry0 = retry_stats()
             faults0 = _faults.recovered_total()
             rf0 = _rf.stats()
+            pc0 = _pc.stats()
             _out, qid = self._collect_tpu()
             after = cache_stats()
             # per-QUERY deltas (counters are process-wide cumulative;
@@ -1071,12 +1244,19 @@ class DataFrame:
                   "misses": after["misses"] - before["misses"]}
             retry1 = retry_stats()
             rf1 = _rf.stats()
+            pc1 = _pc.stats()
             counters = {
                 "retry": {k: max(0, retry1[k] - retry0[k])
                           for k in retry1},
                 "faults_recovered": max(
                     0, _faults.recovered_total() - faults0),
                 "rf": {k: max(0, rf1[k] - rf0[k]) for k in rf1},
+                # prepared-plan cache activity in this window (nonzero
+                # when the analyzed collect rode a PreparedQuery or a
+                # concurrent session resolved one — docs/serving.md)
+                "plan_cache": {
+                    k: max(0, pc1[k] - pc0[k])
+                    for k in ("hits", "misses", "evictions")},
             }
             # find OUR event by id — events[-1] may be a concurrent
             # collect's record (fall back to it only if concurrent

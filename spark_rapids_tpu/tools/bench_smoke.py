@@ -21,6 +21,13 @@ tests/test_runtime_filter.py).
 metrics identical to the session's settled QueryHistory snapshot
 (tier-1 via tests/test_eventlog.py).
 
+`run_serving_smoke` holds the serving-tier contract
+(spark_rapids_tpu/serving/, docs/serving.md): a prepared template's
+second execution is a plan-cache hit that never re-enters plan_query,
+a streamed fetch equals collect() to the bit, and two sessions under
+maxConcurrent=1 admission both complete with identical digests
+(tier-1 via tests/test_serving.py).
+
 Run: python -m spark_rapids_tpu.tools.bench_smoke
 """
 
@@ -234,6 +241,128 @@ def run_eventlog_smoke() -> dict:
     return out
 
 
+def run_serving_smoke() -> dict:
+    """Serving-tier acceptance contract, cheap CI form (tier-1 via
+    tests/test_serving.py): two concurrent sessions under admission
+    control (maxConcurrent=1, so one of them measurably waits), a
+    prepared SQL template whose SECOND execution is a plan-cache hit
+    that performs no plan/tag/lower work, and a streamed fetch whose
+    concatenation equals collect() to the bit."""
+    import threading
+
+    import pyarrow as pa
+
+    import numpy as np
+
+    from spark_rapids_tpu.config import TpuConf, get_conf, set_conf
+    from spark_rapids_tpu.eventlog import table_digest
+    from spark_rapids_tpu.frontends.sql import SqlSession
+    from spark_rapids_tpu.serving import plan_cache as plan_cache_mod
+    from spark_rapids_tpu.serving import scheduler as scheduler_mod
+    from spark_rapids_tpu.plan import planner as planner_mod
+
+    rng = np.random.default_rng(0x5E17)
+    n = 4096
+    t = pa.table({
+        "k": rng.integers(0, 32, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+    out: dict = {}
+    base = dict(get_conf()._values)
+    scheduler_mod.reset()
+    plan_cache_mod.reset_stats()
+    try:
+        # -- prepared SQL template: second execution must be a HIT
+        # that never re-enters plan_query -------------------------- #
+        conf = TpuConf(base)
+        set_conf(conf)
+        ss = SqlSession(conf)
+        ss.register_table("t", t)
+        pq = ss.prepare("select k, sum(v) as sv, count(*) as n from t "
+                        "where k < :kmax group by k order by k")
+        first = pq.execute(params={"kmax": 16})
+        calls = [0]
+        orig_plan_query = planner_mod.plan_query
+
+        def counting_plan_query(*a, **kw):
+            calls[0] += 1
+            return orig_plan_query(*a, **kw)
+
+        # patch EVERY import binding: session.py binds plan_query at
+        # module level, so patching only the planner module would let
+        # a hit path that regressed to re-lowering pass unobserved
+        import spark_rapids_tpu.session as session_mod
+
+        planner_mod.plan_query = counting_plan_query
+        session_mod.plan_query = counting_plan_query
+        try:
+            second = pq.execute(params={"kmax": 16})
+        finally:
+            planner_mod.plan_query = orig_plan_query
+            session_mod.plan_query = orig_plan_query
+        assert calls[0] == 0, \
+            f"plan-cache hit re-lowered the template ({calls[0]}x)"
+        assert table_digest(first) == table_digest(second)
+        pc = plan_cache_mod.stats()
+        assert pc["hits"] >= 1, pc
+        out["serving_plan_cache_hits"] = pc["hits"]
+
+        # -- stream == collect, to the bit ------------------------- #
+        batches = list(pq.execute_stream(params={"kmax": 16}))
+        stream_tbl = pa.Table.from_batches(batches,
+                                           schema=first.schema)
+        assert table_digest(stream_tbl) == table_digest(first), \
+            "streamed result != collected result"
+        out["serving_stream_rows"] = stream_tbl.num_rows
+
+        # -- two sessions, one admission slot ---------------------- #
+        over = dict(base)
+        over["spark.rapids.tpu.serving.maxConcurrent"] = 1
+        over["spark.rapids.tpu.serving.queueDepth"] = 8
+        scheduler_mod.reset()
+        results: list = []
+        errors: list = []
+
+        def run(i: int) -> None:
+            try:
+                c = TpuConf(over)
+                set_conf(c)
+                from spark_rapids_tpu.session import TpuSession, col
+                from spark_rapids_tpu.session import sum_ as _sum
+
+                sess = TpuSession(c, tenant=f"tenant{i}")
+                df = (sess.create_dataframe(t)
+                      .group_by(col("k"))
+                      .agg((_sum(col("v")), "sv"))
+                      .order_by(col("k")))
+                spq = sess.prepare(df)
+                for _ in range(3):
+                    results.append(table_digest(spq.execute()))
+            except BaseException as e:  # noqa: BLE001 — reported below
+                errors.append(e)
+
+        ths = [threading.Thread(target=run, args=(i,))
+               for i in range(2)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        assert not errors, errors
+        assert len(set(results)) == 1, \
+            "concurrent sessions produced diverging results"
+        st = scheduler_mod.scheduler_stats()
+        assert st["admitted"] >= 6, st
+        assert st["rejected"] == 0, st
+        out["serving_admitted"] = st["admitted"]
+    finally:
+        conf = get_conf()
+        conf._values.clear()
+        conf._values.update(base)
+        set_conf(conf)
+        scheduler_mod.reset()
+    return out
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -275,6 +404,7 @@ def main() -> int:
     results = run_smoke()
     results.update(run_rf_smoke())
     results.update(run_eventlog_smoke())
+    results.update(run_serving_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

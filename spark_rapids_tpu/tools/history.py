@@ -15,7 +15,8 @@ four analyses operate on one or many of them:
 - ``health``   — HealthCheck: a rule registry flagging unhealthy runs
   (CPU fallbacks, retry storms, spill thrash, jit-cache miss-budget
   blowouts, steady-state blocking readbacks, starved pipelines,
-  runtime filters that pruned nothing).
+  runtime filters that pruned nothing, serving-tier admission waits
+  past the conf budget).
 - ``report``   — the fleet-style regression report: one markdown
   document with run fingerprints, the compare matrix, and per-run
   health findings.
@@ -465,6 +466,33 @@ def _hc_recovered_faults(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_admission_wait(q: QueryRecord) -> Optional[str]:
+    """HC009: this query's serving-tier admission wait blew the
+    conf budget (spark.rapids.tpu.serving.health.admitWaitBudgetMs) —
+    the serving tier is saturated for its traffic.  Fed from the
+    serve.admit_wait_ms event-log counter the scheduler deposits per
+    query; queries that never passed admission carry no counter and
+    stay silent.  bench.py --sessions reports the fleet-level
+    admission_wait_p99_ms next to this per-query flag."""
+    w = q.counter("serve.admit_wait_ms")
+    if w <= 0:
+        return None
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.serving import ADMIT_WAIT_BUDGET_MS
+
+    budget = float(get_conf().get(ADMIT_WAIT_BUDGET_MS))
+    if w > budget:
+        tenant = ""
+        serving = q.raw.get("serving") or {}
+        if serving.get("tenant"):
+            tenant = f" (tenant {serving['tenant']!r})"
+        return (f"admission wait {w:.0f}ms above the "
+                f"{budget:.0f}ms budget{tenant} — the serving tier "
+                "is saturated; raise serving.maxConcurrent, shed "
+                "load, or add replicas (docs/serving.md)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -473,7 +501,8 @@ for _id, _sev, _fn in (
         ("HC005", "warning", _hc_blocking_readbacks),
         ("HC006", "warning", _hc_starved_pipeline),
         ("HC007", "warning", _hc_rf_no_prune),
-        ("HC008", "info", _hc_recovered_faults)):
+        ("HC008", "info", _hc_recovered_faults),
+        ("HC009", "warning", _hc_admission_wait)):
     register_health_rule(_id, _sev, _fn)
 
 

@@ -253,6 +253,12 @@ def _counter_footer(counters: Optional[dict]) -> list[str]:
             f"runtime filters: built={rf.get('filters_built', 0)} "
             f"pruned_rows={rf.get('pruned_rows', 0)} "
             f"row_groups_pruned={rf.get('row_groups_pruned', 0)}")
+    pc = counters.get("plan_cache")
+    if pc is not None:
+        lines.append(
+            f"plan cache: hits={pc.get('hits', 0)} "
+            f"misses={pc.get('misses', 0)} "
+            f"evictions={pc.get('evictions', 0)}")
     return lines
 
 

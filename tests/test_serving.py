@@ -1,0 +1,726 @@
+"""Serving-tier tests: admission control (weighted-fair scheduler over
+the device semaphore), the prepared-plan cache, streaming result fetch,
+and THE concurrent-session stress test (N sessions x M queries with
+distinct confs, results bit-identical to serial execution).
+
+Process-global state discipline: the scheduler, the plan-cache
+counters and the semaphore singleton are reset around every test (the
+tracer follows test_trace's rules)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf, get_conf, set_conf
+from spark_rapids_tpu.eventlog import table_digest
+from spark_rapids_tpu.frontends.sql import SqlError, SqlSession
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.serving import (
+    clear_serving_context,
+    current_serving_context,
+    plan_cache as plan_cache_mod,
+)
+from spark_rapids_tpu.serving.plan_cache import PlanCache
+from spark_rapids_tpu.serving.scheduler import (
+    AdmissionRejected,
+    QueryScheduler,
+    scheduler_stats,
+)
+from spark_rapids_tpu.serving import scheduler as scheduler_mod
+from spark_rapids_tpu.session import TpuSession, col, count_star, sum_
+
+
+@pytest.fixture(autouse=True)
+def _isolate_serving():
+    scheduler_mod.reset()
+    plan_cache_mod.reset_stats()
+    clear_serving_context()
+    TpuSemaphore.reset()
+    yield
+    scheduler_mod.reset()
+    plan_cache_mod.reset_stats()
+    clear_serving_context()
+    TpuSemaphore.reset()
+    from spark_rapids_tpu import trace
+
+    trace.disable()
+    trace.clear()
+
+
+def _table(n=4096, keys=16, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, keys, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _agg_df(session, t):
+    """Deterministic (integer sums, ordered output) grouped aggregate:
+    digest-stable across runs and thread interleavings."""
+    return (session.create_dataframe(t)
+            .group_by(col("k"))
+            .agg((sum_(col("v")), "sv"), (count_star(), "n"))
+            .order_by(col("k")))
+
+
+# ------------------------------------------------------------------ #
+# Semaphore resize / sync_conf (the PR's satellite fix)
+# ------------------------------------------------------------------ #
+
+
+def test_semaphore_resize_wakes_waiters():
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary("a")
+    got = threading.Event()
+
+    def waiter():
+        sem.acquire_if_necessary("b")
+        got.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert not got.wait(0.1), "second task got a permit from a 1-permit pool"
+    sem.resize(2)
+    assert got.wait(2.0), "resize(2) did not wake the blocked waiter"
+    t.join()
+    sem.release_if_necessary("a")
+    sem.release_if_necessary("b")
+    assert sem._available == 2
+
+
+def test_semaphore_shrink_blocks_new_admissions():
+    sem = TpuSemaphore(2)
+    sem.acquire_if_necessary("a")
+    sem.acquire_if_necessary("b")
+    sem.resize(1)
+    assert sem._available == -1  # both holders finish first
+    sem.release_if_necessary("a")
+    got = threading.Event()
+
+    def waiter():
+        sem.acquire_if_necessary("c")
+        got.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert not got.wait(0.1)
+    sem.release_if_necessary("b")  # now a permit is truly free
+    assert got.wait(2.0)
+    t.join()
+
+
+def test_semaphore_sync_conf_resizes_without_restart():
+    conf = get_conf()
+    sem = TpuSemaphore.get()
+    base = sem.permits
+    conf.set("spark.rapids.tpu.sql.concurrentTpuTasks", base + 3)
+    TpuSemaphore.sync_conf(conf)
+    assert TpuSemaphore.get() is sem  # LIVE resize, not a new instance
+    assert sem.permits == base + 3
+    # the owner conf may move it back to the default
+    conf.set("spark.rapids.tpu.sql.concurrentTpuTasks", base)
+    TpuSemaphore.sync_conf(conf)
+    assert sem.permits == base
+
+
+def test_semaphore_sync_conf_default_conf_cannot_shrink_owner():
+    from spark_rapids_tpu.config import CONCURRENT_TPU_TASKS
+
+    owner = TpuConf({CONCURRENT_TPU_TASKS.key: 5})
+    TpuSemaphore.get()
+    TpuSemaphore.sync_conf(owner)
+    assert TpuSemaphore.get().permits == 5
+    other = TpuConf()  # carries the default
+    TpuSemaphore.sync_conf(other)
+    assert TpuSemaphore.get().permits == 5, \
+        "a defaults-only conf shrank another session's explicit resize"
+    # but the owner itself can restore the default
+    owner.set(CONCURRENT_TPU_TASKS.key, CONCURRENT_TPU_TASKS.default)
+    TpuSemaphore.sync_conf(owner)
+    assert TpuSemaphore.get().permits == CONCURRENT_TPU_TASKS.default
+
+
+# ------------------------------------------------------------------ #
+# Scheduler semantics
+# ------------------------------------------------------------------ #
+
+
+def _fill_slot(sched):
+    """Occupy every slot so later admits queue."""
+    tickets = []
+    for _ in range(sched.max_concurrent):
+        tickets.append(sched.admit("filler"))
+    return tickets
+
+
+def _queue_async(sched, tenant, priority, order, name):
+    done = threading.Event()
+
+    def run():
+        t = sched.admit(tenant, priority)
+        order.append(name)
+        sched.release(t)
+        done.set()
+
+    th = threading.Thread(target=run)
+    th.start()
+    return th, done
+
+
+def test_scheduler_weighted_fair_share():
+    """Priority-3 tenant should be admitted ~3x as often as a
+    priority-1 tenant under contention (start-time WFQ: vtime advances
+    1/3 vs 1 per grant)."""
+    sched = QueryScheduler(max_concurrent=1, queue_depth=64)
+    hold = _fill_slot(sched)
+    order: list = []
+    threads = []
+    # interleave enqueues so both tenants always have queued work
+    for i in range(4):
+        threads.append(_queue_async(sched, "light", 1, order,
+                                    f"L{i}")[0])
+        for j in range(3):
+            threads.append(_queue_async(sched, "heavy", 3, order,
+                                        f"H{i * 3 + j}")[0])
+    import time
+
+    time.sleep(0.2)  # all 16 queued behind the held slot
+    for t in hold:
+        sched.release(t)
+    for th in threads:
+        th.join(5.0)
+    assert len(order) == 16, order
+    first8 = order[:8]
+    heavy = sum(1 for n in first8 if n.startswith("H"))
+    assert heavy >= 5, f"heavy tenant under-served: {order}"
+    assert any(n.startswith("L") for n in first8), \
+        f"light tenant starved: {order}"
+
+
+def test_scheduler_rejects_past_queue_depth():
+    sched = QueryScheduler(max_concurrent=1, queue_depth=1)
+    hold = _fill_slot(sched)
+    th, _done = _queue_async(sched, "t", 1, [], "q1")
+    import time
+
+    time.sleep(0.1)  # q1 parked in the queue
+    with pytest.raises(AdmissionRejected, match="queue full"):
+        sched.admit("t")
+    assert sched.stats()["rejected"] == 1
+    for t in hold:
+        sched.release(t)
+    th.join(5.0)
+
+
+def test_scheduler_clamps_to_semaphore_permits():
+    """maxConcurrent above the device semaphore's permit count clamps:
+    admission rides the same budget that caps batch residency."""
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.concurrentTpuTasks", 2)
+    TpuSemaphore.reset()
+    TpuSemaphore.get()
+    sched = QueryScheduler(max_concurrent=16, queue_depth=8)
+    assert sched._limit() == 2
+    TpuSemaphore.get().resize(5)
+    assert sched._limit() == 5
+
+
+def test_scheduler_records_wait_and_context():
+    sched = QueryScheduler(max_concurrent=1, queue_depth=8)
+    t1 = sched.admit("a")
+    ctx = current_serving_context()
+    assert ctx["tenant"] == "a" and ctx["admit_wait_ms"] == 0.0
+    done = threading.Event()
+    waited_ms = []
+
+    def second():
+        t2 = sched.admit("b", priority=2)
+        waited_ms.append(current_serving_context()["admit_wait_ms"])
+        sched.release(t2)
+        done.set()
+
+    th = threading.Thread(target=second)
+    th.start()
+    import time
+
+    time.sleep(0.15)
+    sched.release(t1)
+    assert done.wait(5.0)
+    th.join()
+    assert waited_ms[0] >= 100.0, waited_ms  # really waited
+    st = sched.stats()
+    assert st["admitted"] == 2
+    assert st["wait_p99_ms"] >= 100.0
+
+
+def test_admission_disabled_is_inert_and_reentrant():
+    conf = get_conf()
+    assert int(conf.get("spark.rapids.tpu.serving.maxConcurrent")) == 0
+    with scheduler_mod.admission(conf) as ticket:
+        assert ticket is None
+    assert scheduler_stats()["admitted"] == 0
+    # enabled: nested admission on one thread must not self-deadlock
+    conf.set("spark.rapids.tpu.serving.maxConcurrent", 1)
+    with scheduler_mod.admission(conf, tenant="x") as t1:
+        assert t1 is not None
+        with scheduler_mod.admission(conf, tenant="x") as t2:
+            assert t2 is None  # re-entrant passthrough
+    assert scheduler_stats()["admitted"] == 1
+
+
+# ------------------------------------------------------------------ #
+# Prepared-plan cache
+# ------------------------------------------------------------------ #
+
+
+def test_exec_tree_is_redrainable():
+    """The cache's load-bearing assumption: collect_exec on one lowered
+    tree twice returns identical results (close() resets join builds /
+    shuffle registrations)."""
+    from spark_rapids_tpu.plan.planner import collect_exec, plan_query
+
+    s = TpuSession()
+    df = _agg_df(s, _table())
+    exec_, _meta = plan_query(df._plan, s.conf)
+    r1 = collect_exec(exec_)
+    r2 = collect_exec(exec_)
+    assert r1.equals(r2)
+
+
+def test_prepared_hit_skips_lowering_and_matches():
+    import spark_rapids_tpu.session as session_mod
+    from spark_rapids_tpu.plan import planner as planner_mod
+
+    s = TpuSession()
+    pq = s.prepare(_agg_df(s, _table()))
+    first = pq.execute()
+    calls = [0]
+    orig = planner_mod.plan_query
+
+    def counting(*a, **kw):
+        calls[0] += 1
+        return orig(*a, **kw)
+
+    # both import bindings: prepared.py resolves through the planner
+    # module, session.py through its own module-level import
+    planner_mod.plan_query = counting
+    session_mod.plan_query = counting
+    try:
+        second = pq.execute()
+    finally:
+        planner_mod.plan_query = orig
+        session_mod.plan_query = orig
+    assert calls[0] == 0, "cache hit re-entered plan_query"
+    assert table_digest(first) == table_digest(second)
+    st = plan_cache_mod.stats()
+    assert st["hits"] >= 2 and st["misses"] == 1, st
+
+
+def test_prepared_distinct_templates_distinct_entries():
+    s = TpuSession()
+    t = _table()
+    pq1 = s.prepare(_agg_df(s, t))
+    pq2 = s.prepare(s.create_dataframe(t)
+                    .group_by(col("k"))
+                    .agg((sum_(col("v")), "other_name"))
+                    .order_by(col("k")))
+    assert len(s.plan_cache) == 2
+    assert pq1.execute().column_names != pq2.execute().column_names
+
+
+def test_prepared_conf_epoch_changes_key():
+    """Lowering reads conf, so a conf change must not reuse the old
+    lowered tree — the key includes the conf fingerprint."""
+    s = TpuSession()
+    pq = s.prepare(_agg_df(s, _table()))
+    pq.execute()
+    misses0 = plan_cache_mod.stats()["misses"]
+    s.conf.set("spark.rapids.tpu.sql.batchSizeRows", 512)
+    r = pq.execute()  # new conf epoch: re-lowered, not stale-hit
+    assert plan_cache_mod.stats()["misses"] == misses0 + 1
+    assert r.num_rows == 16
+
+
+def test_plan_cache_lru_eviction_closes_and_recounts():
+    s = TpuSession()
+    s._plan_cache = PlanCache(capacity=2)
+    t = _table()
+    for i in range(3):
+        s.prepare(s.create_dataframe(t)
+                  .group_by(col("k"))
+                  .agg((sum_(col("v")), f"sv{i}"))
+                  .order_by(col("k")))
+    st = plan_cache_mod.stats()
+    assert st["evictions"] == 1 and len(s.plan_cache) == 2
+    # evicted template still works — it just re-lowers
+    pq = s.prepare(s.create_dataframe(t)
+                   .group_by(col("k"))
+                   .agg((sum_(col("v")), "sv0"))
+                   .order_by(col("k")))
+    assert pq.execute().num_rows == 16
+
+
+def test_prepared_sql_template_params_and_bindings():
+    t = _table()
+    ss = SqlSession()
+    ss.register_table("t", t)
+    pq = ss.prepare("select k, sum(v) as sv from t where k < :kmax "
+                    "group by k order by k")
+    assert pq.param_names == frozenset({"kmax"})
+    a8 = pq.execute(params={"kmax": 8})
+    b8 = pq.execute(params={"kmax": 8})   # same binding: HIT
+    a4 = pq.execute(params={"kmax": 4})   # new binding: its own entry
+    assert a8.num_rows == 8 and a4.num_rows == 4
+    assert table_digest(a8) == table_digest(b8)
+    st = plan_cache_mod.stats()
+    assert st["hits"] >= 1 and st["misses"] == 2, st
+    with pytest.raises(SqlError, match="unbound parameter :kmax"):
+        pq.execute()
+
+
+def test_template_key_distinguishes_shared_subplans():
+    """DAG-shaped plans that share repeated subplan OBJECTS must key by
+    WHICH node repeats: union(a,b)+a and union(a,b)+b differ only in
+    the shared leg, and colliding them would re-drain the wrong cached
+    tree."""
+    from spark_rapids_tpu.serving.plan_cache import template_key
+
+    s = TpuSession()
+    a = s.create_dataframe(_table(seed=1))
+    b = s.create_dataframe(_table(seed=2))
+    ab_a = a.union(b).union(s.create_dataframe(_table(seed=1)))
+    # share the SAME plan objects for the repeat legs
+    aa = a.union(b)
+    aa._plan.children.append(a._plan)  # union(a, b, a) with shared a
+    bb = a.union(b)
+    bb._plan.children.append(b._plan)  # union(a, b, b) with shared b
+    conf = get_conf()
+    assert template_key(aa._plan, conf) != template_key(bb._plan, conf)
+    assert template_key(ab_a._plan, conf)  # content-digested, no crash
+
+
+def test_sql_template_key_preserves_string_literal_whitespace():
+    """Whitespace normalization must not reach inside string literals:
+    'a  b' and 'a b' are different queries and must never share one
+    cache entry (a stale hit would answer the wrong query)."""
+    from spark_rapids_tpu.serving.plan_cache import sql_template_key
+
+    conf = get_conf()
+    k1 = sql_template_key("select * from t where s = 'a  b'", conf)
+    k2 = sql_template_key("select * from t where s = 'a b'", conf)
+    assert k1 != k2
+    # benign formatting differences DO share a key
+    k3 = sql_template_key("select *\n  from t\n where s = 'a  b'",
+                          conf)
+    assert k1 == k3
+
+
+def test_nested_admission_does_not_inherit_serving_context():
+    """A nested collect on an admitted thread (subquery prepass,
+    CPU-compare) must not report the outer query's admission wait /
+    tenant as its own; the outer context is restored afterwards."""
+    from spark_rapids_tpu.serving import update_serving_context
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.serving.maxConcurrent", 1)
+    with scheduler_mod.admission(conf, tenant="outer"):
+        update_serving_context(plan_cache="hit")
+        outer = current_serving_context()
+        assert outer["tenant"] == "outer"
+        with scheduler_mod.admission(conf, tenant="ignored"):
+            assert current_serving_context() is None
+        restored = current_serving_context()
+        assert restored["tenant"] == "outer"
+        assert restored["plan_cache"] == "hit"
+
+
+def test_sql_named_params_inline_and_errors():
+    t = _table()
+    ss = SqlSession()
+    ss.register_table("t", t)
+    r = ss.sql("select k, sum(v) as sv from t where k = :k group by k",
+               params={"k": 3})
+    out = r.collect(engine="tpu")
+    assert out.num_rows == 1 and out.to_pydict()["k"] == [3]
+    # typed literal binding: str / bool / date / None
+    import datetime as dt
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.frontends.sql import _param_literal
+
+    assert _param_literal("s", "abc", 0).dtype == T.STRING
+    assert _param_literal("b", True, 0).dtype == T.BOOLEAN
+    dlit = _param_literal("d", dt.date(1996, 1, 1), 0)
+    assert dlit.dtype == T.DATE and dlit.value == 9496
+    assert _param_literal("n", None, 0).value is None
+    with pytest.raises(SqlError, match="unbound parameter :missing"):
+        ss.sql("select * from t where k = :missing")
+    with pytest.raises(SqlError, match="unknown parameter"):
+        ss.sql("select * from t where k = :k",
+               params={"k": 1, "typo": 2})
+    with pytest.raises(SqlError, match="unsupported type"):
+        ss.sql("select * from t where k = :k", params={"k": [1, 2]})
+
+
+# ------------------------------------------------------------------ #
+# Streaming result fetch
+# ------------------------------------------------------------------ #
+
+
+def test_execute_stream_matches_collect_multibatch():
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 512)
+    s = TpuSession()
+    t = _table(n=4096)
+    # projection+filter (no aggregate): output stays multi-batch, so
+    # the stream actually streams
+    df = (s.create_dataframe(t)
+          .where(col("k") < col("v"))
+          .select(col("k"), (col("v") + col("k")).alias("vk")))
+    pq = s.prepare(df)
+    collected = pq.execute()
+    batches = list(pq.execute_stream())
+    assert len(batches) > 1, "stream produced one giant batch"
+    streamed = pa.Table.from_batches(batches, schema=collected.schema)
+    assert table_digest(streamed) == table_digest(collected)
+    # batch_rows re-chunks without changing content
+    rechunked = list(pq.execute_stream(batch_rows=100))
+    assert all(rb.num_rows <= 100 for rb in rechunked)
+    assert table_digest(
+        pa.Table.from_batches(rechunked, schema=collected.schema)) \
+        == table_digest(collected)
+
+
+def test_execute_stream_early_close_releases_everything():
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 256)
+    conf.set("spark.rapids.tpu.serving.maxConcurrent", 1)
+    s = TpuSession()
+    df = (s.create_dataframe(_table(n=4096))
+          .where(col("k") >= 0)
+          .select(col("k"), col("v")))
+    pq = s.prepare(df)
+    gen = pq.execute_stream()
+    next(gen)
+    gen.close()  # abandon mid-stream
+    # entry lock AND the admission slot must be free again; run the
+    # re-execute on a guard thread so a leak fails instead of hanging
+    out: list = []
+    th = threading.Thread(target=lambda: out.append(pq.execute()))
+    th.start()
+    th.join(60.0)
+    assert out, "abandoned stream leaked its admission slot/entry lock"
+    assert out[0].num_rows == 4096
+
+
+def test_open_stream_same_thread_reexecute_raises_not_deadlocks():
+    """A partially consumed stream holds the template's drain lock on
+    the consumer thread; re-executing the same template there must
+    raise immediately with an explanation (a plain lock would hang the
+    thread forever — reproduced before the DrainLock owner check)."""
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 256)
+    s = TpuSession()
+    df = (s.create_dataframe(_table(n=2048))
+          .where(col("k") >= 0).select(col("k"), col("v")))
+    pq = s.prepare(df)
+    gen = pq.execute_stream()
+    next(gen)
+    with pytest.raises(RuntimeError, match="still draining"):
+        pq.execute()
+    with pytest.raises(RuntimeError, match="still draining"):
+        next(pq.execute_stream())
+    # drain the open stream: the lock releases and execution works
+    for _ in gen:
+        pass
+    assert pq.execute().num_rows == 2048
+
+
+def test_eviction_of_streaming_entry_does_not_block():
+    """Evicting an entry whose drain lock is held (an open stream on
+    THIS thread) must neither hang nor raise — the in-flight drain
+    closes its own tree when it finishes."""
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 256)
+    s = TpuSession()
+    s._plan_cache = PlanCache(capacity=1)
+    t = _table(n=2048)
+    pq1 = s.prepare(s.create_dataframe(t)
+                    .where(col("k") >= 0).select(col("k")))
+    gen = pq1.execute_stream()
+    next(gen)  # pq1's entry lock held by this thread
+    # preparing a second template evicts pq1's entry (capacity 1)
+    pq2 = s.prepare(s.create_dataframe(t)
+                    .where(col("v") >= 0).select(col("v")))
+    assert plan_cache_mod.stats()["evictions"] == 1
+    rest = sum(tbl.num_rows for tbl in gen)  # stream still drains
+    assert rest > 0
+    assert pq2.execute().num_rows == 2048
+
+
+def test_stream_records_history_on_drain():
+    s = TpuSession()
+    pq = s.prepare(_agg_df(s, _table()))
+    n_before = len(s.history.events)
+    _ = list(pq.execute_stream())
+    events = s.history.events
+    assert len(events) == n_before + 1
+    assert "Aggregate" in events[-1].explain
+
+
+# ------------------------------------------------------------------ #
+# Event log + health (HC009)
+# ------------------------------------------------------------------ #
+
+
+def test_eventlog_serving_record(tmp_path):
+    from spark_rapids_tpu.tools.history import load_application
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.eventLog.enabled", True)
+    conf.set("spark.rapids.tpu.eventLog.dir", str(tmp_path))
+    conf.set("spark.rapids.tpu.serving.maxConcurrent", 2)
+    s = TpuSession(conf, tenant="acme", priority=3)
+    pq = s.prepare(_agg_df(s, _table()))
+    pq.execute()   # miss->insert happened at prepare; this is a hit
+    _ = s.history.events  # drain: the log is complete
+    app = load_application(s.event_log_path)
+    q = app.queries[-1]
+    assert "serve.admit_wait_ms" in q.counters
+    assert q.counters["serve.plan_cache_hit"] == 1
+    serving = q.raw.get("serving")
+    assert serving["tenant"] == "acme" and serving["priority"] == 3
+    assert serving["plan_cache"] == "hit"
+
+
+def test_hc009_flags_admission_wait_over_budget():
+    from spark_rapids_tpu.tools.history import (
+        _query_from_record,
+        health_check,
+        ApplicationInfo,
+    )
+
+    def rec(wait_ms):
+        return _query_from_record({
+            "query_id": 1, "plan": "x", "plan_hash": "h",
+            "engine": "tpu", "wall_s": 1.0, "start_ts": 0.0,
+            "end_ts": 1.0, "conf_hash": "c",
+            "counters": {"serve.admit_wait_ms": wait_ms},
+            "serving": {"tenant": "acme", "admit_wait_ms": wait_ms},
+        })
+
+    get_conf().set(
+        "spark.rapids.tpu.serving.health.admitWaitBudgetMs", 100.0)
+    app = ApplicationInfo("log", "eventlog", {},
+                          [rec(50.0), rec(5000.0)])
+    findings = [f for f in health_check(app) if f.rule == "HC009"]
+    assert len(findings) == 1
+    assert "5000ms" in findings[0].message
+    assert "acme" in findings[0].message
+
+
+def test_serving_smoke():
+    """tools/bench_smoke.run_serving_smoke wired into tier-1."""
+    from spark_rapids_tpu.tools.bench_smoke import run_serving_smoke
+
+    out = run_serving_smoke()
+    assert out["serving_plan_cache_hits"] >= 1
+    assert out["serving_admitted"] >= 6
+
+
+# ------------------------------------------------------------------ #
+# THE concurrent-session stress test
+# ------------------------------------------------------------------ #
+
+
+def test_concurrent_sessions_stress(tmp_path):
+    """N sessions x M queries on distinct confs, concurrently:
+
+    - results bit-identical to serial execution (integer aggregates +
+      pinned order, so digests must match exactly);
+    - conf isolation: each thread runs its own batchSizeRows without
+      leaking into the others;
+    - trace ownership (PR3 sync_conf rules): only session 0 traces;
+      the other sessions' collects must not kill its capture;
+    - eventlog ownership: each session's log holds exactly its own
+      queries;
+    - per-session query_id monotonicity."""
+    from spark_rapids_tpu import trace
+    from spark_rapids_tpu.tools.history import load_application
+
+    n_sessions, m_iters = 4, 3
+    t = _table(n=4096, keys=32)
+
+    # serial reference digests, one per template variant
+    s0 = TpuSession()
+    serial = {}
+    for i in range(n_sessions):
+        df = (s0.create_dataframe(t)
+              .where(col("v") >= i)
+              .group_by(col("k"))
+              .agg((sum_(col("v")), "sv"), (count_star(), "n"))
+              .order_by(col("k")))
+        serial[i] = table_digest(df.collect(engine="tpu"))
+
+    errors: list = []
+    sessions: list = [None] * n_sessions
+
+    def run(i: int) -> None:
+        try:
+            conf = TpuConf({
+                "spark.rapids.tpu.sql.batchSizeRows": 256 * (i + 1),
+                "spark.rapids.tpu.serving.maxConcurrent": 2,
+                "spark.rapids.tpu.eventLog.enabled": True,
+                "spark.rapids.tpu.eventLog.dir":
+                    str(tmp_path / f"s{i}"),
+                "spark.rapids.tpu.trace.enabled": i == 0,
+            })
+            set_conf(conf)
+            sess = TpuSession(conf, tenant=f"tenant{i % 2}",
+                              priority=1 + (i % 2))
+            sessions[i] = sess
+            df = (sess.create_dataframe(t)
+                  .where(col("v") >= i)
+                  .group_by(col("k"))
+                  .agg((sum_(col("v")), "sv"), (count_star(), "n"))
+                  .order_by(col("k")))
+            pq = sess.prepare(df)
+            for _ in range(m_iters):
+                d = table_digest(pq.execute())
+                if d != serial[i]:
+                    errors.append((i, "digest mismatch"))
+        except BaseException as e:  # noqa: BLE001 — reported below
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_sessions)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120.0)
+    assert not errors, errors
+
+    # trace ownership: session 0's spans survived 3 sessions' worth of
+    # concurrent sync_conf(trace off) calls
+    assert trace.is_enabled(), \
+        "a non-tracing session's collect killed the tracing session"
+    span_qids = {e.attrs.get("query_id")
+                 for e in trace.snapshot() if e.name == "query.execute"}
+    s0_qids = {ev.query_id for ev in sessions[0].history.events}
+    assert s0_qids & span_qids, "tracing session captured no spans"
+
+    for i, sess in enumerate(sessions):
+        events = sess.history.events  # drains the eventlog too
+        qids = [ev.query_id for ev in events]
+        assert qids == sorted(qids) and len(set(qids)) == len(qids), \
+            f"session {i} query ids not monotonic: {qids}"
+        assert len(events) == m_iters
+        app = load_application(sess.event_log_path)
+        assert len(app.queries) == m_iters, \
+            f"session {i} log holds foreign/missing queries"
+        assert {q.query_id for q in app.queries} == set(qids)
+        for q in app.queries:
+            assert q.raw["serving"]["tenant"] == f"tenant{i % 2}"

@@ -760,3 +760,60 @@ def test_exists_errors(tpch_full):
         tpch_full.sql(
             "select count(*) n from orders where exists "
             "(select * from lineitem where l_orderkey < o_orderkey)")
+
+
+# ------------------------------------------------------------------ #
+# Named parameters (:name) — the prepared-template substrate
+# ------------------------------------------------------------------ #
+
+
+def test_named_params_match_inline_literals(tpch):
+    """A parameterized template must lower to exactly what inline
+    literals lower to: same rows, TPU/CPU differential on both."""
+    inline = _diff(tpch.sql(
+        "select l_returnflag, count(*) as n from lineitem "
+        "where l_quantity < 24 and l_discount >= 0.05 "
+        "group by l_returnflag"))
+    bound = _diff(tpch.sql(
+        "select l_returnflag, count(*) as n from lineitem "
+        "where l_quantity < :qmax and l_discount >= :dmin "
+        "group by l_returnflag",
+        params={"qmax": 24, "dmin": 0.05}))
+    assert inline == bound
+
+
+def test_named_param_reused_binds_every_site(tpch):
+    """One parameter referenced twice binds at every reference."""
+    inline = _diff(tpch.sql(
+        "select l_returnflag, count(*) as n from lineitem "
+        "where l_quantity >= 20 and l_quantity < 20 + 10 "
+        "group by l_returnflag"))
+    bound = _diff(tpch.sql(
+        "select l_returnflag, count(*) as n from lineitem "
+        "where l_quantity >= :qmin and l_quantity < :qmin + 10 "
+        "group by l_returnflag", params={"qmin": 20}))
+    assert inline == bound
+
+
+def test_named_param_date_binding(tpch):
+    """datetime.date params bind as DATE literals (TPC-H predicates
+    parameterize their date range)."""
+    import datetime as dt
+
+    inline = _diff(tpch.sql(
+        "select count(*) as n from lineitem "
+        "where l_shipdate >= date '1995-01-01'"))
+    bound = _diff(tpch.sql(
+        "select count(*) as n from lineitem where l_shipdate >= :d0",
+        params={"d0": dt.date(1995, 1, 1)}))
+    assert inline == bound and inline[0][0] > 0
+
+
+def test_named_param_errors(tpch):
+    with pytest.raises(SqlError, match=r"unbound parameter :qmax"):
+        tpch.sql("select count(*) as n from lineitem "
+                 "where l_quantity < :qmax")
+    with pytest.raises(SqlError, match=r"unknown parameter\(s\) :typo"):
+        tpch.sql("select count(*) as n from lineitem "
+                 "where l_quantity < :qmax",
+                 params={"qmax": 10, "typo": 1})
