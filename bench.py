@@ -39,6 +39,15 @@ Attribution fields (so round-over-round deltas are explainable):
   pass against df.cache()-materialized DEVICE-resident batches, so
   actual device throughput is measured with the H2D wire out of the
   loop;
+- per-query DEVICE-LEDGER attribution (trace/ledger.py,
+  docs/device_ledger.md): `q*_device_busy_ms` (attributed device time
+  per collect, vs the wall-clock numbers' host+wire+dispatch
+  residual), `q*_roofline_attributed` (XLA-cost-model bytes over
+  settled device time against the HBM peak — the honest counterpart
+  of the coarse `hbm_roofline_fraction` quotients, same constant via
+  trace/ledger.roofline_fraction), `q*_dispatches`/`q*_programs`
+  (launch counts + distinct compiled programs: the ROADMAP #2
+  fusion/bucketing scoreboard) and `q*_top_program` (+`_share`);
 - `q{1,3,6,67}_retry_splits` / `_spills_under_pressure` /
   `_recovered_faults` (reset per query like the pipeline/speculation
   counters): recovery activity in the timed window.  On a clean run
@@ -78,9 +87,18 @@ N_FILES = 6  # ~6.3M rows ~ TPC-H SF1 lineitem
 ROW_BYTES = 8 * 3 + 4  # three float64 columns + one int32 date
 TPU_ITERS = 5
 CPU_ITERS = 3
-# HBM bandwidth of the bench chip (TPU v5e ~819 GB/s); only used for the
-# roofline sanity fraction in the diagnostic fields.
-HBM_BYTES_PER_S = 819e9
+
+
+def _roofline(rows_per_s: float) -> float:
+    """Coarse roofline fraction of a rows/s figure.  The formula AND
+    the HBM-bandwidth constant live in trace/ledger.py (conf
+    spark.rapids.tpu.trace.ledger.hbmBytesPerSec, default TPU v5e
+    ~819 GB/s) — one definition shared by this coarse quotient, the
+    warm-pass variant and the ledger's per-program attribution, so
+    the three can never drift."""
+    from spark_rapids_tpu.trace.ledger import roofline_fraction
+
+    return round(roofline_fraction(rows_per_s * ROW_BYTES), 4)
 
 #: --chaos schedule, re-armed (fresh counters, so the nth-call policies
 #: re-fire) at every per-query counter reset: one device-alloc OOM
@@ -402,16 +420,27 @@ def _reset_pipeline_counters() -> None:
     from spark_rapids_tpu.parallel.speculation import reset_stats
     from spark_rapids_tpu.plan import runtime_filter
     from spark_rapids_tpu.robustness import faults
+    from spark_rapids_tpu.trace import ledger
 
     reset_stage_counters()
     reset_stats()  # per-query speculation hit rates, same discipline
     runtime_filter.reset_stats()  # per-query pruned-row counts too
     reset_retry_stats()  # per-query split/spill-retry attribution
+    ledger.reset_stats()  # per-query program/roofline attribution
     if _CHAOS:
         # fresh schedule per query: counters zero, nth policies re-fire
         faults.install(CHAOS_SPEC, forced=True)
     else:
         faults.reset_stats()
+
+
+def _reset_ledger() -> None:
+    """Zero ONLY the device ledger (warm passes call this so their
+    attribution covers the warm runs alone, without the side effects
+    of the full counter reset — which re-arms the --chaos schedule)."""
+    from spark_rapids_tpu.trace import ledger
+
+    ledger.reset_stats()
 
 
 def _robustness_fields(prefix: str, spilled_before: int = 0) -> dict:
@@ -461,6 +490,43 @@ def _sync_spec_fields(prefix: str, iters: int,
         st = speculation.stats()
         out[f"{prefix}_speculation_overflows"] = sum(
             s["overflows"] for s in st.values())
+    return out
+
+
+def _ledger_fields(prefix: str, iters: int) -> dict:
+    """Per-query device-ledger attribution for the timed window (the
+    ledger is reset per query by _reset_pipeline_counters, so the
+    cumulative snapshot IS the window):
+
+    - `{prefix}_device_busy_ms`: attributed device time per collect —
+      summed dispatch-to-completion wall of every program the window
+      dispatched (the DEVICE share of the coarse wall-clock numbers
+      above; the gap is host decode/wire/dispatch overhead);
+    - `{prefix}_roofline_attributed`: device-time-weighted roofline
+      fraction from XLA's cost model (bytes accessed x dispatches /
+      device time / HBM peak) — the honest per-program counterpart of
+      the coarse `hbm_roofline_fraction`;
+    - `{prefix}_dispatches` / `{prefix}_programs`: launch count per
+      collect and distinct compiled programs in the window (the
+      fusion/bucketing scoreboard of ROADMAP #2);
+    - `{prefix}_top_program` (+ `_share`): where the device time went.
+    """
+    from spark_rapids_tpu.trace import ledger
+
+    ledger.LEDGER.flush(timeout=10.0)
+    s = ledger.summarize(ledger.snapshot())
+    t = s["totals"]
+    per = max(iters, 1)
+    out = {
+        f"{prefix}_device_busy_ms": round(t["device_ms"] / per, 2),
+        f"{prefix}_dispatches": round(t["dispatches"] / per, 1),
+        f"{prefix}_programs": t["programs"],
+        f"{prefix}_roofline_attributed": t["roofline"],
+    }
+    top = t.get("top") or []
+    if top:
+        out[f"{prefix}_top_program"] = top[0]["key"]
+        out[f"{prefix}_top_program_share"] = top[0]["share"]
     return out
 
 
@@ -546,6 +612,7 @@ def _bench_q1(session, d: str) -> dict:
         occ = _pipeline_occupancy("q1_pipeline")
         occ.update(_sync_spec_fields("q1", 3))
         occ.update(_robustness_fields("q1", sp0))
+        occ.update(_ledger_fields("q1", 3))
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
         breakdown.update(occ)
@@ -565,8 +632,13 @@ def _bench_q1(session, d: str) -> dict:
                         (count_star(), "count_order")))
         try:
             warm_df.collect(engine="tpu")  # fills the cache slot
+            # ledger-ONLY reset: the full counter reset would re-arm
+            # the --chaos fault schedule inside the warm timed loop,
+            # perturbing the steady-state numbers this pass exists for
+            _reset_ledger()
             breakdown.update(_bench_warm(warm_df, "q1_warm",
                                          ROWS_PER_FILE * 2))
+            breakdown.update(_ledger_fields("q1_warm", 3))
         finally:
             cached.unpersist()
     finally:
@@ -601,6 +673,7 @@ def _bench_q3(session, d: str) -> dict:
     occ = _pipeline_occupancy("q3_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q3", 3))
     occ.update(_robustness_fields("q3", sp0))
+    occ.update(_ledger_fields("q3", 3))
     # runtime-filter attribution for the timed window + the on/off
     # uploaded-row delta (the wire-shrink the filters buy)
     occ.update(_rf_fields(df, 3))
@@ -641,6 +714,7 @@ def _bench_q67(session, d: str) -> dict:
     occ = _pipeline_occupancy("q67_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q67", 3))
     occ.update(_robustness_fields("q67", sp0))
+    occ.update(_ledger_fields("q67", 3))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     got = list(zip(*tpu_r.to_pydict().values()))
     want = list(zip(*cpu_r.to_pydict().values()))
@@ -975,6 +1049,12 @@ def main() -> None:
             ev_dir = _eventlog_dir()
             get_conf().set("spark.rapids.tpu.eventLog.enabled", True)
             get_conf().set("spark.rapids.tpu.eventLog.dir", ev_dir)
+        # device-ledger attribution rides every round: per-query
+        # q*_device_busy_ms / q*_roofline_attributed / top-program
+        # fields, and the event log's per-query `programs` section
+        # (docs/device_ledger.md); per-dispatch cost is one counter
+        # bump, settlement is off the timed path
+        get_conf().set("spark.rapids.tpu.trace.ledger.enabled", True)
         session = TpuSession()
         df = q6_dataframe(session, paths)
 
@@ -1000,6 +1080,7 @@ def main() -> None:
         occ.update(_sync_spec_fields("q6", TPU_ITERS,
                                      with_hit_rate=False))
         occ.update(_robustness_fields("q6", sp0))
+        occ.update(_ledger_fields("q6", TPU_ITERS))
         breakdown = _stage_breakdown(df, "q6")
         breakdown.update(occ)
 
@@ -1019,10 +1100,16 @@ def main() -> None:
         warm_df = cached.where(cond).agg((_sum(price * disc), "revenue"))
         try:
             warm_df.collect(engine="tpu")  # fills the cache slot
+            # ledger-ONLY reset (see _bench_q1: the full reset would
+            # re-arm the --chaos schedule inside the warm loop)
+            _reset_ledger()
             warm = _bench_warm(warm_df, "q6_warm", n_rows)
-            warm["hbm_roofline_fraction_warm"] = round(
-                warm["q6_warm_rows_per_s"] * ROW_BYTES
-                / HBM_BYTES_PER_S, 4)
+            warm["hbm_roofline_fraction_warm"] = _roofline(
+                warm["q6_warm_rows_per_s"])
+            # the ATTRIBUTED counterpart: per-program device time +
+            # cost-model roofline for the warm window — the number
+            # ROADMAP #2's fusion/donation work moves
+            warm.update(_ledger_fields("q6_warm", 3))
         finally:
             cached.unpersist()
         breakdown.update(warm)
@@ -1050,7 +1137,7 @@ def main() -> None:
         "tpu_s_per_query": round(tpu_t, 4),
         "cpu_s_per_query": round(cpu_t, 4),
         "bytes_per_s": round(bytes_per_s, 1),
-        "hbm_roofline_fraction": round(bytes_per_s / HBM_BYTES_PER_S, 4),
+        "hbm_roofline_fraction": _roofline(rows_per_s),
     }
     out.update(_stats(tpu_ts, "q6_tpu"))
     out.update(link)

@@ -365,8 +365,15 @@ class EventLogWriter:
 
     def query_begin(self) -> dict:
         """Pre-query capture: the counter surface before execution (the
-        record stores per-query deltas)."""
-        return {"counters": counters_snapshot()}
+        record stores per-query deltas), plus the device-ledger
+        snapshot when the ledger is on (the `programs` section is a
+        per-query delta too)."""
+        from spark_rapids_tpu.trace import ledger as _ledger
+
+        pre = {"counters": counters_snapshot()}
+        if _ledger.LEDGER.enabled:
+            pre["ledger"] = _ledger.snapshot()
+        return pre
 
     def query_end(self, pre: dict) -> dict:
         """End-of-query capture, ON THE CALLING THREAD: counter deltas,
@@ -384,7 +391,17 @@ class EventLogWriter:
         the structured `serving` record field."""
         from spark_rapids_tpu.robustness import faults
         from spark_rapids_tpu.serving import current_serving_context
+        from spark_rapids_tpu.trace import ledger as _ledger
 
+        programs = None
+        if pre.get("ledger") is not None and _ledger.LEDGER.enabled:
+            # bounded settle wait: the result fetch already forced the
+            # device work, so in practice this returns immediately; a
+            # wedged settle degrades the section, never the query
+            _ledger.LEDGER.flush(timeout=2.0)
+            d = _ledger.delta(pre["ledger"], _ledger.snapshot())
+            if d:
+                programs = _ledger.summarize(d)
         counters = counters_delta(pre["counters"], counters_snapshot())
         sctx = current_serving_context()
         if sctx:
@@ -398,6 +415,7 @@ class EventLogWriter:
             "pipeline": _pipeline_surface(),
             "faults": faults.fault_stats() or None,
             "serving": sctx,
+            "programs": programs,
         }
 
     def build_query_record(self, ev, post: dict, plan_text: str,
@@ -448,6 +466,7 @@ class EventLogWriter:
             "pipeline": post["pipeline"],
             "faults": post["faults"],
             "serving": post.get("serving"),
+            "programs": post.get("programs"),
             "result_digest": result_digest,
             "rows": rows,
             "trace_file": trace_file,
@@ -458,6 +477,21 @@ class EventLogWriter:
                   rows: Optional[int] = None) -> None:
         self.append(self.build_query_record(
             ev, post, plan_text, engine, result_digest, rows))
+
+    def log_telemetry(self, sample: dict) -> None:
+        """Append one live-telemetry gauge sample (called by the
+        trace/telemetry sampler thread for every attached session;
+        `append` is lock-protected, so sampler and query records
+        interleave without tearing)."""
+        from spark_rapids_tpu.eventlog.schema import SCHEMA_VERSION
+
+        self.append({
+            "type": "telemetry",
+            "schema_version": SCHEMA_VERSION,
+            "ts": time.time(),
+            "session": self.session_id,
+            "counters": dict(sample),
+        })
 
 
 def _pipeline_surface() -> dict:

@@ -91,11 +91,23 @@ def iter_records(path: str, strict: bool = False,
 def read_log(path: str, strict: bool = False
              ) -> tuple[Optional[dict], list[dict]]:
     """(header, query_records) for one log file."""
+    header, queries, _telemetry = read_log_all(path, strict=strict)
+    return header, queries
+
+
+def read_log_all(path: str, strict: bool = False
+                 ) -> tuple[Optional[dict], list[dict], list[dict]]:
+    """(header, query_records, telemetry_records) for one log file —
+    the full surface tools/history loads (telemetry records are the
+    live sampler's gauge samples, trace/telemetry.py)."""
     header = None
     queries: list[dict] = []
+    telemetry: list[dict] = []
     for rec in iter_records(path, strict=strict):
         if rec.get("type") == "header":
             header = rec
         elif rec.get("type") == "query":
             queries.append(rec)
-    return header, queries
+        elif rec.get("type") == "telemetry":
+            telemetry.append(rec)
+    return header, queries, telemetry

@@ -23,7 +23,7 @@ from typing import Any
 SCHEMA_VERSION = 1
 
 #: record types the writer emits
-RECORD_TYPES = ("header", "query")
+RECORD_TYPES = ("header", "query", "telemetry")
 
 #: required fields per record type: name -> allowed python types.
 #: Anything NOT listed here is optional-by-construction; readers must
@@ -54,6 +54,17 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
         "conf_hash": (str,),
         "counters": (dict,),
     },
+    # one live-telemetry gauge sample (trace/telemetry.py): appended
+    # by the sampler thread between query records; `counters` is the
+    # flat sample_now() dict (store tiers, semaphore, admission queue,
+    # pipeline occupancy)
+    "telemetry": {
+        "type": (str,),
+        "schema_version": (int,),
+        "ts": (int, float),
+        "session": (str,),
+        "counters": (dict,),
+    },
 }
 
 #: optional fields we still type-check WHEN present
@@ -67,10 +78,15 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
         "pipeline": (dict, type(None)),
         "faults": (dict, type(None)),
         "serving": (dict, type(None)),
+        # device-ledger attribution for this query (trace/ledger.py):
+        # {"programs": {key: {...}}, "totals": {...}} — present only
+        # when the ledger was enabled for the query
+        "programs": (dict, type(None)),
         "result_digest": (str, type(None)),
         "trace_file": (str, type(None)),
         "rows": (int, type(None)),
     },
+    "telemetry": {},
 }
 
 

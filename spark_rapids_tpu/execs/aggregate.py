@@ -288,7 +288,7 @@ class TpuHashAggregateExec(TpuExec):
              tuple(c.width for c in b.columns if hasattr(c, "width")))
             for b in batches)
         fn = cached_jit(("aggdrainfused", self._cache_key(), struct),
-                        lambda: prog)
+                        lambda: prog, op=self.name)
         with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             out = t.observe(fn([b.with_device_num_rows()
                                 for b in batches]))
@@ -312,7 +312,7 @@ class TpuHashAggregateExec(TpuExec):
              tuple(c.width for c in b.columns if hasattr(c, "width")))
             for b in batches)
         fn = cached_jit(("aggconcat_traced", self._cache_key(), struct),
-                        lambda: concat_batches_traced)
+                        lambda: concat_batches_traced, op=self.name)
         return fn(batches)
 
     def _jit_concat(self, batches: list[ColumnarBatch]) -> ColumnarBatch:
@@ -328,7 +328,8 @@ class TpuHashAggregateExec(TpuExec):
                    if hasattr(c, "width")))
             for b in batches)
         fn = cached_jit(("aggconcat", self._cache_key(), struct),
-                        lambda: lambda bs: concat_batches(bs))
+                        lambda: lambda bs: concat_batches(bs),
+                        op=self.name)
         return fn(batches)
 
     def _finalize_batch(self, partial: ColumnarBatch) -> ColumnarBatch:
@@ -455,12 +456,14 @@ class TpuHashAggregateExec(TpuExec):
                     return self._update_batch(b, mask)
 
                 upd = cached_jit(key + ("absorb", ckeys, "update"),
-                                 lambda: update_full)
+                                 lambda: update_full, op=self.name)
                 self._jits = (
                     upd,
-                    cached_jit(key + ("merge",), lambda: self._merge_batch),
+                    cached_jit(key + ("merge",), lambda: self._merge_batch,
+                               op=self.name),
                     cached_jit(key + ("final",),
-                               lambda: self._finalize_batch))
+                               lambda: self._finalize_batch,
+                               op=self.name))
             (self._jit_update, self._jit_merge,
              self._jit_finalize) = self._jits
 

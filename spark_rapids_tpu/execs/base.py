@@ -461,7 +461,7 @@ class FusableExec(TpuExec):
             from spark_rapids_tpu.execs.jit_cache import cached_jit
 
             jitted = cached_jit(("fused", tuple(keys), ansi),
-                                lambda: pipeline)
+                                lambda: pipeline, op=self.name)
         else:
             jitted = jax.jit(pipeline)
         self._fused = (jitted, node, aware, ansi)
@@ -499,7 +499,7 @@ class FusableExec(TpuExec):
             from spark_rapids_tpu.execs.jit_cache import cached_jit
 
             jitted = cached_jit(("fusedenc", tuple(keys), ansi),
-                                lambda: pipeline)
+                                lambda: pipeline, op=self.name)
         else:
             jitted = jax.jit(pipeline)
         self._fused_enc = jitted

@@ -102,7 +102,8 @@ class TpuCollectAggExec(TpuExec):
 
         with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             sb, live_s, ng, mk = cached_jit(
-                key + ("p1", big.capacity), lambda: phase1)(big)
+                key + ("p1", big.capacity), lambda: phase1,
+                op=self.name)(big)
             from spark_rapids_tpu.parallel.pipeline import device_read_many
 
             num_groups, max_kept = (int(x) for x in
@@ -117,7 +118,7 @@ class TpuCollectAggExec(TpuExec):
 
             out = t.observe(cached_jit(
                 key + ("p2", L, out_cap, sb.capacity),
-                lambda: phase2)(sb, live_s))
+                lambda: phase2, op=self.name)(sb, live_s))
         import dataclasses
 
         n_rows = num_groups if n_keys else max(num_groups, 1)

@@ -525,7 +525,8 @@ class TpuCollectiveSortExec(_CollectiveBase):
                     jit_sample = cached_jit(
                         ("csortsample", pkey, s.capacity, n_sample,
                          repr(s.schema)),
-                        lambda: lambda b, p: part.key_batch(
+                        op=self.name,
+                        make_fn=lambda: lambda b, p: part.key_batch(
                             b).gather(p, p.shape[0]))
                     pos = jnp.asarray(
                         rng.integers(0, rows, n_sample).astype(np.int32))
@@ -537,7 +538,8 @@ class TpuCollectiveSortExec(_CollectiveBase):
             jit_bounds = cached_jit(
                 ("csortbounds", pkey, n_live, n,
                  tuple(s.capacity for s in samples)),
-                lambda: lambda ss: choose_bounds(
+                op=self.name,
+                make_fn=lambda: lambda ss: choose_bounds(
                     concat_batches(ss), part.key_orders(), n, n_live))
             bounds = jit_bounds(samples)
 

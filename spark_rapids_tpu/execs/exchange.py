@@ -111,7 +111,8 @@ class TpuShuffleExchangeExec(TpuExec):
                           getattr(part, "start", 0),
                           exprs_key(getattr(part, "exprs", ())))
                     pid_fn = self._pid_fns[key] = cached_jit(
-                        ck, lambda: part.partition_ids)
+                        ck, lambda: part.partition_ids,
+                        op=self.name)
         from collections import deque
 
         from spark_rapids_tpu.columnar.column import pad_capacity
@@ -332,7 +333,8 @@ class TpuShuffleExchangeExec(TpuExec):
                         jit_sample = cached_jit(
                             ("rangesample", pkey, batch.capacity,
                              n_sample, repr(batch.schema)),
-                            lambda: lambda b, p: part.key_batch(
+                            op=self.name,
+                            make_fn=lambda: lambda b, p: part.key_batch(
                                 b).gather(p, n_sample))
                         with rng_lock:
                             pos = rng.integers(0, rows, n_sample).astype(
@@ -372,7 +374,7 @@ class TpuShuffleExchangeExec(TpuExec):
         bounds = cached_jit(
             ("rangebounds", pkey, k, n_sample, n,
              tuple(s.capacity for s in samples)),
-            lambda: pool_and_bound)(samples)
+            lambda: pool_and_bound, op=self.name)(samples)
 
         from spark_rapids_tpu.columnar.column import pad_capacity
 

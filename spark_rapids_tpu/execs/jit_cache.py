@@ -20,11 +20,12 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 
 from spark_rapids_tpu import trace as _trace
+from spark_rapids_tpu.trace import ledger as _ledger
 from spark_rapids_tpu.exprs.base import Expression
 
 _LOCK = threading.Lock()
@@ -78,9 +79,17 @@ def exprs_key(es: Sequence) -> tuple:
     return tuple(expr_key(e) for e in es)
 
 
-def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
+def cached_jit(key: tuple, make_fn: Callable[[], Callable],
+               op: Optional[str] = None):
     """Return a jitted callable shared by every caller presenting `key`.
-    `make_fn` is invoked (once) only on a cache miss."""
+    `make_fn` is invoked (once) only on a cache miss.
+
+    `op` (the owning exec's name, when the caller has one) labels the
+    program in the device-utilization ledger (trace/ledger.py) so
+    explain("analyze") can attribute per-operator roofline fractions;
+    the cached callable is the ledger's dispatch hook — with the
+    ledger off the wrapper is one attribute read and a passthrough
+    call, bit-identical to the raw jitted function."""
     global _HITS, _MISSES
     with _LOCK:
         fn = _CACHE.get(key)
@@ -105,7 +114,13 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable]):
                 lambda: _faults.fault_point("jit.compile",
                                             key=repr(key)[:80]),
                 action="compile_retry")
-            fn = _CACHE[key] = jax.jit(make_fn())
+            # every program the engine compiles flows through here:
+            # the ledger wrapper is the single metering point feeding
+            # per-program dispatch counts + device time + cost-model
+            # attribution (tpulint SRC009 flags raw jax.jit in exec
+            # modules for exactly this reason)
+            fn = _CACHE[key] = _ledger.LEDGER.wrap(
+                key, jax.jit(make_fn()), op=op)
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)
         else:

@@ -228,7 +228,8 @@ class _HashJoinBase(TpuExec):
 
             fn = cache[out_cap] = cached_jit(
                 self._cache_key() + ("expand", out_cap),
-                lambda: partial(self._expand, out_cap=out_cap))
+                lambda: partial(self._expand, out_cap=out_cap),
+                op=self.name)
         return fn
 
     @property
@@ -248,7 +249,8 @@ class _HashJoinBase(TpuExec):
                 return batch.compact(p.data.astype(bool) & p.validity)
 
             fn = self._cond_fn = cached_jit(
-                ("join_cond", expr_key(cond)), lambda: apply)
+                ("join_cond", expr_key(cond)), lambda: apply,
+                op=self.name)
         return fn
 
     def _join_stream(self, build: Optional[ColumnarBatch],
@@ -284,10 +286,10 @@ class _HashJoinBase(TpuExec):
         from spark_rapids_tpu.parallel import speculation as SP
 
         jit_probe = cached_jit(self._cache_key() + ("probe",),
-                               lambda: self._probe)
+                               lambda: self._probe, op=self.name)
         jit_semi_compact = cached_jit(
             ("semi_compact",), lambda: lambda stream, keep:
-            stream.compact(keep))
+            stream.compact(keep), op=self.name)
         matched_b_acc = None
         sizes_output = self.join_type not in ("left_semi", "left_anti")
         pred = SP.predictor(self._cache_key() + ("sizing",)) \
@@ -446,7 +448,8 @@ class _HashJoinBase(TpuExec):
         from spark_rapids_tpu.execs.jit_cache import cached_jit
 
         out = cached_jit(self._cache_key() + ("unmatched",),
-                         lambda: unmatched)(build, matched_b)
+                         lambda: unmatched,
+                         op=self.name)(build, matched_b)
         if out.concrete_num_rows() > 0:
             yield self._count_output(out)
 
@@ -523,7 +526,7 @@ class TpuRuntimeFilterBuildExec(TpuExec):
 
             fn = self._update_fn = cached_jit(
                 ("rf.update", exprs_key([k for k, _ in entries]), specs,
-                 repr(self.schema)), lambda: update)
+                 repr(self.schema)), lambda: update, op=self.name)
         return fn
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:

@@ -117,7 +117,8 @@ class TpuSortExec(_SortMixin):
         from spark_rapids_tpu.execs.jit_cache import cached_jit
 
         self._jit_sorted = cached_jit(("sort", self._keys_cache_key()),
-                                      lambda: self._sorted)
+                                      lambda: self._sorted,
+                                      op=self.name)
         # augmented layout: data columns ++ evaluated key columns
         child_schema = child.schema
         self._n_data = len(child_schema.fields)
@@ -220,7 +221,7 @@ class TpuSortExec(_SortMixin):
         store = get_store()
         kkey = self._keys_cache_key()
         jit_aug = cached_jit(("sortaug", kkey, repr(self.aug_schema)),
-                             lambda: self._augment)
+                             lambda: self._augment, op=self.name)
 
         # collect phase: augment + register (spillable).  Sampling starts
         # only once the running total crosses the single-batch threshold
@@ -236,7 +237,8 @@ class TpuSortExec(_SortMixin):
             jit_sample = cached_jit(
                 ("sortsample", kkey, aug.capacity, n_sample,
                  repr(self.aug_schema)),
-                lambda: lambda a, p: a.gather(p, n_sample))
+                lambda: lambda a, p: a.gather(p, n_sample),
+                op=self.name)
             samples.append(jit_sample(aug, jnp.asarray(pos, jnp.int32)))
 
         def pin_deferred() -> None:
@@ -390,7 +392,7 @@ class TpuSortExec(_SortMixin):
 
         return cached_jit(
             ("sortdrop", self._keys_cache_key(), repr(self.aug_schema)),
-            lambda: self._sort_drop)
+            lambda: self._sort_drop, op=self.name)
 
     def _merge_buckets(self, store, handles, rows, samples, total,
                        single_rows, depth: int = 0
@@ -430,7 +432,7 @@ class TpuSortExec(_SortMixin):
         bounds = cached_jit(
             ("sortbounds", kkey, k, n_sample, n_parts,
              tuple(s.capacity for s in samples)),
-            lambda: pool_and_bound)(samples)
+            lambda: pool_and_bound, op=self.name)(samples)
 
         # split phase: group each collected batch by bucket, park on host
         runs: list[tuple[object, np.ndarray, np.ndarray]] = []
@@ -539,7 +541,7 @@ class TpuSortExec(_SortMixin):
 
         buf, layout = _pack_components(comps)
         unpack = cached_jit(("unpack", layout),
-                            lambda: _make_unpack(layout))
+                            lambda: _make_unpack(layout), op=self.name)
         dev = unpack(jnp.asarray(buf))
         cols: list = []
         for kind, i, dtype in recipe:
@@ -639,7 +641,8 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
         from spark_rapids_tpu.execs.jit_cache import cached_jit, exprs_key
 
         jit_topn = cached_jit(
-            ("topn", self.n, self._keys_cache_key()), lambda: self._topn)
+            ("topn", self.n, self._keys_cache_key()), lambda: self._topn,
+            op=self.name)
         top: Optional[ColumnarBatch] = None
         for b in self.children[0].execute():
             with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
@@ -659,7 +662,7 @@ class TpuTakeOrderedAndProjectExec(_SortMixin):
 
             out = cached_jit(
                 ("topn_proj", exprs_key(self.project), repr(self._schema)),
-                lambda: proj)(out)
+                lambda: proj, op=self.name)(out)
         yield self._count_output(out)
 
 
@@ -687,10 +690,10 @@ class TpuTopNExec(_SortMixin):
 
         self._jit_cand = cached_jit(
             ("topn_cand", self.n, self._keys_cache_key()),
-            lambda: self._candidates)
+            lambda: self._candidates, op=self.name)
         self._jit_final = cached_jit(
             ("topnfinal", self.n, self._keys_cache_key()),
-            lambda: self._final)
+            lambda: self._final, op=self.name)
 
     @property
     def schema(self) -> T.Schema:

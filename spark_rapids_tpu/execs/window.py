@@ -247,7 +247,8 @@ class TpuWindowExec(TpuExec):
         # handles zero live rows; empty SOURCES returned above)
         if self.partitioned and big.concrete_num_rows() == 0:
             return  # empty reduce partition
-        fn = cached_jit(self._cache_key(), lambda: self._window_batch)
+        fn = cached_jit(self._cache_key(), lambda: self._window_batch,
+                        op=self.name)
         with MetricTimer(self.metrics[TOTAL_TIME], op=self.name):
             out = fn(big.with_device_num_rows())
         yield self._count_output(out)

@@ -69,6 +69,19 @@ Rules
   fall-back-to-slow-path sites (the fastpar decoder's per-column
   bailouts) are baselined, not suppressed inline.  execs/retry.py
   itself — the classification gate — is exempt by construction.
+- SRC009 (error): raw ``jax.jit`` in an exec or ops module (execs/,
+  ops/) bypassing ``execs/jit_cache.cached_jit``.  Every program the
+  engine compiles is supposed to flow through the structural-key
+  cache: a raw jit is UNMETERED — it escapes the jit-cache hit/miss
+  stats that explain("analyze") reports, AND the device-utilization
+  ledger (trace/ledger.py) that attributes per-program dispatches,
+  device time and roofline fractions — and it re-traces per exec
+  instance where the cache would share one compiled program across
+  every query presenting the same key.  Sites with no stable
+  structural key (the fused-pipeline fallback when a chain member has
+  no fuse key, the module-level Pallas kernel wrappers) are
+  baselined, not suppressed inline.  execs/jit_cache.py — the cache
+  itself — is exempt by construction.
 """
 
 from __future__ import annotations
@@ -469,6 +482,64 @@ class _RawTimingChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawJitChecker(ast.NodeVisitor):
+    """SRC009: raw ``jax.jit`` calls (or decorators, including
+    ``partial(jax.jit, ...)``) in execs//ops/ modules instead of
+    ``cached_jit``.
+
+    Scope is syntactic and module-wide like SRC005: a raw jit
+    ANYWHERE in an exec/ops module produces a program the ledger and
+    the compile-cache stats cannot see.  ``pjit`` is out of scope (the
+    collective tier's partitioned programs have their own lifecycle);
+    ``cached_jit`` itself obviously passes."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        # bare decorator forms (`@jax.jit`, `@jit`) are plain
+        # Attribute/Name nodes — no Call for visit_Call to see;
+        # `@partial(jax.jit, ...)` IS a Call and lands there
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call) and self._is_raw_jit(dec):
+                self._emit(dec, "a raw `@jax.jit` decorator")
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _emit(self, node: ast.AST, what: str) -> None:
+        qual = self._fn_stack[-1] if self._fn_stack else "<module>"
+        self.out.append(Diagnostic(
+            "SRC009", "error", f"{self.path}::{qual}",
+            f"{what} bypasses the jit cache — the compiled program is "
+            "unmetered (no ledger attribution, no cache stats, no "
+            "cross-query sharing)",
+            hint="route it through execs.jit_cache.cached_jit with a "
+                 "structural key (and op= for per-operator roofline "
+                 "attribution); baseline only sites that genuinely "
+                 "have no stable key",
+            line=getattr(node, "lineno", 0)))
+
+    @staticmethod
+    def _is_raw_jit(e: ast.expr) -> bool:
+        """A reference to jax.jit / bare jit (imported from jax)."""
+        if isinstance(e, ast.Attribute):
+            return e.attr == "jit" and _terminal_name(e.value) == "jax"
+        return isinstance(e, ast.Name) and e.id == "jit"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_raw_jit(node.func):
+            self._emit(node, "raw `jax.jit(...)`")
+        elif _terminal_name(node.func) == "partial" and node.args \
+                and self._is_raw_jit(node.args[0]):
+            self._emit(node, "`partial(jax.jit, ...)`")
+        self.generic_visit(node)
+
+
 #: handler-body calls that prove the exception was CLASSIFIED before
 #: being absorbed (the execs/retry gate + the fault-accounting hooks)
 _CLASSIFY_CALLS = {"classify", "is_retryable", "should_cpu_fallback",
@@ -569,6 +640,16 @@ def _is_sync_hazard_module(path: str) -> bool:
     return "execs" in parts or "ops" in parts
 
 
+def _is_program_module(path: str) -> bool:
+    """SRC009 scope: the modules that compile device programs.
+    execs/jit_cache.py IS the cache — exempt by construction."""
+    norm = path.replace("\\", "/")
+    if norm.endswith("execs/jit_cache.py"):
+        return False
+    parts = norm.split("/")
+    return "execs" in parts or "ops" in parts
+
+
 def _is_recovery_module(path: str) -> bool:
     """SRC008 scope: the layers whose exceptions feed the recovery
     ladder.  execs/retry.py IS the classification gate — exempt."""
@@ -599,6 +680,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _RawTimingChecker(path, out).visit(tree)
     if _is_sync_hazard_module(path):
         _HostMaterializeChecker(path, out).visit(tree)
+    if _is_program_module(path):
+        _RawJitChecker(path, out).visit(tree)
     if _is_recovery_module(path):
         _SwallowChecker(path, out).visit(tree)
     return out

@@ -106,6 +106,26 @@ class TpuSemaphore:
             if delta > 0:
                 self._cv.notify_all()
 
+    def usage(self) -> dict:
+        """Point-in-time permit occupancy — the telemetry sampler's
+        device-residency gauge.  ``in_use`` may transiently exceed
+        ``permits`` right after a shrink (holders finish out; see
+        :meth:`resize`)."""
+        with self._cv:
+            return {"permits": self.permits,
+                    "in_use": self.permits - self._available}
+
+    @classmethod
+    def usage_now(cls) -> dict:
+        """Usage of the live instance WITHOUT creating one (a sampler
+        probing an idle process must not instantiate the semaphore
+        from whatever conf its thread happens to hold)."""
+        with cls._lock:
+            inst = cls._instance
+        if inst is None:
+            return {"permits": 0, "in_use": 0}
+        return inst.usage()
+
     def acquire_if_necessary(self, task_id) -> None:
         """Idempotent per task (ref: GpuSemaphore.acquireIfNecessary).
 

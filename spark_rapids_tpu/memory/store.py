@@ -282,6 +282,9 @@ class _Entry:
     #: stream partitions concurrently; the first unpin must not make
     #: the entry evictable under the others.
     pins: int = 0
+    #: host-bytes equivalent parked on the DISK tier (what disk_used
+    #: credits back when the entry is restored or removed)
+    disk_bytes: int = 0
 
     @property
     def pinned(self) -> bool:
@@ -352,6 +355,9 @@ class BufferStore:
         #: observability (ref: spill metrics + memoryBytesSpilled)
         self.spilled_device_to_host = 0
         self.spilled_host_to_disk = 0
+        #: gauge: host-bytes equivalent currently parked on disk (the
+        #: telemetry sampler's third storage tier)
+        self.disk_used = 0
 
     def spill_stats(self) -> dict[str, int]:
         """Point-in-time spill/occupancy accounting — the store's
@@ -363,6 +369,7 @@ class BufferStore:
             return {
                 "device_used": self.device_used,
                 "host_used": self.host_used,
+                "disk_used": self.disk_used,
                 "spilled_device_to_host": self.spilled_device_to_host,
                 "spilled_host_to_disk": self.spilled_host_to_disk,
             }
@@ -440,6 +447,8 @@ class BufferStore:
                     os.unlink(e.path)
                 except OSError:
                     pass
+                self.disk_used -= e.disk_bytes
+                e.disk_bytes = 0
             e.batch, e.host, e.path = batch, None, None
             e.tier = StorageTier.DEVICE
             self.device_used += e.nbytes
@@ -480,6 +489,8 @@ class BufferStore:
                     os.unlink(e.path)
                 except OSError:
                     pass
+                self.disk_used -= e.disk_bytes
+                e.disk_bytes = 0
 
     # -- budget / spill -------------------------------------------------- #
 
@@ -584,7 +595,9 @@ class BufferStore:
         victim.host = None
         victim.path = path
         victim.tier = StorageTier.DISK
+        victim.disk_bytes = hb
         self.host_used -= hb
+        self.disk_used += hb
         self.spilled_host_to_disk += hb
         return True
 
@@ -615,6 +628,16 @@ def get_store() -> BufferStore:
     with _STORE_LOCK:
         if _STORE is None:
             _STORE = BufferStore()
+        return _STORE
+
+
+def peek_store() -> Optional[BufferStore]:
+    """The live store WITHOUT creating one.  A background probe (the
+    telemetry sampler) must never construct the process singleton from
+    its own thread's conf — the store snapshots budgets and the spill
+    codec at __init__, and a sampler-thread default conf would pin
+    them for the process lifetime."""
+    with _STORE_LOCK:
         return _STORE
 
 

@@ -272,6 +272,18 @@ def get_scheduler(conf=None) -> QueryScheduler:
     return s
 
 
+def queue_gauges() -> dict:
+    """Point-in-time admission occupancy without creating a scheduler
+    (the telemetry sampler's serving-tier gauge: queries running under
+    admission + queue depth right now)."""
+    with _LOCK:
+        s = _SCHED
+    if s is None:
+        return {"running": 0, "waiting": 0}
+    with s._cv:
+        return {"running": s._running, "waiting": len(s._waiting)}
+
+
 def scheduler_stats() -> dict:
     with _LOCK:
         s = _SCHED

@@ -429,9 +429,11 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
     paths so they can never drift: align the process-global subsystems
     with this session's conf — the tracer (spans carry this query),
     the fault registry (conf-armed chaos schedules take effect per
-    query) and the device semaphore (per-session concurrentTpuTasks
+    query), the device semaphore (per-session concurrentTpuTasks
     changes resize the live permit pool, which also re-sizes serving
-    admission) — then allocate the query id, snapshot the event-log
+    admission), the device-utilization ledger and the telemetry
+    sampler (which also attaches this session's event-log writer for
+    periodic `telemetry` records) — then allocate the query id, snapshot the event-log
     counters (the per-query event-log check: `elog` is None when
     disabled — no writer thread, nothing on the batch loop) and stamp
     the clocks.
@@ -443,10 +445,14 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
     from spark_rapids_tpu.eventlog import conf_fingerprint
     from spark_rapids_tpu.memory.semaphore import TpuSemaphore
     from spark_rapids_tpu.robustness import faults as _faults
+    from spark_rapids_tpu.trace import ledger as _ledger
+    from spark_rapids_tpu.trace import telemetry as _telemetry
 
     _trace.sync_conf(conf)
     _faults.sync_conf(conf)
     TpuSemaphore.sync_conf(conf)
+    _ledger.sync_conf(conf)
+    _telemetry.sync_conf(conf, writer=session._eventlog)
     qid = session.history.allocate_id()
     elog = session._eventlog
     pre = elog.query_begin() if elog is not None else None
@@ -1228,12 +1234,18 @@ class DataFrame:
             from spark_rapids_tpu.tools.profiling import render_analyze
 
             from spark_rapids_tpu.serving import plan_cache as _pc
+            from spark_rapids_tpu.trace import ledger as _ledger
 
             before = cache_stats()
             retry0 = retry_stats()
             faults0 = _faults.recovered_total()
             rf0 = _rf.stats()
             pc0 = _pc.stats()
+            # sync NOW (normally a _begin_query job) so the pre-collect
+            # snapshot sees a conf-enabled ledger on the first analyze
+            _ledger.sync_conf(self._session.conf)
+            led0 = _ledger.snapshot() if _ledger.LEDGER.enabled \
+                else None
             _out, qid = self._collect_tpu()
             after = cache_stats()
             # per-QUERY deltas (counters are process-wide cumulative;
@@ -1261,12 +1273,20 @@ class DataFrame:
             # find OUR event by id — events[-1] may be a concurrent
             # collect's record (fall back to it only if concurrent
             # collects evicted ours from a tiny history ring)
+            # per-query device-ledger attribution (the roofline column
+            # + top-programs footer; docs/device_ledger.md) — settled
+            # off the critical path, bounded-waited here
+            led = None
+            if led0 is not None and _ledger.LEDGER.enabled:
+                _ledger.LEDGER.flush(timeout=2.0)
+                led = _ledger.summarize(
+                    _ledger.delta(led0, _ledger.snapshot()))
             events_ = self._session.history.events
             ev = next((e for e in reversed(events_)
                        if e.query_id == qid), events_[-1])
             events = _trace.snapshot() if _trace.is_enabled() else None
             return render_analyze(ev, events, cache_stats=cs,
-                                  counters=counters)
+                                  counters=counters, ledger=led)
         exec_, meta = plan_query(self._plan, self._session.conf)
         # the lowered plan + its static annotation sections (lint
         # findings, pipeline stages, runtime-filter sites) — shared

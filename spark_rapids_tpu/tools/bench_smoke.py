@@ -21,6 +21,13 @@ tests/test_runtime_filter.py).
 metrics identical to the session's settled QueryHistory snapshot
 (tier-1 via tests/test_eventlog.py).
 
+`run_ledger_smoke` holds the device-ledger contract
+(spark_rapids_tpu/trace/ledger.py, docs/device_ledger.md): a tiny
+query collected with `trace.ledger.enabled` must attribute at least
+one program with nonzero cost-model bytes and dispatch count, and the
+attributed device time must stay within the query wall (tier-1 via
+tests/test_ledger.py).
+
 `run_serving_smoke` holds the serving-tier contract
 (spark_rapids_tpu/serving/, docs/serving.md): a prepared template's
 second execution is a plan-cache hit that never re-enters plan_query,
@@ -363,6 +370,78 @@ def run_serving_smoke() -> dict:
     return out
 
 
+def run_ledger_smoke() -> dict:
+    """Device-ledger acceptance contract, cheap CI form (tier-1 via
+    tests/test_ledger.py): a tiny grouped aggregate collected with the
+    ledger on must attribute >=1 program with a nonzero cost-model
+    byte count AND a nonzero dispatch count, and the sum of attributed
+    device time must not exceed the query's wall clock (attribution
+    may under-count — dispatch gaps are real — but it must never
+    invent device time; the ledger credits EXCLUSIVE busy intervals,
+    so overlapping async-dispatch windows cannot double-count the one
+    chip).  Pipelining/speculation are pinned OFF so the stream loop
+    stays serial, and the wall is measured through the settle flush —
+    every credited interval lies inside the measured window."""
+    import time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+    from spark_rapids_tpu.trace import ledger
+
+    conf = get_conf()
+    keys = ("spark.rapids.tpu.trace.ledger.enabled",
+            "spark.rapids.tpu.sql.pipeline.enabled",
+            "spark.rapids.tpu.sql.speculation.enabled")
+    saved = {k: conf.get(k) for k in keys}
+    out: dict = {}
+    try:
+        conf.set(keys[0], True)
+        conf.set(keys[1], False)
+        conf.set(keys[2], False)
+        ledger.reset_stats()
+        session = TpuSession()
+        rng = np.random.default_rng(0x1ED6)
+        n = 4096
+        t = pa.table({
+            "k": rng.integers(0, 32, n).astype(np.int64),
+            "v": rng.random(n),
+        })
+        df = (session.create_dataframe(t)
+              .group_by(col("k"))
+              .agg((sum_(col("v")), "sv")))
+        t0 = time.perf_counter()
+        result = df.collect(engine="tpu")
+        assert ledger.LEDGER.flush(timeout=30.0), \
+            "ledger settlement did not drain"
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        s = ledger.summarize(ledger.snapshot())
+        progs = s["programs"]
+        assert progs, "ledger recorded no programs"
+        assert any(p["dispatches"] > 0 and p["bytes_accessed"] > 0
+                   for p in progs.values()), \
+            f"no program has cost-model bytes + dispatches: {progs}"
+        total = s["totals"]
+        assert total["device_ms"] <= wall_ms, (
+            f"attributed device time {total['device_ms']}ms exceeds "
+            f"the query wall {wall_ms:.1f}ms")
+        out["ledger_programs"] = total["programs"]
+        out["ledger_dispatches"] = total["dispatches"]
+        out["ledger_rows"] = result.num_rows
+    finally:
+        for k, v in saved.items():
+            conf.set(k, v)
+        ledger.reset_stats()
+        if not ledger.LEDGER.forced:
+            # conf-owned enable from this smoke: drop it now instead
+            # of waiting for the next query boundary (a FORCED enable
+            # belongs to someone else — leave it alone)
+            ledger.disable()
+    return out
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -405,6 +484,7 @@ def main() -> int:
     results.update(run_rf_smoke())
     results.update(run_eventlog_smoke())
     results.update(run_serving_smoke())
+    results.update(run_ledger_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

@@ -16,7 +16,9 @@ four analyses operate on one or many of them:
   (CPU fallbacks, retry storms, spill thrash, jit-cache miss-budget
   blowouts, steady-state blocking readbacks, starved pipelines,
   runtime filters that pruned nothing, serving-tier admission waits
-  past the conf budget).
+  past the conf budget, dispatch-overhead-dominated queries and
+  attributed rooflines below budget — the last two fed from the
+  device ledger's per-query ``programs`` section).
 - ``report``   — the fleet-style regression report: one markdown
   document with run fingerprints, the compare matrix, and per-run
   health findings.
@@ -62,6 +64,14 @@ BLOCKING_READBACK_BUDGET = 32
 #: from reading as starvation)
 OCCUPANCY_FLOOR = 0.05
 OCCUPANCY_MIN_ITEMS = 32
+#: HC010 (dispatch-overhead-dominated): at/above this many program
+#: dispatches in one query AND device time under the share below, the
+#: chip idled between launches — fuse chains / bucket shapes instead
+DISPATCH_OVERHEAD_FLOOR = 64
+DISPATCH_DEVICE_SHARE = 0.2
+#: HC011 (roofline below budget) only engages past this much settled
+#: device time — a 3ms unit query tells you nothing about the roofline
+ROOFLINE_MIN_DEVICE_MS = 50.0
 
 
 # ------------------------------------------------------------------ #
@@ -107,9 +117,16 @@ class QueryRecord:
     result_digest: Optional[str]
     rows: Optional[int]
     raw: dict
+    #: device-ledger attribution ({"programs": {...}, "totals": {...}},
+    #: trace/ledger.py) — None when the ledger was off for this query
+    programs: Optional[dict] = None
 
     def counter(self, key: str, default: float = 0) -> float:
         return self.counters.get(key, default) or 0
+
+    def program_totals(self) -> dict:
+        """The ledger totals for this query ({} when unrecorded)."""
+        return (self.programs or {}).get("totals") or {}
 
     def occupancy(self) -> Optional[float]:
         """Item-weighted pipeline occupancy (bench.py's formula), or
@@ -133,6 +150,9 @@ class ApplicationInfo:
     kind: str  # "eventlog" | "bench"
     header: dict
     queries: list
+    #: live-telemetry gauge samples (trace/telemetry.py records), in
+    #: file order; empty for bench pseudo-apps and sampler-off runs
+    telemetry: list = dataclasses.field(default_factory=list)
 
     @property
     def label(self) -> str:
@@ -174,6 +194,7 @@ def _query_from_record(rec: dict) -> QueryRecord:
         result_digest=rec.get("result_digest"),
         rows=rec.get("rows"),
         raw=rec,
+        programs=rec.get("programs"),
     )
 
 
@@ -235,7 +256,7 @@ def load_bench_round(path: str) -> ApplicationInfo:
 def load_application(path: str) -> ApplicationInfo:
     """Load one run: an event log (.jsonl[.gz]) or a committed bench
     round JSON (detected by content, not extension)."""
-    from spark_rapids_tpu.eventlog.reader import read_log
+    from spark_rapids_tpu.eventlog.reader import read_log_all
 
     if not path.endswith(".gz"):
         try:
@@ -246,9 +267,10 @@ def load_application(path: str) -> ApplicationInfo:
                 return load_bench_round(path)
         except UnicodeDecodeError:
             pass
-    header, recs = read_log(path)
+    header, recs, telemetry = read_log_all(path)
     return ApplicationInfo(path, "eventlog", header or {},
-                           [_query_from_record(r) for r in recs])
+                           [_query_from_record(r) for r in recs],
+                           telemetry=telemetry)
 
 
 # ------------------------------------------------------------------ #
@@ -300,6 +322,45 @@ def _operator_deltas(base: OpNode, run: OpNode,
     return sorted(out, key=lambda d: -d["ratio"])
 
 
+def _program_deltas(base: dict, run: dict,
+                    threshold: float) -> list[dict]:
+    """Per-PROGRAM device-time deltas between two recorded ledger
+    sections (the `programs` query-record field): programs match by
+    their structural key hash (stable across runs — the key is built
+    from expression trees and capacities, never addresses), so a
+    regression is pinned to the compiled program that slowed down, not
+    just the operator class.  Programs present on only one side are
+    reported as appeared/vanished — a changed fusion/bucketing
+    decision shows up as churn here before it shows up as wall
+    time."""
+    bp = (base or {}).get("programs") or {}
+    rp = (run or {}).get("programs") or {}
+    out: list[dict] = []
+    for key in sorted(set(bp) | set(rp)):
+        b, r = bp.get(key), rp.get(key)
+        if b is None or r is None:
+            side = "appeared" if b is None else "vanished"
+            p = r or b
+            out.append({"program": key, "op": p.get("op"),
+                        "change": side,
+                        "device_ms": p.get("device_ms", 0.0),
+                        "dispatches": p.get("dispatches", 0)})
+            continue
+        tb, tr = b.get("device_ms", 0.0), r.get("device_ms", 0.0)
+        if tb >= 1.0 and tr >= 1.0:  # ignore sub-ms noise
+            ratio = tr / tb
+            if ratio >= threshold or ratio <= 1.0 / threshold:
+                out.append({
+                    "program": key, "op": r.get("op"),
+                    "change": "ratio",
+                    "base_ms": round(tb, 2), "run_ms": round(tr, 2),
+                    "ratio": round(ratio, 3),
+                    "base_dispatches": b.get("dispatches", 0),
+                    "run_dispatches": r.get("dispatches", 0),
+                })
+    return sorted(out, key=lambda d: -d.get("ratio", 0.0))
+
+
 def compare_applications(apps: Sequence[ApplicationInfo],
                          threshold: float =
                          DEFAULT_REGRESSION_THRESHOLD) -> dict:
@@ -343,6 +404,11 @@ def compare_applications(apps: Sequence[ApplicationInfo],
             if bq.operators and rq.operators:
                 row["operator_deltas"] = _operator_deltas(
                     bq.operators, rq.operators, threshold)
+            if bq.programs and rq.programs:
+                pd = _program_deltas(bq.programs, rq.programs,
+                                     threshold)
+                if pd:
+                    row["program_deltas"] = pd
             rows.append(row)
             if flag == "regression":
                 regressions.append(row)
@@ -493,6 +559,51 @@ def _hc_admission_wait(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_dispatch_overhead(q: QueryRecord) -> Optional[str]:
+    """HC010: dispatch-overhead-dominated query — the ledger recorded
+    many program launches but the chip was busy for only a small
+    share of the wall, so per-dispatch overhead (trace/compile-cache
+    lookup, host argument marshalling, link round trips on tunneled
+    backends) dominated.  The fusion/bucketing work of ROADMAP #2
+    exists to collapse exactly this shape."""
+    totals = q.program_totals()
+    disp = totals.get("dispatches") or 0
+    device_ms = totals.get("device_ms") or 0.0
+    if disp < DISPATCH_OVERHEAD_FLOOR or q.wall_s <= 0:
+        return None
+    if device_ms < DISPATCH_DEVICE_SHARE * q.wall_s * 1e3:
+        return (f"dispatch-overhead-dominated: {int(disp)} program "
+                f"dispatches but only {device_ms:.0f}ms device time "
+                f"in {q.wall_s * 1e3:.0f}ms wall "
+                f"(< {DISPATCH_DEVICE_SHARE:.0%}) — fuse chains / "
+                "bucket shapes to cut launches "
+                "(docs/device_ledger.md)")
+    return None
+
+
+def _hc_roofline_budget(q: QueryRecord) -> Optional[str]:
+    """HC011: attributed roofline below budget — the query's programs
+    burned real device time at a device-time-weighted roofline
+    fraction under spark.rapids.tpu.trace.ledger.health.rooflineFloor.
+    Only fires past ROOFLINE_MIN_DEVICE_MS of settled device time, so
+    unit-test-sized queries stay silent."""
+    totals = q.program_totals()
+    device_ms = totals.get("device_ms") or 0.0
+    roofline = totals.get("roofline")
+    if roofline is None or device_ms < ROOFLINE_MIN_DEVICE_MS:
+        return None
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.trace.ledger import LEDGER_ROOFLINE_FLOOR
+
+    floor = float(get_conf().get(LEDGER_ROOFLINE_FLOOR))
+    if roofline < floor:
+        return (f"attributed roofline {roofline:.6f} below the "
+                f"{floor} budget over {device_ms:.0f}ms device time — "
+                "the chip ran far under its bandwidth roofline for "
+                "this plan (docs/device_ledger.md; ROADMAP #2)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -502,7 +613,9 @@ for _id, _sev, _fn in (
         ("HC006", "warning", _hc_starved_pipeline),
         ("HC007", "warning", _hc_rf_no_prune),
         ("HC008", "info", _hc_recovered_faults),
-        ("HC009", "warning", _hc_admission_wait)):
+        ("HC009", "warning", _hc_admission_wait),
+        ("HC010", "warning", _hc_dispatch_overhead),
+        ("HC011", "warning", _hc_roofline_budget)):
     register_health_rule(_id, _sev, _fn)
 
 
@@ -548,6 +661,21 @@ def render_compare_md(result: dict) -> str:
                 f"- {row['run']} / {row['query']}: "
                 f"`{od['operator']}` {od['base_ms']}ms -> "
                 f"{od['run_ms']}ms ({od['ratio']}x)")
+        for pd in row.get("program_deltas", []):
+            if pd["change"] == "ratio":
+                lines.append(
+                    f"- {row['run']} / {row['query']}: program "
+                    f"`{pd['program']}` ({pd['op']}) "
+                    f"{pd['base_ms']}ms -> {pd['run_ms']}ms "
+                    f"({pd['ratio']}x, "
+                    f"{pd['base_dispatches']}->"
+                    f"{pd['run_dispatches']} dispatches)")
+            else:
+                lines.append(
+                    f"- {row['run']} / {row['query']}: program "
+                    f"`{pd['program']}` ({pd['op']}) {pd['change']} "
+                    f"({pd['device_ms']}ms, "
+                    f"{pd['dispatches']} dispatches)")
     if result["unmatched"]:
         lines += ["", "Unmatched queries (no counterpart run):"]
         for u in result["unmatched"]:

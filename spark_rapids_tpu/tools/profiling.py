@@ -262,10 +262,35 @@ def _counter_footer(counters: Optional[dict]) -> list[str]:
     return lines
 
 
+def _ledger_footer(ledger: Optional[dict]) -> list[str]:
+    """Device-ledger footer: totals + the top programs by device time
+    (callers pass a per-query `trace.ledger.summarize(delta)` — the
+    same section the event log persists, so explain("analyze") and
+    tools/history read one story)."""
+    if not ledger:
+        return []
+    t = ledger.get("totals") or {}
+    roof = t.get("roofline")
+    lines = [
+        f"device ledger: programs={t.get('programs', 0)} "
+        f"dispatches={t.get('dispatches', 0)} "
+        f"device_ms={t.get('device_ms', 0.0):.2f} "
+        f"dispatch_ms={t.get('dispatch_ms', 0.0):.2f} "
+        + (f"roofline={roof:.6f}" if roof is not None
+           else "roofline=n/a")]
+    for p in t.get("top") or []:
+        lines.append(
+            f"  top: {p['key']} op={p['op'] or '-'} "
+            f"dispatches={p['dispatches']} "
+            f"device_ms={p['device_ms']:.2f} share={p['share']:.0%}")
+    return lines
+
+
 def profile_query(ev: QueryEvent,
                   trace_events: Optional[Sequence] = None,
                   cache_stats: Optional[dict] = None,
-                  counters: Optional[dict] = None) -> str:
+                  counters: Optional[dict] = None,
+                  ledger: Optional[dict] = None) -> str:
     """Per-operator metrics table for one query (the Analysis /
     ClassWarehouse per-SQL metrics view).  With `trace_events` (a
     spark_rapids_tpu.trace snapshot), a `self_ms` column reports each
@@ -305,6 +330,7 @@ def profile_query(ev: QueryEvent,
     footer = ([] if cache_stats is None
               else [_jit_cache_line(cache_stats)])
     footer += _counter_footer(counters)
+    footer += _ledger_footer(ledger)
     if footer:
         lines += [""] + footer
     return "\n".join(lines) + "\n"
@@ -313,7 +339,8 @@ def profile_query(ev: QueryEvent,
 def render_analyze(ev: QueryEvent,
                    trace_events: Optional[Sequence] = None,
                    cache_stats: Optional[dict] = None,
-                   counters: Optional[dict] = None) -> str:
+                   counters: Optional[dict] = None,
+                   ledger: Optional[dict] = None) -> str:
     """EXPLAIN ANALYZE: the post-run plan tree, each operator annotated
     with its SETTLED metrics (wall time per device-synced totalTime,
     rows, batches) and — when a trace is available — span-derived
@@ -327,12 +354,22 @@ def render_analyze(ev: QueryEvent,
     the regular metric annotations — a join showing only specHits ran
     its stream loop sync-free.  `cache_stats` (a per-query
     jit_cache.cache_stats() delta) appends the compile-cache hit
-    rate."""
+    rate.  `ledger` (a per-query `trace.ledger.summarize(delta)`,
+    present when the device ledger is on) adds a per-operator
+    ``roofline=`` column — that operator's ATTRIBUTED roofline
+    fraction: cost-model bytes x dispatches of the programs it
+    compiled, over their settled device time, against the HBM peak —
+    plus a top-programs footer (docs/device_ledger.md)."""
     stats: dict = {}
     if trace_events is not None:
         from spark_rapids_tpu.trace.export import span_stats
 
         stats = span_stats(trace_events, query_id=ev.query_id)
+    op_roof: dict = {}
+    if ledger:
+        from spark_rapids_tpu.trace.ledger import per_op
+
+        op_roof = per_op(ledger.get("programs") or {})
     lines = [f"== Physical Plan (ANALYZE, query {ev.query_id}, "
              f"{ev.wall_s:.3f}s wall) =="]
 
@@ -350,6 +387,16 @@ def render_analyze(ev: QueryEvent,
                 f"span(busy={st['busy_ns'] / 1e6:.2f}ms "
                 f"self={st['wall_ns'] / 1e6:.2f}ms "
                 f"overlap={st['overlap_ns'] / 1e6:.2f}ms)")
+        lr = op_roof.get(_op_key(n.desc))
+        if lr:
+            # the ledger's attributed per-operator roofline (the
+            # column ROADMAP #2's fusion work is judged against)
+            ann.append(
+                "roofline=" + (f"{lr['roofline']:.6f}"
+                               if lr["roofline"] is not None
+                               else "n/a")
+                + f" device={lr['device_ms']:.2f}ms"
+                  f" dispatches={lr['dispatches']}")
         extras = {k: v for k, v in m.items()
                   if k not in ("totalTime", "numOutputRows",
                                "numOutputBatches") and v}
@@ -366,6 +413,7 @@ def render_analyze(ev: QueryEvent,
     if jc is not None:
         lines.append(jc)
     lines.extend(_counter_footer(counters))
+    lines.extend(_ledger_footer(ledger))
     return "\n".join(lines) + "\n"
 
 

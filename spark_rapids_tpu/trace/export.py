@@ -41,6 +41,10 @@ def chrome_trace(events: Optional[Sequence[TraceEvent]] = None) -> dict:
                "args": dict(ev.attrs)}
         if ev.ph == "X":
             rec["dur"] = ev.dur_ns / 1e3
+        elif ev.ph == "C":
+            # counter sample (the telemetry tracks): args ARE the
+            # series values; Perfetto stacks them into a counter track
+            pass
         else:
             rec["s"] = "t"  # thread-scoped instant
         out.append(rec)

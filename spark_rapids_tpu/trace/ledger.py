@@ -1,0 +1,544 @@
+"""Per-program device-utilization ledger: which compiled program burns
+the chip's time, and how close each runs to its roofline.
+
+ROADMAP open item #2 is judged on ``hbm_roofline_fraction``, but until
+this module that number was a single coarse quotient in bench.py
+(wall-clock rows/s x row bytes / HBM bandwidth) — nothing could say
+WHICH XLA program the time went to, how much of a query was dispatch
+overhead versus device compute, or what a program's achieved bytes/s
+and FLOPs/s are against the chip peaks.  The reference stack leans on
+exactly this attribution (per-exec GpuMetrics feeding the Profiling /
+Qualification tools); this is the XLA analog:
+
+- every compiled program already flows through ONE chokepoint —
+  :func:`spark_rapids_tpu.execs.jit_cache.cached_jit` — keyed by a
+  structural program key.  The cache wraps each jitted callable with a
+  ledger hook: when the ledger is ON, each dispatch bumps an invocation
+  counter and hands the program's output to a settlement worker (the
+  metric-reaper pattern: poll ``is_ready`` off the critical path, then
+  credit the dispatch its EXCLUSIVE busy interval — completion stamps
+  are monotone across the settle queue, so overlapping async-dispatch
+  windows never double-count the one chip and the per-query sum is a
+  true device-busy time bounded by the wall);
+- on a program's FIRST ledger-observed dispatch, XLA's own cost model
+  is captured (``fn.lower(*args).compile().cost_analysis()`` on the
+  settlement worker): flops and bytes accessed per execution;
+- from (dispatches, device wall, cost model) the ledger computes the
+  ATTRIBUTED roofline per program — achieved bytes/s and flops/s
+  against the chip peaks — plus dispatch-overhead ratios, surfaced in
+  ``explain("analyze")`` (per-operator roofline column + top-program
+  footer), bench.py (``q*_device_busy_ms`` / ``q*_roofline_attributed``
+  / top-program fields), the event log (the per-query ``programs``
+  section) and ``tools/history`` (per-program compare deltas, health
+  rules HC010/HC011).
+
+Cost discipline: with ``spark.rapids.tpu.trace.ledger.enabled=false``
+(the default) the per-dispatch cost is ONE attribute read in the
+cached_jit wrapper — no entry exists, no lock is taken, behavior is
+bit-identical.  Enabled, the hot loop pays one counter bump under a
+per-entry lock; everything else (completion wait, cost analysis)
+settles on the ledger's worker thread.  Docs: ``docs/device_ledger.md``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Optional
+
+from spark_rapids_tpu.config import register
+
+LEDGER_ENABLED = register(
+    "spark.rapids.tpu.trace.ledger.enabled", False,
+    "Enable the per-program device-utilization ledger: every program "
+    "dispatched through the jit cache records invocation count, "
+    "device wall time (settled off the critical path) and XLA's own "
+    "cost model (flops, bytes accessed), from which per-program and "
+    "per-operator ATTRIBUTED roofline fractions are computed — "
+    "surfaced in explain('analyze'), bench.py and the event log's "
+    "per-query `programs` section (docs/device_ledger.md).  Off (the "
+    "default) the only per-dispatch cost is one attribute read.")
+
+LEDGER_HBM_BYTES_PER_S = register(
+    "spark.rapids.tpu.trace.ledger.hbmBytesPerSec", 819e9,
+    "HBM bandwidth roofline of the chip (bytes/s; default: TPU v5e "
+    "~819 GB/s).  The single source of the roofline denominator: "
+    "bench.py's coarse hbm_roofline_fraction and the ledger's "
+    "attributed per-program fractions both divide by this, so the "
+    "constant cannot drift between them.",
+    check=lambda v: v > 0)
+
+LEDGER_PEAK_FLOPS = register(
+    "spark.rapids.tpu.trace.ledger.peakFlopsPerSec", 197e12,
+    "Compute roofline of the chip (FLOPs/s; default: TPU v5e bf16 "
+    "~197 TFLOP/s) — denominator of the ledger's attributed "
+    "flops-side roofline fraction.",
+    check=lambda v: v > 0)
+
+LEDGER_ROOFLINE_FLOOR = register(
+    "spark.rapids.tpu.trace.ledger.health.rooflineFloor", 0.001,
+    "HC011 health-rule budget: a query whose ATTRIBUTED roofline "
+    "fraction (device-time-weighted, from the event log's per-query "
+    "programs section) falls below this while its programs burned "
+    "real device time is flagged — the chip ran far under its "
+    "roofline for that plan (docs/device_ledger.md).",
+    check=lambda v: 0 <= v <= 1)
+
+#: the conf default, importable without a conf in hand (bench.py's
+#: module-level docs reference the same number the conf carries)
+DEFAULT_HBM_BYTES_PER_S = float(LEDGER_HBM_BYTES_PER_S.default)
+
+
+def roofline_fraction(bytes_per_s: float,
+                      hbm_bytes_per_s: Optional[float] = None) -> float:
+    """THE roofline formula: achieved bytes/s over the chip's HBM
+    bandwidth.  One definition shared by bench.py's coarse cold/warm
+    quotients and the ledger's per-program attribution, so the formula
+    and the constant cannot drift apart."""
+    if hbm_bytes_per_s is None:
+        from spark_rapids_tpu.config import get_conf
+
+        hbm_bytes_per_s = float(get_conf().get(LEDGER_HBM_BYTES_PER_S))
+    return bytes_per_s / hbm_bytes_per_s
+
+
+def program_key_str(key: Any) -> str:
+    """Stable, compact cross-run identity for a structural jit key:
+    the key's leading tag (every cached_jit key starts with one) plus a
+    hash of the full structural serialization.  Structural keys contain
+    only expression trees / capacities / schemas — no addresses — so
+    the same program hashes identically across runs, which is what
+    lets tools/history line programs up between event logs."""
+    tag = key[0] if isinstance(key, tuple) and key \
+        and isinstance(key[0], str) else "prog"
+    h = hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+    return f"{tag}#{h}"
+
+
+class ProgramEntry:
+    """Cumulative counters for one compiled program (one jit key)."""
+
+    __slots__ = ("key_str", "tag", "op", "gen", "dispatches",
+                 "dispatch_ns", "device_ns", "flops", "bytes_accessed",
+                 "cost_state", "lock")
+
+    #: cost_state values
+    COST_NONE, COST_PENDING, COST_DONE = 0, 1, 2
+
+    def __init__(self, key: Any, op: Optional[str], gen: int):
+        self.key_str = program_key_str(key)
+        self.tag = key[0] if isinstance(key, tuple) and key \
+            and isinstance(key[0], str) else "prog"
+        self.op = op
+        self.gen = gen
+        self.dispatches = 0
+        self.dispatch_ns = 0  # host-side dispatch wall (call duration)
+        self.device_ns = 0  # exclusive busy intervals, reaper-settled
+        self.flops = 0.0  # per execution, from XLA cost analysis
+        self.bytes_accessed = 0.0  # per execution
+        self.cost_state = self.COST_NONE
+        self.lock = threading.Lock()
+
+
+class _SettleWorker:
+    """Off-critical-path settlement, mirroring the metric reaper:
+    dispatch sites derive zero-row SENTINELS from the program output on
+    the producing thread (the sentinel's completion implies the
+    program finished; polling the output arrays themselves would race
+    the spill store's .delete()) and this daemon polls readiness, then
+    credits dispatch-to-completion time to the entry.  Cost-analysis
+    capture (lower+compile+cost_analysis, once per program) also runs
+    here — it can take tens of ms and must never sit on the hot
+    loop."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._unfinished = 0
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        #: completion stamp of the previously settled dispatch: each
+        #: dispatch is credited its EXCLUSIVE interval
+        #: [max(t0, prev_done), done] — async dispatch lets
+        #: dispatch-to-completion windows overlap (program k+1 is
+        #: launched while k still runs), and crediting overlapping
+        #: wall to both would double-count one chip.  The device runs
+        #: programs in order, the worker settles them in order, so the
+        #: credited intervals are disjoint and their sum is a true
+        #: BUSY time, bounded by the query wall (the run_ledger_smoke
+        #: acceptance bound) — queue wait inherited from the previous
+        #: program is excluded by construction.
+        self._last_done_ns = 0
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="tpu-ledger-settle", daemon=True)
+            self._thread.start()
+
+    def submit(self, entry: ProgramEntry, t0: int, out: Any,
+               cost_req: Optional[tuple]) -> None:
+        import jax
+
+        try:
+            sentinels = [x[:0] if x.ndim > 0 else x.reshape((1,))[:0]
+                         for x in jax.tree_util.tree_leaves(out)
+                         if isinstance(x, jax.Array)]
+        except Exception:
+            sentinels = []  # deleted/donated already: settle as host
+        with self._cv:
+            self._ensure_thread()
+            self._unfinished += 1
+        self._q.put((entry, t0, sentinels, cost_req))
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait (bounded) until every submitted dispatch has settled;
+        returns False on timeout.  Bounded because callers sit at query
+        boundaries — a wedged settle must degrade the ledger, not hang
+        the query epilogue."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            while self._unfinished:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def _task_done(self) -> None:
+        with self._cv:
+            self._unfinished -= 1
+            if not self._unfinished:
+                self._cv.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            entry, t0, sentinels, cost_req = self._q.get()
+            try:
+                for x in sentinels:
+                    while not x.is_ready():
+                        time.sleep(0.001)
+                done = time.perf_counter_ns()
+                start = max(t0, self._last_done_ns)
+                self._last_done_ns = done
+                with entry.lock:
+                    entry.device_ns += max(0, done - start)
+                if cost_req is not None:
+                    self._capture_cost(entry, cost_req)
+            except Exception:
+                pass  # diagnostics must never take the engine down
+            finally:
+                self._task_done()
+
+    @staticmethod
+    def _capture_cost(entry: ProgramEntry, cost_req: tuple) -> None:
+        """XLA cost model for one program: lower+compile at the first
+        observed argument signature, read flops / bytes accessed.  A
+        backend without cost analysis (or an unlowerable signature)
+        marks the entry DONE with zeros — retried never."""
+        fn, args, kwargs = cost_req
+        flops = nbytes = 0.0
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                flops = max(0.0, float(ca.get("flops", 0.0) or 0.0))
+                nbytes = max(0.0, float(
+                    ca.get("bytes accessed", 0.0) or 0.0))
+        except Exception:
+            pass
+        with entry.lock:
+            entry.flops = flops
+            entry.bytes_accessed = nbytes
+            entry.cost_state = ProgramEntry.COST_DONE
+
+
+class DeviceLedger:
+    """Process-wide program ledger.  ``enabled`` is THE fast-path
+    guard (the cached_jit wrapper reads this one attribute and does
+    nothing else when the ledger is off); ``forced`` marks a
+    programmatic :func:`enable` that :func:`sync_conf` must not
+    override — the tracer's ownership discipline exactly."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.forced = False
+        self.gen = 0  # bumped by reset(); stale wrapper cells re-key
+        self._entries: dict[Any, ProgramEntry] = {}
+        self._lock = threading.Lock()
+        self._enabled_by: Optional[weakref.ref] = None
+        self._settle = _SettleWorker()
+
+    # -- recording (fed by the cached_jit wrapper) ------------------- #
+
+    def entry(self, key: Any, op: Optional[str]) -> ProgramEntry:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = ProgramEntry(key, op, self.gen)
+            elif e.op is None and op is not None:
+                e.op = op
+            return e
+
+    def wrap(self, key: Any, fn, op: Optional[str] = None):
+        """Wrap one jitted callable with ledger accounting.  The
+        disabled path is one attribute read + the passthrough call —
+        bit-identical results either way (the wrapper never touches
+        arguments or output)."""
+        cell: list = [None]
+        ledger = self
+
+        def dispatch(*args, **kwargs):
+            if not ledger.enabled:
+                return fn(*args, **kwargs)
+            e = cell[0]
+            if e is None or e.gen != ledger.gen:
+                e = cell[0] = ledger.entry(key, op)
+            t0 = time.perf_counter_ns()
+            out = fn(*args, **kwargs)
+            t1 = time.perf_counter_ns()
+            cost_req = None
+            with e.lock:
+                e.dispatches += 1
+                e.dispatch_ns += t1 - t0
+                if e.cost_state == ProgramEntry.COST_NONE:
+                    e.cost_state = ProgramEntry.COST_PENDING
+                    # args are immutable jax values: safe to hold for
+                    # the worker's one-time lower+compile
+                    cost_req = (fn, args, kwargs)
+            ledger._settle.submit(e, t0, out, cost_req)
+            return out
+
+        dispatch.__wrapped__ = fn
+        return dispatch
+
+    # -- lifecycle --------------------------------------------------- #
+
+    def enable(self, forced: bool = True) -> None:
+        self.enabled = True
+        self.forced = forced
+
+    def disable(self) -> None:
+        self.enabled = False
+        self.forced = False
+        self._enabled_by = None
+
+    def reset(self) -> None:
+        """Drop every entry (bench resets between queries, tests
+        between cases).  Wrapper cells holding stale entries re-key on
+        their next dispatch via the generation check."""
+        with self._lock:
+            self.gen += 1
+            self._entries = {}
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        return self._settle.flush(timeout)
+
+    # -- reading ----------------------------------------------------- #
+
+    def snapshot(self) -> dict[str, dict]:
+        """Point-in-time cumulative counters per program (key_str ->
+        plain dict).  Callers wanting per-query figures snapshot
+        before/after and :func:`delta`."""
+        with self._lock:
+            entries = list(self._entries.values())
+        out: dict[str, dict] = {}
+        for e in entries:
+            with e.lock:
+                out[e.key_str] = {
+                    "tag": e.tag,
+                    "op": e.op,
+                    "dispatches": e.dispatches,
+                    "dispatch_ms": round(e.dispatch_ns / 1e6, 3),
+                    "device_ms": round(e.device_ns / 1e6, 3),
+                    "flops": e.flops,
+                    "bytes_accessed": e.bytes_accessed,
+                }
+        return out
+
+
+#: THE process-wide ledger; the cached_jit wrapper guards on
+#: ``LEDGER.enabled``
+LEDGER = DeviceLedger()
+
+
+def is_enabled() -> bool:
+    return LEDGER.enabled
+
+
+def enable() -> None:
+    """Force the ledger on (tests, bench): survives sync_conf."""
+    LEDGER.enable(forced=True)
+
+
+def disable() -> None:
+    LEDGER.disable()
+
+
+def reset_stats() -> None:
+    LEDGER.reset()
+
+
+def snapshot() -> dict[str, dict]:
+    return LEDGER.snapshot()
+
+
+def sync_conf(conf=None) -> None:
+    """Align the ledger with the session conf at a query boundary —
+    same ownership rule as the tracer: a programmatic enable() wins,
+    and only the conf that ENABLED the ledger may turn it off (a
+    concurrent session's defaults-only conf must not kill another
+    session's capture mid-query)."""
+    if LEDGER.forced:
+        return
+    from spark_rapids_tpu.config import get_conf
+
+    conf = conf or get_conf()
+    want = bool(conf.get(LEDGER_ENABLED))
+    if want:
+        if not LEDGER.enabled:
+            LEDGER.enable(forced=False)
+        LEDGER._enabled_by = weakref.ref(conf)
+    elif LEDGER.enabled and LEDGER._enabled_by is not None \
+            and LEDGER._enabled_by() is conf:
+        LEDGER.disable()
+
+
+# ------------------------------------------------------------------ #
+# Analytics over snapshots
+# ------------------------------------------------------------------ #
+
+
+def delta(before: dict[str, dict],
+          after: dict[str, dict]) -> dict[str, dict]:
+    """Per-query attribution: after - before on the monotonic
+    counters, cost-model fields carried from `after` (they are
+    per-execution constants).  Programs that did not dispatch in the
+    window are dropped."""
+    out: dict[str, dict] = {}
+    for k, a in after.items():
+        b = before.get(k, {})
+        d = a["dispatches"] - b.get("dispatches", 0)
+        if d <= 0:
+            continue
+        out[k] = {
+            "tag": a["tag"],
+            "op": a["op"],
+            "dispatches": d,
+            "dispatch_ms": round(
+                a["dispatch_ms"] - b.get("dispatch_ms", 0.0), 3),
+            "device_ms": round(
+                a["device_ms"] - b.get("device_ms", 0.0), 3),
+            "flops": a["flops"],
+            "bytes_accessed": a["bytes_accessed"],
+        }
+    return out
+
+
+def summarize(programs: dict[str, dict], top_n: int = 5,
+              hbm_bytes_per_s: Optional[float] = None,
+              peak_flops: Optional[float] = None) -> dict:
+    """Enrich a snapshot/delta with attributed rooflines and totals —
+    the ``programs`` section the event log persists and bench/analyze
+    render.  Per program: achieved bytes/s and flops/s (cost model x
+    dispatches over settled device time) against the chip peaks, and
+    the dispatch-overhead ratio (host dispatch ms per device ms).
+    Totals: device-time totals, a device-time-WEIGHTED roofline
+    fraction, and the top-N programs by device time with their
+    share."""
+    from spark_rapids_tpu.config import get_conf
+
+    conf = get_conf()
+    if hbm_bytes_per_s is None:
+        hbm_bytes_per_s = float(conf.get(LEDGER_HBM_BYTES_PER_S))
+    if peak_flops is None:
+        peak_flops = float(conf.get(LEDGER_PEAK_FLOPS))
+    enriched: dict[str, dict] = {}
+    total_device_ms = 0.0
+    total_dispatch_ms = 0.0
+    total_dispatches = 0
+    weighted_roofline = 0.0
+    weighted_known_ms = 0.0
+    for k, p in programs.items():
+        device_s = p["device_ms"] / 1e3
+        e = dict(p)
+        if device_s > 0 and p["bytes_accessed"] > 0:
+            bps = p["bytes_accessed"] * p["dispatches"] / device_s
+            fps = p["flops"] * p["dispatches"] / device_s
+            e["bytes_per_s"] = round(bps, 1)
+            e["flops_per_s"] = round(fps, 1)
+            e["roofline"] = round(
+                roofline_fraction(bps, hbm_bytes_per_s), 6)
+            e["flops_fraction"] = round(fps / peak_flops, 9)
+            weighted_roofline += e["roofline"] * p["device_ms"]
+            weighted_known_ms += p["device_ms"]
+        else:
+            e["bytes_per_s"] = e["flops_per_s"] = None
+            e["roofline"] = e["flops_fraction"] = None
+        e["dispatch_overhead"] = round(
+            p["dispatch_ms"] / p["device_ms"], 3) \
+            if p["device_ms"] > 0 else None
+        enriched[k] = e
+        total_device_ms += p["device_ms"]
+        total_dispatch_ms += p["dispatch_ms"]
+        total_dispatches += p["dispatches"]
+    top = sorted(enriched.items(),
+                 key=lambda kv: -kv[1]["device_ms"])[:top_n]
+    totals = {
+        "programs": len(enriched),
+        "dispatches": total_dispatches,
+        "dispatch_ms": round(total_dispatch_ms, 3),
+        "device_ms": round(total_device_ms, 3),
+        "roofline": round(weighted_roofline / weighted_known_ms, 6)
+        if weighted_known_ms else None,
+        "top": [{
+            "key": k,
+            "op": p["op"],
+            "dispatches": p["dispatches"],
+            "device_ms": p["device_ms"],
+            "share": round(p["device_ms"] / total_device_ms, 3)
+            if total_device_ms else 0.0,
+        } for k, p in top],
+    }
+    return {"programs": enriched, "totals": totals}
+
+
+def per_op(programs: dict[str, dict],
+           hbm_bytes_per_s: Optional[float] = None) -> dict[str, dict]:
+    """Aggregate an (un-enriched or enriched) program delta by the
+    operator that compiled it (cached_jit's `op=`), for the
+    explain('analyze') per-operator roofline column: per op —
+    dispatches, device_ms, and the attributed roofline over the op's
+    own device time (cost-model bytes x dispatches / device time)."""
+    from spark_rapids_tpu.config import get_conf
+
+    if hbm_bytes_per_s is None:
+        hbm_bytes_per_s = float(
+            get_conf().get(LEDGER_HBM_BYTES_PER_S))
+    acc: dict[str, dict] = {}
+    for p in programs.values():
+        op = p.get("op")
+        if not op:
+            continue
+        a = acc.setdefault(op, {"dispatches": 0, "device_ms": 0.0,
+                                "bytes_total": 0.0})
+        a["dispatches"] += p["dispatches"]
+        a["device_ms"] += p["device_ms"]
+        a["bytes_total"] += p["bytes_accessed"] * p["dispatches"]
+    out: dict[str, dict] = {}
+    for op, a in acc.items():
+        device_s = a["device_ms"] / 1e3
+        roof = None
+        if device_s > 0 and a["bytes_total"] > 0:
+            roof = round(roofline_fraction(
+                a["bytes_total"] / device_s, hbm_bytes_per_s), 6)
+        out[op] = {"dispatches": a["dispatches"],
+                   "device_ms": round(a["device_ms"], 3),
+                   "roofline": roof}
+    return out

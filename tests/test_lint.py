@@ -507,6 +507,65 @@ def test_source_lint_swallow_rule_scoped_and_exempt():
             lint_source_text(_SWALLOW_FIXTURE, path)), path
 
 
+_RAW_JIT_FIXTURE = """
+import functools
+import jax
+from jax import jit
+from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+
+class FakeExec:
+    def _compile(self, fn):
+        return jax.jit(fn)                   # SRC009: unmetered
+
+    def _compile_bare(self, fn):
+        return jit(fn)                       # SRC009: unmetered
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def kernel(x, interpret=False):              # SRC009: decorator form
+    return x
+
+
+@jax.jit
+def bare_kernel(x):                          # SRC009: bare decorator
+    return x
+
+
+def metered(key, fn, name):
+    return cached_jit(key, lambda: fn, op=name)   # blessed path
+"""
+
+
+def test_source_lint_flags_raw_jit_in_program_modules():
+    """SRC009: raw jax.jit/jit/partial(jax.jit) in execs//ops/ is an
+    ERROR — the program escapes the jit cache's stats AND the device
+    ledger's per-program attribution (docs/device_ledger.md);
+    cached_jit is the blessed path."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/ops/fake.py"):
+        diags = lint_source_text(_RAW_JIT_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC009"]
+        assert len(hits) == 4, (path, diags)
+        assert all(h.severity == "error" for h in hits)
+        locs = " ".join(h.location for h in hits)
+        assert "_compile" in locs and "kernel" in locs \
+            and "bare_kernel" in locs
+    # an ERROR fails even the non-strict repo gate
+    assert evaluate(lint_source_text(
+        _RAW_JIT_FIXTURE, "spark_rapids_tpu/execs/fake.py"))[2] != 0
+
+
+def test_source_lint_raw_jit_rule_scoped_and_exempt():
+    """SRC009 does not police modules outside execs//ops/, nor
+    execs/jit_cache.py itself (it IS the metered chokepoint)."""
+    for path in ("spark_rapids_tpu/parallel/fake.py",
+                 "spark_rapids_tpu/columnar/fake.py",
+                 "spark_rapids_tpu/execs/jit_cache.py"):
+        assert "SRC009" not in rules(
+            lint_source_text(_RAW_JIT_FIXTURE, path)), path
+
+
 # -- metric-registry checker (MET001) ----------------------------------- #
 
 _MET_UNSETTLED = """
@@ -617,7 +676,10 @@ def test_repo_baseline_covers_only_intentional_syncs():
     broad-except sites (the metric reaper's drop-the-sample guards,
     the fastpar/pa_filter/scan fall-back-to-slow-path bailouts, the
     shuffle server's bad-request guards and the heartbeat chain's
-    keep-alive swallow) — nothing may hide behind it silently."""
+    keep-alive swallow) plus (since SRC009) the keyless raw-jit
+    sites — the fused-pipeline fallback in execs/base.py when a chain
+    member has no fuse key, and the module-level Pallas kernel
+    wrappers — nothing may hide behind it silently."""
     from spark_rapids_tpu.lint.diagnostic import load_baseline
 
     keys = load_baseline()
@@ -632,11 +694,16 @@ def test_repo_baseline_covers_only_intentional_syncs():
                      "spark_rapids_tpu/io/pa_filter.py",
                      "spark_rapids_tpu/io/scan.py",
                      "spark_rapids_tpu/shuffle/net.py")
+    rawjit_infra = ("spark_rapids_tpu/execs/base.py",
+                    "spark_rapids_tpu/ops/pallas_kernels.py")
     metric_infra = ("spark_rapids_tpu/execs/", "spark_rapids_tpu/io/")
     for k in keys:
         if k.startswith("SRC005::"):
             assert k.startswith(
                 "SRC005::spark_rapids_tpu/execs/base.py::"), k
+        elif k.startswith("SRC009::"):
+            assert any(k.startswith(f"SRC009::{p}::")
+                       for p in rawjit_infra), k
         elif k.startswith("MET001::"):
             # intentional metric-registry placeholders may be
             # baselined, but only inside the exec layers the rule
