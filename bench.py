@@ -65,6 +65,20 @@ Attribution fields (so round-over-round deltas are explainable):
   hand-diffing these JSON fields (docs/eventlog.md); the file path
   rides in the output as `eventlog`.
 
+- `q{1,3,6}_upload_bytes_wire` / `_upload_bytes_raw` / `_upload_ratio`
+  (+ `link_upload_mb_s_effective`): bytes actually crossing the H2D
+  link over the tapped batched-upload counter, wire compression
+  as-configured vs forced off — the multiplier the wire-codec
+  subsystem (docs/wire_compression.md) buys on the tunneled link.
+  Compression is ON by default for bench rounds
+  (`--no-wire-compression` reverts to the raw wire; the correctness
+  gates run either way).
+
+`bench.py --scale-rows N` switches to the SCALING-CURVE round
+(ROADMAP #1): q6 at N rows (~63M = SF10 lineitem) and q1 at
+max(N // 3, 20M) rows with the full per-stage attribution, proving
+the codec + OOC machinery under real pressure.
+
 `bench.py --sessions N [--tenants K]` switches to the SERVING bench
 (docs/serving.md): N concurrent sessions across K tenants drive
 deterministic golden templates through admission control and the
@@ -530,6 +544,35 @@ def _ledger_fields(prefix: str, iters: int) -> dict:
     return out
 
 
+def _wire_fields(df, prefix: str) -> dict:
+    """Wire-compression attribution: bytes actually crossing the H2D
+    link (the tapped batched-upload counter) with the codec subsystem
+    as-configured vs forced off — `{prefix}_upload_ratio` is the
+    multiplier the codecs buy on the ~13 MB/s tunneled link
+    (docs/wire_compression.md)."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.tools.bench_smoke import count_upload_bytes
+
+    key = "spark.rapids.tpu.sql.wireCompression.enabled"
+    conf = get_conf()
+    old = conf.get(key)
+    try:
+        # AS-CONFIGURED first (matches the timed windows — under
+        # --no-wire-compression this honestly reports ratio 1.0
+        # instead of attributing bytes the measured run never shipped)
+        on_bytes = count_upload_bytes(df)
+        conf.set(key, False)
+        off_bytes = count_upload_bytes(df)
+    finally:
+        conf.set(key, old)
+    return {
+        f"{prefix}_upload_bytes_wire": on_bytes,
+        f"{prefix}_upload_bytes_raw": off_bytes,
+        f"{prefix}_upload_ratio": round(off_bytes / max(on_bytes, 1),
+                                        3),
+    }
+
+
 def _rf_fields(df, iters: int) -> dict:
     """q3 runtime-filter attribution: pruned rows + build cost over the
     timed window (per collect), plus uploaded-row counts with filters
@@ -613,6 +656,7 @@ def _bench_q1(session, d: str) -> dict:
         occ.update(_sync_spec_fields("q1", 3))
         occ.update(_robustness_fields("q1", sp0))
         occ.update(_ledger_fields("q1", 3))
+        occ.update(_wire_fields(df, "q1"))
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
         breakdown.update(occ)
@@ -677,6 +721,7 @@ def _bench_q3(session, d: str) -> dict:
     # runtime-filter attribution for the timed window + the on/off
     # uploaded-row delta (the wire-shrink the filters buy)
     occ.update(_rf_fields(df, 3))
+    occ.update(_wire_fields(df, "q3"))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     # top-k by float revenue: compare the revenue VALUES (ties may order
     # differently) and the grouped rows' exactness via set inclusion
@@ -992,6 +1037,100 @@ def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
     return out
 
 
+def _bench_scaled(scale_rows: int) -> dict:
+    """The scaling-curve round (ROADMAP #1: bench scale was ~SF1
+    against milestones specced SF10+): `bench.py --scale-rows N` runs
+    q6 at N rows (~63M = SF10 lineitem) and q1 at max(N // 3, 20M)
+    rows, each with the full per-stage attribution — stage breakdown,
+    blocking syncs, spills under pressure, device-ledger programs and
+    the wire-compression on/off byte delta — so BENCH_r06+ can prove
+    the codec + OOC machinery under real pressure instead of unit
+    tests.  Correctness stays gated against the CPU engine (one
+    reference iteration; a fast wrong answer at scale is still not a
+    benchmark)."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.session import TpuSession
+
+    n_files6 = max(1, -(-scale_rows // ROWS_PER_FILE))
+    q1_rows = max(scale_rows // 3, 20 * 10**6)
+    n_files1 = max(1, -(-q1_rows // ROWS_PER_FILE))
+    out: dict = {
+        "metric": "scaling_curve",
+        "value": scale_rows,
+        "unit": "rows",
+        "scale_rows": scale_rows,
+        "q6_scaled_rows": n_files6 * ROWS_PER_FILE,
+        "q1_scaled_rows": n_files1 * ROWS_PER_FILE,
+    }
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.trace.ledger.enabled", True)
+    session = TpuSession()
+    with tempfile.TemporaryDirectory(prefix="qscale_") as d:
+        paths = make_lineitem(d, n_files=n_files6)
+        df = q6_dataframe(session, paths)
+        df.collect(engine="tpu")  # warmup
+        link = _link_probe()
+        _reset_pipeline_counters()
+        sp0 = _spilled_now()
+        tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
+        occ = _pipeline_occupancy("q6_scaled_pipeline")
+        occ.update(_sync_spec_fields("q6_scaled", 3,
+                                     with_hit_rate=False))
+        occ.update(_robustness_fields("q6_scaled", sp0))
+        occ.update(_ledger_fields("q6_scaled", 3))
+        occ.update(_wire_fields(df, "q6_scaled"))
+        occ.update(_stage_breakdown(df, "q6_scaled"))
+        cpu_ts, cpu_r = _time_collect(df, "cpu", 1)
+        got = tpu_r.to_pydict()["revenue"][0]
+        want = cpu_r.to_pydict()["revenue"][0]
+        assert abs(got - want) <= 1e-6 * max(1.0, abs(want)), (got, want)
+        tpu_t = statistics.median(tpu_ts)
+        out.update(_stats(tpu_ts, "q6_scaled_tpu"))
+        out.update({
+            "q6_scaled_tpu_s_per_query": round(tpu_t, 4),
+            "q6_scaled_cpu_s_per_query": round(cpu_ts[0], 4),
+            "q6_scaled_vs_cpu": round(cpu_ts[0] / tpu_t, 3),
+            "q6_scaled_rows_per_s": round(
+                n_files6 * ROWS_PER_FILE / tpu_t, 1),
+        })
+        out.update(occ)
+        out.update(link)
+
+        # q1 at >= 20M rows: the grouped 8-aggregate under the same
+        # exchange-width-1 discipline as the plain round
+        key = "spark.rapids.tpu.sql.shuffle.partitions"
+        old_sp = conf.get(key)
+        conf.set(key, 1)
+        try:
+            q1_files = make_lineitem(os.path.join(d, "q1"),
+                                     n_files=n_files1,
+                                     with_q1_cols=True)
+            df1 = q1_dataframe(session, q1_files)
+            df1.collect(engine="tpu")  # warmup
+            _reset_pipeline_counters()
+            sp0 = _spilled_now()
+            tpu_ts, tpu_r = _time_collect(df1, "tpu", 3)
+            occ = _pipeline_occupancy("q1_scaled_pipeline")
+            occ.update(_sync_spec_fields("q1_scaled", 3))
+            occ.update(_robustness_fields("q1_scaled", sp0))
+            occ.update(_ledger_fields("q1_scaled", 3))
+            occ.update(_wire_fields(df1, "q1_scaled"))
+            occ.update(_stage_breakdown(df1, "q1_scaled"))
+            cpu_ts, cpu_r = _time_collect(df1, "cpu", 1)
+            _check_rows(tpu_r, cpu_r, float_from=2, key_cols=2)
+            tpu_t = statistics.median(tpu_ts)
+            out.update(_stats(tpu_ts, "q1_scaled_tpu"))
+            out.update({
+                "q1_scaled_tpu_s_per_query": round(tpu_t, 4),
+                "q1_scaled_cpu_s_per_query": round(cpu_ts[0], 4),
+                "q1_scaled_vs_cpu": round(cpu_ts[0] / tpu_t, 3),
+            })
+            out.update(occ)
+        finally:
+            conf.set(key, old_sp)
+    return out
+
+
 def _eventlog_dir() -> str:
     """Where this round's event log lands: --eventlog DIR, else
     $BENCH_EVENTLOG_DIR, else ./bench_eventlog.  On by default so
@@ -1029,6 +1168,20 @@ def main() -> None:
         # single-session q6/q1/q3/q67 rounds are the plain invocation)
         tenants = _int_flag("--tenants") or min(2, sessions)
         print(json.dumps(_bench_serving(sessions, tenants)))
+        return
+    # wire compression rides every bench round by default (the lever
+    # for the upload-bound milestones; correctness gates stay on, and
+    # the per-query _wire_fields still measure the on/off byte delta);
+    # --no-wire-compression reverts to the raw wire
+    if "--no-wire-compression" not in sys.argv[1:]:
+        from spark_rapids_tpu.config import get_conf as _gc
+
+        _gc().set("spark.rapids.tpu.sql.wireCompression.enabled", True)
+    scale = _int_flag("--scale-rows")
+    if scale:
+        # scaling-curve mode ONLY (ROADMAP #1): q6 at N rows, q1 at
+        # >= 20M, full per-stage attribution, CPU-gated
+        print(json.dumps(_bench_scaled(scale)))
         return
     if "--chaos" in sys.argv[1:]:
         # chaos mode: every query below runs under the deterministic
@@ -1081,8 +1234,17 @@ def main() -> None:
                                      with_hit_rate=False))
         occ.update(_robustness_fields("q6", sp0))
         occ.update(_ledger_fields("q6", TPU_ITERS))
+        occ.update(_wire_fields(df, "q6"))
         breakdown = _stage_breakdown(df, "q6")
         breakdown.update(occ)
+        # effective upload bandwidth: raw (uncompressed-equivalent)
+        # bytes over the wall the wire stage actually spent moving the
+        # compressed form — the codec's multiplier applied to the
+        # physical link's weather-of-the-day figure
+        wire_s = breakdown.get("q6_stage_wire_upload_s", 0.0)
+        if wire_s > 0:
+            breakdown["link_upload_mb_s_effective"] = round(
+                occ["q6_upload_bytes_raw"] / wire_s / 1e6, 1)
 
         # warm device-resident q6: the same filter+aggregate against a
         # df.cache()-materialized scan — batches already in HBM, so

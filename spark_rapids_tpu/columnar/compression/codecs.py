@@ -1,0 +1,375 @@
+"""The built-in wire codecs.
+
+Array codecs are XLA-static-shape by construction: every packed
+layout is fully determined by the static meta tuple (block size, lane
+width, padded length, run capacity) that rides the wire plan — so one
+compiled decode program serves every batch of the same plan, and the
+decompress composes into whatever jitted program reads the component
+(shifts/masks for bitpack lanes, segment cumsum for delta,
+cumsum+searchsorted gather for RLE; deliberately no
+bitcast_convert_type — see columnar/transfer.py's X64-rewriter
+caveat).
+
+Host packing is vectorized numpy: k-bit lanes fold into uint32 words
+by a reshape + shift + or-reduce, so the scan-prefetch thread pays a
+few passes over the column, not a Python loop.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.compression.registry import (
+    Codec,
+    register_codec,
+)
+
+#: packed lane widths: sub-byte and sub-word powers of two, so each
+#: uint32 word holds exactly 32/k lanes and shifts are static masks.
+#: k=0 is the degenerate pure-frame-of-reference form (constant
+#: blocks: only the per-block references ride the wire).
+_KBITS = (0, 1, 2, 4, 8, 16)
+
+
+def _pack_words(lanes: np.ndarray, k: int) -> np.ndarray:
+    """k-bit lanes (uint32, values < 2**k, length a multiple of 32/k)
+    -> packed uint32 words, little-endian lane order within a word."""
+    vpw = 32 // k
+    m = lanes.reshape(-1, vpw)
+    shifts = (np.arange(vpw, dtype=np.uint32) * np.uint32(k))
+    return np.bitwise_or.reduce(m << shifts, axis=1).astype(np.uint32)
+
+
+def _unpack_words(words, k: int, n: int):
+    """Traceable inverse of _pack_words: n k-bit lanes as uint32."""
+    vpw = 32 // k
+    i = jnp.arange(n, dtype=jnp.int32)
+    w = jnp.take(words, i // vpw, axis=0)
+    sh = ((i % vpw) * k).astype(jnp.uint32)
+    return (w >> sh) & jnp.uint32((1 << k) - 1)
+
+
+def _pad_to_blocks(v: np.ndarray, block: int) -> np.ndarray:
+    """Pad to a whole number of blocks with the LAST value (keeps the
+    tail block's range — zero padding could widen it; the decode
+    slices the pad back off, so the fill never surfaces)."""
+    n = len(v)
+    padded = -(-n // block) * block
+    if padded == n:
+        return v
+    return np.concatenate([v, np.full(padded - n, v[-1], v.dtype)])
+
+
+def _range_guard(v64: np.ndarray) -> bool:
+    """True when (v - blockmin) arithmetic cannot overflow int64.  A
+    spread past 2**62 is incompressible for these codecs anyway."""
+    return int(v64.max()) - int(v64.min()) < (1 << 62)
+
+
+def _sample_blocks(n: int, block: int, take: int = 16) -> np.ndarray:
+    nb = max(1, n // block)
+    return np.unique(np.linspace(0, nb - 1, min(nb, take)).astype(int))
+
+
+def _choose_k(ranges: np.ndarray, block: int, itemsize: int,
+              padded: int) -> tuple[int, int]:
+    """(k, nexc) minimizing total packed cost.  Blocks whose range
+    exceeds 2**k - 1 become EXCEPTIONS shipped raw and scatter-patched
+    on device (patched frame-of-reference) — so one outlier block (a
+    value spike, or the mixed live/zero-pad block at the wire tail)
+    cannot poison the lane width of the whole column."""
+    best_cost = best_k = best_exc = None
+    for k in _KBITS:
+        lim = (1 << k) - 1
+        nexc = int(np.count_nonzero(ranges > lim))
+        cost = (padded * k) // 8 + nexc * block * itemsize \
+            + len(ranges) * 8
+        if best_cost is None or cost < best_cost:
+            best_cost, best_k, best_exc = cost, k, nexc
+    return best_k, best_exc
+
+
+def _exception_comps(a_padded: np.ndarray, exc_blocks: np.ndarray,
+                     block: int) -> tuple[list[np.ndarray], int]:
+    """([block indices (int32), raw block values (wire dtype)], cap)
+    for the exception blocks.  The count buckets to a power of two —
+    padded with REPEATS of the last exception block, so the duplicate
+    device scatter rewrites the same rows with the same values
+    (idempotent) — because the cap lands in the static meta that keys
+    the compiled decode program: an exact per-batch count would mint a
+    fresh XLA program per outlier population (the same reason RLE
+    buckets its run capacity)."""
+    idx = np.flatnonzero(exc_blocks).astype(np.int32)
+    cap = 1
+    while cap < len(idx):
+        cap <<= 1
+    pad = cap - len(idx)
+    if pad:
+        idx = np.concatenate([idx, np.full(pad, idx[-1], np.int32)])
+    vals = a_padded.reshape(-1, block)[idx].reshape(-1)
+    return [idx, np.ascontiguousarray(vals)], cap
+
+
+def _patch_exceptions(out, arrays: Sequence, nexc: int, block: int):
+    """Traceable: overwrite the exception blocks of the reconstructed
+    (padded-length) array with their raw values."""
+    if nexc == 0:
+        return out
+    exc_idx, exc_vals = arrays[-2], arrays[-1]
+    rows = (exc_idx[:, None].astype(jnp.int32) * block
+            + jnp.arange(block, dtype=jnp.int32)[None, :]).reshape(-1)
+    return out.at[rows].set(exc_vals.astype(out.dtype))
+
+
+class BitpackCodec(Codec):
+    """Block frame-of-reference + sub-byte bitpacking: per pow2 block
+    the host subtracts the block minimum and packs the deltas as k-bit
+    lanes into uint32 words (k the smallest of 1/2/4/8/16 covering the
+    widest block range); the device unpacks with shifts/masks and adds
+    the gathered block reference back.  The workhorse for dict codes,
+    dates, validity masks and clustered integer keys."""
+
+    name = "bitpack"
+    decoder_program_key = "device:wire.decode.bitpack"
+    supports_arrays = True
+
+    def estimate(self, vals: np.ndarray,
+                 block_rows: int) -> Optional[float]:
+        v = vals.astype(np.int64, copy=False)
+        ranges = []
+        for b in _sample_blocks(len(v), block_rows):
+            blk = v[b * block_rows:(b + 1) * block_rows]
+            if not _range_guard(blk):
+                return None
+            ranges.append(int(blk.max()) - int(blk.min()))
+        k, nexc = _choose_k(np.asarray(ranges, np.int64), block_rows,
+                            vals.dtype.itemsize,
+                            len(ranges) * block_rows)
+        exc_frac = nexc / max(len(ranges), 1)
+        return vals.dtype.itemsize / (
+            k / 8 + 8.0 / block_rows
+            + exc_frac * vals.dtype.itemsize)
+
+    def encode_array(self, vals: np.ndarray, block_rows: int
+                     ) -> Optional[tuple[list[np.ndarray], tuple]]:
+        n = len(vals)
+        v64 = _pad_to_blocks(vals.astype(np.int64), block_rows)
+        if not _range_guard(v64):
+            return None
+        a_padded = _pad_to_blocks(np.asarray(vals), block_rows) \
+            if len(v64) != n else np.asarray(vals)
+        blocks = v64.reshape(-1, block_rows)
+        refs = blocks.min(axis=1)
+        delta = blocks - refs[:, None]
+        ranges = delta.max(axis=1)
+        k, nexc = _choose_k(ranges, block_rows,
+                            vals.dtype.itemsize, len(v64))
+        exc_blocks = ranges > ((1 << k) - 1)
+        comps: list[np.ndarray] = []
+        if k > 0:
+            lanes = np.where(exc_blocks[:, None], 0, delta)
+            comps.append(_pack_words(
+                lanes.reshape(-1).astype(np.uint32), k))
+        comps.append(refs)
+        exc_cap = 0
+        if nexc:
+            exc, exc_cap = _exception_comps(a_padded, exc_blocks,
+                                            block_rows)
+            comps += exc
+        return comps, ("bitpack", block_rows, k, exc_cap, len(v64), n)
+
+    def decode_array(self, arrays: Sequence, meta: tuple,
+                     out_dtype: np.dtype):
+        _, block, k, nexc, padded, n = meta
+        i = jnp.arange(padded, dtype=jnp.int32)
+        if k == 0:
+            refs = arrays[0]
+            out = jnp.take(refs, i // block, axis=0)
+        else:
+            words, refs = arrays[0], arrays[1]
+            d = _unpack_words(words, k, padded).astype(jnp.int64)
+            out = jnp.take(refs, i // block, axis=0) + d
+        out = _patch_exceptions(out.astype(out_dtype), arrays, nexc,
+                                block)
+        return out[:n]
+
+
+class DeltaCodec(Codec):
+    """Delta + zigzag + bitpack for sorted/clustered columns (shipdates
+    out of a time-ordered file, monotone keys): per block the host
+    stores the first value and packs zigzagged successive differences;
+    the device unpacks and reconstructs with a per-block cumulative
+    sum."""
+
+    name = "delta"
+    decoder_program_key = "device:wire.decode.delta"
+    supports_arrays = True
+
+    @staticmethod
+    def _zigzag_bits(d: np.ndarray) -> int:
+        z = (d << 1) ^ (d >> 63)
+        return int(z.max()).bit_length() if len(z) else 0
+
+    def estimate(self, vals: np.ndarray,
+                 block_rows: int) -> Optional[float]:
+        v = vals.astype(np.int64, copy=False)
+        ranges = []
+        for b in _sample_blocks(len(v), block_rows, take=8):
+            blk = v[b * block_rows:(b + 1) * block_rows]
+            if len(blk) < 2:
+                continue
+            if not _range_guard(blk):
+                return None
+            ranges.append(self._zigzag_bits(np.diff(blk)))
+        if not ranges:
+            return None
+        zr = np.asarray([(1 << b) - 1 for b in ranges], np.int64)
+        k, nexc = _choose_k(zr, block_rows, vals.dtype.itemsize,
+                            len(zr) * block_rows)
+        exc_frac = nexc / len(zr)
+        return vals.dtype.itemsize / (
+            k / 8 + 8.0 / block_rows
+            + exc_frac * vals.dtype.itemsize)
+
+    def encode_array(self, vals: np.ndarray, block_rows: int
+                     ) -> Optional[tuple[list[np.ndarray], tuple]]:
+        n = len(vals)
+        v64 = _pad_to_blocks(vals.astype(np.int64), block_rows)
+        if not _range_guard(v64):
+            return None
+        a_padded = _pad_to_blocks(np.asarray(vals), block_rows) \
+            if len(v64) != n else np.asarray(vals)
+        blocks = v64.reshape(-1, block_rows)
+        refs = np.ascontiguousarray(blocks[:, 0])
+        d = np.diff(blocks, axis=1, prepend=blocks[:, :1])
+        z = (d << 1) ^ (d >> 63)
+        ranges = z.max(axis=1)
+        k, nexc = _choose_k(ranges, block_rows,
+                            vals.dtype.itemsize, len(v64))
+        exc_blocks = ranges > ((1 << k) - 1)
+        comps: list[np.ndarray] = []
+        if k > 0:
+            lanes = np.where(exc_blocks[:, None], 0, z)
+            comps.append(_pack_words(
+                lanes.reshape(-1).astype(np.uint32), k))
+        comps.append(refs)
+        exc_cap = 0
+        if nexc:
+            exc, exc_cap = _exception_comps(a_padded, exc_blocks,
+                                            block_rows)
+            comps += exc
+        return comps, ("delta", block_rows, k, exc_cap, len(v64), n)
+
+    def decode_array(self, arrays: Sequence, meta: tuple,
+                     out_dtype: np.dtype):
+        _, block, k, nexc, padded, n = meta
+        if k == 0:
+            refs = arrays[0]
+            i = jnp.arange(padded, dtype=jnp.int32)
+            out = jnp.take(refs, i // block, axis=0)
+        else:
+            words, refs = arrays[0], arrays[1]
+            z = _unpack_words(words, k, padded).astype(jnp.int64)
+            d = (z >> 1) ^ -(z & 1)  # un-zigzag
+            out = (jnp.cumsum(d.reshape(-1, block), axis=1)
+                   + refs[:, None]).reshape(-1)
+        out = _patch_exceptions(out.astype(out_dtype), arrays, nexc,
+                                block)
+        return out[:n]
+
+
+class RleCodec(Codec):
+    """Block run-length encoding, expanded on device via a cumulative
+    sum over run lengths and a searchsorted gather — heavy-repeat
+    columns (status flags, low-cardinality codes in clustered order,
+    zero-padded char tails) collapse to (values, lengths) pairs.  Run
+    capacity buckets to a power of two so program variants stay
+    bounded."""
+
+    name = "rle"
+    decoder_program_key = "device:wire.decode.rle"
+    supports_arrays = True
+
+    def estimate(self, vals: np.ndarray,
+                 block_rows: int) -> Optional[float]:
+        n = len(vals)
+        win = min(n, 2048)
+        changes = 0
+        sampled = 0
+        for start in {0, max(0, n // 2 - win // 2), max(0, n - win)}:
+            w = vals[start:start + win]
+            if len(w) > 1:
+                changes += int(np.count_nonzero(w[1:] != w[:-1]))
+                sampled += len(w) - 1
+        if sampled == 0:
+            return None
+        est_runs = max(1.0, (changes / sampled) * n + 3)
+        return (n * vals.dtype.itemsize) \
+            / (est_runs * (vals.dtype.itemsize + 4))
+
+    def encode_array(self, vals: np.ndarray, block_rows: int
+                     ) -> Optional[tuple[list[np.ndarray], tuple]]:
+        n = len(vals)
+        change = np.flatnonzero(vals[1:] != vals[:-1]) + 1
+        starts = np.concatenate([np.zeros(1, np.int64), change])
+        r = len(starts)
+        cap = 8
+        while cap < r:
+            cap <<= 1
+        values = np.empty(cap, vals.dtype)
+        values[:r] = vals[starts]
+        values[r:] = vals[-1]
+        lens = np.zeros(cap, np.int32)
+        lens[:r] = np.diff(np.concatenate(
+            [starts, np.asarray([n], np.int64)])).astype(np.int32)
+        return [values, lens], ("rle", cap, n)
+
+    def decode_array(self, arrays: Sequence, meta: tuple,
+                     out_dtype: np.dtype):
+        values, lens = arrays
+        _, _cap, n = meta
+        ends = jnp.cumsum(lens)
+        idx = jnp.searchsorted(ends, jnp.arange(n, dtype=ends.dtype),
+                               side="right")
+        return jnp.take(values, idx, axis=0).astype(out_dtype)
+
+
+class NoneCodec(Codec):
+    """Identity byte codec: frames ship as serialized."""
+
+    name = "none"
+    decoder_program_key = "host:identity"
+    supports_bytes = True
+
+    def compress_bytes(self, body: bytes) -> bytes:
+        return body
+
+    def decompress_bytes(self, body: bytes) -> bytes:
+        return body
+
+
+class ZlibCodec(Codec):
+    """Host-side zlib for serde frames (TCP shuffle, spill files) —
+    the stdlib stand-in for nvcomp's host path (zstd/lz4 are not in
+    this image)."""
+
+    name = "zlib"
+    decoder_program_key = "host:zlib.decompress"
+    supports_bytes = True
+
+    def compress_bytes(self, body: bytes) -> bytes:
+        return zlib.compress(body, 1)
+
+    def decompress_bytes(self, body: bytes) -> bytes:
+        return zlib.decompress(body)
+
+
+register_codec(BitpackCodec())
+register_codec(DeltaCodec())
+register_codec(RleCodec())
+register_codec(NoneCodec())
+register_codec(ZlibCodec())

@@ -7,14 +7,16 @@ column component dicts <-> a single framed byte stream, used by the
 disk spill tier and any future network shuffle transport.
 
 Format: MAGIC | version | codec | json header (names, dtypes, shapes)
-| concatenated (possibly compressed) buffers.  Codecs: none, zlib
-(zstd/lz4 are not in this image; zlib is the stdlib stand-in)."""
+| concatenated (possibly compressed) buffers.  Codecs resolve through
+the shared wire-codec registry (columnar/compression/ — byte codecs:
+none, zlib; zstd/lz4 are not in this image, zlib is the stdlib
+stand-in), so TCP shuffle and the spill tiers report through the same
+per-codec stats surface as the H2D tunnel."""
 
 from __future__ import annotations
 
 import json
 import struct
-import zlib
 
 import numpy as np
 
@@ -39,10 +41,13 @@ SPILL_COMPRESSION = register(
 
 
 def serialize_arrays(arrays: dict, codec: str = "none") -> bytes:
-    """Host component dict (str -> np.ndarray) -> framed bytes."""
-    if codec not in ("none", "zlib"):
-        raise ValueError(f"unknown codec {codec!r}")
+    """Host component dict (str -> np.ndarray) -> framed bytes.  The
+    codec resolves through the shared registry (byte form), which also
+    accounts raw-vs-wire bytes per codec."""
+    from spark_rapids_tpu.columnar import compression as WC
     from spark_rapids_tpu.memory.device_manager import HostBufferPool
+
+    bytes_codec = WC.get_bytes_codec(codec)
 
     header = []
     items = []
@@ -64,8 +69,8 @@ def serialize_arrays(arrays: dict, codec: str = "none") -> bytes:
         off += a.nbytes
     body = bytes(staging[:total])
     pool.give(staging)
-    if codec == "zlib":
-        body = zlib.compress(body, level=1)
+    body = bytes_codec.compress_bytes(body)
+    WC.record_compress(codec, total, len(body))
     hjson = json.dumps({"cols": header, "codec": codec}).encode()
     return b"".join([
         _MAGIC, struct.pack("<HH", _VERSION, 0),  # version, reserved
@@ -83,8 +88,10 @@ def deserialize_arrays(data: bytes) -> dict:
     (hlen,) = struct.unpack("<I", data[8:12])
     meta = json.loads(data[12:12 + hlen].decode())
     body = data[12 + hlen:]
-    if meta["codec"] == "zlib":
-        body = zlib.decompress(body)
+    from spark_rapids_tpu.columnar import compression as WC
+
+    body = WC.get_bytes_codec(meta["codec"]).decompress_bytes(body)
+    WC.record_decompress(meta["codec"])
     out = {}
     off = 0
     for c in meta["cols"]:

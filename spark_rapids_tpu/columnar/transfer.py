@@ -26,6 +26,14 @@ arrays and only sub-32-bit codes get widened on device.
 Wire row counts bucket to <=8 sizes per capacity (compile-cache
 stability) and live row count rides as a dynamic scalar, so one
 compiled decode program serves every batch of the same plan.
+
+When `spark.rapids.tpu.sql.wireCompression.enabled` is on, data-plane
+components additionally ride COMPRESSED (columnar/compression/): the
+host packs them through the codec chooser during scan-prefetch encode
+and the decode program decompresses in HBM — shift/mask unpacking,
+per-block cumsums, searchsorted run expansion — fused into the same
+XLA program as the rest of the decode.  Off (the default) is
+bit-for-bit the uncompressed wire format above.
 """
 
 from __future__ import annotations
@@ -47,8 +55,23 @@ from spark_rapids_tpu.columnar.column import (
     pad_width,
 )
 
-_unpack_cache: dict = {}
-_cache_lock = threading.Lock()
+#: tapped H2D accounting: bytes actually crossing the wire through
+#: THE batched upload below (compressed components count their packed
+#: size) — the counter the wire-codec acceptance gate and bench.py's
+#: q*_upload_bytes_wire / q*_upload_ratio fields read.
+_upload_lock = threading.Lock()
+_UPLOAD_STATS = {"batches": 0, "wire_bytes": 0}
+
+
+def upload_stats() -> dict:
+    with _upload_lock:
+        return dict(_UPLOAD_STATS)
+
+
+def reset_upload_stats() -> None:
+    with _upload_lock:
+        _UPLOAD_STATS["batches"] = 0
+        _UPLOAD_STATS["wire_bytes"] = 0
 
 
 def upload_components(comps):
@@ -67,7 +90,20 @@ def upload_components(comps):
         _faults.fault_point("transfer.upload", n_comps=len(comps))
         return jax.device_put(comps)
 
-    return absorb_once(attempt, action="upload_retry")
+    out = absorb_once(attempt, action="upload_retry")
+    # count HOST array leaves only (tree_leaves: nested column pytrees
+    # from the arrow.py fallback path count too): device-resident
+    # components handed back through here (decode_now re-running a
+    # wire-form batch) are a device_put no-op, and crediting them
+    # would double-count bytes that never crossed the link
+    host_bytes = sum(
+        int(a.nbytes) for a in jax.tree_util.tree_leaves(comps)
+        if isinstance(a, np.ndarray))
+    if host_bytes:
+        with _upload_lock:
+            _UPLOAD_STATS["batches"] += 1
+            _UPLOAD_STATS["wire_bytes"] += host_bytes
+    return out
 
 
 def _round_up(x: int, m: int) -> int:
@@ -241,15 +277,40 @@ class _Comps:
     the X64-rewriter caveat moot.
 
     add() returns an opaque ref the plan stores; the decode program
-    resolves refs against the uploaded list.
+    resolves refs against the uploaded list.  add_wire() is the
+    data-plane variant: when wire compression is configured it routes
+    the component through the codec chooser and returns a "comp" ref
+    carrying the codec name + static meta — the decode program
+    resolves those by running the codec's device decompress before
+    (fused with) the rest of the decode.  With compression off,
+    add_wire IS add, so the disabled wire format is bit-for-bit the
+    historical one.
     """
 
-    def __init__(self):
+    def __init__(self, wire_cfg: Optional[tuple] = None):
         self.arrays: list[np.ndarray] = []
+        self.wire_cfg = wire_cfg  # (codec names, min_ratio, block_rows)
 
     def add(self, a: np.ndarray):
         self.arrays.append(np.ascontiguousarray(a))
         return ("arr", len(self.arrays) - 1)
+
+    def add_wire(self, a: np.ndarray):
+        a = np.ascontiguousarray(a)
+        if self.wire_cfg is not None:
+            from spark_rapids_tpu import trace as _trace
+            from spark_rapids_tpu.columnar import compression as WC
+
+            with _trace.span("wire.compress", nbytes=a.nbytes,
+                             dtype=str(a.dtype)):
+                enc = WC.choose_and_encode(a.reshape(-1),
+                                           *self.wire_cfg)
+            if enc is not None:
+                name, arrays, meta = enc
+                refs = tuple(self.add(x) for x in arrays)
+                return ("comp", name, refs, meta, str(a.dtype),
+                        a.shape)
+        return self.add(a)
 
     def finish(self) -> list[np.ndarray]:
         return self.arrays
@@ -271,7 +332,9 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
 
     cap = pad_capacity(n)
     wire = _wire_rows(n, cap)
-    comps = _Comps()
+    from spark_rapids_tpu.columnar.compression import wire_codec_config
+
+    comps = _Comps(wire_codec_config())
     n_ref = comps.add(np.asarray(n, np.int32))  # dynamic live row count
     entries: list[tuple] = []
 
@@ -301,7 +364,7 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
             continue
         vref = None
         if validity is not None:
-            vref = comps.add(_padded(validity, wire))
+            vref = comps.add_wire(_padded(validity, wire))
         phys = vals.dtype
         kind = "raw"
         extra: tuple = ()
@@ -331,7 +394,7 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
                 nvp = max(8, pad_capacity(len(dvals)))
                 kind = "dict"
                 dict_n = _dict_len_bound(len(dvals), nvp)
-                extra = (comps.add(_padded(dvals, nvp)),)
+                extra = (comps.add_wire(_padded(dvals, nvp)),)
                 vals = codes.astype(code_dt)
             elif phys.itemsize == 8:
                 scaled = _try_scaled(vals)
@@ -343,7 +406,7 @@ def encode_for_device(arrays: Sequence[pa.Array], schema: T.Schema,
                     # host encoder verified
                     extra = (comps.add(np.asarray(100.0, np.float64)),)
                     vals = scaled
-        dref = comps.add(_padded(vals, wire))
+        dref = comps.add_wire(_padded(vals, wire))
         entries.append(("fixed", kind, dref, str(phys), extra, vref,
                         dict_n))
 
@@ -389,10 +452,10 @@ def _encode_dict_direct(comps: _Comps, arr: pa.DictionaryArray,
         return None
     code_dt = np.uint8 if nvals <= 0x100 else np.uint16
     nvp = max(8, pad_capacity(max(nvals, 1)))
-    vref = comps.add(_padded(validity, wire)) if validity is not None \
-        else None
-    cref = comps.add(_padded(codes.astype(code_dt), wire))
-    extra = (comps.add(_padded(dnp, nvp)),)
+    vref = comps.add_wire(_padded(validity, wire)) \
+        if validity is not None else None
+    cref = comps.add_wire(_padded(codes.astype(code_dt), wire))
+    extra = (comps.add_wire(_padded(dnp, nvp)),)
     return ("fixed", "dict", cref, str(dnp.dtype), extra, vref,
             _dict_len_bound(nvals, nvp))
 
@@ -411,11 +474,11 @@ def _sdict_entry(comps: _Comps, codes: np.ndarray, dvals: pa.Array,
         return None
     code_dt = np.uint8 if nvals <= 0x100 else np.uint16
     nvp = max(8, pad_capacity(max(nvals, 1)))
-    vref = comps.add(_padded(validity, wire)) if validity is not None \
-        else None
-    cref = comps.add(_padded(codes.astype(code_dt), wire))
-    dcref = comps.add(_padded(dchars, nvp))
-    dlref = comps.add(_padded(dlens.astype(np.uint16), nvp))
+    vref = comps.add_wire(_padded(validity, wire)) \
+        if validity is not None else None
+    cref = comps.add_wire(_padded(codes.astype(code_dt), wire))
+    dcref = comps.add_wire(_padded(dchars, nvp))
+    dlref = comps.add_wire(_padded(dlens.astype(np.uint16), nvp))
     return ("sdict", cref, dcref, dlref, vref,
             _dict_len_bound(nvals, nvp))
 
@@ -449,14 +512,14 @@ def _encode_string(comps: _Comps, arr: pa.Array, wire: int) -> tuple:
 
     vref = None
     if validity is not None:
-        vref = comps.add(_padded(validity, wire))
+        vref = comps.add_wire(_padded(validity, wire))
     chars, _ = _chars_matrix(sarr, lens)
-    cref = comps.add(_padded(chars, wire))
+    cref = comps.add_wire(_padded(chars, wire))
     # lengths >= 64KiB would wrap uint16: widen the wire type (the
     # decode side reads whatever dtype the ref carries)
     len_dt = np.uint16 if (not lens.size or int(lens.max()) <= 0xFFFF) \
         else np.int32
-    lref = comps.add(_padded(lens.astype(len_dt), wire))
+    lref = comps.add_wire(_padded(lens.astype(len_dt), wire))
     return ("sraw", cref, lref, vref)
 
 
@@ -518,7 +581,18 @@ def _make_decode(plan: tuple):
 
     def decode(xs):
         def read(ref):
-            return xs[ref[1]]  # one typed array per component
+            if ref[0] == "arr":
+                return xs[ref[1]]  # one typed array per component
+            # ("comp", codec, refs, meta, dtype, shape): run the
+            # codec's device decompress — it traces into THIS program,
+            # so decompress+decode(+consumer transform) is one fused
+            # XLA execution per batch
+            from spark_rapids_tpu.columnar.compression import get_codec
+
+            _, name, refs, meta, dt, shape = ref
+            out = get_codec(name).decode_array(
+                [xs[r[1]] for r in refs], meta, np.dtype(dt))
+            return out.reshape(shape) if len(shape) > 1 else out
 
         n_live = read(n_ref)
         live_mask = jnp.arange(cap, dtype=jnp.int32) < n_live
@@ -615,24 +689,65 @@ def _wrap_cols(parts, schema: T.Schema, entries=None):
     return cols
 
 
-def decode_on_device(comps: list, plan: tuple, schema: T.Schema):
+def plan_codecs(plan: tuple) -> tuple:
+    """Codec names appearing in a wire plan's comp refs (empty when the
+    plan is uncompressed) — the host-side view the decompress stats and
+    the wire.decompress span key off."""
+    names = []
+    for e in plan[3]:
+        for ref in e:
+            if isinstance(ref, tuple) and ref and ref[0] == "comp":
+                names.append(ref[1])
+            elif isinstance(ref, tuple) and ref and \
+                    isinstance(ref[0], tuple):  # extra refs tuple
+                names.extend(r[1] for r in ref if r[0] == "comp")
+    return tuple(names)
+
+
+def _record_decompress(names: tuple) -> None:
+    """Bump the per-codec decompress stats for one wire-form batch
+    (``names`` = plan_codecs(plan), computed once by the caller)."""
+    if not names:
+        return
+    from spark_rapids_tpu.columnar import compression as WC
+
+    for name in set(names):
+        WC.record_decompress(name, names.count(name))
+
+
+def decode_on_device(comps: list, plan: tuple, schema: T.Schema,
+                     record: bool = True):
     """Upload the component list (one batched transfer round) and run
     the cached decode program.  Returns device columns in schema
-    order."""
+    order.  The program is compiled through cached_jit under
+    op="WireDecode", so the device ledger attributes decode (and
+    decompress) device-time per program.
+
+    ``record=False`` skips the per-codec decompress stat bump: callers
+    whose batch was ALREADY counted at encode_batch (decode_now on a
+    wire-form batch) must not count it twice — every encoded batch
+    contributes exactly one decompress per codec use, whether its
+    decode runs here eagerly or fused inside a consumer program."""
+    from spark_rapids_tpu import trace as _trace
+    from spark_rapids_tpu.execs.jit_cache import cached_jit
+
     # the compiled decode ignores dict_n (it is applied by _wrap_cols
     # OUTSIDE the program here): strip it from the cache key so row
     # groups differing only in dictionary cardinality bucket share one
     # program (the fused EncodedBatch path legitimately keys on it)
-    key = plan[:3] + (tuple(
+    key = ("wire.decode",) + plan[:3] + (tuple(
         e[:-1] if e[0] in ("fixed", "sdict") else e for e in plan[3]),)
-    with _cache_lock:
-        fn = _unpack_cache.get(key)
-        if fn is None:
-            fn = _unpack_cache[key] = jax.jit(_make_decode(plan))
-            while len(_unpack_cache) > 256:
-                _unpack_cache.pop(next(iter(_unpack_cache)))
+    fn = cached_jit(key, lambda: _make_decode(plan), op="WireDecode")
     dev = upload_components(comps)
-    parts = fn(dev)
+    codecs = plan_codecs(plan)
+    if codecs:
+        if record:
+            _record_decompress(codecs)
+        with _trace.span("wire.decompress", components=len(codecs),
+                         codecs=",".join(sorted(set(codecs)))):
+            parts = fn(dev)
+    else:
+        parts = fn(dev)
     return _wrap_cols(parts, schema, plan[3])
 
 
@@ -694,7 +809,10 @@ class EncodedBatch:
         """Eager fallback for consumers that do not fuse the decode."""
         from spark_rapids_tpu.columnar.batch import ColumnarBatch
 
-        cols = decode_on_device(self.comps, self.plan, self.schema)
+        # record=False: this batch's decompress was counted when
+        # encode_batch shipped it
+        cols = decode_on_device(self.comps, self.plan, self.schema,
+                                record=False)
         n = self.num_rows
         if n is None:
             from spark_rapids_tpu.parallel.pipeline import device_read_int
@@ -711,4 +829,10 @@ def encode_batch(arrays: Sequence[pa.Array], schema: T.Schema,
     if enc is None:
         return None
     comps, plan = enc
+    # a wire-form batch is decoded (decompressed) exactly once —
+    # fused inside a consumer program or via decode_now — so the
+    # per-codec decompress stat is counted HERE, where every such
+    # batch passes once on the host (trace-time counting inside the
+    # fused program would undercount on compile-cache hits)
+    _record_decompress(plan_codecs(plan))
     return EncodedBatch(upload_components(comps), plan, schema, n)

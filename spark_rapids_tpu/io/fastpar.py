@@ -171,7 +171,15 @@ def _snappy_decompress(payload, out_len: int) -> Optional[np.ndarray]:
     try:
         dec = pa.Codec("snappy").decompress(payload,
                                             decompressed_size=out_len)
-    except Exception:
+    except Exception as e:
+        # a RETRYABLE failure (transient resource exhaustion) must
+        # reach the recovery ladder, not silently demote this file to
+        # the slow pyarrow path; a corrupt/foreign stream stays a
+        # clean None (the caller's fallback decodes it properly)
+        from spark_rapids_tpu.execs.retry import classify
+
+        if classify(e) == "retryable":
+            raise
         return None
     return np.frombuffer(dec, np.uint8)
 

@@ -19,6 +19,11 @@ properties hard-checkable here:
 - REG005 (error): api_validation exec-map drift — the coverage map
   names a module/class that no longer exists
 - REG006 (error): registered aggregate has no AGG_SIGS entry
+- REG007 (error): wire-codec registry drift — a codec registered in
+  columnar/compression/ without a declared decoder program key, or
+  missing from the round-trip test matrix
+  (tests/test_wire_compression.py): a codec whose decode is untested
+  could ship wrong bytes over the wire
 """
 
 from __future__ import annotations
@@ -57,6 +62,54 @@ def _docs_text(docs_dir: str = None) -> str:
         return ""
     with open(path) as f:
         return f.read()
+
+
+def _roundtrip_matrix_text(tests_dir: str = None) -> str:
+    """The round-trip test matrix source (the REG007 coverage check
+    reads the test module the same way REG003 reads the generated
+    docs: the registry and its test matrix must not drift)."""
+    if tests_dir is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        tests_dir = os.path.join(root, "tests")
+    path = os.path.join(tests_dir, "test_wire_compression.py")
+    if not os.path.exists(path):
+        return ""
+    with open(path) as f:
+        return f.read()
+
+
+def check_wire_codecs(tests_dir: str = None) -> list[Diagnostic]:
+    """REG007: every codec in the wire-codec registry declares a
+    decoder program key and appears in the round-trip test matrix."""
+    from spark_rapids_tpu.columnar import compression as WC
+
+    out: list[Diagnostic] = []
+    matrix = _roundtrip_matrix_text(tests_dir)
+    for name, codec in WC.registry_items():
+        if not getattr(codec, "decoder_program_key", ""):
+            out.append(Diagnostic(
+                "REG007", "error", _loc(f"codec:{name}"),
+                f"wire codec {name!r} declares no decoder_program_key: "
+                "nothing names the program that undoes its encode",
+                hint="set decoder_program_key on the Codec subclass "
+                     "(device:<program> or host:<routine>)"))
+        if matrix and f'"{name}"' not in matrix \
+                and f"'{name}'" not in matrix:
+            out.append(Diagnostic(
+                "REG007", "error", _loc(f"codec:{name}"),
+                f"wire codec {name!r} is missing from the round-trip "
+                "test matrix (tests/test_wire_compression.py): its "
+                "decode path would ship untested bytes",
+                hint="add the codec to ROUND_TRIP_MATRIX in "
+                     "tests/test_wire_compression.py"))
+    if not matrix:
+        out.append(Diagnostic(
+            "REG007", "error", _loc("tests/test_wire_compression.py"),
+            "the wire-codec round-trip test matrix is missing "
+            "(tests/test_wire_compression.py)",
+            hint="restore the round-trip property tests"))
+    return out
 
 
 def _expr_classes():
@@ -149,6 +202,9 @@ def check_registries(docs_dir: str = None) -> list[Diagnostic]:
                 "docs/supported_ops.md row",
                 hint="regenerate: python -m "
                      "spark_rapids_tpu.tools.gen_docs"))
+
+    # -- wire-codec registry: decoder key + round-trip coverage --------- #
+    out.extend(check_wire_codecs())
 
     # -- api_validation drift becomes a hard failure ------------------- #
     for ref in AV.validate()["exec_drift"]:

@@ -85,6 +85,15 @@ HOST_SPILL_BYTES = register(
 SPILL_DIR = register(
     "spark.rapids.tpu.memory.spill.dir", "",
     "Directory for disk-tier spill files (default: a temp dir).")
+SPILL_HOST_COMPRESS = register(
+    "spark.rapids.tpu.memory.spill.compressHostTier", False,
+    "Serialize device->host spills through the spill codec "
+    "(spark.rapids.tpu.memory.spill.compression.codec, shared "
+    "wire-codec registry) so the HOST tier holds compressed frames: "
+    "more batches fit under spillStorageSize before the disk tier "
+    "engages, and a later host->disk spill writes the frame as-is "
+    "(no recompression).  Costs a decompress on restore.  Snapshotted "
+    "at store construction, like the codec itself.")
 
 
 def _col_device_bytes(c) -> int:
@@ -261,8 +270,32 @@ def _host_to_batch(arrays: dict, schema: T.Schema) -> ColumnarBatch:
     return ColumnarBatch(cols, n, schema)
 
 
-def _host_bytes(arrays: dict) -> int:
-    return int(sum(a.nbytes for a in arrays.values()))
+class _HostFrame:
+    """A HOST-tier entry held as one compressed serde frame instead of
+    a raw array dict (spill.compressHostTier): the host tier then
+    stores what the disk tier would write, so host->disk spill is a
+    plain file write and host occupancy accounts compressed bytes."""
+
+    __slots__ = ("frame",)
+
+    def __init__(self, frame: bytes):
+        self.frame = frame
+
+
+def _host_arrays(held) -> dict:
+    """A HOST-tier entry's payload as a raw array dict (decompressing
+    a _HostFrame through the serde/codec registry)."""
+    if isinstance(held, _HostFrame):
+        from spark_rapids_tpu.columnar.serde import deserialize_arrays
+
+        return deserialize_arrays(held.frame)
+    return held
+
+
+def _host_bytes(held) -> int:
+    if isinstance(held, _HostFrame):
+        return len(held.frame)
+    return int(sum(a.nbytes for a in held.values()))
 
 
 @dataclasses.dataclass
@@ -346,6 +379,8 @@ class BufferStore:
         from spark_rapids_tpu.columnar.serde import spill_codec
 
         self._spill_codec = spill_codec()
+        self._host_compress = conf.get_bool(SPILL_HOST_COMPRESS.key) \
+            and self._spill_codec != "none"
         self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
         self._entries: dict[int, _Entry] = {}
         self._next_id = 0
@@ -423,7 +458,7 @@ class BufferStore:
                 with _trace.span("spill.restore", tier=e.tier.name,
                                  bytes=e.nbytes, buffer=e.buffer_id):
                     if e.tier == StorageTier.HOST:
-                        arrays = e.host
+                        arrays = _host_arrays(e.host)
                     else:
                         from spark_rapids_tpu.columnar.serde import (
                             read_spill_file,
@@ -438,7 +473,7 @@ class BufferStore:
                 e.pins = max(0, e.pins - 1)
                 raise
             if e.tier == StorageTier.HOST:
-                self.host_used -= _host_bytes(arrays)
+                self.host_used -= _host_bytes(e.host)
             elif e.path:
                 # unlink only after the upload succeeded: an exception
                 # mid-acquire (cascaded spill, H2D failure) must not lose
@@ -462,7 +497,7 @@ class BufferStore:
             e.pins += 1
             try:
                 if e.tier == StorageTier.HOST:
-                    return e.host  # type: ignore[return-value]
+                    return _host_arrays(e.host)
                 if e.tier == StorageTier.DISK:
                     from spark_rapids_tpu.columnar.serde import (
                         read_spill_file,
@@ -566,11 +601,19 @@ class BufferStore:
         with _trace.span("spill.device_to_host", tier="DEVICE",
                          bytes=e.nbytes, buffer=e.buffer_id):
             arrays = _batch_to_host(e.batch)  # type: ignore[arg-type]
+            held: object = arrays
+            if self._host_compress:
+                from spark_rapids_tpu.columnar.serde import (
+                    serialize_arrays,
+                )
+
+                held = _HostFrame(serialize_arrays(
+                    arrays, self._spill_codec))
         e.batch = None
         e.tier = StorageTier.HOST
-        e.host = arrays
+        e.host = held  # type: ignore[assignment]
         self.device_used -= e.nbytes
-        hb = _host_bytes(arrays)
+        hb = _host_bytes(held)
         self.host_used += hb
         self.spilled_device_to_host += e.nbytes
         while self.host_used > self.host_budget:
@@ -583,15 +626,21 @@ class BufferStore:
         if not candidates:
             return False
         victim = min(candidates, key=lambda e: (e.priority, e.buffer_id))
-        arrays = victim.host
+        held = victim.host
         path = os.path.join(self._dir(), f"spill-{victim.buffer_id}.tpub")
         from spark_rapids_tpu.columnar.serde import write_spill_file
 
-        hb = _host_bytes(arrays)  # type: ignore[arg-type]
+        hb = _host_bytes(held)  # type: ignore[arg-type]
         with _trace.span("spill.host_to_disk", tier="HOST", bytes=hb,
                          buffer=victim.buffer_id):
-            write_spill_file(path, arrays,  # type: ignore[arg-type]
-                             self._spill_codec)
+            if isinstance(held, _HostFrame):
+                # the host tier already holds the serde frame: write
+                # it as-is — no recompression on the way to disk
+                with open(path, "wb") as f:
+                    f.write(held.frame)
+            else:
+                write_spill_file(path, held,  # type: ignore[arg-type]
+                                 self._spill_codec)
         victim.host = None
         victim.path = path
         victim.tier = StorageTier.DISK

@@ -150,8 +150,10 @@ class ShuffleBlockServer:
 
     def __init__(self, manager=None, host: str = "127.0.0.1",
                  port: int = 0, codec: str = "none"):
+        from spark_rapids_tpu.columnar.compression import get_bytes_codec
         from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 
+        get_bytes_codec(codec)  # fail fast on a typo'd codec conf
         self._srv = socketserver.ThreadingTCPServer(
             (host, port), _BlockHandler, bind_and_activate=True)
         self._srv.daemon_threads = True
@@ -176,9 +178,16 @@ class ShuffleBlockServer:
             name="tpu-shuffle-server")
 
     def bytes_stats(self) -> dict:
-        """{'raw': bytes before codec, 'wire': framed bytes sent}."""
+        """{'raw': bytes before codec, 'wire': framed bytes sent,
+        'codec': this server's frame codec, 'codecs': the process-wide
+        per-codec registry stats} — the shuffle tier's view of the ONE
+        stats surface the H2D tunnel and spill tiers also report
+        through (columnar/compression/; docs/wire_compression.md)."""
+        from spark_rapids_tpu.columnar import compression as WC
+
         with self._bytes_lock:
-            return {"raw": self._raw_bytes, "wire": self._wire_bytes}
+            return {"raw": self._raw_bytes, "wire": self._wire_bytes,
+                    "codec": self._srv.codec, "codecs": WC.stats()}
 
     @property
     def address(self) -> tuple[str, int]:

@@ -113,6 +113,20 @@ def count_upload_rows(df) -> int:
     return counted[0]
 
 
+def count_upload_bytes(df) -> int:
+    """One TPU collect over the tapped batched-upload counter
+    (columnar/transfer.upload_stats): total bytes actually crossing
+    the H2D wire — compressed components count their packed size, so
+    the wire-codec on/off delta IS the bytes the codec kept off the
+    slow link.  Shared by bench.py's q*_upload_bytes_wire /
+    q*_upload_ratio fields and the wire-codec acceptance tests."""
+    from spark_rapids_tpu.columnar import transfer
+
+    transfer.reset_upload_stats()
+    df.collect(engine="tpu")
+    return transfer.upload_stats()["wire_bytes"]
+
+
 def run_rf_smoke() -> dict:
     """Runtime-filter acceptance contract, cheap CI form: a q3-shaped
     parquet join (date-filtered build side, larger probe side)
@@ -442,6 +456,85 @@ def run_ledger_smoke() -> dict:
     return out
 
 
+def run_wire_codec_smoke() -> dict:
+    """Wire-compression acceptance contract, cheap CI form (tier-1 via
+    tests/test_wire_compression.py): a q3-shaped scan->join->aggregate
+    over a COMPRESSIBLE parquet fixture must return bit-identical rows
+    with spark.rapids.tpu.sql.wireCompression on and off (the codec is
+    lossless re-encoding, never approximation), and with compression
+    on the tapped upload counter must show ratio > 1 — fewer bytes
+    actually crossed the H2D wire.  Aggregates are integer-exact
+    (sums of integers, counts) with pinned output order, so the
+    equality gate is bit-for-bit, not tolerance-based."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import TpuSession, col, count_star, sum_
+
+    key = "spark.rapids.tpu.sql.wireCompression.enabled"
+    conf = get_conf()
+    saved = conf.get(key)
+    session = TpuSession()
+    out: dict = {}
+    rng = np.random.default_rng(0xC0DEC)
+    with tempfile.TemporaryDirectory(prefix="wire_codec_smoke_") as d:
+        n = 1 << 15
+        # q3 shape, deliberately compressible the way real fact tables
+        # are: clustered keys, sorted dates, small-range quantities
+        li = pa.table({
+            "l_orderkey": np.sort(rng.integers(0, 2048, n)).astype(
+                np.int64),
+            "l_shipdate": np.sort(rng.integers(8766, 10957, n)).astype(
+                np.int32),
+            "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+        })
+        li_path = os.path.join(d, "li.parquet")
+        pq.write_table(li, li_path, row_group_size=n)
+        orders = pa.table({
+            "o_orderkey": np.arange(2048, dtype=np.int64),
+            "o_priority": rng.integers(0, 5, 2048).astype(np.int32),
+        })
+        o_path = os.path.join(d, "orders.parquet")
+        pq.write_table(orders, o_path)
+
+        def q():
+            lidf = (session.read_parquet(li_path)
+                    .where(col("l_shipdate") > lit(9000)))
+            odf = session.read_parquet(o_path)
+            return (lidf.join(odf, left_on=[col("l_orderkey")],
+                              right_on=[col("o_orderkey")])
+                    .group_by(col("o_priority"))
+                    .agg((sum_(col("l_quantity")), "qty"),
+                         (count_star(), "cnt"))
+                    .order_by(col("o_priority")))
+
+        try:
+            conf.set(key, True)
+            on_bytes = count_upload_bytes(q())
+            on = q().collect(engine="tpu")
+            conf.set(key, False)
+            off_bytes = count_upload_bytes(q())
+            off = q().collect(engine="tpu")
+        finally:
+            conf.set(key, saved)
+    assert on.to_pydict() == off.to_pydict(), (
+        "wire compression changed query results: "
+        f"{on.to_pydict()} != {off.to_pydict()}")
+    ratio = off_bytes / max(on_bytes, 1)
+    assert ratio > 1.0, (
+        f"wire compression saved nothing on a compressible fixture: "
+        f"{off_bytes} raw vs {on_bytes} compressed")
+    out["wire_codec_rows"] = on.num_rows
+    out["wire_codec_upload_ratio"] = round(ratio, 2)
+    return out
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -485,6 +578,7 @@ def main() -> int:
     results.update(run_eventlog_smoke())
     results.update(run_serving_smoke())
     results.update(run_ledger_smoke())
+    results.update(run_wire_codec_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

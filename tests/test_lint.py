@@ -213,6 +213,42 @@ def test_registry_flags_missing_agg_sig(monkeypatch):
                for d in check_registries())
 
 
+def test_registry_flags_bad_wire_codec():
+    """REG007: a codec registered without a decoder program key, or
+    absent from the round-trip test matrix, is a hard error."""
+    from spark_rapids_tpu.columnar import compression as WC
+    from spark_rapids_tpu.lint.registry import check_wire_codecs
+
+    class PhantomCodec(WC.Codec):
+        name = "phantom"
+        decoder_program_key = ""  # nothing names its decoder
+        supports_arrays = True
+
+    WC.register_codec(PhantomCodec())
+    try:
+        diags = check_wire_codecs()
+        assert any(d.rule == "REG007" and "decoder_program_key"
+                   in d.message and "phantom" in d.message
+                   for d in diags), diags
+        assert any(d.rule == "REG007" and "round-trip" in d.message
+                   and "phantom" in d.message for d in diags), diags
+        assert all(d.severity == "error" for d in diags)
+    finally:
+        WC.unregister_codec("phantom")
+    # the live registry itself must be clean
+    assert check_wire_codecs() == []
+
+
+def test_registry_flags_missing_wire_matrix(tmp_path):
+    """REG007 with no test matrix file at all: the registry-wide
+    coverage contract is itself enforced."""
+    from spark_rapids_tpu.lint.registry import check_wire_codecs
+
+    diags = check_wire_codecs(tests_dir=str(tmp_path))
+    assert any(d.rule == "REG007" and "matrix is missing"
+               in d.message for d in diags), diags
+
+
 def test_registry_flags_missing_doc_row(tmp_path):
     from spark_rapids_tpu.lint.registry import check_registries
 
