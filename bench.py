@@ -48,6 +48,14 @@ Attribution fields (so round-over-round deltas are explainable):
   trace/ledger.roofline_fraction), `q*_dispatches`/`q*_programs`
   (launch counts + distinct compiled programs: the ROADMAP #2
   fusion/bucketing scoreboard) and `q*_top_program` (+`_share`);
+- `q*_fusion_chains` / `q*_fused_dispatch_savings` (docs/fusion.md):
+  whole-stage fusion attribution per collect — chains the planner
+  fused into single programs and the program launches those fused
+  executions did not pay; the warm passes are additionally GATED by
+  `spark.rapids.tpu.sql.fusion.warmDispatchBudget` (warm dispatches
+  over budget, or any warm jit miss, fails the round — ROADMAP #2's
+  dispatch-soup diagnosis as a regression gate).  Buffer donation is
+  ON by default for rounds (`--no-donation` reverts);
 - `q{1,3,6,67}_retry_splits` / `_spills_under_pressure` /
   `_recovered_faults` (reset per query like the pipeline/speculation
   counters): recovery activity in the timed window.  On a clean run
@@ -428,7 +436,16 @@ def _pipeline_occupancy(prefix: str = "pipeline") -> dict:
     }
 
 
-def _reset_pipeline_counters() -> None:
+def reset_all_counters() -> None:
+    """THE per-query counter reset: every process-global stat surface
+    the q*_ attribution fields read — pipeline stage counters,
+    speculation, runtime filters, retry ladder, device ledger, fusion
+    chains, upload taps and the fault schedule — zeroed in ONE place
+    so a new counter surface cannot be forgotten at one of the call
+    sites (the warm-window choreography used to re-list them
+    per site)."""
+    from spark_rapids_tpu.columnar.transfer import reset_upload_stats
+    from spark_rapids_tpu.execs.base import reset_fusion_stats
     from spark_rapids_tpu.execs.retry import reset_retry_stats
     from spark_rapids_tpu.parallel.pipeline import reset_stage_counters
     from spark_rapids_tpu.parallel.speculation import reset_stats
@@ -441,6 +458,8 @@ def _reset_pipeline_counters() -> None:
     runtime_filter.reset_stats()  # per-query pruned-row counts too
     reset_retry_stats()  # per-query split/spill-retry attribution
     ledger.reset_stats()  # per-query program/roofline attribution
+    reset_fusion_stats()  # per-query fused-chain/savings attribution
+    reset_upload_stats()  # per-query H2D byte taps
     if _CHAOS:
         # fresh schedule per query: counters zero, nth policies re-fire
         faults.install(CHAOS_SPEC, forced=True)
@@ -459,7 +478,7 @@ def _reset_ledger() -> None:
 
 def _robustness_fields(prefix: str, spilled_before: int = 0) -> dict:
     """Recovery activity in the timed window (reset per query by
-    _reset_pipeline_counters): ladder bisections, device->host bytes
+    reset_all_counters): ladder bisections, device->host bytes
     spilled under pressure, and recovered injected faults (nonzero
     only under --chaos)."""
     from spark_rapids_tpu.execs.retry import retry_stats
@@ -509,7 +528,7 @@ def _sync_spec_fields(prefix: str, iters: int,
 
 def _ledger_fields(prefix: str, iters: int) -> dict:
     """Per-query device-ledger attribution for the timed window (the
-    ledger is reset per query by _reset_pipeline_counters, so the
+    ledger is reset per query by reset_all_counters, so the
     cumulative snapshot IS the window):
 
     - `{prefix}_device_busy_ms`: attributed device time per collect —
@@ -542,6 +561,54 @@ def _ledger_fields(prefix: str, iters: int) -> dict:
         out[f"{prefix}_top_program"] = top[0]["key"]
         out[f"{prefix}_top_program_share"] = top[0]["share"]
     return out
+
+
+def _fusion_fields(prefix: str, iters: int) -> dict:
+    """Whole-stage fusion attribution for the timed window (reset per
+    query by reset_all_counters; docs/fusion.md):
+
+    - `{prefix}_fusion_chains`: fused chain programs planned per
+      collect (the planner's _plan_fusion count — agrees with
+      explain()'s "Fusion:" section by construction);
+    - `{prefix}_fused_dispatch_savings`: program launches the fused
+      executions did NOT pay per collect vs the unfused engine
+      (chain length - 1 per execution, +1 when the wire decode rode
+      inside) — the BENCH_r06+ scoreboard for ROADMAP #2's
+      dispatch-soup diagnosis."""
+    from spark_rapids_tpu.execs.base import fusion_stats
+
+    st = fusion_stats()
+    per = max(iters, 1)
+    return {
+        f"{prefix}_fusion_chains": round(st["chains"] / per, 1),
+        f"{prefix}_fused_dispatch_savings": round(
+            st["saved_dispatches"] / per, 1),
+    }
+
+
+def _assert_warm_budget(prefix: str, fields: dict) -> None:
+    """The dispatch-budget regression GATE (ROADMAP #2): a warm
+    (compile-cache-hot) milestone query must pay at most
+    spark.rapids.tpu.sql.fusion.warmDispatchBudget program launches
+    per collect and compile NOTHING — un-fusing a chain or
+    destabilizing a jit key fails the round here instead of drifting
+    in the diagnostics."""
+    from spark_rapids_tpu.execs.base import warm_dispatch_budget
+
+    budget = warm_dispatch_budget()
+    if budget > 0:
+        # budget 0 disables BOTH halves of the gate (the conf's
+        # documented escape hatch for environments where warm
+        # recompiles are expected, e.g. backend bring-up)
+        misses = fields.get(f"{prefix}_jit_misses")
+        assert misses == 0, (
+            f"{prefix}: warm pass re-compiled {misses} program(s) — "
+            "jit keys are unstable across identical collects")
+        d = fields.get(f"{prefix}_dispatches")
+        assert d is not None and d <= budget, (
+            f"{prefix}: warm dispatch count {d} exceeds the budget "
+            f"{budget} (spark.rapids.tpu.sql.fusion."
+            f"warmDispatchBudget)")
 
 
 def _wire_fields(df, prefix: str) -> dict:
@@ -607,8 +674,14 @@ def _bench_warm(df, prefix: str, n_rows: int, iters: int = 3) -> dict:
     subtree, so timed collects run against batches already in HBM — the
     first measurement of actual DEVICE throughput, with the H2D wire
     out of the loop (VERDICT weak #3).  Caller collects once to fill
-    the cache before timing."""
+    the cache before timing.  `{prefix}_jit_misses` (compiles inside
+    the warm window — budgeted to 0 by _assert_warm_budget) rides
+    along for the dispatch-budget gate."""
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+
+    j0 = cache_stats()
     times, _r = _time_collect(df, "tpu", iters)
+    j1 = cache_stats()
     t = statistics.median(times)
     rows_per_s = n_rows / t
     out = {
@@ -616,6 +689,7 @@ def _bench_warm(df, prefix: str, n_rows: int, iters: int = 3) -> dict:
         f"{prefix}_s_min": round(min(times), 4),
         f"{prefix}_s_max": round(max(times), 4),
         f"{prefix}_rows_per_s": round(rows_per_s, 1),
+        f"{prefix}_jit_misses": j1["misses"] - j0["misses"],
     }
     return out
 
@@ -647,7 +721,7 @@ def _bench_q1(session, d: str) -> dict:
                                  with_q1_cols=True)
         df = q1_dataframe(session, q1_files)
         df.collect(engine="tpu")  # warmup
-        _reset_pipeline_counters()  # per-query occupancy
+        reset_all_counters()  # per-query occupancy
         sp0 = _spilled_now()
         tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
         # occupancy + sync/speculation counters read BEFORE the tapped
@@ -656,6 +730,7 @@ def _bench_q1(session, d: str) -> dict:
         occ.update(_sync_spec_fields("q1", 3))
         occ.update(_robustness_fields("q1", sp0))
         occ.update(_ledger_fields("q1", 3))
+        occ.update(_fusion_fields("q1", 3))
         occ.update(_wire_fields(df, "q1"))
         cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
         breakdown = _stage_breakdown(df, "q1")
@@ -683,6 +758,9 @@ def _bench_q1(session, d: str) -> dict:
             breakdown.update(_bench_warm(warm_df, "q1_warm",
                                          ROWS_PER_FILE * 2))
             breakdown.update(_ledger_fields("q1_warm", 3))
+            # the dispatch-budget regression gate: warm q1 must stay
+            # under the conf budget and compile nothing
+            _assert_warm_budget("q1_warm", breakdown)
         finally:
             cached.unpersist()
     finally:
@@ -711,13 +789,14 @@ def _bench_q3(session, d: str) -> dict:
     orders = make_orders(q3dir)
     df = q3_dataframe(session, li, orders)
     df.collect(engine="tpu")  # warmup
-    _reset_pipeline_counters()  # per-query occupancy
+    reset_all_counters()  # per-query occupancy
     sp0 = _spilled_now()
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q3_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q3", 3))
     occ.update(_robustness_fields("q3", sp0))
     occ.update(_ledger_fields("q3", 3))
+    occ.update(_fusion_fields("q3", 3))
     # runtime-filter attribution for the timed window + the on/off
     # uploaded-row delta (the wire-shrink the filters buy)
     occ.update(_rf_fields(df, 3))
@@ -753,13 +832,14 @@ def _bench_q67(session, d: str) -> dict:
     paths = make_store_sales(q67dir)
     df = q67_dataframe(session, paths)
     df.collect(engine="tpu")  # warmup
-    _reset_pipeline_counters()  # per-query occupancy
+    reset_all_counters()  # per-query occupancy
     sp0 = _spilled_now()
     tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
     occ = _pipeline_occupancy("q67_pipeline")  # timed runs only
     occ.update(_sync_spec_fields("q67", 3))
     occ.update(_robustness_fields("q67", sp0))
     occ.update(_ledger_fields("q67", 3))
+    occ.update(_fusion_fields("q67", 3))
     cpu_ts, cpu_r = _time_collect(df, "cpu", 2)
     got = list(zip(*tpu_r.to_pydict().values()))
     want = list(zip(*cpu_r.to_pydict().values()))
@@ -1070,7 +1150,7 @@ def _bench_scaled(scale_rows: int) -> dict:
         df = q6_dataframe(session, paths)
         df.collect(engine="tpu")  # warmup
         link = _link_probe()
-        _reset_pipeline_counters()
+        reset_all_counters()
         sp0 = _spilled_now()
         tpu_ts, tpu_r = _time_collect(df, "tpu", 3)
         occ = _pipeline_occupancy("q6_scaled_pipeline")
@@ -1078,6 +1158,7 @@ def _bench_scaled(scale_rows: int) -> dict:
                                      with_hit_rate=False))
         occ.update(_robustness_fields("q6_scaled", sp0))
         occ.update(_ledger_fields("q6_scaled", 3))
+        occ.update(_fusion_fields("q6_scaled", 3))
         occ.update(_wire_fields(df, "q6_scaled"))
         occ.update(_stage_breakdown(df, "q6_scaled"))
         cpu_ts, cpu_r = _time_collect(df, "cpu", 1)
@@ -1107,13 +1188,14 @@ def _bench_scaled(scale_rows: int) -> dict:
                                      with_q1_cols=True)
             df1 = q1_dataframe(session, q1_files)
             df1.collect(engine="tpu")  # warmup
-            _reset_pipeline_counters()
+            reset_all_counters()
             sp0 = _spilled_now()
             tpu_ts, tpu_r = _time_collect(df1, "tpu", 3)
             occ = _pipeline_occupancy("q1_scaled_pipeline")
             occ.update(_sync_spec_fields("q1_scaled", 3))
             occ.update(_robustness_fields("q1_scaled", sp0))
             occ.update(_ledger_fields("q1_scaled", 3))
+            occ.update(_fusion_fields("q1_scaled", 3))
             occ.update(_wire_fields(df1, "q1_scaled"))
             occ.update(_stage_breakdown(df1, "q1_scaled"))
             cpu_ts, cpu_r = _time_collect(df1, "cpu", 1)
@@ -1177,6 +1259,14 @@ def main() -> None:
         from spark_rapids_tpu.config import get_conf as _gc
 
         _gc().set("spark.rapids.tpu.sql.wireCompression.enabled", True)
+    # buffer donation rides bench rounds by default (the fused
+    # scan->agg programs reuse the wire components' HBM;
+    # docs/fusion.md) — `--no-donation` reverts; the digest-gated
+    # correctness checks run either way
+    if "--no-donation" not in sys.argv[1:]:
+        from spark_rapids_tpu.config import get_conf as _gc
+
+        _gc().set("spark.rapids.tpu.sql.fusion.donation.enabled", True)
     scale = _int_flag("--scale-rows")
     if scale:
         # scaling-curve mode ONLY (ROADMAP #1): q6 at N rows, q1 at
@@ -1213,7 +1303,7 @@ def main() -> None:
 
         df.collect(engine="tpu")  # warmup: compile cache, page cache
         link = _link_probe()
-        _reset_pipeline_counters()  # q6 occupancy = timed runs only
+        reset_all_counters()  # q6 occupancy = timed runs only
         sp0 = _spilled_now()
         tpu_ts, tpu_result = _time_collect(df, "tpu", TPU_ITERS)
         cpu_ts, cpu_result = _time_collect(df, "cpu", CPU_ITERS)
@@ -1234,6 +1324,7 @@ def main() -> None:
                                      with_hit_rate=False))
         occ.update(_robustness_fields("q6", sp0))
         occ.update(_ledger_fields("q6", TPU_ITERS))
+        occ.update(_fusion_fields("q6", TPU_ITERS))
         occ.update(_wire_fields(df, "q6"))
         breakdown = _stage_breakdown(df, "q6")
         breakdown.update(occ)
@@ -1272,6 +1363,9 @@ def main() -> None:
             # cost-model roofline for the warm window — the number
             # ROADMAP #2's fusion/donation work moves
             warm.update(_ledger_fields("q6_warm", 3))
+            # the dispatch-budget regression gate: warm q6 must stay
+            # under the conf budget and compile nothing
+            _assert_warm_budget("q6_warm", warm)
         finally:
             cached.unpersist()
         breakdown.update(warm)

@@ -751,6 +751,88 @@ def decode_on_device(comps: list, plan: tuple, schema: T.Schema,
     return _wrap_cols(parts, schema, plan[3])
 
 
+class ConsumedBatchError(RuntimeError):
+    """A donated (consumed) batch was asked for its device buffers
+    again.  Deliberately NON-retryable (no retryable marker in the
+    text): re-running over freed HBM cannot succeed, so the failure
+    must fail fast instead of burning the spill/split ladder —
+    donation's contract is that consumers resume from the memoized
+    program output (run_consuming), never re-execute."""
+
+
+def run_consuming(fn, eb: "EncodedBatch"):
+    """Execute a DONATING fused program over a wire-form batch exactly
+    once.  The batch is marked consumed BEFORE the call (a failure
+    mid-execution leaves device state unknown — conservatively gone)
+    and the output is memoized on the batch, so a retry-ladder re-run
+    of the same unit (e.g. a retire-side OOM after a successful
+    update dispatch) RESUMES from the already-produced output instead
+    of re-executing over donated buffers.  A re-run that finds the
+    batch consumed with no memoized output (the program itself died)
+    — or a memoized output whose buffers were since freed (spilled
+    while registered, and the rollback's repair_donated_memo could
+    not restore it) — raises ConsumedBatchError, non-retryable by
+    design."""
+    if eb.consumed:
+        if eb.donated_out is None:
+            raise ConsumedBatchError(
+                "donated program died mid-execution; input buffers "
+                "are gone and no output was memoized")
+        if memo_is_dead(eb.donated_out):
+            raise ConsumedBatchError(
+                "memoized donated output was spilled and its device "
+                "buffers freed before the re-run; input buffers are "
+                "gone too, so the unit cannot be recovered")
+        return eb.donated_out
+    eb.consumed = True
+    out = fn(eb)
+    eb.donated_out = out
+    return out
+
+
+def memo_is_dead(out) -> bool:
+    """True if any device-array leaf of a memoized program output has
+    been deleted.  The spill store's device→host spill deletes the
+    device arrays of the batch it holds (`_batch_to_host(delete=True)`)
+    and restores into a NEW batch object — a raw reference memoized
+    before the spill (EncodedBatch.donated_out) is not updated, so it
+    must be liveness-checked before the resume path hands it
+    downstream."""
+    for x in jax.tree_util.tree_leaves(out):
+        if isinstance(x, jax.Array):
+            try:
+                if x.is_deleted():
+                    return True
+            except Exception:
+                return True
+    return False
+
+
+def repair_donated_memo(eb: "EncodedBatch", handle) -> bool:
+    """Rollback seam for a donated unit (docs/fusion.md): retire
+    registers the memoized update output with the spill store UNPINNED,
+    so pressure may spill it — deleting the very device arrays
+    ``eb.donated_out`` references.  A retry-ladder rollback about to
+    close that registration (dropping the only surviving copy) calls
+    this first: if the memo is dead, re-materialize through the handle
+    and re-memoize, so the re-run's resume hands downstream a live
+    batch instead of freed buffers — the recovery the memo exists for.
+    Best-effort: a failed restore (e.g. OOM during the rollback
+    itself) leaves the memo dead and run_consuming fails fast with
+    ConsumedBatchError instead of an opaque deleted-array crash.
+    Returns True when the memo was repaired."""
+    out = eb.donated_out
+    if out is None or not memo_is_dead(out):
+        return False
+    try:
+        restored = handle.get()  # re-materialize on device (pins)
+        handle.unpin()
+    except Exception:
+        return False  # rollback must proceed; resume will fail fast
+    eb.donated_out = restored
+    return True
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class EncodedBatch:
@@ -768,12 +850,24 @@ class EncodedBatch:
     bookkeeping; it deliberately does NOT survive tracing (the decode
     derives the traced count from the wire components), so one compiled
     consumer program serves every ragged tail.
+
+    `consumed` / `donated_out`: donation bookkeeping
+    (docs/fusion.md).  A consumer that donates the wire components
+    into its fused program (cached_jit's `donate=`) marks the batch
+    consumed FIRST and memoizes the program output — the retry/split
+    ladder's re-run path then resumes from the memoized output instead
+    of re-executing over donated (freed) buffers, and
+    `retry.bisect_batch`/`_batch_rows` refuse to decode or split a
+    consumed batch.  Neither field rides the pytree (flatten drops
+    them): tracing sees only the wire components.
     """
 
     comps: list
     plan: tuple
     schema: T.Schema
     num_rows: Optional[int] = None
+    consumed: bool = False
+    donated_out: Optional[object] = None
 
     def tree_flatten(self):
         return (tuple(self.comps),), (self.plan, self.schema)
@@ -809,6 +903,10 @@ class EncodedBatch:
         """Eager fallback for consumers that do not fuse the decode."""
         from spark_rapids_tpu.columnar.batch import ColumnarBatch
 
+        if self.consumed:
+            raise ConsumedBatchError(
+                "wire components were donated into a fused program; "
+                "the batch cannot be decoded again")
         # record=False: this batch's decompress was counted when
         # encode_batch shipped it
         cols = decode_on_device(self.comps, self.plan, self.schema,

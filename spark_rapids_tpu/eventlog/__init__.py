@@ -245,6 +245,11 @@ def render_plan_report(exec_, meta) -> str:
     stages = getattr(exec_, "_pipeline_stages", None)
     if stages:
         out += "Pipeline:\n" + "\n".join("  " + s for s in stages) + "\n"
+    fusion = getattr(exec_, "_fusion_report", None)
+    if fusion:
+        # which per-batch chains compile into single XLA programs (and
+        # why others don't) — docs/fusion.md
+        out += "Fusion:\n" + "\n".join("  " + s for s in fusion) + "\n"
     from spark_rapids_tpu.plan.runtime_filter import (
         render_runtime_filters,
     )

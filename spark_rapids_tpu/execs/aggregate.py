@@ -150,6 +150,7 @@ class TpuHashAggregateExec(TpuExec):
         import threading
 
         self._jit_update = None
+        self._jit_update_donated = None
         self._jit_merge = None
         self._jit_finalize = None
         self._jits = None
@@ -377,12 +378,16 @@ class TpuHashAggregateExec(TpuExec):
             cached = getattr(self, "_absorb", "unset")
             if cached != "unset":
                 return cached
-            from spark_rapids_tpu.execs.base import FusableExec
+            from spark_rapids_tpu.execs.base import (
+                FusableExec,
+                fusion_enabled,
+            )
             from spark_rapids_tpu.exprs.base import ansi_enabled
 
             result = None
             child = self.children[0]
-            if (self.mode != "final" and isinstance(child, FusableExec)
+            if (self.mode != "final" and fusion_enabled()
+                    and isinstance(child, FusableExec)
                     and not ansi_enabled()):
                 chain, node, aware, keys = child.fusion_chain()
                 if not aware and all(k is not None for k in keys):
@@ -457,15 +462,30 @@ class TpuHashAggregateExec(TpuExec):
 
                 upd = cached_jit(key + ("absorb", ckeys, "update"),
                                  lambda: update_full, op=self.name)
+                # the donated twin: same traced program, wire
+                # components donate_argnums'd so XLA reuses their HBM
+                # for the partial columns.  A SEPARATE cached program
+                # (cached_jit folds the donation state into the key)
+                # because the plain one also serves decoded batches
+                # whose arrays — shared validity masks, dictionary
+                # sidecars — must never be donated.
+                from spark_rapids_tpu.execs.jit_cache import (
+                    donation_enabled,
+                )
+
+                upd_d = cached_jit(
+                    key + ("absorb", ckeys, "update"),
+                    lambda: update_full, op=self.name,
+                    donate=(0,)) if donation_enabled() else None
                 self._jits = (
-                    upd,
+                    upd, upd_d,
                     cached_jit(key + ("merge",), lambda: self._merge_batch,
                                op=self.name),
                     cached_jit(key + ("final",),
                                lambda: self._finalize_batch,
                                op=self.name))
-            (self._jit_update, self._jit_merge,
-             self._jit_finalize) = self._jits
+            (self._jit_update, self._jit_update_donated,
+             self._jit_merge, self._jit_finalize) = self._jits
 
         from spark_rapids_tpu.memory import SpillPriorities, get_store
         from spark_rapids_tpu.parallel import speculation as SP
@@ -608,14 +628,52 @@ class TpuHashAggregateExec(TpuExec):
 
         pending_rows = 0
 
+        from spark_rapids_tpu.columnar.transfer import (
+            EncodedBatch,
+            repair_donated_memo,
+            run_consuming,
+        )
+        from spark_rapids_tpu.execs.base import record_fused_dispatch
+
+        # donated-unit resume bookkeeping: update-output id -> the
+        # EncodedBatch memoizing it, so a rollback can repair a memo
+        # whose registered copy was spilled (see guarded_retire)
+        donated_units: dict = {}
+
+        _ch = self._absorbed_chain()
+        # the update itself counts as a chain member: a chain of N
+        # fusable execs absorbed into the update is N+1 operators in
+        # one program
+        chain_len = (len(_ch[0]) + 1) if _ch is not None else 1
+
         def dispatch(batch):
             """Async half: the update program for batch k+1 is
             dispatched before batch k's sizing sync retires (the same
-            lookahead shape as the join probe loop)."""
+            lookahead shape as the join probe loop).  Wire-form
+            batches route through the DONATED update twin when
+            donation is on: run_consuming marks the batch consumed
+            and memoizes the output, so a ladder re-run of this unit
+            resumes instead of re-executing over donated buffers."""
             with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 if self.mode == "final":
                     return batch  # already partial layout
-                return t.observe(self._jit_update(_as_device_rows(batch)))
+                enc = isinstance(batch, EncodedBatch)
+                if enc and self._jit_update_donated is not None:
+                    # a retry-ladder re-run of a consumed batch
+                    # RESUMES from the memoized output — no program
+                    # launches, so the fused-dispatch stats must not
+                    # tick (q*_fused_dispatch_savings would otherwise
+                    # over-report under --chaos)
+                    resumed = batch.consumed
+                    out = run_consuming(self._jit_update_donated, batch)
+                    donated_units[id(out)] = batch
+                    if not resumed:
+                        record_fused_dispatch(chain_len,
+                                              decode_fused=True)
+                else:
+                    out = self._jit_update(_as_device_rows(batch))
+                    record_fused_dispatch(chain_len, decode_fused=enc)
+                return t.observe(out)
 
         def merge_and_park(park):
             """Re-merge the pending partials as ONE transaction on the
@@ -753,12 +811,23 @@ class TpuHashAggregateExec(TpuExec):
             try:
                 retire(part)
             except BaseException:
+                eb = donated_units.get(id(part))
+                if eb is not None and len(pending) > n0:
+                    # every retire path registers the update output
+                    # FIRST, so pending[n0] holds part's registration:
+                    # if pressure spilled it (deleting the arrays the
+                    # memoized donated_out references), restore the
+                    # memo through the handle BEFORE the sweep below
+                    # drops the only surviving copy — the re-run's
+                    # resume must hand downstream a live batch
+                    repair_donated_memo(eb, pending[n0])
                 for h in pending[n0:]:
                     futs.pop(id(h), None)
                     h.close()
                 del pending[n0:]
                 pending_rows = r0
                 raise
+            donated_units.pop(id(part), None)
             return ()
 
         dispatch_guarded, retire_guarded = R.guarded_pipeline(

@@ -160,13 +160,17 @@ class _MetricReaper:
         # producer program finished (data dependency + in-order device
         # execution), and the reaper exclusively owns it — polling the
         # observed arrays themselves would race the spill store's
-        # .delete() (is_ready on a deleted PJRT buffer segfaults)
-        try:
-            sentinels = [x[:0] if x.ndim > 0 else x.reshape((1,))[:0]
-                         for x in jax.tree_util.tree_leaves(observed)
-                         if isinstance(x, jax.Array)]
-        except Exception:
-            return  # already deleted/donated: drop the sample
+        # .delete() (is_ready on a deleted PJRT buffer segfaults).
+        # Per-leaf derivation (trace.ledger.derive_sentinels): a
+        # donated fused program's output can mix live and consumed
+        # leaves, and one dead leaf must not drop the whole sample
+        from spark_rapids_tpu.trace.ledger import derive_sentinels
+
+        sentinels = derive_sentinels(observed)
+        # no live device leaves (host-only output, or every leaf
+        # already consumed): the worker records the elapsed wall with
+        # no readiness wait — the timer still ticks, like the
+        # non-observing MetricTimer branch
         # correlation context crosses to the reaper thread by capture
         ctx = _trace.current_context() if _trace.TRACER.enabled else None
         self._q.put((metric, t0, sentinels, ctx))
@@ -357,6 +361,101 @@ class TpuExec:
 BatchFn = Callable[[ColumnarBatch], ColumnarBatch]
 
 
+FUSION_ENABLED = None  # registered lazily to avoid import-order churn
+
+
+def _fusion_conf():
+    global FUSION_ENABLED
+    if FUSION_ENABLED is None:
+        from spark_rapids_tpu.config import register
+
+        FUSION_ENABLED = register(
+            "spark.rapids.tpu.sql.fusion.enabled", True,
+            "Whole-stage program fusion: compile consecutive fusable "
+            "execs (filter/project/...), the wire decode of an "
+            "encoded scan batch, and the hash aggregate's update "
+            "phase into ONE XLA program per (pipeline key, capacity "
+            "bucket) — the XLA analog of Spark's WholeStageCodegen "
+            "(docs/fusion.md).  Off: every exec compiles and "
+            "dispatches its own per-batch program and scans upload "
+            "eagerly-decoded batches — the dispatch-soup baseline "
+            "the fusion smoke measures against.  Results are "
+            "bit-identical either way.")
+    return FUSION_ENABLED
+
+
+def fusion_enabled() -> bool:
+    return get_conf().get(_fusion_conf())
+
+
+WARM_DISPATCH_BUDGET = None  # registered lazily, like FUSION_ENABLED
+
+
+def _budget_conf():
+    global WARM_DISPATCH_BUDGET
+    if WARM_DISPATCH_BUDGET is None:
+        from spark_rapids_tpu.config import register
+
+        WARM_DISPATCH_BUDGET = register(
+            "spark.rapids.tpu.sql.fusion.warmDispatchBudget", 256,
+            "Per-query WARM dispatch budget: the maximum ledger "
+            "program-launch count a warm (compile-cache-hot) "
+            "milestone query may pay per collect before the bench "
+            "dispatch-budget gate and run_fusion_smoke fail the "
+            "round.  Turns ROADMAP #2's dispatch-soup diagnosis "
+            "(HC010) into a regression GATE instead of a diagnostic: "
+            "un-fusing a chain or destabilizing a jit key shows up as "
+            "a hard assertion, not a slow drift.  0 disables the "
+            "gate.", check=lambda v: v >= 0)
+    return WARM_DISPATCH_BUDGET
+
+
+def warm_dispatch_budget() -> int:
+    return int(get_conf().get(_budget_conf()))
+
+
+#: process-global fusion activity counters (reset per bench query like
+#: the pipeline/speculation/ledger stats): `chains` = fused chain
+#: programs BUILT (>= 2 execs, or 1 exec + in-program wire decode);
+#: `fused_dispatches` = executions of such programs;
+#: `saved_dispatches` = program launches those executions did NOT pay
+#: vs the unfused engine (chain length - 1, +1 when the wire decode
+#: rode inside) — bench.py's q*_fusion_chains /
+#: q*_fused_dispatch_savings fields.
+_FUSION_LOCK = threading.Lock()
+_FUSION_STATS = {"chains": 0, "fused_dispatches": 0,
+                 "saved_dispatches": 0}
+
+
+def record_fused_chain() -> None:
+    """One fused chain planned for the current query (called by the
+    planner's _plan_fusion, once per 'one program' line it reports —
+    so the counter agrees with explain()'s Fusion section by
+    construction)."""
+    with _FUSION_LOCK:
+        _FUSION_STATS["chains"] += 1
+
+
+def record_fused_dispatch(n_execs: int, decode_fused: bool) -> None:
+    saved = (n_execs - 1) + (1 if decode_fused else 0)
+    if saved <= 0:
+        return
+    with _FUSION_LOCK:
+        _FUSION_STATS["fused_dispatches"] += 1
+        _FUSION_STATS["saved_dispatches"] += saved
+
+
+def fusion_stats() -> dict:
+    with _FUSION_LOCK:
+        return dict(_FUSION_STATS)
+
+
+def reset_fusion_stats() -> None:
+    with _FUSION_LOCK:
+        for k in _FUSION_STATS:
+            _FUSION_STATS[k] = 0
+
+
 class FusableExec(TpuExec):
     """An exec that is a pure per-batch device transform (narrow: output
     partitioning == child's).  Consecutive fusable execs compile into a
@@ -407,16 +506,20 @@ class FusableExec(TpuExec):
 
         # walk down through fusable children, composing their batch fns;
         # stop before a row-multiplying exec if anything above it needs
-        # partition context (its row_offset counts THIS chain's input)
+        # partition context (its row_offset counts THIS chain's input).
+        # With fusion disabled the chain is just this exec — every
+        # operator dispatches its own program (the unfused baseline
+        # the fusion smoke and the on/off digest gates compare).
         execs: list[FusableExec] = [self]
         node: TpuExec = self.children[0]
         aware = is_aware(self)
-        while isinstance(node, FusableExec):
-            if aware and node.MULTIPLIES_ROWS:
-                break
-            execs.append(node)  # type: ignore[arg-type]
-            aware = aware or is_aware(node)
-            node = node.children[0]
+        if fusion_enabled():
+            while isinstance(node, FusableExec):
+                if aware and node.MULTIPLIES_ROWS:
+                    break
+                execs.append(node)  # type: ignore[arg-type]
+                aware = aware or is_aware(node)
+                node = node.children[0]
         return (list(reversed(execs)), node, aware,
                 [e.fuse_key() for e in execs])
 
@@ -464,13 +567,18 @@ class FusableExec(TpuExec):
                                 lambda: pipeline, op=self.name)
         else:
             jitted = jax.jit(pipeline)
-        self._fused = (jitted, node, aware, ansi)
+        self._fused = (jitted, node, aware, ansi, len(chain))
         return self._fused
 
     def _fused_pipeline_encoded(self):
         """Jitted pipeline variant whose input is a wire-form
         EncodedBatch: the decode runs inside the same program as the
-        transform chain (one execution per batch)."""
+        transform chain (one execution per batch).  Returns
+        (jitted, donated, n_execs); with donation enabled the wire
+        components are donate_argnums'd into the program — they are
+        fresh per-batch uploads consumed exactly once, so XLA may
+        write the decoded columns into their HBM (the driver marks
+        the batch consumed via transfer.run_consuming)."""
         cached = getattr(self, "_fused_enc", None)
         if cached is not None:
             return cached
@@ -495,21 +603,30 @@ class FusableExec(TpuExec):
                 batch = f(batch)
             return batch
 
+        donated = False
         if all(k is not None for k in keys):
-            from spark_rapids_tpu.execs.jit_cache import cached_jit
+            from spark_rapids_tpu.execs.jit_cache import (
+                cached_jit,
+                donation_enabled,
+            )
 
+            donated = donation_enabled()
             jitted = cached_jit(("fusedenc", tuple(keys), ansi),
-                                lambda: pipeline, op=self.name)
+                                lambda: pipeline, op=self.name,
+                                donate=(0,))
         else:
             jitted = jax.jit(pipeline)
-        self._fused_enc = jitted
-        return jitted
+        self._fused_enc = (jitted, donated, len(chain))
+        return self._fused_enc
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        from spark_rapids_tpu.columnar.transfer import EncodedBatch
+        from spark_rapids_tpu.columnar.transfer import (
+            EncodedBatch,
+            run_consuming,
+        )
         from spark_rapids_tpu.exprs.base import raise_if_ansi_error
 
-        fused, node, aware, ansi = self._fused_pipeline()
+        fused, node, aware, ansi, n_execs = self._fused_pipeline()
         if aware:
             pidx = jnp.asarray(p, jnp.int32)
             off = jnp.asarray(0, jnp.int64)
@@ -520,12 +637,20 @@ class FusableExec(TpuExec):
                     # a different signature; decode eagerly instead
                     batch = batch.decode_now()
                 else:
+                    fn_enc, donated, n_enc = \
+                        self._fused_pipeline_encoded()
+                    # consumed = a re-run resuming from the memoized
+                    # output; no program launches, stats must not tick
+                    resumed = donated and batch.consumed
                     with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
-                        out = self._fused_pipeline_encoded()(batch)
+                        out = run_consuming(fn_enc, batch) if donated \
+                            else fn_enc(batch)
                         if ansi:
                             out, err = out
                             raise_if_ansi_error(jax.device_get(err))
                         out = t.observe(out)
+                    if not resumed:
+                        record_fused_dispatch(n_enc, decode_fused=True)
                     yield self._count_output(out)
                     continue
             b = batch.with_device_num_rows()
@@ -544,6 +669,7 @@ class FusableExec(TpuExec):
                     # (the reference pays the same via cudf's throw)
                     raise_if_ansi_error(jax.device_get(err))
                 out = t.observe(out)
+            record_fused_dispatch(n_execs, decode_fused=False)
             yield self._count_output(out)
 
     def execute(self) -> Iterator[ColumnarBatch]:

@@ -20,13 +20,42 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import warnings
 from typing import Callable, Optional, Sequence
 
 import jax
 
 from spark_rapids_tpu import trace as _trace
+from spark_rapids_tpu.config import register
 from spark_rapids_tpu.trace import ledger as _ledger
 from spark_rapids_tpu.exprs.base import Expression
+
+DONATION_ENABLED = register(
+    "spark.rapids.tpu.sql.fusion.donation.enabled", False,
+    "Donate per-batch WIRE-form decode inputs (fresh single-use "
+    "uploads) into fused XLA programs via cached_jit's `donate=` arg, "
+    "so XLA reuses their HBM for the program's outputs instead of "
+    "allocating fresh buffers.  Donated inputs are CONSUMED — the "
+    "engine marks them (EncodedBatch.consumed via "
+    "transfer.run_consuming) so the retry/split ladder never touches "
+    "a donated buffer again; a future donation site over "
+    "store-registered batches must first un-register them via "
+    "SpillableBatch.mark_consumed (the seam exists and is tested, "
+    "but no engine path donates store-registered batches today — "
+    "decoded batches carry process-shared arrays and are never "
+    "donated).  Off (the default): donate= is ignored and behavior "
+    "is bit-for-bit identical to the non-donating engine "
+    "(docs/fusion.md).  Read at program-compile time; the "
+    "compile-cache key carries the donation state, so flipping it "
+    "mid-session compiles fresh programs rather than corrupting "
+    "cached ones.")
+
+#: CPU/METAL backends implement donation as a no-op and warn per
+#: compile; the engine treats donation as best-effort HBM reuse (the
+#: consumed-state bookkeeping is what matters for correctness), so the
+#: warning is noise in every non-TPU test run
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 _LOCK = threading.Lock()
 #: LRU: a long-lived process serving many distinct ad-hoc query shapes
@@ -79,8 +108,43 @@ def exprs_key(es: Sequence) -> tuple:
     return tuple(expr_key(e) for e in es)
 
 
+def donation_enabled() -> bool:
+    """Is buffer donation into fused programs on for this thread's
+    conf?  One conf read — callers gate their consumed-state
+    bookkeeping on the same value they pass programs through with."""
+    from spark_rapids_tpu.config import get_conf
+
+    return bool(get_conf().get(DONATION_ENABLED))
+
+
+def _validate_donate(donate) -> tuple:
+    """Normalize/validate a donate= spec: a tuple of distinct
+    non-negative argnums.  Validated HERE, not at jax call time —
+    a malformed spec must fail at the compile chokepoint with the
+    caller's key in hand, not deep inside jax's pytree plumbing."""
+    if isinstance(donate, bool):
+        # bool IS int in Python: a natural-looking donate=True would
+        # silently normalize to argnum 1 and donate the WRONG buffer
+        raise TypeError(
+            "cached_jit donate= takes argnums, not a flag; use "
+            "donate=(0,) to donate the first argument")
+    if isinstance(donate, int):
+        donate = (donate,)
+    donate = tuple(donate)
+    if not donate:
+        return ()
+    if not all(isinstance(i, int) and not isinstance(i, bool)
+               and i >= 0 for i in donate) \
+            or len(set(donate)) != len(donate):
+        raise TypeError(
+            f"cached_jit donate= must be distinct non-negative "
+            f"argnums, got {donate!r}")
+    return donate
+
+
 def cached_jit(key: tuple, make_fn: Callable[[], Callable],
-               op: Optional[str] = None):
+               op: Optional[str] = None,
+               donate: "int | Sequence[int] | None" = None):
     """Return a jitted callable shared by every caller presenting `key`.
     `make_fn` is invoked (once) only on a cache miss.
 
@@ -89,8 +153,24 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
     explain("analyze") can attribute per-operator roofline fractions;
     the cached callable is the ledger's dispatch hook — with the
     ledger off the wrapper is one attribute read and a passthrough
-    call, bit-identical to the raw jitted function."""
+    call, bit-identical to the raw jitted function.
+
+    `donate` (argnums) marks input args whose buffers XLA may reuse
+    for the program's outputs (the pjit donate_argnums plumbing —
+    SNIPPETS [1][2]).  Honored only when
+    spark.rapids.tpu.sql.fusion.donation.enabled is on; the caller
+    owns the CONSUMED-state bookkeeping for whatever it donates
+    (EncodedBatch.consumed / SpillableBatch.mark_consumed) — a
+    donated-then-spilled buffer is a use-after-free.  The donation
+    state folds into the cache key, so donating and non-donating
+    callers of the same logical program never share a compiled
+    executable."""
     global _HITS, _MISSES
+    donate = _validate_donate(donate) if donate is not None else ()
+    if donate and donation_enabled():
+        key = key + ("donate", donate)
+    else:
+        donate = ()
     with _LOCK:
         fn = _CACHE.get(key)
         if fn is None:
@@ -120,7 +200,8 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
             # attribution (tpulint SRC009 flags raw jax.jit in exec
             # modules for exactly this reason)
             fn = _CACHE[key] = _ledger.LEDGER.wrap(
-                key, jax.jit(make_fn()), op=op)
+                key, jax.jit(make_fn(), donate_argnums=donate),
+                op=op, donated=bool(donate))
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)
         else:
@@ -154,6 +235,24 @@ def reset_cache_stats() -> None:
     with _LOCK:
         _HITS = 0
         _MISSES = 0
+
+
+def program_census() -> dict[str, int]:
+    """Distinct compiled programs per key TAG (the leading string of
+    every structural key): the jit-key audit surface behind ROADMAP
+    #2's bucketing work.  A steady workload whose census GROWS run
+    over run has non-structural values (literals, per-batch counts)
+    leaking into its keys — the fusion smoke and
+    tests/test_fusion.py's re-key stability test diff this figure
+    across identical collects to pin key churn to the tag that minted
+    it."""
+    with _LOCK:
+        keys = list(_CACHE)
+    census: dict[str, int] = {}
+    for k in keys:
+        tag = _ledger.key_tag(k)
+        census[tag] = census.get(tag, 0) + 1
+    return census
 
 
 def clear() -> None:

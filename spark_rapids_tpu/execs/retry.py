@@ -269,6 +269,10 @@ def bisect_batch(batch):
     from spark_rapids_tpu.columnar.transfer import EncodedBatch
 
     if isinstance(batch, EncodedBatch):
+        # a consumed (donated) batch has no device buffers left to
+        # split; decode_now refuses it with ConsumedBatchError
+        # (non-retryable) — callers gate on _batch_rows first, so the
+        # ladder escalates instead of bisecting freed HBM
         batch = batch.decode_now()
     n = batch.concrete_num_rows()
     assert n >= 2, f"cannot bisect a {n}-row batch"
@@ -300,6 +304,13 @@ def _batch_rows(batch) -> Optional[int]:
         from spark_rapids_tpu.columnar.transfer import EncodedBatch
 
         if isinstance(batch, EncodedBatch):
+            if batch.consumed:
+                # donated into a fused program: its buffers are gone,
+                # so bisection is off the table — rows=None keeps the
+                # ladder on the retry/escalate rungs, which resume
+                # from the memoized program output (run_consuming)
+                # without touching the consumed buffer
+                return None
             if batch.num_rows is not None:
                 return int(batch.num_rows)
             from spark_rapids_tpu.parallel.pipeline import (

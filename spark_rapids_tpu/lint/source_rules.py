@@ -69,6 +69,19 @@ Rules
   fall-back-to-slow-path sites (the fastpar decoder's per-column
   bailouts) are baselined, not suppressed inline.  execs/retry.py
   itself — the classification gate — is exempt by construction.
+- SRC010 (error): source-level use-after-donate.  In execs//ops/
+  modules, a local assigned from ``cached_jit(..., donate=...)`` is a
+  DONATING program: the locals passed at its donated argnum positions
+  are consumed by the call (XLA reuses their buffers for the outputs
+  — docs/fusion.md), so any later reference to those locals in the
+  same function is a use-after-free waiting for a TPU backend.  The
+  direct-call spelling ``cached_jit(..., donate=...)(x)`` is covered
+  too.  Deliberately narrow (local names, source order within one
+  function): donation routed through the blessed consuming helper
+  (``transfer.run_consuming``, which memoizes the output and marks
+  the batch consumed) is exempt by construction — that is the
+  spelling engine code is supposed to use.  Intentional raw sites,
+  if any ever appear, are baselined, not suppressed inline.
 - SRC009 (error): raw ``jax.jit`` in an exec or ops module (execs/,
   ops/) bypassing ``execs/jit_cache.cached_jit``.  Every program the
   engine compiles is supposed to flow through the structural-key
@@ -540,6 +553,169 @@ class _RawJitChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _UseAfterDonateChecker(ast.NodeVisitor):
+    """SRC010: a local passed at a donated argnum of a
+    ``cached_jit(..., donate=...)`` program, referenced after the call
+    site.
+
+    Per-function, source-order analysis: assignments like
+    ``fn = cached_jit(key, mk, donate=(0,))`` register ``fn`` as a
+    donating callable with its (constant) argnums; a later ``fn(b)``
+    marks ``b`` consumed at that line; any LOAD of ``b`` on a later
+    line in the same function is flagged.  A re-assignment of the
+    consumed name clears it (the local now holds something else).
+    When the donate spec is not a constant tuple/int, every positional
+    arg of the call is treated as donated — conservative, loud.
+    ``transfer.run_consuming`` is the blessed escape hatch and is not
+    tracked (it owns the consumed-state bookkeeping)."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+
+    @staticmethod
+    def _donate_spec(call: ast.Call):
+        """The donate= keyword of a cached_jit call: a tuple of
+        argnums, None when absent/disabled, or "all" when not
+        statically known."""
+        if _terminal_name(call.func) != "cached_jit":
+            return None
+        for kw in call.keywords:
+            if kw.arg != "donate":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and v.value is None:
+                return None
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                nums = []
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        nums.append(el.value)
+                    else:
+                        return "all"
+                return tuple(nums) if nums else None
+            return "all"
+        return None
+
+    @staticmethod
+    def _own_nodes(fn: ast.FunctionDef):
+        """Walk a function body WITHOUT descending into nested
+        function definitions — each function is its own scope and is
+        checked by its own visit (no double reports)."""
+        stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _check_function(self, fn: ast.FunctionDef) -> None:
+        consumed: dict[str, tuple[int, str]] = {}  # name -> (line, fn)
+        rebound: dict[str, int] = {}  # name -> earliest later rebind
+
+        def consume_args(call: ast.Call, spec, via: str) -> None:
+            args = call.args
+            idxs = range(len(args)) if spec == "all" else spec
+            for i in idxs:
+                if i < len(args) and isinstance(args[i], ast.Name):
+                    consumed[args[i].id] = (call.lineno, via)
+
+        # pass 0: EVERY assignment to each name, in source order (the
+        # walk itself is not source ordered) — a call site then
+        # resolves against the latest assignment at or before its own
+        # line, so re-binding a donating name to a plain callable (or
+        # vice versa) is honored for straight-line code
+        assigns: dict[str, list[tuple[int, object]]] = {}
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign):
+                spec = self._donate_spec(node.value) \
+                    if isinstance(node.value, ast.Call) else None
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, []).append(
+                            (node.lineno, spec))
+        for history in assigns.values():
+            history.sort()
+
+        def spec_at(name: str, line: int):
+            """The donate spec of `name`'s latest assignment at or
+            before `line` (None = plain / not assigned yet)."""
+            spec = None
+            for lineno, s in assigns.get(name, ()):
+                if lineno > line:
+                    break
+                spec = s
+            return spec
+
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                spec = spec_at(node.func.id, node.lineno)
+                if spec is not None:
+                    consume_args(node, spec, node.func.id)
+            elif isinstance(node.func, ast.Call):
+                spec = self._donate_spec(node.func)
+                if spec is not None:
+                    consume_args(node, spec, "cached_jit(...)")
+        if not consumed:
+            return
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store) \
+                    and node.id in consumed \
+                    and node.lineno >= consumed[node.id][0]:
+                rebound[node.id] = min(
+                    node.lineno, rebound.get(node.id, node.lineno))
+        # lambda parameters SHADOW: a Load of a consumed name inside a
+        # lambda whose own params bind that name refers to the
+        # parameter, not the donated local — exempt those Loads
+        shadowed: set[int] = set()
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Lambda):
+                continue
+            params = {a.arg for a in (node.args.posonlyargs
+                                      + node.args.args
+                                      + node.args.kwonlyargs)}
+            if not params & set(consumed):
+                continue
+            for sub in ast.walk(node.body):
+                if isinstance(sub, ast.Name) and sub.id in params:
+                    shadowed.add(id(sub))
+        for node in self._own_nodes(fn):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)) \
+                    or id(node) in shadowed:
+                continue
+            hit = consumed.get(node.id)
+            if hit is None or node.lineno <= hit[0] \
+                    or node.lineno >= rebound.get(node.id, 1 << 30):
+                continue  # before the donate, or after a rebind
+            line, via = hit
+            self.out.append(Diagnostic(
+                "SRC010", "error", f"{self.path}::{fn.name}",
+                f"`{node.id}` was donated into `{via}` at line {line} "
+                "and referenced afterwards — its device buffers "
+                "belong to the program's outputs now (use-after-free "
+                "on a TPU backend)",
+                hint="route donation through "
+                     "transfer.run_consuming (memoizes the output, "
+                     "marks the batch consumed) or stop referencing "
+                     "the donated local; baseline only intentional "
+                     "sites",
+                line=node.lineno))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_function(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
 #: handler-body calls that prove the exception was CLASSIFIED before
 #: being absorbed (the execs/retry gate + the fault-accounting hooks)
 _CLASSIFY_CALLS = {"classify", "is_retryable", "should_cpu_fallback",
@@ -682,6 +858,7 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _HostMaterializeChecker(path, out).visit(tree)
     if _is_program_module(path):
         _RawJitChecker(path, out).visit(tree)
+        _UseAfterDonateChecker(path, out).visit(tree)
     if _is_recovery_module(path):
         _SwallowChecker(path, out).visit(tree)
     return out

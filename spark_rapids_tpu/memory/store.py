@@ -327,24 +327,70 @@ class _Entry:
 class SpillableBatch:
     """Handle registering a device batch with the store so it may spill
     while not in active use.  `get()` returns a device-resident batch,
-    re-materializing (and re-registering at DEVICE) if spilled."""
+    re-materializing (and re-registering at DEVICE) if spilled.
+
+    `mark_consumed()` is the donation seam (docs/fusion.md): a caller
+    that donates the batch's device arrays into a fused XLA program
+    must un-register them FIRST — a donated-then-spilled buffer is a
+    use-after-free (`_batch_to_host` would device_get freed HBM).
+    Consumed handles stay valid objects: `unpin`/`close` become
+    no-ops (so retry-ladder rollbacks that sweep handle lists never
+    re-park a donated batch) and `get()` fails fast."""
 
     def __init__(self, store: "BufferStore", buffer_id: int):
         self._store = store
         self.buffer_id = buffer_id
+        self._consumed = False
 
     def get(self) -> ColumnarBatch:
         """Acquire device-resident (pins the buffer until unpin/close)."""
+        if self._consumed:
+            from spark_rapids_tpu.columnar.transfer import (
+                ConsumedBatchError,
+            )
+
+            raise ConsumedBatchError(
+                f"buffer {self.buffer_id} was donated into a fused "
+                "program and cannot be re-materialized")
         return self._store.acquire(self.buffer_id)
+
+    def mark_consumed(self) -> None:
+        """Un-register: the device arrays are being donated into a
+        fused program (XLA reuses their HBM for the outputs), so the
+        store must never spill or account them again.  Idempotent;
+        the entry is dropped WITHOUT deleting the arrays (XLA now
+        owns that memory)."""
+        if self._consumed:
+            return
+        self._consumed = True
+        self._store.remove(self.buffer_id)
+
+    @property
+    def consumed(self) -> bool:
+        return self._consumed
+
+    def _raise_consumed(self, what: str) -> None:
+        from spark_rapids_tpu.columnar.transfer import (
+            ConsumedBatchError,
+        )
+
+        raise ConsumedBatchError(
+            f"buffer {self.buffer_id} was donated into a fused "
+            f"program; {what} is gone")
 
     def get_host(self) -> dict:
         """Read the batch as host arrays without materializing on device
         (pins; the out-of-core sort assembles buckets host-side)."""
+        if self._consumed:
+            self._raise_consumed("its host view")
         return self._store.acquire_host(self.buffer_id)
 
     def unpin(self) -> None:
         """Make the buffer spillable again (caller dropped its batch
-        reference)."""
+        reference).  No-op on a consumed handle — a rollback sweep
+        must never make a donated buffer spillable."""
+        if self._consumed:
+            return
         with self._store._lock:
             e = self._store._entries.get(self.buffer_id)
             if e is not None:
@@ -352,13 +398,21 @@ class SpillableBatch:
 
     @property
     def tier(self) -> StorageTier:
+        if self._consumed:
+            self._raise_consumed("its storage tier")
         return self._store._entries[self.buffer_id].tier
 
     @property
     def nbytes(self) -> int:
+        if self._consumed:
+            self._raise_consumed("its byte accounting")
         return self._store._entries[self.buffer_id].nbytes
 
     def close(self) -> None:
+        """No-op on a consumed handle (mark_consumed already dropped
+        the entry; the arrays belong to XLA now)."""
+        if self._consumed:
+            return
         self._store.remove(self.buffer_id)
 
 

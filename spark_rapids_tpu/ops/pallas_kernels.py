@@ -155,10 +155,33 @@ def pallas_hash_string(chars: jax.Array, lengths: jax.Array,
 
 def maybe_pallas_hash_string(chars, lengths, seeds):
     """Route to the Pallas kernel when available and the shape fits;
-    None means 'use the jnp reference path'."""
+    None means 'use the jnp reference path'.
+
+    Sub-block batches COALESCE into one kernel block: a tiny tail
+    batch (capacity < _BLOCK_N — ragged scan tails, small partials)
+    pads its rows up to the block size and slices the result back,
+    instead of falling to the width-specialized jnp path.  Shapes are
+    static (capacities are pow2), so the pad/slice fuse into the
+    surrounding program; the win is program-count, not FLOPs — every
+    distinct jnp-path shape used to mint its own ~1.25*W-pass lowering
+    per (capacity, width), while the padded form shares the one
+    grid-blocked kernel per width with every full-size batch.  Padding
+    rows hash garbage nobody reads (length 0 -> fmix of an empty
+    string); the slice drops them inside the same program."""
     n, width = chars.shape
-    if n % _BLOCK_N != 0 or width > _MAX_WIDTH:
+    if width > _MAX_WIDTH or not pallas_available():
         return None
-    if not pallas_available():
-        return None
+    if n % _BLOCK_N != 0:
+        if n > _BLOCK_N:
+            # over-block ragged shapes don't occur (capacities are
+            # pow2), but refuse rather than pad multi-block sizes
+            return None
+        pad = _BLOCK_N - n
+        chars = jnp.concatenate(
+            [chars, jnp.zeros((pad, width), chars.dtype)], axis=0)
+        lengths = jnp.concatenate(
+            [lengths, jnp.zeros((pad,), lengths.dtype)], axis=0)
+        seeds = jnp.concatenate(
+            [seeds, jnp.zeros((pad,), seeds.dtype)], axis=0)
+        return pallas_hash_string(chars, lengths, seeds)[:n]
     return pallas_hash_string(chars, lengths, seeds)

@@ -1365,6 +1365,7 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
         inject_runtime_filters(root, conf)
         _mark_encoded_scans(root)
         _plan_pipeline(root, conf)
+        _plan_fusion(root)
     return root, meta
 
 
@@ -1378,6 +1379,14 @@ def _mark_encoded_scans(root: TpuExec) -> None:
     from spark_rapids_tpu.execs.base import FusableExec
     from spark_rapids_tpu.io.scan import ParquetScanExec
 
+    from spark_rapids_tpu.execs.base import fusion_enabled
+
+    if not fusion_enabled():
+        # unfused baseline (spark.rapids.tpu.sql.fusion.enabled=false):
+        # scans upload eagerly-decoded batches and every exec runs its
+        # own program — the dispatch-soup configuration the fusion
+        # smoke's on/off digest + dispatch-count gates compare against
+        return
     for node in root._walk():
         for c in node.children:
             if not isinstance(c, ParquetScanExec):
@@ -1413,6 +1422,95 @@ def _plan_pipeline(root: TpuExec, conf) -> None:
             stages.append(
                 f"{root.name}: last-exec->fetch stage (depth={depth})")
     root._pipeline_stages = stages
+
+
+def _plan_fusion(root: TpuExec) -> None:
+    """Record which per-batch chains fuse into single XLA programs —
+    and why others don't — for DataFrame.explain()'s "Fusion:" section
+    (mirrors the "Pipeline:"/"RuntimeFilters:" sections; the list is
+    stored on the root and rendered by eventlog.render_plan_report so
+    the persisted plan matches the in-process view).  Pure
+    description: it reads the same fusion_chain()/_absorbed_chain()
+    decisions the drivers execute, so the report can never say one
+    thing while the engine compiles another (docs/fusion.md)."""
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.base import (
+        FusableExec,
+        fusion_enabled,
+        record_fused_chain,
+    )
+    from spark_rapids_tpu.execs.jit_cache import donation_enabled
+    from spark_rapids_tpu.exprs.base import ansi_enabled
+
+    lines: list[str] = []
+    if not fusion_enabled():
+        from spark_rapids_tpu.execs.base import _fusion_conf
+
+        root._fusion_report = [
+            f"disabled by {_fusion_conf().key}: every exec "
+            "dispatches its own per-batch program"]
+        return
+    donate = donation_enabled()
+
+    def decode_part() -> str:
+        return "wire decode fused" + (", inputs donated" if donate
+                                      else "")
+
+    absorbed_heads: set[int] = set()
+    for node in root._walk():
+        if isinstance(node, TpuHashAggregateExec):
+            ch = node._absorbed_chain()
+            src = node._source_node()
+            decode = getattr(src, "emit_encoded", False)
+            if ch is not None:
+                chain, src, _keys = ch
+                absorbed_heads.update(id(e) for e in chain)
+                names = "<-".join(e.name for e in reversed(chain))
+                parts = [f"update + {len(chain)} exec(s)"]
+                if decode:
+                    parts.append(decode_part())
+                lines.append(
+                    f"{node.name}[{node.mode}] absorbs {names}: one "
+                    f"program [{', '.join(parts)}] over {src.name}")
+                record_fused_chain()
+            elif decode:
+                # no fusable chain below, but the scan's wire decode
+                # still fuses into the update program
+                lines.append(
+                    f"{node.name}[{node.mode}]: one program "
+                    f"[update + {decode_part()}] over {src.name}")
+                record_fused_chain()
+            elif node.mode != "final" and isinstance(
+                    node.children[0], FusableExec):
+                why = "ANSI error polling" if ansi_enabled() else \
+                    "partition-aware or uncacheable chain"
+                lines.append(
+                    f"{node.name}[{node.mode}]: child chain NOT "
+                    f"absorbed ({why}) — the chain still fuses on "
+                    "its own")
+    seen: set[int] = set()
+    for node in root._walk():
+        if not isinstance(node, FusableExec) or id(node) in seen \
+                or id(node) in absorbed_heads:
+            continue
+        chain, src, aware, keys = node.fusion_chain()
+        seen.update(id(e) for e in chain)
+        decode = getattr(src, "emit_encoded", False) and not aware
+        if len(chain) > 1 or decode:
+            names = "<-".join(e.name for e in reversed(chain))
+            parts = [f"{len(chain)} exec(s)"]
+            if decode:
+                parts.append(decode_part())
+            lines.append(f"{names}: one program "
+                         f"[{', '.join(parts)}] over {src.name}")
+            record_fused_chain()
+            if aware:
+                lines[-1] += " (partition-aware: encoded inputs " \
+                             "decode eagerly)"
+            if not all(k is not None for k in keys):
+                lines[-1] += " (uncacheable key: compiled per " \
+                             "instance)"
+    root._fusion_report = lines
 
 
 def _schema_device_representable(schema: T.Schema) -> bool:

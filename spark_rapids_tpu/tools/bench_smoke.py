@@ -535,6 +535,182 @@ def run_wire_codec_smoke() -> dict:
     return out
 
 
+def run_fusion_smoke() -> dict:
+    """Whole-stage fusion acceptance contract, cheap CI form (tier-1
+    via tests/test_fusion.py, docs/fusion.md): a q1-shaped
+    scan->filter->agg parquet query, multi-batch, run with the device
+    ledger on.
+
+    - the WARM pass (second fusion-enabled collect) compiles nothing:
+      0 jit-cache misses in its window;
+    - the warm pass dispatches STRICTLY fewer ledger programs than the
+      unfused baseline (`spark.rapids.tpu.sql.fusion.enabled=false`) —
+      decode+filter+agg-update collapse into one program per batch;
+    - results are bit-identical across fusion on, fusion off, and
+      donation on (the three-way digest gate);
+    - the warm dispatch count respects the conf budget
+      (`spark.rapids.tpu.sql.fusion.warmDispatchBudget`) — the
+      regression gate ROADMAP #2's dispatch-soup diagnosis asked for.
+
+    Returns the warm/unfused dispatch counts, the warm roofline
+    fraction and the top-programs footer so callers (and the committed
+    smoke artifact) can show WHERE the device time went."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.eventlog import table_digest
+    from spark_rapids_tpu.execs.base import fusion_stats, \
+        reset_fusion_stats
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import TpuSession, col, count_star, sum_
+    from spark_rapids_tpu.trace import ledger
+
+    # force-register the lazily-registered fusion confs BEFORE the
+    # save/restore snapshot: saving an unregistered key reads None,
+    # and restoring that None would permanently shadow the registered
+    # default for the rest of the process
+    from spark_rapids_tpu.execs.base import _budget_conf, _fusion_conf
+
+    _fusion_conf()
+    _budget_conf()
+    conf = get_conf()
+    keys = ("spark.rapids.tpu.sql.fusion.enabled",
+            "spark.rapids.tpu.sql.fusion.donation.enabled",
+            "spark.rapids.tpu.sql.pipeline.enabled",
+            "spark.rapids.tpu.sql.speculation.enabled",
+            "spark.rapids.tpu.sql.batchSizeRows",
+            "spark.rapids.tpu.sql.shuffle.partitions")
+    saved = {k: conf.get(k) for k in keys}
+    out: dict = {}
+    ledger_was_on = ledger.LEDGER.enabled
+    rng = np.random.default_rng(0xF05E)
+    with tempfile.TemporaryDirectory(prefix="fusion_smoke_") as d:
+        n = 1 << 14
+        t = pa.table({
+            # q1 shape: date filter, string-ish group keys (small int
+            # domain stands in — keeps the fixture seconds-scale),
+            # summed measures
+            "l_shipdate": rng.integers(8766, 10957, n).astype(np.int32),
+            "l_key": rng.integers(0, 4, n).astype(np.int64),
+            "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+            "l_price": rng.integers(900, 105000, n).astype(np.int64),
+        })
+        path = os.path.join(d, "li.parquet")
+        pq.write_table(t, path, row_group_size=n // 4)
+
+        def q(session):
+            return (session.read_parquet(path)
+                    .where(col("l_shipdate") <= lit(10471))
+                    .group_by(col("l_key"))
+                    .agg((sum_(col("l_quantity")), "sum_qty"),
+                         (sum_(col("l_price")), "sum_price"),
+                         (count_star(), "n"))
+                    .order_by(col("l_key")))
+
+        def collect_counted(session):
+            """(digest, ledger dispatch count, jit misses) for one
+            collect, ledger window isolated."""
+            ledger.reset_stats()
+            j0 = cache_stats()
+            r = q(session).collect(engine="tpu")
+            assert ledger.LEDGER.flush(timeout=30.0), \
+                "ledger settlement did not drain"
+            s = ledger.summarize(ledger.snapshot())
+            j1 = cache_stats()
+            return (table_digest(r), s, j1["misses"] - j0["misses"])
+
+        try:
+            # pipelining/speculation pinned off so dispatch counts are
+            # deterministic; small batches so the stream actually
+            # streams (4 row groups -> 4 wire batches)
+            conf.set(keys[2], False)
+            conf.set(keys[3], False)
+            conf.set(keys[4], n // 4)
+            conf.set(keys[5], 1)
+            conf.set(keys[0], True)
+            conf.set(keys[1], False)
+            ledger.enable()
+            reset_fusion_stats()
+            session = TpuSession()
+            cold_digest, cold_sum, _ = collect_counted(session)
+            # isolate the warm window: chains/saved_dispatches below
+            # describe ONE collect, same semantics as bench.py's
+            # per-query q*_fusion_chains fields
+            reset_fusion_stats()
+            warm_digest, warm_sum, warm_misses = \
+                collect_counted(session)
+            fstats = fusion_stats()
+            assert warm_misses == 0, (
+                f"warm pass re-compiled {warm_misses} program(s): "
+                "jit keys are unstable across identical collects")
+            assert warm_digest == cold_digest
+            warm_d = warm_sum["totals"]["dispatches"]
+
+            # unfused baseline: fresh session, fusion off
+            conf.set(keys[0], False)
+            unfused_digest, unfused_sum, _ = \
+                collect_counted(TpuSession())
+            unfused_d = unfused_sum["totals"]["dispatches"]
+            assert unfused_digest == warm_digest, \
+                "fusion.enabled changed query results"
+            assert warm_d < unfused_d, (
+                f"fusion saved no dispatches: warm {warm_d} vs "
+                f"unfused {unfused_d}")
+
+            # donation on: digest identical, consumed-state bookkeeping
+            # exercised end to end
+            conf.set(keys[0], True)
+            conf.set(keys[1], True)
+            donated_digest, _ds, _ = collect_counted(TpuSession())
+            assert donated_digest == warm_digest, \
+                "donation.enabled changed query results"
+
+            # the dispatch-budget regression gate
+            from spark_rapids_tpu.execs.base import (
+                warm_dispatch_budget,
+            )
+
+            budget = warm_dispatch_budget()
+            if budget > 0:
+                assert warm_d <= budget, (
+                    f"warm dispatch count {warm_d} exceeds the "
+                    f"budget {budget} "
+                    "(spark.rapids.tpu.sql.fusion.warmDispatchBudget)")
+
+            top = warm_sum["totals"].get("top") or []
+            out["fusion_warm_dispatches"] = warm_d
+            out["fusion_unfused_dispatches"] = unfused_d
+            out["fusion_dispatch_savings_ratio"] = round(
+                unfused_d / max(warm_d, 1), 2)
+            out["fusion_warm_jit_misses"] = warm_misses
+            out["fusion_chains"] = fstats["chains"]
+            out["fusion_saved_dispatches"] = fstats["saved_dispatches"]
+            out["fusion_warm_roofline"] = \
+                warm_sum["totals"]["roofline"]
+            out["fusion_warm_device_ms"] = \
+                warm_sum["totals"]["device_ms"]
+            out["fusion_top_programs"] = [
+                {"key": p["key"], "op": p["op"],
+                 "dispatches": p["dispatches"],
+                 "device_ms": p["device_ms"], "share": p["share"]}
+                for p in top]
+        finally:
+            for k, v in saved.items():
+                conf.set(k, v)
+            ledger.reset_stats()
+            if not ledger_was_on:
+                # this smoke's own force-enable: release it (an outer
+                # caller's enable — bench, a wrapping test — survives)
+                ledger.disable()
+    return out
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -579,6 +755,7 @@ def main() -> int:
     results.update(run_serving_smoke())
     results.update(run_ledger_smoke())
     results.update(run_wire_codec_smoke())
+    results.update(run_fusion_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

@@ -105,6 +105,17 @@ def roofline_fraction(bytes_per_s: float,
     return bytes_per_s / hbm_bytes_per_s
 
 
+def key_tag(key: Any) -> str:
+    """THE key-tag rule: the leading string of a structural jit key
+    (every cached_jit key starts with one), "prog" otherwise.  One
+    definition shared by ProgramEntry, program_key_str and
+    jit_cache.program_census — the census the fusion smoke diffs and
+    the ledger footer must bucket keys identically or key churn gets
+    pinned to the wrong tag."""
+    return key[0] if isinstance(key, tuple) and key \
+        and isinstance(key[0], str) else "prog"
+
+
 def program_key_str(key: Any) -> str:
     """Stable, compact cross-run identity for a structural jit key:
     the key's leading tag (every cached_jit key starts with one) plus a
@@ -112,28 +123,27 @@ def program_key_str(key: Any) -> str:
     only expression trees / capacities / schemas — no addresses — so
     the same program hashes identically across runs, which is what
     lets tools/history line programs up between event logs."""
-    tag = key[0] if isinstance(key, tuple) and key \
-        and isinstance(key[0], str) else "prog"
     h = hashlib.sha256(repr(key).encode()).hexdigest()[:12]
-    return f"{tag}#{h}"
+    return f"{key_tag(key)}#{h}"
 
 
 class ProgramEntry:
     """Cumulative counters for one compiled program (one jit key)."""
 
-    __slots__ = ("key_str", "tag", "op", "gen", "dispatches",
+    __slots__ = ("key_str", "tag", "op", "gen", "donated", "dispatches",
                  "dispatch_ns", "device_ns", "flops", "bytes_accessed",
                  "cost_state", "lock")
 
     #: cost_state values
     COST_NONE, COST_PENDING, COST_DONE = 0, 1, 2
 
-    def __init__(self, key: Any, op: Optional[str], gen: int):
+    def __init__(self, key: Any, op: Optional[str], gen: int,
+                 donated: bool = False):
         self.key_str = program_key_str(key)
-        self.tag = key[0] if isinstance(key, tuple) and key \
-            and isinstance(key[0], str) else "prog"
+        self.tag = key_tag(key)
         self.op = op
         self.gen = gen
+        self.donated = donated
         self.dispatches = 0
         self.dispatch_ns = 0  # host-side dispatch wall (call duration)
         self.device_ns = 0  # exclusive busy intervals, reaper-settled
@@ -141,6 +151,40 @@ class ProgramEntry:
         self.bytes_accessed = 0.0  # per execution
         self.cost_state = self.COST_NONE
         self.lock = threading.Lock()
+
+
+def derive_sentinels(out: Any) -> list:
+    """Zero-row sentinel slices for every live device-array leaf of a
+    program output pytree (the sentinel's completion implies the
+    program finished — data dependency + in-order device execution —
+    and the settle worker exclusively owns it, so polling never races
+    the spill store's .delete()).
+
+    PER-LEAF fault isolation: under buffer donation a fused program's
+    output can mix live leaves with leaves the caller already consumed
+    (donated into the next program, or passed through from a donated
+    input) — one dead leaf must not throw away every usable sentinel,
+    or the donated fused program silently settles \"as host\" and its
+    device-busy time vanishes from the ledger (the warm-roofline
+    number ROADMAP #2 is judged on).  The retained leaves still bound
+    the program's completion: the device runs programs in order, so
+    ANY output leaf's readiness implies the whole program retired."""
+    import jax
+
+    try:
+        leaves = jax.tree_util.tree_leaves(out)
+    except Exception:
+        return []
+    sentinels = []
+    for x in leaves:
+        if not isinstance(x, jax.Array):
+            continue
+        try:
+            sentinels.append(x[:0] if x.ndim > 0
+                             else x.reshape((1,))[:0])
+        except Exception:
+            continue  # this leaf is gone; the survivors still settle
+    return sentinels
 
 
 class _SettleWorker:
@@ -180,14 +224,7 @@ class _SettleWorker:
 
     def submit(self, entry: ProgramEntry, t0: int, out: Any,
                cost_req: Optional[tuple]) -> None:
-        import jax
-
-        try:
-            sentinels = [x[:0] if x.ndim > 0 else x.reshape((1,))[:0]
-                         for x in jax.tree_util.tree_leaves(out)
-                         if isinstance(x, jax.Array)]
-        except Exception:
-            sentinels = []  # deleted/donated already: settle as host
+        sentinels = derive_sentinels(out)
         with self._cv:
             self._ensure_thread()
             self._unfinished += 1
@@ -277,20 +314,25 @@ class DeviceLedger:
 
     # -- recording (fed by the cached_jit wrapper) ------------------- #
 
-    def entry(self, key: Any, op: Optional[str]) -> ProgramEntry:
+    def entry(self, key: Any, op: Optional[str],
+              donated: bool = False) -> ProgramEntry:
         with self._lock:
             e = self._entries.get(key)
             if e is None:
-                e = self._entries[key] = ProgramEntry(key, op, self.gen)
+                e = self._entries[key] = ProgramEntry(key, op, self.gen,
+                                                      donated)
             elif e.op is None and op is not None:
                 e.op = op
             return e
 
-    def wrap(self, key: Any, fn, op: Optional[str] = None):
+    def wrap(self, key: Any, fn, op: Optional[str] = None,
+             donated: bool = False):
         """Wrap one jitted callable with ledger accounting.  The
         disabled path is one attribute read + the passthrough call —
         bit-identical results either way (the wrapper never touches
-        arguments or output)."""
+        arguments or output).  `donated` marks programs compiled with
+        buffer donation so snapshots/footers can say which programs
+        reuse input HBM."""
         cell: list = [None]
         ledger = self
 
@@ -299,7 +341,7 @@ class DeviceLedger:
                 return fn(*args, **kwargs)
             e = cell[0]
             if e is None or e.gen != ledger.gen:
-                e = cell[0] = ledger.entry(key, op)
+                e = cell[0] = ledger.entry(key, op, donated)
             t0 = time.perf_counter_ns()
             out = fn(*args, **kwargs)
             t1 = time.perf_counter_ns()
@@ -354,6 +396,7 @@ class DeviceLedger:
                 out[e.key_str] = {
                     "tag": e.tag,
                     "op": e.op,
+                    "donated": e.donated,
                     "dispatches": e.dispatches,
                     "dispatch_ms": round(e.dispatch_ns / 1e6, 3),
                     "device_ms": round(e.device_ns / 1e6, 3),
@@ -430,6 +473,7 @@ def delta(before: dict[str, dict],
         out[k] = {
             "tag": a["tag"],
             "op": a["op"],
+            "donated": a.get("donated", False),
             "dispatches": d,
             "dispatch_ms": round(
                 a["dispatch_ms"] - b.get("dispatch_ms", 0.0), 3),

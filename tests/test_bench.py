@@ -47,7 +47,7 @@ def test_bench_chaos_mode_records_recovery(tmp_path):
     df = bench.q6_dataframe(TpuSession(), paths)
     try:
         bench._CHAOS = True
-        bench._reset_pipeline_counters()  # arms CHAOS_SPEC
+        bench.reset_all_counters()  # arms CHAOS_SPEC
         sp0 = bench._spilled_now()
         assert_tpu_cpu_equal(df, approx_float=True)
         fields = bench._robustness_fields("q6", sp0)
@@ -55,7 +55,7 @@ def test_bench_chaos_mode_records_recovery(tmp_path):
     finally:
         bench._CHAOS = False
         faults.disarm()
-    bench._reset_pipeline_counters()
+    bench.reset_all_counters()
     clean = bench._robustness_fields("q6", bench._spilled_now())
     assert clean["q6_retry_splits"] == 0
     assert clean["q6_recovered_faults"] == 0

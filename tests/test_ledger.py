@@ -189,6 +189,62 @@ def test_reset_rekeys_wrapper_cells():
     assert snap and all(p["dispatches"] >= 1 for p in snap.values())
 
 
+# -- donation-safe settlement ------------------------------------------- #
+
+def test_derive_sentinels_retains_live_leaves():
+    """THE donation-attribution regression (ISSUE 11 satellite): a
+    program output mixing a dead (deleted/donated) leaf with live
+    leaves must keep sentinels for the live ones — the old
+    all-or-nothing derivation settled the whole dispatch 'as host',
+    silently dropping a donated fused program's device-busy time."""
+    import jax.numpy as jnp
+
+    live = jnp.arange(16)
+    dead = jnp.arange(8) + 1
+    dead.block_until_ready()
+    dead.delete()
+    sentinels = ledger.derive_sentinels({"a": dead, "b": live,
+                                         "n": 7})
+    assert len(sentinels) == 1  # the live leaf survives the dead one
+    assert sentinels[0].shape == (0,)
+    # all-dead (or host-only) outputs degrade to no sentinels, never
+    # raise
+    assert ledger.derive_sentinels({"a": dead}) == []
+    assert ledger.derive_sentinels(42) == []
+
+
+def test_donated_program_settles_device_time():
+    """End-to-end through the settle worker: a dispatch whose output
+    pytree holds a DEAD leaf next to a live one still settles its
+    exclusive busy interval via the retained sibling sentinel (and
+    the entry carries the donated marker for the footer)."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    ledger.enable()
+    entry = ledger.LEDGER.entry(("fusedenc", "t"), "T", donated=True)
+    live = jnp.arange(1 << 16) * 3
+    dead = jnp.arange(8)
+    dead.block_until_ready()
+    dead.delete()
+    # THE regression contract: per-leaf fault isolation.  The old
+    # all-or-nothing derivation returned [] the moment any leaf was
+    # dead, so the settle worker stamped completion at submit time
+    # ("as host") and the fused program's busy time vanished.  The
+    # live sibling must survive as a sentinel.
+    sentinels = ledger.derive_sentinels({"a": dead, "b": live})
+    assert len(sentinels) == 1 and sentinels[0].shape == (0,)
+    t0 = _time.perf_counter_ns()
+    ledger.LEDGER._settle.submit(entry, t0, {"a": dead, "b": live},
+                                 None)
+    assert ledger.LEDGER.flush(timeout=30.0)
+    snap = ledger.snapshot()
+    e = snap[ledger.program_key_str(("fusedenc", "t"))]
+    assert e["donated"] is True
+    assert e["device_ms"] >= 0.0  # settled through the live sentinel
+
+
 # -- off = free and bit-identical --------------------------------------- #
 
 def test_ledger_disabled_dispatches_touch_nothing(monkeypatch):

@@ -602,6 +602,94 @@ def test_source_lint_raw_jit_rule_scoped_and_exempt():
             lint_source_text(_RAW_JIT_FIXTURE, path)), path
 
 
+_DONATE_FIXTURE = """
+from spark_rapids_tpu.columnar.transfer import run_consuming
+from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+
+class FakeExec:
+    def _use_after_donate(self, key, mk, batch):
+        fn = cached_jit(key, mk, donate=(0,))
+        out = fn(batch)
+        return out, batch.num_rows            # SRC010: batch donated
+
+    def _direct_call_form(self, key, mk, batch):
+        out = cached_jit(key, mk, donate=(0,))(batch)
+        n = batch.capacity                    # SRC010: batch donated
+        return out, n
+
+    def _clean_last_use(self, key, mk, batch):
+        fn = cached_jit(key, mk, donate=(0,))
+        return fn(batch)                      # clean: no use after
+
+    def _clean_rebound(self, key, mk, batch):
+        fn = cached_jit(key, mk, donate=(0,))
+        batch = fn(batch)                     # rebound: fresh value
+        return batch.num_rows                 # clean
+
+    def _clean_no_donate(self, key, mk, batch):
+        fn = cached_jit(key, mk)
+        out = fn(batch)
+        return out, batch.num_rows            # clean: nothing donated
+
+    def _blessed_helper(self, fn, batch):
+        out = run_consuming(fn, batch)
+        return out, batch.num_rows            # clean: helper owns it
+
+    def _donating_after_plain(self, key, mk, plain, batch):
+        fn = plain(key)
+        fn = cached_jit(key, mk, donate=(0,))
+        out = fn(batch)
+        return out, batch.num_rows            # SRC010: latest assign
+                                              # wins, batch donated
+
+    def _plain_after_donating(self, key, mk, plain, b, c):
+        fn = cached_jit(key, mk, donate=(0,))
+        out = fn(c)
+        fn = plain(key)
+        out2 = fn(b)
+        return out, out2, b.num_rows          # clean: b hit the
+                                              # PLAIN rebinding
+
+    def _lambda_param_shadows(self, key, mk, batch, rows):
+        fn = cached_jit(key, mk, donate=(0,))
+        out = fn(batch)
+        return out, sorted(rows,
+                           key=lambda batch: batch.ordinal)  # clean:
+                                              # the lambda's own param
+"""
+
+
+def test_source_lint_flags_use_after_donate():
+    """SRC010: referencing a local after it was passed at a donated
+    argnum of a cached_jit(donate=...) call is an ERROR in execs//ops/
+    — its buffers belong to the program's outputs now.  The
+    run_consuming helper, plain cached_jit, last-use and rebound
+    shapes all pass."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/ops/fake.py"):
+        diags = lint_source_text(_DONATE_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC010"]
+        assert len(hits) == 3, (path, [d.render() for d in hits])
+        assert all(h.severity == "error" for h in hits)
+        locs = " ".join(h.location for h in hits)
+        assert "_use_after_donate" in locs \
+            and "_direct_call_form" in locs \
+            and "_donating_after_plain" in locs
+    assert evaluate(lint_source_text(
+        _DONATE_FIXTURE, "spark_rapids_tpu/execs/fake.py"))[2] != 0
+
+
+def test_source_lint_donate_rule_scoped():
+    """SRC010 polices execs//ops/ only (jit_cache.py exempt, like
+    SRC009)."""
+    for path in ("spark_rapids_tpu/parallel/fake.py",
+                 "spark_rapids_tpu/columnar/fake.py",
+                 "spark_rapids_tpu/execs/jit_cache.py"):
+        assert "SRC010" not in rules(
+            lint_source_text(_DONATE_FIXTURE, path)), path
+
+
 # -- metric-registry checker (MET001) ----------------------------------- #
 
 _MET_UNSETTLED = """
@@ -740,6 +828,12 @@ def test_repo_baseline_covers_only_intentional_syncs():
         elif k.startswith("SRC009::"):
             assert any(k.startswith(f"SRC009::{p}::")
                        for p in rawjit_infra), k
+        elif k.startswith("SRC010::"):
+            # intentional use-after-donate sites (none today: engine
+            # donation routes through transfer.run_consuming) may be
+            # baselined only inside the program modules the rule scans
+            assert any(k.startswith(f"SRC010::spark_rapids_tpu/{p}/")
+                       for p in ("execs", "ops")), k
         elif k.startswith("MET001::"):
             # intentional metric-registry placeholders may be
             # baselined, but only inside the exec layers the rule

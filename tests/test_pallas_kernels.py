@@ -63,3 +63,39 @@ def test_empty_and_full_width_strings():
     ref = hash_string_bytes(chars, lengths, jnp.uint32(42))
     got = pallas_hash_string(chars, lengths, seeds, interpret=True)
     assert np.array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_subblock_batches_coalesce_into_one_block(monkeypatch):
+    """Tail batches below _BLOCK_N pad into one kernel block instead
+    of falling to the width-specialized jnp path (ISSUE 11: tiny tail
+    batches must not each mint their own lowering) — results match
+    the reference bit-for-bit and the padding rows are sliced away."""
+    import spark_rapids_tpu.ops.pallas_kernels as PK
+
+    monkeypatch.setattr(PK, "pallas_available", lambda: True)
+    calls = []
+
+    def interp(chars, lengths, seeds):
+        calls.append(chars.shape)
+        return pallas_hash_string(chars, lengths, seeds,
+                                  interpret=True)
+
+    monkeypatch.setattr(PK, "pallas_hash_string", interp)
+    for n in (8, 256, _BLOCK_N // 2):
+        chars, lengths = _string_matrix(n, 8, seed=n)
+        seeds = jnp.full((n,), 42, jnp.uint32)
+        got = PK.maybe_pallas_hash_string(chars, lengths, seeds)
+        assert got is not None and got.shape == (n,)
+        # the kernel saw exactly one full block
+        assert calls[-1] == (_BLOCK_N, 8)
+        ref = hash_string_bytes(chars, lengths, jnp.uint32(42))
+        assert np.array_equal(np.asarray(got), np.asarray(ref))
+    # full-block shapes pass through unpadded; over-wide refuses
+    chars, lengths = _string_matrix(_BLOCK_N, 8, seed=1)
+    seeds = jnp.full((_BLOCK_N,), 42, jnp.uint32)
+    assert PK.maybe_pallas_hash_string(chars, lengths, seeds) \
+        is not None
+    assert calls[-1] == (_BLOCK_N, 8)
+    wide = jnp.zeros((_BLOCK_N, 256), jnp.uint8)
+    assert PK.maybe_pallas_hash_string(
+        wide, jnp.zeros(_BLOCK_N, jnp.int32), seeds) is None
