@@ -94,7 +94,14 @@ prepared-plan cache, emitting `serving_qps`, `serving_p50_ms` /
 `serving_p99_ms`, `admission_wait_p99_ms` and `plan_cache_hit_rate`,
 with a bit-for-bit digest gate against serial execution and a
 repeat-template pass asserting hit rate 1.0 with zero plan/tag/lower
-spans and zero jit-cache misses.
+spans and zero jit-cache misses.  Cross-tenant work sharing
+(docs/work_sharing.md) is ON by default: the round runs the whole
+concurrent pass twice — sharing off then on — and emits the A/B
+(`serving_qps_sharing_{on,off}`, `shared_scan_dedup_ratio`,
+`result_cache_hit_rate`, tapped upload-byte totals); `--no-sharing`
+opts out, `--chaos` arms the deterministic fault schedule in both
+arms, `--store-budget N` shrinks the spill-store budgets so cached
+results take the host->disk spill/restore path mid-round.
 """
 
 import json
@@ -900,12 +907,191 @@ def _serving_queries(session, li_paths, orders_path):
     return [("qa", qa), ("qb", qb), ("qc", qc)]
 
 
+def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
+                   digests: dict, conf_factory, sharing: bool) -> dict:
+    """One full concurrent serving pass (warm + measured repeat) with
+    cross-tenant sharing on or off: the A/B unit of the serving bench.
+    Resets the scheduler/plan-cache/work-share/upload counters at
+    phase start, runs every session's warm pass, arms the measured
+    window at the barrier, and returns the phase's latency set plus
+    every counter surface (docs/work_sharing.md)."""
+    import threading
+
+    from spark_rapids_tpu import trace as _trace
+    from spark_rapids_tpu.columnar.transfer import (
+        reset_upload_stats,
+        upload_stats,
+    )
+    from spark_rapids_tpu.config import set_conf
+    from spark_rapids_tpu.eventlog import table_digest
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.robustness import faults
+    from spark_rapids_tpu.serving import plan_cache as _plan_cache
+    from spark_rapids_tpu.serving import scheduler as _scheduler
+    from spark_rapids_tpu.serving import work_share as _ws
+    from spark_rapids_tpu.session import TpuSession
+
+    repeat_iters = 3
+    _scheduler.reset()
+    _plan_cache.reset_stats()
+    _ws.reset()
+    reset_upload_stats()
+    if _CHAOS:
+        # fresh deterministic schedule per phase so the nth-call
+        # policies fire in BOTH the sharing-off and sharing-on arms
+        faults.install(CHAOS_SPEC, forced=True)
+    lat_lock = threading.Lock()
+    latencies: list = []
+    mismatches: list = []
+    prepared: list = []  # (session, {name: PreparedQuery})
+    # the main thread is a barrier party: it arms the measured
+    # window's instrumentation strictly AFTER every warm pass and
+    # strictly BEFORE any repeat execution
+    warm_done = threading.Barrier(n_sessions + 1)
+    go_repeat = threading.Event()
+
+    def run_session(i: int) -> None:
+        pqs = {}
+        try:
+            conf = conf_factory(sharing=sharing)
+            set_conf(conf)
+            session = TpuSession(conf, tenant=f"t{i % n_tenants}")
+            for name, df in _serving_queries(session, li, orders):
+                pqs[name] = session.prepare(df)
+            with lat_lock:
+                prepared.append((session, pqs))
+            # warm pass: every template once (prepare already
+            # lowered; this compiles + validates), digest-gated
+            for name, pq in pqs.items():
+                r = pq.execute()
+                if table_digest(r) != digests[name]:
+                    with lat_lock:
+                        mismatches.append((i, name, "warm"))
+        except BaseException as e:  # noqa: BLE001 — reported below
+            with lat_lock:
+                mismatches.append((i, "session-error", repr(e)))
+            pqs = {}
+        finally:
+            # ALWAYS reach the barrier: a dead party would leave
+            # the main thread blocked in warm_done.wait() forever
+            # instead of failing with the recorded error
+            warm_done.wait()
+        if not pqs:
+            return
+        go_repeat.wait()
+        # measured REPEAT pass: pure cache hits, timed
+        try:
+            for _ in range(repeat_iters):
+                for name, pq in pqs.items():
+                    t0 = time.perf_counter()
+                    r = pq.execute()
+                    dt = time.perf_counter() - t0
+                    if table_digest(r) != digests[name]:
+                        with lat_lock:
+                            mismatches.append((i, name, "repeat"))
+                    with lat_lock:
+                        latencies.append(dt)
+        except BaseException as e:  # noqa: BLE001 — reported below
+            with lat_lock:
+                mismatches.append((i, "repeat-error", repr(e)))
+
+    threads = [threading.Thread(target=run_session, args=(i,),
+                                name=f"serve-bench-{i}")
+               for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    warm_done.wait()
+    # measured-window instrumentation, armed while every session
+    # sits at go_repeat: plan-cache stats reset (repeats must show
+    # hit rate 1.0), jit snapshot (zero misses on hits), tracer on
+    # (zero query.plan/tag/lower spans on hits), work-share window
+    # snapshot (repeats with sharing on must be pure result-cache
+    # hits)
+    _plan_cache.reset_stats()
+    _scheduler.reset()  # fresh wait ring for the measured window
+    jit0 = cache_stats()
+    ws0 = _ws.stats()
+    _trace.clear()
+    _trace.enable()
+    wall0 = time.perf_counter()
+    go_repeat.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - wall0
+    _trace.disable()
+    spans = _trace.snapshot()
+    _trace.clear()
+    jit1 = cache_stats()
+    pc = _plan_cache.stats()
+    sched = _scheduler.scheduler_stats()
+    ws1 = _ws.stats()
+    up = upload_stats()
+
+    # -- streaming gate: stream == collect, to the bit ---------- #
+    stream_ok = False
+    if prepared and not mismatches:
+        import pyarrow as pa
+
+        _s_last, pqs_last = prepared[-1]
+        batches = list(pqs_last["qa"].execute_stream())
+        stream_tbl = pa.Table.from_batches(batches)
+        stream_ok = table_digest(stream_tbl) == digests["qa"]
+
+    # event logs hold every query before the dir is reported
+    for session, _p in prepared:
+        if session.event_log_path is not None:
+            _ = session.history.events
+
+    assert not mismatches, (
+        f"serving results diverged from serial digests "
+        f"(sharing={sharing}): {mismatches}")
+    assert stream_ok, "streamed result digest != collect digest"
+    plan_spans = sum(1 for e in spans
+                     if e.name in ("query.plan", "query.tag",
+                                   "query.lower"))
+    n_execs = len(latencies)
+    latencies.sort()
+
+    def q(p: float) -> float:
+        return latencies[min(n_execs - 1,
+                             int(round(p * (n_execs - 1))))]
+
+    window = ws1["result_hits"] - ws0["result_hits"] \
+        + ws1["result_misses"] - ws0["result_misses"]
+    hits = ws1["result_hits"] - ws0["result_hits"]
+    return {
+        "qps": round(n_execs / wall, 2),
+        "p50_ms": round(q(0.50) * 1e3, 1),
+        "p99_ms": round(q(0.99) * 1e3, 1),
+        "n_execs": n_execs,
+        "sched": sched,
+        "pc": pc,
+        "plan_spans": plan_spans,
+        "jit_misses": jit1["misses"] - jit0["misses"],
+        # per-PHASE device-work evidence (warm + repeat): decoded
+        # rows/units and tapped H2D wire bytes — the sub-linearity
+        # story is these staying ~flat in sessions with sharing on
+        "scan_rows_decoded": ws1["scan_rows_decoded"],
+        "scan_units_decoded": ws1["scan_units_decoded"],
+        "scan_units_shared": ws1["scan_units_shared"],
+        "scan_subscribes": ws1["scan_subscribes"],
+        "upload_bytes": up["wire_bytes"],
+        # measured-WINDOW result-cache verdict: hit rate over the
+        # repeat pass alone
+        "result_cache_window_hits": hits,
+        "result_cache_hit_rate":
+            round(hits / window, 3) if window else 0.0,
+        "result_inserts": ws1["result_inserts"],
+    }
+
+
 def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
     """The multi-session serving bench (bench.py --sessions N
     [--tenants K]): N concurrent sessions across K tenants drive the
     deterministic golden templates through the serving tier — admission
-    control + prepared-plan cache + per-session event logs — and the
-    output makes 'heavy traffic' a measured claim:
+    control + prepared-plan cache + cross-tenant work sharing +
+    per-session event logs — and the output makes 'heavy traffic' a
+    measured claim:
 
     - serving_qps, serving_p50_ms / serving_p99_ms over the measured
       window (all sessions, all templates);
@@ -915,27 +1101,32 @@ def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
       during that pass — asserted 0: hits skip lowering entirely) and
       serving_repeat_jit_misses (asserted 0: cached trees re-use their
       compiled programs);
-    - a bit-for-bit digest gate: every concurrent result must hash
-      identical to the serial run's, and one streamed fetch must hash
-      identical to its collect.
+    - the sharing A/B (docs/work_sharing.md): the whole concurrent
+      pass runs TWICE, sharing off then on (skip the on-arm with
+      --no-sharing), emitting serving_qps_sharing_{on,off},
+      shared_scan_dedup_ratio (decoded rows off/on, tapped counter),
+      result_cache_hit_rate (repeat window, asserted 1.0 with sharing
+      on) and the upload-byte totals proving device work scales
+      sub-linearly in sessions;
+    - a bit-for-bit digest gate: every concurrent result in BOTH arms
+      must hash identical to the serial sharing-off run's, and one
+      streamed fetch must hash identical to its collect — under
+      --chaos too (the deterministic fault schedule re-arms per arm).
     """
-    import threading
-
-    from spark_rapids_tpu import trace as _trace
     from spark_rapids_tpu.config import TpuConf, set_conf
     from spark_rapids_tpu.eventlog import table_digest
-    from spark_rapids_tpu.execs.jit_cache import cache_stats
-    from spark_rapids_tpu.serving import plan_cache as _plan_cache
-    from spark_rapids_tpu.serving import scheduler as _scheduler
+    from spark_rapids_tpu.robustness import faults
+    from spark_rapids_tpu.serving import work_share as _ws
     from spark_rapids_tpu.session import TpuSession
 
-    repeat_iters = 3
+    sharing_on = "--no-sharing" not in sys.argv[1:]
     max_concurrent = max(1, min(2, n_sessions))
+    store_budget = _int_flag("--store-budget")
     ev_dir = None
     if "--no-eventlog" not in sys.argv[1:]:
         ev_dir = _eventlog_dir()
 
-    def _conf(extra=None) -> TpuConf:
+    def _conf(extra=None, sharing=False) -> TpuConf:
         over = {
             "spark.rapids.tpu.serving.maxConcurrent": max_concurrent,
             "spark.rapids.tpu.serving.queueDepth": 4 * n_sessions + 8,
@@ -943,19 +1134,36 @@ def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
             # scheduler clamp makes maxConcurrent a dead knob here
             "spark.rapids.tpu.sql.concurrentTpuTasks":
                 max(2, max_concurrent),
+            "spark.rapids.tpu.serving.sharing.enabled": sharing,
         }
+        if store_budget:
+            # --store-budget N: shrink the spill-store budgets so
+            # cached shared results are forced through the host->disk
+            # spill/restore path during the bench itself
+            over["spark.rapids.tpu.memory.hbm.budgetBytes"] = \
+                store_budget
+            over["spark.rapids.tpu.memory.host.spillStorageSize"] = \
+                store_budget
         if ev_dir is not None:
             over["spark.rapids.tpu.eventLog.enabled"] = True
             over["spark.rapids.tpu.eventLog.dir"] = ev_dir
         over.update(extra or {})
         return TpuConf(over)
 
+    if store_budget:
+        # the store snapshots budgets at construction: start fresh so
+        # the serving sessions' shrunken budgets actually apply
+        from spark_rapids_tpu.memory.store import reset_store
+
+        reset_store()
+
     with tempfile.TemporaryDirectory(prefix="serve_bench_") as d:
         li = make_lineitem(d, n_files=2, with_q1_cols=True,
                            with_orderkey=True)
         orders = make_orders(d)
 
-        # -- serial reference: digests + latency baseline ----------- #
+        # -- serial reference: digests + latency baseline (sharing
+        # off, fault-free — THE ground truth both arms must match) -- #
         serial_conf = _conf(
             {"spark.rapids.tpu.serving.maxConcurrent": 0})
         set_conf(serial_conf)
@@ -969,151 +1177,87 @@ def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
             serial_ts.append(time.perf_counter() - t0)
             digests[name] = table_digest(r)
 
-        # -- concurrent sessions ------------------------------------ #
-        _scheduler.reset()
-        _plan_cache.reset_stats()
-        lat_lock = threading.Lock()
-        latencies: list = []
-        mismatches: list = []
-        prepared: list = []  # (session, {name: PreparedQuery})
-        # the main thread is a barrier party: it arms the measured
-        # window's instrumentation strictly AFTER every warm pass and
-        # strictly BEFORE any repeat execution
-        warm_done = threading.Barrier(n_sessions + 1)
-        go_repeat = threading.Event()
+        try:
+            off = _serving_phase(n_sessions, n_tenants, li, orders,
+                                 digests, _conf, sharing=False)
+            on = None
+            if sharing_on:
+                on = _serving_phase(n_sessions, n_tenants, li, orders,
+                                    digests, _conf, sharing=True)
+        finally:
+            if _CHAOS:
+                faults.disarm()
+            _ws.reset()
 
-        def run_session(i: int) -> None:
-            pqs = {}
-            try:
-                conf = _conf()
-                set_conf(conf)
-                session = TpuSession(conf, tenant=f"t{i % n_tenants}")
-                for name, df in _serving_queries(session, li, orders):
-                    pqs[name] = session.prepare(df)
-                with lat_lock:
-                    prepared.append((session, pqs))
-                # warm pass: every template once (prepare already
-                # lowered; this compiles + validates), digest-gated
-                for name, pq in pqs.items():
-                    r = pq.execute()
-                    if table_digest(r) != digests[name]:
-                        with lat_lock:
-                            mismatches.append((i, name, "warm"))
-            except BaseException as e:  # noqa: BLE001 — reported below
-                with lat_lock:
-                    mismatches.append((i, "session-error", repr(e)))
-                pqs = {}
-            finally:
-                # ALWAYS reach the barrier: a dead party would leave
-                # the main thread blocked in warm_done.wait() forever
-                # instead of failing with the recorded error
-                warm_done.wait()
-            if not pqs:
-                return
-            go_repeat.wait()
-            # measured REPEAT pass: pure cache hits, timed
-            try:
-                for _ in range(repeat_iters):
-                    for name, pq in pqs.items():
-                        t0 = time.perf_counter()
-                        r = pq.execute()
-                        dt = time.perf_counter() - t0
-                        if table_digest(r) != digests[name]:
-                            with lat_lock:
-                                mismatches.append((i, name, "repeat"))
-                        with lat_lock:
-                            latencies.append(dt)
-            except BaseException as e:  # noqa: BLE001 — reported below
-                with lat_lock:
-                    mismatches.append((i, "repeat-error", repr(e)))
-
-        threads = [threading.Thread(target=run_session, args=(i,),
-                                    name=f"serve-bench-{i}")
-                   for i in range(n_sessions)]
-        for t in threads:
-            t.start()
-        warm_done.wait()
-        # measured-window instrumentation, armed while every session
-        # sits at go_repeat: plan-cache stats reset (repeats must show
-        # hit rate 1.0), jit snapshot (zero misses on hits), tracer on
-        # (zero query.plan/tag/lower spans on hits)
-        _plan_cache.reset_stats()
-        _scheduler.reset()  # fresh wait ring for the measured window
-        jit0 = cache_stats()
-        _trace.clear()
-        _trace.enable()
-        wall0 = time.perf_counter()
-        go_repeat.set()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - wall0
-        _trace.disable()
-        spans = _trace.snapshot()
-        _trace.clear()
-        jit1 = cache_stats()
-        pc = _plan_cache.stats()
-        sched = _scheduler.scheduler_stats()
-
-        # -- streaming gate: stream == collect, to the bit ---------- #
-        stream_ok = False
-        if prepared and not mismatches:
-            import pyarrow as pa
-
-            _s_last, pqs_last = prepared[-1]
-            batches = list(pqs_last["qa"].execute_stream())
-            stream_tbl = pa.Table.from_batches(batches)
-            stream_ok = table_digest(stream_tbl) == digests["qa"]
-
-        # event logs hold every query before the dir is reported
-        for session, _p in prepared:
-            if session.event_log_path is not None:
-                _ = session.history.events
-
-    assert not mismatches, (
-        f"serving results diverged from serial digests: {mismatches}")
-    assert stream_ok, "streamed result digest != collect digest"
-    plan_spans = sum(1 for e in spans
-                     if e.name in ("query.plan", "query.tag",
-                                   "query.lower"))
-    n_execs = len(latencies)
-    latencies.sort()
-
-    def q(p: float) -> float:
-        return latencies[min(n_execs - 1,
-                             int(round(p * (n_execs - 1))))]
-
+    # headline fields come from the DEFAULT posture (sharing on unless
+    # --no-sharing): the serving round measures the fleet as shipped
+    head = on if on is not None else off
     out = {
         "metric": "serving_bench",
-        "value": round(n_execs / wall, 2),
+        "value": head["qps"],
         "unit": "qps",
         "serving_sessions": n_sessions,
         "serving_tenants": n_tenants,
         "serving_max_concurrent": max_concurrent,
-        "serving_qps": round(n_execs / wall, 2),
-        "serving_p50_ms": round(q(0.50) * 1e3, 1),
-        "serving_p99_ms": round(q(0.99) * 1e3, 1),
-        "serving_executions": n_execs,
+        "serving_sharing": bool(on is not None),
+        "serving_qps": head["qps"],
+        "serving_p50_ms": head["p50_ms"],
+        "serving_p99_ms": head["p99_ms"],
+        "serving_executions": head["n_execs"],
         "serial_p50_ms": round(
             statistics.median(serial_ts) * 1e3, 1),
-        "admission_wait_p99_ms": sched["wait_p99_ms"],
-        "admission_total_wait_ms": sched["total_wait_ms"],
-        "admitted": sched["admitted"],
-        "rejected": sched["rejected"],
-        "plan_cache_hit_rate": pc["hit_rate"],
-        "plan_cache_hits": pc["hits"],
-        "plan_cache_misses": pc["misses"],
-        "serving_repeat_plan_spans": plan_spans,
-        "serving_repeat_jit_misses": jit1["misses"] - jit0["misses"],
+        "admission_wait_p99_ms": head["sched"]["wait_p99_ms"],
+        "admission_total_wait_ms": head["sched"]["total_wait_ms"],
+        "admitted": head["sched"]["admitted"],
+        "rejected": head["sched"]["rejected"],
+        "admission_coalesced": head["sched"]["coalesced"],
+        "plan_cache_hit_rate": head["pc"]["hit_rate"],
+        "plan_cache_hits": head["pc"]["hits"],
+        "plan_cache_misses": head["pc"]["misses"],
+        "serving_repeat_plan_spans": head["plan_spans"],
+        "serving_repeat_jit_misses": head["jit_misses"],
+        "serving_qps_sharing_off": off["qps"],
+        "serving_upload_bytes_sharing_off": off["upload_bytes"],
+        "serving_scan_rows_decoded_sharing_off":
+            off["scan_rows_decoded"],
         "digests_match": True,
         "stream_matches_collect": True,
     }
+    if _CHAOS:
+        out["chaos"] = CHAOS_SPEC
+    if store_budget:
+        out["store_budget_bytes"] = store_budget
+    if on is not None:
+        out.update({
+            "serving_qps_sharing_on": on["qps"],
+            "serving_upload_bytes_sharing_on": on["upload_bytes"],
+            "serving_scan_rows_decoded_sharing_on":
+                on["scan_rows_decoded"],
+            "shared_scan_dedup_ratio": round(
+                off["scan_rows_decoded"]
+                / max(1, on["scan_rows_decoded"]), 2),
+            "result_cache_hit_rate": on["result_cache_hit_rate"],
+            "result_cache_window_hits":
+                on["result_cache_window_hits"],
+            "scan_units_shared": on["scan_units_shared"],
+            "scan_subscribes": on["scan_subscribes"],
+        })
     if ev_dir is not None:
         out["eventlog"] = ev_dir
     # the acceptance contract, enforced where it is measured: repeats
-    # are pure hits that lowered nothing and compiled nothing
-    assert pc["hit_rate"] == 1.0, pc
-    assert plan_spans == 0, plan_spans
-    assert out["serving_repeat_jit_misses"] == 0, out
+    # are pure hits that lowered nothing and compiled nothing — and
+    # with sharing on, pure RESULT-cache hits that out-run and
+    # out-dedup the sharing-off arm
+    for phase in (off,) if on is None else (off, on):
+        assert phase["pc"]["hit_rate"] == 1.0, phase["pc"]
+        assert phase["plan_spans"] == 0, phase["plan_spans"]
+        assert phase["jit_misses"] == 0, phase
+    if on is not None:
+        assert on["result_cache_hit_rate"] == 1.0, on
+        assert off["scan_rows_decoded"] >= \
+            2 * max(1, on["scan_rows_decoded"]), (off, on)
+        assert on["qps"] > off["qps"], (on["qps"], off["qps"])
+        assert off["upload_bytes"] > on["upload_bytes"], (off, on)
     return out
 
 
@@ -1244,6 +1388,13 @@ def _int_flag(name: str) -> int:
 
 def main() -> None:
     global _CHAOS
+    if "--chaos" in sys.argv[1:]:
+        # chaos mode (parsed ahead of the mode dispatch so the serving
+        # round honors it too): every query below runs under the
+        # deterministic fault schedule — the correctness gates stay
+        # on, so what gets measured is the cost of RECOVERING, not a
+        # different answer
+        _CHAOS = True
     sessions = _int_flag("--sessions")
     if sessions:
         # serving mode: the multi-session concurrency bench ONLY (the
@@ -1273,12 +1424,6 @@ def main() -> None:
         # >= 20M, full per-stage attribution, CPU-gated
         print(json.dumps(_bench_scaled(scale)))
         return
-    if "--chaos" in sys.argv[1:]:
-        # chaos mode: every query below runs under the deterministic
-        # fault schedule (re-armed per query by the counter reset) —
-        # the correctness gates stay on, so what gets measured is the
-        # cost of RECOVERING, not a different answer
-        _CHAOS = True
     n_rows = ROWS_PER_FILE * N_FILES
     with tempfile.TemporaryDirectory(prefix="q6bench_") as d:
         paths = make_lineitem(d)

@@ -69,10 +69,40 @@ def all_valid_mask(cap: int) -> jax.Array:
         return m
 
 
+#: device arrays CURRENTLY shared across consumers (the work-sharing
+#: tier's shared scan batches, serving/work_share.py): id -> weakref.
+#: Identity-keyed with a GC callback, so a recycled id can never alias
+#: a dead shared array onto a fresh private one.  Spilling a shared
+#: array copies it to host but must never .delete() the device copy —
+#: another query may be mid-compute over the same buffers; release
+#: defers to the last Python reference instead.
+_SHARED_ARRAYS: dict[int, object] = {}
+
+
+def mark_shared_array(a) -> None:
+    """Register one device array as cross-consumer shared (see
+    _SHARED_ARRAYS).  Idempotent; non-arrays are ignored."""
+    import weakref as _weakref
+
+    if not isinstance(a, jax.Array):
+        return
+    key = id(a)
+    try:
+        ref = _weakref.ref(
+            a, lambda _r, _k=key: _SHARED_ARRAYS.pop(_k, None))
+    except TypeError:
+        return
+    with _SHARED_LOCK:
+        _SHARED_ARRAYS[key] = ref
+
+
 def is_shared_array(a) -> bool:
     """True for process-shared immortal arrays (spill must not delete)."""
     with _SHARED_LOCK:
-        return any(m is a for m in _SHARED_MASKS.values())
+        if any(m is a for m in _SHARED_MASKS.values()):
+            return True
+        ref = _SHARED_ARRAYS.get(id(a))
+        return ref is not None and ref() is a
 
 
 @jax.tree_util.register_pytree_node_class

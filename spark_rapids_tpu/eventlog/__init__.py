@@ -85,6 +85,10 @@ MONOTONIC_COUNTERS = (
     "speculation.hits", "speculation.overflows", "speculation.synced",
     "pipeline.readbacks", "pipeline.async_readbacks", "pipeline.items",
     "spill.device_to_host_bytes", "spill.host_to_disk_bytes",
+    "share.result_hits", "share.result_misses",
+    "share.result_evictions", "share.result_invalidations",
+    "share.scan_subscribes", "share.scan_units_shared",
+    "share.scan_units_decoded", "share.scan_rows_decoded",
 )
 
 
@@ -134,6 +138,18 @@ def counters_snapshot() -> dict[str, float]:
     out["spill.host_to_disk_bytes"] = ss["spilled_host_to_disk"]
     out["store.device_used"] = ss["device_used"]
     out["store.host_used"] = ss["host_used"]
+    from spark_rapids_tpu.serving import work_share
+
+    ws = work_share.stats()
+    out["share.result_hits"] = ws["result_hits"]
+    out["share.result_misses"] = ws["result_misses"]
+    out["share.result_evictions"] = ws["result_evictions"]
+    out["share.result_invalidations"] = ws["result_invalidations"]
+    out["share.scan_subscribes"] = ws["scan_subscribes"]
+    out["share.scan_units_shared"] = ws["scan_units_shared"]
+    out["share.scan_units_decoded"] = ws["scan_units_decoded"]
+    out["share.scan_rows_decoded"] = ws["scan_rows_decoded"]
+    out["share.result_bytes"] = ws["result_bytes"]  # gauge
     return out
 
 
@@ -415,11 +431,32 @@ class EventLogWriter:
             if "plan_cache" in sctx:
                 counters["serve.plan_cache_hit"] = \
                     1 if sctx["plan_cache"] == "hit" else 0
+            if "result_cache" in sctx:
+                counters["serve.result_cache_hit"] = \
+                    1 if sctx["result_cache"] == "hit" else 0
+        # the structured sharing section (docs/work_sharing.md): the
+        # per-query result-cache verdict plus this query's share.*
+        # counter deltas, None when the query never touched the
+        # sharing tier (the common sharing-off fleet)
+        share_delta = {k: v for k, v in counters.items()
+                       if k.startswith("share.")}
+        verdict = (sctx or {}).get("result_cache")
+        sharing = None
+        # the trigger reads the true per-query DELTAS only — the
+        # result_bytes gauge reports the cache's current footprint,
+        # which would mint a section for every query in the fleet
+        # once anything is cached
+        if verdict is not None or any(
+                v for k, v in share_delta.items()
+                if k != "share.result_bytes"):
+            sharing = {"result_cache": verdict,
+                       "counters": share_delta}
         return {
             "counters": counters,
             "pipeline": _pipeline_surface(),
             "faults": faults.fault_stats() or None,
             "serving": sctx,
+            "sharing": sharing,
             "programs": programs,
         }
 
@@ -471,6 +508,7 @@ class EventLogWriter:
             "pipeline": post["pipeline"],
             "faults": post["faults"],
             "serving": post.get("serving"),
+            "sharing": post.get("sharing"),
             "programs": post.get("programs"),
             "result_digest": result_digest,
             "rows": rows,

@@ -78,6 +78,10 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
         "pipeline": (dict, type(None)),
         "faults": (dict, type(None)),
         "serving": (dict, type(None)),
+        # cross-tenant work sharing (serving/work_share.py): the
+        # result-cache verdict for this query plus its share.*
+        # counter deltas — None when the sharing tier never engaged
+        "sharing": (dict, type(None)),
         # device-ledger attribution for this query (trace/ledger.py):
         # {"programs": {key: {...}}, "totals": {...}} — present only
         # when the ledger was enabled for the query

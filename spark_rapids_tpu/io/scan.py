@@ -651,7 +651,15 @@ class ParquetScanExec(TpuExec):
         """Accumulates decoded host tables ACROSS row groups and files
         up to batch_rows, then uploads each accumulated chunk in one
         transfer round: few big batches, not many small ones — on TPU
-        the per-dispatch/per-transfer latency dominates small batches."""
+        the per-dispatch/per-transfer latency dominates small batches.
+
+        With cross-tenant sharing on (serving/work_share.py), an
+        identical scan task already decoding for another query is
+        joined instead of repeated: the first arrival LEADS (decoding
+        and publishing its upload units), later arrivals SUBSCRIBE
+        and ride the same decode — and, while consumers overlap, the
+        same uploaded device batch.  Scans with runtime filters
+        registered never share (their pruning is query-dependent)."""
         conjuncts = self._conjuncts()
         self._prefilter_on = self._prefilter_active() \
             or getattr(self, "exact_prefilter", False)
@@ -670,6 +678,48 @@ class ParquetScanExec(TpuExec):
         from spark_rapids_tpu.io.rebase import REBASE_MODE_READ
 
         self._rebase_mode = conf.get(REBASE_MODE_READ)
+
+        from spark_rapids_tpu.parallel import pipeline as P
+
+        depth = getattr(self, "_pipeline_depth", None)
+        if depth is None:
+            depth = P.stage_depth(conf)
+
+        share = None
+        if not self.runtime_filters:
+            from spark_rapids_tpu.serving import work_share as _ws
+
+            if _ws.scan_sharing_enabled(conf):
+                from spark_rapids_tpu.plan.share_key import (
+                    scan_share_key,
+                )
+
+                skey = scan_share_key(self, p, conf)
+                if skey is not None:
+                    share, leader = _ws.SCAN_REGISTRY.begin(skey)
+                    if share is not None and not leader:
+                        yield from self._subscribe_shared(
+                            share, p, conf, conjuncts, depth)
+                        return
+        yield from self._drain_units(
+            self._local_units(conf, conjuncts, p, depth), p,
+            share=share)
+
+    def _local_units(self, conf, conjuncts, p: int, depth):
+        """The scan's own decode pipeline: prefetched file decode ->
+        upload-unit accumulation (optionally on its own pipeline
+        stage).  Every decoded item ticks the tapped decode counter —
+        THE evidence shared/cached executions decode nothing."""
+
+        def _counted(gen):
+            from spark_rapids_tpu.serving.work_share import (
+                record_scan_decode,
+            )
+
+            for item in gen:
+                record_scan_decode(
+                    item if isinstance(item, int) else item.num_rows)
+                yield item
 
         def task():
             import os
@@ -697,7 +747,8 @@ class ParquetScanExec(TpuExec):
             threads = min(conf.get(SCAN_DECODE_THREADS), len(files))
             if threads <= 1 or big:
                 for fi in files:
-                    yield from self._file_tables(fi, conjuncts)
+                    yield from _counted(self._file_tables(fi,
+                                                          conjuncts))
                 return
             # per-file decode pool with a bounded in-flight window (the
             # MultiFileCloud reader shape): file k+threads starts while
@@ -705,7 +756,8 @@ class ParquetScanExec(TpuExec):
             from concurrent.futures import ThreadPoolExecutor
 
             def decode(fi):
-                return list(self._file_tables(fi, conjuncts))
+                return list(_counted(self._file_tables(fi,
+                                                       conjuncts)))
 
             with ThreadPoolExecutor(max_workers=threads) as pool:
                 pending = []
@@ -723,9 +775,6 @@ class ParquetScanExec(TpuExec):
 
         from spark_rapids_tpu.parallel import pipeline as P
 
-        depth = getattr(self, "_pipeline_depth", None)
-        if depth is None:
-            depth = P.stage_depth(conf)
         units = self._upload_units(
             _prefetched(task(), stage="scan.decode", depth=depth))
         if depth:
@@ -734,26 +783,98 @@ class ParquetScanExec(TpuExec):
             # device compute; units are host tables (no device
             # residency crosses the stage queue)
             units = P.prefetch(units, depth=depth, stage="scan.upload")
+        return units
+
+    def _empty_scan_batch(self) -> ColumnarBatch:
+        aschema = schema_to_arrow(self._schema)
+        return from_arrow(pa.Table.from_arrays(
+            [pa.array([], fl.type) for fl in aschema],
+            schema=aschema))
+
+    def _drain_units(self, units, p: int, share=None,
+                     skip: int = 0) -> Iterator[ColumnarBatch]:
+        """Upload-and-yield loop over upload units.  As the LEADER of
+        a shared scan (`share` set), every unit is published for
+        subscribers — plain decoded device batches ride along so
+        overlapping consumers skip their own upload; wire-form
+        EncodedBatches never do (donation bookkeeping makes them
+        mutable).  `skip` replays a deterministic prefix without
+        re-uploading it (the subscriber-fallback path: those batches
+        were already served from the aborted share entry)."""
         empty = True
-        for unit in units:
-            empty = False
-            # scanTime: host-unit -> device-batch (encode + upload
-            # dispatch, settled when the device work completes) — the
-            # reference's GpuScan scan-time metric; the decode wait
-            # ahead of it lives on the scan.decode pipeline stage
-            with MetricTimer(self.metrics["scanTime"],
-                             op=self.name) as t:
-                if isinstance(unit, int):
-                    b = ColumnarBatch([], unit, self._schema)
+        completed = False
+        try:
+            for i, unit in enumerate(units):
+                empty = False
+                if i < skip:
+                    continue
+                # scanTime: host-unit -> device-batch (encode + upload
+                # dispatch, settled when the device work completes) —
+                # the reference's GpuScan scan-time metric; the decode
+                # wait ahead of it lives on the scan.decode stage
+                with MetricTimer(self.metrics["scanTime"],
+                                 op=self.name) as t:
+                    if isinstance(unit, int):
+                        b = ColumnarBatch([], unit, self._schema)
+                    else:
+                        b = t.observe(self._upload(unit))
+                if share is not None:
+                    share.publish(
+                        unit, b if type(b) is ColumnarBatch else None)
+                yield self._count_output(b)
+            completed = True
+        finally:
+            if share is not None:
+                from spark_rapids_tpu.serving import work_share as _ws
+
+                if completed:
+                    share.complete()
                 else:
-                    b = t.observe(self._upload(unit))
-            yield self._count_output(b)
-        if empty and p == 0:
-            aschema = schema_to_arrow(self._schema)
-            yield self._count_output(
-                from_arrow(pa.Table.from_arrays(
-                    [pa.array([], fl.type) for fl in aschema],
-                    schema=aschema)))
+                    # died or was abandoned mid-stream: wake the
+                    # subscribers so they fall back to their own
+                    # decode instead of waiting forever
+                    share.abort()
+                _ws.SCAN_REGISTRY.release(share)
+        if empty and skip == 0 and p == 0:
+            yield self._count_output(self._empty_scan_batch())
+
+    def _subscribe_shared(self, share, p: int, conf, conjuncts,
+                          depth) -> Iterator[ColumnarBatch]:
+        """Ride another query's identical scan: replay its buffered
+        upload units (and, while in flight, its uploaded device
+        batches), then follow live.  If the leader aborts mid-stream,
+        fall back to a local decode, skipping the deterministic
+        prefix already served."""
+        from spark_rapids_tpu.serving import work_share as _ws
+
+        _ws.tick("scan_subscribes")
+        consumed = 0
+        aborted = False
+        try:
+            for unit, dev in share.subscribe_units():
+                with MetricTimer(self.metrics["scanTime"],
+                                 op=self.name) as t:
+                    if dev is not None:
+                        _ws.tick("scan_upload_shared")
+                        b = dev
+                    elif isinstance(unit, int):
+                        b = ColumnarBatch([], unit, self._schema)
+                    else:
+                        b = t.observe(self._upload(unit))
+                _ws.tick("scan_units_shared")
+                consumed += 1
+                yield self._count_output(b)
+        except _ws.ScanShareAborted:
+            aborted = True
+        finally:
+            _ws.SCAN_REGISTRY.release(share)
+        if aborted:
+            yield from self._drain_units(
+                self._local_units(conf, conjuncts, p, depth), p,
+                skip=consumed)
+            return
+        if consumed == 0 and p == 0:
+            yield self._count_output(self._empty_scan_batch())
 
 
 class OrcScanExec(ParquetScanExec):

@@ -82,6 +82,19 @@ Rules
   the batch consumed) is exempt by construction — that is the
   spelling engine code is supposed to use.  Intentional raw sites,
   if any ever appear, are baselined, not suppressed inline.
+- SRC011 (error): direct mutation of a shared-cache object in a
+  serving-path module (serving/, execs/, io/).  Cross-tenant work
+  sharing (serving/work_share.py, docs/work_sharing.md) hands the
+  SAME objects — a shared scan's published units and device batches
+  (``subscribe_units``), a cached query result (``lookup_result``) —
+  to every concurrent consumer: an in-place mutation (item/attribute
+  assignment, ``append``/``update``/``sort``/... on the object or
+  anything reached through it) corrupts OTHER tenants' in-flight
+  queries and the cache itself.  Consumers must copy-on-write or
+  re-materialize.  Taint is local-name based (assignments from the
+  accessor calls, loop targets iterating them, and propagation
+  through attribute/subscript reads); serving/work_share.py — the
+  cache's own bookkeeping — is exempt by construction.
 - SRC009 (error): raw ``jax.jit`` in an exec or ops module (execs/,
   ops/) bypassing ``execs/jit_cache.cached_jit``.  Every program the
   engine compiles is supposed to flow through the structural-key
@@ -716,6 +729,131 @@ class _UseAfterDonateChecker(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
 
 
+#: SRC011: accessor calls whose results are SHARED cache objects
+#: (serving/work_share.py) — every concurrent consumer sees the same
+#: Python objects, so mutating them corrupts other tenants' queries
+_SHARED_ACCESSORS = {"subscribe_units", "lookup_result"}
+#: method names that mutate their receiver in place
+_MUTATOR_METHODS = {"append", "extend", "insert", "pop", "remove",
+                    "clear", "update", "sort", "reverse",
+                    "setdefault", "popitem", "add", "discard"}
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    """The root Name of an attribute/subscript chain
+    (``x.cols[0].data`` -> ``x``), or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _SharedMutationChecker(ast.NodeVisitor):
+    """SRC011: in-place mutation of shared-cache objects (see module
+    doc).  Per function: pass 1 collects tainted local names —
+    assignments from the shared accessors, loop targets iterating
+    them, and propagation through plain / attribute / subscript
+    reads; pass 2 flags item/attribute assignment, ``del``, augmented
+    assignment, and mutator-method calls whose receiver chain roots
+    in a tainted name.  Conservative within one function body (taint
+    is not flow-sensitive): shared-cache consumers are expected to
+    copy before touching, which never taints."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+
+    # -- pass 1: taint ---------------------------------------------- #
+
+    @staticmethod
+    def _names_in_target(t: ast.expr) -> list[str]:
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out = []
+            for e in t.elts:
+                out.extend(_SharedMutationChecker._names_in_target(e))
+            return out
+        return []
+
+    @staticmethod
+    def _is_shared_source(v: ast.expr, tainted: set) -> bool:
+        if isinstance(v, ast.Call):
+            return _terminal_name(v.func) in _SHARED_ACCESSORS
+        return _base_name(v) in tainted
+
+    def _collect_taint(self, fn: ast.FunctionDef) -> set:
+        tainted: set = set()
+        # iterate to a fixpoint so `b = dev; c = b.columns` taints c
+        # regardless of statement visit order (bounded: names only
+        # ever get ADDED)
+        while True:
+            before = len(tainted)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if self._is_shared_source(node.value, tainted):
+                        for t in node.targets:
+                            tainted.update(self._names_in_target(t))
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    if self._is_shared_source(node.iter, tainted):
+                        tainted.update(
+                            self._names_in_target(node.target))
+            if len(tainted) == before:
+                return tainted
+
+    # -- pass 2: mutations ------------------------------------------ #
+
+    def _flag(self, name: str, node: ast.AST, what: str) -> None:
+        self.out.append(Diagnostic(
+            "SRC011", "error", self.path,
+            f"{what} mutates `{name}`, a shared-cache object "
+            "(serving/work_share.py) — other tenants' in-flight "
+            "queries and the cache itself see the same Python "
+            "object, so in-place mutation corrupts their results",
+            hint="cached results are immutable by contract: copy "
+                 "first (table.combine_chunks(), list(...), a fresh "
+                 "batch) or re-materialize, then mutate the copy "
+                 "(docs/work_sharing.md)",
+            line=getattr(node, "lineno", 0)))
+
+    def _check_function(self, fn: ast.FunctionDef) -> None:
+        tainted = self._collect_taint(fn)
+        if not tainted:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        name = _base_name(t)
+                        if name in tainted:
+                            self._flag(name, node,
+                                       "item/attribute assignment")
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target,
+                              (ast.Attribute, ast.Subscript)):
+                    name = _base_name(node.target)
+                    if name in tainted:
+                        self._flag(name, node, "augmented assignment")
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        name = _base_name(t)
+                        if name in tainted:
+                            self._flag(name, node, "del")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATOR_METHODS:
+                name = _base_name(node.func.value)
+                if name in tainted:
+                    self._flag(name, node,
+                               f"`.{node.func.attr}()`")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_function(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
 #: handler-body calls that prove the exception was CLASSIFIED before
 #: being absorbed (the execs/retry gate + the fault-accounting hooks)
 _CLASSIFY_CALLS = {"classify", "is_retryable", "should_cpu_fallback",
@@ -826,6 +964,18 @@ def _is_program_module(path: str) -> bool:
     return "execs" in parts or "ops" in parts
 
 
+def _is_sharing_module(path: str) -> bool:
+    """SRC011 scope: the layers that consume shared-cache objects
+    (the serving tier, exec stream loops, the scan subscribers).
+    serving/work_share.py IS the cache — its own bookkeeping mutates
+    its own lists by construction — so it is exempt."""
+    norm = path.replace("\\", "/")
+    if norm.endswith("serving/work_share.py"):
+        return False
+    parts = norm.split("/")
+    return any(p in parts for p in ("serving", "execs", "io"))
+
+
 def _is_recovery_module(path: str) -> bool:
     """SRC008 scope: the layers whose exceptions feed the recovery
     ladder.  execs/retry.py IS the classification gate — exempt."""
@@ -861,6 +1011,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _UseAfterDonateChecker(path, out).visit(tree)
     if _is_recovery_module(path):
         _SwallowChecker(path, out).visit(tree)
+    if _is_sharing_module(path):
+        _SharedMutationChecker(path, out).visit(tree)
     return out
 
 

@@ -58,6 +58,12 @@ class SpillPriorities:
 
     OUTPUT_FOR_SHUFFLE = -100
     COALESCE_PENDING = 0
+    #: cross-tenant shared-result entries (serving/work_share.py):
+    #: pure cache — always rebuildable by re-running the query, and
+    #: entirely host/disk-tier (Arrow-IPC frames, never device
+    #: buffers) — so they yield host memory before any working data
+    #: does; the disk hop is their designed pressure valve
+    SHARED_RESULT = 10
     #: cached (df.cache) batches are re-served across queries but are
     #: rebuildable by re-running the subtree: spill them before the
     #: working set of the running query

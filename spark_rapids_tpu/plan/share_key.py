@@ -1,0 +1,229 @@
+"""Subplan-hash extraction: the keying substrate of cross-tenant work
+sharing (serving/work_share.py, docs/work_sharing.md).
+
+The reference amortizes device work across a fleet of tasks through
+shared, content-addressed storage (the tiered device store keyed by
+buffer id, shuffle blocks keyed by (shuffle, map, reduce)).  The
+serving-tier mirror needs the same property one level up: a QUERY
+(or any subtree of one) must have a deterministic, content-complete
+identity so two tenants presenting the same work can share one
+execution.  This module mints those identities:
+
+- :func:`plan_share_key` — the result-cache key for a logical plan:
+  the structural plan serialization (serving/plan_cache.py — node
+  classes + every attribute, expressions via the jit_cache structural
+  keys, in-memory tables by CONTENT digest) crossed with the conf
+  fingerprint (lowering reads conf, so two conf epochs must never
+  share a result), hashed.  ``None`` when the plan is not shareable.
+- :func:`plan_is_shareable` — the determinism gate: only plans built
+  from pure relational nodes over pure expressions may share results.
+  Nondeterministic expressions (rand, monotonically_increasing_id,
+  partition ids), opaque host callables (pandas/arrow UDFs — their
+  structural key is identity-based and proves nothing about behavior)
+  and mutable-state nodes (df.cache slots) are excluded: serving a
+  cached result for those could answer a DIFFERENT computation.
+- :func:`plan_source_digests` — the file-content fingerprint of every
+  file relation in the plan ((path, size, mtime_ns) STAT triples, not
+  byte hashes — hashing every input at every lookup would cost the
+  scan sharing exists to save; see docs/work_sharing.md for the
+  coarse-mtime caveat): the invalidation token.  The structural key
+  pins WHICH files a plan reads; the fingerprints pin what was IN
+  them when the result was produced, and a mismatch at lookup time
+  invalidates the entry (in-memory tables need no token — their
+  content digest is already part of the structural key, and Arrow
+  tables are immutable).
+- :func:`iter_shareable_subplans` — every shareable subtree with its
+  key, root first: the subplan enumeration the result cache keys by
+  (today the cache serves whole-plan hits — a dashboard fleet issues
+  the same full query — and scan-level sharing reuses the relation
+  subtree identity through :func:`scan_share_key`).
+- :func:`scan_share_key` — the in-flight scan-dedup key for one scan
+  exec partition: the relation subtree identity (paths + content
+  digests + read columns + partition values) crossed with everything
+  that shapes the decoded unit stream (pushed-filter structural key,
+  prefilter mode, batch rows, upload-suppression set, wire form) and
+  the conf fingerprint.  Two queries holding the same key provably
+  produce byte-identical unit streams, so the second may ride the
+  first's decode.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Iterator, Optional
+
+from spark_rapids_tpu.plan import logical as L
+
+
+def _source_stat(path: str) -> tuple[str, int, int]:
+    st = os.stat(path)
+    return (path, st.st_size, st.st_mtime_ns)
+
+
+def plan_source_digests(plan: L.LogicalPlan) -> list[tuple]:
+    """(path, size, mtime_ns) for every file the plan reads, in
+    deterministic order — the content token verified at lookup time.
+    Raises OSError when a file vanished (callers treat that as
+    unshareable)."""
+    out: list[tuple] = []
+
+    def walk(p: L.LogicalPlan) -> None:
+        if isinstance(p, (L.ParquetRelation, L.OrcRelation,
+                          L.CsvRelation)):
+            for path in p.paths:
+                out.append(_source_stat(path))
+        for c in p.children:
+            walk(c)
+
+    walk(plan)
+    return sorted(out)
+
+
+#: logical nodes whose execution is a pure function of (inputs, conf).
+#: Anything outside this set keeps mutable state (Cached slots) or runs
+#: opaque host callables (pandas/arrow UDF nodes) — never shared.
+_PURE_NODES = (
+    L.InMemoryRelation, L.ParquetRelation, L.OrcRelation,
+    L.CsvRelation, L.RangeRel, L.Project, L.Filter, L.Aggregate,
+    L.Sort, L.Limit, L.Join, L.Union, L.Window, L.Expand, L.Generate,
+)
+
+
+def _node_exprs(p: L.LogicalPlan) -> list:
+    if isinstance(p, L.Project):
+        return list(p.exprs)
+    if isinstance(p, L.Filter):
+        return [p.condition]
+    if isinstance(p, L.Aggregate):
+        out = list(p.groups)
+        for na in p.aggs:
+            out.extend(na.fn.inputs())
+        return out
+    if isinstance(p, L.Sort):
+        return [k.expr for k in p.keys]
+    if isinstance(p, L.Join):
+        out = list(p.left_keys) + list(p.right_keys)
+        if p.condition is not None:
+            out.append(p.condition)
+        return out
+    if isinstance(p, L.Window):
+        return [e for we, _n in p.window_exprs for e in we.children]
+    if isinstance(p, L.Expand):
+        return [e for proj in p.projections for e in proj]
+    if isinstance(p, L.Generate):
+        return [p.generator.child]
+    return []
+
+
+def _expr_is_pure(e) -> bool:
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        tree_is_partition_aware,
+    )
+    from spark_rapids_tpu.exprs.subquery import ScalarSubquery
+
+    if tree_is_partition_aware(e):
+        return False
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        # opaque host callables: their structural key is id()-based
+        # (serving/plan_cache._value_key) and says nothing about what
+        # the function computes — a recycled id could alias a cached
+        # result onto a different function
+        if type(x).__module__.endswith("udf.exprs"):
+            return False
+        if isinstance(x, ScalarSubquery):
+            if not plan_is_shareable(x.plan):
+                return False
+        stack.extend(x.children)
+    return True
+
+
+def plan_is_shareable(plan: L.LogicalPlan) -> bool:
+    """True when the plan's RESULT is a pure function of its inputs'
+    content and the conf — the precondition for serving a cached
+    result (see module doc for what is excluded and why)."""
+    if not isinstance(plan, _PURE_NODES):
+        return False
+    for e in _node_exprs(plan):
+        if not _expr_is_pure(e):
+            return False
+    return all(plan_is_shareable(c) for c in plan.children)
+
+
+def _digest(payload: str) -> str:
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def plan_share_key(plan: L.LogicalPlan, conf) -> Optional[str]:
+    """The result-cache key: structural plan identity x conf
+    fingerprint (None when the plan is not shareable).  File CONTENT
+    is deliberately NOT part of the key — it is the invalidation
+    token (:func:`plan_source_digests`), verified at lookup, so a
+    mutated input observably invalidates the stale entry instead of
+    silently orphaning it under a new key."""
+    if not plan_is_shareable(plan):
+        return None
+    from spark_rapids_tpu.eventlog import conf_fingerprint
+    from spark_rapids_tpu.serving.plan_cache import plan_structural_key
+
+    try:
+        structural = plan_structural_key(plan)
+    except Exception:
+        return None  # unserializable attribute: never guess a key
+    return _digest(structural + "|" + conf_fingerprint(conf))
+
+
+def iter_shareable_subplans(plan: L.LogicalPlan,
+                            conf) -> Iterator[tuple[str,
+                                                    L.LogicalPlan]]:
+    """(key, subplan) for every shareable subtree, root first in
+    pre-order — the subplan enumeration work sharing keys by.  A
+    subtree inside an unshareable parent still enumerates: the parent
+    cannot share its result, but the subtree's identity remains valid
+    (scan-level sharing rides exactly this)."""
+    key = plan_share_key(plan, conf)
+    if key is not None:
+        yield key, plan
+    for c in plan.children:
+        yield from iter_shareable_subplans(c, conf)
+
+
+def scan_share_key(scan, partition: int, conf) -> Optional[str]:
+    """The in-flight scan-dedup key for one ParquetScanExec/OrcScanExec
+    task partition (see module doc).  None when the scan's unit stream
+    is not provably deterministic-and-identical across queries:
+    runtime filters registered (their publication time is
+    query-dependent), or a pushed filter with no structural key."""
+    if getattr(scan, "runtime_filters", None):
+        return None
+    from spark_rapids_tpu.eventlog import conf_fingerprint
+
+    parts: list[str] = [type(scan).__name__, str(partition)]
+    try:
+        for p in scan.paths:
+            parts.append(repr(_source_stat(p)))
+    except OSError:
+        return None
+    parts.append(repr(scan.columns))
+    parts.append(repr(scan.batch_rows))
+    parts.append(repr(scan.partition_values))
+    parts.append(repr([(f.name, f.dtype.name)
+                       for f in scan.partition_fields]))
+    pushed = getattr(scan, "pushed_filter", None)
+    if pushed is not None:
+        from spark_rapids_tpu.execs.jit_cache import expr_key
+
+        try:
+            parts.append(expr_key(pushed))
+        except Exception:
+            return None  # no structural form: never guess
+    else:
+        parts.append("-")
+    parts.append(repr(bool(getattr(scan, "exact_prefilter", False))))
+    parts.append(repr(sorted(getattr(scan, "null_upload_cols", None)
+                             or ())))
+    parts.append(repr(bool(getattr(scan, "emit_encoded", False))))
+    parts.append(conf_fingerprint(conf))
+    return _digest("|".join(parts))

@@ -75,6 +75,18 @@ PLAN_CACHE_CAPACITY = register(
     "in-memory tables), so the bound is a memory bound too.",
     check=lambda v: v >= 1)
 
+BATCHING_ENABLED = register(
+    "spark.rapids.tpu.serving.batching.enabled", True,
+    "Admission-aware batching (docs/work_sharing.md): when granting "
+    "slots, the scheduler prefers queued queries whose template group "
+    "(the prepared-statement identity, independent of parameter "
+    "bindings) matches one already running — compatible plans run "
+    "together, so the work-sharing tier's in-flight scan dedup and "
+    "result cache engage instead of the same scan being paid once per "
+    "slot generation.  A deliberate, bounded throughput-over-strict-"
+    "WFQ-order tradeoff; disable for strict weighted-fair order.  "
+    "Inert unless serving.maxConcurrent > 0.")
+
 ADMIT_WAIT_BUDGET_MS = register(
     "spark.rapids.tpu.serving.health.admitWaitBudgetMs", 250.0,
     "Admission-wait budget per query for the HC009 health rule "

@@ -26,9 +26,12 @@ whole LOWERED exec tree is the cached object, keyed by
 Exec trees are re-drainable by construction (close() returns join
 builds / shuffle registrations to their pre-execute state — asserted by
 tests/test_serving.py), so a hit simply re-drains the cached tree.
-Operator metrics on a cached tree accumulate across executions (the
-tree IS the long-lived object); per-execution attribution lives in
-wall_s and the event log's counter deltas.
+Operator metrics on the LIVE cached tree accumulate across executions
+(the tree is the long-lived object), but every record derived from a
+re-drain — explain("analyze"), the history event, the event-log
+operator tree — reports per-EXECUTION deltas: the collect paths
+snapshot the settled pre-drain totals and subtract
+(session._collect_tpu_admitted / tools.profiling.snapshot_delta).
 
 Eviction: LRU bounded by ``spark.rapids.tpu.serving.planCache.capacity``
 — entries pin their source data (ArrowSourceExec tables), so the bound
@@ -67,6 +70,23 @@ def _value_key(v: Any, seen: dict) -> str:
             return expr_key(v)
         except TypeError:
             return repr(v)
+    from spark_rapids_tpu.exprs.aggregates import (
+        AggregateFunction,
+        NamedAgg,
+    )
+
+    if isinstance(v, NamedAgg):
+        return (f"NamedAgg({_value_key(v.fn, seen)},"
+                f"{v.out_name!r})")
+    if isinstance(v, AggregateFunction):
+        # no custom __repr__: the default falls back to the object
+        # address, which would mint a fresh key per plan INSTANCE and
+        # defeat every structural-identity consumer (prepared-plan
+        # cache across template objects, the cross-tenant result
+        # cache) — serialize class + attributes instead
+        parts = [f"{k}={_value_key(x, seen)}"
+                 for k, x in sorted(vars(v).items())]
+        return f"{type(v).__name__}({','.join(parts)})"
     if isinstance(v, LogicalPlan):
         return plan_structural_key(v, seen)
     if isinstance(v, pa.Table):

@@ -52,6 +52,10 @@ class PreparedQuery:
         #: key digests in-memory tables; recomputing it per execute
         #: would re-hash the data every time
         self._key_memo: dict = {}
+        #: conf_fingerprint -> binding-INDEPENDENT template key (the
+        #: admission-batching group: same template, different
+        #: bindings, one group)
+        self._group_memo: dict = {}
         self.last_plan_hash: Optional[str] = None
 
     # -- resolution -------------------------------------------------- #
@@ -72,6 +76,29 @@ class PreparedQuery:
         if len(self._key_memo) > 64:
             self._key_memo.clear()
         self._key_memo[(fp, binding)] = key
+        return key
+
+    def _group_key(self, conf) -> str:
+        """The binding-independent template identity this query admits
+        under: admission-aware batching (serving/scheduler.py) grants
+        queued queries sharing it together, so their scans overlap and
+        dedup in flight (docs/work_sharing.md).  SQL templates key on
+        normalized text x conf (bindings excluded — 'same template,
+        different bindings' is exactly the compatible-plan class);
+        DataFrame templates on their structural plan key x conf."""
+        from spark_rapids_tpu.eventlog import conf_fingerprint
+
+        fp = conf_fingerprint(conf)
+        memo = self._group_memo.get(fp)
+        if memo is not None:
+            return memo
+        if self._sql_text is not None:
+            key = sql_template_key(self._sql_text, conf, None)
+        else:
+            key = template_key(self._df._plan, conf)
+        if len(self._group_memo) > 64:
+            self._group_memo.clear()
+        self._group_memo[fp] = key
         return key
 
     def _resolve(self, params: Optional[dict]) -> tuple:
@@ -124,7 +151,10 @@ class PreparedQuery:
         out, _qid = entry.df._collect_tpu(
             exec_=entry.exec_, meta=entry.meta,
             drain_lock=entry.lock,
-            serving_facts={"plan_cache": "hit" if hit else "miss"})
+            serving_facts={
+                "plan_cache": "hit" if hit else "miss",
+                "admission_group":
+                    self._group_key(self._session.conf)})
         return out
 
     def execute_stream(self, params: Optional[dict] = None,
@@ -141,7 +171,10 @@ class PreparedQuery:
         yield from entry.df._stream_tpu(
             exec_=entry.exec_, meta=entry.meta,
             batch_rows=batch_rows, drain_lock=entry.lock,
-            serving_facts={"plan_cache": "hit" if hit else "miss"})
+            serving_facts={
+                "plan_cache": "hit" if hit else "miss",
+                "admission_group":
+                    self._group_key(self._session.conf)})
 
     # -- introspection ----------------------------------------------- #
 

@@ -52,6 +52,7 @@ from typing import Optional
 
 from spark_rapids_tpu import trace as _tr
 from spark_rapids_tpu.serving import (
+    BATCHING_ENABLED,
     DEFAULT_PRIORITY,
     MAX_CONCURRENT,
     QUEUE_DEPTH,
@@ -76,35 +77,46 @@ class _Tenant:
 
 
 class _Entry:
-    __slots__ = ("tenant", "priority", "vtime", "seq", "granted")
+    __slots__ = ("tenant", "priority", "vtime", "seq", "granted",
+                 "group")
 
     def __init__(self, tenant: str, priority: int, vtime: float,
-                 seq: int):
+                 seq: int, group: Optional[str] = None):
         self.tenant = tenant
         self.priority = priority
         self.vtime = vtime
         self.seq = seq
         self.granted = False
+        #: template-group key for admission-aware batching
+        #: (docs/work_sharing.md): queued entries sharing a group with
+        #: a RUNNING query are granted preferentially, so compatible
+        #: plans overlap and their scans dedup in flight
+        self.group = group
 
 
 class QueryScheduler:
     """One device's admission scheduler (see module doc)."""
 
     def __init__(self, max_concurrent: int, queue_depth: int,
-                 default_priority: int = 1):
+                 default_priority: int = 1, batching: bool = True):
         self.max_concurrent = int(max_concurrent)
         self.queue_depth = int(queue_depth)
         self.default_priority = int(default_priority)
+        self.batching = bool(batching)
         self._cv = threading.Condition()
         self._running = 0
         self._waiting: list[_Entry] = []
         self._tenants: dict[str, _Tenant] = {}
+        #: group -> count of RUNNING queries carrying it (the
+        #: batching preference's membership test)
+        self._running_groups: dict[str, int] = {}
         self._vclock = 0.0
         self._seq = 0
         # stats (under _cv): totals + a bounded ring of recent waits so
         # p50/p99 stay O(1) memory on a long-lived server
         self._admitted = 0
         self._rejected = 0
+        self._coalesced = 0
         self._total_wait_ms = 0.0
         self._waits_ms: deque = deque(maxlen=4096)
 
@@ -128,22 +140,53 @@ class QueryScheduler:
         times were assigned at ENQUEUE (each tenant's clock advances
         1/priority per queued request), so a burst from one tenant
         interleaves with other tenants' queued work instead of
-        draining FIFO."""
+        draining FIFO.
+
+        Admission-aware batching (serving.batching.enabled): a
+        waiting entry whose template group is already RUNNING is
+        granted ahead of strict WFQ order — compatible plans then
+        execute together and the work-sharing tier dedups their scans
+        in flight (docs/work_sharing.md).  Bounded unfairness: the
+        preference only ever reorders against live groups, and each
+        coalesced grant still consumes a slot, so ungrouped tenants
+        advance as slots free."""
         limit = self._limit()
         while self._running < limit and self._waiting:
-            nxt = min(self._waiting,
-                      key=lambda e: (e.vtime, e.seq))
+            nxt = None
+            if self.batching and self._running_groups:
+                cands = [e for e in self._waiting
+                         if e.group and e.group in self._running_groups]
+                if cands:
+                    nxt = min(cands, key=lambda e: (e.vtime, e.seq))
+                    self._coalesced += 1
+            if nxt is None:
+                nxt = min(self._waiting,
+                          key=lambda e: (e.vtime, e.seq))
             self._waiting.remove(nxt)
             nxt.granted = True
             self._running += 1
+            if nxt.group:
+                self._running_groups[nxt.group] = \
+                    self._running_groups.get(nxt.group, 0) + 1
             self._vclock = max(self._vclock, nxt.vtime)
         self._cv.notify_all()
 
+    def _drop_running_locked(self, entry: _Entry) -> None:
+        self._running -= 1
+        if entry.group:
+            n = self._running_groups.get(entry.group, 0) - 1
+            if n <= 0:
+                self._running_groups.pop(entry.group, None)
+            else:
+                self._running_groups[entry.group] = n
+
     def admit(self, tenant: str = "default",
-              priority: Optional[int] = None) -> _Entry:
+              priority: Optional[int] = None,
+              group: Optional[str] = None) -> _Entry:
         """Block until this query is admitted (or raise
         :class:`AdmissionRejected` when the queue is full).  Returns
-        the ticket to hand back to :meth:`release`."""
+        the ticket to hand back to :meth:`release`.  `group` is the
+        optional template-group key batching coalesces on."""
         prio = int(priority) if priority is not None \
             else self.default_priority
         t0 = time.perf_counter_ns()
@@ -165,7 +208,8 @@ class QueryScheduler:
                     f"tenant={tenant!r}")
             self._seq += 1
             entry = _Entry(tenant, prio,
-                           max(te.vtime, self._vclock), self._seq)
+                           max(te.vtime, self._vclock), self._seq,
+                           group=group)
             # advance the tenant clock AT ENQUEUE: its next request
             # starts 1/priority later in virtual time, which is what
             # spaces a burst out against other tenants' queued work
@@ -184,7 +228,7 @@ class QueryScheduler:
                 if entry in self._waiting:
                     self._waiting.remove(entry)
                 elif entry.granted:
-                    self._running -= 1
+                    self._drop_running_locked(entry)
                     self._pump_locked()
                 raise
             dt_ns = (time.perf_counter_ns() - t0) if waited else 0
@@ -203,7 +247,7 @@ class QueryScheduler:
 
     def release(self, entry: _Entry) -> None:
         with self._cv:
-            self._running -= 1
+            self._drop_running_locked(entry)
             self._pump_locked()
 
     # -- stats ------------------------------------------------------- #
@@ -222,6 +266,7 @@ class QueryScheduler:
             out = {
                 "admitted": self._admitted,
                 "rejected": self._rejected,
+                "coalesced": self._coalesced,
                 "running": self._running,
                 "waiting": len(self._waiting),
                 "total_wait_ms": round(self._total_wait_ms, 3),
@@ -234,6 +279,7 @@ class QueryScheduler:
         with self._cv:
             self._admitted = 0
             self._rejected = 0
+            self._coalesced = 0
             self._total_wait_ms = 0.0
             self._waits_ms.clear()
 
@@ -257,17 +303,21 @@ def get_scheduler(conf=None) -> QueryScheduler:
     want_max = int(conf.get(MAX_CONCURRENT))
     want_depth = int(conf.get(QUEUE_DEPTH))
     want_prio = int(conf.get(DEFAULT_PRIORITY))
+    want_batch = bool(conf.get(BATCHING_ENABLED))
     with _LOCK:
         if _SCHED is None:
-            _SCHED = QueryScheduler(want_max, want_depth, want_prio)
+            _SCHED = QueryScheduler(want_max, want_depth, want_prio,
+                                    batching=want_batch)
             return _SCHED
         s = _SCHED
-    if (s.max_concurrent, s.queue_depth, s.default_priority) != \
-            (want_max, want_depth, want_prio):
+    if (s.max_concurrent, s.queue_depth, s.default_priority,
+            s.batching) != (want_max, want_depth, want_prio,
+                            want_batch):
         with s._cv:
             s.max_concurrent = want_max
             s.queue_depth = want_depth
             s.default_priority = want_prio
+            s.batching = want_batch
             s._pump_locked()
     return s
 
@@ -288,8 +338,9 @@ def scheduler_stats() -> dict:
     with _LOCK:
         s = _SCHED
     return s.stats() if s is not None else {
-        "admitted": 0, "rejected": 0, "running": 0, "waiting": 0,
-        "total_wait_ms": 0.0, "wait_p50_ms": 0.0, "wait_p99_ms": 0.0}
+        "admitted": 0, "rejected": 0, "coalesced": 0, "running": 0,
+        "waiting": 0, "total_wait_ms": 0.0, "wait_p50_ms": 0.0,
+        "wait_p99_ms": 0.0}
 
 
 def reset() -> None:
@@ -302,13 +353,16 @@ def reset() -> None:
 
 @contextmanager
 def admission(conf, tenant: str = "default",
-              priority: Optional[int] = None):
+              priority: Optional[int] = None,
+              group: Optional[str] = None):
     """The query-boundary hook: a no-op single conf read when serving
     admission is disabled (maxConcurrent <= 0); otherwise admit through
     the process scheduler for the duration of the block.  Re-entrant
     per thread — a nested collect on an admitted thread (scalar
     subquery prepass, CPU-compare runs inside an admitted bench driver)
-    passes straight through instead of deadlocking against itself."""
+    passes straight through instead of deadlocking against itself.
+    `group` (optional, the prepared template's binding-independent
+    key) feeds admission-aware batching."""
     if int(conf.get(MAX_CONCURRENT)) <= 0:
         try:
             yield None
@@ -338,7 +392,7 @@ def admission(conf, tenant: str = "default",
                 update_serving_context(**outer_ctx)
         return
     sched = get_scheduler(conf)
-    ticket = sched.admit(tenant, priority)
+    ticket = sched.admit(tenant, priority, group=group)
     tl.depth = 1
     try:
         yield ticket

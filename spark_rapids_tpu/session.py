@@ -462,17 +462,22 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
 
 def _record_query(session: "TpuSession", explain_text: str, exec_tree,
                   qid: int, conf_hash: str, start_ts: float, t0: float,
-                  t0_ns: int, on_event) -> None:
+                  t0_ns: int, on_event, baseline=None) -> None:
     """Per-query epilogue shared by the collect paths: the history
     record with the full clock set (the event-log hook rides
-    `on_event` onto the snapshot worker)."""
+    `on_event` onto the snapshot worker).  `baseline` — a settled
+    pre-drain metric snapshot — makes the record report THIS
+    execution's deltas on a re-drained cached exec tree (the metrics
+    on the long-lived tree itself accumulate); `exec_tree` may be
+    None for executions that ran no operators at all (a result-cache
+    hit)."""
     import time as _time
 
     session.history.record(
         explain_text, exec_tree, _time.perf_counter() - t0,
         query_id=qid, start_ts=start_ts, end_ts=_time.time(),
         start_ns=t0_ns, end_ns=_time.perf_counter_ns(),
-        conf_hash=conf_hash, on_event=on_event)
+        conf_hash=conf_hash, on_event=on_event, baseline=baseline)
 
 
 def _prune_scan_columns(plan, exprs):
@@ -1029,23 +1034,80 @@ class DataFrame:
         `drain_lock` (the cache entry's re-drain lock) is acquired
         INSIDE admission: taking it before would deadlock when an
         admitted query nested-executes the template a waiting thread
-        already locked.  `serving_facts` (the plan-cache verdict) is
-        deposited into the serving context inside the query's
-        admission scope, so a nested query's facts land in ITS record
-        and never pollute the outer query's."""
+        already locked.  `serving_facts` (the plan-cache verdict,
+        plus the binding-independent `admission_group` template key
+        that admission-aware batching coalesces on) is deposited into
+        the serving context inside the query's admission scope, so a
+        nested query's facts land in ITS record and never pollute the
+        outer query's.
+
+        With cross-tenant sharing on (serving.sharing.enabled), the
+        process-wide result cache is consulted INSIDE admission and
+        before the drain lock: a hit returns the cached result with
+        zero plan/lower/compile/scan work, and a completed miss
+        offers its result back (docs/work_sharing.md).  Disabled =
+        one conf read."""
         import contextlib
 
         conf = self._session.conf
         from spark_rapids_tpu.serving import update_serving_context
         from spark_rapids_tpu.serving.scheduler import admission
 
+        facts = dict(serving_facts) if serving_facts else None
+        group = facts.pop("admission_group", None) if facts else None
         with admission(conf, tenant=self._session.tenant,
-                       priority=self._session.priority):
-            if serving_facts:
-                update_serving_context(**serving_facts)
+                       priority=self._session.priority, group=group):
+            if facts:
+                update_serving_context(**facts)
+            from spark_rapids_tpu.serving import work_share as _ws
+
+            sharing = _ws.enabled(conf)
+            if sharing:
+                cached, verdict = _ws.lookup_result(self._plan, conf)
+                if verdict is not None:
+                    update_serving_context(result_cache=verdict)
+                if cached is not None:
+                    return self._result_cache_hit(cached, meta)
             with drain_lock if drain_lock is not None \
                     else contextlib.nullcontext():
-                return self._collect_tpu_admitted(exec_, meta)
+                out, qid = self._collect_tpu_admitted(exec_, meta)
+            if sharing:
+                _ws.offer_result(self._plan, conf, out)
+            return out, qid
+
+    def _result_cache_hit(self, out: pa.Table,
+                          meta) -> tuple[pa.Table, int]:
+        """Serve a collect from the cross-tenant result cache: no exec
+        tree ever exists, but the query still runs the full history/
+        event-log lifecycle (the record carries the real digest and
+        rows, the serving context's result_cache verdict, and a
+        near-zero counter delta) so fleet tooling sees served traffic,
+        not a gap."""
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.eventlog import table_digest
+
+        conf = self._session.conf
+        qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
+            _begin_query(self._session, conf)
+        expl = meta.explain() if meta is not None else \
+            "ResultCacheHit [plan not lowered — served from the " \
+            "cross-tenant result cache]\n"
+
+        def _on_event():
+            if elog is None:
+                return None
+            post = elog.query_end(pre)
+            return lambda ev: elog.log_query(
+                ev, post, expl, "tpu",
+                result_digest=table_digest(out), rows=out.num_rows)
+
+        with _trace.trace_context(query_id=qid):
+            if _trace.TRACER.enabled:
+                _trace.event("serve.result_cache_hit", query_id=qid,
+                             rows=out.num_rows)
+        _record_query(self._session, expl, None, qid, conf_hash,
+                      start_ts, t0, t0_ns, _on_event())
+        return out, qid
 
     def _collect_tpu_admitted(self, exec_=None,
                               meta=None) -> tuple[pa.Table, int]:
@@ -1059,6 +1121,16 @@ class DataFrame:
 
         qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
             _begin_query(self._session, conf)
+        baseline = None
+        if exec_ is not None:
+            # re-draining a CACHED exec tree (the prepared-plan hit
+            # path): its metrics accumulate across executions, so
+            # snapshot the settled pre-drain totals — the history/
+            # event-log record then reports THIS execution's deltas,
+            # not the running total (docs/serving.md)
+            from spark_rapids_tpu.tools.profiling import snapshot_exec
+
+            baseline = snapshot_exec(exec_)
 
         def _on_event(render_plan, engine: str, result):
             """History-worker hook appending the event-log record once
@@ -1114,13 +1186,15 @@ class DataFrame:
                 _record_query(
                     self._session, expl, exec_, qid, conf_hash,
                     start_ts, t0, t0_ns,
-                    _on_event(lambda: expl, "cpu_fallback", out))
+                    _on_event(lambda: expl, "cpu_fallback", out),
+                    baseline=baseline)
                 return out, qid
             _record_query(
                 self._session, meta.explain(), exec_, qid, conf_hash,
                 start_ts, t0, t0_ns,
                 _on_event(lambda: render_plan_report(exec_, meta),
-                          "tpu", out))
+                          "tpu", out),
+                baseline=baseline)
         return out, qid
 
     def _stream_tpu(self, exec_=None, meta=None,
@@ -1145,14 +1219,39 @@ class DataFrame:
         from spark_rapids_tpu.serving.scheduler import admission
 
         conf = self._session.conf
+        facts = dict(serving_facts) if serving_facts else None
+        group = facts.pop("admission_group", None) if facts else None
         with admission(conf, tenant=self._session.tenant,
-                       priority=self._session.priority), \
+                       priority=self._session.priority, group=group), \
                 (drain_lock if drain_lock is not None
                  else contextlib.nullcontext()):
-            if serving_facts:
-                update_serving_context(**serving_facts)
+            if facts:
+                update_serving_context(**facts)
+            from spark_rapids_tpu.serving import work_share as _ws
+
+            if _ws.enabled(conf):
+                cached, verdict = _ws.lookup_result(self._plan, conf)
+                if verdict is not None:
+                    update_serving_context(result_cache=verdict)
+                if cached is not None:
+                    # serve the stream from the cached result: the
+                    # same record-batch surface, the same per-query
+                    # record, zero execution
+                    out, _qid = self._result_cache_hit(cached, meta)
+                    for rb in out.to_batches(max_chunksize=batch_rows):
+                        yield rb
+                    return
             qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
                 _begin_query(self._session, conf)
+            baseline = None
+            if exec_ is not None:
+                # cached-tree re-drain: record per-execution metric
+                # deltas, not the tree's running totals
+                from spark_rapids_tpu.tools.profiling import (
+                    snapshot_exec,
+                )
+
+                baseline = snapshot_exec(exec_)
             with _trace.trace_context(query_id=qid):
                 if exec_ is None:
                     with _trace.span("query.plan"):
@@ -1200,7 +1299,8 @@ class DataFrame:
             _record_query(
                 self._session, meta.explain(), exec_, qid, conf_hash,
                 start_ts, t0, t0_ns,
-                _on_event(lambda: render_plan_report(exec_, meta)))
+                _on_event(lambda: render_plan_report(exec_, meta)),
+                baseline=baseline)
 
     def to_batches(self, batch_rows: Optional[int] = None):
         """Stream the result as Arrow record batches (the ColumnarRdd

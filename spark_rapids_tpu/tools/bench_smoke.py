@@ -35,6 +35,13 @@ a streamed fetch equals collect() to the bit, and two sessions under
 maxConcurrent=1 admission both complete with identical digests
 (tier-1 via tests/test_serving.py).
 
+`run_sharing_smoke` holds the cross-tenant work-sharing contract
+(serving/work_share.py, docs/work_sharing.md): a second session's
+identical parquet-backed template performs ZERO scan decodes (tapped
+counter), its digest is bit-identical to sharing-off and to serial,
+and rewriting the input file invalidates the cached result on the
+content-digest change (tier-1 via tests/test_work_share.py).
+
 Run: python -m spark_rapids_tpu.tools.bench_smoke
 """
 
@@ -381,6 +388,127 @@ def run_serving_smoke() -> dict:
         conf._values.update(base)
         set_conf(conf)
         scheduler_mod.reset()
+    return out
+
+
+def run_sharing_smoke() -> dict:
+    """Cross-tenant work-sharing acceptance contract, cheap CI form
+    (tier-1 via tests/test_work_share.py; docs/work_sharing.md): two
+    sessions execute the same parquet-backed golden template —
+
+    - the second execution performs ZERO scan decodes (the tapped
+      scan_units_decoded counter stays flat: it is served from the
+      process-wide result cache);
+    - its digest is bit-identical to the sharing-off run and to the
+      serial reference (sharing must be invisible in the bytes);
+    - a content-mutation probe rewrites the input file and proves the
+      cache INVALIDATES on digest change: the next execution decodes
+      again and returns the new file's answer."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq_mod
+
+    from spark_rapids_tpu.config import TpuConf, get_conf, set_conf
+    from spark_rapids_tpu.eventlog import table_digest
+    from spark_rapids_tpu.serving import work_share as ws
+    from spark_rapids_tpu.session import TpuSession, col, count_star
+    from spark_rapids_tpu.session import sum_ as _sum
+
+    def _template(session, path):
+        return (session.read_parquet(path)
+                .group_by(col("k"))
+                .agg((_sum(col("v")), "sv"), (count_star(), "n"))
+                .order_by(col("k")))
+
+    def _write(path, seed: int) -> None:
+        rng = np.random.default_rng(seed)
+        n = 8192
+        pq_mod.write_table(pa.table({
+            "k": rng.integers(0, 16, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        }), path)
+
+    out: dict = {}
+    base = dict(get_conf()._values)
+    ws.reset()
+    try:
+        with tempfile.TemporaryDirectory(prefix="share_smoke_") as d:
+            path = os.path.join(d, "t.parquet")
+            _write(path, seed=0x5A5A)
+
+            # serial sharing-off reference: THE ground truth
+            off_conf = TpuConf(base)
+            set_conf(off_conf)
+            d_serial = table_digest(
+                _template(TpuSession(off_conf), path)
+                .collect(engine="tpu"))
+
+            on = dict(base)
+            on["spark.rapids.tpu.serving.sharing.enabled"] = True
+
+            # session 1 (sharing on): decodes + populates the cache
+            c1 = TpuConf(on)
+            set_conf(c1)
+            d1 = table_digest(
+                _template(TpuSession(c1, tenant="a"), path)
+                .collect(engine="tpu"))
+            assert d1 == d_serial, \
+                "sharing-on digest != serial sharing-off digest"
+            st1 = ws.stats()
+            assert st1["scan_units_decoded"] >= 1, st1
+            assert st1["result_inserts"] >= 1, st1
+
+            # session 2, same template: served from the result cache
+            # with ZERO scan decodes (the tapped counter stays flat)
+            c2 = TpuConf(on)
+            set_conf(c2)
+            d2 = table_digest(
+                _template(TpuSession(c2, tenant="b"), path)
+                .collect(engine="tpu"))
+            st2 = ws.stats()
+            assert d2 == d_serial, \
+                "second session's digest != serial digest"
+            assert st2["result_hits"] == st1["result_hits"] + 1, \
+                (st1, st2)
+            assert st2["scan_units_decoded"] == \
+                st1["scan_units_decoded"], (
+                    "result-cache hit decoded scan units", st1, st2)
+            out["sharing_second_exec_decodes"] = (
+                st2["scan_units_decoded"]
+                - st1["scan_units_decoded"])
+            out["sharing_result_hits"] = st2["result_hits"]
+
+            # content-mutation probe: rewrite the file — the stale
+            # entry must invalidate on the digest change, and the
+            # fresh execution must answer for the NEW content
+            _write(path, seed=0xB0B0)
+            set_conf(off_conf)
+            d_serial2 = table_digest(
+                _template(TpuSession(off_conf), path)
+                .collect(engine="tpu"))
+            assert d_serial2 != d_serial, \
+                "mutation probe wrote identical content"
+            set_conf(c2)
+            d3 = table_digest(
+                _template(TpuSession(c2, tenant="b"), path)
+                .collect(engine="tpu"))
+            st3 = ws.stats()
+            assert d3 == d_serial2, \
+                "post-mutation digest != fresh serial digest"
+            assert st3["result_invalidations"] >= 1, st3
+            assert st3["scan_units_decoded"] > \
+                st2["scan_units_decoded"], (
+                    "post-mutation execution did not re-decode", st3)
+            out["sharing_invalidations"] = st3["result_invalidations"]
+    finally:
+        conf = get_conf()
+        conf._values.clear()
+        conf._values.update(base)
+        set_conf(conf)
+        ws.reset()
     return out
 
 
@@ -753,6 +881,7 @@ def main() -> int:
     results.update(run_rf_smoke())
     results.update(run_eventlog_smoke())
     results.update(run_serving_smoke())
+    results.update(run_sharing_smoke())
     results.update(run_ledger_smoke())
     results.update(run_wire_codec_smoke())
     results.update(run_fusion_smoke())

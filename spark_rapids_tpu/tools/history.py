@@ -16,12 +16,14 @@ four analyses operate on one or many of them:
   (CPU fallbacks, retry storms, spill thrash, jit-cache miss-budget
   blowouts, steady-state blocking readbacks, starved pipelines,
   runtime filters that pruned nothing, serving-tier admission waits
-  past the conf budget, dispatch-overhead-dominated queries and
-  attributed rooflines below budget — the last two fed from the
-  device ledger's per-query ``programs`` section).
+  past the conf budget, dispatch-overhead-dominated queries,
+  attributed rooflines below budget — those two fed from the device
+  ledger's per-query ``programs`` section — and cross-tenant
+  result-cache thrash from the work-sharing counter deltas).
 - ``report``   — the fleet-style regression report: one markdown
-  document with run fingerprints, the compare matrix, and per-run
-  health findings.
+  document with run fingerprints, the compare matrix, the
+  work-sharing rollup (when any run engaged the sharing tier), and
+  per-run health findings.
 - ``dot``      — GenerateDot: the recorded plan as annotated graphviz.
 
 CLI::
@@ -120,6 +122,10 @@ class QueryRecord:
     #: device-ledger attribution ({"programs": {...}, "totals": {...}},
     #: trace/ledger.py) — None when the ledger was off for this query
     programs: Optional[dict] = None
+    #: cross-tenant work sharing ({"result_cache": verdict,
+    #: "counters": {...}}, serving/work_share.py) — None when the
+    #: sharing tier never engaged for this query
+    sharing: Optional[dict] = None
 
     def counter(self, key: str, default: float = 0) -> float:
         return self.counters.get(key, default) or 0
@@ -195,6 +201,7 @@ def _query_from_record(rec: dict) -> QueryRecord:
         rows=rec.get("rows"),
         raw=rec,
         programs=rec.get("programs"),
+        sharing=rec.get("sharing"),
     )
 
 
@@ -604,6 +611,35 @@ def _hc_roofline_budget(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_result_cache_thrash(q: QueryRecord) -> Optional[str]:
+    """HC012: cross-tenant result-cache thrash — this query's window
+    evicted more cached results than it served while the hit rate sat
+    under spark.rapids.tpu.serving.resultCache.health.minHitRate: the
+    cache budget is too small for the fleet's working set, so entries
+    churn host/disk bytes without ever amortizing device work.  Fed
+    from the per-query share.* counter deltas the event log records
+    (docs/work_sharing.md); sharing-off fleets carry no deltas and
+    stay silent."""
+    ev = q.counter("share.result_evictions")
+    hits = q.counter("share.result_hits")
+    misses = q.counter("share.result_misses")
+    window = hits + misses
+    if ev <= hits or window <= 0:
+        return None
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.serving.work_share import RESULT_MIN_HIT_RATE
+
+    floor = float(get_conf().get(RESULT_MIN_HIT_RATE))
+    rate = hits / window
+    if rate < floor:
+        return (f"result-cache thrash: {int(ev)} eviction(s) against "
+                f"{int(hits)} hit(s) at a {rate:.2f} hit rate "
+                f"(< {floor}) — the cache budget "
+                "(serving.resultCache.budgetBytes) is too small for "
+                "the fleet's working set (docs/work_sharing.md)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -615,7 +651,8 @@ for _id, _sev, _fn in (
         ("HC008", "info", _hc_recovered_faults),
         ("HC009", "warning", _hc_admission_wait),
         ("HC010", "warning", _hc_dispatch_overhead),
-        ("HC011", "warning", _hc_roofline_budget)):
+        ("HC011", "warning", _hc_roofline_budget),
+        ("HC012", "warning", _hc_result_cache_thrash)):
     register_health_rule(_id, _sev, _fn)
 
 
@@ -702,11 +739,58 @@ def render_health_md(apps: Sequence[ApplicationInfo]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_sharing_md(apps: Sequence[ApplicationInfo]) -> str:
+    """The cross-tenant work-sharing section (docs/work_sharing.md):
+    per run, the result-cache verdict mix and the shared-scan dedup
+    evidence aggregated from each query's share.* counter deltas.
+    Empty string when no run ever engaged the sharing tier, so
+    sharing-off fleets see no section at all."""
+    rows = []
+    for app in apps:
+        agg = {"hits": 0, "misses": 0, "evictions": 0,
+               "invalidations": 0, "units_shared": 0,
+               "units_decoded": 0, "rows_decoded": 0}
+        served = 0
+        for q in app.queries:
+            if q.sharing is not None:
+                served += 1
+            agg["hits"] += int(q.counter("share.result_hits"))
+            agg["misses"] += int(q.counter("share.result_misses"))
+            agg["evictions"] += int(
+                q.counter("share.result_evictions"))
+            agg["invalidations"] += int(
+                q.counter("share.result_invalidations"))
+            agg["units_shared"] += int(
+                q.counter("share.scan_units_shared"))
+            agg["units_decoded"] += int(
+                q.counter("share.scan_units_decoded"))
+            agg["rows_decoded"] += int(
+                q.counter("share.scan_rows_decoded"))
+        if served or any(agg.values()):
+            rows.append((app.label, served, agg))
+    if not rows:
+        return ""
+    lines = ["## Work sharing", "",
+             "| run | shared queries | hits | misses | hit rate | "
+             "evictions | invalidations | scan units shared | "
+             "scan units decoded |",
+             "|---|---|---|---|---|---|---|---|---|"]
+    for label, served, a in rows:
+        total = a["hits"] + a["misses"]
+        rate = f"{a['hits'] / total:.2f}" if total else "-"
+        lines.append(
+            f"| {label} | {served} | {a['hits']} | {a['misses']} | "
+            f"{rate} | {a['evictions']} | {a['invalidations']} | "
+            f"{a['units_shared']} | {a['units_decoded']} |")
+    return "\n".join(lines) + "\n"
+
+
 def render_report(apps: Sequence[ApplicationInfo],
                   threshold: float = DEFAULT_REGRESSION_THRESHOLD
                   ) -> str:
     """The full fleet-style markdown report: run fingerprints, the
-    cross-run compare, per-run health."""
+    cross-run compare, the work-sharing rollup (when any run engaged
+    the sharing tier), per-run health."""
     lines = ["# Fleet regression report", "",
              "| run | kind | queries | conf hash | jax | devices |",
              "|---|---|---|---|---|---|"]
@@ -722,6 +806,9 @@ def render_report(apps: Sequence[ApplicationInfo],
     if len(apps) >= 2:
         lines.append(render_compare_md(
             compare_applications(apps, threshold)))
+    sharing = render_sharing_md(apps)
+    if sharing:
+        lines.append(sharing)
     lines.append(render_health_md(apps))
     return "\n".join(lines)
 

@@ -99,6 +99,30 @@ def _snap(node: TpuExec) -> NodeSnapshot:
         [_snap(c) for c in node.children])
 
 
+def snapshot_delta(after: NodeSnapshot,
+                   before: Optional[NodeSnapshot]) -> NodeSnapshot:
+    """Positional per-metric subtraction of two snapshots of ONE exec
+    tree (same shape by construction): the per-execution attribution
+    for re-drained cached plan trees, whose live metrics accumulate
+    across executions.  Numeric metrics subtract (clamped at 0 — a
+    concurrent settle between the snapshots must never read as
+    negative work); anything else reports the after value."""
+    if before is None:
+        return after
+    mets: dict = {}
+    for k, v in after.metrics.items():
+        b = before.metrics.get(k)
+        if isinstance(v, (int, float)) and isinstance(b, (int, float)) \
+            and not isinstance(v, bool):
+            mets[k] = max(0, v - b)
+        else:
+            mets[k] = v
+    kids = [snapshot_delta(c, before.children[i]
+                           if i < len(before.children) else None)
+            for i, c in enumerate(after.children)]
+    return NodeSnapshot(after.desc, mets, kids)
+
+
 class QueryHistory:
     """Session-attached ring of recent QueryEvents.
 
@@ -147,22 +171,33 @@ class QueryHistory:
         process-global: the trace buffer is shared by every session."""
         return next(_QUERY_IDS)
 
-    def record(self, explain: str, exec_tree: TpuExec,
+    def record(self, explain: str, exec_tree: Optional[TpuExec],
                wall_s: float, query_id: Optional[int] = None,
                start_ts: float = 0.0, end_ts: float = 0.0,
                start_ns: int = 0, end_ns: int = 0,
                conf_hash: str = "",
-               on_event=None) -> None:
+               on_event=None, baseline=None) -> None:
         """`on_event(ev)` (optional) runs on the snapshot worker AFTER
         the settled event is appended — the event-log writer's hook:
         it sees device-settled metrics without adding a second settle
-        wait to collect()'s critical path."""
+        wait to collect()'s critical path.  `baseline` (a settled
+        pre-drain NodeSnapshot of the same tree) turns the recorded
+        metrics into per-execution deltas — the cached-plan re-drain
+        contract; `exec_tree` may be None for queries that executed no
+        operators (a result-cache hit), which record a placeholder
+        operator node."""
         ts = time.time()
         if query_id is None:
             query_id = next(_QUERY_IDS)
 
         def snap(qid):
-            ev = QueryEvent(qid, explain, snapshot_exec(exec_tree),
+            if exec_tree is None:
+                root = NodeSnapshot(
+                    "ResultCacheHit [no operators executed]", {}, [])
+            else:
+                root = snapshot_delta(snapshot_exec(exec_tree),
+                                      baseline)
+            ev = QueryEvent(qid, explain, root,
                             wall_s, ts, start_ts=start_ts,
                             end_ts=end_ts, start_ns=start_ns,
                             end_ns=end_ns, conf_hash=conf_hash)

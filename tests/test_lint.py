@@ -690,6 +690,73 @@ def test_source_lint_donate_rule_scoped():
             lint_source_text(_DONATE_FIXTURE, path)), path
 
 
+_SHARED_MUTATION_FIXTURE = """
+from spark_rapids_tpu.serving.work_share import lookup_result
+
+
+class FakeConsumer:
+    def _mutate_subscribed(self, share):
+        for unit, dev in share.subscribe_units():
+            unit.columns[0] = None            # SRC011: shared unit
+            yield dev
+
+    def _mutate_cached_result(self, plan, conf):
+        tbl, verdict = lookup_result(plan, conf)
+        tbl.append(None)                      # SRC011: cached result
+        return tbl
+
+    def _mutate_through_alias(self, share):
+        for unit, dev in share.subscribe_units():
+            b = dev
+            cols = b.columns
+            cols.append(None)                 # SRC011: alias chain
+            yield b
+
+    def _clean_copy_first(self, share):
+        for unit, dev in share.subscribe_units():
+            cols = list(unit.columns)
+            cols.append(None)                 # clean: list() copied
+            yield cols
+
+    def _clean_read_only(self, share):
+        for unit, dev in share.subscribe_units():
+            yield unit.num_rows               # clean: reads only
+
+    def _clean_unrelated(self, batch):
+        batch.columns.append(None)            # clean: not shared
+        return batch
+"""
+
+
+def test_source_lint_flags_shared_cache_mutation():
+    """SRC011: in-place mutation of a shared-cache object (a
+    subscribed scan unit, a cached result, or anything reached
+    through one) is an ERROR in serving//execs//io/ — every
+    concurrent consumer holds the same Python object.  Copy-first and
+    read-only consumers pass, as do mutations of unrelated locals."""
+    for path in ("spark_rapids_tpu/serving/fake.py",
+                 "spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/io/fake.py"):
+        diags = lint_source_text(_SHARED_MUTATION_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC011"]
+        assert len(hits) == 3, (path, [d.render() for d in hits])
+        assert all(h.severity == "error" for h in hits)
+    assert evaluate(lint_source_text(
+        _SHARED_MUTATION_FIXTURE,
+        "spark_rapids_tpu/serving/fake.py"))[2] != 0
+
+
+def test_source_lint_shared_mutation_rule_scoped_and_exempt():
+    """SRC011 polices serving//execs//io/ only, and
+    serving/work_share.py itself — the cache's own bookkeeping — is
+    exempt by construction."""
+    for path in ("spark_rapids_tpu/parallel/fake.py",
+                 "spark_rapids_tpu/columnar/fake.py",
+                 "spark_rapids_tpu/serving/work_share.py"):
+        assert "SRC011" not in rules(
+            lint_source_text(_SHARED_MUTATION_FIXTURE, path)), path
+
+
 # -- metric-registry checker (MET001) ----------------------------------- #
 
 _MET_UNSETTLED = """
@@ -834,6 +901,12 @@ def test_repo_baseline_covers_only_intentional_syncs():
             # baselined only inside the program modules the rule scans
             assert any(k.startswith(f"SRC010::spark_rapids_tpu/{p}/")
                        for p in ("execs", "ops")), k
+        elif k.startswith("SRC011::"):
+            # intentional shared-cache mutation sites (none today:
+            # consumers copy-on-write by contract) may be baselined
+            # only inside the serving-path modules the rule scans
+            assert any(k.startswith(f"SRC011::spark_rapids_tpu/{p}/")
+                       for p in ("serving", "execs", "io")), k
         elif k.startswith("MET001::"):
             # intentional metric-registry placeholders may be
             # baselined, but only inside the exec layers the rule

@@ -1,0 +1,617 @@
+"""Cross-tenant work-sharing tests (serving/work_share.py,
+plan/share_key.py, docs/work_sharing.md): the keying substrate and its
+determinism gate, the process-wide result cache (LRU, content-digest
+invalidation, spill/restore through the buffer store), shared-scan
+in-flight dedup, admission-aware batching, the per-execution
+metrics-delta contract on cached plan trees, the sharing event-log
+record + HC012, and THE tier-1 sharing smoke
+(tools/bench_smoke.run_sharing_smoke).
+
+Process-global state discipline: the work-share caches, scheduler,
+plan-cache counters and serving context are reset around every test
+(the conf follows conftest's snapshot/restore)."""
+
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.eventlog import table_digest
+from spark_rapids_tpu.plan.share_key import (
+    iter_shareable_subplans,
+    plan_is_shareable,
+    plan_share_key,
+    plan_source_digests,
+    scan_share_key,
+)
+from spark_rapids_tpu.serving import (
+    clear_serving_context,
+    plan_cache as plan_cache_mod,
+    scheduler as scheduler_mod,
+    work_share as ws,
+)
+from spark_rapids_tpu.serving.scheduler import QueryScheduler
+from spark_rapids_tpu.session import (
+    TpuSession,
+    col,
+    count_star,
+    rand,
+    sum_,
+)
+
+SHARING = "spark.rapids.tpu.serving.sharing.enabled"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_sharing():
+    ws.reset()
+    scheduler_mod.reset()
+    plan_cache_mod.reset_stats()
+    clear_serving_context()
+    yield
+    ws.reset()
+    scheduler_mod.reset()
+    plan_cache_mod.reset_stats()
+    clear_serving_context()
+
+
+def _table(n=4096, keys=16, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, keys, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _agg_df(session, t):
+    """Deterministic (integer sums, ordered output) grouped aggregate:
+    digest-stable across runs and thread interleavings."""
+    return (session.create_dataframe(t)
+            .group_by(col("k"))
+            .agg((sum_(col("v")), "sv"), (count_star(), "n"))
+            .order_by(col("k")))
+
+
+# ------------------------------------------------------------------ #
+# Keying substrate (plan/share_key.py)
+# ------------------------------------------------------------------ #
+
+
+def test_plan_share_key_structural_identity():
+    """Two plan INSTANCES over equal content share one key; different
+    content (the in-memory table's digest is part of the structural
+    key) gets a different one."""
+    conf = get_conf()
+    s = TpuSession(conf)
+    k1 = plan_share_key(_agg_df(s, _table())._plan, conf)
+    k2 = plan_share_key(_agg_df(s, _table())._plan, conf)
+    k3 = plan_share_key(_agg_df(s, _table(seed=8))._plan, conf)
+    assert k1 is not None
+    assert k1 == k2, "identical plans over equal content must share"
+    assert k1 != k3, "different input content must never share a key"
+
+
+def test_plan_share_key_conf_sensitivity():
+    """Lowering reads conf, so two conf epochs never share a result:
+    the conf fingerprint is part of the key."""
+    conf = get_conf()
+    s = TpuSession(conf)
+    df = _agg_df(s, _table())
+    k1 = plan_share_key(df._plan, conf)
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 999)
+    k2 = plan_share_key(df._plan, conf)
+    assert k1 != k2
+
+
+def test_determinism_gate_excludes_nondeterministic():
+    """rand() (partition-aware) poisons shareability for its plan —
+    but a pure subtree under the impure root still enumerates with
+    its own valid identity (scan-level sharing rides exactly this)."""
+    conf = get_conf()
+    s = TpuSession(conf)
+    pure = s.create_dataframe(_table())
+    impure = pure.select(rand(42).alias("r"))
+    assert plan_is_shareable(pure._plan)
+    assert not plan_is_shareable(impure._plan)
+    assert plan_share_key(impure._plan, conf) is None
+    keys = dict(iter_shareable_subplans(impure._plan, conf))
+    assert plan_share_key(pure._plan, conf) in keys
+
+
+def test_plan_source_digests_track_file_content(tmp_path):
+    conf = get_conf()
+    s = TpuSession(conf)
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(_table(), p)
+    df = (s.read_parquet(p).group_by(col("k"))
+          .agg((sum_(col("v")), "sv")))
+    d1 = plan_source_digests(df._plan)
+    assert d1 and d1[0][0] == p
+    pq.write_table(_table(seed=9), p)
+    d2 = plan_source_digests(df._plan)
+    assert d1 != d2, "rewriting the file must change its digest"
+    # the digest is the INVALIDATION token, not part of the key
+    assert plan_share_key(df._plan, conf) is not None
+
+
+def test_scan_share_key_gates(tmp_path):
+    """Runtime-filtered scans never share (their pruning is
+    query-dependent); otherwise identical scan shapes over identical
+    file content share one key."""
+    conf = get_conf()
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(_table(), p)
+
+    def scan(**kw):
+        base = dict(runtime_filters=[], paths=[p],
+                    columns=("k", "v"), batch_rows=1024,
+                    partition_values=(), partition_fields=())
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    k1 = scan_share_key(scan(), 0, conf)
+    assert k1 is not None
+    assert scan_share_key(scan(), 0, conf) == k1
+    assert scan_share_key(scan(), 1, conf) != k1, \
+        "different partitions must not share a unit stream"
+    assert scan_share_key(scan(columns=("k",)), 0, conf) != k1
+    assert scan_share_key(
+        scan(runtime_filters=[object()]), 0, conf) is None
+
+
+# ------------------------------------------------------------------ #
+# Result cache
+# ------------------------------------------------------------------ #
+
+
+def test_result_cache_roundtrip_bit_identical():
+    t = _agg_df(TpuSession(get_conf()), _table()).collect(engine="tpu")
+    assert ws.RESULT_CACHE.insert("k1", [], t)
+    got = ws.RESULT_CACHE.lookup("k1", [])
+    assert got is not None
+    assert table_digest(got) == table_digest(t)
+    st = ws.stats()
+    assert st["result_hits"] == 1 and st["result_inserts"] == 1
+
+
+def test_result_cache_invalidates_on_digest_change():
+    t = pa.table({"a": [1, 2, 3]})
+    assert ws.RESULT_CACHE.insert("k1", [("f", 10, 100)], t)
+    # same key, changed input content: invalidated + honest miss
+    assert ws.RESULT_CACHE.lookup("k1", [("f", 10, 200)]) is None
+    st = ws.stats()
+    assert st["result_invalidations"] == 1
+    assert st["result_misses"] == 1
+    assert len(ws.RESULT_CACHE) == 0, "stale entry must be dropped"
+
+
+def test_result_cache_lru_eviction_and_oversize():
+    conf = get_conf()
+    t = pa.table({"a": np.arange(256, dtype=np.int64)})
+    nbytes = len(ws._table_ipc(t))
+    # a single result may use at most a QUARTER of the budget, so
+    # 4.5x one entry admits entries while 6 inserts overflow the LRU
+    conf.set("spark.rapids.tpu.serving.resultCache.budgetBytes",
+             int(nbytes * 4.5))
+    for k in ("a", "b", "c", "d", "e", "f"):
+        assert ws.RESULT_CACHE.insert(k, [], t)
+    st = ws.stats()
+    assert st["result_evictions"] >= 1
+    assert ws.RESULT_CACHE.lookup("a", []) is None, "LRU: oldest out"
+    assert ws.RESULT_CACHE.lookup("f", []) is not None
+    # a result larger than a quarter of the budget is not cached
+    big = pa.table({"a": np.arange(4096, dtype=np.int64)})
+    assert not ws.RESULT_CACHE.insert("big", [], big)
+    assert ws.RESULT_CACHE.lookup("big", []) is None
+
+
+def test_result_cache_spills_and_restores_through_store():
+    """THE spill-interaction contract (docs/work_sharing.md): cached
+    results live in the buffer store at HOST tier — a 1-byte host
+    budget pushes the entry straight to disk, and lookup restores it
+    bit-identical; a killed store reads as an honest miss, never a
+    broken hit."""
+    from spark_rapids_tpu.memory import reset_store
+    from spark_rapids_tpu.memory.store import BufferStore
+
+    store = BufferStore(device_budget=1 << 30, host_budget=1)
+    reset_store(store)
+    try:
+        t = _agg_df(TpuSession(get_conf()),
+                    _table()).collect(engine="tpu")
+        assert ws.RESULT_CACHE.insert("k", [], t)
+        assert store.spilled_host_to_disk > 0, \
+            "entry should have continued host->disk under the budget"
+        got = ws.RESULT_CACHE.lookup("k", [])
+        assert got is not None
+        assert table_digest(got) == table_digest(t)
+        # the backing store dies (bench phase boundary): honest miss
+        reset_store(BufferStore(device_budget=1 << 30,
+                                host_budget=1 << 30))
+        assert ws.RESULT_CACHE.lookup("k", []) is None
+        assert len(ws.RESULT_CACHE) == 0
+    finally:
+        ws.RESULT_CACHE.reset()
+        reset_store()
+
+
+# ------------------------------------------------------------------ #
+# Shared scans: entry protocol + registry
+# ------------------------------------------------------------------ #
+
+
+def test_scan_share_subscriber_replays_in_publish_order():
+    e = ws.ScanShareEntry("k")
+    t1, t2 = pa.table({"a": [1]}), pa.table({"a": [2]})
+    e.publish([t1])
+    e.publish([t2])
+    e.complete()
+    got = [u for u, _dev in e.subscribe_units()]
+    assert got == [[t1], [t2]]
+    assert e.done
+
+
+def test_scan_share_abort_wakes_subscriber_for_fallback():
+    e = ws.ScanShareEntry("k")
+    e.publish([pa.table({"a": [1]})])
+    consumed, raised = [], threading.Event()
+
+    def sub():
+        try:
+            for u, _dev in e.subscribe_units():
+                consumed.append(u)
+        except ws.ScanShareAborted:
+            raised.set()
+
+    th = threading.Thread(target=sub)
+    th.start()
+    while not consumed:  # the buffered prefix replays immediately
+        th.join(0.01)
+    e.abort()
+    th.join(5.0)
+    assert raised.is_set(), "abort must raise, not hang the subscriber"
+    assert len(consumed) == 1, "the deterministic prefix was served"
+
+
+def test_scan_registry_same_thread_never_subscribes_itself():
+    """A live entry led by THIS thread answers (None, False) — a
+    same-thread subscribe (self-join interleaving two scans of one
+    table on one task thread) would deadlock."""
+    e, leader = ws.SCAN_REGISTRY.begin("k")
+    assert leader and e is not None
+    e2, leader2 = ws.SCAN_REGISTRY.begin("k")
+    assert e2 is None and not leader2
+    e.complete()
+    ws.SCAN_REGISTRY.release(e)
+    # completed entries ARE re-joinable, same thread or not
+    e3, leader3 = ws.SCAN_REGISTRY.begin("k")
+    assert e3 is e and not leader3
+    ws.SCAN_REGISTRY.release(e3)
+
+
+def test_scan_registry_budget_evicts_completed_never_inflight():
+    conf = get_conf()
+    conf.set(
+        "spark.rapids.tpu.serving.sharing.scanCache.budgetBytes", 0)
+    done, leader = ws.SCAN_REGISTRY.begin("done")
+    assert leader
+    done.publish([pa.table({"a": [1, 2, 3]})])
+    done.complete()
+    ws.SCAN_REGISTRY.release(done)
+    assert len(ws.SCAN_REGISTRY) == 0, \
+        "completed entry over budget must be evicted"
+    live, leader = ws.SCAN_REGISTRY.begin("live")
+    assert leader  # cap is 0 (the conf budget above): no self-abort
+    live.publish([pa.table({"a": [1, 2, 3]})])
+    ws.SCAN_REGISTRY._enforce_budget()
+    assert len(ws.SCAN_REGISTRY) == 1, \
+        "in-flight entries are never evicted"
+
+
+def test_scan_share_inflight_overflow_self_aborts():
+    """The in-flight footprint cap: an entry whose buffered units
+    outgrow scanCache.budgetBytes self-aborts (buffer freed,
+    subscribers fall back) instead of materializing the whole scan in
+    host memory; the leader's own stream is unaffected."""
+    e = ws.ScanShareEntry("k", cap=64)
+    big = [pa.table({"a": np.arange(1024, dtype=np.int64)})]
+    e.publish(big)  # blows the 64-byte cap on the first unit
+    assert e._aborted
+    assert not e._units, "the buffered footprint must be freed NOW"
+    with pytest.raises(ws.ScanShareAborted):
+        list(e.subscribe_units())
+    assert ws.stats()["scan_overflows"] == 1
+    e.publish(big)  # post-abort publishes are inert
+    assert not e._units and ws.stats()["scan_overflows"] == 1
+
+
+# ------------------------------------------------------------------ #
+# Admission-aware batching (serving/scheduler.py)
+# ------------------------------------------------------------------ #
+
+
+def _queue_two(s):
+    """Queue tenant-b (group h) then tenant-c (group g) behind a full
+    scheduler; returns their grant events + tickets."""
+    got_b, got_c = threading.Event(), threading.Event()
+    tickets: dict = {}
+
+    def wait_admit(name, tenant, group, ev):
+        tickets[name] = s.admit(tenant, group=group)
+        ev.set()
+
+    tb = threading.Thread(target=wait_admit,
+                          args=("b", "tb", "h", got_b))
+    tb.start()
+    while s.stats()["waiting"] < 1:
+        tb.join(0.005)
+    tc = threading.Thread(target=wait_admit,
+                          args=("c", "tc", "g", got_c))
+    tc.start()
+    while s.stats()["waiting"] < 2:
+        tc.join(0.005)
+    return got_b, got_c, tickets, (tb, tc)
+
+
+def test_admission_batching_prefers_running_group():
+    """The batching preference: a queued query whose template group is
+    already RUNNING is granted ahead of strict WFQ order, so
+    compatible plans overlap and their scans dedup in flight."""
+    s = QueryScheduler(2, 32, batching=True)
+    e_a = s.admit("ta", group="g")
+    e_f = s.admit("tf")
+    got_b, got_c, tickets, threads = _queue_two(s)
+    s.release(e_f)  # one slot frees while group g is still running
+    assert got_c.wait(5.0), "group-g query should coalesce first"
+    assert not got_b.wait(0.05), \
+        "strict-WFQ-first query must still be queued"
+    assert s.stats()["coalesced"] == 1
+    s.release(e_a)
+    assert got_b.wait(5.0)
+    for th in threads:
+        th.join()
+    for t in tickets.values():
+        s.release(t)
+
+
+def test_admission_batching_disabled_is_strict_wfq():
+    s = QueryScheduler(2, 32, batching=False)
+    e_a = s.admit("ta", group="g")
+    e_f = s.admit("tf")
+    got_b, got_c, tickets, threads = _queue_two(s)
+    s.release(e_f)
+    assert got_b.wait(5.0), "batching off: FIFO-within-tie WFQ order"
+    assert not got_c.wait(0.05)
+    assert s.stats()["coalesced"] == 0
+    s.release(e_a)
+    assert got_c.wait(5.0)
+    for th in threads:
+        th.join()
+    for t in tickets.values():
+        s.release(t)
+
+
+# ------------------------------------------------------------------ #
+# End-to-end: the collect path
+# ------------------------------------------------------------------ #
+
+
+def test_second_tenant_served_from_result_cache():
+    """The tentpole contract in miniature: tenant B issuing tenant A's
+    exact query gets the cached result — bit-identical, zero decoded
+    units — and the serving context carries the verdict."""
+    conf = get_conf()
+    d_off = table_digest(
+        _agg_df(TpuSession(conf), _table()).collect(engine="tpu"))
+    conf.set(SHARING, True)
+    d_a = table_digest(
+        _agg_df(TpuSession(conf, tenant="a"),
+                _table()).collect(engine="tpu"))
+    st = ws.stats()
+    assert st["result_inserts"] == 1 and st["result_hits"] == 0
+    d_b = table_digest(
+        _agg_df(TpuSession(conf, tenant="b"),
+                _table()).collect(engine="tpu"))
+    st = ws.stats()
+    assert st["result_hits"] == 1
+    assert st["result_hit_rate"] == 0.5  # 1 hit / (1 hit + 1 miss)
+    assert d_off == d_a == d_b, "sharing must be invisible in the bytes"
+
+
+def test_shared_scan_rides_prior_decode(tmp_path):
+    """A DIFFERENT query over the same file set (result-cache miss by
+    construction) still skips the decode: it subscribes to the
+    retained shared-scan entry, and the tapped decode counter stays
+    flat."""
+    conf = get_conf()
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(_table(), p)
+
+    def q1(s):
+        return (s.read_parquet(p).group_by(col("k"))
+                .agg((sum_(col("v")), "sv")).order_by(col("k")))
+
+    def q2(s):
+        return (s.read_parquet(p).group_by(col("k"))
+                .agg((sum_(col("v")), "s2"), (count_star(), "n2"))
+                .order_by(col("k")))
+
+    d2_off = table_digest(
+        q2(TpuSession(conf)).collect(engine="tpu"))
+    conf.set(SHARING, True)
+    q1(TpuSession(conf, tenant="a")).collect(engine="tpu")
+    decoded_after_q1 = ws.stats()["scan_units_decoded"]
+    assert decoded_after_q1 >= 1
+    d2_on = table_digest(
+        q2(TpuSession(conf, tenant="b")).collect(engine="tpu"))
+    st = ws.stats()
+    assert st["result_hits"] == 0, "different plans: no result hit"
+    assert st["scan_subscribes"] == 1, "q2's scan must subscribe"
+    assert st["scan_units_shared"] >= 1
+    assert st["scan_units_decoded"] == decoded_after_q1, \
+        "the shared scan must not decode again"
+    assert d2_on == d2_off
+
+
+def test_nondeterministic_plans_never_consult_the_cache():
+    conf = get_conf()
+    conf.set(SHARING, True)
+    s = TpuSession(conf)
+    df = s.create_dataframe(_table()).select(rand(42).alias("r"))
+    df.collect(engine="tpu")
+    df.collect(engine="tpu")
+    st = ws.stats()
+    assert st["result_hits"] == 0 and st["result_misses"] == 0 \
+        and st["result_inserts"] == 0, \
+        "the determinism gate must keep rand() out of the cache"
+
+
+# ------------------------------------------------------------------ #
+# Per-execution metrics deltas on cached plan trees (the PR8 quirk)
+# ------------------------------------------------------------------ #
+
+
+def test_cached_tree_records_per_execution_metric_deltas():
+    """Regression: metrics on a cached prepared-plan tree ACCUMULATE
+    across re-drains (the tree is the long-lived object), but each
+    recorded execution must report ITS OWN deltas — the second
+    execution's numOutputRows equals the result size, not 2x."""
+    s = TpuSession(get_conf())
+    prepared = s.prepare(_agg_df(s, _table()))
+    r1 = prepared.execute()
+    r2 = prepared.execute()
+    assert table_digest(r1) == table_digest(r2)
+    events = s.history.events
+    assert len(events) >= 2
+    ev1, ev2 = events[-2], events[-1]
+    m1 = ev1.root.metrics.get("numOutputRows")
+    m2 = ev2.root.metrics.get("numOutputRows")
+    assert m1 == r1.num_rows, (m1, r1.num_rows)
+    assert m2 == r2.num_rows, \
+        f"re-drain reported the running total ({m2}), not the delta"
+
+
+def test_result_cache_hit_records_full_lifecycle(tmp_path):
+    """A result-cache hit never builds an exec tree, but the fleet
+    still sees served traffic: the history event exists with a
+    placeholder operator node and the event-log record round-trips the
+    sharing verdict, counters and the real digest."""
+    from spark_rapids_tpu.tools.history import load_application
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.eventLog.enabled", True)
+    conf.set("spark.rapids.tpu.eventLog.dir", str(tmp_path))
+    conf.set(SHARING, True)
+    s1 = TpuSession(conf, tenant="a")
+    r1 = _agg_df(s1, _table()).collect(engine="tpu")
+    s2 = TpuSession(conf, tenant="b")
+    r2 = _agg_df(s2, _table()).collect(engine="tpu")
+    assert table_digest(r1) == table_digest(r2)
+    _ = s1.history.events
+    _ = s2.history.events
+    q1 = load_application(s1.event_log_path).queries[-1]
+    q2 = load_application(s2.event_log_path).queries[-1]
+    assert q1.sharing is not None \
+        and q1.sharing["result_cache"] == "miss"
+    assert q2.sharing is not None \
+        and q2.sharing["result_cache"] == "hit"
+    assert q2.counters.get("serve.result_cache_hit") == 1
+    # the hit itself ticks BEFORE query_begin's snapshot (outside the
+    # delta window, like plan-cache hits) — the per-query surface is
+    # the verdict above; the share.* delta keys still ride the record
+    assert "share.result_hits" in q2.counters
+    assert q2.result_digest == q1.result_digest
+    assert q2.rows == r2.num_rows
+    assert "ResultCacheHit" in q2.plan
+    # regression: with the cache non-empty, a query that never touched
+    # the sharing tier (verdict None, zero deltas) records NO sharing
+    # section — the result_bytes gauge must not trigger one
+    s3 = TpuSession(conf, tenant="c")
+    s3.create_dataframe(_table()).select(
+        rand(7).alias("r")).collect(engine="tpu")
+    _ = s3.history.events
+    q3 = load_application(s3.event_log_path).queries[-1]
+    assert q3.counter("share.result_bytes") > 0, \
+        "precondition: the cache held bytes during q3"
+    assert q3.sharing is None
+
+
+def test_hc012_result_cache_thrash_matrix():
+    """HC012 fires on evictions >> hits under the conf floor, and only
+    then — healthy hit rates and eviction-free windows stay silent."""
+    from spark_rapids_tpu.tools.history import (
+        ApplicationInfo,
+        _query_from_record,
+        health_check,
+    )
+
+    def q(counters):
+        return _query_from_record({
+            "query_id": 0, "plan": "", "plan_hash": "x",
+            "engine": "tpu", "wall_s": 1.0, "counters": counters})
+
+    def rules(rec):
+        app = ApplicationInfo("x", "eventlog", {}, [rec])
+        return {f.rule for f in health_check(app)}
+
+    thrash = q({"share.result_evictions": 6, "share.result_hits": 1,
+                "share.result_misses": 9})
+    assert "HC012" in rules(thrash)
+    healthy_rate = q({"share.result_evictions": 6,
+                      "share.result_hits": 9,
+                      "share.result_misses": 1})
+    assert "HC012" not in rules(healthy_rate)
+    no_thrash = q({"share.result_hits": 1,
+                   "share.result_misses": 9})
+    assert "HC012" not in rules(no_thrash)
+    sharing_off = q({})
+    assert "HC012" not in rules(sharing_off)
+
+
+# ------------------------------------------------------------------ #
+# Shared-object immutability bookkeeping
+# ------------------------------------------------------------------ #
+
+
+def test_mark_shared_array_identity_and_gc():
+    import gc
+
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import (
+        is_shared_array,
+        mark_shared_array,
+    )
+
+    a = jnp.arange(8)
+    b = jnp.arange(8)
+    mark_shared_array(a)
+    assert is_shared_array(a)
+    assert not is_shared_array(b), "identity-keyed, not value-keyed"
+    del a
+    gc.collect()
+    # the weakref callback cleared the slot: a recycled id can never
+    # alias the dead shared array onto a fresh private one
+    assert not is_shared_array(b)
+
+
+# ------------------------------------------------------------------ #
+# THE tier-1 sharing smoke (tools/bench_smoke.run_sharing_smoke)
+# ------------------------------------------------------------------ #
+
+
+def test_sharing_smoke():
+    """tools/bench_smoke.run_sharing_smoke wired into tier-1: second
+    execution decodes ZERO units, digests bit-identical to the
+    sharing-off serial run, and the content-mutation probe proves
+    immediate invalidation."""
+    from spark_rapids_tpu.tools.bench_smoke import run_sharing_smoke
+
+    out = run_sharing_smoke()
+    assert out["sharing_second_exec_decodes"] == 0
+    assert out["sharing_result_hits"] >= 1
+    assert out["sharing_invalidations"] >= 1
