@@ -89,6 +89,8 @@ MONOTONIC_COUNTERS = (
     "share.result_evictions", "share.result_invalidations",
     "share.scan_subscribes", "share.scan_units_shared",
     "share.scan_units_decoded", "share.scan_rows_decoded",
+    "cancel.cancelled", "cancel.deadline_exceeded",
+    "cancel.breaker_trips", "cancel.quarantined",
 )
 
 
@@ -150,6 +152,21 @@ def counters_snapshot() -> dict[str, float]:
     out["share.scan_units_decoded"] = ws["scan_units_decoded"]
     out["share.scan_rows_decoded"] = ws["scan_rows_decoded"]
     out["share.result_bytes"] = ws["result_bytes"]  # gauge
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.parallel.pipeline import live_stage_threads
+    from spark_rapids_tpu.serving import cancel as _cancel
+
+    cs = _cancel.stats()
+    out["cancel.cancelled"] = cs["cancelled"]
+    out["cancel.deadline_exceeded"] = cs["deadline_exceeded"]
+    out["cancel.breaker_trips"] = cs["breaker_trips"]
+    out["cancel.quarantined"] = cs["quarantined"]
+    # residency GAUGES (recorded verbatim like store.*_used): the
+    # snapshot taken at query END is the HC013 leak surface — a
+    # cancelled query's record must show these back at baseline
+    out["semaphore.in_use"] = TpuSemaphore.usage_now()["in_use"]
+    out["pipeline.stage_threads"] = live_stage_threads()
+    out["scan.inflight"] = work_share.SCAN_REGISTRY.inflight()
     return out
 
 

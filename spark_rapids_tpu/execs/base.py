@@ -333,6 +333,13 @@ class TpuExec:
         return s
 
     def _count_output(self, batch: ColumnarBatch) -> ColumnarBatch:
+        # THE per-operator cooperative cancellation checkpoint: every
+        # exec counts each output batch here, so one check covers the
+        # whole tree's stream loops (serving/cancel.py; one
+        # thread-local read when no token is attached)
+        from spark_rapids_tpu.serving.cancel import check_point
+
+        check_point()
         self.metrics[NUM_OUTPUT_BATCHES].add(1)
         # device-scalar row counts are deferred (summed when the metric is
         # read) — forcing them here would put a host round trip in every

@@ -445,12 +445,17 @@ class TpuShuffleExchangeExec(TpuExec):
         # defaults (batch sizing, pipeline depth/kill-switch, chunk
         # rows) for everything executing below the exchange.  The trace
         # correlation context makes the same hop, so map-task spans
-        # stay attributable to the query that dispatched them.
+        # stay attributable to the query that dispatched them — and so
+        # does the query's cancel token, so a cancelled query's map
+        # tasks unwind at their own checkpoints instead of running the
+        # whole map stage for nobody.
         from spark_rapids_tpu import trace as _trace
         from spark_rapids_tpu.config import get_conf, set_conf
+        from spark_rapids_tpu.serving import cancel as _cancel
 
         conf = get_conf()
         tctx = _trace.current_context()
+        ctok = _cancel.current_token()
 
         def run(p: int) -> None:
             set_conf(conf)
@@ -458,6 +463,7 @@ class TpuShuffleExchangeExec(TpuExec):
             # covers the map stage, and a second op-keyed span per task
             # would double-count the exchange in span_stats
             with _trace.attach_context(tctx), \
+                    _cancel.attach_token(ctok), \
                     _trace.span("exchange.task", task=p):
                 fn(p)
 

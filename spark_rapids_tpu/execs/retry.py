@@ -130,6 +130,13 @@ def reset_retry_stats() -> None:
 def is_retryable(exc: BaseException) -> bool:
     """Device / transient failure (retry may succeed) vs logic error
     (fail fast)."""
+    from spark_rapids_tpu.serving.cancel import QueryCancelled
+
+    if isinstance(exc, QueryCancelled):
+        # cancellation/deadline is a VERDICT, not a fault: no retry,
+        # no split, no CPU degrade — the query unwinds (its message
+        # must never be marker-matched into a retry)
+        return False
     if isinstance(exc, MemoryError):
         return True
     from spark_rapids_tpu.shuffle.net import FetchFailedError
@@ -210,6 +217,8 @@ def _retry_loop(fn: Callable[[], T], stat_key: str, action: str,
     and the whole-task rung: classify, count, spill everything
     unpinned, jittered doubling backoff, credit absorbed injected
     faults on eventual success."""
+    from spark_rapids_tpu.serving.cancel import check_point
+
     conf = get_conf()
     attempts = attempts if attempts is not None \
         else max(1, conf.get(TASK_MAX_FAILURES))
@@ -221,6 +230,9 @@ def _retry_loop(fn: Callable[[], T], stat_key: str, action: str,
         except BaseException as e:  # noqa: BLE001 - classified below
             if not is_retryable(e) or attempt == attempts - 1:
                 raise
+            # a cancelled query must not burn backoff sleeps and
+            # re-attempts on work nobody will consume
+            check_point()
             caught.append(e)
             _bump(stat_key)
             _release_pressure()
@@ -351,6 +363,7 @@ def with_split_retry(run, batch, desc: str = "batch",
     ``initial_error`` seeds the ladder with a failure that happened at
     dispatch time, before any attempt could run here."""
     from spark_rapids_tpu.robustness import faults as _faults
+    from spark_rapids_tpu.serving.cancel import check_point
 
     conf = get_conf()
     attempts = max(1, conf.get(TASK_MAX_FAILURES))
@@ -386,6 +399,9 @@ def with_split_retry(run, batch, desc: str = "batch",
                 raise
             failures += 1
             caught.append(e)
+            # between rungs: a cancelled query escalates OUT of the
+            # ladder instead of spilling/splitting for nobody
+            check_point()
             if failures == 1:
                 # rung 1: release pressure, retry at full size
                 _bump("spill_retries")

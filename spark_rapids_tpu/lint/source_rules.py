@@ -108,6 +108,22 @@ Rules
   no fuse key, the module-level Pallas kernel wrappers) are
   baselined, not suppressed inline.  execs/jit_cache.py — the cache
   itself — is exempt by construction.
+- SRC012 (error): unbounded blocking waits in serving/ and parallel/.
+  Every wait on the serving path must be INTERRUPTIBLE — the
+  cancellation substrate (serving/cancel.py) can only unwind a query
+  whose blocked seams wake up to poll the token, so a
+  ``Condition.wait()`` / ``Event.wait()`` / ``queue.get()`` /
+  ``Thread.join()`` with no timeout is a query that session.cancel()
+  and the deadline cannot reach.  Syntactic: zero-argument
+  ``.wait()`` / ``.get()`` / ``.join()`` calls without a ``timeout=``
+  keyword (``dict.get`` always takes a key, so a bare ``.get()`` is a
+  queue read — except ``ClassName.get()`` singleton accessors, which
+  are exempt by the leading-capital convention; a bare ``.join()`` is
+  a thread join — ``str.join`` takes an iterable).  The deliberate
+  sites (prefetch's
+  abort-then-join teardown, whose wake-up is the channel abort, not a
+  poll) are baselined with their justification in
+  tests/test_lint.py's coverage contract.
 """
 
 from __future__ import annotations
@@ -504,6 +520,71 @@ class _RawTimingChecker(ast.NodeVisitor):
                 hint="time the region with MetricTimer (device-aware "
                      "metrics) or trace.span (correlated timeline); "
                      "baseline only timing-infrastructure sites",
+                line=getattr(node, "lineno", 0)))
+        self.generic_visit(node)
+
+
+#: SRC012: blocking-wait method names.  `wait` covers Condition/Event,
+#: `get` covers queue.Queue (dict.get always takes a key, so the
+#: zero-arg form is a queue read), `join` covers Thread/Queue
+#: (str.join takes an iterable, so the zero-arg form is a thread join)
+_WAIT_ATTRS = {"wait", "get", "join"}
+
+
+class _UnboundedWaitChecker(ast.NodeVisitor):
+    """SRC012: unbounded blocking waits on the serving path (serving/
+    and parallel/ modules).
+
+    The cancellation substrate is COOPERATIVE: a cancelled query
+    unwinds only when its blocked seams wake up and poll the token, so
+    a timeout-less wait anywhere on the serving path is a query that
+    session.cancel(), PreparedQuery.cancel() and the per-query
+    deadline cannot reach — it blocks until some other party happens
+    to notify.  Every wait must pass a timeout (the
+    serving/cancel.poll_timeout cadence) and re-check the token, or be
+    baselined with its wake-up justification (docs/robustness.md)."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    @staticmethod
+    def _is_class_accessor(node: ast.Call) -> bool:
+        """`TpuSemaphore.get()` / `_MetricReaper.get()` are singleton
+        ACCESSORS, not blocking reads: skip zero-arg `.get()` whose
+        receiver follows the ClassName convention (leading capital,
+        optionally underscore-prefixed)."""
+        if node.func.attr != "get":  # type: ignore[union-attr]
+            return False
+        recv = _terminal_name(node.func.value)  # type: ignore[union-attr]
+        return bool(recv) and recv.lstrip("_")[:1].isupper()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _WAIT_ATTRS \
+                and not node.args \
+                and not any(kw.arg == "timeout"
+                            for kw in node.keywords) \
+                and not self._is_class_accessor(node):
+            qual = self._fn_stack[-1] if self._fn_stack else "<module>"
+            self.out.append(Diagnostic(
+                "SRC012", "error", f"{self.path}::{qual}",
+                f"unbounded blocking `.{node.func.attr}()` on the "
+                "serving path cannot be interrupted by "
+                "cancellation/deadline",
+                hint="wait with a timeout on the "
+                     "serving/cancel.poll_timeout cadence and "
+                     "re-check the cancel token each wake-up; "
+                     "baseline only sites with a guaranteed "
+                     "non-poll wake-up",
                 line=getattr(node, "lineno", 0)))
         self.generic_visit(node)
 
@@ -976,6 +1057,14 @@ def _is_sharing_module(path: str) -> bool:
     return any(p in parts for p in ("serving", "execs", "io"))
 
 
+def _is_wait_module(path: str) -> bool:
+    """SRC012 scope: the serving tier and the parallel substrate — the
+    layers whose blocking waits sit on the serving path a cancelled
+    query must be able to unwind through."""
+    parts = path.replace("\\", "/").split("/")
+    return "serving" in parts or "parallel" in parts
+
+
 def _is_recovery_module(path: str) -> bool:
     """SRC008 scope: the layers whose exceptions feed the recovery
     ladder.  execs/retry.py IS the classification gate — exempt."""
@@ -1013,6 +1102,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _SwallowChecker(path, out).visit(tree)
     if _is_sharing_module(path):
         _SharedMutationChecker(path, out).visit(tree)
+    if _is_wait_module(path):
+        _UnboundedWaitChecker(path, out).visit(tree)
     return out
 
 

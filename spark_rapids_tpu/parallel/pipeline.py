@@ -160,6 +160,17 @@ def reset_stage_counters() -> None:
         _STAGES.clear()
 
 
+def live_stage_threads() -> int:
+    """Gauge: pipeline stage PRODUCER threads alive right now (the
+    ``tpu-pipe-<stage>`` family; the persistent readback harvester
+    pool is excluded).  Zero between queries — a nonzero reading after
+    a query unwound is a leaked stage, the cancellation tests' and
+    HC013's leak surface."""
+    return sum(1 for t in threading.enumerate()
+               if t.name.startswith("tpu-pipe-")
+               and not t.name.startswith("tpu-pipe-harvest"))
+
+
 # ------------------------------------------------------------------ #
 # Readback tracing (test instrumentation)
 # ------------------------------------------------------------------ #
@@ -428,12 +439,20 @@ class _Chan:
     # producer side ---------------------------------------------------- #
 
     def put(self, item, m: StageMetrics) -> bool:
-        """False once the consumer aborted (producer should stop)."""
+        """False once the consumer aborted (producer should stop).
+        The full-queue wait is bounded and cancel-aware (SRC012): a
+        cancelled query's producer raises out of the wait instead of
+        blocking until a consumer that already unwound drains it."""
+        from spark_rapids_tpu.serving import cancel as _cancel
+
         with self.not_full:
             if len(self.buf) >= self.depth and not self.aborted:
                 t0 = time.perf_counter_ns()
+                tok = _cancel.current_token()
                 while len(self.buf) >= self.depth and not self.aborted:
-                    self.not_full.wait()
+                    self.not_full.wait(_cancel.poll_timeout(tok))
+                    if tok is not None:
+                        tok.check()
                 dt = time.perf_counter_ns() - t0
                 with m._lock:
                     m.producer_wait_ns += dt
@@ -468,9 +487,18 @@ class _Chan:
                 m.occupancy_sum += len(self.buf)
                 m.samples += 1
             if not self.buf and not self.done:
+                from spark_rapids_tpu.serving import cancel as _cancel
+
                 t0 = time.perf_counter_ns()
+                tok = _cancel.current_token()
                 while not self.buf and not self.done:
-                    self.not_empty.wait()
+                    # bounded, cancel-aware wait (SRC012): a cancelled
+                    # consumer raises here; the enclosing prefetch's
+                    # finally then aborts the stage and joins the
+                    # producer, so nothing leaks
+                    self.not_empty.wait(_cancel.poll_timeout(tok))
+                    if tok is not None:
+                        tok.check()
                 dt = time.perf_counter_ns() - t0
                 with m._lock:
                     m.consumer_wait_ns += dt
@@ -521,6 +549,8 @@ def prefetch(gen: Iterable, depth: Optional[int] = None,
     if depth <= 0:
         yield from gen
         return
+    from spark_rapids_tpu.serving import cancel as _cancel
+
     m = _stage_metrics(stage)
     with m._lock:
         m.depth = max(m.depth, depth)
@@ -528,18 +558,23 @@ def prefetch(gen: Iterable, depth: Optional[int] = None,
     conf = get_conf()
     # trace correlation (query_id, ...) is thread-local and does NOT
     # follow the generator onto the stage thread: capture here, attach
-    # there — the same hop the conf snapshot makes
+    # there — the same hop the conf snapshot makes.  The query's
+    # cancel token rides the same capture/attach channel, so the
+    # producer observes cancellation mid-decode, not only at the
+    # channel boundary
     tctx = _tr.current_context()
+    ctok = _cancel.current_token()
 
     def produce() -> None:
         err: Optional[BaseException] = None
         set_conf(conf)
-        with _tr.attach_context(tctx), \
+        with _tr.attach_context(tctx), _cancel.attach_token(ctok), \
                 _tr.span(f"pipe.{stage}.run", stage=stage):
             try:
                 try:
                     for item in gen:
                         _stage_checkpoint(stage)
+                        _cancel.check_point()
                         if not chan.put(item, m):
                             return
                 except BaseException as e:  # noqa: BLE001 — re-raised at consumer

@@ -1590,6 +1590,7 @@ def stream_exec(exec_: TpuExec, stage: str = "result.fetch"):
     (also the only path for types with no device layout,
     e.g. list<string>)."""
     from spark_rapids_tpu import trace as _trace
+    from spark_rapids_tpu.serving.cancel import check_point
 
     if isinstance(exec_, CpuFallbackExec):
         try:
@@ -1607,6 +1608,11 @@ def stream_exec(exec_: TpuExec, stage: str = "result.fetch"):
             it = prefetch(it, depth=fetch_depth, stage=stage)
         try:
             for b in it:
+                # the result-fetch cancellation checkpoint: a
+                # cancelled query raises HERE on the consumer thread;
+                # the finallys below close the prefetch stage (abort +
+                # join) and the exec tree (shuffle blocks, spillables)
+                check_point()
                 if _trace.TRACER.enabled:
                     with _trace.span("query.fetch.batch"):
                         t = to_arrow(b)

@@ -21,7 +21,12 @@ Sites (each planted at exactly one seam):
   on the producer thread (recovered in place, stage never torn down);
 - ``exec.batch``      — execs/retry.with_split_retry, once per guarded
   batch attempt in the join/aggregate/sort/exchange stream loops (the
-  drill site for the OOM escalation ladder).
+  drill site for the OOM escalation ladder);
+- ``cancel.check``    — serving/cancel.check_point, once per
+  cooperative cancellation checkpoint WHEN a query token is attached;
+  an injected hit cancels the current token, so chaos schedules drive
+  deterministic cancellations through the real unwind path
+  (docs/robustness.md).
 
 Policies are conf-driven (``spark.rapids.tpu.robustness.faults.spec``)
 and fully deterministic: fail-the-Nth-call (optionally N consecutive
@@ -59,7 +64,8 @@ FAULTS_SPEC = register(
     "Semicolon-separated per-site fault policies: "
     "'site:key=val,key=val;site2:...'.  Sites: alloc.device, "
     "transfer.upload, shuffle.fetch, jit.compile, pipeline.stage, "
-    "exec.batch.  Keys: nth=N (fail the Nth call, 1-based), times=K "
+    "exec.batch, cancel.check.  Keys: nth=N (fail the Nth call, "
+    "1-based), times=K "
     "(with nth: fail K consecutive calls from the Nth; default 1), "
     "every=N (fail every Nth call), prob=P (seeded per-call "
     "probability), seed=S (per-site RNG seed for prob), latency=MS "
@@ -70,7 +76,8 @@ FAULTS_SPEC = register(
 #: the registered sites (a checkpoint at an unknown site is a no-op so
 #: schedules stay forward-compatible, but tests assert against this)
 SITES = ("alloc.device", "transfer.upload", "shuffle.fetch",
-         "jit.compile", "pipeline.stage", "exec.batch")
+         "jit.compile", "pipeline.stage", "exec.batch",
+         "cancel.check")
 
 #: default injected-error text per site — every default carries a
 #: marker execs/retry.is_retryable classifies as transient, so the
@@ -88,6 +95,11 @@ _DEFAULT_MARKERS = {
         "RESOURCE_EXHAUSTED: injected pipeline stage fault",
     "exec.batch":
         "RESOURCE_EXHAUSTED: injected batch processing fault",
+    # deliberately NO retryable marker: an injected cancellation is
+    # converted by check_point into a real token cancel and must fail
+    # fast through the ladder, exactly like a user cancel
+    "cancel.check":
+        "injected cancellation at a cancel.check checkpoint",
 }
 
 

@@ -1,0 +1,509 @@
+"""Cooperative query cancellation: tokens, deadlines, and the
+per-tenant circuit breaker.
+
+The serving tier could admit, share, and fuse work for N tenants
+(PR8/PR12) but never STOP any of it: once admitted, a query ran to
+completion or process death.  The reference leans on Spark's
+task-kill/stage-abort machinery for exactly this (SURVEY §2.9 task
+model); this module is the TPU engine's analog, built cooperative
+(the engine's blocking seams poll, nothing is killed mid-dispatch —
+a TPU program cannot be preempted anyway, so the useful granularity
+is *between* batches and *inside* waits):
+
+- :class:`CancelToken` — one per in-flight query, carried across
+  thread hops with the same capture/attach discipline the tracer's
+  correlation context uses (:func:`current_token` on the dispatching
+  side, :func:`attach_token` on the receiving thread), so prefetch
+  stage producers, the exchange map pool and shared-scan
+  subscribers all observe the same token.  Three trigger sources:
+  explicit ``session.cancel()`` / ``PreparedQuery.cancel()``, a
+  per-query deadline (``spark.rapids.tpu.serving.deadlineMs``,
+  enforced from the admission queue onward so a query whose deadline
+  expires while queued is shed with ZERO device work), and the
+  fault seam below.
+- :func:`check_point` — THE cooperative checkpoint, planted at the
+  engine's stream seams (per-operator batch counting, the pipeline
+  channel waits, the admission wait, retry-ladder re-attempts,
+  shuffle fetch retries, shared-scan subscriber waits, the streaming
+  result fetch).  No token attached = one thread-local read.  It is
+  also the ``cancel.check`` fault-injection site: an armed schedule
+  (robustness/faults.py) converts an injected hit into a REAL
+  cancellation of the current token, so chaos runs exercise the
+  production unwind path deterministically.
+- a per-tenant **circuit breaker**
+  (``serving.breaker.{failureThreshold,cooldownMs}``): a tenant whose
+  admitted queries keep dying (crash or deadline — the poison-query
+  signature) is quarantined at admission (:class:`TenantQuarantined`)
+  for the cooldown instead of re-entering the WFQ queue forever;
+  after the cooldown ONE probe query is admitted (half-open) and its
+  outcome closes or re-opens the breaker.  Explicit user cancels are
+  breaker-neutral.  State machine: closed -> (failureThreshold
+  consecutive failures) open -> (cooldownMs) half-open -> closed on
+  probe success / open on probe failure.
+
+Unwind contract (tested by the cancellation-storm acceptance test):
+a :class:`QueryCancelled` raised at any checkpoint rides the SAME
+teardown paths a failure does — admission entries removed and slots
+released, pipeline producers closed and joined, shared-scan
+leaderships aborted (subscribers fall back), exec trees closed
+(shuffle blocks dropped, SpillableBatches freed), semaphore permits
+released — and the event log records the query with
+``engine="cancelled"`` / ``"deadline_exceeded"``.  A cancelled query
+is an observable outcome, not a leak; the post-storm process gauges
+(permits, store bytes, stage threads, in-flight shares) return to
+baseline exactly.
+
+Cost discipline: ``serving.cancellation.enabled=false`` makes
+:func:`begin` a single conf read returning None, every checkpoint one
+thread-local read, and the engine's plan/readback pattern bit-identical
+to the uncancellable engine (asserted in tests/test_cancellation.py).
+Docs: docs/robustness.md (cancellation semantics), docs/serving.md
+(deadline + breaker operations).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+from spark_rapids_tpu import trace as _tr
+from spark_rapids_tpu.config import register
+from spark_rapids_tpu.serving.scheduler import AdmissionRejected
+
+CANCEL_ENABLED = register(
+    "spark.rapids.tpu.serving.cancellation.enabled", True,
+    "Arm the cooperative cancellation substrate: every collect carries "
+    "a CancelToken honoring session.cancel()/PreparedQuery.cancel(), "
+    "the per-query deadline (serving.deadlineMs) and the per-tenant "
+    "circuit breaker.  Off = one conf read per query, no token exists, "
+    "and the engine's plan/readback pattern is bit-identical to the "
+    "uncancellable engine (docs/robustness.md).")
+
+DEADLINE_MS = register(
+    "spark.rapids.tpu.serving.deadlineMs", 0.0,
+    "Per-query deadline in milliseconds (0 = none).  Enforced from the "
+    "admission queue onward: a query whose deadline expires while "
+    "queued is shed with zero device work (no jit dispatch, no "
+    "upload); one that expires mid-flight unwinds cooperatively at "
+    "the next checkpoint.  Either way the query raises QueryCancelled "
+    "(reason deadline_exceeded) and its event-log record carries "
+    "engine=\"deadline_exceeded\" (docs/serving.md).",
+    check=lambda v: v >= 0)
+
+BREAKER_THRESHOLD = register(
+    "spark.rapids.tpu.serving.breaker.failureThreshold", 0,
+    "Consecutive failed queries (crash or deadline_exceeded; explicit "
+    "cancels are neutral) after which a tenant's circuit breaker "
+    "OPENS: further admissions raise TenantQuarantined for "
+    "breaker.cooldownMs, so a poison query stops consuming WFQ slots. "
+    "0 disables the breaker.  Scoped to the serving tier "
+    "(serving.maxConcurrent > 0).", check=lambda v: v >= 0)
+
+BREAKER_COOLDOWN_MS = register(
+    "spark.rapids.tpu.serving.breaker.cooldownMs", 5000.0,
+    "How long an OPEN tenant breaker quarantines before admitting one "
+    "half-open probe query; the probe's outcome closes the breaker or "
+    "re-opens it for another cooldown (docs/serving.md).",
+    check=lambda v: v >= 0)
+
+BREAKER_MAX_TRIPS = register(
+    "spark.rapids.tpu.serving.breaker.health.maxTrips", 0,
+    "HC013 (tools/history) flags a query window whose "
+    "cancel.breaker_trips counter delta exceeds this — tenants are "
+    "crash-looping into quarantine faster than the fleet should "
+    "tolerate (docs/serving.md).", check=lambda v: v >= 0)
+
+#: poll granularity for interruptible waits (SRC012: every wait on the
+#: serving path is bounded); grants/publishes still wake waiters via
+#: notify, so this bounds only cancel/deadline RESPONSE latency
+WAIT_POLL_S = 0.05
+
+
+class QueryCancelled(RuntimeError):
+    """The query was cancelled (``reason="cancelled"``) or its deadline
+    expired (``reason="deadline_exceeded"``).  NEVER retryable: the
+    retry ladder, the CPU-degrade rung and the fetch retry loop all
+    fail fast on it (execs/retry.is_retryable gates on this type)."""
+
+    def __init__(self, reason: str, detail: str = "",
+                 query_id: Optional[int] = None):
+        msg = reason if not detail else f"{reason}: {detail}"
+        if query_id is not None:
+            msg += f" (query_id={query_id})"
+        super().__init__(msg)
+        self.reason = reason
+        self.detail = detail
+        self.query_id = query_id
+        #: set once a per-query record was emitted, so the outer
+        #: collect wrapper does not double-record
+        self.recorded = False
+
+
+class TenantQuarantined(AdmissionRejected):
+    """This tenant's circuit breaker is OPEN (its queries kept dying):
+    the serving tier sheds the query at admission instead of letting a
+    poison query consume another WFQ slot.  Subclasses
+    AdmissionRejected so load-shedding callers handle both alike;
+    retry after serving.breaker.cooldownMs."""
+
+
+class CancelToken:
+    """One query's cancellation state.  Thread-safe; crossed between
+    threads by capture/attach (see module doc).  ``cancel()`` is
+    first-writer-wins: the first reason sticks."""
+
+    __slots__ = ("tenant", "deadline_ns", "query_id", "reason",
+                 "detail", "_mu")
+
+    def __init__(self, tenant: str = "default",
+                 deadline_ms: Optional[float] = None):
+        self.tenant = tenant
+        self.deadline_ns = (
+            time.monotonic_ns() + int(deadline_ms * 1e6)
+            if deadline_ms else None)
+        self.query_id: Optional[int] = None
+        self.reason: Optional[str] = None
+        self.detail = ""
+        self._mu = threading.Lock()
+
+    def cancel(self, reason: str = "cancelled",
+               detail: str = "") -> bool:
+        """Request cancellation; False if already cancelled (the first
+        reason sticks).  Wakes nothing by itself — the query's blocked
+        seams poll on the WAIT_POLL_S cadence."""
+        with self._mu:
+            if self.reason is not None:
+                return False
+            self.reason = reason
+            self.detail = detail
+        if _tr.TRACER.enabled:
+            _tr.event("cancel.request", reason=reason,
+                      query_id=self.query_id, tenant=self.tenant)
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self.reason is not None
+
+    def expired(self) -> bool:
+        return self.deadline_ns is not None \
+            and time.monotonic_ns() >= self.deadline_ns
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_ns is None:
+            return None
+        return (self.deadline_ns - time.monotonic_ns()) / 1e9
+
+    def check(self) -> None:
+        """Raise :class:`QueryCancelled` if cancelled or past
+        deadline; otherwise return (two attribute reads)."""
+        r = self.reason
+        if r is None and self.deadline_ns is not None \
+                and time.monotonic_ns() >= self.deadline_ns:
+            self.cancel("deadline_exceeded",
+                        detail="per-query deadline "
+                               "(serving.deadlineMs) exceeded")
+            r = self.reason
+        if r is not None:
+            if _tr.TRACER.enabled:
+                _tr.event("cancel.unwind", reason=r,
+                          query_id=self.query_id)
+            raise QueryCancelled(r, self.detail, self.query_id)
+
+
+class TokenSet:
+    """A lock-protected set of live tokens — the session's (and each
+    PreparedQuery's) handle for ``cancel()``."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._toks: set = set()
+
+    def add(self, tok: Optional[CancelToken]) -> None:
+        if tok is None:
+            return
+        with self._mu:
+            self._toks.add(tok)
+
+    def discard(self, tok: Optional[CancelToken]) -> None:
+        if tok is None:
+            return
+        with self._mu:
+            self._toks.discard(tok)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._toks)
+
+    def cancel(self, query_id: Optional[int] = None,
+               reason: str = "cancelled") -> int:
+        """Cancel every tracked in-flight query (or just ``query_id``);
+        returns how many tokens this call newly cancelled.  Queries
+        still in the admission queue have no query id yet and are only
+        matched by the cancel-all form."""
+        with self._mu:
+            toks = list(self._toks)
+        n = 0
+        for t in toks:
+            if query_id is None or t.query_id == query_id:
+                if t.cancel(reason):
+                    n += 1
+        return n
+
+
+# ------------------------------------------------------------------ #
+# Thread-local carry (the tracer-context discipline)
+# ------------------------------------------------------------------ #
+
+_TL = threading.local()
+
+#: process-wide live-token gauge (telemetry's cancel.active)
+_ACTIVE = 0
+_ACTIVE_MU = threading.Lock()
+
+
+def current_token() -> Optional[CancelToken]:
+    """This thread's token (capture on the dispatching side before a
+    thread hop, :func:`attach_token` on the receiving side — exactly
+    the tracer-context / conf-snapshot hop discipline)."""
+    return getattr(_TL, "token", None)
+
+
+@contextlib.contextmanager
+def attach_token(tok: Optional[CancelToken]) -> Iterator[None]:
+    """Install a token on the current thread for the block (a nested
+    query's token shadows the outer one; the outer is restored on
+    exit)."""
+    prev = getattr(_TL, "token", None)
+    _TL.token = tok
+    try:
+        yield
+    finally:
+        _TL.token = prev
+
+
+def check_point() -> None:
+    """THE cooperative cancellation checkpoint (and the
+    ``cancel.check`` fault seam): no token attached = one thread-local
+    read.  An armed injected hit cancels the CURRENT token and unwinds
+    through the real cancellation path — chaos runs exercise the
+    production teardown, not a test-only shortcut."""
+    tok = getattr(_TL, "token", None)
+    if tok is None:
+        return
+    from spark_rapids_tpu.robustness import faults as _faults
+
+    try:
+        _faults.fault_point("cancel.check")
+    except _faults.InjectedFault as e:
+        tok.cancel("cancelled", detail=str(e))
+    tok.check()
+
+
+def poll_timeout(tok: Optional[CancelToken],
+                 default: float = WAIT_POLL_S) -> float:
+    """Bound for one blocking-wait slice: the poll cadence, clipped to
+    the token's remaining deadline so expiry is observed promptly."""
+    if tok is None:
+        return default
+    rem = tok.remaining_s()
+    if rem is None:
+        return default
+    return max(0.0, min(default, rem))
+
+
+# ------------------------------------------------------------------ #
+# Per-query lifecycle (session.py's prologue/epilogue hooks)
+# ------------------------------------------------------------------ #
+
+
+def begin(conf, tenant: str = "default") -> Optional[CancelToken]:
+    """The query-boundary hook: None after ONE conf read when
+    cancellation is disabled; otherwise a fresh token carrying the
+    conf deadline (serving.deadlineMs, 0 = none)."""
+    global _ACTIVE
+    if not conf.get(CANCEL_ENABLED):
+        return None
+    dl = float(conf.get(DEADLINE_MS))
+    tok = CancelToken(tenant, deadline_ms=dl if dl > 0 else None)
+    with _ACTIVE_MU:
+        _ACTIVE += 1
+    return tok
+
+
+def end(tok: Optional[CancelToken]) -> None:
+    global _ACTIVE
+    if tok is None:
+        return
+    with _ACTIVE_MU:
+        _ACTIVE -= 1
+
+
+def active_count() -> int:
+    with _ACTIVE_MU:
+        return _ACTIVE
+
+
+# ------------------------------------------------------------------ #
+# Outcome counters (the event log's cancel.* surface)
+# ------------------------------------------------------------------ #
+
+_STATS_MU = threading.Lock()
+_STATS = {"cancelled": 0, "deadline_exceeded": 0, "breaker_trips": 0,
+          "quarantined": 0}
+
+
+def tick_outcome(reason: str) -> None:
+    """Count one unwound query by reason (session.py's cancellation
+    epilogue calls this exactly once per cancelled query)."""
+    key = "deadline_exceeded" if reason == "deadline_exceeded" \
+        else "cancelled"
+    with _STATS_MU:
+        _STATS[key] += 1
+
+
+def stats() -> dict:
+    with _STATS_MU:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _STATS_MU:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+# ------------------------------------------------------------------ #
+# Per-tenant circuit breaker
+# ------------------------------------------------------------------ #
+
+
+class _Breaker:
+    __slots__ = ("failures", "state", "open_until_ns", "probing")
+
+    def __init__(self):
+        self.failures = 0
+        self.state = "closed"
+        self.open_until_ns = 0
+        self.probing = False
+
+
+_BREAKERS: dict[str, _Breaker] = {}
+_BREAKERS_MU = threading.Lock()
+
+
+def breaker_admit(conf, tenant: str) -> None:
+    """Admission-time gate: raise :class:`TenantQuarantined` while the
+    tenant's breaker is open (or while its half-open probe is still in
+    flight).  Disabled (failureThreshold <= 0, the default) this is
+    one conf read."""
+    thr = int(conf.get(BREAKER_THRESHOLD))
+    if thr <= 0:
+        return
+    now = time.monotonic_ns()
+    with _BREAKERS_MU:
+        b = _BREAKERS.get(tenant)
+        if b is None:
+            b = _BREAKERS[tenant] = _Breaker()
+        if b.state == "open":
+            if now < b.open_until_ns:
+                quarantine = True
+            else:
+                b.state = "half_open"
+                b.probing = True  # this query is the probe
+                quarantine = False
+        elif b.state == "half_open":
+            quarantine = b.probing  # one probe at a time
+            if not quarantine:
+                b.probing = True
+        else:
+            quarantine = False
+        if quarantine:
+            remain_ms = max(0.0, (b.open_until_ns - now) / 1e6) \
+                if b.state == "open" else 0.0
+    if quarantine:
+        with _STATS_MU:
+            _STATS["quarantined"] += 1
+        if _tr.TRACER.enabled:
+            _tr.event("breaker.quarantined", tenant=tenant)
+        raise TenantQuarantined(
+            f"tenant {tenant!r} is quarantined (circuit breaker "
+            f"open after repeated failures; retry in "
+            f"~{remain_ms:.0f}ms or after a successful probe)")
+
+
+def breaker_release(conf, tenant: str) -> None:
+    """Release a claimed half-open probe WITHOUT counting an outcome:
+    the probe query exited through a breaker-neutral path — explicit
+    user cancel, abandoned stream, or it never got admitted at all
+    (queue full, deadline expired while queued).  The breaker stays
+    half-open and the NEXT query becomes the probe; without this, a
+    lost probe would leave ``probing`` set forever and quarantine the
+    tenant with no escape.  No-op for closed/open breakers and when
+    the breaker is disabled."""
+    if int(conf.get(BREAKER_THRESHOLD)) <= 0:
+        return
+    with _BREAKERS_MU:
+        b = _BREAKERS.get(tenant)
+        if b is not None and b.state == "half_open":
+            b.probing = False
+
+
+def breaker_result(conf, tenant: str, ok: bool) -> None:
+    """Outcome hook for an ADMITTED query: success closes/heals, a
+    failure (crash or deadline_exceeded — explicit cancels never reach
+    here) counts toward the threshold; a failed half-open probe
+    re-opens for another cooldown."""
+    thr = int(conf.get(BREAKER_THRESHOLD))
+    if thr <= 0:
+        return
+    cooldown_ns = int(float(conf.get(BREAKER_COOLDOWN_MS)) * 1e6)
+    tripped = False
+    with _BREAKERS_MU:
+        b = _BREAKERS.get(tenant)
+        if b is None:
+            b = _BREAKERS[tenant] = _Breaker()
+        if b.state == "half_open":
+            b.probing = False
+            if ok:
+                b.state = "closed"
+                b.failures = 0
+            else:
+                b.state = "open"
+                b.open_until_ns = time.monotonic_ns() + cooldown_ns
+                tripped = True
+        elif ok:
+            b.failures = 0
+        else:
+            b.failures += 1
+            if b.failures >= thr:
+                b.state = "open"
+                b.open_until_ns = time.monotonic_ns() + cooldown_ns
+                b.failures = 0
+                tripped = True
+    if tripped:
+        with _STATS_MU:
+            _STATS["breaker_trips"] += 1
+        if _tr.TRACER.enabled:
+            _tr.event("breaker.trip", tenant=tenant)
+
+
+def breaker_state(tenant: str) -> str:
+    """'closed' | 'open' | 'half_open' (tests/observability)."""
+    with _BREAKERS_MU:
+        b = _BREAKERS.get(tenant)
+        return b.state if b is not None else "closed"
+
+
+def reset_breakers() -> None:
+    with _BREAKERS_MU:
+        _BREAKERS.clear()
+
+
+def reset() -> None:
+    """Test isolation: breakers + outcome counters (live tokens are
+    owned by their queries and left alone)."""
+    reset_breakers()
+    reset_stats()

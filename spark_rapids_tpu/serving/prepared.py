@@ -57,6 +57,11 @@ class PreparedQuery:
         #: bindings, one group)
         self._group_memo: dict = {}
         self.last_plan_hash: Optional[str] = None
+        #: in-flight CancelTokens of THIS template's executions (the
+        #: PreparedQuery.cancel() scope; serving/cancel.py)
+        from spark_rapids_tpu.serving.cancel import TokenSet
+
+        self._inflight = TokenSet()
 
     # -- resolution -------------------------------------------------- #
 
@@ -154,8 +159,19 @@ class PreparedQuery:
             serving_facts={
                 "plan_cache": "hit" if hit else "miss",
                 "admission_group":
-                    self._group_key(self._session.conf)})
+                    self._group_key(self._session.conf)},
+            token_sink=self._inflight)
         return out
+
+    def cancel(self, reason: str = "cancelled") -> int:
+        """Cooperatively cancel every in-flight execution of THIS
+        template (narrower than ``session.cancel()``): each raises
+        QueryCancelled at its next checkpoint and unwinds cleanly —
+        admission slot released, the entry's re-drain lock freed, the
+        cached exec tree closed back to its re-drainable state.
+        Returns the number of executions newly cancelled.  Requires
+        spark.rapids.tpu.serving.cancellation.enabled (the default)."""
+        return self._inflight.cancel(reason=reason)
 
     def execute_stream(self, params: Optional[dict] = None,
                        batch_rows: Optional[int] = None) -> Iterator:
@@ -174,7 +190,8 @@ class PreparedQuery:
             serving_facts={
                 "plan_cache": "hit" if hit else "miss",
                 "admission_group":
-                    self._group_key(self._session.conf)})
+                    self._group_key(self._session.conf)},
+            token_sink=self._inflight)
 
     # -- introspection ----------------------------------------------- #
 

@@ -117,6 +117,10 @@ class QueryScheduler:
         self._admitted = 0
         self._rejected = 0
         self._coalesced = 0
+        #: queued entries unwound by cancellation/deadline before grant
+        #: (or after an unconsumed grant) — the admission queue's share
+        #: of the cancellation story (docs/robustness.md)
+        self._shed = 0
         self._total_wait_ms = 0.0
         self._waits_ms: deque = deque(maxlen=4096)
 
@@ -182,15 +186,29 @@ class QueryScheduler:
 
     def admit(self, tenant: str = "default",
               priority: Optional[int] = None,
-              group: Optional[str] = None) -> _Entry:
+              group: Optional[str] = None, token=None) -> _Entry:
         """Block until this query is admitted (or raise
         :class:`AdmissionRejected` when the queue is full).  Returns
         the ticket to hand back to :meth:`release`.  `group` is the
-        optional template-group key batching coalesces on."""
+        optional template-group key batching coalesces on.
+
+        ``token`` (a serving/cancel CancelToken) makes the admission
+        wait INTERRUPTIBLE: the wait polls on the cancel cadence
+        bounded by the token's remaining deadline, so a query whose
+        deadline expires (or that is cancelled) WHILE QUEUED is shed
+        here — entry removed, no device work ever dispatched — with
+        QueryCancelled raised to the caller.  An already-expired
+        deadline sheds before the entry is even enqueued."""
+        from spark_rapids_tpu.serving.cancel import poll_timeout
+
         prio = int(priority) if priority is not None \
             else self.default_priority
         t0 = time.perf_counter_ns()
         with self._cv:
+            if token is not None:
+                # expired-before-admission: shed with zero queue time
+                # (the zero-device-work contract starts here)
+                token.check()
             te = self._tenants.get(tenant)
             if te is None:
                 te = self._tenants[tenant] = _Tenant(tenant)
@@ -219,12 +237,20 @@ class QueryScheduler:
             waited = not entry.granted
             try:
                 while not entry.granted:
-                    self._cv.wait()
+                    # bounded wait (tpulint SRC012: every serving-path
+                    # wait is interruptible): grants still arrive via
+                    # notify; the timeout only bounds cancel/deadline
+                    # response latency
+                    self._cv.wait(poll_timeout(token))
+                    if token is not None and not entry.granted:
+                        token.check()
             except BaseException:
-                # interrupted wait (KeyboardInterrupt, injected test
-                # abort): unwind the entry, or the pump would later
-                # grant a slot nobody will ever release and admission
-                # wedges for the process lifetime
+                # interrupted wait (cancellation/deadline shed,
+                # KeyboardInterrupt, injected test abort): unwind the
+                # entry, or the pump would later grant a slot nobody
+                # will ever release and admission wedges for the
+                # process lifetime
+                self._shed += 1
                 if entry in self._waiting:
                     self._waiting.remove(entry)
                 elif entry.granted:
@@ -267,6 +293,7 @@ class QueryScheduler:
                 "admitted": self._admitted,
                 "rejected": self._rejected,
                 "coalesced": self._coalesced,
+                "shed": self._shed,
                 "running": self._running,
                 "waiting": len(self._waiting),
                 "total_wait_ms": round(self._total_wait_ms, 3),
@@ -338,9 +365,9 @@ def scheduler_stats() -> dict:
     with _LOCK:
         s = _SCHED
     return s.stats() if s is not None else {
-        "admitted": 0, "rejected": 0, "coalesced": 0, "running": 0,
-        "waiting": 0, "total_wait_ms": 0.0, "wait_p50_ms": 0.0,
-        "wait_p99_ms": 0.0}
+        "admitted": 0, "rejected": 0, "coalesced": 0, "shed": 0,
+        "running": 0, "waiting": 0, "total_wait_ms": 0.0,
+        "wait_p50_ms": 0.0, "wait_p99_ms": 0.0}
 
 
 def reset() -> None:
@@ -354,7 +381,7 @@ def reset() -> None:
 @contextmanager
 def admission(conf, tenant: str = "default",
               priority: Optional[int] = None,
-              group: Optional[str] = None):
+              group: Optional[str] = None, token=None):
     """The query-boundary hook: a no-op single conf read when serving
     admission is disabled (maxConcurrent <= 0); otherwise admit through
     the process scheduler for the duration of the block.  Re-entrant
@@ -362,7 +389,15 @@ def admission(conf, tenant: str = "default",
     subquery prepass, CPU-compare runs inside an admitted bench driver)
     passes straight through instead of deadlocking against itself.
     `group` (optional, the prepared template's binding-independent
-    key) feeds admission-aware batching."""
+    key) feeds admission-aware batching.
+
+    ``token`` threads the query's CancelToken into the admission wait
+    (deadline/cancel shed while queued — serving/cancel.py) and this
+    block reports the ADMITTED query's outcome to the tenant's circuit
+    breaker: success heals, a crash or deadline_exceeded counts toward
+    serving.breaker.failureThreshold, an explicit user cancel is
+    neutral.  A quarantined tenant is shed BEFORE taking a WFQ slot
+    (TenantQuarantined)."""
     if int(conf.get(MAX_CONCURRENT)) <= 0:
         try:
             yield None
@@ -391,15 +426,47 @@ def admission(conf, tenant: str = "default",
             if outer_ctx:
                 update_serving_context(**outer_ctx)
         return
+    from spark_rapids_tpu.serving import cancel as _cancel
+
+    _cancel.breaker_admit(conf, tenant)  # may raise TenantQuarantined
     sched = get_scheduler(conf)
-    ticket = sched.admit(tenant, priority, group=group)
+    try:
+        ticket = sched.admit(tenant, priority, group=group,
+                             token=token)
+    except BaseException:
+        # shed before admission (queue full, deadline expired while
+        # queued, interrupt): if breaker_admit claimed the half-open
+        # probe for this query, release it — a lost probe must not
+        # leave the tenant quarantined forever
+        _cancel.breaker_release(conf, tenant)
+        raise
     tl.depth = 1
+    outcome = "failure"
     try:
         yield ticket
+        outcome = "success"
+    except _cancel.QueryCancelled as e:
+        # deadline mid-flight = the hang signature (counts toward the
+        # breaker); an explicit user cancel says nothing about the
+        # query's health
+        outcome = "failure" if e.reason == "deadline_exceeded" \
+            else "neutral"
+        raise
+    except GeneratorExit:
+        # a stream consumer closing early (the documented early-close
+        # pattern) is not a query failure — breaker-neutral
+        outcome = "neutral"
+        raise
     finally:
         tl.depth = 0
         sched.release(ticket)
         clear_serving_context()
+        if outcome != "neutral":
+            _cancel.breaker_result(conf, tenant,
+                                   ok=outcome == "success")
+        else:
+            # neutral exits still release a claimed half-open probe
+            _cancel.breaker_release(conf, tenant)
 
 
 _ADMITTED_TL = threading.local()

@@ -466,12 +466,21 @@ class ScanShareEntry:
         order: buffered units first, then live as the leader produces
         them.  Raises :class:`ScanShareAborted` when the leader
         abandons mid-stream (the consumer's fallback skips what it
-        already received)."""
+        already received), or QueryCancelled when the SUBSCRIBER's own
+        query is cancelled while waiting for the leader (the wait is
+        bounded and cancel-aware — SRC012; the subscriber's release
+        path runs normally, and a cancelled LEADER aborts the entry
+        through its drain finally, waking everyone here)."""
+        from spark_rapids_tpu.serving import cancel as _cancel
+
         i = 0
         while True:
             with self._cv:
+                tok = _cancel.current_token()
                 while i >= len(self._units) and not self._done:
-                    self._cv.wait()
+                    self._cv.wait(_cancel.poll_timeout(tok))
+                    if tok is not None:
+                        tok.check()
                 if i < len(self._units):
                     unit = self._units[i]
                     dev = self._device.get(i)

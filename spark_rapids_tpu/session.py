@@ -327,6 +327,12 @@ class TpuSession:
         #: _collect_tpu (docs/eventlog.md)
         self._eventlog = maybe_writer(self.conf)
         self._plan_cache = None  # lazy; most sessions never prepare
+        #: in-flight CancelTokens of this session's queries (the
+        #: session.cancel() surface; serving/cancel.py) — empty and
+        #: untouched while serving.cancellation.enabled is false
+        from spark_rapids_tpu.serving.cancel import TokenSet
+
+        self._tokens = TokenSet()
 
     @property
     def plan_cache(self):
@@ -357,6 +363,24 @@ class TpuSession:
         pq = PreparedQuery(self, df=df)
         pq._resolve(None)  # warm: pay the lowering at prepare time
         return pq
+
+    def cancel(self, query_id: Optional[int] = None,
+               reason: str = "cancelled") -> int:
+        """Cooperatively cancel this session's in-flight queries (all
+        of them, or just ``query_id`` — the id ``_collect_tpu``
+        returns and the history/event log record).  The cancelled
+        collect/stream raises
+        :class:`~spark_rapids_tpu.serving.cancel.QueryCancelled` at
+        its next checkpoint and unwinds cleanly (admission slot
+        released, pipeline stages joined, exec tree closed); its
+        event-log record carries ``engine="cancelled"``.  Returns how
+        many queries this call newly cancelled (0 when none matched —
+        a query that already finished cannot be cancelled).  Requires
+        spark.rapids.tpu.serving.cancellation.enabled (the default);
+        queries still waiting in the admission queue have no id yet
+        and are only reached by the cancel-all form
+        (docs/robustness.md)."""
+        return self._tokens.cancel(query_id, reason)
 
     @property
     def event_log_path(self) -> Optional[str]:
@@ -1018,7 +1042,8 @@ class DataFrame:
         return self._collect_tpu()[0]
 
     def _collect_tpu(self, exec_=None, meta=None, drain_lock=None,
-                     serving_facts=None) -> tuple[pa.Table, int]:
+                     serving_facts=None,
+                     token_sink=None) -> tuple[pa.Table, int]:
         """TPU-engine collect; returns (result, query_id) so callers
         that need the history/trace correlation key (EXPLAIN ANALYZE)
         can find THEIR event instead of trusting events[-1] under
@@ -1046,34 +1071,98 @@ class DataFrame:
         before the drain lock: a hit returns the cached result with
         zero plan/lower/compile/scan work, and a completed miss
         offers its result back (docs/work_sharing.md).  Disabled =
-        one conf read."""
+        one conf read.
+
+        Cancellation (serving/cancel.py): the query carries a
+        CancelToken (one conf read + None when
+        serving.cancellation.enabled is false) honoring
+        session.cancel(), the serving deadline and the tenant
+        breaker; a cancelled query unwinds through the normal
+        teardown paths, is recorded with engine="cancelled"/
+        "deadline_exceeded", and raises QueryCancelled.
+        ``token_sink`` (a cancel.TokenSet) additionally tracks the
+        token for a narrower cancel scope (PreparedQuery.cancel)."""
         import contextlib
 
         conf = self._session.conf
         from spark_rapids_tpu.serving import update_serving_context
+        from spark_rapids_tpu.serving import cancel as _cancel
         from spark_rapids_tpu.serving.scheduler import admission
 
         facts = dict(serving_facts) if serving_facts else None
         group = facts.pop("admission_group", None) if facts else None
-        with admission(conf, tenant=self._session.tenant,
-                       priority=self._session.priority, group=group):
-            if facts:
-                update_serving_context(**facts)
-            from spark_rapids_tpu.serving import work_share as _ws
+        tok = _cancel.begin(conf, tenant=self._session.tenant)
+        self._session._tokens.add(tok)
+        if token_sink is not None:
+            token_sink.add(tok)
+        try:
+            with (_cancel.attach_token(tok) if tok is not None
+                  else contextlib.nullcontext()), \
+                    admission(conf, tenant=self._session.tenant,
+                              priority=self._session.priority,
+                              group=group, token=tok):
+                if facts:
+                    update_serving_context(**facts)
+                from spark_rapids_tpu.serving import work_share as _ws
 
-            sharing = _ws.enabled(conf)
-            if sharing:
-                cached, verdict = _ws.lookup_result(self._plan, conf)
-                if verdict is not None:
-                    update_serving_context(result_cache=verdict)
-                if cached is not None:
-                    return self._result_cache_hit(cached, meta)
-            with drain_lock if drain_lock is not None \
-                    else contextlib.nullcontext():
-                out, qid = self._collect_tpu_admitted(exec_, meta)
-            if sharing:
-                _ws.offer_result(self._plan, conf, out)
-            return out, qid
+                sharing = _ws.enabled(conf)
+                if sharing:
+                    cached, verdict = _ws.lookup_result(self._plan,
+                                                        conf)
+                    if verdict is not None:
+                        update_serving_context(result_cache=verdict)
+                    if cached is not None:
+                        return self._result_cache_hit(cached, meta)
+                with drain_lock if drain_lock is not None \
+                        else contextlib.nullcontext():
+                    out, qid = self._collect_tpu_admitted(exec_, meta)
+                if sharing:
+                    _ws.offer_result(self._plan, conf, out)
+                return out, qid
+        except _cancel.QueryCancelled as e:
+            self._record_cancelled(e)
+            raise
+        finally:
+            self._session._tokens.discard(tok)
+            if token_sink is not None:
+                token_sink.discard(tok)
+            _cancel.end(tok)
+
+    def _record_cancelled(self, e) -> None:
+        """Cancellation epilogue: count the outcome once, and when the
+        query unwound BEFORE its execution prologue ran (deadline
+        expired in the admission queue), emit the per-query record
+        HERE with ``engine=e.reason`` and a zero counter delta — a
+        cancelled query is an observable outcome, not a gap.
+        Mid-flight cancels were already recorded (with their partial
+        metrics) by the admitted/stream paths."""
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.serving import cancel as _cancel
+
+        _cancel.tick_outcome(e.reason)
+        if e.recorded:
+            return
+        conf = self._session.conf
+        qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
+            _begin_query(self._session, conf)
+        if e.query_id is None:
+            e.query_id = qid
+        expl = (f"CancelledBeforeExecution [{e.reason}: shed in the "
+                f"admission queue; no operator ran]\n")
+
+        def _on_event():
+            if elog is None:
+                return None
+            post = elog.query_end(pre)
+            return lambda ev: elog.log_query(ev, post, expl, e.reason)
+
+        with _trace.trace_context(query_id=qid):
+            if _trace.TRACER.enabled:
+                _trace.event("cancel.shed", query_id=qid,
+                             reason=e.reason)
+        _record_query(self._session, expl, None, qid, conf_hash,
+                      start_ts, t0, t0_ns, _on_event())
+        e.recorded = True
 
     def _result_cache_hit(self, out: pa.Table,
                           meta) -> tuple[pa.Table, int]:
@@ -1121,6 +1210,12 @@ class DataFrame:
 
         qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
             _begin_query(self._session, conf)
+        from spark_rapids_tpu.serving import cancel as _cancel
+
+        tok = _cancel.current_token()
+        if tok is not None:
+            # the id session.cancel(query_id) targets from now on
+            tok.query_id = qid
         baseline = None
         if exec_ is not None:
             # re-draining a CACHED exec tree (the prepared-plan hit
@@ -1141,14 +1236,16 @@ class DataFrame:
             this query's attribution.  The result digest and the
             annotated-plan render are deferred to the worker: both
             read immutable state, and neither belongs on collect()'s
-            critical path."""
+            critical path.  `result` is None for unwound (cancelled)
+            queries: no digest, no rows — the record still lands."""
             if elog is None:
                 return None
             post = elog.query_end(pre)
             return lambda ev: elog.log_query(
                 ev, post, render_plan(), engine,
-                result_digest=table_digest(result),
-                rows=result.num_rows)
+                result_digest=table_digest(result)
+                if result is not None else None,
+                rows=result.num_rows if result is not None else None)
 
         with _trace.trace_context(query_id=qid):
             if exec_ is None:
@@ -1157,6 +1254,23 @@ class DataFrame:
             try:
                 with _trace.span("query.execute"):
                     out = collect_exec(exec_)
+            except _cancel.QueryCancelled as e:
+                # cooperative unwind mid-flight: the drain loop's
+                # close-on-raise already tore the tree down (pipeline
+                # stages joined, shuffle blocks dropped); record the
+                # query as an observable cancelled outcome with its
+                # partial metric deltas, then let it propagate
+                if e.query_id is None:
+                    e.query_id = qid
+                expl = (meta.explain()
+                        + f"\n[query unwound: {e.reason}]")
+                _record_query(
+                    self._session, expl, exec_, qid, conf_hash,
+                    start_ts, t0, t0_ns,
+                    _on_event(lambda: expl, e.reason, None),
+                    baseline=baseline)
+                e.recorded = True
+                raise
             except BaseException as e:
                 from spark_rapids_tpu.execs.retry import (
                     should_cpu_fallback,
@@ -1199,7 +1313,8 @@ class DataFrame:
 
     def _stream_tpu(self, exec_=None, meta=None,
                     batch_rows: Optional[int] = None,
-                    drain_lock=None, serving_facts=None):
+                    drain_lock=None, serving_facts=None,
+                    token_sink=None):
         """Streaming TPU collect (serving tier): yield the result as
         Arrow record batches INCREMENTALLY off the pipelined fetch path
         (planner.stream_exec) instead of one materialized table, with
@@ -1216,13 +1331,44 @@ class DataFrame:
         from spark_rapids_tpu import trace as _trace
         from spark_rapids_tpu.plan.planner import stream_exec
         from spark_rapids_tpu.serving import update_serving_context
+        from spark_rapids_tpu.serving import cancel as _cancel
         from spark_rapids_tpu.serving.scheduler import admission
 
         conf = self._session.conf
         facts = dict(serving_facts) if serving_facts else None
         group = facts.pop("admission_group", None) if facts else None
+        tok = _cancel.begin(conf, tenant=self._session.tenant)
+        self._session._tokens.add(tok)
+        if token_sink is not None:
+            token_sink.add(tok)
+        try:
+            yield from self._stream_tpu_cancellable(
+                exec_, meta, batch_rows, drain_lock, facts, group,
+                tok)
+        except _cancel.QueryCancelled as e:
+            self._record_cancelled(e)
+            raise
+        finally:
+            self._session._tokens.discard(tok)
+            if token_sink is not None:
+                token_sink.discard(tok)
+            _cancel.end(tok)
+
+    def _stream_tpu_cancellable(self, exec_, meta, batch_rows,
+                                drain_lock, facts, group, tok):
+        import contextlib
+        import time as _time
+
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.plan.planner import stream_exec
+        from spark_rapids_tpu.serving import update_serving_context
+        from spark_rapids_tpu.serving import cancel as _cancel
+        from spark_rapids_tpu.serving.scheduler import admission
+
+        conf = self._session.conf
         with admission(conf, tenant=self._session.tenant,
-                       priority=self._session.priority, group=group), \
+                       priority=self._session.priority, group=group,
+                       token=tok), \
                 (drain_lock if drain_lock is not None
                  else contextlib.nullcontext()):
             if facts:
@@ -1243,6 +1389,8 @@ class DataFrame:
                     return
             qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
                 _begin_query(self._session, conf)
+            if tok is not None:
+                tok.query_id = qid
             baseline = None
             if exec_ is not None:
                 # cached-tree re-drain: record per-execution metric
@@ -1252,7 +1400,8 @@ class DataFrame:
                 )
 
                 baseline = snapshot_exec(exec_)
-            with _trace.trace_context(query_id=qid):
+            with _trace.trace_context(query_id=qid), \
+                    _cancel.attach_token(tok):
                 if exec_ is None:
                     with _trace.span("query.plan"):
                         exec_, meta = plan_query(self._plan, conf)
@@ -1261,14 +1410,40 @@ class DataFrame:
             gen = stream_exec(exec_, stage="serve.stream.fetch")
             try:
                 while True:
-                    # re-attach the query's trace context around each
-                    # pull (NOT across yields: the consumer's own work
-                    # between pulls must not inherit this query's id)
-                    with _trace.attach_context(tctx):
+                    # re-attach the query's trace context AND cancel
+                    # token around each pull (NOT across yields: the
+                    # consumer's own work between pulls must not
+                    # inherit this query's id or its cancel scope)
+                    with _trace.attach_context(tctx), \
+                            _cancel.attach_token(tok):
                         try:
                             tbl = next(gen)
                         except StopIteration:
                             break
+                        except _cancel.QueryCancelled as e:
+                            # record the unwound stream (partial rows,
+                            # no digest) before propagating — an
+                            # ABANDONED stream records nothing, a
+                            # CANCELLED one is an observable outcome
+                            if e.query_id is None:
+                                e.query_id = qid
+                            expl = (meta.explain()
+                                    + f"\n[stream unwound: {e.reason}]")
+
+                            def _on_cancel_event():
+                                if elog is None:
+                                    return None
+                                post = elog.query_end(pre)
+                                return lambda ev: elog.log_query(
+                                    ev, post, expl, e.reason,
+                                    result_digest=None, rows=rows)
+
+                            _record_query(
+                                self._session, expl, exec_, qid,
+                                conf_hash, start_ts, t0, t0_ns,
+                                _on_cancel_event(), baseline=baseline)
+                            e.recorded = True
+                            raise
                     rows += tbl.num_rows
                     for rb in tbl.to_batches(max_chunksize=batch_rows):
                         yield rb

@@ -55,7 +55,11 @@ FETCH_MAX_ATTEMPTS = register(
     "spark.shuffle.io.maxRetries).  Between attempts the client backs "
     "off exponentially with jitter; callers that supply a resolver "
     "(net.peer_resolver over the heartbeat registry) get the peer "
-    "address re-resolved before the final attempt.",
+    "address re-resolved before every retry after the first (with "
+    "only two attempts budgeted, before that sole retry) — a "
+    "restarted peer on a fresh port is found early, not only on the "
+    "last-ditch attempt.  The query's cancel token is honored between "
+    "attempts (a cancelled reducer stops reconnecting immediately).",
     check=lambda v: v >= 1)
 
 FETCH_BACKOFF_S = register(
@@ -253,14 +257,20 @@ def fetch_blocks(host: str, port: int, shuffle_id: int, reduce_id: int,
     dicts, with BOUNDED RETRIES inside the fetch itself (ref:
     RetryingBlockTransferor / spark.shuffle.io.maxRetries): each
     attempt gets its own socket timeout; between attempts the client
-    sleeps a jittered doubling backoff; before the LAST attempt a
-    persistent failure re-resolves the peer through ``resolve_peer``
-    (typically HeartbeatManager.live_peers via ``peer_resolver``) in
-    case the executor came back on a new port.  Only after the budget
-    is spent does FetchFailedError propagate — the task-retry layer
-    then provides the coarser elasticity, as before."""
+    honors the query's cancel token (a cancelled reducer raises
+    QueryCancelled instead of reconnecting) and sleeps a jittered
+    doubling backoff; from the SECOND retry on, every attempt first
+    re-resolves the peer through ``resolve_peer`` (typically
+    HeartbeatManager.live_peers via ``peer_resolver``) — a restarted
+    executor re-registers on a fresh port, and finding it early saves
+    whole backoff rounds hammering a dead address (the first retry
+    skips resolution: transient resets on a LIVE peer are the common
+    case and the registry round trip is not free).  Only after the
+    budget is spent does FetchFailedError propagate — the task-retry
+    layer then provides the coarser elasticity, as before."""
     from spark_rapids_tpu.config import get_conf
     from spark_rapids_tpu.robustness import faults as _faults
+    from spark_rapids_tpu.serving.cancel import check_point
 
     conf = get_conf()
     if timeout is None:
@@ -276,13 +286,20 @@ def fetch_blocks(host: str, port: int, shuffle_id: int, reduce_id: int,
             if attempt == attempts - 1:
                 raise
             caught.append(e)
+            check_point()  # cancelled mid-fetch: stop reconnecting
             from spark_rapids_tpu.execs.retry import _sleep_backoff
 
             _sleep_backoff(backoff, attempt)
-            if resolve_peer is not None and attempt == attempts - 2:
-                # persistent failure: one re-resolution before the
-                # final attempt (a restarted peer re-registers with a
-                # fresh endpoint; its old address never recovers)
+            if resolve_peer is not None \
+                    and attempt >= min(1, attempts - 2):
+                # persistent failure (two attempts on this address
+                # died): re-resolve before EVERY further attempt — a
+                # restarted peer re-registers with a fresh endpoint
+                # and is found as early as the registry knows it,
+                # not only before the last-ditch attempt.  With only
+                # two attempts budgeted the sole retry IS the final
+                # attempt, so resolution fires before it (min clamp)
+                # rather than never
                 try:
                     fresh = resolve_peer()
                 except Exception as re_exc:  # noqa: BLE001 — resolver is best-effort

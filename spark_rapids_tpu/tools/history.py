@@ -640,6 +640,48 @@ def _hc_result_cache_thrash(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_cancellation_leak(q: QueryRecord) -> Optional[str]:
+    """HC013: cancellation-storm health.  Two triggers:
+
+    (a) a CANCELLED query record (engine "cancelled" /
+    "deadline_exceeded") whose end-of-query residency gauges —
+    semaphore permits in use, live pipeline stage threads, in-flight
+    shared-scan entries — did not return to zero: the unwind leaked.
+    The gauges are process-wide, so a concurrent fleet may carry
+    another query's residency here (warning severity for that
+    reason); in a serialized storm replay a nonzero reading is a real
+    leak (docs/robustness.md).
+
+    (b) any query window whose cancel.breaker_trips counter delta
+    exceeds spark.rapids.tpu.serving.breaker.health.maxTrips —
+    tenants are crash-looping into quarantine faster than the fleet
+    should tolerate (docs/serving.md)."""
+    if q.engine in ("cancelled", "deadline_exceeded"):
+        leaked = {g: int(q.counter(g)) for g in
+                  ("semaphore.in_use", "pipeline.stage_threads",
+                   "scan.inflight")
+                  if q.counter(g) > 0}
+        if leaked:
+            return (f"{q.engine} query left nonzero residency gauges "
+                    f"{leaked} at query end — the cooperative unwind "
+                    "leaked (or a concurrent query held residency); "
+                    "permits/stage threads/scan shares must return "
+                    "to baseline (docs/robustness.md)")
+    trips = q.counter("cancel.breaker_trips")
+    if trips > 0:
+        from spark_rapids_tpu.config import get_conf
+        from spark_rapids_tpu.serving.cancel import BREAKER_MAX_TRIPS
+
+        budget = int(get_conf().get(BREAKER_MAX_TRIPS))
+        if trips > budget:
+            return (f"{int(trips)} circuit-breaker trip(s) in this "
+                    f"query window (> {budget} budget, "
+                    "serving.breaker.health.maxTrips) — a tenant is "
+                    "crash-looping into quarantine "
+                    "(docs/serving.md)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -652,7 +694,8 @@ for _id, _sev, _fn in (
         ("HC009", "warning", _hc_admission_wait),
         ("HC010", "warning", _hc_dispatch_overhead),
         ("HC011", "warning", _hc_roofline_budget),
-        ("HC012", "warning", _hc_result_cache_thrash)):
+        ("HC012", "warning", _hc_result_cache_thrash),
+        ("HC013", "warning", _hc_cancellation_leak)):
     register_health_rule(_id, _sev, _fn)
 
 

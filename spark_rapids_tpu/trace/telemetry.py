@@ -74,7 +74,12 @@ def sample_now() -> dict:
     `telemetry` record persists exactly this dict)."""
     from spark_rapids_tpu.memory.semaphore import TpuSemaphore
     from spark_rapids_tpu.memory.store import peek_store
-    from spark_rapids_tpu.parallel.pipeline import stage_snapshot
+    from spark_rapids_tpu.parallel.pipeline import (
+        live_stage_threads,
+        stage_snapshot,
+    )
+    from spark_rapids_tpu.serving import cancel as _cancel
+    from spark_rapids_tpu.serving import work_share as _ws
     from spark_rapids_tpu.serving.scheduler import queue_gauges
 
     # peek, never create: the singleton store snapshots budgets + the
@@ -102,6 +107,13 @@ def sample_now() -> dict:
         "pipeline.occupancy": round(weighted / items, 3)
         if items else 0.0,
         "pipeline.items": int(items),
+        # the cancellation tier's live-serving gauges: in-flight
+        # tokens, live stage producer threads and in-flight shared
+        # scans — all must return to baseline after a cancellation
+        # storm (docs/robustness.md)
+        "cancel.active": _cancel.active_count(),
+        "pipeline.stage_threads": live_stage_threads(),
+        "scan.inflight": _ws.SCAN_REGISTRY.inflight(),
     }
 
 

@@ -26,6 +26,49 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def leak_check():
+    """Reusable process-residency leak gauge (docs/robustness.md):
+    snapshots semaphore permits in use, BufferStore bytes per tier,
+    live prefetch stage threads and the in-flight shared-scan count at
+    setup, and asserts at teardown that every gauge returned EXACTLY
+    to baseline (with a bounded settle wait for stage threads still
+    unwinding).  Yields the snapshot callable so tests can also diff
+    mid-test.  Suite-wide usage: test_serving.py, test_work_share.py
+    and test_cancellation.py wrap it in a module-level autouse
+    fixture, turning "no leaks" from a one-off assert into coverage
+    every test in those modules carries."""
+    import time as _time
+
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.memory.store import peek_store
+    from spark_rapids_tpu.parallel.pipeline import live_stage_threads
+    from spark_rapids_tpu.serving.work_share import SCAN_REGISTRY
+
+    def snap() -> dict:
+        store = peek_store()
+        ss = store.spill_stats() if store is not None else {
+            "device_used": 0, "host_used": 0, "disk_used": 0}
+        return {
+            "semaphore_in_use": TpuSemaphore.usage_now()["in_use"],
+            "store_device_bytes": ss["device_used"],
+            "store_host_bytes": ss["host_used"],
+            "store_disk_bytes": ss["disk_used"],
+            "stage_threads": live_stage_threads(),
+            "scan_inflight": SCAN_REGISTRY.inflight(),
+        }
+
+    before = snap()
+    yield snap
+    deadline = _time.monotonic() + 5.0
+    after = snap()
+    while after != before and _time.monotonic() < deadline:
+        _time.sleep(0.05)  # stage threads may still be joining
+        after = snap()
+    assert after == before, (
+        f"process residency leaked: before={before} after={after}")
+
+
 @pytest.fixture(autouse=True)
 def _isolate_conf():
     """Snapshot/restore the thread-local conf so a test's conf.set()

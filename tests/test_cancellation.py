@@ -1,0 +1,682 @@
+"""End-to-end query cancellation, deadline propagation and
+poison-query quarantine (serving/cancel.py, docs/robustness.md):
+
+- token semantics (cancel / deadline / first-writer-wins) and the
+  never-retryable classification;
+- explicit session.cancel()/PreparedQuery.cancel() mid-flight, with
+  the event log recording engine="cancelled";
+- THE zero-device-work contract: a deadline expiring in the admission
+  queue sheds the query with 0 jit dispatches, 0 ledger program
+  activity and 0 tapped upload bytes, recorded
+  engine="deadline_exceeded";
+- the per-tenant circuit breaker state machine (closed -> open ->
+  half-open probe -> closed/open) and its blast-radius isolation;
+- the disabled posture: one conf read per query and a
+  plan/dispatch/readback pattern bit-identical to the uncancellable
+  engine;
+- the ``cancel.check`` fault seam driving deterministic cancels
+  through the real unwind path;
+- THE cancellation-storm acceptance test: N concurrent sessions,
+  random cancels and deadlines mid-flight under an armed chaos
+  schedule — every SURVIVING query digest bit-identical to the serial
+  fault-free run, and every process residency gauge back at baseline.
+
+Every test in this module additionally carries the suite-wide leak
+gauge (conftest.leak_check): permits, store bytes per tier, stage
+threads and in-flight scan shares must return exactly to baseline."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import get_conf, set_conf, TpuConf
+from spark_rapids_tpu.eventlog import table_digest
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.robustness import faults
+from spark_rapids_tpu.serving import cancel as C
+from spark_rapids_tpu.serving import (
+    clear_serving_context,
+    scheduler as scheduler_mod,
+)
+from spark_rapids_tpu.session import TpuSession, col, count_star, sum_
+
+DEADLINE = "spark.rapids.tpu.serving.deadlineMs"
+MAXC = "spark.rapids.tpu.serving.maxConcurrent"
+THRESH = "spark.rapids.tpu.serving.breaker.failureThreshold"
+COOLDOWN = "spark.rapids.tpu.serving.breaker.cooldownMs"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_cancel():
+    from spark_rapids_tpu.memory.store import reset_store
+
+    scheduler_mod.reset()
+    C.reset()
+    clear_serving_context()
+    TpuSemaphore.reset()
+    # fresh store: earlier modules' cached entries (df.cache, shared
+    # results) would otherwise migrate tiers under this module's
+    # memory pressure and false-positive the exact-baseline leak gauge
+    reset_store()
+    yield
+    faults.disarm()
+    scheduler_mod.reset()
+    C.reset()
+    clear_serving_context()
+    TpuSemaphore.reset()
+    from spark_rapids_tpu import trace
+
+    trace.disable()
+    trace.clear()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaks(leak_check):
+    """Every cancellation test proves its unwind leaked nothing
+    (conftest.leak_check)."""
+    yield
+
+
+def _table(n=20000, keys=64, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, keys, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _agg_df(session, t):
+    return (session.create_dataframe(t)
+            .group_by(col("k"))
+            .agg((sum_(col("v")), "sv"), (count_star(), "n"))
+            .order_by(col("k")))
+
+
+# ------------------------------------------------------------------ #
+# Token semantics + classification
+# ------------------------------------------------------------------ #
+
+
+def test_token_semantics():
+    tok = C.CancelToken("t0")
+    assert not tok.cancelled and tok.remaining_s() is None
+    tok.check()  # no-op
+    assert tok.cancel() and not tok.cancel("deadline_exceeded")
+    assert tok.reason == "cancelled"  # first writer wins
+    with pytest.raises(C.QueryCancelled) as ei:
+        tok.check()
+    assert ei.value.reason == "cancelled"
+
+    dl = C.CancelToken("t0", deadline_ms=1.0)
+    assert dl.remaining_s() is not None
+    time.sleep(0.01)
+    assert dl.expired()
+    with pytest.raises(C.QueryCancelled) as ei:
+        dl.check()
+    assert ei.value.reason == "deadline_exceeded"
+
+    ts = C.TokenSet()
+    a, b = C.CancelToken(), C.CancelToken()
+    b.query_id = 7
+    ts.add(a), ts.add(b)
+    assert ts.cancel(query_id=7) == 1 and b.cancelled \
+        and not a.cancelled
+    assert ts.cancel() == 1  # the remaining one
+
+
+def test_query_cancelled_never_retryable():
+    from spark_rapids_tpu.execs.retry import (
+        is_retryable,
+        should_cpu_fallback,
+    )
+
+    e = C.QueryCancelled("deadline_exceeded", "x", query_id=3)
+    assert not is_retryable(e)
+    assert not should_cpu_fallback(e)
+    # the message must not marker-match into the retry ladder even
+    # though DEADLINE_EXCEEDED (uppercase) is a retryable marker
+    assert "deadline_exceeded" in str(e)
+
+
+def test_checkpoint_is_inert_without_token():
+    C.check_point()  # no token attached: a no-op, never a raise
+    with C.attach_token(None):
+        C.check_point()
+
+
+# ------------------------------------------------------------------ #
+# Explicit cancel + records
+# ------------------------------------------------------------------ #
+
+
+def test_explicit_cancel_unwinds_and_records(tmp_path):
+    from spark_rapids_tpu.tools.history import load_application
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.eventLog.enabled", True)
+    conf.set("spark.rapids.tpu.eventLog.dir", str(tmp_path))
+    s = TpuSession(conf)
+    df = _agg_df(s, _table())
+    df.collect(engine="tpu")  # warm compile caches
+
+    # cancel from a second thread while the collect is mid-flight
+    stop = threading.Event()
+
+    def canceller():
+        while not stop.is_set():
+            s.cancel()
+            time.sleep(0.0005)
+
+    th = threading.Thread(target=canceller)
+    th.start()
+    try:
+        with pytest.raises(C.QueryCancelled) as ei:
+            df.collect(engine="tpu")
+    finally:
+        stop.set()
+        th.join()
+    assert ei.value.reason == "cancelled"
+    assert C.stats()["cancelled"] == 1
+    _ = s.history.events  # drain the log
+    app = load_application(s.event_log_path)
+    rec = app.queries[-1]
+    assert rec.engine == "cancelled"
+    assert rec.result_digest is None
+    # HC013's leak surface: the record's end-of-query gauges are clean
+    assert rec.counter("semaphore.in_use") == 0
+    assert rec.counter("pipeline.stage_threads") == 0
+    # ... and the engine still works afterwards (nothing wedged)
+    assert df.collect(engine="tpu").num_rows > 0
+
+
+def test_prepared_cancel_scopes_to_template():
+    conf = get_conf()
+    conf.set(MAXC, 2)
+    s = TpuSession(conf)
+    pq = s.prepare(_agg_df(s, _table()))
+    other = s.prepare(_agg_df(s, _table(seed=5)))
+    ref = pq.execute()
+    started = threading.Event()
+    outcome: dict = {}
+
+    def run():
+        started.set()
+        try:
+            outcome["r"] = pq.execute()
+        except C.QueryCancelled as e:
+            outcome["cancelled"] = e.reason
+
+    th = threading.Thread(target=run)
+    th.start()
+    started.wait()
+    # hammer cancel until the in-flight execution (if still running)
+    # is reached; a narrower scope than session.cancel()
+    while th.is_alive():
+        pq.cancel()
+        time.sleep(0.0005)
+    th.join()
+    assert outcome, "execution neither finished nor cancelled"
+    # whichever way the race went, the template stays usable and the
+    # OTHER template was never in scope
+    assert table_digest(other.execute()) == table_digest(
+        other.execute())
+    assert table_digest(pq.execute()) == table_digest(ref)
+
+
+# ------------------------------------------------------------------ #
+# Deadline in the admission queue: ZERO device work
+# ------------------------------------------------------------------ #
+
+
+def test_queue_deadline_sheds_with_zero_device_work(tmp_path):
+    from spark_rapids_tpu.columnar.transfer import upload_stats
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.tools.history import load_application
+    from spark_rapids_tpu.trace import ledger as _ledger
+
+    conf = get_conf()
+    conf.set(MAXC, 1)
+    conf.set("spark.rapids.tpu.eventLog.enabled", True)
+    conf.set("spark.rapids.tpu.eventLog.dir", str(tmp_path))
+    conf.set("spark.rapids.tpu.trace.ledger.enabled", True)
+    s = TpuSession(conf)
+    df = _agg_df(s, _table())
+
+    # occupy the ONLY admission slot so the query must queue
+    sched = scheduler_mod.get_scheduler(conf)
+    hog = sched.admit("hog")
+    try:
+        _ledger.sync_conf(conf)
+        led0 = _ledger.LEDGER.snapshot()
+        jit0 = cache_stats()
+        up0 = upload_stats()
+        conf.set(DEADLINE, 30.0)
+        t0 = time.perf_counter()
+        with pytest.raises(C.QueryCancelled) as ei:
+            df.collect(engine="tpu")
+        waited = time.perf_counter() - t0
+        conf.set(DEADLINE, 0.0)
+        assert ei.value.reason == "deadline_exceeded"
+        # shed FROM THE QUEUE: it never waited for the hog's release
+        assert waited < 5.0
+        # the zero-device-work contract: no program dispatched, no
+        # compile, no byte uploaded
+        assert _ledger.delta(led0, _ledger.LEDGER.snapshot()) == {}
+        jit1 = cache_stats()
+        assert (jit1["hits"], jit1["misses"]) == (jit0["hits"],
+                                                 jit0["misses"])
+        assert upload_stats() == up0
+    finally:
+        sched.release(hog)
+        conf.set(DEADLINE, 0.0)
+    assert C.stats()["deadline_exceeded"] == 1
+    assert scheduler_mod.scheduler_stats()["shed"] == 1
+    _ = s.history.events
+    app = load_application(s.event_log_path)
+    rec = app.queries[-1]
+    assert rec.engine == "deadline_exceeded"
+    assert "CancelledBeforeExecution" in rec.plan
+
+
+def test_expired_deadline_sheds_before_enqueue():
+    conf = get_conf()
+    conf.set(MAXC, 2)
+    s = TpuSession(conf)
+    df = _agg_df(s, _table())
+    conf.set(DEADLINE, 1e-4)  # expired by the time admit runs
+    try:
+        with pytest.raises(C.QueryCancelled) as ei:
+            df.collect(engine="tpu")
+    finally:
+        conf.set(DEADLINE, 0.0)
+    assert ei.value.reason == "deadline_exceeded"
+    st = scheduler_mod.scheduler_stats()
+    assert st["admitted"] == 0 and st["waiting"] == 0
+
+
+# ------------------------------------------------------------------ #
+# Circuit breaker
+# ------------------------------------------------------------------ #
+
+
+def _poison_df(session, tmp_path):
+    """A prepared-at-plan-time scan whose file vanishes: every
+    execution crashes in the scan with a non-retryable OSError."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    p = str(tmp_path / "poison.parquet")
+    pq.write_table(pa.table({"x": [1, 2, 3]}), p)
+    df = session.read_parquet(p)
+    os.remove(p)
+    return df
+
+
+def test_breaker_quarantines_poison_tenant_and_heals(tmp_path):
+    conf = get_conf()
+    conf.set(MAXC, 2)
+    conf.set(THRESH, 2)
+    conf.set(COOLDOWN, 150.0)
+    bad = TpuSession(conf, tenant="poison")
+    good = TpuSession(conf, tenant="healthy")
+    pdf = _poison_df(bad, tmp_path)
+    gdf = _agg_df(good, _table())
+    ref = table_digest(gdf.collect(engine="tpu"))
+
+    failures = quarantined = 0
+    for _ in range(6):
+        try:
+            pdf.collect(engine="tpu")
+        except C.TenantQuarantined:
+            quarantined += 1
+        except FileNotFoundError:
+            failures += 1
+    # quarantine engaged WITHIN failureThreshold queries, and every
+    # later attempt was shed without executing
+    assert failures == 2 and quarantined == 4
+    assert C.breaker_state("poison") == "open"
+    assert C.stats()["breaker_trips"] == 1
+    assert C.stats()["quarantined"] == 4
+    # blast radius: the healthy tenant is untouched
+    assert table_digest(gdf.collect(engine="tpu")) == ref
+    assert C.breaker_state("healthy") == "closed"
+
+    # cooldown -> half-open probe; a SUCCESSFUL probe closes it
+    time.sleep(0.2)
+    fixed = _agg_df(bad, _table(seed=3))
+    assert fixed.collect(engine="tpu").num_rows > 0
+    assert C.breaker_state("poison") == "closed"
+    # and the tenant serves normally again
+    assert pdf is not fixed and fixed.collect(
+        engine="tpu").num_rows > 0
+
+
+def test_breaker_failed_probe_reopens(tmp_path):
+    conf = get_conf()
+    conf.set(MAXC, 2)
+    conf.set(THRESH, 1)
+    conf.set(COOLDOWN, 100.0)
+    s = TpuSession(conf, tenant="p2")
+    pdf = _poison_df(s, tmp_path)
+    with pytest.raises(FileNotFoundError):
+        pdf.collect(engine="tpu")
+    assert C.breaker_state("p2") == "open"
+    time.sleep(0.12)
+    # the half-open probe fails -> straight back to open (one trip
+    # per open transition)
+    with pytest.raises(FileNotFoundError):
+        pdf.collect(engine="tpu")
+    assert C.breaker_state("p2") == "open"
+    assert C.stats()["breaker_trips"] == 2
+    with pytest.raises(C.TenantQuarantined):
+        pdf.collect(engine="tpu")
+
+
+def test_breaker_lost_probe_releases_instead_of_wedging():
+    """A half-open probe that exits through a breaker-neutral path
+    (explicit cancel, shed before admission) RELEASES the probe claim:
+    the next query becomes the probe instead of the tenant being
+    quarantined forever on a stuck ``probing`` flag."""
+    conf = get_conf()
+    conf.set(THRESH, 1)
+    conf.set(COOLDOWN, 50.0)
+    C.breaker_result(conf, "w1", ok=False)  # trips: closed -> open
+    assert C.breaker_state("w1") == "open"
+    time.sleep(0.06)
+    C.breaker_admit(conf, "w1")  # the probe claim (half-open)
+    assert C.breaker_state("w1") == "half_open"
+    # the probe is lost through a neutral path — admission releases
+    # the claim (pre-admission shed and neutral outcomes both route
+    # here)
+    C.breaker_release(conf, "w1")
+    # the NEXT query claims the probe instead of TenantQuarantined...
+    C.breaker_admit(conf, "w1")
+    C.breaker_result(conf, "w1", ok=True)
+    # ...and its success closes the breaker
+    assert C.breaker_state("w1") == "closed"
+
+
+def test_stream_early_close_is_breaker_neutral():
+    """A consumer closing a stream early (the documented early-close
+    pattern) is not a query failure: with failureThreshold=1 it would
+    trip on any counted failure — the breaker must stay closed and
+    the tenant keeps serving."""
+    conf = get_conf()
+    conf.set(MAXC, 1)
+    conf.set(THRESH, 1)
+    s = TpuSession(conf, tenant="ec")
+    pq = s.prepare(_agg_df(s, _table()))
+    gen = pq.execute_stream()
+    next(gen)
+    gen.close()
+    assert C.breaker_state("ec") == "closed"
+    assert C.stats()["breaker_trips"] == 0
+    assert pq.execute().num_rows > 0
+
+
+def test_explicit_cancel_is_breaker_neutral():
+    conf = get_conf()
+    conf.set(MAXC, 1)
+    conf.set(THRESH, 1)
+    s = TpuSession(conf, tenant="n1")
+    df = _agg_df(s, _table())
+    df.collect(engine="tpu")  # warm
+    stop = threading.Event()
+
+    def canceller():
+        while not stop.is_set():
+            s.cancel()
+            time.sleep(0.0005)
+
+    th = threading.Thread(target=canceller)
+    th.start()
+    try:
+        with pytest.raises(C.QueryCancelled):
+            df.collect(engine="tpu")
+    finally:
+        stop.set()
+        th.join()
+    # a user cancel says nothing about the tenant's health: threshold
+    # 1 would have tripped on any counted failure
+    assert C.breaker_state("n1") == "closed"
+    assert C.stats()["breaker_trips"] == 0
+
+
+# ------------------------------------------------------------------ #
+# Disabled = one conf read, bit-identical engine behavior
+# ------------------------------------------------------------------ #
+
+
+def test_disabled_is_one_conf_read_and_pattern_identical():
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    base = get_conf()
+    s = TpuSession(base)
+    df = _agg_df(s, _table())
+    df.collect(engine="tpu")  # warm: compile cache, page cache
+
+    # enabled (the default), no deadline: the shipped posture
+    with P.trace_events() as ev_on:
+        r_on = df.collect(engine="tpu")
+
+    # count cancellation-tier conf reads with the tier disabled
+    reads: list = []
+    orig_get = TpuConf.get
+
+    def counting_get(self, entry_or_key, default=None):
+        key = entry_or_key if isinstance(entry_or_key, str) \
+            else entry_or_key.key
+        if "cancellation" in key or "deadline" in key \
+                or "breaker" in key:
+            reads.append(key)
+        return orig_get(self, entry_or_key, default)
+
+    base.set("spark.rapids.tpu.serving.cancellation.enabled", False)
+    TpuConf.get = counting_get  # type: ignore[method-assign]
+    try:
+        with P.trace_events() as ev_off:
+            r_off = df.collect(engine="tpu")
+    finally:
+        TpuConf.get = orig_get  # type: ignore[method-assign]
+    assert reads == [
+        "spark.rapids.tpu.serving.cancellation.enabled"]
+    # disabled vs enabled-no-deadline: bit-identical result AND the
+    # same dispatch/readback pattern — the tier adds no sync, no
+    # reorder, no extra device work
+    assert table_digest(r_off) == table_digest(r_on)
+    assert ev_off == ev_on
+
+
+# ------------------------------------------------------------------ #
+# The cancel.check fault seam
+# ------------------------------------------------------------------ #
+
+
+def test_cancel_check_fault_seam_drives_real_unwind(tmp_path):
+    from spark_rapids_tpu.tools.history import load_application
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.eventLog.enabled", True)
+    conf.set("spark.rapids.tpu.eventLog.dir", str(tmp_path))
+    s = TpuSession(conf)
+    df = _agg_df(s, _table())
+    df.collect(engine="tpu")  # warm
+    faults.install("cancel.check:nth=2", forced=True)
+    try:
+        with pytest.raises(C.QueryCancelled) as ei:
+            df.collect(engine="tpu")
+    finally:
+        faults.disarm()
+    assert ei.value.reason == "cancelled"
+    assert "injected cancellation" in ei.value.detail
+    _ = s.history.events
+    app = load_application(s.event_log_path)
+    assert app.queries[-1].engine == "cancelled"
+
+
+# ------------------------------------------------------------------ #
+# HC013: cancellation-storm health
+# ------------------------------------------------------------------ #
+
+
+def test_hc013_cancellation_leak_matrix():
+    """HC013 fires on (a) a cancelled/deadline record whose residency
+    gauges did not return to zero and (b) breaker-trip deltas above
+    the serving.breaker.health.maxTrips budget — and only then: clean
+    unwinds, plain-tpu records and budgeted trips stay silent."""
+    from spark_rapids_tpu.tools.history import (
+        ApplicationInfo,
+        _query_from_record,
+        health_check,
+    )
+
+    def q(engine, counters):
+        return _query_from_record({
+            "query_id": 0, "plan": "", "plan_hash": "x",
+            "engine": engine, "wall_s": 1.0, "counters": counters})
+
+    def rules(rec):
+        app = ApplicationInfo("x", "eventlog", {}, [rec])
+        return {f.rule for f in health_check(app)}
+
+    leaked = q("cancelled", {"semaphore.in_use": 2,
+                             "pipeline.stage_threads": 0})
+    assert "HC013" in rules(leaked)
+    leaked_dl = q("deadline_exceeded", {"scan.inflight": 1})
+    assert "HC013" in rules(leaked_dl)
+    clean = q("cancelled", {"semaphore.in_use": 0,
+                            "pipeline.stage_threads": 0,
+                            "scan.inflight": 0})
+    assert "HC013" not in rules(clean)
+    # residency on a NON-cancelled record is another query's business
+    busy_tpu = q("tpu", {"semaphore.in_use": 2})
+    assert "HC013" not in rules(busy_tpu)
+
+    # breaker trips over the (default 0) budget
+    trips = q("tpu", {"cancel.breaker_trips": 1})
+    assert "HC013" in rules(trips)
+    get_conf().set(
+        "spark.rapids.tpu.serving.breaker.health.maxTrips", 2)
+    assert "HC013" not in rules(trips)  # now inside the budget
+
+
+# ------------------------------------------------------------------ #
+# THE acceptance test: the cancellation storm
+# ------------------------------------------------------------------ #
+
+
+def test_cancellation_storm_bit_identical_and_leak_free():
+    """N concurrent sessions under an armed chaos schedule with random
+    mid-flight cancels and per-query deadlines: every SURVIVING
+    query's digest is bit-identical to the serial fault-free run, at
+    least one query was cancelled and one shed by deadline, and the
+    post-storm residency gauges (permits, store bytes by tier, stage
+    threads, in-flight shares, admission queue) return exactly to
+    baseline — via both the module leak fixture and the explicit
+    sample_now() probe below."""
+    import random
+
+    from spark_rapids_tpu.trace.telemetry import sample_now
+
+    n_sessions, iters = 4, 3
+    tables = [_table(seed=100 + i) for i in range(3)]
+
+    # serial fault-free ground truth
+    base = get_conf()
+    s0 = TpuSession(base)
+    serial = [table_digest(_agg_df(s0, t).collect(engine="tpu"))
+              for t in tables]
+
+    # the storm: chaos latency stretches queries so cancels land
+    # mid-flight; prob-seeded exec.batch faults keep the recovery
+    # ladder engaged UNDER cancellation
+    faults.install("pipeline.stage:latency=2;"
+                   "exec.batch:prob=0.05,seed=13", forced=True)
+    mismatches: list = []
+    outcomes = {"survived": 0, "cancelled": 0}
+    lock = threading.Lock()
+
+    def run_session(i: int) -> None:
+        rng = random.Random(70 + i)
+        conf = TpuConf({MAXC: 2,
+                        "spark.rapids.tpu.serving.queueDepth": 64})
+        set_conf(conf)
+        session = TpuSession(conf, tenant=f"t{i % 2}")
+        dfs = [_agg_df(session, t) for t in tables]
+        for it in range(iters):
+            for qi, df in enumerate(dfs):
+                # seeded per-query perturbation: ~30% a short deadline,
+                # ~30% a one-shot mid-flight session.cancel() from a
+                # second thread, ~40% untouched — the storm is random
+                # yet the survivor population is guaranteed nonempty
+                roll = rng.random()
+                mode = "deadline" if roll < 0.3 else \
+                    ("cancel" if roll < 0.6 else None)
+                canceller = None
+                if mode == "deadline":
+                    conf.set(DEADLINE, round(rng.uniform(0.5, 8.0), 2))
+                elif mode == "cancel":
+                    canceller = threading.Timer(
+                        rng.uniform(0.001, 0.01), session.cancel)
+                    canceller.start()
+                try:
+                    r = df.collect(engine="tpu")
+                    if table_digest(r) != serial[qi]:
+                        with lock:
+                            mismatches.append((i, it, qi))
+                    with lock:
+                        outcomes["survived"] += 1
+                except C.QueryCancelled:
+                    with lock:
+                        outcomes["cancelled"] += 1
+                finally:
+                    if mode == "deadline":
+                        conf.set(DEADLINE, 0.0)
+                    if canceller is not None:
+                        # fired or defused, then JOINED: a late cancel
+                        # must never leak into the next query's token
+                        canceller.cancel()
+                        canceller.join()
+
+    threads = [threading.Thread(target=run_session, args=(i,),
+                                name=f"storm-{i}")
+               for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join()
+    finally:
+        faults.disarm()
+        set_conf(base)
+
+    assert not mismatches, mismatches
+    # the storm must have actually stormed AND left survivors
+    assert outcomes["survived"] >= 1, outcomes
+    assert outcomes["cancelled"] >= 1, outcomes
+    st = C.stats()
+    assert st["cancelled"] + st["deadline_exceeded"] \
+        == outcomes["cancelled"], (st, outcomes)
+
+    # post-storm gauges, explicitly (the leak fixture re-checks the
+    # store tiers and permits against its pre-test snapshot)
+    deadline_ns = time.monotonic() + 5.0
+    while time.monotonic() < deadline_ns:
+        g = sample_now()
+        if (g["semaphore.in_use"] == 0
+                and g["pipeline.stage_threads"] == 0
+                and g["scan.inflight"] == 0
+                and g["admission.running"] == 0
+                and g["admission.waiting"] == 0
+                and g["cancel.active"] == 0):
+            break
+        time.sleep(0.05)
+    g = sample_now()
+    for key in ("semaphore.in_use", "pipeline.stage_threads",
+                "scan.inflight", "admission.running",
+                "admission.waiting", "cancel.active"):
+        assert g[key] == 0, (key, g)

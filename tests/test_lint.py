@@ -757,6 +757,60 @@ def test_source_lint_shared_mutation_rule_scoped_and_exempt():
             lint_source_text(_SHARED_MUTATION_FIXTURE, path)), path
 
 
+_WAIT_FIXTURE = """
+import queue
+import threading
+
+
+class Stage:
+    def pump(self, cv, ev, q, t):
+        cv.wait()                         # SRC012: unbounded Condition
+        ev.wait()                         # SRC012: unbounded Event
+        item = q.get()                    # SRC012: unbounded queue get
+        t.join()                          # SRC012: unbounded join
+
+    def clean(self, cv, ev, q, t, d, parts):
+        cv.wait(0.05)                     # bounded: ok
+        ev.wait(timeout=0.05)             # bounded: ok
+        item = q.get(timeout=0.05)        # bounded: ok
+        t.join(0.1)                       # bounded: ok
+        v = d.get("key")                  # dict get: takes a key
+        s = ",".join(parts)               # str join: takes an iterable
+        reaper = _MetricReaper.get()      # singleton accessor: exempt
+        return item, v, s, reaper
+"""
+
+
+def test_source_lint_flags_unbounded_serving_waits():
+    """SRC012: timeout-less Condition/Event waits, queue gets and
+    thread joins in serving/ and parallel/ are ERRORS — a wait the
+    cancel token cannot interrupt is a query session.cancel() and the
+    deadline cannot reach.  Bounded waits, dict gets, string joins
+    and ClassName.get() singleton accessors all pass."""
+    for path in ("spark_rapids_tpu/serving/fake.py",
+                 "spark_rapids_tpu/parallel/fake.py"):
+        diags = lint_source_text(_WAIT_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC012"]
+        assert len(hits) == 4, (path, [d.render() for d in hits])
+        assert all(h.severity == "error" for h in hits)
+        assert {"wait", "get", "join"} == {
+            h.message.split("`.")[1].split("()")[0] for h in hits} \
+            | {"wait"}
+    assert evaluate(lint_source_text(
+        _WAIT_FIXTURE, "spark_rapids_tpu/serving/fake.py"))[2] != 0
+
+
+def test_source_lint_wait_rule_scoped_to_serving_path():
+    """SRC012 polices serving/ and parallel/ only: the reaper's
+    queue.get() in execs/ and arbitrary waits elsewhere are other
+    rules' (or nobody's) business."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/io/fake.py",
+                 "tools/fake.py"):
+        assert "SRC012" not in rules(
+            lint_source_text(_WAIT_FIXTURE, path)), path
+
+
 # -- metric-registry checker (MET001) ----------------------------------- #
 
 _MET_UNSETTLED = """
@@ -870,7 +924,12 @@ def test_repo_baseline_covers_only_intentional_syncs():
     keep-alive swallow) plus (since SRC009) the keyless raw-jit
     sites — the fused-pipeline fallback in execs/base.py when a chain
     member has no fuse key, and the module-level Pallas kernel
-    wrappers — nothing may hide behind it silently."""
+    wrappers — plus (since SRC012) the ONE intentional unbounded wait:
+    prefetch's producer-thread join, whose guaranteed wake-up is the
+    channel abort() the same finally issued one line earlier (a
+    timeout there would return with the producer still running — the
+    exact leaked-stage-thread outcome the cancellation tier forbids).
+    Nothing may hide behind the baseline silently."""
     from spark_rapids_tpu.lint.diagnostic import load_baseline
 
     keys = load_baseline()
@@ -907,6 +966,15 @@ def test_repo_baseline_covers_only_intentional_syncs():
             # only inside the serving-path modules the rule scans
             assert any(k.startswith(f"SRC011::spark_rapids_tpu/{p}/")
                        for p in ("serving", "execs", "io")), k
+        elif k.startswith("SRC012::"):
+            # intentional unbounded waits may be baselined only inside
+            # the serving-path modules the rule scans, and only where
+            # a non-poll wake-up is guaranteed (today: prefetch's
+            # abort-then-join teardown)
+            assert k == ("SRC012::spark_rapids_tpu/parallel/"
+                         "pipeline.py::prefetch::unbounded blocking "
+                         "`.join()` on the serving path cannot be "
+                         "interrupted by cancellation/deadline"), k
         elif k.startswith("MET001::"):
             # intentional metric-registry placeholders may be
             # baselined, but only inside the exec layers the rule

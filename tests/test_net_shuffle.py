@@ -151,6 +151,113 @@ def test_truncated_stream_is_fetch_failure(remote_server):
         fetch_blocks("127.0.0.1", port, sid, 1, timeout=2.0)
 
 
+def test_fetch_reresolves_on_every_retry_after_first():
+    """A moved peer is found EARLY: from the second retry on, every
+    attempt re-resolves through the resolver (previously only the
+    last-ditch attempt did), so with maxAttempts=4 a fetch against a
+    dead address succeeds on the third attempt — one resolver call,
+    not three wasted backoff rounds."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    mgr = ShuffleManager()
+    sid = mgr.new_shuffle_id()
+    mgr.write(sid, 0, ColumnarBatch.from_numpy(
+        {"k": np.arange(4, dtype=np.int64),
+         "v": np.ones(4)}, SCHEMA))
+    live = ShuffleBlockServer(mgr).start()
+    dead = ShuffleBlockServer(ShuffleManager()).start()
+    dead_addr = dead.address
+    dead.shutdown()  # refuses connections from here on
+
+    calls = [0]
+
+    def resolve():
+        calls[0] += 1
+        return live.address
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.shuffle.fetch.maxAttempts", 4)
+    conf.set("spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.01)
+    try:
+        blocks = fetch_blocks(dead_addr[0], dead_addr[1], sid, 0,
+                              timeout=2.0, resolve_peer=resolve)
+        # attempt 0 fails, retry 1 fails on the same dead address (no
+        # resolution yet — transient resets on a live peer are the
+        # common case), resolution fires, attempt 2 succeeds
+        assert calls[0] == 1, calls
+        assert len(blocks) == 1
+    finally:
+        live.shutdown()
+
+
+def test_fetch_two_attempt_budget_still_reresolves():
+    """maxAttempts=2 has exactly one retry — which IS the final
+    attempt, so resolution must fire before it (the min clamp) rather
+    than never: a moved peer is still found within the budget."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    mgr = ShuffleManager()
+    sid = mgr.new_shuffle_id()
+    mgr.write(sid, 0, ColumnarBatch.from_numpy(
+        {"k": np.arange(4, dtype=np.int64),
+         "v": np.ones(4)}, SCHEMA))
+    live = ShuffleBlockServer(mgr).start()
+    dead = ShuffleBlockServer(ShuffleManager()).start()
+    dead_addr = dead.address
+    dead.shutdown()
+
+    calls = [0]
+
+    def resolve():
+        calls[0] += 1
+        return live.address
+
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.shuffle.fetch.maxAttempts", 2)
+    conf.set("spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.01)
+    try:
+        blocks = fetch_blocks(dead_addr[0], dead_addr[1], sid, 0,
+                              timeout=2.0, resolve_peer=resolve)
+        assert calls[0] == 1, calls
+        assert len(blocks) == 1
+    finally:
+        live.shutdown()
+
+
+def test_fetch_honors_cancel_token_between_attempts():
+    """A cancelled query stops reconnecting: the retry loop checks the
+    cancel token between attempts, so the fetch raises QueryCancelled
+    after the first failure instead of burning the whole backoff
+    budget against a peer nobody will consume from."""
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.serving.cancel import (
+        CancelToken,
+        QueryCancelled,
+        attach_token,
+    )
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    dead = ShuffleBlockServer(ShuffleManager()).start()
+    host, port = dead.address
+    dead.shutdown()
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.shuffle.fetch.maxAttempts", 5)
+    conf.set("spark.rapids.tpu.shuffle.fetch.retryWaitSeconds", 0.01)
+    tok = CancelToken("t0")
+    tok.cancel()
+    t0 = time.perf_counter()
+    with attach_token(tok):
+        with pytest.raises(QueryCancelled):
+            fetch_blocks(host, port, 1, 0, timeout=2.0)
+    # one failed connect, then the token check raised — nowhere near
+    # the 5-attempt backoff budget
+    assert time.perf_counter() - t0 < 2.0
+
+
 def test_heartbeat_registry_peer_discovery():
     """register/heartbeat protocol (ref:
     RapidsShuffleHeartbeatManagerTest): registration returns existing

@@ -49,6 +49,14 @@ def _isolate_serving():
     trace.clear()
 
 
+@pytest.fixture(autouse=True)
+def _no_leaks(leak_check):
+    """Every serving test carries the suite-wide leak gauge: permits,
+    store bytes per tier, stage threads and in-flight scan shares must
+    return exactly to baseline (conftest.leak_check)."""
+    yield
+
+
 def _table(n=4096, keys=16, seed=7):
     rng = np.random.default_rng(seed)
     return pa.table({

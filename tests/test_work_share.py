@@ -59,6 +59,17 @@ def _isolate_sharing():
     clear_serving_context()
 
 
+@pytest.fixture(autouse=True)
+def _no_leaks(leak_check):
+    """Every sharing test carries the suite-wide leak gauge
+    (conftest.leak_check).  The caches are dropped FIRST — retained
+    result/scan entries hold store bytes by design; what must return
+    to baseline is everything else (permits, stage threads, in-flight
+    shares, and the store bytes the reset releases)."""
+    yield
+    ws.reset()
+
+
 def _table(n=4096, keys=16, seed=7):
     rng = np.random.default_rng(seed)
     return pa.table({
