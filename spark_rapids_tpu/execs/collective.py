@@ -12,10 +12,20 @@ collectives ride ICI scheduled by XLA; SURVEY.md §5.8).
 
 Inputs stream through BOUNDED per-shard rounds (conf
 spark.rapids.tpu.shuffle.collective.roundRows): each round stacks at
-most that many rows per shard, runs the fused program, and parks the
-per-shard results on device — so a skewed or large child never forces
+most that many rows per shard — so a skewed or large child never forces
 one stop-the-world host gather (the streaming discipline of the
-reference's shuffle writer)."""
+reference's shuffle writer).
+
+STAGE EXECUTION (docs/spmd.md): with
+spark.rapids.tpu.shuffle.collective.spmd.enabled (the default), a
+whole query stage lowers to O(1) partitioned pjit programs over the
+mesh with NamedSharding end-to-end — rounds are a lax.scan INSIDE the
+compiled program (bucketed by .spmd.bucketRounds), inputs arrive as
+global sharded arrays, and the per-round host syncs
+(concrete_num_rows, shrink) of the legacy host-loop driver are
+deferred to ONE stage-exit counts fetch.  spmd.enabled=false keeps
+the legacy per-round host loop (one dispatch + 2n syncs per round) —
+the digest-comparison baseline for the SPMD path."""
 
 from __future__ import annotations
 
@@ -27,12 +37,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
-from spark_rapids_tpu.columnar.column import (
-    Column,
-    StringColumn,
-    pad_capacity,
-    pad_width,
-)
+from spark_rapids_tpu.columnar.column import pad_capacity
 from spark_rapids_tpu.config import register, get_conf
 from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
 from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
@@ -48,49 +53,50 @@ COLLECTIVE_ROUND_ROWS = register(
     "(the batch-at-a-time discipline of the reference's shuffle "
     "writer, GpuShuffleExchangeExec.scala:167-270).")
 
+SPMD_STAGE = register(
+    "spark.rapids.tpu.shuffle.collective.spmd.enabled", True,
+    "Lower each collective query stage (exchange + its fused "
+    "agg/join/sort work) to O(1) partitioned pjit programs over the "
+    "active mesh with NamedSharding end-to-end: exchange rounds run "
+    "as a lax.scan INSIDE the compiled program, inputs arrive as "
+    "global sharded arrays, and per-round host syncs are deferred to "
+    "one stage-exit counts fetch (docs/spmd.md).  Off: the legacy "
+    "host-loop driver — one program dispatch plus per-shard "
+    "concrete_num_rows/shrink syncs per round — kept as the "
+    "bit-identical digest baseline.  The planner reads this at plan "
+    "time (collective.stage_config), so the stage shape is part of "
+    "the plan, not a collect-time surprise.")
 
-def _repad(batch: ColumnarBatch, cap: int,
-           widths: dict[int, int]) -> ColumnarBatch:
-    """Pad a batch to a common capacity/string-width profile so per-shard
-    leaves stack into one array with a leading device axis."""
-    cols = []
-    for ci, c in enumerate(batch.columns):
-        if isinstance(c, StringColumn):
-            w = widths[ci]
-            chars = c.chars
-            if c.width < w:
-                chars = jnp.pad(chars, ((0, 0), (0, w - c.width)))
-            if c.capacity < cap:
-                pad = cap - c.capacity
-                chars = jnp.pad(chars, ((0, pad), (0, 0)))
-                cols.append(StringColumn(
-                    chars,
-                    jnp.pad(c.lengths, (0, pad)),
-                    jnp.pad(c.validity, (0, pad))))
-            else:
-                cols.append(StringColumn(chars, c.lengths, c.validity))
-        else:
-            if c.capacity < cap:
-                pad = cap - c.capacity
-                cols.append(Column(jnp.pad(c.data, (0, pad)),
-                                   jnp.pad(c.validity, (0, pad)),
-                                   c.dtype))
-            else:
-                cols.append(c)
-    return ColumnarBatch(cols, batch.num_rows, batch.schema)
+SPMD_BUCKET_ROUNDS = register(
+    "spark.rapids.tpu.shuffle.collective.spmd.bucketRounds", 8,
+    "Maximum exchange rounds folded into ONE partitioned stage "
+    "program's in-program scan (agg and join stream stages; the sort "
+    "stage folds ALL rounds into its single program because range "
+    "bounds must see every round's sample).  Bounds the stage's "
+    "resident input footprint at bucketRounds x roundRows rows per "
+    "shard; round counts inside a bucket pad to a power of two so the "
+    "scan length — part of the compiled program's key — takes a "
+    "handful of values instead of one executable per data-dependent "
+    "round count (docs/spmd.md).",
+    check=lambda v: v >= 1)
+
+
+def stage_config(conf=None) -> tuple[bool, int]:
+    """(spmd_enabled, bucket_rounds) — THE planner seam deciding how
+    collective stage boundaries compile.  Read at plan time and pinned
+    into the exec (and therefore into explain()/the event log's plan
+    report), so a conf flip after planning cannot silently change an
+    already-planned stage's execution shape."""
+    conf = conf or get_conf()
+    return bool(conf.get(SPMD_STAGE)), int(conf.get(SPMD_BUCKET_ROUNDS))
 
 
 def _unify_shards(shards: list[ColumnarBatch]) -> list[ColumnarBatch]:
-    """Pad shard batches to one capacity/width profile for stacking."""
-    cap = max(s.capacity for s in shards)
-    widths: dict[int, int] = {}
-    for s in shards:
-        for ci, c in enumerate(s.columns):
-            if isinstance(c, StringColumn):
-                widths[ci] = max(widths.get(ci, 1), c.width)
-    for ci in widths:
-        widths[ci] = pad_width(widths[ci])
-    return [_repad(s, cap, widths) for s in shards]
+    """Pad shard batches to one capacity/width profile for stacking
+    (shared with the SPMD global-array assembly in parallel/spmd.py)."""
+    from spark_rapids_tpu.parallel.spmd import unify_batches
+
+    return unify_batches(shards)
 
 
 def _fold_groups(groups: list[list[ColumnarBatch]],
@@ -116,6 +122,20 @@ class _CollectiveBase(TpuExec):
     stacked above) read shard p through `execute_partition(p)`."""
 
     mesh = None  # set by subclass __init__
+
+    def _init_stage(self, spmd: Optional[bool],
+                    bucket_rounds: Optional[int]) -> None:
+        """Pin the stage execution shape at construction (= plan)
+        time; the planner passes stage_config() through so the
+        decision is part of the plan."""
+        conf_spmd, conf_bucket = stage_config()
+        self.spmd_stage = conf_spmd if spmd is None else bool(spmd)
+        self.bucket_rounds = max(1, conf_bucket if bucket_rounds is None
+                                 else int(bucket_rounds))
+
+    def _stage_desc(self) -> str:
+        return (f"stage=spmd(bucket={self.bucket_rounds})"
+                if self.spmd_stage else "stage=host-loop")
 
     @property
     def num_partitions(self) -> int:
@@ -227,9 +247,12 @@ class TpuCollectiveHashAggregateExec(_CollectiveBase):
     always land on the same shard, so the cross-round merge is local."""
 
     def __init__(self, groups: Sequence[Expression],
-                 aggs: Sequence[NamedAgg], child: TpuExec, mesh):
+                 aggs: Sequence[NamedAgg], child: TpuExec, mesh,
+                 spmd: Optional[bool] = None,
+                 bucket_rounds: Optional[int] = None):
         super().__init__(child)
         self.mesh = mesh
+        self._init_stage(spmd, bucket_rounds)
         # the partial-mode exec carries every traceable phase we fuse
         self._agg = TpuHashAggregateExec(groups, aggs, child,
                                          mode="partial")
@@ -248,7 +271,7 @@ class TpuCollectiveHashAggregateExec(_CollectiveBase):
         keys = ", ".join(e.name for e in a.groups)
         return (f"TpuCollectiveHashAggregateExec keys=[{keys}] "
                 f"[all_to_all over mesh axis '{DATA_AXIS}' x"
-                f"{self.num_partitions}]")
+                f"{self.num_partitions}] [{self._stage_desc()}]")
 
     def additional_metrics(self):
         return [("collectiveRows", "MODERATE"),
@@ -271,6 +294,64 @@ class TpuCollectiveHashAggregateExec(_CollectiveBase):
     # -- driver ----------------------------------------------------------- #
 
     def _materialize(self) -> list[list[ColumnarBatch]]:
+        if self.spmd_stage:
+            return self._materialize_spmd()
+        return self._materialize_host_loop()
+
+    def _materialize_spmd(self) -> list[list[ColumnarBatch]]:
+        """The aggregation stage as O(1) partitioned programs: one
+        exchange-scan program per round bucket (map-side update ->
+        in-program hash all_to_all -> reduce-side merge, all rounds
+        folded into a lax.scan), ONE mid-stage counts fetch + shrink,
+        then one tail program (cross-round merge + finalize) at tight
+        capacity — same keys always land on the same shard, so the
+        cross-round fold is shard-local."""
+        from spark_rapids_tpu.parallel import spmd as S
+        from spark_rapids_tpu.parallel.exchange import exchange_shard
+
+        child = self.children[0]
+        n = self.num_partitions
+        akey = self._agg._cache_key()
+        ko = list(range(self._agg.n_keys))
+
+        def xchg_body(b: ColumnarBatch) -> ColumnarBatch:
+            return self._merge(
+                exchange_shard(self._pre(b), ko, n, DATA_AXIS))
+
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
+            shrunk: list[list[ColumnarBatch]] = []  # rounds[r][d]
+            bucket: list = []
+
+            def flush(bucket):
+                bucket = S.pad_rounds_pow2(bucket, child.schema, n)
+                xs = S.shard_stack_rounds(bucket, self.mesh)
+                prog = S.make_exchange_scan_stage(
+                    self.mesh, akey, xchg_body, len(bucket),
+                    op=self.name, donate=True)
+                shrunk.extend(S.shrink_rounds(prog(xs)))
+
+            for shards in self._shard_rounds(child):
+                bucket.append(shards)
+                if len(bucket) == self.bucket_rounds:
+                    flush(bucket)
+                    bucket = []
+            if bucket:
+                flush(bucket)
+            rounds2 = S.pad_rounds_pow2(
+                shrunk, self._agg.partial_schema, n)
+            xs2 = S.shard_stack_rounds(rounds2, self.mesh)
+            tail = S.make_stage_tail(self.mesh, akey, self._finalize,
+                                     len(rounds2), op=self.name,
+                                     donate=True)
+            final = t.observe(tail(xs2))
+        counts = S.stage_counts(final)
+        out = []
+        for d, b in enumerate(S.unstack_stage(final, counts)):
+            self.metrics["collectiveRows"].add(int(counts[d]))
+            out.append([b])
+        return out
+
+    def _materialize_host_loop(self) -> list[list[ColumnarBatch]]:
         from spark_rapids_tpu.parallel.exchange import (
             make_hash_exchange_step,
             make_local_step,
@@ -307,12 +388,15 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
     SUPPORTED_TYPES = ("inner", "left_outer", "left_semi", "left_anti")
 
     def __init__(self, left_keys, right_keys, join_type: str,
-                 left: TpuExec, right: TpuExec, mesh):
+                 left: TpuExec, right: TpuExec, mesh,
+                 spmd: Optional[bool] = None,
+                 bucket_rounds: Optional[int] = None):
         from spark_rapids_tpu.execs.join import _nullable_fields
 
         assert join_type in self.SUPPORTED_TYPES, join_type
         super().__init__(left, right)
         self.mesh = mesh
+        self._init_stage(spmd, bucket_rounds)
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.join_type = join_type
@@ -333,7 +417,8 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
         ks = ", ".join(f"{lk.name}={rk.name}" for lk, rk in
                        zip(self.left_keys, self.right_keys))
         return (f"TpuCollectiveHashJoinExec {self.join_type} [{ks}] "
-                f"[all_to_all x{self.num_partitions}]")
+                f"[all_to_all x{self.num_partitions}] "
+                f"[{self._stage_desc()}]")
 
     def additional_metrics(self):
         return [("buildRows", "MODERATE"),
@@ -348,21 +433,28 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
         cols = [k.eval(ctx) for k in self.right_keys]
         return partition_ids(cols, batch.capacity, self.num_partitions)
 
+    def _route_stream(self, stream: ColumnarBatch) -> jax.Array:
+        from spark_rapids_tpu.exprs.hashing import partition_ids
+
+        sctx = EvalContext.for_batch(stream)
+        return partition_ids([k.eval(sctx) for k in self.left_keys],
+                             stream.capacity, self.num_partitions)
+
     def _join_shard(self, stream: ColumnarBatch, build: ColumnarBatch,
                     out_cap: int):
-        from spark_rapids_tpu.exprs.hashing import partition_ids
+        from spark_rapids_tpu.parallel.exchange import route_shard
+
+        routed = route_shard(stream, self._route_stream(stream),
+                             self.num_partitions, DATA_AXIS)
+        return self._join_local(routed, build, out_cap)
+
+    def _join_local(self, routed: ColumnarBatch, build: ColumnarBatch,
+                    out_cap: int):
         from spark_rapids_tpu.ops.join import (
             expand_pairs,
             gather_joined,
             join_state,
         )
-        from spark_rapids_tpu.parallel.exchange import route_shard
-
-        n = self.num_partitions
-        sctx = EvalContext.for_batch(stream)
-        pid = partition_ids([k.eval(sctx) for k in self.left_keys],
-                            stream.capacity, n)
-        routed = route_shard(stream, pid, n, DATA_AXIS)
 
         rctx = EvalContext.for_batch(routed)
         bctx = EvalContext.for_batch(build)
@@ -410,7 +502,115 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
             self.metrics["buildRows"].add(b.concrete_num_rows())
         return self._stack(merged)
 
+    def _join_key(self) -> tuple:
+        from spark_rapids_tpu.execs.jit_cache import exprs_key
+
+        return ("cjoin", self.join_type, exprs_key(self.left_keys),
+                exprs_key(self.right_keys), repr(self._schema))
+
     def _materialize(self) -> list[list[ColumnarBatch]]:
+        if self.spmd_stage:
+            return self._materialize_spmd()
+        return self._materialize_host_loop()
+
+    def _materialize_spmd(self) -> list[list[ColumnarBatch]]:
+        """The join stage as O(1) partitioned programs per side: the
+        build side runs one exchange-scan program (route by right-key
+        hash, all rounds in one lax.scan) + mid-stage shrink + one
+        tail program folding the per-shard build batch; each stream
+        bucket runs one exchange-scan program (route by left-key
+        hash) + shrink, then one probe program joining the TIGHT
+        routed rounds against the resident build shard.  Host syncs
+        happen only at stage exits (the shrink counts and each
+        bucket's true totals); overflow of the output-capacity guess
+        re-dispatches that bucket's probe program at the
+        JoinGatherer-style re-bucketed capacity."""
+        from spark_rapids_tpu.parallel import spmd as S
+        from spark_rapids_tpu.parallel.exchange import route_shard
+
+        n = self.num_partitions
+        jkey = self._join_key()
+        chunks: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+        semi_anti = self.join_type in ("left_semi", "left_anti")
+
+        def build_body(b: ColumnarBatch) -> ColumnarBatch:
+            return route_shard(b, self._route_build(b), n, DATA_AXIS)
+
+        def stream_body(b: ColumnarBatch) -> ColumnarBatch:
+            return route_shard(b, self._route_stream(b), n, DATA_AXIS)
+
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
+            build_rounds = S.pad_rounds_pow2(
+                list(self._shard_rounds(self.children[1])),
+                self.children[1].schema, n)
+            xs_b = S.shard_stack_rounds(build_rounds, self.mesh)
+            bprog = S.make_exchange_scan_stage(
+                self.mesh, jkey + ("build",), build_body,
+                len(build_rounds), op=self.name, donate=True)
+            ys_b = bprog(xs_b)
+            bcounts = S.stage_counts(ys_b)
+            shrunk_b = S.shrink_rounds(ys_b, bcounts)
+            self.metrics["buildRows"].add(int(bcounts.sum()))
+            build_rows = int(bcounts.sum(axis=0).max()) \
+                if bcounts.size else 0
+            rounds_b = S.pad_rounds_pow2(
+                shrunk_b, self.children[1].schema, n)
+            btail = S.make_stage_tail(
+                self.mesh, jkey + ("buildfold",), lambda b: b,
+                len(rounds_b), op=self.name, donate=True)
+            build = btail(S.shard_stack_rounds(rounds_b, self.mesh))
+
+            def run_bucket(bucket):
+                bucket = S.pad_rounds_pow2(bucket,
+                                           self.children[0].schema, n)
+                xs = S.shard_stack_rounds(bucket, self.mesh)
+                rprog = S.make_exchange_scan_stage(
+                    self.mesh, jkey + ("stream",), stream_body,
+                    len(bucket), op=self.name, donate=True)
+                ys = rprog(xs)
+                rounds2 = S.pad_rounds_pow2(
+                    S.shrink_rounds(ys),
+                    self.children[0].schema, n)
+                xs2 = S.shard_stack_rounds(rounds2, self.mesh)
+                cap2 = max(b.capacity for shards in rounds2
+                           for b in shards)
+                cap_guess = 64 if semi_anti else pad_capacity(
+                    max(cap2, build_rows, 64))
+                while True:
+                    prog = S.make_join_scan_stage(
+                        self.mesh, jkey + (cap_guess,),
+                        lambda s, b, c=cap_guess:
+                            self._join_local(s, b, c),
+                        len(rounds2), op=self.name)
+                    outs, totals = prog(xs2, build)
+                    if semi_anti:
+                        break
+                    worst = int(S.fetch(totals).max())
+                    if worst <= cap_guess:
+                        break
+                    # JoinGatherer-style re-bucket: recompile at the
+                    # capacity the data actually needs
+                    cap_guess = pad_capacity(worst)
+                outs = t.observe(outs)
+                per = S.unstack_round_stage(outs)  # bucket stage exit
+                for d in range(n):
+                    chunks[d].extend(per[d])
+
+            bucket: list = []
+            any_bucket = False
+            for shards in self._shard_rounds(self.children[0]):
+                bucket.append(shards)
+                if len(bucket) == self.bucket_rounds:
+                    run_bucket(bucket)
+                    any_bucket = True
+                    bucket = []
+            if bucket or not any_bucket:
+                run_bucket(bucket or [
+                    [ColumnarBatch.empty(self.children[0].schema)
+                     for _ in range(n)]])
+        return chunks
+
+    def _materialize_host_loop(self) -> list[list[ColumnarBatch]]:
         from spark_rapids_tpu.parallel.exchange import unstack_batch
 
         chunks: list[list[ColumnarBatch]] = [
@@ -460,11 +660,14 @@ class TpuCollectiveSortExec(_CollectiveBase):
 
     SAMPLE_PER_SHARD = 256
 
-    def __init__(self, keys, child: TpuExec, mesh):
+    def __init__(self, keys, child: TpuExec, mesh,
+                 spmd: Optional[bool] = None,
+                 bucket_rounds: Optional[int] = None):
         super().__init__(child)
         from spark_rapids_tpu.ops.partition import RangePartitioning
 
         self.mesh = mesh
+        self._init_stage(spmd, bucket_rounds)
         self.keys = list(keys)
         n = int(mesh.shape[DATA_AXIS])
         self._part = RangePartitioning(self.keys, n).bind(child.schema)
@@ -478,7 +681,8 @@ class TpuCollectiveSortExec(_CollectiveBase):
             f"{k.expr.name}{' DESC' if k.descending else ''}"
             for k in self.keys)
         return (f"TpuCollectiveSortExec [{ks}] "
-                f"[range all_to_all x{self.num_partitions}]")
+                f"[range all_to_all x{self.num_partitions}] "
+                f"[{self._stage_desc()}]")
 
     def additional_metrics(self):
         return [("collectiveRounds", "MODERATE")]
@@ -493,7 +697,65 @@ class TpuCollectiveSortExec(_CollectiveBase):
         k = max(16, min(256, rows // 64))
         return 1 << (k - 1).bit_length()
 
+    def _sort_key(self) -> tuple:
+        from spark_rapids_tpu.execs.jit_cache import exprs_key
+
+        return (exprs_key([k.expr for k in self._part.keys]),
+                tuple((k.descending, k.nulls_last)
+                      for k in self._part.keys))
+
     def _materialize(self) -> list[list[ColumnarBatch]]:
+        if self.spmd_stage:
+            return self._materialize_spmd()
+        return self._materialize_host_loop()
+
+    def _materialize_spmd(self) -> list[list[ColumnarBatch]]:
+        """The distributed ORDER BY as TWO partitioned programs: the
+        route program (in-program sampling at host-chosen fractional
+        positions — no per-batch row-count sync — all_gather-pooled
+        dynamic range bounds, the range-routed all_to_all over a
+        scanned rounds axis), ONE mid-stage counts fetch + shrink,
+        then the tail program sorting each shard at tight capacity —
+        shard index order IS the total order.  The sort stage ignores
+        bucketRounds: bounds must see every round's sample, and the
+        host-loop path also parked all rounds before routing, so the
+        resident footprint is unchanged."""
+        from spark_rapids_tpu.ops.sort import sort_permutation
+        from spark_rapids_tpu.parallel import spmd as S
+
+        child = self.children[0]
+        part = self._part
+        n = self.num_partitions
+        skey = self._sort_key()
+
+        def local_sort(b: ColumnarBatch) -> ColumnarBatch:
+            # sort by the evaluated key batch (works for arbitrary
+            # key expressions, not just column refs)
+            perm = sort_permutation(part.key_batch(b),
+                                    part.key_orders())
+            return b.gather(perm, b.num_rows)
+
+        with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
+            rounds = S.pad_rounds_pow2(
+                list(self._shard_rounds(child)), child.schema, n)
+            xs = S.shard_stack_rounds(rounds, self.mesh)
+            fracs = S.sample_fracs(self.mesh, len(rounds),
+                                   self.SAMPLE_PER_SHARD)
+            rprog = S.make_sort_route_stage(
+                self.mesh, skey, part, len(rounds),
+                self.SAMPLE_PER_SHARD, op=self.name, donate=True)
+            routed = rprog(xs, fracs)
+            rounds2 = S.pad_rounds_pow2(
+                S.shrink_rounds(routed), child.schema, n)
+            xs2 = S.shard_stack_rounds(rounds2, self.mesh)
+            tail = S.make_stage_tail(self.mesh, skey, local_sort,
+                                     len(rounds2), op=self.name,
+                                     donate=True)
+            out = t.observe(tail(xs2))
+        counts = S.stage_counts(out)
+        return [[b] for b in S.unstack_stage(out, counts)]
+
+    def _materialize_host_loop(self) -> list[list[ColumnarBatch]]:
         import numpy as np
 
         from spark_rapids_tpu.execs.jit_cache import cached_jit, exprs_key

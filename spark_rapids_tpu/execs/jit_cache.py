@@ -142,9 +142,27 @@ def _validate_donate(donate) -> tuple:
     return donate
 
 
+def _shardings_key(in_shardings, out_shardings) -> tuple:
+    """Serialize a sharding spec pair for the cache key.  reprs carry
+    mesh axis names/sizes and the PartitionSpec but NOT device
+    identity — partitioned callers additionally fold
+    parallel.mesh.mesh_key(mesh) into their own key (the SPMD stage
+    builders do), so two same-shaped meshes over different devices
+    never share an executable."""
+    def one(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            return tuple(one(x) for x in s)
+        return repr(s)
+    return (one(in_shardings), one(out_shardings))
+
+
 def cached_jit(key: tuple, make_fn: Callable[[], Callable],
                op: Optional[str] = None,
-               donate: "int | Sequence[int] | None" = None):
+               donate: "int | Sequence[int] | None" = None,
+               in_shardings=None, out_shardings=None,
+               meta: Optional[dict] = None):
     """Return a jitted callable shared by every caller presenting `key`.
     `make_fn` is invoked (once) only on a cache miss.
 
@@ -164,13 +182,27 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
     donated-then-spilled buffer is a use-after-free.  The donation
     state folds into the cache key, so donating and non-donating
     callers of the same logical program never share a compiled
-    executable."""
+    executable.
+
+    `in_shardings` / `out_shardings` thread jax.sharding specs
+    (NamedSharding pytrees) into the compiled program — the pjit/GSPMD
+    plumbing for partitioned SPMD stage programs (SNIPPETS [1][2][3]).
+    Sharding is PART of the executable (GSPMD partitions the program
+    around it), so the spec pair folds into the cache key; donation
+    composes (a donated sharded input's per-device buffers are reused
+    for the partitioned outputs).  `meta` attaches static program
+    attributes (mesh device count, in-program collective round count)
+    to the ledger entry so partitioned programs attribute per-device
+    busy time in snapshots/bench."""
     global _HITS, _MISSES
     donate = _validate_donate(donate) if donate is not None else ()
     if donate and donation_enabled():
         key = key + ("donate", donate)
     else:
         donate = ()
+    if in_shardings is not None or out_shardings is not None:
+        key = key + ("shardings",
+                     _shardings_key(in_shardings, out_shardings))
     with _LOCK:
         fn = _CACHE.get(key)
         if fn is None:
@@ -199,9 +231,14 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
             # per-program dispatch counts + device time + cost-model
             # attribution (tpulint SRC009 flags raw jax.jit in exec
             # modules for exactly this reason)
+            jit_kwargs: dict = {"donate_argnums": donate}
+            if in_shardings is not None:
+                jit_kwargs["in_shardings"] = in_shardings
+            if out_shardings is not None:
+                jit_kwargs["out_shardings"] = out_shardings
             fn = _CACHE[key] = _ledger.LEDGER.wrap(
-                key, jax.jit(make_fn(), donate_argnums=donate),
-                op=op, donated=bool(donate))
+                key, jax.jit(make_fn(), **jit_kwargs),
+                op=op, donated=bool(donate), meta=meta)
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)
         else:

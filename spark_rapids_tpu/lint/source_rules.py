@@ -108,6 +108,18 @@ Rules
   no fuse key, the module-level Pallas kernel wrappers) are
   baselined, not suppressed inline.  execs/jit_cache.py — the cache
   itself — is exempt by construction.
+- SRC013 (error): host syncs inside collective step functions /
+  shard_map bodies (parallel/exchange.py, parallel/spmd.py,
+  execs/collective.py).  The SPMD whole-stage contract (docs/spmd.md)
+  defers per-round host syncs to stage exit: a
+  ``concrete_num_rows()`` / ``.block_until_ready()`` /
+  ``np.asarray`` / ``jax.device_get`` / ``.item()`` inside a step
+  builder's nested body, a function passed to ``shard_map``, or a
+  collective-exec method handed to a builder either fails at trace
+  time or silently re-inserts the per-round host round-trip the
+  partitioned stage architecture exists to remove.  The host driver
+  code in the same modules (round staging, stage-exit
+  ``stage_counts``/``fetch``) is out of scope by construction.
 - SRC012 (error): unbounded blocking waits in serving/ and parallel/.
   Every wait on the serving path must be INTERRUPTIBLE — the
   cancellation substrate (serving/cancel.py) can only unwind a query
@@ -589,6 +601,148 @@ class _UnboundedWaitChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: SRC013: attribute-call spellings that force a device->host sync —
+#: fatal inside a collective step / shard_map body, where they either
+#: fail at trace time or silently serialize the partitioned program
+_STEP_SYNC_ATTRS = {"concrete_num_rows", "block_until_ready", "item",
+                    "tolist"}
+#: builder-function name prefixes whose NESTED defs are traced step
+#: bodies (make_hash_exchange_step's shard_fn, make_agg_stage's
+#: shard_fn/body, ...)
+_STEP_BUILDER_PREFIXES = ("make_", "spmd_")
+
+
+class _CollectiveStepSyncChecker(ast.NodeVisitor):
+    """SRC013: host syncs inside collective step functions / shard_map
+    bodies (parallel/exchange.py, parallel/spmd.py,
+    execs/collective.py).
+
+    The SPMD whole-stage contract (docs/spmd.md) is that per-round
+    host syncs are DEFERRED to stage exit: everything inside a stage
+    program — the shard_map body, the lax.scan round body, the fused
+    pre/merge/finalize phases — must stay traceable.  A
+    `concrete_num_rows()` / `.block_until_ready()` / `np.asarray` /
+    `jax.device_get` / `.item()` in one of those bodies either fails
+    at trace time or, on a warm-up path handed concrete values,
+    silently re-inserts the per-round host round-trip the whole
+    architecture exists to remove.
+
+    Traced bodies, syntactically:
+    - any function nested inside a step/stage BUILDER (a function
+      whose name starts with ``make_`` or ``spmd_``);
+    - any function passed by name to ``shard_map``/``_shard_map``;
+    - in execs/collective.py: methods handed to a builder as a bound
+      reference or called from a lambda passed to a builder
+      (``make_route_step(mesh, lambda b: self._route_build(b))``
+      makes ``_route_build`` a traced body).
+
+    The host DRIVER code in the same modules (round staging,
+    stage-exit counts fetches) legitimately syncs and is out of
+    scope."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[ast.FunctionDef] = []
+        #: method names referenced as `self._x` in builder-call args
+        self.traced_methods: set[str] = set()
+
+    # -- pass 1: find traced bodies --------------------------------- #
+
+    @staticmethod
+    def _is_builder_call(node: ast.Call) -> bool:
+        name = _terminal_name(node.func)
+        return bool(name) and name.startswith(_STEP_BUILDER_PREFIXES)
+
+    @staticmethod
+    def _self_attrs(e: ast.expr) -> list[str]:
+        """`self._x` attribute names referenced anywhere under `e`."""
+        out = []
+        for n in ast.walk(e):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                out.append(n.attr)
+        return out
+
+    def collect_traced(self, tree: ast.Module) -> tuple[set[int],
+                                                        set[str]]:
+        """(ids of traced FunctionDef nodes, traced method names)."""
+        traced: set[int] = set()
+        methods: set[str] = set()
+        parents: list[ast.FunctionDef] = []
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                if any(p.name.startswith(_STEP_BUILDER_PREFIXES)
+                       for p in parents):
+                    traced.add(id(node))
+                parents.append(node)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                parents.pop()
+                return
+            if isinstance(node, ast.Call):
+                if self._is_builder_call(node):
+                    for a in list(node.args) \
+                            + [k.value for k in node.keywords]:
+                        methods.update(self._self_attrs(a))
+                        if isinstance(a, ast.Name):
+                            methods.add(a.id)
+                fname = _terminal_name(node.func)
+                if fname in ("shard_map", "_shard_map"):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            methods.add(a.id)  # resolved by name below
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(tree)
+        return traced, methods
+
+    # -- pass 2: flag syncs inside traced bodies --------------------- #
+
+    def _emit(self, node: ast.AST, what: str) -> None:
+        qual = self._fn_stack[-1].name if self._fn_stack else "<module>"
+        self.out.append(Diagnostic(
+            "SRC013", "error", f"{self.path}::{qual}",
+            f"{what} is a host sync inside a collective step / "
+            "shard_map body — the SPMD stage contract defers syncs "
+            "to stage exit (docs/spmd.md)",
+            hint="keep the body traceable (jnp/lax only); read counts "
+                 "once at stage exit via parallel.spmd.stage_counts / "
+                 "fetch",
+            line=getattr(node, "lineno", 0)))
+
+    def check_body(self, fn: ast.FunctionDef) -> None:
+        self._fn_stack.append(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _STEP_SYNC_ATTRS \
+                        and not node.args:
+                    self._emit(node, f"`.{node.func.attr}()`")
+                elif node.func.attr in ("asarray", "array") \
+                        and _terminal_name(node.func.value) \
+                        in _NP_NAMES:
+                    self._emit(node, f"`np.{node.func.attr}(...)`")
+                elif node.func.attr == "device_get" \
+                        and _terminal_name(node.func.value) == "jax":
+                    self._emit(node, "`jax.device_get`")
+        self._fn_stack.pop()
+
+    def run(self, tree: ast.Module) -> None:
+        traced, method_names = self.collect_traced(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if id(node) in traced or node.name in method_names:
+                self.check_body(node)
+
+
 class _RawJitChecker(ast.NodeVisitor):
     """SRC009: raw ``jax.jit`` calls (or decorators, including
     ``partial(jax.jit, ...)``) in execs//ops/ modules instead of
@@ -1057,6 +1211,16 @@ def _is_sharing_module(path: str) -> bool:
     return any(p in parts for p in ("serving", "execs", "io"))
 
 
+def _is_collective_step_module(path: str) -> bool:
+    """SRC013 scope: the modules that define collective step /
+    shard_map bodies — the exchange program builders, the SPMD stage
+    builders, and the collective execs whose methods trace into
+    them."""
+    norm = path.replace("\\", "/")
+    return norm.endswith(("parallel/exchange.py", "parallel/spmd.py",
+                          "execs/collective.py"))
+
+
 def _is_wait_module(path: str) -> bool:
     """SRC012 scope: the serving tier and the parallel substrate — the
     layers whose blocking waits sit on the serving path a cancelled
@@ -1104,6 +1268,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _SharedMutationChecker(path, out).visit(tree)
     if _is_wait_module(path):
         _UnboundedWaitChecker(path, out).visit(tree)
+    if _is_collective_step_module(path):
+        _CollectiveStepSyncChecker(path, out).run(tree)
     return out
 
 

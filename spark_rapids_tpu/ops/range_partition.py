@@ -76,6 +76,32 @@ def choose_bounds(samples: ColumnarBatch, orders: Sequence[SortOrder],
     return ColumnarBatch(picked.columns, n_bounds, s.schema)
 
 
+def choose_bounds_dynamic(samples: ColumnarBatch,
+                          orders: Sequence[SortOrder],
+                          n_parts: int) -> ColumnarBatch:
+    """choose_bounds with a TRACED live-sample count: sort the pooled
+    sample (dead rows last), then gather n_parts-1 evenly spaced ranks
+    computed from the in-program `num_rows` scalar.  This is the form
+    the SPMD sort stage needs — the host never learns how many samples
+    each shard contributed (that would be a per-round sync), so the
+    rank arithmetic happens on device.  With zero live samples the
+    picked bounds are dead padding rows, which is harmless: every data
+    row routed against them is itself dead."""
+    from spark_rapids_tpu.ops.sort import sort_batch
+
+    assert n_parts >= 1
+    n_bounds = n_parts - 1
+    s = sort_batch(samples, orders)
+    if n_bounds == 0:
+        return s.slice_prefix(0)
+    n_live = jnp.asarray(s.num_rows, jnp.int32)
+    ranks = jnp.minimum(
+        (jnp.arange(1, n_parts, dtype=jnp.int32) * n_live) // n_parts,
+        jnp.maximum(n_live - 1, 0))
+    picked = s.gather(ranks, n_bounds)
+    return ColumnarBatch(picked.columns, n_bounds, s.schema)
+
+
 def bucket_ids(batch: ColumnarBatch, bounds: ColumnarBatch,
                orders: Sequence[SortOrder], n_bounds: int) -> jax.Array:
     """Per-row partition id in [0, n_bounds]: number of bounds strictly

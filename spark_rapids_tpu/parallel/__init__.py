@@ -7,7 +7,10 @@ riding ICI within a pod slice (DCN across slices) with no explicit
 endpoint/bounce-buffer management — the compiler owns the transport.
 """
 
-from spark_rapids_tpu.parallel.mesh import make_mesh  # noqa: F401
+from spark_rapids_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    mesh_key,
+)
 from spark_rapids_tpu.parallel.exchange import (  # noqa: F401
     make_hash_exchange_step,
     stack_batches,

@@ -40,6 +40,20 @@ from spark_rapids_tpu.columnar.column import AnyColumn, Column, StringColumn
 from spark_rapids_tpu.exprs.hashing import partition_ids
 from spark_rapids_tpu.parallel.mesh import DATA_AXIS
 
+#: older jax spells shard_map's replication-check flag `check_rep`
+#: (the newer name is `check_vma`); probe once at import
+_SM_CHECK_KW = ("check_vma" if "check_vma"
+                in __import__("inspect").signature(shard_map).parameters
+                else "check_rep")
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication check off, spelled portably
+    across jax versions — every collective step / SPMD stage program
+    builds through this one wrapper."""
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **{_SM_CHECK_KW: False})
+
 
 def stack_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Stack per-device batches into one batch whose leaves carry a leading
@@ -186,8 +200,8 @@ def make_hash_exchange_step(
             b = post(b)
         return _unsqueeze0(b)
 
-    mapped = shard_map(shard_fn, mesh=mesh, in_specs=P(axis_name),
-                       out_specs=P(axis_name), check_vma=False)
+    mapped = _shard_map(shard_fn, mesh, P(axis_name),
+                       P(axis_name))
     return jax.jit(mapped)
 
 
@@ -211,8 +225,8 @@ def make_route_step(
         return _unsqueeze0(b)
 
     in_specs = (P(axis_name),) + (P(),) * n_extra
-    mapped = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
-                       out_specs=P(axis_name), check_vma=False)
+    mapped = _shard_map(shard_fn, mesh, in_specs,
+                       P(axis_name))
     return jax.jit(mapped)
 
 
@@ -228,8 +242,8 @@ def make_local_step(
     def shard_fn(stacked: ColumnarBatch) -> ColumnarBatch:
         return _unsqueeze0(fn(_squeeze0(stacked)))
 
-    mapped = shard_map(shard_fn, mesh=mesh, in_specs=P(axis_name),
-                       out_specs=P(axis_name), check_vma=False)
+    mapped = _shard_map(shard_fn, mesh, P(axis_name),
+                       P(axis_name))
     return jax.jit(mapped)
 
 
@@ -249,8 +263,7 @@ def make_join_step(
                               _squeeze0(build_stacked))
         return _unsqueeze0(out), total[None]
 
-    mapped = shard_map(wrapped, mesh=mesh,
-                       in_specs=(P(axis_name), P(axis_name)),
-                       out_specs=(P(axis_name), P(axis_name)),
-                       check_vma=False)
+    mapped = _shard_map(wrapped, mesh,
+                        (P(axis_name), P(axis_name)),
+                        (P(axis_name), P(axis_name)))
     return jax.jit(mapped)

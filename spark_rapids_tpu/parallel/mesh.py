@@ -33,6 +33,17 @@ def active_mesh() -> Optional[Mesh]:
     return _ACTIVE_MESH
 
 
+def mesh_key(mesh: Mesh) -> tuple:
+    """Structural identity of a mesh for compile-cache keys: device ids,
+    axis names and axis sizes.  Two meshes over DIFFERENT device sets
+    must never share a cached partitioned executable (the sharding's
+    repr alone does not carry device identity), so every SPMD stage
+    program folds this into its cached_jit key."""
+    return (tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names))
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axes: Sequence[str] = (DATA_AXIS,),
               shape: Optional[Sequence[int]] = None,

@@ -1,0 +1,521 @@
+"""SPMD whole-stage execution: one pjit program per query stage.
+
+The collective tier's original driver (execs/collective.py) ran a HOST
+LOOP per exchange round: stack per-shard batches on the default device,
+dispatch one shard_map step, unstack, host-sync every shard's row count,
+shrink, fold.  Per round that is one program dispatch plus 2n host
+round-trips — the dispatch-soup anti-pattern the DeviceLedger exists to
+expose, and the opposite of how pjit/GSPMD programs are meant to run
+(SNIPPETS [1][2]: partitioned compilation with `PartitionSpec` +
+donation; [3]: mesh/`NamedSharding` helpers).
+
+This module is the replacement: a query stage (exchange + its fused
+agg/join/sort work) lowers to a SINGLE partitioned XLA program over the
+active mesh with `NamedSharding` end-to-end —
+
+- inputs arrive as GLOBAL sharded arrays: per-shard round batches are
+  assembled with `jax.make_array_from_single_device_arrays` under
+  ``NamedSharding(mesh, P(None, "data"))`` (leading axis = exchange
+  rounds, second axis = mesh shard), so GSPMD never reshards at
+  dispatch and nothing round-trips through one host-stacked array;
+- the hash/range exchange is an IN-PROGRAM collective: the per-round
+  ``all_to_all`` body of parallel/exchange.py runs inside a
+  ``lax.scan`` over the rounds axis — R exchange rounds compile once
+  and dispatch once, instead of R host dispatches;
+- per-round host syncs are DEFERRED to stage exit: one
+  ``stage_counts`` fetch of the output row-count array replaces the
+  per-round per-shard `concrete_num_rows` + shrink choreography.
+
+Programs compile through execs/jit_cache.cached_jit with the sharding
+spec pair folded into the structural key (plus parallel.mesh.mesh_key,
+so same-shaped meshes over different devices never share an
+executable); donation composes — a stage's freshly assembled global
+input is single-use and may be donated into the program.  The ledger
+entry carries ``{"devices": n, "rounds": R}`` so partitioned programs
+attribute per-device busy time and in-program collective rounds in
+bench/analyze (docs/spmd.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (
+    ColumnarBatch,
+    concat_batches_traced,
+)
+from spark_rapids_tpu.columnar.column import (
+    AnyColumn,
+    Column,
+    MIN_CAPACITY,
+    StringColumn,
+    pad_capacity,
+    pad_width,
+)
+from spark_rapids_tpu.parallel.exchange import (
+    _shard_map,
+    _squeeze0,
+    _unsqueeze0,
+    route_shard,
+)
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS, mesh_key
+
+
+def rounds_sharding(mesh) -> NamedSharding:
+    """Sharding of a round-stacked stage input: leaves are
+    (rounds, n_shards, capacity, ...), sharded over the mesh axis."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def stage_sharding(mesh) -> NamedSharding:
+    """Sharding of a per-shard stage output: leaves are
+    (n_shards, capacity, ...)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+# ------------------------------------------------------------------ #
+# Capacity unification (shared with the host-loop fallback path)
+# ------------------------------------------------------------------ #
+
+
+def repad_batch(batch: ColumnarBatch, cap: int,
+                widths: dict[int, int]) -> ColumnarBatch:
+    """Pad a batch to a common capacity/string-width profile so
+    per-shard leaves stack into one array with leading device (and
+    round) axes."""
+    cols: list[AnyColumn] = []
+    for ci, c in enumerate(batch.columns):
+        if isinstance(c, StringColumn):
+            w = widths[ci]
+            chars = c.chars
+            if c.width < w:
+                chars = jnp.pad(chars, ((0, 0), (0, w - c.width)))
+            if c.capacity < cap:
+                pad = cap - c.capacity
+                chars = jnp.pad(chars, ((0, pad), (0, 0)))
+                cols.append(StringColumn(
+                    chars,
+                    jnp.pad(c.lengths, (0, pad)),
+                    jnp.pad(c.validity, (0, pad))))
+            else:
+                cols.append(StringColumn(chars, c.lengths, c.validity))
+        else:
+            if c.capacity < cap:
+                pad = cap - c.capacity
+                cols.append(Column(jnp.pad(c.data, (0, pad)),
+                                   jnp.pad(c.validity, (0, pad)),
+                                   c.dtype))
+            else:
+                cols.append(c)
+    return ColumnarBatch(cols, batch.num_rows, batch.schema)
+
+
+def unify_batches(batches: Sequence[ColumnarBatch]
+                  ) -> list[ColumnarBatch]:
+    """Pad batches to ONE capacity/width profile (max over the set,
+    width pow2-padded) so their leaves stack into rectangular arrays."""
+    cap = max(b.capacity for b in batches)
+    widths: dict[int, int] = {}
+    for b in batches:
+        for ci, c in enumerate(b.columns):
+            if isinstance(c, StringColumn):
+                widths[ci] = max(widths.get(ci, 1), c.width)
+    for ci in widths:
+        widths[ci] = pad_width(widths[ci])
+    return [repad_batch(b, cap, widths) for b in batches]
+
+
+# ------------------------------------------------------------------ #
+# Global sharded-array assembly (stage entry)
+# ------------------------------------------------------------------ #
+
+
+def _assemble(mesh, per_dev: list) -> jax.Array:
+    """One global (R, n, ...) array from one (R, ...) piece per mesh
+    device: each piece is device_put onto ITS shard's device and the
+    global array is assembled without ever materializing a
+    host-stacked copy (`jax.make_array_from_single_device_arrays` —
+    the NamedSharding idiom of SNIPPETS [3])."""
+    devs = list(mesh.devices.flat)
+    pieces = [jax.device_put(p[:, None], d)
+              for p, d in zip(per_dev, devs)]
+    shape = (per_dev[0].shape[0], len(devs)) + tuple(
+        per_dev[0].shape[1:])
+    return jax.make_array_from_single_device_arrays(
+        shape, rounds_sharding(mesh), pieces)
+
+
+def shard_stack_rounds(rounds: Sequence[Sequence[ColumnarBatch]],
+                       mesh) -> ColumnarBatch:
+    """Assemble R rounds of n per-shard batches into ONE global sharded
+    batch: every leaf becomes a (R, n, capacity, ...) jax Array under
+    ``NamedSharding(mesh, P(None, "data"))``, with shard d's slice
+    resident on mesh device d.  num_rows becomes an int32 (R, n)
+    global array.  This is the stage INPUT contract of every SPMD
+    stage program."""
+    n = int(mesh.shape[DATA_AXIS])
+    flat = [b for shards in rounds for b in shards]
+    assert flat and len(flat) == len(rounds) * n
+    unified = unify_batches(flat)
+    r_count = len(rounds)
+
+    def at(r: int, d: int) -> ColumnarBatch:
+        return unified[r * n + d]
+
+    schema = flat[0].schema
+    cols: list[AnyColumn] = []
+    for ci, c0 in enumerate(unified[0].columns):
+        if isinstance(c0, StringColumn):
+            cols.append(StringColumn(
+                _assemble(mesh, [
+                    jnp.stack([at(r, d).columns[ci].chars
+                               for r in range(r_count)])
+                    for d in range(n)]),
+                _assemble(mesh, [
+                    jnp.stack([at(r, d).columns[ci].lengths
+                               for r in range(r_count)])
+                    for d in range(n)]),
+                _assemble(mesh, [
+                    jnp.stack([at(r, d).columns[ci].validity
+                               for r in range(r_count)])
+                    for d in range(n)])))
+        else:
+            cols.append(Column(
+                _assemble(mesh, [
+                    jnp.stack([at(r, d).columns[ci].data
+                               for r in range(r_count)])
+                    for d in range(n)]),
+                _assemble(mesh, [
+                    jnp.stack([at(r, d).columns[ci].validity
+                               for r in range(r_count)])
+                    for d in range(n)]),
+                c0.dtype))
+    num_rows = _assemble(mesh, [
+        np.asarray([at(r, d).concrete_num_rows()
+                    for r in range(r_count)], np.int32)
+        for d in range(n)])
+    return ColumnarBatch(cols, num_rows, schema)
+
+
+def pad_rounds_pow2(rounds: list, schema: T.Schema, n: int) -> list:
+    """Pad a round list with rounds of empty shard batches up to the
+    next power of two, so the in-program scan length (part of the
+    compiled program's key) takes a handful of bucketed values instead
+    of minting one executable per data-dependent round count."""
+    r = len(rounds)
+    want = 1 << (r - 1).bit_length() if r > 1 else 1
+    out = list(rounds)
+    while len(out) < want:
+        out.append([ColumnarBatch.empty(schema) for _ in range(n)])
+    return out
+
+
+def sample_fracs(mesh, n_rounds: int, k: int,
+                 seed: int = 0x52414E47) -> jax.Array:
+    """Deterministic per-(round, shard) sample-position fractions in
+    [0, 1) for the sort stage's in-program sampling, assembled as a
+    global (R, n, k) sharded array."""
+    n = int(mesh.shape[DATA_AXIS])
+    rng = np.random.default_rng(seed)
+    fr = rng.random((n_rounds, n, k), dtype=np.float32)
+    return _assemble(mesh, [fr[:, d] for d in range(n)])
+
+
+# ------------------------------------------------------------------ #
+# Stage exit: ONE host sync, then unstack + shrink
+# ------------------------------------------------------------------ #
+
+
+def stage_counts(batch: ColumnarBatch) -> np.ndarray:
+    """THE stage-exit sync: fetch the output row-count array (shape
+    (n,) or (R, n)) in one device_get.  Everything the host loop used
+    to learn per round (`concrete_num_rows` per shard, shrink sizes)
+    comes out of this single fetch."""
+    return np.asarray(jax.device_get(batch.num_rows))
+
+
+def fetch(arr) -> np.ndarray:
+    """Host fetch of a small stage-exit diagnostic array (the join
+    stage's per-round true totals) — one device_get at a stage
+    boundary, never inside the round loop."""
+    return np.asarray(jax.device_get(arr))
+
+
+def _slice_shard(batch: ColumnarBatch, idx: tuple,
+                 rows: int) -> ColumnarBatch:
+    cols: list[AnyColumn] = []
+    for c in batch.columns:
+        if isinstance(c, StringColumn):
+            cols.append(StringColumn(c.chars[idx], c.lengths[idx],
+                                     c.validity[idx]))
+        else:
+            cols.append(Column(c.data[idx], c.validity[idx], c.dtype))
+    out = ColumnarBatch(cols, rows, batch.schema)
+    return out.shrink_to_capacity(max(MIN_CAPACITY,
+                                      pad_capacity(rows)))
+
+
+def unstack_stage(batch: ColumnarBatch,
+                  counts: Optional[np.ndarray] = None
+                  ) -> list[ColumnarBatch]:
+    """Split a (n, capacity, ...) stage output into n shrunk per-shard
+    batches using the stage-exit counts (fetched once if not given)."""
+    if counts is None:
+        counts = stage_counts(batch)
+    return [_slice_shard(batch, (d,), int(counts[d]))
+            for d in range(counts.shape[0])]
+
+
+def unstack_round_stage(batch: ColumnarBatch,
+                        counts: Optional[np.ndarray] = None
+                        ) -> list[list[ColumnarBatch]]:
+    """Split a (R, n, capacity, ...) stage output into per-shard lists
+    of per-round shrunk batches (empty rounds dropped)."""
+    if counts is None:
+        counts = stage_counts(batch)
+    r_count, n = counts.shape
+    out: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+    for d in range(n):
+        for r in range(r_count):
+            rows = int(counts[r, d])
+            if rows:
+                out[d].append(_slice_shard(batch, (r, d), rows))
+    return out
+
+
+def shrink_rounds(batch: ColumnarBatch,
+                  counts: Optional[np.ndarray] = None
+                  ) -> list[list[ColumnarBatch]]:
+    """THE mid-stage shrink: split a (R, n, capacity, ...) exchange
+    program output into a rectangular rounds[r][d] grid of shrunk
+    batches (empty rounds kept), using ONE stage-exit counts fetch.
+    The exchange program's outputs carry the worst-case n x cap
+    receive capacity per shard; shrinking here — once per stage, not
+    once per round — is what keeps the tail program's merge/sort/join
+    work proportional to the LIVE rows instead of the padding."""
+    if counts is None:
+        counts = stage_counts(batch)
+    r_count, n = counts.shape
+    return [[_slice_shard(batch, (r, d), int(counts[r, d]))
+             for d in range(n)]
+            for r in range(r_count)]
+
+
+# ------------------------------------------------------------------ #
+# Stage program builders (compiled via cached_jit: sharding + mesh in
+# the key, ledger meta = {devices, rounds})
+# ------------------------------------------------------------------ #
+
+
+def _tree_index(tree, r: int):
+    return jax.tree_util.tree_map(lambda leaf: leaf[r], tree)
+
+
+def _concat_rounds(ys, n_rounds: int,
+                   squeeze: bool = False) -> ColumnarBatch:
+    """Fold a rounds-stacked pytree into one traced batch.  `squeeze`
+    strips the per-shard device axis first — program INPUTS carry it
+    (leaves (R, 1, cap, ...)); in-body scan outputs do not."""
+    parts = [_tree_index(ys, r) for r in range(n_rounds)]
+    if squeeze:
+        parts = [_squeeze0(p) for p in parts]
+    if n_rounds == 1:
+        return parts[0]
+    merged = concat_batches_traced(parts)
+    assert merged is not None, \
+        "collective schemas are flat (supports_schema gates nesting)"
+    return merged
+
+
+def _stage_jit(key: tuple, make_fn, mesh, op, in_shardings,
+               out_shardings, donate, n_rounds: int):
+    from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+    n = int(mesh.shape[DATA_AXIS])
+    return cached_jit(
+        key + (mesh_key(mesh),), make_fn, op=op,
+        in_shardings=in_shardings, out_shardings=out_shardings,
+        donate=donate,
+        meta={"devices": n, "rounds": n_rounds})
+
+
+def make_exchange_scan_stage(mesh, key: tuple, body: Callable,
+                             n_rounds: int, op: Optional[str] = None,
+                             donate: bool = False):
+    """The EXCHANGE program of a stage: lax.scan over the rounds axis
+    applying `body` (per-shard round batch -> per-shard batch; the
+    in-program all_to_all — exchange_shard / route_shard — lives
+    inside `body`, as do any fused map/reduce phases).  Emits the
+    round-stacked per-shard outputs at the worst-case n x cap receive
+    capacity; the host shrinks them ONCE at stage exit
+    (`shrink_rounds`) before the tail program, so the tail's work is
+    proportional to live rows, not padding."""
+    axis = DATA_AXIS
+
+    def make():
+        def shard_fn(xs: ColumnarBatch) -> ColumnarBatch:
+            def sbody(carry, x):
+                return carry, _unsqueeze0(body(_squeeze0(x)))
+            _, ys = jax.lax.scan(sbody, jnp.int32(0), xs)
+            return ys
+
+        return _shard_map(shard_fn, mesh, P(None, axis),
+                          P(None, axis))
+
+    return _stage_jit(
+        ("spmdxchg", key, n_rounds), make, mesh, op,
+        (rounds_sharding(mesh),), rounds_sharding(mesh),
+        (0,) if donate else None, n_rounds)
+
+
+def make_stage_tail(mesh, key: tuple, fn: Callable, n_rounds: int,
+                    op: Optional[str] = None, donate: bool = False):
+    """The TAIL program of a stage: concatenate the (shrunk,
+    re-assembled) per-shard rounds and apply `fn` — the agg's
+    cross-round merge + finalize, the sort's local sort, the join
+    build side's fold.  No collectives: the exchange already owns
+    placement, so the tail is pure per-shard work at tight
+    capacity."""
+    axis = DATA_AXIS
+
+    def make():
+        def shard_fn(xs: ColumnarBatch) -> ColumnarBatch:
+            merged = _concat_rounds(xs, n_rounds, squeeze=True)
+            return _unsqueeze0(fn(merged))
+
+        return _shard_map(shard_fn, mesh, P(None, axis), P(axis))
+
+    return _stage_jit(
+        ("spmdtail", key, n_rounds), make, mesh, op,
+        (rounds_sharding(mesh),), stage_sharding(mesh),
+        (0,) if donate else None, n_rounds)
+
+
+def make_join_scan_stage(mesh, key: tuple, join_fn: Callable,
+                         n_rounds: int, op: Optional[str] = None):
+    """Join probe program: scan the PRE-ROUTED stream rounds against
+    the resident per-shard build batch — `join_fn(stream_shard,
+    build_shard) -> (joined, total)` runs entirely in-program.
+    Outputs round-stacked joined batches plus per-(round, shard) true
+    totals for the host's stage-exit capacity-overflow check (the one
+    decision that stays on the host, because it re-COMPILES at a
+    bigger bucket).  Inputs are NOT donated: an overflow re-dispatches
+    the same arrays."""
+    axis = DATA_AXIS
+
+    def make():
+        def shard_fn(xs: ColumnarBatch, build: ColumnarBatch):
+            b = _squeeze0(build)
+
+            def body(carry, x):
+                s = _squeeze0(x)
+                out, total = join_fn(s, b)
+                return carry, (_unsqueeze0(out), total[None])
+            _, (ys, totals) = jax.lax.scan(body, jnp.int32(0), xs)
+            return ys, totals
+
+        return _shard_map(
+            shard_fn, mesh, (P(None, axis), P(axis)),
+            (P(None, axis), P(None, axis)))
+
+    return _stage_jit(
+        ("spmdjoin", key, n_rounds), make, mesh, op,
+        (rounds_sharding(mesh), stage_sharding(mesh)),
+        (rounds_sharding(mesh), rounds_sharding(mesh)),
+        None, n_rounds)
+
+
+def _all_gather_concat(b: ColumnarBatch, n: int,
+                       axis: str) -> ColumnarBatch:
+    """Pool one prefix-compact per-shard batch across the mesh INSIDE
+    the program: all_gather every leaf, rebuild liveness from the
+    gathered row counts, compact.  Every shard holds the identical
+    pooled result afterwards (replicated by construction)."""
+    rows_all = jax.lax.all_gather(
+        jnp.asarray(b.num_rows, jnp.int32), axis)  # (n,)
+    cap = b.capacity
+
+    def ag(x):
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    cols: list[AnyColumn] = []
+    for c in b.columns:
+        if isinstance(c, StringColumn):
+            cols.append(StringColumn(ag(c.chars), ag(c.lengths),
+                                     ag(c.validity)))
+        else:
+            cols.append(Column(ag(c.data), ag(c.validity), c.dtype))
+    idx = jnp.arange(n * cap, dtype=jnp.int32)
+    live = (idx % cap) < jnp.take(rows_all, idx // cap)
+    return ColumnarBatch(cols, n * cap, b.schema).compact(live)
+
+
+def make_sort_route_stage(mesh, key: tuple, part, n_rounds: int,
+                          sample_k: int, op: Optional[str] = None,
+                          donate: bool = False):
+    """The exchange program of a distributed ORDER BY:
+
+    1. scan rounds gathering per-shard sort-key samples at host-chosen
+       fractional positions (sample count proportional to each round's
+       live rows, so a 10-row tail batch cannot outweigh a full one);
+    2. all_gather the samples and compute range bounds IN-PROGRAM
+       (`choose_bounds_dynamic` — every shard derives identical bounds
+       from the identical pooled sample);
+    3. scan rounds again through the range-routed all_to_all.
+
+    Emits the round-stacked routed rounds; after the mid-stage shrink
+    the tail program (`make_stage_tail` with the local sort) sorts
+    each shard at tight capacity — shard index order IS the total
+    order.  The host-loop path needed a per-batch `concrete_num_rows`
+    sync just to SIZE its samples; here the row counts never leave
+    the device."""
+    from spark_rapids_tpu.ops.range_partition import (
+        choose_bounds_dynamic,
+    )
+
+    n = int(mesh.shape[DATA_AXIS])
+    axis = DATA_AXIS
+    orders = part.key_orders()
+
+    def make():
+        def shard_fn(xs: ColumnarBatch, fracs: jax.Array):
+            def sample_body(carry, xf):
+                x, frac = xf
+                b = _squeeze0(x)
+                kb = part.key_batch(b)
+                rows = jnp.asarray(b.num_rows, jnp.int32)
+                cap = b.capacity
+                pos = jnp.clip(
+                    (frac[0] * rows.astype(jnp.float32)).astype(
+                        jnp.int32),
+                    0, jnp.maximum(rows - 1, 0))
+                n_valid = (sample_k * rows + cap - 1) // cap
+                return carry, kb.gather(pos, n_valid)
+            _, samples = jax.lax.scan(sample_body, jnp.int32(0),
+                                      (xs, fracs))
+            pooled = _all_gather_concat(
+                _concat_rounds(samples, n_rounds), n, axis)
+            bounds = choose_bounds_dynamic(pooled, orders, n)
+
+            def route_body(carry, x):
+                b = _squeeze0(x)
+                pid = part.partition_ids_with_bounds(b, bounds)
+                return carry, _unsqueeze0(
+                    route_shard(b, pid, n, axis))
+            _, routed = jax.lax.scan(route_body, jnp.int32(0), xs)
+            return routed
+
+        return _shard_map(
+            shard_fn, mesh, (P(None, axis), P(None, axis)),
+            P(None, axis))
+
+    return _stage_jit(
+        ("spmdsortroute", key, n_rounds, sample_k), make, mesh, op,
+        (rounds_sharding(mesh), rounds_sharding(mesh)),
+        rounds_sharding(mesh), (0,) if donate else None, n_rounds)

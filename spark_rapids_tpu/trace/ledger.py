@@ -130,20 +130,30 @@ def program_key_str(key: Any) -> str:
 class ProgramEntry:
     """Cumulative counters for one compiled program (one jit key)."""
 
-    __slots__ = ("key_str", "tag", "op", "gen", "donated", "dispatches",
-                 "dispatch_ns", "device_ns", "flops", "bytes_accessed",
-                 "cost_state", "lock")
+    __slots__ = ("key_str", "tag", "op", "gen", "donated", "meta",
+                 "dispatches", "dispatch_ns", "device_ns", "flops",
+                 "bytes_accessed", "cost_state", "lock")
 
     #: cost_state values
     COST_NONE, COST_PENDING, COST_DONE = 0, 1, 2
 
     def __init__(self, key: Any, op: Optional[str], gen: int,
-                 donated: bool = False):
+                 donated: bool = False,
+                 meta: Optional[dict] = None):
         self.key_str = program_key_str(key)
         self.tag = key_tag(key)
         self.op = op
         self.gen = gen
         self.donated = donated
+        #: static program attributes from the compile site — a
+        #: PARTITIONED (SPMD) program records its mesh device count
+        #: (`devices`) and in-program collective round count
+        #: (`rounds`), so snapshots can attribute per-device busy time
+        #: (device_ms spans the whole mesh: the per-device figure IS
+        #: device_ms, and the mesh burns devices x device_ms of chip
+        #: capacity) and the multichip bench can report how many
+        #: exchange rounds each stage folded into one dispatch
+        self.meta = dict(meta) if meta else None
         self.dispatches = 0
         self.dispatch_ns = 0  # host-side dispatch wall (call duration)
         self.device_ns = 0  # exclusive busy intervals, reaper-settled
@@ -315,24 +325,26 @@ class DeviceLedger:
     # -- recording (fed by the cached_jit wrapper) ------------------- #
 
     def entry(self, key: Any, op: Optional[str],
-              donated: bool = False) -> ProgramEntry:
+              donated: bool = False,
+              meta: Optional[dict] = None) -> ProgramEntry:
         with self._lock:
             e = self._entries.get(key)
             if e is None:
                 e = self._entries[key] = ProgramEntry(key, op, self.gen,
-                                                      donated)
+                                                      donated, meta)
             elif e.op is None and op is not None:
                 e.op = op
             return e
 
     def wrap(self, key: Any, fn, op: Optional[str] = None,
-             donated: bool = False):
+             donated: bool = False, meta: Optional[dict] = None):
         """Wrap one jitted callable with ledger accounting.  The
         disabled path is one attribute read + the passthrough call —
         bit-identical results either way (the wrapper never touches
         arguments or output).  `donated` marks programs compiled with
         buffer donation so snapshots/footers can say which programs
-        reuse input HBM."""
+        reuse input HBM; `meta` carries static partitioned-program
+        attributes (mesh devices, in-program collective rounds)."""
         cell: list = [None]
         ledger = self
 
@@ -341,7 +353,7 @@ class DeviceLedger:
                 return fn(*args, **kwargs)
             e = cell[0]
             if e is None or e.gen != ledger.gen:
-                e = cell[0] = ledger.entry(key, op, donated)
+                e = cell[0] = ledger.entry(key, op, donated, meta)
             t0 = time.perf_counter_ns()
             out = fn(*args, **kwargs)
             t1 = time.perf_counter_ns()
@@ -393,7 +405,7 @@ class DeviceLedger:
         out: dict[str, dict] = {}
         for e in entries:
             with e.lock:
-                out[e.key_str] = {
+                rec = {
                     "tag": e.tag,
                     "op": e.op,
                     "donated": e.donated,
@@ -403,6 +415,12 @@ class DeviceLedger:
                     "flops": e.flops,
                     "bytes_accessed": e.bytes_accessed,
                 }
+                if e.meta:
+                    # partitioned-program attribution: device_ms spans
+                    # the mesh, so per-device busy IS device_ms and the
+                    # stage burned devices x device_ms of chip capacity
+                    rec.update(e.meta)
+                out[e.key_str] = rec
         return out
 
 
@@ -470,7 +488,7 @@ def delta(before: dict[str, dict],
         d = a["dispatches"] - b.get("dispatches", 0)
         if d <= 0:
             continue
-        out[k] = {
+        rec = {
             "tag": a["tag"],
             "op": a["op"],
             "donated": a.get("donated", False),
@@ -482,6 +500,10 @@ def delta(before: dict[str, dict],
             "flops": a["flops"],
             "bytes_accessed": a["bytes_accessed"],
         }
+        for mk in ("devices", "rounds"):
+            if mk in a:
+                rec[mk] = a[mk]
+        out[k] = rec
     return out
 
 

@@ -811,6 +811,68 @@ def test_source_lint_wait_rule_scoped_to_serving_path():
             lint_source_text(_WAIT_FIXTURE, path)), path
 
 
+_STEP_SYNC_FIXTURE = """
+import numpy as np
+
+
+def make_route_step(mesh, pid_fn):
+    def shard_fn(stacked):
+        n = stacked.concrete_num_rows()     # SRC013: sync in step body
+        h = np.asarray(stacked.data)        # SRC013: host materialize
+        return stacked
+    return shard_fn
+
+
+def local_sort_fn(b):
+    b.block_until_ready()                   # SRC013: passed to builder
+    return b
+
+
+class TpuCollectiveFooExec:
+    def _route(self, b):
+        got = jax.device_get(b.data)        # SRC013: traced method
+        return b
+
+    def _drive(self):
+        step = make_route_step(self.mesh, lambda b: self._route(b))
+        final = make_local_step(self.mesh, local_sort_fn)
+        counts = out.concrete_num_rows()    # host driver: out of scope
+        host = np.asarray(counts)           # host driver: out of scope
+        return counts, host
+"""
+
+
+def test_source_lint_flags_syncs_in_collective_step_bodies():
+    """SRC013: host syncs (`concrete_num_rows`, `.block_until_ready`,
+    `np.asarray`, `jax.device_get`) inside collective step functions /
+    shard_map bodies are ERRORS — the SPMD stage contract defers every
+    sync to stage exit (docs/spmd.md).  The host DRIVER code in the
+    same modules (round staging, stage-exit counts fetches) stays out
+    of scope."""
+    for path in ("spark_rapids_tpu/parallel/exchange.py",
+                 "spark_rapids_tpu/parallel/spmd.py",
+                 "spark_rapids_tpu/execs/collective.py"):
+        diags = lint_source_text(_STEP_SYNC_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC013"]
+        assert len(hits) == 4, (path, [d.render() for d in hits])
+        assert all(h.severity == "error" for h in hits)
+        assert not any("_drive" in h.location for h in hits)
+    assert evaluate(lint_source_text(
+        _STEP_SYNC_FIXTURE,
+        "spark_rapids_tpu/parallel/spmd.py"))[2] != 0
+
+
+def test_source_lint_step_sync_rule_scoped_to_collective_modules():
+    """SRC013 polices the collective step modules only — the same
+    spellings in scan/exec driver modules are SRC005/SRC007's
+    business (different severity, different contract)."""
+    for path in ("spark_rapids_tpu/io/scan.py",
+                 "spark_rapids_tpu/parallel/pipeline.py",
+                 "spark_rapids_tpu/execs/aggregate.py"):
+        assert "SRC013" not in rules(
+            lint_source_text(_STEP_SYNC_FIXTURE, path)), path
+
+
 # -- metric-registry checker (MET001) ----------------------------------- #
 
 _MET_UNSETTLED = """
@@ -987,6 +1049,15 @@ def test_repo_baseline_covers_only_intentional_syncs():
         elif k.startswith("SRC008::"):
             assert any(k.startswith(f"SRC008::{p}::")
                        for p in swallow_infra), k
+        elif k.startswith("SRC013::"):
+            # intentional host syncs inside collective step bodies
+            # (none today: the SPMD stage contract defers every sync
+            # to stage exit) may be baselined only inside the step
+            # modules the rule scans
+            assert any(k.startswith(f"SRC013::spark_rapids_tpu/{p}")
+                       for p in ("parallel/exchange.py",
+                                 "parallel/spmd.py",
+                                 "execs/collective.py")), k
         else:
             assert k.startswith("SRC006::"), k
             assert any(k.startswith(f"SRC006::{p}::")
