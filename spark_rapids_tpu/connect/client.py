@@ -1,0 +1,217 @@
+"""Connect client: submit serialized plans over TCP, receive Arrow.
+
+The client half of the Spark Connect-style ingress (docs/connect.md).
+This module deliberately imports NOTHING from the engine — stdlib
+sockets plus pyarrow only — so a client process stays engine-free: no
+session, no planner, no execs, no device runtime (the wire-parity test
+asserts exactly that on a subprocess, and
+``python -m spark_rapids_tpu.tools.connect_client`` is the packaged
+stand-alone entry point).  The server (connect/server.py) imports the
+framing helpers from HERE, so both ends share one wire contract.
+
+Wire format (one frame):
+
+    <u64 little-endian length> <1-byte tag> <payload>
+
+``length`` counts the tag byte plus the payload and is clamped against
+a maximum BEFORE any allocation on both ends (tpulint SRC014 enforces
+the server side).  Tags: ``J`` = JSON control, ``A`` = one Arrow IPC
+stream carrying one record batch.  A request is one J frame; the
+response is a J header, zero or more A frames (one per device batch —
+socket backpressure propagates straight into the engine's bounded
+prefetch queue), and a J trailer carrying rows/batches or the error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import struct
+from typing import Iterator, Optional, Union
+
+#: default frame clamp, mirroring spark.rapids.tpu.connect.maxFrameBytes
+DEFAULT_MAX_FRAME_BYTES = 64 << 20
+
+TAG_JSON = b"J"
+TAG_ARROW = b"A"
+
+
+class ConnectError(RuntimeError):
+    """Protocol-level failure (framing, transport, server rejection).
+    ``kind`` carries the server's error class when one was reported
+    (e.g. ``translate_error``, ``deadline_exceeded``,
+    ``admission_rejected``)."""
+
+    def __init__(self, message: str, kind: str = "protocol"):
+        super().__init__(message)
+        self.kind = kind
+
+
+# ------------------------------------------------------------------ #
+# Framing (shared with the server)
+# ------------------------------------------------------------------ #
+
+
+def send_frame(sock: socket.socket, tag: bytes, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload) + 1) + tag + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket,
+               max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+               ) -> tuple[bytes, bytes]:
+    """Read one ``(tag, payload)`` frame.  The length is validated
+    against ``max_frame_bytes`` BEFORE any payload allocation — an
+    oversized or nonsensical length costs 8 bytes of read, never a
+    giant bytearray (the SRC014 contract)."""
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if n < 1 or n > max_frame_bytes:
+        raise ConnectError(
+            f"frame length {n} outside (0, {max_frame_bytes}] — "
+            "oversized or corrupt frame")
+    body = _recv_exact(sock, n)
+    return body[:1], body[1:]
+
+
+def recv_json(sock: socket.socket,
+              max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> dict:
+    tag, payload = recv_frame(sock, max_frame_bytes)
+    if tag != TAG_JSON:
+        raise ConnectError(f"expected JSON frame, got tag {tag!r}")
+    try:
+        out = json.loads(payload.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ConnectError(f"malformed JSON frame: {e}") from None
+    if not isinstance(out, dict):
+        raise ConnectError("JSON frame must carry an object")
+    return out
+
+
+def table_digest(tbl) -> str:
+    """Engine-free mirror of eventlog.table_digest: sha256 of the
+    combined table's Arrow IPC stream bytes, truncated to 16 hex
+    chars — the two ends agree bit-for-bit exactly when the results
+    do."""
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        for b in tbl.combine_chunks().to_batches():
+            w.write_batch(b)
+    return hashlib.sha256(memoryview(sink.getvalue())).hexdigest()[:16]
+
+
+# ------------------------------------------------------------------ #
+# Client
+# ------------------------------------------------------------------ #
+
+
+class ConnectClient:
+    """One connection to a ConnectServer.  Requests are sequential per
+    connection (the Spark Connect ExecutePlan shape); reconnect or open
+    more clients for concurrency.  Usable as a context manager."""
+
+    def __init__(self, host: str, port: int,
+                 tenant: str = "default",
+                 timeout: float = 120.0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self.tenant = tenant
+        self._max_frame = max_frame_bytes
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+
+    # -- lifecycle -- #
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ConnectClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- requests -- #
+
+    def ping(self) -> bool:
+        send_frame(self._sock, TAG_JSON,
+                   json.dumps({"op": "ping"}).encode())
+        return bool(recv_json(self._sock, self._max_frame).get("pong"))
+
+    def execute_plan(self, plan: Union[str, dict], **kw):
+        """Submit a Substrait plan (JSON text or dict); returns the
+        full result as one pyarrow Table.  Keywords: ``conf`` (session
+        conf overrides), ``deadline_ms`` (becomes
+        spark.rapids.tpu.serving.deadlineMs server-side), and
+        ``batch_rows``."""
+        import pyarrow as pa
+
+        tbls = list(self.execute_plan_stream(plan, **kw))
+        if not tbls:
+            return pa.table({})
+        # concat (not from_batches): a 0-row frame still carries the
+        # result schema, and the reassembled table must keep it
+        return pa.concat_tables(tbls)
+
+    def execute_plan_stream(self, plan: Union[str, dict],
+                            conf: Optional[dict] = None,
+                            params: Optional[dict] = None,
+                            deadline_ms: Optional[float] = None,
+                            batch_rows: Optional[int] = None,
+                            sql: Optional[str] = None) -> Iterator:
+        """Stream the result: yields one pyarrow Table per response
+        Arrow frame (= one device batch).  ``plan`` may be None when
+        ``sql`` text is given instead."""
+        req: dict = {"op": "execute_plan", "tenant": self.tenant}
+        if plan is not None:
+            req["plan"] = plan
+        if sql is not None:
+            req["sql"] = sql
+        if conf:
+            req["conf"] = dict(conf)
+        if params:
+            req["params"] = dict(params)
+        if deadline_ms is not None:
+            req["deadline_ms"] = float(deadline_ms)
+        if batch_rows is not None:
+            req["batch_rows"] = int(batch_rows)
+        send_frame(self._sock, TAG_JSON, json.dumps(req).encode())
+        head = recv_json(self._sock, self._max_frame)
+        if not head.get("ok"):
+            raise ConnectError(head.get("error", "server error"),
+                               kind=head.get("kind", "server"))
+        import pyarrow as pa
+
+        while True:
+            tag, payload = recv_frame(self._sock, self._max_frame)
+            if tag == TAG_ARROW:
+                with pa.ipc.open_stream(pa.py_buffer(payload)) as rd:
+                    yield rd.read_all()
+                continue
+            if tag != TAG_JSON:
+                raise ConnectError(f"unexpected frame tag {tag!r}")
+            trailer = json.loads(payload.decode())
+            if not trailer.get("ok"):
+                raise ConnectError(
+                    trailer.get("error", "stream failed"),
+                    kind=trailer.get("kind", "server"))
+            return
+
+    def execute_sql(self, sql: str, **kw):
+        """SQL-text convenience: same wire op with ``sql`` instead of a
+        Substrait plan (``params`` binds :name placeholders)."""
+        return self.execute_plan(None, sql=sql, **kw)

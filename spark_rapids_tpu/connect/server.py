@@ -1,0 +1,378 @@
+"""Connect server: the wire-facing plan ingress (docs/connect.md).
+
+A ThreadingTCPServer on the shuffle/net.py length-prefixed framing
+idiom (framing shared with the engine-free client, connect/client.py).
+One connection = one sequential request loop (the Spark Connect
+ExecutePlan shape); each connection gets its own engine session per
+(tenant, conf-override) combination, so concurrent tenants ride the
+process-wide serving substrate — weighted-fair admission, the
+prepared-plan cache keyed by the wire plan's structural key, the
+cross-tenant result/scan caches — while never sharing a mutable conf.
+
+Serving-seam discipline (tpulint SRC014): nothing here calls
+``DataFrame.collect()``; every query drains through
+``PreparedQuery.execute_stream`` → ``_stream_tpu`` — admission,
+cancellation, sharing, history and the event log all engage exactly as
+for an in-process query, and the per-query record carries a ``connect``
+section (peer, wire_bytes, translate_ms).
+
+Failure contract:
+
+- translate errors (bad Substrait / SQL outside the subset), admission
+  rejection, quarantine and deadline expiry are reported as error
+  frames; the connection stays usable (and the server certainly
+  survives);
+- malformed or oversized frames get an error frame and close ONLY that
+  connection — the length clamp runs before any allocation;
+- a dropped client connection cancels the in-flight query via its
+  CancelToken, so the engine unwinds cooperatively (admission slot
+  released, partial metrics recorded as a cancelled outcome).
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+import time
+from typing import Optional
+
+from spark_rapids_tpu.connect import (
+    BATCH_ROWS,
+    MAX_FRAME_BYTES,
+    SEND_BUFFER_BYTES,
+    SOCKET_TIMEOUT_S,
+)
+from spark_rapids_tpu.connect.client import (
+    TAG_ARROW,
+    TAG_JSON,
+    ConnectError,
+    recv_frame,
+    send_frame,
+)
+
+
+class _SessionState:
+    """Per-connection engine state for one (tenant, conf-overrides)
+    combination: the Substrait and SQL frontends share one TpuSession
+    (one plan cache, one event log, one tenant identity)."""
+
+    def __init__(self, catalog: dict, base_conf: dict,
+                 overrides: dict, tenant: str):
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.frontends.sql import SqlSession
+        from spark_rapids_tpu.frontends.substrait import (
+            SubstraitFrontend,
+        )
+
+        conf = TpuConf()
+        # pin the value set to the server's frozen snapshot + the
+        # request overrides — NOT whatever the registry holds at this
+        # connection's construction time.  Confs register lazily
+        # (including per-expression kill-switches minted at tagging),
+        # so two otherwise-identical sessions built before/after the
+        # first query would fingerprint differently and fork every
+        # fingerprint-keyed cache (plan cache, cross-tenant result
+        # cache).  Unregistered keys fall back to registry defaults
+        # through TpuConf.get.
+        conf._values = dict(base_conf)
+        for k, v in overrides.items():
+            conf.set(k, v)
+        self.conf = conf
+        self.substrait = SubstraitFrontend(conf)
+        self.session = self.substrait._session
+        self.session.tenant = tenant
+        self.sql = SqlSession(session=self.session)
+        for name, source in catalog.items():
+            self.substrait.register_table(name, source)
+            self._register_sql(name, source)
+
+    def _register_sql(self, name: str, source) -> None:
+        import pyarrow as pa
+
+        if isinstance(source, pa.Table):
+            self.sql.register_table(name, source)
+        else:
+            paths = [source] if isinstance(source, str) else list(source)
+            self.sql.register_parquet(name, *paths)
+
+
+class _ConnectHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        srv = self.server
+        conf = srv.base_tpu_conf  # type: ignore[attr-defined]
+        max_frame = conf.get(MAX_FRAME_BYTES)
+        self.request.settimeout(conf.get(SOCKET_TIMEOUT_S))
+        sndbuf = conf.get(SEND_BUFFER_BYTES)
+        if sndbuf:
+            import socket as _socket
+
+            self.request.setsockopt(_socket.SOL_SOCKET,
+                                    _socket.SO_SNDBUF, int(sndbuf))
+        peer = "%s:%s" % self.client_address[:2]
+        states: dict[tuple, _SessionState] = {}
+        while True:
+            try:
+                tag, payload = recv_frame(self.request, max_frame)
+                if tag != TAG_JSON:
+                    raise ConnectError(
+                        f"expected JSON frame, got tag {tag!r}")
+                try:
+                    req = json.loads(payload.decode())
+                except (UnicodeDecodeError,
+                        json.JSONDecodeError) as je:
+                    raise ConnectError(
+                        f"malformed JSON frame: {je}") from None
+                if not isinstance(req, dict):
+                    raise ConnectError("JSON frame must carry an "
+                                       "object")
+            except ConnectError as e:
+                # EOF mid-length-read is a normal disconnect; anything
+                # else (oversized length, bad tag, bad JSON) gets a
+                # best-effort error frame — either way only THIS
+                # connection closes
+                if "closed mid-frame" not in str(e):
+                    self._reply_error(str(e), "bad_frame")
+                return
+            except OSError:
+                return
+            op = req.get("op")
+            if op == "ping":
+                self._reply({"ok": True, "pong": True})
+                continue
+            if op != "execute_plan":
+                self._reply_error(f"unknown op {op!r}", "bad_request")
+                continue
+            try:
+                # the exact request bytes as framed on the wire (the
+                # length recv_frame already validated), not a re-dump
+                self._execute(srv, states, req, peer, len(payload))
+            except OSError:
+                return  # client gone; _execute already cancelled
+
+    # -- replies ----------------------------------------------------- #
+
+    def _reply(self, obj: dict) -> None:
+        send_frame(self.request, TAG_JSON, json.dumps(obj).encode())
+
+    def _reply_error(self, message: str, kind: str) -> None:
+        try:
+            self._reply({"ok": False, "error": message, "kind": kind})
+        except OSError:
+            pass
+
+    # -- the one query path ------------------------------------------ #
+
+    def _execute(self, srv, states: dict, req: dict, peer: str,
+                 wire_bytes: int) -> None:
+        from spark_rapids_tpu.frontends.sql import SqlError
+        from spark_rapids_tpu.frontends.substrait import SubstraitError
+        from spark_rapids_tpu.serving.cancel import DEADLINE_MS
+
+        tenant = str(req.get("tenant") or "default")
+        overrides = dict(req.get("conf") or {})
+        key = (tenant, tuple(sorted(
+            (str(k), str(v)) for k, v in overrides.items())))
+        state = states.get(key)
+        if state is None:
+            state = states[key] = _SessionState(
+                srv.catalog, srv.base_conf_values, overrides, tenant)
+        # the wire deadline becomes serving.deadlineMs for THIS request
+        # (restored to the PRE-REQUEST value right after — which may
+        # itself be a session-level conf override; requests on one
+        # connection are sequential, and restoring the prior value
+        # restores the constructed conf fingerprint)
+        deadline = req.get("deadline_ms")
+        if deadline is not None:
+            prev_deadline = state.conf.get(DEADLINE_MS)
+            state.conf.set(DEADLINE_MS.key, float(deadline))
+        try:
+            t0 = time.perf_counter()
+            try:
+                if req.get("sql") is not None:
+                    pq = state.sql.prepare(str(req["sql"]))
+                    params = self._decode_params(req.get("params"))
+                else:
+                    plan = req.get("plan")
+                    if plan is None:
+                        raise ConnectError(
+                            "execute_plan needs 'plan' or 'sql'",
+                            kind="bad_request")
+                    df = state.substrait.dataframe(plan)
+                    pq = state.session.prepare(df)
+                    params = None
+            except (SubstraitError, SqlError, ConnectError,
+                    KeyError, TypeError, ValueError) as e:
+                self._reply_error(
+                    f"{type(e).__name__}: {e}", "translate_error")
+                return
+            translate_ms = (time.perf_counter() - t0) * 1e3
+            batch_rows = int(req.get("batch_rows")
+                             or state.conf.get(BATCH_ROWS) or 0) or None
+            facts = {"connect": {
+                "peer": peer, "wire_bytes": wire_bytes,
+                "translate_ms": round(translate_ms, 3)}}
+            self._stream_result(pq, params, batch_rows, facts)
+        finally:
+            if deadline is not None:
+                state.conf.set(DEADLINE_MS.key, prev_deadline)
+
+    @staticmethod
+    def _decode_params(raw: Optional[dict]) -> Optional[dict]:
+        """JSON carries no date type: ``{"name": {"date":
+        "2001-01-02"}}`` binds a date parameter; everything else binds
+        as-is."""
+        if not raw:
+            return None
+        import datetime as _dt
+
+        out = {}
+        for k, v in raw.items():
+            if isinstance(v, dict) and set(v) == {"date"}:
+                v = _dt.date.fromisoformat(v["date"])
+            out[k] = v
+        return out
+
+    def _stream_result(self, pq, params, batch_rows: Optional[int],
+                       facts: dict) -> None:
+        """Drain one prepared query to the socket: J header, one A
+        frame per record batch off the engine's streaming fetch path
+        (socket backpressure stalls the producer, not the process), J
+        trailer.  A send failure = the client dropped — cancel the
+        in-flight query via its CancelToken and let it unwind
+        cooperatively before closing."""
+        import pyarrow as pa
+
+        from spark_rapids_tpu.serving.cancel import (
+            QueryCancelled,
+            TenantQuarantined,
+        )
+        from spark_rapids_tpu.serving.scheduler import AdmissionRejected
+
+        gen = pq.execute_stream(params=params, batch_rows=batch_rows,
+                                extra_facts=facts)
+        rows = 0
+        batches = 0
+        sent_header = False
+        try:
+            while True:
+                try:
+                    rb = next(gen)
+                except StopIteration:
+                    break
+                except QueryCancelled as e:
+                    self._reply_error(str(e), e.reason)
+                    return
+                except (TenantQuarantined, AdmissionRejected) as e:
+                    self._reply_error(str(e), "admission_rejected")
+                    return
+                except Exception as e:  # noqa: BLE001 — wire boundary:
+                    # the engine already classified/recorded; the
+                    # client gets the terminal error frame
+                    self._reply_error(
+                        f"{type(e).__name__}: {e}", "execution_error")
+                    return
+                if not sent_header:
+                    self._reply({"ok": True})
+                    sent_header = True
+                try:
+                    sink = pa.BufferOutputStream()
+                    with pa.ipc.new_stream(sink, rb.schema) as w:
+                        w.write_batch(rb)
+                    send_frame(self.request, TAG_ARROW,
+                               sink.getvalue().to_pybytes())
+                except OSError:
+                    # client dropped mid-stream: cancel via the
+                    # token, then drain to the cancellation point so
+                    # the engine records the cancelled outcome and
+                    # releases its admission slot (already-produced
+                    # batches yield without a checkpoint; the token
+                    # raises at the next production checkpoint), then
+                    # propagate the disconnect
+                    pq.cancel(reason="cancelled")
+                    try:
+                        for _ in gen:
+                            pass
+                    except QueryCancelled:
+                        pass
+                    raise
+                rows += rb.num_rows
+                batches += 1
+            if not sent_header:
+                self._reply({"ok": True})
+            if batches == 0:
+                # an empty result still carries its SCHEMA: one empty
+                # Arrow frame, so the client reassembles a
+                # schema-bearing 0-row table bit-identical to an
+                # in-process collect (not a columnless placeholder)
+                from spark_rapids_tpu.columnar.arrow import (
+                    schema_to_arrow,
+                )
+
+                entry, _hit = pq._resolve(params)  # cached
+                aschema = schema_to_arrow(entry.exec_.schema)
+                empty = pa.RecordBatch.from_arrays(
+                    [pa.array([], type=f.type) for f in aschema],
+                    schema=aschema)
+                sink = pa.BufferOutputStream()
+                with pa.ipc.new_stream(sink, aschema) as w:
+                    w.write_batch(empty)
+                send_frame(self.request, TAG_ARROW,
+                           sink.getvalue().to_pybytes())
+                batches = 1
+            self._reply({"ok": True, "rows": rows, "batches": batches})
+        finally:
+            gen.close()
+
+
+class ConnectServer:
+    """The wire front door: register tables, start, take queries.
+
+    ``conf`` seeds every connection session (per-request overrides
+    layer on top); ``catalog`` entries are pyarrow Tables or parquet
+    path(s), registered under their name for both the Substrait
+    (namedTable) and SQL frontends of every connection."""
+
+    def __init__(self, conf=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.tools.gen_docs import (
+            load_conf_registrars,
+        )
+
+        # complete the conf registry BEFORE any session conf is
+        # snapshotted: a lazily-registered conf appearing between two
+        # connections would fork their fingerprints and split every
+        # fingerprint-keyed cache (plan cache, result cache) across
+        # tenants issuing identical queries
+        load_conf_registrars()
+        self.base_conf = conf if conf is not None else TpuConf()
+        self.catalog: dict = {}
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _ConnectHandler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.base_tpu_conf = self.base_conf
+        # raw (key, value) overrides a _SessionState reconstructs its
+        # TpuConf from: the base conf's non-default values
+        self._srv.base_conf_values = dict(self.base_conf._values)
+        self._srv.catalog = self.catalog
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="tpu-connect-server")
+
+    def register_table(self, name: str, source) -> None:
+        """``source``: pa.Table, or parquet path(s).  Takes effect for
+        connections opened after the call."""
+        self.catalog[name.lower()] = source
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def start(self) -> "ConnectServer":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

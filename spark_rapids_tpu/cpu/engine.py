@@ -773,6 +773,23 @@ def _dispatch_extended(e, table, n):  # noqa: C901
                else _dec.Decimal(int(v)).scaleb(-e.scale)
                for v in c.cast(pa.int64()).to_pylist()]
         return pa.array(out, T.to_arrow_type(e.dtype))
+    if isinstance(e, DT.AddMonths):
+        import calendar as _cal
+        import datetime as _pydt
+
+        c = cpu_eval(e.child, table)
+        v, ok = _np_vals(c.cast(pa.int32()), pa.int32())
+        epoch = _pydt.date(1970, 1, 1)
+
+        def _shift(x: int) -> int:
+            d = epoch + _pydt.timedelta(days=int(x))
+            mi = d.year * 12 + (d.month - 1) + e.months
+            y, m = divmod(mi, 12)
+            day = min(d.day, _cal.monthrange(y, m + 1)[1])
+            return (_pydt.date(y, m + 1, day) - epoch).days
+
+        out = np.array([_shift(x) for x in v], np.int32)
+        return _from_np(out, ok, pa.int32()).cast(pa.date32())
     if isinstance(e, (DT.DateAdd, DT.DateSub)):
         l = cpu_eval(e.left, table).cast(pa.int32())
         r = cpu_eval(e.right, table).cast(pa.int32())

@@ -442,6 +442,11 @@ class EventLogWriter:
                 programs = _ledger.summarize(d)
         counters = counters_delta(pre["counters"], counters_snapshot())
         sctx = current_serving_context()
+        # the wire-ingress section (docs/connect.md): the connect
+        # server deposits peer/wire_bytes/translate_ms through the
+        # serving facts; it is its own record section, not a serving
+        # fact — in-process queries never carry one
+        connect = sctx.pop("connect", None) if sctx else None
         if sctx:
             if "admit_wait_ms" in sctx:
                 counters["serve.admit_wait_ms"] = sctx["admit_wait_ms"]
@@ -474,6 +479,7 @@ class EventLogWriter:
             "faults": faults.fault_stats() or None,
             "serving": sctx,
             "sharing": sharing,
+            "connect": connect,
             "programs": programs,
         }
 
@@ -526,6 +532,7 @@ class EventLogWriter:
             "faults": post["faults"],
             "serving": post.get("serving"),
             "sharing": post.get("sharing"),
+            "connect": post.get("connect"),
             "programs": post.get("programs"),
             "result_digest": result_digest,
             "rows": rows,

@@ -82,6 +82,10 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
         # result-cache verdict for this query plus its share.*
         # counter deltas — None when the sharing tier never engaged
         "sharing": (dict, type(None)),
+        # wire-ingress provenance (connect/server.py): peer address,
+        # request wire bytes and plan-translate ms — present only for
+        # queries that arrived over the connect front door
+        "connect": (dict, type(None)),
         # device-ledger attribution for this query (trace/ledger.py):
         # {"programs": {key: {...}}, "totals": {...}} — present only
         # when the ledger was enabled for the query

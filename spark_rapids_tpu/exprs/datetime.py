@@ -148,6 +148,55 @@ class LastDay(Expression):
 
 
 @dataclasses.dataclass(repr=False)
+class AddMonths(Expression):
+    """add_months(date, n) — calendar month shift with end-of-month
+    clamping (ref: GpuAddMonths, datetimeExpressions.scala): Jan 31 +
+    1 month = Feb 28 (29 in leap years).  Proleptic Gregorian on
+    device via Hinnant's civil conversions, so pre-1582 dates shift
+    exactly like Python's datetime does — the month/year arm of the
+    SQL frontend's date-column interval arithmetic lowers here."""
+
+    child: Expression
+    months: int
+
+    @property
+    def dtype(self) -> T.DataType:
+        return T.DATE
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    @property
+    def name(self) -> str:
+        return f"add_months({self.child.name}, {self.months})"
+
+    @property
+    def children(self) -> tuple:
+        return (self.child,)
+
+    def with_children(self, children):
+        return AddMonths(children[0], self.months)
+
+    def check_supported(self) -> None:
+        if not isinstance(self.child.dtype, T.DateType):
+            raise TypeError("AddMonths needs a date input")
+
+    def eval(self, ctx: EvalContext) -> AnyColumn:
+        c = self.child.eval(ctx)
+        days = c.data.astype(jnp.int32)
+        y, m, d = civil_from_days(days)
+        mi = y.astype(jnp.int64) * 12 + (m - 1) + jnp.int64(self.months)
+        # floor divmod keeps pre-year-1 months correct
+        y2 = (jnp.where(mi >= 0, mi, mi - 11) // 12).astype(jnp.int32)
+        m2 = (mi - y2.astype(jnp.int64) * 12).astype(jnp.int32) + 1
+        dim = jnp.take(_DAYS_IN_MONTH, m2 - 1)
+        dim = jnp.where((m2 == 2) & _leap(y2), 29, dim)
+        d2 = jnp.minimum(d, dim)
+        return Column(days_from_civil(y2, m2, d2), c.validity, T.DATE)
+
+
+@dataclasses.dataclass(repr=False)
 class _TimeField(Expression):
     child: Expression
 

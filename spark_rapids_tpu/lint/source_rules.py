@@ -136,6 +136,16 @@ Rules
   abort-then-join teardown, whose wake-up is the channel abort, not a
   poll) are baselined with their justification in
   tests/test_lint.py's coverage contract.
+- SRC014 (error): wire-facing handler discipline in connect/.  A
+  frame length read off the wire (``struct.unpack``) must be clamped
+  by an ``if``-raise guard BEFORE it feeds any allocation or read —
+  an 8-byte hostile length must cost an error frame, never a giant
+  bytearray; and nothing under connect/ may call ``.collect()`` /
+  ``collect_exec()`` / ``execute_cpu()`` directly — every wire query
+  routes through the admission-controlled serving seam
+  (PreparedQuery.execute_stream → _stream_tpu) so deadline/cancel
+  propagation and the per-query ``connect`` record engage
+  (docs/connect.md).
 """
 
 from __future__ import annotations
@@ -599,6 +609,129 @@ class _UnboundedWaitChecker(ast.NodeVisitor):
                      "non-poll wake-up",
                 line=getattr(node, "lineno", 0)))
         self.generic_visit(node)
+
+
+#: SRC014: engine entry points a wire-facing handler must NOT call
+#: directly — the connect ingress routes every query through the
+#: admission-controlled serving seam (PreparedQuery.execute_stream /
+#: _stream_tpu), never a bare collect
+_WIRE_FORBIDDEN_CALLS = {"collect_exec", "execute_cpu"}
+
+
+class _WireHandlerChecker(ast.NodeVisitor):
+    """SRC014: wire-facing code under connect/ must (a) clamp a frame
+    length read off the wire BEFORE allocating with it, and (b) never
+    call collect()/collect_exec()/execute_cpu() directly.
+
+    (a) syntactically: a function that assigns from ``struct.unpack``
+    (the length-prefix read) and then passes one of those names to any
+    call (``recv``/``_recv_exact``/``bytearray`` — the allocation)
+    must also contain an ``if``-guard comparing that name and raising.
+    Without the clamp, an 8-byte hostile length becomes an arbitrary
+    allocation — the server must reject oversized frames, not die
+    trying to honor them (docs/connect.md).
+
+    (b) a direct collect bypasses admission control, the deadline/
+    cancellation substrate and the per-query serving record; the
+    blessed path is the prepared-statement streaming seam."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def _qual(self) -> str:
+        return self._fn_stack[-1] if self._fn_stack else "<module>"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self._check_unclamped_lengths(node)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _terminal_name(node.func)
+        is_collect_attr = isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "collect"
+        if is_collect_attr or name in _WIRE_FORBIDDEN_CALLS:
+            what = (f".{node.func.attr}()" if is_collect_attr
+                    else f"{name}()")
+            self.out.append(Diagnostic(
+                "SRC014", "error", f"{self.path}::{self._qual()}",
+                f"wire-facing handler calls {what} directly, "
+                "bypassing the admission-controlled serving seam",
+                hint="route wire queries through "
+                     "PreparedQuery.execute_stream/_stream_tpu so "
+                     "admission, deadline/cancel propagation and the "
+                     "per-query connect record all engage "
+                     "(docs/connect.md)",
+                line=getattr(node, "lineno", 0)))
+        self.generic_visit(node)
+
+    # -- (a): unpack-then-allocate without a clamp ------------------- #
+
+    @staticmethod
+    def _assigned_names(target: ast.expr) -> set[str]:
+        return {n.id for n in ast.walk(target)
+                if isinstance(n, ast.Name)}
+
+    @classmethod
+    def _own_nodes(cls, node: ast.AST):
+        """This function's own statements/expressions — nested defs
+        are excluded (they get their own visit)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield child
+            yield from cls._own_nodes(child)
+
+    def _check_unclamped_lengths(self, fn: ast.FunctionDef) -> None:
+        unpacked: dict[str, int] = {}  # name -> lineno
+        guarded: set[str] = set()
+        used: dict[str, int] = {}
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and _terminal_name(node.value.func) == "unpack":
+                for t in node.targets:
+                    for nm in self._assigned_names(t):
+                        unpacked[nm] = node.lineno
+            if isinstance(node, ast.If):
+                has_raise = any(isinstance(x, ast.Raise)
+                                for x in ast.walk(node))
+                if has_raise:
+                    for x in ast.walk(node.test):
+                        if isinstance(x, ast.Name):
+                            guarded.add(x.id)
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) != "unpack":
+                for a in list(node.args) \
+                        + [k.value for k in node.keywords]:
+                    for x in ast.walk(a):
+                        if isinstance(x, ast.Name):
+                            used.setdefault(x.id, node.lineno)
+        for nm, line in sorted(unpacked.items()):
+            if nm in used and nm not in guarded:
+                self.out.append(Diagnostic(
+                    "SRC014", "error",
+                    f"{self.path}::{fn.name}",
+                    f"wire frame length {nm!r} (struct.unpack) is "
+                    "used to allocate/read without a clamp guard",
+                    hint="validate the length against "
+                         "spark.rapids.tpu.connect.maxFrameBytes and "
+                         "raise BEFORE any allocation — an 8-byte "
+                         "hostile length must never become a giant "
+                         "bytearray (docs/connect.md)",
+                    line=used[nm]))
+
+
+def _is_wire_module(path: str) -> bool:
+    """SRC014 scope: the wire-facing connect ingress package."""
+    parts = path.replace("\\", "/").split("/")
+    return "connect" in parts
 
 
 #: SRC013: attribute-call spellings that force a device->host sync —
@@ -1270,6 +1403,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _UnboundedWaitChecker(path, out).visit(tree)
     if _is_collective_step_module(path):
         _CollectiveStepSyncChecker(path, out).run(tree)
+    if _is_wire_module(path):
+        _WireHandlerChecker(path, out).visit(tree)
     return out
 
 

@@ -108,7 +108,7 @@ for _sig, _classes in (
              BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned)),
     (_DT, (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
            DT.DayOfYear, DT.Quarter, DT.LastDay, DT.Hour, DT.Minute,
-           DT.Second, DT.DateAdd, DT.DateSub, DT.DateDiff,
+           DT.Second, DT.DateAdd, DT.DateSub, DT.AddMonths, DT.DateDiff,
            DT.UnixTimestampFromTs, DT.DateFormatClass, DT.TimeAdd,
            DT.TimeSub, DT.DateAddInterval)),
     (TS.ExprSig(TS.INTEGRAL + TS.NULLSIG,

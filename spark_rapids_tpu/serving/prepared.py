@@ -145,7 +145,22 @@ class PreparedQuery:
 
     # -- execution --------------------------------------------------- #
 
-    def execute(self, params: Optional[dict] = None):
+    def _facts(self, hit: bool,
+               extra_facts: Optional[dict]) -> dict:
+        """The per-query serving facts deposited inside the admission
+        scope.  ``extra_facts`` lets an ingress layer attach its own
+        record section (the connect server's peer/wire_bytes/
+        translate_ms — docs/connect.md) without a second deposit
+        path."""
+        facts = {"plan_cache": "hit" if hit else "miss",
+                 "admission_group":
+                     self._group_key(self._session.conf)}
+        if extra_facts:
+            facts.update(extra_facts)
+        return facts
+
+    def execute(self, params: Optional[dict] = None,
+                extra_facts: Optional[dict] = None):
         """Run the template (binding ``params`` for SQL templates) and
         return the full Arrow result table.  Cache hits skip straight
         to draining the cached lowered plan.  The entry's re-drain
@@ -156,10 +171,7 @@ class PreparedQuery:
         out, _qid = entry.df._collect_tpu(
             exec_=entry.exec_, meta=entry.meta,
             drain_lock=entry.lock,
-            serving_facts={
-                "plan_cache": "hit" if hit else "miss",
-                "admission_group":
-                    self._group_key(self._session.conf)},
+            serving_facts=self._facts(hit, extra_facts),
             token_sink=self._inflight)
         return out
 
@@ -174,7 +186,8 @@ class PreparedQuery:
         return self._inflight.cancel(reason=reason)
 
     def execute_stream(self, params: Optional[dict] = None,
-                       batch_rows: Optional[int] = None) -> Iterator:
+                       batch_rows: Optional[int] = None,
+                       extra_facts: Optional[dict] = None) -> Iterator:
         """Run the template and yield the result INCREMENTALLY as
         Arrow record batches (optionally re-chunked to ``batch_rows``).
         Backpressure: the device-side producer runs at most the
@@ -187,10 +200,7 @@ class PreparedQuery:
         yield from entry.df._stream_tpu(
             exec_=entry.exec_, meta=entry.meta,
             batch_rows=batch_rows, drain_lock=entry.lock,
-            serving_facts={
-                "plan_cache": "hit" if hit else "miss",
-                "admission_group":
-                    self._group_key(self._session.conf)},
+            serving_facts=self._facts(hit, extra_facts),
             token_sink=self._inflight)
 
     # -- introspection ----------------------------------------------- #

@@ -1375,7 +1375,17 @@ class DataFrame:
                 update_serving_context(**facts)
             from spark_rapids_tpu.serving import work_share as _ws
 
-            if _ws.enabled(conf):
+            sharing = _ws.enabled(conf)
+            #: sharing-on miss path: accumulate the streamed batches
+            #: (bounded to the result cache's own single-result cap,
+            #: budget/4) so a fully-drained stream populates the
+            #: cross-tenant result cache exactly like a collect — the
+            #: wire front door streams every query, and a front door
+            #: that never fills the cache would defeat the sharing
+            #: economics (docs/connect.md).  None = not accumulating.
+            share_acc: Optional[list] = None
+            share_cap = 0
+            if sharing:
                 cached, verdict = _ws.lookup_result(self._plan, conf)
                 if verdict is not None:
                     update_serving_context(result_cache=verdict)
@@ -1387,6 +1397,8 @@ class DataFrame:
                     for rb in out.to_batches(max_chunksize=batch_rows):
                         yield rb
                     return
+                share_acc = []
+                share_cap = conf.get(_ws.RESULT_CACHE_BUDGET) // 4
             qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
                 _begin_query(self._session, conf)
             if tok is not None:
@@ -1409,6 +1421,14 @@ class DataFrame:
             rows = 0
             gen = stream_exec(exec_, stage="serve.stream.fetch")
             try:
+                #: wire frames re-chunked from the current engine
+                #: table — drained with a cancellation checkpoint per
+                #: frame, so a cancel lands between frames even when
+                #: the whole result arrived as ONE table (otherwise a
+                #: stalled consumer's cancel could not interrupt the
+                #: re-chunk loop; the connect server's disconnect
+                #: cancellation rests on this)
+                pending: list = []
                 while True:
                     # re-attach the query's trace context AND cancel
                     # token around each pull (NOT across yields: the
@@ -1417,7 +1437,24 @@ class DataFrame:
                     with _trace.attach_context(tctx), \
                             _cancel.attach_token(tok):
                         try:
-                            tbl = next(gen)
+                            if pending:
+                                _cancel.check_point()
+                                rb = pending.pop(0)
+                            else:
+                                tbl = next(gen)
+                                rows += tbl.num_rows
+                                if share_acc is not None:
+                                    share_acc.append(tbl)
+                                    if sum(t.nbytes
+                                           for t in share_acc) \
+                                            > share_cap:
+                                        # past the cache's single-
+                                        # result cap: stop
+                                        # accumulating, free the held
+                                        share_acc = None
+                                pending = list(tbl.to_batches(
+                                    max_chunksize=batch_rows))
+                                continue
                         except StopIteration:
                             break
                         except _cancel.QueryCancelled as e:
@@ -1427,15 +1464,19 @@ class DataFrame:
                             # CANCELLED one is an observable outcome
                             if e.query_id is None:
                                 e.query_id = qid
+                            # bind NOW: the except-variable `e` is
+                            # unbound when the block exits, but the
+                            # closure runs later on the history worker
+                            reason = e.reason
                             expl = (meta.explain()
-                                    + f"\n[stream unwound: {e.reason}]")
+                                    + f"\n[stream unwound: {reason}]")
 
                             def _on_cancel_event():
                                 if elog is None:
                                     return None
                                 post = elog.query_end(pre)
                                 return lambda ev: elog.log_query(
-                                    ev, post, expl, e.reason,
+                                    ev, post, expl, reason,
                                     result_digest=None, rows=rows)
 
                             _record_query(
@@ -1444,11 +1485,16 @@ class DataFrame:
                                 _on_cancel_event(), baseline=baseline)
                             e.recorded = True
                             raise
-                    rows += tbl.num_rows
-                    for rb in tbl.to_batches(max_chunksize=batch_rows):
-                        yield rb
+                    yield rb
             finally:
                 gen.close()
+            if share_acc:
+                # fully drained with sharing on: offer the result so
+                # the next tenant's identical query is a cache hit
+                # (offer_result re-checks shareability and size;
+                # empty results are simply not offered)
+                _ws.offer_result(self._plan, conf,
+                                 pa.concat_tables(share_acc))
             # fully drained: record the query (an ABANDONED stream —
             # generator closed early — records nothing; its partial
             # metrics would read as a complete run).  The execute span

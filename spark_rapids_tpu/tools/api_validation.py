@@ -17,7 +17,8 @@ from __future__ import annotations
 
 #: reference expression rules (GpuOverrides.scala:773-2669 + shims)
 REFERENCE_EXPRESSIONS = """
-Abs Acos Acosh Add AggregateExpression Alias And ArrayContains Asin Asinh
+Abs Acos Acosh Add AddMonths AggregateExpression Alias And ArrayContains
+Asin Asinh
 AtLeastNNonNulls Atan Atanh AttributeReference Average BRound BitwiseAnd
 BitwiseNot BitwiseOr BitwiseXor CaseWhen Cbrt Ceil CheckOverflow Coalesce
 CollectList Concat ConcatWs Contains Cos Cosh Cot Count CreateArray

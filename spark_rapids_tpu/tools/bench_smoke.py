@@ -839,6 +839,82 @@ def run_fusion_smoke() -> dict:
     return out
 
 
+def run_connect_smoke() -> dict:
+    """The wire front-door contract (spark_rapids_tpu/connect/,
+    docs/connect.md): an in-process ConnectServer thread serves one
+    wire query — a Substrait plan over real TCP framing — and the
+    Arrow batches reassembled by the engine-free client must digest
+    bit-identical to the SAME plan collected in-process, with the
+    repeat request hitting the prepared-plan cache (tier-1 via
+    tests/test_connect.py)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.connect.client import (
+        ConnectClient,
+        table_digest,
+    )
+    from spark_rapids_tpu.connect.server import ConnectServer
+    from spark_rapids_tpu.frontends.substrait import SubstraitFrontend
+
+    rng = np.random.default_rng(41)
+    n = 4096
+    t = pa.table({
+        "k": (rng.integers(0, 9, n)).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.float64),
+    })
+    plan = {
+        "extensions": [
+            {"extensionFunction": {"functionAnchor": 1,
+                                   "name": "gt:any_any"}},
+            {"extensionFunction": {"functionAnchor": 2,
+                                   "name": "sum:fp64"}},
+        ],
+        "relations": [{"root": {"names": ["k", "total"], "input": {
+            "aggregate": {
+                "input": {"filter": {
+                    "input": {"read": {
+                        "namedTable": {"names": ["t"]},
+                        "baseSchema": {"names": ["k", "v"]}}},
+                    "condition": {"scalarFunction": {
+                        "functionReference": 1, "arguments": [
+                            {"value": {"selection": {
+                                "directReference": {
+                                    "structField": {"field": 1}}}}},
+                            {"value": {"literal": {"fp64": 10.0}}},
+                        ]}}}},
+                "groupings": [{"groupingExpressions": [
+                    {"selection": {"directReference": {
+                        "structField": {"field": 0}}}}]}],
+                "measures": [{"measure": {
+                    "functionReference": 2,
+                    "arguments": [{"value": {"selection": {
+                        "directReference": {
+                            "structField": {"field": 1}}}}}]}}],
+            }}}}],
+    }
+    srv = ConnectServer()
+    srv.register_table("t", t)
+    srv.start()
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port, tenant="smoke") as cli:
+            assert cli.ping(), "connect ping failed"
+            wire1 = cli.execute_plan(plan)
+            wire2 = cli.execute_plan(plan)  # prepared-plan cache hit
+        local = SubstraitFrontend()
+        local.register_table("t", t)
+        in_proc = local.execute_plan(plan).combine_chunks()
+        d_wire, d_local = table_digest(wire1), table_digest(in_proc)
+        assert d_wire == d_local, (
+            f"wire digest {d_wire} != in-process {d_local}")
+        assert table_digest(wire2) == d_local, "repeat wire mismatch"
+    finally:
+        srv.shutdown()
+    return {"connect_smoke_rows": wire1.num_rows,
+            "connect_smoke_digest": d_wire}
+
+
 def run_smoke() -> dict:
     """Collect each smoke query with speculation on, then off, assert
     table equality, and return {query_name: rows}."""
@@ -885,6 +961,7 @@ def main() -> int:
     results.update(run_ledger_smoke())
     results.update(run_wire_codec_smoke())
     results.update(run_fusion_smoke())
+    results.update(run_connect_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

@@ -458,9 +458,9 @@ def test_exists_over_derived_table_reuses_lowering(monkeypatch):
     calls: list[int] = []
     orig = SqlSession._lower
 
-    def spy(self, q):
+    def spy(self, q, ctes=None):
         calls.append(id(q))
-        return orig(self, q)
+        return orig(self, q, ctes)
 
     monkeypatch.setattr(SqlSession, "_lower", spy)
     df = fe.sql("select ok from t1 where exists "

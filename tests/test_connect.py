@@ -1,0 +1,428 @@
+"""The Connect-style wire front door (spark_rapids_tpu/connect/,
+docs/connect.md):
+
+- THE wire-parity acceptance test: an EXTERNAL CLIENT PROCESS (engine
+  modules never imported) submits a plan over TCP and the Arrow
+  batches it reassembles digest bit-identical to an in-process
+  collect;
+- multi-batch round trip with strings + NULLs, equality vs collect;
+- a wire deadline expiring in the admission queue sheds with ZERO
+  device work (no ledger programs, no jit compiles, no tapped upload
+  bytes) and records engine="deadline_exceeded";
+- a dropped client connection cancels the in-flight query via its
+  CancelToken — the engine unwinds cooperatively and every residency
+  gauge returns to baseline (conftest.leak_check, module-wide);
+- malformed and oversized frames are rejected without killing the
+  server (the SRC014 clamp contract);
+- two tenants over two sockets share the process-wide result cache;
+- the per-query event-log record carries the `connect` section
+  (peer, wire_bytes, translate_ms);
+- the tier-1 hook for tools/bench_smoke.run_connect_smoke.
+"""
+
+import json
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf, get_conf
+from spark_rapids_tpu.connect.client import (
+    ConnectClient,
+    ConnectError,
+    table_digest,
+)
+from spark_rapids_tpu.connect.server import ConnectServer
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.serving import cancel as C
+from spark_rapids_tpu.serving import clear_serving_context
+from spark_rapids_tpu.serving import scheduler as scheduler_mod
+
+
+@pytest.fixture(autouse=True)
+def _isolate_connect():
+    from spark_rapids_tpu.memory.store import reset_store
+    from spark_rapids_tpu.serving import work_share
+
+    scheduler_mod.reset()
+    C.reset()
+    clear_serving_context()
+    TpuSemaphore.reset()
+    work_share.reset()
+    reset_store()
+    yield
+    scheduler_mod.reset()
+    C.reset()
+    clear_serving_context()
+    TpuSemaphore.reset()
+    work_share.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaks(leak_check):
+    """Every wire test proves its unwind leaked nothing.  The shared
+    caches are dropped FIRST — retained result-cache entries hold
+    store bytes by design; everything else must return to baseline."""
+    yield
+    from spark_rapids_tpu.serving import work_share
+
+    work_share.reset()
+
+
+def _table(n=6000, seed=5):
+    rng = np.random.default_rng(seed)
+    strs = np.array(["alpha", "beta", "gamma", "delta", None],
+                    dtype=object)
+    return pa.table({
+        "k": rng.integers(0, 23, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+        "s": pa.array([strs[i % 5] for i in rng.integers(0, 5, n)]),
+    })
+
+
+def _server(conf=None, table=None):
+    srv = ConnectServer(conf=conf)
+    srv.register_table("t", table if table is not None else _table())
+    return srv.start()
+
+
+SQL = ("select k, s, count(*) as n, sum(v) as sv from t "
+       "group by k, s order by k, s nulls last")
+
+
+# ------------------------------------------------------------------ #
+# Round trip parity
+# ------------------------------------------------------------------ #
+
+
+def test_multibatch_roundtrip_equals_collect():
+    """Strings + NULLs over several wire frames reassemble to the
+    exact in-process collect table (bit-identical digest)."""
+    from spark_rapids_tpu.frontends.sql import SqlSession
+
+    t = _table()
+    srv = _server(table=t)
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port, tenant="t1") as cli:
+            got = cli.execute_sql(SQL, batch_rows=16)
+        assert got.num_rows > 16  # several frames
+        fe = SqlSession()
+        fe.register_table("t", t)
+        want = fe.sql(SQL).collect(engine="tpu").combine_chunks()
+        assert table_digest(got) == table_digest(want)
+        # and the digest helper agrees with the engine's
+        from spark_rapids_tpu.eventlog import table_digest as engine_td
+
+        assert table_digest(want) == engine_td(want)
+    finally:
+        srv.shutdown()
+
+
+def test_external_client_process_wire_parity(tmp_path):
+    """THE acceptance test: a separate client PROCESS that never
+    imports the engine submits a Substrait plan over TCP and gets
+    batches digest-identical to the same plan collected in-process."""
+    from spark_rapids_tpu.frontends.substrait import SubstraitFrontend
+
+    t = _table()
+    plan = {
+        "relations": [{"root": {
+            "names": ["k", "v", "s"],
+            "input": {"read": {"namedTable": {"names": ["t"]},
+                               "baseSchema":
+                                   {"names": ["k", "v", "s"]}}}}}],
+    }
+    srv = _server(table=t)
+    try:
+        host, port = srv.address
+        plan_file = tmp_path / "plan.json"
+        plan_file.write_text(json.dumps(plan))
+        code = (
+            "import sys, json\n"
+            "from spark_rapids_tpu.connect.client import "
+            "ConnectClient, table_digest\n"
+            f"plan = json.load(open({str(plan_file)!r}))\n"
+            f"with ConnectClient({host!r}, {port}, tenant='ext') "
+            "as cli:\n"
+            "    t = cli.execute_plan(plan)\n"
+            "print('DIGEST', table_digest(t), t.num_rows)\n"
+            "engine = [m for m in sys.modules"
+            " if m.startswith('spark_rapids_tpu.')"
+            " and m.split('.')[1] in ('session', 'plan', 'execs',"
+            " 'ops', 'io', 'memory', 'parallel', 'serving',"
+            " 'frontends', 'columnar')]\n"
+            "print('ENGINE_MODULES', engine)\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        lines = dict(
+            line.split(" ", 1) for line in out.stdout.splitlines())
+        assert lines["ENGINE_MODULES"] == "[]", (
+            "client process imported the engine: "
+            + lines["ENGINE_MODULES"])
+        fe = SubstraitFrontend()
+        fe.register_table("t", t)
+        want = fe.execute_plan(plan).combine_chunks()
+        digest, rows = lines["DIGEST"].split()
+        assert int(rows) == want.num_rows
+        assert digest == table_digest(want)
+    finally:
+        srv.shutdown()
+
+
+def test_connect_client_cli(tmp_path):
+    """python -m spark_rapids_tpu.tools.connect_client --digest-only"""
+    t = _table(n=500)
+    srv = _server(table=t)
+    try:
+        host, port = srv.address
+        out = subprocess.run(
+            [sys.executable, "-m",
+             "spark_rapids_tpu.tools.connect_client",
+             "--host", host, "--port", str(port),
+             "--sql", "select k, sum(v) as sv from t group by k "
+                      "order by k",
+             "--digest-only"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        from spark_rapids_tpu.frontends.sql import SqlSession
+
+        fe = SqlSession()
+        fe.register_table("t", t)
+        want = fe.sql("select k, sum(v) as sv from t group by k "
+                      "order by k").collect(engine="tpu")
+        assert out.stdout.strip() == table_digest(
+            want.combine_chunks())
+    finally:
+        srv.shutdown()
+
+
+def test_bench_smoke_connect():
+    """tier-1 hook: the packaged connect smoke passes."""
+    from spark_rapids_tpu.tools.bench_smoke import run_connect_smoke
+
+    out = run_connect_smoke()
+    assert out["connect_smoke_rows"] > 0
+
+
+# ------------------------------------------------------------------ #
+# Deadline from the wire: shed in queue, zero device work
+# ------------------------------------------------------------------ #
+
+
+def test_wire_deadline_sheds_in_queue_zero_device_work(tmp_path):
+    from spark_rapids_tpu.columnar.transfer import upload_stats
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.trace import ledger as _ledger
+
+    conf = TpuConf({
+        "spark.rapids.tpu.serving.maxConcurrent": 1,
+        "spark.rapids.tpu.eventLog.enabled": True,
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.trace.ledger.enabled": True,
+    })
+    srv = _server(conf=conf)
+    sched = scheduler_mod.get_scheduler(conf)
+    hog = sched.admit("hog")  # occupy the only admission slot
+    try:
+        _ledger.sync_conf(conf)
+        led0 = _ledger.LEDGER.snapshot()
+        jit0 = cache_stats()
+        up0 = upload_stats()
+        host, port = srv.address
+        t0 = time.perf_counter()
+        with ConnectClient(host, port, tenant="dl") as cli:
+            with pytest.raises(ConnectError) as ei:
+                cli.execute_sql(SQL, deadline_ms=40.0)
+        waited = time.perf_counter() - t0
+        assert ei.value.kind == "deadline_exceeded"
+        assert waited < 10.0
+        # the zero-DEVICE-work contract over the wire: no ledger
+        # program activity, no byte uploaded, no program DISPATCHED.
+        # (Translate + prepared-plan resolve legitimately run before
+        # admission — that is the plan-cache design, same as an
+        # in-process PreparedQuery — so plan-time compiles are not
+        # device work; what must be zero is execution.)
+        assert _ledger.delta(led0, _ledger.LEDGER.snapshot()) == {}
+        assert upload_stats() == up0
+    finally:
+        sched.release(hog)
+        srv.shutdown()
+        _ledger.disable()
+        _ledger.sync_conf(get_conf())
+    # the shed query is an observable outcome in the event log
+    rec = _wait_for_record(tmp_path, "deadline_exceeded")
+    assert rec["engine"] == "deadline_exceeded"
+
+
+def _wait_for_record(log_dir, engine: str, timeout=10.0):
+    from spark_rapids_tpu.eventlog.reader import iter_records
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for path in sorted(log_dir.glob("*.jsonl")):
+            for rec in iter_records(str(path)):
+                if rec.get("type") == "query" \
+                        and rec.get("engine") == engine:
+                    return rec
+        time.sleep(0.1)
+    raise AssertionError(f"no {engine!r} query record in {log_dir}")
+
+
+# ------------------------------------------------------------------ #
+# Client disconnect cancels mid-stream
+# ------------------------------------------------------------------ #
+
+
+def test_client_disconnect_cancels_inflight(tmp_path):
+    """Closing the socket mid-stream cancels the query via its
+    CancelToken: the engine records a cancelled outcome and (via the
+    module-wide leak_check) every residency gauge returns to
+    baseline."""
+    conf = TpuConf({
+        "spark.rapids.tpu.eventLog.enabled": True,
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.sql.batchSizeRows": 256,
+        # tight server send buffer: the stream BLOCKS as soon as this
+        # client stops reading, so the drop is detected mid-stream
+        # instead of after the whole result fit in kernel buffers
+        "spark.rapids.tpu.connect.sendBufferBytes": 8192,
+    })
+    srv = _server(conf=conf, table=_table(n=60000))
+    try:
+        host, port = srv.address
+        cli = ConnectClient(host, port, tenant="dropper")
+        cli._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                             8192)
+        stream = cli.execute_plan_stream(
+            None, sql="select k, v, s from t", batch_rows=16)
+        first = next(stream)  # at least one frame arrived
+        assert first.num_rows > 0
+        time.sleep(0.5)  # let the producer run into the full buffer
+        cli.close()  # drop the connection mid-stream
+        rec = _wait_for_record(tmp_path, "cancelled", timeout=20.0)
+        assert rec["engine"] == "cancelled"
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Framing robustness
+# ------------------------------------------------------------------ #
+
+
+def test_malformed_and_oversized_frames_rejected():
+    srv = _server(table=_table(n=100))
+    try:
+        host, port = srv.address
+        # oversized length: rejected BEFORE allocation, with an error
+        # frame, and only this connection dies
+        with socket.create_connection((host, port), timeout=10) as s:
+            s.sendall(struct.pack("<Q", 1 << 60))
+            s.sendall(b"JXXXX")
+            from spark_rapids_tpu.connect.client import recv_json
+
+            resp = recv_json(s)
+            assert not resp["ok"] and resp["kind"] == "bad_frame"
+        # malformed JSON: same contract
+        with socket.create_connection((host, port), timeout=10) as s:
+            payload = b"Jnot-json"
+            s.sendall(struct.pack("<Q", len(payload)) + payload)
+            resp = recv_json(s)
+            assert not resp["ok"] and resp["kind"] == "bad_frame"
+        # unknown op: error frame, connection stays usable
+        with ConnectClient(host, port) as cli:
+            from spark_rapids_tpu.connect.client import (
+                TAG_JSON,
+                send_frame,
+                recv_json as rj,
+            )
+
+            send_frame(cli._sock, TAG_JSON,
+                       json.dumps({"op": "nope"}).encode())
+            resp = rj(cli._sock)
+            assert not resp["ok"] and resp["kind"] == "bad_request"
+            assert cli.ping()  # same connection still serves
+        # and the server survived all of it
+        with ConnectClient(host, port) as cli:
+            out = cli.execute_sql("select count(*) as n from t")
+            assert out.column("n")[0].as_py() == 100
+    finally:
+        srv.shutdown()
+
+
+def test_translate_error_keeps_connection():
+    srv = _server(table=_table(n=50))
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port) as cli:
+            with pytest.raises(ConnectError) as ei:
+                cli.execute_sql("select frobnicate(k) from t")
+            assert ei.value.kind == "translate_error"
+            # same connection executes the next query fine
+            out = cli.execute_sql("select count(*) as n from t")
+            assert out.column("n")[0].as_py() == 50
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Cross-tenant result sharing over the wire
+# ------------------------------------------------------------------ #
+
+
+def test_two_tenants_two_sockets_share_result_cache():
+    from spark_rapids_tpu.serving import work_share
+
+    conf = TpuConf({
+        "spark.rapids.tpu.serving.sharing.enabled": True,
+    })
+    srv = _server(conf=conf)
+    try:
+        host, port = srv.address
+        s0 = work_share.stats()
+        with ConnectClient(host, port, tenant="tenant_a") as a:
+            ra = a.execute_sql(SQL)
+        with ConnectClient(host, port, tenant="tenant_b") as b:
+            rb = b.execute_sql(SQL)
+        s1 = work_share.stats()
+        assert table_digest(ra) == table_digest(rb)
+        assert s1["result_hits"] - s0["result_hits"] >= 1, (
+            "second tenant's wire query did not hit the shared "
+            f"result cache: {s0} -> {s1}")
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Event-log connect section
+# ------------------------------------------------------------------ #
+
+
+def test_eventlog_connect_section(tmp_path):
+    conf = TpuConf({
+        "spark.rapids.tpu.eventLog.enabled": True,
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+    })
+    srv = _server(conf=conf, table=_table(n=300))
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port, tenant="logged") as cli:
+            cli.execute_sql("select count(*) as n from t")
+        rec = _wait_for_record(tmp_path, "tpu")
+        conn = rec["connect"]
+        assert conn is not None
+        assert conn["peer"].startswith("127.0.0.1:")
+        assert conn["wire_bytes"] > 0
+        assert conn["translate_ms"] >= 0
+        # the serving facts rode the same deposit (plan-cache verdict)
+        assert rec["serving"]["plan_cache"] in ("hit", "miss")
+    finally:
+        srv.shutdown()

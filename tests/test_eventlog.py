@@ -81,8 +81,8 @@ GOLDEN_QUERY_FIELDS = frozenset({
     "type", "schema_version", "query_id", "plan", "plan_hash",
     "engine", "wall_s", "start_ts", "end_ts", "start_ns", "end_ns",
     "conf_hash", "counters", "operators", "spans", "pipeline",
-    "faults", "serving", "sharing", "programs", "result_digest",
-    "rows", "trace_file"})
+    "faults", "serving", "sharing", "connect", "programs",
+    "result_digest", "rows", "trace_file"})
 
 
 def test_schema_golden_every_record_validates(tmp_path):

@@ -873,6 +873,80 @@ def test_source_lint_step_sync_rule_scoped_to_collective_modules():
             lint_source_text(_STEP_SYNC_FIXTURE, path)), path
 
 
+_WIRE_FIXTURE = """
+import json
+import struct
+
+
+def bad_recv(sock):
+    (n,) = struct.unpack("<Q", sock.recv(8))
+    return sock.recv(n)                     # SRC014: unclamped length
+
+
+def good_recv(sock, max_frame):
+    (n,) = struct.unpack("<Q", sock.recv(8))
+    if n > max_frame:
+        raise ValueError("oversized frame")
+    return sock.recv(n)                     # clamped: clean
+
+
+def bad_handler(df, exec_):
+    out = df.collect(engine="tpu")          # SRC014: bypasses serving
+    tbl = collect_exec(exec_)               # SRC014: bypasses serving
+    return out, tbl
+
+
+def good_handler(pq):
+    return list(pq.execute_stream())        # the blessed seam
+"""
+
+
+def test_source_lint_wire_handler_rules():
+    """SRC014: under connect/, a wire frame length read via
+    struct.unpack must be clamp-guarded before it feeds any
+    allocation, and nothing may call .collect()/collect_exec()/
+    execute_cpu() directly — wire queries route through the
+    admission-controlled serving seam (docs/connect.md)."""
+    diags = lint_source_text(
+        _WIRE_FIXTURE, "spark_rapids_tpu/connect/fake.py")
+    hits = [d for d in diags if d.rule == "SRC014"]
+    assert len(hits) == 3, [d.render() for d in hits]
+    assert all(h.severity == "error" for h in hits)
+    assert any("bad_recv" in h.location for h in hits)
+    assert not any("good_recv" in h.location for h in hits)
+    assert sum("bad_handler" in h.location for h in hits) == 2
+    assert not any("good_handler" in h.location for h in hits)
+    assert evaluate(lint_source_text(
+        _WIRE_FIXTURE, "spark_rapids_tpu/connect/fake.py"))[2] != 0
+
+
+def test_source_lint_wire_rule_scoped_to_connect():
+    """SRC014 polices connect/ only — shuffle/net.py's framing and
+    exec-layer collects are other contracts."""
+    for path in ("spark_rapids_tpu/shuffle/net.py",
+                 "spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/tools/fake.py"):
+        assert "SRC014" not in rules(
+            lint_source_text(_WIRE_FIXTURE, path)), path
+
+
+def test_shipped_connect_package_is_src014_clean():
+    """The shipped wire server/client pass their own rule with ZERO
+    baseline entries (the clamp lives in client.recv_frame, shared by
+    both ends)."""
+    import os
+
+    import spark_rapids_tpu
+
+    root = os.path.dirname(spark_rapids_tpu.__file__)
+    for fn in ("server.py", "client.py", "__init__.py"):
+        path = os.path.join(root, "connect", fn)
+        with open(path) as f:
+            diags = lint_source_text(
+                f.read(), f"spark_rapids_tpu/connect/{fn}")
+        assert "SRC014" not in rules(diags), fn
+
+
 # -- metric-registry checker (MET001) ----------------------------------- #
 
 _MET_UNSETTLED = """
