@@ -123,6 +123,13 @@ digest stays bit-identical to serial, and the post-phase residency
 gauges (semaphore permits, stage threads, in-flight scan shares,
 admission queue) are asserted back at baseline — a cancelled query
 is an outcome, not a leak (docs/robustness.md).
+
+Every --sessions measured window additionally runs under the runtime
+lock-order tracker (robustness/lock_tracker.py, docs/concurrency.md):
+the phase asserts ZERO lock-order cycles across the storm's
+interleavings and emits `lock_acquisitions` /
+`lock_contention_waits` / `max_lock_hold_ms` — observed registry-mutex
+contention, the HC014 health surface measured rather than inferred.
 """
 
 import json
@@ -957,6 +964,7 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
     from spark_rapids_tpu.eventlog import table_digest
     from spark_rapids_tpu.execs.jit_cache import cache_stats
     from spark_rapids_tpu.robustness import faults
+    from spark_rapids_tpu.robustness import lock_tracker as _locks
     from spark_rapids_tpu.serving import cancel as _cancel
     from spark_rapids_tpu.serving import plan_cache as _plan_cache
     from spark_rapids_tpu.serving import scheduler as _scheduler
@@ -1120,6 +1128,11 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
     # hits)
     _plan_cache.reset_stats()
     _scheduler.reset()  # fresh wait ring for the measured window
+    # runtime lock-order tracker over the measured window: the N-way
+    # repeat pass (and the cancellation storm's unwinds) is the most
+    # contended interleaving the engine sees — a cycle here is a
+    # deadlock a production fleet would eventually hit
+    _locks.install(forced=True)
     jit0 = cache_stats()
     ws0 = _ws.stats()
     cancel0 = _cancel.stats()
@@ -1141,6 +1154,11 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
     _trace.disable()
     spans = _trace.snapshot()
     _trace.clear()
+    lock_agg = _locks.aggregate_stats()
+    lock_graph = _locks.order_graph()
+    _locks.disarm()
+    assert lock_agg["cycles"] == 0, (
+        f"lock-order cycle under the serving storm: {lock_graph}")
     jit1 = cache_stats()
     pc = _plan_cache.stats()
     sched = _scheduler.scheduler_stats()
@@ -1232,6 +1250,13 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
         "deadline_exceeded_count": storm["deadline_exceeded"],
         "breaker_trips": storm["breaker_trips"],
         "quarantined_count": storm["quarantined"],
+        # measured-window lock health (runtime tracker, armed for the
+        # repeat pass): real contention on the engine's registry
+        # mutexes and the longest single hold — the HC014 surface,
+        # observed under the storm instead of inferred
+        "lock_acquisitions": lock_agg["acquisitions"],
+        "lock_contention_waits": lock_agg["contention_waits"],
+        "max_lock_hold_ms": lock_agg["max_hold_ms"],
         "admission_shed": sched.get("shed", 0),
         "poison": poison_report or None,
     }
@@ -1386,6 +1411,11 @@ def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
         "breaker_trips": head["breaker_trips"],
         "quarantined_count": head["quarantined_count"],
         "admission_shed": head["admission_shed"],
+        # lock-tracker surface (tracker armed for every measured
+        # window; the phase already asserted zero cycles)
+        "lock_acquisitions": head["lock_acquisitions"],
+        "lock_contention_waits": head["lock_contention_waits"],
+        "max_lock_hold_ms": head["max_lock_hold_ms"],
     }
     if cancel_rate > 0:
         out["cancel_rate"] = cancel_rate

@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
-# tpulint gate: static analysis over engine source, registries, and the
-# live planner's plan corpus.  Mirrors
+# tpulint gate: static analysis over engine source, registries, the
+# live planner's plan corpus, and — by default — the concurrency rules
+# (CON*: guard discipline, lock-order cycles, CV hygiene; see
+# docs/concurrency.md).  Mirrors
 # tests/test_lint.py::test_repo_is_clean_or_baselined (the tier-1 hook);
 # run it standalone for fast pre-commit feedback.
+# `scripts/lint.sh --baseline-diff` audits baseline.json for stale
+# suppressions.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

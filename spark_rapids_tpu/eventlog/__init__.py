@@ -91,6 +91,7 @@ MONOTONIC_COUNTERS = (
     "share.scan_units_decoded", "share.scan_rows_decoded",
     "cancel.cancelled", "cancel.deadline_exceeded",
     "cancel.breaker_trips", "cancel.quarantined",
+    "lock.acquisitions", "lock.contention_waits", "lock.cycles",
 )
 
 
@@ -167,6 +168,15 @@ def counters_snapshot() -> dict[str, float]:
     out["semaphore.in_use"] = TpuSemaphore.usage_now()["in_use"]
     out["pipeline.stage_threads"] = live_stage_threads()
     out["scan.inflight"] = work_share.SCAN_REGISTRY.inflight()
+    from spark_rapids_tpu.robustness import lock_tracker
+
+    ls = lock_tracker.aggregate_stats()
+    out["lock.acquisitions"] = ls["acquisitions"]
+    out["lock.contention_waits"] = ls["contention_waits"]
+    out["lock.cycles"] = ls["cycles"]
+    # hold-time high-water GAUGE (HC014 reads it against holdBudgetMs);
+    # all-zero when the tracker is disarmed (the default)
+    out["lock.max_hold_ms"] = ls["max_hold_ms"]
     return out
 
 

@@ -1,6 +1,6 @@
 """tpulint: static analysis for plans, registries, and engine source.
 
-Four analyzers share one Diagnostic model and one baseline:
+Five analyzers share one Diagnostic model and one baseline:
 
 - ``dtype_flow``   — dtype propagation through lowered physical plans
                      (DT*: the UNION-truncation bug class, statically)
@@ -8,6 +8,9 @@ Four analyzers share one Diagnostic model and one baseline:
 - ``plan_rules``   — plan anti-patterns: fallback islands, redundant
                      sorts, nondeterminism above exchanges (PL*)
 - ``source_rules`` — host-device sync hazards in traced code (SRC*)
+- ``concurrency_rules`` — lock-discipline over the threaded tiers:
+                     guard breaches, lock-order cycles, CV hygiene
+                     (CON*; runtime sibling: robustness/lock_tracker)
 
 CLI: ``python -m spark_rapids_tpu.tools.lint [--strict]``.
 Docs: ``docs/lint.md``.

@@ -1,0 +1,861 @@
+"""Concurrency linter: lock-discipline AST dataflow over the engine's
+threaded tiers (CON*).
+
+Every concurrency bug this codebase has shipped was found LATE and
+DYNAMICALLY — the admitted-thread drain-lock deadlock took two review
+rounds, the half-open breaker probe wedged under storm load, the shared
+sidecar freed under spill.  The reference plugin leans on RMM/cudf
+enforcing synchronization discipline at the library layer; our
+equivalent is enforced here, by tooling — the lockdep/TSan analog for a
+thread-pooled accelerator runtime.  The dynamic sibling
+(robustness/lock_tracker.py, docs/concurrency.md) watches the same
+invariants at runtime.
+
+Scope: ``serving/``, ``parallel/``, ``memory/``, ``shuffle/``,
+``trace/``, ``connect/`` — the packages whose objects are shared
+across the serving tier's thread populations.
+
+Guard annotations
+-----------------
+A shared field is declared with a trailing comment on its ``__init__``
+(or class-body) assignment::
+
+    self._entries = {}          # guard: _mu
+    self._done = False          # guard: _cv
+
+meaning: every read/write of ``self._entries`` in this class must sit
+lexically inside a ``with self._mu:`` scope.  Conditions constructed
+over an explicit lock (``threading.Condition(self.lock)``) ALIAS that
+lock — holding any member of the alias group satisfies the guard.
+Methods whose names end in ``_locked`` are exempt by the repo's
+caller-holds-the-lock convention (scheduler's ``_pump_locked``).
+Cross-object accesses (``e._done`` from the registry that owns ``e``)
+are checked too, when the field name is guarded by exactly one class in
+the module and the base is a simple name: the access must sit inside
+``with e._cv:``.
+
+Rules
+-----
+- CON001 (error): a ``# guard:``-annotated field read or written
+  outside a ``with``-scope of its declared lock (in-class ``self.F``
+  and cross-object ``name.F`` forms).
+- CON002 (warning): lock-scope escape — ``return self.F`` of a
+  guarded MUTABLE container (dict/list/set/deque literal or ctor in
+  ``__init__``) while holding its lock: the caller keeps mutating the
+  shared object after the lock is released.  Return a copy.
+- CON003 (error): static lock-order cycle.  Nested ``with``-lock
+  scopes build a global acquisition graph (node = declaring class +
+  lock attr, or module global); any cycle is the PR8 deadlock class
+  and fails the lint.  Purely lexical — call-chain edges are the
+  runtime tracker's job.
+- CON004 (error): a Condition ``.wait()`` not inside a ``while``
+  predicate loop — a naked wait misses wakeups (spurious or stolen)
+  and re-checks nothing.
+- CON005 (error): ``notify()``/``notify_all()`` on a Condition whose
+  lock is not lexically held (alias groups honored; ``_locked``
+  helpers exempt).  Python raises at runtime; the lint fails at
+  review time.
+- CON006 (error): same-lock re-acquisition through a call — while
+  holding a NON-reentrant ``self.<lock>``, calling a sibling method
+  that itself acquires ``with self.<lock>``: a guaranteed
+  self-deadlock (the callback-under-lock class, scoped to the
+  intra-class form that is statically decidable; the runtime tracker
+  owns the cross-module form).
+
+Unit-test entry: :func:`lint_concurrency_text`.  Repo entry:
+:func:`check_concurrency` (wired into ``run_lint`` and the tier-1
+repo-clean gate).  Rule catalog with examples: docs/concurrency.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from spark_rapids_tpu.lint.diagnostic import Diagnostic
+
+#: packages under spark_rapids_tpu/ whose objects cross threads
+_CON_DIRS = ("serving", "parallel", "memory", "shuffle", "trace",
+             "connect")
+
+_GUARD_RE = re.compile(r"#\s*guard:\s*([A-Za-z_]\w*)")
+
+#: constructors that declare a lock.  tracked_lock/TrackedLock are the
+#: robustness/lock_tracker wrappers around a plain mutex.
+_LOCK_CTORS = {"Lock": "lock", "DrainLock": "lock",
+               "tracked_lock": "lock", "TrackedLock": "lock",
+               "RLock": "rlock", "Condition": "condition"}
+
+#: __init__ value shapes that make a guarded field a MUTABLE container
+#: (the CON002 escape surface)
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "OrderedDict",
+                  "defaultdict", "Counter"}
+
+
+def _terminal_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _LockDecl:
+    __slots__ = ("kind", "wraps")
+
+    def __init__(self, kind: str, wraps: Optional[str] = None):
+        self.kind = kind    # "lock" | "rlock" | "condition"
+        self.wraps = wraps  # condition's explicit lock attr, if any
+
+
+def _lock_decl(value: ast.expr) -> Optional[_LockDecl]:
+    """The lock declaration a ``self.X = <value>`` makes, or None."""
+    if not isinstance(value, ast.Call):
+        return None
+    kind = _LOCK_CTORS.get(_terminal_name(value.func) or "")
+    if kind is None:
+        return None
+    wraps = None
+    if kind == "condition" and value.args:
+        a = value.args[0]
+        if isinstance(a, ast.Attribute):
+            wraps = a.attr
+        elif isinstance(a, ast.Name):
+            wraps = a.id
+    return _LockDecl(kind, wraps)
+
+
+def _is_mutable_ctor(value: ast.expr) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        return _terminal_name(value.func) in _MUTABLE_CTORS
+    return False
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: dict[str, _LockDecl] = {}
+        self.guards: dict[str, str] = {}       # field -> lock attr
+        self.mutable_fields: set[str] = set()
+        self.methods: dict[str, ast.FunctionDef] = {}
+        #: method name -> canonical self-lock attrs it acquires
+        self.method_acquires: dict[str, set[str]] = {}
+        #: annotation text of each self.F field (type witnesses)
+        self.raw_ann: dict[str, str] = {}
+        #: container field -> module class name of its ELEMENTS,
+        #: resolved from the field's type annotation; lets the checker
+        #: type values pulled out of `self._entries` and apply the
+        #: element class's guard contract to them
+        self.container_elem: dict[str, str] = {}
+
+    def canon(self, attr: str) -> str:
+        """Alias-group representative: a Condition over an explicit
+        lock resolves to that lock; everything else is itself."""
+        decl = self.locks.get(attr)
+        if decl is not None and decl.wraps \
+                and decl.wraps in self.locks:
+            return decl.wraps
+        return attr
+
+    def lock_kind(self, attr: str) -> Optional[str]:
+        decl = self.locks.get(attr)
+        return decl.kind if decl else None
+
+
+class _ModuleInfo:
+    def __init__(self, path: str):
+        self.path = path
+        self.module_locks: dict[str, _LockDecl] = {}
+        self.classes: dict[str, _ClassInfo] = {}
+        #: module-level container NAME -> element class name
+        self.module_container_elem: dict[str, str] = {}
+
+    def lock_attr_owner(self, attr: str) -> Optional[_ClassInfo]:
+        """The unique class declaring lock attr `attr`, else None."""
+        owners = [c for c in self.classes.values()
+                  if attr in c.locks]
+        return owners[0] if len(owners) == 1 else None
+
+    def elem_class_of_field(self, field: str) -> Optional[_ClassInfo]:
+        """Element class of a typed container field, when the field
+        name maps to exactly one element class across the module."""
+        hits = {c.container_elem[field] for c in self.classes.values()
+                if field in c.container_elem}
+        if field in self.module_container_elem:
+            hits.add(self.module_container_elem[field])
+        if len(hits) != 1:
+            return None
+        return self.classes.get(next(iter(hits)))
+
+
+def _ann_elem_class(ann_text: str, class_names) -> Optional[str]:
+    """The unique module class named inside an annotation string
+    (``OrderedDict[str, ScanShareEntry]`` -> ``ScanShareEntry``)."""
+    hits = [n for n in class_names
+            if re.search(rf"\b{re.escape(n)}\b", ann_text)]
+    return hits[0] if len(hits) == 1 else None
+
+
+def _collect_module(tree: ast.Module, src_lines: list[str],
+                    path: str) -> _ModuleInfo:
+    info = _ModuleInfo(path)
+    module_anns: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            decl = _lock_decl(node.value)
+            if decl is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        info.module_locks[t.id] = decl
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            try:
+                module_anns[node.target.id] = ast.unparse(
+                    node.annotation)
+            except Exception:  # pragma: no cover
+                pass
+        elif isinstance(node, ast.ClassDef):
+            info.classes[node.name] = _collect_class(node, src_lines)
+    # second pass: resolve container element types now that every
+    # class name in the module is known
+    names = list(info.classes)
+    for ci in info.classes.values():
+        for field, ann in ci.raw_ann.items():
+            elem = _ann_elem_class(ann, names)
+            if elem is not None:
+                ci.container_elem[field] = elem
+    for name, ann in module_anns.items():
+        elem = _ann_elem_class(ann, names)
+        if elem is not None:
+            info.module_container_elem[name] = elem
+    return info
+
+
+def _guard_on_line(src_lines: list[str], lineno: int) -> Optional[str]:
+    """Guard annotation for the assignment starting at `lineno`: a
+    trailing ``# guard: X`` on the line itself, or a standalone
+    comment line directly above (for assignments whose first line has
+    no room — long annotated declarations)."""
+    if 1 <= lineno <= len(src_lines):
+        m = _GUARD_RE.search(src_lines[lineno - 1])
+        if m:
+            return m.group(1)
+    if lineno >= 2:
+        above = src_lines[lineno - 2].strip()
+        if above.startswith("#"):
+            m = _GUARD_RE.search(above)
+            if m:
+                return m.group(1)
+    return None
+
+
+def _collect_class(node: ast.ClassDef,
+                   src_lines: list[str]) -> _ClassInfo:
+    ci = _ClassInfo(node.name)
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            # class-level lock (TpuSemaphore._lock style)
+            decl = _lock_decl(stmt.value)
+            if decl is not None:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        ci.locks[t.id] = decl
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ci.methods[stmt.name] = stmt
+    init = ci.methods.get("__init__")
+    if init is not None:
+        for sub in ast.walk(init):
+            targets: list[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, ast.AnnAssign) \
+                    and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                decl = _lock_decl(value)
+                if decl is not None:
+                    ci.locks[t.attr] = decl
+                if isinstance(sub, ast.AnnAssign):
+                    try:
+                        ci.raw_ann[t.attr] = ast.unparse(
+                            sub.annotation)
+                    except Exception:  # pragma: no cover
+                        pass
+                guard = _guard_on_line(src_lines, sub.lineno)
+                if guard is not None:
+                    ci.guards[t.attr] = guard
+                    if _is_mutable_ctor(value):
+                        ci.mutable_fields.add(t.attr)
+    # drop guards naming a lock the class never declares — a typo'd
+    # annotation must not silently disable checking; surface it
+    # through CON001 firing on every access instead of hiding, so keep
+    # the guard but canonicalization falls back to the raw name.
+    for name, fn in ci.methods.items():
+        ci.method_acquires[name] = _self_acquires(fn, ci)
+    return ci
+
+
+def _self_acquires(fn: ast.FunctionDef, ci: _ClassInfo) -> set[str]:
+    """Canonical self-lock attrs a method's body acquires lexically
+    (nested defs excluded — they run on their own schedule)."""
+    out: set[str] = set()
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) \
+                        and isinstance(e.value, ast.Name) \
+                        and e.value.id in ("self", "cls") \
+                        and e.attr in ci.locks:
+                    out.add(ci.canon(e.attr))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# Per-function checking
+# ------------------------------------------------------------------ #
+
+
+class _Hold:
+    """One acquired lock in the lexical with-stack."""
+
+    __slots__ = ("base", "attr", "kind", "node_id", "line")
+
+    def __init__(self, base: str, attr: str, kind: str,
+                 node_id: str, line: int):
+        self.base = base        # "self", "cls", a var name, "<module>"
+        self.attr = attr        # canonical lock attr (or global name)
+        self.kind = kind
+        self.node_id = node_id  # global lock-order graph node
+        self.line = line
+
+
+class _FunctionChecker:
+    def __init__(self, fn: ast.FunctionDef, qual: str,
+                 module: _ModuleInfo, cls: Optional[_ClassInfo],
+                 out: list[Diagnostic],
+                 edges: list[tuple[str, str, str, int]]):
+        self.fn = fn
+        self.qual = qual
+        self.module = module
+        self.cls = cls
+        self.out = out
+        self.edges = edges  # (from_node, to_node, path, line)
+        self.holds: list[_Hold] = []
+        self.while_depth = 0
+        self.exempt = qual.rsplit(".", 1)[-1].endswith("_locked") \
+            or qual.rsplit(".", 1)[-1] == "__init__"
+        #: local var name -> module class it is known to hold, from
+        #: type witnesses: parameter annotations, ClassName(...) ctor
+        #: assignments, and bindings pulled out of typed container
+        #: fields (for/comprehension targets, .get()/[...] results)
+        self.local_types: dict[str, _ClassInfo] = {}
+        self._collect_local_types()
+
+    # -- type witnesses ---------------------------------------------- #
+
+    def _expr_witness(self, expr: ast.expr) -> Optional[_ClassInfo]:
+        """The module class a bound value is known to be: a direct
+        ``ClassName(...)`` construction, or an expression that reaches
+        into a typed container field (``self._entries.get(k)``,
+        ``self._entries.values()``, ``self._entries[k]``)."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name in self.module.classes:
+                    return self.module.classes[name]
+            if isinstance(node, ast.Attribute):
+                hit = self.module.elem_class_of_field(node.attr)
+                if hit is not None:
+                    return hit
+            if isinstance(node, ast.Name):
+                hit = self.module.module_container_elem.get(node.id)
+                if hit is not None:
+                    return self.module.classes.get(hit)
+        return None
+
+    def _collect_local_types(self) -> None:
+        args = self.fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if a.annotation is None:
+                continue
+            try:
+                ann = ast.unparse(a.annotation)
+            except Exception:  # pragma: no cover
+                continue
+            elem = _ann_elem_class(ann, list(self.module.classes))
+            if elem is not None:
+                self.local_types[a.arg] = self.module.classes[elem]
+        # two passes: the second resolves bindings that forward-refer
+        # through another local (`for e in entries` where `entries`
+        # was typed deeper in the AST walk order)
+        for _ in range(2):
+            for node in ast.walk(self.fn):
+                target: Optional[ast.expr] = None
+                source: Optional[ast.expr] = None
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    target, source = node.targets[0], node.value
+                elif isinstance(node, ast.For):
+                    target, source = node.target, node.iter
+                elif isinstance(node, ast.comprehension):
+                    target, source = node.target, node.iter
+                if not isinstance(target, ast.Name) or source is None:
+                    continue
+                hit = self._expr_witness(source) \
+                    or self._name_passthrough(source)
+                if hit is not None:
+                    self.local_types[target.id] = hit
+
+    def _name_passthrough(self, expr: ast.expr
+                          ) -> Optional[_ClassInfo]:
+        """Type flow through a bare rebinding or a shape-preserving
+        wrapper (``list(entries)``, ``sorted(entries)``) of an
+        already-typed local — NOT a general expression walk, which
+        would mis-type derived values."""
+        if isinstance(expr, ast.Name):
+            return self.local_types.get(expr.id)
+        if isinstance(expr, ast.Call) and not expr.keywords \
+                and len(expr.args) == 1 \
+                and isinstance(expr.args[0], ast.Name) \
+                and _terminal_name(expr.func) in ("list", "sorted",
+                                                  "tuple", "reversed"):
+            return self.local_types.get(expr.args[0].id)
+        return None
+
+    # -- lock resolution -------------------------------------------- #
+
+    def _resolve_lock(self, e: ast.expr) -> Optional[_Hold]:
+        """A with-item context expr as an acquired lock, or None."""
+        line = getattr(e, "lineno", 0)
+        if isinstance(e, ast.Name):
+            decl = self.module.module_locks.get(e.id)
+            if decl is None:
+                return None
+            return _Hold("<module>", e.id, decl.kind,
+                         f"{self.module.path}::{e.id}", line)
+        if not isinstance(e, ast.Attribute):
+            return None
+        base = e.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                and self.cls is not None \
+                and e.attr in self.cls.locks:
+            canon = self.cls.canon(e.attr)
+            return _Hold("self", canon, self.cls.lock_kind(e.attr),
+                         f"{self.module.path}::"
+                         f"{self.cls.name}.{canon}", line)
+        owner = None
+        if isinstance(base, ast.Name):
+            typed = self.local_types.get(base.id)
+            if typed is not None and e.attr in typed.locks:
+                owner = typed
+        if owner is None:
+            owner = self.module.lock_attr_owner(e.attr)
+        if owner is None or e.attr not in owner.locks:
+            return None
+        canon = owner.canon(e.attr)
+        try:
+            base_key = ast.unparse(base)
+        except Exception:  # pragma: no cover - unparse is total here
+            return None
+        return _Hold(base_key, canon, owner.lock_kind(e.attr),
+                     f"{self.module.path}::{owner.name}.{canon}", line)
+
+    def _held(self, base: str, canon_attr: str) -> bool:
+        return any(h.base == base and h.attr == canon_attr
+                   for h in self.holds)
+
+    # -- emission ---------------------------------------------------- #
+
+    def _emit(self, rule: str, severity: str, node: ast.AST,
+              message: str, hint: str = "") -> None:
+        self.out.append(Diagnostic(
+            rule, severity, f"{self.module.path}::{self.qual}",
+            message, hint=hint, line=getattr(node, "lineno", 0)))
+
+    # -- traversal --------------------------------------------------- #
+
+    def run(self) -> None:
+        for child in ast.iter_child_nodes(self.fn):
+            self._visit(child)
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later, on its own thread/schedule —
+            # fresh checker, empty lock stack
+            _FunctionChecker(node, f"{self.qual}.{node.name}",
+                             self.module, self.cls, self.out,
+                             self.edges).run()
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: list[_Hold] = []
+            for item in node.items:
+                hold = self._resolve_lock(item.context_expr)
+                if hold is None:
+                    continue
+                for h in self.holds:
+                    if h.node_id != hold.node_id:
+                        self.edges.append((h.node_id, hold.node_id,
+                                           self.module.path,
+                                           hold.line))
+                self.holds.append(hold)
+                acquired.append(hold)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            for _ in acquired:
+                self.holds.pop()
+            return
+        if isinstance(node, ast.While):
+            self.while_depth += 1
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            self.while_depth -= 1
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        elif isinstance(node, ast.Attribute):
+            self._check_attribute(node)
+        elif isinstance(node, ast.Return):
+            self._check_return(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    # -- CON001 / CON002 -------------------------------------------- #
+
+    def _guard_satisfied(self, owner: _ClassInfo, base_label: str,
+                         lock_attr: str) -> tuple[bool, str]:
+        """(held?, required-scope label).  The guard names either a
+        lock of the owning class (held via `with <base>.<lock>`) or a
+        MODULE-level lock (the _Breaker-under-_BREAKERS_MU shape, held
+        via `with <LOCK>`), whichever the declaration resolves to."""
+        if lock_attr in owner.locks:
+            guard = owner.canon(lock_attr)
+            return (self._held(base_label, guard),
+                    f"with {base_label}.{guard}")
+        if lock_attr in self.module.module_locks:
+            return (self._held("<module>", lock_attr),
+                    f"with {lock_attr}")
+        # a guard naming nothing declared anywhere is a typo: treat as
+        # never-held so every access fires rather than silently passing
+        return False, f"with {base_label}.{lock_attr} (undeclared!)"
+
+    def _check_attribute(self, node: ast.Attribute) -> None:
+        if self.exempt:
+            return
+        base = node.value
+        if not isinstance(base, ast.Name):
+            return
+        field = node.attr
+        if base.id in ("self", "cls"):
+            if self.cls is None or field not in self.cls.guards:
+                return
+            held, scope = self._guard_satisfied(
+                self.cls, "self", self.cls.guards[field])
+            if not held:
+                self._emit(
+                    "CON001", "error", node,
+                    f"guarded field `self.{field}` (guard: "
+                    f"{self.cls.guards[field]}) accessed outside "
+                    f"`{scope}`",
+                    hint="take the declared lock around the access, "
+                         "move the access into a *_locked helper "
+                         "called under the lock, or drop the guard "
+                         "annotation if the field is genuinely "
+                         "unshared (docs/concurrency.md)")
+            return
+        owner = self.local_types.get(base.id)
+        if owner is None or field not in owner.guards:
+            return
+        lock_attr = owner.guards[field]
+        held, scope = self._guard_satisfied(owner, base.id, lock_attr)
+        if not held:
+            self._emit(
+                "CON001", "error", node,
+                f"guarded field `{base.id}.{field}` "
+                f"({owner.name} guards it with {lock_attr}) accessed "
+                f"outside `{scope}`",
+                hint=f"read/write it inside `{scope}:` — the owning "
+                     "class mutates it under that lock, so an "
+                     "unlocked peek is a data race "
+                     "(docs/concurrency.md)")
+
+    def _check_return(self, node: ast.Return) -> None:
+        if self.exempt or self.cls is None or node.value is None:
+            return
+        v = node.value
+        if not (isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "self"):
+            return
+        field = v.attr
+        if field not in self.cls.guards \
+                or field not in self.cls.mutable_fields:
+            return
+        held, scope = self._guard_satisfied(
+            self.cls, "self", self.cls.guards[field])
+        if held:
+            self._emit(
+                "CON002", "warning", node,
+                f"`return self.{field}` escapes a guarded mutable "
+                f"container out of its `{scope}` scope",
+                hint="return a copy (list(...)/dict(...)) — the "
+                     "caller holds a live alias the lock no longer "
+                     "protects (docs/concurrency.md)")
+
+    # -- CON004 / CON005 / CON006 ------------------------------------ #
+
+    def _condition_recv(self, func: ast.Attribute
+                        ) -> Optional[tuple[str, str, _ClassInfo]]:
+        """(base_key, cond attr, owner class) when the receiver of a
+        wait/notify resolves to a declared Condition."""
+        recv = func.value
+        if not isinstance(recv, ast.Attribute):
+            return None
+        base = recv.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                and self.cls is not None:
+            if self.cls.lock_kind(recv.attr) == "condition":
+                return "self", recv.attr, self.cls
+            return None
+        if not isinstance(base, ast.Name):
+            return None
+        owner = self.local_types.get(base.id) \
+            or self.module.lock_attr_owner(recv.attr)
+        if owner is not None \
+                and owner.lock_kind(recv.attr) == "condition":
+            return base.id, recv.attr, owner
+        return None
+
+    def _check_call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "wait":
+                hit = self._condition_recv(func)
+                if hit is not None and self.while_depth == 0:
+                    self._emit(
+                        "CON004", "error", node,
+                        f"naked Condition `.wait()` on "
+                        f"`{hit[0]}.{hit[1]}` — not inside a "
+                        "`while <predicate>` loop",
+                        hint="wrap the wait in a while loop "
+                             "re-checking the predicate: wakeups are "
+                             "spurious and stealable "
+                             "(docs/concurrency.md)")
+            elif func.attr in ("notify", "notify_all"):
+                hit = self._condition_recv(func)
+                if hit is not None and not self.exempt:
+                    base, attr, owner = hit
+                    guard = owner.canon(attr)
+                    if not self._held(base, guard):
+                        self._emit(
+                            "CON005", "error", node,
+                            f"`.{func.attr}()` on `{base}.{attr}` "
+                            "without its lock held",
+                            hint=f"notify inside `with {base}."
+                                 f"{guard}:` (or any alias of it) — "
+                                 "an unlocked notify raises "
+                                 "RuntimeError at runtime "
+                                 "(docs/concurrency.md)")
+            # CON006: self-deadlock through a sibling call
+            if self.cls is not None \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id == "self" \
+                    and func.attr in self.cls.method_acquires:
+                reacquired = {
+                    h.attr for h in self.holds
+                    if h.base == "self" and h.kind in ("lock",
+                                                       "condition")
+                } & self.cls.method_acquires[func.attr]
+                if reacquired:
+                    lock = sorted(reacquired)[0]
+                    self._emit(
+                        "CON006", "error", node,
+                        f"`self.{func.attr}()` called while holding "
+                        f"non-reentrant `self.{lock}`, and that "
+                        "method acquires the same lock — guaranteed "
+                        "self-deadlock",
+                        hint="hoist the call out of the critical "
+                             "section, or split the callee into a "
+                             "*_locked body the caller invokes under "
+                             "the lock (docs/concurrency.md)")
+
+
+# ------------------------------------------------------------------ #
+# Lock-order cycle detection (CON003)
+# ------------------------------------------------------------------ #
+
+
+def _find_cycles(edges: Iterable[tuple[str, str, str, int]]
+                 ) -> list[Diagnostic]:
+    """Tarjan SCCs over the acquisition graph; every non-trivial SCC
+    (>= 2 nodes, or a self-loop) is one CON003 error.  Deterministic
+    output: nodes and members sorted, so the baseline key is stable."""
+    graph: dict[str, set[str]] = {}
+    sites: dict[tuple[str, str], tuple[str, int]] = {}
+    for a, b, path, line in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        sites.setdefault((a, b), (path, line))
+
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+
+    out: list[Diagnostic] = []
+    for scc in sccs:
+        members = sorted(scc)
+        cyclic = len(members) > 1 or (
+            members and members[0] in graph[members[0]])
+        if not cyclic:
+            continue
+        cycle_edges = [(a, b) for a in members
+                       for b in sorted(graph[a]) if b in set(members)]
+        where = "; ".join(
+            f"{a.split('::')[-1]}->{b.split('::')[-1]} at "
+            f"{sites[(a, b)][0]}:{sites[(a, b)][1]}"
+            for a, b in cycle_edges if (a, b) in sites)
+        first = sites.get(cycle_edges[0]) if cycle_edges else None
+        out.append(Diagnostic(
+            "CON003", "error", "concurrency::lock-order",
+            "static lock-order cycle: "
+            + " <-> ".join(m.split("::")[-1] for m in members),
+            hint="pick ONE global acquisition order and release the "
+                 f"outer lock before taking the inner ({where}); "
+                 "the runtime tracker raises LockCycleError on the "
+                 "dynamic form (docs/concurrency.md)",
+            line=first[1] if first else 0))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# Entry points
+# ------------------------------------------------------------------ #
+
+
+def _analyze_module(src: str, path: str
+                    ) -> tuple[list[Diagnostic],
+                               list[tuple[str, str, str, int]]]:
+    out: list[Diagnostic] = []
+    edges: list[tuple[str, str, str, int]] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        out.append(Diagnostic(
+            "CON000", "error", path, f"syntax error: {exc}",
+            line=exc.lineno or 0))
+        return out, edges
+    info = _collect_module(tree, src.splitlines(), path)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FunctionChecker(node, node.name, info, None, out,
+                             edges).run()
+        elif isinstance(node, ast.ClassDef):
+            ci = info.classes[node.name]
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    _FunctionChecker(stmt,
+                                     f"{node.name}.{stmt.name}",
+                                     info, ci, out, edges).run()
+    return out, edges
+
+
+def lint_concurrency_text(src: str, path: str) -> list[Diagnostic]:
+    """Lint one module's source text (unit-test entry point) —
+    per-module rules plus lock-order cycles over this module's own
+    acquisition edges."""
+    out, edges = _analyze_module(src, path)
+    out.extend(_find_cycles(edges))
+    return out
+
+
+def _is_concurrency_module(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(d in parts for d in _CON_DIRS)
+
+
+def check_concurrency(root: Optional[str] = None) -> list[Diagnostic]:
+    """Run the concurrency rules over the engine's threaded tiers and
+    the GLOBAL lock-order graph (cycles across modules are cycles)."""
+    from spark_rapids_tpu.lint.source_rules import (
+        _package_root,
+        iter_source_files,
+    )
+
+    root = root or _package_root()
+    base = os.path.dirname(root)
+    out: list[Diagnostic] = []
+    edges: list[tuple[str, str, str, int]] = []
+    for path in iter_source_files(root):
+        rel = os.path.relpath(path, base)
+        if not _is_concurrency_module(rel):
+            continue
+        with open(path) as f:
+            src = f.read()
+        diags, mod_edges = _analyze_module(src, rel)
+        out.extend(diags)
+        edges.extend(mod_edges)
+    out.extend(_find_cycles(edges))
+    return out

@@ -1,7 +1,9 @@
-"""tpulint runner: compose the four analyzers into one pass.
+"""tpulint runner: compose the five analyzers into one pass.
 
 A repo run covers:
 - the engine-source linter over spark_rapids_tpu/ (source_rules);
+- the concurrency/lock-discipline linter over the threaded tiers
+  (concurrency_rules, CON*);
 - the registry consistency checker (registry);
 - dtype-flow + plan lint over a built-in corpus of representative
   plans lowered by the LIVE planner — every lint run statically
@@ -82,6 +84,7 @@ def _corpus_plans(errors: Optional[list] = None):
 
 def run_lint(source: bool = True, registry: bool = True,
              plans: bool = True, metrics: bool = True,
+             concurrency: bool = True,
              extra_roots: Sequence = ()) -> list[Diagnostic]:
     """Run the selected analyzers; returns ALL findings (unbaselined)."""
     out: list[Diagnostic] = []
@@ -89,6 +92,14 @@ def run_lint(source: bool = True, registry: bool = True,
         from spark_rapids_tpu.lint.source_rules import check_sources
 
         out.extend(check_sources())
+    if concurrency:
+        # CON*: guard discipline, lock-order cycles, CV hygiene over
+        # the serving tier's shared classes (docs/concurrency.md)
+        from spark_rapids_tpu.lint.concurrency_rules import (
+            check_concurrency,
+        )
+
+        out.extend(check_concurrency())
     if registry:
         from spark_rapids_tpu.lint.registry import check_registries
 

@@ -35,10 +35,12 @@ class TpuSemaphore:
     _owner: Optional["weakref.ref"] = None
 
     def __init__(self, permits: int):
+        # `permits` itself is deliberately unguarded: scheduler._limit
+        # reads it as a config-tier value on the admission hot path
         self.permits = permits
-        self._available = permits
+        self._available = permits   # guard: _cv
         self._cv = threading.Condition()
-        self._holders: set = set()
+        self._holders: set = set()  # guard: _cv
 
     @classmethod
     def get(cls) -> "TpuSemaphore":

@@ -442,17 +442,17 @@ class BufferStore:
         self._host_compress = conf.get_bool(SPILL_HOST_COMPRESS.key) \
             and self._spill_codec != "none"
         self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
-        self._entries: dict[int, _Entry] = {}
-        self._next_id = 0
+        self._entries: dict[int, _Entry] = {}  # guard: _lock
+        self._next_id = 0           # guard: _lock
         self._lock = threading.RLock()
-        self.device_used = 0
-        self.host_used = 0
+        self.device_used = 0        # guard: _lock
+        self.host_used = 0          # guard: _lock
         #: observability (ref: spill metrics + memoryBytesSpilled)
-        self.spilled_device_to_host = 0
-        self.spilled_host_to_disk = 0
+        self.spilled_device_to_host = 0  # guard: _lock
+        self.spilled_host_to_disk = 0    # guard: _lock
         #: gauge: host-bytes equivalent currently parked on disk (the
         #: telemetry sampler's third storage tier)
-        self.disk_used = 0
+        self.disk_used = 0          # guard: _lock
 
     def spill_stats(self) -> dict[str, int]:
         """Point-in-time spill/occupancy accounting — the store's
@@ -502,7 +502,7 @@ class BufferStore:
                 None, schema)
             self.host_used += nbytes
             while self.host_used > self.host_budget:
-                if not self._spill_one_host():
+                if not self._spill_one_host_locked():
                     break
             return SpillableBatch(self, bid)
 
@@ -611,12 +611,12 @@ class BufferStore:
 
                 if not is_retryable(e):
                     raise
-                while self._spill_one_device():
+                while self._spill_one_device_locked():
                     pass
                 device_alloc_checkpoint(nbytes)  # 2nd failure escalates
                 _faults.note_recovered(e, action="alloc_spill_retry")
             while self.device_used + nbytes > self.device_budget:
-                if not self._spill_one_device():
+                if not self._spill_one_device_locked():
                     break  # nothing spillable left; let XLA try anyway
 
     def leak_report(self) -> list[str]:
@@ -644,20 +644,20 @@ class BufferStore:
         number of buffers spilled."""
         n = 0
         with self._lock:
-            while self._spill_one_device():
+            while self._spill_one_device_locked():
                 n += 1
         return n
 
-    def _spill_one_device(self) -> bool:
+    def _spill_one_device_locked(self) -> bool:
         candidates = [e for e in self._entries.values()
                       if e.tier == StorageTier.DEVICE and not e.pinned]
         if not candidates:
             return False
         victim = min(candidates, key=lambda e: (e.priority, e.buffer_id))
-        self._spill_to_host(victim)
+        self._spill_to_host_locked(victim)
         return True
 
-    def _spill_to_host(self, e: _Entry) -> None:
+    def _spill_to_host_locked(self, e: _Entry) -> None:
         with _trace.span("spill.device_to_host", tier="DEVICE",
                          bytes=e.nbytes, buffer=e.buffer_id):
             arrays = _batch_to_host(e.batch)  # type: ignore[arg-type]
@@ -677,10 +677,10 @@ class BufferStore:
         self.host_used += hb
         self.spilled_device_to_host += e.nbytes
         while self.host_used > self.host_budget:
-            if not self._spill_one_host():
+            if not self._spill_one_host_locked():
                 break
 
-    def _spill_one_host(self) -> bool:
+    def _spill_one_host_locked(self) -> bool:
         candidates = [e for e in self._entries.values()
                       if e.tier == StorageTier.HOST and not e.pinned]
         if not candidates:

@@ -52,6 +52,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 from spark_rapids_tpu import trace as _tr
 from spark_rapids_tpu.config import get_conf, register, set_conf
+from spark_rapids_tpu.robustness.lock_tracker import tracked_lock
 
 PIPELINE_ENABLED = register(
     "spark.rapids.tpu.sql.pipeline.enabled", True,
@@ -102,14 +103,14 @@ class StageMetrics:
 
     def __init__(self, name: str):
         self.name = name
-        self.depth = 0
-        self.items = 0
-        self.occupancy_sum = 0
-        self.samples = 0
-        self.producer_wait_ns = 0
-        self.consumer_wait_ns = 0
-        self.readbacks = 0
-        self.async_readbacks = 0
+        self.depth = 0              # guard: _lock
+        self.items = 0              # guard: _lock
+        self.occupancy_sum = 0      # guard: _lock
+        self.samples = 0            # guard: _lock
+        self.producer_wait_ns = 0   # guard: _lock
+        self.consumer_wait_ns = 0   # guard: _lock
+        self.readbacks = 0          # guard: _lock
+        self.async_readbacks = 0    # guard: _lock
         self._lock = threading.Lock()
 
     def snapshot(self) -> dict:
@@ -130,7 +131,7 @@ class StageMetrics:
 
 
 _STAGES: dict[str, StageMetrics] = {}
-_STAGES_LOCK = threading.Lock()
+_STAGES_LOCK = tracked_lock("pipeline.stages")
 
 
 def _stage_metrics(name: str) -> StageMetrics:
@@ -428,12 +429,17 @@ class _Chan:
 
     def __init__(self, depth: int):
         self.depth = depth
-        self.buf: deque = deque()
+        self.buf: deque = deque()   # guard: lock
         self.lock = threading.Lock()
+        # both conditions share the ONE channel lock (an alias group:
+        # holding either holds `lock`; they differ only in who waits)
         self.not_full = threading.Condition(self.lock)
         self.not_empty = threading.Condition(self.lock)
-        self.done = False
-        self.aborted = False
+        self.done = False           # guard: lock
+        self.aborted = False        # guard: lock
+        # `error` is deliberately NOT guarded: written under the lock
+        # in finish(), read by the consumer only after pop() returned
+        # (None, False) — the lock release/acquire pair orders the two
         self.error: Optional[BaseException] = None
 
     # producer side ---------------------------------------------------- #

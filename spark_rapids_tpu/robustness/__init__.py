@@ -1,6 +1,8 @@
-"""Robustness subsystem: deterministic fault injection (faults.py) and
-the batch-granular OOM split-and-retry ladder (execs/retry.py builds on
-it).  See docs/robustness.md."""
+"""Robustness subsystem: deterministic fault injection (faults.py),
+the batch-granular OOM split-and-retry ladder (execs/retry.py builds
+on it), and the runtime lock-order/deadlock tracker (lock_tracker.py
+— the dynamic sibling of the CON* lint family; docs/concurrency.md).
+See docs/robustness.md."""
 
 from spark_rapids_tpu.robustness.faults import (  # noqa: F401
     InjectedFault,

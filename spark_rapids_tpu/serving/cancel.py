@@ -70,6 +70,7 @@ from typing import Iterator, Optional
 
 from spark_rapids_tpu import trace as _tr
 from spark_rapids_tpu.config import register
+from spark_rapids_tpu.robustness.lock_tracker import tracked_lock
 from spark_rapids_tpu.serving.scheduler import AdmissionRejected
 
 CANCEL_ENABLED = register(
@@ -219,7 +220,7 @@ class TokenSet:
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._toks: set = set()
+        self._toks: set = set()  # guard: _mu
 
     def add(self, tok: Optional[CancelToken]) -> None:
         if tok is None:
@@ -261,7 +262,7 @@ _TL = threading.local()
 
 #: process-wide live-token gauge (telemetry's cancel.active)
 _ACTIVE = 0
-_ACTIVE_MU = threading.Lock()
+_ACTIVE_MU = tracked_lock("cancel.active")
 
 
 def current_token() -> Optional[CancelToken]:
@@ -384,14 +385,18 @@ class _Breaker:
     __slots__ = ("failures", "state", "open_until_ns", "probing")
 
     def __init__(self):
-        self.failures = 0
-        self.state = "closed"
-        self.open_until_ns = 0
-        self.probing = False
+        # every _Breaker lives in _BREAKERS and is mutated only under
+        # the module-level registry lock (a per-instance lock would
+        # add nothing: admit/result always resolve tenant -> breaker
+        # under _BREAKERS_MU anyway)
+        self.failures = 0           # guard: _BREAKERS_MU
+        self.state = "closed"       # guard: _BREAKERS_MU
+        self.open_until_ns = 0      # guard: _BREAKERS_MU
+        self.probing = False        # guard: _BREAKERS_MU
 
 
 _BREAKERS: dict[str, _Breaker] = {}
-_BREAKERS_MU = threading.Lock()
+_BREAKERS_MU = tracked_lock("cancel.breakers")
 
 
 def breaker_admit(conf, tenant: str) -> None:

@@ -47,6 +47,7 @@ import hashlib
 import threading
 from typing import Any, Optional
 
+from spark_rapids_tpu.robustness.lock_tracker import tracked_lock
 from spark_rapids_tpu.serving import PLAN_CACHE_CAPACITY
 
 # ------------------------------------------------------------------ #
@@ -291,9 +292,10 @@ class PlanCache:
 
             capacity = int(get_conf().get(PLAN_CACHE_CAPACITY))
         self.capacity = max(1, int(capacity))
+        # guard: _mu
         self._entries: "collections.OrderedDict[str, CacheEntry]" = \
             collections.OrderedDict()
-        self._mu = threading.Lock()
+        self._mu = tracked_lock("planCache.mu")
 
     def lookup(self, key: str) -> Optional[CacheEntry]:
         """Get-and-touch; ticks the global hit/miss counters."""

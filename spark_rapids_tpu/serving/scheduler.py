@@ -51,6 +51,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from spark_rapids_tpu import trace as _tr
+from spark_rapids_tpu.robustness.lock_tracker import tracked_lock
 from spark_rapids_tpu.serving import (
     BATCHING_ENABLED,
     DEFAULT_PRIORITY,
@@ -104,25 +105,25 @@ class QueryScheduler:
         self.default_priority = int(default_priority)
         self.batching = bool(batching)
         self._cv = threading.Condition()
-        self._running = 0
-        self._waiting: list[_Entry] = []
-        self._tenants: dict[str, _Tenant] = {}
+        self._running = 0                        # guard: _cv
+        self._waiting: list[_Entry] = []         # guard: _cv
+        self._tenants: dict[str, _Tenant] = {}   # guard: _cv
         #: group -> count of RUNNING queries carrying it (the
         #: batching preference's membership test)
-        self._running_groups: dict[str, int] = {}
-        self._vclock = 0.0
-        self._seq = 0
+        self._running_groups: dict[str, int] = {}  # guard: _cv
+        self._vclock = 0.0                       # guard: _cv
+        self._seq = 0                            # guard: _cv
         # stats (under _cv): totals + a bounded ring of recent waits so
         # p50/p99 stay O(1) memory on a long-lived server
-        self._admitted = 0
-        self._rejected = 0
-        self._coalesced = 0
+        self._admitted = 0                       # guard: _cv
+        self._rejected = 0                       # guard: _cv
+        self._coalesced = 0                      # guard: _cv
         #: queued entries unwound by cancellation/deadline before grant
         #: (or after an unconsumed grant) — the admission queue's share
         #: of the cancellation story (docs/robustness.md)
-        self._shed = 0
-        self._total_wait_ms = 0.0
-        self._waits_ms: deque = deque(maxlen=4096)
+        self._shed = 0                           # guard: _cv
+        self._total_wait_ms = 0.0                # guard: _cv
+        self._waits_ms: deque = deque(maxlen=4096)  # guard: _cv
 
     # -- limit ------------------------------------------------------- #
 
@@ -316,7 +317,7 @@ class QueryScheduler:
 # ------------------------------------------------------------------ #
 
 _SCHED: Optional[QueryScheduler] = None
-_LOCK = threading.Lock()
+_LOCK = tracked_lock("scheduler.registry")
 
 
 def get_scheduler(conf=None) -> QueryScheduler:

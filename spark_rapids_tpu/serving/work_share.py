@@ -57,6 +57,7 @@ import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu.config import register
+from spark_rapids_tpu.robustness.lock_tracker import tracked_lock
 
 SHARING_ENABLED = register(
     "spark.rapids.tpu.serving.sharing.enabled", False,
@@ -191,9 +192,10 @@ class ResultCache:
     host/disk footprint immediately."""
 
     def __init__(self):
+        # guard: _mu
         self._entries: "collections.OrderedDict[str, _ResultEntry]" = \
             collections.OrderedDict()
-        self._mu = threading.Lock()
+        self._mu = tracked_lock("resultCache.mu")
 
     def bytes_used(self) -> int:
         with self._mu:
@@ -401,13 +403,13 @@ class ScanShareEntry:
     def __init__(self, key: str, cap: int = 0):
         self.key = key
         self._cv = threading.Condition()
-        self._units: list = []      # host units, publish order
-        self._device: dict = {}     # idx -> shared plain batch
-        self._done = False
-        self._aborted = False
+        self._units: list = []      # guard: _cv (publish order)
+        self._device: dict = {}     # guard: _cv (idx -> shared batch)
+        self._done = False          # guard: _cv
+        self._aborted = False       # guard: _cv
         self.leader_thread = threading.get_ident()
-        self._consumers = 1  # the leader
-        self.nbytes = 0
+        self._consumers = 1         # guard: _cv (starts at the leader)
+        self.nbytes = 0             # guard: _cv
         #: in-flight footprint cap (scanCache.budgetBytes, 0 = none):
         #: an entry buffers its host units for the scan's LIFETIME, so
         #: without a cap one huge scan would materialize its whole
@@ -518,9 +520,10 @@ class ScanShareRegistry:
     device memos drop with the last overlapping consumer)."""
 
     def __init__(self):
+        # guard: _mu
         self._entries: "collections.OrderedDict[str, ScanShareEntry]" \
             = collections.OrderedDict()
-        self._mu = threading.Lock()
+        self._mu = tracked_lock("scanShare.mu")
 
     def begin(self, key: str) -> tuple[Optional[ScanShareEntry], bool]:
         """(entry, is_leader).  (None, False) means "do not share":
@@ -576,17 +579,27 @@ class ScanShareRegistry:
 
         budget = int(get_conf().get(SCAN_CACHE_BUDGET))
         with self._mu:
-            used = sum(e.nbytes for e in self._entries.values())
+            # snapshot size + liveness per entry under ITS lock (a
+            # leader thread grows nbytes under _cv concurrently; the
+            # old unlocked sum could tear against publish and evict
+            # on a stale total), then evict from the locked snapshot.
+            # _mu -> _cv nesting matches begin()'s acquisition order.
+            sizes: dict[str, int] = {}
+            busy: dict[str, bool] = {}
+            used = 0
+            for key in list(self._entries):
+                e = self._entries[key]
+                with e._cv:
+                    sizes[key] = e.nbytes
+                    busy[key] = e._consumers > 0 or not e._done
+                used += sizes[key]
             for key in list(self._entries):
                 if used <= budget:
                     break
-                e = self._entries[key]
-                with e._cv:
-                    busy = e._consumers > 0 or not e._done
-                if busy:
+                if busy[key]:
                     continue  # in-flight entries are never evicted
-                del self._entries[key]
-                used -= e.nbytes
+                e = self._entries.pop(key)
+                used -= sizes[key]
                 e._drop_device()
 
     def __len__(self) -> int:
@@ -594,9 +607,17 @@ class ScanShareRegistry:
             return len(self._entries)
 
     def inflight(self) -> int:
+        # _done is _cv-guarded state a leader flips concurrently;
+        # snapshot the registry under _mu, then read each entry's
+        # flag under its own lock instead of racing complete()/abort()
         with self._mu:
-            return sum(1 for e in self._entries.values()
-                       if not e._done)
+            entries = list(self._entries.values())
+        n = 0
+        for e in entries:
+            with e._cv:
+                if not e._done:
+                    n += 1
+        return n
 
     def reset(self) -> None:
         with self._mu:

@@ -469,11 +469,13 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
     from spark_rapids_tpu.eventlog import conf_fingerprint
     from spark_rapids_tpu.memory.semaphore import TpuSemaphore
     from spark_rapids_tpu.robustness import faults as _faults
+    from spark_rapids_tpu.robustness import lock_tracker as _locks
     from spark_rapids_tpu.trace import ledger as _ledger
     from spark_rapids_tpu.trace import telemetry as _telemetry
 
     _trace.sync_conf(conf)
     _faults.sync_conf(conf)
+    _locks.sync_conf(conf)
     TpuSemaphore.sync_conf(conf)
     _ledger.sync_conf(conf)
     _telemetry.sync_conf(conf, writer=session._eventlog)

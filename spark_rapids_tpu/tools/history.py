@@ -682,6 +682,37 @@ def _hc_cancellation_leak(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_lock_hold(q: QueryRecord) -> Optional[str]:
+    """HC014: tracked-lock hold over budget.  Only queries run with
+    the lock tracker armed (robustness.lockTracker.enabled) carry a
+    nonzero lock.max_hold_ms gauge; a reading over
+    spark.rapids.tpu.robustness.lockTracker.holdBudgetMs means some
+    engine registry mutex (plan cache, scan-share registry, breaker
+    table, ...) was held long enough to serialize every thread
+    population behind it during this query (docs/concurrency.md)."""
+    hold_ms = q.counter("lock.max_hold_ms")
+    if hold_ms <= 0:
+        return None
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.robustness.lock_tracker import (
+        LOCK_HOLD_BUDGET_MS,
+    )
+
+    budget = float(get_conf().get(LOCK_HOLD_BUDGET_MS))
+    if hold_ms > budget:
+        extra = ""
+        cycles = q.counter("lock.cycles")
+        if cycles > 0:
+            extra = (f"; {int(cycles)} lock-order cycle(s) were also "
+                     "detected in this window")
+        return (f"a tracked engine lock was held for {hold_ms:.1f}ms "
+                f"(> {budget:g}ms budget, "
+                "robustness.lockTracker.holdBudgetMs) — long registry "
+                "holds serialize the fleet behind one mutex"
+                f"{extra} (docs/concurrency.md)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -695,7 +726,8 @@ for _id, _sev, _fn in (
         ("HC010", "warning", _hc_dispatch_overhead),
         ("HC011", "warning", _hc_roofline_budget),
         ("HC012", "warning", _hc_result_cache_thrash),
-        ("HC013", "warning", _hc_cancellation_leak)):
+        ("HC013", "warning", _hc_cancellation_leak),
+        ("HC014", "warning", _hc_lock_hold)):
     register_health_rule(_id, _sev, _fn)
 
 

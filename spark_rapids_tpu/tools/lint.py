@@ -11,8 +11,14 @@ Usage::
     --update-baseline   accept all current findings and rewrite the
                         baseline file
     --json              machine-readable output
-    --no-source / --no-registry / --no-plans / --no-metrics
-                        skip individual analyzers
+    --no-source / --no-registry / --no-plans / --no-metrics /
+    --no-concurrency    skip individual analyzers
+    --baseline-diff     audit the baseline file against HEAD: print
+                        added (firing, not baselined) and stale
+                        (baselined, no longer firing) entries; stale
+                        entries are an ERROR — a suppression whose
+                        site is gone must be deleted, or it will
+                        silently mask the next regression at that key
 
 Exit status: 0 when every finding at/above the failing severity is in
 the baseline; 1 otherwise.  Rule ids and examples: docs/lint.md.
@@ -23,6 +29,35 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _baseline_diff(diags, baseline_path, as_json: bool) -> int:
+    """Audit the suppression file against what HEAD actually fires:
+    `added` = findings not yet baselined (informational — the normal
+    strict gate owns failing on those); `stale` = baseline keys whose
+    site no longer fires, which is an ERROR: a dead suppression sits
+    ready to mask the next real regression that lands on its key."""
+    from spark_rapids_tpu.lint import load_baseline
+
+    current = {d.key for d in diags}
+    accepted = load_baseline(baseline_path)
+    added = sorted(current - accepted)
+    stale = sorted(accepted - current)
+    if as_json:
+        print(json.dumps({"added": added, "stale": stale,
+                          "exit": 1 if stale else 0}, indent=1))
+        return 1 if stale else 0
+    for key in added:
+        print(f"added (firing, not baselined): {key}")
+    for key in stale:
+        print(f"STALE (baselined, no longer firing): {key}")
+    print(f"baseline-diff: {len(added)} added, {len(stale)} stale")
+    if stale:
+        print("tpulint: FAIL (stale baseline entries; delete them "
+              "from baseline.json or run --update-baseline)")
+        return 1
+    print("tpulint: OK")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -42,6 +77,10 @@ def main(argv=None) -> int:
     ap.add_argument("--no-registry", action="store_true")
     ap.add_argument("--no-plans", action="store_true")
     ap.add_argument("--no-metrics", action="store_true")
+    ap.add_argument("--no-concurrency", action="store_true")
+    ap.add_argument("--baseline-diff", action="store_true",
+                    help="audit baseline vs HEAD findings; stale "
+                         "entries fail")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.lint import (
@@ -53,7 +92,11 @@ def main(argv=None) -> int:
     diags = run_lint(source=not args.no_source,
                      registry=not args.no_registry,
                      plans=not args.no_plans,
-                     metrics=not args.no_metrics)
+                     metrics=not args.no_metrics,
+                     concurrency=not args.no_concurrency)
+
+    if args.baseline_diff:
+        return _baseline_diff(diags, args.baseline, args.json)
 
     if args.update_baseline:
         path = save_baseline(diags, args.baseline)

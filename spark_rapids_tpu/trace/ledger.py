@@ -154,12 +154,12 @@ class ProgramEntry:
         #: capacity) and the multichip bench can report how many
         #: exchange rounds each stage folded into one dispatch
         self.meta = dict(meta) if meta else None
-        self.dispatches = 0
-        self.dispatch_ns = 0  # host-side dispatch wall (call duration)
-        self.device_ns = 0  # exclusive busy intervals, reaper-settled
-        self.flops = 0.0  # per execution, from XLA cost analysis
-        self.bytes_accessed = 0.0  # per execution
-        self.cost_state = self.COST_NONE
+        self.dispatches = 0     # guard: lock
+        self.dispatch_ns = 0    # guard: lock (host-side dispatch wall)
+        self.device_ns = 0      # guard: lock (exclusive busy, settled)
+        self.flops = 0.0        # guard: lock (XLA cost analysis)
+        self.bytes_accessed = 0.0  # guard: lock (per execution)
+        self.cost_state = self.COST_NONE  # guard: lock
         self.lock = threading.Lock()
 
 
@@ -210,7 +210,7 @@ class _SettleWorker:
 
     def __init__(self) -> None:
         self._q: "queue.Queue" = queue.Queue()
-        self._unfinished = 0
+        self._unfinished = 0    # guard: _cv
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         #: completion stamp of the previously settled dispatch: each
@@ -317,7 +317,7 @@ class DeviceLedger:
         self.enabled = False
         self.forced = False
         self.gen = 0  # bumped by reset(); stale wrapper cells re-key
-        self._entries: dict[Any, ProgramEntry] = {}
+        self._entries: dict[Any, ProgramEntry] = {}  # guard: _lock
         self._lock = threading.Lock()
         self._enabled_by: Optional[weakref.ref] = None
         self._settle = _SettleWorker()

@@ -69,6 +69,38 @@ def leak_check():
         f"process residency leaked: before={before} after={after}")
 
 
+#: tier-1 modules that run with the runtime lock-order tracker ARMED:
+#: the concurrency-heavy suites double as a continuous deadlock hunt —
+#: any lock-order cycle the tests' interleavings ever exhibit raises
+#: LockCycleError right there instead of hanging a future soak
+#: (docs/concurrency.md)
+_LOCK_TRACKED_MODULES = frozenset((
+    "test_serving",
+    "test_cancellation",
+    "test_work_share",
+    "test_chaos",
+))
+
+
+@pytest.fixture(autouse=True)
+def _arm_lock_tracker(request):
+    """Force-arm the lock tracker for the modules above (forced
+    installs survive sync_conf, so in-test sessions carrying the
+    default conf cannot disarm it mid-test); verify no cycle formed."""
+    if request.module.__name__ not in _LOCK_TRACKED_MODULES:
+        yield
+        return
+    from spark_rapids_tpu.robustness import lock_tracker
+
+    lock_tracker.install(forced=True)
+    yield
+    cycles = lock_tracker.cycle_count()
+    graph = lock_tracker.order_graph()
+    lock_tracker.disarm()
+    assert cycles == 0, (
+        f"lock-order cycle detected during test: graph={graph}")
+
+
 @pytest.fixture(autouse=True)
 def _isolate_conf():
     """Snapshot/restore the thread-local conf so a test's conf.set()

@@ -129,7 +129,7 @@ def test_disk_acquire_survives_reserve_failure(monkeypatch):
     h = store.register(b)
     h.unpin()
     e = store._entries[h.buffer_id]
-    store._spill_to_host(e)  # host_budget=0 cascades straight to disk
+    store._spill_to_host_locked(e)  # host_budget=0 cascades straight to disk
     assert e.tier == StorageTier.DISK
 
     # first acquire attempt dies mid-upload; the file must survive

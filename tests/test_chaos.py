@@ -218,6 +218,83 @@ def test_chaos_never_degrades_to_cpu():
 
 
 # ------------------------------------------------------------------ #
+# chaos under the runtime lock tracker (docs/concurrency.md)
+# ------------------------------------------------------------------ #
+
+
+def test_chaos_under_lock_tracker_zero_cycles_exact_bookkeeping():
+    """Fault-driven recovery AND fault-driven cancellation unwinds,
+    executed with the runtime lock-order tracker armed (the conftest
+    arms it for this module): the perturbed interleavings must form
+    ZERO lock-order cycles, and lock_stats() must balance exactly —
+    every aggregate is precisely the per-name sum, every name is a
+    known engine lock, and the locks the exercised paths own
+    (pipeline stage metrics, the active-token gauge) show real
+    acquisitions."""
+    from spark_rapids_tpu.robustness import lock_tracker as LT
+    from spark_rapids_tpu.serving import cancel as C
+
+    conf = get_conf()
+    conf.set(BATCH_SIZE_ROWS.key, 512)  # multi-batch: the prefetch
+    # pipeline (and its stage fault seam) only runs a real stream
+    rng = np.random.default_rng(23)
+    # integer measure: exact sums independent of the accumulation
+    # order a recovery re-split may choose
+    t = pa.table({"k": rng.integers(0, 16, 4000),
+                  "v": rng.integers(0, 1000, 4000).astype(np.int64)})
+    s = TpuSession()
+    df = (s.create_dataframe(t).group_by(col("k"))
+          .agg((sum_(col("v")), "sv")))
+    want = df.collect(engine="tpu")  # warm + fault-free reference
+
+    LT.reset_stats()  # measure only the chaos runs below
+    # recovery path: one injected producer-stage fault, recovered
+    faults.install("pipeline.stage:nth=1", forced=True)
+    try:
+        got = df.collect(engine="tpu")
+        stage_stats = faults.fault_stats()["pipeline.stage"]
+    finally:
+        faults.disarm()  # disarm drops the site state: read first
+    assert_bitwise_equal(got, want)
+    assert stage_stats["recovered"] == 1
+    # cancellation path: an injected cancel.check hit unwinds the
+    # query through the production teardown
+    faults.install("cancel.check:nth=2", forced=True)
+    try:
+        with pytest.raises(C.QueryCancelled):
+            df.collect(engine="tpu")
+    finally:
+        faults.disarm()
+
+    assert LT.cycle_count() == 0, LT.order_graph()
+    stats = LT.lock_stats()
+    agg = LT.aggregate_stats()
+    # exact bookkeeping: aggregates are the per-name sums, nothing
+    # drops or double-counts
+    assert agg["acquisitions"] == sum(
+        v["acquisitions"] for v in stats.values())
+    assert agg["contention_waits"] == sum(
+        v["contention_waits"] for v in stats.values())
+    assert agg["max_hold_ms"] == max(
+        (v["max_hold_ms"] for v in stats.values()), default=0)
+    assert agg["cycles"] == 0
+    # only known engine locks appear
+    assert set(stats) <= {
+        "planCache.mu", "resultCache.mu", "scanShare.mu",
+        "cancel.breakers", "cancel.active", "pipeline.stages",
+        "scheduler.registry"}
+    for name, v in stats.items():
+        assert 0 <= v["contention_waits"] <= v["acquisitions"], name
+        assert v["max_hold_ms"] >= 0, name
+    # the exercised paths really own their locks: the pipelined agg
+    # ticks stage metrics; every collect brackets the active-token
+    # gauge (cancellation is on by default)
+    assert stats["pipeline.stages"]["acquisitions"] > 0
+    assert stats["cancel.active"]["acquisitions"] >= 4, \
+        "begin+end per collect across the two chaos runs"
+
+
+# ------------------------------------------------------------------ #
 # shuffle-fetch chaos: bounded retries + peer re-resolution
 # ------------------------------------------------------------------ #
 

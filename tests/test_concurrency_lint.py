@@ -1,0 +1,372 @@
+"""Concurrency lint tests (lint/concurrency_rules.py, CON*): each rule
+proven on a seeded-bug fixture AND on its clean twin, the guard/type
+resolution corners (cross-object typed witnesses, module-level lock
+guards, `_locked` exemptions), and the repo gate — the engine's
+threaded tiers lint clean with ZERO baselined CON entries."""
+
+import pytest
+
+from spark_rapids_tpu.lint.concurrency_rules import (
+    check_concurrency,
+    lint_concurrency_text,
+)
+
+PATH = "spark_rapids_tpu/serving/fixture.py"
+
+
+def _rules(src: str, path: str = PATH):
+    return sorted(d.rule for d in lint_concurrency_text(src, path))
+
+
+def _diags(src: str, rule: str, path: str = PATH):
+    return [d for d in lint_concurrency_text(src, path)
+            if d.rule == rule]
+
+
+# ------------------------------------------------------------------ #
+# CON001: guard discipline
+# ------------------------------------------------------------------ #
+
+
+GUARDED_CLASS = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.items = []   # guard: _mu
+'''
+
+
+def test_con001_unlocked_field_access_fires():
+    src = GUARDED_CLASS + '''
+    def bad(self):
+        return len(self.items)
+'''
+    ds = _diags(src, "CON001")
+    assert len(ds) == 1
+    assert "items" in ds[0].message and "_mu" in ds[0].message
+    assert ds[0].severity == "error"
+
+
+def test_con001_locked_access_is_clean():
+    src = GUARDED_CLASS + '''
+    def good(self):
+        with self._mu:
+            return len(self.items)
+'''
+    assert _rules(src) == []
+
+
+def test_con001_init_and_locked_suffix_exempt():
+    src = GUARDED_CLASS + '''
+    def _drain_locked(self):
+        return list(self.items)   # caller holds _mu by convention
+'''
+    assert _rules(src) == []
+
+
+def test_con001_wrong_lock_held_still_fires():
+    src = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._other = threading.Lock()
+        self.items = []   # guard: _mu
+
+    def bad(self):
+        with self._other:
+            self.items.append(1)
+'''
+    assert _rules(src) == ["CON001"]
+
+
+def test_con001_undeclared_guard_name_surfaces_typo():
+    """A guard naming a lock the class never declares is treated as
+    never-held: the annotation typo itself becomes visible as CON001
+    on the field's first use instead of silently disabling the rule."""
+    src = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.items = []   # guard: _mux
+
+    def use(self):
+        with self._mu:
+            self.items.append(1)
+'''
+    assert _rules(src) == ["CON001"]
+
+
+def test_con001_cross_object_typed_witness():
+    """Reaching into ANOTHER object's guarded field fires only when
+    the base's type is locally witnessed (param annotation); untyped
+    bases are skipped — no false positives on unknown objects."""
+    src = GUARDED_CLASS + '''
+def drain(box: Box):
+    return list(box.items)
+
+def unknown(b):
+    return list(b.items)
+'''
+    ds = _diags(src, "CON001")
+    assert len(ds) == 1
+    assert "drain" in ds[0].location
+
+
+def test_con001_module_level_lock_guard():
+    src = '''
+import threading
+
+_MU = threading.Lock()
+
+class Entry:
+    def __init__(self):
+        self.state = "closed"   # guard: _MU
+
+def flip(e: Entry):
+    e.state = "open"
+
+def flip_locked_properly(e: Entry):
+    with _MU:
+        e.state = "open"
+'''
+    ds = _diags(src, "CON001")
+    assert len(ds) == 1
+    assert "flip" in ds[0].location
+    assert "flip_locked_properly" not in ds[0].location
+
+
+# ------------------------------------------------------------------ #
+# CON002: guarded mutable state escaping under its own lock
+# ------------------------------------------------------------------ #
+
+
+def test_con002_returning_guarded_container_fires():
+    src = GUARDED_CLASS + '''
+    def snapshot(self):
+        with self._mu:
+            return self.items
+'''
+    ds = _diags(src, "CON002")
+    assert len(ds) == 1 and ds[0].severity == "warning"
+
+
+def test_con002_returning_a_copy_is_clean():
+    src = GUARDED_CLASS + '''
+    def snapshot(self):
+        with self._mu:
+            return list(self.items)
+'''
+    assert _rules(src) == []
+
+
+# ------------------------------------------------------------------ #
+# CON003: static lock-order cycles
+# ------------------------------------------------------------------ #
+
+
+def test_con003_two_lock_cycle_fires():
+    src = '''
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+def ab():
+    with A:
+        with B:
+            pass
+
+def ba():
+    with B:
+        with A:
+            pass
+'''
+    ds = _diags(src, "CON003")
+    assert len(ds) == 1
+    assert ds[0].location == "concurrency::lock-order"
+
+
+def test_con003_consistent_order_is_clean():
+    src = '''
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+def ab():
+    with A:
+        with B:
+            pass
+
+def ab_again():
+    with A:
+        with B:
+            pass
+'''
+    assert _rules(src) == []
+
+
+# ------------------------------------------------------------------ #
+# CON004/CON005: condition-variable hygiene
+# ------------------------------------------------------------------ #
+
+
+CV_CLASS = '''
+import threading
+
+class Chan:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.buf = []   # guard: _cv
+'''
+
+
+def test_con004_naked_wait_fires():
+    src = CV_CLASS + '''
+    def take(self):
+        with self._cv:
+            self._cv.wait()
+            return self.buf.pop()
+'''
+    ds = _diags(src, "CON004")
+    assert len(ds) == 1 and ds[0].severity == "error"
+
+
+def test_con004_wait_in_while_is_clean():
+    src = CV_CLASS + '''
+    def take(self):
+        with self._cv:
+            while not self.buf:
+                self._cv.wait()
+            return self.buf.pop()
+'''
+    assert _rules(src) == []
+
+
+def test_con005_notify_without_lock_fires():
+    src = CV_CLASS + '''
+    def put(self, x):
+        with self._cv:
+            self.buf.append(x)
+        self._cv.notify()
+'''
+    ds = _diags(src, "CON005")
+    assert len(ds) == 1 and ds[0].severity == "error"
+
+
+def test_con005_notify_under_lock_is_clean():
+    src = CV_CLASS + '''
+    def put(self, x):
+        with self._cv:
+            self.buf.append(x)
+            self._cv.notify()
+'''
+    assert _rules(src) == []
+
+
+def test_con005_condition_alias_group_shares_the_lock():
+    """threading.Condition(self.lock) aliases: holding the base lock
+    satisfies a notify on the derived condition."""
+    src = '''
+import threading
+
+class Chan:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.not_empty = threading.Condition(self.lock)
+        self.buf = []   # guard: lock
+
+    def put(self, x):
+        with self.lock:
+            self.buf.append(x)
+            self.not_empty.notify()
+'''
+    assert _rules(src) == []
+
+
+# ------------------------------------------------------------------ #
+# CON006: self-deadlock through a re-acquiring method call
+# ------------------------------------------------------------------ #
+
+
+def test_con006_method_call_under_own_lock_fires():
+    src = GUARDED_CLASS + '''
+    def add(self, x):
+        with self._mu:
+            self.items.append(x)
+
+    def add_all(self, xs):
+        with self._mu:
+            for x in xs:
+                self.add(x)   # re-acquires _mu: self-deadlock
+'''
+    ds = _diags(src, "CON006")
+    assert len(ds) == 1 and ds[0].severity == "error"
+    assert "add" in ds[0].message
+
+
+def test_con006_rlock_reentry_is_clean():
+    src = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self.items = []   # guard: _mu
+
+    def add(self, x):
+        with self._mu:
+            self.items.append(x)
+
+    def add_all(self, xs):
+        with self._mu:
+            for x in xs:
+                self.add(x)   # RLock: owning-thread re-entry is fine
+'''
+    assert _rules(src) == []
+
+
+# ------------------------------------------------------------------ #
+# CON000 + repo gate
+# ------------------------------------------------------------------ #
+
+
+def test_con000_syntax_error_is_a_finding():
+    ds = lint_concurrency_text("def broken(:\n", PATH)
+    assert [d.rule for d in ds] == ["CON000"]
+
+
+def test_tracked_lock_ctor_is_recognized():
+    """robustness.lock_tracker's tracked_lock() is a lock ctor to the
+    analyzer — wrapping a mutex for runtime tracking must not blind
+    the static rules."""
+    src = '''
+from spark_rapids_tpu.robustness.lock_tracker import tracked_lock
+
+class Box:
+    def __init__(self):
+        self._mu = tracked_lock("box.mu")
+        self.items = []   # guard: _mu
+
+    def bad(self):
+        return len(self.items)
+'''
+    assert _rules(src) == ["CON001"]
+
+
+def test_repo_concurrency_tiers_are_clean():
+    """THE repo gate: serving/parallel/memory/shuffle/trace/connect
+    lint clean under CON* with ZERO baseline entries — violations get
+    fixed (see test_work_share regression tests), not suppressed."""
+    from spark_rapids_tpu.lint import load_baseline
+
+    diags = check_concurrency()
+    assert diags == [], "\n".join(d.render() for d in diags)
+    assert not any(k.startswith("CON") for k in load_baseline()), \
+        "CON findings must be fixed, never baselined"

@@ -1155,3 +1155,53 @@ def test_cli_exits_zero_on_repo():
     # source+registry only: the plan corpus ran in the previous test;
     # keep the CLI check cheap inside the tier-1 run
     assert main(["--strict", "--no-plans"]) == 0
+
+
+def test_baseline_diff_repo_baseline_is_not_stale(capsys):
+    """The shipped baseline audits clean: every accepted key still
+    fires at HEAD (a stale suppression would silently mask the next
+    regression landing on its key).  --no-plans is safe here — every
+    baseline entry is an SRC* source finding."""
+    from spark_rapids_tpu.tools.lint import main
+
+    assert main(["--baseline-diff", "--no-plans"]) == 0
+    out = capsys.readouterr().out
+    assert "0 stale" in out and "tpulint: OK" in out
+
+
+def test_baseline_diff_stale_entry_is_an_error(tmp_path, capsys):
+    """A baselined key whose site no longer fires must FAIL the diff
+    (and be listed), while keys that still fire stay silent."""
+    import json as _json
+
+    from spark_rapids_tpu.lint import load_baseline
+    from spark_rapids_tpu.tools.lint import main
+
+    dead = "SRC005::spark_rapids_tpu/gone.py::deleted long ago"
+    keys = sorted(load_baseline()) + [dead]
+    p = tmp_path / "baseline.json"
+    p.write_text(_json.dumps({"accepted": keys}))
+    assert main(["--baseline-diff", "--no-plans",
+                 "--baseline", str(p)]) == 1
+    out = capsys.readouterr().out
+    assert f"STALE (baselined, no longer firing): {dead}" in out
+    assert "1 stale" in out and "tpulint: FAIL" in out
+
+
+def test_baseline_diff_added_is_informational(tmp_path, capsys):
+    """Findings not yet baselined report as `added` but do NOT fail
+    the diff — the strict gate owns failing on new findings; the diff
+    subcommand's error condition is exclusively staleness."""
+    import json as _json
+
+    from spark_rapids_tpu.tools.lint import main
+
+    p = tmp_path / "empty.json"
+    p.write_text(_json.dumps({"accepted": []}))
+    assert main(["--baseline-diff", "--no-plans", "--json",
+                 "--baseline", str(p)]) == 0
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["stale"] == [] and payload["exit"] == 0
+    # the repo's intentional (normally-baselined) findings surface
+    assert payload["added"], "expected the SRC* intentional findings"
+    assert all("::" in k for k in payload["added"])

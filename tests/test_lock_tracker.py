@@ -1,0 +1,293 @@
+"""Runtime lock-order/deadlock tracker tests
+(robustness/lock_tracker.py, docs/concurrency.md): cycle detection at
+formation time, per-name stats bookkeeping, the disarmed fast path,
+the conf sync_conf ownership discipline (faults/tracer idiom), the
+eventlog lock.* counter surface, and HC014."""
+
+import threading
+
+import pytest
+
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.robustness import lock_tracker as LT
+
+ENABLED = "spark.rapids.tpu.robustness.lockTracker.enabled"
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    LT.disarm()
+    LT.reset_stats()  # disarm keeps counters; tests start from zero
+    yield
+    LT.disarm()
+    LT.reset_stats()
+
+
+# ------------------------------------------------------------------ #
+# cycle detection
+# ------------------------------------------------------------------ #
+
+
+def test_two_lock_cycle_raises_at_formation():
+    """THE acceptance behavior: a->b established, then b->a attempted
+    on the SAME thread — the acquisition that would deadlock under the
+    right interleaving raises right there, before any wait."""
+    LT.install(forced=True)
+    a = LT.tracked_lock("t.a")
+    b = LT.tracked_lock("t.b")
+    with a:
+        with b:
+            pass
+    assert LT.order_graph() == {"t.a": ["t.b"]}
+    with b:
+        with pytest.raises(LT.LockCycleError) as ei:
+            a.acquire()
+    assert ei.value.edge == ("t.b", "t.a")
+    assert ei.value.path == ["t.a", "t.b"]
+    assert LT.cycle_count() == 1
+    # the refused acquisition took nothing: both locks reacquirable
+    with a:
+        pass
+    with b:
+        pass
+
+
+def test_transitive_cycle_detected_through_the_graph():
+    """a->b and b->c observed on separate code paths; c->a is a cycle
+    even though no single scope ever nested all three."""
+    LT.install(forced=True)
+    a, b, c = (LT.tracked_lock(n) for n in ("t3.a", "t3.b", "t3.c"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(LT.LockCycleError) as ei:
+            a.acquire()
+    assert ei.value.path == ["t3.a", "t3.b", "t3.c"]
+
+
+def test_same_name_reacquisition_is_not_a_cycle():
+    """Two INSTANCES sharing a name (two sessions' plan-cache mutexes)
+    pool their identity; nesting one under the other neither edges nor
+    raises (a self-edge would poison every per-instance lock class)."""
+    LT.install(forced=True)
+    a1 = LT.tracked_lock("pool.mu")
+    a2 = LT.tracked_lock("pool.mu")
+    with a1:
+        with a2:
+            pass
+    assert LT.order_graph() == {}
+    assert LT.cycle_count() == 0
+
+
+def test_reentrant_lock_reentry_makes_no_edge():
+    LT.install(forced=True)
+    r = LT.tracked_lock("t.r", reentrant=True)
+    with r:
+        with r:   # owning-thread re-entry: no edge, no new frame
+            pass
+    assert LT.order_graph() == {}
+    st = LT.lock_stats()["t.r"]
+    assert st["acquisitions"] == 1  # outermost only
+
+
+def test_nonblocking_acquire_never_raises_cycle():
+    """acquire(blocking=False) gives up instead of waiting — not a
+    deadlock hazard, so it records the acquisition but never refuses."""
+    LT.install(forced=True)
+    a = LT.tracked_lock("nb.a")
+    b = LT.tracked_lock("nb.b")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(blocking=False) is True
+        a.release()
+    assert LT.cycle_count() == 0
+
+
+# ------------------------------------------------------------------ #
+# stats bookkeeping
+# ------------------------------------------------------------------ #
+
+
+def test_lock_stats_exact_bookkeeping():
+    LT.install(forced=True)
+    a = LT.tracked_lock("s.a")
+    b = LT.tracked_lock("s.b")
+    for _ in range(3):
+        with a:
+            pass
+    with b:
+        pass
+    st = LT.lock_stats()
+    assert st["s.a"]["acquisitions"] == 3
+    assert st["s.b"]["acquisitions"] == 1
+    agg = LT.aggregate_stats()
+    assert agg["acquisitions"] == 4
+    assert agg["cycles"] == 0
+    assert agg["max_hold_ms"] == max(
+        v["max_hold_ms"] for v in st.values())
+
+
+def test_contention_wait_is_counted():
+    LT.install(forced=True)
+    a = LT.tracked_lock("c.a")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with a:
+            entered.set()
+            release.wait(5.0)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    entered.wait(5.0)
+    got = []
+
+    def contender():
+        with a:
+            got.append(True)
+
+    th2 = threading.Thread(target=contender)
+    th2.start()
+    th2.join(0.2)
+    release.set()
+    th.join(5.0)
+    th2.join(5.0)
+    assert got == [True]
+    st = LT.lock_stats()["c.a"]
+    assert st["acquisitions"] == 2
+    assert st["contention_waits"] == 1
+    assert st["max_hold_ms"] > 0
+
+
+def test_reset_stats_keeps_armed_state():
+    LT.install(forced=True)
+    a = LT.tracked_lock("rs.a")
+    with a:
+        pass
+    assert LT.lock_stats()["rs.a"]["acquisitions"] == 1
+    LT.reset_stats()
+    assert LT.tracker_armed()
+    assert LT.lock_stats() == {}
+    with a:
+        pass
+    assert LT.lock_stats()["rs.a"]["acquisitions"] == 1
+
+
+# ------------------------------------------------------------------ #
+# disarmed fast path + arm/disarm transitions
+# ------------------------------------------------------------------ #
+
+
+def test_disarmed_records_nothing_and_passes_through():
+    a = LT.tracked_lock("d.a")
+    b = LT.tracked_lock("d.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:   # would be a cycle if tracked — disarmed, it is not
+            pass
+    assert LT.lock_stats() == {}
+    assert LT.order_graph() == {}
+    assert LT.cycle_count() == 0
+
+
+def test_release_after_disarm_mid_hold_is_safe():
+    """Arm state can flip between acquire and release (a query
+    boundary disarms while a worker holds a lock): release must not
+    corrupt the thread's stack or the inner lock either way."""
+    a = LT.tracked_lock("flip.a")
+    LT.install(forced=True)
+    a.acquire()
+    LT.disarm()
+    a.release()   # armed-acquired, disarmed-released
+    a.acquire()   # disarmed-acquired...
+    LT.install(forced=True)
+    a.release()   # ...armed-released: tolerated, no phantom frame
+    with a:
+        pass
+    assert LT.lock_stats()["flip.a"]["acquisitions"] == 1
+
+
+# ------------------------------------------------------------------ #
+# conf ownership (faults/tracer sync_conf idiom)
+# ------------------------------------------------------------------ #
+
+
+def test_sync_conf_arms_and_only_owner_disarms():
+    conf = get_conf()
+    conf.set(ENABLED, True)
+    LT.sync_conf(conf)
+    assert LT.tracker_armed()
+    other = type(conf)()   # a second session's default conf
+    LT.sync_conf(other)    # non-owner default must NOT disarm
+    assert LT.tracker_armed()
+    conf.set(ENABLED, False)
+    LT.sync_conf(conf)     # the owner's disable does
+    assert not LT.tracker_armed()
+
+
+def test_forced_install_survives_sync_conf():
+    LT.install(forced=True)
+    conf = get_conf()
+    assert not conf.get(
+        "spark.rapids.tpu.robustness.lockTracker.enabled")
+    LT.sync_conf(conf)     # default conf, forced install: no disarm
+    assert LT.tracker_armed()
+
+
+# ------------------------------------------------------------------ #
+# eventlog + HC014 surface
+# ------------------------------------------------------------------ #
+
+
+def test_eventlog_counters_carry_lock_surface():
+    from spark_rapids_tpu.eventlog import (
+        MONOTONIC_COUNTERS,
+        counters_snapshot,
+    )
+
+    for k in ("lock.acquisitions", "lock.contention_waits",
+              "lock.cycles"):
+        assert k in MONOTONIC_COUNTERS
+    assert "lock.max_hold_ms" not in MONOTONIC_COUNTERS  # gauge
+    LT.install(forced=True)
+    a = LT.tracked_lock("ev.a")
+    with a:
+        pass
+    snap = counters_snapshot()
+    assert snap["lock.acquisitions"] >= 1
+    assert snap["lock.cycles"] == 0
+    assert snap["lock.max_hold_ms"] >= 0
+
+
+def test_hc014_lock_hold_over_budget():
+    from spark_rapids_tpu.tools.history import (
+        ApplicationInfo,
+        _query_from_record,
+        health_check,
+    )
+
+    def rules(counters):
+        rec = _query_from_record({
+            "query_id": 0, "plan": "", "plan_hash": "x",
+            "engine": "tpu", "wall_s": 1.0, "counters": counters})
+        app = ApplicationInfo("x", "eventlog", {}, [rec])
+        return {f.rule for f in health_check(app)}
+
+    # over budget (default 250ms) -> fires
+    assert "HC014" in rules({"lock.max_hold_ms": 900.0})
+    # under budget, or tracker-off all-zero record -> silent
+    assert "HC014" not in rules({"lock.max_hold_ms": 3.0})
+    assert "HC014" not in rules({})
+    # conf moves the budget
+    get_conf().set(
+        "spark.rapids.tpu.robustness.lockTracker.holdBudgetMs", 2.0)
+    assert "HC014" in rules({"lock.max_hold_ms": 3.0})

@@ -454,14 +454,14 @@ def test_spill_host_tier_compression():
         h = store.register(batch)
         raw = {k: np.asarray(v) for k, v in zip(
             ("k", "v"), (batch.columns[0].data, batch.columns[1].data))}
-        assert store._spill_one_device()
+        assert store._spill_one_device_locked()
         from spark_rapids_tpu.memory.store import _HostFrame
 
         e = store._entries[h.buffer_id]
         assert isinstance(e.host, _HostFrame)
         assert store.host_used == len(e.host.frame)
         # continue to disk: the frame lands on disk unrecompressed
-        assert store._spill_one_host()
+        assert store._spill_one_host_locked()
         restored = h.get()
         for name, want in raw.items():
             i = 0 if name == "k" else 1

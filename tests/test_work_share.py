@@ -323,6 +323,93 @@ def test_scan_registry_budget_evicts_completed_never_inflight():
         "in-flight entries are never evicted"
 
 
+def test_scan_registry_inflight_reads_done_under_entry_lock():
+    """CON001 regression (the violation the concurrency lint surfaced):
+    inflight() used to read each entry's ``_done`` — ``_cv``-guarded
+    state a leader flips in complete() — with no lock at all.  The fix
+    snapshots the registry under ``_mu`` and reads each flag under the
+    entry's own ``_cv``.  Proven structurally: while a leader HOLDS an
+    entry's ``_cv``, inflight() must block (it waits for that lock),
+    and it must NOT be sitting on ``_mu`` while it waits (a reader
+    stuck behind one busy entry must not freeze registry admission)."""
+    live, leader = ws.SCAN_REGISTRY.begin("live")
+    assert leader
+    got = []
+    th = threading.Thread(
+        target=lambda: got.append(ws.SCAN_REGISTRY.inflight()))
+    with live._cv:
+        th.start()
+        th.join(0.2)
+        assert th.is_alive(), \
+            "inflight() returned while the entry lock was held — " \
+            "it is reading _done without taking _cv"
+        # ...but _mu was already released: registry admission (which
+        # only needs _mu) must proceed while inflight() waits
+        other, lead2 = ws.SCAN_REGISTRY.begin("other")
+        assert lead2
+    th.join(5.0)
+    assert got == [1], \
+        "the reader's registry snapshot predates the second begin()"
+    assert ws.SCAN_REGISTRY.inflight() == 2
+    other.complete()
+    live.complete()
+    assert ws.SCAN_REGISTRY.inflight() == 0
+
+
+def test_scan_registry_budget_sizes_entries_under_entry_lock():
+    """CON001/CON002 regression: _enforce_budget() used to sum
+    ``e.nbytes`` (``_cv``-guarded, grown by a publishing leader) over
+    the registry with no entry lock — a torn read against publish()
+    could evict on a stale total.  The fix snapshots size + liveness
+    under each entry's ``_cv`` (nested inside ``_mu``, same order as
+    begin()) and evicts strictly from that snapshot."""
+    conf = get_conf()
+    conf.set(
+        "spark.rapids.tpu.serving.sharing.scanCache.budgetBytes", 1)
+    done, leader = ws.SCAN_REGISTRY.begin("done")
+    assert leader
+    done.publish([pa.table({"a": [1, 2, 3]})])
+    done.complete()
+    ws.SCAN_REGISTRY.release(done)  # runs _enforce_budget on release
+    assert len(ws.SCAN_REGISTRY) == 0
+
+    # structural proof of the locked snapshot: with an entry's _cv
+    # held, _enforce_budget must block instead of reading sizes.
+    # Release the leader under a roomy budget (release() enforces too,
+    # and a leader counts as a consumer until released), THEN shrink.
+    conf.set(
+        "spark.rapids.tpu.serving.sharing.scanCache.budgetBytes",
+        10**9)
+    stale, leader = ws.SCAN_REGISTRY.begin("stale")
+    assert leader
+    stale.publish([pa.table({"a": [1, 2, 3]})])
+    stale.complete()
+    ws.SCAN_REGISTRY.release(stale)
+    assert len(ws.SCAN_REGISTRY) == 1
+    conf.set(
+        "spark.rapids.tpu.serving.sharing.scanCache.budgetBytes", 1)
+
+    def _enforce_with_test_conf():
+        from spark_rapids_tpu.config import set_conf
+        set_conf(conf)  # the conf is thread-local; adopt the test's
+        ws.SCAN_REGISTRY._enforce_budget()
+
+    th = threading.Thread(target=_enforce_with_test_conf)
+    with stale._cv:
+        th.start()
+        th.join(0.2)
+        assert th.is_alive(), \
+            "_enforce_budget() finished while the entry lock was " \
+            "held — it is sizing entries without taking _cv"
+        # raw dict read: the blocked enforcer still holds _mu, so
+        # len(registry) here would deadlock the test itself
+        assert len(ws.SCAN_REGISTRY._entries) == 1, "nothing evicted"
+    th.join(5.0)
+    assert not th.is_alive()
+    assert len(ws.SCAN_REGISTRY) == 0, \
+        "the over-budget completed entry is evicted once sized"
+
+
 def test_scan_share_inflight_overflow_self_aborts():
     """The in-flight footprint cap: an entry whose buffered units
     outgrow scanCache.budgetBytes self-aborts (buffer freed,
