@@ -47,7 +47,11 @@ Attribution fields (so round-over-round deltas are explainable):
   of the coarse `hbm_roofline_fraction` quotients, same constant via
   trace/ledger.roofline_fraction), `q*_dispatches`/`q*_programs`
   (launch counts + distinct compiled programs: the ROADMAP #2
-  fusion/bucketing scoreboard) and `q*_top_program` (+`_share`);
+  fusion/bucketing scoreboard), `q*_live_capacity_ratio` (live rows
+  over padded capacity across the window's dispatches — the occupancy
+  scoreboard, docs/occupancy.md) and `q*_top_program` (+`_share`).
+  Batch coalescing is ON by default for rounds (`--no-coalesce`
+  reverts; results are bit-identical either way);
 - `q*_fusion_chains` / `q*_fused_dispatch_savings` (docs/fusion.md):
   whole-stage fusion attribution per collect — chains the planner
   fused into single programs and the program launches those fused
@@ -577,6 +581,9 @@ def _ledger_fields(prefix: str, iters: int) -> dict:
     - `{prefix}_dispatches` / `{prefix}_programs`: launch count per
       collect and distinct compiled programs in the window (the
       fusion/bucketing scoreboard of ROADMAP #2);
+    - `{prefix}_live_capacity_ratio`: live rows over padded capacity
+      across every dispatch in the window — the occupancy scoreboard
+      (1.0 = every program ran full; docs/occupancy.md);
     - `{prefix}_top_program` (+ `_share`): where the device time went.
     """
     from spark_rapids_tpu.trace import ledger
@@ -591,6 +598,8 @@ def _ledger_fields(prefix: str, iters: int) -> dict:
         f"{prefix}_programs": t["programs"],
         f"{prefix}_roofline_attributed": t["roofline"],
     }
+    if t.get("live_capacity_ratio") is not None:
+        out[f"{prefix}_live_capacity_ratio"] = t["live_capacity_ratio"]
     top = t.get("top") or []
     if top:
         out[f"{prefix}_top_program"] = top[0]["key"]
@@ -792,6 +801,11 @@ def _bench_q1(session, d: str) -> dict:
             _reset_ledger()
             breakdown.update(_bench_warm(warm_df, "q1_warm",
                                          ROWS_PER_FILE * 2))
+            # q1's own coarse roofline for the warm window (ISSUE 17
+            # acceptance metric; q6's equivalent is the headline
+            # hbm_roofline_fraction_warm)
+            breakdown["q1_hbm_roofline_fraction_warm"] = _roofline(
+                breakdown["q1_warm_rows_per_s"])
             breakdown.update(_ledger_fields("q1_warm", 3))
             # the dispatch-budget regression gate: warm q1 must stay
             # under the conf budget and compile nothing
@@ -1553,6 +1567,7 @@ def _bench_scaled(scale_rows: int) -> dict:
         old_sp = conf.get(key)
         conf.set(key, 1)
         try:
+            os.makedirs(os.path.join(d, "q1"), exist_ok=True)
             q1_files = make_lineitem(os.path.join(d, "q1"),
                                      n_files=n_files1,
                                      with_q1_cols=True)
@@ -1709,6 +1724,14 @@ def main() -> None:
         from spark_rapids_tpu.config import get_conf as _gc
 
         _gc().set("spark.rapids.tpu.sql.fusion.donation.enabled", True)
+    # batch coalescing rides bench rounds by default (dense programs
+    # under fused chains / joins / aggregates; docs/occupancy.md) —
+    # `--no-coalesce` reverts; results are bit-identical either way
+    # (coalescing only re-buckets rows) and the digest gates run anyway
+    if "--no-coalesce" not in sys.argv[1:]:
+        from spark_rapids_tpu.config import get_conf as _gc
+
+        _gc().set("spark.rapids.tpu.sql.coalesce.enabled", True)
     scale = _int_flag("--scale-rows")
     if scale:
         # scaling-curve mode ONLY (ROADMAP #1): q6 at N rows, q1 at

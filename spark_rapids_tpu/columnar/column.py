@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import get_conf, register
 
 ArrayLike = Union[jax.Array, np.ndarray]
 
@@ -38,12 +39,49 @@ MIN_CAPACITY = 8
 #: string width buckets (bytes)
 _WIDTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
+CAPACITY_POLICY = register(
+    "spark.rapids.tpu.sql.capacity.policy", "pow2",
+    "Capacity bucket policy.  'pow2' (default) rounds row counts up to "
+    "the next power of two; 'pow2x3' additionally admits 3*pow2/2 "
+    "intermediate buckets (12, 24, 48, ...) when the pow2 bucket's "
+    "live-row ratio would fall below "
+    "spark.rapids.tpu.sql.capacity.liveRatioFloor, halving worst-case "
+    "pad waste from ~2x to ~4/3x.  At most one extra bucket per octave, "
+    "so the compile-cache key space stays bounded.  Results are "
+    "bit-identical under either policy: capacity only controls how many "
+    "pad rows a program carries.",
+    check=lambda v: v in ("pow2", "pow2x3"))
+CAPACITY_LIVE_RATIO_FLOOR = register(
+    "spark.rapids.tpu.sql.capacity.liveRatioFloor", 0.75,
+    "Under capacity.policy=pow2x3: a batch whose live/capacity ratio in "
+    "its pow2 bucket would be below this floor drops to the 3*pow2/2 "
+    "bucket instead (when it fits).  0.75 re-buckets every batch that "
+    "fits the intermediate bucket; lower values re-bucket only sparser "
+    "batches; values below 0.5 disable re-bucketing (pow2 buckets "
+    "already guarantee ratio > 1/2).",
+    check=lambda v: 0.0 <= v <= 1.0)
+
 
 def pad_capacity(n: int) -> int:
-    """Round a row count up to its capacity bucket (next power of two)."""
+    """Round a row count up to its capacity bucket.
+
+    Default policy is next-power-of-two.  Under capacity.policy=pow2x3
+    an intermediate 3*pow2/2 bucket (12, 24, 48, ...) is chosen when the
+    pow2 bucket would leave the live ratio below the configured floor —
+    e.g. 5 of 8 rows live (0.625) re-buckets to 6 (0.83 live).  The
+    policy is read per call (host-side, thread-local dict get) so tests
+    can flip it; programs are keyed by the resulting capacity either
+    way, so mixing policies in one process is safe, just cache-wasteful.
+    """
     c = MIN_CAPACITY
     while c < n:
         c <<= 1
+    if c > MIN_CAPACITY and n > 0:
+        conf = get_conf()
+        if conf.get(CAPACITY_POLICY) == "pow2x3":
+            mid = 3 * (c >> 2)  # the 3*pow2/2 bucket between c/2 and c
+            if n <= mid and n / c <= conf.get(CAPACITY_LIVE_RATIO_FLOOR):
+                return mid
     return c
 
 

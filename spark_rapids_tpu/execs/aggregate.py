@@ -40,6 +40,7 @@ from spark_rapids_tpu.ops.groupby import (
     groupby_aggregate,
     reduce_aggregate,
 )
+from spark_rapids_tpu.trace import ledger as _ledger
 
 #: total partial capacity the one-program fused drain (and the traced
 #: device concat) accepts.  The stack+compact inside the program is
@@ -61,6 +62,10 @@ _DEFER_SYNC_CAP = 1 << 18
 def _as_device_rows(batch):
     if not isinstance(batch, ColumnarBatch):
         return batch  # EncodedBatch: traced count rides the wire comps
+    # promotion hides num_rows from the ledger's occupancy scan; state
+    # it while host-known (consumed by the dispatch this feeds)
+    if _ledger.LEDGER.enabled and type(batch.num_rows) is int:
+        _ledger.note_occupancy(batch.num_rows, batch.capacity)
     return batch.with_device_num_rows()
 
 
@@ -76,9 +81,11 @@ class TpuHashAggregateExec(TpuExec):
         super().__init__(child)
         assert mode in ("partial", "final", "complete"), mode
         self.mode = mode
-        from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+        from spark_rapids_tpu.memory.device_manager import (
+            effective_batch_size_rows,
+        )
 
-        self.goal_rows = goal_rows or get_conf().get(BATCH_SIZE_ROWS)
+        self.goal_rows = goal_rows or effective_batch_size_rows()
 
         child_schema = child.schema
         bind_schema = input_schema if mode == "final" else child_schema

@@ -632,6 +632,7 @@ class FusableExec(TpuExec):
             run_consuming,
         )
         from spark_rapids_tpu.exprs.base import raise_if_ansi_error
+        from spark_rapids_tpu.trace import ledger as _ledger
 
         fused, node, aware, ansi, n_execs = self._fused_pipeline()
         if aware:
@@ -660,6 +661,10 @@ class FusableExec(TpuExec):
                         record_fused_dispatch(n_enc, decode_fused=True)
                     yield self._count_output(out)
                     continue
+            # the promotion below hides num_rows from the ledger's
+            # argument scan (device scalar); state it while host-known
+            if _ledger.LEDGER.enabled and type(batch.num_rows) is int:
+                _ledger.note_occupancy(batch.num_rows, batch.capacity)
             b = batch.with_device_num_rows()
             with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
                 if aware:

@@ -137,9 +137,11 @@ class TpuRangeExec(TpuExec):
                  batch_rows: Optional[int] = None):
         super().__init__()
         self.start, self.end, self.step = start, end, step
-        from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
+        from spark_rapids_tpu.memory.device_manager import (
+            effective_batch_size_rows,
+        )
 
-        self.batch_rows = batch_rows or get_conf().get(BATCH_SIZE_ROWS)
+        self.batch_rows = batch_rows or effective_batch_size_rows()
         self._schema = T.Schema([T.Field("id", T.LONG, False)])
 
     @property
@@ -196,38 +198,9 @@ class TpuUnionExec(TpuExec):
             p -= child.num_partitions
 
 
-class TpuCoalesceBatchesExec(TpuExec):
-    """Concatenate small batches up to a target row goal
-    (ref: GpuCoalesceBatches.scala:133-455 AbstractGpuCoalesceIterator)."""
-
-    def __init__(self, child: TpuExec, goal_rows: Optional[int] = None):
-        super().__init__(child)
-        from spark_rapids_tpu.config import BATCH_SIZE_ROWS, get_conf
-
-        self.goal_rows = goal_rows or get_conf().get(BATCH_SIZE_ROWS)
-
-    @property
-    def schema(self) -> T.Schema:
-        return self.children[0].schema
-
-    def additional_metrics(self):
-        return [("numConcats", "MODERATE")]
-
-    def execute(self) -> Iterator[ColumnarBatch]:
-        from spark_rapids_tpu.columnar.batch import concat_batches
-
-        pending: list[ColumnarBatch] = []
-        pending_rows = 0
-        for b in self.children[0].execute():
-            n = b.concrete_num_rows()
-            if n == 0:
-                continue
-            pending.append(b)
-            pending_rows += n
-            if pending_rows >= self.goal_rows:
-                self.metrics["numConcats"].add(1)
-                yield self._count_output(concat_batches(pending))
-                pending, pending_rows = [], 0
-        if pending:
-            out = concat_batches(pending) if len(pending) > 1 else pending[0]
-            yield self._count_output(out)
+# batch coalescing moved to execs/coalesce.py (the planner-inserted
+# occupancy exec with cached concat programs + retry seams); re-exported
+# here because plan rules and older callers import it from this module
+from spark_rapids_tpu.execs.coalesce import (  # noqa: E402,F401
+    TpuCoalesceBatchesExec,
+)

@@ -271,7 +271,14 @@ def bisect_batch(batch):
     row axis.  Runs only on the failure path (after a spill), so the
     sizing sync and the eager gathers are off the happy path by
     construction.  EncodedBatch inputs decode first (splitting wire
-    components is plan-specific; the decoded form is universal)."""
+    components is plan-specific; the decoded form is universal).
+
+    A COALESCED batch (TpuCoalesceBatchesExec output, carrying
+    `coalesce_seams`) splits at the seam boundary nearest the midpoint
+    instead of n//2, and each half inherits its side's seams: the retry
+    ladder walks a coalesced batch back down the producer's original
+    batch granularity, so the bucket shapes the recovery dispatches at
+    are ones the compile cache has already seen."""
     import dataclasses
 
     import jax.numpy as jnp
@@ -280,6 +287,7 @@ def bisect_batch(batch):
     from spark_rapids_tpu.columnar.column import pad_capacity
     from spark_rapids_tpu.columnar.transfer import EncodedBatch
 
+    seams = getattr(batch, "coalesce_seams", None)
     if isinstance(batch, EncodedBatch):
         # a consumed (donated) batch has no device buffers left to
         # split; decode_now refuses it with ConsumedBatchError
@@ -290,6 +298,17 @@ def bisect_batch(batch):
     assert n >= 2, f"cannot bisect a {n}-row batch"
     batch = dataclasses.replace(batch, num_rows=n)
     lo = n // 2
+    first_seams = second_seams = None
+    if seams and len(seams) >= 2 and sum(seams) == n:
+        offs, acc = [], 0
+        for s in seams[:-1]:
+            acc += s
+            offs.append(acc)
+        cut = min(offs, key=lambda o: abs(o - lo))
+        if 0 < cut < n:
+            lo = cut
+            k = offs.index(cut) + 1
+            first_seams, second_seams = seams[:k], seams[k:]
     first = batch.slice_prefix(lo).shrink_to_capacity(pad_capacity(lo))
     cap = batch.capacity
     # gather DIRECTLY at the half's padded capacity: this path runs
@@ -303,6 +322,10 @@ def bisect_batch(batch):
     live = jnp.arange(out_cap, dtype=jnp.int32) < (n - lo)
     cols = [c.with_validity(c.validity & live) for c in cols]
     second = ColumnarBatch(cols, n - lo, batch.schema)
+    if first_seams and len(first_seams) >= 2:
+        first.coalesce_seams = first_seams
+    if second_seams and len(second_seams) >= 2:
+        second.coalesce_seams = second_seams
     return first, second
 
 

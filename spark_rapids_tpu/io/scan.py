@@ -141,9 +141,12 @@ def _scan_batch_rows(schema: T.Schema) -> int:
     import numpy as np
 
     from spark_rapids_tpu.config import BATCH_SIZE_ROWS, MAX_CAPACITY
+    from spark_rapids_tpu.memory.device_manager import (
+        effective_batch_size_rows,
+    )
 
     conf = _config.get_conf()
-    rows_cap = conf.get(BATCH_SIZE_ROWS)
+    rows_cap = effective_batch_size_rows(conf)
     if rows_cap == BATCH_SIZE_ROWS.default:
         rows_cap = 64 << 20  # defer to the byte target
     def _w(dt: T.DataType) -> int:

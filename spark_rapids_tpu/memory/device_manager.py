@@ -49,6 +49,23 @@ HOST_POOL_BYTES = register(
     "Upper bound on recycled host staging buffers held by the "
     "HostBufferPool (the pinned-host pool analog).")
 
+BATCH_ROWS_AUTO = register(
+    "spark.rapids.tpu.sql.batchSizeRows.auto", False,
+    "Scale the DEFAULT batchSizeRows with the selected device's HBM: "
+    "rows = pow2 floor of memory.fraction * HBM / 2KiB-per-row working "
+    "set (≈32 live copies of a 64B row: the batch, its program "
+    "temporaries and double-buffered successors), clamped to "
+    "maxBatchCapacity — bigger chips run denser batches without "
+    "retuning (the computeRmmInitSizes idea applied to batch sizing).  "
+    "An EXPLICITLY set batchSizeRows always wins, and backends that "
+    "report no real chip memory (the CPU test backend) keep the static "
+    "default (docs/occupancy.md).")
+
+#: HBM bytes budgeted per batch row under batchSizeRows.auto — ~32
+#: concurrent live copies of a ~64-byte row (inputs, fused-program
+#: temporaries, double-buffered successors, spill headroom)
+_AUTO_ROW_BYTES = 2048
+
 
 def device_alloc_checkpoint(nbytes: int) -> None:
     """The ``alloc.device`` fault-injection seam (robustness/faults.py):
@@ -100,6 +117,35 @@ def select_device(conf=None):
     if 0 <= ordinal < len(devs):
         return devs[ordinal]
     return devs[0]
+
+
+def effective_batch_size_rows(conf=None) -> int:
+    """batchSizeRows after HBM scaling: the conf value verbatim unless
+    batchSizeRows.auto is on AND the conf sits at its default AND the
+    selected device reports real chip memory — then the default scales
+    with the HBM budget (pow2 floor of fraction * HBM / _AUTO_ROW_BYTES,
+    clamped to [default, maxBatchCapacity]).  Every consumer of
+    BATCH_SIZE_ROWS that sizes device batches routes through here."""
+    from spark_rapids_tpu.config import BATCH_SIZE_ROWS, MAX_CAPACITY
+
+    conf = conf or get_conf()
+    rows = int(conf.get(BATCH_SIZE_ROWS))
+    if not conf.get(BATCH_ROWS_AUTO) or rows != BATCH_SIZE_ROWS.default:
+        return rows
+    try:
+        import jax
+
+        dev = select_device(conf)
+        info = discover()[jax.devices().index(dev)]
+    except Exception:
+        return rows
+    if not info.memory_bytes or info.platform == "cpu":
+        # CPU test backends report host RAM as "device" memory
+        return rows
+    budget = int(info.memory_bytes * conf.get(MEMORY_FRACTION))
+    scaled = max(1, budget // _AUTO_ROW_BYTES)
+    scaled = 1 << (scaled.bit_length() - 1)
+    return int(min(max(scaled, rows), conf.get(MAX_CAPACITY)))
 
 
 def initialize(conf=None) -> "DeviceInfo":

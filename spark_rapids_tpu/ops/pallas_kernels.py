@@ -110,8 +110,8 @@ def pallas_hash_string(chars: jax.Array, lengths: jax.Array,
                        interpret: bool = False) -> jax.Array:
     """Spark murmur3 of a fixed-width string column via a Pallas grid
     over row blocks.  chars (N, W) uint8; lengths/seeds (N,); -> (N,)
-    uint32.  Caller guarantees N % _BLOCK_N == 0 (capacities are
-    pow2 >= 1024, so this holds for every real batch)."""
+    uint32.  Caller guarantees N % _BLOCK_N == 0
+    (maybe_pallas_hash_string pads off-multiple shapes up first)."""
     from jax.experimental import pallas as pl
 
     n, width = chars.shape
@@ -157,26 +157,28 @@ def maybe_pallas_hash_string(chars, lengths, seeds):
     """Route to the Pallas kernel when available and the shape fits;
     None means 'use the jnp reference path'.
 
-    Sub-block batches COALESCE into one kernel block: a tiny tail
-    batch (capacity < _BLOCK_N — ragged scan tails, small partials)
-    pads its rows up to the block size and slices the result back,
-    instead of falling to the width-specialized jnp path.  Shapes are
-    static (capacities are pow2), so the pad/slice fuse into the
-    surrounding program; the win is program-count, not FLOPs — every
-    distinct jnp-path shape used to mint its own ~1.25*W-pass lowering
-    per (capacity, width), while the padded form shares the one
-    grid-blocked kernel per width with every full-size batch.  Padding
-    rows hash garbage nobody reads (length 0 -> fmix of an empty
-    string); the slice drops them inside the same program."""
+    Off-multiple batches pad into WIDE kernel blocks: any capacity
+    that is not a _BLOCK_N multiple — ragged scan tails and small
+    partials below one block, and the 3*pow2/2 occupancy buckets above
+    it (1536, 3·2^k for k < 10: capacity.policy=pow2x3,
+    docs/occupancy.md) — pads its rows up to the next block multiple
+    and slices the result back, instead of falling to the
+    width-specialized jnp path.  Shapes are static (capacities come
+    from pad_capacity), so the pad/slice fuse into the surrounding
+    program; the win is program-count, not FLOPs — every distinct
+    jnp-path shape used to mint its own ~1.25*W-pass lowering per
+    (capacity, width), while the padded form shares the one
+    grid-blocked kernel per width with every batch, including the
+    multi-batch blocks a TpuCoalesceBatchesExec feeds in.  The grid
+    covers ceil(n / _BLOCK_N) row blocks — sized to the live region of
+    the padded matrix — and the pad tail is masked by construction:
+    padding rows hash garbage nobody reads (length 0 -> fmix of an
+    empty string); the slice drops them inside the same program."""
     n, width = chars.shape
     if width > _MAX_WIDTH or not pallas_available():
         return None
     if n % _BLOCK_N != 0:
-        if n > _BLOCK_N:
-            # over-block ragged shapes don't occur (capacities are
-            # pow2), but refuse rather than pad multi-block sizes
-            return None
-        pad = _BLOCK_N - n
+        pad = -n % _BLOCK_N
         chars = jnp.concatenate(
             [chars, jnp.zeros((pad, width), chars.dtype)], axis=0)
         lengths = jnp.concatenate(

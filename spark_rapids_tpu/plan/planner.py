@@ -1375,10 +1375,57 @@ def plan_query(plan: L.LogicalPlan, conf=None) -> tuple[TpuExec, PlanMeta]:
         )
 
         inject_runtime_filters(root, conf)
+        # coalesce insertion runs BEFORE the encoded-scan marking so
+        # the marking can look through the inserted execs
+        root = _plan_coalesce(root, conf)
         _mark_encoded_scans(root)
         _plan_pipeline(root, conf)
         _plan_fusion(root)
     return root, meta
+
+
+def _plan_coalesce(root: TpuExec, conf) -> TpuExec:
+    """Insert TpuCoalesceBatchesExec below the operators whose programs
+    benefit from dense inputs (spark.rapids.tpu.sql.coalesce.enabled;
+    docs/occupancy.md): the bottom link of every fusable chain, hash
+    aggregates, hash joins and sorts.  Consecutive small batches from
+    the producer below (scans, caches, exchanges, CPU fallbacks) then
+    reach the expensive operator concatenated up to the coalesce
+    targets.  Off (the default), the plan is untouched — bit-for-bit
+    the pre-coalesce engine.  The insertion points are recorded on the
+    root (`_coalesce_report`) for DataFrame.explain()."""
+    from spark_rapids_tpu.execs.coalesce import (
+        TpuCoalesceBatchesExec,
+        coalesce_enabled,
+    )
+
+    if not coalesce_enabled(conf):
+        root._coalesce_report = []
+        return root
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.base import FusableExec
+    from spark_rapids_tpu.execs.join import _HashJoinBase
+    from spark_rapids_tpu.execs.sort import _SortMixin
+
+    def wants_dense_input(node: TpuExec, child: TpuExec) -> bool:
+        if isinstance(child, FusableExec):
+            # never split a fusable chain (or an aggregate's absorbed
+            # chain): the coalesce lands below the chain's BOTTOM link
+            # instead, where the chain sources its batches
+            return False
+        return isinstance(node, (FusableExec, TpuHashAggregateExec,
+                                 _HashJoinBase, _SortMixin))
+
+    lines: list[str] = []
+    for node in list(root._walk()):
+        for i, c in enumerate(list(node.children)):
+            if isinstance(c, (TpuCoalesceBatchesExec, CpuFallbackExec)) \
+                    or not wants_dense_input(node, c):
+                continue
+            node.children[i] = TpuCoalesceBatchesExec(c)
+            lines.append(f"{node.name} <- coalesce({c.name})")
+    root._coalesce_report = lines
+    return root
 
 
 def _mark_encoded_scans(root: TpuExec) -> None:
@@ -1389,6 +1436,7 @@ def _mark_encoded_scans(root: TpuExec) -> None:
     trip on the tunneled backend)."""
     from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.execs.base import FusableExec
+    from spark_rapids_tpu.execs.coalesce import TpuCoalesceBatchesExec
     from spark_rapids_tpu.io.scan import ParquetScanExec
 
     from spark_rapids_tpu.execs.base import fusion_enabled
@@ -1401,12 +1449,18 @@ def _mark_encoded_scans(root: TpuExec) -> None:
         return
     for node in root._walk():
         for c in node.children:
-            if not isinstance(c, ParquetScanExec):
+            # look through a planner-inserted coalesce: the decode no
+            # longer fuses into `node`'s program (the coalesce decodes
+            # eagerly before concatenating), but the compressed wire
+            # upload is preserved and the decode program is cached
+            scan = c.children[0] \
+                if isinstance(c, TpuCoalesceBatchesExec) else c
+            if not isinstance(scan, ParquetScanExec):
                 continue
             if isinstance(node, FusableExec) or (
                     isinstance(node, TpuHashAggregateExec)
                     and node.mode != "final"):
-                c.emit_encoded = True
+                scan.emit_encoded = True
 
 
 def _plan_pipeline(root: TpuExec, conf) -> None:

@@ -839,6 +839,174 @@ def run_fusion_smoke() -> dict:
     return out
 
 
+def run_coalesce_smoke() -> dict:
+    """Batch-coalescing acceptance contract, cheap CI form (tier-1 via
+    tests/test_coalesce.py, docs/occupancy.md): many tiny cached
+    batches through a q1-shaped filter->group-by->agg chain.
+
+    - results digest bit-identical with sql.coalesce.enabled on vs off
+      (coalescing only re-buckets rows);
+    - the coalesced run dispatches STRICTLY fewer ledger programs —
+      the fused chain runs once over one dense block instead of once
+      per starved input batch;
+    - the coalesced window's aggregate live/capacity ratio sits at or
+      above the HC015 occupancy floor
+      (trace.ledger.health.occupancyFloor): the chip ran dense;
+    - under a SHRUNK device budget the retry ladder bisects a
+      coalesced batch back along its input seams (`coalesce_seams`),
+      so recovery dispatches land on the producer's original batch
+      granularity, with row order preserved."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.eventlog import table_digest
+    from spark_rapids_tpu.execs import retry as R
+    from spark_rapids_tpu.execs.basic import TpuBatchSourceExec
+    from spark_rapids_tpu.execs.coalesce import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.exprs.base import lit
+    from spark_rapids_tpu.session import TpuSession, col, count_star, \
+        sum_
+    from spark_rapids_tpu.trace import ledger
+    from spark_rapids_tpu.trace.ledger import LEDGER_OCCUPANCY_FLOOR
+
+    conf = get_conf()
+    keys = ("spark.rapids.tpu.sql.coalesce.enabled",
+            "spark.rapids.tpu.sql.coalesce.targetRows",
+            "spark.rapids.tpu.sql.batchSizeRows",
+            "spark.rapids.tpu.sql.shuffle.partitions",
+            "spark.rapids.tpu.sql.pipeline.enabled",
+            "spark.rapids.tpu.sql.speculation.enabled",
+            R.SPLIT_MIN_ROWS.key)
+    saved = {k: conf.get(k) for k in keys}
+    out: dict = {}
+    ledger_was_on = ledger.LEDGER.enabled
+    rng = np.random.default_rng(0xC0A1)
+    with tempfile.TemporaryDirectory(prefix="coalesce_smoke_") as d:
+        # 16 part-full batches: 384 live rows each ride a 512 bucket
+        # (live/cap 0.75 uncoalesced); coalesced they pack one dense
+        # 6144-row block in the 8192 bucket
+        group, n_batches = 384, 16
+        n = group * n_batches
+        t = pa.table({
+            "l_shipdate": rng.integers(8766, 10957, n).astype(np.int32),
+            "l_key": rng.integers(0, 4, n).astype(np.int64),
+            "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+        })
+        path = os.path.join(d, "li.parquet")
+        pq.write_table(t, path, row_group_size=group)
+
+        def q(cached):
+            return (cached
+                    .where(col("l_shipdate") <= lit(10471))
+                    .group_by(col("l_key"))
+                    .agg((sum_(col("l_quantity")), "sum_qty"),
+                         (count_star(), "cnt"))
+                    .order_by(col("l_key")))
+
+        def collect_counted(enabled: bool):
+            """(digest, ledger summary) for one warm collect against a
+            device-resident cache, coalesce as given.  A fresh session
+            per config: the planner decides insertion at plan time."""
+            conf.set(keys[0], enabled)
+            session = TpuSession()
+            cached = session.read_parquet(path).cache()
+            df = q(cached)
+            try:
+                df.collect(engine="tpu")  # fill the cache + compile
+                ledger.reset_stats()
+                r = df.collect(engine="tpu")
+                assert ledger.LEDGER.flush(timeout=30.0), \
+                    "ledger settlement did not drain"
+                s = ledger.summarize(ledger.snapshot())
+            finally:
+                cached.unpersist()
+            return table_digest(r), s
+
+        try:
+            # pipelining/speculation pinned off so dispatch counts are
+            # deterministic; tiny batches so the chain actually starves
+            conf.set(keys[2], group)
+            conf.set(keys[3], 1)
+            conf.set(keys[4], False)
+            conf.set(keys[5], False)
+            conf.set(keys[1], 1 << 20)  # one flush per partition
+            ledger.enable()
+            off_digest, off_sum = collect_counted(False)
+            on_digest, on_sum = collect_counted(True)
+            assert on_digest == off_digest, \
+                "sql.coalesce.enabled changed query results"
+            off_d = off_sum["totals"]["dispatches"]
+            on_d = on_sum["totals"]["dispatches"]
+            assert on_d < off_d, (
+                f"coalescing saved no dispatches: on {on_d} vs "
+                f"off {off_d}")
+            ratio = on_sum["totals"].get("live_capacity_ratio")
+            floor = float(conf.get(LEDGER_OCCUPANCY_FLOOR))
+            assert ratio is not None and ratio >= floor, (
+                f"coalesced live/capacity ratio {ratio} below the "
+                f"{floor} occupancy floor")
+            out["coalesce_off_dispatches"] = off_d
+            out["coalesce_on_dispatches"] = on_d
+            out["coalesce_dispatch_savings_ratio"] = round(
+                off_d / max(on_d, 1), 2)
+            out["coalesce_live_capacity_ratio"] = ratio
+            out["coalesce_off_live_capacity_ratio"] = \
+                off_sum["totals"].get("live_capacity_ratio")
+
+            # shrunk-budget split: the coalesced block must bisect
+            # back along its input seams, not at the arbitrary midpoint
+            schema = T.Schema([T.Field("x", T.LONG)])
+            sizes = (300, 500, 200, 400)  # midpoint 700; seam cut 800
+            offs = np.cumsum((0,) + sizes)
+            parts = [ColumnarBatch.from_numpy(
+                {"x": np.arange(offs[i], offs[i + 1],
+                                dtype=np.int64)}, schema)
+                for i in range(len(sizes))]
+            co = TpuCoalesceBatchesExec(
+                TpuBatchSourceExec(parts, schema))
+            outs = list(co.execute())
+            assert len(outs) == 1 and \
+                outs[0].coalesce_seams == sizes
+            conf.set(R.SPLIT_MIN_ROWS.key, 64)
+
+            class _ShrunkBudget(RuntimeError):
+                def __str__(self):
+                    return ("RESOURCE_EXHAUSTED: shrunk device "
+                            "budget (coalesce smoke)")
+
+            budget_rows, seen, got = 900, [], []
+
+            def run(batch):
+                nr = batch.concrete_num_rows()
+                if nr > budget_rows:
+                    raise _ShrunkBudget()
+                seen.append(nr)
+                yield batch
+
+            for b in R.with_split_retry(run, outs[0],
+                                        desc="coalesce_smoke"):
+                got.extend(b.to_pydict()["x"])
+            # seam-aligned halves (300+500 | 200+400), not 700/700
+            assert seen == [800, 600], seen
+            assert got == list(range(sum(sizes))), \
+                "seam split lost or reordered rows"
+            out["coalesce_split_chunks"] = seen
+        finally:
+            for k, v in saved.items():
+                conf.set(k, v)
+            ledger.reset_stats()
+            if not ledger_was_on:
+                ledger.disable()
+    return out
+
+
 def run_connect_smoke() -> dict:
     """The wire front-door contract (spark_rapids_tpu/connect/,
     docs/connect.md): an in-process ConnectServer thread serves one
@@ -961,6 +1129,7 @@ def main() -> int:
     results.update(run_ledger_smoke())
     results.update(run_wire_codec_smoke())
     results.update(run_fusion_smoke())
+    results.update(run_coalesce_smoke())
     results.update(run_connect_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0

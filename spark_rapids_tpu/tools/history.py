@@ -74,6 +74,9 @@ DISPATCH_DEVICE_SHARE = 0.2
 #: HC011 (roofline below budget) only engages past this much settled
 #: device time — a 3ms unit query tells you nothing about the roofline
 ROOFLINE_MIN_DEVICE_MS = 50.0
+#: HC015 (pad-waste) likewise only engages past this much settled
+#: device time — tiny queries legitimately ride part-full buckets
+PAD_WASTE_MIN_DEVICE_MS = 50.0
 
 
 # ------------------------------------------------------------------ #
@@ -713,6 +716,31 @@ def _hc_lock_hold(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_pad_waste(q: QueryRecord) -> Optional[str]:
+    """HC015: pad-waste — the query's dispatches carried live rows
+    for under spark.rapids.tpu.trace.ledger.health.occupancyFloor of
+    their padded capacity while burning real device time (>=
+    PAD_WASTE_MIN_DEVICE_MS settled): most of what the chip read was
+    padding.  Coalesce small batches or switch the capacity policy to
+    densify (docs/occupancy.md)."""
+    totals = q.program_totals()
+    device_ms = totals.get("device_ms") or 0.0
+    ratio = totals.get("live_capacity_ratio")
+    if ratio is None or device_ms < PAD_WASTE_MIN_DEVICE_MS:
+        return None
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.trace.ledger import LEDGER_OCCUPANCY_FLOOR
+
+    floor = float(get_conf().get(LEDGER_OCCUPANCY_FLOOR))
+    if ratio < floor:
+        return (f"pad-waste: live/capacity ratio {ratio:.2f} below "
+                f"the {floor:g} floor over {device_ms:.0f}ms device "
+                "time — programs mostly processed padding; enable "
+                "sql.coalesce.enabled or capacity.policy=pow2x3 "
+                "(docs/occupancy.md)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -727,7 +755,8 @@ for _id, _sev, _fn in (
         ("HC011", "warning", _hc_roofline_budget),
         ("HC012", "warning", _hc_result_cache_thrash),
         ("HC013", "warning", _hc_cancellation_leak),
-        ("HC014", "warning", _hc_lock_hold)):
+        ("HC014", "warning", _hc_lock_hold),
+        ("HC015", "warning", _hc_pad_waste)):
     register_health_rule(_id, _sev, _fn)
 
 

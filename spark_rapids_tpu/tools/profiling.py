@@ -306,18 +306,29 @@ def _ledger_footer(ledger: Optional[dict]) -> list[str]:
         return []
     t = ledger.get("totals") or {}
     roof = t.get("roofline")
+    lcr = t.get("live_capacity_ratio")
     lines = [
         f"device ledger: programs={t.get('programs', 0)} "
         f"dispatches={t.get('dispatches', 0)} "
         f"device_ms={t.get('device_ms', 0.0):.2f} "
         f"dispatch_ms={t.get('dispatch_ms', 0.0):.2f} "
         + (f"roofline={roof:.6f}" if roof is not None
-           else "roofline=n/a")]
+           else "roofline=n/a")
+        + (f" live/cap={lcr:.2f}" if lcr is not None else "")]
+    progs = ledger.get("programs") or {}
     for p in t.get("top") or []:
+        # per-program efficiency: cost-model bytes x dispatches over
+        # settled busy time, against the HBM peak — plus the occupancy
+        # ratio saying how much of that traffic was live rows
+        e = progs.get(p["key"]) or {}
+        eff = e.get("roofline")
+        plcr = p.get("live_capacity_ratio")
         lines.append(
             f"  top: {p['key']} op={p['op'] or '-'} "
             f"dispatches={p['dispatches']} "
-            f"device_ms={p['device_ms']:.2f} share={p['share']:.0%}")
+            f"device_ms={p['device_ms']:.2f} share={p['share']:.0%}"
+            + (f" eff={eff:.6f}" if eff is not None else " eff=n/a")
+            + (f" live/cap={plcr:.2f}" if plcr is not None else ""))
     return lines
 
 
@@ -431,7 +442,10 @@ def render_analyze(ev: QueryEvent,
                                if lr["roofline"] is not None
                                else "n/a")
                 + f" device={lr['device_ms']:.2f}ms"
-                  f" dispatches={lr['dispatches']}")
+                  f" dispatches={lr['dispatches']}"
+                + (f" live/cap={lr['live_capacity_ratio']:.2f}"
+                   if lr.get("live_capacity_ratio") is not None
+                   else ""))
         extras = {k: v for k, v in m.items()
                   if k not in ("totalTime", "numOutputRows",
                                "numOutputBatches") and v}

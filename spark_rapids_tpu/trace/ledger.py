@@ -87,6 +87,17 @@ LEDGER_ROOFLINE_FLOOR = register(
     "roofline for that plan (docs/device_ledger.md).",
     check=lambda v: 0 <= v <= 1)
 
+LEDGER_OCCUPANCY_FLOOR = register(
+    "spark.rapids.tpu.trace.ledger.health.occupancyFloor", 0.5,
+    "HC015 health-rule budget: a query whose aggregate live-rows over "
+    "padded-capacity ratio (from the event log's per-query programs "
+    "section) falls below this while its programs burned real device "
+    "time is flagged — the chip mostly processed padding; coalesce "
+    "small batches (sql.coalesce.enabled) or switch the capacity "
+    "policy (sql.capacity.policy=pow2x3) to densify "
+    "(docs/occupancy.md).",
+    check=lambda v: 0 <= v <= 1)
+
 #: the conf default, importable without a conf in hand (bench.py's
 #: module-level docs reference the same number the conf carries)
 DEFAULT_HBM_BYTES_PER_S = float(LEDGER_HBM_BYTES_PER_S.default)
@@ -132,7 +143,8 @@ class ProgramEntry:
 
     __slots__ = ("key_str", "tag", "op", "gen", "donated", "meta",
                  "dispatches", "dispatch_ns", "device_ns", "flops",
-                 "bytes_accessed", "cost_state", "lock")
+                 "bytes_accessed", "live_rows", "capacity_rows",
+                 "cost_state", "lock")
 
     #: cost_state values
     COST_NONE, COST_PENDING, COST_DONE = 0, 1, 2
@@ -159,8 +171,88 @@ class ProgramEntry:
         self.device_ns = 0      # guard: lock (exclusive busy, settled)
         self.flops = 0.0        # guard: lock (XLA cost analysis)
         self.bytes_accessed = 0.0  # guard: lock (per execution)
+        self.live_rows = 0      # guard: lock (occupancy accounting)
+        self.capacity_rows = 0  # guard: lock (occupancy accounting)
         self.cost_state = self.COST_NONE  # guard: lock
         self.lock = threading.Lock()
+
+
+# ------------------------------------------------------------------ #
+# Occupancy accounting (live rows vs padded capacity per dispatch)
+# ------------------------------------------------------------------ #
+
+#: per-thread occupancy hint: dispatch sites whose batch row counts are
+#: device-resident (the fused pipelines promote num_rows to a device
+#: scalar before dispatch) state the host-known live/capacity pair just
+#: before calling the wrapped program; the very next ledger dispatch on
+#: that thread consumes it.  Sites that don't hint fall back to the
+#: argument scan below.
+_OCC_TLS = threading.local()
+
+#: batch classes recognized by the argument scan; resolved lazily (the
+#: columnar package imports config only, but ledger loads very early)
+_BATCH_TYPES: Optional[tuple] = None
+
+
+def note_occupancy(live_rows: Any, capacity_rows: Any) -> None:
+    """Record the live/capacity row counts for the NEXT cached_jit
+    dispatch on this thread.  No-op when the ledger is off (one
+    attribute read), so call sites need no guard of their own; counts
+    that aren't host ints (traced values) are ignored."""
+    if not LEDGER.enabled:
+        return
+    try:
+        live, cap = int(live_rows), int(capacity_rows)
+    except Exception:
+        return
+    if cap > 0:
+        _OCC_TLS.occ = (live, cap)
+
+
+def _batch_types() -> Optional[tuple]:
+    global _BATCH_TYPES
+    if _BATCH_TYPES is None:
+        try:
+            from spark_rapids_tpu.columnar.batch import ColumnarBatch
+            from spark_rapids_tpu.columnar.transfer import EncodedBatch
+
+            _BATCH_TYPES = (ColumnarBatch, EncodedBatch)
+        except Exception:
+            return None
+    return _BATCH_TYPES
+
+
+def observe_occupancy(args: tuple) -> tuple[int, int]:
+    """(live_rows, capacity_rows) summed over every batch argument
+    whose row count is host-known.  Batches carrying device-resident
+    counts are skipped (reading them would force a sync on the hot
+    path) — their dispatch sites use :func:`note_occupancy` instead.
+    Scans one level of tuple/list nesting, bounded, never throws."""
+    types_ = _batch_types()
+    if types_ is None:
+        return (0, 0)
+    batch_cls, encoded_cls = types_
+    live = cap = 0
+    stack = list(args)
+    budget = 64
+    while stack and budget > 0:
+        budget -= 1
+        a = stack.pop()
+        try:
+            if isinstance(a, batch_cls):
+                n = a.num_rows
+                if type(n) is int:
+                    live += n
+                    cap += a.capacity
+            elif isinstance(a, encoded_cls):
+                if a.num_rows is not None:
+                    live += int(a.num_rows)
+                    cap += int(a.capacity)
+            elif isinstance(a, (tuple, list)):
+                stack.extend(a)
+        except Exception:
+            continue
+    return (live, cap)
 
 
 def derive_sentinels(out: Any) -> list:
@@ -354,13 +446,21 @@ class DeviceLedger:
             e = cell[0]
             if e is None or e.gen != ledger.gen:
                 e = cell[0] = ledger.entry(key, op, donated, meta)
+            occ = getattr(_OCC_TLS, "occ", None)
+            if occ is not None:
+                _OCC_TLS.occ = None
             t0 = time.perf_counter_ns()
             out = fn(*args, **kwargs)
             t1 = time.perf_counter_ns()
+            if occ is None:
+                occ = observe_occupancy(args)
             cost_req = None
             with e.lock:
                 e.dispatches += 1
                 e.dispatch_ns += t1 - t0
+                if occ[1] > 0:
+                    e.live_rows += occ[0]
+                    e.capacity_rows += occ[1]
                 if e.cost_state == ProgramEntry.COST_NONE:
                     e.cost_state = ProgramEntry.COST_PENDING
                     # args are immutable jax values: safe to hold for
@@ -414,6 +514,11 @@ class DeviceLedger:
                     "device_ms": round(e.device_ns / 1e6, 3),
                     "flops": e.flops,
                     "bytes_accessed": e.bytes_accessed,
+                    "live_rows": e.live_rows,
+                    "capacity_rows": e.capacity_rows,
+                    "live_capacity_ratio": round(
+                        e.live_rows / e.capacity_rows, 4)
+                    if e.capacity_rows else None,
                 }
                 if e.meta:
                     # partitioned-program attribution: device_ms spans
@@ -488,6 +593,8 @@ def delta(before: dict[str, dict],
         d = a["dispatches"] - b.get("dispatches", 0)
         if d <= 0:
             continue
+        live = a.get("live_rows", 0) - b.get("live_rows", 0)
+        cap = a.get("capacity_rows", 0) - b.get("capacity_rows", 0)
         rec = {
             "tag": a["tag"],
             "op": a["op"],
@@ -499,6 +606,10 @@ def delta(before: dict[str, dict],
                 a["device_ms"] - b.get("device_ms", 0.0), 3),
             "flops": a["flops"],
             "bytes_accessed": a["bytes_accessed"],
+            "live_rows": live,
+            "capacity_rows": cap,
+            "live_capacity_ratio": round(live / cap, 4)
+            if cap > 0 else None,
         }
         for mk in ("devices", "rounds"):
             if mk in a:
@@ -529,11 +640,15 @@ def summarize(programs: dict[str, dict], top_n: int = 5,
     total_device_ms = 0.0
     total_dispatch_ms = 0.0
     total_dispatches = 0
+    total_live = 0
+    total_capacity = 0
     weighted_roofline = 0.0
     weighted_known_ms = 0.0
     for k, p in programs.items():
         device_s = p["device_ms"] / 1e3
         e = dict(p)
+        total_live += p.get("live_rows", 0)
+        total_capacity += p.get("capacity_rows", 0)
         if device_s > 0 and p["bytes_accessed"] > 0:
             bps = p["bytes_accessed"] * p["dispatches"] / device_s
             fps = p["flops"] * p["dispatches"] / device_s
@@ -563,6 +678,10 @@ def summarize(programs: dict[str, dict], top_n: int = 5,
         "device_ms": round(total_device_ms, 3),
         "roofline": round(weighted_roofline / weighted_known_ms, 6)
         if weighted_known_ms else None,
+        "live_rows": total_live,
+        "capacity_rows": total_capacity,
+        "live_capacity_ratio": round(total_live / total_capacity, 4)
+        if total_capacity else None,
         "top": [{
             "key": k,
             "op": p["op"],
@@ -570,6 +689,7 @@ def summarize(programs: dict[str, dict], top_n: int = 5,
             "device_ms": p["device_ms"],
             "share": round(p["device_ms"] / total_device_ms, 3)
             if total_device_ms else 0.0,
+            "live_capacity_ratio": p.get("live_capacity_ratio"),
         } for k, p in top],
     }
     return {"programs": enriched, "totals": totals}
@@ -593,10 +713,13 @@ def per_op(programs: dict[str, dict],
         if not op:
             continue
         a = acc.setdefault(op, {"dispatches": 0, "device_ms": 0.0,
-                                "bytes_total": 0.0})
+                                "bytes_total": 0.0, "live_rows": 0,
+                                "capacity_rows": 0})
         a["dispatches"] += p["dispatches"]
         a["device_ms"] += p["device_ms"]
         a["bytes_total"] += p["bytes_accessed"] * p["dispatches"]
+        a["live_rows"] += p.get("live_rows", 0)
+        a["capacity_rows"] += p.get("capacity_rows", 0)
     out: dict[str, dict] = {}
     for op, a in acc.items():
         device_s = a["device_ms"] / 1e3
@@ -606,5 +729,8 @@ def per_op(programs: dict[str, dict],
                 a["bytes_total"] / device_s, hbm_bytes_per_s), 6)
         out[op] = {"dispatches": a["dispatches"],
                    "device_ms": round(a["device_ms"], 3),
-                   "roofline": roof}
+                   "roofline": roof,
+                   "live_capacity_ratio": round(
+                       a["live_rows"] / a["capacity_rows"], 4)
+                   if a["capacity_rows"] else None}
     return out

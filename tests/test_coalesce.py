@@ -1,0 +1,324 @@
+"""Batch coalescing + occupancy-aware capacity (docs/occupancy.md):
+
+- the tier-1 hook for tools/bench_smoke.run_coalesce_smoke (digest
+  identity on/off, strictly fewer dispatches, live/capacity above the
+  HC015 floor, seam-aligned split-retry under a shrunk budget);
+- the padding-policy parity matrix: pow2 vs pow2x3 capacity buckets
+  must digest bit-identical through a query with nulls, strings
+  (dictionary-coded through the wire) and floats;
+- coalesce x donation x speculation interaction digests;
+- the program-census bound: repeated coalesced collects mint no new
+  compiled programs (concat keys are stable);
+- seam-aware bisect unit behavior (execs/retry.py);
+- planner insertion discipline: a coalesce lands below a fused chain's
+  BOTTOM link, never inside it, and OFF leaves the plan untouched;
+- HBM-scaled default batchSizeRows (memory/device_manager.py).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.column import pad_capacity
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.eventlog import table_digest
+from spark_rapids_tpu.session import TpuSession, col, count_star, sum_
+
+POLICY = "spark.rapids.tpu.sql.capacity.policy"
+FLOOR = "spark.rapids.tpu.sql.capacity.liveRatioFloor"
+COALESCE = "spark.rapids.tpu.sql.coalesce.enabled"
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _fixture(tmp_path, n=3000, seed=7):
+    """Strings (dictionary-coded on the wire), nullable floats, ints —
+    the sidecar-carrying column mix — in part-full row groups so
+    batches ride non-power-of-two live counts."""
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "k": pa.array(rng.choice(["AAA", "BB", "C", None], n)),
+        "q": pa.array(rng.integers(1, 51, n).astype(np.int64)),
+        "f": pa.array([None if rng.random() < 0.1 else float(x)
+                       for x in rng.integers(0, 30, n)]),
+    })
+    path = str(tmp_path / "li.parquet")
+    pq.write_table(t, path, row_group_size=384)
+    return path
+
+
+def _q(session, path):
+    return (session.read_parquet(path)
+            .group_by(col("k"))
+            .agg((sum_(col("q")), "sq"),
+                 (sum_(col("f")), "sf"),
+                 (count_star(), "n"))
+            .order_by(col("k")))
+
+
+# ------------------------------------------------------------------ #
+# padding-policy parity
+# ------------------------------------------------------------------ #
+
+
+def test_pad_capacity_pow2x3_buckets():
+    conf = get_conf()
+    conf.set(POLICY, "pow2x3")
+    # the 3*pow2/2 bucket engages only when n fits it AND the pow2
+    # bucket would run at or under the live-ratio floor
+    assert [pad_capacity(n) for n in
+            (0, 1, 8, 9, 12, 13, 16, 100, 700, 1000)] \
+        == [8, 8, 8, 12, 12, 16, 16, 128, 768, 1024]
+    assert pad_capacity(6 * (1 << 20)) == 6 << 20  # exactly 3*2^21
+    conf.set(FLOOR, 0.4)  # floor below 0.5 disables the mid bucket
+    assert pad_capacity(700) == 1024
+    conf.set(POLICY, "pow2")
+    conf.set(FLOOR, 0.75)
+    assert [pad_capacity(n) for n in (12, 700, 1000)] == [16, 1024, 1024]
+
+
+def test_capacity_policy_parity_matrix(tmp_path, session):
+    """pow2 vs pow2x3, coalesce off and on: four digests, one answer.
+    Strings/nulls/dict-coded columns included via the fixture."""
+    path = _fixture(tmp_path)
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 384)
+    digests = {}
+    for policy in ("pow2", "pow2x3"):
+        for coalesce in (False, True):
+            conf.set(POLICY, policy)
+            conf.set(COALESCE, coalesce)
+            r = _q(TpuSession(), path).collect(engine="tpu")
+            digests[(policy, coalesce)] = table_digest(r)
+    want = digests[("pow2", False)]
+    assert all(d == want for d in digests.values()), digests
+
+
+def test_concat_batches_parity_across_policies():
+    """The columnar layer itself: the same rows concatenated under
+    either policy round-trip identically (nulls + strings included)."""
+    schema = T.Schema([T.Field("x", T.LONG),
+                       T.Field("s", T.STRING)])
+    rng = np.random.default_rng(3)
+    pydicts = {}
+    for policy in ("pow2", "pow2x3"):
+        get_conf().set(POLICY, policy)
+        parts = []
+        for i, n in enumerate((300, 84, 700)):
+            xs = rng.integers(0, 1000, n)
+            parts.append(ColumnarBatch.from_numpy(
+                {"x": xs.astype(np.int64),
+                 "s": np.asarray([f"s{v}" for v in xs], object)},
+                schema))
+        out = concat_batches(parts)
+        assert out.capacity == pad_capacity(1084)
+        pydicts[policy] = out.to_pydict()
+        rng = np.random.default_rng(3)  # same rows for both policies
+    assert pydicts["pow2"] == pydicts["pow2x3"]
+    # and the buckets genuinely differed (1084 -> 2048 vs 1536)
+    get_conf().set(POLICY, "pow2")
+    c2 = pad_capacity(1084)
+    get_conf().set(POLICY, "pow2x3")
+    assert (c2, pad_capacity(1084)) == (2048, 1536)
+
+
+# ------------------------------------------------------------------ #
+# coalesce x donation x speculation + program census
+# ------------------------------------------------------------------ #
+
+
+def test_coalesce_donation_speculation_matrix(tmp_path, session):
+    """Coalescing composes with buffer donation and speculative
+    sizing: every combination answers bit-identically."""
+    path = _fixture(tmp_path)
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 384)
+    base = table_digest(_q(TpuSession(), path).collect(engine="tpu"))
+    for donation in (False, True):
+        for spec in (False, True):
+            conf.set(COALESCE, True)
+            conf.set("spark.rapids.tpu.sql.fusion.donation.enabled",
+                     donation)
+            conf.set("spark.rapids.tpu.sql.speculation.enabled", spec)
+            got = table_digest(
+                _q(TpuSession(), path).collect(engine="tpu"))
+            assert got == base, (donation, spec)
+
+
+def test_coalesce_program_census_bound(tmp_path, session):
+    """Repeated coalesced collects mint NO new programs once warm:
+    the concat key space ((caps, ns, out_cap) tuples) is bounded by
+    the fixed scan batch size plus one ragged tail, so the compile
+    cache stops growing after the first collect."""
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+
+    path = _fixture(tmp_path)
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.batchSizeRows", 384)
+    conf.set(COALESCE, True)
+    s = TpuSession()
+    df = _q(s, path)
+    df.collect(engine="tpu")  # warm
+    j0 = cache_stats()
+    for _ in range(3):
+        df.collect(engine="tpu")
+    j1 = cache_stats()
+    assert j1["misses"] == j0["misses"], (
+        f"warm coalesced collects compiled "
+        f"{j1['misses'] - j0['misses']} new program(s)")
+
+
+def test_bench_smoke_coalesce():
+    """Tier-1 hook for the full acceptance contract."""
+    from spark_rapids_tpu.tools.bench_smoke import run_coalesce_smoke
+
+    out = run_coalesce_smoke()
+    assert out["coalesce_on_dispatches"] < out["coalesce_off_dispatches"]
+    assert out["coalesce_live_capacity_ratio"] >= 0.5
+    assert out["coalesce_split_chunks"] == [800, 600]
+
+
+# ------------------------------------------------------------------ #
+# seam-aware bisect
+# ------------------------------------------------------------------ #
+
+
+def _parts(sizes):
+    schema = T.Schema([T.Field("x", T.LONG)])
+    offs = np.cumsum((0,) + tuple(sizes))
+    return [ColumnarBatch.from_numpy(
+        {"x": np.arange(offs[i], offs[i + 1], dtype=np.int64)}, schema)
+        for i in range(len(sizes))]
+
+
+def test_bisect_splits_along_seams():
+    from spark_rapids_tpu.execs.retry import bisect_batch
+
+    big = concat_batches(_parts((3, 5, 2, 6)))
+    big.coalesce_seams = (3, 5, 2, 6)
+    f, s = bisect_batch(big)
+    # n=16: offsets [3, 8, 10], midpoint 8 -> cut at 8, not n//2 blind
+    assert (f.concrete_num_rows(), s.concrete_num_rows()) == (8, 8)
+    assert f.coalesce_seams == (3, 5) and s.coalesce_seams == (2, 6)
+    assert f.to_pydict()["x"] + s.to_pydict()["x"] == list(range(16))
+
+
+def test_bisect_without_seams_keeps_midpoint():
+    from spark_rapids_tpu.execs.retry import bisect_batch
+
+    big = concat_batches(_parts((3, 5, 2, 6)))
+    f, s = bisect_batch(big)
+    assert (f.concrete_num_rows(), s.concrete_num_rows()) == (8, 8)
+    assert not hasattr(f, "coalesce_seams")
+    assert not hasattr(s, "coalesce_seams")
+
+
+def test_bisect_ignores_inconsistent_seams():
+    from spark_rapids_tpu.execs.retry import bisect_batch
+
+    big = concat_batches(_parts((3, 5, 2, 6)))
+    big.coalesce_seams = (3, 3)  # stale: does not sum to n
+    f, s = bisect_batch(big)
+    assert (f.concrete_num_rows(), s.concrete_num_rows()) == (8, 8)
+    assert not hasattr(f, "coalesce_seams")
+
+
+def test_bisect_single_seam_halves_drop_attr():
+    from spark_rapids_tpu.execs.retry import bisect_batch
+
+    big = concat_batches(_parts((3, 13)))
+    big.coalesce_seams = (3, 13)
+    f, s = bisect_batch(big)
+    # seam cut at 3 (nearest boundary to 8); 1-seam halves are plain
+    # batches again — no attr to mislead a second-level bisect
+    assert (f.concrete_num_rows(), s.concrete_num_rows()) == (3, 13)
+    assert not hasattr(f, "coalesce_seams")
+    assert not hasattr(s, "coalesce_seams")
+
+
+# ------------------------------------------------------------------ #
+# planner insertion discipline
+# ------------------------------------------------------------------ #
+
+
+def test_planner_inserts_below_chain_bottom(tmp_path, session):
+    """With coalesce on, the exec sits below the fused chain's BOTTOM
+    link (between the chain and its source), never between two
+    FusableExecs — chains and aggregate absorption stay intact."""
+    from spark_rapids_tpu.execs.base import FusableExec
+    from spark_rapids_tpu.execs.coalesce import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    path = _fixture(tmp_path)
+    conf = get_conf()
+    conf.set(COALESCE, True)
+    df = (session.read_parquet(path)
+          .where(col("q") > 10)
+          .group_by(col("k"))
+          .agg((sum_(col("q")), "sq")))
+    root, _ = plan_query(df._plan)
+    found = []
+    for node in root._walk():
+        for c in node.children:
+            if isinstance(c, TpuCoalesceBatchesExec):
+                found.append((node, c))
+                assert not isinstance(c.children[0], FusableExec), \
+                    "coalesce split a fusable chain"
+    assert found, "coalesce.enabled inserted no exec"
+    report = getattr(root, "_coalesce_report", None)
+    assert report, "planner recorded no coalesce report"
+
+
+def test_planner_off_leaves_plan_untouched(tmp_path, session):
+    """The PR16-parity gate: with every occupancy conf at its default
+    the planned tree contains no coalesce exec and pad_capacity is
+    pure pow2 — bit-for-bit the pre-occupancy engine."""
+    from spark_rapids_tpu.execs.coalesce import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.plan.planner import plan_query
+
+    path = _fixture(tmp_path)
+    df = (session.read_parquet(path)
+          .where(col("q") > 10)
+          .group_by(col("k"))
+          .agg((sum_(col("q")), "sq")))
+    root, _ = plan_query(df._plan)
+    assert not [n for n in root._walk()
+                if isinstance(n, TpuCoalesceBatchesExec)]
+    assert [pad_capacity(n) for n in (12, 700, 1000, 1536)] \
+        == [16, 1024, 1024, 2048]
+
+
+# ------------------------------------------------------------------ #
+# HBM-scaled default batchSizeRows
+# ------------------------------------------------------------------ #
+
+
+def test_effective_batch_size_rows(monkeypatch):
+    from spark_rapids_tpu.memory import device_manager as dm
+
+    conf = get_conf()
+    auto = "spark.rapids.tpu.sql.batchSizeRows.auto"
+    rows = "spark.rapids.tpu.sql.batchSizeRows"
+    # off: conf verbatim; on + CPU backend: static default
+    assert dm.effective_batch_size_rows(conf) == 1 << 20
+    conf.set(auto, True)
+    assert dm.effective_batch_size_rows(conf) == 1 << 20
+    # an explicit setting always wins
+    conf.set(rows, 4096)
+    assert dm.effective_batch_size_rows(conf) == 4096
+    conf.set(rows, 1 << 20)
+    # a 16GiB chip: 16GiB * 0.8 / 2KiB-per-row -> pow2 floor 4M,
+    # clamped by maxBatchCapacity (4M default)
+    monkeypatch.setattr(dm, "discover", lambda: [
+        dm.DeviceInfo(0, "tpu", "v5e", 16 << 30)])
+    assert dm.effective_batch_size_rows(conf) == 1 << 22
+    # a small chip never scales BELOW the static default
+    monkeypatch.setattr(dm, "discover", lambda: [
+        dm.DeviceInfo(0, "tpu", "tiny", 1 << 30)])
+    assert dm.effective_batch_size_rows(conf) == 1 << 20

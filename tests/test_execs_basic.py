@@ -82,4 +82,8 @@ def test_coalesce_batches():
     plan = TpuCoalesceBatchesExec(source(*tables), goal_rows=6)
     batches = list(plan.execute())
     assert [b.concrete_num_rows() for b in batches] == [6, 4]
-    assert plan.metrics["numConcats"].value == 1
+    # both flushes concatenated >1 buffered batch (3 + 2)
+    assert plan.metrics["numConcats"].value == 2
+    # coalesced outputs carry their input seams for the retry ladder
+    assert batches[0].coalesce_seams == (2, 2, 2)
+    assert batches[1].coalesce_seams == (2, 2)

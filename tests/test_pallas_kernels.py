@@ -99,3 +99,32 @@ def test_subblock_batches_coalesce_into_one_block(monkeypatch):
     wide = jnp.zeros((_BLOCK_N, 256), jnp.uint8)
     assert PK.maybe_pallas_hash_string(
         wide, jnp.zeros(_BLOCK_N, jnp.int32), seeds) is None
+
+
+def test_wide_blocks_pad_off_multiple_shapes(monkeypatch):
+    """Over-block off-multiple shapes — the 3*pow2/2 occupancy bucket
+    (1536 = capacity.policy=pow2x3) and coalesced multi-batch blocks —
+    pad up to the next _BLOCK_N multiple and run the same grid-blocked
+    kernel instead of falling to the jnp path (ISSUE 17 wide blocks).
+    The grid covers the live region; pad rows hash as empty strings
+    and are sliced away bit-exactly."""
+    import spark_rapids_tpu.ops.pallas_kernels as PK
+
+    monkeypatch.setattr(PK, "pallas_available", lambda: True)
+    calls = []
+
+    def interp(chars, lengths, seeds):
+        calls.append(chars.shape)
+        return pallas_hash_string(chars, lengths, seeds,
+                                  interpret=True)
+
+    monkeypatch.setattr(PK, "pallas_hash_string", interp)
+    for n in (_BLOCK_N * 3 // 2, _BLOCK_N * 2 + 8, _BLOCK_N * 3):
+        chars, lengths = _string_matrix(n, 8, seed=n)
+        seeds = jnp.full((n,), 42, jnp.uint32)
+        got = PK.maybe_pallas_hash_string(chars, lengths, seeds)
+        assert got is not None and got.shape == (n,)
+        blocks = -(-n // _BLOCK_N)
+        assert calls[-1] == (blocks * _BLOCK_N, 8)
+        ref = hash_string_bytes(chars, lengths, jnp.uint32(42))
+        assert np.array_equal(np.asarray(got), np.asarray(ref))
