@@ -134,6 +134,15 @@ the phase asserts ZERO lock-order cycles across the storm's
 interleavings and emits `lock_acquisitions` /
 `lock_contention_waits` / `max_lock_hold_ms` — observed registry-mutex
 contention, the HC014 health surface measured rather than inferred.
+
+Every --sessions measured window also runs SCRAPED: the live ops
+plane (spark_rapids_tpu/obs/, docs/ops_plane.md) is forced on and a
+scraper thread hammers /metrics concurrently with the repeat pass.
+The phase asserts every monotone eventlog counter only ever moves
+forward across successive scrapes, and — because the serial reference
+digests were computed with the plane off — the existing digest gate
+doubles as the zero-impact proof: obs on vs off is bit-identical.
+The round emits `obs_scrapes` / `obs_scrape_monotone`.
 """
 
 import json
@@ -1154,10 +1163,56 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
     if cancel_rate > 0:
         poison_thread = threading.Thread(target=run_poison,
                                          name="serve-bench-poison")
+    # scrape-under-storm (docs/ops_plane.md): the ops plane is forced
+    # on and a scraper hammers /metrics CONCURRENTLY with the measured
+    # window.  Every monotone eventlog counter must never step
+    # backwards across successive scrapes, and the digest gate below
+    # doubles as the zero-impact proof — the serial reference digests
+    # were computed with the plane off, so obs on vs off stays
+    # bit-identical by the same assert
+    from spark_rapids_tpu import obs as _obs
+    from spark_rapids_tpu.eventlog import MONOTONIC_COUNTERS
+    from spark_rapids_tpu.obs import metrics as _om
+
+    obs_owned = not _obs.is_enabled()
+    if obs_owned:
+        _obs.start(port=0)  # forced: sessions' sync_conf can't stop it
+    scrape_stop = threading.Event()
+    scrape_report = {"scrapes": 0, "violations": [], "errors": 0}
+
+    def run_scraper() -> None:
+        import urllib.request
+
+        base = f"http://127.0.0.1:{_obs.plane().port}"
+        mono = tuple(MONOTONIC_COUNTERS)
+        prev: dict = {}
+        while True:
+            try:
+                body = urllib.request.urlopen(
+                    base + "/metrics", timeout=5).read().decode()
+                parsed = _om.parse_openmetrics(body)
+                for key in mono:
+                    v = _om.scrape_value(
+                        parsed, _om.counter_metric_name(key))
+                    if v is None:
+                        continue
+                    if key in prev and v < prev[key]:
+                        scrape_report["violations"].append(
+                            (key, prev[key], v))
+                    prev[key] = v
+                scrape_report["scrapes"] += 1
+            except Exception:  # noqa: BLE001 — scrape, don't perturb
+                scrape_report["errors"] += 1
+            if scrape_stop.wait(0.02):
+                return
+
+    scraper = threading.Thread(target=run_scraper,
+                               name="serve-bench-scraper")
     _trace.clear()
     _trace.enable()
     wall0 = time.perf_counter()
     go_repeat.set()
+    scraper.start()
     if poison_thread is not None:
         poison_thread.start()
     for t in threads:
@@ -1165,6 +1220,15 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
     if poison_thread is not None:
         poison_thread.join()
     wall = time.perf_counter() - wall0
+    scrape_stop.set()
+    scraper.join()
+    if obs_owned:
+        _obs.stop()
+    assert scrape_report["scrapes"] >= 1, \
+        "the storm scraper never completed a scrape"
+    assert not scrape_report["violations"], (
+        "monotone counter stepped backwards under concurrent "
+        f"scraping: {scrape_report['violations']}")
     _trace.disable()
     spans = _trace.snapshot()
     _trace.clear()
@@ -1273,6 +1337,11 @@ def _serving_phase(n_sessions: int, n_tenants: int, li, orders,
         "max_lock_hold_ms": lock_agg["max_hold_ms"],
         "admission_shed": sched.get("shed", 0),
         "poison": poison_report or None,
+        # scrape-under-storm outcome: /metrics scrapes completed
+        # concurrently with this measured window (monotonicity and
+        # the digest gates asserted above)
+        "obs_scrapes": scrape_report["scrapes"],
+        "obs_scrape_errors": scrape_report["errors"],
     }
 
 
@@ -1430,6 +1499,12 @@ def _bench_serving(n_sessions: int, n_tenants: int) -> dict:
         "lock_acquisitions": head["lock_acquisitions"],
         "lock_contention_waits": head["lock_contention_waits"],
         "max_lock_hold_ms": head["max_lock_hold_ms"],
+        # scrape-under-storm (docs/ops_plane.md): concurrent /metrics
+        # scrapes over the measured window, monotone counters and the
+        # obs-on digests bit-identical to the obs-off serial reference
+        # — both asserted inside the phase
+        "obs_scrapes": head["obs_scrapes"],
+        "obs_scrape_monotone": True,
     }
     if cancel_rate > 0:
         out["cancel_rate"] = cancel_rate

@@ -20,14 +20,24 @@ stream carrying one record batch.  A request is one J frame; the
 response is a J header, zero or more A frames (one per device batch —
 socket backpressure propagates straight into the engine's bounded
 prefetch queue), and a J trailer carrying rows/batches or the error.
+
+Trace propagation (docs/ops_plane.md): a request MAY carry an optional
+``"trace": {"trace_id": <16 hex>, "span_id": <id>}`` object — the
+client mints the trace id (:func:`mint_trace_id`, still engine-free)
+and the server installs it as correlation context around the query, so
+every server-side span of that query is tagged with the client's id.
+Servers ignore the field when absent; old servers ignore it entirely
+(it is just one more JSON key), so the frame stays wire-compatible.
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import os
 import socket
 import struct
+import time
 from typing import Iterator, Optional, Union
 
 #: default frame clamp, mirroring spark.rapids.tpu.connect.maxFrameBytes
@@ -98,6 +108,15 @@ def recv_json(sock: socket.socket,
     return out
 
 
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char wire trace id (engine-free: os.urandom, no
+    engine tracer import).  The client stamps it on each request's
+    optional ``trace`` field; the server installs it as correlation
+    context, so both sides' spans merge onto one timeline
+    (trace/export.merge_wire_trace; docs/ops_plane.md)."""
+    return os.urandom(8).hex()
+
+
 def table_digest(tbl) -> str:
     """Engine-free mirror of eventlog.table_digest: sha256 of the
     combined table's Arrow IPC stream bytes, truncated to 16 hex
@@ -125,9 +144,20 @@ class ConnectClient:
     def __init__(self, host: str, port: int,
                  tenant: str = "default",
                  timeout: float = 120.0,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 trace: bool = False):
         self.tenant = tenant
         self._max_frame = max_frame_bytes
+        #: wire trace propagation (docs/ops_plane.md): with trace=True
+        #: every request carries {"trace": {"trace_id", "span_id"}} and
+        #: the client records send / first-byte / last-byte spans into
+        #: ``trace_spans`` as plain dicts — perf_counter_ns timestamps,
+        #: the engine tracer's clock, so an in-process loopback merges
+        #: onto ONE Chrome-trace timeline (export.merge_wire_trace)
+        self.trace_id: Optional[str] = mint_trace_id() if trace \
+            else None
+        self.trace_spans: list[dict] = []
+        self._span_seq = 0
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
 
@@ -189,27 +219,60 @@ class ConnectClient:
             req["deadline_ms"] = float(deadline_ms)
         if batch_rows is not None:
             req["batch_rows"] = int(batch_rows)
+        span_attrs = None
+        if self.trace_id is not None:
+            self._span_seq += 1
+            span_id = f"{self.trace_id}.{self._span_seq}"
+            req["trace"] = {"trace_id": self.trace_id,
+                            "span_id": span_id}
+            span_attrs = {"trace_id": self.trace_id,
+                          "span_id": span_id}
+        t0 = time.perf_counter_ns()
         send_frame(self._sock, TAG_JSON, json.dumps(req).encode())
+        t_sent = time.perf_counter_ns()
         head = recv_json(self._sock, self._max_frame)
+        t_first = time.perf_counter_ns()
+        if span_attrs is not None:
+            # client-side wire spans: request serialization+send, then
+            # time-to-first-byte (the server's admit+translate+first
+            # batch sit inside it on the merged timeline)
+            self.trace_spans.append(
+                {"name": "connect.client.send", "ph": "X",
+                 "ts_ns": t0, "dur_ns": t_sent - t0,
+                 "attrs": dict(span_attrs)})
+            self.trace_spans.append(
+                {"name": "connect.client.first_byte", "ph": "X",
+                 "ts_ns": t_sent, "dur_ns": t_first - t_sent,
+                 "attrs": dict(span_attrs)})
         if not head.get("ok"):
             raise ConnectError(head.get("error", "server error"),
                                kind=head.get("kind", "server"))
         import pyarrow as pa
 
-        while True:
-            tag, payload = recv_frame(self._sock, self._max_frame)
-            if tag == TAG_ARROW:
-                with pa.ipc.open_stream(pa.py_buffer(payload)) as rd:
-                    yield rd.read_all()
-                continue
-            if tag != TAG_JSON:
-                raise ConnectError(f"unexpected frame tag {tag!r}")
-            trailer = json.loads(payload.decode())
-            if not trailer.get("ok"):
-                raise ConnectError(
-                    trailer.get("error", "stream failed"),
-                    kind=trailer.get("kind", "server"))
-            return
+        try:
+            while True:
+                tag, payload = recv_frame(self._sock, self._max_frame)
+                if tag == TAG_ARROW:
+                    with pa.ipc.open_stream(
+                            pa.py_buffer(payload)) as rd:
+                        yield rd.read_all()
+                    continue
+                if tag != TAG_JSON:
+                    raise ConnectError(
+                        f"unexpected frame tag {tag!r}")
+                trailer = json.loads(payload.decode())
+                if not trailer.get("ok"):
+                    raise ConnectError(
+                        trailer.get("error", "stream failed"),
+                        kind=trailer.get("kind", "server"))
+                return
+        finally:
+            if span_attrs is not None:
+                t_last = time.perf_counter_ns()
+                self.trace_spans.append(
+                    {"name": "connect.client.last_byte", "ph": "X",
+                     "ts_ns": t_first, "dur_ns": t_last - t_first,
+                     "attrs": dict(span_attrs)})
 
     def execute_sql(self, sql: str, **kw):
         """SQL-text convenience: same wire op with ``sql`` instead of a

@@ -31,6 +31,7 @@ Failure contract:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import socketserver
 import threading
@@ -212,7 +213,24 @@ class _ConnectHandler(socketserver.BaseRequestHandler):
             facts = {"connect": {
                 "peer": peer, "wire_bytes": wire_bytes,
                 "translate_ms": round(translate_ms, 3)}}
-            self._stream_result(pq, params, batch_rows, facts)
+            # wire trace propagation (docs/ops_plane.md): install the
+            # client-minted trace id as correlation context around the
+            # drain — _stream_tpu's trace_context(query_id=...) MERGES
+            # onto this, so every server span of the query carries the
+            # inbound id and the two sides join on one timeline.  The
+            # id also rides the record's connect section.
+            trace = req.get("trace")
+            tctx = {}
+            if isinstance(trace, dict) and trace.get("trace_id"):
+                tctx = {"trace_id": str(trace["trace_id"])}
+                if trace.get("span_id"):
+                    tctx["parent_span_id"] = str(trace["span_id"])
+                facts["connect"]["trace_id"] = tctx["trace_id"]
+            from spark_rapids_tpu import trace as _trace
+
+            with (_trace.trace_context(**tctx) if tctx
+                  else contextlib.nullcontext()):
+                self._stream_result(pq, params, batch_rows, facts)
         finally:
             if deadline is not None:
                 state.conf.set(DEADLINE_MS.key, prev_deadline)

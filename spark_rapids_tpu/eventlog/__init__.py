@@ -555,6 +555,24 @@ class EventLogWriter:
         self.append(self.build_query_record(
             ev, post, plan_text, engine, result_digest, rows))
 
+    def log_slo(self, breach: dict) -> None:
+        """Append one SLO breach record (called by the obs/slo.py
+        watchdog thread for every attached session — the HC016 health
+        rule's input; `append` is lock-protected like log_telemetry)."""
+        from spark_rapids_tpu.eventlog.schema import SCHEMA_VERSION
+
+        self.append({
+            "type": "slo",
+            "schema_version": SCHEMA_VERSION,
+            "ts": float(breach.get("ts") or time.time()),
+            "session": self.session_id,
+            "tenant": str(breach.get("tenant") or ""),
+            "metric": str(breach["metric"]),
+            "observed_ms": float(breach["observed_ms"]),
+            "budget_ms": float(breach["budget_ms"]),
+            "window": int(breach.get("window") or 0),
+        })
+
     def log_telemetry(self, sample: dict) -> None:
         """Append one live-telemetry gauge sample (called by the
         trace/telemetry sampler thread for every attached session;

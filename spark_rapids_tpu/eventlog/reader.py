@@ -91,18 +91,22 @@ def iter_records(path: str, strict: bool = False,
 def read_log(path: str, strict: bool = False
              ) -> tuple[Optional[dict], list[dict]]:
     """(header, query_records) for one log file."""
-    header, queries, _telemetry = read_log_all(path, strict=strict)
+    header, queries, _telemetry, _slo = read_log_all(path,
+                                                     strict=strict)
     return header, queries
 
 
 def read_log_all(path: str, strict: bool = False
-                 ) -> tuple[Optional[dict], list[dict], list[dict]]:
-    """(header, query_records, telemetry_records) for one log file —
-    the full surface tools/history loads (telemetry records are the
-    live sampler's gauge samples, trace/telemetry.py)."""
+                 ) -> tuple[Optional[dict], list[dict], list[dict],
+                            list[dict]]:
+    """(header, query_records, telemetry_records, slo_records) for one
+    log file — the full surface tools/history loads (telemetry records
+    are the live sampler's gauge samples, trace/telemetry.py; slo
+    records are the watchdog's budget breaches, obs/slo.py)."""
     header = None
     queries: list[dict] = []
     telemetry: list[dict] = []
+    slo: list[dict] = []
     for rec in iter_records(path, strict=strict):
         if rec.get("type") == "header":
             header = rec
@@ -110,4 +114,6 @@ def read_log_all(path: str, strict: bool = False
             queries.append(rec)
         elif rec.get("type") == "telemetry":
             telemetry.append(rec)
-    return header, queries, telemetry
+        elif rec.get("type") == "slo":
+            slo.append(rec)
+    return header, queries, telemetry, slo

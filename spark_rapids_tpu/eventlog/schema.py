@@ -23,7 +23,7 @@ from typing import Any
 SCHEMA_VERSION = 1
 
 #: record types the writer emits
-RECORD_TYPES = ("header", "query", "telemetry")
+RECORD_TYPES = ("header", "query", "telemetry", "slo")
 
 #: required fields per record type: name -> allowed python types.
 #: Anything NOT listed here is optional-by-construction; readers must
@@ -65,6 +65,20 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
         "session": (str,),
         "counters": (dict,),
     },
+    # one SLO breach (obs/slo.py): a tenant's rolling percentile went
+    # over its spark.rapids.tpu.obs.slo.* budget — appended by the
+    # watchdog thread; the HC016 health rule's input (tools/history)
+    "slo": {
+        "type": (str,),
+        "schema_version": (int,),
+        "ts": (int, float),
+        "session": (str,),
+        "tenant": (str,),
+        "metric": (str,),
+        "observed_ms": (int, float),
+        "budget_ms": (int, float),
+        "window": (int,),
+    },
 }
 
 #: optional fields we still type-check WHEN present
@@ -95,6 +109,7 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
         "rows": (int, type(None)),
     },
     "telemetry": {},
+    "slo": {},
 }
 
 

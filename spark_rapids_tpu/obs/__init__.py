@@ -1,0 +1,403 @@
+"""Live ops plane: OpenMetrics export, in-flight query registry and
+the SLO watchdog.
+
+Everything the last ten PRs built records to POST-HOC artifacts — the
+trace rings, the event log, the device ledger, the lock stats are all
+JSONL a CLI reads after the process exits.  An operator of the connect
+front door has no live endpoint to scrape, no view of in-flight
+queries, and no latency alarm.  This package is that read side:
+
+- an **OpenMetrics HTTP endpoint** (:mod:`obs.server`, stdlib
+  ``http.server`` on the connect/shuffle daemon-thread idiom) serving
+  ``/metrics`` — the full existing counter surface, names derived
+  MECHANICALLY from the eventlog keys (:mod:`obs.metrics`; scrape ==
+  ``counters_snapshot`` parity is asserted by
+  ``tools/bench_smoke.run_ops_smoke``) — plus ``/queries``,
+  ``/queries/<id>``, ``/slo`` and ``/healthz`` JSON views;
+- a **live query registry** (:class:`LiveQueryRegistry`): the shared
+  per-query prologue/epilogue (``session._begin_query`` /
+  ``_record_query``) registers every in-flight query with tenant,
+  plan, elapsed, batches-so-far and its cancel token — the data under
+  ``/queries`` and the ``tools/top.py`` terminal live view;
+- an **SLO watchdog** (:mod:`obs.slo`): one thread (tracer-style
+  ownership, ``stop()`` joins) holding rolling per-tenant wall /
+  admission-wait windows fed by the registry's epilogue, comparing
+  p50/p99 against the ``obs.slo.*`` budgets; breaches append ``slo``
+  event-log records (the HC016 health-rule input) and surface at
+  ``/slo``.
+
+Cost discipline: disabled (the default) the per-query cost is one
+conf read in :func:`sync_conf` — no thread, no socket, no registry
+entry; the dispatch/readback pattern is bit-identical (asserted).
+Ownership mirrors the tracer/telemetry sampler: a programmatic
+:func:`start` survives ``sync_conf``; a conf-driven start is owned by
+the enabling conf and only that conf's "off" tears the plane down.
+Docs: ``docs/ops_plane.md``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Optional
+
+from spark_rapids_tpu.config import register
+
+OBS_ENABLED = register(
+    "spark.rapids.tpu.obs.enabled", False,
+    "Enable the live ops plane: an OpenMetrics HTTP endpoint "
+    "(/metrics, /queries, /slo on obs.port), the in-flight query "
+    "registry and the SLO watchdog thread.  Off (the default) no "
+    "thread or socket exists and a collect pays one conf read "
+    "(docs/ops_plane.md).")
+
+OBS_PORT = register(
+    "spark.rapids.tpu.obs.port", 0,
+    "TCP port of the ops-plane HTTP endpoint (0 = ephemeral; the "
+    "bound port is logged and available as obs.plane().port).  The "
+    "endpoint binds obs.host and serves /metrics (OpenMetrics text), "
+    "/queries, /queries/<id>, /slo and /healthz.",
+    check=lambda v: 0 <= v <= 65535)
+
+OBS_HOST = register(
+    "spark.rapids.tpu.obs.host", "127.0.0.1",
+    "Bind address of the ops-plane HTTP endpoint.  The default stays "
+    "loopback-only: the plane exposes query plans and tenant names, "
+    "so fleet-wide exposure is an explicit opt-in.")
+
+SLO_WALL_BUDGET_MS = register(
+    "spark.rapids.tpu.obs.slo.wallBudgetMs", 0.0,
+    "Per-tenant p99 wall-clock budget (ms) the SLO watchdog holds "
+    "completed queries against over obs.slo.windowSeconds.  0 "
+    "disables the wall objective.  A breach appends an `slo` "
+    "event-log record (the HC016 health input) and surfaces at "
+    "/slo (docs/ops_plane.md).",
+    check=lambda v: v >= 0)
+
+SLO_ADMIT_BUDGET_MS = register(
+    "spark.rapids.tpu.obs.slo.admitWaitBudgetMs", 0.0,
+    "Per-tenant p99 admission-wait budget (ms) for the SLO watchdog "
+    "(0 disables the admission objective).  Complements the per-query "
+    "HC009 rule: HC009 flags one recorded query after the fact, the "
+    "watchdog alarms on the rolling fleet percentile while the "
+    "process is alive.",
+    check=lambda v: v >= 0)
+
+SLO_WINDOW_S = register(
+    "spark.rapids.tpu.obs.slo.windowSeconds", 60.0,
+    "Rolling window the SLO watchdog computes per-tenant p50/p99 "
+    "over.  Observations older than this fall out of the window.",
+    check=lambda v: v > 0)
+
+SLO_INTERVAL_MS = register(
+    "spark.rapids.tpu.obs.slo.checkIntervalMs", 1000.0,
+    "SLO watchdog evaluation period (ms).  At most one breach record "
+    "per (tenant, objective) is emitted per evaluation.",
+    check=lambda v: v >= 10)
+
+
+# ------------------------------------------------------------------ #
+# Live query registry
+# ------------------------------------------------------------------ #
+
+
+class LiveQueryRegistry:
+    """In-flight queries, keyed by the process-global query id the
+    shared prologue allocates.  ``enabled`` is the fast-path guard the
+    session hooks read; everything else is behind the lock.  Entries
+    hold the cancel token itself (so ``/queries/<id>/cancel`` works)
+    but only WEAK state otherwise — plain strings and numbers, never
+    the exec tree."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._live: dict[int, dict] = {}
+        self._ledger_base: dict[int, dict] = {}
+
+    def count(self) -> int:
+        # len() of a dict is atomic under the GIL: this is the
+        # queries.in_flight telemetry gauge, read lock-free at Hz
+        return len(self._live)
+
+    def begin(self, qid: int, tenant: Optional[str] = None,
+              token: Any = None, conf_hash: str = "",
+              plan: Optional[str] = None,
+              plan_hash: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        entry = {
+            "query_id": qid,
+            "tenant": tenant,
+            "conf_hash": conf_hash,
+            "plan": plan,
+            "plan_hash": plan_hash,
+            "started_ts": time.time(),
+            "started_pc": time.perf_counter(),
+            "batches": 0,
+            "rows": 0,
+            "token": token,
+        }
+        with self._lock:
+            self._live[qid] = entry
+        # per-operator metrics-so-far ride the device ledger when it
+        # is on: snapshot at begin, delta at read time (process-global
+        # — concurrent queries share the delta, documented caveat)
+        try:
+            from spark_rapids_tpu.trace import ledger as _ledger
+
+            if _ledger.LEDGER.enabled:
+                self._ledger_base[qid] = _ledger.snapshot()
+        except Exception:
+            pass
+
+    def annotate(self, qid: int, **kv: Any) -> None:
+        """Attach facts learned after begin (rendered plan, plan
+        hash, tenant discovered at admission)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            e = self._live.get(qid)
+            if e is not None:
+                e.update({k: v for k, v in kv.items()
+                          if v is not None})
+
+    def note_batch(self, qid: int, rows: int) -> None:
+        """One streamed batch retired for this query (called from the
+        streaming drain loop; collect-path queries report 0)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            e = self._live.get(qid)
+            if e is not None:
+                e["batches"] += 1
+                e["rows"] += int(rows)
+
+    def finish(self, qid: int, engine: str = "tpu") -> None:
+        """The shared epilogue: deregister + feed the completed
+        observation (tenant, wall, admission wait) to the SLO
+        watchdog's rolling windows."""
+        if not self.enabled:
+            # plane turned off mid-query: drop any stale entry.  The
+            # common disabled path (nothing ever registered) is two
+            # attribute reads and no lock.
+            if self._live or self._ledger_base:
+                with self._lock:
+                    self._live.pop(qid, None)
+                    self._ledger_base.pop(qid, None)
+            return
+        with self._lock:
+            e = self._live.pop(qid, None)
+            self._ledger_base.pop(qid, None)
+        if e is None:
+            return
+        from spark_rapids_tpu.obs import slo as _slo
+        from spark_rapids_tpu.serving import current_serving_context
+
+        sctx = current_serving_context() or {}
+        wall_ms = (time.perf_counter() - e["started_pc"]) * 1e3
+        _slo.WATCHDOG.observe(
+            tenant=e.get("tenant") or sctx.get("tenant") or "",
+            wall_ms=wall_ms,
+            admit_wait_ms=float(sctx.get("admit_wait_ms") or 0.0),
+            engine=engine)
+
+    def drop(self, qid: int) -> None:
+        """Silent deregistration (no SLO observation): the collect
+        paths' ``finally`` safety net, so a crashed query or an
+        ABANDONED stream (generator closed early, nothing recorded)
+        cannot leak a forever-\"in-flight\" /queries entry.  No-op —
+        two attribute reads, no lock — after a normal finish()."""
+        if self._live or self._ledger_base:
+            with self._lock:
+                self._live.pop(qid, None)
+                self._ledger_base.pop(qid, None)
+
+    def _entry_json(self, e: dict, with_plan: bool) -> dict:
+        tok = e.get("token")
+        out = {
+            "query_id": e["query_id"],
+            "tenant": e.get("tenant"),
+            "conf_hash": e.get("conf_hash"),
+            "plan_hash": e.get("plan_hash"),
+            "started_ts": e["started_ts"],
+            "elapsed_ms": round(
+                (time.perf_counter() - e["started_pc"]) * 1e3, 1),
+            "batches": e["batches"],
+            "rows": e["rows"],
+            "cancel": _describe_token(tok),
+        }
+        if with_plan:
+            out["plan"] = e.get("plan")
+        return out
+
+    def snapshot(self) -> list[dict]:
+        """The /queries JSON: every in-flight query, oldest first
+        (plans elided — fetch /queries/<id> for one)."""
+        with self._lock:
+            entries = list(self._live.values())
+        entries.sort(key=lambda e: e["started_ts"])
+        return [self._entry_json(e, with_plan=False) for e in entries]
+
+    def get(self, qid: int) -> Optional[dict]:
+        """The /queries/<id> JSON: the full entry (rendered plan +
+        per-op device-ledger metrics-so-far when the ledger is on)."""
+        with self._lock:
+            e = self._live.get(qid)
+            base = self._ledger_base.get(qid)
+        if e is None:
+            return None
+        out = self._entry_json(e, with_plan=True)
+        if base is not None:
+            try:
+                from spark_rapids_tpu.trace import ledger as _ledger
+
+                out["operators"] = _ledger.per_op(
+                    _ledger.delta(base, _ledger.snapshot()))
+            except Exception:
+                out["operators"] = None
+        return out
+
+    def cancel(self, qid: int, reason: str = "ops") -> bool:
+        """Cancel one in-flight query via its registered token
+        (POST /queries/<id>/cancel).  False when the query is gone or
+        carries no token (cancellation tier off)."""
+        with self._lock:
+            e = self._live.get(qid)
+        tok = e.get("token") if e else None
+        if tok is None:
+            return False
+        tok.cancel(reason)
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._ledger_base.clear()
+
+
+def _describe_token(tok: Any) -> Optional[dict]:
+    if tok is None:
+        return None
+    try:
+        from spark_rapids_tpu.serving.cancel import describe_token
+
+        return describe_token(tok)
+    except Exception:
+        return None
+
+
+#: THE process registry (the session hooks' target)
+REGISTRY = LiveQueryRegistry()
+
+
+# ------------------------------------------------------------------ #
+# Plane lifecycle (endpoint + watchdog + registry, one owner)
+# ------------------------------------------------------------------ #
+
+
+class OpsPlane:
+    """Owns the three moving parts: the HTTP endpoint thread, the SLO
+    watchdog thread and the registry's enabled flag.  One instance per
+    process; ownership discipline mirrors the telemetry sampler."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.forced = False
+        self._enabled_by: Optional[weakref.ref] = None
+        self._lock = threading.Lock()
+        self._server = None
+
+    @property
+    def port(self) -> Optional[int]:
+        srv = self._server
+        return srv.port if srv is not None else None
+
+    def start(self, port: Optional[int] = None,
+              host: Optional[str] = None,
+              forced: bool = True) -> None:
+        from spark_rapids_tpu.obs import slo as _slo
+        from spark_rapids_tpu.obs.server import OpsHttpServer
+
+        with self._lock:
+            self.forced = self.forced or forced
+            if self.enabled:
+                return
+            self._server = OpsHttpServer(
+                host=host or str(OBS_HOST.default),
+                port=int(OBS_PORT.default if port is None else port))
+            self._server.start()
+            REGISTRY.enabled = True
+            _slo.WATCHDOG.start()
+            self.enabled = True
+
+    def stop(self) -> None:
+        """Stop and JOIN both threads, close the socket — leak-free
+        by contract (run_ops_smoke counts threads and probes the
+        port after stop)."""
+        from spark_rapids_tpu.obs import slo as _slo
+
+        with self._lock:
+            self.forced = False
+            self._enabled_by = None
+            if not self.enabled:
+                return
+            self.enabled = False
+            srv, self._server = self._server, None
+        REGISTRY.enabled = False
+        REGISTRY.clear()
+        _slo.WATCHDOG.stop()
+        if srv is not None:
+            srv.stop()
+
+    def sync_conf(self, conf=None, writer=None) -> None:
+        from spark_rapids_tpu.config import get_conf
+        from spark_rapids_tpu.obs import slo as _slo
+
+        conf = conf or get_conf()
+        if self.forced:
+            if self.enabled:
+                _slo.WATCHDOG.sync_budgets(conf)
+                _slo.WATCHDOG.attach_writer(writer)
+            return
+        want = bool(conf.get(OBS_ENABLED))
+        if want:
+            if not self.enabled:
+                self.start(port=int(conf.get(OBS_PORT)),
+                           host=str(conf.get(OBS_HOST)),
+                           forced=False)
+            self._enabled_by = weakref.ref(conf)
+            _slo.WATCHDOG.sync_budgets(conf)
+            _slo.WATCHDOG.attach_writer(writer)
+        elif self.enabled and self._enabled_by is not None \
+                and self._enabled_by() is conf:
+            self.stop()
+
+
+#: THE process plane
+PLANE = OpsPlane()
+
+
+def is_enabled() -> bool:
+    return PLANE.enabled
+
+
+def plane() -> OpsPlane:
+    return PLANE
+
+
+def start(port: Optional[int] = None,
+          host: Optional[str] = None) -> None:
+    """Force the plane on (tests/tools): survives sync_conf."""
+    PLANE.start(port=port, host=host, forced=True)
+
+
+def stop() -> None:
+    PLANE.stop()
+
+
+def sync_conf(conf=None, writer=None) -> None:
+    """Query-boundary alignment with the session conf (one conf read
+    when the plane is off — the whole disabled-path cost)."""
+    PLANE.sync_conf(conf, writer=writer)

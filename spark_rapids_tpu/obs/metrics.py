@@ -1,0 +1,165 @@
+"""The /metrics registry adapter: OpenMetrics text exposition over
+the engine's EXISTING counter surfaces.
+
+The naming contract is mechanical, never hand-curated — a metric
+exists here if and only if its key exists on one of the four source
+surfaces, so the scrape can be asserted EQUAL to the in-process
+snapshot (run_ops_smoke) and a new eventlog counter appears on
+/metrics with zero code:
+
+- ``eventlog.counters_snapshot()`` -> ``tpu_<key . -> _>`` —
+  ``_total``-suffixed counter families for MONOTONIC_COUNTERS keys,
+  gauges for the residency gauges riding the same snapshot;
+- ``telemetry.sample_now()`` -> ``tpu_telemetry_<key>`` gauges (the
+  sampler's fleet-load view, namespaced because its keys overlap the
+  snapshot's);
+- ``scheduler.scheduler_stats()`` + per-tenant wait stats ->
+  ``tpu_serving_<key>`` gauges (``tenant=``-labelled where
+  per-tenant);
+- the device ledger's per-op rollup -> ``tpu_ledger_<field>`` gauges
+  labelled ``op=``.
+
+Docs: ``docs/ops_plane.md`` (metric naming contract).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+
+def metric_name(key: str, prefix: str = "tpu") -> str:
+    """The mechanical derivation: eventlog/telemetry key ->
+    OpenMetrics sample name."""
+    return f"{prefix}_{key.replace('.', '_').replace('-', '_')}"
+
+
+def counter_metric_name(key: str) -> str:
+    """Monotonic counters additionally carry the OpenMetrics
+    ``_total`` suffix."""
+    return metric_name(key) + "_total"
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if value is None:
+        return "0"
+    f = float(value)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _labels(kv: dict) -> str:
+    if not kv:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\")
+                         .replace('"', '\\"').replace("\n", " "))
+        for k, v in sorted(kv.items()))
+    return "{" + inner + "}"
+
+
+def families() -> list[tuple[str, str, list[tuple[dict, float]]]]:
+    """Every family as (name, type, [(labels, value), ...]) — the
+    single source both the text renderer and the parity smoke use."""
+    from spark_rapids_tpu import obs as _obs
+    from spark_rapids_tpu.eventlog import (
+        MONOTONIC_COUNTERS,
+        counters_snapshot,
+    )
+    from spark_rapids_tpu.serving.scheduler import scheduler_stats
+    from spark_rapids_tpu.trace import ledger as _ledger
+    from spark_rapids_tpu.trace.telemetry import sample_now
+
+    out: list[tuple[str, str, list[tuple[dict, float]]]] = []
+    monotonic = set(MONOTONIC_COUNTERS)
+    for key, val in sorted(counters_snapshot().items()):
+        if key in monotonic:
+            out.append((counter_metric_name(key), "counter",
+                        [({}, val)]))
+        else:
+            out.append((metric_name(key), "gauge", [({}, val)]))
+    for key, val in sorted(sample_now().items()):
+        out.append((metric_name(key, "tpu_telemetry"), "gauge",
+                    [({}, val)]))
+    for key, val in sorted(scheduler_stats().items()):
+        out.append((metric_name(key, "tpu_serving"), "gauge",
+                    [({}, val)]))
+    try:
+        from spark_rapids_tpu.serving.scheduler import tenant_wait_stats
+
+        waits = tenant_wait_stats()
+    except Exception:
+        waits = {}
+    for field in ("wait_p50_ms", "wait_p99_ms", "admitted"):
+        samples = [({"tenant": t}, s.get(field, 0))
+                   for t, s in sorted(waits.items())]
+        if samples:
+            out.append((metric_name(f"tenant.{field}", "tpu_serving"),
+                        "gauge", samples))
+    out.append(("tpu_queries_in_flight", "gauge",
+                [({}, _obs.REGISTRY.count())]))
+    if _ledger.LEDGER.enabled:
+        per_op = _ledger.per_op(_ledger.snapshot())
+        for field in ("device_ms", "dispatches", "roofline",
+                      "live_capacity_ratio"):
+            samples = [({"op": op}, v[field])
+                       for op, v in sorted(per_op.items())
+                       if v.get(field) is not None]
+            if samples:
+                out.append((metric_name(f"ledger.{field}"), "gauge",
+                            samples))
+    return out
+
+
+def openmetrics_text() -> str:
+    """The /metrics body: OpenMetrics text exposition, terminated by
+    the spec's ``# EOF`` marker."""
+    lines: list[str] = []
+    for name, mtype, samples in families():
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lines.append(f"{name}{_labels(labels)} {_fmt(value)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse_openmetrics(text: str) -> dict[str, dict]:
+    """Parse an exposition back into {name: {"type": t, "samples":
+    {labels_str: value}}} — the smoke/bench side of the parity
+    assertion (stdlib only, so the connect client tests could reuse
+    it)."""
+    out: dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line == "# EOF":
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            out.setdefault(name, {"type": mtype.strip(),
+                                  "samples": {}})
+            continue
+        if line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        name, labels = head, ""
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            labels = "{" + rest
+        out.setdefault(name, {"type": "untyped", "samples": {}})
+        out[name]["samples"][labels] = float(val)
+    return out
+
+
+def scrape_value(parsed: dict, name: str,
+                 labels: str = "") -> Optional[float]:
+    fam = parsed.get(name)
+    if fam is None:
+        return None
+    return fam["samples"].get(labels)
+
+
+def counter_keys() -> Iterable[str]:
+    from spark_rapids_tpu.eventlog import MONOTONIC_COUNTERS
+
+    return MONOTONIC_COUNTERS

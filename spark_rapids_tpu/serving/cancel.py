@@ -214,6 +214,20 @@ class CancelToken:
             raise QueryCancelled(r, self.detail, self.query_id)
 
 
+def describe_token(tok: CancelToken) -> dict:
+    """JSON-safe view of one token's state — the ops plane's
+    ``/queries`` cancel column (obs/__init__.py)."""
+    return {
+        "tenant": tok.tenant,
+        "query_id": tok.query_id,
+        "reason": tok.reason,
+        "detail": tok.detail or None,
+        "deadline_remaining_s": (
+            round(tok.remaining_s(), 3)
+            if tok.deadline_ns is not None else None),
+    }
+
+
 class TokenSet:
     """A lock-protected set of live tokens — the session's (and each
     PreparedQuery's) handle for ``cancel()``."""

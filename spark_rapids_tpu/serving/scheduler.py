@@ -124,6 +124,11 @@ class QueryScheduler:
         self._shed = 0                           # guard: _cv
         self._total_wait_ms = 0.0                # guard: _cv
         self._waits_ms: deque = deque(maxlen=4096)  # guard: _cv
+        #: per-tenant wait rings + admit totals (the ops plane's
+        #: tenant-labelled /metrics families; same bound as the
+        #: global ring so a long-lived server stays O(1) memory)
+        self._tenant_waits: dict[str, deque] = {}   # guard: _cv
+        self._tenant_admitted: dict[str, int] = {}  # guard: _cv
 
     # -- limit ------------------------------------------------------- #
 
@@ -263,6 +268,12 @@ class QueryScheduler:
             self._admitted += 1
             self._total_wait_ms += wait_ms
             self._waits_ms.append(wait_ms)
+            ring = self._tenant_waits.get(tenant)
+            if ring is None:
+                ring = self._tenant_waits[tenant] = deque(maxlen=512)
+            ring.append(wait_ms)
+            self._tenant_admitted[tenant] = \
+                self._tenant_admitted.get(tenant, 0) + 1
         if _tr.TRACER.enabled:
             # the admission wait as a first-class span on the
             # correlated timeline (zero-length for immediate grants)
@@ -303,6 +314,19 @@ class QueryScheduler:
         out["wait_p99_ms"] = round(self._quantile(waits, 0.99), 3)
         return out
 
+    def tenant_stats(self) -> dict:
+        """Per-tenant admission waits: {tenant: {wait_p50_ms,
+        wait_p99_ms, admitted}} — the ops plane's tenant-labelled
+        ``tpu_serving_tenant_*`` metric families."""
+        with self._cv:
+            rings = {t: list(r) for t, r in self._tenant_waits.items()}
+            admitted = dict(self._tenant_admitted)
+        return {t: {
+            "wait_p50_ms": round(self._quantile(w, 0.50), 3),
+            "wait_p99_ms": round(self._quantile(w, 0.99), 3),
+            "admitted": admitted.get(t, 0),
+        } for t, w in rings.items()}
+
     def reset_stats(self) -> None:
         with self._cv:
             self._admitted = 0
@@ -310,6 +334,8 @@ class QueryScheduler:
             self._coalesced = 0
             self._total_wait_ms = 0.0
             self._waits_ms.clear()
+            self._tenant_waits.clear()
+            self._tenant_admitted.clear()
 
 
 # ------------------------------------------------------------------ #
@@ -369,6 +395,14 @@ def scheduler_stats() -> dict:
         "admitted": 0, "rejected": 0, "coalesced": 0, "shed": 0,
         "running": 0, "waiting": 0, "total_wait_ms": 0.0,
         "wait_p50_ms": 0.0, "wait_p99_ms": 0.0}
+
+
+def tenant_wait_stats() -> dict:
+    """Per-tenant admission-wait stats without creating a scheduler
+    (the ops plane's /metrics adapter; {} while the tier is dormant)."""
+    with _LOCK:
+        s = _SCHED
+    return s.tenant_stats() if s is not None else {}
 
 
 def reset() -> None:

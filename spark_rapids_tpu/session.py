@@ -457,7 +457,9 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
     changes resize the live permit pool, which also re-sizes serving
     admission), the device-utilization ledger and the telemetry
     sampler (which also attaches this session's event-log writer for
-    periodic `telemetry` records) — then allocate the query id, snapshot the event-log
+    periodic `telemetry` records) and the live ops plane (one conf
+    read when disabled; enabled, the query registers in-flight under
+    /queries with its tenant and cancel token) — then allocate the query id, snapshot the event-log
     counters (the per-query event-log check: `elog` is None when
     disabled — no writer thread, nothing on the batch loop) and stamp
     the clocks.
@@ -465,6 +467,7 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
     Returns (qid, elog, pre, conf_hash, start_ts, t0, t0_ns)."""
     import time as _time
 
+    from spark_rapids_tpu import obs as _obs
     from spark_rapids_tpu import trace as _trace
     from spark_rapids_tpu.eventlog import conf_fingerprint
     from spark_rapids_tpu.memory.semaphore import TpuSemaphore
@@ -479,16 +482,28 @@ def _begin_query(session: "TpuSession", conf) -> tuple:
     TpuSemaphore.sync_conf(conf)
     _ledger.sync_conf(conf)
     _telemetry.sync_conf(conf, writer=session._eventlog)
+    _obs.sync_conf(conf, writer=session._eventlog)
     qid = session.history.allocate_id()
+    conf_hash = conf_fingerprint(conf)
+    if _obs.REGISTRY.enabled:
+        # register in the live ops plane (/queries) with whatever is
+        # known at the prologue; plan/plan_hash arrive via annotate()
+        # once planning renders them
+        from spark_rapids_tpu.serving import cancel as _cancel
+
+        _obs.REGISTRY.begin(qid, tenant=session.tenant,
+                            token=_cancel.current_token(),
+                            conf_hash=conf_hash)
     elog = session._eventlog
     pre = elog.query_begin() if elog is not None else None
-    return (qid, elog, pre, conf_fingerprint(conf), _time.time(),
+    return (qid, elog, pre, conf_hash, _time.time(),
             _time.perf_counter(), _time.perf_counter_ns())
 
 
 def _record_query(session: "TpuSession", explain_text: str, exec_tree,
                   qid: int, conf_hash: str, start_ts: float, t0: float,
-                  t0_ns: int, on_event, baseline=None) -> None:
+                  t0_ns: int, on_event, baseline=None,
+                  engine: str = "tpu") -> None:
     """Per-query epilogue shared by the collect paths: the history
     record with the full clock set (the event-log hook rides
     `on_event` onto the snapshot worker).  `baseline` — a settled
@@ -496,9 +511,18 @@ def _record_query(session: "TpuSession", explain_text: str, exec_tree,
     execution's deltas on a re-drained cached exec tree (the metrics
     on the long-lived tree itself accumulate); `exec_tree` may be
     None for executions that ran no operators at all (a result-cache
-    hit)."""
+    hit).  With the ops plane on, the query deregisters from the live
+    registry here and its (tenant, wall, admission wait) observation
+    feeds the SLO watchdog's rolling windows — `engine` labels the
+    outcome ("tpu", "cancelled", "deadline_exceeded", ...)."""
     import time as _time
 
+    from spark_rapids_tpu import obs as _obs
+
+    # deregister BEFORE the history record: the serving context (the
+    # admission wait the watchdog windows) is still live here, and the
+    # registry must never show a query whose record already landed
+    _obs.REGISTRY.finish(qid, engine=engine)
     session.history.record(
         explain_text, exec_tree, _time.perf_counter() - t0,
         query_id=qid, start_ts=start_ts, end_ts=_time.time(),
@@ -1122,7 +1146,7 @@ class DataFrame:
                     _ws.offer_result(self._plan, conf, out)
                 return out, qid
         except _cancel.QueryCancelled as e:
-            self._record_cancelled(e)
+            self._record_cancelled(e, facts)
             raise
         finally:
             self._session._tokens.discard(tok)
@@ -1130,15 +1154,28 @@ class DataFrame:
                 token_sink.discard(tok)
             _cancel.end(tok)
 
-    def _record_cancelled(self, e) -> None:
+    def _record_cancelled(self, e, facts=None) -> None:
         """Cancellation epilogue: count the outcome once, and when the
         query unwound BEFORE its execution prologue ran (deadline
         expired in the admission queue), emit the per-query record
         HERE with ``engine=e.reason`` and a zero counter delta — a
         cancelled query is an observable outcome, not a gap.
         Mid-flight cancels were already recorded (with their partial
-        metrics) by the admitted/stream paths."""
+        metrics) by the admitted/stream paths.
+
+        ``facts`` are the caller's undeposited serving facts: the
+        connect front door's wire section (peer, wire_bytes,
+        translate_ms) normally lands in the serving context INSIDE
+        admission, AFTER admit() succeeds — a query shed in the queue
+        unwinds before that deposit, so without re-depositing here its
+        deadline_exceeded record would silently drop the ``connect``
+        section (the fleet's shed-by-peer attribution)."""
         from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.serving import (
+            clear_serving_context,
+            current_serving_context,
+            update_serving_context,
+        )
         from spark_rapids_tpu.serving import cancel as _cancel
 
         _cancel.tick_outcome(e.reason)
@@ -1151,6 +1188,18 @@ class DataFrame:
             e.query_id = qid
         expl = (f"CancelledBeforeExecution [{e.reason}: shed in the "
                 f"admission queue; no operator ran]\n")
+        deposited = False
+        prev_ctx = None
+        if facts and facts.get("connect"):
+            # admission never deposited the wire facts (shed in the
+            # queue): deposit them NOW so query_end's serving-context
+            # capture — which runs inside _on_event() below, on this
+            # thread — folds the connect section into the record.
+            # Save/restore around it (the nested-admission idiom): an
+            # outer query's restored context must survive this record.
+            prev_ctx = current_serving_context()
+            update_serving_context(connect=facts["connect"])
+            deposited = True
 
         def _on_event():
             if elog is None:
@@ -1158,12 +1207,19 @@ class DataFrame:
             post = elog.query_end(pre)
             return lambda ev: elog.log_query(ev, post, expl, e.reason)
 
-        with _trace.trace_context(query_id=qid):
-            if _trace.TRACER.enabled:
-                _trace.event("cancel.shed", query_id=qid,
-                             reason=e.reason)
-        _record_query(self._session, expl, None, qid, conf_hash,
-                      start_ts, t0, t0_ns, _on_event())
+        try:
+            with _trace.trace_context(query_id=qid):
+                if _trace.TRACER.enabled:
+                    _trace.event("cancel.shed", query_id=qid,
+                                 reason=e.reason)
+            _record_query(self._session, expl, None, qid, conf_hash,
+                          start_ts, t0, t0_ns, _on_event(),
+                          engine=e.reason)
+        finally:
+            if deposited:
+                clear_serving_context()
+                if prev_ctx:
+                    update_serving_context(**prev_ctx)
         e.recorded = True
 
     def _result_cache_hit(self, out: pa.Table,
@@ -1204,11 +1260,8 @@ class DataFrame:
                               meta=None) -> tuple[pa.Table, int]:
         conf = self._session.conf
 
-        from spark_rapids_tpu import trace as _trace
-        from spark_rapids_tpu.eventlog import (
-            render_plan_report,
-            table_digest,
-        )
+        from spark_rapids_tpu import obs as _obs
+        from spark_rapids_tpu.eventlog import table_digest
 
         qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
             _begin_query(self._session, conf)
@@ -1249,10 +1302,35 @@ class DataFrame:
                 if result is not None else None,
                 rows=result.num_rows if result is not None else None)
 
+        try:
+            return self._collect_tpu_admitted_registered(
+                exec_, meta, conf, qid, elog, pre, conf_hash,
+                start_ts, t0, t0_ns, baseline, _on_event)
+        finally:
+            # safety net for paths that never reach an epilogue (a
+            # crash that is not CPU-degradable): the live registry
+            # must not keep a dead query in flight
+            _obs.REGISTRY.drop(qid)
+
+    def _collect_tpu_admitted_registered(
+            self, exec_, meta, conf, qid, elog, pre, conf_hash,
+            start_ts, t0, t0_ns, baseline, _on_event):
+        from spark_rapids_tpu import obs as _obs
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.eventlog import render_plan_report
+        from spark_rapids_tpu.serving import cancel as _cancel
+
         with _trace.trace_context(query_id=qid):
             if exec_ is None:
                 with _trace.span("query.plan"):
                     exec_, meta = plan_query(self._plan, conf)
+            if _obs.REGISTRY.enabled:
+                from spark_rapids_tpu.eventlog import plan_fingerprint
+
+                ptext = meta.explain()
+                _obs.REGISTRY.annotate(
+                    qid, plan=ptext,
+                    plan_hash=plan_fingerprint(ptext))
             try:
                 with _trace.span("query.execute"):
                     out = collect_exec(exec_)
@@ -1270,7 +1348,7 @@ class DataFrame:
                     self._session, expl, exec_, qid, conf_hash,
                     start_ts, t0, t0_ns,
                     _on_event(lambda: expl, e.reason, None),
-                    baseline=baseline)
+                    baseline=baseline, engine=e.reason)
                 e.recorded = True
                 raise
             except BaseException as e:
@@ -1303,7 +1381,7 @@ class DataFrame:
                     self._session, expl, exec_, qid, conf_hash,
                     start_ts, t0, t0_ns,
                     _on_event(lambda: expl, "cpu_fallback", out),
-                    baseline=baseline)
+                    baseline=baseline, engine="cpu_fallback")
                 return out, qid
             _record_query(
                 self._session, meta.explain(), exec_, qid, conf_hash,
@@ -1343,21 +1421,31 @@ class DataFrame:
         self._session._tokens.add(tok)
         if token_sink is not None:
             token_sink.add(tok)
+        qid_box: list = []
         try:
             yield from self._stream_tpu_cancellable(
                 exec_, meta, batch_rows, drain_lock, facts, group,
-                tok)
+                tok, qid_box)
         except _cancel.QueryCancelled as e:
-            self._record_cancelled(e)
+            self._record_cancelled(e, facts)
             raise
         finally:
             self._session._tokens.discard(tok)
             if token_sink is not None:
                 token_sink.discard(tok)
             _cancel.end(tok)
+            if qid_box:
+                # safety net: an ABANDONED stream (generator closed
+                # early) records nothing — but it must not keep a dead
+                # query in the live registry either (no-op after a
+                # drained stream's normal finish)
+                from spark_rapids_tpu import obs as _obs
+
+                _obs.REGISTRY.drop(qid_box[0])
 
     def _stream_tpu_cancellable(self, exec_, meta, batch_rows,
-                                drain_lock, facts, group, tok):
+                                drain_lock, facts, group, tok,
+                                qid_box=None):
         import contextlib
         import time as _time
 
@@ -1403,6 +1491,8 @@ class DataFrame:
                 share_cap = conf.get(_ws.RESULT_CACHE_BUDGET) // 4
             qid, elog, pre, conf_hash, start_ts, t0, t0_ns = \
                 _begin_query(self._session, conf)
+            if qid_box is not None:
+                qid_box.append(qid)
             if tok is not None:
                 tok.query_id = qid
             baseline = None
@@ -1420,6 +1510,15 @@ class DataFrame:
                     with _trace.span("query.plan"):
                         exec_, meta = plan_query(self._plan, conf)
                 tctx = _trace.current_context()
+            from spark_rapids_tpu import obs as _obs
+
+            if _obs.REGISTRY.enabled:
+                from spark_rapids_tpu.eventlog import plan_fingerprint
+
+                ptext = meta.explain()
+                _obs.REGISTRY.annotate(
+                    qid, plan=ptext,
+                    plan_hash=plan_fingerprint(ptext), token=tok)
             rows = 0
             gen = stream_exec(exec_, stage="serve.stream.fetch")
             try:
@@ -1445,6 +1544,8 @@ class DataFrame:
                             else:
                                 tbl = next(gen)
                                 rows += tbl.num_rows
+                                _obs.REGISTRY.note_batch(
+                                    qid, tbl.num_rows)
                                 if share_acc is not None:
                                     share_acc.append(tbl)
                                     if sum(t.nbytes
@@ -1484,7 +1585,8 @@ class DataFrame:
                             _record_query(
                                 self._session, expl, exec_, qid,
                                 conf_hash, start_ts, t0, t0_ns,
-                                _on_cancel_event(), baseline=baseline)
+                                _on_cancel_event(), baseline=baseline,
+                                engine=reason)
                             e.recorded = True
                             raise
                     yield rb

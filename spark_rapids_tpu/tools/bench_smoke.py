@@ -35,6 +35,14 @@ a streamed fetch equals collect() to the bit, and two sessions under
 maxConcurrent=1 admission both complete with identical digests
 (tier-1 via tests/test_serving.py).
 
+`run_ops_smoke` holds the live ops-plane contract
+(spark_rapids_tpu/obs/, docs/ops_plane.md): with `obs.enabled` a real
+HTTP scrape of /metrics must parse as OpenMetrics and EQUAL the
+in-process counters_snapshot (the registry-adapter parity gate), the
+live query registry must empty back to zero after the query, and
+turning the conf off must leave no ops thread and no listening socket
+(tier-1 via tests/test_obs.py).
+
 `run_sharing_smoke` holds the cross-tenant work-sharing contract
 (serving/work_share.py, docs/work_sharing.md): a second session's
 identical parquet-backed template performs ZERO scan decodes (tapped
@@ -1007,6 +1015,118 @@ def run_coalesce_smoke() -> dict:
     return out
 
 
+def run_ops_smoke() -> dict:
+    """Live ops-plane acceptance contract, cheap CI form (tier-1 via
+    tests/test_obs.py; docs/ops_plane.md):
+
+    - `spark.rapids.tpu.obs.enabled` starts the endpoint at the next
+      query boundary; after the query the LIVE registry is empty again
+      (/queries serves []);
+    - a real HTTP scrape of /metrics parses as OpenMetrics (terminated
+      by `# EOF`) and every eventlog counters_snapshot family equals
+      the in-process snapshot value — asserted only for counters that
+      are QUIESCENT across the scrape (bracketing snapshots on both
+      sides), so a background settle cannot flake the gate while a
+      drifting scrape implementation still fails it;
+    - the owning conf's off stops BOTH threads (http + slo watchdog)
+      and releases the socket: no tpu-obs-* thread survives, and a
+      fresh connect to the old port is refused."""
+    import json as _json
+    import socket
+    import threading
+    import urllib.request
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu import obs
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.eventlog import (
+        MONOTONIC_COUNTERS,
+        counters_snapshot,
+    )
+    from spark_rapids_tpu.obs import metrics as om
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+
+    def _obs_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("tpu-obs")]
+
+    conf = get_conf()
+    keys = ("spark.rapids.tpu.obs.enabled",
+            "spark.rapids.tpu.obs.port")
+    saved = {k: conf.get(k) for k in keys}
+    out: dict = {}
+    try:
+        conf.set(keys[0], True)
+        conf.set(keys[1], 0)  # ephemeral: parallel CI runs never clash
+        session = TpuSession()
+        rng = np.random.default_rng(0x0B5)
+        n = 2048
+        t = pa.table({
+            "k": rng.integers(0, 16, n).astype(np.int64),
+            "v": rng.random(n),
+        })
+        df = (session.create_dataframe(t)
+              .group_by(col("k"))
+              .agg((sum_(col("v")), "sv")))
+        result = df.collect(engine="tpu")
+        assert obs.is_enabled(), "obs.enabled did not start the plane"
+        port = obs.plane().port
+        assert port, "ops endpoint bound no port"
+        assert obs.REGISTRY.count() == 0, \
+            "live query registry did not empty after the query"
+
+        # -- scrape == snapshot parity ------------------------------ #
+        base = f"http://127.0.0.1:{port}"
+        before = counters_snapshot()
+        body = urllib.request.urlopen(
+            base + "/metrics", timeout=10).read().decode()
+        after = counters_snapshot()
+        assert body.rstrip().endswith("# EOF"), \
+            "scrape is missing the OpenMetrics EOF marker"
+        parsed = om.parse_openmetrics(body)
+        mono = set(MONOTONIC_COUNTERS)
+        checked = 0
+        for key, val in before.items():
+            name = om.counter_metric_name(key) if key in mono \
+                else om.metric_name(key)
+            got = om.scrape_value(parsed, name)
+            assert got is not None, f"/metrics is missing {name}"
+            if after.get(key) == val:  # quiescent across the scrape
+                assert got == float(val), (
+                    f"scrape parity broken for {key}: "
+                    f"/metrics says {got}, snapshot says {val}")
+                checked += 1
+        assert checked > 0, "no quiescent counter to parity-check"
+
+        # -- live registry JSON surface ----------------------------- #
+        qbody = urllib.request.urlopen(
+            base + "/queries", timeout=10).read().decode()
+        assert _json.loads(qbody) == [], \
+            "/queries is not empty between queries"
+        out["ops_rows"] = result.num_rows
+        out["ops_scrape_families"] = len(parsed)
+        out["ops_parity_counters"] = checked
+
+        # -- off: no thread, no socket ------------------------------ #
+        conf.set(keys[0], False)
+        obs.sync_conf(conf)
+        assert not obs.is_enabled()
+        assert _obs_threads() == [], \
+            f"ops threads survived the off: {_obs_threads()}"
+        with socket.socket() as probe:
+            probe.settimeout(0.5)
+            assert probe.connect_ex(("127.0.0.1", port)) != 0, \
+                "ops socket still listening after stop"
+        out["ops_stopped_clean"] = True
+    finally:
+        for k, v in saved.items():
+            conf.set(k, v)
+        obs.stop()
+    return out
+
+
 def run_connect_smoke() -> dict:
     """The wire front-door contract (spark_rapids_tpu/connect/,
     docs/connect.md): an in-process ConnectServer thread serves one
@@ -1131,6 +1251,7 @@ def main() -> int:
     results.update(run_fusion_smoke())
     results.update(run_coalesce_smoke())
     results.update(run_connect_smoke())
+    results.update(run_ops_smoke())
     print(json.dumps({"bench_smoke": results, "ok": True}))
     return 0
 

@@ -10,16 +10,17 @@ four analyses operate on one or many of them:
   threshold.  Queries match across runs by *plan fingerprint*
   (normalized-plan hash), so the same query template lines up even
   when query ids and temp paths differ.  Committed ``BENCH_r0*.json``
-  round artifacts load as pseudo-applications, so the whole perf
-  trajectory is diffable with one command.
+  and ``SWEEP_r0*.json`` round artifacts load as pseudo-applications,
+  so the whole perf trajectory is diffable with one command.
 - ``health``   — HealthCheck: a rule registry flagging unhealthy runs
   (CPU fallbacks, retry storms, spill thrash, jit-cache miss-budget
   blowouts, steady-state blocking readbacks, starved pipelines,
   runtime filters that pruned nothing, serving-tier admission waits
   past the conf budget, dispatch-overhead-dominated queries,
   attributed rooflines below budget — those two fed from the device
-  ledger's per-query ``programs`` section — and cross-tenant
-  result-cache thrash from the work-sharing counter deltas).
+  ledger's per-query ``programs`` section — cross-tenant
+  result-cache thrash from the work-sharing counter deltas, and SLO
+  budget breaches recorded by the live ops plane's watchdog, HC016).
 - ``report``   — the fleet-style regression report: one markdown
   document with run fingerprints, the compare matrix, the
   work-sharing rollup (when any run engaged the sharing tier), and
@@ -156,12 +157,15 @@ class ApplicationInfo:
     """One run: header fingerprint + its query records."""
 
     path: str
-    kind: str  # "eventlog" | "bench"
+    kind: str  # "eventlog" | "bench" | "sweep"
     header: dict
     queries: list
     #: live-telemetry gauge samples (trace/telemetry.py records), in
     #: file order; empty for bench pseudo-apps and sampler-off runs
     telemetry: list = dataclasses.field(default_factory=list)
+    #: SLO breach records (obs/slo.py watchdog emissions), in file
+    #: order; HC016's input — empty for watchdog-off runs
+    slo: list = dataclasses.field(default_factory=list)
 
     @property
     def label(self) -> str:
@@ -263,24 +267,73 @@ def load_bench_round(path: str) -> ApplicationInfo:
     return ApplicationInfo(path, "bench", header, queries)
 
 
+def load_sweep_round(path: str) -> ApplicationInfo:
+    """Adapt one committed SWEEP_rNN.json artifact (tools/sweep.py)
+    into a pseudo-application: one QueryRecord per swept query keyed
+    ``sweep:<q>`` (plan fingerprints line rounds up with each other
+    and never with real event logs), wall from the verdict's
+    ``wall_ms`` — so ``history compare SWEEP_r01.json SWEEP_r02.json``
+    diffs sweep rounds exactly like bench rounds.  Old artifacts
+    without per-query wall load with wall 0 (they predate the
+    field)."""
+    with open(path) as f:
+        data = json.load(f)
+    queries = []
+    for name, v in sorted(data.get("queries", {}).items(),
+                          key=lambda kv: int(kv[0][1:])):
+        queries.append(QueryRecord(
+            query_id=name, plan=f"sweep:{name}",
+            plan_hash=f"sweep:{name}",
+            engine=v.get("status", "unknown"),
+            wall_s=float(v.get("wall_ms", 0.0)) / 1e3,
+            start_ts=0.0, end_ts=0.0, conf_hash="",
+            counters={}, operators=None, spans=None, pipeline=None,
+            faults=None, result_digest=None, rows=v.get("rows"),
+            raw=v))
+    header = {"session": os.path.basename(path), "conf_hash": "",
+              "env": {"round": data.get("round"),
+                      "scale": data.get("scale"),
+                      "totals": data.get("totals")}}
+    return ApplicationInfo(path, "sweep", header, queries)
+
+
+def _is_eventlog_head(head: str) -> bool:
+    """True when the sniffed file prefix is an event log: its first
+    line is a typed record (the header).  Checked BEFORE the bench/
+    sweep keyword sniffs — an `slo` record carries a "metric" field,
+    so keyword order alone would misroute a breached run's log into
+    the bench-round loader."""
+    from spark_rapids_tpu.eventlog.schema import RECORD_TYPES
+
+    try:
+        first = json.loads(head.splitlines()[0])
+    except (json.JSONDecodeError, IndexError):
+        return False
+    return isinstance(first, dict) and first.get("type") in RECORD_TYPES
+
+
 def load_application(path: str) -> ApplicationInfo:
-    """Load one run: an event log (.jsonl[.gz]) or a committed bench
-    round JSON (detected by content, not extension)."""
+    """Load one run: an event log (.jsonl[.gz]), a committed bench
+    round JSON, or a committed sweep round JSON (detected by content,
+    not extension)."""
     from spark_rapids_tpu.eventlog.reader import read_log_all
 
     if not path.endswith(".gz"):
         try:
             with open(path) as f:
                 head = f.read(1 << 16).lstrip()
-            if head.startswith("{") and ("\"metric\"" in head
-                                         or "\"tail\"" in head):
-                return load_bench_round(path)
+            if head.startswith("{") and not _is_eventlog_head(head):
+                if "\"failure_taxonomy\"" in head \
+                        or "\"satellite_advances\"" in head:
+                    return load_sweep_round(path)
+                if "\"metric\"" in head or "\"tail\"" in head:
+                    return load_bench_round(path)
         except UnicodeDecodeError:
             pass
-    header, recs, telemetry = read_log_all(path)
+    header, recs, telemetry, slo = read_log_all(path)
     return ApplicationInfo(path, "eventlog", header or {},
                            [_query_from_record(r) for r in recs],
-                           telemetry=telemetry)
+                           telemetry=telemetry, slo=slo)
 
 
 # ------------------------------------------------------------------ #
@@ -760,8 +813,41 @@ for _id, _sev, _fn in (
     register_health_rule(_id, _sev, _fn)
 
 
+def _hc016_slo_breaches(app: ApplicationInfo) -> list[HealthFinding]:
+    """HC016: SLO budget breach — the obs watchdog (obs/slo.py)
+    recorded a tenant's rolling percentile over its
+    spark.rapids.tpu.obs.slo.* budget during this run.  Unlike
+    HC001-HC015 this rule reads the run-level ``slo`` records, not a
+    QueryRecord: one finding per (tenant, metric) pair summarizing the
+    worst observed value, so a sustained breach doesn't flood the
+    report with one line per watchdog tick (docs/ops_plane.md)."""
+    worst: dict[tuple[str, str], dict] = {}
+    count: dict[tuple[str, str], int] = {}
+    for rec in app.slo:
+        key = (rec.get("tenant") or "", rec.get("metric") or "")
+        count[key] = count.get(key, 0) + 1
+        prev = worst.get(key)
+        if prev is None or rec.get("observed_ms", 0.0) \
+                > prev.get("observed_ms", 0.0):
+            worst[key] = rec
+    out = []
+    for (tenant, metric), rec in sorted(worst.items()):
+        n = count[(tenant, metric)]
+        out.append(HealthFinding(
+            "HC016", "warning", f"tenant:{tenant or 'default'}",
+            f"SLO breach: {metric} reached "
+            f"{rec.get('observed_ms', 0.0):.0f}ms against a "
+            f"{rec.get('budget_ms', 0.0):.0f}ms budget "
+            f"({n} breach record(s) over a "
+            f"{rec.get('window', 0)}-observation window) — "
+            "the tenant ran over its obs.slo.* budget "
+            "(docs/ops_plane.md)"))
+    return out
+
+
 def health_check(app: ApplicationInfo) -> list[HealthFinding]:
-    """Run every registered rule over every query of one run."""
+    """Run every registered rule over every query of one run, plus
+    the run-level rules (HC016, fed from the SLO breach records)."""
     out: list[HealthFinding] = []
     for q in app.queries:
         for rule_id, severity, check in HEALTH_RULES:
@@ -769,6 +855,7 @@ def health_check(app: ApplicationInfo) -> list[HealthFinding]:
             if msg is not None:
                 out.append(HealthFinding(rule_id, severity,
                                          _query_label(q), msg))
+    out.extend(_hc016_slo_breaches(app))
     return out
 
 

@@ -391,6 +391,11 @@ def run_sweep(query_ids=None, scale: float = 1.0, seed: int = 7,
                         and v["status"] != "oracle_error"),
         "correct": counts.get("correct", 0),
         "by_status": counts,
+        # summed per-query wall (each verdict's wall_ms covers its
+        # parse->oracle chain): the round-over-round perf trend that
+        # `tools/history compare SWEEP_r01.json SWEEP_r02.json` diffs
+        "wall_ms": round(sum(v.get("wall_ms", 0.0)
+                             for v in results.values()), 1),
     }
     taxonomy: dict = {}
     for v in results.values():

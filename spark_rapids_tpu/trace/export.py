@@ -61,6 +61,35 @@ def export_chrome_trace(path: str,
     return path
 
 
+def merge_wire_trace(doc: dict, client_spans: Sequence[dict],
+                     label: str = "connect-client") -> dict:
+    """Fold a connect client's wire spans (plain dicts —
+    ``ConnectClient.trace_spans``, engine-free by design) into a
+    :func:`chrome_trace` document IN PLACE and return it: both sides
+    stamp ``perf_counter_ns``, so for an in-process loopback (the
+    tests' and bench's shape) client send/first-byte/last-byte spans
+    and the server's trace_id-tagged engine spans land on ONE
+    timeline.  Client spans get their own named thread track.  For a
+    genuinely remote client the clocks are unrelated — align
+    externally before merging (docs/ops_plane.md)."""
+    events = doc.setdefault("traceEvents", [])
+    if not client_spans:
+        return doc
+    pid = os.getpid()
+    tid = max((e.get("tid", 0) for e in events
+               if isinstance(e.get("tid", 0), int)), default=0) + 1
+    events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                   "tid": tid, "args": {"name": label}})
+    for sp in client_spans:
+        events.append({
+            "name": sp["name"], "ph": sp.get("ph", "X"), "pid": pid,
+            "tid": tid, "ts": sp["ts_ns"] / 1e3,
+            "dur": sp.get("dur_ns", 0) / 1e3, "cat": "wire",
+            "args": dict(sp.get("attrs") or {}),
+        })
+    return doc
+
+
 def _union_ns(intervals: list[tuple[int, int]]) -> int:
     intervals.sort()
     total = 0

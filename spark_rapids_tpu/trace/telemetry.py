@@ -114,7 +114,18 @@ def sample_now() -> dict:
         "cancel.active": _cancel.active_count(),
         "pipeline.stage_threads": live_stage_threads(),
         "scan.inflight": _ws.SCAN_REGISTRY.inflight(),
+        # the ops-plane surfaces (docs/ops_plane.md): live registered
+        # queries (0 whenever the obs plane is off — REGISTRY.count()
+        # is a plain len, no lock, no conf read) and the shared
+        # result-cache residency the /metrics scrape reports
+        "queries.in_flight": _obs_inflight(),
+        "result_cache.bytes": _ws.RESULT_CACHE.bytes_used(),
     }
+
+
+def _obs_inflight() -> int:
+    from spark_rapids_tpu.obs import REGISTRY
+    return REGISTRY.count()
 
 
 #: Chrome counter TRACKS: one ph="C" event per track per sample, the
@@ -128,6 +139,9 @@ _COUNTER_TRACKS = (
                              ("waiting", "admission.waiting"))),
     ("telemetry.pipeline_occupancy",
      (("occupancy", "pipeline.occupancy"),)),
+    ("telemetry.queries", (("in_flight", "queries.in_flight"),)),
+    ("telemetry.result_cache_bytes",
+     (("bytes", "result_cache.bytes"),)),
 )
 
 

@@ -16,7 +16,14 @@ docs/connect.md):
   server (the SRC014 clamp contract);
 - two tenants over two sockets share the process-wide result cache;
 - the per-query event-log record carries the `connect` section
-  (peer, wire_bytes, translate_ms);
+  (peer, wire_bytes, translate_ms) — INCLUDING queue-shed
+  deadline_exceeded records (the facts are deposited before the
+  shed outcome is logged);
+- wire trace propagation (docs/ops_plane.md): a client-minted trace
+  id rides the request frame, every server-side span of that query
+  carries it, and trace/export.merge_wire_trace folds the client's
+  send/first-byte/last-byte spans onto the SAME Chrome-trace
+  timeline;
 - the tier-1 hook for tools/bench_smoke.run_connect_smoke.
 """
 
@@ -262,6 +269,39 @@ def test_wire_deadline_sheds_in_queue_zero_device_work(tmp_path):
     assert rec["engine"] == "deadline_exceeded"
 
 
+def test_queue_shed_record_keeps_connect_section(tmp_path):
+    """Regression: a wire query shed IN THE ADMISSION QUEUE
+    (deadline_exceeded before admit) must still record its `connect`
+    section.  The facts are deposited into the serving context only
+    after admission on the happy path, so the shed path used to drop
+    peer/wire_bytes from the event-log record — the cancelled-outcome
+    recorder now deposits them itself before logging."""
+    conf = TpuConf({
+        "spark.rapids.tpu.serving.maxConcurrent": 1,
+        "spark.rapids.tpu.eventLog.enabled": True,
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+    })
+    srv = _server(conf=conf)
+    sched = scheduler_mod.get_scheduler(conf)
+    hog = sched.admit("hog")  # occupy the only admission slot
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port, tenant="shed") as cli:
+            with pytest.raises(ConnectError) as ei:
+                cli.execute_sql(SQL, deadline_ms=40.0)
+        assert ei.value.kind == "deadline_exceeded"
+    finally:
+        sched.release(hog)
+        srv.shutdown()
+    rec = _wait_for_record(tmp_path, "deadline_exceeded")
+    conn = rec.get("connect")
+    assert conn is not None, \
+        "queue-shed record dropped its connect section"
+    assert conn["peer"].startswith("127.0.0.1:")
+    assert conn["wire_bytes"] > 0
+    assert conn["translate_ms"] >= 0
+
+
 def _wait_for_record(log_dir, engine: str, timeout=10.0):
     from spark_rapids_tpu.eventlog.reader import iter_records
 
@@ -397,6 +437,88 @@ def test_two_tenants_two_sockets_share_result_cache():
         assert s1["result_hits"] - s0["result_hits"] >= 1, (
             "second tenant's wire query did not hit the shared "
             f"result cache: {s0} -> {s1}")
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Wire trace propagation (docs/ops_plane.md)
+# ------------------------------------------------------------------ #
+
+
+def test_wire_trace_propagates_and_merges_one_timeline():
+    """THE trace-propagation acceptance test: a wire query submitted
+    with a client-minted trace id produces server-side spans tagged
+    with that exact id, and merge_wire_trace folds the client's
+    send/first-byte/last-byte spans into the same Chrome-trace
+    document — both sides stamp perf_counter_ns, so for this
+    in-process loopback every tagged server span lands INSIDE the
+    client's wire window on one timeline."""
+    from spark_rapids_tpu import trace as _trace
+    from spark_rapids_tpu.trace.export import (
+        chrome_trace,
+        merge_wire_trace,
+    )
+
+    srv = _server(table=_table(n=2000))
+    _trace.enable()
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port, tenant="traced",
+                           trace=True) as cli:
+            got = cli.execute_sql(SQL, batch_rows=256)
+        assert got.num_rows > 0
+        # the client minted one 16-hex id and recorded its wire spans
+        assert cli.trace_id and len(cli.trace_id) == 16
+        assert [s["name"] for s in cli.trace_spans] == [
+            "connect.client.send", "connect.client.first_byte",
+            "connect.client.last_byte"]
+        assert all(s["attrs"]["trace_id"] == cli.trace_id
+                   for s in cli.trace_spans)
+        # server-side spans of the query carry the INBOUND id — the
+        # correlation context survives the drain loop's per-pull
+        # re-attach and the pipeline threads
+        tagged = [e for e in _trace.snapshot()
+                  if e.attrs.get("trace_id") == cli.trace_id]
+        assert tagged, "no server span carries the client trace id"
+        assert any(e.name == "query.execute" for e in tagged)
+        # one timeline: every tagged server span starts inside the
+        # client's send..last_byte window (shared clock in-process)
+        send = cli.trace_spans[0]
+        last = cli.trace_spans[-1]
+        lo = send["ts_ns"]
+        hi = last["ts_ns"] + last["dur_ns"]
+        for e in tagged:
+            assert lo <= e.ts_ns <= hi, (e.name, e.ts_ns, lo, hi)
+        # merged export: both sides in ONE document, client spans on
+        # their own named track
+        doc = merge_wire_trace(chrome_trace(tagged), cli.trace_spans)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "connect.client.send" in names
+        assert "connect.client.last_byte" in names
+        assert "query.execute" in names
+        assert any(e.get("ph") == "M"
+                   and e.get("args", {}).get("name") ==
+                   "connect-client"
+                   for e in doc["traceEvents"])
+        json.dumps(doc)  # serializable whole
+    finally:
+        _trace.disable()
+        _trace.clear()
+        srv.shutdown()
+
+
+def test_wire_trace_off_by_default():
+    """Without trace=True no trace field is minted and no span is
+    recorded — the wire contract is unchanged for existing clients."""
+    srv = _server(table=_table(n=200))
+    try:
+        host, port = srv.address
+        with ConnectClient(host, port) as cli:
+            out = cli.execute_sql("select count(*) as n from t")
+        assert out.column("n")[0].as_py() == 200
+        assert cli.trace_id is None
+        assert cli.trace_spans == []
     finally:
         srv.shutdown()
 

@@ -454,6 +454,9 @@ def test_telemetry_counter_tracks_export_to_chrome_trace():
     names = {e["name"] for e in counters}
     assert "telemetry.store_bytes" in names
     assert "telemetry.admission" in names
+    # the ops-plane gauges ride the same counter-track export
+    assert "telemetry.queries" in names
+    assert "telemetry.result_cache_bytes" in names
     for e in counters:
         assert "dur" not in e and "s" not in e
         assert all(isinstance(v, (int, float))
@@ -522,6 +525,8 @@ def test_telemetry_sampler_leakfree_under_concurrent_sessions(
             if r["type"] == "telemetry":
                 assert "store.device_bytes" in r["counters"]
                 assert "admission.waiting" in r["counters"]
+                assert "queries.in_flight" in r["counters"]
+                assert "result_cache.bytes" in r["counters"]
     assert telem_total > 0, "no telemetry records landed in any log"
 
 
@@ -552,3 +557,4 @@ def test_telemetry_history_roundtrip(tmp_path):
     assert len(app.queries) == 1
     assert app.telemetry, "history dropped the telemetry records"
     assert "pipeline.occupancy" in app.telemetry[0]["counters"]
+    assert "queries.in_flight" in app.telemetry[0]["counters"]
