@@ -143,6 +143,15 @@ forward across successive scrapes, and — because the serial reference
 digests were computed with the plane off — the existing digest gate
 doubles as the zero-impact proof: obs on vs off is bit-identical.
 The round emits `obs_scrapes` / `obs_scrape_monotone`.
+
+`bench.py --cold-start N` switches to the COLD-START bench
+(docs/warm_start.md): after two unmeasured populate/prime children
+fill one persist directory, N fresh subprocesses run the fusion-smoke
+query against the WARM directory and N against EMPTY ones, emitting
+`warm_cold_p50_ms` / `warm_cold_p99_ms` / `warm_cold_jit_misses` /
+`warm_persist_hit_rate` and the `empty_*` mirror, a bit-identical
+digest gate across every child, and `cold_p50_speedup` — the wall a
+process restart re-pays with and without the warm-start cache.
 """
 
 import json
@@ -1714,6 +1723,52 @@ def _float_flag(name: str) -> float:
     return _flag_operand(name, float)
 
 
+def _bench_cold_start(n: int) -> dict:
+    """bench.py --cold-start N: the restart-cost artifact
+    (docs/warm_start.md).  Two unmeasured children populate + prime
+    one persist directory, then N measured WARM children run against
+    it and N EMPTY children against fresh directories — cold wall
+    p50/p99, jit misses, compile counts and persist hit rate both
+    ways, a digest gate across every child, and the p50 speedup the
+    warm-start cache buys a restarted fleet."""
+    from spark_rapids_tpu.tools import cold_start as cs
+
+    data = tempfile.mkdtemp(prefix="tpu-coldstart-data-")
+    warm_dir = tempfile.mkdtemp(prefix="tpu-coldstart-warm-")
+    cs.make_fixture(data)
+    for _ in range(2):  # populate the program store, prime XLA cache
+        cs.run_subprocess(data, warm_dir)
+    warm = [cs.run_subprocess(data, warm_dir) for _ in range(n)]
+    empty = [cs.run_subprocess(
+        data, tempfile.mkdtemp(prefix="tpu-coldstart-empty-"))
+        for _ in range(n)]
+
+    def fold(runs, label):
+        walls = sorted(r["wall_ms"] for r in runs)
+        return {
+            f"{label}_cold_p50_ms": round(
+                statistics.median(walls), 3),
+            f"{label}_cold_p99_ms": round(
+                walls[min(len(walls) - 1,
+                          int(0.99 * len(walls)))], 3),
+            f"{label}_cold_jit_misses": max(
+                r["jit_misses"] for r in runs),
+            f"{label}_compiles": max(r["compiles"] for r in runs),
+            f"{label}_persist_hit_rate": min(
+                r["persist"]["hit_rate"] for r in runs),
+        }
+
+    digests = {r["digest"] for r in warm} | {r["digest"] for r in empty}
+    out = {"metric": "cold_start_bench", "children": n,
+           "digest_ok": len(digests) == 1}
+    out.update(fold(warm, "warm"))
+    out.update(fold(empty, "empty"))
+    if out["warm_cold_p50_ms"]:
+        out["cold_p50_speedup"] = round(
+            out["empty_cold_p50_ms"] / out["warm_cold_p50_ms"], 2)
+    return out
+
+
 def _bench_multichip(n_devices: int) -> dict:
     """The MULTICHIP round: run dryrun_multichip on the virtual
     N-device CPU mesh with stderr captured at the fd level (XLA's AOT
@@ -1782,6 +1837,12 @@ def main() -> None:
         # single-session q6/q1/q3/q67 rounds are the plain invocation)
         tenants = _int_flag("--tenants") or min(2, sessions)
         print(json.dumps(_bench_serving(sessions, tenants)))
+        return
+    cold = _int_flag("--cold-start")
+    if cold:
+        # cold-start mode: fresh subprocesses only — this parent
+        # process must not touch jax before forking the children
+        print(json.dumps(_bench_cold_start(cold)))
         return
     # wire compression rides every bench round by default (the lever
     # for the upload-bound milestones; correctness gates stay on, and

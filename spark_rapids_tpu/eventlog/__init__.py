@@ -76,7 +76,12 @@ _SESSION_SEQ = itertools.count()
 #: counter keys that are MONOTONIC cumulative process totals — the
 #: writer records per-query deltas of exactly these
 MONOTONIC_COUNTERS = (
-    "jit.hits", "jit.misses",
+    "jit.hits", "jit.misses", "jit.compiles",
+    "persist.hits", "persist.misses", "persist.writes",
+    "persist.evictions", "persist.errors",
+    "persist.plan_hits", "persist.result_hits",
+    "persist.fallback_compiles",
+    "persist.deserialize_ms", "persist.serialize_ms",
     "retry.splits", "retry.spill_retries", "retry.task_retries",
     "retry.cpu_fallbacks",
     "faults.injected", "faults.recovered",
@@ -113,6 +118,23 @@ def counters_snapshot() -> dict[str, float]:
     jc = cache_stats()
     out["jit.hits"] = jc["hits"]
     out["jit.misses"] = jc["misses"]
+    out["jit.compiles"] = jc["compiles"]
+    from spark_rapids_tpu import persist as _persist
+
+    ps = _persist.stats()
+    out["persist.hits"] = ps["hits"]
+    out["persist.misses"] = ps["misses"]
+    out["persist.writes"] = ps["writes"]
+    out["persist.evictions"] = ps["evictions"]
+    out["persist.errors"] = ps["errors"]
+    out["persist.plan_hits"] = ps["plan_hits"]
+    out["persist.result_hits"] = ps["result_hits"]
+    out["persist.fallback_compiles"] = ps["fallback_compiles"]
+    out["persist.deserialize_ms"] = ps["deserialize_ms"]
+    out["persist.serialize_ms"] = ps["serialize_ms"]
+    # on-disk footprint GAUGE (0 without a dir walk when persistence
+    # never activated in this process)
+    out["persist_cache.bytes"] = _persist.cache_bytes()
     rs = retry_stats()
     out["retry.splits"] = rs["splits"]
     out["retry.spill_retries"] = rs["spill_retries"]

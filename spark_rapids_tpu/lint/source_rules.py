@@ -146,6 +146,20 @@ Rules
   (PreparedQuery.execute_stream → _stream_tpu) so deadline/cancel
   propagation and the per-query ``connect`` record engage
   (docs/connect.md).
+- SRC015 (error): raw executable persistence outside the warm-start
+  module.  Serialized program artifacts (``.serialize()`` products —
+  jax.export blobs) and ``pickle`` writes of engine objects MUST flow
+  through spark_rapids_tpu/persist.py's validated writer (magic +
+  checksummed header + env stamp + temp-file-and-rename atomicity —
+  docs/warm_start.md): a raw ``open().write(blob)`` or
+  ``pickle.dump`` elsewhere produces files with no torn-write
+  protection and no staleness stamp, which a later process would
+  deserialize blind.  Syntactic: ``pickle.dump``/``dumps``/
+  ``Pickler`` calls, and ``.write(x)`` where x is a ``.serialize()``
+  result (directly or through a local).  persist.py IS the writer —
+  exempt by construction — and python_worker/ (the UDF pipe
+  protocol, pickled function frames over stdin, never files) is out
+  of scope.
 """
 
 from __future__ import annotations
@@ -1305,6 +1319,82 @@ class _SwallowChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _PersistWriteChecker(ast.NodeVisitor):
+    """SRC015: raw persistence of serialized executables outside
+    spark_rapids_tpu/persist.py (see Rules).  Taint is local-name
+    based: a name assigned from a ``.serialize()`` call (or from an
+    already-tainted name) is a serialized artifact; any ``.write()``
+    taking it — or taking a ``.serialize()`` call directly — is a raw
+    unvalidated write.  ``pickle.dump``/``dumps``/``Pickler`` are
+    flagged outright (the engine has exactly one blessed pickle
+    surface, the python_worker pipe protocol, which is out of
+    scope)."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+        self._tainted: set[str] = set()
+
+    def _qual(self) -> str:
+        return self._fn_stack[-1] if self._fn_stack else "<module>"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        saved = self._tainted
+        self._tainted = set()
+        self.generic_visit(node)
+        self._tainted = saved
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    @staticmethod
+    def _is_serialize_call(v: ast.expr) -> bool:
+        return isinstance(v, ast.Call) \
+            and _terminal_name(v.func) == "serialize"
+
+    def _is_tainted(self, v: ast.expr) -> bool:
+        if self._is_serialize_call(v):
+            return True
+        return isinstance(v, ast.Name) and v.id in self._tainted
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._is_tainted(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._tainted.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _terminal_name(node.func)
+        if name in ("dump", "dumps", "Pickler") \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "pickle":
+            self.out.append(Diagnostic(
+                "SRC015", "error", f"{self.path}::{self._qual()}",
+                f"raw `pickle.{name}` outside the persist module — "
+                "engine artifacts written to disk must go through "
+                "persist.py's validated writer (magic + checksum + "
+                "env stamp + atomic rename)",
+                hint="route the write through "
+                     "spark_rapids_tpu/persist.py, or keep the data "
+                     "in memory",
+                line=node.lineno))
+        elif name == "write" and node.args \
+                and self._is_tainted(node.args[0]):
+            self.out.append(Diagnostic(
+                "SRC015", "error", f"{self.path}::{self._qual()}",
+                "raw `.write()` of a serialized executable — a file "
+                "written outside persist.py's validated writer has "
+                "no torn-write protection and no staleness stamp",
+                hint="route the artifact through "
+                     "spark_rapids_tpu/persist.py's save_* APIs",
+                line=node.lineno))
+        self.generic_visit(node)
+
+
 def _is_exec_module(path: str) -> bool:
     parts = path.replace("\\", "/").split("/")
     return "execs" in parts
@@ -1362,6 +1452,17 @@ def _is_wait_module(path: str) -> bool:
     return "serving" in parts or "parallel" in parts
 
 
+def _is_persist_scope_module(path: str) -> bool:
+    """SRC015 scope: the whole engine EXCEPT persist.py (it IS the
+    validated writer) and python_worker/ (its pickle use is the UDF
+    pipe protocol — function frames over stdin, never disk files)."""
+    norm = path.replace("\\", "/")
+    if norm.endswith("spark_rapids_tpu/persist.py") \
+            or norm == "persist.py":
+        return False
+    return "python_worker" not in norm.split("/")
+
+
 def _is_recovery_module(path: str) -> bool:
     """SRC008 scope: the layers whose exceptions feed the recovery
     ladder.  execs/retry.py IS the classification gate — exempt."""
@@ -1405,6 +1506,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _CollectiveStepSyncChecker(path, out).run(tree)
     if _is_wire_module(path):
         _WireHandlerChecker(path, out).visit(tree)
+    if _is_persist_scope_module(path):
+        _PersistWriteChecker(path, out).visit(tree)
     return out
 
 

@@ -77,6 +77,16 @@ def _output_fields(exprs: Sequence[Expression]) -> T.Schema:
     return T.Schema([output_field(e, i) for i, e in enumerate(exprs)])
 
 
+#: content_digest() computations since process start — the serving
+#: test's proof that repeated prepare()s of one in-memory table hash
+#: its content once, not once per structural-key build
+_DIGESTS_COMPUTED = 0
+
+
+def digests_computed() -> int:
+    return _DIGESTS_COMPUTED
+
+
 class InMemoryRelation(LogicalPlan):
     """Leaf over a host Arrow table (test sources, fallback boundaries)."""
 
@@ -86,6 +96,22 @@ class InMemoryRelation(LogicalPlan):
         self.children = []
         self.table = table
         self._schema = schema_from_arrow(table.schema)
+        self._content_digest: Optional[str] = None
+
+    def content_digest(self) -> str:
+        """Memoized content digest of the wrapped table, for structural
+        plan keys (serving/plan_cache).  Arrow tables are immutable, so
+        hashing once per RELATION is sound — without the memo every
+        prepare() of a large in-memory table re-hashed its buffers on
+        the serving hot path.  The underscore slot keeps the memo out
+        of the structural key itself."""
+        global _DIGESTS_COMPUTED
+        if self._content_digest is None:
+            from spark_rapids_tpu.eventlog import table_digest
+
+            _DIGESTS_COMPUTED += 1
+            self._content_digest = table_digest(self.table)
+        return self._content_digest
 
     @property
     def schema(self) -> T.Schema:

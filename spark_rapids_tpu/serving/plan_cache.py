@@ -126,13 +126,23 @@ def plan_structural_key(plan, seen: Optional[dict] = None) -> str:
     different subtrees of one class."""
     if seen is None:
         seen = {}
+    import pyarrow as pa
+
     ref = seen.get(id(plan))
     if ref is not None:
         return f"ref:{ref}"
     seen[id(plan)] = len(seen)
+    digester = getattr(plan, "content_digest", None)
     parts = [type(plan).__name__]
     for k, v in sorted(vars(plan).items()):
         if k.startswith("_") and k != "_schema":
+            continue
+        if isinstance(v, pa.Table) and digester is not None:
+            # the node memoizes its own content digest
+            # (InMemoryRelation.content_digest): same digest-keyed
+            # identity as _value_key's table branch, hashed once per
+            # relation instead of once per prepare()
+            parts.append(f"{k}=table:{digester()}")
             continue
         parts.append(f"{k}={_value_key(v, seen)}")
     return f"{parts[0]}[{','.join(parts[1:])}]"
@@ -273,7 +283,8 @@ class CacheEntry:
     one template from two threads must not interleave two drains of one
     tree."""
 
-    __slots__ = ("exec_", "meta", "plan_hash", "df", "lock")
+    __slots__ = ("exec_", "meta", "plan_hash", "df", "lock",
+                 "rehydrated")
 
     def __init__(self, exec_, meta, plan_hash: str, df=None):
         self.exec_ = exec_
@@ -281,6 +292,14 @@ class CacheEntry:
         self.plan_hash = plan_hash
         self.df = df
         self.lock = DrainLock()
+        #: metadata restored from the warm-start disk tier
+        #: (spark_rapids_tpu/persist.py) for this key, when a prior
+        #: process prepared the same template — None otherwise.  The
+        #: lowered exec tree itself is LIVE state (closures, device
+        #: buffers) and is rebuilt, immediately hitting the persisted
+        #: AOT program tier; this slot carries the cross-process
+        #: prepare lineage (docs/warm_start.md).
+        self.rehydrated: Optional[dict] = None
 
 
 class PlanCache:
@@ -295,10 +314,18 @@ class PlanCache:
         # guard: _mu
         self._entries: "collections.OrderedDict[str, CacheEntry]" = \
             collections.OrderedDict()
+        # guard: _mu — persisted-plan metadata restored on a miss,
+        # consumed by the insert() that follows it (prepared._resolve
+        # always inserts after a miss)
+        self._rehydrated: dict[str, dict] = {}
         self._mu = tracked_lock("planCache.mu")
 
     def lookup(self, key: str) -> Optional[CacheEntry]:
-        """Get-and-touch; ticks the global hit/miss counters."""
+        """Get-and-touch; ticks the global hit/miss counters.  An
+        in-memory miss additionally probes the warm-start disk tier
+        (one conf read when persistence is off): a valid persisted
+        entry for this (structural plan key x conf fingerprint) stashes
+        its metadata for the insert() that follows the re-lowering."""
         global _HITS, _MISSES
         with self._mu:
             e = self._entries.get(key)
@@ -309,6 +336,15 @@ class PlanCache:
                 _MISSES += 1
             else:
                 _HITS += 1
+        if e is None:
+            from spark_rapids_tpu import persist as _persist
+
+            store = _persist.active()
+            if store is not None:
+                meta = store.load_plan(key)
+                if meta is not None:
+                    with self._mu:
+                        self._rehydrated[key] = meta
         return e
 
     def insert(self, key: str, entry: CacheEntry) -> CacheEntry:
@@ -323,9 +359,22 @@ class PlanCache:
                 self._entries.move_to_end(key)
                 return cur
             self._entries[key] = entry
+            entry.rehydrated = self._rehydrated.pop(key, None)
             while len(self._entries) > self.capacity:
                 _k, old = self._entries.popitem(last=False)
                 evicted.append(old)
+        from spark_rapids_tpu import persist as _persist
+
+        store = _persist.active()
+        if store is not None:
+            # write-back (async, off the prepare path): next process's
+            # lookup() rehydrates this metadata instead of starting its
+            # prepare lineage from zero
+            prev = int((entry.rehydrated or {}).get("prepares", 0))
+            store.save_plan_async(
+                key, {"plan_hash": entry.plan_hash,
+                      "prepares": prev + 1},
+                _persist.max_bytes())
         if evicted:
             with _STATS_LOCK:
                 _EVICTIONS += len(evicted)

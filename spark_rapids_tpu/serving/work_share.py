@@ -223,6 +223,10 @@ class ResultCache:
             tick("result_invalidations")
             self._close(stale)
         if e is None:
+            tbl = self._restore_persisted(key, digests)
+            if tbl is not None:
+                tick("result_hits")
+                return tbl
             tick("result_misses")
             return None
         try:
@@ -240,6 +244,40 @@ class ResultCache:
             tick("result_misses")
             return None
         tick("result_hits")
+        return tbl
+
+    def _restore_persisted(self, key: str,
+                           digests: list) -> Optional[pa.Table]:
+        """Lazy restore from the warm-start disk tier
+        (spark_rapids_tpu/persist.py) on an in-memory miss — one conf
+        read when persistence is off.  The persisted frame carries its
+        own `plan_source_digests` stat triples; a mismatch against the
+        CURRENT digests (a source file changed since the frame was
+        written) deletes the entry and reads as an honest miss.  A
+        valid restore re-enters the normal in-memory tier via
+        insert(), so it re-registers with the buffer store and ages
+        under the same LRU as a fresh result."""
+        from spark_rapids_tpu import persist as _persist
+
+        store = _persist.active()
+        if store is None:
+            return None
+        rec = store.load_result(key)
+        if rec is None:
+            return None
+        meta, payload = rec
+        if meta.get("digests") != [list(t) for t in digests]:
+            store.delete_result(key)
+            tick("result_invalidations")
+            return None
+        try:
+            tbl = _ipc_table(payload)
+        except Exception:
+            store.delete_result(key)
+            _persist.tick("errors")
+            return None
+        _persist.tick("result_hits")
+        self.insert(key, digests, tbl)
         return tbl
 
     def insert(self, key: str, digests: list, tbl: pa.Table) -> bool:
@@ -293,6 +331,18 @@ class ResultCache:
         for old in evicted:
             tick("result_evictions")
             self._close(old)
+        from spark_rapids_tpu import persist as _persist
+
+        store = _persist.active()
+        if store is not None:
+            # reuse the IPC frame already computed for the store
+            # registration; the write itself runs on the persist
+            # writer thread, off the collect critical path, and a
+            # restore-triggered re-insert skips it (file exists)
+            store.save_result_async(
+                key, {"digests": [list(t) for t in digests],
+                      "rows": tbl.num_rows},
+                buf, _persist.max_bytes())
         tick("result_inserts")
         return True
 

@@ -847,6 +847,82 @@ def run_fusion_smoke() -> dict:
     return out
 
 
+def run_warm_start_smoke() -> dict:
+    """Warm-start acceptance contract, cheap CI form (tier-1 via
+    tests/test_persist.py, docs/warm_start.md): one child process
+    populates a persist directory with the fusion-smoke query's AOT
+    programs, then a second FRESH child runs the same query against
+    the warm directory and must
+
+    - compile NOTHING: the jit cache's `compiles` counter stays 0 in
+      the child (restored programs dispatch deserialized jax.export
+      artifacts; the counter bumps only at a fresh wrapper's first
+      real invocation);
+    - restore from disk: `persist.hits` > 0;
+    - agree bit-for-bit: the child's digest equals both the
+      populating child's and an in-process reference run with
+      persistence OFF;
+    - keep ledger attribution: the warm child's dispatch count equals
+      the populating child's (restored programs still meter)."""
+    import os
+    import tempfile
+
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.execs.base import _budget_conf, _fusion_conf
+    from spark_rapids_tpu.tools import cold_start as cs
+    from spark_rapids_tpu.trace import ledger
+
+    # force-register lazily-registered confs BEFORE the snapshot (the
+    # fusion smoke's save/restore caveat applies here too)
+    _fusion_conf()
+    _budget_conf()
+    conf = get_conf()
+    keys = ("spark.rapids.tpu.sql.pipeline.enabled",
+            "spark.rapids.tpu.sql.speculation.enabled",
+            "spark.rapids.tpu.sql.batchSizeRows",
+            "spark.rapids.tpu.sql.shuffle.partitions",
+            "spark.rapids.tpu.sql.fusion.enabled",
+            "spark.rapids.tpu.sql.fusion.donation.enabled")
+    saved = {k: conf.get(k) for k in keys}
+    ledger_was_on = ledger.LEDGER.enabled
+    with tempfile.TemporaryDirectory(prefix="warm_smoke_") as d:
+        data = os.path.join(d, "data")
+        warm = os.path.join(d, "persist")
+        os.makedirs(data)
+        os.makedirs(warm)
+        cs.make_fixture(data)
+        try:
+            ledger.reset_stats()
+            ref = cs.run_once(data, None)  # in-process, persist OFF
+        finally:
+            for k, v in saved.items():
+                conf.set(k, v)
+            ledger.reset_stats()
+            if not ledger_was_on:
+                ledger.disable()
+        populate = cs.run_subprocess(data, warm)
+        child = cs.run_subprocess(data, warm)
+    assert child["compiles"] == 0, (
+        f"warm child compiled {child['compiles']} programs; a warm "
+        "disk cache must restore every invoked program")
+    assert child["persist"]["hits"] > 0, (
+        "warm child restored nothing from the persist directory")
+    assert child["digest"] == populate["digest"] == ref["digest"], (
+        f"digest drift across persist modes: in-process "
+        f"{ref['digest']}, populate {populate['digest']}, warm child "
+        f"{child['digest']}")
+    assert child["dispatches"] == populate["dispatches"], (
+        f"restored programs lost ledger attribution: warm child "
+        f"dispatched {child['dispatches']} vs populate "
+        f"{populate['dispatches']}")
+    return {
+        "warm_start_child_compiles": child["compiles"],
+        "warm_start_persist_hits": child["persist"]["hits"],
+        "warm_start_dispatches": child["dispatches"],
+        "warm_start_digest_ok": True,
+    }
+
+
 def run_coalesce_smoke() -> dict:
     """Batch-coalescing acceptance contract, cheap CI form (tier-1 via
     tests/test_coalesce.py, docs/occupancy.md): many tiny cached
@@ -1249,6 +1325,7 @@ def main() -> int:
     results.update(run_ledger_smoke())
     results.update(run_wire_codec_smoke())
     results.update(run_fusion_smoke())
+    results.update(run_warm_start_smoke())
     results.update(run_coalesce_smoke())
     results.update(run_connect_smoke())
     results.update(run_ops_smoke())

@@ -794,6 +794,36 @@ def _hc_pad_waste(q: QueryRecord) -> Optional[str]:
     return None
 
 
+def _hc_persist_low_hit(q: QueryRecord) -> Optional[str]:
+    """HC017: cold process, warm disk cache, but the warm-start
+    program store mostly missed — this query's window probed the
+    persist tier (persist.hits + persist.misses > 0), still paid real
+    XLA compiles (jit.compiles > 0), and its persist hit rate sat
+    under spark.rapids.tpu.persist.health.minHitRate.  The serialized
+    artifacts did not match this process: stale entries (jax/jaxlib
+    upgrade, different device fingerprint, conf drift splitting the
+    fingerprint) or a wrong persist.dir (docs/warm_start.md).
+    Persist-off fleets carry no persist.* deltas and stay silent."""
+    hits = q.counter("persist.hits")
+    misses = q.counter("persist.misses")
+    window = hits + misses
+    compiles = q.counter("jit.compiles")
+    if window <= 0 or compiles <= 0:
+        return None
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.persist import PERSIST_MIN_HIT_RATE
+
+    floor = float(get_conf().get(PERSIST_MIN_HIT_RATE))
+    rate = hits / window
+    if rate < floor:
+        return (f"warm-start cache mostly missed: persist hit rate "
+                f"{rate:.2f} (< {floor}) with {int(compiles)} real "
+                "compile(s) in this window — disk entries are stale "
+                "(jax/device/conf drift) or persist.dir is wrong "
+                "(docs/warm_start.md)")
+    return None
+
+
 for _id, _sev, _fn in (
         ("HC001", "error", _hc_cpu_fallback),
         ("HC002", "warning", _hc_retry_storm),
@@ -809,7 +839,8 @@ for _id, _sev, _fn in (
         ("HC012", "warning", _hc_result_cache_thrash),
         ("HC013", "warning", _hc_cancellation_leak),
         ("HC014", "warning", _hc_lock_hold),
-        ("HC015", "warning", _hc_pad_waste)):
+        ("HC015", "warning", _hc_pad_waste),
+        ("HC017", "warning", _hc_persist_low_hit)):
     register_health_rule(_id, _sev, _fn)
 
 

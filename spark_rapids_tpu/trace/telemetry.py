@@ -120,12 +120,20 @@ def sample_now() -> dict:
         # result-cache residency the /metrics scrape reports
         "queries.in_flight": _obs_inflight(),
         "result_cache.bytes": _ws.RESULT_CACHE.bytes_used(),
+        # warm-start disk-cache footprint (docs/warm_start.md): 0 with
+        # no dir walk when persistence never activated in this process
+        "persist_cache.bytes": _persist_bytes(),
     }
 
 
 def _obs_inflight() -> int:
     from spark_rapids_tpu.obs import REGISTRY
     return REGISTRY.count()
+
+
+def _persist_bytes() -> int:
+    from spark_rapids_tpu.persist import cache_bytes
+    return cache_bytes()
 
 
 #: Chrome counter TRACKS: one ph="C" event per track per sample, the
@@ -142,6 +150,8 @@ _COUNTER_TRACKS = (
     ("telemetry.queries", (("in_flight", "queries.in_flight"),)),
     ("telemetry.result_cache_bytes",
      (("bytes", "result_cache.bytes"),)),
+    ("telemetry.persist_cache_bytes",
+     (("bytes", "persist_cache.bytes"),)),
 )
 
 

@@ -602,6 +602,70 @@ def test_source_lint_raw_jit_rule_scoped_and_exempt():
             lint_source_text(_RAW_JIT_FIXTURE, path)), path
 
 
+_RAW_PERSIST_FIXTURE = """
+import pickle
+
+from spark_rapids_tpu import persist
+
+
+def leak_program(exported, path):
+    blob = exported.serialize()
+    with open(path, "wb") as f:
+        f.write(blob)                        # SRC015: raw blob write
+
+
+def leak_direct(exported, f):
+    f.write(exported.serialize())            # SRC015: direct form
+
+
+def leak_pickle(batch, path):
+    with open(path, "wb") as f:
+        pickle.dump(batch, f)                # SRC015: raw pickle
+    return pickle.dumps(batch)               # SRC015: raw pickle
+
+
+def blessed(store, key, conf_fp, sig, fn, avals, budget):
+    # the validated writer is the only sanctioned path
+    store.save_program_async(key, conf_fp, sig, fn, avals, budget)
+
+
+def harmless(log, line):
+    log.write(line)                          # untainted .write is fine
+"""
+
+
+def test_source_lint_flags_raw_executable_persistence():
+    """SRC015: `.write()` of a `.serialize()` product (direct or via a
+    local) and `pickle.dump/dumps` outside persist.py are ERRORS — a
+    raw file has no magic/checksum/env-stamp/atomic-rename protection
+    and a later process would deserialize it blind
+    (docs/warm_start.md)."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/serving/fake.py",
+                 "spark_rapids_tpu/tools/fake.py"):
+        diags = lint_source_text(_RAW_PERSIST_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC015"]
+        assert len(hits) == 4, (path, diags)
+        assert all(h.severity == "error" for h in hits)
+        locs = " ".join(h.location for h in hits)
+        assert "leak_program" in locs and "leak_direct" in locs \
+            and "leak_pickle" in locs
+        assert "blessed" not in locs and "harmless" not in locs
+    # an ERROR fails even the non-strict repo gate
+    assert evaluate(lint_source_text(
+        _RAW_PERSIST_FIXTURE, "spark_rapids_tpu/execs/fake.py"))[2] != 0
+
+
+def test_source_lint_persist_rule_scoped_and_exempt():
+    """SRC015 exempts persist.py itself (it IS the validated writer)
+    and python_worker/ (pipe-protocol pickle, never disk files)."""
+    for path in ("spark_rapids_tpu/persist.py",
+                 "persist.py",
+                 "spark_rapids_tpu/python_worker/worker.py"):
+        assert "SRC015" not in rules(
+            lint_source_text(_RAW_PERSIST_FIXTURE, path)), path
+
+
 _DONATE_FIXTURE = """
 from spark_rapids_tpu.columnar.transfer import run_consuming
 from spark_rapids_tpu.execs.jit_cache import cached_jit

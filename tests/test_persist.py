@@ -1,0 +1,503 @@
+"""Warm-start persistence (spark_rapids_tpu/persist.py,
+docs/warm_start.md): the disabled-path cost contract, the AOT program
+tier's compile-free restore (including THE cross-process acceptance
+test: a fresh subprocess against a warm disk cache executes the
+fusion-smoke query with zero XLA compilations and bit-identical
+digests), the disk-cache poisoning matrix (every corrupt/stale entry
+an honest miss), persisted plan metadata and result frames, LRU
+eviction, the persist.* event-log counter surface and the HC017
+health rule."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import persist as P
+from spark_rapids_tpu.config import get_conf
+from spark_rapids_tpu.execs import jit_cache as JC
+from spark_rapids_tpu.execs.jit_cache import cached_jit
+
+ENABLED = "spark.rapids.tpu.persist.enabled"
+DIR = "spark.rapids.tpu.persist.dir"
+MAX_BYTES = "spark.rapids.tpu.persist.maxBytes"
+XLA = "spark.rapids.tpu.persist.xlaCache.enabled"
+MIN_HIT = "spark.rapids.tpu.persist.health.minHitRate"
+
+_KEYS = (ENABLED, DIR, MAX_BYTES, XLA, MIN_HIT)
+
+
+@pytest.fixture(autouse=True)
+def _persist_sandbox():
+    """Every test starts with persistence OFF, no activated stores,
+    zeroed counters — and leaves the process the same way (the XLA
+    compilation-cache config the suite's conftest pins is restored by
+    reset_for_tests)."""
+    conf = get_conf()
+    saved = {k: conf.get(k) for k in _KEYS}
+    P.reset_for_tests()
+    JC.reset_cache_stats()
+    yield
+    P.reset_for_tests()
+    JC.reset_cache_stats()
+    for k, v in saved.items():
+        conf.set(k, v)
+
+
+def _enable(tmp_path, xla=False) -> str:
+    root = str(tmp_path / "store")
+    conf = get_conf()
+    conf.set(ENABLED, True)
+    conf.set(DIR, root)
+    conf.set(XLA, xla)
+    return root
+
+
+def _forget_key(key) -> None:
+    """Simulate a process restart for ONE structural key: drop the
+    in-process wrapper, keep the disk."""
+    with JC._LOCK:
+        JC._CACHE.pop(key, None)
+
+
+def _program_files(root: str) -> list:
+    d = os.path.join(root, "programs")
+    return sorted(os.path.join(d, n) for n in os.listdir(d)
+                  if n.endswith(P._SUFFIX))
+
+
+# -- cost contract ------------------------------------------------------ #
+
+def test_disabled_is_one_conf_read(monkeypatch):
+    """persist.enabled=false: active() performs exactly ONE conf read
+    and returns None — no store object, no directory, no thread."""
+    conf = get_conf()
+    reads = []
+    orig = type(conf).get
+
+    def counting(self, entry_or_key, default=None):
+        reads.append(entry_or_key)
+        return orig(self, entry_or_key, default)
+
+    monkeypatch.setattr(type(conf), "get", counting)
+    assert P.active(conf) is None
+    assert len(reads) == 1
+    key = reads[0]
+    assert getattr(key, "key", key) == ENABLED
+
+
+def test_disabled_compile_path_untouched(tmp_path):
+    """With persistence off, cached_jit compiles exactly as ever and
+    writes nothing anywhere."""
+    import jax.numpy as jnp
+
+    key = ("persist_test", "off_path")
+    fn = cached_jit(key, lambda: (lambda x: x + 1))
+    out = np.asarray(fn(jnp.arange(4, dtype=jnp.int32)))
+    np.testing.assert_array_equal(out, [1, 2, 3, 4])
+    assert P.stats()["writes"] == 0
+    assert not (tmp_path / "store").exists()
+    _forget_key(key)
+
+
+# -- the AOT program tier ----------------------------------------------- #
+
+def test_program_roundtrip_restores_without_compiling(tmp_path):
+    """Compile -> async export -> 'restart' -> restore: the restored
+    program answers bit-identically with ZERO compiles, and an UNSEEN
+    argument signature falls back to one honest counted compile that
+    auto-saves for the next restart."""
+    import jax.numpy as jnp
+
+    root = _enable(tmp_path)
+    key = ("persist_test", "affine")
+
+    def make():
+        return lambda x: x * 2 + 1
+
+    x8 = jnp.arange(8, dtype=jnp.int32)
+    want = np.asarray(cached_jit(key, make)(x8))
+    assert P.flush(30.0)
+    assert P.stats()["writes"] == 1
+    assert len(_program_files(root)) == 1
+
+    _forget_key(key)
+    JC.reset_cache_stats()
+    P.reset_stats()
+    fn2 = cached_jit(key, make)
+    np.testing.assert_array_equal(np.asarray(fn2(x8)), want)
+    st, ps = JC.cache_stats(), P.stats()
+    assert st["compiles"] == 0, st
+    assert ps["hits"] == 1 and ps["fallback_compiles"] == 0, ps
+
+    # unseen signature: honest fallback, counted, auto-saved
+    x16 = jnp.arange(16, dtype=jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(fn2(x16)), np.arange(16) * 2 + 1)
+    assert JC.cache_stats()["compiles"] == 1
+    assert P.stats()["fallback_compiles"] == 1
+    assert P.flush(30.0)
+    assert len(_program_files(root)) == 2
+
+    # second restart: BOTH signatures restore compile-free
+    _forget_key(key)
+    JC.reset_cache_stats()
+    P.reset_stats()
+    fn3 = cached_jit(key, make)
+    fn3(x8)
+    fn3(x16)
+    assert JC.cache_stats()["compiles"] == 0
+    assert P.stats()["hits"] == 2
+    _forget_key(key)
+
+
+def test_compiles_counter_is_first_invocation():
+    """The `compiles` counter bumps at a fresh wrapper's first REAL
+    call, never at creation: a speculatively minted wrapper that is
+    never dispatched compiles nothing (jax.jit is lazy) and must not
+    read as a compile — the warm-start smoke's zero-compiles assert
+    depends on exactly this."""
+    import jax.numpy as jnp
+
+    JC.reset_cache_stats()
+    key = ("persist_test", "never_called")
+    cached_jit(key, lambda: (lambda x: x - 1))
+    assert JC.cache_stats()["compiles"] == 0  # minted, not invoked
+    assert JC.cache_stats()["misses"] == 1
+    key2 = ("persist_test", "called_once")
+    fn = cached_jit(key2, lambda: (lambda x: x - 1))
+    fn(jnp.arange(4, dtype=jnp.int32))
+    fn(jnp.arange(4, dtype=jnp.int32))
+    assert JC.cache_stats()["compiles"] == 1  # latched once
+    for k in (key, key2):
+        _forget_key(k)
+
+
+# -- the poisoning matrix ----------------------------------------------- #
+
+def _poison_truncate(path: str) -> None:
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:-16])
+
+
+def _poison_stamp(field: str):
+    def poison(path: str) -> None:
+        blob = open(path, "rb").read()
+        rest = blob[len(P._MAGIC):]
+        nl = rest.index(b"\n")
+        header = json.loads(rest[:nl])
+        header["stamp"][field] = "poisoned-0.0.0"
+        with open(path, "wb") as f:
+            f.write(P._MAGIC + json.dumps(header).encode() + b"\n"
+                    + rest[nl + 1:])
+    return poison
+
+
+def _poison_magic(path: str) -> None:
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(b"X" + blob[1:])
+
+
+@pytest.mark.parametrize("poison", [
+    _poison_truncate,            # torn write survivor
+    _poison_stamp("jax"),        # jax version drift
+    _poison_stamp("device"),     # different device fingerprint
+    _poison_magic,               # foreign/garbage file
+], ids=["truncated", "jax_stamp", "device_stamp", "magic"])
+def test_poisoned_program_entries_are_honest_misses(tmp_path, poison):
+    """Every corrupt/stale program entry reads as an honest miss —
+    deleted, counted under persist.errors/misses, the query recompiled
+    and bit-identical to a no-persist run.  Never a wrong answer."""
+    import jax.numpy as jnp
+
+    root = _enable(tmp_path)
+    key = ("persist_test", "poisoned")
+    make = lambda: (lambda x: x * 3)  # noqa: E731
+    x = jnp.arange(8, dtype=jnp.int32)
+    want = np.asarray(cached_jit(key, make)(x))
+    assert P.flush(30.0)
+    (path,) = _program_files(root)
+    poison(path)
+
+    _forget_key(key)
+    JC.reset_cache_stats()
+    P.reset_stats()
+    fn = cached_jit(key, make)
+    np.testing.assert_array_equal(np.asarray(fn(x)), want)
+    ps = JC.cache_stats()
+    assert ps["compiles"] == 1, ps  # honest recompile
+    st = P.stats()
+    assert st["hits"] == 0 and st["misses"] == 1, st
+    assert st["errors"] >= 1, st
+    assert not os.path.exists(path)  # poisoned entry deleted
+    _forget_key(key)
+
+
+def test_concurrent_writers_from_two_processes(tmp_path):
+    """Two processes hammering the SAME entry path concurrently: the
+    unique-temp-file + os.replace protocol guarantees the survivor is
+    one COMPLETE entry (header matches payload), never an interleaved
+    torn file."""
+    root = str(tmp_path / "store")
+    P.PersistStore(root)  # mkdirs
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import os, sys\n"
+        "root, tag = sys.argv[1], sys.argv[2]\n"
+        "from spark_rapids_tpu.persist import PersistStore\n"
+        "store = PersistStore(root)\n"
+        "path = os.path.join(root, 'results', 'res-shared.tpup')\n"
+        "payload = tag.encode() * 4096\n"
+        "for _ in range(40):\n"
+        "    store._write_entry(path, {'writer': tag}, payload)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen([sys.executable, "-c", script, root, tag],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE)
+             for tag in ("A", "B")]
+    for p in procs:
+        _out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()[-2000:]
+    store = P.PersistStore(root)
+    rec = store._read_entry(
+        os.path.join(root, "results", "res-shared.tpup"),
+        check_env=False)
+    assert rec is not None, "survivor entry failed validation"
+    meta, payload = rec
+    assert meta["writer"] in ("A", "B")
+    assert payload == meta["writer"].encode() * 4096
+
+
+# -- eviction ----------------------------------------------------------- #
+
+def test_lru_eviction_respects_byte_budget(tmp_path):
+    """evict_over_budget deletes oldest-mtime entries until the
+    validated footprint fits; hits _touch entries, so a recently read
+    entry survives an older unread one."""
+    root = _enable(tmp_path)
+    store = P.active()
+    paths = []
+    for i in range(4):
+        path = os.path.join(root, "results", f"res-e{i}.tpup")
+        assert store._write_entry(path, {"i": i}, bytes(1000))
+        os.utime(path, (1000.0 + i, 1000.0 + i))  # deterministic LRU
+        paths.append(path)
+    per_entry = os.stat(paths[0]).st_size
+    # re-read entry 0: the hit touches it to the LRU front
+    assert store._read_entry(paths[0], check_env=False) is not None
+    n = store.evict_over_budget(per_entry * 2)
+    assert n == 2
+    assert P.stats()["evictions"] == 2
+    # oldest-untouched (1, 2) evicted; 0 (touched) and 3 survive
+    assert os.path.exists(paths[0]) and os.path.exists(paths[3])
+    assert not os.path.exists(paths[1])
+    assert not os.path.exists(paths[2])
+
+
+# -- the plan tier ------------------------------------------------------ #
+
+def test_plan_cache_rehydrates_prepare_lineage(tmp_path):
+    """A fresh process's PlanCache miss probes the disk tier; the
+    insert that follows carries the persisted metadata (cross-process
+    prepare lineage) and writes back a bumped generation."""
+    from spark_rapids_tpu.serving.plan_cache import CacheEntry, PlanCache
+
+    _enable(tmp_path)
+    pc = PlanCache(capacity=4)
+    assert pc.lookup("tpl-1") is None
+    pc.insert("tpl-1", CacheEntry(object(), {}, "ph-abc"))
+    assert P.flush(30.0)
+    assert P.stats()["plan_writes"] == 1
+
+    pc2 = PlanCache(capacity=4)  # "the next process"
+    # still a miss — the lowered exec tree is live state, rebuilt...
+    assert pc2.lookup("tpl-1") is None
+    assert P.stats()["plan_hits"] == 1
+    e2 = CacheEntry(object(), {}, "ph-abc")
+    pc2.insert("tpl-1", e2)
+    # ...but the insert rehydrates the persisted lineage
+    assert e2.rehydrated is not None
+    assert e2.rehydrated["plan_hash"] == "ph-abc"
+    assert int(e2.rehydrated["prepares"]) == 1
+    assert P.flush(30.0)
+    assert P.stats()["plan_writes"] == 2  # bumped generation written
+
+    pc3 = PlanCache(capacity=4)
+    assert pc3.lookup("tpl-1") is None
+    e3 = CacheEntry(object(), {}, "ph-abc")
+    pc3.insert("tpl-1", e3)
+    assert int(e3.rehydrated["prepares"]) == 2
+
+
+# -- the result tier ---------------------------------------------------- #
+
+def _result_table():
+    import pyarrow as pa
+
+    return pa.table({"k": [1, 2, 3], "v": [10, 20, 30]})
+
+
+def test_result_cache_disk_tier_roundtrip(tmp_path):
+    """A result-cache frame persists verbatim (exact Arrow IPC bytes +
+    plan_source_digests tokens) and restores lazily on first probe in
+    a fresh cache — re-entering the in-memory tier."""
+    from spark_rapids_tpu.serving.work_share import ResultCache
+
+    _enable(tmp_path)
+    digests = [("li.parquet", 1234, 567890)]
+    tbl = _result_table()
+    rc = ResultCache()
+    assert rc.insert("res-key", digests, tbl)
+    assert P.flush(30.0)
+    assert P.stats()["result_writes"] == 1
+
+    rc2 = ResultCache()  # "the next process"
+    got = rc2.lookup("res-key", digests)
+    assert got is not None and got.equals(tbl)
+    assert P.stats()["result_hits"] == 1
+    assert len(rc2) == 1  # restored frame re-entered the memory tier
+    # second probe: pure in-memory hit, no second persist restore
+    assert rc2.lookup("res-key", digests).equals(tbl)
+    assert P.stats()["result_hits"] == 1
+
+
+def test_result_cache_persisted_frame_invalidated_by_digest(tmp_path):
+    """A persisted frame whose stat-triple tokens no longer match the
+    CURRENT source digests is deleted and reads as an honest miss —
+    the file-mutation contract crosses process restarts."""
+    from spark_rapids_tpu.serving.work_share import ResultCache
+
+    root = _enable(tmp_path)
+    digests = [("li.parquet", 1234, 567890)]
+    rc = ResultCache()
+    assert rc.insert("res-key", digests, _result_table())
+    assert P.flush(30.0)
+
+    rc2 = ResultCache()
+    changed = [("li.parquet", 1234, 999999)]  # mtime_ns moved
+    assert rc2.lookup("res-key", changed) is None
+    assert P.stats()["result_hits"] == 0
+    d = os.path.join(root, "results")
+    assert [n for n in os.listdir(d) if n.endswith(P._SUFFIX)] == []
+
+
+# -- observability ------------------------------------------------------ #
+
+def test_persist_counter_surface_and_gauge():
+    """persist.* counters ride the event log's MONOTONIC_COUNTERS
+    surface; persist_cache.bytes is a GAUGE (telemetry + snapshot),
+    costing zero directory walks while persistence never activated."""
+    from spark_rapids_tpu.eventlog import (
+        MONOTONIC_COUNTERS,
+        counters_snapshot,
+    )
+    from spark_rapids_tpu.trace.telemetry import sample_now
+
+    for k in ("jit.compiles", "persist.hits", "persist.misses",
+              "persist.writes", "persist.evictions", "persist.errors",
+              "persist.plan_hits", "persist.result_hits",
+              "persist.fallback_compiles", "persist.deserialize_ms",
+              "persist.serialize_ms"):
+        assert k in MONOTONIC_COUNTERS, k
+    assert "persist_cache.bytes" not in MONOTONIC_COUNTERS  # gauge
+    snap = counters_snapshot()
+    assert snap["persist_cache.bytes"] == 0  # no store, no dir walk
+    assert sample_now()["persist_cache.bytes"] == 0
+
+
+def test_cache_bytes_gauge_tracks_activated_store(tmp_path):
+    _enable(tmp_path)
+    store = P.active()
+    assert P.cache_bytes() == 0
+    store._write_entry(os.path.join(store.root, "plans",
+                                    "plan-x.tpup"), {}, bytes(512))
+    assert P.cache_bytes() > 512
+
+
+def test_hc017_flags_low_persist_hit_rate():
+    """HC017: a query window that probed the warm-start cache, paid
+    real compiles, and hit under persist.health.minHitRate warns;
+    healthy, persist-off and all-restored windows stay silent."""
+    from spark_rapids_tpu.tools.history import (
+        HEALTH_RULES,
+        QueryRecord,
+        _hc_persist_low_hit,
+    )
+
+    assert any(r[0] == "HC017" and r[1] == "warning"
+               for r in HEALTH_RULES)
+
+    def q(counters):
+        return QueryRecord(
+            query_id="q", plan="", plan_hash="", engine="tpu",
+            wall_s=1.0, start_ts=0.0, end_ts=1.0, conf_hash="",
+            counters=counters, operators=None, spans=None,
+            pipeline=None, faults=None, result_digest=None, rows=0,
+            raw={})
+
+    msg = _hc_persist_low_hit(q({"persist.hits": 1,
+                                 "persist.misses": 9,
+                                 "jit.compiles": 4}))
+    assert msg is not None and "persist hit rate" in msg
+    assert _hc_persist_low_hit(q({"persist.hits": 9,
+                                  "persist.misses": 1,
+                                  "jit.compiles": 1})) is None
+    assert _hc_persist_low_hit(q({"jit.compiles": 5})) is None
+    assert _hc_persist_low_hit(q({"persist.hits": 3,
+                                  "persist.misses": 7})) is None
+
+
+# -- THE acceptance test ------------------------------------------------ #
+
+def test_warm_start_cold_process_acceptance(tmp_path):
+    """THE PR gate (docs/warm_start.md): a fresh subprocess against a
+    warm disk cache executes the fusion-smoke query with ZERO XLA
+    compilations (ledger/jit-tapped), >=2x lower cold wall than the
+    empty-cache subprocess, digests bit-identical across persist
+    off / empty / warm, and full dispatch-attribution parity."""
+    from spark_rapids_tpu.tools import cold_start as cs
+
+    data = str(tmp_path / "data")
+    warm = str(tmp_path / "warm")
+    os.makedirs(data)
+    os.makedirs(warm)
+    cs.make_fixture(data)
+    empty = cs.run_subprocess(data, warm)   # cold, empty cache
+    cs.run_subprocess(data, warm)           # prime the XLA disk cache
+    child = cs.run_subprocess(data, warm)   # measured warm child
+    off = cs.run_subprocess(data, None)     # persistence off
+
+    assert child["compiles"] == 0, child
+    assert child["persist"]["hits"] > 0
+    assert child["digest"] == empty["digest"] == off["digest"]
+    assert child["rows"] == empty["rows"] == off["rows"]
+    # dispatch parity: restored programs still attribute in the ledger
+    assert child["dispatches"] == empty["dispatches"] \
+        == off["dispatches"]
+    assert child["jit_misses"] == empty["jit_misses"]
+    # the cold-start speed gate: warm restart at least 2x cheaper
+    assert child["wall_ms"] * 2 <= empty["wall_ms"], (
+        child["wall_ms"], empty["wall_ms"])
+
+
+def test_warm_start_smoke_tier1():
+    """tools/bench_smoke.run_warm_start_smoke wired into tier-1: the
+    populate + warm-child pass with the zero-compile and digest
+    asserts (satellite of the acceptance test above; also runs in the
+    committed smoke artifact)."""
+    from spark_rapids_tpu.tools.bench_smoke import run_warm_start_smoke
+
+    out = run_warm_start_smoke()
+    assert out["warm_start_child_compiles"] == 0
+    assert out["warm_start_persist_hits"] > 0
+    assert out["warm_start_digest_ok"] is True

@@ -410,6 +410,29 @@ def test_template_key_distinguishes_shared_subplans():
     assert template_key(ab_a._plan, conf)  # content-digested, no crash
 
 
+def test_template_key_memoizes_table_content_digest():
+    """Repeated prepare()s of one in-memory table hash its buffers
+    ONCE (InMemoryRelation.content_digest memo), not once per
+    structural-key build — counter-verified, and the memoized digest
+    is the same content identity table_digest computes."""
+    from spark_rapids_tpu.plan import logical
+    from spark_rapids_tpu.serving.plan_cache import plan_structural_key
+
+    s = TpuSession()
+    df = s.create_dataframe(_table(seed=7))
+    before = logical.digests_computed()
+    k1 = plan_structural_key(df._plan)
+    assert logical.digests_computed() == before + 1
+    k2 = plan_structural_key(df._plan)  # re-prepare: memo, no re-hash
+    assert k2 == k1
+    assert logical.digests_computed() == before + 1
+    rel = df._plan
+    while not isinstance(rel, logical.InMemoryRelation):
+        rel = rel.children[0]
+    assert rel.content_digest() == table_digest(rel.table)
+    assert logical.digests_computed() == before + 1
+
+
 def test_sql_template_key_preserves_string_literal_whitespace():
     """Whitespace normalization must not reach inside string literals:
     'a  b' and 'a b' are different queries and must never share one

@@ -206,32 +206,30 @@ def test_join_warmup_batches_pay_the_sync():
     assert on == off
 
 
-def test_join_steady_state_zero_blocking_sizing_readbacks():
+def test_join_steady_state_zero_blocking_sizing_readbacks(monkeypatch):
     """THE acceptance criterion: with speculation on (the default),
     the steady-state portion of an inner-join stream performs ZERO
     blocking sizing readbacks — only the warm-up prefix (warmupBatches
     + the lookahead window) pays the sync.
 
-    One measured retry: a harvest future that misses the bounded
-    pipeline._HARVEST_GRACE_S wait (a CI scheduler stall, not a
-    speculation regression) degrades one speculative retire into an
-    extra blocking readback.  On a readback miscount the measurement
-    resets the process-global predictor/stat state and re-runs ONCE
-    from cold; the assertions below judge the final attempt, so a real
-    regression (every run over-syncs) still fails both times."""
+    The harvest grace window is widened FOR THIS TEST ONLY: under
+    full-suite load the harvester thread can be preempted past the
+    25ms production grace, degrading one speculative retire into an
+    extra blocking readback — a CI scheduler stall, not a speculation
+    regression.  The wide window keeps this test measuring the
+    dispatch PROTOCOL (did the exec route sizing through a harvest
+    future?) instead of thread-scheduling noise; a real regression —
+    the exec syncing inline per batch — still fails, because the
+    warm-up readbacks it would multiply are inline device_read calls
+    that never touch the grace path."""
+    monkeypatch.setattr(P, "_HARVEST_GRACE_S", 2.0)
     left, right = _join_tables(n_stream=480)
     assert get_conf().get(ENABLED) is True  # the default
-    for attempt in (0, 1):
-        SP.reset_predictors()
-        SP.reset_stats()
-        ex = _join_exec("inner", left, right)
-        with P.trace_events() as events:
-            got = _rows(ex)
-        ev = [kind for kind, tag in events if tag == "join.probe"]
-        n_batches = ev.count("dispatch")
-        if attempt == 0 and ev.count("readback") != 2:
-            continue  # timing noise: retry once from a reset state
-        break
+    ex = _join_exec("inner", left, right)
+    with P.trace_events() as events:
+        got = _rows(ex)
+    ev = [kind for kind, tag in events if tag == "join.probe"]
+    n_batches = ev.count("dispatch")
     assert n_batches >= 10
     # warm-up prefix: warmupBatches(1) + lookahead(1) blocking syncs
     assert ev.count("readback") == 2, ev
