@@ -580,6 +580,11 @@ def _sync_spec_fields(prefix: str, iters: int,
         st = speculation.stats()
         out[f"{prefix}_speculation_overflows"] = sum(
             s["overflows"] for s in st.values())
+        # adaptive kill-switch verdict for the window: tags whose
+        # rolling hit rate fell below speculation.adaptive.minHitRate
+        # and were auto-disabled (0 with the default threshold off)
+        out[f"{prefix}_speculation_disabled"] = len(
+            speculation.disabled_tags())
     return out
 
 
@@ -1816,12 +1821,226 @@ def _bench_multichip(n_devices: int) -> dict:
     return out
 
 
+def _bench_mesh_serving(n_devices: int, n_sessions: int) -> dict:
+    """bench.py --multichip N --sessions K: pod-scale serving — K
+    concurrent sessions drive the milestone templates (agg / join /
+    sort) through the serving tier ON an N-device virtual mesh with
+    mesh-resident execution enabled (docs/pod_serving.md).  Emits
+    `serving_qps_per_chip` and asserts the tentpole's contracts where
+    they are measured:
+
+    - every concurrent result hashes bit-identical (canonical digest)
+      to the SERIAL SINGLE-DEVICE reference;
+    - `serving.mesh.enabled=false` on the same mesh is asserted
+      bit-for-bit identical too (the flag-off path is untouched);
+    - steady state is device-born: the measured window's tapped
+      `placement.host_uploads` counter is asserted ZERO (control-plane
+      uploads tallied separately);
+    - repeats are pure plan-cache hits (rate 1.0) that compile nothing
+      (zero jit-cache misses).
+    """
+    import threading
+
+    from spark_rapids_tpu.platform import pin_cpu_platform
+
+    cpu_devs = pin_cpu_platform(n_devices)
+
+    import __graft_entry__ as graft
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import TpuConf, set_conf
+    from spark_rapids_tpu.execs.jit_cache import cache_stats
+    from spark_rapids_tpu.parallel import make_mesh
+    from spark_rapids_tpu.parallel import placement as _placement
+    from spark_rapids_tpu.parallel.mesh import set_active_mesh
+    from spark_rapids_tpu.serving import plan_cache as _plan_cache
+    from spark_rapids_tpu.serving import scheduler as _scheduler
+    from spark_rapids_tpu.session import TpuSession, col, count, sum_
+    from spark_rapids_tpu.shuffle.transport import SHUFFLE_TRANSPORT
+
+    mesh = make_mesh(n_devices, devices=cpu_devs)
+    rows = int(os.environ.get("MESH_SERVING_ROWS", 1 << 14))
+    rng = np.random.default_rng(7)
+    fact = pa.table({
+        "k": rng.integers(0, 1024, rows).astype(np.int64),
+        "v": rng.integers(0, 1000, rows).astype(np.int64),
+    })
+    dim = pa.table({
+        "k": np.arange(1024, dtype=np.int64),
+        "w": np.arange(1024, dtype=np.int64) * 3,
+    })
+    sort_t = pa.table({
+        "k": rng.permutation(rows).astype(np.int64),
+        "v": np.arange(rows, dtype=np.int64),
+    })
+
+    def templates(s):
+        return [
+            ("agg", s.create_dataframe(fact)
+             .group_by(col("k"))
+             .agg((sum_(col("v")), "s"), (count(col("v")), "c"))),
+            ("join", s.create_dataframe(fact)
+             .join(s.create_dataframe(dim), on="k", how="inner")),
+            ("sort", s.create_dataframe(sort_t).order_by(col("k"))),
+        ]
+
+    def _conf(transport: str, mesh_serving: bool) -> TpuConf:
+        return TpuConf({
+            SHUFFLE_TRANSPORT.key: transport,
+            "spark.rapids.tpu.shuffle.collective.spmd.enabled":
+                transport == "collective",
+            "spark.rapids.tpu.shuffle.collective.roundRows":
+                max(1024, rows // (n_devices * 4)),
+            "spark.rapids.tpu.sql.batchSizeRows":
+                max(512, rows // (n_devices * 8)),
+            "spark.rapids.tpu.sql.autoBroadcastJoinThresholdBytes": -1,
+            "spark.rapids.tpu.serving.mesh.enabled": mesh_serving,
+            "spark.rapids.tpu.serving.maxConcurrent": 2,
+            "spark.rapids.tpu.sql.concurrentTpuTasks": 2,
+            "spark.rapids.tpu.serving.sharing.enabled": False,
+        })
+
+    set_active_mesh(mesh)
+    out: dict = {"metric": "mesh_serving_bench",
+                 "n_devices": n_devices,
+                 "serving_sessions": n_sessions, "rows": rows}
+    try:
+        # -- serial single-device reference (the ground truth) ------ #
+        serial_conf = _conf("local", False)
+        serial_conf.set("spark.rapids.tpu.serving.maxConcurrent", 0)
+        set_conf(serial_conf)
+        s0 = TpuSession(serial_conf)
+        digests = {}
+        for name, df in templates(s0):
+            df.collect(engine="tpu")  # warm
+            digests[name] = graft._canon_digest(df.collect(engine="tpu"))
+
+        # -- flag-off gate: collective SPMD on the mesh with
+        # serving.mesh.enabled=false must be bit-for-bit the
+        # pre-mesh-serving engine (every gated path dormant) -------- #
+        off_conf = _conf("collective", False)
+        set_conf(off_conf)
+        s_off = TpuSession(off_conf)
+        for name, df in templates(s_off):
+            got = graft._canon_digest(df.collect(engine="tpu"))
+            assert got == digests[name], \
+                f"mesh.enabled=false diverged on {name}"
+        out["mesh_off_identical"] = True
+
+        # -- mesh-resident serving phase ---------------------------- #
+        repeat_iters = 3
+        _scheduler.reset()
+        lock = threading.Lock()
+        latencies: list = []
+        mismatches: list = []
+        warm_done = threading.Barrier(n_sessions + 1)
+        go = threading.Event()
+
+        def run_session(i: int) -> None:
+            pqs = {}
+            try:
+                conf = _conf("collective", True)
+                set_conf(conf)
+                session = TpuSession(conf, tenant=f"t{i % 2}")
+                for name, df in templates(session):
+                    pqs[name] = session.prepare(df)
+                for name, pq in pqs.items():
+                    if graft._canon_digest(pq.execute()) \
+                            != digests[name]:
+                        with lock:
+                            mismatches.append((i, name, "warm"))
+            except BaseException as e:  # noqa: BLE001 — reported below
+                with lock:
+                    mismatches.append((i, "session-error", repr(e)))
+                pqs = {}
+            finally:
+                warm_done.wait()
+            if not pqs:
+                return
+            go.wait()
+            try:
+                for _ in range(repeat_iters):
+                    for name, pq in pqs.items():
+                        t0 = time.perf_counter()
+                        r = pq.execute()
+                        dt = time.perf_counter() - t0
+                        if graft._canon_digest(r) != digests[name]:
+                            with lock:
+                                mismatches.append((i, name, "repeat"))
+                        with lock:
+                            latencies.append(dt)
+            except BaseException as e:  # noqa: BLE001 — reported below
+                with lock:
+                    mismatches.append((i, "repeat-error", repr(e)))
+
+        threads = [threading.Thread(target=run_session, args=(i,),
+                                    name=f"mesh-serve-{i}")
+                   for i in range(n_sessions)]
+        for t in threads:
+            t.start()
+        warm_done.wait()
+        # measured window armed strictly after every warm pass:
+        # repeats must be pure plan-cache hits that compile nothing
+        # and upload nothing on the data plane
+        _plan_cache.reset_stats()
+        _scheduler.reset()
+        _placement.reset_stats()
+        jit0 = cache_stats()
+        wall0 = time.perf_counter()
+        go.set()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - wall0
+        assert not mismatches, (
+            f"mesh serving diverged from the serial single-device "
+            f"digests: {mismatches}")
+        jit1 = cache_stats()
+        pc = _plan_cache.stats()
+        pl = _placement.stats()
+        n_execs = len(latencies)
+        latencies.sort()
+        qps = n_execs / wall if wall else 0.0
+        out.update({
+            "serving_executions": n_execs,
+            "serving_qps": round(qps, 2),
+            "serving_qps_per_chip": round(qps / n_devices, 3),
+            "serving_p50_ms": round(
+                latencies[n_execs // 2] * 1e3, 1) if n_execs else 0.0,
+            "plan_cache_hit_rate": pc["hit_rate"],
+            "serving_repeat_jit_misses":
+                jit1["misses"] - jit0["misses"],
+            "placement_host_uploads": pl["host_uploads"],
+            "placement_control_uploads": pl["control_uploads"],
+            "placement_device_born": pl["device_born"],
+            "placement_d2d_transfers": pl["d2d_transfers"],
+            "placement_adoptions": pl["adoptions"],
+            "digests_match": True,
+        })
+        assert pc["hit_rate"] == 1.0, pc
+        assert out["serving_repeat_jit_misses"] == 0, (jit0, jit1)
+        # the device-born contract, measured where it bites: the
+        # steady-state window moved ZERO data-plane bytes host->device
+        # through stage assembly
+        assert pl["host_uploads"] == 0, pl
+        out["ok"] = True
+    finally:
+        set_active_mesh(None)
+    return out
+
+
 def main() -> None:
     global _CHAOS
     multichip = _int_flag("--multichip")
     if multichip:
         # multichip mode FIRST: it must pin the virtual CPU platform
         # before any backend initialization below touches jax
+        sessions = _int_flag("--sessions")
+        if sessions:
+            # pod-scale serving: K sessions on the N-device mesh with
+            # mesh-resident execution (docs/pod_serving.md)
+            print(json.dumps(_bench_mesh_serving(multichip, sessions)))
+            return
         print(json.dumps(_bench_multichip(multichip)))
         return
     if "--chaos" in sys.argv[1:]:

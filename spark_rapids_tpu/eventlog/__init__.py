@@ -88,6 +88,9 @@ MONOTONIC_COUNTERS = (
     "rf.filters_built", "rf.build_rows", "rf.build_ms",
     "rf.pruned_rows", "rf.row_groups_pruned",
     "speculation.hits", "speculation.overflows", "speculation.synced",
+    "speculation.disabled",
+    "placement.host_uploads", "placement.device_born",
+    "placement.d2d_transfers",
     "pipeline.readbacks", "pipeline.async_readbacks", "pipeline.items",
     "spill.device_to_host_bytes", "spill.host_to_disk_bytes",
     "share.result_hits", "share.result_misses",
@@ -153,6 +156,13 @@ def counters_snapshot() -> dict[str, float]:
     out["speculation.overflows"] = sum(
         s["overflows"] for s in sp.values())
     out["speculation.synced"] = sum(s["synced"] for s in sp.values())
+    out["speculation.disabled"] = speculation.disabled_total()
+    from spark_rapids_tpu.parallel import placement as _placement
+
+    pl = _placement.stats()
+    out["placement.host_uploads"] = pl["host_uploads"]
+    out["placement.device_born"] = pl["device_born"]
+    out["placement.d2d_transfers"] = pl["d2d_transfers"]
     st = stage_snapshot()
     out["pipeline.readbacks"] = sum(s["readbacks"] for s in st.values())
     out["pipeline.async_readbacks"] = sum(

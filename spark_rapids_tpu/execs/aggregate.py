@@ -506,7 +506,8 @@ class TpuHashAggregateExec(TpuExec):
         #: sizing): the drain reconciles them before its batched fetch
         futs: dict = {}
         pred = SP.predictor(self._cache_key() + ("sizing",)) \
-            if SP.speculation_enabled() else None
+            if SP.speculation_enabled() \
+            and SP.tag_enabled("agg.size") else None
 
         #: handle-ids whose sizing future already fed the predictor —
         #: a drain RE-RUN after an OOM (spill-retry rung) must not

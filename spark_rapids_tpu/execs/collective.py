@@ -44,6 +44,7 @@ from spark_rapids_tpu.execs.base import MetricTimer, TOTAL_TIME, TpuExec
 from spark_rapids_tpu.exprs.aggregates import NamedAgg
 from spark_rapids_tpu.exprs.base import EvalContext, Expression
 from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_tpu.trace import ledger as _ledger
 
 COLLECTIVE_ROUND_ROWS = register(
     "spark.rapids.tpu.shuffle.collective.roundRows", 1 << 20,
@@ -328,7 +329,8 @@ class TpuCollectiveHashAggregateExec(_CollectiveBase):
                 prog = S.make_exchange_scan_stage(
                     self.mesh, akey, xchg_body, len(bucket),
                     op=self.name, donate=True)
-                shrunk.extend(S.shrink_rounds(prog(xs)))
+                shrunk.extend(S.shrink_rounds(prog(xs),
+                                              mesh=self.mesh))
 
             for shards in self._shard_rounds(child):
                 bucket.append(shards)
@@ -346,7 +348,8 @@ class TpuCollectiveHashAggregateExec(_CollectiveBase):
             final = t.observe(tail(xs2))
         counts = S.stage_counts(final)
         out = []
-        for d, b in enumerate(S.unstack_stage(final, counts)):
+        for d, b in enumerate(S.unstack_stage(final, counts,
+                                              mesh=self.mesh)):
             self.metrics["collectiveRows"].add(int(counts[d]))
             out.append([b])
         return out
@@ -549,7 +552,7 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
                 len(build_rounds), op=self.name, donate=True)
             ys_b = bprog(xs_b)
             bcounts = S.stage_counts(ys_b)
-            shrunk_b = S.shrink_rounds(ys_b, bcounts)
+            shrunk_b = S.shrink_rounds(ys_b, bcounts, mesh=self.mesh)
             self.metrics["buildRows"].add(int(bcounts.sum()))
             build_rows = int(bcounts.sum(axis=0).max()) \
                 if bcounts.size else 0
@@ -568,15 +571,26 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
                     self.mesh, jkey + ("stream",), stream_body,
                     len(bucket), op=self.name, donate=True)
                 ys = rprog(xs)
+                counts2 = S.stage_counts(ys)
                 rounds2 = S.pad_rounds_pow2(
-                    S.shrink_rounds(ys),
+                    S.shrink_rounds(ys, counts2, mesh=self.mesh),
                     self.children[0].schema, n)
                 xs2 = S.shard_stack_rounds(rounds2, self.mesh)
-                cap2 = max(b.capacity for shards in rounds2
-                           for b in shards)
+                # probe out-capacity from the LIVE routed maximum, not
+                # the padded round capacity or the whole build side:
+                # pad_capacity honors the pow2x3 bucket policy, so a
+                # 5/8-full shard stops forcing expand_pairs to compute
+                # on a worst-case pad (MULTICHIP_r06 measured the old
+                # max(cap, build_rows) guess at 0.505x per device).
+                # An undershoot is safe: the totals check below
+                # re-buckets and re-dispatches at the true capacity.
+                live_max = int(counts2.max()) if counts2.size else 0
                 cap_guess = 64 if semi_anti else pad_capacity(
-                    max(cap2, build_rows, 64))
+                    max(live_max, 64))
                 while True:
+                    if not semi_anti:
+                        _ledger.note_occupancy(max(live_max, 1),
+                                               cap_guess)
                     prog = S.make_join_scan_stage(
                         self.mesh, jkey + (cap_guess,),
                         lambda s, b, c=cap_guess:
@@ -592,7 +606,7 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
                     # capacity the data actually needs
                     cap_guess = pad_capacity(worst)
                 outs = t.observe(outs)
-                per = S.unstack_round_stage(outs)  # bucket stage exit
+                per = S.unstack_round_stage(outs, mesh=self.mesh)
                 for d in range(n):
                     chunks[d].extend(per[d])
 
@@ -611,13 +625,14 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
         return chunks
 
     def _materialize_host_loop(self) -> list[list[ColumnarBatch]]:
+        from spark_rapids_tpu.parallel import spmd as S
         from spark_rapids_tpu.parallel.exchange import unstack_batch
 
         chunks: list[list[ColumnarBatch]] = [
             [] for _ in range(self.num_partitions)]
         with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
             build_stacked = self._collect_build()
-            build_rows = int(jnp.max(build_stacked.num_rows))
+            build_rows = int(S.fetch(build_stacked.num_rows).max())
             for shards in self._shard_rounds(self.children[0]):
                 n = self.num_partitions
                 cap_round = max(s.capacity for s in shards)
@@ -633,7 +648,7 @@ class TpuCollectiveHashJoinExec(_CollectiveBase):
                     out, totals = step(stacked, build_stacked)
                     if self.join_type in ("left_semi", "left_anti"):
                         break
-                    worst = int(jnp.max(totals))
+                    worst = int(S.fetch(totals).max())
                     if worst <= cap_guess:
                         break
                     # JoinGatherer-style re-bucket: recompile at the
@@ -735,25 +750,98 @@ class TpuCollectiveSortExec(_CollectiveBase):
                                     part.key_orders())
             return b.gather(perm, b.num_rows)
 
+        from spark_rapids_tpu.serving import mesh_serving_enabled
+
         with MetricTimer(self.metrics[TOTAL_TIME], op=self.name) as t:
-            rounds = S.pad_rounds_pow2(
-                list(self._shard_rounds(child)), child.schema, n)
-            xs = S.shard_stack_rounds(rounds, self.mesh)
-            fracs = S.sample_fracs(self.mesh, len(rounds),
-                                   self.SAMPLE_PER_SHARD)
-            rprog = S.make_sort_route_stage(
-                self.mesh, skey, part, len(rounds),
-                self.SAMPLE_PER_SHARD, op=self.name, donate=True)
-            routed = rprog(xs, fracs)
-            rounds2 = S.pad_rounds_pow2(
-                S.shrink_rounds(routed), child.schema, n)
-            xs2 = S.shard_stack_rounds(rounds2, self.mesh)
-            tail = S.make_stage_tail(self.mesh, skey, local_sort,
-                                     len(rounds2), op=self.name,
-                                     donate=True)
-            out = t.observe(tail(xs2))
+            raw = list(self._shard_rounds(child))
+            if (len(raw) > self.bucket_rounds
+                    and mesh_serving_enabled()):
+                out = self._spmd_sort_bucketed(raw, local_sort, t)
+            else:
+                rounds = S.pad_rounds_pow2(raw, child.schema, n)
+                xs = S.shard_stack_rounds(rounds, self.mesh)
+                fracs = S.sample_fracs(self.mesh, len(rounds),
+                                       self.SAMPLE_PER_SHARD)
+                rprog = S.make_sort_route_stage(
+                    self.mesh, skey, part, len(rounds),
+                    self.SAMPLE_PER_SHARD, op=self.name, donate=True)
+                routed = rprog(xs, fracs)
+                rounds2 = S.pad_rounds_pow2(
+                    S.shrink_rounds(routed, mesh=self.mesh),
+                    child.schema, n)
+                xs2 = S.shard_stack_rounds(rounds2, self.mesh)
+                tail = S.make_stage_tail(self.mesh, skey, local_sort,
+                                         len(rounds2), op=self.name,
+                                         donate=True)
+                out = t.observe(tail(xs2))
         counts = S.stage_counts(out)
-        return [[b] for b in S.unstack_stage(out, counts)]
+        return [[b]
+                for b in S.unstack_stage(out, counts, mesh=self.mesh)]
+
+    def _spmd_sort_bucketed(self, raw: list, local_sort, t):
+        """Bounded-residency sort (mesh serving, docs/pod_serving.md):
+        instead of assembling EVERY round into one resident global
+        array (the single-program path's footprint is R x n x roundRows
+        for the whole stage), sample bucket by bucket (pass 1, one
+        bucket stacked at a time), choose bounds once from the pooled
+        tiny samples, then range-route bucket by bucket (pass 2, bounds
+        as a replicated program argument).  Row placement may differ
+        from the single-program path (bounds come from the same
+        fraction scheme but bucket-local pooling); the TOTAL order —
+        sorted shards concatenated by shard index — is identical by
+        construction, because any bounds partition sorts correctly."""
+        from spark_rapids_tpu.execs.jit_cache import cached_jit
+        from spark_rapids_tpu.ops.range_partition import choose_bounds
+        from spark_rapids_tpu.parallel import spmd as S
+
+        child = self.children[0]
+        part = self._part
+        n = self.num_partitions
+        skey = self._sort_key()
+        B = self.bucket_rounds
+        buckets = [S.pad_rounds_pow2(raw[i:i + B], child.schema, n)
+                   for i in range(0, len(raw), B)]
+
+        # pass 1: per-bucket sample programs; only the tiny per-shard
+        # key samples stay resident between passes
+        samples: list[ColumnarBatch] = []
+        for bucket in buckets:
+            xs = S.shard_stack_rounds(bucket, self.mesh)
+            fracs = S.sample_fracs(self.mesh, len(bucket),
+                                   self.SAMPLE_PER_SHARD)
+            sprog = S.make_sort_sample_stage(
+                self.mesh, skey, part, len(bucket),
+                self.SAMPLE_PER_SHARD, op=self.name)
+            per = S.unstack_round_stage(sprog(xs, fracs),
+                                        mesh=self.mesh)
+            for shard_list in per:
+                samples.extend(shard_list)
+        if not samples:
+            samples = [part.key_batch(ColumnarBatch.empty(child.schema))]
+        n_live = sum(s.concrete_num_rows() for s in samples)
+        jit_bounds = cached_jit(
+            ("csortbounds", skey, n_live, n,
+             tuple(s.capacity for s in samples)),
+            op=self.name,
+            make_fn=lambda: lambda ss: choose_bounds(
+                concat_batches(ss), part.key_orders(), n, n_live))
+        bounds = jit_bounds(samples)
+
+        # pass 2: per-bucket range routing against the shared bounds
+        shrunk: list[list[ColumnarBatch]] = []
+        for bucket in buckets:
+            xs = S.shard_stack_rounds(bucket, self.mesh)
+            rprog = S.make_bounds_route_stage(
+                self.mesh, skey, part, len(bucket), op=self.name,
+                donate=True)
+            shrunk.extend(S.shrink_rounds(rprog(xs, bounds),
+                                          mesh=self.mesh))
+        rounds2 = S.pad_rounds_pow2(shrunk, child.schema, n)
+        xs2 = S.shard_stack_rounds(rounds2, self.mesh)
+        tail = S.make_stage_tail(self.mesh, skey, local_sort,
+                                 len(rounds2), op=self.name,
+                                 donate=True)
+        return t.observe(tail(xs2))
 
     def _materialize_host_loop(self) -> list[list[ColumnarBatch]]:
         import numpy as np

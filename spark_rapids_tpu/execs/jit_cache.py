@@ -199,6 +199,62 @@ class _CompileLatch:
         return self._fn(*args, **kwargs)
 
 
+#: process-wide ENQUEUE gate for PARTITIONED (sharded) programs.
+#: XLA's CPU collectives rendezvous per-device participant threads
+#: that drain per-device execution queues in FIFO order, so two
+#: threads enqueueing two multi-device programs can interleave the
+#: per-device queue orders — device 0 queues A-then-B while device 1
+#: queues B-then-A, each program's rendezvous waits on participants
+#: parked BEHIND the other program, and both stall forever (the
+#: `collective_ops_utils` "waiting for all participants" deadlock).
+#: Pod-scale serving's concurrent sessions are exactly this shape
+#: (docs/pod_serving.md).  Holding the lock across the (async) call
+#: makes every device see the same program order — sufficient, IF
+#: every multi-device launch goes through the gate: the eager side
+#: doors (a sharded array's `__getitem__`, an eager `jnp.max` on a
+#: sharded leaf) are closed in exchange.take_piece and the stage-exit
+#: device_get fetches.  Single-threaded/mesh-off callers never
+#: contend, and program-to-program pipelining is untouched.
+_SHARDED_DISPATCH_LOCK = threading.RLock()
+
+
+class _SerializedDispatch:
+    """Wrap a compiled partitioned program so concurrent callers
+    ENQUEUE atomically (see _SHARDED_DISPATCH_LOCK): the runtime's
+    per-device execution queues drain FIFO, so as long as every
+    collective program lands on every device queue in the same order,
+    the per-device worker threads reach each program's rendezvous
+    together and no program waits on participants parked behind it.
+    The call itself stays async — program-to-program overlap and
+    host/device overlap are preserved; only the enqueue interleaving
+    (the thing two threads can scramble) is serialized.  The eager
+    side doors are closed separately (exchange.take_piece, stage-exit
+    device_get fetches) — an UNGUARDED multi-device launch between
+    two gated ones reintroduces the scramble.  Attribute access
+    (``.lower`` for the ledger cost model) passes through."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+    def __call__(self, *args, **kwargs):
+        with _SHARDED_DISPATCH_LOCK:
+            return self._fn(*args, **kwargs)
+
+
+def serialize_sharded(fn: Callable) -> Callable:
+    """Route a multi-device program compiled OUTSIDE cached_jit (the
+    shard_map step builders in parallel/exchange.py) through the same
+    process-wide collective dispatch gate — every rendezvous-bearing
+    program in the process must share ONE gate or the pool-starvation
+    deadlock above comes back through the unguarded door."""
+    return _SerializedDispatch(fn)
+
+
 def cached_jit(key: tuple, make_fn: Callable[[], Callable],
                op: Optional[str] = None,
                donate: "int | Sequence[int] | None" = None,
@@ -283,13 +339,27 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
             # fingerprint); a hit dispatches restored executables and
             # compiles nothing.  Sharded programs are excluded (their
             # sharding specs bind live device objects that don't
-            # round-trip a serialize).  Off = one conf read in
-            # active(), then the identical compile path as ever.
+            # round-trip a serialize) — EXCEPT under mesh serving
+            # (docs/pod_serving.md): a partitioned stage program's key
+            # already folds parallel/mesh.mesh_key, so a warm pod
+            # restart on the same mesh shape redeploys the exported
+            # partitioned executables; an export that cannot serialize
+            # degrades to the honest compile through AutoSave's
+            # swallowed-error path (persist.errors), never a wrong
+            # program.  Off = one conf read in active(), then the
+            # identical compile path as ever.
             from spark_rapids_tpu import persist as _persist
 
-            store = None if (in_shardings is not None
-                             or out_shardings is not None) \
-                else _persist.active()
+            sharded = (in_shardings is not None
+                       or out_shardings is not None)
+            if sharded:
+                from spark_rapids_tpu.serving import (
+                    mesh_serving_enabled,
+                )
+                store = _persist.active() \
+                    if mesh_serving_enabled() else None
+            else:
+                store = _persist.active()
             restored = None
             conf_fp = ""
             if store is not None:
@@ -300,7 +370,7 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
                         key, exported, make_fn, jit_kwargs, store,
                         conf_fp)
             if restored is not None:
-                fn = _CACHE[key] = _ledger.LEDGER.wrap(
+                fn = _ledger.LEDGER.wrap(
                     key, restored, op=op, donated=bool(donate),
                     meta={**(meta or {}), "persist_restored": True})
             else:
@@ -308,9 +378,15 @@ def cached_jit(key: tuple, make_fn: Callable[[], Callable],
                 if store is not None:
                     jitted = _persist.AutoSave(key, jitted, store,
                                                conf_fp)
-                fn = _CACHE[key] = _ledger.LEDGER.wrap(
+                fn = _ledger.LEDGER.wrap(
                     key, _CompileLatch(jitted), op=op,
                     donated=bool(donate), meta=meta)
+            if sharded:
+                # outside the ledger wrapper: lock WAIT (another
+                # session's enqueue) must not inflate this program's
+                # attributed dispatch time
+                fn = _SerializedDispatch(fn)
+            _CACHE[key] = fn
             while len(_CACHE) > MAX_ENTRIES:
                 _CACHE.popitem(last=False)
         else:

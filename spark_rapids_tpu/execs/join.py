@@ -293,7 +293,8 @@ class _HashJoinBase(TpuExec):
         matched_b_acc = None
         sizes_output = self.join_type not in ("left_semi", "left_anti")
         pred = SP.predictor(self._cache_key() + ("sizing",)) \
-            if sizes_output and SP.speculation_enabled() else None
+            if sizes_output and SP.speculation_enabled() \
+            and SP.tag_enabled("join.probe") else None
         chunk = get_conf().get(JOIN_OUTPUT_CHUNK_ROWS)
         chunk_cap_ceiling = pad_capacity(chunk)
 

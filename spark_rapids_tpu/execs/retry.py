@@ -266,6 +266,39 @@ def run_with_oom_retry(fn: Callable[[], T], desc: str = "op",
 # -- batch bisection --------------------------------------------------- #
 
 
+def _desharded(batch):
+    """Re-place a batch whose leaves are mesh-sharded (or scattered
+    across devices) onto ONE device before the ladder's row-indexed
+    gathers: bisection slices leaf-by-leaf with plain `gather`/`slice`
+    ops that assume fully-addressable single-device arrays, and a
+    multi-device leaf would either fail the trace or silently gather a
+    single shard's rows.  Under mesh serving (the only producer of
+    sharded stage leaves) the move routes through
+    parallel/placement.adopt_batch — the single device_put choke point
+    (SRC016) — so it shows up in the placement counters instead of
+    vanishing into an untracked transfer."""
+    import jax
+
+    target = None
+    for c in getattr(batch, "columns", ()):
+        for leaf in jax.tree_util.tree_leaves(c):
+            if isinstance(leaf, jax.Array):
+                try:
+                    devs = leaf.devices()
+                except Exception:
+                    continue
+                if len(devs) > 1:
+                    target = sorted(devs, key=lambda d: d.id)[0]
+                    break
+        if target is not None:
+            break
+    if target is None:
+        return batch
+    from spark_rapids_tpu.parallel import placement as _placement
+
+    return _placement.adopt_batch(batch, target)
+
+
 def bisect_batch(batch):
     """Split a device batch into (first_half, second_half) along the
     row axis.  Runs only on the failure path (after a spill), so the
@@ -294,6 +327,7 @@ def bisect_batch(batch):
         # (non-retryable) — callers gate on _batch_rows first, so the
         # ladder escalates instead of bisecting freed HBM
         batch = batch.decode_now()
+    batch = _desharded(batch)
     n = batch.concrete_num_rows()
     assert n >= 2, f"cannot bisect a {n}-row batch"
     batch = dataclasses.replace(batch, num_rows=n)

@@ -160,6 +160,17 @@ Rules
   exempt by construction — and python_worker/ (the UDF pipe
   protocol, pickled function frames over stdin, never files) is out
   of scope.
+- SRC016 (error): raw ``jax.device_put`` in execs/ and parallel/
+  outside parallel/placement.py.  Stage-input placement has ONE choke
+  point (docs/pod_serving.md): placement.place_piece /
+  placement.adopt_batch classify every move (host upload vs
+  device-born vs device-to-device) into the ``placement.*`` counters
+  that back the pod-serving zero-host-upload gate — a raw
+  ``device_put`` elsewhere is an untracked transfer that silently
+  re-opens the host round-trip the device-born contract closed.
+  Syntactic and module-wide: any ``jax.device_put(...)`` call (or
+  bare ``device_put`` imported from jax) in scope.  placement.py IS
+  the choke point — exempt by construction.
 """
 
 from __future__ import annotations
@@ -1395,6 +1406,54 @@ class _PersistWriteChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawDevicePutChecker(ast.NodeVisitor):
+    """SRC016: raw ``jax.device_put`` calls in execs//parallel/
+    modules instead of the placement choke point.
+
+    Scope is syntactic and module-wide like SRC009: a raw device_put
+    anywhere in these layers moves a stage-input leaf without
+    classifying it into the ``placement.*`` counters, so the
+    pod-serving steady-state-zero-host-uploads gate (and the
+    device-born evidence it rests on) silently stops covering that
+    transfer.  parallel/placement.py IS the choke point — exempt by
+    construction."""
+
+    def __init__(self, path: str, out: list[Diagnostic]):
+        self.path = path
+        self.out = out
+        self._fn_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    @staticmethod
+    def _is_raw_device_put(e: ast.expr) -> bool:
+        """A reference to jax.device_put / bare device_put."""
+        if isinstance(e, ast.Attribute):
+            return e.attr == "device_put" \
+                and _terminal_name(e.value) == "jax"
+        return isinstance(e, ast.Name) and e.id == "device_put"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_raw_device_put(node.func):
+            qual = self._fn_stack[-1] if self._fn_stack else "<module>"
+            self.out.append(Diagnostic(
+                "SRC016", "error", f"{self.path}::{qual}",
+                "raw `jax.device_put` bypasses the stage-input "
+                "placement choke point — the transfer is unclassified "
+                "(no placement.* counter), so the pod-serving "
+                "zero-host-upload gate no longer covers it",
+                hint="route the move through parallel/placement."
+                     "place_piece (per-shard pieces) or "
+                     "placement.adopt_batch (whole batches)",
+                line=node.lineno))
+        self.generic_visit(node)
+
+
 def _is_exec_module(path: str) -> bool:
     parts = path.replace("\\", "/").split("/")
     return "execs" in parts
@@ -1463,6 +1522,17 @@ def _is_persist_scope_module(path: str) -> bool:
     return "python_worker" not in norm.split("/")
 
 
+def _is_placement_scope_module(path: str) -> bool:
+    """SRC016 scope: exec bodies and the parallel substrate — the
+    layers that feed stage inputs — EXCEPT parallel/placement.py (it
+    IS the classified mover)."""
+    norm = path.replace("\\", "/")
+    if norm.endswith("parallel/placement.py"):
+        return False
+    parts = norm.split("/")
+    return "execs" in parts or "parallel" in parts
+
+
 def _is_recovery_module(path: str) -> bool:
     """SRC008 scope: the layers whose exceptions feed the recovery
     ladder.  execs/retry.py IS the classification gate — exempt."""
@@ -1508,6 +1578,8 @@ def lint_source_text(src: str, path: str) -> list[Diagnostic]:
         _WireHandlerChecker(path, out).visit(tree)
     if _is_persist_scope_module(path):
         _PersistWriteChecker(path, out).visit(tree)
+    if _is_placement_scope_module(path):
+        _RawDevicePutChecker(path, out).visit(tree)
     return out
 
 

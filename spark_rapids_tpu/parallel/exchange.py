@@ -27,6 +27,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # jax >= 0.6 stable API
@@ -55,6 +56,82 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                      out_specs=out_specs, **{_SM_CHECK_KW: False})
 
 
+def _sharded_jit(mapped) -> Callable:
+    """jit a shard_map program and route its dispatch through the
+    process-wide collective gate (jit_cache.serialize_sharded): the
+    step builders below are the only multi-device programs compiled
+    outside cached_jit, and an unguarded concurrent launch can starve
+    XLA's CPU collective thread pool mid-rendezvous
+    (docs/pod_serving.md)."""
+    from spark_rapids_tpu.execs.jit_cache import serialize_sharded
+
+    return serialize_sharded(jax.jit(mapped))
+
+
+def take_piece(arr: jax.Array, idx: tuple):
+    """``arr[idx]`` for leading-dim integer indices, resolved against
+    the array's addressable shards.  An eager ``__getitem__`` on a
+    PARTITIONED array compiles and launches a cross-device gather —
+    an unguarded multi-device program that can rendezvous against a
+    concurrently launched one and starve XLA's CPU collective pool
+    (the jit_cache._SHARDED_DISPATCH_LOCK deadlock, through the eager
+    door).  A stage output's (round, shard) piece is wholly resident
+    on its shard's device, so the local-shard slice below is both
+    collective-free and copy-free; anything not covered by a local
+    shard falls back to the plain (single-device) getitem."""
+    try:
+        shards = arr.addressable_shards
+    except (AttributeError, RuntimeError):
+        return arr[idx]
+    for s in shards:
+        sl = s.index
+        loc = []
+        for i, g in enumerate(idx):
+            start = sl[i].start or 0
+            stop = sl[i].stop if sl[i].stop is not None \
+                else arr.shape[i]
+            if not (start <= g < stop):
+                break
+            loc.append(g - start)
+        else:
+            return s.data[tuple(loc)]
+    return arr[idx]
+
+
+def _stack_parts(parts: list):
+    """``jnp.stack`` for per-device leaves that may be COMMITTED to
+    distinct devices (take_piece's local-shard slices are).  An eager
+    jnp.stack of committed arrays on different devices is an
+    incompatible-devices error, so the committed case assembles the
+    stacked global array shard-by-shard with
+    make_array_from_single_device_arrays — no cross-device op at all;
+    duplicated-device pieces fall back to placement-routed moves onto
+    the first piece's device."""
+    try:
+        return jnp.stack(parts)
+    except ValueError:
+        devsets = [getattr(p, "devices", lambda: None)() for p in parts]
+        singles = all(ds is not None and len(ds) == 1
+                      for ds in devsets)
+        if singles:
+            devs = [next(iter(ds)) for ds in devsets]
+            if len(set(devs)) == len(devs):
+                from jax.sharding import NamedSharding
+                shape = (len(parts),) + parts[0].shape
+                mesh = Mesh(np.asarray(devs), ("stack",))
+                sh = NamedSharding(
+                    mesh, P("stack", *([None] * parts[0].ndim)))
+                return jax.make_array_from_single_device_arrays(
+                    shape, sh, [p[None] for p in parts])
+        from spark_rapids_tpu.parallel import placement as _placement
+
+        target = next((next(iter(ds)) for ds in devsets if ds), None)
+        if target is None:
+            raise
+        return jnp.stack([_placement.place_piece(p, target)
+                          for p in parts])
+
+
 def stack_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Stack per-device batches into one batch whose leaves carry a leading
     device axis (num_rows becomes an int32 vector)."""
@@ -64,13 +141,13 @@ def stack_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         parts = [b.columns[ci] for b in batches]
         if isinstance(parts[0], StringColumn):
             cols.append(StringColumn(
-                jnp.stack([p.chars for p in parts]),
-                jnp.stack([p.lengths for p in parts]),
-                jnp.stack([p.validity for p in parts])))
+                _stack_parts([p.chars for p in parts]),
+                _stack_parts([p.lengths for p in parts]),
+                _stack_parts([p.validity for p in parts])))
         else:
             cols.append(Column(
-                jnp.stack([p.data for p in parts]),
-                jnp.stack([p.validity for p in parts]),
+                _stack_parts([p.data for p in parts]),
+                _stack_parts([p.validity for p in parts]),
                 parts[0].dtype))
     n_rows = jnp.asarray([b.concrete_num_rows() for b in batches], jnp.int32)
     return ColumnarBatch(cols, n_rows, schema)
@@ -79,16 +156,20 @@ def stack_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
 def unstack_batch(stacked: ColumnarBatch) -> list[ColumnarBatch]:
     n_dev = stacked.columns[0].data.shape[0] if isinstance(
         stacked.columns[0], Column) else stacked.columns[0].chars.shape[0]
+    counts = np.asarray(jax.device_get(stacked.num_rows))
     out = []
     for d in range(n_dev):
         cols: list[AnyColumn] = []
         for c in stacked.columns:
             if isinstance(c, StringColumn):
-                cols.append(StringColumn(c.chars[d], c.lengths[d],
-                                         c.validity[d]))
+                cols.append(StringColumn(take_piece(c.chars, (d,)),
+                                         take_piece(c.lengths, (d,)),
+                                         take_piece(c.validity, (d,))))
             else:
-                cols.append(Column(c.data[d], c.validity[d], c.dtype))
-        out.append(ColumnarBatch(cols, int(stacked.num_rows[d]),
+                cols.append(Column(take_piece(c.data, (d,)),
+                                   take_piece(c.validity, (d,)),
+                                   c.dtype))
+        out.append(ColumnarBatch(cols, int(counts[d]),
                                  stacked.schema))
     return out
 
@@ -202,7 +283,7 @@ def make_hash_exchange_step(
 
     mapped = _shard_map(shard_fn, mesh, P(axis_name),
                        P(axis_name))
-    return jax.jit(mapped)
+    return _sharded_jit(mapped)
 
 
 def make_route_step(
@@ -227,7 +308,7 @@ def make_route_step(
     in_specs = (P(axis_name),) + (P(),) * n_extra
     mapped = _shard_map(shard_fn, mesh, in_specs,
                        P(axis_name))
-    return jax.jit(mapped)
+    return _sharded_jit(mapped)
 
 
 def make_local_step(
@@ -244,7 +325,7 @@ def make_local_step(
 
     mapped = _shard_map(shard_fn, mesh, P(axis_name),
                        P(axis_name))
-    return jax.jit(mapped)
+    return _sharded_jit(mapped)
 
 
 def make_join_step(
@@ -266,4 +347,4 @@ def make_join_step(
     mapped = _shard_map(wrapped, mesh,
                         (P(axis_name), P(axis_name)),
                         (P(axis_name), P(axis_name)))
-    return jax.jit(mapped)
+    return _sharded_jit(mapped)

@@ -77,6 +77,28 @@ SPECULATION_TEST_FORCE_CAPACITY = register(
     "under-/over-speculation paths deterministically).",
     internal=True)
 
+SPECULATION_ADAPTIVE_MIN_HIT_RATE = register(
+    "spark.rapids.tpu.sql.speculation.adaptive.minHitRate", 0.0,
+    "Adaptive kill-switch: when > 0, a predictor TAG (join.probe, "
+    "agg.size, ...) whose rolling hit rate over the last "
+    "speculation.adaptive.window outcomes falls below this is "
+    "auto-DISABLED for the rest of the process (or until "
+    "reset_stats) — its execs revert to the conservative blocking "
+    "sizing sync.  BISECT_q3_r07's conviction: a workload whose output "
+    "counts the EWMA cannot track pays continuation chunks on every "
+    "batch, and turning speculation off recovered 1.294x on q3.  The "
+    "disable lands as a speculation.disabled event-log counter and a "
+    "speculation.disabled trace instant; 0.0 = never disable.",
+    check=lambda v: 0.0 <= v <= 1.0)
+
+SPECULATION_ADAPTIVE_WINDOW = register(
+    "spark.rapids.tpu.sql.speculation.adaptive.window", 16,
+    "Rolling outcome-window length per predictor tag for the adaptive "
+    "kill-switch: the hit rate is judged only once this many "
+    "speculative dispatches (hits + overflows) have been observed, so "
+    "one unlucky warm-up batch cannot convict a tag.",
+    check=lambda v: v >= 2)
+
 #: EWMA step: ~4 batches of memory — fast enough to track a selectivity
 #: shift mid-stream, slow enough that one outlier batch does not thrash
 #: the bucket choice
@@ -166,6 +188,12 @@ def reset_predictors() -> None:
 _STATS: dict[str, dict] = {}
 _STATS_LOCK = threading.Lock()
 
+#: per-tag rolling outcome window (True = hit) for the adaptive
+#: kill-switch, plus the set of convicted tags
+_WINDOWS: dict[str, "collections.deque"] = {}
+_DISABLED: set[str] = set()
+_DISABLED_TOTAL = 0
+
 
 def _stat(tag: str) -> dict:
     s = _STATS.get(tag)
@@ -174,14 +202,46 @@ def _stat(tag: str) -> dict:
     return s
 
 
+def _observe_outcome_locked(tag: str, hit: bool) -> bool:
+    """Feed the tag's rolling window; returns True when this outcome
+    just convicted the tag (caller emits the events OUTSIDE the
+    lock).  Caller holds _STATS_LOCK."""
+    global _DISABLED_TOTAL
+    conf = get_conf()
+    min_rate = float(conf.get(SPECULATION_ADAPTIVE_MIN_HIT_RATE))
+    if min_rate <= 0.0 or tag in _DISABLED:
+        return False
+    window = int(conf.get(SPECULATION_ADAPTIVE_WINDOW))
+    w = _WINDOWS.get(tag)
+    if w is None or w.maxlen != window:
+        w = _WINDOWS[tag] = collections.deque(w or (), maxlen=window)
+    w.append(hit)
+    if len(w) < window:
+        return False
+    if sum(w) / float(window) >= min_rate:
+        return False
+    _DISABLED.add(tag)
+    _DISABLED_TOTAL += 1
+    return True
+
+
+def _note_disabled(tag: str, rate: float) -> None:
+    _P._trace("spec_disabled", tag)
+    if _tr.TRACER.enabled:
+        _tr.event("speculation.disabled", tag=tag, hit_rate=rate)
+
+
 def record_hit(tag: str, cap: int = 0, actual: int = 0) -> None:
     """The speculated capacity covered the true count: the batch ran
     with ZERO blocking sizing syncs."""
     with _STATS_LOCK:
         _stat(tag)["hits"] += 1
+        tripped = _observe_outcome_locked(tag, True)
     _P._trace("spec_hit", tag)
     if _tr.TRACER.enabled:
         _tr.event("speculation.hit", tag=tag, cap=cap, actual=actual)
+    if tripped:
+        _note_disabled(tag, hit_rate((tag,)))
 
 
 def record_overflow(tag: str, cap: int = 0, actual: int = 0) -> None:
@@ -189,16 +249,45 @@ def record_overflow(tag: str, cap: int = 0, actual: int = 0) -> None:
     continued with chunks from offset=cap (no rollback)."""
     with _STATS_LOCK:
         _stat(tag)["overflows"] += 1
+        tripped = _observe_outcome_locked(tag, False)
     _P._trace("spec_overflow", tag)
     if _tr.TRACER.enabled:
         _tr.event("speculation.overflow", tag=tag, cap=cap,
                   actual=actual)
+    if tripped:
+        _note_disabled(tag, hit_rate((tag,)))
 
 
 def record_sync(tag: str) -> None:
     """A conservative blocking sizing sync (warm-up batch)."""
     with _STATS_LOCK:
         _stat(tag)["synced"] += 1
+
+
+def tag_enabled(tag: str) -> bool:
+    """False once the adaptive kill-switch convicted this tag — the
+    exec should skip predictor creation / speculation and pay the
+    blocking sizing sync (which the kill-switch has just proven
+    cheaper than the continuation-chunk churn)."""
+    with _STATS_LOCK:
+        return tag not in _DISABLED
+
+
+def disabled_tags() -> list[str]:
+    """Tags the adaptive kill-switch has disabled, sorted (bench.py's
+    ``q*_speculation_disabled`` field)."""
+    with _STATS_LOCK:
+        return sorted(_DISABLED)
+
+
+def disabled_total() -> int:
+    """Cumulative count of kill-switch trips this process (the
+    ``speculation.disabled`` event-log counter; monotonic across
+    reset_stats like every other eventlog counter source is NOT —
+    this one survives reset_stats precisely so per-query deltas in
+    the event log attribute the trip to the query that caused it)."""
+    with _STATS_LOCK:
+        return _DISABLED_TOTAL
 
 
 def stats() -> dict[str, dict]:
@@ -210,9 +299,15 @@ def stats() -> dict[str, dict]:
 
 def reset_stats() -> None:
     """bench.py resets between benchmark queries so hit rates report
-    PER QUERY (the reset_stage_counters discipline)."""
+    PER QUERY (the reset_stage_counters discipline).  Also re-arms the
+    adaptive kill-switch (windows + convicted tags) so one query's
+    conviction does not bleed into the next query's measurement; the
+    cumulative ``disabled_total`` survives so event-log deltas stay
+    monotonic."""
     with _STATS_LOCK:
         _STATS.clear()
+        _WINDOWS.clear()
+        _DISABLED.clear()
 
 
 def hit_rate(tags=None) -> float:

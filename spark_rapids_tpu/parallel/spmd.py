@@ -63,6 +63,7 @@ from spark_rapids_tpu.parallel.exchange import (
     _squeeze0,
     _unsqueeze0,
     route_shard,
+    take_piece,
 )
 from spark_rapids_tpu.parallel.mesh import DATA_AXIS, mesh_key
 
@@ -136,14 +137,18 @@ def unify_batches(batches: Sequence[ColumnarBatch]
 # ------------------------------------------------------------------ #
 
 
-def _assemble(mesh, per_dev: list) -> jax.Array:
+def _assemble(mesh, per_dev: list, control: bool = False) -> jax.Array:
     """One global (R, n, ...) array from one (R, ...) piece per mesh
-    device: each piece is device_put onto ITS shard's device and the
-    global array is assembled without ever materializing a
-    host-stacked copy (`jax.make_array_from_single_device_arrays` —
-    the NamedSharding idiom of SNIPPETS [3])."""
+    device: each piece is placed onto ITS shard's device through
+    parallel/placement.py (device-born pieces are adopted zero-copy;
+    host-born ones are counted and uploaded) and the global array is
+    assembled without ever materializing a host-stacked copy
+    (`jax.make_array_from_single_device_arrays` — the NamedSharding
+    idiom of SNIPPETS [3])."""
+    from spark_rapids_tpu.parallel import placement as _placement
+
     devs = list(mesh.devices.flat)
-    pieces = [jax.device_put(p[:, None], d)
+    pieces = [_placement.place_piece(p[:, None], d, control=control)
               for p, d in zip(per_dev, devs)]
     shape = (per_dev[0].shape[0], len(devs)) + tuple(
         per_dev[0].shape[1:])
@@ -199,7 +204,7 @@ def shard_stack_rounds(rounds: Sequence[Sequence[ColumnarBatch]],
     num_rows = _assemble(mesh, [
         np.asarray([at(r, d).concrete_num_rows()
                     for r in range(r_count)], np.int32)
-        for d in range(n)])
+        for d in range(n)], control=True)
     return ColumnarBatch(cols, num_rows, schema)
 
 
@@ -224,7 +229,8 @@ def sample_fracs(mesh, n_rounds: int, k: int,
     n = int(mesh.shape[DATA_AXIS])
     rng = np.random.default_rng(seed)
     fr = rng.random((n_rounds, n, k), dtype=np.float32)
-    return _assemble(mesh, [fr[:, d] for d in range(n)])
+    # host-chosen control plane (k floats per round-shard), not data
+    return _assemble(mesh, [fr[:, d] for d in range(n)], control=True)
 
 
 # ------------------------------------------------------------------ #
@@ -247,62 +253,94 @@ def fetch(arr) -> np.ndarray:
     return np.asarray(jax.device_get(arr))
 
 
-def _slice_shard(batch: ColumnarBatch, idx: tuple,
-                 rows: int) -> ColumnarBatch:
+def _slice_shard(batch: ColumnarBatch, idx: tuple, rows: int,
+                 device=None) -> ColumnarBatch:
+    # take_piece, not plain getitem: the (round, shard) piece of a
+    # partitioned stage output is wholly resident on one device, and
+    # an eager getitem on the sharded array would launch an unguarded
+    # cross-device gather (exchange.take_piece documents the hazard)
     cols: list[AnyColumn] = []
     for c in batch.columns:
         if isinstance(c, StringColumn):
-            cols.append(StringColumn(c.chars[idx], c.lengths[idx],
-                                     c.validity[idx]))
+            cols.append(StringColumn(take_piece(c.chars, idx),
+                                     take_piece(c.lengths, idx),
+                                     take_piece(c.validity, idx)))
         else:
-            cols.append(Column(c.data[idx], c.validity[idx], c.dtype))
+            cols.append(Column(take_piece(c.data, idx),
+                               take_piece(c.validity, idx), c.dtype))
     out = ColumnarBatch(cols, rows, batch.schema)
-    return out.shrink_to_capacity(max(MIN_CAPACITY,
-                                      pad_capacity(rows)))
+    out = out.shrink_to_capacity(max(MIN_CAPACITY,
+                                     pad_capacity(rows)))
+    if device is not None:
+        from spark_rapids_tpu.parallel import placement as _placement
+        out = _placement.adopt_batch(out, device)
+    return out
+
+
+def _adoption_devices(mesh) -> Optional[list]:
+    """Mesh device list when producer-side adoption is on (mesh
+    serving), else None — the default keeps shrink outputs wherever
+    slicing left them, bit-for-bit the pre-placement behavior."""
+    if mesh is None:
+        return None
+    from spark_rapids_tpu.serving import mesh_serving_enabled
+    if not mesh_serving_enabled():
+        return None
+    return list(mesh.devices.flat)
 
 
 def unstack_stage(batch: ColumnarBatch,
-                  counts: Optional[np.ndarray] = None
-                  ) -> list[ColumnarBatch]:
+                  counts: Optional[np.ndarray] = None,
+                  mesh=None) -> list[ColumnarBatch]:
     """Split a (n, capacity, ...) stage output into n shrunk per-shard
-    batches using the stage-exit counts (fetched once if not given)."""
+    batches using the stage-exit counts (fetched once if not given).
+    Under mesh serving (pass the mesh) shard d's batch adopts mesh
+    device d at this producer boundary."""
     if counts is None:
         counts = stage_counts(batch)
-    return [_slice_shard(batch, (d,), int(counts[d]))
+    devs = _adoption_devices(mesh)
+    return [_slice_shard(batch, (d,), int(counts[d]),
+                         devs[d] if devs else None)
             for d in range(counts.shape[0])]
 
 
 def unstack_round_stage(batch: ColumnarBatch,
-                        counts: Optional[np.ndarray] = None
-                        ) -> list[list[ColumnarBatch]]:
+                        counts: Optional[np.ndarray] = None,
+                        mesh=None) -> list[list[ColumnarBatch]]:
     """Split a (R, n, capacity, ...) stage output into per-shard lists
     of per-round shrunk batches (empty rounds dropped)."""
     if counts is None:
         counts = stage_counts(batch)
     r_count, n = counts.shape
+    devs = _adoption_devices(mesh)
     out: list[list[ColumnarBatch]] = [[] for _ in range(n)]
     for d in range(n):
         for r in range(r_count):
             rows = int(counts[r, d])
             if rows:
-                out[d].append(_slice_shard(batch, (r, d), rows))
+                out[d].append(_slice_shard(
+                    batch, (r, d), rows, devs[d] if devs else None))
     return out
 
 
 def shrink_rounds(batch: ColumnarBatch,
-                  counts: Optional[np.ndarray] = None
-                  ) -> list[list[ColumnarBatch]]:
+                  counts: Optional[np.ndarray] = None,
+                  mesh=None) -> list[list[ColumnarBatch]]:
     """THE mid-stage shrink: split a (R, n, capacity, ...) exchange
     program output into a rectangular rounds[r][d] grid of shrunk
     batches (empty rounds kept), using ONE stage-exit counts fetch.
     The exchange program's outputs carry the worst-case n x cap
     receive capacity per shard; shrinking here — once per stage, not
     once per round — is what keeps the tail program's merge/sort/join
-    work proportional to the LIVE rows instead of the padding."""
+    work proportional to the LIVE rows instead of the padding.  Under
+    mesh serving each shard column adopts its mesh device here, so the
+    tail program's re-assembly finds every piece device-born."""
     if counts is None:
         counts = stage_counts(batch)
     r_count, n = counts.shape
-    return [[_slice_shard(batch, (r, d), int(counts[r, d]))
+    devs = _adoption_devices(mesh)
+    return [[_slice_shard(batch, (r, d), int(counts[r, d]),
+                          devs[d] if devs else None)
              for d in range(n)]
             for r in range(r_count)]
 
@@ -454,6 +492,76 @@ def _all_gather_concat(b: ColumnarBatch, n: int,
     idx = jnp.arange(n * cap, dtype=jnp.int32)
     live = (idx % cap) < jnp.take(rows_all, idx // cap)
     return ColumnarBatch(cols, n * cap, b.schema).compact(live)
+
+
+def make_sort_sample_stage(mesh, key: tuple, part, n_rounds: int,
+                           sample_k: int, op: Optional[str] = None):
+    """Pass 1 of the BUCKETED distributed ORDER BY (mesh serving,
+    docs/pod_serving.md): scan one bucket's rounds gathering per-shard
+    sort-key samples at host-chosen fractional positions — the sample
+    half of `make_sort_route_stage`, emitted as a round-stacked stage
+    OUTPUT instead of being consumed in-program.  A million-round sort
+    samples bucket by bucket (one bucket stacked at a time) instead of
+    assembling every round into one resident global array.  Inputs are
+    NOT donated: the same rounds re-stack for the route pass."""
+    axis = DATA_AXIS
+
+    def make():
+        def shard_fn(xs: ColumnarBatch, fracs: jax.Array):
+            def sample_body(carry, xf):
+                x, frac = xf
+                b = _squeeze0(x)
+                kb = part.key_batch(b)
+                rows = jnp.asarray(b.num_rows, jnp.int32)
+                cap = b.capacity
+                pos = jnp.clip(
+                    (frac[0] * rows.astype(jnp.float32)).astype(
+                        jnp.int32),
+                    0, jnp.maximum(rows - 1, 0))
+                n_valid = (sample_k * rows + cap - 1) // cap
+                return carry, _unsqueeze0(kb.gather(pos, n_valid))
+            _, samples = jax.lax.scan(sample_body, jnp.int32(0),
+                                      (xs, fracs))
+            return samples
+
+        return _shard_map(
+            shard_fn, mesh, (P(None, axis), P(None, axis)),
+            P(None, axis))
+
+    return _stage_jit(
+        ("spmdsortsample", key, n_rounds, sample_k), make, mesh, op,
+        (rounds_sharding(mesh), rounds_sharding(mesh)),
+        rounds_sharding(mesh), None, n_rounds)
+
+
+def make_bounds_route_stage(mesh, key: tuple, part, n_rounds: int,
+                            op: Optional[str] = None,
+                            donate: bool = False):
+    """Pass 2 of the bucketed distributed ORDER BY: scan one bucket's
+    rounds through the range-routed all_to_all, with the bounds batch
+    riding as a REPLICATED program argument (the make_route_step
+    idiom) — one compiled program serves every bounds value, so the
+    bucket count never mints executables."""
+    n = int(mesh.shape[DATA_AXIS])
+    axis = DATA_AXIS
+
+    def make():
+        def shard_fn(xs: ColumnarBatch, bounds: ColumnarBatch):
+            def route_body(carry, x):
+                b = _squeeze0(x)
+                pid = part.partition_ids_with_bounds(b, bounds)
+                return carry, _unsqueeze0(
+                    route_shard(b, pid, n, axis))
+            _, routed = jax.lax.scan(route_body, jnp.int32(0), xs)
+            return routed
+
+        return _shard_map(
+            shard_fn, mesh, (P(None, axis), P()), P(None, axis))
+
+    return _stage_jit(
+        ("spmdboundsroute", key, n_rounds), make, mesh, op,
+        (rounds_sharding(mesh), NamedSharding(mesh, P())),
+        rounds_sharding(mesh), (0,) if donate else None, n_rounds)
 
 
 def make_sort_route_stage(mesh, key: tuple, part, n_rounds: int,

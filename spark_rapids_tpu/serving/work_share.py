@@ -374,14 +374,22 @@ _KEY_MEMO_LOCK = threading.Lock()
 def _plan_key(plan, conf) -> Optional[str]:
     from spark_rapids_tpu.eventlog import conf_fingerprint
     from spark_rapids_tpu.plan.share_key import plan_share_key
+    from spark_rapids_tpu.serving import mesh_cache_suffix
 
-    fp = conf_fingerprint(conf)
+    # mesh suffix in BOTH the memo key and the result key: a cached
+    # result's row ORDER is execution-shaped (mesh width changes
+    # partition interleaving), so a result minted on one mesh must not
+    # serve another (docs/pod_serving.md)
+    mesh_sfx = mesh_cache_suffix(conf)
+    fp = conf_fingerprint(conf) + mesh_sfx
     pid = id(plan)
     with _KEY_MEMO_LOCK:
         memo = _KEY_MEMO.get(pid)
         if memo is not None and memo[0]() is plan and memo[1] == fp:
             return memo[2]
     key = plan_share_key(plan, conf)
+    if key is not None and mesh_sfx:
+        key = key + mesh_sfx
     try:
         ref = weakref.ref(plan)
     except TypeError:

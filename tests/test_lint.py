@@ -666,6 +666,60 @@ def test_source_lint_persist_rule_scoped_and_exempt():
             lint_source_text(_RAW_PERSIST_FIXTURE, path)), path
 
 
+_RAW_DEVICE_PUT_FIXTURE = """
+import jax
+from jax import device_put
+
+from spark_rapids_tpu.parallel import placement
+
+
+def leak_put(piece, dev):
+    return jax.device_put(piece, dev)        # SRC016: raw move
+
+
+def leak_bare(piece, dev):
+    return device_put(piece, dev)            # SRC016: imported form
+
+
+def blessed(piece, dev):
+    return placement.place_piece(piece, dev)
+
+
+def blessed_batch(batch, dev):
+    return placement.adopt_batch(batch, dev)
+"""
+
+
+def test_source_lint_flags_raw_device_put():
+    """SRC016: a raw `jax.device_put` (or bare imported `device_put`)
+    in execs//parallel/ is an ERROR — the transfer bypasses the
+    placement choke point's host-upload/device-born/d2d classification
+    and so escapes the pod-serving zero-host-upload gate
+    (docs/pod_serving.md)."""
+    for path in ("spark_rapids_tpu/execs/fake.py",
+                 "spark_rapids_tpu/parallel/fake.py"):
+        diags = lint_source_text(_RAW_DEVICE_PUT_FIXTURE, path)
+        hits = [d for d in diags if d.rule == "SRC016"]
+        assert len(hits) == 2, (path, diags)
+        assert all(h.severity == "error" for h in hits)
+        locs = " ".join(h.location for h in hits)
+        assert "leak_put" in locs and "leak_bare" in locs
+        assert "blessed" not in locs
+    assert evaluate(lint_source_text(
+        _RAW_DEVICE_PUT_FIXTURE, "spark_rapids_tpu/execs/fake.py"))[2] != 0
+
+
+def test_source_lint_device_put_rule_scoped_and_exempt():
+    """SRC016 exempts parallel/placement.py (it IS the classified
+    mover) and does not police layers outside execs//parallel/ (the
+    columnar upload path and memory tier have their own counters)."""
+    for path in ("spark_rapids_tpu/parallel/placement.py",
+                 "spark_rapids_tpu/columnar/fake.py",
+                 "spark_rapids_tpu/memory/fake.py"):
+        assert "SRC016" not in rules(
+            lint_source_text(_RAW_DEVICE_PUT_FIXTURE, path)), path
+
+
 _DONATE_FIXTURE = """
 from spark_rapids_tpu.columnar.transfer import run_consuming
 from spark_rapids_tpu.execs.jit_cache import cached_jit

@@ -379,6 +379,60 @@ def test_speculation_stats_and_hit_rate():
     assert SP.stats() == {}
 
 
+def test_adaptive_kill_switch_convicts_a_cold_tag():
+    """The adaptive kill-switch (speculation.adaptive.minHitRate):
+    a tag whose rolling hit rate over a FULL window falls below the
+    threshold is auto-disabled — tag_enabled() goes False (the
+    predictor-creation sites consult it, reverting the operator to
+    honest synchronous sizing), the tag lands in disabled_tags(), and
+    the monotonic disabled_total() feeds the `speculation.disabled`
+    event-log counter.  A healthy tag is untouched, and reset_stats
+    re-arms the windows WITHOUT rewinding the monotonic total."""
+    conf = get_conf()
+    conf.set("spark.rapids.tpu.sql.speculation.adaptive.minHitRate",
+             0.5)
+    conf.set("spark.rapids.tpu.sql.speculation.adaptive.window", 4)
+    total0 = SP.disabled_total()
+    # three misses do NOT convict: the window must be FULL first (one
+    # unlucky warm-up batch cannot disable a tag)
+    for _ in range(3):
+        SP.record_overflow("kill.cold", 64, 100)
+    assert SP.tag_enabled("kill.cold")
+    SP.record_overflow("kill.cold", 64, 100)
+    assert not SP.tag_enabled("kill.cold")
+    assert "kill.cold" in SP.disabled_tags()
+    assert SP.disabled_total() == total0 + 1
+    # healthy tag: full window of hits stays enabled
+    for _ in range(5):
+        SP.record_hit("kill.warm", 128, 60)
+    assert SP.tag_enabled("kill.warm")
+    assert "kill.warm" not in SP.disabled_tags()
+    # further outcomes on a convicted tag don't re-convict (the total
+    # stays monotone and exact)
+    SP.record_overflow("kill.cold", 64, 100)
+    assert SP.disabled_total() == total0 + 1
+    # the eventlog counter surface reads the same monotonic total
+    from spark_rapids_tpu.eventlog import counters_snapshot
+
+    assert counters_snapshot()["speculation.disabled"] == \
+        SP.disabled_total()
+    # reset re-arms (fresh window, tag enabled again) but never
+    # rewinds the monotonic total (eventlog deltas clamp at >= 0)
+    SP.reset_stats()
+    assert SP.tag_enabled("kill.cold")
+    assert SP.disabled_total() == total0 + 1
+
+
+def test_adaptive_kill_switch_off_by_default():
+    """With the default minHitRate=0.0 the kill-switch never engages:
+    any number of overflows leaves the tag enabled (bit-for-bit the
+    pre-adaptive engine)."""
+    for _ in range(32):
+        SP.record_overflow("kill.default", 8, 999)
+    assert SP.tag_enabled("kill.default")
+    assert SP.disabled_tags() == []
+
+
 def test_jit_cache_stats_counters():
     from spark_rapids_tpu.execs import jit_cache as JC
 
